@@ -1,0 +1,59 @@
+"""skypilot_tpu — a TPU-native cloud-workload orchestrator.
+
+A ground-up, TPU-first rebuild of the capabilities of SkyPilot
+(reference surveyed in SURVEY.md): declarative Task YAML, an optimizer that
+cost-ranks TPU pod slices against GPUs, GCP provisioning with cross-zone
+failover, SSH gang scheduling across all hosts of a multi-host slice with
+``jax.distributed`` rendezvous injected, job queue + log streaming + autostop,
+managed jobs with preemption recovery, and autoscaled serving.
+
+The compute layer (``skypilot_tpu.models``, ``.ops``, ``.parallel``) is
+idiomatic JAX/XLA: ``jax.sharding`` meshes, XLA collectives over ICI/DCN, and
+Pallas kernels — replacing the Ray/NCCL patterns the reference orchestrates.
+
+Public API parity target: ``sky/__init__.py`` in the reference.
+"""
+
+__version__ = '0.1.0'
+
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+# Lazy-loaded heavy entry points (importing execution pulls in backends).
+_LAZY_ATTRS = {
+    'launch': ('skypilot_tpu.execution', 'launch'),
+    'exec': ('skypilot_tpu.execution', 'exec_'),
+    'Optimizer': ('skypilot_tpu.optimizer', 'Optimizer'),
+    'OptimizeTarget': ('skypilot_tpu.optimizer', 'OptimizeTarget'),
+    'status': ('skypilot_tpu.core', 'status'),
+    'start': ('skypilot_tpu.core', 'start'),
+    'stop': ('skypilot_tpu.core', 'stop'),
+    'down': ('skypilot_tpu.core', 'down'),
+    'autostop': ('skypilot_tpu.core', 'autostop'),
+    'queue': ('skypilot_tpu.core', 'queue'),
+    'cancel': ('skypilot_tpu.core', 'cancel'),
+    'tail_logs': ('skypilot_tpu.core', 'tail_logs'),
+    'cost_report': ('skypilot_tpu.core', 'cost_report'),
+    'Storage': ('skypilot_tpu.data.storage', 'Storage'),
+    'StorageMode': ('skypilot_tpu.data.storage', 'StorageMode'),
+    'StoreType': ('skypilot_tpu.data.storage', 'StoreType'),
+    'ClusterStatus': ('skypilot_tpu.global_state', 'ClusterStatus'),
+    'JobStatus': ('skypilot_tpu.skylet.job_lib', 'JobStatus'),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY_ATTRS:
+        import importlib
+        module_name, attr = _LAZY_ATTRS[name]
+        return getattr(importlib.import_module(module_name), attr)
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
+
+
+__all__ = [
+    'Dag',
+    'Resources',
+    'Task',
+    '__version__',
+] + list(_LAZY_ATTRS)

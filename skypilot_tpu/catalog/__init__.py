@@ -1,0 +1,298 @@
+"""Service catalog: accelerator/instance pricing + feasibility lookups.
+
+Parity: ``sky/clouds/service_catalog/`` (``common.py:331,507,558``), redesigned
+TPU-first: TPU slices are priced **per chip-hour with the host included**
+(parity note: ``gcp_catalog.py:243-254`` — TPU-VM host machines are not priced
+separately), so slice cost = chips × $/chip-hr, and feasibility is a function
+of valid slice sizes (``topology.valid_chip_counts``), not instance SKUs.
+
+Data lives in bundled CSVs under ``catalog/data/`` (authored from public list
+prices; refreshable by ``skypilot_tpu.catalog.fetchers`` when network access
+exists).
+"""
+import dataclasses
+import functools
+import os
+from typing import Dict, List, Optional, Tuple
+
+import pandas as pd
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import topology as topo_lib
+
+_DATA_DIR = os.path.join(os.path.dirname(__file__), 'data')
+
+# Catalog override dir for tests / refreshed data.
+CATALOG_DIR_ENV = 'SKYTPU_CATALOG_DIR'
+
+
+def _catalog_path(name: str) -> str:
+    override_dir = os.environ.get(CATALOG_DIR_ENV)
+    if override_dir:
+        candidate = os.path.join(os.path.expanduser(override_dir), name)
+        if os.path.exists(candidate):
+            return candidate
+    return os.path.join(_DATA_DIR, name)
+
+
+@functools.lru_cache(maxsize=None)
+def _read_csv(name: str) -> pd.DataFrame:
+    df = pd.read_csv(_catalog_path(name))
+    return df
+
+
+def _tpu_df() -> pd.DataFrame:
+    return _read_csv('gcp_tpus.csv')
+
+
+def _vm_df() -> pd.DataFrame:
+    return _read_csv('gcp_vms.csv')
+
+
+def invalidate_cache() -> None:
+    _read_csv.cache_clear()
+
+
+@dataclasses.dataclass
+class InstanceTypeInfo:
+    """One priced SKU row (parity: service_catalog.common.InstanceTypeInfo)."""
+    cloud: str
+    instance_type: str
+    accelerator_name: Optional[str]
+    accelerator_count: float
+    cpu_count: Optional[float]
+    memory_gb: Optional[float]
+    price: float
+    spot_price: float
+    region: str
+    zone: Optional[str]
+
+
+# ---------------------------------------------------------------- TPU slices
+
+
+def tpu_regions_zones(generation_name: str,
+                      region: Optional[str] = None,
+                      zone: Optional[str] = None) -> List[Tuple[str, str]]:
+    """(region, zone) pairs offering this TPU generation, cheapest first."""
+    df = _tpu_df()
+    df = df[df['AcceleratorName'] == f'tpu-{generation_name}']
+    if region is not None:
+        df = df[df['Region'] == region]
+    if zone is not None:
+        df = df[df['AvailabilityZone'] == zone]
+    df = df.sort_values('PricePerChipHour')
+    return list(df[['Region', 'AvailabilityZone']].itertuples(index=False,
+                                                              name=None))
+
+
+def tpu_price_per_chip_hour(generation_name: str,
+                            region: str,
+                            use_spot: bool = False) -> Optional[float]:
+    df = _tpu_df()
+    rows = df[(df['AcceleratorName'] == f'tpu-{generation_name}') &
+              (df['Region'] == region)]
+    if rows.empty:
+        return None
+    col = 'SpotPricePerChipHour' if use_spot else 'PricePerChipHour'
+    return float(rows.iloc[0][col])
+
+
+def tpu_slice_hourly_cost(slice_topology: topo_lib.TpuSliceTopology,
+                          region: str,
+                          use_spot: bool = False) -> Optional[float]:
+    per_chip = tpu_price_per_chip_hour(slice_topology.generation.name, region,
+                                       use_spot)
+    if per_chip is None:
+        return None
+    return per_chip * slice_topology.num_chips
+
+
+# ------------------------------------------------------------- VM instances
+
+
+def instance_type_exists(instance_type: str) -> bool:
+    return bool((_vm_df()['InstanceType'] == instance_type).any())
+
+
+def get_vcpus_mem_from_instance_type(
+        instance_type: str) -> Tuple[Optional[float], Optional[float]]:
+    df = _vm_df()
+    rows = df[df['InstanceType'] == instance_type]
+    if rows.empty:
+        return None, None
+    row = rows.iloc[0]
+    return float(row['vCPUs']), float(row['MemoryGiB'])
+
+
+def get_hourly_cost(instance_type: str,
+                    region: Optional[str] = None,
+                    use_spot: bool = False) -> Optional[float]:
+    df = _vm_df()
+    rows = df[df['InstanceType'] == instance_type]
+    if region is not None:
+        rows = rows[rows['Region'] == region]
+    if rows.empty:
+        return None
+    col = 'SpotPrice' if use_spot else 'Price'
+    return float(rows[col].min())
+
+
+def get_accelerators_from_instance_type(
+        instance_type: str) -> Optional[Dict[str, float]]:
+    df = _vm_df()
+    rows = df[df['InstanceType'] == instance_type]
+    if rows.empty:
+        return None
+    row = rows.iloc[0]
+    name = row['AcceleratorName']
+    if pd.isna(name) or not str(name):
+        return None
+    return {str(name): float(row['AcceleratorCount'])}
+
+
+def get_instance_type_for_accelerator(
+        acc_name: str,
+        acc_count: float,
+        cpus: Optional[str] = None,
+        memory: Optional[str] = None,
+        region: Optional[str] = None,
+        zone: Optional[str] = None) -> Optional[List[str]]:
+    """GPU accelerator → hosting instance types, cheapest first.
+
+    Parity: ``service_catalog/common.py:507``
+    (get_instance_type_for_accelerator_impl). TPUs never route here — they
+    are slices, not instance-attached devices.
+    """
+    df = _vm_df()
+    rows = df[(df['AcceleratorName'] == acc_name) &
+              (df['AcceleratorCount'] == acc_count)]
+    if region is not None:
+        rows = rows[rows['Region'] == region]
+    if zone is not None:
+        rows = rows[rows['AvailabilityZone'] == zone]
+    rows = _filter_cpus_mem(rows, cpus, memory)
+    if rows.empty:
+        return None
+    rows = rows.sort_values('Price')
+    return list(dict.fromkeys(rows['InstanceType'].tolist()))
+
+
+def get_default_instance_type(cpus: Optional[str] = None,
+                              memory: Optional[str] = None) -> Optional[str]:
+    """Cheapest CPU-only instance satisfying cpus/memory ('8', '8+')."""
+    df = _vm_df()
+    rows = df[df['AcceleratorName'].isna() | (df['AcceleratorName'] == '')]
+    if cpus is None and memory is None:
+        rows = rows[rows['vCPUs'] >= 8]  # parity: default 8 vCPUs
+    rows = _filter_cpus_mem(rows, cpus, memory)
+    if rows.empty:
+        return None
+    return str(rows.sort_values('Price').iloc[0]['InstanceType'])
+
+
+def _filter_cpus_mem(rows: pd.DataFrame, cpus: Optional[str],
+                     memory: Optional[str]) -> pd.DataFrame:
+    if cpus is not None:
+        s = str(cpus)
+        if s.endswith('+'):
+            rows = rows[rows['vCPUs'] >= float(s[:-1])]
+        else:
+            rows = rows[rows['vCPUs'] == float(s)]
+    if memory is not None:
+        s = str(memory)
+        if s.endswith('+'):
+            rows = rows[rows['MemoryGiB'] >= float(s[:-1])]
+        else:
+            rows = rows[rows['MemoryGiB'] == float(s)]
+    return rows
+
+
+def vm_regions_zones(instance_type: str,
+                     region: Optional[str] = None,
+                     zone: Optional[str] = None) -> List[Tuple[str, str]]:
+    df = _vm_df()
+    rows = df[df['InstanceType'] == instance_type]
+    if region is not None:
+        rows = rows[rows['Region'] == region]
+    if zone is not None:
+        rows = rows[rows['AvailabilityZone'] == zone]
+    rows = rows.sort_values('Price')
+    return list(rows[['Region', 'AvailabilityZone']].itertuples(index=False,
+                                                                name=None))
+
+
+# -------------------------------------------------------------- listings
+
+
+def list_accelerators(
+        gpus_only: bool = False,
+        name_filter: Optional[str] = None) -> Dict[str, List[InstanceTypeInfo]]:
+    """All accelerators (TPU slices and GPUs) with prices.
+
+    Parity: ``service_catalog/common.py:331`` (list_accelerators_impl),
+    feeding `sky show-gpus`-style listings.
+    """
+    result: Dict[str, List[InstanceTypeInfo]] = {}
+    if not gpus_only:
+        df = _tpu_df()
+        for _, row in df.iterrows():
+            name = str(row['AcceleratorName'])
+            if name_filter and name_filter.lower() not in name.lower():
+                continue
+            result.setdefault(name, []).append(
+                InstanceTypeInfo(cloud='GCP',
+                                 instance_type='TPU-VM',
+                                 accelerator_name=name,
+                                 accelerator_count=1,
+                                 cpu_count=None,
+                                 memory_gb=None,
+                                 price=float(row['PricePerChipHour']),
+                                 spot_price=float(
+                                     row['SpotPricePerChipHour']),
+                                 region=str(row['Region']),
+                                 zone=str(row['AvailabilityZone'])))
+    df = _vm_df()
+    gpu_rows = df[df['AcceleratorName'].notna() & (df['AcceleratorName'] != '')]
+    for _, row in gpu_rows.iterrows():
+        name = str(row['AcceleratorName'])
+        if name_filter and name_filter.lower() not in name.lower():
+            continue
+        result.setdefault(name, []).append(
+            InstanceTypeInfo(cloud='GCP',
+                             instance_type=str(row['InstanceType']),
+                             accelerator_name=name,
+                             accelerator_count=float(row['AcceleratorCount']),
+                             cpu_count=float(row['vCPUs']),
+                             memory_gb=float(row['MemoryGiB']),
+                             price=float(row['Price']),
+                             spot_price=float(row['SpotPrice']),
+                             region=str(row['Region']),
+                             zone=str(row['AvailabilityZone'])))
+    return result
+
+
+def validate_region_zone(
+        region: Optional[str],
+        zone: Optional[str]) -> Tuple[Optional[str], Optional[str]]:
+    """Validate (region, zone) against any catalog row; returns canonical."""
+    if region is None and zone is None:
+        return None, None
+    tpu = _tpu_df()
+    vm = _vm_df()
+    regions = set(tpu['Region']) | set(vm['Region'])
+    zones = set(tpu['AvailabilityZone']) | set(vm['AvailabilityZone'])
+    if zone is not None:
+        if zone not in zones:
+            raise exceptions.InvalidSkyError(
+                f'Invalid zone {zone!r} for GCP. Known zones include: '
+                f'{sorted(zones)[:10]}...')
+        inferred = zone.rsplit('-', 1)[0]
+        if region is not None and region != inferred:
+            raise exceptions.InvalidSkyError(
+                f'Zone {zone} is not in region {region}.')
+        region = inferred
+    if region is not None and region not in regions:
+        raise exceptions.InvalidSkyError(
+            f'Invalid region {region!r} for GCP. Known: {sorted(regions)}')
+    return region, zone

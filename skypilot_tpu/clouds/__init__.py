@@ -1,0 +1,16 @@
+"""Cloud registry (parity: ``sky/clouds/__init__.py``)."""
+from skypilot_tpu.clouds.cloud import Cloud
+from skypilot_tpu.clouds.cloud import CloudImplementationFeatures
+from skypilot_tpu.clouds.cloud import Region
+from skypilot_tpu.clouds.cloud import Zone
+from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.local import Local
+
+__all__ = [
+    'Cloud',
+    'CloudImplementationFeatures',
+    'GCP',
+    'Local',
+    'Region',
+    'Zone',
+]

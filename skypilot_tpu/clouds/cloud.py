@@ -1,0 +1,218 @@
+"""Cloud ABC: per-cloud capability surface.
+
+Parity: ``sky/clouds/cloud.py:130`` (Cloud), ``:31``
+(CloudImplementationFeatures), ``:385`` (get_feasible_launchable_resources).
+"""
+import enum
+import typing
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+class CloudImplementationFeatures(enum.Enum):
+    """Features a cloud may not implement; requirements are checked against
+
+    this set before provisioning (parity: sky/clouds/cloud.py:31)."""
+    STOP = 'stop'
+    AUTOSTOP = 'autostop'
+    AUTODOWN = 'autodown'
+    MULTI_NODE = 'multi_node'
+    SPOT_INSTANCE = 'spot_instance'
+    CLONE_DISK_FROM_CLUSTER = 'clone_disk_from_cluster'
+    IMAGE_ID = 'image_id'
+    DOCKER_IMAGE = 'docker_image'
+    OPEN_PORTS = 'open_ports'
+    STORAGE_MOUNTING = 'storage_mounting'
+    HOST_CONTROLLERS = 'host_controllers'
+    CUSTOM_DISK_TIER = 'custom_disk_tier'
+
+
+class Region:
+
+    def __init__(self, name: str):
+        self.name = name
+        self.zones: List['Zone'] = []
+
+    def set_zones(self, zones: List['Zone']) -> 'Region':
+        self.zones = zones
+        for z in zones:
+            z.region = self.name
+        return self
+
+    def __repr__(self) -> str:
+        return self.name
+
+
+class Zone:
+
+    def __init__(self, name: str):
+        self.name = name
+        self.region: Optional[str] = None
+
+    def __repr__(self) -> str:
+        return self.name
+
+
+class Cloud:
+    """Abstract per-cloud surface. Subclasses register in CLOUD_REGISTRY."""
+
+    _REPR = 'Cloud'
+    # Max cluster-name length on this cloud (None = unlimited).
+    _MAX_CLUSTER_NAME_LEN_LIMIT: Optional[int] = None
+
+    # ----------------------------------------------------------- identity
+
+    def __repr__(self) -> str:
+        return self._REPR
+
+    @property
+    def name(self) -> str:
+        return self._REPR.lower()
+
+    def is_same_cloud(self, other: Optional['Cloud']) -> bool:
+        return other is not None and self.name == other.name
+
+    @classmethod
+    def max_cluster_name_length(cls) -> Optional[int]:
+        return cls._MAX_CLUSTER_NAME_LEN_LIMIT
+
+    # ----------------------------------------------------------- features
+
+    @classmethod
+    def unsupported_features(
+        cls, resources: Optional['resources_lib.Resources'] = None
+    ) -> Dict[CloudImplementationFeatures, str]:
+        """Feature → human reason, for features this cloud cannot do for
+
+        the given resources (e.g. TPU pods cannot STOP)."""
+        del resources
+        return {}
+
+    @classmethod
+    def check_features_are_supported(
+            cls, resources: 'resources_lib.Resources',
+            requested: Set[CloudImplementationFeatures]) -> None:
+        from skypilot_tpu import exceptions
+        unsupported = cls.unsupported_features(resources)
+        bad = {f: r for f, r in unsupported.items() if f in requested}
+        if bad:
+            reasons = '; '.join(f'{f.value}: {r}' for f, r in bad.items())
+            raise exceptions.NotSupportedError(
+                f'{cls._REPR} does not support the requested features: '
+                f'{reasons}')
+
+    # ----------------------------------------------------------- topology
+
+    def regions_with_offering(self, instance_type: Optional[str],
+                              accelerators: Optional[Dict[str, float]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[Region]:
+        raise NotImplementedError
+
+    def zones_provision_loop(
+            self,
+            *,
+            region: str,
+            num_nodes: int,
+            instance_type: Optional[str],
+            accelerators: Optional[Dict[str, float]] = None,
+            use_spot: bool = False) -> Iterator[Optional[List[Zone]]]:
+        """Yield zone batches to try within a region (failover granularity)."""
+        raise NotImplementedError
+
+    # ----------------------------------------------------------- pricing
+
+    def instance_type_to_hourly_cost(self, instance_type: str, use_spot: bool,
+                                     region: Optional[str],
+                                     zone: Optional[str]) -> float:
+        raise NotImplementedError
+
+    def accelerators_to_hourly_cost(self, accelerators: Dict[str, float],
+                                    use_spot: bool, region: Optional[str],
+                                    zone: Optional[str]) -> float:
+        """Extra cost of accelerators on top of the host instance. TPU slices
+
+        return the full slice cost here (host included in chip price)."""
+        raise NotImplementedError
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        raise NotImplementedError
+
+    # ----------------------------------------------------------- catalog
+
+    def instance_type_exists(self, instance_type: str) -> bool:
+        raise NotImplementedError
+
+    @classmethod
+    def get_default_instance_type(cls,
+                                  cpus: Optional[str] = None,
+                                  memory: Optional[str] = None,
+                                  disk_tier: Optional[str] = None
+                                  ) -> Optional[str]:
+        raise NotImplementedError
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(
+            cls,
+            instance_type: str) -> Tuple[Optional[float], Optional[float]]:
+        raise NotImplementedError
+
+    @classmethod
+    def get_accelerators_from_instance_type(
+            cls, instance_type: str) -> Optional[Dict[str, float]]:
+        raise NotImplementedError
+
+    def get_feasible_launchable_resources(
+            self, resources: 'resources_lib.Resources',
+            num_nodes: int) -> Tuple[List['resources_lib.Resources'], List[str]]:
+        """Map a (possibly partial) request to concrete launchable candidates.
+
+        Returns (candidates, fuzzy_hint_names). Parity: cloud.py:385.
+        """
+        raise NotImplementedError
+
+    # ----------------------------------------------------------- deploy
+
+    def make_deploy_resources_variables(self, resources:
+                                        'resources_lib.Resources',
+                                        cluster_name_on_cloud: str,
+                                        region: Region,
+                                        zones: Optional[List[Zone]],
+                                        num_nodes: int) -> Dict[str, object]:
+        """Variables consumed by the provisioner (parity: cloud.py:293)."""
+        raise NotImplementedError
+
+    # ----------------------------------------------------------- identity
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        """(ok, reason-if-not). Parity: check_credentials."""
+        raise NotImplementedError
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        return None
+
+    @classmethod
+    def get_current_user_identity_str(cls) -> Optional[str]:
+        ident = cls.get_current_user_identity()
+        return None if ident is None else ','.join(ident)
+
+    # ----------------------------------------------------------- misc
+
+    def need_cleanup_after_preemption_or_failure(
+            self, resources: 'resources_lib.Resources') -> bool:
+        return False
+
+    @classmethod
+    def check_cluster_name_is_valid(cls, cluster_name: str) -> None:
+        from skypilot_tpu.utils import common_utils
+        common_utils.check_cluster_name_is_valid(cluster_name)
+        limit = cls.max_cluster_name_length()
+        if limit is not None and len(cluster_name) > limit:
+            from skypilot_tpu import exceptions
+            raise exceptions.InvalidClusterNameError(
+                f'Cluster name {cluster_name!r} exceeds {cls._REPR} limit '
+                f'of {limit} chars.')

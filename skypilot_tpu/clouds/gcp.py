@@ -1,0 +1,306 @@
+"""GCP cloud with TPU pod slices as the first-class target.
+
+Parity: ``sky/clouds/gcp.py`` (TPU logic at :207-217,255,474-498,547-553,
+614-665) — redesigned so that a TPU request resolves through
+``skypilot_tpu.topology`` rather than string special-cases:
+
+* ``instance_type`` for TPU slices is the sentinel ``'TPU-VM'`` (parity
+  gcp.py:255); host vCPU/RAM come from the generation table.
+* TPU pods cannot STOP (only delete) — reflected in unsupported_features
+  (parity gcp.py:207-213).
+* Deploy variables carry the resolved slice: ``accelerator_type`` (GCP API
+  name like ``v5p-128``), ``topology``, ``runtime_version``, ``num_hosts``.
+"""
+import subprocess
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu import topology as topo_lib
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+TPU_VM_INSTANCE_TYPE = 'TPU-VM'
+
+# Default TPU software (runtime) versions per generation.
+# Parity: sky/resources.py:615-631 runtime_version defaulting matrix.
+_DEFAULT_RUNTIME_VERSIONS = {
+    'v2': 'tpu-vm-base',
+    'v3': 'tpu-vm-base',
+    'v4': 'tpu-vm-v4-base',
+    'v5e': 'v2-alpha-tpuv5-lite',
+    'v5p': 'v2-alpha-tpuv5',
+    'v6e': 'v2-alpha-tpuv6e',
+}
+
+
+@CLOUD_REGISTRY.register()
+class GCP(cloud.Cloud):
+    """Google Cloud Platform."""
+
+    _REPR = 'GCP'
+    _MAX_CLUSTER_NAME_LEN_LIMIT = 35
+
+    @classmethod
+    def unsupported_features(
+        cls,
+        resources=None
+    ) -> Dict[cloud.CloudImplementationFeatures, str]:
+        feats: Dict[cloud.CloudImplementationFeatures, str] = {
+            cloud.CloudImplementationFeatures.CLONE_DISK_FROM_CLUSTER:
+                'Disk cloning is not supported yet on GCP.',
+        }
+        if resources is not None and resources.tpu_topology is not None:
+            if resources.tpu_topology.is_pod:
+                # Parity: sky/clouds/gcp.py:207-213 — multi-host TPU slices
+                # cannot be stopped, only deleted.
+                feats[cloud.CloudImplementationFeatures.STOP] = (
+                    'Multi-host TPU slices do not support stopping; only '
+                    'tearing down (delete).')
+                feats[cloud.CloudImplementationFeatures.AUTOSTOP] = (
+                    'Multi-host TPU slices support autodown, not autostop.')
+        return feats
+
+    # ----------------------------------------------------------- topology
+
+    def regions_with_offering(self, instance_type, accelerators, use_spot,
+                              region, zone) -> List[cloud.Region]:
+        if instance_type == TPU_VM_INSTANCE_TYPE:
+            assert accelerators, 'TPU-VM requires a TPU accelerator'
+            acc_name = next(iter(accelerators))
+            gen = topo_lib.parse_generation(acc_name)
+            pairs = catalog.tpu_regions_zones(gen.name, region, zone)
+        elif instance_type is not None:
+            pairs = catalog.vm_regions_zones(instance_type, region, zone)
+        else:
+            pairs = []
+        regions: Dict[str, cloud.Region] = {}
+        for r, z in pairs:
+            regions.setdefault(r, cloud.Region(r))
+            zone_obj = cloud.Zone(z)
+            zone_obj.region = r
+            regions[r].zones.append(zone_obj)
+        return list(regions.values())
+
+    def zones_provision_loop(self,
+                             *,
+                             region: str,
+                             num_nodes: int,
+                             instance_type: Optional[str],
+                             accelerators=None,
+                             use_spot: bool = False
+                             ) -> Iterator[Optional[List[cloud.Zone]]]:
+        # GCP provisions per-zone: yield one zone at a time (parity:
+        # gcp.py zones_provision_loop yields singleton zone lists).
+        del num_nodes
+        for r in self.regions_with_offering(instance_type, accelerators,
+                                            use_spot, region, None):
+            for z in r.zones:
+                yield [z]
+
+    # ----------------------------------------------------------- pricing
+
+    def instance_type_to_hourly_cost(self, instance_type, use_spot, region,
+                                     zone) -> float:
+        if instance_type == TPU_VM_INSTANCE_TYPE:
+            # TPU slice cost is carried entirely by accelerators_to_hourly_cost
+            # (chip price includes the host; parity gcp_catalog.py:243-254).
+            return 0.0
+        price = catalog.get_hourly_cost(instance_type, region, use_spot)
+        if price is None:
+            raise exceptions.ResourcesUnavailableError(
+                f'No pricing for {instance_type} in {region}.')
+        return price
+
+    def accelerators_to_hourly_cost(self, accelerators, use_spot, region,
+                                    zone) -> float:
+        acc_name, acc_count = next(iter(accelerators.items()))
+        if topo_lib.is_tpu_accelerator(acc_name):
+            gen = topo_lib.parse_generation(acc_name)
+            if region is None:
+                pairs = catalog.tpu_regions_zones(gen.name)
+                if not pairs:
+                    raise exceptions.ResourcesUnavailableError(
+                        f'No region offers {acc_name}.')
+                region = pairs[0][0]
+            per_chip = catalog.tpu_price_per_chip_hour(gen.name, region,
+                                                       use_spot)
+            if per_chip is None:
+                raise exceptions.ResourcesUnavailableError(
+                    f'No TPU pricing for {acc_name} in {region}.')
+            return per_chip * acc_count
+        # GPUs on GCP are priced as part of the hosting a2/a3/g2 instance in
+        # our catalog; no extra accelerator cost.
+        return 0.0
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        # Parity: sky/clouds/gcp.py egress tiers.
+        if num_gigabytes <= 0:
+            return 0.0
+        if num_gigabytes <= 1024:
+            return num_gigabytes * 0.12
+        if num_gigabytes <= 10240:
+            return 1024 * 0.12 + (num_gigabytes - 1024) * 0.11
+        return 1024 * 0.12 + 9216 * 0.11 + (num_gigabytes - 10240) * 0.08
+
+    # ----------------------------------------------------------- catalog
+
+    def instance_type_exists(self, instance_type: str) -> bool:
+        return (instance_type == TPU_VM_INSTANCE_TYPE or
+                catalog.instance_type_exists(instance_type))
+
+    @classmethod
+    def get_default_instance_type(cls,
+                                  cpus=None,
+                                  memory=None,
+                                  disk_tier=None) -> Optional[str]:
+        del disk_tier
+        return catalog.get_default_instance_type(cpus, memory)
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(cls, instance_type):
+        if instance_type == TPU_VM_INSTANCE_TYPE:
+            return None, None  # depends on generation; handled via topology
+        return catalog.get_vcpus_mem_from_instance_type(instance_type)
+
+    @classmethod
+    def get_accelerators_from_instance_type(cls, instance_type):
+        if instance_type == TPU_VM_INSTANCE_TYPE:
+            return None  # accelerators are the request, not derived
+        return catalog.get_accelerators_from_instance_type(instance_type)
+
+    def get_feasible_launchable_resources(self, resources, num_nodes):
+        """Resolve a partial request into launchable candidates.
+
+        TPU path: any `tpu-*` accelerator ⇒ instance_type='TPU-VM', regions
+        from the TPU catalog. GPU path: catalog SKU lookup. CPU path: default
+        instance type. Parity: sky/clouds/gcp.py _get_feasible... +
+        cloud.py:385.
+        """
+        from skypilot_tpu import resources as resources_lib
+        del num_nodes
+        if resources.instance_type is not None and resources.accelerators is None:
+            if not self.instance_type_exists(resources.instance_type):
+                return [], []
+            return [resources.copy(cloud=self)], []
+
+        accs = resources.accelerators
+        if accs is None:
+            instance_type = self.get_default_instance_type(
+                resources.cpus, resources.memory)
+            if instance_type is None:
+                return [], []
+            return [
+                resources.copy(cloud=self, instance_type=instance_type)
+            ], []
+
+        acc_name, acc_count = next(iter(accs.items()))
+        if topo_lib.is_tpu_accelerator(acc_name):
+            try:
+                topo = topo_lib.resolve_topology(
+                    acc_name, acc_count,
+                    (resources.accelerator_args or {}).get('topology'))
+            except exceptions.InvalidSkyError:
+                raise
+            pairs = catalog.tpu_regions_zones(topo.generation.name,
+                                              resources.region,
+                                              resources.zone)
+            if not pairs:
+                return [], []
+            return [
+                resources.copy(
+                    cloud=self,
+                    instance_type=TPU_VM_INSTANCE_TYPE,
+                    accelerators={topo.name: topo.num_chips},
+                )
+            ], []
+
+        instance_types = catalog.get_instance_type_for_accelerator(
+            acc_name,
+            acc_count,
+            cpus=resources.cpus,
+            memory=resources.memory,
+            region=resources.region,
+            zone=resources.zone)
+        if not instance_types:
+            # Fuzzy hints: other counts/names with this prefix.
+            hints = sorted(
+                {n for n in catalog.list_accelerators(gpus_only=True)
+                 if acc_name.lower() in n.lower()})
+            return [], hints
+        return [
+            resources.copy(cloud=self, instance_type=instance_types[0])
+        ], []
+
+    # ----------------------------------------------------------- deploy
+
+    def make_deploy_resources_variables(self, resources,
+                                        cluster_name_on_cloud, region, zones,
+                                        num_nodes) -> Dict[str, object]:
+        zone = zones[0].name if zones else None
+        vars_: Dict[str, object] = {
+            'instance_type': resources.instance_type,
+            'region': region.name,
+            'zones': zone,
+            'use_spot': resources.use_spot,
+            'disk_size': resources.disk_size,
+            'image_id': resources.image_id,
+            'labels': dict(resources.labels or {}),
+            'num_nodes': num_nodes,
+        }
+        topo = resources.tpu_topology
+        if topo is not None:
+            args = resources.accelerator_args or {}
+            vars_.update({
+                'tpu_vm': True,
+                'tpu_node_name': cluster_name_on_cloud,
+                'accelerator_type': topo.gcp_accelerator_type,
+                'topology': topo.topology_str,
+                'runtime_version': args.get(
+                    'runtime_version',
+                    _DEFAULT_RUNTIME_VERSIONS[topo.generation.name]),
+                'num_hosts': topo.num_hosts,
+                'chips_per_host': topo.chips_per_host,
+            })
+        elif resources.accelerators:
+            acc_name, acc_count = next(iter(resources.accelerators.items()))
+            vars_.update({
+                'gpu': acc_name,
+                'gpu_count': int(acc_count),
+            })
+        return vars_
+
+    # ----------------------------------------------------------- identity
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        try:
+            proc = subprocess.run(
+                ['gcloud', 'auth', 'list',
+                 '--filter=status:ACTIVE', '--format=value(account)'],
+                capture_output=True,
+                text=True,
+                timeout=20,
+                check=False)
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            return False, ('gcloud CLI not found or not responding. Install '
+                           'the Google Cloud SDK and run `gcloud auth login`.')
+        account = proc.stdout.strip()
+        if proc.returncode != 0 or not account:
+            return False, 'No active gcloud account. Run `gcloud auth login`.'
+        return True, None
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        try:
+            proc = subprocess.run(
+                ['gcloud', 'auth', 'list',
+                 '--filter=status:ACTIVE', '--format=value(account)'],
+                capture_output=True,
+                text=True,
+                timeout=20,
+                check=False)
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            return None
+        account = proc.stdout.strip()
+        return [account] if account else None

@@ -1,0 +1,106 @@
+"""Local cloud: provisions "instances" as processes on this machine.
+
+This plays the role the reference's Kubernetes-kind path plays for testing
+(``sky local up``): a zero-credential backend the whole stack — optimizer,
+provisioner, backend, skylet, jobs, serve — can run against end-to-end in CI.
+Each "node" is a directory under ``~/.skytpu/local_cluster/<name>/<rank>`` and
+commands run through the local CommandRunner (no SSH).
+"""
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+_REGION = cloud.Region('local')
+_REGION.set_zones([cloud.Zone('local-a')])
+
+
+@CLOUD_REGISTRY.register()
+class Local(cloud.Cloud):
+    """The machine we are running on, as a cloud."""
+
+    _REPR = 'Local'
+
+    @classmethod
+    def unsupported_features(
+            cls, resources=None) -> Dict[cloud.CloudImplementationFeatures, str]:
+        return {
+            cloud.CloudImplementationFeatures.SPOT_INSTANCE:
+                'Local cloud has no spot market.',
+            cloud.CloudImplementationFeatures.IMAGE_ID:
+                'Local cloud has no machine images.',
+            cloud.CloudImplementationFeatures.OPEN_PORTS:
+                'Local ports are already reachable.',
+        }
+
+    def regions_with_offering(self, instance_type, accelerators, use_spot,
+                              region, zone) -> List[cloud.Region]:
+        if use_spot or (region not in (None, 'local')):
+            return []
+        r = cloud.Region('local')
+        z = cloud.Zone('local-a')
+        z.region = 'local'
+        r.zones = [z]
+        return [r]
+
+    def zones_provision_loop(self, *, region, num_nodes, instance_type,
+                             accelerators=None, use_spot=False
+                             ) -> Iterator[Optional[List[cloud.Zone]]]:
+        for r in self.regions_with_offering(instance_type, accelerators,
+                                            use_spot, region, None):
+            yield r.zones
+
+    def instance_type_to_hourly_cost(self, instance_type, use_spot, region,
+                                     zone) -> float:
+        return 0.0
+
+    def accelerators_to_hourly_cost(self, accelerators, use_spot, region,
+                                    zone) -> float:
+        return 0.0
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        return 0.0
+
+    def instance_type_exists(self, instance_type: str) -> bool:
+        return instance_type.startswith('local')
+
+    @classmethod
+    def get_default_instance_type(cls, cpus=None, memory=None,
+                                  disk_tier=None) -> Optional[str]:
+        return 'local'
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(
+            cls, instance_type) -> Tuple[Optional[float], Optional[float]]:
+        return float(os.cpu_count() or 1), None
+
+    @classmethod
+    def get_accelerators_from_instance_type(cls, instance_type):
+        return None
+
+    def get_feasible_launchable_resources(self, resources, num_nodes):
+        if resources.accelerators is not None or resources.use_spot:
+            return [], []
+        if resources.region not in (None, 'local'):
+            return [], []
+        return [resources.copy(cloud=self, instance_type='local')], []
+
+    def make_deploy_resources_variables(self, resources,
+                                        cluster_name_on_cloud, region, zones,
+                                        num_nodes) -> Dict[str, object]:
+        return {
+            'instance_type': 'local',
+            'region': 'local',
+            'zones': 'local-a',
+            'num_nodes': num_nodes,
+        }
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        return True, None
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        from skypilot_tpu.utils import common_utils
+        return [common_utils.get_user_hash()]

@@ -1,0 +1,80 @@
+"""Task DAG (parity: ``sky/dag.py:11,84``)."""
+import threading
+from typing import List, Optional
+
+import networkx as nx
+
+
+class Dag:
+    """Directed acyclic graph of Tasks. Use as a context manager::
+
+        with Dag() as dag:
+            task = Task(...)   # auto-added to dag
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+        self.graph = nx.DiGraph()
+        self.tasks: List = []
+
+    def add(self, task) -> None:
+        self.graph.add_node(task)
+        self.tasks.append(task)
+
+    def remove(self, task) -> None:
+        self.tasks.remove(task)
+        self.graph.remove_node(task)
+
+    def add_edge(self, op1, op2) -> None:
+        assert op1 in self.graph.nodes
+        assert op2 in self.graph.nodes
+        self.graph.add_edge(op1, op2)
+
+    def __len__(self) -> int:
+        return len(self.tasks)
+
+    def __enter__(self) -> 'Dag':
+        push_dag(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pop_dag()
+
+    def is_chain(self) -> bool:
+        nodes = list(self.graph.nodes)
+        out_degrees = [self.graph.out_degree(n) for n in nodes]
+        in_degrees = [self.graph.in_degree(n) for n in nodes]
+        return (len(nodes) <= 1 or
+                (all(d <= 1 for d in out_degrees) and
+                 all(d <= 1 for d in in_degrees) and
+                 nx.is_directed_acyclic_graph(self.graph) and
+                 nx.number_weakly_connected_components(self.graph) == 1))
+
+    def get_sorted_tasks(self) -> List:
+        return list(nx.topological_sort(self.graph))
+
+    def __repr__(self) -> str:
+        return f'Dag({self.name}, {len(self.tasks)} tasks)'
+
+
+class _DagContext(threading.local):
+    """Thread-local DAG stack (parity: sky/dag.py:84)."""
+
+    def __init__(self):
+        super().__init__()
+        self._stack: List[Dag] = []
+
+    def push(self, dag: Dag) -> None:
+        self._stack.append(dag)
+
+    def pop(self) -> Dag:
+        return self._stack.pop()
+
+    def current(self) -> Optional[Dag]:
+        return self._stack[-1] if self._stack else None
+
+
+_dag_context = _DagContext()
+push_dag = _dag_context.push
+pop_dag = _dag_context.pop
+get_current_dag = _dag_context.current

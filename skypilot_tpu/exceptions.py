@@ -1,0 +1,182 @@
+"""Typed failure taxonomy.
+
+Parity: ``sky/exceptions.py`` (reference, 554 LoC). The central type is
+``ResourcesUnavailableError`` carrying a ``failover_history`` so callers (the
+retrying provisioner, managed-job recovery) can distinguish "this zone is out
+of capacity" from "every candidate failed".
+"""
+from typing import List, Optional, Sequence
+
+
+class SkyTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class InvalidSkyError(SkyTpuError):
+    """Malformed user input (task YAML, resources string, CLI args)."""
+
+
+class ResourcesMismatchError(SkyTpuError):
+    """Requested resources do not match the existing cluster's resources."""
+
+
+class ResourcesUnavailableError(SkyTpuError):
+    """No candidate (cloud, region, zone, slice) could be provisioned.
+
+    Carries ``failover_history``: the per-zone exceptions hit while walking the
+    optimizer's candidate list (parity: ``sky/exceptions.py`` failover_history
+    on ResourcesUnavailableError).
+    """
+
+    def __init__(self,
+                 message: str,
+                 no_failover: bool = False,
+                 failover_history: Optional[Sequence[Exception]] = None):
+        super().__init__(message)
+        self.no_failover = no_failover
+        self.failover_history: List[Exception] = list(failover_history or [])
+
+    def with_failover_history(
+            self, history: Sequence[Exception]) -> 'ResourcesUnavailableError':
+        self.failover_history = list(history)
+        return self
+
+
+class ProvisionerError(SkyTpuError):
+    """An unrecoverable error from a cloud provisioner."""
+
+    # Populated by failover error handlers: resources blocked by this error.
+    blocked_resources: Optional[list] = None
+
+
+class ClusterNotUpError(SkyTpuError):
+    """Operation requires an UP cluster but the cluster is not up."""
+
+    def __init__(self, message: str, cluster_status=None, handle=None):
+        super().__init__(message)
+        self.cluster_status = cluster_status
+        self.handle = handle
+
+
+class ClusterDoesNotExist(SkyTpuError):
+    """Named cluster is not in the registry."""
+
+
+class ClusterOwnerIdentityMismatchError(SkyTpuError):
+    """Current cloud identity differs from the cluster creator's."""
+
+
+class NotSupportedError(SkyTpuError):
+    """The requested feature is not supported by the selected cloud/resource."""
+
+
+class CloudUserIdentityError(SkyTpuError):
+    """Failed to determine the active cloud identity."""
+
+
+class CloudCredentialError(SkyTpuError):
+    """Cloud credentials missing or invalid."""
+
+
+class CommandError(SkyTpuError):
+    """A remote/local command failed.
+
+    Parity: reference ``exceptions.CommandError`` raised by
+    ``subprocess_utils.handle_returncode``.
+    """
+
+    def __init__(self, returncode: int, command: str, error_msg: str,
+                 detailed_reason: Optional[str] = None):
+        self.returncode = returncode
+        self.command = command
+        self.error_msg = error_msg
+        self.detailed_reason = detailed_reason
+        if len(command) > 100:
+            command = command[:100] + '...'
+        super().__init__(
+            f'Command {command} failed with return code {returncode}.'
+            f'\n{error_msg}')
+
+
+class JobError(SkyTpuError):
+    """A submitted job failed."""
+
+
+class JobNotFoundError(SkyTpuError):
+    """Job id not present in the on-cluster job queue."""
+
+
+class ManagedJobReachedMaxRetriesError(SkyTpuError):
+    """Managed job exhausted its recovery budget."""
+
+
+class ManagedJobStatusError(SkyTpuError):
+    """Managed job is in an unexpected state."""
+
+
+class ServeUserTerminatedError(SkyTpuError):
+    """Service was torn down by the user mid-operation."""
+
+
+class StorageError(SkyTpuError):
+    """Base class for storage subsystem errors."""
+
+
+class StorageBucketCreateError(StorageError):
+    pass
+
+
+class StorageBucketGetError(StorageError):
+    pass
+
+
+class StorageBucketDeleteError(StorageError):
+    pass
+
+
+class StorageUploadError(StorageError):
+    pass
+
+
+class StorageSourceError(StorageError):
+    """Invalid local/remote source for a storage object."""
+
+
+class StorageModeError(StorageError):
+    """Unsupported (store, mode) combination."""
+
+
+class StorageSpecError(StorageError):
+    """Malformed storage spec in task YAML."""
+
+
+class NoCloudAccessError(SkyTpuError):
+    """No cloud is enabled/usable (run `sky check`)."""
+
+
+class ApiServerError(SkyTpuError):
+    """API server unreachable or returned an unexpected response."""
+
+
+class RequestCancelled(SkyTpuError):
+    """An async API request was cancelled."""
+
+
+class InvalidClusterNameError(SkyTpuError):
+    """Cluster name fails cloud naming constraints."""
+
+
+class HeadNodeUnreachableError(SkyTpuError):
+    """SSH to the head host (worker 0) of a slice failed."""
+
+
+class FetchClusterInfoError(SkyTpuError):
+    """Could not query instance metadata from the cloud after provisioning."""
+
+    class Reason:
+        HEAD = 'head'
+        WORKER = 'worker'
+
+    def __init__(self, reason: str = Reason.HEAD):
+        super().__init__(f'Failed to fetch cluster info ({reason}).')
+        self.reason = reason

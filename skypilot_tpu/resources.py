@@ -1,0 +1,487 @@
+"""Hardware request model with TPU slices first-class.
+
+Parity: ``sky/resources.py:32`` (Resources), ``:564`` (_set_accelerators TPU
+special-casing), ``:1069`` (make_deploy_variables), ``:1151``
+(less_demanding_than), ``:1353`` (from_yaml_config).
+
+Key TPU-first redesign: an accelerator string like ``tpu-v5p:128`` resolves
+eagerly to a :class:`skypilot_tpu.topology.TpuSliceTopology`; the cloud
+defaults to GCP; feasibility and cost flow through the slice model instead of
+instance SKUs.
+"""
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import topology as topo_lib
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import schemas
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+logger = sky_logging.init_logger(__name__)
+
+_DEFAULT_DISK_SIZE_GB = 256
+
+
+class Resources:
+    """An (possibly partial) infrastructure request.
+
+    Examples::
+
+        Resources(accelerators='tpu-v5p:128')          # 32-host v5p slice
+        Resources(accelerators='tpu-v6e:8', use_spot=True)
+        Resources(cloud='gcp', accelerators={'A100': 8})
+        Resources(cpus='8+', memory='32+')
+    """
+
+    def __init__(
+        self,
+        cloud: Optional[Union[str, cloud_lib.Cloud]] = None,
+        instance_type: Optional[str] = None,
+        accelerators: Optional[Union[str, Dict[str, float]]] = None,
+        accelerator_args: Optional[Dict[str, Any]] = None,
+        cpus: Optional[Union[int, float, str]] = None,
+        memory: Optional[Union[int, float, str]] = None,
+        region: Optional[str] = None,
+        zone: Optional[str] = None,
+        use_spot: Optional[bool] = None,
+        job_recovery: Optional[Union[str, Dict[str, Any]]] = None,
+        disk_size: Optional[int] = None,
+        disk_tier: Optional[str] = None,
+        ports: Optional[Union[int, str, List[Union[int, str]]]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        image_id: Optional[str] = None,
+        autostop: Optional[Union[bool, int, str, Dict[str, Any]]] = None,
+        _is_launchable: Optional[bool] = None,
+    ):
+        self._cloud = self._canonicalize_cloud(cloud)
+        self._region: Optional[str] = None
+        self._zone: Optional[str] = None
+        self._set_region_zone(region, zone)
+
+        self._instance_type = instance_type
+        self._cpus = self._canonicalize_count(cpus, 'cpus')
+        self._memory = self._canonicalize_count(memory, 'memory')
+
+        self._use_spot_specified = use_spot is not None
+        self._use_spot = bool(use_spot) if use_spot is not None else False
+        self._job_recovery = self._canonicalize_job_recovery(job_recovery)
+
+        self._disk_size = disk_size if disk_size is not None else \
+            _DEFAULT_DISK_SIZE_GB
+        self._disk_tier = disk_tier
+        self._ports = self._canonicalize_ports(ports)
+        self._labels = dict(labels) if labels else None
+        self._image_id = image_id
+        self._autostop = self._canonicalize_autostop(autostop)
+
+        self._accelerator_args = dict(accelerator_args) \
+            if accelerator_args else None
+        self._accelerators: Optional[Dict[str, float]] = None
+        self._tpu_topology: Optional[topo_lib.TpuSliceTopology] = None
+        self._set_accelerators(accelerators)
+        self._validate()
+
+    # ------------------------------------------------------- canonicalize
+
+    @staticmethod
+    def _canonicalize_cloud(cloud) -> Optional[cloud_lib.Cloud]:
+        if cloud is None or isinstance(cloud, cloud_lib.Cloud):
+            return cloud
+        return CLOUD_REGISTRY.from_str(str(cloud))
+
+    @staticmethod
+    def _canonicalize_count(value, what: str) -> Optional[str]:
+        if value is None:
+            return None
+        s = str(value)
+        body = s[:-1] if s.endswith('+') else s
+        try:
+            v = float(body)
+        except ValueError:
+            raise exceptions.InvalidSkyError(
+                f'Invalid {what} spec {value!r}: expected a number with '
+                'optional trailing "+".') from None
+        if v <= 0:
+            raise exceptions.InvalidSkyError(f'{what} must be positive.')
+        return s
+
+    @staticmethod
+    def _canonicalize_ports(ports) -> Optional[List[str]]:
+        if ports is None:
+            return None
+        if not isinstance(ports, list):
+            ports = [ports]
+        out = []
+        for p in ports:
+            s = str(p)
+            if '-' in s:
+                lo, hi = s.split('-', 1)
+                if not (lo.strip().isdigit() and hi.strip().isdigit()):
+                    raise exceptions.InvalidSkyError(
+                        f'Invalid port range {s!r}.')
+            elif not s.isdigit():
+                raise exceptions.InvalidSkyError(f'Invalid port {s!r}.')
+            out.append(s)
+        return out or None
+
+    @staticmethod
+    def _canonicalize_job_recovery(jr) -> Optional[Dict[str, Any]]:
+        if jr is None:
+            return None
+        if isinstance(jr, str):
+            return {'strategy': jr.upper()}
+        out = dict(jr)
+        if 'strategy' in out and isinstance(out['strategy'], str):
+            out['strategy'] = out['strategy'].upper()
+        return out
+
+    @staticmethod
+    def _canonicalize_autostop(a) -> Optional[Dict[str, Any]]:
+        """→ {'idle_minutes': int, 'down': bool} or None.
+
+        Accepts: True/False, minutes as int, '15m'/'1h' strings, or a dict.
+        """
+        if a is None:
+            return None
+        try:
+            if isinstance(a, bool):
+                return {'idle_minutes': 5, 'down': False} if a else None
+            if isinstance(a, (int, float)):
+                return {'idle_minutes': int(a), 'down': False}
+            if isinstance(a, str):
+                s = a.strip().lower()
+                if s.endswith('h'):
+                    return {'idle_minutes': int(float(s[:-1]) * 60),
+                            'down': False}
+                return {'idle_minutes': int(s.rstrip('m')), 'down': False}
+            return {'idle_minutes': int(a.get('idle_minutes', 5)),
+                    'down': bool(a.get('down', False))}
+        except (ValueError, TypeError, AttributeError):
+            raise exceptions.InvalidSkyError(
+                f'Invalid autostop spec {a!r}: expected minutes (int), '
+                "'<N>m'/'<N>h', or {idle_minutes:, down:}.") from None
+
+    def _set_region_zone(self, region: Optional[str],
+                         zone: Optional[str]) -> None:
+        self._region = region
+        self._zone = zone
+        if zone is not None and region is None:
+            self._region = zone.rsplit('-', 1)[0]
+
+    def _set_accelerators(self, accelerators) -> None:
+        """Parse accelerators; TPU names imply cloud=GCP + slice resolution.
+
+        Parity: sky/resources.py:575-640 (TPU ⇒ GCP, runtime_version default,
+        tpu_vm flag) — here the result is a full TpuSliceTopology.
+        """
+        if accelerators is None:
+            return
+        if isinstance(accelerators, str):
+            if ':' in accelerators:
+                name, count_s = accelerators.split(':', 1)
+                try:
+                    count = float(count_s)
+                except ValueError:
+                    raise exceptions.InvalidSkyError(
+                        f'Invalid accelerator count in {accelerators!r}.'
+                    ) from None
+            else:
+                name, count = accelerators, 1.0
+            accelerators = {name: count}
+        if len(accelerators) != 1:
+            raise exceptions.InvalidSkyError(
+                'Exactly one accelerator type may be requested, got: '
+                f'{accelerators}')
+        name, count = next(iter(accelerators.items()))
+        if topo_lib.is_tpu_accelerator(name):
+            args = self._accelerator_args or {}
+            topo = topo_lib.resolve_topology(name, count,
+                                             args.get('topology'))
+            self._tpu_topology = topo
+            accelerators = {topo.name: float(topo.num_chips)}
+            if self._cloud is None:
+                self._cloud = CLOUD_REGISTRY.from_str('gcp')
+            elif self._cloud.name not in ('gcp',):
+                raise exceptions.ResourcesMismatchError(
+                    f'TPU accelerators require GCP; got cloud='
+                    f'{self._cloud}.')
+            if self._accelerator_args is None:
+                self._accelerator_args = {}
+            self._accelerator_args.setdefault('tpu_vm', True)
+        else:
+            accelerators = {name: float(count)}
+        self._accelerators = accelerators
+
+    def _validate(self) -> None:
+        if self._cloud is not None and (self._region is not None or
+                                        self._zone is not None):
+            if self._cloud.name == 'gcp':
+                from skypilot_tpu import catalog
+                catalog.validate_region_zone(self._region, self._zone)
+        if self._use_spot and self._cloud is not None:
+            unsupported = self._cloud.unsupported_features(self)
+            if cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE in \
+                    unsupported:
+                raise exceptions.NotSupportedError(
+                    f'{self._cloud} does not support spot instances.')
+
+    # ------------------------------------------------------------ getters
+
+    @property
+    def cloud(self) -> Optional[cloud_lib.Cloud]:
+        return self._cloud
+
+    @property
+    def region(self) -> Optional[str]:
+        return self._region
+
+    @property
+    def zone(self) -> Optional[str]:
+        return self._zone
+
+    @property
+    def instance_type(self) -> Optional[str]:
+        return self._instance_type
+
+    @property
+    def accelerators(self) -> Optional[Dict[str, float]]:
+        return self._accelerators
+
+    @property
+    def accelerator_args(self) -> Optional[Dict[str, Any]]:
+        return self._accelerator_args
+
+    @property
+    def tpu_topology(self) -> Optional[topo_lib.TpuSliceTopology]:
+        return self._tpu_topology
+
+    @property
+    def is_tpu(self) -> bool:
+        return self._tpu_topology is not None
+
+    @property
+    def cpus(self) -> Optional[str]:
+        return self._cpus
+
+    @property
+    def memory(self) -> Optional[str]:
+        return self._memory
+
+    @property
+    def use_spot(self) -> bool:
+        return self._use_spot
+
+    @property
+    def use_spot_specified(self) -> bool:
+        return self._use_spot_specified
+
+    @property
+    def job_recovery(self) -> Optional[Dict[str, Any]]:
+        return self._job_recovery
+
+    @property
+    def disk_size(self) -> int:
+        return self._disk_size
+
+    @property
+    def disk_tier(self) -> Optional[str]:
+        return self._disk_tier
+
+    @property
+    def ports(self) -> Optional[List[str]]:
+        return self._ports
+
+    @property
+    def labels(self) -> Optional[Dict[str, str]]:
+        return self._labels
+
+    @property
+    def image_id(self) -> Optional[str]:
+        return self._image_id
+
+    @property
+    def autostop(self) -> Optional[Dict[str, Any]]:
+        return self._autostop
+
+    def is_launchable(self) -> bool:
+        return self._cloud is not None and self._instance_type is not None
+
+    # --------------------------------------------------------------- ops
+
+    def copy(self, **override) -> 'Resources':
+        """New Resources with fields overridden (parity: Resources.copy)."""
+        fields: Dict[str, Any] = {
+            'cloud': self._cloud,
+            'instance_type': self._instance_type,
+            'accelerators': self._accelerators,
+            'accelerator_args': self._accelerator_args,
+            'cpus': self._cpus,
+            'memory': self._memory,
+            'region': self._region,
+            'zone': self._zone,
+            'use_spot': self._use_spot if self._use_spot_specified else None,
+            'job_recovery': self._job_recovery,
+            'disk_size': self._disk_size,
+            'disk_tier': self._disk_tier,
+            'ports': self._ports,
+            'labels': self._labels,
+            'image_id': self._image_id,
+            'autostop': self._autostop,
+        }
+        fields.update(override)
+        return Resources(**fields)
+
+    def get_required_cloud_features(
+            self) -> set:
+        feats = set()
+        if self._use_spot:
+            feats.add(cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE)
+        if self._ports:
+            feats.add(cloud_lib.CloudImplementationFeatures.OPEN_PORTS)
+        if self._image_id:
+            feats.add(cloud_lib.CloudImplementationFeatures.IMAGE_ID)
+        if self._autostop is not None:
+            if self._autostop.get('down'):
+                feats.add(cloud_lib.CloudImplementationFeatures.AUTODOWN)
+            else:
+                feats.add(cloud_lib.CloudImplementationFeatures.AUTOSTOP)
+        if self._disk_tier is not None:
+            feats.add(cloud_lib.CloudImplementationFeatures.CUSTOM_DISK_TIER)
+        return feats
+
+    def get_cost(self, seconds: float) -> float:
+        """Cost in $ for running `seconds` (launchable resources only)."""
+        assert self.is_launchable(), self
+        hours = seconds / 3600.0
+        hourly = self._cloud.instance_type_to_hourly_cost(
+            self._instance_type, self._use_spot, self._region, self._zone)
+        if self._accelerators is not None:
+            hourly += self._cloud.accelerators_to_hourly_cost(
+                self._accelerators, self._use_spot, self._region, self._zone)
+        return hourly * hours
+
+    def get_hourly_cost(self) -> float:
+        return self.get_cost(3600.0)
+
+    def num_hosts_per_node(self) -> int:
+        """SSH targets per logical node: >1 for multi-host TPU slices.
+
+        Parity: num_ips_per_node (cloud_vm_ray_backend.py:2586).
+        """
+        if self._tpu_topology is not None:
+            return self._tpu_topology.num_hosts
+        return 1
+
+    def less_demanding_than(self,
+                            other: 'Resources',
+                            requested_num_nodes: int = 1) -> bool:
+        """Is `self` satisfiable by a cluster launched with `other`?
+
+        Parity: sky/resources.py:1151. Used by `exec` / job scheduling to
+        check an existing cluster can host a new task.
+        """
+        if self._cloud is not None and not self._cloud.is_same_cloud(
+                other.cloud):
+            return False
+        if self._region is not None and self._region != other.region:
+            return False
+        if self._zone is not None and self._zone != other.zone:
+            return False
+        if (self._instance_type is not None and
+                self._instance_type != other.instance_type):
+            return False
+        if self._use_spot_specified and self._use_spot != other.use_spot:
+            return False
+        if self._accelerators is not None:
+            if other.accelerators is None:
+                return False
+            for acc, count in self._accelerators.items():
+                if other.accelerators.get(acc, 0) < count:
+                    return False
+        return True
+
+    def make_deploy_variables(self, cluster_name_on_cloud: str,
+                              region: cloud_lib.Region,
+                              zones: Optional[List[cloud_lib.Zone]],
+                              num_nodes: int) -> Dict[str, Any]:
+        """Parity: sky/resources.py:1069 — delegates to the cloud."""
+        assert self.is_launchable(), self
+        return self._cloud.make_deploy_resources_variables(
+            self, cluster_name_on_cloud, region, zones, num_nodes)
+
+    # ------------------------------------------------------------- (de)ser
+
+    @classmethod
+    def from_yaml_config(cls, config: Optional[Dict[str, Any]]) -> 'Resources':
+        if config is None:
+            config = {}
+        schemas.validate(config, schemas.get_resources_schema(),
+                         'Invalid resources spec: ')
+        config = dict(config)
+        config.pop('any_of', None)
+        config.pop('ordered', None)
+        config.pop('_cluster_config_overrides', None)
+        return cls(**config)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        config: Dict[str, Any] = {}
+
+        def add(key, value):
+            if value is not None:
+                config[key] = value
+
+        add('cloud', str(self._cloud) if self._cloud else None)
+        add('region', self._region)
+        add('zone', self._zone)
+        add('instance_type', self._instance_type)
+        add('cpus', self._cpus)
+        add('memory', self._memory)
+        if self._accelerators is not None:
+            name, count = next(iter(self._accelerators.items()))
+            count_s = str(int(count)) if count == int(count) else str(count)
+            add('accelerators', f'{name}:{count_s}')
+        add('accelerator_args', self._accelerator_args)
+        if self._use_spot_specified:
+            add('use_spot', self._use_spot)
+        add('job_recovery', self._job_recovery)
+        if self._disk_size != _DEFAULT_DISK_SIZE_GB:
+            add('disk_size', self._disk_size)
+        add('disk_tier', self._disk_tier)
+        add('ports', self._ports)
+        add('labels', self._labels)
+        add('image_id', self._image_id)
+        add('autostop', self._autostop)
+        return config
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, Resources):
+            return NotImplemented
+        return self.to_yaml_config() == other.to_yaml_config()
+
+    def __hash__(self) -> int:
+        return hash(common_utils.json_hash(self.to_yaml_config()))
+
+    def __repr__(self) -> str:
+        parts = []
+        if self._cloud is not None:
+            parts.append(str(self._cloud))
+        if self._tpu_topology is not None:
+            parts.append(str(self._tpu_topology))
+        elif self._accelerators is not None:
+            name, count = next(iter(self._accelerators.items()))
+            parts.append(f'{name}:{int(count)}')
+        elif self._instance_type is not None:
+            parts.append(self._instance_type)
+        if self._cpus:
+            parts.append(f'cpus={self._cpus}')
+        if self._memory:
+            parts.append(f'mem={self._memory}')
+        if self._use_spot:
+            parts.append('[Spot]')
+        if self._region:
+            parts.append(self._region)
+        if not parts:
+            parts = ['<empty>']
+        return f'Resources({", ".join(parts)})'

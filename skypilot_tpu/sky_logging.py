@@ -1,0 +1,88 @@
+"""Hierarchical logging with env-tunable verbosity.
+
+Parity: ``sky/sky_logging.py``. ``SKYTPU_DEBUG=1`` switches to debug-level
+with timestamps; ``SKYTPU_MINIMIZE_LOGGING=1`` quiets info chatter.
+"""
+import contextlib
+import logging
+import os
+import sys
+import threading
+
+_FORMAT = '%(levelname).1s %(asctime)s %(filename)s:%(lineno)d] %(message)s'
+_DATE_FORMAT = '%m-%d %H:%M:%S'
+
+_root_name = 'skypilot_tpu'
+_setup_lock = threading.Lock()
+_setup_done = False
+
+
+def _debug_enabled() -> bool:
+    return os.environ.get('SKYTPU_DEBUG', '0') == '1'
+
+
+def minimize_logging() -> bool:
+    return os.environ.get('SKYTPU_MINIMIZE_LOGGING', '0') == '1'
+
+
+class _NoPrefixFormatter(logging.Formatter):
+    """Plain messages at INFO and below; prefixed at WARNING+/debug mode."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        if not _debug_enabled() and record.levelno <= logging.INFO:
+            return record.getMessage()
+        return super().format(record)
+
+
+def _setup_root() -> None:
+    global _setup_done
+    with _setup_lock:
+        if _setup_done:
+            return
+        root = logging.getLogger(_root_name)
+        root.setLevel(logging.DEBUG if _debug_enabled() else logging.INFO)
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(_NoPrefixFormatter(_FORMAT, _DATE_FORMAT))
+        handler.setLevel(
+            logging.WARNING if minimize_logging() else logging.DEBUG)
+        root.addHandler(handler)
+        root.propagate = False
+        _setup_done = True
+
+
+def init_logger(name: str) -> logging.Logger:
+    _setup_root()
+    if not name.startswith(_root_name):
+        name = f'{_root_name}.{name}'
+    return logging.getLogger(name)
+
+
+@contextlib.contextmanager
+def silent():
+    """Temporarily silence all framework logging (parity: sky_logging.silent)."""
+    root = logging.getLogger(_root_name)
+    prev = root.level
+    root.setLevel(logging.CRITICAL)
+    try:
+        yield
+    finally:
+        root.setLevel(prev)
+
+
+def print_exception_no_traceback():
+    """With debug off, raise user-facing errors without the traceback wall."""
+    return _DisableTracebackCtx()
+
+
+class _DisableTracebackCtx(contextlib.AbstractContextManager):
+
+    def __enter__(self):
+        if not _debug_enabled():
+            self._prev = getattr(sys, 'tracebacklimit', 1000)
+            sys.tracebacklimit = 0
+        return self
+
+    def __exit__(self, *exc):
+        if not _debug_enabled():
+            sys.tracebacklimit = self._prev
+        return False

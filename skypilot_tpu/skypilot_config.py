@@ -1,0 +1,132 @@
+"""Layered nested-key config: ``~/.skytpu/config.yaml`` + overrides.
+
+Parity: ``sky/skypilot_config.py`` (``get_nested:97``,
+``override_skypilot_config:198``). Layering, lowest to highest precedence:
+
+1. user config file (``~/.skytpu/config.yaml``, or ``$SKYTPU_CONFIG``)
+2. a thread-local override stack (per-request server overrides,
+   per-task ``experimental.config_overrides``)
+
+Keys are addressed as tuples: ``get_nested(('gcp', 'project_id'), None)``.
+"""
+import contextlib
+import copy
+import os
+import threading
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import yaml
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+ENV_VAR_CONFIG_PATH = 'SKYTPU_CONFIG'
+DEFAULT_CONFIG_PATH = '~/.skytpu/config.yaml'
+
+_local = threading.local()
+_global_config: Optional[Dict[str, Any]] = None
+_loaded_path: Optional[str] = None
+_load_lock = threading.Lock()
+
+
+def _config_path() -> str:
+    return os.path.expanduser(
+        os.environ.get(ENV_VAR_CONFIG_PATH, DEFAULT_CONFIG_PATH))
+
+
+def _load() -> Dict[str, Any]:
+    global _global_config, _loaded_path
+    path = _config_path()
+    with _load_lock:
+        if _global_config is not None and _loaded_path == path:
+            return _global_config
+        config: Dict[str, Any] = {}
+        if os.path.exists(path):
+            try:
+                with open(path, encoding='utf-8') as f:
+                    config = yaml.safe_load(f) or {}
+            except yaml.YAMLError as e:
+                logger.warning(f'Failed to parse config at {path}: {e}')
+                config = {}
+            from skypilot_tpu.utils import schemas
+            schemas.validate(config, schemas.get_config_schema(),
+                             f'Invalid config {path}: ')
+        _global_config = config
+        _loaded_path = path
+        return config
+
+
+def reload_config() -> None:
+    global _global_config
+    with _load_lock:
+        _global_config = None
+
+
+def _override_stack() -> list:
+    if not hasattr(_local, 'stack'):
+        _local.stack = []
+    return _local.stack
+
+
+def merge_dicts(base: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any]:
+    """Recursive dict merge; override wins; lists are replaced."""
+    out = copy.deepcopy(base)
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = merge_dicts(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+def to_dict() -> Dict[str, Any]:
+    """The fully-merged effective config."""
+    config = _load()
+    for override in _override_stack():
+        config = merge_dicts(config, override)
+    return config
+
+
+def get_nested(keys: Iterable[str],
+               default_value: Any = None,
+               override_configs: Optional[Dict[str, Any]] = None) -> Any:
+    """Fetch a nested key tuple, e.g. ('jobs', 'controller', 'resources')."""
+    config = to_dict()
+    if override_configs:
+        config = merge_dicts(config, override_configs)
+    cur: Any = config
+    for key in keys:
+        if not isinstance(cur, dict) or key not in cur:
+            return default_value
+        cur = cur[key]
+    return cur
+
+
+def set_nested(keys: Tuple[str, ...], value: Any) -> Dict[str, Any]:
+    """Return the effective config with keys set to value (no persistence)."""
+    config = to_dict()
+    cur = config
+    for key in keys[:-1]:
+        cur = cur.setdefault(key, {})
+    cur[keys[-1]] = value
+    return config
+
+
+@contextlib.contextmanager
+def override_skypilot_config(override: Optional[Dict[str, Any]]):
+    """Thread-locally layer an override dict (parity: :198)."""
+    if not override:
+        yield
+        return
+    stack = _override_stack()
+    stack.append(override)
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def loaded_config_path() -> Optional[str]:
+    path = _config_path()
+    return path if os.path.exists(path) else None

@@ -1,0 +1,290 @@
+"""Declarative task: run/setup commands + resources + mounts + envs.
+
+Parity: ``sky/task.py:196`` (Task), ``:436`` (from_yaml_config), ``:1214``
+(to_yaml_config). Env-var substitution in YAML mirrors ``task.py:78``.
+"""
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Set, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import schemas
+
+logger = sky_logging.init_logger(__name__)
+
+_VAR_PATTERN = re.compile(r'\$\{(\w+)\}')
+
+RunFn = Callable[[int, List[str]], Optional[str]]
+
+
+def _fill_env_vars(yaml_str: str, env_overrides: Dict[str, str]) -> str:
+    """Substitute ${VAR} from overrides then os.environ (parity task.py:78)."""
+
+    def repl(m):
+        var = m.group(1)
+        if var in env_overrides:
+            return str(env_overrides[var])
+        if var in os.environ:
+            return os.environ[var]
+        return m.group(0)
+
+    return _VAR_PATTERN.sub(repl, yaml_str)
+
+
+class Task:
+    """A coarse-grained unit of execution.
+
+    Example::
+
+        task = Task(name='train',
+                    setup='pip list',
+                    run='python -c "import jax; print(jax.devices())"',
+                    num_nodes=1)
+        task.set_resources(Resources(accelerators='tpu-v5e:8'))
+    """
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        setup: Optional[str] = None,
+        run: Optional[Union[str, RunFn]] = None,
+        envs: Optional[Dict[str, str]] = None,
+        secrets: Optional[Dict[str, str]] = None,
+        workdir: Optional[str] = None,
+        num_nodes: Optional[int] = None,
+        file_mounts: Optional[Dict[str, Any]] = None,
+    ):
+        self.name = name
+        self.setup = setup
+        self.run = run
+        self.workdir = workdir
+        self._envs = dict(envs) if envs else {}
+        self._secrets = dict(secrets) if secrets else {}
+        self._num_nodes = 1
+        if num_nodes is not None:
+            self.num_nodes = num_nodes
+
+        self.file_mounts: Optional[Dict[str, str]] = None
+        self.storage_mounts: Dict[str, Any] = {}
+        if file_mounts is not None:
+            self.set_file_mounts(file_mounts)
+
+        self.resources: Set[resources_lib.Resources] = {
+            resources_lib.Resources()
+        }
+        self.service: Optional[Any] = None  # SkyServiceSpec
+        # Filled at execution: estimated best resources from the optimizer.
+        self.best_resources: Optional[resources_lib.Resources] = None
+
+        self._validate()
+
+        # Auto-register into the active `with Dag():` context (parity:
+        # sky/task.py Task.__init__ → dag.add).
+        from skypilot_tpu import dag as dag_lib
+        current_dag = dag_lib.get_current_dag()
+        if current_dag is not None:
+            current_dag.add(self)
+
+    def _validate(self) -> None:
+        if self.name is not None:
+            common_utils.check_cluster_name_is_valid(self.name)
+        if self.run is not None and not isinstance(self.run, str) and \
+                not callable(self.run):
+            raise exceptions.InvalidSkyError(
+                f'run must be a string or callable, got {type(self.run)}')
+        if self.workdir is not None:
+            expanded = os.path.expanduser(self.workdir)
+            if not os.path.isdir(expanded):
+                raise exceptions.InvalidSkyError(
+                    f'workdir {self.workdir!r} is not an existing directory.')
+
+    # ----------------------------------------------------------- num_nodes
+
+    @property
+    def num_nodes(self) -> int:
+        return self._num_nodes
+
+    @num_nodes.setter
+    def num_nodes(self, num_nodes: Optional[int]) -> None:
+        if num_nodes is None:
+            num_nodes = 1
+        if not isinstance(num_nodes, int) or num_nodes < 1:
+            raise exceptions.InvalidSkyError(
+                f'num_nodes must be a positive int, got {num_nodes!r}')
+        self._num_nodes = num_nodes
+
+    # ----------------------------------------------------------- envs
+
+    @property
+    def envs(self) -> Dict[str, str]:
+        return dict(self._envs)
+
+    @property
+    def secrets(self) -> Dict[str, str]:
+        return dict(self._secrets)
+
+    @property
+    def envs_and_secrets(self) -> Dict[str, str]:
+        out = dict(self._envs)
+        out.update(self._secrets)
+        return out
+
+    def update_envs(self, envs: Optional[Dict[str, str]]) -> 'Task':
+        for k, v in (envs or {}).items():
+            if not isinstance(k, str) or not k:
+                raise exceptions.InvalidSkyError(f'Invalid env key {k!r}')
+            self._envs[k] = '' if v is None else str(v)
+        return self
+
+    def update_secrets(self, secrets: Optional[Dict[str, str]]) -> 'Task':
+        for k, v in (secrets or {}).items():
+            self._secrets[k] = '' if v is None else str(v)
+        return self
+
+    # ----------------------------------------------------------- resources
+
+    def set_resources(
+        self, resources: Union[resources_lib.Resources,
+                               Set[resources_lib.Resources],
+                               List[resources_lib.Resources]]
+    ) -> 'Task':
+        if isinstance(resources, resources_lib.Resources):
+            resources = {resources}
+        self.resources = set(resources)
+        return self
+
+    def set_resources_override(self, override: Dict[str, Any]) -> 'Task':
+        self.set_resources({r.copy(**override) for r in self.resources})
+        return self
+
+    # ----------------------------------------------------------- mounts
+
+    def set_file_mounts(self, file_mounts: Optional[Dict[str, Any]]) -> 'Task':
+        """dst → src. Plain str srcs are files/dirs or cloud URIs; dict srcs
+
+        are inline Storage specs (parsed by ``sync_storage_mounts``)."""
+        if file_mounts is None:
+            self.file_mounts = None
+            return self
+        plain: Dict[str, str] = {}
+        for dst, src in file_mounts.items():
+            if isinstance(src, dict):
+                try:
+                    from skypilot_tpu.data import storage as storage_lib
+                except ImportError:
+                    raise exceptions.NotSupportedError(
+                        'Storage-spec file_mounts require the data '
+                        'subsystem, which is not available in this build.'
+                    ) from None
+                self.storage_mounts[dst] = \
+                    storage_lib.Storage.from_yaml_config(src)
+            else:
+                plain[dst] = str(src)
+        self.file_mounts = plain or None
+        return self
+
+    def update_file_mounts(self, file_mounts: Dict[str, str]) -> 'Task':
+        if self.file_mounts is None:
+            self.file_mounts = {}
+        self.file_mounts.update(file_mounts)
+        return self
+
+    # ----------------------------------------------------------- service
+
+    def set_service(self, service) -> 'Task':
+        self.service = service
+        return self
+
+    # ----------------------------------------------------------- (de)ser
+
+    @classmethod
+    def from_yaml_config(cls,
+                         config: Dict[str, Any],
+                         env_overrides: Optional[Dict[str, str]] = None
+                         ) -> 'Task':
+        if env_overrides:
+            yaml_str = common_utils.dump_yaml_str(config)
+            config = __import__('yaml').safe_load(
+                _fill_env_vars(yaml_str, env_overrides))
+        schemas.validate(config, schemas.get_task_schema(),
+                         'Invalid task spec: ')
+        config = dict(config)
+        envs = config.get('envs') or {}
+        envs = {k: ('' if v is None else str(v)) for k, v in envs.items()}
+        secrets = config.get('secrets') or {}
+        secrets = {k: ('' if v is None else str(v))
+                   for k, v in secrets.items()}
+
+        task = cls(
+            name=config.get('name'),
+            setup=config.get('setup'),
+            run=config.get('run'),
+            workdir=config.get('workdir'),
+            num_nodes=config.get('num_nodes'),
+            envs=envs,
+        )
+        task.update_secrets(secrets)
+        if config.get('file_mounts') is not None:
+            task.set_file_mounts(config['file_mounts'])
+        if config.get('resources') is not None:
+            task.set_resources(
+                resources_lib.Resources.from_yaml_config(
+                    config['resources']))
+        if config.get('service') is not None:
+            try:
+                from skypilot_tpu.serve import service_spec
+            except ImportError:
+                raise exceptions.NotSupportedError(
+                    'service: sections require the serve subsystem, which '
+                    'is not available in this build.') from None
+            task.set_service(
+                service_spec.SkyServiceSpec.from_yaml_config(
+                    config['service']))
+        return task
+
+    @classmethod
+    def from_yaml(cls,
+                  yaml_path: str,
+                  env_overrides: Optional[Dict[str, str]] = None) -> 'Task':
+        config = common_utils.read_yaml(yaml_path)
+        if not isinstance(config, dict):
+            raise exceptions.InvalidSkyError(
+                f'{yaml_path} does not contain a task mapping.')
+        return cls.from_yaml_config(config, env_overrides)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        config: Dict[str, Any] = {}
+
+        def add(key, value):
+            if value is not None and value != {} and value != []:
+                config[key] = value
+
+        add('name', self.name)
+        if len(self.resources) == 1:
+            add('resources', next(iter(self.resources)).to_yaml_config())
+        elif len(self.resources) > 1:
+            add('resources',
+                {'any_of': [r.to_yaml_config() for r in self.resources]})
+        if self._num_nodes != 1:
+            add('num_nodes', self._num_nodes)
+        add('workdir', self.workdir)
+        add('setup', self.setup)
+        add('run', self.run if isinstance(self.run, str) else None)
+        add('envs', self._envs or None)
+        add('secrets', self._secrets or None)
+        mounts: Dict[str, Any] = dict(self.file_mounts or {})
+        for dst, store in self.storage_mounts.items():
+            mounts[dst] = store.to_yaml_config()
+        add('file_mounts', mounts or None)
+        if self.service is not None:
+            add('service', self.service.to_yaml_config())
+        return config
+
+    def __repr__(self) -> str:
+        label = self.name or '<unnamed>'
+        res = next(iter(self.resources)) if self.resources else None
+        return f'Task({label}, num_nodes={self._num_nodes}, {res})'

@@ -1,0 +1,316 @@
+"""TPU slice topology: the first-class compute-target model.
+
+This replaces the reference's GPU-count-centric accelerator model
+(``sky/resources.py:575-640`` TPU special-casing, ``sky/clouds/gcp.py:207-217``)
+with an explicit slice model: an accelerator request like ``tpu-v5p:128``
+resolves to a :class:`TpuSliceTopology` — generation, chip count, ICI torus
+shape, host fan-out — which drives:
+
+* the optimizer (price = chips × $/chip-hr; feasibility = valid slice sizes),
+* the provisioner (one TPU node, ``num_hosts`` SSH targets from
+  ``networkEndpoints[]`` — parity: ``provision/gcp/instance_utils.py:1635``),
+* the runtime (``jax.distributed`` coordinator + per-host ranks),
+* the compute layer (default ``jax.sharding.Mesh`` axis sizes).
+
+Counts are **chips** throughout (not TensorCores): ``tpu-v4:8`` is 8 chips
+(2 hosts). The legacy GCP core-based names (``tpu-v2-8`` etc.) are accepted
+and converted.
+"""
+import dataclasses
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuGenerationInfo:
+    """Static per-generation hardware data (catalog-level facts)."""
+    name: str                      # 'v5p'
+    torus_dims: int                # 2 or 3 (ICI torus dimensionality)
+    chips_per_host: int            # hosts in multi-host slices
+    single_host_sizes: Tuple[int, ...]  # chip counts servable by one host
+    max_chips: int
+    hbm_gib_per_chip: float
+    peak_bf16_tflops_per_chip: float
+    ici_gbps_per_link: float       # unidirectional per-link ICI bandwidth
+    host_vcpus: int                # parity: sky/clouds/gcp.py:474-498
+    host_memory_gb: int
+    # Core-count multiplier for legacy names (v2-8 = 8 cores = 4 chips).
+    cores_per_chip: int = 2
+    supports_stop: bool = False    # TPU pods can only be deleted, gcp.py:207
+
+    def valid_chip_counts(self) -> List[int]:
+        """Chip counts GCP actually sells for this generation.
+
+        2D-torus generations scale by doubling; 3D-torus generations
+        (v4/v5p) additionally sell cuboids with every dimension a multiple
+        of 4 (e.g. v5p 4x4x12 = 192 chips, 16x16x24 = 6144 chips).
+        """
+        counts = set(self.single_host_sizes)
+        if self.torus_dims == 2:
+            c = self.chips_per_host
+            while c <= self.max_chips:
+                counts.add(c)
+                c *= 2
+        else:
+            # Small sub-cube slices.
+            for c in (4, 8, 16, 32):
+                if c <= self.max_chips:
+                    counts.add(c)
+            # All 4-multiple cuboids a<=b<=c up to a per-dim cap of 32.
+            dims = [d for d in range(4, 33, 4)]
+            for a in dims:
+                for b in dims:
+                    if b < a:
+                        continue
+                    for c in dims:
+                        if c < b:
+                            continue
+                        prod = a * b * c
+                        if prod <= self.max_chips:
+                            counts.add(prod)
+        return sorted(x for x in counts if x <= self.max_chips)
+
+
+# Generation table. Sources: public GCP TPU docs; perf figures are the
+# published peak bf16 numbers used for cost/MFU modeling in the optimizer.
+TPU_GENERATIONS: Dict[str, TpuGenerationInfo] = {
+    'v2': TpuGenerationInfo('v2', 2, 4, (4,), 512, 8, 23, 100, 96, 334),
+    'v3': TpuGenerationInfo('v3', 2, 4, (4,), 1024, 16, 61, 175, 96, 334),
+    'v4': TpuGenerationInfo('v4', 3, 4, (4,), 4096, 32, 275, 200, 240, 400),
+    'v5e': TpuGenerationInfo('v5e', 2, 4, (1, 4, 8), 256, 16, 197, 200, 224,
+                             400, cores_per_chip=1),
+    'v5p': TpuGenerationInfo('v5p', 3, 4, (4,), 6144, 95, 459, 400, 208, 448),
+    'v6e': TpuGenerationInfo('v6e', 2, 4, (1, 4, 8), 256, 32, 918, 400, 180,
+                             720, cores_per_chip=1),
+}
+
+_TPU_NAME_RE = re.compile(r'^tpu-?(v\d+[a-z]*)$', re.IGNORECASE)
+# Legacy GCP catalog name: tpu-v2-8 (8 = TensorCores).
+_TPU_LEGACY_RE = re.compile(r'^tpu-?(v\d+[a-z]*)-(\d+)$', re.IGNORECASE)
+
+
+def is_tpu_accelerator(name: str) -> bool:
+    return bool(_TPU_NAME_RE.match(name) or _TPU_LEGACY_RE.match(name))
+
+
+def _squarish_factors(n: int, dims: int) -> Tuple[int, ...]:
+    """Factor n into `dims` factors, as balanced as possible, ascending."""
+    if dims == 1:
+        return (n,)
+    best: Optional[Tuple[int, ...]] = None
+    best_score = math.inf
+
+    def search(remaining: int, left: int, acc: List[int]):
+        nonlocal best, best_score
+        if left == 1:
+            shape = tuple(sorted(acc + [remaining]))
+            score = max(shape) / min(shape)
+            if score < best_score:
+                best, best_score = shape, score
+            return
+        f = 1
+        while f * f <= remaining * 2:
+            if remaining % f == 0:
+                search(remaining // f, left - 1, acc + [f])
+            f += 1
+
+    search(n, dims, [])
+    assert best is not None
+    return best
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuSliceTopology:
+    """A concrete TPU slice: generation + chips + ICI shape + host fan-out."""
+    generation: TpuGenerationInfo
+    num_chips: int
+    ici_shape: Tuple[int, ...]
+
+    @property
+    def name(self) -> str:
+        """Canonical accelerator name, e.g. 'tpu-v5p'."""
+        return f'tpu-{self.generation.name}'
+
+    @property
+    def gcp_accelerator_type(self) -> str:
+        """GCP TPU API acceleratorType, e.g. 'v5p-128' (TensorCore count)."""
+        if self.generation.cores_per_chip == 1:
+            return f'{self.generation.name}-{self.num_chips}'
+        return f'{self.generation.name}-' \
+               f'{self.num_chips * self.generation.cores_per_chip}'
+
+    @property
+    def topology_str(self) -> str:
+        """GCP API topology string, e.g. '4x4x8'."""
+        return 'x'.join(str(d) for d in self.ici_shape)
+
+    @property
+    def num_hosts(self) -> int:
+        if self.num_chips in self.generation.single_host_sizes:
+            return 1
+        return max(1, self.num_chips // self.generation.chips_per_host)
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.num_chips // self.num_hosts
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.num_hosts > 1
+
+    @property
+    def is_pod(self) -> bool:
+        return self.is_multi_host
+
+    @property
+    def hbm_gib(self) -> float:
+        return self.num_chips * self.generation.hbm_gib_per_chip
+
+    @property
+    def peak_bf16_tflops(self) -> float:
+        return self.num_chips * self.generation.peak_bf16_tflops_per_chip
+
+    def default_mesh_shape(self,
+                           data_parallel: Optional[int] = None
+                           ) -> Dict[str, int]:
+        """Default jax Mesh axis sizes for this slice.
+
+        Model-parallel ('model') axis stays within a host's ICI-adjacent
+        chips where possible; 'fsdp' spans hosts over ICI; 'data' is either
+        the given DP degree or 1 (multislice DP over DCN is configured by the
+        launcher, not the slice).
+        """
+        dp = data_parallel or 1
+        chips = self.num_chips // dp
+        model = min(self.chips_per_host, chips)
+        fsdp = chips // model
+        return {'data': dp, 'fsdp': fsdp, 'model': model}
+
+    def __str__(self) -> str:
+        return (f'{self.name}:{self.num_chips} '
+                f'(topology {self.topology_str}, {self.num_hosts} host'
+                f'{"s" if self.num_hosts != 1 else ""})')
+
+
+_SMALL_3D_SHAPES = {4: (1, 2, 2), 8: (2, 2, 2), 16: (2, 2, 4), 32: (2, 4, 4)}
+
+
+def _default_ici_shape(gen: TpuGenerationInfo, chips: int) -> Tuple[int, ...]:
+    if chips == 1:
+        return (1,) * gen.torus_dims
+    if gen.torus_dims == 2:
+        return _squarish_factors(chips, 2)
+    # 3D torus (v4/v5p): small slices use fixed sub-cube shapes; larger
+    # slices must have every dim a multiple of 4 — pick the most balanced
+    # multiple-of-4 cuboid.
+    if chips in _SMALL_3D_SHAPES:
+        return _SMALL_3D_SHAPES[chips]
+    best: Optional[Tuple[int, ...]] = None
+    best_score = math.inf
+    dims = [d for d in range(4, 33, 4)]
+    for a in dims:
+        for b in dims:
+            if b < a:
+                continue
+            if a * b > chips:
+                break
+            c, rem = divmod(chips, a * b)
+            if rem or c < b or c % 4:
+                continue
+            score = c / a
+            if score < best_score:
+                best, best_score = (a, b, c), score
+    if best is None:
+        # Shouldn't happen for counts from valid_chip_counts(); fall back.
+        return _squarish_factors(chips, 3)
+    return best
+
+
+def resolve_topology(accelerator_name: str,
+                     count: float,
+                     topology: Optional[str] = None) -> TpuSliceTopology:
+    """Parse ('tpu-v5p', 128) or legacy ('tpu-v5p-256', 1) into a topology.
+
+    Raises InvalidSkyError for unknown generations or invalid chip counts.
+    """
+    name = accelerator_name.lower()
+    m = _TPU_NAME_RE.match(name)
+    chips = int(count)
+    if m is None:
+        lm = _TPU_LEGACY_RE.match(name)
+        if lm is None:
+            raise exceptions.InvalidSkyError(
+                f'Not a TPU accelerator: {accelerator_name!r}')
+        gen_name, cores = lm.group(1), int(lm.group(2))
+        if gen_name not in TPU_GENERATIONS:
+            raise exceptions.InvalidSkyError(
+                f'Unknown TPU generation {gen_name!r}. Known: '
+                f'{sorted(TPU_GENERATIONS)}')
+        gen = TPU_GENERATIONS[gen_name]
+        if int(count) != 1:
+            raise exceptions.InvalidSkyError(
+                f'Legacy TPU name {accelerator_name!r} must have count 1 '
+                f'(got {count}); the size is embedded in the name.')
+        chips = max(1, cores // gen.cores_per_chip)
+    else:
+        gen_name = m.group(1)
+        if gen_name not in TPU_GENERATIONS:
+            raise exceptions.InvalidSkyError(
+                f'Unknown TPU generation {gen_name!r}. Known: '
+                f'{sorted(TPU_GENERATIONS)}')
+        gen = TPU_GENERATIONS[gen_name]
+    if count != int(count):
+        raise exceptions.InvalidSkyError(
+            f'TPU chip count must be an integer, got {count}')
+
+    if topology is not None:
+        dims = tuple(int(d) for d in topology.lower().split('x'))
+        if math.prod(dims) != chips:
+            raise exceptions.InvalidSkyError(
+                f'Topology {topology} has {math.prod(dims)} chips, but '
+                f'{chips} chips were requested.')
+        if len(dims) != gen.torus_dims:
+            raise exceptions.InvalidSkyError(
+                f'TPU {gen.name} has a {gen.torus_dims}D torus; topology '
+                f'{topology} has {len(dims)} dims.')
+        # Preserve the user's shape verbatim — the GCP API accepts the
+        # AcceleratorConfig topology exactly as given.
+        shape = tuple(dims)
+    else:
+        valid = gen.valid_chip_counts()
+        if chips not in valid:
+            raise exceptions.InvalidSkyError(
+                f'Invalid chip count {chips} for TPU {gen.name}. Valid '
+                f'sizes: {valid}. (Counts are chips, not TensorCores.)')
+        shape = _default_ici_shape(gen, chips)
+    if chips > gen.max_chips:
+        raise exceptions.InvalidSkyError(
+            f'TPU {gen.name} supports at most {gen.max_chips} chips, '
+            f'got {chips}.')
+    return TpuSliceTopology(gen, chips, shape)
+
+
+def parse_generation(accelerator_name: str) -> TpuGenerationInfo:
+    """Accelerator name ('tpu-v5p' or legacy 'tpu-v5p-256') → generation.
+
+    Cheaper than resolve_topology for callers that only need region/pricing
+    lookups (which are per-generation, not per-slice).
+    """
+    name = accelerator_name.lower()
+    m = _TPU_NAME_RE.match(name) or _TPU_LEGACY_RE.match(name)
+    if m is None:
+        raise exceptions.InvalidSkyError(
+            f'Not a TPU accelerator: {accelerator_name!r}')
+    gen_name = m.group(1)
+    if gen_name not in TPU_GENERATIONS:
+        raise exceptions.InvalidSkyError(
+            f'Unknown TPU generation {gen_name!r}. Known: '
+            f'{sorted(TPU_GENERATIONS)}')
+    return TPU_GENERATIONS[gen_name]
+
+
+def list_tpu_accelerators() -> List[str]:
+    return [f'tpu-{g}' for g in TPU_GENERATIONS]

@@ -1,0 +1,190 @@
+"""Small shared helpers: ids, hashing, yaml, validation, retries.
+
+Parity: ``sky/utils/common_utils.py``.
+"""
+import functools
+import getpass
+import hashlib
+import inspect
+import json
+import os
+import re
+import socket
+import time
+import uuid
+from typing import Any, Callable, Dict, Optional, Union
+
+import yaml
+
+_USER_HASH_FILE = os.path.expanduser('~/.skytpu/user_hash')
+_user_hash_cache: Optional[str] = None
+
+CLUSTER_NAME_VALID_REGEX = re.compile(r'^[a-zA-Z]([-_.a-zA-Z0-9]*[a-zA-Z0-9])?$')
+
+
+def get_user_hash() -> str:
+    """Stable 8-hex id for this user on this machine (parity: user_hash)."""
+    global _user_hash_cache
+    if _user_hash_cache is not None:
+        return _user_hash_cache
+    env = os.environ.get('SKYTPU_USER_HASH')
+    if env:
+        _user_hash_cache = env
+        return env
+    if os.path.exists(_USER_HASH_FILE):
+        with open(_USER_HASH_FILE, encoding='utf-8') as f:
+            h = f.read().strip()
+        if re.fullmatch(r'[0-9a-f]{8}', h):
+            _user_hash_cache = h
+            return h
+    h = hashlib.md5(
+        f'{getpass.getuser()}@{socket.gethostname()}'.encode()).hexdigest()[:8]
+    os.makedirs(os.path.dirname(_USER_HASH_FILE), exist_ok=True)
+    with open(_USER_HASH_FILE, 'w', encoding='utf-8') as f:
+        f.write(h)
+    _user_hash_cache = h
+    return h
+
+
+def get_user_name() -> str:
+    return os.environ.get('SKYTPU_USER', None) or getpass.getuser()
+
+
+def get_usage_run_id() -> str:
+    return str(uuid.uuid4())
+
+
+def make_cluster_name_on_cloud(display_name: str,
+                               max_length: int = 35,
+                               add_user_hash: bool = True) -> str:
+    """Display name → cloud-safe unique name (parity: common_utils
+
+    ``make_cluster_name_on_cloud``): lowercase, hyphens, user-hash suffix,
+    truncated with a content hash when too long.
+    """
+    safe = re.sub(r'[^a-z0-9-]', '-', display_name.lower()).strip('-')
+    if not safe or not safe[0].isalpha():
+        safe = 'c-' + safe
+    suffix = f'-{get_user_hash()}' if add_user_hash else ''
+    name = safe + suffix
+    if len(name) <= max_length:
+        return name
+    digest = hashlib.md5(display_name.encode()).hexdigest()[:4]
+    keep = max_length - len(suffix) - 5
+    return f'{safe[:keep]}-{digest}{suffix}'
+
+
+def check_cluster_name_is_valid(name: Optional[str]) -> None:
+    if name is None:
+        return
+    if not CLUSTER_NAME_VALID_REGEX.fullmatch(name):
+        from skypilot_tpu import exceptions
+        raise exceptions.InvalidClusterNameError(
+            f'Cluster name {name!r} is invalid: must start with a letter and '
+            'contain only letters, digits, and -._')
+
+
+def read_yaml(path: str) -> Dict[str, Any]:
+    with open(os.path.expanduser(path), encoding='utf-8') as f:
+        return yaml.safe_load(f)
+
+
+def read_yaml_all(path: str):
+    with open(os.path.expanduser(path), encoding='utf-8') as f:
+        return list(yaml.safe_load_all(f))
+
+
+def dump_yaml(path: str, config: Union[Dict[str, Any], list]) -> None:
+    with open(os.path.expanduser(path), 'w', encoding='utf-8') as f:
+        f.write(dump_yaml_str(config))
+
+
+def dump_yaml_str(config: Union[Dict[str, Any], list]) -> str:
+
+    class _Dumper(yaml.SafeDumper):
+        pass
+
+    _Dumper.add_representer(
+        tuple, lambda dumper, data: dumper.represent_list(list(data)))
+    return yaml.dump(config,
+                     Dumper=_Dumper,
+                     default_flow_style=False,
+                     sort_keys=False)
+
+
+def json_hash(obj: Any, length: int = 16) -> str:
+    """Deterministic content hash of a JSON-able object."""
+    payload = json.dumps(obj, sort_keys=True, default=str).encode()
+    return hashlib.sha256(payload).hexdigest()[:length]
+
+
+def format_float(x: Optional[float], precision: int = 2) -> str:
+    if x is None:
+        return '-'
+    if abs(x) >= 1000:
+        return f'{x:.0f}'
+    return f'{x:.{precision}f}'
+
+
+def parse_memory(mem: Union[str, int, float, None]) -> Optional[float]:
+    """'16', '16+', 16 → GiB float (plus-suffix handled by caller)."""
+    if mem is None:
+        return None
+    s = str(mem).rstrip('+')
+    return float(s)
+
+
+def retry(fn: Optional[Callable] = None,
+          *,
+          max_retries: int = 3,
+          initial_backoff: float = 1.0,
+          exceptions_to_retry=(Exception,)):
+    """Exponential-backoff retry decorator."""
+
+    def wrap(func):
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            backoff = initial_backoff
+            for attempt in range(max_retries):
+                try:
+                    return func(*args, **kwargs)
+                except exceptions_to_retry:
+                    if attempt == max_retries - 1:
+                        raise
+                    time.sleep(backoff)
+                    backoff *= 2
+
+        return wrapper
+
+    if fn is not None:
+        return wrap(fn)
+    return wrap
+
+
+def get_pretty_entrypoint() -> str:
+    import sys
+    argv = sys.argv[:]
+    if not argv:
+        return ''
+    argv[0] = os.path.basename(argv[0])
+    return ' '.join(argv)
+
+
+def class_fullname(cls) -> str:
+    return f'{cls.__module__}.{cls.__qualname__}'
+
+
+def remove_none_values(d: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: v for k, v in d.items() if v is not None}
+
+
+def deprecated_kwarg(old: str, new: str, kwargs: Dict[str, Any]):
+    if old in kwargs:
+        raise TypeError(f'{old!r} is deprecated; use {new!r}.')
+
+
+def fn_qualname(fn: Callable) -> str:
+    mod = inspect.getmodule(fn)
+    prefix = f'{mod.__name__}.' if mod else ''
+    return prefix + getattr(fn, '__qualname__', str(fn))

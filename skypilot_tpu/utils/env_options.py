@@ -1,0 +1,19 @@
+"""Boolean env knobs (parity: ``sky/utils/env_options.py``)."""
+import enum
+import os
+
+
+class Options(enum.Enum):
+    IS_DEVELOPER = 'SKYTPU_DEV'
+    SHOW_DEBUG_INFO = 'SKYTPU_DEBUG'
+    MINIMIZE_LOGGING = 'SKYTPU_MINIMIZE_LOGGING'
+    SUPPRESS_SENSITIVE_LOG = 'SKYTPU_SUPPRESS_SENSITIVE_LOG'
+    RUNNING_IN_BUFFER = 'SKYTPU_INTERNAL'
+    DISABLE_TELEMETRY = 'SKYTPU_DISABLE_USAGE_COLLECTION'
+
+    def get(self) -> bool:
+        return os.environ.get(self.value, '0') == '1'
+
+    # Allow `if env_options.Options.SHOW_DEBUG_INFO:` style via bool().
+    def __bool__(self) -> bool:
+        return self.get()

@@ -1,0 +1,99 @@
+"""File locks guarding cluster/job/request state.
+
+Parity: the reference's ``filelock`` usage (``backend_utils`` cluster lock,
+``jobs/scheduler.py:80``). Implemented on ``fcntl.flock`` so we add no pip
+dependency; provides both blocking and timeout acquisition.
+"""
+import contextlib
+import fcntl
+import os
+import time
+from typing import Optional
+
+from skypilot_tpu import exceptions
+
+LOCK_DIR = os.path.expanduser('~/.skytpu/locks')
+
+
+class LockTimeout(exceptions.SkyTpuError):
+    pass
+
+
+class FileLock:
+    """Inter-process advisory lock backed by flock(2). Reentrant per-instance."""
+
+    def __init__(self, path: str, timeout: Optional[float] = None):
+        self._path = os.path.expanduser(path)
+        self._timeout = timeout
+        self._fd: Optional[int] = None
+        self._depth = 0
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def acquire(self, timeout: Optional[float] = None) -> None:
+        if self._depth > 0:
+            self._depth += 1
+            return
+        timeout = self._timeout if timeout is None else timeout
+        os.makedirs(os.path.dirname(self._path), exist_ok=True)
+        fd = os.open(self._path, os.O_RDWR | os.O_CREAT, 0o644)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except (BlockingIOError, PermissionError):
+                if deadline is not None and time.monotonic() > deadline:
+                    os.close(fd)
+                    raise LockTimeout(
+                        f'Could not acquire lock {self._path} within '
+                        f'{timeout}s. Another operation may be in progress.')
+                time.sleep(0.05)
+        self._fd = fd
+        self._depth = 1
+
+    def release(self) -> None:
+        if self._depth == 0:
+            return
+        self._depth -= 1
+        if self._depth == 0 and self._fd is not None:
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+            os.close(self._fd)
+            self._fd = None
+
+    def __enter__(self) -> 'FileLock':
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._depth > 0
+
+
+def cluster_status_lock(cluster_name: str) -> FileLock:
+    """Per-cluster status lock (parity: backend_utils CLUSTER_STATUS_LOCK)."""
+    return FileLock(os.path.join(LOCK_DIR, f'cluster.{cluster_name}.lock'),
+                    timeout=20)
+
+
+def cluster_file_mounts_lock(cluster_name: str) -> FileLock:
+    return FileLock(os.path.join(LOCK_DIR, f'mounts.{cluster_name}.lock'),
+                    timeout=10)
+
+
+@contextlib.contextmanager
+def try_lock(lock: FileLock, timeout: float):
+    """Yield True if acquired within timeout, else False (no exception)."""
+    try:
+        lock.acquire(timeout=timeout)
+    except LockTimeout:
+        yield False
+        return
+    try:
+        yield True
+    finally:
+        lock.release()

@@ -1,0 +1,63 @@
+"""Name → class registries (parity: ``sky/utils/registry.py:16``)."""
+from typing import Callable, Dict, Generic, List, Optional, Type, TypeVar
+
+T = TypeVar('T')
+
+
+class Registry(Generic[T]):
+    """Case-insensitive name→instance/class registry with aliases."""
+
+    def __init__(self, registry_name: str):
+        self._name = registry_name
+        self._entries: Dict[str, T] = {}
+        self._aliases: Dict[str, str] = {}
+
+    def register(self,
+                 name: Optional[str] = None,
+                 aliases: Optional[List[str]] = None) -> Callable:
+        """Class decorator: instantiates and registers the class."""
+
+        def decorator(cls: Type) -> Type:
+            key = (name or cls.__name__).lower()
+            if key in self._entries:
+                raise ValueError(
+                    f'{self._name} registry: duplicate entry {key!r}')
+            self._entries[key] = cls() if isinstance(cls, type) else cls
+            for alias in aliases or []:
+                self._aliases[alias.lower()] = key
+            return cls
+
+        return decorator
+
+    def register_value(self, name: str, value: T) -> None:
+        self._entries[name.lower()] = value
+
+    def from_str(self, name: Optional[str]) -> Optional[T]:
+        if name is None:
+            return None
+        key = name.lower()
+        key = self._aliases.get(key, key)
+        if key not in self._entries:
+            raise ValueError(
+                f'{self._name} {name!r} is not a registered entry. '
+                f'Registered: {sorted(self._entries)}')
+        return self._entries[key]
+
+    def __contains__(self, name: str) -> bool:
+        key = name.lower()
+        return key in self._entries or key in self._aliases
+
+    def keys(self):
+        return self._entries.keys()
+
+    def values(self):
+        return self._entries.values()
+
+    def items(self):
+        return self._entries.items()
+
+
+# Cloud registry is populated by skypilot_tpu.clouds at import.
+CLOUD_REGISTRY: Registry = Registry('Cloud')
+# Managed-job recovery strategies (parity: JOBS_RECOVERY_STRATEGY_REGISTRY).
+JOBS_RECOVERY_STRATEGY_REGISTRY: Registry = Registry('RecoveryStrategy')

@@ -1,0 +1,214 @@
+"""JSON-schema validation for task YAML / config / service specs.
+
+Parity: ``sky/utils/schemas.py`` (~1,200 LoC). Schemas are deliberately
+permissive where the reference is (unknown keys rejected at the top level,
+allowed inside cloud-specific bags).
+"""
+from typing import Any, Dict
+
+import jsonschema
+
+from skypilot_tpu import exceptions
+
+
+def _case_insensitive_enum(values):
+    return {'type': 'string', 'case_insensitive_enum': list(values)}
+
+
+_RESOURCES_SCHEMA: Dict[str, Any] = {
+    '$schema': 'https://json-schema.org/draft/2020-12/schema',
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'cloud': {'type': ['string', 'null']},
+        'region': {'type': ['string', 'null']},
+        'zone': {'type': ['string', 'null']},
+        'instance_type': {'type': ['string', 'null']},
+        'cpus': {'type': ['string', 'number', 'null']},
+        'memory': {'type': ['string', 'number', 'null']},
+        'accelerators': {
+            'anyOf': [
+                {'type': 'string'},
+                {'type': 'null'},
+                {'type': 'object', 'additionalProperties': {'type': 'number'}},
+                {'type': 'array', 'items': {'type': 'string'}},
+            ]
+        },
+        'accelerator_args': {
+            'type': ['object', 'null'],
+            'additionalProperties': True,
+            'properties': {
+                'topology': {'type': 'string'},
+                'runtime_version': {'type': 'string'},
+                'tpu_vm': {'type': 'boolean'},
+            },
+        },
+        'use_spot': {'type': ['boolean', 'null']},
+        'job_recovery': {
+            'anyOf': [{'type': 'string'}, {'type': 'null'},
+                      {'type': 'object', 'additionalProperties': True}]
+        },
+        'disk_size': {'type': ['integer', 'null']},
+        'disk_tier': {'type': ['string', 'null']},
+        'ports': {
+            'anyOf': [
+                {'type': 'string'}, {'type': 'integer'}, {'type': 'null'},
+                {'type': 'array', 'items': {'type': ['string', 'integer']}},
+            ]
+        },
+        'labels': {'type': ['object', 'null'],
+                   'additionalProperties': {'type': 'string'}},
+        'image_id': {'type': ['string', 'object', 'null']},
+        'autostop': {
+            'anyOf': [{'type': 'boolean'}, {'type': 'integer'},
+                      {'type': 'string'}, {'type': 'null'},
+                      {'type': 'object', 'additionalProperties': True}]
+        },
+        'any_of': {'type': 'array', 'items': {'type': 'object'}},
+        'ordered': {'type': 'array', 'items': {'type': 'object'}},
+        '_cluster_config_overrides': {'type': 'object',
+                                      'additionalProperties': True},
+    },
+}
+
+_STORAGE_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'name': {'type': ['string', 'null']},
+        'source': {
+            'anyOf': [{'type': 'string'}, {'type': 'null'},
+                      {'type': 'array', 'items': {'type': 'string'}}]
+        },
+        'store': {'type': ['string', 'null']},
+        'persistent': {'type': ['boolean', 'null']},
+        'mode': {'type': ['string', 'null']},
+        '_is_sky_managed': {'type': ['boolean', 'null']},
+    },
+}
+
+_SERVICE_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'required': ['readiness_probe'],
+    'properties': {
+        'readiness_probe': {
+            'anyOf': [
+                {'type': 'string'},
+                {
+                    'type': 'object',
+                    'additionalProperties': False,
+                    'required': ['path'],
+                    'properties': {
+                        'path': {'type': 'string'},
+                        'initial_delay_seconds': {'type': 'number'},
+                        'timeout_seconds': {'type': 'number'},
+                        'post_data': {'type': ['string', 'object']},
+                        'headers': {'type': 'object',
+                                    'additionalProperties': {'type': 'string'}},
+                    },
+                },
+            ]
+        },
+        'replica_policy': {
+            'type': 'object',
+            'additionalProperties': False,
+            'required': ['min_replicas'],
+            'properties': {
+                'min_replicas': {'type': 'integer', 'minimum': 0},
+                'max_replicas': {'type': ['integer', 'null']},
+                'target_qps_per_replica': {'type': ['number', 'null']},
+                'upscale_delay_seconds': {'type': ['number', 'null']},
+                'downscale_delay_seconds': {'type': ['number', 'null']},
+                'base_ondemand_fallback_replicas': {'type': ['integer', 'null']},
+                'dynamic_ondemand_fallback': {'type': ['boolean', 'null']},
+            },
+        },
+        'replicas': {'type': ['integer', 'null']},
+        'load_balancing_policy': {'type': ['string', 'null']},
+    },
+}
+
+_TASK_SCHEMA: Dict[str, Any] = {
+    '$schema': 'https://json-schema.org/draft/2020-12/schema',
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'name': {'type': ['string', 'null']},
+        'workdir': {'type': ['string', 'null']},
+        'num_nodes': {'type': ['integer', 'null'], 'minimum': 1},
+        'setup': {'type': ['string', 'null']},
+        'run': {'type': ['string', 'null']},
+        'envs': {'type': ['object', 'null'],
+                 'additionalProperties': {'type': ['string', 'number', 'null']}},
+        'secrets': {'type': ['object', 'null'],
+                    'additionalProperties': {'type': ['string', 'number',
+                                                      'null']}},
+        'file_mounts': {'type': ['object', 'null'],
+                        'additionalProperties': True},
+        'resources': {'anyOf': [_RESOURCES_SCHEMA, {'type': 'null'}]},
+        'service': {'anyOf': [_SERVICE_SCHEMA, {'type': 'null'}]},
+        'experimental': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'config_overrides': {'type': 'object',
+                                     'additionalProperties': True},
+            },
+        },
+        # Internal/bookkeeping keys accepted on round-trip.
+        'inputs': {'type': ['object', 'null'], 'additionalProperties': True},
+        'outputs': {'type': ['object', 'null'], 'additionalProperties': True},
+    },
+}
+
+_CONFIG_SCHEMA: Dict[str, Any] = {
+    '$schema': 'https://json-schema.org/draft/2020-12/schema',
+    'type': 'object',
+    'additionalProperties': True,
+    'properties': {
+        'jobs': {'type': 'object', 'additionalProperties': True},
+        'serve': {'type': 'object', 'additionalProperties': True},
+        'gcp': {
+            'type': 'object',
+            'additionalProperties': True,
+            'properties': {
+                'project_id': {'type': 'string'},
+                'specific_reservations': {'type': 'array',
+                                          'items': {'type': 'string'}},
+            },
+        },
+        'allowed_clouds': {'type': 'array', 'items': {'type': 'string'}},
+        'api_server': {'type': 'object', 'additionalProperties': True},
+        'admin_policy': {'type': 'string'},
+        'provision': {'type': 'object', 'additionalProperties': True},
+        'ssh': {'type': 'object', 'additionalProperties': True},
+    },
+}
+
+
+def get_task_schema() -> Dict[str, Any]:
+    return _TASK_SCHEMA
+
+
+def get_resources_schema() -> Dict[str, Any]:
+    return _RESOURCES_SCHEMA
+
+
+def get_storage_schema() -> Dict[str, Any]:
+    return _STORAGE_SCHEMA
+
+
+def get_service_schema() -> Dict[str, Any]:
+    return _SERVICE_SCHEMA
+
+
+def get_config_schema() -> Dict[str, Any]:
+    return _CONFIG_SCHEMA
+
+
+def validate(obj: Any, schema: Dict[str, Any], err_prefix: str = '') -> None:
+    try:
+        jsonschema.validate(obj, schema)
+    except jsonschema.ValidationError as e:
+        raise exceptions.InvalidSkyError(f'{err_prefix}{e.message}') from e

@@ -1,0 +1,139 @@
+"""Subprocess helpers: parallel map, returncode handling, daemon spawn.
+
+Parity: ``sky/utils/subprocess_utils.py`` + ``sky/skylet/subprocess_daemon.py``.
+"""
+import os
+import shlex
+import subprocess
+import sys
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+def get_parallel_threads(n_items: int, max_workers: Optional[int] = None) -> int:
+    cpus = os.cpu_count() or 4
+    cap = max_workers if max_workers is not None else max(4, cpus * 2)
+    return max(1, min(n_items, cap))
+
+
+def run_in_parallel(fn: Callable,
+                    args_list: Sequence[Any],
+                    num_threads: Optional[int] = None) -> List[Any]:
+    """Map fn over args in a thread pool; re-raises the first exception.
+
+    Each element of ``args_list`` is passed as a single positional argument
+    (use tuples + a wrapper for multi-arg fns), matching the reference's
+    ``subprocess_utils.run_in_parallel``.
+    """
+    if not args_list:
+        return []
+    if len(args_list) == 1:
+        return [fn(args_list[0])]
+    with ThreadPoolExecutor(
+            max_workers=get_parallel_threads(len(args_list),
+                                             num_threads)) as pool:
+        return list(pool.map(fn, args_list))
+
+
+def run(cmd: str,
+        *,
+        shell: bool = True,
+        check: bool = False,
+        **kwargs) -> subprocess.CompletedProcess:
+    return subprocess.run(cmd,
+                          shell=shell,
+                          check=check,
+                          executable='/bin/bash' if shell else None,
+                          **kwargs)
+
+
+def run_no_outputs(cmd: str, **kwargs) -> int:
+    return run(cmd,
+               stdout=subprocess.DEVNULL,
+               stderr=subprocess.DEVNULL,
+               **kwargs).returncode
+
+
+def handle_returncode(returncode: int,
+                      command: str,
+                      error_msg: str,
+                      stderr: Optional[str] = None,
+                      stream_logs: bool = True) -> None:
+    """Raise CommandError on nonzero returncode (parity: handle_returncode)."""
+    if returncode == 0:
+        return
+    if stream_logs and stderr:
+        logger.error(stderr)
+    raise exceptions.CommandError(returncode, command, error_msg, stderr)
+
+
+def kill_children_processes(parent_pid: Optional[int] = None,
+                            force: bool = False) -> None:
+    """Kill the whole process tree below parent (default: this process)."""
+    parent_pid = parent_pid if parent_pid is not None else os.getpid()
+    try:
+        out = subprocess.run(['pgrep', '-P', str(parent_pid)],
+                             capture_output=True,
+                             text=True,
+                             check=False).stdout
+    except FileNotFoundError:
+        return
+    for pid_s in out.split():
+        try:
+            pid = int(pid_s)
+        except ValueError:
+            continue
+        kill_children_processes(pid, force)
+        try:
+            os.kill(pid, 9 if force else 15)
+        except ProcessLookupError:
+            pass
+
+
+def launch_daemon(cmd: List[str],
+                  log_path: str,
+                  cwd: Optional[str] = None,
+                  env: Optional[dict] = None) -> int:
+    """Start a fully detached daemon process; returns its pid.
+
+    Parity: how the reference double-detaches skylet/controllers
+    (``start_new_session`` + redirected output).
+    """
+    os.makedirs(os.path.dirname(os.path.abspath(log_path)), exist_ok=True)
+    with open(log_path, 'ab') as log_f:
+        proc = subprocess.Popen(cmd,
+                                stdout=log_f,
+                                stderr=subprocess.STDOUT,
+                                stdin=subprocess.DEVNULL,
+                                cwd=cwd,
+                                env=env,
+                                start_new_session=True)
+    return proc.pid
+
+
+def process_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def shlex_join(argv: Sequence[str]) -> str:
+    return ' '.join(shlex.quote(a) for a in argv)
+
+
+def format_run_result(
+        proc: subprocess.CompletedProcess) -> Tuple[int, str, str]:
+    out = proc.stdout.decode() if isinstance(proc.stdout, bytes) else (
+        proc.stdout or '')
+    err = proc.stderr.decode() if isinstance(proc.stderr, bytes) else (
+        proc.stderr or '')
+    return proc.returncode, out, err

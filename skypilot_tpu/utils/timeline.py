@@ -1,0 +1,110 @@
+"""Chrome-trace timeline profiling (parity: ``sky/utils/timeline.py:22-130``).
+
+``@timeline.event`` wraps entrypoints; with ``SKYTPU_DEBUG=1`` the accumulated
+events are dumped as Chrome trace JSON at process exit to
+``~/.skytpu/timelines/<run_id>.json`` (load in ``chrome://tracing`` / Perfetto).
+"""
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Union
+
+_events: List[dict] = []
+_events_lock = threading.Lock()
+_enabled = os.environ.get('SKYTPU_DEBUG', '0') == '1'
+_save_registered = False
+
+
+class Event:
+    """A begin/end trace event usable as context manager."""
+
+    def __init__(self, name: str, message: Optional[str] = None):
+        self._name = name
+        self._message = message
+
+    def _record(self, phase: str) -> None:
+        if not _enabled:
+            return
+        evt = {
+            'name': self._name,
+            'ph': phase,
+            'ts': f'{time.time() * 1e6:.3f}',
+            'pid': str(os.getpid()),
+            'tid': str(threading.current_thread().ident),
+        }
+        if phase == 'B' and self._message is not None:
+            evt['args'] = {'message': self._message}
+        with _events_lock:
+            _events.append(evt)
+        _ensure_save_hook()
+
+    def begin(self):
+        self._record('B')
+
+    def end(self):
+        self._record('E')
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def event(name_or_fn: Union[str, Callable], message: Optional[str] = None):
+    """Decorator (or named factory) recording a span around the call."""
+    if callable(name_or_fn):
+        fn = name_or_fn
+        name = f'{fn.__module__}.{fn.__qualname__}'
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with Event(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    def decorator(fn):
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with Event(name_or_fn, message):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+class FileLockEvent(Event):
+    """Span covering a file-lock acquisition (parity: FileLockEvent)."""
+
+    def __init__(self, lockpath: str):
+        super().__init__(f'filelock:{lockpath}')
+
+
+def save_timeline(path: Optional[str] = None) -> Optional[str]:
+    if not _events:
+        return None
+    if path is None:
+        path = os.path.expanduser(
+            f'~/.skytpu/timelines/{int(time.time())}-{os.getpid()}.json')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with _events_lock:
+        payload = {'traceEvents': list(_events)}
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(payload, f)
+    return path
+
+
+def _ensure_save_hook() -> None:
+    global _save_registered
+    if _save_registered or not _enabled:
+        return
+    _save_registered = True
+    atexit.register(save_timeline)

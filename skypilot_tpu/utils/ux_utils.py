@@ -1,0 +1,65 @@
+"""Terminal UX helpers: colors, spinners-lite, indented log paths.
+
+Parity: ``sky/utils/ux_utils.py`` + a minimal stand-in for rich spinners.
+"""
+import contextlib
+import sys
+from typing import Optional
+
+BOLD = '\033[1m'
+DIM = '\033[2m'
+RESET = '\033[0m'
+GREEN = '\033[32m'
+YELLOW = '\033[33m'
+RED = '\033[31m'
+CYAN = '\033[36m'
+
+INDENT_SYMBOL = f'{DIM}├── {RESET}'
+INDENT_LAST_SYMBOL = f'{DIM}└── {RESET}'
+
+
+def _tty() -> bool:
+    return sys.stdout.isatty()
+
+
+def bold(s: str) -> str:
+    return f'{BOLD}{s}{RESET}' if _tty() else s
+
+
+def dim(s: str) -> str:
+    return f'{DIM}{s}{RESET}' if _tty() else s
+
+
+def colored(s: str, color: str) -> str:
+    return f'{color}{s}{RESET}' if _tty() else s
+
+
+def starting_message(msg: str) -> str:
+    return f'{colored("⚙︎", CYAN)} {msg}'
+
+
+def finishing_message(msg: str, log_path: Optional[str] = None) -> str:
+    base = f'{colored("✓", GREEN)} {msg}'
+    if log_path:
+        base += f'\n{INDENT_LAST_SYMBOL}{dim(f"Log: {log_path}")}'
+    return base
+
+
+def error_message(msg: str) -> str:
+    return f'{colored("⨯", RED)} {msg}'
+
+
+def log_path_hint(log_path: str) -> str:
+    return f'{INDENT_LAST_SYMBOL}{dim(f"To stream logs: tail -f {log_path}")}'
+
+
+@contextlib.contextmanager
+def status(msg: str):
+    """Minimal spinner substitute: prints start/done lines."""
+    print(starting_message(msg))
+    yield
+    print(finishing_message(msg.rstrip('.') + '. Done.'))
+
+
+def retry_message(msg: str) -> str:
+    return f'{colored("↺", YELLOW)} {msg}'

@@ -1,0 +1,51 @@
+"""Test harness: virtual 8-device CPU mesh + isolated ~/.skytpu state.
+
+All tests run on a CPU "mesh" of 8 XLA host devices so multi-chip sharding
+logic (pjit/shard_map over a Mesh) is exercised without TPU hardware —
+mirroring how the driver dry-runs `__graft_entry__.dryrun_multichip`.
+"""
+import os
+import sys
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault('XLA_FLAGS',
+                      '--xla_force_host_platform_device_count=8')
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolate_state(tmp_path, monkeypatch):
+    """Point all persistent state (~/.skytpu) at a per-test tmpdir."""
+    home = tmp_path / 'home'
+    home.mkdir()
+    monkeypatch.setenv('HOME', str(home))
+    monkeypatch.setenv('SKYTPU_USER_HASH', 'abcd1234')
+    # Reset cached module state that depends on HOME.
+    import skypilot_tpu.skypilot_config as config
+    config.reload_config()
+    import skypilot_tpu.utils.common_utils as cu
+    cu._user_hash_cache = None  # pylint: disable=protected-access
+    import skypilot_tpu.utils.locks as locks
+    monkeypatch.setattr(locks, 'LOCK_DIR', str(home / '.skytpu' / 'locks'))
+    yield
+
+
+@pytest.fixture
+def enable_all_clouds(monkeypatch):
+    """Parity: tests/common_test_fixtures.py:137 enable_all_clouds —
+
+    make credential checks pass for every registered cloud."""
+    from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+    for impl in CLOUD_REGISTRY.values():
+        monkeypatch.setattr(type(impl), 'check_credentials',
+                            classmethod(lambda cls: (True, None)))
+        monkeypatch.setattr(
+            type(impl), 'get_current_user_identity',
+            classmethod(lambda cls: ['test-identity']))
+    yield

@@ -1,0 +1,111 @@
+"""Tests for Resources (parity model: tests/unit_tests/test_resources.py)."""
+import pytest
+
+from skypilot_tpu import Resources
+from skypilot_tpu import exceptions
+
+
+def test_tpu_accelerator_implies_gcp():
+    r = Resources(accelerators='tpu-v5p:128')
+    assert r.cloud is not None and r.cloud.name == 'gcp'
+    assert r.tpu_topology is not None
+    assert r.tpu_topology.num_hosts == 32
+    assert r.accelerators == {'tpu-v5p': 128.0}
+    assert r.accelerator_args['tpu_vm'] is True
+    assert r.num_hosts_per_node() == 32
+
+
+def test_tpu_on_aws_rejected():
+    with pytest.raises(exceptions.ResourcesMismatchError):
+        Resources(cloud='local', accelerators='tpu-v5e:8')
+
+
+def test_gpu_accelerator_dict():
+    r = Resources(accelerators={'A100': 8})
+    assert r.accelerators == {'A100': 8.0}
+    assert r.tpu_topology is None
+
+
+def test_accelerator_string_with_count():
+    r = Resources(accelerators='A100:4')
+    assert r.accelerators == {'A100': 4.0}
+
+
+def test_cpus_plus_syntax():
+    r = Resources(cpus='8+', memory='32+')
+    assert r.cpus == '8+'
+    assert r.memory == '32+'
+    with pytest.raises(exceptions.InvalidSkyError):
+        Resources(cpus='abc')
+
+
+def test_zone_infers_region():
+    r = Resources(cloud='gcp', zone='us-central1-a')
+    assert r.region == 'us-central1'
+
+
+def test_invalid_zone_rejected():
+    with pytest.raises(exceptions.InvalidSkyError):
+        Resources(cloud='gcp', zone='mars-central1-z')
+
+
+def test_yaml_roundtrip():
+    r = Resources(accelerators='tpu-v5e:8',
+                  use_spot=True,
+                  region='us-central1',
+                  labels={'team': 'research'})
+    config = r.to_yaml_config()
+    r2 = Resources.from_yaml_config(config)
+    assert r2.to_yaml_config() == config
+    assert r2.use_spot
+    assert r2.tpu_topology.num_chips == 8
+
+
+def test_less_demanding_than():
+    want = Resources(accelerators='tpu-v5e:8')
+    have = Resources(cloud='gcp',
+                     instance_type='TPU-VM',
+                     accelerators='tpu-v5e:8')
+    assert want.less_demanding_than(have)
+    bigger = Resources(accelerators='tpu-v5e:16')
+    assert not bigger.less_demanding_than(have)
+
+
+def test_copy_override():
+    r = Resources(accelerators='tpu-v5p:8')
+    r2 = r.copy(use_spot=True)
+    assert r2.use_spot
+    assert r2.tpu_topology.num_chips == 8
+    assert not r.use_spot
+
+
+def test_autostop_forms():
+    assert Resources(autostop=True).autostop == {'idle_minutes': 5,
+                                                 'down': False}
+    assert Resources(autostop=10).autostop == {'idle_minutes': 10,
+                                               'down': False}
+    assert Resources(autostop='15m').autostop == {'idle_minutes': 15,
+                                                  'down': False}
+    assert Resources(autostop={'idle_minutes': 3, 'down': True}).autostop \
+        == {'idle_minutes': 3, 'down': True}
+    assert Resources().autostop is None
+
+
+def test_tpu_hourly_cost():
+    r = Resources(accelerators='tpu-v5e:8',
+                  instance_type='TPU-VM',
+                  region='us-central1')
+    # 8 chips * $1.20/chip-hr
+    assert r.get_hourly_cost() == pytest.approx(9.6)
+    spot = Resources(accelerators='tpu-v5e:8',
+                     instance_type='TPU-VM',
+                     region='us-central1',
+                     use_spot=True)
+    assert spot.get_hourly_cost() == pytest.approx(8 * 0.48)
+
+
+def test_ports_validation():
+    r = Resources(ports=[8080, '9000-9010'])
+    assert r.ports == ['8080', '9000-9010']
+    with pytest.raises(exceptions.InvalidSkyError):
+        Resources(ports='http')

@@ -1,0 +1,81 @@
+"""Tests for Task YAML parsing and Dag context."""
+import textwrap
+
+import pytest
+import yaml
+
+from skypilot_tpu import Dag, Resources, Task
+from skypilot_tpu import exceptions
+
+
+def test_basic_task():
+    t = Task(name='train', run='echo hi', setup='echo setup', num_nodes=2)
+    assert t.num_nodes == 2
+    assert t.run == 'echo hi'
+
+
+def test_task_from_yaml():
+    config = yaml.safe_load(
+        textwrap.dedent("""\
+        name: finetune
+        resources:
+          accelerators: tpu-v5p:128
+          use_spot: true
+        num_nodes: 1
+        envs:
+          MODEL: llama-3.1-8b
+        setup: |
+          echo setup
+        run: |
+          python train.py --model $MODEL
+        """))
+    t = Task.from_yaml_config(config)
+    assert t.name == 'finetune'
+    r = next(iter(t.resources))
+    assert r.tpu_topology.num_chips == 128
+    assert r.use_spot
+    assert t.envs == {'MODEL': 'llama-3.1-8b'}
+
+
+def test_env_var_substitution():
+    config = yaml.safe_load('run: echo ${MYVAR}\n')
+    t = Task.from_yaml_config(config, env_overrides={'MYVAR': 'hello'})
+    assert t.run == 'echo hello'
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(exceptions.InvalidSkyError):
+        Task.from_yaml_config({'bogus_key': 1})
+
+
+def test_yaml_roundtrip():
+    t = Task(name='t1', run='echo a', num_nodes=4,
+             envs={'A': '1'})
+    t.set_resources(Resources(accelerators='tpu-v5e:16'))
+    config = t.to_yaml_config()
+    t2 = Task.from_yaml_config(config)
+    assert t2.to_yaml_config() == config
+
+
+def test_dag_context_auto_add():
+    with Dag() as dag:
+        t1 = Task(name='a', run='echo 1')
+        t2 = Task(name='b', run='echo 2')
+    assert dag.tasks == [t1, t2]
+    assert len(dag) == 2
+    assert not dag.is_chain()  # two disconnected nodes: not a chain
+    dag.add_edge(t1, t2)
+    assert dag.is_chain()
+    assert dag.get_sorted_tasks() == [t1, t2]
+
+
+def test_invalid_num_nodes():
+    with pytest.raises(exceptions.InvalidSkyError):
+        Task(num_nodes=0)
+
+
+def test_workdir_must_exist(tmp_path):
+    t = Task(workdir=str(tmp_path))
+    assert t.workdir == str(tmp_path)
+    with pytest.raises(exceptions.InvalidSkyError):
+        Task(workdir=str(tmp_path / 'nope'))

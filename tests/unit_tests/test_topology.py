@@ -1,0 +1,82 @@
+"""Tests for TPU slice topology resolution (SURVEY §2.2 GCP TPU logic)."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import topology
+
+
+def test_v5p_128_resolves():
+    topo = topology.resolve_topology('tpu-v5p', 128)
+    assert topo.num_chips == 128
+    assert topo.num_hosts == 32
+    assert topo.chips_per_host == 4
+    assert topo.is_pod
+    assert topo.gcp_accelerator_type == 'v5p-256'  # TensorCores = 2x chips
+    prod = 1
+    for d in topo.ici_shape:
+        prod *= d
+    assert prod == 128
+    assert len(topo.ici_shape) == 3  # v5p is a 3D torus
+
+
+def test_v5e_8_single_host():
+    topo = topology.resolve_topology('tpu-v5e', 8)
+    assert topo.num_hosts == 1
+    assert not topo.is_pod
+    assert topo.gcp_accelerator_type == 'v5e-8'
+
+
+def test_v5e_16_multi_host():
+    topo = topology.resolve_topology('tpu-v5e', 16)
+    assert topo.num_hosts == 4
+    assert topo.chips_per_host == 4
+    assert len(topo.ici_shape) == 2  # v5e is a 2D torus
+
+
+def test_legacy_core_name():
+    # Legacy GCP name: v2-8 = 8 TensorCores = 4 chips, one host.
+    topo = topology.resolve_topology('tpu-v2-8', 1)
+    assert topo.num_chips == 4
+    assert topo.num_hosts == 1
+
+
+def test_explicit_topology():
+    topo = topology.resolve_topology('tpu-v4', 32, topology='4x4x2')
+    assert topo.topology_str == '4x4x2'
+    assert topo.num_chips == 32
+    assert topo.num_hosts == 8
+
+
+def test_invalid_chip_count():
+    with pytest.raises(exceptions.InvalidSkyError, match='Valid sizes'):
+        topology.resolve_topology('tpu-v5p', 12)
+
+
+def test_unknown_generation():
+    with pytest.raises(exceptions.InvalidSkyError, match='Unknown TPU'):
+        topology.resolve_topology('tpu-v99', 8)
+
+
+def test_topology_chip_mismatch():
+    with pytest.raises(exceptions.InvalidSkyError, match='chips'):
+        topology.resolve_topology('tpu-v4', 32, topology='4x4x4')
+
+
+def test_default_mesh_shape():
+    topo = topology.resolve_topology('tpu-v5p', 128)
+    mesh = topo.default_mesh_shape()
+    assert mesh['data'] * mesh['fsdp'] * mesh['model'] == 128
+    assert mesh['model'] <= topo.chips_per_host
+
+
+def test_is_tpu_accelerator():
+    assert topology.is_tpu_accelerator('tpu-v5p')
+    assert topology.is_tpu_accelerator('tpu-v2-8')
+    assert not topology.is_tpu_accelerator('A100')
+    assert not topology.is_tpu_accelerator('H100')
+
+
+def test_hbm_and_flops():
+    topo = topology.resolve_topology('tpu-v5p', 8)
+    assert topo.hbm_gib == 8 * 95
+    assert topo.peak_bf16_tflops == 8 * 459
