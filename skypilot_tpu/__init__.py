@@ -16,12 +16,13 @@ Public API parity target: ``sky/__init__.py`` in the reference.
 
 __version__ = '0.1.0'
 
-from skypilot_tpu.dag import Dag
-from skypilot_tpu.resources import Resources
-from skypilot_tpu.task import Task
-
-# Lazy-loaded heavy entry points (importing execution pulls in backends).
+# Everything is lazy: on-cluster codegen snippets import
+# skypilot_tpu.skylet.* hundreds of times over SSH, and a heavy package
+# __init__ would tax every control-plane roundtrip.
 _LAZY_ATTRS = {
+    'Dag': ('skypilot_tpu.dag', 'Dag'),
+    'Resources': ('skypilot_tpu.resources', 'Resources'),
+    'Task': ('skypilot_tpu.task', 'Task'),
     'launch': ('skypilot_tpu.execution', 'launch'),
     'exec': ('skypilot_tpu.execution', 'exec_'),
     'Optimizer': ('skypilot_tpu.optimizer', 'Optimizer'),
@@ -51,9 +52,4 @@ def __getattr__(name):
     raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
 
 
-__all__ = [
-    'Dag',
-    'Resources',
-    'Task',
-    '__version__',
-] + list(_LAZY_ATTRS)
+__all__ = ['__version__'] + list(_LAZY_ATTRS)

@@ -1,0 +1,67 @@
+"""Pluggable request mutation/validation hook.
+
+Parity: ``sky/admin_policy.py`` (AdminPolicy, UserRequest,
+MutatedUserRequest) applied to every DAG at ``execution.py:180-187``.
+Configure with ``admin_policy: my_module.MyPolicy`` in
+``~/.skytpu/config.yaml``.
+"""
+import dataclasses
+import importlib
+import typing
+from typing import Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import skypilot_config
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import dag as dag_lib
+
+
+@dataclasses.dataclass
+class UserRequest:
+    dag: 'dag_lib.Dag'
+    skypilot_config: dict
+
+
+@dataclasses.dataclass
+class MutatedUserRequest:
+    dag: 'dag_lib.Dag'
+    skypilot_config: dict
+
+
+class AdminPolicy:
+    """Subclass and override validate_and_mutate."""
+
+    @classmethod
+    def validate_and_mutate(cls,
+                            user_request: UserRequest) -> MutatedUserRequest:
+        return MutatedUserRequest(dag=user_request.dag,
+                                  skypilot_config=user_request.skypilot_config)
+
+
+def _load_policy() -> Optional[type]:
+    path = skypilot_config.get_nested(('admin_policy',), None)
+    if path is None:
+        return None
+    module_name, _, class_name = path.rpartition('.')
+    try:
+        module = importlib.import_module(module_name)
+        policy = getattr(module, class_name)
+    except (ImportError, AttributeError) as e:
+        raise exceptions.InvalidSkyError(
+            f'Could not load admin policy {path!r}: {e}') from e
+    if not issubclass(policy, AdminPolicy):
+        raise exceptions.InvalidSkyError(
+            f'{path} is not an AdminPolicy subclass.')
+    return policy
+
+
+def apply(dag: 'dag_lib.Dag') -> 'dag_lib.Dag':
+    """Parity: admin_policy_utils.apply."""
+    policy = _load_policy()
+    if policy is None:
+        return dag
+    request = UserRequest(dag=dag,
+                          skypilot_config=skypilot_config.to_dict())
+    mutated = policy.validate_and_mutate(request)
+    return mutated.dag

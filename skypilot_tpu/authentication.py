@@ -1,0 +1,44 @@
+"""SSH keypair management (parity: ``sky/authentication.py:133``).
+
+Key generation is pure-Python (``cryptography``) so it works on hosts
+without OpenSSH client tools; the keys are standard RSA/OpenSSH format
+consumed by the ssh/scp binaries on real control hosts.
+"""
+import os
+
+from skypilot_tpu.utils import locks
+
+DEFAULT_SSH_USER = 'skytpu'
+_KEY_PATH = '~/.skytpu/sky-key'
+
+
+def _generate_keypair(private_path: str, public_path: str) -> None:
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    private_pem = key.private_bytes(
+        encoding=serialization.Encoding.PEM,
+        format=serialization.PrivateFormat.OpenSSH,
+        encryption_algorithm=serialization.NoEncryption())
+    public_ssh = key.public_key().public_bytes(
+        encoding=serialization.Encoding.OpenSSH,
+        format=serialization.PublicFormat.OpenSSH)
+    with open(private_path, 'wb') as f:
+        f.write(private_pem)
+    os.chmod(private_path, 0o600)
+    with open(public_path, 'wb') as f:
+        f.write(public_ssh + b' skytpu\n')
+
+
+def get_or_generate_keys() -> tuple:
+    """Returns (public_key_str, private_key_path); generates once."""
+    private_path = os.path.expanduser(_KEY_PATH)
+    public_path = private_path + '.pub'
+    os.makedirs(os.path.dirname(private_path), exist_ok=True)
+    lock = locks.FileLock(private_path + '.lock', timeout=20)
+    with lock:
+        if not os.path.exists(private_path):
+            _generate_keypair(private_path, public_path)
+    with open(public_path, encoding='utf-8') as f:
+        public_key = f.read().strip()
+    return public_key, _KEY_PATH

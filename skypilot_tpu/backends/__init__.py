@@ -1,0 +1,7 @@
+"""Backends (parity: ``sky/backends/__init__.py``)."""
+from skypilot_tpu.backends.backend import Backend
+from skypilot_tpu.backends.backend import ResourceHandle
+from skypilot_tpu.backends.gang_backend import ClusterHandle
+from skypilot_tpu.backends.gang_backend import TpuGangBackend
+
+__all__ = ['Backend', 'ClusterHandle', 'ResourceHandle', 'TpuGangBackend']

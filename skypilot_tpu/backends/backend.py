@@ -1,0 +1,119 @@
+"""Backend ABC: template-method cluster lifecycle.
+
+Parity: ``sky/backends/backend.py:30-153`` — public wrappers calling
+``_``-impl hooks so subclasses override behavior, not the surface.
+"""
+import typing
+from typing import Any, Dict, Generic, Optional, TypeVar
+
+from skypilot_tpu.usage import usage_lib
+from skypilot_tpu.utils import timeline
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+
+Path = str
+
+
+class ResourceHandle:
+    """Opaque pickled handle to a provisioned cluster."""
+
+    def get_cluster_name(self) -> str:
+        raise NotImplementedError
+
+
+_ResourceHandleType = TypeVar('_ResourceHandleType', bound=ResourceHandle)
+
+
+class Backend(Generic[_ResourceHandleType]):
+    """Template-method lifecycle: provision → sync → setup → execute."""
+
+    NAME = 'backend'
+
+    # ------------------------------------------------------------- public
+
+    @timeline.event
+    @usage_lib.entrypoint(name='backend.provision')
+    def provision(
+            self,
+            task: 'task_lib.Task',
+            to_provision: Optional['resources_lib.Resources'],
+            dryrun: bool,
+            stream_logs: bool,
+            cluster_name: Optional[str] = None,
+            retry_until_up: bool = False) -> Optional[_ResourceHandleType]:
+        if cluster_name is None:
+            from skypilot_tpu.backends import backend_utils
+            cluster_name = backend_utils.generate_cluster_name()
+        return self._provision(task, to_provision, dryrun, stream_logs,
+                               cluster_name, retry_until_up)
+
+    @timeline.event
+    def sync_workdir(self, handle: _ResourceHandleType, workdir: Path) -> None:
+        return self._sync_workdir(handle, workdir)
+
+    @timeline.event
+    def sync_file_mounts(
+        self,
+        handle: _ResourceHandleType,
+        all_file_mounts: Optional[Dict[Path, Path]],
+        storage_mounts: Optional[Dict[Path, Any]],
+    ) -> None:
+        return self._sync_file_mounts(handle, all_file_mounts, storage_mounts)
+
+    @timeline.event
+    def setup(self, handle: _ResourceHandleType, task: 'task_lib.Task',
+              detach_setup: bool = False) -> None:
+        return self._setup(handle, task, detach_setup)
+
+    @timeline.event
+    def execute(self,
+                handle: _ResourceHandleType,
+                task: 'task_lib.Task',
+                detach_run: bool = False,
+                dryrun: bool = False) -> Optional[int]:
+        """Returns the job id (None for dryrun)."""
+        from skypilot_tpu import global_state
+        global_state.update_last_use(handle.get_cluster_name())
+        return self._execute(handle, task, detach_run, dryrun)
+
+    @timeline.event
+    def post_execute(self, handle: _ResourceHandleType,
+                     down: bool) -> None:
+        return self._post_execute(handle, down)
+
+    @timeline.event
+    def teardown(self,
+                 handle: _ResourceHandleType,
+                 terminate: bool,
+                 purge: bool = False) -> None:
+        return self._teardown(handle, terminate, purge)
+
+    def register_info(self, **kwargs) -> None:
+        """Inject backend knobs (parity: backend.py register_info)."""
+
+    # ---------------------------------------------------------- impl hooks
+
+    def _provision(self, task, to_provision, dryrun, stream_logs,
+                   cluster_name, retry_until_up):
+        raise NotImplementedError
+
+    def _sync_workdir(self, handle, workdir) -> None:
+        raise NotImplementedError
+
+    def _sync_file_mounts(self, handle, all_file_mounts,
+                          storage_mounts) -> None:
+        raise NotImplementedError
+
+    def _setup(self, handle, task, detach_setup) -> None:
+        raise NotImplementedError
+
+    def _execute(self, handle, task, detach_run, dryrun) -> Optional[int]:
+        raise NotImplementedError
+
+    def _post_execute(self, handle, down) -> None:
+        raise NotImplementedError
+
+    def _teardown(self, handle, terminate, purge) -> None:
+        raise NotImplementedError

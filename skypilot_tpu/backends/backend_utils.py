@@ -1,0 +1,204 @@
+"""Backend helpers: provider config assembly, cluster status refresh.
+
+Parity: ``sky/backends/backend_utils.py`` — most notably the status
+reconciliation state machine (``_update_cluster_status:1766``,
+``refresh_cluster_record:2081``) and cluster config generation
+(``write_cluster_config:530``; here config is structured data handed to the
+provisioner, not a Jinja-rendered Ray YAML).
+"""
+import os
+import time
+import typing
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import authentication
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu import provision as provision_router
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import locks
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.backends import gang_backend
+
+logger = sky_logging.init_logger(__name__)
+
+# Status refresh TTL (parity: backend_utils CLUSTER_STATUS_POLL TTL).
+_CLUSTER_STATUS_TTL_SECONDS = 2.0
+
+
+def generate_cluster_name() -> str:
+    return f'sky-{int(time.time()) % 10**8:08x}-{common_utils.get_user_hash()[:4]}'
+
+
+def make_provision_config(
+        resources: 'resources_lib.Resources', num_nodes: int,
+        cluster_name_on_cloud: str, region_name: str,
+        zone_name: Optional[str]) -> provision_common.ProvisionConfig:
+    """Deploy variables + auth → ProvisionConfig (parity:
+    write_cluster_config:530, minus Jinja)."""
+    from skypilot_tpu.clouds import cloud as cloud_lib
+    cloud = resources.cloud
+    assert cloud is not None
+    region = cloud_lib.Region(region_name)
+    zones = None
+    if zone_name is not None:
+        z = cloud_lib.Zone(zone_name)
+        z.region = region_name
+        zones = [z]
+    node_config = resources.make_deploy_variables(cluster_name_on_cloud,
+                                                  region, zones, num_nodes)
+    provider_config: Dict[str, Any] = {
+        'region': region_name,
+        'availability_zone': zone_name,
+    }
+    auth_config: Dict[str, Any] = {}
+    if cloud.name == 'gcp':
+        public_key, private_key = authentication.get_or_generate_keys()
+        ssh_user = authentication.DEFAULT_SSH_USER
+        provider_config['ssh_user'] = ssh_user
+        provider_config['ssh_private_key'] = private_key
+        auth_config['ssh_keys'] = f'{ssh_user}:{public_key}'
+        auth_config['ssh_user'] = ssh_user
+    return provision_common.ProvisionConfig(
+        provider_config=provider_config,
+        authentication_config=auth_config,
+        docker_config={},
+        node_config=node_config,
+        count=num_nodes,
+        tags={},
+        resume_stopped_nodes=True,
+    )
+
+
+# ----------------------------------------------------------- status refresh
+
+
+def refresh_cluster_record(
+        cluster_name: str,
+        force_refresh: bool = False,
+        acquire_per_cluster_status_lock: bool = True
+) -> Optional[Dict[str, Any]]:
+    """Return the cluster record, reconciling with the cloud if stale.
+
+    Parity: backend_utils.refresh_cluster_record:2081.
+    """
+    record = global_state.get_cluster_from_name(cluster_name)
+    if record is None:
+        return None
+    if not force_refresh:
+        updated_at = record.get('status_updated_at') or 0
+        if time.time() - updated_at < _CLUSTER_STATUS_TTL_SECONDS:
+            return record
+    if not acquire_per_cluster_status_lock:
+        return _update_cluster_status(cluster_name)
+    lock = locks.cluster_status_lock(cluster_name)
+    with locks.try_lock(lock, timeout=10) as acquired:
+        if not acquired:
+            return global_state.get_cluster_from_name(cluster_name)
+        return _update_cluster_status(cluster_name)
+
+
+def _update_cluster_status(cluster_name: str) -> Optional[Dict[str, Any]]:
+    """Query the cloud and reconcile the registry row.
+
+    State machine (parity: _update_cluster_status:1766):
+    * all nodes running  → UP
+    * any node stopped/missing with others running → INIT (partial)
+    * all stopped        → STOPPED
+    * none found         → drop row (terminated out-of-band)
+    """
+    record = global_state.get_cluster_from_name(cluster_name)
+    if record is None:
+        return None
+    handle = record['handle']
+    if not hasattr(handle, 'provider_name'):
+        return record
+    try:
+        statuses = provision_router.query_instances(
+            handle.provider_name,
+            handle.cluster_name_on_cloud,
+            provider_config=handle.provider_config)
+    except Exception as e:  # pylint: disable=broad-except
+        logger.debug(f'query_instances failed for {cluster_name}: {e}')
+        return record
+    values = list(statuses.values())
+    expected = handle.launched_nodes
+    n_running = sum(1 for v in values if v == 'running')
+    if not values:
+        # Terminated behind our back: remove the record.
+        global_state.remove_cluster(cluster_name, terminate=True)
+        return None
+    if n_running == expected == len(values):
+        global_state.update_cluster_status(cluster_name,
+                                           global_state.ClusterStatus.UP)
+    elif n_running == 0 and all(v == 'stopped' for v in values):
+        global_state.update_cluster_status(
+            cluster_name, global_state.ClusterStatus.STOPPED)
+    else:
+        # Partial: some nodes died/preempted → INIT, callers decide.
+        global_state.update_cluster_status(cluster_name,
+                                           global_state.ClusterStatus.INIT)
+    return global_state.get_cluster_from_name(cluster_name)
+
+
+def check_cluster_available(
+        cluster_name: str,
+        operation: str) -> 'gang_backend.ClusterHandle':
+    """Raise unless the cluster exists and is UP (parity:
+
+    check_cluster_available in backend_utils)."""
+    record = refresh_cluster_record(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist. '
+            f'Cannot {operation}.')
+    if record['status'] != global_state.ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is {record["status"].value}; '
+            f'cannot {operation}. Run `sky start {cluster_name}` first.',
+            cluster_status=record['status'],
+            handle=record['handle'])
+    return record['handle']
+
+
+def check_owner_identity(cluster_name: str) -> None:
+    """Parity: check_owner_identity:1518 — refuse to operate on clusters
+
+    created under a different cloud identity."""
+    record = global_state.get_cluster_from_name(cluster_name)
+    if record is None or record.get('owner') is None:
+        return
+    handle = record['handle']
+    cloud = getattr(getattr(handle, 'launched_resources', None), 'cloud',
+                    None)
+    if cloud is None:
+        return
+    current = type(cloud).get_current_user_identity()
+    if current is None:
+        return
+    owner: List[str] = record['owner']
+    if not set(owner) & set(current):
+        raise exceptions.ClusterOwnerIdentityMismatchError(
+            f'Cluster {cluster_name!r} is owned by identity {owner}, but '
+            f'the current identity is {current}.')
+
+
+def get_clusters(refresh: bool = False,
+                 cluster_names: Optional[List[str]] = None
+                 ) -> List[Dict[str, Any]]:
+    """Parity: backend_utils.get_clusters:2494."""
+    records = global_state.get_clusters()
+    if cluster_names is not None:
+        records = [r for r in records if r['name'] in cluster_names]
+    if not refresh:
+        return records
+    out = []
+    for r in records:
+        nr = refresh_cluster_record(r['name'], force_refresh=True)
+        if nr is not None:
+            out.append(nr)
+    return out

@@ -1,0 +1,81 @@
+"""Credential probing per cloud (`sky check`). Parity: ``sky/check.py:25``."""
+from typing import Iterable, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu import skypilot_config
+from skypilot_tpu.utils import ux_utils
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+logger = sky_logging.init_logger(__name__)
+
+
+def check(quiet: bool = False,
+          clouds: Optional[Iterable[str]] = None) -> List[str]:
+    """Probe credentials for each cloud; persist the enabled set.
+
+    Returns the list of enabled cloud names.
+    """
+    allowed = skypilot_config.get_nested(('allowed_clouds',), None)
+    results: List[Tuple[str, bool, Optional[str]]] = []
+    to_check = ([CLOUD_REGISTRY.from_str(c) for c in clouds]
+                if clouds else list(CLOUD_REGISTRY.values()))
+    for impl in to_check:
+        name = str(impl)
+        if allowed is not None and name.lower() not in [
+                a.lower() for a in allowed
+        ]:
+            results.append((name, False, 'disabled by allowed_clouds config'))
+            continue
+        try:
+            ok, reason = type(impl).check_credentials()
+        except Exception as e:  # pylint: disable=broad-except
+            ok, reason = False, str(e)
+        results.append((name, ok, reason))
+
+    enabled = [name for name, ok, _ in results if ok]
+    if clouds is None:
+        global_state.set_enabled_clouds(enabled)
+    else:
+        # Partial check: merge with the previously-enabled set.
+        prev = set(global_state.get_enabled_clouds())
+        for name, ok, _ in results:
+            if ok:
+                prev.add(name)
+            else:
+                prev.discard(name)
+        global_state.set_enabled_clouds(sorted(prev))
+        enabled = sorted(prev)
+
+    if not quiet:
+        print(ux_utils.bold('Checked credentials for clouds:'))
+        for name, ok, reason in results:
+            mark = ux_utils.colored('enabled', ux_utils.GREEN) if ok else \
+                ux_utils.colored('disabled', ux_utils.RED)
+            line = f'  {name}: {mark}'
+            if not ok and reason:
+                line += f'\n    {ux_utils.dim(reason)}'
+            print(line)
+    if not enabled:
+        raise exceptions.NoCloudAccessError(
+            'No cloud is enabled. Configure credentials (e.g. `gcloud auth '
+            'login`) and rerun `sky check`.')
+    return enabled
+
+
+def get_cached_enabled_clouds_or_refresh(
+        raise_if_no_cloud: bool = True) -> List:
+    """Enabled Cloud objects from cache, probing once if the cache is empty.
+
+    Parity: check.py:184 get_cached_enabled_clouds_or_refresh.
+    """
+    names = global_state.get_enabled_clouds()
+    if not names:
+        try:
+            names = check(quiet=True)
+        except exceptions.NoCloudAccessError:
+            if raise_if_no_cloud:
+                raise
+            names = []
+    return [CLOUD_REGISTRY.from_str(n) for n in names]

@@ -1,0 +1,193 @@
+"""Cluster management verbs (parity: ``sky/core.py``).
+
+status/start/stop/down/autostop/queue/cancel/tail_logs/download_logs/
+cost_report — each operates through the registry + backend.
+"""
+import time
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu.backends import backend_utils
+from skypilot_tpu.backends import gang_backend
+from skypilot_tpu.skylet import job_lib
+from skypilot_tpu.usage import usage_lib
+from skypilot_tpu.utils import locks
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _backend() -> gang_backend.TpuGangBackend:
+    return gang_backend.TpuGangBackend()
+
+
+@usage_lib.entrypoint(name='status')
+def status(cluster_names: Optional[Union[str, List[str]]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    """Cluster records (parity: core.py:92)."""
+    if isinstance(cluster_names, str):
+        cluster_names = [cluster_names]
+    return backend_utils.get_clusters(refresh=refresh,
+                                     cluster_names=cluster_names)
+
+
+@usage_lib.entrypoint(name='start')
+def start(cluster_name: str,
+          idle_minutes_to_autostop: Optional[int] = None,
+          retry_until_up: bool = False,
+          down: bool = False) -> gang_backend.ClusterHandle:
+    """Restart a STOPPED cluster (parity: core.py:393)."""
+    from skypilot_tpu import provision as provision_router
+    from skypilot_tpu.provision import provisioner as provisioner_lib
+    record = backend_utils.refresh_cluster_record(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    handle: gang_backend.ClusterHandle = record['handle']
+    if record['status'] == global_state.ClusterStatus.UP:
+        logger.info(f'Cluster {cluster_name!r} is already up.')
+        return handle
+    backend_utils.check_owner_identity(cluster_name)
+    with locks.cluster_status_lock(cluster_name):
+        config = backend_utils.make_provision_config(
+            handle.launched_resources, handle.launched_nodes,
+            handle.cluster_name_on_cloud,
+            handle.provider_config.get('region'),
+            handle.provider_config.get('availability_zone'))
+        provisioner_lib.bulk_provision(handle.provider_name,
+                                       handle.provider_config.get('region'),
+                                       handle.cluster_name_on_cloud, config)
+        cluster_info = provision_router.get_cluster_info(
+            handle.provider_name,
+            handle.provider_config.get('region'),
+            handle.cluster_name_on_cloud,
+            provider_config=config.provider_config)
+        if handle.launched_resources.tpu_topology is not None:
+            cluster_info.custom_metadata['chips_per_host'] = \
+                handle.launched_resources.tpu_topology.chips_per_host
+        provisioner_lib.wait_for_ssh(cluster_info)
+        provisioner_lib.post_provision_runtime_setup(
+            cluster_name, handle.cluster_name_on_cloud, cluster_info,
+            cluster_info.provider_config)
+        handle.update_cluster_info()
+        global_state.add_or_update_cluster(cluster_name, handle, ready=True)
+    if idle_minutes_to_autostop is not None:
+        _backend().set_autostop(handle, idle_minutes_to_autostop, down)
+    return handle
+
+
+@usage_lib.entrypoint(name='stop')
+def stop(cluster_name: str, purge: bool = False) -> None:
+    """Parity: core.py:500. TPU pods cannot stop — surfaced as an error."""
+    record = global_state.get_cluster_from_name(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    backend_utils.check_owner_identity(cluster_name)
+    handle = record['handle']
+    res = handle.launched_resources
+    if res.tpu_topology is not None and res.tpu_topology.is_pod:
+        raise exceptions.NotSupportedError(
+            f'Cluster {cluster_name!r} is a multi-host TPU slice, which '
+            'GCP cannot stop. Use `sky down` instead.')
+    _backend().teardown(handle, terminate=False, purge=purge)
+
+
+@usage_lib.entrypoint(name='down')
+def down(cluster_name: str, purge: bool = False) -> None:
+    """Parity: core.py:465."""
+    record = global_state.get_cluster_from_name(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    backend_utils.check_owner_identity(cluster_name)
+    _backend().teardown(record['handle'], terminate=True, purge=purge)
+
+
+@usage_lib.entrypoint(name='autostop')
+def autostop(cluster_name: str, idle_minutes: int,
+             down: bool = False) -> None:  # pylint: disable=redefined-outer-name
+    """Parity: core.py:560. idle_minutes < 0 cancels autostop."""
+    handle = backend_utils.check_cluster_available(cluster_name, 'autostop')
+    res = handle.launched_resources
+    if (res.tpu_topology is not None and res.tpu_topology.is_pod and
+            not down and idle_minutes >= 0):
+        raise exceptions.NotSupportedError(
+            'Multi-host TPU slices support autodown (`down=True`), not '
+            'autostop.')
+    _backend().set_autostop(handle, idle_minutes, down)
+
+
+@usage_lib.entrypoint(name='queue')
+def queue(cluster_name: str,
+          skip_finished: bool = False) -> List[Dict[str, Any]]:
+    """Parity: core.py:669."""
+    handle = backend_utils.check_cluster_available(cluster_name, 'queue')
+    jobs = _backend().get_job_queue(handle)
+    if skip_finished:
+        jobs = [
+            j for j in jobs
+            if not job_lib.JobStatus(j['status']).is_terminal()
+        ]
+    return jobs
+
+
+@usage_lib.entrypoint(name='cancel')
+def cancel(cluster_name: str,
+           job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> None:
+    """Parity: core.py:732."""
+    handle = backend_utils.check_cluster_available(cluster_name, 'cancel')
+    _backend().cancel_jobs(handle, job_ids, cancel_all=all_jobs)
+
+
+@usage_lib.entrypoint(name='tail_logs')
+def tail_logs(cluster_name: str,
+              job_id: Optional[int] = None,
+              follow: bool = True) -> int:
+    """Parity: core.py:827."""
+    handle = backend_utils.check_cluster_available(cluster_name, 'tail logs')
+    return _backend().tail_logs(handle, job_id, follow=follow)
+
+
+@usage_lib.entrypoint(name='download_logs')
+def download_logs(cluster_name: str,
+                  job_id: Optional[int] = None,
+                  local_dir: str = '~/sky_logs') -> str:
+    """Parity: core.py:865."""
+    handle = backend_utils.check_cluster_available(cluster_name,
+                                                   'download logs')
+    return _backend().sync_down_logs(handle, job_id, local_dir)
+
+
+@usage_lib.entrypoint(name='job_status')
+def job_status(cluster_name: str,
+               job_id: Optional[int] = None
+               ) -> Optional[job_lib.JobStatus]:
+    handle = backend_utils.check_cluster_available(cluster_name,
+                                                   'query job status')
+    return _backend().get_job_status(handle, job_id)
+
+
+@usage_lib.entrypoint(name='cost_report')
+def cost_report() -> List[Dict[str, Any]]:
+    """Per-cluster accumulated cost from usage intervals.
+
+    Parity: core.py:280 + global_user_state.py:548.
+    """
+    out = []
+    for record in global_state.get_cluster_history():
+        resources = record['launched_resources']
+        cost = None
+        if resources is not None and resources.is_launchable():
+            cost = resources.get_hourly_cost() * \
+                (record['num_nodes'] or 1) * record['duration'] / 3600.0
+        out.append({
+            'name': record['name'],
+            'duration': record['duration'],
+            'num_nodes': record['num_nodes'],
+            'resources': resources,
+            'total_cost': cost,
+        })
+    return out

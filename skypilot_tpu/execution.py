@@ -1,0 +1,193 @@
+"""Staged task lifecycle: OPTIMIZE → PROVISION → SYNC → SETUP → EXEC.
+
+Parity: ``sky/execution.py:35-46`` (Stage), ``:99`` (_execute), ``:380``
+(launch), ``:568`` (exec).
+"""
+import enum
+from typing import List, Optional, Tuple, Union
+
+from skypilot_tpu import admin_policy
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backends import backend_utils
+from skypilot_tpu.backends import gang_backend
+from skypilot_tpu.usage import usage_lib
+from skypilot_tpu.utils import timeline
+
+logger = sky_logging.init_logger(__name__)
+
+
+class Stage(enum.Enum):
+    """Parity: execution.py:35-46."""
+    OPTIMIZE = enum.auto()
+    PROVISION = enum.auto()
+    SYNC_WORKDIR = enum.auto()
+    SYNC_FILE_MOUNTS = enum.auto()
+    SETUP = enum.auto()
+    PRE_EXEC = enum.auto()
+    EXEC = enum.auto()
+    DOWN = enum.auto()
+
+
+def _to_dag(entrypoint: Union[task_lib.Task, dag_lib.Dag]) -> dag_lib.Dag:
+    if isinstance(entrypoint, task_lib.Task):
+        dag = dag_lib.Dag()
+        dag.add(entrypoint)
+        return dag
+    return entrypoint
+
+
+@timeline.event
+def _execute(
+    entrypoint: Union[task_lib.Task, dag_lib.Dag],
+    dryrun: bool = False,
+    down: bool = False,
+    stream_logs: bool = True,
+    backend: Optional[gang_backend.TpuGangBackend] = None,
+    stages: Optional[List[Stage]] = None,
+    cluster_name: Optional[str] = None,
+    detach_run: bool = False,
+    idle_minutes_to_autostop: Optional[int] = None,
+    retry_until_up: bool = False,
+    no_setup: bool = False,
+    handle: Optional[gang_backend.ClusterHandle] = None,
+) -> Tuple[Optional[int], Optional[gang_backend.ClusterHandle]]:
+    """Returns (job_id, handle). Parity: execution.py:99."""
+    dag = _to_dag(entrypoint)
+    if len(dag.tasks) != 1:
+        # Parity: execution.py:188 — multi-task dags go through sky jobs.
+        raise exceptions.NotSupportedError(
+            'launch/exec expects exactly one task; use managed jobs for '
+            'pipelines.')
+    dag = admin_policy.apply(dag)
+    task = dag.tasks[0]
+    if cluster_name is not None:
+        backend_utils.check_owner_identity(cluster_name)
+
+    backend = backend or gang_backend.TpuGangBackend()
+    stages = stages or list(Stage)
+
+    job_id = None
+    try:
+        if Stage.OPTIMIZE in stages and task.best_resources is None:
+            optimizer_lib.Optimizer.optimize(
+                dag,
+                minimize=optimizer_lib.OptimizeTarget.COST,
+                quiet=not stream_logs)
+        if dryrun and Stage.PROVISION not in stages:
+            return None, None
+
+        if Stage.PROVISION in stages:
+            handle = backend.provision(
+                task,
+                task.best_resources,
+                dryrun=dryrun,
+                stream_logs=stream_logs,
+                cluster_name=cluster_name,
+                retry_until_up=retry_until_up)
+            if dryrun:
+                return None, None
+            assert handle is not None
+
+        if Stage.SYNC_WORKDIR in stages and task.workdir is not None:
+            backend.sync_workdir(handle, task.workdir)
+
+        if Stage.SYNC_FILE_MOUNTS in stages and (task.file_mounts or
+                                                 task.storage_mounts):
+            backend.sync_file_mounts(handle, task.file_mounts,
+                                     task.storage_mounts)
+
+        if Stage.SETUP in stages and not no_setup:
+            backend.setup(handle, task)
+
+        if Stage.PRE_EXEC in stages:
+            autostop = idle_minutes_to_autostop
+            autostop_down = down
+            if autostop is None:
+                res = task.best_resources or next(iter(task.resources))
+                if res.autostop is not None:
+                    autostop = res.autostop['idle_minutes']
+                    autostop_down = res.autostop['down']
+            if autostop is not None and autostop >= 0:
+                backend.set_autostop(handle, autostop, autostop_down)
+
+        if Stage.EXEC in stages:
+            job_id = backend.execute(handle, task, detach_run=detach_run)
+
+        if Stage.DOWN in stages and down and idle_minutes_to_autostop is None:
+            backend.teardown(handle, terminate=True)
+    finally:
+        pass
+    return job_id, handle
+
+
+@usage_lib.entrypoint(name='launch')
+def launch(
+    task: Union[task_lib.Task, dag_lib.Dag],
+    cluster_name: Optional[str] = None,
+    retry_until_up: bool = False,
+    idle_minutes_to_autostop: Optional[int] = None,
+    dryrun: bool = False,
+    down: bool = False,
+    stream_logs: bool = True,
+    backend: Optional[gang_backend.TpuGangBackend] = None,
+    detach_run: bool = False,
+    no_setup: bool = False,
+) -> Tuple[Optional[int], Optional[gang_backend.ClusterHandle]]:
+    """Provision (if needed) + run a task. Parity: execution.py:380."""
+    return _execute(task,
+                    dryrun=dryrun,
+                    down=down,
+                    stream_logs=stream_logs,
+                    backend=backend,
+                    cluster_name=cluster_name,
+                    detach_run=detach_run,
+                    idle_minutes_to_autostop=idle_minutes_to_autostop,
+                    retry_until_up=retry_until_up,
+                    no_setup=no_setup)
+
+
+@usage_lib.entrypoint(name='exec')
+def exec_(  # pylint: disable=redefined-builtin
+    task: Union[task_lib.Task, dag_lib.Dag],
+    cluster_name: str,
+    dryrun: bool = False,
+    down: bool = False,
+    stream_logs: bool = True,
+    backend: Optional[gang_backend.TpuGangBackend] = None,
+    detach_run: bool = False,
+) -> Tuple[Optional[int], Optional[gang_backend.ClusterHandle]]:
+    """Run on an existing cluster, skipping provision/setup.
+
+    Parity: execution.py:568 — requires the cluster to be UP and the task's
+    resources to fit the cluster.
+    """
+    dag = _to_dag(task)
+    t = dag.tasks[0]
+    handle = backend_utils.check_cluster_available(cluster_name, 'exec')
+    # any-of semantics: the task fits if ANY resource alternative fits
+    # (parity: _check_task_resources_smaller_than_cluster).
+    if not any(
+            res.less_demanding_than(handle.launched_resources, t.num_nodes)
+            for res in t.resources):
+        raise exceptions.ResourcesMismatchError(
+            f'Task requires one of {t.resources}, none of which the '
+            f'cluster {cluster_name!r} ({handle.launched_resources}) can '
+            'satisfy.')
+    t.best_resources = handle.launched_resources
+    return _execute(dag,
+                    dryrun=dryrun,
+                    down=down,
+                    stream_logs=stream_logs,
+                    backend=backend,
+                    cluster_name=cluster_name,
+                    detach_run=detach_run,
+                    handle=handle,
+                    stages=[
+                        Stage.SYNC_WORKDIR,
+                        Stage.EXEC,
+                    ] if t.workdir else [Stage.EXEC])

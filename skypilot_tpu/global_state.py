@@ -1,0 +1,345 @@
+"""sqlite registry of clusters / storage / enabled clouds / users.
+
+Parity: ``sky/global_user_state.py:40,194,673``. Cluster handles are pickled
+into the row like the reference (handle classes implement ``__setstate__``
+for forward migration).
+"""
+import enum
+import json
+import os
+import pickle
+import sqlite3
+import threading
+import time
+import typing
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import common_utils
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.backends import backend as backend_lib
+
+_DB_PATH = '~/.skytpu/state.db'
+_local = threading.local()
+
+
+class ClusterStatus(enum.Enum):
+    """Parity: sky/global_user_state.py ClusterStatus."""
+    INIT = 'INIT'
+    UP = 'UP'
+    STOPPED = 'STOPPED'
+
+    def colored_str(self) -> str:
+        from skypilot_tpu.utils import ux_utils
+        color = {
+            ClusterStatus.INIT: ux_utils.YELLOW,
+            ClusterStatus.UP: ux_utils.GREEN,
+            ClusterStatus.STOPPED: ux_utils.DIM,
+        }[self]
+        return ux_utils.colored(self.value, color)
+
+
+def _db() -> sqlite3.Connection:
+    if getattr(_local, 'conn', None) is None:
+        path = os.path.expanduser(_DB_PATH)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        conn = sqlite3.connect(path, timeout=10)
+        conn.row_factory = sqlite3.Row
+        _create_tables(conn)
+        _local.conn = conn
+        _local.path = path
+    elif getattr(_local, 'path', None) != os.path.expanduser(_DB_PATH):
+        # $HOME changed (tests isolate state): reopen.
+        _local.conn.close()
+        _local.conn = None
+        return _db()
+    return _local.conn
+
+
+def _create_tables(conn: sqlite3.Connection) -> None:
+    conn.executescript("""
+        CREATE TABLE IF NOT EXISTS clusters (
+            name TEXT PRIMARY KEY,
+            launched_at INTEGER,
+            handle BLOB,
+            last_use TEXT,
+            status TEXT,
+            autostop INTEGER DEFAULT -1,
+            to_down INTEGER DEFAULT 0,
+            owner TEXT DEFAULT NULL,
+            metadata TEXT DEFAULT '{}',
+            cluster_hash TEXT DEFAULT NULL,
+            status_updated_at INTEGER DEFAULT NULL
+        );
+        CREATE TABLE IF NOT EXISTS cluster_history (
+            cluster_hash TEXT PRIMARY KEY,
+            name TEXT,
+            num_nodes INTEGER,
+            requested_resources BLOB,
+            launched_resources BLOB,
+            usage_intervals BLOB
+        );
+        CREATE TABLE IF NOT EXISTS storage (
+            name TEXT PRIMARY KEY,
+            launched_at INTEGER,
+            handle BLOB,
+            last_use TEXT,
+            status TEXT
+        );
+        CREATE TABLE IF NOT EXISTS enabled_clouds (
+            cloud TEXT PRIMARY KEY
+        );
+        CREATE TABLE IF NOT EXISTS users (
+            id TEXT PRIMARY KEY,
+            name TEXT
+        );
+        CREATE TABLE IF NOT EXISTS config (
+            key TEXT PRIMARY KEY,
+            value TEXT
+        );
+    """)
+    conn.commit()
+
+
+# ------------------------------------------------------------------ clusters
+
+
+def add_or_update_cluster(cluster_name: str,
+                          cluster_handle: 'backend_lib.ResourceHandle',
+                          requested_resources: Optional[set] = None,
+                          is_launch: bool = True,
+                          ready: bool = False) -> None:
+    """Parity: global_user_state.add_or_update_cluster:194."""
+    conn = _db()
+    status = ClusterStatus.UP if ready else ClusterStatus.INIT
+    now = int(time.time())
+    handle_blob = pickle.dumps(cluster_handle)
+    usage_intervals: List = []
+    cluster_hash = _get_hash(cluster_name) or common_utils.get_usage_run_id()
+    row = conn.execute('SELECT * FROM cluster_history WHERE cluster_hash=?',
+                       (cluster_hash,)).fetchone()
+    if row is not None:
+        usage_intervals = pickle.loads(row['usage_intervals'])
+    if is_launch and (not usage_intervals or
+                      usage_intervals[-1][1] is not None):
+        usage_intervals.append((now, None))
+    launched_nodes = getattr(cluster_handle, 'launched_nodes', None)
+    launched_resources = getattr(cluster_handle, 'launched_resources', None)
+    conn.execute(
+        """INSERT INTO clusters
+           (name, launched_at, handle, last_use, status, autostop, to_down,
+            owner, metadata, cluster_hash, status_updated_at)
+           VALUES (?,?,?,?,?,
+                   COALESCE((SELECT autostop FROM clusters WHERE name=?), -1),
+                   COALESCE((SELECT to_down FROM clusters WHERE name=?), 0),
+                   (SELECT owner FROM clusters WHERE name=?),
+                   COALESCE((SELECT metadata FROM clusters WHERE name=?),
+                            '{}'),
+                   ?, ?)
+           ON CONFLICT(name) DO UPDATE SET
+             launched_at=excluded.launched_at, handle=excluded.handle,
+             last_use=excluded.last_use, status=excluded.status,
+             cluster_hash=excluded.cluster_hash,
+             status_updated_at=excluded.status_updated_at""",
+        (cluster_name, now, handle_blob, common_utils.get_pretty_entrypoint(),
+         status.value, cluster_name, cluster_name, cluster_name, cluster_name,
+         cluster_hash, now))
+    conn.execute(
+        """INSERT OR REPLACE INTO cluster_history
+           (cluster_hash, name, num_nodes, requested_resources,
+            launched_resources, usage_intervals) VALUES (?,?,?,?,?,?)""",
+        (cluster_hash, cluster_name, launched_nodes,
+         pickle.dumps(requested_resources), pickle.dumps(launched_resources),
+         pickle.dumps(usage_intervals)))
+    conn.commit()
+
+
+def _get_hash(cluster_name: str) -> Optional[str]:
+    row = _db().execute('SELECT cluster_hash FROM clusters WHERE name=?',
+                        (cluster_name,)).fetchone()
+    return row['cluster_hash'] if row else None
+
+
+def update_cluster_status(cluster_name: str, status: ClusterStatus) -> None:
+    conn = _db()
+    conn.execute(
+        'UPDATE clusters SET status=?, status_updated_at=? WHERE name=?',
+        (status.value, int(time.time()), cluster_name))
+    conn.commit()
+    if status != ClusterStatus.UP:
+        _close_usage_interval(cluster_name)
+
+
+def update_last_use(cluster_name: str) -> None:
+    conn = _db()
+    conn.execute('UPDATE clusters SET last_use=? WHERE name=?',
+                 (common_utils.get_pretty_entrypoint(), cluster_name))
+    conn.commit()
+
+
+def _close_usage_interval(cluster_name: str) -> None:
+    conn = _db()
+    h = _get_hash(cluster_name)
+    if h is None:
+        return
+    row = conn.execute('SELECT * FROM cluster_history WHERE cluster_hash=?',
+                       (h,)).fetchone()
+    if row is None:
+        return
+    intervals = pickle.loads(row['usage_intervals'])
+    if intervals and intervals[-1][1] is None:
+        intervals[-1] = (intervals[-1][0], int(time.time()))
+        conn.execute(
+            'UPDATE cluster_history SET usage_intervals=? '
+            'WHERE cluster_hash=?', (pickle.dumps(intervals), h))
+        conn.commit()
+
+
+def remove_cluster(cluster_name: str, terminate: bool) -> None:
+    """Parity: on down → delete row; on stop → STOPPED + clear cached IPs."""
+    conn = _db()
+    _close_usage_interval(cluster_name)
+    if terminate:
+        conn.execute('DELETE FROM clusters WHERE name=?', (cluster_name,))
+    else:
+        record = get_cluster_from_name(cluster_name)
+        if record is not None:
+            handle = record['handle']
+            if hasattr(handle, 'stable_internal_external_ips'):
+                handle.stable_internal_external_ips = None
+            conn.execute(
+                'UPDATE clusters SET status=?, handle=?, '
+                'status_updated_at=? WHERE name=?',
+                (ClusterStatus.STOPPED.value, pickle.dumps(handle),
+                 int(time.time()), cluster_name))
+    conn.commit()
+
+
+def get_cluster_from_name(
+        cluster_name: Optional[str]) -> Optional[Dict[str, Any]]:
+    row = _db().execute('SELECT * FROM clusters WHERE name=?',
+                        (cluster_name,)).fetchone()
+    if row is None:
+        return None
+    return _row_to_record(row)
+
+
+def _row_to_record(row: sqlite3.Row) -> Dict[str, Any]:
+    return {
+        'name': row['name'],
+        'launched_at': row['launched_at'],
+        'handle': pickle.loads(row['handle']),
+        'last_use': row['last_use'],
+        'status': ClusterStatus(row['status']),
+        'autostop': row['autostop'],
+        'to_down': bool(row['to_down']),
+        'owner': json.loads(row['owner']) if row['owner'] else None,
+        'metadata': json.loads(row['metadata']),
+        'cluster_hash': row['cluster_hash'],
+        'status_updated_at': row['status_updated_at'],
+    }
+
+
+def get_clusters() -> List[Dict[str, Any]]:
+    rows = _db().execute(
+        'SELECT * FROM clusters ORDER BY launched_at DESC').fetchall()
+    return [_row_to_record(r) for r in rows]
+
+
+def set_cluster_autostop_value(cluster_name: str, idle_minutes: int,
+                               to_down: bool) -> None:
+    conn = _db()
+    conn.execute('UPDATE clusters SET autostop=?, to_down=? WHERE name=?',
+                 (idle_minutes, int(to_down), cluster_name))
+    conn.commit()
+
+
+def set_owner_identity_for_cluster(cluster_name: str,
+                                   owner_identity: Optional[List[str]]
+                                   ) -> None:
+    if owner_identity is None:
+        return
+    conn = _db()
+    conn.execute('UPDATE clusters SET owner=? WHERE name=?',
+                 (json.dumps(owner_identity), cluster_name))
+    conn.commit()
+
+
+def get_cluster_usage_intervals(cluster_hash: Optional[str]):
+    if cluster_hash is None:
+        return None
+    row = _db().execute(
+        'SELECT usage_intervals FROM cluster_history WHERE cluster_hash=?',
+        (cluster_hash,)).fetchone()
+    return pickle.loads(row['usage_intervals']) if row else None
+
+
+def get_cluster_history() -> List[Dict[str, Any]]:
+    rows = _db().execute('SELECT * FROM cluster_history').fetchall()
+    out = []
+    for row in rows:
+        intervals = pickle.loads(row['usage_intervals'])
+        duration = sum((end or int(time.time())) - start
+                       for start, end in intervals)
+        out.append({
+            'name': row['name'],
+            'num_nodes': row['num_nodes'],
+            'launched_resources': pickle.loads(row['launched_resources']),
+            'duration': duration,
+            'usage_intervals': intervals,
+        })
+    return out
+
+
+# ------------------------------------------------------------------ storage
+
+
+def add_or_update_storage(storage_name: str, storage_handle: Any,
+                          storage_status: str) -> None:
+    conn = _db()
+    conn.execute(
+        'INSERT OR REPLACE INTO storage VALUES (?,?,?,?,?)',
+        (storage_name, int(time.time()), pickle.dumps(storage_handle),
+         common_utils.get_pretty_entrypoint(), storage_status))
+    conn.commit()
+
+
+def remove_storage(storage_name: str) -> None:
+    conn = _db()
+    conn.execute('DELETE FROM storage WHERE name=?', (storage_name,))
+    conn.commit()
+
+
+def get_storage_from_name(storage_name: str) -> Optional[Dict[str, Any]]:
+    row = _db().execute('SELECT * FROM storage WHERE name=?',
+                        (storage_name,)).fetchone()
+    if row is None:
+        return None
+    return {
+        'name': row['name'],
+        'launched_at': row['launched_at'],
+        'handle': pickle.loads(row['handle']),
+        'last_use': row['last_use'],
+        'status': row['status'],
+    }
+
+
+def get_storage() -> List[Dict[str, Any]]:
+    rows = _db().execute('SELECT name FROM storage').fetchall()
+    return [get_storage_from_name(r['name']) for r in rows]
+
+
+# ------------------------------------------------------------ enabled clouds
+
+
+def get_enabled_clouds() -> List[str]:
+    rows = _db().execute('SELECT cloud FROM enabled_clouds').fetchall()
+    return [r['cloud'] for r in rows]
+
+
+def set_enabled_clouds(enabled_clouds: List[str]) -> None:
+    conn = _db()
+    conn.execute('DELETE FROM enabled_clouds')
+    conn.executemany('INSERT INTO enabled_clouds VALUES (?)',
+                     [(c,) for c in enabled_clouds])
+    conn.commit()

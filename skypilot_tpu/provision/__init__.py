@@ -1,0 +1,48 @@
+"""Provision router: dispatch lifecycle calls to per-cloud modules.
+
+Parity: ``sky/provision/__init__.py:37-197`` (@_route_to_cloud_impl).
+"""
+import functools
+import importlib
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+
+_PROVIDER_MODULES = {
+    'gcp': 'skypilot_tpu.provision.gcp',
+    'local': 'skypilot_tpu.provision.local',
+}
+
+
+def _get_module(provider_name: str):
+    key = provider_name.lower()
+    if key not in _PROVIDER_MODULES:
+        raise ValueError(f'Unknown provisioner {provider_name!r}. '
+                         f'Known: {sorted(_PROVIDER_MODULES)}')
+    return importlib.import_module(_PROVIDER_MODULES[key])
+
+
+def _route(fn_name: str):
+
+    def call(provider_name: str, *args, **kwargs):
+        module = _get_module(provider_name)
+        impl = getattr(module, fn_name, None)
+        if impl is None:
+            raise NotImplementedError(
+                f'{provider_name} provisioner does not implement {fn_name}')
+        return impl(*args, **kwargs)
+
+    call.__name__ = fn_name
+    return call
+
+
+# Uniform provisioner surface (parity: run/stop/terminate/wait/open_ports/
+# get_cluster_info dispatchers).
+run_instances = _route('run_instances')
+stop_instances = _route('stop_instances')
+terminate_instances = _route('terminate_instances')
+wait_instances = _route('wait_instances')
+get_cluster_info = _route('get_cluster_info')
+query_instances = _route('query_instances')
+open_ports = _route('open_ports')
+cleanup_ports = _route('cleanup_ports')

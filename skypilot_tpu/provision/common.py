@@ -1,0 +1,125 @@
+"""Provisioner shared types (parity: ``sky/provision/common.py:39-233``)."""
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ProvisionConfig:
+    """Everything a cloud provisioner needs to create instances."""
+    provider_config: Dict[str, Any]       # cloud-specific (project, zone, …)
+    authentication_config: Dict[str, Any]  # ssh user/keys
+    docker_config: Dict[str, Any]
+    node_config: Dict[str, Any]            # deploy variables from Resources
+    count: int                             # logical nodes
+    tags: Dict[str, str]
+    resume_stopped_nodes: bool
+
+
+@dataclasses.dataclass
+class ProvisionRecord:
+    """Result of run_instances (parity: common.py:63)."""
+    provider_name: str
+    region: str
+    zone: Optional[str]
+    cluster_name: str
+    head_instance_id: str
+    resumed_instance_ids: List[str]
+    created_instance_ids: List[str]
+
+    def is_instance_just_booted(self, instance_id: str) -> bool:
+        return (instance_id in self.resumed_instance_ids or
+                instance_id in self.created_instance_ids)
+
+
+@dataclasses.dataclass
+class InstanceInfo:
+    """One SSH target. A multi-host TPU slice yields one InstanceInfo per
+
+    worker host of the node (parity: instance_utils.py:1635-1656 fan-out)."""
+    instance_id: str
+    internal_ip: str
+    external_ip: Optional[str]
+    tags: Dict[str, str]
+    ssh_port: int = 22
+
+    def get_feasible_ip(self) -> str:
+        return self.external_ip or self.internal_ip
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """Post-provision cluster metadata (parity: common.py:109)."""
+    instances: Dict[str, List[InstanceInfo]]  # instance_id -> host infos
+    head_instance_id: Optional[str]
+    provider_name: str
+    provider_config: Dict[str, Any]
+    ssh_user: str = 'skytpu'
+    ssh_private_key: Optional[str] = None
+    # Extra metadata (e.g. TPU accelerator_type, topology).
+    custom_metadata: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def get_head_instance(self) -> Optional[InstanceInfo]:
+        if self.head_instance_id is None:
+            return None
+        infos = self.instances.get(self.head_instance_id)
+        return infos[0] if infos else None
+
+    def ordered_host_infos(self) -> List[InstanceInfo]:
+        """All hosts in rank order: head instance's hosts first, then the
+
+        rest — rank == index == TPU worker id within a slice. The single
+        source of truth for host ordering (runners, cluster_info.json,
+        cached handles all derive from it)."""
+        order = [self.head_instance_id] + sorted(
+            i for i in self.instances if i != self.head_instance_id)
+        out: List[InstanceInfo] = []
+        for iid in order:
+            if iid is None:
+                continue
+            out.extend(self.instances.get(iid, []))
+        return out
+
+    def ordered_host_meta(self) -> List[Dict[str, Any]]:
+        """Rank-ordered transport dicts (the ``hosts`` entries of
+
+        cluster_info.json, also cached on ClusterHandle)."""
+        hosts: List[Dict[str, Any]] = []
+        for rank, info in enumerate(self.ordered_host_infos()):
+            if self.provider_name == 'local':
+                hosts.append({
+                    'transport': 'local',
+                    'rank': rank,
+                    'node_dir': info.tags['node_dir'],
+                    'internal_ip': info.tags['node_dir'],
+                })
+            else:
+                hosts.append({
+                    'transport': 'ssh',
+                    'rank': rank,
+                    'ip': info.get_feasible_ip(),
+                    'internal_ip': info.internal_ip,
+                    'ssh_port': info.ssh_port,
+                    'ssh_user': self.ssh_user,
+                    'ssh_key': self.ssh_private_key or '~/.skytpu/sky-key',
+                })
+        return hosts
+
+    def ip_tuples(self) -> List[tuple]:
+        """[(internal_ip, external_ip)], rank order."""
+        return [(i.internal_ip, i.external_ip)
+                for i in self.ordered_host_infos()]
+
+    def get_ssh_ports(self) -> List[int]:
+        return [i.ssh_port for i in self.ordered_host_infos()]
+
+    def num_hosts(self) -> int:
+        return sum(len(v) for v in self.instances.values())
+
+
+class ProvisionerError(RuntimeError):
+    """Base error; carries blocked-resource hints for the failover engine."""
+    errors: List[Dict[str, Any]] = []
+
+
+class StopFailoverError(ProvisionerError):
+    """Cluster is partially up and must not fail over elsewhere."""

@@ -1,0 +1,21 @@
+"""GCP provisioner: TPU VM slices (first-class) via tpu.googleapis.com.
+
+Parity: ``sky/provision/gcp/`` — but TPU-only and TPU-first: the unit of
+provisioning is a *slice node* whose ``networkEndpoints[]`` fan out to one
+``InstanceInfo`` per worker host (parity: instance_utils.py:1635-1656).
+Compute-VM support (GPU hosts) is routed through the same surface later.
+"""
+from skypilot_tpu.provision.gcp.instance import cleanup_ports
+from skypilot_tpu.provision.gcp.instance import get_cluster_info
+from skypilot_tpu.provision.gcp.instance import open_ports
+from skypilot_tpu.provision.gcp.instance import query_instances
+from skypilot_tpu.provision.gcp.instance import run_instances
+from skypilot_tpu.provision.gcp.instance import stop_instances
+from skypilot_tpu.provision.gcp.instance import terminate_instances
+from skypilot_tpu.provision.gcp.instance import wait_instances
+
+__all__ = [
+    'cleanup_ports', 'get_cluster_info', 'open_ports', 'query_instances',
+    'run_instances', 'stop_instances', 'terminate_instances',
+    'wait_instances'
+]

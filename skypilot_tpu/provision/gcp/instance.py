@@ -1,0 +1,265 @@
+"""GCP TPU slice lifecycle (parity: ``sky/provision/gcp/instance.py`` +
+
+``GCPTPUVMInstance`` in ``instance_utils.py:1191``).
+
+A "cluster" of N logical nodes = N TPU slice nodes named
+``{cluster}-{i}``; each slice contributes ``num_hosts`` SSH targets.
+Single-slice clusters (num_nodes=1, the common case) are one TPU node.
+"""
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu import skypilot_config
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import tpu_api
+
+logger = sky_logging.init_logger(__name__)
+
+_CLUSTER_LABEL = 'skytpu-cluster'
+
+# GCP TPU node states → framework status strings.
+_STATE_MAP = {
+    'CREATING': 'pending',
+    'STARTING': 'pending',
+    'REPAIRING': 'pending',
+    'READY': 'running',
+    'RESTARTING': 'pending',
+    'STOPPING': 'stopping',
+    'STOPPED': 'stopped',
+    'DELETING': 'terminating',
+    'PREEMPTED': 'terminated',
+    'TERMINATED': 'terminated',
+    'HIDING': 'terminating',
+    'HIDDEN': 'terminated',
+    'UNHIDING': 'pending',
+}
+
+
+def _project_id(provider_config: Dict[str, Any]) -> str:
+    project = provider_config.get('project_id') or skypilot_config.get_nested(
+        ('gcp', 'project_id'), None) or os.environ.get('GOOGLE_CLOUD_PROJECT')
+    if not project:
+        raise common.ProvisionerError(
+            'No GCP project configured. Set gcp.project_id in '
+            '~/.skytpu/config.yaml or $GOOGLE_CLOUD_PROJECT.')
+    return project
+
+
+def _client(provider_config: Dict[str, Any]) -> tpu_api.TpuClient:
+    return tpu_api.TpuClient(_project_id(provider_config))
+
+
+def _node_name(cluster_name_on_cloud: str, index: int) -> str:
+    return f'{cluster_name_on_cloud}-{index}'
+
+
+def _cluster_nodes(client: tpu_api.TpuClient, zone: str,
+                   cluster_name_on_cloud: str) -> List[dict]:
+    nodes = client.list_nodes(zone)
+    return [
+        n for n in nodes
+        if n.get('labels', {}).get(_CLUSTER_LABEL) == cluster_name_on_cloud
+    ]
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    """Create (or resume) the cluster's TPU slice nodes."""
+    zone = config.provider_config['availability_zone']
+    client = _client(config.provider_config)
+    node_cfg = config.node_config
+
+    existing = _cluster_nodes(client, zone, cluster_name_on_cloud)
+    existing_by_name = {n['name'].split('/')[-1]: n for n in existing}
+
+    created: List[str] = []
+    resumed: List[str] = []
+    head_id: Optional[str] = None
+    for i in range(config.count):
+        name = _node_name(cluster_name_on_cloud, i)
+        if i == 0:
+            head_id = name
+        node = existing_by_name.get(name)
+        if node is not None:
+            state = node.get('state')
+            if state == 'READY':
+                continue
+            if state == 'STOPPED' and config.resume_stopped_nodes:
+                client.start_node(zone, name)
+                resumed.append(name)
+                continue
+            if state in ('PREEMPTED', 'TERMINATED'):
+                client.delete_node(zone, name)
+            else:
+                continue  # pending states: wait_instances handles it
+        body: Dict[str, Any] = {
+            'acceleratorType': node_cfg['accelerator_type'],
+            'runtimeVersion': node_cfg['runtime_version'],
+            'networkConfig': {'enableExternalIps': True},
+            'labels': {_CLUSTER_LABEL: cluster_name_on_cloud,
+                       **node_cfg.get('labels', {})},
+            'metadata': {
+                'ssh-keys': config.authentication_config.get('ssh_keys', ''),
+            },
+        }
+        if node_cfg.get('topology'):
+            # Explicit AcceleratorConfig pins the exact torus shape.
+            body['acceleratorConfig'] = {
+                'type': _accel_config_type(node_cfg['accelerator_type']),
+                'topology': node_cfg['topology'],
+            }
+        if config.node_config.get('use_spot'):
+            body['schedulingConfig'] = {'preemptible': True}
+        reservation = skypilot_config.get_nested(
+            ('gcp', 'specific_reservations'), None)
+        if reservation:
+            body['schedulingConfig'] = {
+                **body.get('schedulingConfig', {}), 'reserved': True
+            }
+        logger.debug(f'Creating TPU node {name} in {zone}: '
+                     f'{node_cfg["accelerator_type"]}')
+        client.create_node(zone, name, body)
+        created.append(name)
+
+    assert head_id is not None
+    return common.ProvisionRecord(provider_name='gcp',
+                                  region=region,
+                                  zone=zone,
+                                  cluster_name=cluster_name_on_cloud,
+                                  head_instance_id=head_id,
+                                  resumed_instance_ids=resumed,
+                                  created_instance_ids=created)
+
+
+def _accel_config_type(accelerator_type: str) -> str:
+    gen = accelerator_type.split('-')[0].upper()  # v5p → V5P
+    return {'V2': 'V2', 'V3': 'V3', 'V4': 'V4', 'V5E': 'V5LITE_POD',
+            'V5P': 'V5P', 'V6E': 'V6E'}.get(gen, gen)
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = 'running',
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    """Block until every slice node reaches `state`."""
+    import time
+    assert provider_config is not None
+    zone = provider_config['availability_zone']
+    client = _client(provider_config)
+    deadline = time.time() + 1800
+    while True:
+        nodes = _cluster_nodes(client, zone, cluster_name_on_cloud)
+        statuses = [_STATE_MAP.get(n.get('state'), 'pending') for n in nodes]
+        if nodes and all(s == state for s in statuses):
+            return
+        if time.time() > deadline:
+            raise common.ProvisionerError(
+                f'Timed out waiting for {cluster_name_on_cloud} to reach '
+                f'{state}; current: {statuses}')
+        time.sleep(5)
+
+
+def get_cluster_info(
+        region: str,
+        cluster_name_on_cloud: str,
+        provider_config: Optional[Dict[str, Any]] = None
+) -> common.ClusterInfo:
+    assert provider_config is not None
+    zone = provider_config['availability_zone']
+    client = _client(provider_config)
+    nodes = _cluster_nodes(client, zone, cluster_name_on_cloud)
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    head_id = None
+    custom = {}
+    for node in sorted(nodes, key=lambda n: n['name']):
+        name = node['name'].split('/')[-1]
+        if head_id is None:
+            head_id = name
+            custom = {
+                'accelerator_type': node.get('acceleratorType'),
+                'runtime_version': node.get('runtimeVersion'),
+                'topology': node.get('acceleratorConfig', {}).get('topology'),
+            }
+        infos = []
+        # One InstanceInfo per worker host of the slice (parity:
+        # instance_utils.py:1635-1656).
+        for worker_idx, ep in enumerate(node.get('networkEndpoints', [])):
+            infos.append(
+                common.InstanceInfo(
+                    instance_id=f'{name}/worker-{worker_idx}',
+                    internal_ip=ep.get('ipAddress', ''),
+                    external_ip=ep.get('accessConfig', {}).get('externalIp'),
+                    tags={'worker_index': str(worker_idx)},
+                ))
+        instances[name] = infos
+    ssh_user = provider_config.get('ssh_user', 'skytpu')
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head_id,
+        provider_name='gcp',
+        provider_config=provider_config,
+        ssh_user=ssh_user,
+        ssh_private_key=provider_config.get('ssh_private_key'),
+        custom_metadata=custom,
+    )
+
+
+def query_instances(
+        cluster_name_on_cloud: str,
+        provider_config: Optional[Dict[str, Any]] = None,
+        non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
+    """instance_id → status string (parity: query_instances)."""
+    assert provider_config is not None
+    zone = provider_config['availability_zone']
+    client = _client(provider_config)
+    out: Dict[str, Optional[str]] = {}
+    for node in _cluster_nodes(client, zone, cluster_name_on_cloud):
+        status = _STATE_MAP.get(node.get('state'), 'pending')
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[node['name'].split('/')[-1]] = status
+    return out
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    assert provider_config is not None
+    zone = provider_config['availability_zone']
+    client = _client(provider_config)
+    for node in _cluster_nodes(client, zone, cluster_name_on_cloud):
+        name = node['name'].split('/')[-1]
+        if worker_only and name.endswith('-0'):
+            continue
+        if len(node.get('networkEndpoints', [])) > 1:
+            raise common.ProvisionerError(
+                f'TPU slice {name} is multi-host and cannot be stopped; '
+                'only terminate is supported (GCP limitation).')
+        client.stop_node(zone, name)
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    assert provider_config is not None
+    zone = provider_config['availability_zone']
+    client = _client(provider_config)
+    for node in _cluster_nodes(client, zone, cluster_name_on_cloud):
+        name = node['name'].split('/')[-1]
+        if worker_only and name.endswith('-0'):
+            continue
+        client.delete_node(zone, name)
+
+
+def open_ports(cluster_name_on_cloud: str,
+               ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    # Firewall management is a no-op in the fake; the real path would create
+    # a VPC firewall rule targeting the slice's network tags.
+    logger.debug(f'open_ports({cluster_name_on_cloud}, {ports})')
+
+
+def cleanup_ports(cluster_name_on_cloud: str,
+                  ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    logger.debug(f'cleanup_ports({cluster_name_on_cloud}, {ports})')

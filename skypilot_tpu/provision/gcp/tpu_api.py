@@ -1,0 +1,275 @@
+"""TPU VM REST API client (tpu.googleapis.com v2) with a fake backend.
+
+Parity: the reference drives this API from ``GCPTPUVMInstance``
+(``sky/provision/gcp/instance_utils.py:1191``): create/stop/delete/query
+nodes, poll long-running operations, and fan multi-host slices out via
+``networkEndpoints[]`` (``:1635-1656``).
+
+Transport is pluggable:
+
+* :class:`RestTransport` — real HTTP via ``requests`` with a
+  ``gcloud auth print-access-token`` bearer token.
+* :class:`FakeTpuService` — in-memory implementation of the same surface,
+  used by tests and when ``SKYTPU_GCP_FAKE=1`` (e.g. CI without egress).
+  Fault injection: set ``SKYTPU_GCP_FAKE_STOCKOUT='zone1,zone2'`` to make
+  those zones raise capacity errors — exercising the failover engine.
+"""
+import json
+import os
+import subprocess
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_API_BASE = 'https://tpu.googleapis.com/v2'
+
+_FAKE_STATE_ENV = 'SKYTPU_GCP_FAKE_STATE'  # json file for cross-process fakes
+
+
+class TpuApiError(Exception):
+
+    def __init__(self, status: int, message: str, body: Optional[dict] = None):
+        super().__init__(f'TPU API error {status}: {message}')
+        self.status = status
+        self.message = message
+        self.body = body or {}
+
+
+class GcpCapacityError(TpuApiError):
+    """Stockout / quota errors — the failover engine blocklists the zone."""
+
+
+def _get_access_token() -> str:
+    proc = subprocess.run(['gcloud', 'auth', 'print-access-token'],
+                          capture_output=True,
+                          text=True,
+                          timeout=30,
+                          check=False)
+    if proc.returncode != 0:
+        raise TpuApiError(401, f'gcloud token failed: {proc.stderr.strip()}')
+    return proc.stdout.strip()
+
+
+class RestTransport:
+    """Thin authenticated JSON-over-HTTP layer."""
+
+    def __init__(self):
+        import requests  # local import: only the real path needs it
+        self._session = requests.Session()
+        self._token: Optional[str] = None
+        self._token_time = 0.0
+
+    def _headers(self) -> Dict[str, str]:
+        if self._token is None or time.time() - self._token_time > 1800:
+            self._token = _get_access_token()
+            self._token_time = time.time()
+        return {
+            'Authorization': f'Bearer {self._token}',
+            'Content-Type': 'application/json',
+        }
+
+    def request(self, method: str, path: str,
+                body: Optional[dict] = None,
+                params: Optional[dict] = None) -> dict:
+        url = f'{_API_BASE}/{path.lstrip("/")}'
+        resp = self._session.request(method,
+                                     url,
+                                     headers=self._headers(),
+                                     json=body,
+                                     params=params,
+                                     timeout=60)
+        if resp.status_code >= 400:
+            try:
+                payload = resp.json()
+            except ValueError:
+                payload = {'error': {'message': resp.text}}
+            message = payload.get('error', {}).get('message', resp.text)
+            lowered = message.lower()
+            if ('no more capacity' in lowered or 'stockout' in lowered or
+                    'resource_exhausted' in lowered or
+                    'quota' in lowered or
+                    'not enough resources' in lowered):
+                raise GcpCapacityError(resp.status_code, message, payload)
+            raise TpuApiError(resp.status_code, message, payload)
+        return resp.json() if resp.text else {}
+
+
+class FakeTpuService:
+    """In-memory tpu.googleapis.com: nodes + instant operations.
+
+    State optionally persisted to a JSON file (``SKYTPU_GCP_FAKE_STATE``) so
+    separate processes (CLI invocations in tests) see the same cloud.
+    """
+
+    _lock = threading.Lock()
+    _nodes: Dict[str, Dict[str, Any]] = {}
+
+    def __init__(self):
+        self._state_path = os.environ.get(_FAKE_STATE_ENV)
+
+    # -------------------------------------------------------- persistence
+
+    def _load(self) -> Dict[str, Dict[str, Any]]:
+        if self._state_path and os.path.exists(self._state_path):
+            with open(self._state_path, encoding='utf-8') as f:
+                return json.load(f)
+        return FakeTpuService._nodes
+
+    def _save(self, nodes: Dict[str, Dict[str, Any]]) -> None:
+        if self._state_path:
+            with open(self._state_path, 'w', encoding='utf-8') as f:
+                json.dump(nodes, f)
+        else:
+            FakeTpuService._nodes = nodes
+
+    # ----------------------------------------------------------- protocol
+
+    def request(self, method: str, path: str,
+                body: Optional[dict] = None,
+                params: Optional[dict] = None) -> dict:
+        with FakeTpuService._lock:
+            return self._dispatch(method, path, body or {}, params or {})
+
+    def _dispatch(self, method: str, path: str, body: dict,
+                  params: dict) -> dict:
+        nodes = self._load()
+        parts = path.strip('/').split('/')
+        # projects/{p}/locations/{zone}/nodes[...]
+        zone = parts[3] if len(parts) > 3 else ''
+        stockout_zones = os.environ.get('SKYTPU_GCP_FAKE_STOCKOUT', '')
+        if method == 'POST' and parts[-1] == 'nodes':
+            if zone in stockout_zones.split(','):
+                raise GcpCapacityError(
+                    429, f'There is no more capacity in the zone "{zone}"')
+            node_id = params['nodeId']
+            full = f'{path}/{node_id}'
+            accel = body.get('acceleratorType', 'v5e-8')
+            node = dict(body)
+            node['name'] = full
+            node['state'] = 'READY'
+            node['networkEndpoints'] = self._make_endpoints(accel)
+            nodes[full] = node
+            self._save(nodes)
+            return {'name': f'op/{uuid.uuid4()}', 'done': True,
+                    'response': node}
+        if method == 'GET' and parts[-1] == 'nodes':
+            matched = [
+                n for k, n in nodes.items()
+                if k.startswith(path.strip('/') + '/') or
+                k.split('/nodes/')[0] == path.strip('/').rsplit('/nodes')[0]
+            ]
+            return {'nodes': matched}
+        if method == 'GET':
+            key = path.strip('/')
+            if key.startswith('op/') or '/operations/' in key:
+                return {'name': key, 'done': True}
+            if key not in nodes:
+                raise TpuApiError(404, f'Node {key} not found')
+            return nodes[key]
+        if method == 'DELETE':
+            key = path.strip('/')
+            nodes.pop(key, None)
+            self._save(nodes)
+            return {'name': f'op/{uuid.uuid4()}', 'done': True}
+        if method == 'POST' and parts[-1].endswith(':stop'):
+            key = path.strip('/').rsplit(':', 1)[0]
+            if key in nodes:
+                nodes[key]['state'] = 'STOPPED'
+                self._save(nodes)
+            return {'name': f'op/{uuid.uuid4()}', 'done': True}
+        if method == 'POST' and parts[-1].endswith(':start'):
+            key = path.strip('/').rsplit(':', 1)[0]
+            if key in nodes:
+                nodes[key]['state'] = 'READY'
+                self._save(nodes)
+            return {'name': f'op/{uuid.uuid4()}', 'done': True}
+        raise TpuApiError(400, f'Fake: unsupported {method} {path}')
+
+    @staticmethod
+    def _make_endpoints(accelerator_type: str) -> List[dict]:
+        # v5p-256 → 128 chips → 32 hosts; v5e-8 → 8 chips single host.
+        from skypilot_tpu import topology as topo_lib
+        gen_name, size = accelerator_type.rsplit('-', 1)
+        gen = topo_lib.TPU_GENERATIONS[gen_name]
+        chips = int(size) // gen.cores_per_chip
+        if chips in gen.single_host_sizes:
+            hosts = 1
+        else:
+            hosts = max(1, chips // gen.chips_per_host)
+        return [{
+            'ipAddress': f'10.0.0.{i + 2}',
+            'accessConfig': {'externalIp': f'34.1.0.{i + 2}'},
+        } for i in range(hosts)]
+
+
+def make_transport():
+    if os.environ.get('SKYTPU_GCP_FAKE', '0') == '1':
+        return FakeTpuService()
+    return RestTransport()
+
+
+class TpuClient:
+    """Typed wrapper over the node/operation surface."""
+
+    def __init__(self, project: str, transport=None):
+        self.project = project
+        self.transport = transport or make_transport()
+
+    def _loc(self, zone: str) -> str:
+        return f'projects/{self.project}/locations/{zone}'
+
+    def create_node(self, zone: str, node_id: str,
+                    config: Dict[str, Any]) -> dict:
+        op = self.transport.request('POST', f'{self._loc(zone)}/nodes',
+                                    body=config, params={'nodeId': node_id})
+        return self.wait_operation(op)
+
+    def list_nodes(self, zone: str) -> List[dict]:
+        resp = self.transport.request('GET', f'{self._loc(zone)}/nodes')
+        return resp.get('nodes', [])
+
+    def get_node(self, zone: str, node_id: str) -> dict:
+        return self.transport.request('GET',
+                                      f'{self._loc(zone)}/nodes/{node_id}')
+
+    def delete_node(self, zone: str, node_id: str) -> dict:
+        op = self.transport.request('DELETE',
+                                    f'{self._loc(zone)}/nodes/{node_id}')
+        return self.wait_operation(op)
+
+    def stop_node(self, zone: str, node_id: str) -> dict:
+        op = self.transport.request(
+            'POST', f'{self._loc(zone)}/nodes/{node_id}:stop')
+        return self.wait_operation(op)
+
+    def start_node(self, zone: str, node_id: str) -> dict:
+        op = self.transport.request(
+            'POST', f'{self._loc(zone)}/nodes/{node_id}:start')
+        return self.wait_operation(op)
+
+    def wait_operation(self, op: dict, timeout: float = 1800.0) -> dict:
+        """Poll a long-running operation (parity: instance_utils.py
+        :1212-1258 operation polling loop)."""
+        deadline = time.time() + timeout
+        backoff = 1.0
+        while not op.get('done', False):
+            if time.time() > deadline:
+                raise TpuApiError(
+                    504, f'Operation {op.get("name")} timed out.')
+            time.sleep(backoff)
+            backoff = min(backoff * 1.5, 10.0)
+            op = self.transport.request('GET', op['name'])
+        if 'error' in op:
+            err = op['error']
+            message = err.get('message', str(err))
+            lowered = message.lower()
+            if ('capacity' in lowered or 'stockout' in lowered or
+                    'quota' in lowered):
+                raise GcpCapacityError(429, message, op)
+            raise TpuApiError(500, message, op)
+        return op.get('response', op)

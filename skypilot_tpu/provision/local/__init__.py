@@ -1,0 +1,21 @@
+"""Local provisioner: nodes are directories + local processes.
+
+The credential-free analogue of the reference's `sky local up` kind cluster:
+lets the entire stack (provision → setup → skylet → exec → logs → autostop)
+run end-to-end on one machine, which is how the test suite exercises the
+backend (SURVEY §4 "Multi-node without a real cluster").
+"""
+from skypilot_tpu.provision.local.instance import cleanup_ports
+from skypilot_tpu.provision.local.instance import get_cluster_info
+from skypilot_tpu.provision.local.instance import open_ports
+from skypilot_tpu.provision.local.instance import query_instances
+from skypilot_tpu.provision.local.instance import run_instances
+from skypilot_tpu.provision.local.instance import stop_instances
+from skypilot_tpu.provision.local.instance import terminate_instances
+from skypilot_tpu.provision.local.instance import wait_instances
+
+__all__ = [
+    'cleanup_ports', 'get_cluster_info', 'open_ports', 'query_instances',
+    'run_instances', 'stop_instances', 'terminate_instances',
+    'wait_instances'
+]

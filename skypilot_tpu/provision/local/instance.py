@@ -1,0 +1,139 @@
+"""Local node lifecycle: a node = a directory with a state file."""
+import json
+import os
+import shutil
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+
+CLUSTER_ROOT = '~/.skytpu/local_cluster'
+
+
+def _cluster_dir(cluster_name_on_cloud: str) -> str:
+    return os.path.expanduser(
+        os.path.join(CLUSTER_ROOT, cluster_name_on_cloud))
+
+
+def _state_path(cluster_name_on_cloud: str) -> str:
+    return os.path.join(_cluster_dir(cluster_name_on_cloud), 'state.json')
+
+
+def _load_state(cluster_name_on_cloud: str) -> Dict[str, str]:
+    path = _state_path(cluster_name_on_cloud)
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding='utf-8') as f:
+        return json.load(f)
+
+
+def _save_state(cluster_name_on_cloud: str, state: Dict[str, str]) -> None:
+    os.makedirs(_cluster_dir(cluster_name_on_cloud), exist_ok=True)
+    with open(_state_path(cluster_name_on_cloud), 'w',
+              encoding='utf-8') as f:
+        json.dump(state, f)
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    state = _load_state(cluster_name_on_cloud)
+    created, resumed = [], []
+    for i in range(config.count):
+        node_id = f'{cluster_name_on_cloud}-{i}'
+        node_dir = os.path.join(_cluster_dir(cluster_name_on_cloud),
+                                f'node-{i}')
+        os.makedirs(node_dir, exist_ok=True)
+        prev = state.get(node_id)
+        if prev == 'running':
+            continue
+        if prev == 'stopped':
+            resumed.append(node_id)
+        else:
+            created.append(node_id)
+        state[node_id] = 'running'
+    _save_state(cluster_name_on_cloud, state)
+    return common.ProvisionRecord(provider_name='local',
+                                  region='local',
+                                  zone='local-a',
+                                  cluster_name=cluster_name_on_cloud,
+                                  head_instance_id=f'{cluster_name_on_cloud}-0',
+                                  resumed_instance_ids=resumed,
+                                  created_instance_ids=created)
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = 'running',
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del region, cluster_name_on_cloud, state, provider_config  # instant
+
+
+def get_cluster_info(
+        region: str,
+        cluster_name_on_cloud: str,
+        provider_config: Optional[Dict[str, Any]] = None
+) -> common.ClusterInfo:
+    state = _load_state(cluster_name_on_cloud)
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    head_id = None
+    for node_id in sorted(state):
+        if state[node_id] != 'running':
+            continue
+        idx = int(node_id.rsplit('-', 1)[1])
+        node_dir = os.path.join(_cluster_dir(cluster_name_on_cloud),
+                                f'node-{idx}')
+        if head_id is None or idx == 0:
+            head_id = node_id
+        instances[node_id] = [
+            common.InstanceInfo(instance_id=node_id,
+                                internal_ip=node_dir,
+                                external_ip=None,
+                                tags={'node_dir': node_dir})
+        ]
+    return common.ClusterInfo(instances=instances,
+                              head_instance_id=head_id,
+                              provider_name='local',
+                              provider_config=provider_config or {},
+                              ssh_user=os.environ.get('USER', 'root'))
+
+
+def query_instances(
+        cluster_name_on_cloud: str,
+        provider_config: Optional[Dict[str, Any]] = None,
+        non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
+    state = _load_state(cluster_name_on_cloud)
+    return dict(state)
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    state = _load_state(cluster_name_on_cloud)
+    for node_id in state:
+        if worker_only and node_id.endswith('-0'):
+            continue
+        state[node_id] = 'stopped'
+    _save_state(cluster_name_on_cloud, state)
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    if worker_only:
+        state = _load_state(cluster_name_on_cloud)
+        for node_id in list(state):
+            if not node_id.endswith('-0'):
+                state.pop(node_id)
+        _save_state(cluster_name_on_cloud, state)
+        return
+    shutil.rmtree(_cluster_dir(cluster_name_on_cloud), ignore_errors=True)
+
+
+def open_ports(cluster_name_on_cloud: str,
+               ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    pass
+
+
+def cleanup_ports(cluster_name_on_cloud: str,
+                  ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    pass

@@ -1,0 +1,200 @@
+"""Provision orchestrator: create instances → wait SSH → runtime setup.
+
+Parity: ``sky/provision/provisioner.py:101`` (bulk_provision), ``:353``
+(wait_for_ssh), ``:643`` (post_provision_runtime_setup) +
+``sky/provision/instance_setup.py`` — with the Ray bootstrap replaced by the
+TPU-native gang runtime: sync the framework package to every host, write
+``cluster_info.json`` (slice membership in TPU-worker order), start a skylet
+per host.
+"""
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import provision
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.skylet import constants
+from skypilot_tpu.utils import command_runner as command_runner_lib
+from skypilot_tpu.utils import subprocess_utils
+from skypilot_tpu.utils import timeline
+
+logger = sky_logging.init_logger(__name__)
+
+_MAX_RETRY = 3
+
+
+@dataclasses.dataclass
+class ProvisionResult:
+    record: common.ProvisionRecord
+    cluster_info: common.ClusterInfo
+
+
+def make_runners(
+        cluster_info: common.ClusterInfo
+) -> List[command_runner_lib.CommandRunner]:
+    """One CommandRunner per host, rank order (head's hosts first)."""
+    return runners_from_host_meta(cluster_info.ordered_host_meta())
+
+
+def runners_from_host_meta(
+        hosts_meta: List[Dict[str, Any]]
+) -> List[command_runner_lib.CommandRunner]:
+    runners: List[command_runner_lib.CommandRunner] = []
+    for host in hosts_meta:
+        node_id = f'rank-{host["rank"]}'
+        if host['transport'] == 'local':
+            runners.append(
+                command_runner_lib.LocalProcessRunner(
+                    node_id, host['node_dir']))
+        else:
+            runners.append(
+                command_runner_lib.SSHCommandRunner(
+                    node_id,
+                    host['ip'],
+                    host['ssh_user'],
+                    host['ssh_key'],
+                    ssh_control_name=f'{host["ip"]}-{host["rank"]}',
+                    port=host.get('ssh_port', 22)))
+    return runners
+
+
+@timeline.event
+def bulk_provision(provider_name: str, region: str,
+                   cluster_name_on_cloud: str,
+                   config: common.ProvisionConfig) -> common.ProvisionRecord:
+    """Create instances with bounded retries (parity: provisioner.py:101)."""
+    last_exc: Optional[Exception] = None
+    for attempt in range(_MAX_RETRY):
+        try:
+            record = provision.run_instances(provider_name, region,
+                                             cluster_name_on_cloud, config)
+            provision.wait_instances(provider_name, region,
+                                     cluster_name_on_cloud,
+                                     state='running',
+                                     provider_config=config.provider_config)
+            return record
+        except Exception as e:  # pylint: disable=broad-except
+            from skypilot_tpu.provision.gcp import tpu_api
+            if isinstance(e, tpu_api.GcpCapacityError):
+                raise  # capacity errors go straight to the failover engine
+            last_exc = e
+            logger.warning(f'Provision attempt {attempt + 1} failed: {e}')
+            time.sleep(2**attempt)
+    assert last_exc is not None
+    raise last_exc
+
+
+@timeline.event
+def wait_for_ssh(cluster_info: common.ClusterInfo,
+                 timeout: float = 600.0) -> None:
+    """Probe every host until reachable (parity: provisioner.py:353)."""
+    runners = make_runners(cluster_info)
+    deadline = time.time() + timeout
+
+    def _wait(runner) -> None:
+        backoff = 1.0
+        while True:
+            if runner.check_connection():
+                return
+            if time.time() > deadline:
+                raise common.ProvisionerError(
+                    f'SSH to {runner.node_id} not ready after {timeout}s.')
+            time.sleep(backoff)
+            backoff = min(backoff * 1.5, 10.0)
+
+    subprocess_utils.run_in_parallel(_wait, runners)
+
+
+def _runtime_sync_source() -> str:
+    """Path of the framework package to sync to hosts."""
+    import skypilot_tpu
+    return os.path.dirname(os.path.abspath(skypilot_tpu.__file__))
+
+
+@timeline.event
+def post_provision_runtime_setup(
+        cluster_name: str, cluster_name_on_cloud: str,
+        cluster_info: common.ClusterInfo,
+        provider_config: Dict[str, Any]) -> None:
+    """Install the gang runtime on every host (parity: provisioner.py:643 +
+
+    instance_setup.py:202): sync package, write cluster_info.json, start a
+    skylet per host. Head = rank 0 = TPU worker 0.
+    """
+    hosts_meta = cluster_info.ordered_host_meta()
+    runners = runners_from_host_meta(hosts_meta)
+
+    info_payload = {
+        'cluster_name': cluster_name,
+        'cluster_name_on_cloud': cluster_name_on_cloud,
+        'provider_name': cluster_info.provider_name,
+        'provider_config': _jsonable(provider_config),
+        'chips_per_host': cluster_info.custom_metadata.get('chips_per_host',
+                                                           0),
+        'accelerator_type':
+            cluster_info.custom_metadata.get('accelerator_type'),
+        'hosts': hosts_meta,
+    }
+
+    pkg_src = _runtime_sync_source()
+
+    def _setup_one(args) -> None:
+        runner, host_meta = args
+        # 1) sync the framework package → ~/.skytpu/runtime/skypilot_tpu
+        runner.run('mkdir -p ~/.skytpu/runtime ~/sky_logs ~/.skytpu/jobs',
+                   timeout=60)
+        if isinstance(runner, command_runner_lib.LocalProcessRunner):
+            runner.rsync(pkg_src + '/',
+                         '.skytpu/runtime/skypilot_tpu/',
+                         up=True)
+        else:
+            runner.rsync(pkg_src,
+                         '~/.skytpu/runtime/',
+                         up=True)
+        # 2) cluster_info.json on each host
+        payload = json.dumps(info_payload)
+        runner.run(
+            f'cat > ~/.skytpu/cluster_info.json << "SKYTPU_EOF"\n'
+            f'{payload}\nSKYTPU_EOF',
+            timeout=60)
+        # 3) start skylet (idempotent; drop a pidfile)
+        start_cmd = (
+            'cd ~ && '
+            'if [ -f ~/.skytpu/skylet.pid ] && '
+            'kill -0 $(cat ~/.skytpu/skylet.pid) 2>/dev/null; then '
+            'echo skylet already running; else '
+            'PYTHONPATH=~/.skytpu/runtime:$PYTHONPATH '
+            f'{constants.SKYLET_HOME_ENV}=$HOME '
+            'nohup python3 -m skypilot_tpu.skylet.skylet '
+            '> ~/.skytpu/skylet.log 2>&1 < /dev/null & '
+            'echo $! > ~/.skytpu/skylet.pid; fi',
+            )[0]
+        rc, out, err = runner.run(start_cmd, require_outputs=True,
+                                  timeout=120)
+        subprocess_utils.handle_returncode(
+            rc, 'skylet start', f'Failed to start skylet on '
+            f'{runner.node_id}', err)
+
+    subprocess_utils.run_in_parallel(_setup_one,
+                                     list(zip(runners, hosts_meta)))
+    logger.debug(f'Runtime setup complete on {len(runners)} host(s).')
+
+
+def _jsonable(d: Dict[str, Any]) -> Dict[str, Any]:
+    return json.loads(json.dumps(d, default=str))
+
+
+@timeline.event
+def teardown_cluster(provider_name: str, cluster_name_on_cloud: str,
+                     provider_config: Dict[str, Any],
+                     terminate: bool) -> None:
+    """Parity: provisioner.py:204 teardown_cluster."""
+    if terminate:
+        provision.terminate_instances(provider_name, cluster_name_on_cloud,
+                                      provider_config=provider_config)
+    else:
+        provision.stop_instances(provider_name, cluster_name_on_cloud,
+                                 provider_config=provider_config)

@@ -1,0 +1,67 @@
+"""Autostop config + last-active tracking (parity: ``sky/skylet/
+
+autostop_lib.py:33-110``). The AutostopEvent in events.py consumes this.
+"""
+import json
+import os
+import shlex
+import time
+from typing import Optional
+
+from skypilot_tpu.skylet import constants
+
+AUTOSTOP_CONFIG_FILE = 'autostop_config.json'
+
+
+def _config_path() -> str:
+    return os.path.join(constants.skytpu_dir(), AUTOSTOP_CONFIG_FILE)
+
+
+def get_autostop_config() -> dict:
+    path = _config_path()
+    if not os.path.exists(path):
+        return {'autostop_idle_minutes': -1, 'down': False,
+                'last_active_time': time.time(), 'cloud': None,
+                'cluster_name': None}
+    with open(path, encoding='utf-8') as f:
+        return json.load(f)
+
+
+def set_autostop(idle_minutes: int, down: bool, cloud: Optional[str],
+                 cluster_name: Optional[str]) -> None:
+    cfg = get_autostop_config()
+    cfg.update({
+        'autostop_idle_minutes': idle_minutes,
+        'down': down,
+        'cloud': cloud or cfg.get('cloud'),
+        'cluster_name': cluster_name or cfg.get('cluster_name'),
+        'last_active_time': time.time(),
+    })
+    os.makedirs(os.path.dirname(_config_path()), exist_ok=True)
+    with open(_config_path(), 'w', encoding='utf-8') as f:
+        json.dump(cfg, f)
+
+
+def set_last_active_time_to_now() -> None:
+    cfg = get_autostop_config()
+    cfg['last_active_time'] = time.time()
+    os.makedirs(os.path.dirname(_config_path()), exist_ok=True)
+    with open(_config_path(), 'w', encoding='utf-8') as f:
+        json.dump(cfg, f)
+
+
+class AutostopCodeGen:
+    """SSH snippet to set autostop on the head (parity: autostop_lib.py:110)."""
+
+    _PRELUDE = (
+        'import sys; '
+        'sys.path.insert(0, __import__("os").path.expanduser('
+        '"~/.skytpu/runtime")); '
+        'from skypilot_tpu.skylet import autostop_lib; ')
+
+    @classmethod
+    def set_autostop(cls, idle_minutes: int, down: bool, cloud: str,
+                     cluster_name: str) -> str:
+        body = (f'autostop_lib.set_autostop({idle_minutes}, {down}, '
+                f'{cloud!r}, {cluster_name!r})')
+        return f'python3 -u -c {shlex.quote(cls._PRELUDE + body)}'

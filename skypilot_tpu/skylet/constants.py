@@ -1,0 +1,64 @@
+"""On-cluster paths and env-var names (parity: ``sky/skylet/constants.py``).
+
+The node-rank env surface mirrors the reference's
+``SKYPILOT_NODE_RANK/NODE_IPS/NUM_NODES`` (``constants.py:325-328``), plus
+the TPU-native additions: ``jax.distributed`` coordinator injection so user
+programs can call ``jax.distributed.initialize()`` with no arguments.
+"""
+import os
+
+# A "skylet home" override lets local-cloud nodes isolate their state dirs;
+# on real hosts this is just $HOME.
+SKYLET_HOME_ENV = 'SKYTPU_SKYLET_HOME'
+
+
+def skylet_home() -> str:
+    return os.environ.get(SKYLET_HOME_ENV) or os.path.expanduser('~')
+
+
+def skytpu_dir() -> str:
+    return os.path.join(skylet_home(), '.skytpu')
+
+
+def job_db_path() -> str:
+    return os.path.join(skytpu_dir(), 'jobs.db')
+
+
+def log_dir() -> str:
+    return os.path.join(skylet_home(), 'sky_logs')
+
+
+def runtime_dir() -> str:
+    """Where the framework package is synced on each host."""
+    return os.path.join(skytpu_dir(), 'runtime')
+
+
+def cluster_info_path() -> str:
+    return os.path.join(skytpu_dir(), 'cluster_info.json')
+
+
+SKYLET_PID_FILE = 'skylet.pid'
+SKYLET_LOG_FILE = 'skylet.log'
+
+# --------------------------------------------------------------- task envs
+# Parity: sky/skylet/constants.py:325-328.
+NODE_RANK_ENV = 'SKYTPU_NODE_RANK'
+NODE_IPS_ENV = 'SKYTPU_NODE_IPS'
+NUM_NODES_ENV = 'SKYTPU_NUM_NODES'
+NUM_CHIPS_PER_NODE_ENV = 'SKYTPU_NUM_CHIPS_PER_NODE'
+CLUSTER_NAME_ENV = 'SKYTPU_CLUSTER_NAME'
+TASK_ID_ENV = 'SKYTPU_TASK_ID'
+
+# TPU-native: jax.distributed rendezvous, exported for every task so user
+# code can `jax.distributed.initialize()` with no args (SURVEY §2.11
+# "Rendezvous / cluster env" TPU-native equivalent).
+JAX_COORDINATOR_ENV = 'JAX_COORDINATOR_ADDRESS'
+JAX_NUM_PROCESSES_ENV = 'JAX_NUM_PROCESSES'
+JAX_PROCESS_ID_ENV = 'JAX_PROCESS_ID'
+JAX_COORDINATOR_PORT = 8476
+
+# Compatibility aliases some JAX versions/megascale stacks read.
+TPU_WORKER_ID_ENV = 'TPU_WORKER_ID'
+TPU_WORKER_HOSTNAMES_ENV = 'TPU_WORKER_HOSTNAMES'
+
+SKYLET_VERSION = '1'

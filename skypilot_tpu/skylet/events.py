@@ -1,0 +1,88 @@
+"""skylet periodic events (parity: ``sky/skylet/events.py:28-102``)."""
+import os
+import subprocess
+import time
+import traceback
+
+from skypilot_tpu.skylet import autostop_lib
+from skypilot_tpu.skylet import constants
+from skypilot_tpu.skylet import job_lib
+
+
+class SkyletEvent:
+    """Base: run() every EVENT_CHECKING_INTERVAL_SECONDS ticks."""
+    EVENT_CHECKING_INTERVAL_SECONDS = 20
+
+    def __init__(self):
+        self._last_run = 0.0
+
+    def tick(self) -> None:
+        now = time.time()
+        if now - self._last_run < self.EVENT_CHECKING_INTERVAL_SECONDS:
+            return
+        self._last_run = now
+        try:
+            self.run()
+        except Exception:  # pylint: disable=broad-except
+            traceback.print_exc()
+
+    def run(self) -> None:
+        raise NotImplementedError
+
+
+class JobSchedulerEvent(SkyletEvent):
+    """Keep the FIFO queue moving (parity: events.py:65)."""
+    EVENT_CHECKING_INTERVAL_SECONDS = 20
+
+    def run(self) -> None:
+        job_lib.schedule_step()
+
+
+class AutostopEvent(SkyletEvent):
+    """Idle detection → stop/down via the cloud API (parity: events.py:33).
+
+    On a TPU slice the skylet's host cannot stop itself through the
+    hypervisor; it calls the provisioner's stop/terminate with the cluster
+    identity recorded at setup time.
+    """
+    EVENT_CHECKING_INTERVAL_SECONDS = 60
+
+    def run(self) -> None:
+        cfg = autostop_lib.get_autostop_config()
+        idle_minutes = cfg.get('autostop_idle_minutes', -1)
+        if idle_minutes is None or idle_minutes < 0:
+            return
+        if not job_lib.is_cluster_idle(idle_minutes):
+            autostop_lib.set_last_active_time_to_now()
+            return
+        last_active = cfg.get('last_active_time', time.time())
+        if time.time() - last_active < idle_minutes * 60:
+            return
+        self._stop_cluster(cfg)
+
+    def _stop_cluster(self, cfg: dict) -> None:
+        cluster_info_path = constants.cluster_info_path()
+        if not os.path.exists(cluster_info_path):
+            return
+        import json
+        with open(cluster_info_path, encoding='utf-8') as f:
+            info = json.load(f)
+        provider = info.get('provider_name')
+        provider_config = info.get('provider_config', {})
+        cluster_name = info.get('cluster_name_on_cloud')
+        from skypilot_tpu import provision
+        if cfg.get('down'):
+            provision.terminate_instances(provider, cluster_name,
+                                          provider_config=provider_config)
+        else:
+            provision.stop_instances(provider, cluster_name,
+                                     provider_config=provider_config)
+
+
+class UsageHeartbeatReportEvent(SkyletEvent):
+    """Telemetry heartbeat (parity: events.py:94); no-op if disabled."""
+    EVENT_CHECKING_INTERVAL_SECONDS = 600
+
+    def run(self) -> None:
+        from skypilot_tpu.usage import usage_lib
+        usage_lib.send_heartbeat()

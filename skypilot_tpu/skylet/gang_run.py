@@ -1,0 +1,213 @@
+"""Gang executor: run one task script on every host of the cluster/slice.
+
+This replaces the reference's Ray placement-group machinery
+(``add_gang_scheduling_placement_group_and_setup``,
+``cloud_vm_ray_backend.py:387``) with a direct fan-out owned by the head
+host: parallel transport (SSH or local), deterministic ranks, rank env
+injection including the ``jax.distributed`` coordinator, per-rank log files
+muxed into the job log, and fate-sharing (any rank failing kills the gang).
+
+Cluster membership comes from ``~/.skytpu/cluster_info.json``, written at
+provision time — the TPU slice's worker hosts in ``networkEndpoints`` order,
+so rank == TPU worker id.
+
+Usage (generated into job scripts by the backend):
+    python -m skypilot_tpu.skylet.gang_run --script task.sh --job-id 3 \
+        [--setup]  # run as setup (no rank fate-sharing semantics change)
+"""
+import argparse
+import json
+import os
+import shlex
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from skypilot_tpu.skylet import constants
+
+
+def load_cluster_info(path: Optional[str] = None) -> dict:
+    path = path or constants.cluster_info_path()
+    with open(path, encoding='utf-8') as f:
+        return json.load(f)
+
+
+def _make_argv(host: dict, script_remote_path: str,
+               env_vars: Dict[str, str]) -> List[str]:
+    exports = ' '.join(f'export {k}={shlex.quote(str(v))};'
+                       for k, v in env_vars.items())
+    bash_cmd = f'{exports} bash {script_remote_path}'
+    if host['transport'] == 'local':
+        env_vars2 = dict(env_vars)
+        env_vars2['SKYTPU_NODE_DIR'] = host['node_dir']
+        env_vars2[constants.SKYLET_HOME_ENV] = host['node_dir']
+        env_vars2['HOME'] = host['node_dir']  # node dir acts as $HOME
+        exports2 = ' '.join(f'export {k}={shlex.quote(str(v))};'
+                            for k, v in env_vars2.items())
+        return ['/bin/bash', '-c', f'{exports2} bash {script_remote_path}']
+    # SSH transport.
+    argv = [
+        'ssh', '-o', 'StrictHostKeyChecking=no', '-o',
+        'UserKnownHostsFile=/dev/null', '-o', 'IdentitiesOnly=yes', '-o',
+        'BatchMode=yes', '-o', 'LogLevel=ERROR', '-o', 'ConnectTimeout=30',
+        '-i', os.path.expanduser(host['ssh_key']),
+        f'{host["ssh_user"]}@{host["ip"]}', bash_cmd
+    ]
+    return argv
+
+
+def _push_script(host: dict, script_path: str, remote_path: str) -> None:
+    if host['transport'] == 'local':
+        os.makedirs(os.path.dirname(
+            os.path.join(host['node_dir'], remote_path.lstrip('/'))),
+            exist_ok=True)
+        dst = os.path.join(host['node_dir'], remote_path.lstrip('/'))
+        with open(script_path, encoding='utf-8') as src_f:
+            content = src_f.read()
+        with open(dst, 'w', encoding='utf-8') as dst_f:
+            dst_f.write(content)
+        host['_resolved_script'] = dst
+        return
+    subprocess.run([
+        'scp', '-o', 'StrictHostKeyChecking=no', '-o',
+        'UserKnownHostsFile=/dev/null', '-o', 'BatchMode=yes', '-o',
+        'LogLevel=ERROR', '-i',
+        os.path.expanduser(host['ssh_key']), script_path,
+        f'{host["ssh_user"]}@{host["ip"]}:{remote_path}'
+    ], check=True, capture_output=True)
+    host['_resolved_script'] = remote_path
+
+
+def run_gang(script_path: str,
+             job_id: Optional[int] = None,
+             log_dir: Optional[str] = None,
+             cluster_info: Optional[dict] = None,
+             extra_env: Optional[Dict[str, str]] = None) -> int:
+    """Run the script on all hosts; returns 0 iff every rank returned 0."""
+    info = cluster_info or load_cluster_info()
+    hosts: List[dict] = info['hosts']
+    num_hosts = len(hosts)
+    internal_ips = [h['internal_ip'] for h in hosts]
+    coordinator = f'{internal_ips[0]}:{constants.JAX_COORDINATOR_PORT}'
+    log_dir = log_dir or os.path.join(constants.log_dir(),
+                                      f'job-{job_id or "adhoc"}')
+    os.makedirs(log_dir, exist_ok=True)
+
+    marker = f'skytpu_task_{job_id or int(time.time())}'
+    remote_script = f'/tmp/{marker}.sh'
+
+    procs: List[subprocess.Popen] = [None] * num_hosts  # type: ignore
+    rcs: List[Optional[int]] = [None] * num_hosts
+    failed = threading.Event()
+
+    def _env_for(rank: int) -> Dict[str, str]:
+        env = {
+            constants.NODE_RANK_ENV: str(rank),
+            constants.NODE_IPS_ENV: '\n'.join(internal_ips),
+            constants.NUM_NODES_ENV: str(num_hosts),
+            constants.CLUSTER_NAME_ENV: info.get('cluster_name', ''),
+            constants.NUM_CHIPS_PER_NODE_ENV:
+                str(info.get('chips_per_host', 0)),
+            # jax.distributed rendezvous (multi-host slices).
+            constants.JAX_COORDINATOR_ENV: coordinator,
+            constants.JAX_NUM_PROCESSES_ENV: str(num_hosts),
+            constants.JAX_PROCESS_ID_ENV: str(rank),
+            constants.TPU_WORKER_ID_ENV: str(rank),
+            constants.TPU_WORKER_HOSTNAMES_ENV: ','.join(internal_ips),
+        }
+        env.update(extra_env or {})
+        return env
+
+    def _run_rank(rank: int) -> None:
+        host = hosts[rank]
+        try:
+            _push_script(host, script_path, remote_script)
+        except subprocess.CalledProcessError as e:
+            rcs[rank] = 255
+            with open(os.path.join(log_dir, f'rank-{rank}.log'), 'ab') as f:
+                f.write(f'failed to push task script: {e}\n'.encode())
+            failed.set()
+            return
+        argv = _make_argv(host, host['_resolved_script'], _env_for(rank))
+        rank_log = os.path.join(log_dir, f'rank-{rank}.log')
+        with open(rank_log, 'ab', buffering=0) as log_f:
+            proc = subprocess.Popen(argv,
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT,
+                                    start_new_session=True)
+            procs[rank] = proc
+            assert proc.stdout is not None
+            for line in iter(proc.stdout.readline, b''):
+                log_f.write(line)
+                # Rank 0's output is the job's primary stream (parity with
+                # the reference only streaming the head's task output).
+                if rank == 0 or num_hosts == 1:
+                    sys.stdout.buffer.write(line)
+                    sys.stdout.buffer.flush()
+                else:
+                    sys.stdout.buffer.write(
+                        f'(rank {rank}) '.encode() + line)
+                    sys.stdout.buffer.flush()
+            proc.wait()
+            rcs[rank] = proc.returncode
+            if proc.returncode != 0:
+                failed.set()
+
+    threads = [
+        threading.Thread(target=_run_rank, args=(i,), daemon=True)
+        for i in range(num_hosts)
+    ]
+    for t in threads:
+        t.start()
+
+    # Fate-sharing watchdog: first failure kills the rest of the gang
+    # (parity: Ray task cancellation on placement-group member failure).
+    while any(t.is_alive() for t in threads):
+        if failed.is_set():
+            time.sleep(2)  # grace period for peers to exit on their own
+            _kill_stragglers(hosts, procs, rcs, marker)
+            break
+        time.sleep(0.2)
+    for t in threads:
+        t.join(timeout=30)
+
+    bad = [(i, rc) for i, rc in enumerate(rcs) if rc != 0]
+    if bad:
+        print(f'gang_run: {len(bad)}/{num_hosts} ranks failed: '
+              f'{[(r, c) for r, c in bad[:8]]}',
+              file=sys.stderr)
+        # rc None = rank never reported (hung straggler killed): treat as 255.
+        return next((rc for _, rc in bad if rc), 255)
+    return 0
+
+
+def _kill_stragglers(hosts, procs, rcs, marker: str) -> None:
+    for i, proc in enumerate(procs):
+        if rcs[i] is not None or proc is None:
+            continue
+        try:
+            os.killpg(os.getpgid(proc.pid), 15)
+        except (ProcessLookupError, OSError):
+            pass
+        host = hosts[i]
+        if host['transport'] != 'local':
+            # Also reap the remote process tree.
+            subprocess.run(_make_argv(host, '/dev/null', {})[:-1] +
+                           [f'pkill -f {marker} || true'],
+                           capture_output=True,
+                           check=False)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--script', required=True)
+    parser.add_argument('--job-id', type=int, default=None)
+    parser.add_argument('--log-dir', default=None)
+    args = parser.parse_args()
+    return run_gang(args.script, args.job_id, args.log_dir)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,353 @@
+"""On-cluster sqlite job queue + FIFO scheduler.
+
+Parity: ``sky/skylet/job_lib.py`` (JobStatus:127, JobScheduler:210,
+add_job:311, update_job_status:561, is_cluster_idle:723, JobLibCodeGen:936).
+The reference submits jobs through ``ray job submit``; here the scheduler
+spawns a detached ``job_runner`` process per job — no Ray.
+"""
+import enum
+import getpass
+import json
+import os
+import shlex
+import sqlite3
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.skylet import constants
+
+_TABLE = """
+    CREATE TABLE IF NOT EXISTS jobs (
+        job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+        job_name TEXT,
+        username TEXT,
+        submitted_at REAL,
+        status TEXT,
+        run_timestamp TEXT,
+        start_at REAL DEFAULT -1,
+        end_at REAL DEFAULT NULL,
+        resources TEXT,
+        pid INTEGER DEFAULT -1,
+        script_path TEXT,
+        log_dir TEXT
+    );
+"""
+
+
+class JobStatus(enum.Enum):
+    """Parity: job_lib.py:127. Terminal: SUCCEEDED/FAILED/FAILED_SETUP/
+    CANCELLED."""
+    INIT = 'INIT'
+    PENDING = 'PENDING'
+    SETTING_UP = 'SETTING_UP'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    @classmethod
+    def nonterminal_statuses(cls) -> List['JobStatus']:
+        return [s for s in cls if not s.is_terminal()]
+
+
+_TERMINAL = {
+    JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.FAILED_SETUP,
+    JobStatus.CANCELLED
+}
+
+# Max concurrently-RUNNING jobs on a cluster (the reference derives this
+# from CPU count; TPU jobs own the whole slice so default to 1 at a time
+# plus parallel queued).
+_MAX_PARALLEL_JOBS = int(os.environ.get('SKYTPU_MAX_PARALLEL_JOBS', '1'))
+
+
+def _db() -> sqlite3.Connection:
+    path = constants.job_db_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=30)
+    conn.row_factory = sqlite3.Row
+    conn.executescript(_TABLE)
+    return conn
+
+
+# ------------------------------------------------------------------- CRUD
+
+
+def add_job(job_name: str, username: str, run_timestamp: str,
+            resources_str: str, script_path: str, log_dir: str) -> int:
+    """Insert INIT job; returns job_id (parity: add_job:311)."""
+    with _db() as conn:
+        cur = conn.execute(
+            'INSERT INTO jobs (job_name, username, submitted_at, status, '
+            'run_timestamp, resources, script_path, log_dir) '
+            'VALUES (?,?,?,?,?,?,?,?)',
+            (job_name, username, time.time(), JobStatus.INIT.value,
+             run_timestamp, resources_str, script_path, log_dir))
+        return cur.lastrowid
+
+
+def set_status(job_id: int, status: JobStatus) -> None:
+    with _db() as conn:
+        if status == JobStatus.RUNNING:
+            conn.execute(
+                'UPDATE jobs SET status=?, start_at=? WHERE job_id=?',
+                (status.value, time.time(), job_id))
+        elif status.is_terminal():
+            conn.execute(
+                'UPDATE jobs SET status=?, end_at=? WHERE job_id=?',
+                (status.value, time.time(), job_id))
+        else:
+            conn.execute('UPDATE jobs SET status=? WHERE job_id=?',
+                         (status.value, job_id))
+
+
+def set_pid(job_id: int, pid: int) -> None:
+    with _db() as conn:
+        conn.execute('UPDATE jobs SET pid=? WHERE job_id=?', (pid, job_id))
+
+
+def get_job(job_id: int) -> Optional[Dict[str, Any]]:
+    with _db() as conn:
+        row = conn.execute('SELECT * FROM jobs WHERE job_id=?',
+                           (job_id,)).fetchone()
+    return dict(row) if row else None
+
+
+def get_status(job_id: int) -> Optional[JobStatus]:
+    job = get_job(job_id)
+    return JobStatus(job['status']) if job else None
+
+
+def get_latest_job_id() -> Optional[int]:
+    with _db() as conn:
+        row = conn.execute(
+            'SELECT job_id FROM jobs ORDER BY job_id DESC LIMIT 1'
+        ).fetchone()
+    return row['job_id'] if row else None
+
+
+def get_jobs(statuses: Optional[List[JobStatus]] = None,
+             all_users: bool = True) -> List[Dict[str, Any]]:
+    q = 'SELECT * FROM jobs'
+    args: List[Any] = []
+    if statuses:
+        q += ' WHERE status IN (%s)' % ','.join('?' * len(statuses))
+        args = [s.value for s in statuses]
+    q += ' ORDER BY job_id DESC'
+    with _db() as conn:
+        rows = conn.execute(q, args).fetchall()
+    return [dict(r) for r in rows]
+
+
+def cancel_jobs(job_ids: Optional[List[int]] = None,
+                cancel_all: bool = False) -> List[int]:
+    """Kill processes and mark CANCELLED. Returns cancelled ids."""
+    if cancel_all:
+        jobs = get_jobs(statuses=JobStatus.nonterminal_statuses())
+        job_ids = [j['job_id'] for j in jobs]
+    cancelled = []
+    for jid in job_ids or []:
+        job = get_job(jid)
+        if job is None or JobStatus(job['status']).is_terminal():
+            continue
+        pid = job['pid']
+        if pid and pid > 0:
+            _kill_process_tree(pid)
+        set_status(jid, JobStatus.CANCELLED)
+        cancelled.append(jid)
+    return cancelled
+
+
+def _kill_process_tree(pid: int) -> None:
+    try:
+        os.killpg(os.getpgid(pid), 15)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            os.kill(pid, 15)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+# -------------------------------------------------------------- scheduler
+
+
+def schedule_step() -> None:
+    """FIFO scheduler tick: spawn PENDING jobs while capacity allows.
+
+    Parity: JobScheduler/FIFOScheduler (job_lib.py:210,282) — spawns a
+    detached job_runner per job instead of `ray job submit`. Guarded by an
+    inter-process lock: concurrent `exec` SSH sessions and the skylet tick
+    may all call this; without the lock a PENDING job could double-spawn.
+    """
+    from skypilot_tpu.utils import locks
+    lock = locks.FileLock(
+        os.path.join(constants.skytpu_dir(), 'job_scheduler.lock'),
+        timeout=30)
+    with lock:
+        update_job_statuses()
+        running = get_jobs(
+            statuses=[JobStatus.SETTING_UP, JobStatus.RUNNING])
+        slots = _MAX_PARALLEL_JOBS - len(running)
+        if slots <= 0:
+            return
+        pending = sorted(get_jobs(statuses=[JobStatus.PENDING]),
+                         key=lambda j: j['job_id'])
+        for job in pending[:slots]:
+            _spawn_job_runner(job)
+
+
+def queue_job(job_id: int) -> None:
+    """INIT → PENDING then try to schedule immediately."""
+    set_status(job_id, JobStatus.PENDING)
+    schedule_step()
+
+
+def _spawn_job_runner(job: Dict[str, Any]) -> None:
+    env = dict(os.environ)
+    env[constants.SKYLET_HOME_ENV] = constants.skylet_home()
+    # The runner must resolve skypilot_tpu from the synced runtime dir.
+    runtime = constants.runtime_dir()
+    env['PYTHONPATH'] = runtime + (
+        os.pathsep + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
+    log_dir = os.path.expanduser(job['log_dir'])
+    log_path = os.path.join(log_dir, 'runner.log')
+    os.makedirs(log_dir, exist_ok=True)
+    set_status(job['job_id'], JobStatus.SETTING_UP)
+    with open(log_path, 'ab') as log_f:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.skylet.job_runner',
+             str(job['job_id'])],
+            stdout=log_f,
+            stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL,
+            env=env,
+            start_new_session=True)
+    set_pid(job['job_id'], proc.pid)
+
+
+def update_job_statuses() -> None:
+    """Reconcile: jobs whose runner died without a terminal status → FAILED.
+
+    Parity: update_job_status (job_lib.py:561).
+    """
+    for job in get_jobs(statuses=[JobStatus.SETTING_UP, JobStatus.RUNNING]):
+        pid = job['pid']
+        if pid is None or pid <= 0:
+            continue
+        if not _pid_alive(pid):
+            set_status(job['job_id'], JobStatus.FAILED)
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def is_cluster_idle(idle_minutes: int) -> bool:
+    """No nonterminal jobs and last job ended > idle_minutes ago.
+
+    Parity: is_cluster_idle (job_lib.py:723).
+    """
+    active = get_jobs(statuses=JobStatus.nonterminal_statuses())
+    if active:
+        return False
+    with _db() as conn:
+        row = conn.execute(
+            'SELECT MAX(COALESCE(end_at, submitted_at)) AS t FROM jobs'
+        ).fetchone()
+    last = row['t'] if row and row['t'] else None
+    if last is None:
+        # Never ran a job: idle since skylet start; callers handle via
+        # autostop_lib last-active time.
+        return True
+    return (time.time() - last) > idle_minutes * 60
+
+
+def format_job_queue(jobs: List[Dict[str, Any]]) -> str:
+    header = ('ID', 'NAME', 'USER', 'SUBMITTED', 'STATUS')
+    rows = [(str(j['job_id']), j['job_name'] or '-', j['username'],
+             time.strftime('%m-%d %H:%M', time.localtime(j['submitted_at'])),
+             j['status']) for j in jobs]
+    widths = [
+        max(len(header[i]), *(len(r[i]) for r in rows)) if rows else
+        len(header[i]) for i in range(5)
+    ]
+    lines = ['  '.join(h.ljust(widths[i]) for i, h in enumerate(header))]
+    for r in rows:
+        lines.append('  '.join(c.ljust(widths[i]) for i, c in enumerate(r)))
+    return '\n'.join(lines)
+
+
+# ---------------------------------------------------------------- codegen
+
+
+class JobLibCodeGen:
+    """Generate python snippets the client runs on the head over SSH — the
+
+    control-plane "RPC" idiom (parity: job_lib.py:936)."""
+
+    _PRELUDE = (
+        'import sys; '
+        'sys.path.insert(0, __import__("os").path.expanduser('
+        '"~/.skytpu/runtime")); '
+        'from skypilot_tpu.skylet import job_lib; '
+        'from skypilot_tpu.skylet.job_lib import JobStatus; ')
+
+    @classmethod
+    def _wrap(cls, body: str) -> str:
+        return f'python3 -u -c {shlex.quote(cls._PRELUDE + body)}'
+
+    @classmethod
+    def add_job(cls, job_name: Optional[str], username: str,
+                run_timestamp: str, resources_str: str, script_path: str,
+                log_dir: str) -> str:
+        args = json.dumps([job_name, username, run_timestamp, resources_str,
+                           script_path, log_dir])
+        return cls._wrap(
+            f'import json; a = json.loads({args!r}); '
+            'job_id = job_lib.add_job(*a); '
+            'print("__JOB_ID__", job_id, flush=True)')
+
+    @classmethod
+    def queue_job(cls, job_id: int) -> str:
+        return cls._wrap(f'job_lib.queue_job({job_id})')
+
+    @classmethod
+    def get_job_status(cls, job_id: int) -> str:
+        return cls._wrap(
+            f's = job_lib.get_status({job_id}); '
+            'print("__STATUS__", s.value if s else "None", flush=True)')
+
+    @classmethod
+    def get_job_queue(cls) -> str:
+        return cls._wrap(
+            'import json; jobs = job_lib.get_jobs(); '
+            'print("__QUEUE__" + json.dumps(jobs), flush=True)')
+
+    @classmethod
+    def cancel_jobs(cls, job_ids: Optional[List[int]],
+                    cancel_all: bool = False) -> str:
+        return cls._wrap(
+            f'ids = job_lib.cancel_jobs({job_ids!r}, {cancel_all}); '
+            'print("__CANCELLED__", ids, flush=True)')
+
+    @classmethod
+    def tail_logs(cls, job_id: Optional[int], follow: bool = True) -> str:
+        return cls._wrap(
+            'from skypilot_tpu.skylet import log_lib; '
+            f'log_lib.tail_logs({job_id!r}, follow={follow})')
+
+    @classmethod
+    def schedule_step(cls) -> str:
+        return cls._wrap('job_lib.schedule_step()')

@@ -1,0 +1,52 @@
+"""Per-job driver process spawned by the scheduler.
+
+The analogue of the reference's generated Ray driver program
+(``RayCodeGen``, ``cloud_vm_ray_backend.py:227-742``): owns one job's
+lifecycle on the cluster — status transitions, running the task script
+(which for multi-host slices fans out via ``gang_run``), and recording the
+final state. Runs detached from skylet/SSH sessions.
+"""
+import os
+import sys
+
+from skypilot_tpu.skylet import job_lib
+from skypilot_tpu.skylet import log_lib
+
+
+def main() -> int:
+    job_id = int(sys.argv[1])
+    job = job_lib.get_job(job_id)
+    if job is None:
+        print(f'job {job_id} not found', file=sys.stderr)
+        return 1
+    script_path = os.path.expanduser(job['script_path'])
+    log_dir = os.path.expanduser(job['log_dir'])
+    os.makedirs(log_dir, exist_ok=True)
+    run_log = os.path.join(log_dir, 'run.log')
+
+    job_lib.set_status(job_id, job_lib.JobStatus.RUNNING)
+    try:
+        returncode = log_lib.run_with_log(['/bin/bash', script_path],
+                                          run_log,
+                                          stream_logs=False,
+                                          env_vars={'SKYTPU_JOB_ID':
+                                                    str(job_id)})
+    except Exception as e:  # pylint: disable=broad-except
+        with open(run_log, 'a', encoding='utf-8') as f:
+            f.write(f'\njob_runner error: {e}\n')
+        job_lib.set_status(job_id, job_lib.JobStatus.FAILED)
+        return 1
+    if returncode == 0:
+        job_lib.set_status(job_id, job_lib.JobStatus.SUCCEEDED)
+    else:
+        with open(run_log, 'a', encoding='utf-8') as f:
+            f.write(f'\nJob {job_id} failed with return code '
+                    f'{returncode}.\n')
+        job_lib.set_status(job_id, job_lib.JobStatus.FAILED)
+    # Pull the next pending job, keeping the queue moving.
+    job_lib.schedule_step()
+    return returncode
+
+
+if __name__ == '__main__':
+    sys.exit(main())

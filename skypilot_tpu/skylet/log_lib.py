@@ -1,0 +1,129 @@
+"""On-host log runtime: run-with-tee and tail-with-follow.
+
+Parity: ``sky/skylet/log_lib.py:138`` (run_with_log), ``:239``
+(make_task_bash_script), ``:392`` (tail_logs).
+"""
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, Optional
+
+from skypilot_tpu.skylet import constants
+
+
+def make_task_bash_script(codegen: str,
+                          env_vars: Optional[Dict[str, str]] = None) -> str:
+    """Wrap user commands in a bash script with env exports + sane shell
+
+    settings (parity: log_lib.py:239)."""
+    lines = [
+        '#!/bin/bash',
+        'source ~/.bashrc 2>/dev/null || true',
+        'set -o pipefail',
+        'cd "$HOME" 2>/dev/null || true',
+    ]
+    for k, v in (env_vars or {}).items():
+        sv = str(v).replace("'", "'\\''")
+        lines.append(f"export {k}='{sv}'")
+    lines.append('[ -d ~/sky_workdir ] && cd ~/sky_workdir')
+    lines.append(codegen)
+    return '\n'.join(lines) + '\n'
+
+
+def run_with_log(cmd,
+                 log_path: str,
+                 stream_logs: bool = False,
+                 env_vars: Optional[Dict[str, str]] = None,
+                 shell: bool = False,
+                 **kwargs) -> int:
+    """Run cmd, teeing combined output to log_path (parity: :138)."""
+    log_path = os.path.expanduser(log_path)
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    env = dict(os.environ)
+    if env_vars:
+        env.update({k: str(v) for k, v in env_vars.items()})
+    with open(log_path, 'ab', buffering=0) as log_f:
+        proc = subprocess.Popen(cmd,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT,
+                                env=env,
+                                shell=shell,
+                                start_new_session=True,
+                                **kwargs)
+        assert proc.stdout is not None
+        for line in iter(proc.stdout.readline, b''):
+            log_f.write(line)
+            if stream_logs:
+                sys.stdout.buffer.write(line)
+                sys.stdout.buffer.flush()
+        proc.wait()
+        return proc.returncode
+
+
+def _job_log_path(job_id: int) -> Optional[str]:
+    from skypilot_tpu.skylet import job_lib
+    job = job_lib.get_job(job_id)
+    if job is None:
+        return None
+    return os.path.join(os.path.expanduser(job['log_dir']), 'run.log')
+
+
+def tail_logs(job_id: Optional[int],
+              follow: bool = True,
+              tail: int = 0) -> int:
+    """Stream a job's run.log; with follow, exit when the job terminates.
+
+    Returns the job's exit-ish code (0 on SUCCEEDED). Parity: :392.
+    """
+    from skypilot_tpu.skylet import job_lib
+    if job_id is None:
+        job_id = job_lib.get_latest_job_id()
+        if job_id is None:
+            print('No jobs submitted yet.')
+            return 1
+    log_path = _job_log_path(job_id)
+    if log_path is None:
+        print(f'Job {job_id} not found.')
+        return 1
+    # Wait for the log file to appear (job may still be SETTING_UP).
+    waited = 0.0
+    while not os.path.exists(log_path):
+        status = job_lib.get_status(job_id)
+        if status is None or status.is_terminal() or not follow:
+            break
+        time.sleep(0.5)
+        waited += 0.5
+        if waited > 120:
+            break
+    if not os.path.exists(log_path):
+        status = job_lib.get_status(job_id)
+        print(f'Job {job_id}: no logs (status '
+              f'{status.value if status else "?"}).')
+        return 0 if status == job_lib.JobStatus.SUCCEEDED else 1
+    with open(log_path, 'r', encoding='utf-8', errors='replace') as f:
+        if tail > 0:
+            lines = f.readlines()[-tail:]
+            print(''.join(lines), end='')
+        else:
+            for line in f:
+                print(line, end='')
+        if follow:
+            idle = 0.0
+            while True:
+                line = f.readline()
+                if line:
+                    print(line, end='', flush=True)
+                    idle = 0.0
+                    continue
+                status = job_lib.get_status(job_id)
+                if status is None or status.is_terminal():
+                    # Drain any buffered remainder.
+                    rest = f.read()
+                    if rest:
+                        print(rest, end='', flush=True)
+                    break
+                time.sleep(0.2)
+                idle += 0.2
+    status = job_lib.get_status(job_id)
+    return 0 if status == job_lib.JobStatus.SUCCEEDED else 1

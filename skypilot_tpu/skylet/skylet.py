@@ -1,0 +1,28 @@
+"""skylet: the on-cluster daemon, ticking events forever.
+
+Parity: ``sky/skylet/skylet.py:17-35`` — an infinite loop over the event
+list on the head host (each worker host of a slice also runs one for local
+job bookkeeping, but only the head's drives autostop).
+"""
+import time
+
+from skypilot_tpu.skylet import events
+
+EVENTS = [
+    events.JobSchedulerEvent(),
+    events.AutostopEvent(),
+    events.UsageHeartbeatReportEvent(),
+]
+
+_TICK_SECONDS = 5
+
+
+def main() -> None:
+    while True:
+        for event in EVENTS:
+            event.tick()
+        time.sleep(_TICK_SECONDS)
+
+
+if __name__ == '__main__':
+    main()

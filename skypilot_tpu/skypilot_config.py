@@ -81,8 +81,8 @@ def merge_dicts(base: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any
 
 
 def to_dict() -> Dict[str, Any]:
-    """The fully-merged effective config."""
-    config = _load()
+    """The fully-merged effective config (always a private copy)."""
+    config = copy.deepcopy(_load())
     for override in _override_stack():
         config = merge_dicts(config, override)
     return config

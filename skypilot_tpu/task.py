@@ -231,9 +231,24 @@ class Task:
         if config.get('file_mounts') is not None:
             task.set_file_mounts(config['file_mounts'])
         if config.get('resources') is not None:
-            task.set_resources(
-                resources_lib.Resources.from_yaml_config(
-                    config['resources']))
+            res_config = config['resources']
+            alternatives = res_config.get('any_of') or \
+                res_config.get('ordered')
+            if alternatives:
+                # any_of: the optimizer may pick any alternative; each
+                # entry inherits the outer keys (parity: resources_utils
+                # parse_resources any_of handling).
+                base = {
+                    k: v for k, v in res_config.items()
+                    if k not in ('any_of', 'ordered')
+                }
+                task.set_resources({
+                    resources_lib.Resources.from_yaml_config({**base, **alt})
+                    for alt in alternatives
+                })
+            else:
+                task.set_resources(
+                    resources_lib.Resources.from_yaml_config(res_config))
         if config.get('service') is not None:
             try:
                 from skypilot_tpu.serve import service_spec

@@ -267,7 +267,15 @@ def resolve_topology(accelerator_name: str,
             f'TPU chip count must be an integer, got {count}')
 
     if topology is not None:
-        dims = tuple(int(d) for d in topology.lower().split('x'))
+        try:
+            dims = tuple(int(d) for d in topology.lower().split('x'))
+        except ValueError:
+            raise exceptions.InvalidSkyError(
+                f'Malformed topology {topology!r}: expected NxM or NxMxK '
+                'integers.') from None
+        if not dims or any(d < 1 for d in dims):
+            raise exceptions.InvalidSkyError(
+                f'Malformed topology {topology!r}: dims must be >= 1.')
         if math.prod(dims) != chips:
             raise exceptions.InvalidSkyError(
                 f'Topology {topology} has {math.prod(dims)} chips, but '

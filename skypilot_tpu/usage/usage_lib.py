@@ -1,0 +1,96 @@
+"""Usage telemetry (parity: ``sky/usage/usage_lib.py:74-341``).
+
+The reference posts redacted request/heartbeat messages to a Grafana Loki
+endpoint. This build records the same messages to a local spool file
+(``~/.skytpu/usage/``) and only attempts network delivery when an endpoint
+is explicitly configured — telemetry is off by default and honors
+``SKYTPU_DISABLE_USAGE_COLLECTION=1``.
+"""
+import contextlib
+import functools
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu import skypilot_config
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import env_options
+
+logger = sky_logging.init_logger(__name__)
+
+_run_id: Optional[str] = None
+
+
+def _spool_dir() -> str:
+    return os.path.expanduser('~/.skytpu/usage')
+
+
+def disabled() -> bool:
+    return env_options.Options.DISABLE_TELEMETRY.get()
+
+
+def get_run_id() -> str:
+    global _run_id
+    if _run_id is None:
+        _run_id = str(uuid.uuid4())
+    return _run_id
+
+
+def _record(kind: str, payload: Dict[str, Any]) -> None:
+    if disabled():
+        return
+    msg = {
+        'kind': kind,
+        'run_id': get_run_id(),
+        'user': common_utils.get_user_hash(),
+        'time': time.time(),
+        **payload,
+    }
+    try:
+        os.makedirs(_spool_dir(), exist_ok=True)
+        path = os.path.join(_spool_dir(),
+                            time.strftime('%Y%m%d') + '.jsonl')
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write(json.dumps(msg, default=str) + '\n')
+    except OSError:
+        pass
+    endpoint = skypilot_config.get_nested(('usage', 'endpoint'), None)
+    if endpoint:
+        try:
+            import requests
+            requests.post(endpoint, json=msg, timeout=2)
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def record_entrypoint(name: str, **kwargs) -> None:
+    _record('entrypoint', {'entrypoint': name, **kwargs})
+
+
+def send_heartbeat() -> None:
+    _record('heartbeat', {})
+
+
+def entrypoint(fn=None, *, name: Optional[str] = None):
+    """Decorator recording public API usage (parity: usage_lib.entrypoint)."""
+
+    def wrap(func):
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            record_entrypoint(name or func.__name__)
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    if fn is not None:
+        return wrap(fn)
+    return wrap
+
+
+@contextlib.contextmanager
+def messages_scope():
+    yield

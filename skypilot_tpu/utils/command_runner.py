@@ -1,0 +1,245 @@
+"""Command/rsync transport to cluster hosts — the control-plane substrate.
+
+Parity: ``sky/utils/command_runner.py:167`` (SSHCommandRunner) plus a
+LocalProcessRunner that plays the role of the reference's Kubernetes runner
+for credential-free end-to-end tests: same interface, executes on this
+machine.
+
+SSH uses ControlMaster connection sharing and BatchMode like the reference;
+rsync reuses the same transport.
+"""
+import os
+import shlex
+import subprocess
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple, Union
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import subprocess_utils
+
+logger = sky_logging.init_logger(__name__)
+
+SSH_CONTROL_PATH = '~/.skytpu/ssh_control'
+
+_DEFAULT_SSH_OPTS = [
+    '-o', 'StrictHostKeyChecking=no',
+    '-o', 'UserKnownHostsFile=/dev/null',
+    '-o', 'IdentitiesOnly=yes',
+    '-o', 'GlobalKnownHostsFile=/dev/null',
+    '-o', 'Port=22',
+    '-o', 'ServerAliveInterval=5',
+    '-o', 'ServerAliveCountMax=3',
+    '-o', 'ConnectTimeout=30',
+    '-o', 'LogLevel=ERROR',
+]
+
+
+class CommandRunner:
+    """Abstract transport: run a command on / rsync files to one host."""
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+
+    def run(self,
+            cmd: Union[str, List[str]],
+            *,
+            require_outputs: bool = False,
+            log_path: str = '/dev/null',
+            stream_logs: bool = False,
+            env_vars: Optional[Dict[str, str]] = None,
+            timeout: Optional[float] = None,
+            **kwargs) -> Union[int, Tuple[int, str, str]]:
+        raise NotImplementedError
+
+    def rsync(self,
+              source: str,
+              target: str,
+              *,
+              up: bool,
+              log_path: str = '/dev/null') -> None:
+        raise NotImplementedError
+
+    def check_connection(self) -> bool:
+        ret = self.run('true', timeout=15)
+        return ret == 0
+
+    @staticmethod
+    def _make_cmd(cmd: Union[str, List[str]],
+                  env_vars: Optional[Dict[str, str]]) -> str:
+        if isinstance(cmd, list):
+            cmd = ' '.join(cmd)
+        exports = ''
+        if env_vars:
+            exports = ' '.join(
+                f'export {k}={shlex.quote(str(v))};' for k, v in
+                env_vars.items())
+        return f'{exports} {cmd}'.strip()
+
+
+class LocalProcessRunner(CommandRunner):
+    """Runs commands as local subprocesses; rsync = cp. The "node" is a
+
+    directory serving as the host's home/workspace."""
+
+    def __init__(self, node_id: str, node_dir: str):
+        super().__init__(node_id)
+        self.node_dir = os.path.expanduser(node_dir)
+        os.makedirs(self.node_dir, exist_ok=True)
+
+    def run(self,
+            cmd,
+            *,
+            require_outputs: bool = False,
+            log_path: str = '/dev/null',
+            stream_logs: bool = False,
+            env_vars: None = None,
+            timeout: Optional[float] = None,
+            **kwargs):
+        full = self._make_cmd(cmd, env_vars)
+        env = dict(os.environ)
+        # The node dir acts as the host's $HOME: `~` in commands, skylet
+        # state, and log dirs all isolate under it (one dir per "host").
+        env['HOME'] = self.node_dir
+        env['SKYTPU_SKYLET_HOME'] = self.node_dir
+        env['SKYTPU_NODE_DIR'] = self.node_dir
+        try:
+            proc = subprocess.run(['/bin/bash', '-c', full],
+                                  cwd=self.node_dir,
+                                  env=env,
+                                  capture_output=True,
+                                  text=True,
+                                  timeout=timeout,
+                                  check=False)
+        except subprocess.TimeoutExpired:
+            if require_outputs:
+                return 255, '', f'Timeout after {timeout}s'
+            return 255
+        _tee(log_path, proc.stdout + proc.stderr, stream_logs)
+        if require_outputs:
+            return proc.returncode, proc.stdout, proc.stderr
+        return proc.returncode
+
+    def rsync(self, source, target, *, up: bool, log_path='/dev/null'):
+        # Pure-Python copy: the environment may lack an rsync binary.
+        import shutil
+        source = os.path.expanduser(source)
+        if up:
+            target = os.path.join(self.node_dir, target.lstrip('/')) \
+                if not target.startswith(self.node_dir) else target
+        else:
+            source = os.path.join(self.node_dir, source.lstrip('/')) \
+                if not source.startswith(self.node_dir) else source
+            target = os.path.expanduser(target)
+        src_is_dir = os.path.isdir(source.rstrip('/'))
+        copy_contents = source.endswith('/')
+        src = source.rstrip('/')
+        if src_is_dir:
+            dst = target.rstrip('/') if copy_contents else os.path.join(
+                target.rstrip('/'), os.path.basename(src))
+            os.makedirs(os.path.dirname(dst) or '/', exist_ok=True)
+            shutil.copytree(src,
+                            dst,
+                            dirs_exist_ok=True,
+                            ignore=shutil.ignore_patterns(
+                                '.git', '__pycache__'))
+        else:
+            if target.endswith('/') or os.path.isdir(target):
+                os.makedirs(target.rstrip('/'), exist_ok=True)
+                dst = os.path.join(target.rstrip('/'),
+                                   os.path.basename(src))
+            else:
+                os.makedirs(os.path.dirname(target) or '/', exist_ok=True)
+                dst = target
+            shutil.copy2(src, dst)
+
+
+class SSHCommandRunner(CommandRunner):
+    """SSH + rsync to one remote host (parity: command_runner.py:437)."""
+
+    def __init__(self,
+                 node_id: str,
+                 ip: str,
+                 ssh_user: str,
+                 ssh_private_key: str,
+                 ssh_control_name: Optional[str] = None,
+                 port: int = 22,
+                 proxy_command: Optional[str] = None):
+        super().__init__(node_id)
+        self.ip = ip
+        self.ssh_user = ssh_user
+        self.ssh_private_key = os.path.expanduser(ssh_private_key)
+        self.port = port
+        self.proxy_command = proxy_command
+        self._control_name = ssh_control_name
+
+    def _ssh_base(self) -> List[str]:
+        opts = list(_DEFAULT_SSH_OPTS)
+        opts[opts.index('Port=22')] = f'Port={self.port}'
+        args = ['ssh'] + opts + ['-i', self.ssh_private_key, '-o',
+                                 'BatchMode=yes']
+        if self._control_name:
+            control_dir = os.path.expanduser(SSH_CONTROL_PATH)
+            os.makedirs(control_dir, exist_ok=True)
+            args += [
+                '-o', 'ControlMaster=auto',
+                '-o', f'ControlPath={control_dir}/{self._control_name}-%C',
+                '-o', 'ControlPersist=120s',
+            ]
+        if self.proxy_command:
+            args += ['-o', f'ProxyCommand={self.proxy_command}']
+        return args
+
+    def run(self,
+            cmd,
+            *,
+            require_outputs: bool = False,
+            log_path: str = '/dev/null',
+            stream_logs: bool = False,
+            env_vars=None,
+            timeout: Optional[float] = None,
+            **kwargs):
+        full = self._make_cmd(cmd, env_vars)
+        argv = self._ssh_base() + [
+            f'{self.ssh_user}@{self.ip}',
+            f'bash --login -c {shlex.quote(full)}'
+        ]
+        try:
+            proc = subprocess.run(argv,
+                                  capture_output=True,
+                                  text=True,
+                                  timeout=timeout,
+                                  check=False)
+        except subprocess.TimeoutExpired:
+            if require_outputs:
+                return 255, '', f'SSH timeout after {timeout}s'
+            return 255
+        _tee(log_path, proc.stdout + proc.stderr, stream_logs)
+        if require_outputs:
+            return proc.returncode, proc.stdout, proc.stderr
+        return proc.returncode
+
+    def rsync(self, source, target, *, up: bool, log_path='/dev/null'):
+        ssh_cmd = ' '.join(
+            shlex.quote(a) for a in self._ssh_base())
+        remote = f'{self.ssh_user}@{self.ip}:{target if up else source}'
+        pair = ([os.path.expanduser(source), remote] if up else
+                [remote, os.path.expanduser(target)])
+        argv = ['rsync', '-az', '--exclude', '.git', '-e', ssh_cmd] + pair
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              check=False)
+        _tee(log_path, proc.stdout + proc.stderr, False)
+        subprocess_utils.handle_returncode(
+            proc.returncode, 'rsync',
+            f'Failed to rsync {source} -> {target} on {self.ip}',
+            proc.stderr)
+
+
+def _tee(log_path: str, content: str, stream: bool) -> None:
+    if stream and content:
+        print(content, end='' if content.endswith('\n') else '\n')
+    if log_path and log_path != '/dev/null' and content:
+        os.makedirs(os.path.dirname(os.path.abspath(log_path)),
+                    exist_ok=True)
+        with open(log_path, 'a', encoding='utf-8') as f:
+            f.write(content)
