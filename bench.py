@@ -1,0 +1,107 @@
+"""Benchmark: Llama train-step throughput on one TPU chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "tokens/s/chip", "vs_baseline": N}
+
+The model is the in-tree Llama decoder (bench-160m config: d=1024, L=12,
+MXU-friendly dims), full fwd+bwd+Adam train step, bf16 compute. This is the
+single-chip anchor of the north-star metric (BASELINE.md: tokens/sec/chip);
+multi-chip numbers come from the same train step jitted over a slice mesh.
+
+``vs_baseline``: ratio against the same model/seq on one A100 at 40% MFU —
+the reference's GPU examples hit at most ~40% MFU with torch DDP/LoRA
+recipes (BASELINE.md rows), so this is the honest GPU-side yardstick:
+    baseline_tokens/s = 0.40 * 312e12 / flops_per_token.
+"""
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit('/', 1)[0])
+
+import jax
+
+if os.environ.get('JAX_PLATFORMS'):
+    # Restore env semantics (the TPU plugin overrides platform selection).
+    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+
+import jax.numpy as jnp
+
+A100_PEAK_BF16 = 312e12
+A100_ASSUMED_MFU = 0.40
+
+# Per-chip peak bf16 FLOPs by platform for MFU reporting.
+_TPU_PEAKS = {'v5e': 197e12, 'v5p': 459e12, 'v6e': 918e12, 'v4': 275e12}
+
+
+def _detect_peak() -> float:
+    dev = jax.devices()[0]
+    kind = getattr(dev, 'device_kind', '').lower()
+    for name, peak in _TPU_PEAKS.items():
+        if name in kind.replace(' ', ''):
+            return peak
+    if 'v5 lite' in kind or 'v5lite' in kind:
+        return _TPU_PEAKS['v5e']
+    return 0.0  # unknown (e.g. CPU dev runs)
+
+
+def main() -> None:
+    from skypilot_tpu.models import llama, train
+
+    on_tpu = jax.devices()[0].platform != 'cpu'
+    cfg = llama.CONFIGS['bench-160m']
+    seq = 2048
+    batch = 16
+    steps = 10
+    if not on_tpu:  # CPU dev fallback: tiny shapes, still one JSON line
+        cfg = llama.CONFIGS['debug']
+        seq, batch, steps = 128, 2, 3
+
+    tcfg = train.TrainConfig(warmup_steps=10)
+    state = train.init_train_state(jax.random.PRNGKey(0), cfg, tcfg)
+    step = train.make_train_step(cfg, tcfg)
+    key = jax.random.PRNGKey(1)
+    tokens = jax.random.randint(key, (batch, seq), 0, cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    # Warmup / compile. NOTE: block_until_ready is a no-op on the
+    # tunneled TPU platform — a host fetch (float()) is the only reliable
+    # sync barrier; the donation chain makes the final loss depend on
+    # every step, so one fetch times the whole loop.
+    state, metrics = step(state, tokens, targets)
+    float(metrics['loss'])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, tokens, targets)
+    final_loss = float(metrics['loss'])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = steps * batch * seq / dt
+    flops_per_token = cfg.flops_per_token(seq)
+    peak = _detect_peak()
+    mfu = tokens_per_sec * flops_per_token / peak if peak else None
+    baseline = A100_ASSUMED_MFU * A100_PEAK_BF16 / flops_per_token
+    result = {
+        'metric': 'llama_train_tokens_per_sec_per_chip',
+        'value': round(tokens_per_sec, 1),
+        'unit': 'tokens/s/chip',
+        'vs_baseline': round(tokens_per_sec / baseline, 3),
+    }
+    extra = {
+        'model': 'bench-160m' if on_tpu else 'debug',
+        'params': cfg.num_params(),
+        'seq_len': seq,
+        'batch': batch,
+        'loss': round(final_loss, 3),
+        'mfu': round(mfu, 3) if mfu is not None else None,
+        'device': str(jax.devices()[0]),
+        'baseline': 'A100@40%MFU same model/seq',
+    }
+    print(json.dumps({**result, 'detail': extra}))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,4 @@
+"""In-tree model recipes — the TPU rewrites of the reference's llm/ and
+
+examples/ workloads (``llm/llama-3_1-finetuning``, ``resnet_distributed_*``).
+"""

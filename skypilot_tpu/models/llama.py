@@ -1,0 +1,240 @@
+"""Llama-3.1-family decoder, pure functional JAX, TPU-first.
+
+The flagship model for the north-star benchmark (BASELINE.md: Llama-3.1-8B
+finetune on TPU vs the reference's ``llm/llama-3_1-finetuning/lora.yaml`` on
+8xA100). Design:
+
+* Pure pytree params + a jit-compiled forward: no framework object graph,
+  so sharding is a same-structure pytree of ``PartitionSpec`` and XLA GSPMD
+  handles all collectives.
+* bf16 params/activations, fp32 RMSNorm accumulations and logits; matmuls
+  hit the MXU with `preferred_element_type=float32` accumulation.
+* ``lax.scan`` over decoder blocks (one compiled block body, fast compiles
+  at any depth) + ``jax.checkpoint`` per block (remat: HBM is the usual
+  bottleneck, recompute beats re-read).
+* GQA + RoPE + SwiGLU, matching Llama-3/3.1 shapes.
+* Sharding rules (scaling-book recipe): contraction dims over 'model'
+  (tensor parallel within a host's ICI-adjacent chips), the other dim over
+  'fsdp'; embeddings vocab-sharded over 'model'.
+"""
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    # Remat each block's activations (trade FLOPs for HBM).
+    remat: bool = True
+    # Use ring attention (sequence parallelism over the 'seq' mesh axis).
+    ring_attention: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def num_params(self) -> int:
+        emb = self.vocab_size * self.dim
+        per_layer = (
+            self.dim * self.n_heads * self.head_dim +          # wq
+            2 * self.dim * self.n_kv_heads * self.head_dim +   # wk, wv
+            self.n_heads * self.head_dim * self.dim +          # wo
+            3 * self.dim * self.ffn_dim +                      # w1, w2, w3
+            2 * self.dim)                                      # norms
+        return 2 * emb + self.n_layers * per_layer + self.dim
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Approx fwd+bwd FLOPs per token (6N + attention term)."""
+        n = self.num_params() - self.vocab_size * self.dim  # non-embedding
+        attn = 12 * self.n_layers * self.dim * seq_len  # causal ~ s/2 * 2
+        return 6 * n + attn
+
+
+# Published Llama-3.x shapes + small configs for tests/benches.
+CONFIGS: Dict[str, LlamaConfig] = {
+    'llama3-8b': LlamaConfig(),
+    'llama3-70b': LlamaConfig(dim=8192, n_layers=80, n_heads=64,
+                              n_kv_heads=8, ffn_dim=28672),
+    'llama3-1b': LlamaConfig(dim=2048, n_layers=16, n_heads=32,
+                             n_kv_heads=8, ffn_dim=8192,
+                             vocab_size=128256),
+    # ~160M-class model for single-chip benches (MXU-saturating dims).
+    'bench-160m': LlamaConfig(vocab_size=32768, dim=1024, n_layers=12,
+                              n_heads=16, n_kv_heads=8, ffn_dim=4096,
+                              max_seq_len=2048),
+    'debug': LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                         n_kv_heads=2, ffn_dim=128, max_seq_len=128,
+                         remat=False),
+}
+
+
+# ------------------------------------------------------------------- init
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
+    """Initialize a param pytree. Layer params are stacked along a leading
+
+    axis (scanned), so the tree has one entry per weight *kind*."""
+    k_emb, k_layers, k_out = jax.random.split(key, 3)
+    init = jax.nn.initializers.normal(stddev=0.02)
+    hd = cfg.head_dim
+
+    def stack_init(k, shape):
+        return init(k, (cfg.n_layers,) + shape, cfg.dtype)
+
+    ks = jax.random.split(k_layers, 7)
+    params: Params = {
+        'tok_embedding': init(k_emb, (cfg.vocab_size, cfg.dim), cfg.dtype),
+        'layers': {
+            'attn_norm': jnp.ones((cfg.n_layers, cfg.dim), cfg.dtype),
+            'wq': stack_init(ks[0], (cfg.dim, cfg.n_heads * hd)),
+            'wk': stack_init(ks[1], (cfg.dim, cfg.n_kv_heads * hd)),
+            'wv': stack_init(ks[2], (cfg.dim, cfg.n_kv_heads * hd)),
+            'wo': stack_init(ks[3], (cfg.n_heads * hd, cfg.dim)),
+            'ffn_norm': jnp.ones((cfg.n_layers, cfg.dim), cfg.dtype),
+            'w1': stack_init(ks[4], (cfg.dim, cfg.ffn_dim)),
+            'w3': stack_init(ks[5], (cfg.dim, cfg.ffn_dim)),
+            'w2': stack_init(ks[6], (cfg.ffn_dim, cfg.dim)),
+        },
+        'out_norm': jnp.ones((cfg.dim,), cfg.dtype),
+        'lm_head': init(k_out, (cfg.dim, cfg.vocab_size), cfg.dtype),
+    }
+    return params
+
+
+def param_partition_specs(cfg: LlamaConfig) -> Params:
+    """Same-structure pytree of PartitionSpecs (megatron-style TP + FSDP).
+
+    Contraction/head dims over 'model'; the complementary dim over 'fsdp'.
+    Layer-stacked tensors lead with None (the scan axis is replicated).
+    """
+    del cfg
+    return {
+        'tok_embedding': P(MODEL_AXIS, FSDP_AXIS),
+        'layers': {
+            'attn_norm': P(None, None),
+            'wq': P(None, FSDP_AXIS, MODEL_AXIS),
+            'wk': P(None, FSDP_AXIS, MODEL_AXIS),
+            'wv': P(None, FSDP_AXIS, MODEL_AXIS),
+            'wo': P(None, MODEL_AXIS, FSDP_AXIS),
+            'ffn_norm': P(None, None),
+            'w1': P(None, FSDP_AXIS, MODEL_AXIS),
+            'w3': P(None, FSDP_AXIS, MODEL_AXIS),
+            'w2': P(None, MODEL_AXIS, FSDP_AXIS),
+        },
+        'out_norm': P(None),
+        'lm_head': P(FSDP_AXIS, MODEL_AXIS),
+    }
+
+
+# ---------------------------------------------------------------- forward
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms).astype(x.dtype) * weight
+
+
+def _rope_freqs(cfg: LlamaConfig, positions: jax.Array) -> Tuple[jax.Array,
+                                                                 jax.Array]:
+    hd = cfg.head_dim
+    inv_freq = 1.0 / (cfg.rope_theta
+                      **(jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B?,S,hd/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [B, S, H, hd]; cos/sin: [S, hd/2] or [B, S, hd/2]."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    # Insert the head axis: [.., S, hd/2] → [.., S, 1, hd/2]; leading batch
+    # dims broadcast.
+    cos = cos[..., :, None, :]
+    sin = sin[..., :, None, :]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+    return out.astype(jnp.bfloat16)
+
+
+def _block(cfg: LlamaConfig, x: jax.Array, layer: Params, cos: jax.Array,
+           sin: jax.Array, seq_axis_sharded: bool) -> jax.Array:
+    b, s, d = x.shape
+    hd = cfg.head_dim
+    h = rms_norm(x, layer['attn_norm'], cfg.norm_eps)
+    q = (h @ layer['wq']).reshape(b, s, cfg.n_heads, hd)
+    k = (h @ layer['wk']).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (h @ layer['wv']).reshape(b, s, cfg.n_kv_heads, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    if seq_axis_sharded:
+        attn_out = attention_ops.ring_attention(q, k, v, axis_name=SEQ_AXIS)
+    else:
+        attn_out = attention_ops.gqa_attention(q, k, v, causal=True)
+    attn_out = attn_out.reshape(b, s, cfg.n_heads * hd)
+    x = x + (attn_out @ layer['wo']).astype(cfg.dtype)
+
+    h = rms_norm(x, layer['ffn_norm'], cfg.norm_eps)
+    gate = jax.nn.silu((h @ layer['w1']).astype(jnp.float32))
+    up = (h @ layer['w3']).astype(jnp.float32)
+    down = ((gate * up).astype(cfg.dtype)) @ layer['w2']
+    return x + down.astype(cfg.dtype)
+
+
+def forward(params: Params,
+            tokens: jax.Array,
+            cfg: LlamaConfig,
+            positions: Optional[jax.Array] = None) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, vocab] float32.
+
+    Scans over the stacked layer params; each block body optionally
+    rematerialized.
+    """
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.arange(s, dtype=jnp.int32)
+    cos, sin = _rope_freqs(cfg, positions)
+    x = params['tok_embedding'][tokens].astype(cfg.dtype)
+
+    seq_sharded = cfg.ring_attention
+
+    def body(carry, layer):
+        out = _block(cfg, carry, layer, cos, sin, seq_sharded)
+        return out, None
+
+    if cfg.remat:
+        body = jax.checkpoint(body, prevent_cse=False)
+    x, _ = jax.lax.scan(body, x, params['layers'])
+
+    x = rms_norm(x, params['out_norm'], cfg.norm_eps)
+    logits = (x @ params['lm_head']).astype(jnp.float32)
+    return logits
+
+
+def loss_fn(params: Params, tokens: jax.Array, targets: jax.Array,
+            cfg: LlamaConfig) -> jax.Array:
+    """Mean next-token cross-entropy (targets = tokens shifted by caller)."""
+    logits = forward(params, tokens, cfg)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None],
+                               axis=-1).squeeze(-1)
+    return jnp.mean(logz - gold)

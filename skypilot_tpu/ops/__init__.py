@@ -1,0 +1,1 @@
+"""TPU compute ops: attention (XLA + Pallas paths), collective benches."""

@@ -1,0 +1,64 @@
+"""Attention ops: GQA causal attention (XLA path) + ring attention hook.
+
+The XLA path is written so the compiler fuses mask+softmax into the two
+matmuls and keeps everything on the MXU in bf16 with fp32 accumulation.
+Ring attention (sequence-parallel long context) lives in
+``skypilot_tpu.parallel.ring`` and is dispatched here when a 'seq' mesh
+axis is active.
+"""
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """[B, S, Hkv, D] → [B, S, Hkv*n_rep, D] (GQA key/value head fan-out)."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :],
+                            (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+
+
+def gqa_attention(q: jax.Array,
+                  k: jax.Array,
+                  v: jax.Array,
+                  causal: bool = True,
+                  q_offset: int = 0) -> jax.Array:
+    """q [B,S,H,D], k/v [B,Skv,Hkv,D] → [B,S,H,D].
+
+    bf16 in/out; softmax in fp32. `q_offset` supports decode (q positions
+    start at q_offset within the kv sequence).
+    """
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    k = repeat_kv(k, h // hkv)
+    v = repeat_kv(v, h // hkv)
+    scale = d**-0.5
+    # [B, H, S, Skv]
+    logits = jnp.einsum('bshd,bthd->bhst', q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        skv = k.shape[1]
+        q_pos = jnp.arange(s) + q_offset
+        kv_pos = jnp.arange(skv)
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum('bhst,bthd->bshd', probs, v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str) -> jax.Array:
+    """Dispatch to the sequence-parallel ring implementation.
+
+    Must be called inside a ``shard_map`` over ``axis_name`` (see
+    ``skypilot_tpu.parallel.ring``)."""
+    from skypilot_tpu.parallel import ring
+    return ring.ring_attention_inner(q, k, v, axis_name=axis_name)

@@ -1,0 +1,12 @@
+"""Parallelism library: meshes, sharding rules, collectives, ring attention.
+
+The TPU-native replacement for the reference's user-space NCCL/DDP patterns
+(SURVEY §2.11): a `jax.sharding.Mesh` over the slice's ICI torus, named-axis
+sharding rules for DP/FSDP/TP/SP, XLA collectives over ICI/DCN, and ring
+attention for long-context sequence parallelism.
+"""
+from skypilot_tpu.parallel.mesh import MeshConfig
+from skypilot_tpu.parallel.mesh import make_mesh
+from skypilot_tpu.parallel.mesh import mesh_for_topology
+
+__all__ = ['MeshConfig', 'make_mesh', 'mesh_for_topology']

@@ -1,0 +1,105 @@
+"""Device mesh construction from TPU slice topologies.
+
+The scaling recipe (jax-ml scaling book): pick a mesh whose axes map onto
+the ICI torus — 'model' (tensor parallel) innermost so TP collectives ride
+the fastest links, 'fsdp' next, 'data' outermost (over DCN for multislice).
+XLA inserts the collectives; we only lay out axes and annotate shardings.
+"""
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = 'data'
+FSDP_AXIS = 'fsdp'
+MODEL_AXIS = 'model'
+SEQ_AXIS = 'seq'
+
+AXIS_ORDER = (DATA_AXIS, FSDP_AXIS, SEQ_AXIS, MODEL_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Named axis sizes; -1 on at most one axis = infer from device count."""
+    data: int = 1
+    fsdp: int = -1
+    seq: int = 1
+    model: int = 1
+
+    def resolve(self, num_devices: int) -> Dict[str, int]:
+        sizes = {
+            DATA_AXIS: self.data,
+            FSDP_AXIS: self.fsdp,
+            SEQ_AXIS: self.seq,
+            MODEL_AXIS: self.model,
+        }
+        unknown = [k for k, v in sizes.items() if v == -1]
+        if len(unknown) > 1:
+            raise ValueError(f'Only one axis may be -1, got {unknown}')
+        known = math.prod(v for v in sizes.values() if v != -1)
+        if unknown:
+            if num_devices % known:
+                raise ValueError(
+                    f'{num_devices} devices not divisible by fixed axes '
+                    f'{sizes}')
+            sizes[unknown[0]] = num_devices // known
+        if math.prod(sizes.values()) != num_devices:
+            raise ValueError(
+                f'Mesh {sizes} does not cover {num_devices} devices.')
+        return sizes
+
+
+def make_mesh(config: Optional[MeshConfig] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh over the given (default: all) devices.
+
+    Axis order is fixed (data, fsdp, seq, model) so 'model' neighbors are
+    ICI-adjacent under jax's default device order on TPU slices.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    config = config or MeshConfig()
+    sizes = config.resolve(len(devices))
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def mesh_for_topology(topology, data_parallel: int = 1,
+                      model_parallel: Optional[int] = None,
+                      devices: Optional[Sequence[jax.Device]] = None
+                      ) -> Mesh:
+    """Mesh matched to a TpuSliceTopology's default axis split."""
+    default = topology.default_mesh_shape(data_parallel)
+    model = model_parallel if model_parallel is not None else \
+        default[MODEL_AXIS]
+    cfg = MeshConfig(data=data_parallel, fsdp=-1, model=model)
+    return make_mesh(cfg, devices)
+
+
+def batch_spec() -> P:
+    """Activations: batch sharded over data+fsdp (the standard recipe)."""
+    return P((DATA_AXIS, FSDP_AXIS))
+
+
+def batch_seq_spec() -> P:
+    """Batch over data+fsdp, sequence over the seq axis (context/sequence
+
+    parallelism for long-context training)."""
+    return P((DATA_AXIS, FSDP_AXIS), SEQ_AXIS)
+
+
+def shard_params(params, mesh: Mesh, specs) -> 'jax.Array':
+    """Device-put a param pytree with a matching PartitionSpec pytree."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params,
+        specs)
+
+
+def spec_to_sharding(mesh: Mesh, spec_tree):
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, P))

@@ -1,0 +1,95 @@
+"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+
+Long-context sequence parallelism (SURVEY §2.11 / §5.7): each device holds a
+sequence shard of Q/K/V; K/V blocks rotate around the ring via
+``lax.ppermute`` (ICI neighbor exchange) while each device folds every block
+into an online-softmax accumulator — memory per device stays O(S/n · S/n)
+and the KV transfer overlaps with compute in XLA's pipeline. Numerically
+exact (fp32 accumulators, verified against the dense reference in tests).
+
+Usage: either call :func:`ring_attention` with a mesh (wraps shard_map), or
+call :func:`ring_attention_inner` from inside your own shard_map.
+"""
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from skypilot_tpu.ops import attention as attention_ops
+
+NEG_INF = -1e30
+
+
+def ring_attention_inner(q: jax.Array, k: jax.Array, v: jax.Array,
+                         axis_name: str) -> jax.Array:
+    """Per-device body. q/k/v: [B, Sl, H|Hkv, D] local sequence shards.
+
+    Causality uses global positions derived from the device's ring index.
+    """
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, sl, h, d = q.shape
+    hkv = k.shape[2]
+    k = attention_ops.repeat_kv(k, h // hkv)
+    v = attention_ops.repeat_kv(v, h // hkv)
+    scale = d**-0.5
+
+    q_pos = my_idx * sl + jnp.arange(sl)
+
+    def step(i, carry):
+        k_blk, v_blk, m, l, acc = carry
+        src_idx = (my_idx - i) % n  # whose shard we currently hold
+        kv_pos = src_idx * sl + jnp.arange(sl)
+        logits = jnp.einsum('bshd,bthd->bhst', q, k_blk,
+                            preferred_element_type=jnp.float32) * scale
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+
+        m_blk = jnp.max(logits, axis=-1)                    # [B,H,S]
+        m_new = jnp.maximum(m, m_blk)
+        # Guard fully-masked rows (exp(NEG_INF - NEG_INF) would be 1).
+        safe_m = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        p = jnp.exp(logits - safe_m[..., None])
+        p = jnp.where(mask[None, None], p, 0.0)
+        correction = jnp.where(m == NEG_INF, 0.0, jnp.exp(m - safe_m))
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        pv = jnp.einsum('bhst,bthd->bshd', p.astype(q.dtype), v_blk,
+                        preferred_element_type=jnp.float32)
+        acc_new = acc * correction.transpose(0, 2, 1)[..., None] + pv
+
+        # Rotate K/V around the ring: receive the previous device's block.
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return k_next, v_next, m_new, l_new, acc_new
+
+    m0 = jnp.full((b, h, sl), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sl), jnp.float32)
+    acc0 = jnp.zeros((b, sl, h, d), jnp.float32)
+    _, _, _, l, acc = jax.lax.fori_loop(0, n, step, (k, v, m0, l0, acc0))
+
+    denom = jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
+    return (acc / denom).astype(q.dtype)
+
+
+def ring_attention(q: jax.Array,
+                   k: jax.Array,
+                   v: jax.Array,
+                   mesh: Mesh,
+                   seq_axis: str = 'seq',
+                   batch_axes=('data', 'fsdp'),
+                   head_axis: Optional[str] = 'model') -> jax.Array:
+    """shard_map wrapper: q/k/v are global [B, S, H, D] arrays; S must be
+
+    divisible by the seq-axis size."""
+    spec = P(batch_axes, seq_axis, head_axis, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention_inner, axis_name=seq_axis),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

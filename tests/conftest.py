@@ -7,10 +7,17 @@ mirroring how the driver dry-runs `__graft_entry__.dryrun_multichip`.
 import os
 import sys
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault('XLA_FLAGS',
-                      '--xla_force_host_platform_device_count=8')
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Force the CPU backend with 8 virtual devices. The environment preloads
+# jax at interpreter startup with JAX_PLATFORMS pinned to the TPU backend,
+# so env vars alone are too late — override via jax.config before any
+# backend is initialized (no jax.devices() call has happened yet).
+# Append (not prepend): XLA takes the LAST occurrence of a repeated flag.
+os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '') +
+                           ' --xla_force_host_platform_device_count=8')
+os.environ['JAX_PLATFORMS'] = 'cpu'
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
