@@ -80,10 +80,10 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     tokens_per_sec = steps * batch * seq / dt
-    flops_per_token = cfg.flops_per_token(seq)
     peak = _detect_peak()
-    mfu = tokens_per_sec * flops_per_token / peak if peak else None
-    baseline = A100_ASSUMED_MFU * A100_PEAK_BF16 / flops_per_token
+    mfu = train.tokens_per_second_to_mfu(tokens_per_sec, cfg, seq,
+                                         peak) if peak else None
+    baseline = A100_ASSUMED_MFU * A100_PEAK_BF16 / cfg.flops_per_token(seq)
     result = {
         'metric': 'llama_train_tokens_per_sec_per_chip',
         'value': round(tokens_per_sec, 1),

@@ -1,0 +1,80 @@
+"""FUSE mount / copy command builders executed on cluster hosts.
+
+Parity: ``sky/data/mounting_utils.py:34-243`` — TPU-first cut: gcsfuse is
+the primary mount tool (TPU VMs are GCP machines and ship or can fetch
+gcsfuse); the Local store mounts by symlink so MOUNT-mode semantics
+(writes land in the "bucket") are fully testable without credentials.
+"""
+import shlex
+
+GCSFUSE_VERSION = '2.4.0'
+
+_GCSFUSE_INSTALL = (
+    'which gcsfuse >/dev/null 2>&1 || ('
+    'curl -fsSL -o /tmp/gcsfuse.deb '
+    'https://github.com/GoogleCloudPlatform/gcsfuse/releases/download/'
+    f'v{GCSFUSE_VERSION}/gcsfuse_{GCSFUSE_VERSION}_amd64.deb && '
+    'sudo dpkg -i /tmp/gcsfuse.deb)')
+
+
+def get_mounting_script(mount_path: str, mount_cmd: str,
+                        install_cmd: str = '') -> str:
+    """Idempotent mount script: install tool, create dir, mount if needed."""
+    script = [
+        'set -e',
+        f'MOUNT_PATH={shlex.quote(mount_path)}',
+        'if grep -q " $MOUNT_PATH " /proc/mounts 2>/dev/null; then',
+        '  echo "already mounted: $MOUNT_PATH"; exit 0',
+        'fi',
+    ]
+    if install_cmd:
+        script.append(install_cmd)
+    script += [
+        'mkdir -p "$MOUNT_PATH"',
+        mount_cmd,
+        'echo "mounted: $MOUNT_PATH"',
+    ]
+    return '\n'.join(script)
+
+
+def get_gcs_mount_cmd(bucket_name: str, mount_path: str) -> str:
+    """gcsfuse mount (implicit dirs so checkpoint trees appear)."""
+    return (f'gcsfuse --implicit-dirs '
+            f'--stat-cache-ttl 5s --type-cache-ttl 5s '
+            f'{shlex.quote(bucket_name)} {shlex.quote(mount_path)}')
+
+
+def get_gcs_mount_script(bucket_name: str, mount_path: str) -> str:
+    return get_mounting_script(mount_path,
+                               get_gcs_mount_cmd(bucket_name, mount_path),
+                               install_cmd=_GCSFUSE_INSTALL)
+
+
+def get_gcs_copy_cmd(bucket_name: str, key: str, dst: str) -> str:
+    src = f'gs://{bucket_name}/{key}'.rstrip('/')
+    return f'mkdir -p {shlex.quote(dst)} && gsutil -m rsync -r {src} ' \
+           f'{shlex.quote(dst)}'
+
+
+def get_local_mount_script(bucket_dir: str, mount_path: str) -> str:
+    """Local store "mount": a symlink into the bucket directory.
+
+    Gives real MOUNT semantics for tests — writes under ``mount_path``
+    land in ``bucket_dir`` and survive cluster teardown (the checkpoint /
+    recovery pattern, SURVEY §5.4).
+    """
+    b, m = shlex.quote(bucket_dir), shlex.quote(mount_path)
+    return '\n'.join([
+        'set -e',
+        f'mkdir -p {b}',
+        f'mkdir -p $(dirname {m})',
+        f'if [ -L {m} ]; then rm {m}; fi',
+        f'if [ -d {m} ] && [ ! -L {m} ]; then rmdir {m} 2>/dev/null || true; fi',
+        f'ln -sfn {b} {m}',
+        f'echo "mounted: {m}"',
+    ])
+
+
+def get_local_copy_cmd(bucket_dir: str, dst: str) -> str:
+    b, d = shlex.quote(bucket_dir), shlex.quote(dst)
+    return f'mkdir -p {d} && cp -a {b}/. {d}/'

@@ -1,0 +1,380 @@
+"""Storage objects: named buckets with lifecycle + MOUNT/COPY semantics.
+
+Parity: ``sky/data/storage.py`` (``Storage:519``, ``AbstractStore:279``,
+``StorageMode:265``) — TPU-first cut: GCS is the primary store (TPU VMs are
+GCP machines; gcsfuse/gsutil are the native tools), and a ``LocalStore``
+(directory-backed "bucket") gives the full Storage lifecycle — create,
+upload, mount, write-back, delete — without credentials so the
+checkpoint-to-bucket recovery pattern (SURVEY §5.4) is e2e-testable.
+"""
+import enum
+import os
+import re
+import shutil
+import subprocess
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu.data import mounting_utils
+from skypilot_tpu.data import storage_utils
+from skypilot_tpu.utils import subprocess_utils
+
+logger = sky_logging.init_logger(__name__)
+
+_BUCKET_NAME_RE = re.compile(r'^[a-z0-9][a-z0-9._-]{1,61}[a-z0-9]$')
+
+LOCAL_BUCKET_ROOT = '~/.skytpu/local_buckets'
+
+
+class StorageMode(enum.Enum):
+    """Parity: sky/data/storage.py:265."""
+    MOUNT = 'MOUNT'
+    COPY = 'COPY'
+
+
+class StoreType(enum.Enum):
+    """Bucket backends. Parity: sky/data/storage.py StoreType."""
+    GCS = 'GCS'
+    LOCAL = 'LOCAL'
+
+    @classmethod
+    def from_store(cls, store: 'AbstractStore') -> 'StoreType':
+        if isinstance(store, GcsStore):
+            return cls.GCS
+        if isinstance(store, LocalStore):
+            return cls.LOCAL
+        raise ValueError(f'Unknown store type: {store}')
+
+
+class StorageStatus(enum.Enum):
+    INIT = 'INIT'
+    UPLOAD_FAILED = 'UPLOAD_FAILED'
+    READY = 'READY'
+
+
+def _validate_name(name: str) -> None:
+    if not _BUCKET_NAME_RE.match(name):
+        raise exceptions.StorageNameError(
+            f'Invalid storage name {name!r}: must be 3-63 chars of '
+            'lowercase letters, digits, ., _ or -, starting/ending '
+            'alphanumeric.')
+
+
+class AbstractStore:
+    """One bucket in one backend (parity: AbstractStore:279)."""
+
+    def __init__(self, name: str, source: Optional[str] = None):
+        _validate_name(name)
+        self.name = name
+        self.source = source
+        self.is_sky_managed = source is not None
+
+    # lifecycle ----------------------------------------------------------
+    def initialize(self) -> None:
+        """Create the bucket if it does not exist."""
+        raise NotImplementedError
+
+    def upload(self) -> None:
+        """Sync ``source`` into the bucket (no-op when source is None)."""
+        raise NotImplementedError
+
+    def delete(self) -> None:
+        raise NotImplementedError
+
+    def exists(self) -> bool:
+        raise NotImplementedError
+
+    # on-cluster command builders ---------------------------------------
+    def mount_command(self, mount_path: str) -> str:
+        """Script run on each host to MOUNT the bucket at mount_path."""
+        raise NotImplementedError
+
+    def copy_command(self, dst: str) -> str:
+        """Script run on each host to COPY bucket contents into dst."""
+        raise NotImplementedError
+
+    def get_uri(self) -> str:
+        raise NotImplementedError
+
+
+class GcsStore(AbstractStore):
+    """GCS bucket driven via the gsutil CLI (present on TPU VMs).
+
+    Parity: sky/data/storage.py GcsStore:1886 — reimplemented over the CLI
+    instead of the python SDK so the control path matches what runs on the
+    TPU hosts themselves.
+    """
+
+    def _gsutil(self, *args: str, check: bool = True) -> 'subprocess.CompletedProcess':
+        proc = subprocess.run(['gsutil'] + list(args),
+                              capture_output=True,
+                              text=True,
+                              check=False)
+        if check and proc.returncode != 0:
+            raise exceptions.StorageError(
+                f'gsutil {" ".join(args)} failed: {proc.stderr}')
+        return proc
+
+    def exists(self) -> bool:
+        proc = self._gsutil('ls', '-b', f'gs://{self.name}', check=False)
+        return proc.returncode == 0
+
+    def initialize(self) -> None:
+        if shutil.which('gsutil') is None:
+            raise exceptions.StorageError(
+                'gsutil not found; GCS storage requires the Google Cloud '
+                'SDK. Use a LOCAL store or install gcloud.')
+        if not self.exists():
+            self._gsutil('mb', f'gs://{self.name}')
+            logger.info(f'Created GCS bucket gs://{self.name}')
+
+    def upload(self) -> None:
+        if self.source is None:
+            return
+        src = os.path.expanduser(self.source)
+        if os.path.isfile(src):
+            # rsync requires directory args; single files go via cp.
+            self._gsutil('cp', src, f'gs://{self.name}/')
+            return
+        excludes = storage_utils.get_excluded_files(src)
+        args = ['-m', 'rsync', '-r']
+        if excludes:
+            # gsutil honors a single -x regex; alternation joins patterns.
+            regex = '|'.join(
+                pat.replace('.', r'\.').replace('*', '.*')
+                for pat in excludes)
+            args += ['-x', regex]
+        args += [src, f'gs://{self.name}']
+        self._gsutil(*args)
+
+    def delete(self) -> None:
+        if self.exists():
+            self._gsutil('-m', 'rm', '-r', f'gs://{self.name}', check=False)
+
+    def mount_command(self, mount_path: str) -> str:
+        return mounting_utils.get_gcs_mount_script(self.name, mount_path)
+
+    def copy_command(self, dst: str) -> str:
+        return mounting_utils.get_gcs_copy_cmd(self.name, '', dst)
+
+    def get_uri(self) -> str:
+        return f'gs://{self.name}'
+
+
+class LocalStore(AbstractStore):
+    """Directory-backed bucket for the Local cloud / tests.
+
+    The "bucket" is a directory under ``~/.skytpu/local_buckets`` (absolute
+    path captured at creation so on-"host" commands running with a
+    different $HOME still resolve it). MOUNT = symlink (real write-back);
+    COPY = cp -a.
+    """
+
+    def __init__(self, name: str, source: Optional[str] = None,
+                 bucket_dir: Optional[str] = None):
+        super().__init__(name, source)
+        self.bucket_dir = bucket_dir or os.path.join(
+            os.path.expanduser(LOCAL_BUCKET_ROOT), name)
+
+    def exists(self) -> bool:
+        return os.path.isdir(self.bucket_dir)
+
+    def initialize(self) -> None:
+        os.makedirs(self.bucket_dir, exist_ok=True)
+
+    def upload(self) -> None:
+        if self.source is None:
+            return
+        src = os.path.expanduser(self.source)
+        if os.path.isfile(src):
+            shutil.copy2(src, self.bucket_dir)
+            return
+        for abs_path, rel in storage_utils.list_files_to_upload(src):
+            dst = os.path.join(self.bucket_dir, rel)
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            shutil.copy2(abs_path, dst)
+
+    def delete(self) -> None:
+        shutil.rmtree(self.bucket_dir, ignore_errors=True)
+
+    def mount_command(self, mount_path: str) -> str:
+        return mounting_utils.get_local_mount_script(self.bucket_dir,
+                                                     mount_path)
+
+    def copy_command(self, dst: str) -> str:
+        return mounting_utils.get_local_copy_cmd(self.bucket_dir, dst)
+
+    def get_uri(self) -> str:
+        return f'local://{self.name}'
+
+
+_STORE_CLASSES = {
+    StoreType.GCS: GcsStore,
+    StoreType.LOCAL: LocalStore,
+}
+
+
+class Storage:
+    """A named storage object: bucket(s) + optional local source + mode.
+
+    Parity: sky/data/storage.py Storage:519. YAML form::
+
+        file_mounts:
+          /checkpoints:
+            name: my-ckpts
+            store: gcs          # or local
+            mode: MOUNT         # or COPY
+            source: ~/data      # optional: upload before use
+    """
+
+    def __init__(self,
+                 name: Optional[str] = None,
+                 source: Optional[str] = None,
+                 stores: Optional[List[StoreType]] = None,
+                 persistent: bool = True,
+                 mode: StorageMode = StorageMode.MOUNT):
+        if name is None and source is None:
+            raise exceptions.StorageSpecError(
+                'Storage requires a name and/or source.')
+        if name is None:
+            assert source is not None
+            name = os.path.basename(os.path.abspath(
+                os.path.expanduser(source))).lower().replace('_', '-')
+        _validate_name(name)
+        if source is not None and not source.startswith(
+            ('gs://', 'local://')):
+            expanded = os.path.expanduser(source)
+            if not os.path.exists(expanded):
+                raise exceptions.StorageSourceError(
+                    f'Storage source {source!r} does not exist.')
+        self.name = name
+        self.source = source
+        self.persistent = persistent
+        self.mode = mode
+        self.stores: Dict[StoreType, AbstractStore] = {}
+        self._requested_stores = stores or []
+
+    # ----------------------------------------------------------- lifecycle
+
+    def add_store(self, store_type: StoreType) -> AbstractStore:
+        if isinstance(store_type, str):
+            store_type = StoreType(store_type.upper())
+        if store_type in self.stores:
+            return self.stores[store_type]
+        source = None
+        if self.source is not None and '://' not in self.source:
+            source = self.source
+        store = _STORE_CLASSES[store_type](self.name, source)
+        store.initialize()
+        global_state.add_or_update_storage(self.name, self.handle(),
+                                           StorageStatus.INIT.value)
+        try:
+            store.upload()
+        except exceptions.StorageError:
+            global_state.add_or_update_storage(
+                self.name, self.handle(), StorageStatus.UPLOAD_FAILED.value)
+            raise
+        self.stores[store_type] = store
+        global_state.add_or_update_storage(self.name, self.handle(),
+                                           StorageStatus.READY.value)
+        return store
+
+    def sync_all_stores(self) -> None:
+        """(Re-)create + upload every requested store."""
+        requested = list(self._requested_stores) or [self._default_store()]
+        for st in requested:
+            self.add_store(st)
+
+    def _default_store(self) -> StoreType:
+        if self.source is not None and self.source.startswith('gs://'):
+            return StoreType.GCS
+        if self.source is not None and self.source.startswith('local://'):
+            return StoreType.LOCAL
+        enabled = global_state.get_enabled_clouds()
+        if enabled and all(c.lower() == 'local' for c in enabled):
+            return StoreType.LOCAL
+        return StoreType.GCS
+
+    def delete(self, store_type: Optional[StoreType] = None) -> None:
+        targets = ([store_type] if store_type is not None else
+                   list(self.stores))
+        for st in targets:
+            self.stores.pop(st).delete()
+        if not self.stores:
+            global_state.remove_storage(self.name)
+
+    def handle(self) -> Dict[str, Any]:
+        """Pickle-friendly record stored in global state."""
+        return {
+            'name': self.name,
+            'source': self.source,
+            'mode': self.mode.value,
+            'persistent': self.persistent,
+            'stores': [st.value for st in self.stores],
+        }
+
+    # ----------------------------------------------------------- (de)ser
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Storage':
+        name = config.get('name')
+        source = config.get('source')
+        if source is not None and source.startswith('gs://'):
+            _, bucket, _ = storage_utils.split_bucket_uri(source)
+            if name is not None and name != bucket:
+                # Parity: the reference rejects name+URI-source combos —
+                # the URI already names the bucket; a second name would
+                # silently create a different, empty bucket.
+                raise exceptions.StorageSpecError(
+                    f'Storage name {name!r} conflicts with bucket URI '
+                    f'source {source!r}; drop `name` when `source` is a '
+                    'bucket URI.')
+            name = bucket
+        mode = StorageMode(config.get('mode', 'MOUNT').upper())
+        stores = None
+        if config.get('store') is not None:
+            stores = [StoreType(str(config['store']).upper())]
+        return cls(name=name,
+                   source=source,
+                   stores=stores,
+                   persistent=config.get('persistent', True),
+                   mode=mode)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {'name': self.name}
+        if self.source is not None:
+            cfg['source'] = self.source
+        if self._requested_stores:
+            cfg['store'] = self._requested_stores[0].value.lower()
+        if not self.persistent:
+            cfg['persistent'] = False
+        cfg['mode'] = self.mode.value
+        return cfg
+
+    def __repr__(self) -> str:
+        return (f'Storage(name={self.name!r}, source={self.source!r}, '
+                f'mode={self.mode.value})')
+
+
+def get_store_for_mounting(storage: Storage) -> AbstractStore:
+    """Pick the store used on-cluster, creating it if necessary."""
+    if not storage.stores:
+        storage.sync_all_stores()
+    # Prefer GCS when present (TPU hosts mount it natively).
+    for st in (StoreType.GCS, StoreType.LOCAL):
+        if st in storage.stores:
+            return storage.stores[st]
+    return next(iter(storage.stores.values()))
+
+
+def run_on_hosts(runners, script: str, action: str) -> None:
+    """Execute a mount/copy script on every host in parallel."""
+
+    def _one(runner) -> None:
+        rc, out, err = runner.run(script, require_outputs=True, timeout=600)
+        subprocess_utils.handle_returncode(
+            rc, action, f'{action} failed on {runner.node_id}:\n{out}{err}')
+
+    subprocess_utils.run_in_parallel(_one, list(runners))

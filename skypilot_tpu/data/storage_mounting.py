@@ -1,0 +1,33 @@
+"""Mount/copy Storage objects onto every host of a cluster.
+
+Called by ``TpuGangBackend._sync_file_mounts`` (parity:
+``cloud_vm_ray_backend.py:4892`` _execute_storage_mounts). MOUNT-mode
+storage becomes a live bucket mount on each host (gcsfuse for GCS, symlink
+for the Local store); COPY-mode downloads bucket contents once.
+"""
+import typing
+from typing import Dict
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.data import storage as storage_lib
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.backends import gang_backend
+
+logger = sky_logging.init_logger(__name__)
+
+
+def mount_storage(handle: 'gang_backend.ClusterHandle',
+                  storage_mounts: Dict[str, storage_lib.Storage]) -> None:
+    runners = handle.get_command_runners()
+    for dst, storage in storage_mounts.items():
+        store = storage_lib.get_store_for_mounting(storage)
+        mount_path = dst if not dst.startswith('~/') else dst[2:]
+        if storage.mode == storage_lib.StorageMode.MOUNT:
+            script = store.mount_command(mount_path)
+            action = f'mount {store.get_uri()} -> {dst}'
+        else:
+            script = store.copy_command(mount_path)
+            action = f'copy {store.get_uri()} -> {dst}'
+        storage_lib.run_on_hosts(runners, script, action)
+        logger.info(f'{action} on {len(runners)} host(s).')

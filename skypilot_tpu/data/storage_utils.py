@@ -1,0 +1,84 @@
+"""File-list helpers for storage uploads.
+
+Parity: ``sky/data/storage_utils.py`` — ``.skyignore``/gitignore-aware
+exclusion lists so uploads skip VCS noise, plus path/URI helpers shared by
+stores (parity: ``sky/data/data_utils.py``).
+"""
+import fnmatch
+import os
+from typing import List, Tuple
+
+SKYIGNORE_FILE = '.skyignore'
+GITIGNORE_FILE = '.gitignore'
+
+_ALWAYS_EXCLUDE = ['.git']
+
+
+def get_excluded_files(src_dir: str) -> List[str]:
+    """Patterns to exclude when uploading ``src_dir``.
+
+    ``.skyignore`` wins if present; otherwise ``.gitignore`` (top-level only,
+    like the reference's fast path). Always excludes ``.git``.
+    """
+    src_dir = os.path.expanduser(src_dir)
+    patterns: List[str] = list(_ALWAYS_EXCLUDE)
+    for ignore_file in (SKYIGNORE_FILE, GITIGNORE_FILE):
+        path = os.path.join(src_dir, ignore_file)
+        if os.path.isfile(path):
+            with open(path, encoding='utf-8') as f:
+                for line in f:
+                    line = line.strip()
+                    if line and not line.startswith('#'):
+                        patterns.append(line.rstrip('/'))
+            break  # .skyignore takes precedence over .gitignore
+    return patterns
+
+
+def _split_negations(patterns: List[str]) -> Tuple[List[str], List[str]]:
+    """gitignore '!pattern' lines re-include files a prior rule excluded."""
+    excludes = [p for p in patterns if not p.startswith('!')]
+    reincludes = [p[1:] for p in patterns if p.startswith('!')]
+    return excludes, reincludes
+
+
+def _excluded(rel_path: str, patterns: List[str]) -> bool:
+    parts = rel_path.split(os.sep)
+    for pat in patterns:
+        pat = pat.lstrip('/')
+        if fnmatch.fnmatch(rel_path, pat):
+            return True
+        if any(fnmatch.fnmatch(p, pat) for p in parts):
+            return True
+    return False
+
+
+def list_files_to_upload(src_dir: str) -> List[Tuple[str, str]]:
+    """(absolute_path, relative_key) for every file to upload."""
+    src_dir = os.path.expanduser(src_dir)
+    excludes, reincludes = _split_negations(get_excluded_files(src_dir))
+    out: List[Tuple[str, str]] = []
+    for root, dirs, files in os.walk(src_dir):
+        rel_root = os.path.relpath(root, src_dir)
+        if rel_root == '.':
+            rel_root = ''
+        dirs[:] = [
+            d for d in dirs
+            if not _excluded(os.path.join(rel_root, d), excludes) or
+            reincludes
+        ]
+        for name in files:
+            rel = os.path.join(rel_root, name) if rel_root else name
+            if _excluded(rel, excludes) and not _excluded(rel, reincludes):
+                continue
+            out.append((os.path.join(root, name), rel))
+    return out
+
+
+def split_bucket_uri(uri: str) -> Tuple[str, str, str]:
+    """'gs://bucket/some/key' → ('gs', 'bucket', 'some/key')."""
+    scheme, rest = uri.split('://', maxsplit=1)
+    if '/' in rest:
+        bucket, key = rest.split('/', maxsplit=1)
+    else:
+        bucket, key = rest, ''
+    return scheme, bucket, key

@@ -150,6 +150,10 @@ class StorageSpecError(StorageError):
     """Malformed storage spec in task YAML."""
 
 
+class StorageNameError(StorageSpecError):
+    """Invalid bucket/storage name."""
+
+
 class NoCloudAccessError(SkyTpuError):
     """No cloud is enabled/usable (run `sky check`)."""
 

@@ -98,6 +98,7 @@ def _execute(
 
         if Stage.SYNC_FILE_MOUNTS in stages and (task.file_mounts or
                                                  task.storage_mounts):
+            task.sync_storage_mounts()
             backend.sync_file_mounts(handle, task.file_mounts,
                                      task.storage_mounts)
 

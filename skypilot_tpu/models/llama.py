@@ -173,7 +173,7 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     sin = sin[..., :, None, :]
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
                           axis=-1)
-    return out.astype(jnp.bfloat16)
+    return out.astype(x.dtype)
 
 
 def _block(cfg: LlamaConfig, x: jax.Array, layer: Params, cos: jax.Array,

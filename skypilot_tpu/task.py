@@ -193,6 +193,16 @@ class Task:
         self.file_mounts.update(file_mounts)
         return self
 
+    def sync_storage_mounts(self) -> 'Task':
+        """Create + upload every storage mount's bucket(s).
+
+        Parity: sky/task.py:1028 — run before file mounts are executed so
+        the on-cluster mount commands have a live bucket to point at.
+        """
+        for storage in self.storage_mounts.values():
+            storage.sync_all_stores()
+        return self
+
     # ----------------------------------------------------------- service
 
     def set_service(self, service) -> 'Task':

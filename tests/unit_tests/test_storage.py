@@ -1,0 +1,114 @@
+"""Storage subsystem: spec parsing, local store lifecycle, ignore lists.
+
+Parity model: tests/unit_tests over sky/data (SURVEY §4 unit tier).
+"""
+import os
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.data import mounting_utils
+from skypilot_tpu.data import storage as storage_lib
+from skypilot_tpu.data import storage_utils
+
+
+def test_storage_name_validation():
+    with pytest.raises(exceptions.StorageNameError):
+        storage_lib.Storage(name='UPPER_CASE')
+    with pytest.raises(exceptions.StorageNameError):
+        storage_lib.Storage(name='x')
+    s = storage_lib.Storage(name='valid-bucket-1')
+    assert s.name == 'valid-bucket-1'
+
+
+def test_storage_requires_name_or_source():
+    with pytest.raises(exceptions.StorageSpecError):
+        storage_lib.Storage()
+
+
+def test_storage_missing_source_raises(tmp_path):
+    with pytest.raises(exceptions.StorageSourceError):
+        storage_lib.Storage(name='b-name', source=str(tmp_path / 'nope'))
+
+
+def test_storage_name_derived_from_source(tmp_path):
+    src = tmp_path / 'My_Data'
+    src.mkdir()
+    s = storage_lib.Storage(source=str(src))
+    assert s.name == 'my-data'
+
+
+def test_yaml_config_roundtrip(tmp_path):
+    src = tmp_path / 'data'
+    src.mkdir()
+    cfg = {
+        'name': 'ckpts',
+        'source': str(src),
+        'store': 'local',
+        'mode': 'COPY',
+    }
+    s = storage_lib.Storage.from_yaml_config(cfg)
+    assert s.mode == storage_lib.StorageMode.COPY
+    out = s.to_yaml_config()
+    assert out['name'] == 'ckpts'
+    assert out['store'] == 'local'
+    assert out['mode'] == 'COPY'
+
+
+def test_gs_uri_source_infers_name():
+    s = storage_lib.Storage.from_yaml_config({'source': 'gs://some-bucket'})
+    assert s.name == 'some-bucket'
+    assert s._default_store() == storage_lib.StoreType.GCS
+
+
+def test_local_store_lifecycle(tmp_path):
+    src = tmp_path / 'payload'
+    src.mkdir()
+    (src / 'a.txt').write_text('alpha')
+    (src / 'sub').mkdir()
+    (src / 'sub' / 'b.txt').write_text('beta')
+
+    s = storage_lib.Storage(name='t-bucket', source=str(src),
+                            stores=[storage_lib.StoreType.LOCAL])
+    s.sync_all_stores()
+    store = s.stores[storage_lib.StoreType.LOCAL]
+    assert store.exists()
+    assert os.path.isfile(os.path.join(store.bucket_dir, 'a.txt'))
+    assert os.path.isfile(os.path.join(store.bucket_dir, 'sub', 'b.txt'))
+
+    # Registered in global state.
+    from skypilot_tpu import global_state
+    rec = global_state.get_storage_from_name('t-bucket')
+    assert rec is not None
+
+    s.delete()
+    assert not store.exists()
+    assert global_state.get_storage_from_name('t-bucket') is None
+
+
+def test_skyignore_excludes(tmp_path):
+    src = tmp_path / 'wd'
+    src.mkdir()
+    (src / 'keep.py').write_text('x')
+    (src / 'skip.log').write_text('x')
+    (src / '.git').mkdir()
+    (src / '.git' / 'HEAD').write_text('x')
+    (src / '.skyignore').write_text('*.log\n')
+    files = dict(storage_utils.list_files_to_upload(str(src)))
+    rels = set(files.values())
+    assert 'keep.py' in rels
+    assert 'skip.log' not in rels
+    assert not any(r.startswith('.git') for r in rels)
+
+
+def test_split_bucket_uri():
+    assert storage_utils.split_bucket_uri('gs://b/k/ey') == ('gs', 'b',
+                                                             'k/ey')
+    assert storage_utils.split_bucket_uri('gs://b') == ('gs', 'b', '')
+
+
+def test_gcs_mount_script_shape():
+    script = mounting_utils.get_gcs_mount_script('bkt', '/checkpoints')
+    assert 'gcsfuse' in script
+    assert '/checkpoints' in script
+    assert 'already mounted' in script
