@@ -41,6 +41,7 @@ _LAZY_ATTRS = {
     'StoreType': ('skypilot_tpu.data.storage', 'StoreType'),
     'ClusterStatus': ('skypilot_tpu.global_state', 'ClusterStatus'),
     'JobStatus': ('skypilot_tpu.skylet.job_lib', 'JobStatus'),
+    'jobs': ('skypilot_tpu.jobs', None),
 }
 
 
@@ -48,7 +49,8 @@ def __getattr__(name):
     if name in _LAZY_ATTRS:
         import importlib
         module_name, attr = _LAZY_ATTRS[name]
-        return getattr(importlib.import_module(module_name), attr)
+        module = importlib.import_module(module_name)
+        return module if attr is None else getattr(module, attr)
     raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
 
 

@@ -1,0 +1,17 @@
+"""Managed jobs: launch-with-recovery on preemptible TPU capacity.
+
+Parity: ``sky/jobs/`` (SURVEY §2.6) — a per-job controller process launches
+the task cluster via the ordinary ``launch`` path, polls job/cluster health,
+classifies preemption vs user failure, and drives a pluggable recovery
+strategy. The reference hosts controllers on a dedicated controller VM; here
+controllers are detached processes colocated with the API server (which may
+itself be deployed on a VM), which keeps the recovery semantics identical
+while dropping the controller-cluster bootstrap hop.
+"""
+from skypilot_tpu.jobs.core import cancel
+from skypilot_tpu.jobs.core import launch
+from skypilot_tpu.jobs.core import queue
+from skypilot_tpu.jobs.core import tail_logs
+from skypilot_tpu.jobs.state import ManagedJobStatus
+
+__all__ = ['launch', 'queue', 'cancel', 'tail_logs', 'ManagedJobStatus']

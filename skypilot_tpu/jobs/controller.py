@@ -1,0 +1,159 @@
+"""Per-job controller: launch → poll → classify → recover.
+
+Parity: ``sky/jobs/controller.py`` (JobsController:53, _run_one_task:120,
+start:552). One controller process per managed job; a pipeline (multi-task
+dag) runs its tasks sequentially on freshly provisioned clusters. The poll
+loop distinguishes:
+  - job SUCCEEDED            → next task / job done
+  - job FAILED/FAILED_SETUP  → user-code failure: consume a restart budget
+                               (``max_restarts_on_errors``) or fail the job
+  - cluster unreachable/gone → preemption: run the recovery strategy
+"""
+import argparse
+import os
+import time
+import traceback
+from typing import List
+
+import yaml
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import scheduler
+from skypilot_tpu.jobs import state
+from skypilot_tpu.skylet import job_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+def poll_interval_seconds() -> float:
+    # Parity: JOB_STATUS_CHECK_GAP_SECONDS; env-tunable so tests can poll
+    # fast.
+    return float(os.environ.get('SKYTPU_JOBS_POLL_SECONDS', '15'))
+
+
+def task_cluster_name(job_id: int, task_id: int, task_name) -> str:
+    base = (task_name or 'task').replace('_', '-').lower()[:20]
+    return f'{base}-{job_id}-{task_id}'
+
+
+class JobsController:
+    """Drives one managed job to a terminal state."""
+
+    def __init__(self, job_id: int, dag_yaml: str):
+        self.job_id = job_id
+        with open(os.path.expanduser(dag_yaml), encoding='utf-8') as f:
+            configs = yaml.safe_load(f)
+        self.tasks: List[task_lib.Task] = [
+            task_lib.Task.from_yaml_config(c) for c in configs['tasks']
+        ]
+
+    def run(self) -> None:
+        cancelled = False
+        for task_id, task in enumerate(self.tasks):
+            done = self._run_one_task(task_id, task)
+            if not done:
+                cancelled = state.cancel_requested(self.job_id)
+                break
+        if cancelled:
+            state.set_cancelled(self.job_id)
+
+    def _run_one_task(self, task_id: int, task: task_lib.Task) -> bool:
+        """Returns True iff the task SUCCEEDED."""
+        job_id = self.job_id
+        cluster_name = task_cluster_name(job_id, task_id, task.name)
+        strategy = recovery_strategy.StrategyExecutor.make(
+            cluster_name, task)
+        state.set_starting(job_id, task_id)
+        logger.info(f'Task {task_id}: launching cluster {cluster_name!r}.')
+        try:
+            submitted_at = strategy.launch()
+        except Exception as e:  # pylint: disable=broad-except
+            logger.error(f'Task {task_id} launch failed: '
+                         f'{traceback.format_exc()}')
+            from skypilot_tpu import exceptions
+            failure = (state.ManagedJobStatus.FAILED_NO_RESOURCE
+                       if isinstance(e,
+                                     exceptions.ResourcesUnavailableError)
+                       else state.ManagedJobStatus.FAILED_PRECHECKS)
+            state.set_failed(job_id, task_id, failure, str(e))
+            return False
+        state.set_submitted(job_id, task_id,
+                            run_timestamp=f'job-{job_id}-{task_id}',
+                            cluster_name=cluster_name)
+        state.set_started(job_id, task_id, submitted_at)
+
+        while True:
+            if state.cancel_requested(job_id):
+                logger.info(f'Task {task_id}: cancel requested.')
+                strategy.cancel_job()
+                strategy.cleanup_cluster()
+                return False
+
+            status = strategy.job_status()
+            if status == job_lib.JobStatus.SUCCEEDED:
+                state.set_succeeded(job_id, task_id, time.time())
+                strategy.cleanup_cluster()
+                logger.info(f'Task {task_id}: SUCCEEDED.')
+                return True
+            if status in (job_lib.JobStatus.FAILED,
+                          job_lib.JobStatus.FAILED_SETUP):
+                # User-code failure: recovery will not help (parity:
+                # max_restarts_on_errors budget).
+                if (strategy.restart_cnt_on_failure <
+                        strategy.max_restarts_on_errors):
+                    strategy.restart_cnt_on_failure += 1
+                    logger.info(
+                        f'Task {task_id}: user failure, restart '
+                        f'{strategy.restart_cnt_on_failure}/'
+                        f'{strategy.max_restarts_on_errors}.')
+                    state.set_recovering(job_id, task_id)
+                    recovered = strategy.recover()
+                    state.set_recovered(job_id, task_id, recovered)
+                    continue
+                failure = (state.ManagedJobStatus.FAILED_SETUP
+                           if status == job_lib.JobStatus.FAILED_SETUP else
+                           state.ManagedJobStatus.FAILED)
+                state.set_failed(job_id, task_id, failure,
+                                 'Task command exited non-zero.')
+                strategy.cleanup_cluster()
+                return False
+            if status == job_lib.JobStatus.CANCELLED:
+                # Cancelled out-of-band on the cluster.
+                state.set_failed(job_id, task_id,
+                                 state.ManagedJobStatus.FAILED,
+                                 'Task job was cancelled on the cluster.')
+                strategy.cleanup_cluster()
+                return False
+            if status is None:
+                # Cluster gone or unreachable ⇒ preemption.
+                logger.info(f'Task {task_id}: cluster preempted/unreachable;'
+                            ' recovering.')
+                state.set_recovering(job_id, task_id)
+                recovered = strategy.recover()
+                state.set_recovered(job_id, task_id, recovered)
+                continue
+            time.sleep(poll_interval_seconds())
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--job-id', type=int, required=True)
+    parser.add_argument('--dag-yaml', type=str, required=True)
+    args = parser.parse_args()
+    try:
+        JobsController(args.job_id, args.dag_yaml).run()
+    except Exception:  # pylint: disable=broad-except
+        logger.error(traceback.format_exc())
+        for t in state.get_tasks(args.job_id):
+            if not state.ManagedJobStatus(t['status']).is_terminal():
+                state.set_failed(args.job_id, t['task_id'],
+                                 state.ManagedJobStatus.FAILED_CONTROLLER,
+                                 traceback.format_exc(limit=3))
+    finally:
+        scheduler.job_done(args.job_id)
+
+
+if __name__ == '__main__':
+    main()

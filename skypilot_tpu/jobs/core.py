@@ -1,0 +1,170 @@
+"""User-facing managed-job verbs: launch/queue/cancel/tail_logs.
+
+Parity: ``sky/jobs/`` client surface (SURVEY §2.6) — ``launch`` persists
+the dag and hands it to the scheduler, which spawns a controller process;
+``queue`` reads the controller-side sqlite state; ``cancel`` raises the
+cancel flag the controller polls; ``tail_logs`` follows either the
+controller log or the task cluster's run log.
+"""
+import os
+import subprocess
+import time
+from typing import Any, Dict, List, Optional, Union
+
+import yaml
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs import scheduler
+from skypilot_tpu.jobs import state
+from skypilot_tpu.usage import usage_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+@usage_lib.entrypoint(name='jobs.launch')
+def launch(entrypoint: Union[task_lib.Task, dag_lib.Dag],
+           name: Optional[str] = None) -> int:
+    """Submit a managed job (single task or sequential pipeline).
+
+    Returns the managed job id. Parity: jobs client sdk launch.
+    """
+    if isinstance(entrypoint, task_lib.Task):
+        tasks = [entrypoint]
+        name = name or entrypoint.name
+    else:
+        tasks = list(entrypoint.tasks)
+        name = name or entrypoint.name
+    if not tasks:
+        raise exceptions.InvalidSkyError('Managed job has no tasks.')
+    for t in tasks:
+        # Any-of resources must already be valid; storage mounts are
+        # translated on the task cluster like a normal launch.
+        if t.run is None:
+            raise exceptions.InvalidSkyError(
+                f'Managed job task {t.name!r} has no run command.')
+
+    os.makedirs(state.dag_dir(), exist_ok=True)
+    task_configs = [t.to_yaml_config() for t in tasks]
+    job_id = state.create_job(name, dag_yaml_path='', task_specs=[{
+        'name': t.name,
+        'resources': ', '.join(str(r) for r in t.resources),
+    } for t in tasks])
+    dag_yaml_path = os.path.join(state.dag_dir(), f'{job_id}.yaml')
+    with open(dag_yaml_path, 'w', encoding='utf-8') as f:
+        yaml.safe_dump({'name': name, 'tasks': task_configs}, f)
+    state.set_dag_yaml_path(job_id, dag_yaml_path)
+    scheduler.submit_job(job_id)
+    logger.info(f'Managed job {job_id} ({name!r}) submitted.')
+    return job_id
+
+
+@usage_lib.entrypoint(name='jobs.queue')
+def queue() -> List[Dict[str, Any]]:
+    """All managed jobs with aggregate + per-task status."""
+    scheduler.maybe_schedule_next_jobs()
+    out = []
+    for job in state.get_jobs():
+        tasks = state.get_tasks(job['job_id'])
+        status = state.get_job_status(job['job_id'])
+        recoveries = sum(t['recovery_count'] for t in tasks)
+        duration = sum(t['job_duration'] for t in tasks)
+        for t in tasks:
+            if t['last_recovered_at'] and t['last_recovered_at'] > 0 and \
+                    state.ManagedJobStatus(t['status']) == \
+                    state.ManagedJobStatus.RUNNING:
+                duration += time.time() - t['last_recovered_at']
+        out.append({
+            'job_id': job['job_id'],
+            'name': job['name'],
+            'submitted_at': job['submitted_at'],
+            'status': status.value if status else None,
+            'schedule_state': job['schedule_state'],
+            'recovery_count': recoveries,
+            'job_duration': duration,
+            'tasks': tasks,
+        })
+    return out
+
+
+@usage_lib.entrypoint(name='jobs.cancel')
+def cancel(job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    """Request cancellation; the controller tears the task cluster down."""
+    if all_jobs:
+        job_ids = [
+            j['job_id'] for j in state.get_jobs()
+            if (state.get_job_status(j['job_id']) or
+                state.ManagedJobStatus.PENDING).is_terminal() is False
+        ]
+    cancelled = []
+    for jid in job_ids or []:
+        status = state.get_job_status(jid)
+        if status is None or status.is_terminal():
+            continue
+        state.set_cancelling(jid)
+        cancelled.append(jid)
+    return cancelled
+
+
+@usage_lib.entrypoint(name='jobs.tail_logs')
+def tail_logs(job_id: Optional[int] = None,
+              follow: bool = True,
+              controller: bool = False) -> int:
+    """Follow the controller log (controller=True) or the task run log."""
+    if job_id is None:
+        jobs = state.get_jobs()
+        if not jobs:
+            raise exceptions.JobNotFoundError('No managed jobs.')
+        job_id = jobs[0]['job_id']
+    if controller:
+        path = state.controller_log_path(job_id)
+        return _tail_file(path, follow)
+    # Find the active task's cluster and tail its latest job log.
+    from skypilot_tpu import global_state
+    from skypilot_tpu.backends import gang_backend
+    for t in state.get_tasks(job_id):
+        st = state.ManagedJobStatus(t['status'])
+        if st.is_terminal() or t['cluster_name'] is None:
+            continue
+        record = global_state.get_cluster_from_name(t['cluster_name'])
+        if record is None:
+            continue
+        backend = gang_backend.TpuGangBackend()
+        return backend.tail_logs(record['handle'], job_id=None,
+                                 follow=follow)
+    # Fall back to the controller log (job finished or not yet launched).
+    return _tail_file(state.controller_log_path(job_id), follow)
+
+
+def _tail_file(path: str, follow: bool) -> int:
+    if not os.path.exists(path):
+        logger.info(f'No log at {path} yet.')
+        return 1
+    cmd = ['tail', '-n', '+1']
+    if follow:
+        cmd.append('-f')
+    cmd.append(path)
+    return subprocess.run(cmd, check=False).returncode
+
+
+def format_job_queue(jobs: List[Dict[str, Any]]) -> str:
+    header = ('ID', 'NAME', 'STATUS', 'DURATION', '#RECOVERIES',
+              'SUBMITTED')
+    rows = []
+    for j in jobs:
+        rows.append(
+            (str(j['job_id']), j['name'] or '-', j['status'] or '-',
+             f"{j['job_duration']:.0f}s", str(j['recovery_count']),
+             time.strftime('%m-%d %H:%M',
+                           time.localtime(j['submitted_at']))))
+    widths = [
+        max(len(header[i]), *(len(r[i]) for r in rows)) if rows else
+        len(header[i]) for i in range(len(header))
+    ]
+    lines = ['  '.join(h.ljust(widths[i]) for i, h in enumerate(header))]
+    for r in rows:
+        lines.append('  '.join(c.ljust(widths[i]) for i, c in enumerate(r)))
+    return '\n'.join(lines)

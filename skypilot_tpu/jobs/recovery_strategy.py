@@ -1,0 +1,264 @@
+"""Recovery strategies for managed jobs.
+
+Parity: ``sky/jobs/recovery_strategy.py`` (StrategyExecutor:45, launch,
+FailoverStrategyExecutor:382 recover:414, EagerNextRegionStrategyExecutor:466)
+— FAILOVER retries the same region the job last ran in before falling back
+to the optimizer's full candidate list; EAGER_NEXT_REGION moves on
+immediately (the right default for TPU stockouts, which are zonal and
+sticky). Strategies are looked up by name in
+``JOBS_RECOVERY_STRATEGY_REGISTRY``.
+"""
+import time
+import traceback
+import typing
+from typing import Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu.skylet import job_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.backends import gang_backend
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_RECOVERY_STRATEGY = 'EAGER_NEXT_REGION'
+MAX_JOB_CHECKING_RETRY = 5
+# Backoff between failed full-candidate-list launch sweeps.
+RETRY_INIT_GAP_SECONDS = 10
+
+
+class StrategyExecutor:
+    """Launch/monitor/recover one task's cluster (parity: :45)."""
+
+    RETRY_INIT_GAP_SECONDS = RETRY_INIT_GAP_SECONDS
+
+    def __init__(self, cluster_name: str, task: 'task_lib.Task',
+                 max_restarts_on_errors: int = 0):
+        self.cluster_name = cluster_name
+        self.task = task
+        self.max_restarts_on_errors = max_restarts_on_errors
+        self.restart_cnt_on_failure = 0
+
+    @classmethod
+    def make(cls, cluster_name: str, task: 'task_lib.Task'
+             ) -> 'StrategyExecutor':
+        """Pick the strategy from the task's resources (parity: make)."""
+        strategy_name = None
+        max_restarts = 0
+        for res in task.resources:
+            if res.job_recovery is not None:
+                strategy_name = res.job_recovery.get('strategy')
+                max_restarts = res.job_recovery.get(
+                    'max_restarts_on_errors', 0)
+                break
+        strategy_name = strategy_name or DEFAULT_RECOVERY_STRATEGY
+        strategy_cls = registry.JOBS_RECOVERY_STRATEGY_REGISTRY.from_str(
+            strategy_name)
+        return strategy_cls(cluster_name, task, max_restarts)
+
+    # ------------------------------------------------------------- backend
+
+    def _backend(self) -> 'gang_backend.TpuGangBackend':
+        from skypilot_tpu.backends import gang_backend
+        return gang_backend.TpuGangBackend()
+
+    def cluster_handle(self) -> Optional['gang_backend.ClusterHandle']:
+        record = global_state.get_cluster_from_name(self.cluster_name)
+        if record is None:
+            return None
+        return record['handle']
+
+    def job_status(self) -> Optional[job_lib.JobStatus]:
+        """Poll the task job's status; None ⇒ cluster unreachable/preempted.
+
+        Parity: the controller's `_run_one_task` polling, which treats any
+        failure to reach the cluster as a preemption signal.
+        """
+        handle = self.cluster_handle()
+        if handle is None:
+            return None
+        for _ in range(MAX_JOB_CHECKING_RETRY):
+            try:
+                return self._backend().get_job_status(handle, job_id=None) \
+                    or self._latest_job_status(handle)
+            except Exception:  # pylint: disable=broad-except
+                time.sleep(1)
+        return None
+
+    def _latest_job_status(self, handle) -> Optional[job_lib.JobStatus]:
+        jobs = self._backend().get_job_queue(handle)
+        if not jobs:
+            return None
+        latest = max(jobs, key=lambda j: j['job_id'])
+        return job_lib.JobStatus(latest['status'])
+
+    # -------------------------------------------------------------- launch
+
+    def launch(self) -> float:
+        """First launch. Returns the submit timestamp.
+
+        Parity: StrategyExecutor.launch — raise on definitive failure so the
+        controller can mark FAILED_PRECHECKS/FAILED_NO_RESOURCE.
+        """
+        submitted = self._launch(raise_on_failure=True)
+        assert submitted is not None
+        return submitted
+
+    def recover(self) -> float:
+        raise NotImplementedError
+
+    def _launch(self,
+                max_retry: Optional[int] = 3,
+                raise_on_failure: bool = True,
+                region: Optional[str] = None,
+                zone: Optional[str] = None) -> Optional[float]:
+        """Launch the task cluster with retries; returns submit time.
+
+        Each sweep walks the optimizer's full candidate list (the launch
+        path's own zone-level failover); sweeps are separated by backoff.
+        """
+        from skypilot_tpu import execution
+        retry_cnt = 0
+        backoff = self.RETRY_INIT_GAP_SECONDS
+        task = self.task
+        if region is not None or zone is not None:
+            task = self._pin_task_location(region, zone)
+        while True:
+            retry_cnt += 1
+            try:
+                execution.launch(task,
+                                 cluster_name=self.cluster_name,
+                                 detach_run=True,
+                                 stream_logs=False)
+                return time.time()
+            except exceptions.ResourcesUnavailableError as e:
+                # Everything in the candidate list failed this sweep.
+                logger.info(f'Launch attempt {retry_cnt} found no capacity: '
+                            f'{e}')
+            except (exceptions.InvalidSkyError,
+                    exceptions.NoCloudAccessError) as e:
+                # Precheck-style failures never resolve by retrying.
+                if raise_on_failure:
+                    raise
+                logger.error(f'Launch precheck failed: {e}')
+                return None
+            except Exception as e:  # pylint: disable=broad-except
+                logger.error('Unexpected launch failure: '
+                             f'{traceback.format_exc()}')
+                if raise_on_failure:
+                    raise exceptions.ResourcesUnavailableError(
+                        f'Failed to launch the task cluster: {e}') from e
+                return None
+            if max_retry is not None and retry_cnt >= max_retry:
+                if raise_on_failure:
+                    raise exceptions.ResourcesUnavailableError(
+                        'Failed to launch the task cluster after '
+                        f'{max_retry} sweeps of all candidate zones.')
+                return None
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 300)
+
+    def _pin_task_location(self, region: Optional[str],
+                           zone: Optional[str]) -> 'task_lib.Task':
+        """Copy of the task with resources pinned to (region, zone)."""
+        import copy
+        task = copy.copy(self.task)
+        task.set_resources({
+            r.copy(region=region, zone=zone) for r in self.task.resources
+        })
+        return task
+
+    def cleanup_cluster(self) -> None:
+        """Terminate the task cluster, tolerating already-gone."""
+        handle = self.cluster_handle()
+        if handle is None:
+            return
+        try:
+            self._backend().teardown(handle, terminate=True, purge=True)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'cleanup: {e}')
+
+    def cancel_job(self) -> None:
+        handle = self.cluster_handle()
+        if handle is None:
+            return
+        try:
+            self._backend().cancel_jobs(handle, job_ids=None,
+                                        cancel_all=True)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'cancel: {e}')
+
+    def terminate_and_relaunch(self, region: Optional[str] = None,
+                               zone: Optional[str] = None,
+                               max_retry: Optional[int] = None
+                               ) -> Optional[float]:
+        self.cleanup_cluster()
+        return self._launch(max_retry=max_retry, raise_on_failure=False,
+                            region=region, zone=zone)
+
+
+class FailoverStrategyExecutor(StrategyExecutor):
+    """Retry the last-good region first, then anywhere.
+
+    Parity: FailoverStrategyExecutor (recovery_strategy.py:382,414).
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._last_region: Optional[str] = None
+        self._last_zone: Optional[str] = None
+
+    def launch(self) -> float:
+        t = super().launch()
+        self._remember_location()
+        return t
+
+    def _remember_location(self) -> None:
+        handle = self.cluster_handle()
+        if handle is not None:
+            res = handle.launched_resources
+            self._last_region = res.region
+            self._last_zone = res.zone
+
+    def recover(self) -> float:
+        # 1) Same region/zone the job last ran in (data/cache locality).
+        if self._last_region is not None:
+            submitted = self.terminate_and_relaunch(
+                region=self._last_region, zone=self._last_zone, max_retry=1)
+            if submitted is not None:
+                return submitted
+        # 2) Anywhere, retrying until capacity appears.
+        while True:
+            submitted = self.terminate_and_relaunch(max_retry=3)
+            if submitted is not None:
+                self._remember_location()
+                return submitted
+            logger.info('Recovery sweep failed; backing off.')
+            time.sleep(self.RETRY_INIT_GAP_SECONDS)
+
+
+class EagerNextRegionStrategyExecutor(StrategyExecutor):
+    """Immediately move to the next region on preemption.
+
+    Parity: EagerNextRegionStrategyExecutor (recovery_strategy.py:466). TPU
+    stockouts are zonal and sticky, so not retrying the preempting zone
+    first is usually faster.
+    """
+
+    def recover(self) -> float:
+        while True:
+            submitted = self.terminate_and_relaunch(max_retry=3)
+            if submitted is not None:
+                return submitted
+            logger.info('Recovery sweep failed; backing off.')
+            time.sleep(self.RETRY_INIT_GAP_SECONDS)
+
+
+registry.JOBS_RECOVERY_STRATEGY_REGISTRY.register_value(
+    'FAILOVER', FailoverStrategyExecutor)
+registry.JOBS_RECOVERY_STRATEGY_REGISTRY.register_value(
+    'EAGER_NEXT_REGION', EagerNextRegionStrategyExecutor)

@@ -1,0 +1,127 @@
+"""Controller-process scheduler for managed jobs.
+
+Parity: ``sky/jobs/scheduler.py`` (:86 maybe_schedule_next_jobs, :193
+submit_job, :275 parallelism caps) — WAITING jobs become detached controller
+processes, capped by CPU count so a burst of submissions cannot fork-bomb
+the controller host. All transitions happen under one file lock.
+"""
+import os
+import subprocess
+import sys
+from typing import Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.jobs import state
+from skypilot_tpu.utils import locks
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _max_parallel_jobs() -> int:
+    env = os.environ.get('SKYTPU_JOBS_MAX_PARALLEL')
+    if env:
+        return int(env)
+    # Parity: _get_job_parallelism — bounded by controller host CPU/memory.
+    return max(4, (os.cpu_count() or 4))
+
+
+def _lock() -> locks.FileLock:
+    return locks.FileLock(
+        os.path.join(os.path.expanduser('~'), '.skytpu',
+                     'managed_jobs_scheduler.lock'), timeout=30)
+
+
+def submit_job(job_id: int) -> None:
+    """WAITING job enters the queue; schedule immediately if a slot is free.
+
+    Parity: scheduler.submit_job:193.
+    """
+    maybe_schedule_next_jobs()
+    del job_id
+
+
+def maybe_schedule_next_jobs() -> None:
+    """Spawn controllers for WAITING jobs while below the parallelism cap.
+
+    Parity: maybe_schedule_next_jobs:86.
+    """
+    with _lock():
+        _reconcile_dead_controllers()
+        alive = (
+            state.get_jobs_in_schedule_state(
+                state.ManagedJobScheduleState.LAUNCHING) +
+            state.get_jobs_in_schedule_state(
+                state.ManagedJobScheduleState.ALIVE))
+        slots = _max_parallel_jobs() - len(alive)
+        if slots <= 0:
+            return
+        waiting = state.get_jobs_in_schedule_state(
+            state.ManagedJobScheduleState.WAITING)
+        for job in waiting[:slots]:
+            _spawn_controller(job['job_id'], job['dag_yaml_path'])
+
+
+def _spawn_controller(job_id: int, dag_yaml_path: str) -> None:
+    state.set_schedule_state(job_id,
+                             state.ManagedJobScheduleState.LAUNCHING)
+    import skypilot_tpu
+    pkg_root = os.path.dirname(os.path.dirname(skypilot_tpu.__file__))
+    env = dict(os.environ)
+    env['PYTHONPATH'] = pkg_root + (
+        os.pathsep + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
+    log_path = state.controller_log_path(job_id)
+    with open(log_path, 'ab') as log_f:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+             '--job-id', str(job_id), '--dag-yaml', dag_yaml_path],
+            stdout=log_f,
+            stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL,
+            env=env,
+            start_new_session=True)
+    state.set_controller_pid(job_id, proc.pid)
+    state.set_schedule_state(job_id, state.ManagedJobScheduleState.ALIVE)
+    logger.info(f'Managed job {job_id}: controller pid {proc.pid}.')
+
+
+def job_done(job_id: int) -> None:
+    """Controller exit hook: free the slot, pull in the next WAITING job."""
+    state.set_schedule_state(job_id, state.ManagedJobScheduleState.DONE)
+    maybe_schedule_next_jobs()
+
+
+def _reconcile_dead_controllers() -> None:
+    """ALIVE jobs whose controller died without finishing → FAILED_CONTROLLER.
+
+    Parity: skylet ManagedJobEvent reconciliation (sky/skylet/events.py:73).
+    """
+    for job in state.get_jobs_in_schedule_state(
+            state.ManagedJobScheduleState.ALIVE):
+        pid = job['controller_pid']
+        if pid is None or _pid_alive(pid):
+            continue
+        status = state.get_job_status(job['job_id'])
+        if status is not None and not status.is_terminal():
+            for t in state.get_tasks(job['job_id']):
+                if not state.ManagedJobStatus(t['status']).is_terminal():
+                    state.set_failed(
+                        job['job_id'], t['task_id'],
+                        state.ManagedJobStatus.FAILED_CONTROLLER,
+                        'Controller process died unexpectedly.')
+        state.set_schedule_state(job['job_id'],
+                                 state.ManagedJobScheduleState.DONE)
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def controller_pid(job_id: int) -> Optional[int]:
+    job = state.get_job(job_id)
+    return job['controller_pid'] if job else None

@@ -1,0 +1,290 @@
+"""sqlite state for managed jobs (controller-side).
+
+Parity: ``sky/jobs/state.py`` (spot table :196, ManagedJobStatus :323,
+transition setters :383-680) plus the scheduler's ManagedJobScheduleState.
+One row per (job, task); pipelines are jobs with multiple task rows executed
+sequentially.
+"""
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+_TABLES = """
+    CREATE TABLE IF NOT EXISTS jobs (
+        job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+        name TEXT,
+        submitted_at REAL,
+        schedule_state TEXT,
+        controller_pid INTEGER DEFAULT NULL,
+        dag_yaml_path TEXT,
+        cancel_requested INTEGER DEFAULT 0
+    );
+    CREATE TABLE IF NOT EXISTS tasks (
+        job_id INTEGER,
+        task_id INTEGER,
+        task_name TEXT,
+        resources TEXT,
+        status TEXT,
+        submitted_at REAL,
+        start_at REAL DEFAULT NULL,
+        end_at REAL DEFAULT NULL,
+        last_recovered_at REAL DEFAULT -1,
+        recovery_count INTEGER DEFAULT 0,
+        job_duration REAL DEFAULT 0,
+        failure_reason TEXT,
+        cluster_name TEXT,
+        run_timestamp TEXT,
+        PRIMARY KEY (job_id, task_id)
+    );
+"""
+
+
+def db_path() -> str:
+    return os.path.join(os.path.expanduser('~'), '.skytpu',
+                        'managed_jobs.db')
+
+
+def dag_dir() -> str:
+    return os.path.join(os.path.expanduser('~'), '.skytpu', 'managed_jobs',
+                        'dags')
+
+
+def controller_log_path(job_id: int) -> str:
+    d = os.path.join(os.path.expanduser('~'), '.skytpu', 'managed_jobs',
+                     'logs')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f'{job_id}.log')
+
+
+def _db() -> sqlite3.Connection:
+    path = db_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=30)
+    conn.row_factory = sqlite3.Row
+    conn.executescript(_TABLES)
+    return conn
+
+
+class ManagedJobStatus(enum.Enum):
+    """Parity: sky/jobs/state.py:323 ManagedJobStatus."""
+    PENDING = 'PENDING'
+    SUBMITTED = 'SUBMITTED'
+    STARTING = 'STARTING'
+    RUNNING = 'RUNNING'
+    RECOVERING = 'RECOVERING'
+    CANCELLING = 'CANCELLING'
+    SUCCEEDED = 'SUCCEEDED'
+    CANCELLED = 'CANCELLED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_PRECHECKS = 'FAILED_PRECHECKS'
+    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'
+    FAILED_CONTROLLER = 'FAILED_CONTROLLER'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    def is_failed(self) -> bool:
+        return self in _FAILED
+
+
+_FAILED = {
+    ManagedJobStatus.FAILED, ManagedJobStatus.FAILED_SETUP,
+    ManagedJobStatus.FAILED_PRECHECKS, ManagedJobStatus.FAILED_NO_RESOURCE,
+    ManagedJobStatus.FAILED_CONTROLLER
+}
+_TERMINAL = _FAILED | {
+    ManagedJobStatus.SUCCEEDED, ManagedJobStatus.CANCELLED
+}
+
+
+class ManagedJobScheduleState(enum.Enum):
+    """Controller-process lifecycle (parity: ManagedJobScheduleState)."""
+    WAITING = 'WAITING'
+    LAUNCHING = 'LAUNCHING'
+    ALIVE = 'ALIVE'
+    DONE = 'DONE'
+
+
+# ------------------------------------------------------------------- rows
+
+
+def create_job(name: Optional[str], dag_yaml_path: str,
+               task_specs: List[Dict[str, Any]]) -> int:
+    """Insert job + one PENDING task row per pipeline stage."""
+    with _db() as conn:
+        cur = conn.execute(
+            'INSERT INTO jobs (name, submitted_at, schedule_state, '
+            'dag_yaml_path) VALUES (?,?,?,?)',
+            (name, time.time(), ManagedJobScheduleState.WAITING.value,
+             dag_yaml_path))
+        job_id = cur.lastrowid
+        for task_id, spec in enumerate(task_specs):
+            conn.execute(
+                'INSERT INTO tasks (job_id, task_id, task_name, resources, '
+                'status, submitted_at) VALUES (?,?,?,?,?,?)',
+                (job_id, task_id, spec.get('name'),
+                 json.dumps(spec.get('resources')),
+                 ManagedJobStatus.PENDING.value, time.time()))
+    return job_id
+
+
+def set_dag_yaml_path(job_id: int, path: str) -> None:
+    with _db() as conn:
+        conn.execute('UPDATE jobs SET dag_yaml_path=? WHERE job_id=?',
+                     (path, job_id))
+
+
+def get_job(job_id: int) -> Optional[Dict[str, Any]]:
+    with _db() as conn:
+        row = conn.execute('SELECT * FROM jobs WHERE job_id=?',
+                           (job_id,)).fetchone()
+    return dict(row) if row else None
+
+
+def get_jobs() -> List[Dict[str, Any]]:
+    with _db() as conn:
+        rows = conn.execute(
+            'SELECT * FROM jobs ORDER BY job_id DESC').fetchall()
+    return [dict(r) for r in rows]
+
+
+def get_tasks(job_id: int) -> List[Dict[str, Any]]:
+    with _db() as conn:
+        rows = conn.execute(
+            'SELECT * FROM tasks WHERE job_id=? ORDER BY task_id',
+            (job_id,)).fetchall()
+    return [dict(r) for r in rows]
+
+
+def get_task(job_id: int, task_id: int) -> Optional[Dict[str, Any]]:
+    with _db() as conn:
+        row = conn.execute(
+            'SELECT * FROM tasks WHERE job_id=? AND task_id=?',
+            (job_id, task_id)).fetchone()
+    return dict(row) if row else None
+
+
+def get_job_status(job_id: int) -> Optional[ManagedJobStatus]:
+    """Aggregate status: the first non-SUCCEEDED task's status, else
+    SUCCEEDED (pipelines run sequentially, so at most one task is active)."""
+    tasks = get_tasks(job_id)
+    if not tasks:
+        return None
+    for t in tasks:
+        st = ManagedJobStatus(t['status'])
+        if st != ManagedJobStatus.SUCCEEDED:
+            return st
+    return ManagedJobStatus.SUCCEEDED
+
+
+# -------------------------------------------------------- task transitions
+
+
+def _set(job_id: int, task_id: int, **fields: Any) -> None:
+    cols = ', '.join(f'{k}=?' for k in fields)
+    with _db() as conn:
+        conn.execute(f'UPDATE tasks SET {cols} WHERE job_id=? AND task_id=?',
+                     (*fields.values(), job_id, task_id))
+
+
+def set_submitted(job_id: int, task_id: int, run_timestamp: str,
+                  cluster_name: str) -> None:
+    _set(job_id, task_id, status=ManagedJobStatus.SUBMITTED.value,
+         run_timestamp=run_timestamp, cluster_name=cluster_name)
+
+
+def set_starting(job_id: int, task_id: int) -> None:
+    _set(job_id, task_id, status=ManagedJobStatus.STARTING.value)
+
+
+def set_started(job_id: int, task_id: int, start_time: float) -> None:
+    _set(job_id, task_id, status=ManagedJobStatus.RUNNING.value,
+         start_at=start_time, last_recovered_at=start_time)
+
+
+def set_recovering(job_id: int, task_id: int) -> None:
+    task = get_task(job_id, task_id)
+    assert task is not None
+    # Accumulate healthy runtime before the preemption.
+    duration = task['job_duration']
+    if task['last_recovered_at'] and task['last_recovered_at'] > 0:
+        duration += time.time() - task['last_recovered_at']
+    _set(job_id, task_id, status=ManagedJobStatus.RECOVERING.value,
+         job_duration=duration)
+
+
+def set_recovered(job_id: int, task_id: int, recovered_time: float) -> None:
+    task = get_task(job_id, task_id)
+    assert task is not None
+    _set(job_id, task_id, status=ManagedJobStatus.RUNNING.value,
+         last_recovered_at=recovered_time,
+         recovery_count=task['recovery_count'] + 1)
+
+
+def set_succeeded(job_id: int, task_id: int, end_time: float) -> None:
+    _set(job_id, task_id, status=ManagedJobStatus.SUCCEEDED.value,
+         end_at=end_time)
+
+
+def set_failed(job_id: int, task_id: int, failure_type: ManagedJobStatus,
+               failure_reason: str,
+               end_time: Optional[float] = None) -> None:
+    assert failure_type.is_failed(), failure_type
+    _set(job_id, task_id, status=failure_type.value,
+         failure_reason=failure_reason, end_at=end_time or time.time())
+
+
+def set_cancelling(job_id: int) -> None:
+    """Mark every nonterminal task CANCELLING + raise the cancel flag the
+    controller polls."""
+    with _db() as conn:
+        conn.execute('UPDATE jobs SET cancel_requested=1 WHERE job_id=?',
+                     (job_id,))
+        for t in get_tasks(job_id):
+            if not ManagedJobStatus(t['status']).is_terminal():
+                conn.execute(
+                    'UPDATE tasks SET status=? WHERE job_id=? AND task_id=?',
+                    (ManagedJobStatus.CANCELLING.value, job_id,
+                     t['task_id']))
+
+
+def set_cancelled(job_id: int) -> None:
+    with _db() as conn:
+        conn.execute(
+            'UPDATE tasks SET status=?, end_at=? WHERE job_id=? '
+            'AND status=?',
+            (ManagedJobStatus.CANCELLED.value, time.time(), job_id,
+             ManagedJobStatus.CANCELLING.value))
+
+
+def cancel_requested(job_id: int) -> bool:
+    job = get_job(job_id)
+    return bool(job and job['cancel_requested'])
+
+
+# ---------------------------------------------------------- schedule state
+
+
+def set_schedule_state(job_id: int, st: ManagedJobScheduleState) -> None:
+    with _db() as conn:
+        conn.execute('UPDATE jobs SET schedule_state=? WHERE job_id=?',
+                     (st.value, job_id))
+
+
+def set_controller_pid(job_id: int, pid: int) -> None:
+    with _db() as conn:
+        conn.execute('UPDATE jobs SET controller_pid=? WHERE job_id=?',
+                     (pid, job_id))
+
+
+def get_jobs_in_schedule_state(
+        st: ManagedJobScheduleState) -> List[Dict[str, Any]]:
+    with _db() as conn:
+        rows = conn.execute(
+            'SELECT * FROM jobs WHERE schedule_state=? ORDER BY job_id',
+            (st.value,)).fetchall()
+    return [dict(r) for r in rows]

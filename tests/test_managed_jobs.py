@@ -1,0 +1,171 @@
+"""Managed jobs: controller lifecycle, recovery, cancellation — against the
+Local cloud, mirroring the reference's managed-job recovery smoke tier
+(SURVEY §4: preemption is simulated by terminating instances out-of-band).
+"""
+import os
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_state
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import scheduler
+from skypilot_tpu.jobs import state
+
+
+@pytest.fixture(autouse=True)
+def jobs_env(monkeypatch):
+    global_state.set_enabled_clouds(['Local'])
+    monkeypatch.setenv('SKYTPU_JOBS_POLL_SECONDS', '0.5')
+    yield
+
+
+def _wait_status(job_id, target, timeout=90):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        last = state.get_job_status(job_id)
+        if last is not None and last.is_terminal():
+            assert last == target, (
+                f'job {job_id} ended {last}, wanted {target}; controller '
+                f'log:\n{_controller_log(job_id)}')
+            return last
+        time.sleep(0.5)
+    raise TimeoutError(
+        f'job {job_id} stuck at {last}; log:\n{_controller_log(job_id)}')
+
+
+def _controller_log(job_id):
+    path = state.controller_log_path(job_id)
+    if not os.path.exists(path):
+        return '<no controller log>'
+    with open(path, encoding='utf-8') as f:
+        return f.read()[-4000:]
+
+
+def _local_task(name, run, **kwargs):
+    task = sky.Task(name=name, run=run, **kwargs)
+    task.set_resources(sky.Resources(cloud='local'))
+    return task
+
+
+def test_managed_job_success():
+    job_id = sky.jobs.launch(_local_task('ok', 'echo managed-ok'))
+    _wait_status(job_id, state.ManagedJobStatus.SUCCEEDED)
+    # Task cluster is torn down after success.
+    assert sky.status() == []
+    q = sky.jobs.queue()
+    assert q[0]['job_id'] == job_id
+    assert q[0]['status'] == 'SUCCEEDED'
+    assert q[0]['recovery_count'] == 0
+
+
+def test_managed_job_user_failure_no_recovery():
+    job_id = sky.jobs.launch(_local_task('bad', 'exit 3'))
+    _wait_status(job_id, state.ManagedJobStatus.FAILED)
+    task = state.get_task(job_id, 0)
+    assert task['recovery_count'] == 0
+    assert sky.status() == []
+
+
+def test_managed_job_restarts_on_user_failure_budget(tmp_path):
+    # First run fails, second (restarted) run succeeds.
+    marker = tmp_path / 'restart_marker'
+    task = sky.Task(
+        name='flaky',
+        run=f'if [ -f {marker} ]; then exit 0; else touch {marker}; '
+            'exit 1; fi')
+    task.set_resources(
+        sky.Resources(cloud='local',
+                      job_recovery={'strategy': 'FAILOVER',
+                                    'max_restarts_on_errors': 2}))
+    job_id = sky.jobs.launch(task)
+    _wait_status(job_id, state.ManagedJobStatus.SUCCEEDED)
+    assert state.get_task(job_id, 0)['recovery_count'] == 1
+
+
+def test_managed_job_recovers_from_preemption(tmp_path):
+    marker = tmp_path / 'preempt_marker'
+    # Run 1: creates marker then sleeps (gets preempted). Run 2: sees the
+    # marker and exits 0.
+    task = _local_task(
+        'preemptee',
+        f'if [ -f {marker} ]; then echo recovered; exit 0; fi; '
+        f'touch {marker}; sleep 120')
+    job_id = sky.jobs.launch(task)
+
+    # Wait until the first run is RUNNING and has dropped the marker.
+    deadline = time.time() + 60
+    while time.time() < deadline and not marker.exists():
+        time.sleep(0.5)
+    assert marker.exists(), _controller_log(job_id)
+
+    # Preempt: terminate the task cluster out-of-band.
+    cluster = state.get_task(job_id, 0)['cluster_name']
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if global_state.get_cluster_from_name(cluster) is not None:
+            break
+        time.sleep(0.5)
+    sky.down(cluster)
+
+    _wait_status(job_id, state.ManagedJobStatus.SUCCEEDED, timeout=120)
+    assert state.get_task(job_id, 0)['recovery_count'] == 1
+    assert sky.status() == []
+
+
+def test_managed_pipeline_sequential(tmp_path):
+    log = tmp_path / 'order.log'
+    dag = sky.Dag()
+    dag.name = 'pipe'
+    for i in range(2):
+        t = _local_task(f'stage{i}', f'echo stage{i} >> {log}')
+        dag.add(t)
+    job_id = sky.jobs.launch(dag)
+    _wait_status(job_id, state.ManagedJobStatus.SUCCEEDED, timeout=120)
+    assert log.read_text().splitlines() == ['stage0', 'stage1']
+    tasks = state.get_tasks(job_id)
+    assert [t['status'] for t in tasks] == ['SUCCEEDED', 'SUCCEEDED']
+
+
+def test_managed_job_cancel():
+    job_id = sky.jobs.launch(_local_task('sleepy', 'sleep 300'))
+    # Wait for RUNNING, then cancel.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        st = state.get_job_status(job_id)
+        if st == state.ManagedJobStatus.RUNNING:
+            break
+        time.sleep(0.5)
+    assert sky.jobs.cancel([job_id]) == [job_id]
+    _wait_status(job_id, state.ManagedJobStatus.CANCELLED)
+    # Task cluster torn down by the controller.
+    deadline = time.time() + 30
+    while time.time() < deadline and sky.status():
+        time.sleep(0.5)
+    assert sky.status() == []
+
+
+def test_strategy_selection():
+    t = sky.Task(run='x')
+    t.set_resources(sky.Resources(cloud='local', job_recovery='failover'))
+    s = recovery_strategy.StrategyExecutor.make('c', t)
+    assert isinstance(s, recovery_strategy.FailoverStrategyExecutor)
+    t2 = sky.Task(run='x')
+    t2.set_resources(sky.Resources(cloud='local'))
+    s2 = recovery_strategy.StrategyExecutor.make('c', t2)
+    assert isinstance(s2,
+                      recovery_strategy.EagerNextRegionStrategyExecutor)
+
+
+def test_scheduler_reconciles_dead_controller():
+    job_id = state.create_job('ghost', 'x.yaml', [{'name': 'g',
+                                                   'resources': ''}])
+    state.set_schedule_state(job_id, state.ManagedJobScheduleState.ALIVE)
+    state.set_controller_pid(job_id, 2 ** 30)  # definitely dead
+    state.set_starting(job_id, 0)
+    scheduler.maybe_schedule_next_jobs()
+    assert state.get_job_status(job_id) == \
+        state.ManagedJobStatus.FAILED_CONTROLLER
+    assert state.get_job(job_id)['schedule_state'] == 'DONE'
