@@ -23,6 +23,11 @@ def get_mounting_script(mount_path: str, mount_cmd: str,
     script = [
         'set -e',
         f'MOUNT_PATH={shlex.quote(mount_path)}',
+        # /proc/mounts records absolute paths; resolve relative mount
+        # destinations (e.g. stripped '~/ckpt') against the remote cwd
+        # ($HOME for SSH sessions) so the already-mounted check matches.
+        'case "$MOUNT_PATH" in /*) ;; *) MOUNT_PATH="$PWD/$MOUNT_PATH";; '
+        'esac',
         'if grep -q " $MOUNT_PATH " /proc/mounts 2>/dev/null; then',
         '  echo "already mounted: $MOUNT_PATH"; exit 0',
         'fi',
@@ -69,7 +74,12 @@ def get_local_mount_script(bucket_dir: str, mount_path: str) -> str:
         f'mkdir -p {b}',
         f'mkdir -p $(dirname {m})',
         f'if [ -L {m} ]; then rm {m}; fi',
-        f'if [ -d {m} ] && [ ! -L {m} ]; then rmdir {m} 2>/dev/null || true; fi',
+        # Pre-existing real directory: fold its contents into the bucket
+        # so the symlink can take its place — otherwise ln -sfn would drop
+        # the link INSIDE the dir and writes would silently miss the
+        # bucket. -n: the bucket is authoritative; never clobber a bucket
+        # file with a stale local copy (gcsfuse shadows, it never pushes).
+        f'if [ -d {m} ]; then cp -an {m}/. {b}/ && rm -rf {m}; fi',
         f'ln -sfn {b} {m}',
         f'echo "mounted: {m}"',
     ])

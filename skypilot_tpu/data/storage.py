@@ -138,9 +138,19 @@ class GcsStore(AbstractStore):
             # rsync requires directory args; single files go via cp.
             self._gsutil('cp', src, f'gs://{self.name}/')
             return
-        excludes = storage_utils.get_excluded_files(src)
+        excludes, reincludes = storage_utils.split_negations(
+            storage_utils.get_excluded_files(src))
         args = ['-m', 'rsync', '-r']
-        if excludes:
+        if reincludes:
+            # gitignore '!' re-includes cannot be expressed with pattern
+            # alternation; exclude the exact resolved file set instead
+            # (same walker the LocalStore uses, so bucket contents match
+            # across stores — and nested paths keep their keys).
+            excluded = storage_utils.list_excluded_files(src)
+            if excluded:
+                args += ['-x', '|'.join(
+                    '^' + re.escape(rel) + '$' for rel in excluded)]
+        elif excludes:
             # gsutil honors a single -x regex; alternation joins patterns.
             regex = '|'.join(
                 pat.replace('.', r'\.').replace('*', '.*')
@@ -238,7 +248,18 @@ class Storage:
         if name is None and source is None:
             raise exceptions.StorageSpecError(
                 'Storage requires a name and/or source.')
-        if name is None:
+        if source is not None and source.startswith(('gs://', 'local://')):
+            # The URI already names the bucket; a different `name` would
+            # silently create a second, empty bucket (parity: the
+            # reference rejects name+URI-source combos).
+            _, bucket, _ = storage_utils.split_bucket_uri(source)
+            if name is not None and name != bucket:
+                raise exceptions.StorageSpecError(
+                    f'Storage name {name!r} conflicts with bucket URI '
+                    f'source {source!r}; drop `name` when `source` is a '
+                    'bucket URI.')
+            name = bucket
+        elif name is None:
             assert source is not None
             name = os.path.basename(os.path.abspath(
                 os.path.expanduser(source))).lower().replace('_', '-')
@@ -301,7 +322,9 @@ class Storage:
         targets = ([store_type] if store_type is not None else
                    list(self.stores))
         for st in targets:
-            self.stores.pop(st).delete()
+            store = self.stores.pop(st, None)
+            if store is not None:
+                store.delete()
         if not self.stores:
             global_state.remove_storage(self.name)
 
@@ -321,17 +344,6 @@ class Storage:
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'Storage':
         name = config.get('name')
         source = config.get('source')
-        if source is not None and source.startswith('gs://'):
-            _, bucket, _ = storage_utils.split_bucket_uri(source)
-            if name is not None and name != bucket:
-                # Parity: the reference rejects name+URI-source combos —
-                # the URI already names the bucket; a second name would
-                # silently create a different, empty bucket.
-                raise exceptions.StorageSpecError(
-                    f'Storage name {name!r} conflicts with bucket URI '
-                    f'source {source!r}; drop `name` when `source` is a '
-                    'bucket URI.')
-            name = bucket
         mode = StorageMode(config.get('mode', 'MOUNT').upper())
         stores = None
         if config.get('store') is not None:

@@ -34,11 +34,29 @@ def get_excluded_files(src_dir: str) -> List[str]:
     return patterns
 
 
-def _split_negations(patterns: List[str]) -> Tuple[List[str], List[str]]:
+def split_negations(patterns: List[str]) -> Tuple[List[str], List[str]]:
     """gitignore '!pattern' lines re-include files a prior rule excluded."""
     excludes = [p for p in patterns if not p.startswith('!')]
     reincludes = [p[1:] for p in patterns if p.startswith('!')]
     return excludes, reincludes
+
+
+def list_excluded_files(src_dir: str) -> List[str]:
+    """Relative paths of every file under ``src_dir`` that the ignore rules
+    (incl. '!' re-includes) exclude from upload — the exact complement of
+    ``list_files_to_upload``."""
+    src_dir = os.path.expanduser(src_dir)
+    excludes, reincludes = split_negations(get_excluded_files(src_dir))
+    out: List[str] = []
+    for root, _, files in os.walk(src_dir):
+        rel_root = os.path.relpath(root, src_dir)
+        if rel_root == '.':
+            rel_root = ''
+        for name in files:
+            rel = os.path.join(rel_root, name) if rel_root else name
+            if _excluded(rel, excludes) and not _excluded(rel, reincludes):
+                out.append(rel)
+    return out
 
 
 def _excluded(rel_path: str, patterns: List[str]) -> bool:
@@ -55,7 +73,7 @@ def _excluded(rel_path: str, patterns: List[str]) -> bool:
 def list_files_to_upload(src_dir: str) -> List[Tuple[str, str]]:
     """(absolute_path, relative_key) for every file to upload."""
     src_dir = os.path.expanduser(src_dir)
-    excludes, reincludes = _split_negations(get_excluded_files(src_dir))
+    excludes, reincludes = split_negations(get_excluded_files(src_dir))
     out: List[Tuple[str, str]] = []
     for root, dirs, files in os.walk(src_dir):
         rel_root = os.path.relpath(root, src_dir)

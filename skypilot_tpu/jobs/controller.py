@@ -62,9 +62,12 @@ class JobsController:
     def _run_one_task(self, task_id: int, task: task_lib.Task) -> bool:
         """Returns True iff the task SUCCEEDED."""
         job_id = self.job_id
+        if state.cancel_requested(job_id):
+            return False
         cluster_name = task_cluster_name(job_id, task_id, task.name)
         strategy = recovery_strategy.StrategyExecutor.make(
             cluster_name, task)
+        strategy.should_abort = lambda: state.cancel_requested(job_id)
         state.set_starting(job_id, task_id)
         logger.info(f'Task {task_id}: launching cluster {cluster_name!r}.')
         try:
@@ -110,6 +113,8 @@ class JobsController:
                         f'{strategy.max_restarts_on_errors}.')
                     state.set_recovering(job_id, task_id)
                     recovered = strategy.recover()
+                    if recovered is None:  # cancelled mid-recovery
+                        continue
                     state.set_recovered(job_id, task_id, recovered)
                     continue
                 failure = (state.ManagedJobStatus.FAILED_SETUP
@@ -132,6 +137,8 @@ class JobsController:
                             ' recovering.')
                 state.set_recovering(job_id, task_id)
                 recovered = strategy.recover()
+                if recovered is None:  # cancelled mid-recovery
+                    continue
                 state.set_recovered(job_id, task_id, recovered)
                 continue
             time.sleep(poll_interval_seconds())

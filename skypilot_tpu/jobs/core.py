@@ -9,6 +9,7 @@ controller log or the task cluster's run log.
 import os
 import subprocess
 import time
+import uuid
 from typing import Any, Dict, List, Optional, Union
 
 import yaml
@@ -48,14 +49,17 @@ def launch(entrypoint: Union[task_lib.Task, dag_lib.Dag],
 
     os.makedirs(state.dag_dir(), exist_ok=True)
     task_configs = [t.to_yaml_config() for t in tasks]
-    job_id = state.create_job(name, dag_yaml_path='', task_specs=[{
-        'name': t.name,
-        'resources': ', '.join(str(r) for r in t.resources),
-    } for t in tasks])
-    dag_yaml_path = os.path.join(state.dag_dir(), f'{job_id}.yaml')
+    # The YAML must exist before the WAITING row does — a concurrent
+    # scheduler tick may spawn the controller the instant the row lands.
+    dag_yaml_path = os.path.join(state.dag_dir(), f'{uuid.uuid4().hex}.yaml')
     with open(dag_yaml_path, 'w', encoding='utf-8') as f:
         yaml.safe_dump({'name': name, 'tasks': task_configs}, f)
-    state.set_dag_yaml_path(job_id, dag_yaml_path)
+    job_id = state.create_job(name, dag_yaml_path=dag_yaml_path,
+                              task_specs=[{
+                                  'name': t.name,
+                                  'resources': ', '.join(
+                                      str(r) for r in t.resources),
+                              } for t in tasks])
     scheduler.submit_job(job_id)
     logger.info(f'Managed job {job_id} ({name!r}) submitted.')
     return job_id

@@ -11,7 +11,7 @@ sticky). Strategies are looked up by name in
 import time
 import traceback
 import typing
-from typing import Optional
+from typing import Callable, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import global_state
@@ -42,6 +42,13 @@ class StrategyExecutor:
         self.task = task
         self.max_restarts_on_errors = max_restarts_on_errors
         self.restart_cnt_on_failure = 0
+        # Set by the controller: returns True when the job was cancelled,
+        # so unbounded recover() loops can bail instead of provisioning a
+        # cluster just to tear it down.
+        self.should_abort: Callable[[], bool] = lambda: False
+
+    def _aborted(self) -> bool:
+        return self.should_abort()
 
     @classmethod
     def make(cls, cluster_name: str, task: 'task_lib.Task'
@@ -83,8 +90,7 @@ class StrategyExecutor:
             return None
         for _ in range(MAX_JOB_CHECKING_RETRY):
             try:
-                return self._backend().get_job_status(handle, job_id=None) \
-                    or self._latest_job_status(handle)
+                return self._latest_job_status(handle)
             except Exception:  # pylint: disable=broad-except
                 time.sleep(1)
         return None
@@ -108,7 +114,8 @@ class StrategyExecutor:
         assert submitted is not None
         return submitted
 
-    def recover(self) -> float:
+    def recover(self) -> Optional[float]:
+        """Re-provision after preemption; None ⇒ aborted (cancel)."""
         raise NotImplementedError
 
     def _launch(self,
@@ -146,18 +153,22 @@ class StrategyExecutor:
                     raise
                 logger.error(f'Launch precheck failed: {e}')
                 return None
-            except Exception as e:  # pylint: disable=broad-except
+            except Exception:  # pylint: disable=broad-except
+                # Not a capacity problem: propagate as-is so the controller
+                # classifies it FAILED_PRECHECKS (with the real traceback),
+                # not FAILED_NO_RESOURCE.
                 logger.error('Unexpected launch failure: '
                              f'{traceback.format_exc()}')
                 if raise_on_failure:
-                    raise exceptions.ResourcesUnavailableError(
-                        f'Failed to launch the task cluster: {e}') from e
+                    raise
                 return None
             if max_retry is not None and retry_cnt >= max_retry:
                 if raise_on_failure:
                     raise exceptions.ResourcesUnavailableError(
                         'Failed to launch the task cluster after '
                         f'{max_retry} sweeps of all candidate zones.')
+                return None
+            if self._aborted():
                 return None
             time.sleep(backoff)
             backoff = min(backoff * 2, 300)
@@ -224,21 +235,22 @@ class FailoverStrategyExecutor(StrategyExecutor):
             self._last_region = res.region
             self._last_zone = res.zone
 
-    def recover(self) -> float:
+    def recover(self) -> Optional[float]:
         # 1) Same region/zone the job last ran in (data/cache locality).
-        if self._last_region is not None:
+        if self._last_region is not None and not self._aborted():
             submitted = self.terminate_and_relaunch(
                 region=self._last_region, zone=self._last_zone, max_retry=1)
             if submitted is not None:
                 return submitted
-        # 2) Anywhere, retrying until capacity appears.
-        while True:
+        # 2) Anywhere, retrying until capacity appears (or cancel).
+        while not self._aborted():
             submitted = self.terminate_and_relaunch(max_retry=3)
             if submitted is not None:
                 self._remember_location()
                 return submitted
             logger.info('Recovery sweep failed; backing off.')
             time.sleep(self.RETRY_INIT_GAP_SECONDS)
+        return None
 
 
 class EagerNextRegionStrategyExecutor(StrategyExecutor):
@@ -249,13 +261,14 @@ class EagerNextRegionStrategyExecutor(StrategyExecutor):
     first is usually faster.
     """
 
-    def recover(self) -> float:
-        while True:
+    def recover(self) -> Optional[float]:
+        while not self._aborted():
             submitted = self.terminate_and_relaunch(max_retry=3)
             if submitted is not None:
                 return submitted
             logger.info('Recovery sweep failed; backing off.')
             time.sleep(self.RETRY_INIT_GAP_SECONDS)
+        return None
 
 
 registry.JOBS_RECOVERY_STRATEGY_REGISTRY.register_value(

@@ -64,21 +64,28 @@ def maybe_schedule_next_jobs() -> None:
 def _spawn_controller(job_id: int, dag_yaml_path: str) -> None:
     state.set_schedule_state(job_id,
                              state.ManagedJobScheduleState.LAUNCHING)
-    import skypilot_tpu
-    pkg_root = os.path.dirname(os.path.dirname(skypilot_tpu.__file__))
-    env = dict(os.environ)
-    env['PYTHONPATH'] = pkg_root + (
-        os.pathsep + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
-    log_path = state.controller_log_path(job_id)
-    with open(log_path, 'ab') as log_f:
-        proc = subprocess.Popen(
-            [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
-             '--job-id', str(job_id), '--dag-yaml', dag_yaml_path],
-            stdout=log_f,
-            stderr=subprocess.STDOUT,
-            stdin=subprocess.DEVNULL,
-            env=env,
-            start_new_session=True)
+    try:
+        import skypilot_tpu
+        pkg_root = os.path.dirname(os.path.dirname(skypilot_tpu.__file__))
+        env = dict(os.environ)
+        env['PYTHONPATH'] = pkg_root + (
+            os.pathsep + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
+        log_path = state.controller_log_path(job_id)
+        with open(log_path, 'ab') as log_f:
+            proc = subprocess.Popen(
+                [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+                 '--job-id', str(job_id), '--dag-yaml', dag_yaml_path],
+                stdout=log_f,
+                stderr=subprocess.STDOUT,
+                stdin=subprocess.DEVNULL,
+                env=env,
+                start_new_session=True)
+    except Exception:
+        # Spawn failed: release the slot so the job can be retried rather
+        # than wedging in LAUNCHING forever.
+        state.set_schedule_state(job_id,
+                                 state.ManagedJobScheduleState.WAITING)
+        raise
     state.set_controller_pid(job_id, proc.pid)
     state.set_schedule_state(job_id, state.ManagedJobScheduleState.ALIVE)
     logger.info(f'Managed job {job_id}: controller pid {proc.pid}.')
@@ -94,7 +101,19 @@ def _reconcile_dead_controllers() -> None:
     """ALIVE jobs whose controller died without finishing → FAILED_CONTROLLER.
 
     Parity: skylet ManagedJobEvent reconciliation (sky/skylet/events.py:73).
+    Also repairs LAUNCHING rows left behind by a crash mid-spawn: we hold
+    the scheduler lock, so no spawn is concurrently in flight — a LAUNCHING
+    row with no live pid is stale and goes back to WAITING.
     """
+    for job in state.get_jobs_in_schedule_state(
+            state.ManagedJobScheduleState.LAUNCHING):
+        pid = job['controller_pid']
+        if pid is not None and _pid_alive(pid):
+            state.set_schedule_state(job['job_id'],
+                                     state.ManagedJobScheduleState.ALIVE)
+        else:
+            state.set_schedule_state(job['job_id'],
+                                     state.ManagedJobScheduleState.WAITING)
     for job in state.get_jobs_in_schedule_state(
             state.ManagedJobScheduleState.ALIVE):
         pid = job['controller_pid']

@@ -112,3 +112,45 @@ def test_gcs_mount_script_shape():
     assert 'gcsfuse' in script
     assert '/checkpoints' in script
     assert 'already mounted' in script
+
+
+def test_python_api_uri_source_infers_name():
+    # Regression: the direct constructor (not just from_yaml_config) must
+    # take the bucket name from a URI source, not basename() of the path.
+    s = storage_lib.Storage(source='gs://my-bucket/data')
+    assert s.name == 'my-bucket'
+    with pytest.raises(exceptions.StorageSpecError):
+        storage_lib.Storage(name='other', source='gs://my-bucket')
+
+
+def test_delete_unattached_store_is_noop():
+    s = storage_lib.Storage(name='no-stores-bucket')
+    s.delete(storage_lib.StoreType.GCS)  # must not raise
+
+
+def test_local_mount_over_nonempty_dir(tmp_path):
+    # Regression: pre-existing non-empty mount dir must be folded into the
+    # bucket, not left as a dir with a stray symlink inside.
+    import subprocess
+    bucket = tmp_path / 'bucket'
+    mnt = tmp_path / 'mnt'
+    mnt.mkdir()
+    (mnt / 'pre.txt').write_text('pre-existing')
+    script = mounting_utils.get_local_mount_script(str(bucket), str(mnt))
+    subprocess.run(['bash', '-c', script], check=True, capture_output=True)
+    assert mnt.is_symlink()
+    assert (bucket / 'pre.txt').read_text() == 'pre-existing'
+    (mnt / 'new.txt').write_text('via-mount')
+    assert (bucket / 'new.txt').read_text() == 'via-mount'
+
+
+def test_gitignore_negation_reincluded(tmp_path):
+    src = tmp_path / 'wd'
+    src.mkdir()
+    (src / 'a.log').write_text('x')
+    (src / 'important.log').write_text('x')
+    (src / '.gitignore').write_text('*.log\n!important.log\n')
+    rels = {rel for _, rel in
+            storage_utils.list_files_to_upload(str(src))}
+    assert 'important.log' in rels
+    assert 'a.log' not in rels
