@@ -42,6 +42,7 @@ _LAZY_ATTRS = {
     'ClusterStatus': ('skypilot_tpu.global_state', 'ClusterStatus'),
     'JobStatus': ('skypilot_tpu.skylet.job_lib', 'JobStatus'),
     'jobs': ('skypilot_tpu.jobs', None),
+    'serve': ('skypilot_tpu.serve', None),
 }
 
 

@@ -168,7 +168,11 @@ class StrategyExecutor:
                         'Failed to launch the task cluster after '
                         f'{max_retry} sweeps of all candidate zones.')
                 return None
-            if self._aborted():
+            if not raise_on_failure and self._aborted():
+                # Cancelled between sweeps. Only the recover() path (which
+                # tolerates None) bails here; the first-launch path keeps
+                # its raise semantics and the controller's poll loop
+                # handles the cancel.
                 return None
             time.sleep(backoff)
             backoff = min(backoff * 2, 300)

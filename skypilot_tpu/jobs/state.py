@@ -12,6 +12,8 @@ import sqlite3
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.utils import db_utils
+
 _TABLES = """
     CREATE TABLE IF NOT EXISTS jobs (
         job_id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -59,13 +61,11 @@ def controller_log_path(job_id: int) -> str:
     return os.path.join(d, f'{job_id}.log')
 
 
+_CONN = db_utils.SqliteConn('managed_jobs', db_path, _TABLES)
+
+
 def _db() -> sqlite3.Connection:
-    path = db_path()
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    conn = sqlite3.connect(path, timeout=30)
-    conn.row_factory = sqlite3.Row
-    conn.executescript(_TABLES)
-    return conn
+    return _CONN.get()
 
 
 class ManagedJobStatus(enum.Enum):

@@ -1,0 +1,21 @@
+"""Autoscaled serving: replica clusters + load balancer + autoscaler.
+
+Parity: ``sky/serve/`` (SURVEY §2.7) — a per-service controller process
+drives a replica manager (each replica is an ordinary ``launch``ed cluster),
+a readiness prober, a request-rate autoscaler with hysteresis, and an HTTP
+load balancer (aiohttp reverse proxy; the reference uses FastAPI+httpx).
+The controller is a detached process colocated with the API server, like
+managed-job controllers.
+"""
+from skypilot_tpu.serve.core import down
+from skypilot_tpu.serve.core import status
+from skypilot_tpu.serve.core import tail_logs
+from skypilot_tpu.serve.core import up
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+__all__ = [
+    'up', 'down', 'status', 'tail_logs', 'SkyServiceSpec', 'ServiceStatus',
+    'ReplicaStatus'
+]

@@ -1,0 +1,102 @@
+"""Autoscalers: request rate → target replica count, with hysteresis.
+
+Parity: ``sky/serve/autoscalers.py`` (Autoscaler:116, RequestRateAutoscaler
+:441, FallbackRequestRateAutoscaler:557) — scale-up requires the over-target
+signal to persist ``upscale_delay`` seconds, scale-down ``downscale_delay``
+(longer, so transient dips don't churn replicas).
+"""
+import os
+import time
+from typing import List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import service_spec as spec_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v else default
+
+
+class Autoscaler:
+    """Base: fixed replica count (no autoscaling)."""
+
+    def __init__(self, spec: spec_lib.SkyServiceSpec):
+        self.spec = spec
+
+    def update_spec(self, spec: spec_lib.SkyServiceSpec) -> None:
+        self.spec = spec
+
+    def evaluate(self, num_alive: int, request_timestamps: List[float]
+                 ) -> int:
+        """→ target number of replicas."""
+        del num_alive, request_timestamps
+        return self.spec.min_replicas
+
+    @classmethod
+    def make(cls, spec: spec_lib.SkyServiceSpec) -> 'Autoscaler':
+        if spec.autoscaling_enabled:
+            return RequestRateAutoscaler(spec)
+        return cls(spec)
+
+
+class RequestRateAutoscaler(Autoscaler):
+    """QPS window → target replicas with upscale/downscale hysteresis.
+
+    Parity: autoscalers.py:441. Delays are env-tunable
+    (SKYTPU_SERVE_UPSCALE_DELAY / _DOWNSCALE_DELAY seconds) so tests can
+    run the full loop fast; reference defaults are 300 s / 1200 s.
+    """
+
+    def __init__(self, spec: spec_lib.SkyServiceSpec):
+        super().__init__(spec)
+        self.qps_window_seconds = _env_float('SKYTPU_SERVE_QPS_WINDOW', 60)
+        self.upscale_delay = _env_float('SKYTPU_SERVE_UPSCALE_DELAY', 300)
+        self.downscale_delay = _env_float('SKYTPU_SERVE_DOWNSCALE_DELAY',
+                                          1200)
+        self._over_since: Optional[float] = None
+        self._under_since: Optional[float] = None
+        self._target = max(spec.min_replicas, 1)
+
+    def current_qps(self, request_timestamps: List[float]) -> float:
+        now = time.time()
+        window = self.qps_window_seconds
+        recent = [t for t in request_timestamps if t > now - window]
+        return len(recent) / window
+
+    def evaluate(self, num_alive: int, request_timestamps: List[float]
+                 ) -> int:
+        spec = self.spec
+        assert spec.target_qps_per_replica is not None
+        qps = self.current_qps(request_timestamps)
+        # Raw demand, bounded by [min, max].
+        import math
+        demand = math.ceil(qps / spec.target_qps_per_replica) if qps else 0
+        demand = min(max(demand, spec.min_replicas),
+                     spec.max_replicas or demand)
+        now = time.time()
+        if demand > self._target:
+            self._under_since = None
+            if self._over_since is None:
+                self._over_since = now
+            elif now - self._over_since >= self.upscale_delay:
+                logger.info(f'Autoscaler: qps={qps:.2f} → upscale '
+                            f'{self._target} → {demand}.')
+                self._target = demand
+                self._over_since = None
+        elif demand < self._target:
+            self._over_since = None
+            if self._under_since is None:
+                self._under_since = now
+            elif now - self._under_since >= self.downscale_delay:
+                logger.info(f'Autoscaler: qps={qps:.2f} → downscale '
+                            f'{self._target} → {demand}.')
+                self._target = demand
+                self._under_since = None
+        else:
+            self._over_since = None
+            self._under_since = None
+        del num_alive
+        return self._target

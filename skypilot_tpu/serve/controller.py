@@ -1,0 +1,101 @@
+"""Per-service controller process: autoscaler + prober + load balancer.
+
+Parity: ``sky/serve/controller.py`` (SkyServeController:36) + ``service.py``
+_start — the reference spawns controller and load-balancer as separate
+processes on a controller VM and syncs them over HTTP; here both run in one
+detached process (LB in a thread), sharing the replica set and request
+timestamps in-proc. Recovery/scaling semantics are unchanged.
+"""
+import argparse
+import os
+import time
+import traceback
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import autoscalers as autoscalers_lib
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import replica_managers
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+
+logger = sky_logging.init_logger(__name__)
+
+
+def controller_interval_seconds() -> float:
+    return float(os.environ.get('SKYTPU_SERVE_CONTROLLER_INTERVAL', '10'))
+
+
+class SkyServeController:
+    """Drives one service until shutdown."""
+
+    def __init__(self, service_name: str):
+        svc = serve_state.get_service(service_name)
+        assert svc is not None, f'service {service_name} not found'
+        self.service_name = service_name
+        self.spec = spec_lib.SkyServiceSpec.from_yaml_config(svc['spec'])
+        self.replica_manager = replica_managers.ReplicaManager(
+            service_name, self.spec, svc['task_yaml_path'])
+        self.autoscaler = autoscalers_lib.Autoscaler.make(self.spec)
+        self.load_balancer = lb_lib.LoadBalancer(
+            svc['lb_port'], self.spec.load_balancing_policy,
+            get_ready_urls=self.replica_manager.ready_urls)
+
+    def run(self) -> None:
+        self.load_balancer.start()
+        serve_state.set_service_status(self.service_name,
+                                       ServiceStatus.REPLICA_INIT)
+        interval = controller_interval_seconds()
+        while True:
+            if serve_state.shutdown_requested(self.service_name):
+                logger.info('Shutdown requested; terminating replicas.')
+                self.replica_manager.terminate_all()
+                serve_state.set_service_status(self.service_name,
+                                               ServiceStatus.SHUTDOWN)
+                break
+            try:
+                self._tick()
+            except Exception:  # pylint: disable=broad-except
+                logger.error(f'Controller tick failed: '
+                             f'{traceback.format_exc()}')
+            time.sleep(interval)
+        self.load_balancer.stop()
+
+    def _tick(self) -> None:
+        rm = self.replica_manager
+        rm.reconcile()
+        target = self.autoscaler.evaluate(
+            len(rm.alive_replicas()),
+            self.load_balancer.snapshot_request_timestamps())
+        rm.scale_to(target)
+        self._update_service_status()
+
+    def _update_service_status(self) -> None:
+        replicas = serve_state.get_replicas(self.service_name)
+        statuses = [r['status'] for r in replicas]
+        if any(s == ReplicaStatus.READY for s in statuses):
+            status = ServiceStatus.READY
+        elif any(s.is_alive() for s in statuses):
+            status = ServiceStatus.REPLICA_INIT
+        elif statuses and all(s == ReplicaStatus.FAILED for s in statuses):
+            status = ServiceStatus.FAILED
+        else:
+            status = ServiceStatus.NO_REPLICA
+        serve_state.set_service_status(self.service_name, status)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--service-name', required=True)
+    args = parser.parse_args()
+    try:
+        SkyServeController(args.service_name).run()
+    except Exception:  # pylint: disable=broad-except
+        logger.error(traceback.format_exc())
+        serve_state.set_service_status(args.service_name,
+                                       ServiceStatus.FAILED)
+        raise
+
+
+if __name__ == '__main__':
+    main()

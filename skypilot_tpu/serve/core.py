@@ -1,0 +1,185 @@
+"""User-facing serve verbs: up/status/down/tail_logs.
+
+Parity: ``sky/serve/`` client surface — ``up`` persists the service task +
+spec and spawns the controller process; ``down`` raises the shutdown flag
+the controller polls; ``status`` reads sqlite state.
+"""
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.usage import usage_lib
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('', 0))
+        return s.getsockname()[1]
+
+
+@usage_lib.entrypoint(name='serve.up')
+def up(task: task_lib.Task,
+       service_name: Optional[str] = None) -> Dict[str, Any]:
+    """Start a service. Returns {'name', 'endpoint'}."""
+    if task.service is None:
+        raise exceptions.InvalidSkyError(
+            'Task has no service: section; add one to use sky serve.')
+    service_name = service_name or task.name
+    if service_name is None:
+        raise exceptions.InvalidSkyError(
+            'Provide a service name (task.name or service_name=).')
+    common_utils.check_cluster_name_is_valid(service_name)
+
+    yaml_path = os.path.join(serve_state.task_yaml_dir(),
+                             f'{service_name}.yaml')
+    lb_port = _free_port()
+    # Claim the name FIRST: a running service's controller re-reads its
+    # task YAML on every replica launch, so the YAML must never be
+    # overwritten before uniqueness is established.
+    if not serve_state.add_service(service_name,
+                                   task.service.to_yaml_config(),
+                                   yaml_path, lb_port):
+        raise exceptions.InvalidSkyError(
+            f'Service {service_name!r} already exists. Run '
+            f'`sky serve down {service_name}` first.')
+    common_utils.dump_yaml(yaml_path, task.to_yaml_config())
+    _spawn_controller(service_name)
+    endpoint = f'http://127.0.0.1:{lb_port}'
+    logger.info(f'Service {service_name!r} starting; endpoint {endpoint}')
+    return {'name': service_name, 'endpoint': endpoint}
+
+
+def _spawn_controller(service_name: str) -> None:
+    import skypilot_tpu
+    pkg_root = os.path.dirname(os.path.dirname(skypilot_tpu.__file__))
+    env = dict(os.environ)
+    env['PYTHONPATH'] = pkg_root + (
+        os.pathsep + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
+    log_path = serve_state.controller_log_path(service_name)
+    with open(log_path, 'ab') as log_f:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.serve.controller',
+             '--service-name', service_name],
+            stdout=log_f,
+            stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL,
+            env=env,
+            start_new_session=True)
+    serve_state.set_service_controller_pid(service_name, proc.pid)
+
+
+@usage_lib.entrypoint(name='serve.status')
+def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
+    services = ([serve_state.get_service(service_name)]
+                if service_name else serve_state.get_services())
+    out = []
+    for svc in services:
+        if svc is None:
+            continue
+        replicas = serve_state.get_replicas(svc['name'])
+        out.append({
+            'name': svc['name'],
+            'status': svc['status'].value,
+            'endpoint': f"http://127.0.0.1:{svc['lb_port']}",
+            'replicas': [{
+                'replica_id': r['replica_id'],
+                'status': r['status'].value,
+                'endpoint': r['endpoint'],
+                'launched_at': r['launched_at'],
+            } for r in replicas],
+        })
+    return out
+
+
+@usage_lib.entrypoint(name='serve.down')
+def down(service_name: str, purge: bool = False) -> None:
+    svc = serve_state.get_service(service_name)
+    if svc is None:
+        raise exceptions.InvalidSkyError(
+            f'Service {service_name!r} does not exist.')
+    serve_state.request_shutdown(service_name)
+    # Wait for the controller to finish teardown, then drop the record.
+    deadline = time.time() + float(
+        os.environ.get('SKYTPU_SERVE_DOWN_TIMEOUT', '300'))
+    while time.time() < deadline:
+        svc = serve_state.get_service(service_name)
+        if svc is None or svc['status'] == serve_state.ServiceStatus.SHUTDOWN:
+            break
+        pid = svc['controller_pid']
+        if pid is not None and not _pid_alive(pid):
+            # Controller died before honoring the flag; clean up directly.
+            _cleanup_orphaned_service(service_name)
+            break
+        time.sleep(0.5)
+    else:
+        if not purge:
+            raise exceptions.ServeUserTerminatedError(
+                f'Timed out waiting for {service_name!r} to shut down; '
+                'rerun with purge=True to force.')
+        # Force path: the controller may merely be stalled — kill it
+        # BEFORE removing the row, or it would wake to a deleted service
+        # and keep launching replicas for it.
+        svc = serve_state.get_service(service_name)
+        if svc is not None and svc['controller_pid'] is not None:
+            _kill_process_tree(svc['controller_pid'])
+        _cleanup_orphaned_service(service_name)
+    serve_state.remove_service(service_name)
+    logger.info(f'Service {service_name!r} torn down.')
+
+
+def _cleanup_orphaned_service(service_name: str) -> None:
+    from skypilot_tpu import global_state
+    from skypilot_tpu.backends import gang_backend
+    for rec in serve_state.get_replicas(service_name):
+        record = global_state.get_cluster_from_name(rec['cluster_name'])
+        if record is None:
+            continue
+        try:
+            gang_backend.TpuGangBackend().teardown(record['handle'],
+                                                   terminate=True,
+                                                   purge=True)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'orphan replica teardown: {e}')
+
+
+def _kill_process_tree(pid: int) -> None:
+    try:
+        os.killpg(os.getpgid(pid), 15)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            os.kill(pid, 15)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+@usage_lib.entrypoint(name='serve.tail_logs')
+def tail_logs(service_name: str, follow: bool = True) -> int:
+    path = serve_state.controller_log_path(service_name)
+    if not os.path.exists(path):
+        logger.info(f'No controller log for {service_name!r} yet.')
+        return 1
+    cmd = ['tail', '-n', '+1']
+    if follow:
+        cmd.append('-f')
+    cmd.append(path)
+    return subprocess.run(cmd, check=False).returncode

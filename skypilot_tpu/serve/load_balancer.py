@@ -1,0 +1,139 @@
+"""HTTP load balancer: async reverse proxy over ready replicas.
+
+Parity: ``sky/serve/load_balancer.py`` (SkyServeLoadBalancer:22) — the
+reference is a FastAPI+httpx proxy that syncs the replica set from the
+controller and reports QPS back; here the LB runs in the controller process
+(aiohttp server in a thread), reads the ready set via a shared callback, and
+records request timestamps the autoscaler consumes directly.
+"""
+import asyncio
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, List, Optional
+
+import aiohttp
+from aiohttp import web
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
+
+logger = sky_logging.init_logger(__name__)
+
+_HOP_HEADERS = {
+    'connection', 'keep-alive', 'proxy-authenticate',
+    'proxy-authorization', 'te', 'trailers', 'transfer-encoding',
+    'upgrade', 'host', 'content-length',
+    # aiohttp transparently decompresses upstream bodies, so the encoding
+    # headers must not survive the hop in either direction — a forwarded
+    # 'Content-Encoding: gzip' over an already-inflated body is garbage
+    # to the client.
+    'content-encoding', 'accept-encoding',
+}
+
+
+class LoadBalancer:
+    """aiohttp reverse proxy with a pluggable policy."""
+
+    def __init__(self, port: int, policy_name: str,
+                 get_ready_urls: Callable[[], List[str]]):
+        self.port = port
+        self.policy = lb_policies.LoadBalancingPolicy.make(policy_name)
+        self._get_ready_urls = get_ready_urls
+        # Request arrival timestamps for the autoscaler (QPS window).
+        # Guarded by a lock: the aiohttp thread appends while the
+        # controller thread snapshots.
+        self._ts_lock = threading.Lock()
+        self._request_timestamps: Deque[float] = deque(maxlen=100_000)
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name='skytpu-lb')
+        self._thread.start()
+        if not self._started.wait(timeout=15):
+            raise RuntimeError('Load balancer failed to start.')
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_until_complete(self._setup())
+        self._started.set()
+        try:
+            self._loop.run_forever()
+        finally:
+            self._loop.run_until_complete(self._teardown())
+            self._loop.close()
+
+    async def _setup(self) -> None:
+        # No total timeout: LLM generations stream for minutes; stalls are
+        # caught by sock_read instead.
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=None, sock_connect=30,
+                                          sock_read=300))
+        app = web.Application()
+        app.router.add_route('*', '/{tail:.*}', self._handle)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, '0.0.0.0', self.port)
+        await site.start()
+        logger.info(f'Load balancer listening on :{self.port}.')
+
+    async def _teardown(self) -> None:
+        await self._session.close()
+        await self._runner.cleanup()
+
+    # ------------------------------------------------------------- proxy
+
+    def snapshot_request_timestamps(self) -> list:
+        with self._ts_lock:
+            return list(self._request_timestamps)
+
+    async def _handle(self, request: web.Request) -> web.StreamResponse:
+        with self._ts_lock:
+            self._request_timestamps.append(time.time())
+        self.policy.set_ready_replicas(self._get_ready_urls())
+        url = self.policy.select_replica()
+        if url is None:
+            return web.Response(
+                status=503,
+                text='No ready replicas. Use `sky serve status` to check '
+                     'the service.')
+        target = url.rstrip('/') + '/' + request.match_info['tail']
+        if request.query_string:
+            target += '?' + request.query_string
+        self.policy.request_started(url)
+        try:
+            body = await request.read()
+            headers = {k: v for k, v in request.headers.items()
+                       if k.lower() not in _HOP_HEADERS}
+            async with self._session.request(request.method, target,
+                                             headers=headers,
+                                             data=body) as resp:
+                out_headers = {k: v for k, v in resp.headers.items()
+                               if k.lower() not in _HOP_HEADERS}
+                # Stream chunk-by-chunk: token streams (SSE/chunked LLM
+                # responses) must reach the client as they are produced,
+                # not after the replica finishes.
+                out = web.StreamResponse(status=resp.status,
+                                         headers=out_headers)
+                await out.prepare(request)
+                async for chunk in resp.content.iter_chunked(64 * 1024):
+                    await out.write(chunk)
+                await out.write_eof()
+                return out
+        except aiohttp.ClientError as e:
+            return web.Response(status=502,
+                                text=f'Replica request failed: {e}')
+        finally:
+            self.policy.request_finished(url)

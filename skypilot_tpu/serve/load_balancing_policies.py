@@ -1,0 +1,94 @@
+"""Load-balancing policies (parity: sky/serve/load_balancing_policies.py).
+
+``round_robin`` cycles ready replicas; ``least_load`` (default) picks the
+replica with the fewest in-flight requests proxied through this LB.
+"""
+import itertools
+import threading
+from typing import Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+
+class LoadBalancingPolicy:
+    """Tracks the ready-replica set and picks a target per request."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ready_urls: List[str] = []
+
+    def set_ready_replicas(self, urls: List[str]) -> None:
+        with self._lock:
+            if set(urls) != set(self.ready_urls):
+                self._on_replicas_changed(urls)
+            self.ready_urls = list(urls)
+
+    def _on_replicas_changed(self, urls: List[str]) -> None:
+        pass
+
+    def select_replica(self) -> Optional[str]:
+        raise NotImplementedError
+
+    def request_started(self, url: str) -> None:
+        pass
+
+    def request_finished(self, url: str) -> None:
+        pass
+
+    @classmethod
+    def make(cls, name: str) -> 'LoadBalancingPolicy':
+        impl = _POLICIES.get(name.lower())
+        if impl is None:
+            raise exceptions.InvalidSkyError(
+                f'Unknown load balancing policy {name!r}; '
+                f'available: {sorted(_POLICIES)}')
+        return impl()
+
+
+class RoundRobinPolicy(LoadBalancingPolicy):
+    """Parity: load_balancing_policies.py:89."""
+
+    def __init__(self):
+        super().__init__()
+        self._cycle = itertools.cycle([])
+
+    def _on_replicas_changed(self, urls: List[str]) -> None:
+        self._cycle = itertools.cycle(urls)
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self.ready_urls:
+                return None
+            return next(self._cycle)
+
+
+class LeastLoadPolicy(LoadBalancingPolicy):
+    """Fewest in-flight requests wins (parity: :115, the default)."""
+
+    def __init__(self):
+        super().__init__()
+        self._inflight: Dict[str, int] = {}
+
+    def _on_replicas_changed(self, urls: List[str]) -> None:
+        self._inflight = {u: self._inflight.get(u, 0) for u in urls}
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self.ready_urls:
+                return None
+            return min(self.ready_urls,
+                       key=lambda u: self._inflight.get(u, 0))
+
+    def request_started(self, url: str) -> None:
+        with self._lock:
+            self._inflight[url] = self._inflight.get(url, 0) + 1
+
+    def request_finished(self, url: str) -> None:
+        with self._lock:
+            self._inflight[url] = max(0, self._inflight.get(url, 1) - 1)
+
+
+_POLICIES = {
+    'round_robin': RoundRobinPolicy,
+    'least_load': LeastLoadPolicy,
+}

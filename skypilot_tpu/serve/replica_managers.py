@@ -1,0 +1,302 @@
+"""Replica manager: launch/probe/replace replica clusters.
+
+Parity: ``sky/serve/replica_managers.py`` (SkyPilotReplicaManager:627,
+ReplicaStatusProperty:230) — each replica is an ordinary cluster launched
+asynchronously (thread per launch/teardown, like the reference's process
+pool), probed over HTTP for readiness, and replaced on failure/preemption.
+"""
+import os
+import threading
+import time
+import typing
+from typing import List, Optional
+
+import requests as requests_lib
+
+from skypilot_tpu import global_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.skylet import job_lib
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.serve import service_spec as spec_lib
+
+logger = sky_logging.init_logger(__name__)
+
+# Consecutive probe failures before READY → NOT_READY, and before a
+# NOT_READY replica is recycled.
+_NOT_READY_THRESHOLD = 3
+_RECYCLE_THRESHOLD = 6
+# Stop replacing replicas once this many have FAILED (parity: the
+# reference's per-replica retry budget; without it a bad image would
+# launch clusters in an unbounded loop).
+_MAX_FAILED_REPLICAS = int(os.environ.get('SKYTPU_SERVE_MAX_FAILURES',
+                                          '3'))
+
+REPLICA_PORT_ENV = 'SKYTPU_REPLICA_PORT'
+REPLICA_ID_ENV = 'SKYTPU_REPLICA_ID'
+
+
+class ReplicaManager:
+    """Drives the replica pool of one service toward a target size."""
+
+    def __init__(self, service_name: str, spec: 'spec_lib.SkyServiceSpec',
+                 task_yaml_path: str):
+        self.service_name = service_name
+        self.spec = spec
+        self.task_yaml_path = task_yaml_path
+        # Every launch/terminate worker thread ever started; join() must
+        # wait for in-flight launches too, or shutdown would orphan a
+        # half-provisioned cluster whose replica row is already gone.
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------- naming
+
+    def replica_cluster_name(self, replica_id: int) -> str:
+        return f'{self.service_name}-replica-{replica_id}'
+
+    def _replica_port(self, replica_id: int, cloud_is_local: bool) -> int:
+        # Real clouds: every replica is its own host → same port. Local
+        # cloud: replicas share this machine → offset per replica.
+        if cloud_is_local:
+            return self.spec.replica_port + replica_id
+        return self.spec.replica_port
+
+    # -------------------------------------------------------------- scale
+
+    def alive_replicas(self) -> List[dict]:
+        return [r for r in serve_state.get_replicas(self.service_name)
+                if r['status'].is_alive()]
+
+    def failed_replicas(self) -> List[dict]:
+        return [r for r in serve_state.get_replicas(self.service_name)
+                if r['status'] == ReplicaStatus.FAILED]
+
+    def scale_to(self, target: int) -> None:
+        alive = self.alive_replicas()
+        if len(alive) < target:
+            if len(self.failed_replicas()) >= _MAX_FAILED_REPLICAS:
+                return  # out of retry budget; service will show FAILED
+            for _ in range(target - len(alive)):
+                self._launch_new_replica()
+        elif len(alive) > target:
+            # Scale down newest-first (parity: reference terminates the
+            # latest-launched replicas first).
+            surplus = sorted(alive, key=lambda r: r['launched_at'],
+                             reverse=True)[:len(alive) - target]
+            for rec in surplus:
+                self.terminate_replica(rec['replica_id'], reason='autoscale')
+
+    def _launch_new_replica(self) -> None:
+        replica_id = serve_state.next_replica_id(self.service_name)
+        cluster_name = self.replica_cluster_name(replica_id)
+        serve_state.add_replica(self.service_name, replica_id, cluster_name,
+                                endpoint=None)
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       ReplicaStatus.PROVISIONING)
+        t = threading.Thread(target=self._launch_thread,
+                             args=(replica_id, cluster_name),
+                             daemon=True,
+                             name=f'launch-{cluster_name}')
+        self._track(t)
+        t.start()
+
+    def _build_replica_task(self, replica_id: int) -> task_lib.Task:
+        task = task_lib.Task.from_yaml(self.task_yaml_path)
+        task.service = None  # replicas run the task, not the service
+        cloud_is_local = self._cloud_is_local(task)
+        port = self._replica_port(replica_id, cloud_is_local)
+        task.update_envs({
+            REPLICA_PORT_ENV: str(port),
+            REPLICA_ID_ENV: str(replica_id),
+        })
+        return task
+
+    @staticmethod
+    def _cloud_is_local(task: task_lib.Task) -> bool:
+        for res in task.resources:
+            if res.cloud is not None and res.cloud.name == 'local':
+                return True
+        return False
+
+    def _launch_thread(self, replica_id: int, cluster_name: str) -> None:
+        from skypilot_tpu import execution
+        try:
+            task = self._build_replica_task(replica_id)
+            execution.launch(task,
+                             cluster_name=cluster_name,
+                             detach_run=True,
+                             stream_logs=False)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.error(f'Replica {replica_id} launch failed: {e}')
+            serve_state.set_replica_status(self.service_name, replica_id,
+                                           ReplicaStatus.FAILED)
+            self._teardown_cluster(cluster_name)
+            return
+        # Shutdown may have raced the launch: if the record is gone or
+        # being torn down, the fresh cluster must not be leaked.
+        current = [r for r in serve_state.get_replicas(self.service_name)
+                   if r['replica_id'] == replica_id]
+        if not current or current[0]['status'] == \
+                ReplicaStatus.SHUTTING_DOWN:
+            self._teardown_cluster(cluster_name)
+            return
+        endpoint = self._resolve_endpoint(replica_id, cluster_name)
+        if endpoint is None:
+            serve_state.set_replica_status(self.service_name, replica_id,
+                                           ReplicaStatus.FAILED)
+            return
+        serve_state.set_replica_endpoint(self.service_name, replica_id,
+                                         endpoint)
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       ReplicaStatus.STARTING)
+        logger.info(f'Replica {replica_id} up at {endpoint}; probing.')
+
+    def _resolve_endpoint(self, replica_id: int,
+                          cluster_name: str) -> Optional[str]:
+        record = global_state.get_cluster_from_name(cluster_name)
+        if record is None:
+            return None
+        handle = record['handle']
+        if handle.provider_name == 'local':
+            host = '127.0.0.1'
+            port = self._replica_port(replica_id, cloud_is_local=True)
+        else:
+            if handle.cached_hosts is None:
+                handle.update_cluster_info()
+            head = handle.cached_hosts[0]
+            host = head.get('ip') or head.get('internal_ip')
+            port = self._replica_port(replica_id, cloud_is_local=False)
+        return f'http://{host}:{port}'
+
+    # ---------------------------------------------------------- terminate
+
+    def terminate_replica(self, replica_id: int, reason: str,
+                          remove_record: bool = True) -> None:
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       ReplicaStatus.SHUTTING_DOWN)
+        cluster_name = self.replica_cluster_name(replica_id)
+        logger.info(f'Terminating replica {replica_id} ({reason}).')
+
+        def _term() -> None:
+            self._teardown_cluster(cluster_name)
+            if remove_record:
+                serve_state.remove_replica(self.service_name, replica_id)
+
+        t = threading.Thread(target=_term, daemon=True,
+                             name=f'term-{cluster_name}')
+        self._track(t)
+        t.start()
+
+    def terminate_all(self) -> None:
+        for rec in serve_state.get_replicas(self.service_name):
+            if rec['status'] != ReplicaStatus.SHUTTING_DOWN:
+                self.terminate_replica(rec['replica_id'], reason='shutdown')
+        self.join()
+
+    def _track(self, t: threading.Thread) -> None:
+        # Prune finished workers so a churning service does not accumulate
+        # dead Thread objects for its whole lifetime.
+        self._threads = [x for x in self._threads if x.is_alive()]
+        self._threads.append(t)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        for t in list(self._threads):
+            t.join(timeout=timeout)
+
+    def _teardown_cluster(self, cluster_name: str) -> None:
+        from skypilot_tpu.backends import gang_backend
+        record = global_state.get_cluster_from_name(cluster_name)
+        if record is None:
+            return
+        try:
+            gang_backend.TpuGangBackend().teardown(record['handle'],
+                                                   terminate=True,
+                                                   purge=True)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Replica teardown {cluster_name}: {e}')
+
+    # ------------------------------------------------------------- probe
+
+    def reconcile(self) -> None:
+        """One prober tick over every replica (parity: the reference's
+        per-replica probe loop + process-pool reaping)."""
+        for rec in serve_state.get_replicas(self.service_name):
+            status: ReplicaStatus = rec['status']
+            rid = rec['replica_id']
+            if status in (ReplicaStatus.PENDING, ReplicaStatus.PROVISIONING,
+                          ReplicaStatus.SHUTTING_DOWN):
+                continue  # a thread owns these transitions
+            if status in (ReplicaStatus.FAILED, ReplicaStatus.PREEMPTED):
+                continue
+            cluster_name = self.replica_cluster_name(rid)
+            record = global_state.get_cluster_from_name(cluster_name)
+            if record is None:
+                # Cluster vanished out from under us: preemption.
+                logger.info(f'Replica {rid} preempted.')
+                serve_state.remove_replica(self.service_name, rid)
+                continue
+            if self._job_failed(record['handle']):
+                logger.info(f'Replica {rid} job failed.')
+                serve_state.set_replica_status(self.service_name, rid,
+                                               ReplicaStatus.FAILED)
+                self._teardown_cluster(cluster_name)
+                continue
+            self._probe_one(rec)
+
+    def _job_failed(self, handle) -> bool:
+        from skypilot_tpu.backends import gang_backend
+        try:
+            jobs = gang_backend.TpuGangBackend().get_job_queue(handle)
+        except Exception:  # pylint: disable=broad-except
+            return False  # unreachable ≠ failed; preemption check covers it
+        if not jobs:
+            return False
+        latest = max(jobs, key=lambda j: j['job_id'])
+        return job_lib.JobStatus(latest['status']) in (
+            job_lib.JobStatus.FAILED, job_lib.JobStatus.FAILED_SETUP)
+
+    def _probe_one(self, rec: dict) -> None:
+        rid = rec['replica_id']
+        url = (rec['endpoint'] or '').rstrip('/') + \
+            self.spec.readiness_path
+        ok = False
+        try:
+            resp = requests_lib.get(
+                url, timeout=self.spec.readiness_timeout_seconds)
+            ok = resp.status_code == 200
+        except requests_lib.RequestException:
+            ok = False
+        status: ReplicaStatus = rec['status']
+        if ok:
+            if status != ReplicaStatus.READY:
+                logger.info(f'Replica {rid} is READY.')
+            serve_state.set_replica_failures(self.service_name, rid, 0)
+            serve_state.set_replica_status(self.service_name, rid,
+                                           ReplicaStatus.READY)
+            return
+        failures = rec['consecutive_failures'] + 1
+        serve_state.set_replica_failures(self.service_name, rid, failures)
+        if status == ReplicaStatus.STARTING:
+            elapsed = time.time() - rec['launched_at']
+            if elapsed > self.spec.initial_delay_seconds:
+                logger.info(f'Replica {rid} failed its initial probe '
+                            f'window ({elapsed:.0f}s).')
+                serve_state.set_replica_status(self.service_name, rid,
+                                               ReplicaStatus.FAILED)
+                self._teardown_cluster(self.replica_cluster_name(rid))
+            return
+        if failures >= _RECYCLE_THRESHOLD:
+            self.terminate_replica(rid, reason='unhealthy')
+        elif failures >= _NOT_READY_THRESHOLD:
+            serve_state.set_replica_status(self.service_name, rid,
+                                           ReplicaStatus.NOT_READY)
+
+    # ------------------------------------------------------------- views
+
+    def ready_urls(self) -> List[str]:
+        return [r['endpoint']
+                for r in serve_state.get_replicas(self.service_name)
+                if r['status'] == ReplicaStatus.READY and r['endpoint']]

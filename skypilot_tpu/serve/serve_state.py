@@ -1,0 +1,227 @@
+"""sqlite state for services + replicas.
+
+Parity: ``sky/serve/serve_state.py`` — service rows (status, spec, LB port)
+and replica rows (status state machine, endpoint, failure counters).
+"""
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import db_utils
+
+_TABLES = """
+    CREATE TABLE IF NOT EXISTS services (
+        name TEXT PRIMARY KEY,
+        submitted_at REAL,
+        status TEXT,
+        controller_pid INTEGER DEFAULT NULL,
+        spec_json TEXT,
+        task_yaml_path TEXT,
+        lb_port INTEGER,
+        shutdown_requested INTEGER DEFAULT 0
+    );
+    CREATE TABLE IF NOT EXISTS replicas (
+        service_name TEXT,
+        replica_id INTEGER,
+        cluster_name TEXT,
+        status TEXT,
+        endpoint TEXT,
+        launched_at REAL,
+        consecutive_failures INTEGER DEFAULT 0,
+        PRIMARY KEY (service_name, replica_id)
+    );
+"""
+
+
+def db_path() -> str:
+    return os.path.join(os.path.expanduser('~'), '.skytpu', 'serve.db')
+
+
+def controller_log_path(service_name: str) -> str:
+    d = os.path.join(os.path.expanduser('~'), '.skytpu', 'serve', 'logs')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f'{service_name}.log')
+
+
+def task_yaml_dir() -> str:
+    d = os.path.join(os.path.expanduser('~'), '.skytpu', 'serve', 'tasks')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+_CONN = db_utils.SqliteConn('serve', db_path, _TABLES)
+
+
+def _db() -> sqlite3.Connection:
+    return _CONN.get()
+
+
+class ServiceStatus(enum.Enum):
+    """Parity: sky/serve ServiceStatus."""
+    CONTROLLER_INIT = 'CONTROLLER_INIT'
+    REPLICA_INIT = 'REPLICA_INIT'
+    READY = 'READY'
+    NO_REPLICA = 'NO_REPLICA'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    SHUTDOWN = 'SHUTDOWN'
+    FAILED = 'FAILED'
+
+    def is_terminal(self) -> bool:
+        return self in (ServiceStatus.SHUTDOWN, ServiceStatus.FAILED)
+
+
+class ReplicaStatus(enum.Enum):
+    """Parity: sky/serve ReplicaStatus (replica_managers.py:230)."""
+    PENDING = 'PENDING'
+    PROVISIONING = 'PROVISIONING'
+    STARTING = 'STARTING'
+    READY = 'READY'
+    NOT_READY = 'NOT_READY'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    PREEMPTED = 'PREEMPTED'
+    FAILED = 'FAILED'
+
+    def is_alive(self) -> bool:
+        """Counts toward the provisioned-replica pool."""
+        return self in (ReplicaStatus.PENDING, ReplicaStatus.PROVISIONING,
+                        ReplicaStatus.STARTING, ReplicaStatus.READY,
+                        ReplicaStatus.NOT_READY)
+
+
+# ---------------------------------------------------------------- services
+
+
+def add_service(name: str, spec_json: Dict[str, Any], task_yaml_path: str,
+                lb_port: int) -> bool:
+    """Returns False if a live service with this name exists."""
+    with _db() as conn:
+        row = conn.execute('SELECT status FROM services WHERE name=?',
+                           (name,)).fetchone()
+        if row is not None:
+            if not ServiceStatus(row['status']).is_terminal():
+                return False
+            conn.execute('DELETE FROM services WHERE name=?', (name,))
+            conn.execute('DELETE FROM replicas WHERE service_name=?',
+                         (name,))
+        conn.execute(
+            'INSERT INTO services (name, submitted_at, status, spec_json, '
+            'task_yaml_path, lb_port) VALUES (?,?,?,?,?,?)',
+            (name, time.time(), ServiceStatus.CONTROLLER_INIT.value,
+             json.dumps(spec_json), task_yaml_path, lb_port))
+    return True
+
+
+def _service_row_to_record(row: sqlite3.Row) -> Dict[str, Any]:
+    rec = dict(row)
+    rec['spec'] = json.loads(rec.pop('spec_json'))
+    rec['status'] = ServiceStatus(rec['status'])
+    return rec
+
+
+def get_service(name: str) -> Optional[Dict[str, Any]]:
+    row = _db().execute('SELECT * FROM services WHERE name=?',
+                        (name,)).fetchone()
+    return _service_row_to_record(row) if row is not None else None
+
+
+def get_services() -> List[Dict[str, Any]]:
+    rows = _db().execute('SELECT * FROM services').fetchall()
+    return [_service_row_to_record(r) for r in rows]
+
+
+def set_service_status(name: str, status: ServiceStatus) -> None:
+    with _db() as conn:
+        conn.execute('UPDATE services SET status=? WHERE name=?',
+                     (status.value, name))
+
+
+def set_service_controller_pid(name: str, pid: int) -> None:
+    with _db() as conn:
+        conn.execute('UPDATE services SET controller_pid=? WHERE name=?',
+                     (pid, name))
+
+
+def request_shutdown(name: str) -> None:
+    with _db() as conn:
+        conn.execute(
+            'UPDATE services SET shutdown_requested=1, status=? '
+            'WHERE name=?', (ServiceStatus.SHUTTING_DOWN.value, name))
+
+
+def shutdown_requested(name: str) -> bool:
+    svc = get_service(name)
+    return bool(svc and svc['shutdown_requested'])
+
+
+def remove_service(name: str) -> None:
+    with _db() as conn:
+        conn.execute('DELETE FROM services WHERE name=?', (name,))
+        conn.execute('DELETE FROM replicas WHERE service_name=?', (name,))
+
+
+# ---------------------------------------------------------------- replicas
+
+
+def add_replica(service_name: str, replica_id: int, cluster_name: str,
+                endpoint: Optional[str]) -> None:
+    with _db() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO replicas VALUES (?,?,?,?,?,?,0)',
+            (service_name, replica_id, cluster_name,
+             ReplicaStatus.PENDING.value, endpoint, time.time()))
+
+
+def get_replicas(service_name: str) -> List[Dict[str, Any]]:
+    with _db() as conn:
+        rows = conn.execute(
+            'SELECT * FROM replicas WHERE service_name=? ORDER BY '
+            'replica_id', (service_name,)).fetchall()
+    out = []
+    for r in rows:
+        rec = dict(r)
+        rec['status'] = ReplicaStatus(rec['status'])
+        out.append(rec)
+    return out
+
+
+def set_replica_status(service_name: str, replica_id: int,
+                       status: ReplicaStatus) -> None:
+    with _db() as conn:
+        conn.execute(
+            'UPDATE replicas SET status=? WHERE service_name=? AND '
+            'replica_id=?', (status.value, service_name, replica_id))
+
+
+def set_replica_endpoint(service_name: str, replica_id: int,
+                         endpoint: str) -> None:
+    with _db() as conn:
+        conn.execute(
+            'UPDATE replicas SET endpoint=? WHERE service_name=? AND '
+            'replica_id=?', (endpoint, service_name, replica_id))
+
+
+def set_replica_failures(service_name: str, replica_id: int,
+                         consecutive_failures: int) -> None:
+    with _db() as conn:
+        conn.execute(
+            'UPDATE replicas SET consecutive_failures=? WHERE '
+            'service_name=? AND replica_id=?',
+            (consecutive_failures, service_name, replica_id))
+
+
+def remove_replica(service_name: str, replica_id: int) -> None:
+    with _db() as conn:
+        conn.execute(
+            'DELETE FROM replicas WHERE service_name=? AND replica_id=?',
+            (service_name, replica_id))
+
+
+def next_replica_id(service_name: str) -> int:
+    with _db() as conn:
+        row = conn.execute(
+            'SELECT MAX(replica_id) AS m FROM replicas WHERE '
+            'service_name=?', (service_name,)).fetchone()
+    return (row['m'] or 0) + 1

@@ -1,0 +1,115 @@
+"""``service:`` section of a task YAML.
+
+Parity: ``sky/serve/service_spec.py:24`` SkyServiceSpec — readiness probe,
+replica policy (fixed count or min/max + target QPS), load-balancing policy.
+
+YAML form::
+
+    service:
+      readiness_probe: /health          # or {path:, initial_delay_seconds:}
+      replica_policy:
+        min_replicas: 1
+        max_replicas: 4
+        target_qps_per_replica: 10
+      replica_port: 8080
+      load_balancing_policy: least_load # or round_robin
+"""
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+DEFAULT_INITIAL_DELAY_SECONDS = 1200
+DEFAULT_READINESS_PATH = '/'
+
+
+class SkyServiceSpec:
+    """Validated service section."""
+
+    def __init__(self,
+                 readiness_path: str = DEFAULT_READINESS_PATH,
+                 initial_delay_seconds: float = DEFAULT_INITIAL_DELAY_SECONDS,
+                 readiness_timeout_seconds: float = 15,
+                 min_replicas: int = 1,
+                 max_replicas: Optional[int] = None,
+                 target_qps_per_replica: Optional[float] = None,
+                 replica_port: int = 8080,
+                 load_balancing_policy: str = 'least_load'):
+        if not readiness_path.startswith('/'):
+            raise exceptions.InvalidSkyError(
+                f'readiness_probe path must start with "/": '
+                f'{readiness_path!r}')
+        if min_replicas < 0:
+            raise exceptions.InvalidSkyError('min_replicas must be >= 0.')
+        if max_replicas is not None and max_replicas < min_replicas:
+            raise exceptions.InvalidSkyError(
+                'max_replicas must be >= min_replicas.')
+        if target_qps_per_replica is not None:
+            if target_qps_per_replica <= 0:
+                raise exceptions.InvalidSkyError(
+                    'target_qps_per_replica must be positive.')
+            if max_replicas is None:
+                raise exceptions.InvalidSkyError(
+                    'autoscaling (target_qps_per_replica) requires '
+                    'max_replicas.')
+        self.readiness_path = readiness_path
+        self.initial_delay_seconds = initial_delay_seconds
+        self.readiness_timeout_seconds = readiness_timeout_seconds
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.target_qps_per_replica = target_qps_per_replica
+        self.replica_port = replica_port
+        self.load_balancing_policy = load_balancing_policy
+
+    @property
+    def autoscaling_enabled(self) -> bool:
+        return self.target_qps_per_replica is not None
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'SkyServiceSpec':
+        if not isinstance(config, dict):
+            raise exceptions.InvalidSkyError(
+                f'service: section must be a mapping, got {config!r}')
+        probe = config.get('readiness_probe', DEFAULT_READINESS_PATH)
+        if isinstance(probe, str):
+            probe = {'path': probe}
+        policy = config.get('replica_policy', {})
+        if 'replicas' in config:  # fixed-count shorthand
+            policy = {'min_replicas': config['replicas'],
+                      'max_replicas': config['replicas'], **policy}
+        return cls(
+            readiness_path=probe.get('path', DEFAULT_READINESS_PATH),
+            initial_delay_seconds=probe.get('initial_delay_seconds',
+                                            DEFAULT_INITIAL_DELAY_SECONDS),
+            readiness_timeout_seconds=probe.get('timeout_seconds', 15),
+            min_replicas=policy.get('min_replicas', 1),
+            max_replicas=policy.get('max_replicas'),
+            target_qps_per_replica=policy.get('target_qps_per_replica'),
+            replica_port=config.get('replica_port', 8080),
+            load_balancing_policy=config.get('load_balancing_policy',
+                                             'least_load'),
+        )
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {
+            'readiness_probe': {
+                'path': self.readiness_path,
+                'initial_delay_seconds': self.initial_delay_seconds,
+                'timeout_seconds': self.readiness_timeout_seconds,
+            },
+            'replica_policy': {
+                'min_replicas': self.min_replicas,
+            },
+            'replica_port': self.replica_port,
+            'load_balancing_policy': self.load_balancing_policy,
+        }
+        if self.max_replicas is not None:
+            cfg['replica_policy']['max_replicas'] = self.max_replicas
+        if self.target_qps_per_replica is not None:
+            cfg['replica_policy']['target_qps_per_replica'] = \
+                self.target_qps_per_replica
+        return cfg
+
+    def __repr__(self) -> str:
+        return (f'SkyServiceSpec(replicas={self.min_replicas}..'
+                f'{self.max_replicas}, port={self.replica_port}, '
+                f'probe={self.readiness_path!r})')

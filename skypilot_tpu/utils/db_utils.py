@@ -1,0 +1,45 @@
+"""Thread-local sqlite connection cache with one-time schema creation.
+
+State modules (global_state, jobs/state, serve/serve_state) are polled on
+hot paths (controller ticks, shutdown waits); opening a fresh connection and
+re-running CREATE TABLE per call is measurable overhead. Connections are
+cached per (thread, resolved path) — the path re-resolves each call so
+tests that repoint $HOME get a fresh DB.
+"""
+import os
+import sqlite3
+import threading
+from typing import Callable
+
+_local = threading.local()
+
+
+class SqliteConn:
+    """Factory for thread-local connections to one logical database."""
+
+    def __init__(self, name: str, path_fn: Callable[[], str], schema: str):
+        self._name = name
+        self._path_fn = path_fn
+        self._schema = schema
+
+    def get(self) -> sqlite3.Connection:
+        path = os.path.expanduser(self._path_fn())
+        cache = getattr(_local, 'conns', None)
+        if cache is None:
+            cache = _local.conns = {}
+        key = (self._name, path)
+        conn = cache.get(key)
+        if conn is None:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            conn = sqlite3.connect(path, timeout=30)
+            conn.row_factory = sqlite3.Row
+            conn.executescript(self._schema)
+            conn.commit()
+            # Drop stale connections for this logical DB (old $HOME).
+            for k in [k for k in cache if k[0] == self._name and k != key]:
+                try:
+                    cache.pop(k).close()
+                except sqlite3.Error:
+                    pass
+            cache[key] = conn
+        return conn

@@ -125,6 +125,7 @@ _SERVICE_SCHEMA: Dict[str, Any] = {
             },
         },
         'replicas': {'type': ['integer', 'null']},
+        'replica_port': {'type': ['integer', 'null']},
         'load_balancing_policy': {'type': ['string', 'null']},
     },
 }

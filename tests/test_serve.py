@@ -1,0 +1,238 @@
+"""Serve subsystem: spec/autoscaler/LB-policy units + Local-cloud e2e.
+
+E2e replicas are real launched clusters running ``python3 -m http.server``
+on the injected ``$SKYTPU_REPLICA_PORT`` — the full controller → replica
+manager → prober → load balancer path, no mocks.
+"""
+import time
+
+import pytest
+import requests
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+
+
+# ------------------------------------------------------------------ units
+
+
+def test_service_spec_parsing():
+    spec = spec_lib.SkyServiceSpec.from_yaml_config({
+        'readiness_probe': '/health',
+        'replica_policy': {
+            'min_replicas': 1,
+            'max_replicas': 3,
+            'target_qps_per_replica': 5,
+        },
+        'replica_port': 9000,
+    })
+    assert spec.readiness_path == '/health'
+    assert spec.autoscaling_enabled
+    assert spec.max_replicas == 3
+    rt = spec_lib.SkyServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert rt.target_qps_per_replica == 5
+    assert rt.replica_port == 9000
+
+
+def test_service_spec_validation():
+    with pytest.raises(exceptions.InvalidSkyError):
+        spec_lib.SkyServiceSpec(readiness_path='health')
+    with pytest.raises(exceptions.InvalidSkyError):
+        spec_lib.SkyServiceSpec(min_replicas=2, max_replicas=1)
+    with pytest.raises(exceptions.InvalidSkyError):
+        # autoscaling without max_replicas
+        spec_lib.SkyServiceSpec(target_qps_per_replica=1)
+    # fixed-count shorthand
+    spec = spec_lib.SkyServiceSpec.from_yaml_config({'replicas': 2})
+    assert spec.min_replicas == spec.max_replicas == 2
+
+
+def test_request_rate_autoscaler_hysteresis(monkeypatch):
+    monkeypatch.setenv('SKYTPU_SERVE_QPS_WINDOW', '10')
+    monkeypatch.setenv('SKYTPU_SERVE_UPSCALE_DELAY', '0.2')
+    monkeypatch.setenv('SKYTPU_SERVE_DOWNSCALE_DELAY', '0.4')
+    spec = spec_lib.SkyServiceSpec(min_replicas=1, max_replicas=4,
+                                   target_qps_per_replica=1)
+    a = autoscalers.Autoscaler.make(spec)
+    assert isinstance(a, autoscalers.RequestRateAutoscaler)
+    now = time.time()
+    # ~3 qps over a 10s window → demand 3, but not before upscale_delay.
+    stamps = [now - i * 0.03 for i in range(30)]
+    assert a.evaluate(1, stamps) == 1
+    time.sleep(0.25)
+    assert a.evaluate(1, stamps) == 3
+    # Demand drops to 0 → floor at min_replicas, after downscale_delay.
+    assert a.evaluate(3, []) == 3
+    time.sleep(0.45)
+    assert a.evaluate(3, []) == 1
+
+
+def test_autoscaler_fixed_when_disabled():
+    spec = spec_lib.SkyServiceSpec(min_replicas=2, max_replicas=2)
+    a = autoscalers.Autoscaler.make(spec)
+    assert type(a) is autoscalers.Autoscaler
+    assert a.evaluate(0, []) == 2
+
+
+def test_round_robin_policy():
+    p = lb_policies.LoadBalancingPolicy.make('round_robin')
+    assert p.select_replica() is None
+    p.set_ready_replicas(['a', 'b'])
+    picks = [p.select_replica() for _ in range(4)]
+    assert picks.count('a') == 2 and picks.count('b') == 2
+
+
+def test_least_load_policy():
+    p = lb_policies.LoadBalancingPolicy.make('least_load')
+    p.set_ready_replicas(['a', 'b'])
+    p.request_started('a')
+    assert p.select_replica() == 'b'
+    p.request_started('b')
+    p.request_started('b')
+    assert p.select_replica() == 'a'
+    p.request_finished('b')
+    p.request_finished('b')
+    p.request_finished('a')
+    with pytest.raises(exceptions.InvalidSkyError):
+        lb_policies.LoadBalancingPolicy.make('nope')
+
+
+# -------------------------------------------------------------------- e2e
+
+
+@pytest.fixture
+def serve_env(monkeypatch):
+    global_state.set_enabled_clouds(['Local'])
+    monkeypatch.setenv('SKYTPU_SERVE_CONTROLLER_INTERVAL', '0.5')
+    monkeypatch.setenv('SKYTPU_SERVE_QPS_WINDOW', '5')
+    monkeypatch.setenv('SKYTPU_SERVE_UPSCALE_DELAY', '0.5')
+    monkeypatch.setenv('SKYTPU_SERVE_DOWNSCALE_DELAY', '60')
+    yield
+
+
+def _http_service_task(name, **spec_kwargs):
+    import socket
+    with socket.socket() as s:
+        s.bind(('', 0))
+        base_port = s.getsockname()[1]
+    task = sky.Task(name=name,
+                    run='exec python3 -m http.server $SKYTPU_REPLICA_PORT')
+    task.set_resources(sky.Resources(cloud='local'))
+    defaults = dict(initial_delay_seconds=60, readiness_timeout_seconds=2,
+                    replica_port=base_port)
+    defaults.update(spec_kwargs)
+    task.set_service(spec_lib.SkyServiceSpec(**defaults))
+    return task
+
+
+def _wait_ready(name, timeout=120, min_ready=1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        recs = sky.serve.status(name)
+        if recs:
+            ready = [r for r in recs[0]['replicas']
+                     if r['status'] == 'READY']
+            if len(ready) >= min_ready:
+                return recs[0]
+        time.sleep(0.5)
+    log = serve_state.controller_log_path(name)
+    try:
+        with open(log, encoding='utf-8') as f:
+            detail = f.read()[-4000:]
+    except OSError:
+        detail = '<no log>'
+    raise TimeoutError(f'service {name} not ready; controller log:\n'
+                       f'{detail}')
+
+
+def test_serve_up_probe_proxy_down(serve_env):
+    task = _http_service_task('svc-basic')
+    info = sky.serve.up(task)
+    assert info['name'] == 'svc-basic'
+    rec = _wait_ready('svc-basic')
+    assert rec['status'] == 'READY'
+    # Proxy a real request through the LB.
+    resp = requests.get(info['endpoint'] + '/', timeout=10)
+    assert resp.status_code == 200
+    # Duplicate name rejected while live.
+    with pytest.raises(exceptions.InvalidSkyError):
+        sky.serve.up(_http_service_task('svc-basic'))
+    sky.serve.down('svc-basic')
+    assert sky.serve.status('svc-basic') == []
+    # Replica clusters cleaned up.
+    assert sky.status() == []
+
+
+def test_serve_replica_recovery(serve_env):
+    task = _http_service_task('svc-recover')
+    info = sky.serve.up(task)
+    rec = _wait_ready('svc-recover')
+    victim = rec['replicas'][0]
+    # Preempt the replica cluster out-of-band.
+    cluster = f"svc-recover-replica-{victim['replica_id']}"
+    sky.down(cluster)
+    # The controller replaces it and service returns to READY.
+    deadline = time.time() + 120
+    new_rec = None
+    while time.time() < deadline:
+        recs = sky.serve.status('svc-recover')
+        if recs:
+            ready = [r for r in recs[0]['replicas']
+                     if r['status'] == 'READY']
+            if ready and ready[0]['replica_id'] != victim['replica_id']:
+                new_rec = ready[0]
+                break
+        time.sleep(0.5)
+    assert new_rec is not None, 'replica was not replaced after preemption'
+    resp = requests.get(info['endpoint'] + '/', timeout=10)
+    assert resp.status_code == 200
+    sky.serve.down('svc-recover')
+
+
+def test_serve_autoscale_up(serve_env):
+    task = _http_service_task('svc-scale', min_replicas=1, max_replicas=2,
+                              target_qps_per_replica=1)
+    info = sky.serve.up(task)
+    _wait_ready('svc-scale')
+    # Hammer the LB well above 1 qps-per-replica for the 5s window.
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            requests.get(info['endpoint'] + '/', timeout=5)
+        except requests.RequestException:
+            pass
+        recs = sky.serve.status('svc-scale')
+        if recs and len([r for r in recs[0]['replicas']
+                         if r['status'] != 'SHUTTING_DOWN']) >= 2:
+            break
+        time.sleep(0.2)
+    recs = sky.serve.status('svc-scale')
+    assert len(recs[0]['replicas']) >= 2, recs
+    _wait_ready('svc-scale', min_ready=2)
+    sky.serve.down('svc-scale')
+
+
+def test_serve_failed_replica_budget(serve_env):
+    # A replica whose job exits non-zero must not relaunch unboundedly:
+    # after the failure budget the service is FAILED, and down still works.
+    task = sky.Task(name='svc-bad', run='exit 1')
+    task.set_resources(sky.Resources(cloud='local'))
+    task.set_service(spec_lib.SkyServiceSpec(initial_delay_seconds=60,
+                                             readiness_timeout_seconds=2))
+    sky.serve.up(task)
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        recs = sky.serve.status('svc-bad')
+        if recs and recs[0]['status'] == 'FAILED':
+            break
+        time.sleep(0.5)
+    recs = sky.serve.status('svc-bad')
+    assert recs[0]['status'] == 'FAILED', recs
+    assert len(recs[0]['replicas']) <= 4
+    sky.serve.down('svc-bad')
+    assert sky.status() == []
