@@ -1,0 +1,1 @@
+"""HTTP client SDK + CLI (parity: ``sky/client/`` + ``sky/cli.py``)."""

@@ -1,0 +1,400 @@
+"""The ``sky`` CLI (parity: ``sky/cli.py``, 5,738 LoC click app — same
+command surface, backed by the REST SDK; every command schedules a request
+and streams/prints its result).
+
+Run: ``python -m skypilot_tpu.client.cli <command>`` (or the ``skytpu``
+entrypoint once installed).
+"""
+import time
+from typing import Optional
+
+import click
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.client import sdk
+
+
+def _table(header, rows) -> str:
+    widths = [
+        max(len(str(header[i])), *(len(str(r[i])) for r in rows))
+        if rows else len(str(header[i])) for i in range(len(header))
+    ]
+    lines = ['  '.join(
+        str(h).ljust(widths[i]) for i, h in enumerate(header))]
+    for r in rows:
+        lines.append('  '.join(
+            str(c).ljust(widths[i]) for i, c in enumerate(r)))
+    return '\n'.join(lines)
+
+
+def _load_task(entrypoint: str, overrides) -> task_lib.Task:
+    task = task_lib.Task.from_yaml(entrypoint)
+    if overrides.get('name'):
+        task.name = overrides['name']
+    if overrides.get('num_nodes'):
+        task.num_nodes = overrides['num_nodes']
+    return task
+
+
+def _age(ts: Optional[float]) -> str:
+    if not ts:
+        return '-'
+    secs = int(time.time() - ts)
+    for unit, div in (('d', 86400), ('h', 3600), ('m', 60)):
+        if secs >= div:
+            return f'{secs // div}{unit}'
+    return f'{secs}s'
+
+
+@click.group()
+@click.version_option(version=__import__('skypilot_tpu').__version__,
+                      prog_name='skytpu')
+def cli():
+    """skypilot_tpu: run tasks on TPU (and other) infrastructure."""
+
+
+# ---------------------------------------------------------------- cluster
+
+
+@cli.command()
+@click.argument('entrypoint', required=True)
+@click.option('--cluster', '-c', default=None, help='Cluster name.')
+@click.option('--name', '-n', default=None, help='Override task name.')
+@click.option('--num-nodes', type=int, default=None)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+@click.option('--dryrun', is_flag=True, default=False)
+@click.option('--retry-until-up', is_flag=True, default=False)
+@click.option('--idle-minutes-to-autostop', '-i', type=int, default=None)
+@click.option('--down', is_flag=True, default=False,
+              help='Autodown after the job finishes.')
+def launch(entrypoint, cluster, name, num_nodes, detach_run, dryrun,
+           retry_until_up, idle_minutes_to_autostop, down):
+    """Launch a task from a YAML spec (provision + run)."""
+    task = _load_task(entrypoint, {'name': name, 'num_nodes': num_nodes})
+    request_id = sdk.launch(
+        task, cluster_name=cluster, retry_until_up=retry_until_up,
+        idle_minutes_to_autostop=idle_minutes_to_autostop, dryrun=dryrun,
+        down=down)
+    result = sdk.stream_and_get(request_id)
+    if result and result.get('job_id') is not None:
+        click.echo(f"Job submitted, ID: {result['job_id']} "
+                   f"(cluster {result['cluster_name']!r}).")
+        if not detach_run and result.get('cluster_name'):
+            rid = sdk.tail_logs(result['cluster_name'], result['job_id'])
+            sdk.stream_and_get(rid)
+
+
+@cli.command(name='exec')
+@click.argument('entrypoint', required=True)
+@click.option('--cluster', '-c', required=True)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+def exec_cmd(entrypoint, cluster, detach_run):
+    """Run a task on an existing cluster (skip provision/setup)."""
+    task = _load_task(entrypoint, {})
+    result = sdk.stream_and_get(sdk.exec_(task, cluster_name=cluster))
+    if result and result.get('job_id') is not None:
+        click.echo(f"Job submitted, ID: {result['job_id']}")
+        if not detach_run:
+            sdk.stream_and_get(sdk.tail_logs(cluster, result['job_id']))
+
+
+@cli.command()
+@click.option('--refresh', '-r', is_flag=True, default=False)
+@click.argument('clusters', nargs=-1)
+def status(refresh, clusters):
+    """Show clusters."""
+    records = sdk.get(sdk.status(list(clusters) or None, refresh=refresh))
+    if not records:
+        click.echo('No existing clusters.')
+        return
+    rows = [(r['name'], r['resources'], r['status'],
+             _age(r['launched_at']),
+             (f"{r['autostop']}m{'(down)' if r['to_down'] else ''}"
+              if r['autostop'] >= 0 else '-')) for r in records]
+    click.echo(_table(('NAME', 'RESOURCES', 'STATUS', 'AGE', 'AUTOSTOP'),
+                      rows))
+
+
+@cli.command()
+@click.argument('cluster', required=True)
+@click.option('--retry-until-up', is_flag=True, default=False)
+def start(cluster, retry_until_up):
+    """Restart a stopped cluster."""
+    sdk.stream_and_get(sdk.start(cluster, retry_until_up=retry_until_up))
+    click.echo(f'Cluster {cluster!r} started.')
+
+
+@cli.command()
+@click.argument('cluster', required=True)
+def stop(cluster):
+    """Stop a cluster (single-host TPU/VM only; pods must be downed)."""
+    sdk.stream_and_get(sdk.stop(cluster))
+    click.echo(f'Cluster {cluster!r} stopped.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--purge', is_flag=True, default=False)
+def down(clusters, purge):
+    """Tear down cluster(s)."""
+    for c in clusters:
+        sdk.stream_and_get(sdk.down(c, purge=purge))
+        click.echo(f'Cluster {c!r} terminated.')
+
+
+@cli.command()
+@click.argument('cluster', required=True)
+@click.option('--idle-minutes', '-i', type=int, required=True,
+              help='Idle minutes before stopping; -1 cancels.')
+@click.option('--down', 'autodown', is_flag=True, default=False)
+def autostop(cluster, idle_minutes, autodown):
+    """Schedule autostop/autodown for a cluster."""
+    sdk.get(sdk.autostop(cluster, idle_minutes, autodown))
+    verb = 'autodown' if autodown else 'autostop'
+    click.echo(f'{verb} set to {idle_minutes}m for {cluster!r}.')
+
+
+@cli.command()
+@click.argument('cluster', required=True)
+@click.option('--skip-finished', '-s', is_flag=True, default=False)
+def queue(cluster, skip_finished):
+    """Show a cluster's job queue."""
+    jobs = sdk.get(sdk.queue(cluster, skip_finished=skip_finished))
+    rows = [(j['job_id'], j['job_name'] or '-', j['username'], j['status'])
+            for j in jobs]
+    click.echo(_table(('ID', 'NAME', 'USER', 'STATUS'), rows))
+
+
+@cli.command()
+@click.argument('cluster', required=True)
+@click.option('--job', '-j', 'job_ids', type=int, multiple=True)
+@click.option('--all', '-a', 'all_jobs', is_flag=True, default=False)
+def cancel(cluster, job_ids, all_jobs):
+    """Cancel job(s) on a cluster."""
+    sdk.get(sdk.cancel(cluster, list(job_ids) or None, all_jobs))
+    click.echo('Cancelled.')
+
+
+@cli.command()
+@click.argument('cluster', required=True)
+@click.argument('job_id', type=int, required=False)
+@click.option('--no-follow', is_flag=True, default=False)
+def logs(cluster, job_id, no_follow):
+    """Tail a job's logs."""
+    sdk.stream_and_get(sdk.tail_logs(cluster, job_id,
+                                     follow=not no_follow))
+
+
+@cli.command(name='cost-report')
+def cost_report():
+    """Accumulated cost per cluster (from usage intervals)."""
+    records = sdk.get(sdk.cost_report())
+    rows = [(r['name'], f"{r['duration'] / 3600:.1f}h", r['resources'],
+             f"${r['total_cost']:.2f}" if r['total_cost'] is not None
+             else '-') for r in records]
+    click.echo(_table(('NAME', 'DURATION', 'RESOURCES', 'COST'), rows))
+
+
+@cli.command()
+@click.argument('clouds', nargs=-1)
+def check(clouds):
+    """Probe cloud credentials and cache the enabled set."""
+    enabled = sdk.get(sdk.check(list(clouds) or None))
+    click.echo(f'Enabled clouds: {", ".join(enabled)}')
+
+
+@cli.command(name='show-tpus')
+@click.option('--name-filter', '-f', default=None)
+@click.option('--gpus-only', is_flag=True, default=False)
+def show_tpus(name_filter, gpus_only):
+    """List TPU (and GPU) accelerator offerings with per-chip pricing.
+
+    Parity: `sky show-gpus` (cli.py:3247), TPU-first — this runs
+    client-side off the bundled catalog, no server roundtrip.
+    """
+    from skypilot_tpu import catalog
+    accs = catalog.list_accelerators(gpus_only=gpus_only,
+                                     name_filter=name_filter)
+    rows = []
+    for name in sorted(accs):
+        # One row per accelerator: cheapest offering wins (regions differ).
+        infos = sorted(accs[name], key=lambda i: i.price or 1e9)
+        info = infos[0]
+        rows.append((name, info.cloud, info.region,
+                     f'${info.price:.2f}' if info.price else '-',
+                     f'${info.spot_price:.2f}' if info.spot_price else '-'))
+    click.echo(_table(
+        ('ACCELERATOR', 'CLOUD', 'CHEAPEST REGION', '$/HR', 'SPOT $/HR'),
+        rows))
+
+
+# ------------------------------------------------------------------- jobs
+
+
+@cli.group()
+def jobs():
+    """Managed jobs with automatic recovery."""
+
+
+@jobs.command(name='launch')
+@click.argument('entrypoint', required=True)
+@click.option('--name', '-n', default=None)
+def jobs_launch(entrypoint, name):
+    """Submit a managed job from a YAML spec."""
+    task = _load_task(entrypoint, {'name': name})
+    result = sdk.get(sdk.jobs_launch(task, name=name))
+    click.echo(f"Managed job {result['job_id']} submitted.")
+
+
+@jobs.command(name='queue')
+def jobs_queue():
+    """List managed jobs."""
+    records = sdk.get(sdk.jobs_queue())
+    rows = [(r['job_id'], r['name'] or '-', r['status'] or '-',
+             f"{r['job_duration']:.0f}s", r['recovery_count'])
+            for r in records]
+    click.echo(_table(
+        ('ID', 'NAME', 'STATUS', 'DURATION', '#RECOVERIES'), rows))
+
+
+@jobs.command(name='cancel')
+@click.option('--job', '-j', 'job_ids', type=int, multiple=True)
+@click.option('--all', '-a', 'all_jobs', is_flag=True, default=False)
+def jobs_cancel(job_ids, all_jobs):
+    """Cancel managed job(s)."""
+    result = sdk.get(sdk.jobs_cancel(list(job_ids) or None, all_jobs))
+    click.echo(f"Cancelled: {result['cancelled']}")
+
+
+@jobs.command(name='logs')
+@click.argument('job_id', type=int, required=False)
+@click.option('--controller', is_flag=True, default=False)
+@click.option('--no-follow', is_flag=True, default=False)
+def jobs_logs(job_id, controller, no_follow):
+    """Tail a managed job's logs."""
+    sdk.stream_and_get(sdk.jobs_logs(job_id, follow=not no_follow,
+                                     controller=controller))
+
+
+# ------------------------------------------------------------------ serve
+
+
+@cli.group()
+def serve():
+    """Autoscaled serving."""
+
+
+@serve.command(name='up')
+@click.argument('entrypoint', required=True)
+@click.option('--service-name', '-n', default=None)
+def serve_up(entrypoint, service_name):
+    """Start a service from a YAML spec with a service: section."""
+    task = _load_task(entrypoint, {})
+    result = sdk.stream_and_get(sdk.serve_up(task,
+                                             service_name=service_name))
+    click.echo(f"Service {result['name']!r} starting at "
+               f"{result['endpoint']}")
+
+
+@serve.command(name='status')
+@click.argument('service_name', required=False)
+def serve_status(service_name):
+    """Show service(s) + replicas."""
+    records = sdk.get(sdk.serve_status(service_name))
+    if not records:
+        click.echo('No services.')
+        return
+    for svc in records:
+        click.echo(f"{svc['name']}: {svc['status']} @ {svc['endpoint']}")
+        rows = [(r['replica_id'], r['status'], r['endpoint'] or '-',
+                 _age(r['launched_at'])) for r in svc['replicas']]
+        click.echo(_table(('REPLICA', 'STATUS', 'ENDPOINT', 'AGE'), rows))
+
+
+@serve.command(name='down')
+@click.argument('service_name', required=True)
+@click.option('--purge', is_flag=True, default=False)
+def serve_down(service_name, purge):
+    """Tear down a service and its replicas."""
+    sdk.stream_and_get(sdk.serve_down(service_name, purge=purge))
+    click.echo(f'Service {service_name!r} torn down.')
+
+
+@serve.command(name='logs')
+@click.argument('service_name', required=True)
+@click.option('--no-follow', is_flag=True, default=False)
+def serve_logs(service_name, no_follow):
+    """Tail a service's controller log."""
+    sdk.stream_and_get(sdk.serve_logs(service_name,
+                                      follow=not no_follow))
+
+
+# ---------------------------------------------------------------- storage
+
+
+@cli.group()
+def storage():
+    """Storage objects (buckets)."""
+
+
+@storage.command(name='ls')
+def storage_ls():
+    """List storage objects."""
+    records = sdk.get(sdk.storage_ls())
+    rows = [(r['name'], ','.join(r['stores']) or '-', r['status'],
+             _age(r['launched_at'])) for r in records]
+    click.echo(_table(('NAME', 'STORES', 'STATUS', 'AGE'), rows))
+
+
+@storage.command(name='delete')
+@click.argument('names', nargs=-1, required=True)
+def storage_delete(names):
+    """Delete storage object(s) and their buckets."""
+    for n in names:
+        sdk.stream_and_get(sdk.storage_delete(n))
+        click.echo(f'Storage {n!r} deleted.')
+
+
+# -------------------------------------------------------------------- api
+
+
+@cli.group()
+def api():
+    """API server requests."""
+
+
+@api.command(name='status')
+def api_status_cmd():
+    """List recent API requests."""
+    records = sdk.api_status()
+    rows = [(r['request_id'][:8], r['name'], r['status'],
+             _age(r['created_at'])) for r in records]
+    click.echo(_table(('ID', 'NAME', 'STATUS', 'AGE'), rows))
+
+
+@api.command(name='cancel')
+@click.argument('request_id', required=True)
+def api_cancel_cmd(request_id):
+    """Cancel an API request (kills its worker)."""
+    ok = sdk.api_cancel(request_id)
+    click.echo('Cancelled.' if ok else 'Not cancellable.')
+
+
+@api.command(name='logs')
+@click.argument('request_id', required=True)
+def api_logs(request_id):
+    """Stream an API request's log."""
+    sdk.stream_and_get(request_id)
+
+
+def main() -> None:
+    try:
+        cli()  # pylint: disable=no-value-for-parameter
+    except exceptions.SkyTpuError as e:
+        click.echo(click.style(f'Error: {e}', fg='red'), err=True)
+        raise SystemExit(1) from e
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,253 @@
+"""Client SDK: async-by-default verbs over the REST API.
+
+Parity: ``sky/client/sdk.py`` (:300 launch, :510 exec, :1456 get, :1512
+stream_and_get) — every verb POSTs its payload and returns a ``request_id``
+string; ``get`` blocks for the result; ``stream_and_get`` follows the
+request log while waiting. The server is auto-started locally on first use.
+"""
+import typing
+from typing import Any, Dict, List, Optional, Union
+
+import requests as requests_lib
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.server import common as server_common
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import dag as dag_lib
+    from skypilot_tpu import task as task_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _post(path: str, payload: Dict[str, Any]) -> str:
+    url = server_common.check_server_healthy_or_start()
+    resp = requests_lib.post(f'{url}{path}', json=payload, timeout=30)
+    if resp.status_code != 200:
+        raise exceptions.ApiServerError(
+            f'POST {path} → {resp.status_code}: {resp.text[:500]}')
+    return resp.json()['request_id']
+
+
+def _reconstruct_exception(err: Dict[str, str]) -> Exception:
+    exc_type = getattr(exceptions, err.get('type', ''), None)
+    if exc_type is not None and issubclass(exc_type, Exception):
+        try:
+            return exc_type(err.get('message', ''))
+        except TypeError:
+            pass
+    return exceptions.ApiServerError(
+        f"{err.get('type', 'Error')}: {err.get('message', '')}")
+
+
+def get(request_id: str, timeout: Optional[float] = None) -> Any:
+    """Block until the request finishes; return its value or raise.
+
+    Parity: sdk.get:1456.
+    """
+    import time
+    url = server_common.server_url()
+    deadline = time.time() + timeout if timeout else None
+    while True:
+        server_timeout = 10.0
+        if deadline is not None:
+            server_timeout = min(server_timeout,
+                                 max(0.0, deadline - time.time()))
+        resp = requests_lib.get(
+            f'{url}/api/get',
+            params={'request_id': request_id, 'timeout': server_timeout},
+            timeout=server_timeout + 30)
+        if resp.status_code == 404:
+            raise exceptions.ApiServerError(f'Unknown request '
+                                            f'{request_id}.')
+        body = resp.json()
+        status = body['status']
+        if status == 'SUCCEEDED':
+            return body.get('return_value')
+        if status == 'FAILED':
+            raise _reconstruct_exception(body['error'])
+        if status == 'CANCELLED':
+            raise exceptions.RequestCancelled(
+                f'Request {request_id} was cancelled.')
+        if deadline is not None and time.time() >= deadline:
+            raise TimeoutError(
+                f'Request {request_id} still {status} after {timeout}s.')
+
+
+def stream_and_get(request_id: str, output=None) -> Any:
+    """Follow the request's log, then return its result.
+
+    Parity: sdk.stream_and_get:1512.
+    """
+    import sys
+    out = output or sys.stdout
+    url = server_common.server_url()
+    with requests_lib.get(f'{url}/api/stream',
+                          params={'request_id': request_id},
+                          stream=True, timeout=None) as resp:
+        for chunk in resp.iter_content(chunk_size=None):
+            out.write(chunk.decode('utf-8', errors='replace'))
+            try:
+                out.flush()
+            except Exception:  # pylint: disable=broad-except
+                pass
+    return get(request_id)
+
+
+def api_cancel(request_id: str) -> bool:
+    url = server_common.server_url()
+    resp = requests_lib.post(f'{url}/api/cancel',
+                             json={'request_id': request_id}, timeout=30)
+    return resp.json().get('cancelled', False)
+
+
+def api_status(limit: int = 100) -> List[Dict[str, Any]]:
+    url = server_common.check_server_healthy_or_start()
+    resp = requests_lib.get(f'{url}/api/status',
+                            params={'limit': limit}, timeout=30)
+    return resp.json()
+
+
+# ------------------------------------------------------------------ verbs
+
+
+def _dag_payload(entrypoint: Union['task_lib.Task', 'dag_lib.Dag']
+                 ) -> Dict[str, Any]:
+    from skypilot_tpu import dag as dag_lib_  # noqa: F401
+    from skypilot_tpu import task as task_lib_
+    if isinstance(entrypoint, task_lib_.Task):
+        tasks = [entrypoint]
+        name = entrypoint.name
+    else:
+        tasks = list(entrypoint.tasks)
+        name = entrypoint.name
+    return {'dag_name': name,
+            'tasks': [t.to_yaml_config() for t in tasks]}
+
+
+def launch(task: Union['task_lib.Task', 'dag_lib.Dag'],
+           cluster_name: Optional[str] = None,
+           retry_until_up: bool = False,
+           idle_minutes_to_autostop: Optional[int] = None,
+           dryrun: bool = False,
+           down: bool = False,
+           no_setup: bool = False) -> str:
+    payload = _dag_payload(task)
+    payload.update(cluster_name=cluster_name,
+                   retry_until_up=retry_until_up,
+                   idle_minutes_to_autostop=idle_minutes_to_autostop,
+                   dryrun=dryrun,
+                   down=down,
+                   no_setup=no_setup)
+    return _post('/launch', payload)
+
+
+def exec_(task: Union['task_lib.Task', 'dag_lib.Dag'],
+          cluster_name: str) -> str:
+    payload = _dag_payload(task)
+    payload.update(cluster_name=cluster_name)
+    return _post('/exec', payload)
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> str:
+    return _post('/status', {'cluster_names': cluster_names,
+                             'refresh': refresh})
+
+
+def start(cluster_name: str, retry_until_up: bool = False) -> str:
+    return _post('/start', {'cluster_name': cluster_name,
+                            'retry_until_up': retry_until_up})
+
+
+def stop(cluster_name: str, purge: bool = False) -> str:
+    return _post('/stop', {'cluster_name': cluster_name, 'purge': purge})
+
+
+def down(cluster_name: str, purge: bool = False) -> str:
+    return _post('/down', {'cluster_name': cluster_name, 'purge': purge})
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down_: bool = False) -> str:
+    return _post('/autostop', {'cluster_name': cluster_name,
+                               'idle_minutes': idle_minutes,
+                               'down': down_})
+
+
+def queue(cluster_name: str, skip_finished: bool = False) -> str:
+    return _post('/queue', {'cluster_name': cluster_name,
+                            'skip_finished': skip_finished})
+
+
+def cancel(cluster_name: str,
+           job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> str:
+    return _post('/cancel', {'cluster_name': cluster_name,
+                             'job_ids': job_ids, 'all_jobs': all_jobs})
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = True) -> str:
+    return _post('/logs', {'cluster_name': cluster_name, 'job_id': job_id,
+                           'follow': follow})
+
+
+def cost_report() -> str:
+    return _post('/cost_report', {})
+
+
+def check(clouds: Optional[List[str]] = None) -> str:
+    return _post('/check', {'clouds': clouds})
+
+
+def storage_ls() -> str:
+    return _post('/storage/ls', {})
+
+
+def storage_delete(name: str) -> str:
+    return _post('/storage/delete', {'name': name})
+
+
+def jobs_launch(task: Union['task_lib.Task', 'dag_lib.Dag'],
+                name: Optional[str] = None) -> str:
+    payload = _dag_payload(task)
+    payload.update(name=name)
+    return _post('/jobs/launch', payload)
+
+
+def jobs_queue() -> str:
+    return _post('/jobs/queue', {})
+
+
+def jobs_cancel(job_ids: Optional[List[int]] = None,
+                all_jobs: bool = False) -> str:
+    return _post('/jobs/cancel', {'job_ids': job_ids,
+                                  'all_jobs': all_jobs})
+
+
+def jobs_logs(job_id: Optional[int] = None, follow: bool = True,
+              controller: bool = False) -> str:
+    return _post('/jobs/logs', {'job_id': job_id, 'follow': follow,
+                                'controller': controller})
+
+
+def serve_up(task: 'task_lib.Task',
+             service_name: Optional[str] = None) -> str:
+    return _post('/serve/up', {'task': task.to_yaml_config(),
+                               'service_name': service_name})
+
+
+def serve_status(service_name: Optional[str] = None) -> str:
+    return _post('/serve/status', {'service_name': service_name})
+
+
+def serve_down(service_name: str, purge: bool = False) -> str:
+    return _post('/serve/down', {'service_name': service_name,
+                                 'purge': purge})
+
+
+def serve_logs(service_name: str, follow: bool = True) -> str:
+    return _post('/serve/logs', {'service_name': service_name,
+                                 'follow': follow})

@@ -1,0 +1,10 @@
+"""Client/server split: REST API server + request queue.
+
+Parity: ``sky/server/`` (SURVEY §2.8) — every SDK verb is an async REST
+request: POST returns a request id immediately; the work runs in a detached
+worker process with output captured to a per-request log; ``/api/get``
+returns the result, ``/api/stream`` follows the log. The reference uses
+FastAPI + a multiprocessing queue; this build uses aiohttp + a sqlite
+request table with worker processes, which survives server restarts the
+same way.
+"""

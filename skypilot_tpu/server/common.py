@@ -1,0 +1,85 @@
+"""Server bootstrap: locate or auto-start a local API server.
+
+Parity: ``sky/server/common.py`` (:97-313 — ``_start_api_server``,
+``check_server_healthy_or_start``): the client transparently launches a
+local server the first time a verb is used.
+"""
+import os
+import subprocess
+import sys
+import time
+from typing import Optional
+
+import requests as requests_lib
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import skypilot_config
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_PORT = 46590
+
+
+def server_url() -> str:
+    env = os.environ.get('SKYTPU_API_SERVER_URL')
+    if env:
+        return env.rstrip('/')
+    cfg = skypilot_config.get_nested(('api_server', 'endpoint'), None)
+    if cfg:
+        return str(cfg).rstrip('/')
+    return f'http://127.0.0.1:{DEFAULT_PORT}'
+
+
+def server_log_path() -> str:
+    d = os.path.join(os.path.expanduser('~'), '.skytpu', 'api')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, 'server.log')
+
+
+def is_healthy(url: Optional[str] = None, timeout: float = 2.0) -> bool:
+    try:
+        resp = requests_lib.get(f'{url or server_url()}/health',
+                                timeout=timeout)
+        return resp.status_code == 200
+    except requests_lib.RequestException:
+        return False
+
+
+def check_server_healthy_or_start(start_timeout: float = 30.0) -> str:
+    """Returns a healthy server URL, auto-starting a local one if needed."""
+    url = server_url()
+    if is_healthy(url):
+        return url
+    if not url.startswith(('http://127.0.0.1', 'http://localhost')):
+        raise exceptions.ApiServerError(
+            f'API server {url} is unreachable (and is remote, so it will '
+            'not be auto-started).')
+    _start_local_server(url)
+    deadline = time.time() + start_timeout
+    while time.time() < deadline:
+        if is_healthy(url):
+            return url
+        time.sleep(0.2)
+    raise exceptions.ApiServerError(
+        f'Local API server failed to become healthy; see '
+        f'{server_log_path()}')
+
+
+def _start_local_server(url: str) -> None:
+    port = int(url.rsplit(':', 1)[1])
+    import skypilot_tpu
+    pkg_root = os.path.dirname(os.path.dirname(skypilot_tpu.__file__))
+    env = dict(os.environ)
+    env['PYTHONPATH'] = pkg_root + (
+        os.pathsep + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
+    logger.info(f'Starting local API server on port {port}...')
+    with open(server_log_path(), 'ab') as log_f:
+        subprocess.Popen(
+            [sys.executable, '-u', '-m', 'skypilot_tpu.server.server',
+             '--port', str(port)],
+            stdout=log_f,
+            stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL,
+            env=env,
+            start_new_session=True)

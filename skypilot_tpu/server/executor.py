@@ -1,0 +1,68 @@
+"""Request executor: PENDING request → worker.
+
+Parity: ``sky/server/requests/executor.py`` (:121 QueueBackend, :173
+RequestWorker, :389 schedule_request) — LONG requests (launch/down/logs…)
+each get a detached worker process whose stdout/stderr land in the request
+log; SHORT requests (state reads) run in a thread of the server process.
+"""
+import os
+import subprocess
+import sys
+import threading
+from typing import Any, Dict
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.server import requests_db
+from skypilot_tpu.server import requests_impl
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+
+def schedule(name: str, payload: Dict[str, Any]) -> str:
+    """Persist + dispatch a request; returns its id immediately."""
+    if name not in requests_impl.EXECUTORS:
+        raise ValueError(f'Unknown request name {name!r}')
+    schedule_type = requests_impl.schedule_type_for(name)
+    request_id = requests_db.create_request(
+        name, common_utils.get_user_name(), payload, schedule_type)
+    if schedule_type == requests_db.ScheduleType.LONG:
+        _spawn_worker(request_id)
+    else:
+        t = threading.Thread(target=_run_short, args=(request_id,),
+                             daemon=True, name=f'req-{request_id[:8]}')
+        t.start()
+    return request_id
+
+
+def _spawn_worker(request_id: str) -> None:
+    import skypilot_tpu
+    pkg_root = os.path.dirname(os.path.dirname(skypilot_tpu.__file__))
+    env = dict(os.environ)
+    env['PYTHONPATH'] = pkg_root + (
+        os.pathsep + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
+    log_path = requests_db.log_path(request_id)
+    with open(log_path, 'ab') as log_f:
+        proc = subprocess.Popen(
+            [sys.executable, '-u', '-m',
+             'skypilot_tpu.server.request_runner', request_id],
+            stdout=log_f,
+            stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL,
+            env=env,
+            start_new_session=True)
+    requests_db.set_running(request_id, proc.pid)
+
+
+def _run_short(request_id: str) -> None:
+    rec = requests_db.get_request(request_id)
+    assert rec is not None
+    requests_db.set_running(request_id, pid=None)
+    impl = requests_impl.EXECUTORS[rec['name']]
+    try:
+        result = impl(rec['payload'])
+    except BaseException as e:  # pylint: disable=broad-except
+        logger.debug(f'Request {request_id} ({rec["name"]}) failed: {e}')
+        requests_db.set_exception(request_id, e)
+        return
+    requests_db.set_result(request_id, result)

@@ -1,0 +1,32 @@
+"""Worker process for one LONG request.
+
+Parity: the reference's RequestWorker process body
+(``sky/server/requests/executor.py:272-389``): stdout/stderr are already
+redirected to the request log by the spawner; this just executes the
+registered impl and persists result/exception.
+"""
+import sys
+
+from skypilot_tpu.server import requests_db
+from skypilot_tpu.server import requests_impl
+
+
+def main() -> None:
+    request_id = sys.argv[1]
+    rec = requests_db.get_request(request_id)
+    if rec is None:
+        print(f'request {request_id} not found', file=sys.stderr)
+        sys.exit(1)
+    impl = requests_impl.EXECUTORS[rec['name']]
+    try:
+        result = impl(rec['payload'])
+    except BaseException as e:  # pylint: disable=broad-except
+        import traceback
+        traceback.print_exc()
+        requests_db.set_exception(request_id, e)
+        sys.exit(1)
+    requests_db.set_result(request_id, result)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,177 @@
+"""Persisted request table for the API server.
+
+Parity: ``sky/server/requests/requests.py`` (Request rows :48, create_table
+:120, kill_requests :329) — request ids, statuses, pickled results, and a
+per-request log file so clients can stream output after the fact.
+"""
+import enum
+import os
+import pickle
+import sqlite3
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import db_utils
+
+_TABLES = """
+    CREATE TABLE IF NOT EXISTS requests (
+        request_id TEXT PRIMARY KEY,
+        name TEXT,
+        user TEXT,
+        status TEXT,
+        created_at REAL,
+        finished_at REAL,
+        schedule_type TEXT,
+        payload BLOB,
+        return_value BLOB,
+        exception BLOB,
+        pid INTEGER DEFAULT NULL
+    );
+"""
+
+
+def db_path() -> str:
+    return os.path.join(os.path.expanduser('~'), '.skytpu', 'api',
+                        'requests.db')
+
+
+def log_dir() -> str:
+    d = os.path.join(os.path.expanduser('~'), '.skytpu', 'api', 'logs')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def log_path(request_id: str) -> str:
+    return os.path.join(log_dir(), f'{request_id}.log')
+
+
+_CONN = db_utils.SqliteConn('api_requests', db_path, _TABLES)
+
+
+def _db() -> sqlite3.Connection:
+    return _CONN.get()
+
+
+class RequestStatus(enum.Enum):
+    """Parity: sky/server/requests/requests.py RequestStatus."""
+    PENDING = 'PENDING'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (RequestStatus.SUCCEEDED, RequestStatus.FAILED,
+                        RequestStatus.CANCELLED)
+
+
+class ScheduleType(enum.Enum):
+    """Parity: requests.py:91 — LONG requests (launch) get their own
+    process; SHORT ones run in the server's thread pool."""
+    LONG = 'LONG'
+    SHORT = 'SHORT'
+
+
+def create_request(name: str, user: str, payload: Dict[str, Any],
+                   schedule_type: ScheduleType) -> str:
+    request_id = uuid.uuid4().hex
+    with _db() as conn:
+        conn.execute(
+            'INSERT INTO requests (request_id, name, user, status, '
+            'created_at, schedule_type, payload) VALUES (?,?,?,?,?,?,?)',
+            (request_id, name, user, RequestStatus.PENDING.value,
+             time.time(), schedule_type.value, pickle.dumps(payload)))
+    return request_id
+
+
+def get_request(request_id: str) -> Optional[Dict[str, Any]]:
+    row = _db().execute('SELECT * FROM requests WHERE request_id=?',
+                        (request_id,)).fetchone()
+    if row is None:
+        return None
+    rec = dict(row)
+    rec['status'] = RequestStatus(rec['status'])
+    rec['payload'] = pickle.loads(rec['payload'])
+    rec['return_value'] = (pickle.loads(rec['return_value'])
+                           if rec['return_value'] is not None else None)
+    rec['exception'] = (pickle.loads(rec['exception'])
+                        if rec['exception'] is not None else None)
+    return rec
+
+
+def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
+    rows = _db().execute(
+        'SELECT request_id, name, user, status, created_at, finished_at '
+        'FROM requests ORDER BY created_at DESC LIMIT ?',
+        (limit,)).fetchall()
+    return [dict(r) for r in rows]
+
+
+def set_running(request_id: str, pid: Optional[int]) -> None:
+    # WHERE status=PENDING: a fast-failing worker may already have written
+    # a terminal status — flipping it back to RUNNING would strand the
+    # request (clients would poll it forever). The pid still lands either
+    # way so cancellation can reach the process.
+    with _db() as conn:
+        conn.execute(
+            'UPDATE requests SET status=? WHERE request_id=? AND status=?',
+            (RequestStatus.RUNNING.value, request_id,
+             RequestStatus.PENDING.value))
+        conn.execute('UPDATE requests SET pid=? WHERE request_id=?',
+                     (pid, request_id))
+
+
+_NONTERMINAL = (RequestStatus.PENDING.value, RequestStatus.RUNNING.value)
+
+
+def set_result(request_id: str, return_value: Any) -> None:
+    with _db() as conn:
+        conn.execute(
+            'UPDATE requests SET status=?, return_value=?, finished_at=? '
+            'WHERE request_id=? AND status IN (?,?)',
+            (RequestStatus.SUCCEEDED.value, pickle.dumps(return_value),
+             time.time(), request_id, *_NONTERMINAL))
+
+
+def set_exception(request_id: str, exc: BaseException) -> None:
+    try:
+        blob = pickle.dumps(exc)
+    except Exception:  # pylint: disable=broad-except
+        blob = pickle.dumps(RuntimeError(str(exc)))
+    with _db() as conn:
+        conn.execute(
+            'UPDATE requests SET status=?, exception=?, finished_at=? '
+            'WHERE request_id=? AND status IN (?,?)',
+            (RequestStatus.FAILED.value, blob, time.time(), request_id,
+             *_NONTERMINAL))
+
+
+def set_cancelled(request_id: str) -> None:
+    with _db() as conn:
+        conn.execute(
+            'UPDATE requests SET status=?, finished_at=? WHERE '
+            'request_id=? AND status IN (?,?)',
+            (RequestStatus.CANCELLED.value, time.time(), request_id,
+             *_NONTERMINAL))
+
+
+def kill_request(request_id: str) -> bool:
+    """Cancel a PENDING/RUNNING request; kills the worker process.
+
+    Parity: kill_requests (requests.py:329).
+    """
+    rec = get_request(request_id)
+    if rec is None or rec['status'].is_terminal():
+        return False
+    pid = rec['pid']
+    if pid:
+        try:
+            os.killpg(os.getpgid(pid), 15)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                os.kill(pid, 15)
+            except (ProcessLookupError, PermissionError):
+                pass
+    set_cancelled(request_id)
+    return True

@@ -1,0 +1,267 @@
+"""Server-side request executors: request name → implementation.
+
+Parity: the reference executes SDK calls server-side by importing the same
+core modules (``sky/server/requests/executor.py:272`` _request_execution_
+wrapper); payloads carry task/dag YAML configs, results are JSON-safe
+dicts so any HTTP client can consume them.
+"""
+from typing import Any, Callable, Dict, List
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+
+
+def _dag_from_payload(payload: Dict[str, Any]) -> dag_lib.Dag:
+    dag = dag_lib.Dag()
+    dag.name = payload.get('dag_name')
+    for cfg in payload['tasks']:
+        dag.add(task_lib.Task.from_yaml_config(cfg))
+    return dag
+
+
+def _launch(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import execution
+    dag = _dag_from_payload(payload)
+    job_id, handle = execution.launch(
+        dag,
+        cluster_name=payload.get('cluster_name'),
+        retry_until_up=payload.get('retry_until_up', False),
+        idle_minutes_to_autostop=payload.get('idle_minutes_to_autostop'),
+        dryrun=payload.get('dryrun', False),
+        down=payload.get('down', False),
+        detach_run=True,
+        no_setup=payload.get('no_setup', False))
+    return {
+        'job_id': job_id,
+        'cluster_name': handle.cluster_name if handle else None,
+        'num_hosts': handle.num_hosts if handle else None,
+    }
+
+
+def _exec(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import execution
+    dag = _dag_from_payload(payload)
+    job_id, handle = execution.exec_(dag,
+                                     cluster_name=payload['cluster_name'],
+                                     detach_run=True)
+    return {
+        'job_id': job_id,
+        'cluster_name': handle.cluster_name if handle else None,
+    }
+
+
+def _status(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    from skypilot_tpu import core
+    records = core.status(cluster_names=payload.get('cluster_names'),
+                          refresh=payload.get('refresh', False))
+    out = []
+    for r in records:
+        handle = r['handle']
+        out.append({
+            'name': r['name'],
+            'status': r['status'].value,
+            'launched_at': r['launched_at'],
+            'resources': str(handle.launched_resources),
+            'num_nodes': handle.launched_nodes,
+            'num_hosts': handle.num_hosts,
+            'autostop': r['autostop'],
+            'to_down': r['to_down'],
+            'last_use': r['last_use'],
+        })
+    return out
+
+
+def _start(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import core
+    handle = core.start(payload['cluster_name'],
+                        idle_minutes_to_autostop=payload.get(
+                            'idle_minutes_to_autostop'),
+                        retry_until_up=payload.get('retry_until_up', False),
+                        down=payload.get('down', False))
+    return {'cluster_name': handle.cluster_name}
+
+
+def _stop(payload: Dict[str, Any]) -> None:
+    from skypilot_tpu import core
+    core.stop(payload['cluster_name'], purge=payload.get('purge', False))
+
+
+def _down(payload: Dict[str, Any]) -> None:
+    from skypilot_tpu import core
+    core.down(payload['cluster_name'], purge=payload.get('purge', False))
+
+
+def _autostop(payload: Dict[str, Any]) -> None:
+    from skypilot_tpu import core
+    core.autostop(payload['cluster_name'], payload['idle_minutes'],
+                  down=payload.get('down', False))
+
+
+def _queue(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    from skypilot_tpu import core
+    return core.queue(payload['cluster_name'],
+                      skip_finished=payload.get('skip_finished', False))
+
+
+def _cancel(payload: Dict[str, Any]) -> None:
+    from skypilot_tpu import core
+    core.cancel(payload['cluster_name'],
+                job_ids=payload.get('job_ids'),
+                all_jobs=payload.get('all_jobs', False))
+
+
+def _cost_report(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    from skypilot_tpu import core
+    del payload
+    out = []
+    for rec in core.cost_report():
+        out.append({
+            'name': rec['name'],
+            'duration': rec['duration'],
+            'num_nodes': rec['num_nodes'],
+            'resources': str(rec['resources']),
+            'total_cost': rec['total_cost'],
+        })
+    return out
+
+
+def _check(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import check as check_lib
+    return check_lib.check(quiet=True,
+                           clouds=payload.get('clouds'))
+
+
+def _storage_ls(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    from skypilot_tpu import global_state
+    del payload
+    out = []
+    for rec in global_state.get_storage():
+        out.append({
+            'name': rec['name'],
+            'launched_at': rec['launched_at'],
+            'status': rec['status'],
+            'stores': rec['handle'].get('stores', []),
+        })
+    return out
+
+
+def _storage_delete(payload: Dict[str, Any]) -> None:
+    from skypilot_tpu import global_state
+    from skypilot_tpu.data import storage as storage_lib
+    name = payload['name']
+    rec = global_state.get_storage_from_name(name)
+    if rec is None:
+        raise exceptions.StorageError(f'Storage {name!r} not found.')
+    handle = rec['handle']
+    storage = storage_lib.Storage(
+        name=name, mode=storage_lib.StorageMode(handle['mode']))
+    for st in handle.get('stores', []):
+        storage.add_store(storage_lib.StoreType(st))
+    storage.delete()
+
+
+def _jobs_launch(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import jobs
+    dag = _dag_from_payload(payload)
+    job_id = jobs.launch(dag, name=payload.get('name'))
+    return {'job_id': job_id}
+
+
+def _jobs_queue(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    from skypilot_tpu import jobs
+    del payload
+    out = []
+    for rec in jobs.queue():
+        rec = dict(rec)
+        rec['tasks'] = [{k: v for k, v in t.items()} for t in rec['tasks']]
+        out.append(rec)
+    return out
+
+
+def _jobs_cancel(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import jobs
+    cancelled = jobs.cancel(job_ids=payload.get('job_ids'),
+                            all_jobs=payload.get('all_jobs', False))
+    return {'cancelled': cancelled}
+
+
+def _serve_up(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import serve
+    task = task_lib.Task.from_yaml_config(payload['task'])
+    return serve.up(task, service_name=payload.get('service_name'))
+
+
+def _serve_status(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    from skypilot_tpu import serve
+    return serve.status(payload.get('service_name'))
+
+
+def _serve_down(payload: Dict[str, Any]) -> None:
+    from skypilot_tpu import serve
+    serve.down(payload['service_name'], purge=payload.get('purge', False))
+
+
+def _tail_logs(payload: Dict[str, Any]) -> Dict[str, Any]:
+    # Output streams to this worker's stdout → request log; the client
+    # follows it via /api/stream (parity: /logs keeps the HTTP response
+    # open, server.py:647).
+    from skypilot_tpu import core
+    rc = core.tail_logs(payload['cluster_name'],
+                        job_id=payload.get('job_id'),
+                        follow=payload.get('follow', True))
+    return {'returncode': rc}
+
+
+def _jobs_logs(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import jobs
+    rc = jobs.tail_logs(job_id=payload.get('job_id'),
+                        follow=payload.get('follow', True),
+                        controller=payload.get('controller', False))
+    return {'returncode': rc}
+
+
+def _serve_logs(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import serve
+    rc = serve.tail_logs(payload['service_name'],
+                         follow=payload.get('follow', True))
+    return {'returncode': rc}
+
+
+EXECUTORS: Dict[str, Callable[[Dict[str, Any]], Any]] = {
+    'launch': _launch,
+    'exec': _exec,
+    'status': _status,
+    'start': _start,
+    'stop': _stop,
+    'down': _down,
+    'autostop': _autostop,
+    'queue': _queue,
+    'cancel': _cancel,
+    'cost_report': _cost_report,
+    'check': _check,
+    'storage_ls': _storage_ls,
+    'storage_delete': _storage_delete,
+    'jobs_launch': _jobs_launch,
+    'jobs_queue': _jobs_queue,
+    'jobs_cancel': _jobs_cancel,
+    'serve_up': _serve_up,
+    'serve_status': _serve_status,
+    'serve_down': _serve_down,
+    'logs': _tail_logs,
+    'jobs_logs': _jobs_logs,
+    'serve_logs': _serve_logs,
+}
+
+# LONG requests get a dedicated worker process (they can run for hours and
+# stream logs); everything else is quick state access.
+LONG_REQUESTS = {
+    'launch', 'exec', 'start', 'stop', 'down', 'jobs_launch', 'serve_up',
+    'serve_down', 'storage_delete', 'logs', 'jobs_logs', 'serve_logs',
+}
+
+
+def schedule_type_for(name: str):
+    from skypilot_tpu.server import requests_db
+    return (requests_db.ScheduleType.LONG if name in LONG_REQUESTS else
+            requests_db.ScheduleType.SHORT)

@@ -1,0 +1,185 @@
+"""The API server: every SDK verb as an async REST endpoint.
+
+Parity: ``sky/server/server.py`` (/launch:483, /status:532, /logs:647,
+/api/get:822, /api/stream:843) — aiohttp instead of FastAPI (not in this
+image). POSTing a verb schedules a request and returns its id; results are
+fetched via /api/get and logs followed via /api/stream.
+
+Run: ``python -m skypilot_tpu.server.server [--host H] [--port P]``.
+"""
+import argparse
+import asyncio
+import json
+import os
+
+from aiohttp import web
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.server import executor
+from skypilot_tpu.server import requests_db
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_PORT = 46590
+API_VERSION = '1'
+
+# Verb endpoints → request names (parity: the reference's per-verb routes).
+_VERB_ROUTES = {
+    '/launch': 'launch',
+    '/exec': 'exec',
+    '/status': 'status',
+    '/start': 'start',
+    '/stop': 'stop',
+    '/down': 'down',
+    '/autostop': 'autostop',
+    '/queue': 'queue',
+    '/cancel': 'cancel',
+    '/cost_report': 'cost_report',
+    '/check': 'check',
+    '/logs': 'logs',
+    '/storage/ls': 'storage_ls',
+    '/storage/delete': 'storage_delete',
+    '/jobs/launch': 'jobs_launch',
+    '/jobs/queue': 'jobs_queue',
+    '/jobs/cancel': 'jobs_cancel',
+    '/jobs/logs': 'jobs_logs',
+    '/serve/up': 'serve_up',
+    '/serve/status': 'serve_status',
+    '/serve/down': 'serve_down',
+    '/serve/logs': 'serve_logs',
+}
+
+
+def _json_error(exc: BaseException) -> dict:
+    return {'type': type(exc).__name__, 'message': str(exc)}
+
+
+def _request_record_json(rec: dict) -> dict:
+    out = {
+        'request_id': rec['request_id'],
+        'name': rec['name'],
+        'status': rec['status'].value,
+        'created_at': rec['created_at'],
+        'finished_at': rec['finished_at'],
+    }
+    if rec['status'] == requests_db.RequestStatus.SUCCEEDED:
+        out['return_value'] = rec['return_value']
+    if rec['exception'] is not None:
+        out['error'] = _json_error(rec['exception'])
+    return out
+
+
+async def handle_verb(request: web.Request) -> web.Response:
+    name = _VERB_ROUTES[request.path]
+    try:
+        payload = await request.json()
+    except json.JSONDecodeError:
+        payload = {}
+    request_id = await asyncio.get_event_loop().run_in_executor(
+        None, executor.schedule, name, payload)
+    return web.json_response({'request_id': request_id})
+
+
+async def handle_api_get(request: web.Request) -> web.Response:
+    request_id = request.query.get('request_id')
+    timeout = float(request.query.get('timeout', '0'))
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while True:
+        rec = await loop.run_in_executor(None, requests_db.get_request,
+                                         request_id)
+        if rec is None:
+            return web.json_response({'error': {
+                'type': 'KeyError',
+                'message': f'No request {request_id}'}}, status=404)
+        if rec['status'].is_terminal() or loop.time() >= deadline:
+            return web.json_response(_request_record_json(rec))
+        await asyncio.sleep(0.2)
+
+
+async def handle_api_stream(request: web.Request) -> web.StreamResponse:
+    """Follow a request's log until it finishes (parity: /api/stream)."""
+    request_id = request.query.get('request_id')
+    rec = requests_db.get_request(request_id)
+    if rec is None:
+        return web.json_response({'error': {
+            'type': 'KeyError', 'message': f'No request {request_id}'}},
+            status=404)
+    resp = web.StreamResponse()
+    resp.content_type = 'text/plain'
+    await resp.prepare(request)
+    log_file = requests_db.log_path(request_id)
+    pos = 0
+    loop = asyncio.get_event_loop()
+    while True:
+        if os.path.exists(log_file):
+            with open(log_file, 'rb') as f:
+                f.seek(pos)
+                chunk = f.read()
+            if chunk:
+                pos += len(chunk)
+                await resp.write(chunk)
+        rec = await loop.run_in_executor(None, requests_db.get_request,
+                                         request_id)
+        if rec is None or rec['status'].is_terminal():
+            # Drain any tail written between read and status check.
+            if os.path.exists(log_file):
+                with open(log_file, 'rb') as f:
+                    f.seek(pos)
+                    chunk = f.read()
+                if chunk:
+                    await resp.write(chunk)
+            break
+        await asyncio.sleep(0.2)
+    await resp.write_eof()
+    return resp
+
+
+async def handle_api_status(request: web.Request) -> web.Response:
+    limit = int(request.query.get('limit', '100'))
+    return web.json_response(requests_db.list_requests(limit=limit))
+
+
+async def handle_api_cancel(request: web.Request) -> web.Response:
+    payload = await request.json()
+    ok = requests_db.kill_request(payload['request_id'])
+    return web.json_response({'cancelled': ok})
+
+
+async def handle_health(request: web.Request) -> web.Response:
+    del request
+    import skypilot_tpu
+    return web.json_response({
+        'status': 'healthy',
+        'version': skypilot_tpu.__version__,
+        'api_version': API_VERSION,
+    })
+
+
+def build_app() -> web.Application:
+    app = web.Application()
+    for path in _VERB_ROUTES:
+        app.router.add_post(path, handle_verb)
+    app.router.add_get('/api/get', handle_api_get)
+    app.router.add_get('/api/stream', handle_api_stream)
+    app.router.add_get('/api/status', handle_api_status)
+    app.router.add_post('/api/cancel', handle_api_cancel)
+    app.router.add_get('/health', handle_health)
+    return app
+
+
+def run(host: str = '127.0.0.1', port: int = DEFAULT_PORT) -> None:
+    logger.info(f'API server on http://{host}:{port}')
+    web.run_app(build_app(), host=host, port=port, print=None)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--host', default='127.0.0.1')
+    parser.add_argument('--port', type=int, default=DEFAULT_PORT)
+    args = parser.parse_args()
+    run(args.host, args.port)
+
+
+if __name__ == '__main__':
+    main()

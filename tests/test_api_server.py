@@ -1,0 +1,147 @@
+"""API server + client SDK + CLI against the Local cloud.
+
+Parity model: the reference's client/server in-proc tier
+(tests/common_test_fixtures.py mock_client_requests) — here the server is
+the REAL server process (auto-started by the SDK, like production), with
+$HOME isolated per test.
+"""
+import os
+import socket
+import subprocess
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu.client import sdk
+
+
+@pytest.fixture
+def api_env(monkeypatch):
+    global_state.set_enabled_clouds(['Local'])
+    with socket.socket() as s:
+        s.bind(('', 0))
+        port = s.getsockname()[1]
+    monkeypatch.setenv('SKYTPU_API_SERVER_URL',
+                       f'http://127.0.0.1:{port}')
+    yield port
+    subprocess.run(['pkill', '-f',
+                    f'skypilot_tpu.server.server --port {port}'],
+                   check=False)
+
+
+def _local_task(name, run):
+    task = sky.Task(name=name, run=run)
+    task.set_resources(sky.Resources(cloud='local'))
+    return task
+
+
+def test_sdk_roundtrip(api_env):
+    # launch auto-starts the server, provisions, runs.
+    rid = sdk.launch(_local_task('api-hello', 'echo api-hello-out'),
+                     cluster_name='api-c1')
+    result = sdk.get(rid)
+    assert result['job_id'] == 1
+    assert result['cluster_name'] == 'api-c1'
+
+    # status through the server.
+    records = sdk.get(sdk.status())
+    assert len(records) == 1
+    assert records[0]['name'] == 'api-c1'
+    assert records[0]['status'] == 'UP'
+
+    # queue + wait job done.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        jobs = sdk.get(sdk.queue('api-c1'))
+        if jobs and jobs[0]['status'] == 'SUCCEEDED':
+            break
+        time.sleep(0.5)
+    assert jobs[0]['status'] == 'SUCCEEDED'
+
+    # logs: streamed through the request log.
+    import io
+    buf = io.StringIO()
+    sdk.stream_and_get(sdk.tail_logs('api-c1', 1, follow=False),
+                       output=buf)
+    assert 'api-hello-out' in buf.getvalue()
+
+    # exec on existing cluster.
+    rid = sdk.exec_(_local_task('api-second', 'echo second'),
+                    cluster_name='api-c1')
+    assert sdk.get(rid)['job_id'] == 2
+
+    sdk.get(sdk.down('api-c1'))
+    assert sdk.get(sdk.status()) == []
+
+
+def test_sdk_error_reconstruction(api_env):
+    rid = sdk.down('no-such-cluster-xyz')
+    with pytest.raises(exceptions.ClusterDoesNotExist):
+        sdk.get(rid)
+
+
+def test_api_status_and_requests(api_env):
+    rid = sdk.status()
+    sdk.get(rid)
+    records = sdk.api_status()
+    assert any(r['request_id'] == rid for r in records)
+    rec = [r for r in records if r['request_id'] == rid][0]
+    assert rec['name'] == 'status'
+    assert rec['status'] == 'SUCCEEDED'
+
+
+def test_cli_end_to_end(api_env, tmp_path):
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli as cli_mod
+    yaml_path = tmp_path / 'task.yaml'
+    yaml_path.write_text(
+        'name: cli-task\n'
+        'resources:\n  cloud: local\n'
+        'run: echo from-the-cli\n')
+    runner = CliRunner()
+    res = runner.invoke(cli_mod.cli,
+                        ['launch', str(yaml_path), '-c', 'cli-c1', '-d'])
+    assert res.exit_code == 0, res.output
+    assert 'Job submitted' in res.output
+
+    res = runner.invoke(cli_mod.cli, ['status'])
+    assert res.exit_code == 0, res.output
+    assert 'cli-c1' in res.output
+
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        res = runner.invoke(cli_mod.cli, ['queue', 'cli-c1'])
+        if 'SUCCEEDED' in res.output:
+            break
+        time.sleep(0.5)
+    assert 'SUCCEEDED' in res.output
+
+    res = runner.invoke(cli_mod.cli,
+                        ['logs', 'cli-c1', '1', '--no-follow'])
+    assert 'from-the-cli' in res.output, res.output
+
+    res = runner.invoke(cli_mod.cli, ['down', 'cli-c1'])
+    assert res.exit_code == 0, res.output
+    res = runner.invoke(cli_mod.cli, ['status'])
+    assert 'No existing clusters' in res.output
+
+
+def test_cli_show_tpus():
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli as cli_mod
+    res = CliRunner().invoke(cli_mod.cli, ['show-tpus'])
+    assert res.exit_code == 0, res.output
+    assert 'tpu-v5p' in res.output or 'tpu-v5e' in res.output
+
+
+def test_cli_help_surface():
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli as cli_mod
+    res = CliRunner().invoke(cli_mod.cli, ['--help'])
+    for cmd in ('launch', 'exec', 'status', 'stop', 'start', 'down',
+                'autostop', 'queue', 'cancel', 'logs', 'jobs', 'serve',
+                'storage', 'check', 'cost-report', 'show-tpus', 'api'):
+        assert cmd in res.output, f'missing {cmd}'
