@@ -143,13 +143,14 @@ class GcsStore(AbstractStore):
         args = ['-m', 'rsync', '-r']
         if reincludes:
             # gitignore '!' re-includes cannot be expressed with pattern
-            # alternation; exclude the exact resolved file set instead
+            # alternation; exclude the exact resolved path set instead
             # (same walker the LocalStore uses, so bucket contents match
-            # across stores — and nested paths keep their keys).
-            excluded = storage_utils.list_excluded_files(src)
-            if excluded:
-                args += ['-x', '|'.join(
-                    '^' + re.escape(rel) + '$' for rel in excluded)]
+            # across stores). Wholly-excluded dirs are one prefix each.
+            ex_dirs, ex_files = storage_utils.list_excluded_paths(src)
+            parts = ['^' + re.escape(d) + '/' for d in ex_dirs]
+            parts += ['^' + re.escape(f) + '$' for f in ex_files]
+            if parts:
+                args += ['-x', '|'.join(parts)]
         elif excludes:
             # gsutil honors a single -x regex; alternation joins patterns.
             regex = '|'.join(

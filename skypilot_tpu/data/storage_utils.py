@@ -41,22 +41,37 @@ def split_negations(patterns: List[str]) -> Tuple[List[str], List[str]]:
     return excludes, reincludes
 
 
-def list_excluded_files(src_dir: str) -> List[str]:
-    """Relative paths of every file under ``src_dir`` that the ignore rules
-    (incl. '!' re-includes) exclude from upload — the exact complement of
-    ``list_files_to_upload``."""
+def list_excluded_paths(src_dir: str) -> Tuple[List[str], List[str]]:
+    """→ (excluded_dirs, excluded_files), relative paths.
+
+    The exact complement of ``list_files_to_upload``, kept compact:
+    a wholly-excluded directory is one prefix entry, not a file-per-file
+    enumeration (a real repo's ``.git/`` alone holds tens of thousands of
+    objects — enumerating them would blow past argv limits downstream).
+    Per gitignore semantics, files under an excluded directory cannot be
+    re-included, so pruning at the directory is lossless.
+    """
     src_dir = os.path.expanduser(src_dir)
     excludes, reincludes = split_negations(get_excluded_files(src_dir))
-    out: List[str] = []
-    for root, _, files in os.walk(src_dir):
+    dirs_out: List[str] = []
+    files_out: List[str] = []
+    for root, dirs, files in os.walk(src_dir):
         rel_root = os.path.relpath(root, src_dir)
         if rel_root == '.':
             rel_root = ''
+        keep = []
+        for d in dirs:
+            rel = os.path.join(rel_root, d) if rel_root else d
+            if _excluded(rel, excludes) and not _excluded(rel, reincludes):
+                dirs_out.append(rel)
+            else:
+                keep.append(d)
+        dirs[:] = keep
         for name in files:
             rel = os.path.join(rel_root, name) if rel_root else name
             if _excluded(rel, excludes) and not _excluded(rel, reincludes):
-                out.append(rel)
-    return out
+                files_out.append(rel)
+    return dirs_out, files_out
 
 
 def _excluded(rel_path: str, patterns: List[str]) -> bool:
@@ -79,10 +94,13 @@ def list_files_to_upload(src_dir: str) -> List[Tuple[str, str]]:
         rel_root = os.path.relpath(root, src_dir)
         if rel_root == '.':
             rel_root = ''
+        # Prune excluded dirs (unless the dir itself is re-included):
+        # gitignore semantics — files under an excluded dir cannot be
+        # re-included, so descending is pointless.
         dirs[:] = [
             d for d in dirs
             if not _excluded(os.path.join(rel_root, d), excludes) or
-            reincludes
+            _excluded(os.path.join(rel_root, d), reincludes)
         ]
         for name in files:
             rel = os.path.join(rel_root, name) if rel_root else name

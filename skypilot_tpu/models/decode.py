@@ -1,0 +1,204 @@
+"""Batched autoregressive inference: prefill + KV-cache decode.
+
+The serving-side compute path (the reference serves LLMs via vLLM examples,
+``llm/vllm/service.yaml``; the TPU-native analogue is a JetStream-style
+static-shape engine):
+
+* KV cache preallocated at [L, B, max_len, Hkv, hd] — static shapes, so
+  one compiled decode step serves every position (XLA requirement).
+* Prefill runs the full forward once (flash/ring attention applies),
+  writing the cache; decode is a ``lax.scan`` of single-token steps whose
+  attention reads the cache with a position mask (no recompilation, MXU
+  does [B,1,d]x[d,*] matmuls batched over the whole batch).
+* Greedy or temperature sampling; generation stops per-sequence on EOS
+  via a done mask (static loop length, masked writes).
+"""
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.ops import attention as attention_ops
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeConfig:
+    max_len: int = 2048
+    temperature: float = 0.0          # 0 = greedy
+    eos_id: Optional[int] = None
+
+
+def init_kv_cache(cfg: llama.LlamaConfig, batch: int,
+                  max_len: int) -> Dict[str, jax.Array]:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        'k': jnp.zeros(shape, cfg.dtype),
+        'v': jnp.zeros(shape, cfg.dtype),
+    }
+
+
+def _attend_cached(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                   cur_len: jax.Array) -> jax.Array:
+    """q [B,1,H,hd] against cache [B,max_len,Hkv,hd]; positions >= cur_len
+    masked out."""
+    b, _, h, hd = q.shape
+    hkv = k_cache.shape[2]
+    k = attention_ops.repeat_kv(k_cache, h // hkv)
+    v = attention_ops.repeat_kv(v_cache, h // hkv)
+    scale = hd**-0.5
+    logits = jnp.einsum('bshd,bthd->bhst', q, k,
+                        preferred_element_type=jnp.float32) * scale
+    kv_pos = jnp.arange(k.shape[1])
+    mask = kv_pos[None, :] < cur_len[:, None]          # [B, max_len]
+    logits = jnp.where(mask[:, None, None, :], logits,
+                       attention_ops.NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum('bhst,bthd->bshd', probs, v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def _block_decode(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
+                  k_cache: jax.Array, v_cache: jax.Array,
+                  cos: jax.Array, sin: jax.Array, pos: jax.Array
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decoder block for one new token; returns (x, k_new, v_new)."""
+    b, s, _ = x.shape  # s == 1
+    hd = cfg.head_dim
+    h = llama.rms_norm(x, layer['attn_norm'], cfg.norm_eps)
+    q = (h @ layer['wq']).reshape(b, s, cfg.n_heads, hd)
+    k = (h @ layer['wk']).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (h @ layer['wv']).reshape(b, s, cfg.n_kv_heads, hd)
+    q = llama.apply_rope(q, cos, sin)
+    k = llama.apply_rope(k, cos, sin)
+    # Insert this step's K/V at each sequence's current position.
+    b_idx = jnp.arange(b)
+    k_cache = k_cache.at[b_idx, pos].set(k[:, 0])
+    v_cache = v_cache.at[b_idx, pos].set(v[:, 0])
+    attn = _attend_cached(q, k_cache, v_cache, cur_len=pos + 1)
+    attn = attn.reshape(b, s, cfg.n_heads * hd)
+    x = x + (attn @ layer['wo']).astype(cfg.dtype)
+
+    h = llama.rms_norm(x, layer['ffn_norm'], cfg.norm_eps)
+    gate = jax.nn.silu((h @ layer['w1']).astype(jnp.float32))
+    up = (h @ layer['w3']).astype(jnp.float32)
+    down = ((gate * up).astype(cfg.dtype)) @ layer['w2']
+    return x + down.astype(cfg.dtype), k_cache, v_cache
+
+
+def prefill(params: Params, tokens: jax.Array, cfg: llama.LlamaConfig,
+            cache: Dict[str, jax.Array], prompt_lens: jax.Array
+            ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Run the prompt through the model, filling the cache.
+
+    tokens [B, S_prompt] (right-padded); returns (logits at each
+    sequence's last prompt token [B, vocab], cache).
+    """
+    b, s = tokens.shape
+    positions = jnp.arange(s, dtype=jnp.int32)
+    cos, sin = llama._rope_freqs(cfg, positions)  # pylint: disable=protected-access
+    x = params['tok_embedding'][tokens].astype(cfg.dtype)
+    hd = cfg.head_dim
+
+    def body(carry, layer_kv):
+        xc = carry
+        layer = layer_kv
+        h = llama.rms_norm(xc, layer['attn_norm'], cfg.norm_eps)
+        q = (h @ layer['wq']).reshape(b, s, cfg.n_heads, hd)
+        k = (h @ layer['wk']).reshape(b, s, cfg.n_kv_heads, hd)
+        v = (h @ layer['wv']).reshape(b, s, cfg.n_kv_heads, hd)
+        q = llama.apply_rope(q, cos, sin)
+        k = llama.apply_rope(k, cos, sin)
+        attn = attention_ops.gqa_attention(q, k, v, causal=True)
+        attn = attn.reshape(b, s, cfg.n_heads * hd)
+        xc = xc + (attn @ layer['wo']).astype(cfg.dtype)
+        h = llama.rms_norm(xc, layer['ffn_norm'], cfg.norm_eps)
+        gate = jax.nn.silu((h @ layer['w1']).astype(jnp.float32))
+        up = (h @ layer['w3']).astype(jnp.float32)
+        down = ((gate * up).astype(cfg.dtype)) @ layer['w2']
+        return xc + down.astype(cfg.dtype), (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, params['layers'])
+    # ks/vs: [L, B, S, Hkv, hd] → cache prefix.
+    cache = {
+        'k': cache['k'].at[:, :, :s].set(ks),
+        'v': cache['v'].at[:, :, :s].set(vs),
+    }
+    x = llama.rms_norm(x, params['out_norm'], cfg.norm_eps)
+    logits = (x @ params['lm_head']).astype(jnp.float32)  # [B, S, V]
+    last = jnp.take_along_axis(
+        logits, (prompt_lens - 1)[:, None, None], axis=1)[:, 0]
+    return last, cache
+
+
+def decode_step(params: Params, token: jax.Array, pos: jax.Array,
+                cfg: llama.LlamaConfig, cache: Dict[str, jax.Array]
+                ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """token [B] at positions pos [B] → (logits [B, vocab], cache)."""
+    b = token.shape[0]
+    cos, sin = llama._rope_freqs(cfg, pos[:, None])  # pylint: disable=protected-access
+    x = params['tok_embedding'][token][:, None].astype(cfg.dtype)
+
+    def body(carry, layer_kv):
+        xc = carry
+        layer, k_cache, v_cache = layer_kv
+        xc, k_new, v_new = _block_decode(cfg, xc, layer, k_cache, v_cache,
+                                         cos, sin, pos)
+        return xc, (k_new, v_new)
+
+    x, (ks, vs) = jax.lax.scan(body, x,
+                               (params['layers'], cache['k'], cache['v']))
+    cache = {'k': ks, 'v': vs}
+    x = llama.rms_norm(x, params['out_norm'], cfg.norm_eps)
+    logits = (x[:, 0] @ params['lm_head']).astype(jnp.float32)
+    return logits, cache
+
+
+def _sample(logits: jax.Array, key: jax.Array,
+            temperature: float) -> jax.Array:
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(key, logits / temperature,
+                                  axis=-1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=('cfg', 'dcfg', 'max_new_tokens'))
+def generate(params: Params,
+             prompt: jax.Array,
+             prompt_lens: jax.Array,
+             cfg: llama.LlamaConfig,
+             dcfg: DecodeConfig,
+             max_new_tokens: int,
+             rng: Optional[jax.Array] = None) -> jax.Array:
+    """prompt [B, S_prompt] right-padded → generated tokens
+    [B, max_new_tokens] (post-EOS positions hold eos_id)."""
+    b, s_prompt = prompt.shape
+    assert s_prompt + max_new_tokens <= dcfg.max_len
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    cache = init_kv_cache(cfg, b, dcfg.max_len)
+    last_logits, cache = prefill(params, prompt, cfg, cache, prompt_lens)
+
+    first = _sample(last_logits, rng, dcfg.temperature)
+    done0 = (jnp.full((b,), False) if dcfg.eos_id is None else
+             first == dcfg.eos_id)
+
+    def step(carry, key):
+        token, pos, cache_c, done = carry
+        logits, cache_c = decode_step(params, token, pos, cfg, cache_c)
+        nxt = _sample(logits, key, dcfg.temperature)
+        if dcfg.eos_id is not None:
+            nxt = jnp.where(done, dcfg.eos_id, nxt)
+            done = done | (nxt == dcfg.eos_id)
+        return (nxt, pos + 1, cache_c, done), nxt
+
+    keys = jax.random.split(rng, max_new_tokens - 1) \
+        if max_new_tokens > 1 else jnp.zeros((0, 2), jnp.uint32)
+    (_, _, _, _), rest = jax.lax.scan(
+        step, (first, prompt_lens, cache, done0), keys)
+    return jnp.concatenate([first[:, None], rest.T], axis=1)

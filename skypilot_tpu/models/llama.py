@@ -47,6 +47,10 @@ class LlamaConfig:
     remat: bool = True
     # Use ring attention (sequence parallelism over the 'seq' mesh axis).
     ring_attention: bool = False
+    # Use the Pallas flash-attention kernel (TPU; falls back to the XLA
+    # path off-TPU). Wins at long sequence lengths where [S,S] logits
+    # would pressure HBM.
+    flash_attention: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -188,6 +192,9 @@ def _block(cfg: LlamaConfig, x: jax.Array, layer: Params, cos: jax.Array,
     k = apply_rope(k, cos, sin)
     if seq_axis_sharded:
         attn_out = attention_ops.ring_attention(q, k, v, axis_name=SEQ_AXIS)
+    elif cfg.flash_attention:
+        from skypilot_tpu.ops import flash_attention as fa
+        attn_out = fa.flash_attention(q, k, v, True)
     else:
         attn_out = attention_ops.gqa_attention(q, k, v, causal=True)
     attn_out = attn_out.reshape(b, s, cfg.n_heads * hd)

@@ -1,0 +1,209 @@
+"""Mixtral-family Mixture-of-Experts decoder with expert parallelism.
+
+TPU-first MoE (SURVEY §2.11 "EP" — absent from the reference's library,
+present in its serving examples via vLLM flags):
+
+* Router → top-k gating → **capacity-bounded dispatch/combine einsums**
+  (GShard/Switch style): token routing is two dense einsums against
+  one-hot dispatch masks, so everything stays static-shaped on the MXU —
+  no gather/scatter, no data-dependent shapes under jit.
+* Expert weights carry an 'expert' mesh-axis sharding; under GSPMD the
+  dispatch einsum lowers to the all-to-all over the expert axis, XLA
+  choosing the collective schedule over ICI.
+* Dropped tokens (over capacity) fall through the residual connection —
+  standard Switch behavior; an aux load-balancing loss keeps the router
+  spread.
+
+Attention/norm/RoPE are shared with ``models.llama``.
+"""
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.parallel.mesh import EXPERT_AXIS, FSDP_AXIS, MODEL_AXIS
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig(llama.LlamaConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    # Per-expert capacity = top_k * tokens / n_experts * capacity_factor.
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
+
+
+CONFIGS: Dict[str, MoeConfig] = {
+    'mixtral-8x7b': MoeConfig(dim=4096, n_layers=32, n_heads=32,
+                              n_kv_heads=8, ffn_dim=14336, n_experts=8,
+                              top_k=2),
+    'moe-debug': MoeConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                           n_kv_heads=2, ffn_dim=96, n_experts=4, top_k=2,
+                           max_seq_len=128, remat=False),
+}
+
+
+# ------------------------------------------------------------------- init
+
+
+def init_params(key: jax.Array, cfg: MoeConfig) -> Params:
+    base = llama.init_params(key, cfg)
+    init = jax.nn.initializers.normal(stddev=0.02)
+    k_router, k1, k2, k3 = jax.random.split(jax.random.fold_in(key, 7), 4)
+    layers = dict(base['layers'])
+    # Replace the dense FFN with router + per-expert SwiGLU stacks.
+    for name in ('w1', 'w2', 'w3'):
+        del layers[name]
+    e, d, f = cfg.n_experts, cfg.dim, cfg.ffn_dim
+    layers['router'] = init(k_router, (cfg.n_layers, d, e), jnp.float32)
+    layers['we1'] = init(k1, (cfg.n_layers, e, d, f), cfg.dtype)
+    layers['we3'] = init(k2, (cfg.n_layers, e, d, f), cfg.dtype)
+    layers['we2'] = init(k3, (cfg.n_layers, e, f, d), cfg.dtype)
+    base['layers'] = layers
+    return base
+
+
+def param_partition_specs(cfg: MoeConfig) -> Params:
+    base = llama.param_partition_specs(cfg)
+    layers = dict(base['layers'])
+    for name in ('w1', 'w2', 'w3'):
+        del layers[name]
+    layers['router'] = P(None, FSDP_AXIS, None)
+    # Experts over the 'expert' axis; within an expert, megatron-style.
+    layers['we1'] = P(None, EXPERT_AXIS, FSDP_AXIS, MODEL_AXIS)
+    layers['we3'] = P(None, EXPERT_AXIS, FSDP_AXIS, MODEL_AXIS)
+    layers['we2'] = P(None, EXPERT_AXIS, MODEL_AXIS, FSDP_AXIS)
+    base['layers'] = layers
+    return base
+
+
+# ---------------------------------------------------------------- routing
+
+
+def _route(h: jax.Array, router: jax.Array, cfg: MoeConfig
+           ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """h [T, d] → (dispatch [T, E, C] bool, combine [T, E, C] f32, aux).
+
+    GShard top-k routing with per-expert capacity C.
+    """
+    t = h.shape[0]
+    e = cfg.n_experts
+    capacity = max(1, int(cfg.top_k * t / e * cfg.capacity_factor))
+
+    logits = (h.astype(jnp.float32) @ router)            # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, cfg.top_k)  # [T, K]
+    # Renormalize the kept gates (Mixtral convention).
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    # Position of each (token, k) within its expert's capacity: a cumsum
+    # over the one-hot expert assignment, k-major so first choices win
+    # slots before second choices.
+    onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.int32)  # [T, K, E]
+    flat = onehot.transpose(1, 0, 2).reshape(cfg.top_k * t, e)  # k-major
+    pos_flat = jnp.cumsum(flat, axis=0) - flat               # [K*T, E]
+    pos = pos_flat.reshape(cfg.top_k, t, e).transpose(1, 0, 2)  # [T, K, E]
+    within_cap = (pos < capacity) & (onehot == 1)            # [T, K, E]
+
+    slot = jnp.sum(pos * onehot, axis=-1)                    # [T, K]
+    slot_onehot = jax.nn.one_hot(slot, capacity,
+                                 dtype=jnp.float32)          # [T, K, C]
+    keep = jnp.any(within_cap, axis=-1).astype(jnp.float32)  # [T, K]
+
+    # combine[t, e, c] = gate weight of token t in expert e slot c.
+    combine = jnp.einsum('tke,tkc,tk,tk->tec',
+                         onehot.astype(jnp.float32), slot_onehot,
+                         gate_vals, keep)
+    dispatch = combine > 0.0
+
+    # Switch aux loss: fraction-of-assignments · mean-prob per expert.
+    frac_tokens = jnp.mean(onehot.sum(axis=1) / cfg.top_k, axis=0)  # [E]
+    mean_probs = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac_tokens * mean_probs)
+    return dispatch, combine, aux
+
+
+def moe_ffn(h: jax.Array, layer: Params, cfg: MoeConfig
+            ) -> Tuple[jax.Array, jax.Array]:
+    """h [B, S, d] → (out [B, S, d], aux loss). SwiGLU per expert."""
+    b, s, d = h.shape
+    flat = h.reshape(b * s, d)
+    dispatch, combine, aux = _route(flat, layer['router'], cfg)
+    # [T,E,C] x [T,d] → expert inputs [E,C,d]; under GSPMD with 'expert'-
+    # sharded weights this is the EP all-to-all.
+    xin = jnp.einsum('tec,td->ecd', dispatch.astype(cfg.dtype), flat)
+    gate = jax.nn.silu(jnp.einsum(
+        'ecd,edf->ecf', xin, layer['we1'],
+        preferred_element_type=jnp.float32))
+    up = jnp.einsum('ecd,edf->ecf', xin, layer['we3'],
+                    preferred_element_type=jnp.float32)
+    act = (gate * up).astype(cfg.dtype)
+    xout = jnp.einsum('ecf,efd->ecd', act, layer['we2'],
+                      preferred_element_type=jnp.float32)  # [E,C,d]
+    out = jnp.einsum('tec,ecd->td', combine, xout.astype(jnp.float32))
+    return out.reshape(b, s, d).astype(cfg.dtype), aux
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _moe_block(cfg: MoeConfig, x: jax.Array, layer: Params,
+               cos: jax.Array, sin: jax.Array) -> Tuple[jax.Array,
+                                                        jax.Array]:
+    b, s, _ = x.shape
+    hd = cfg.head_dim
+    h = llama.rms_norm(x, layer['attn_norm'], cfg.norm_eps)
+    q = (h @ layer['wq']).reshape(b, s, cfg.n_heads, hd)
+    k = (h @ layer['wk']).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (h @ layer['wv']).reshape(b, s, cfg.n_kv_heads, hd)
+    q = llama.apply_rope(q, cos, sin)
+    k = llama.apply_rope(k, cos, sin)
+    attn_out = attention_ops.gqa_attention(q, k, v, causal=True)
+    attn_out = attn_out.reshape(b, s, cfg.n_heads * hd)
+    x = x + (attn_out @ layer['wo']).astype(cfg.dtype)
+
+    h = llama.rms_norm(x, layer['ffn_norm'], cfg.norm_eps)
+    ffn_out, aux = moe_ffn(h, layer, cfg)
+    return x + ffn_out, aux
+
+
+def forward(params: Params,
+            tokens: jax.Array,
+            cfg: MoeConfig,
+            positions: Optional[jax.Array] = None
+            ) -> Tuple[jax.Array, jax.Array]:
+    """tokens [B,S] → (logits [B,S,V] f32, aux router loss scalar)."""
+    _, s = tokens.shape
+    if positions is None:
+        positions = jnp.arange(s, dtype=jnp.int32)
+    cos, sin = llama._rope_freqs(cfg, positions)  # pylint: disable=protected-access
+    x = params['tok_embedding'][tokens].astype(cfg.dtype)
+
+    def body(carry, layer):
+        out, aux = _moe_block(cfg, carry, layer, cos, sin)
+        return out, aux
+
+    if cfg.remat:
+        body = jax.checkpoint(body, prevent_cse=False)
+    x, aux_per_layer = jax.lax.scan(body, x, params['layers'])
+
+    x = llama.rms_norm(x, params['out_norm'], cfg.norm_eps)
+    logits = (x @ params['lm_head']).astype(jnp.float32)
+    return logits, jnp.sum(aux_per_layer) * cfg.router_aux_coef
+
+
+def loss_fn(params: Params, tokens: jax.Array, targets: jax.Array,
+            cfg: MoeConfig) -> jax.Array:
+    logits, aux = forward(params, tokens, cfg)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None],
+                               axis=-1).squeeze(-1)
+    return jnp.mean(logz - gold) + aux

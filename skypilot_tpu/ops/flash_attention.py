@@ -1,0 +1,174 @@
+"""Flash attention for TPU, written in Pallas.
+
+TPU-native replacement for the dense attention path when sequences are
+long: the [S, S] logits matrix never materializes in HBM — each Q block
+streams K/V blocks through VMEM with an online-softmax accumulator (the
+same recurrence ``parallel/ring.py`` uses across chips, here across VMEM
+blocks within a chip). Causal blocks that are fully masked are skipped.
+
+Forward is the Pallas kernel; backward (for training) recomputes through
+the XLA path via ``jax.custom_vjp`` — correct gradients everywhere, with
+the kernel's memory win applying to inference/prefill and to the remat'd
+forward. Falls back to the XLA path off-TPU (tests run the kernel in
+interpreter mode to check numerics).
+"""
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from skypilot_tpu.ops import attention as attention_ops
+
+NEG_INF = -1e30
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_len: int,
+                  causal: bool, scale: float):
+    """One (batch·head, q-block) program: stream K/V blocks, fold online.
+
+    q_ref: [1, block_q, d]; k_ref/v_ref: [1, seq_len, d]; o_ref like q_ref.
+    """
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
+    q_start = qi * block_q
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    num_k_blocks = pl.cdiv(seq_len, block_k)
+    if causal:
+        # K blocks beyond this q block's last row are fully masked.
+        num_k_blocks = pl.cdiv(q_start + block_q, block_k)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, bk]
+        if causal:
+            kv_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            mask = q_pos >= kv_pos
+            logits = jnp.where(mask, logits, NEG_INF)
+        m_blk = jnp.max(logits, axis=-1, keepdims=True)    # [bq, 1]
+        m_new = jnp.maximum(m, m_blk)
+        safe_m = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        p = jnp.exp(logits - safe_m)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        correction = jnp.where(m == NEG_INF, 0.0, jnp.exp(m - safe_m))
+        l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, d]
+        return m_new, l_new, acc * correction + pv
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-20)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
+                   block_q: int, block_k: int,
+                   interpret: bool) -> jax.Array:
+    """q [B,S,H,D], k/v [B,S,Hkv,D] → [B,S,H,D]."""
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    n_rep = h // hkv
+    # [B,S,H,D] → [B*H, S, D]; KV heads indexed via the block index map so
+    # GQA fan-out never materializes.
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
+
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    grid = (b * h, s // block_q)
+
+    def q_index(bh, qi):
+        return (bh, qi, 0)
+
+    def kv_index(bh, qi):
+        del qi
+        # bh indexes [B*H]; its KV row is (batch, kv_head) flattened.
+        return ((bh // h) * hkv + (bh % h) // n_rep, 0, 0)
+
+    kernel = functools.partial(_flash_kernel, block_k=block_k,
+                               seq_len=s, causal=causal,
+                               scale=d**-0.5)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, s, d), kv_index),
+            pl.BlockSpec((1, s, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), q_index),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q: jax.Array,
+                    k: jax.Array,
+                    v: jax.Array,
+                    causal: bool = True,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Drop-in for ``attention_ops.gqa_attention`` on full sequences.
+
+    Shapes must tile: S divisible by the (clamped) block sizes. Off-TPU
+    the XLA path runs instead unless ``interpret=True`` forces the kernel
+    through the Pallas interpreter (tests).
+    """
+    interpret = _resolve_interpret(interpret)
+    s = q.shape[1]
+    bq, bk = min(block_q, s), min(block_k, s)
+    if interpret is None or s % bq or s % bk:
+        # Off-TPU, or S does not tile: the XLA path is exact and safe
+        # (an untiled grid would silently leave output rows unwritten).
+        return attention_ops.gqa_attention(q, k, v, causal=causal)
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _resolve_interpret(interpret: Optional[bool]) -> Optional[bool]:
+    """True → interpreter, False → compiled kernel, None → XLA fallback."""
+    if interpret is True:
+        return True
+    if interpret is False:
+        return False
+    return False if jax.default_backend() == 'tpu' else None
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, interpret, res, g):
+    # Recompute through the XLA path for gradients: exact, lets remat'd
+    # forwards still use the kernel. (A full Pallas backward is a later
+    # optimization; the bench tracks whether it pays.)
+    del block_q, block_k, interpret
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_ops.gqa_attention(
+            q_, k_, v_, causal=causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -15,10 +15,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = 'data'
 FSDP_AXIS = 'fsdp'
+EXPERT_AXIS = 'expert'
 MODEL_AXIS = 'model'
 SEQ_AXIS = 'seq'
 
-AXIS_ORDER = (DATA_AXIS, FSDP_AXIS, SEQ_AXIS, MODEL_AXIS)
+AXIS_ORDER = (DATA_AXIS, FSDP_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -26,6 +27,7 @@ class MeshConfig:
     """Named axis sizes; -1 on at most one axis = infer from device count."""
     data: int = 1
     fsdp: int = -1
+    expert: int = 1
     seq: int = 1
     model: int = 1
 
@@ -33,6 +35,7 @@ class MeshConfig:
         sizes = {
             DATA_AXIS: self.data,
             FSDP_AXIS: self.fsdp,
+            EXPERT_AXIS: self.expert,
             SEQ_AXIS: self.seq,
             MODEL_AXIS: self.model,
         }

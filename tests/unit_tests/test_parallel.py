@@ -16,7 +16,8 @@ def test_virtual_device_count():
 
 def test_make_mesh_infer():
     mesh = make_mesh(MeshConfig(data=2, fsdp=-1, model=2))
-    assert mesh.shape == {'data': 2, 'fsdp': 2, 'seq': 1, 'model': 2}
+    assert mesh.shape == {'data': 2, 'fsdp': 2, 'expert': 1, 'seq': 1,
+                          'model': 2}
 
 
 def test_make_mesh_invalid():
