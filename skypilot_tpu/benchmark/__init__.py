@@ -1,0 +1,13 @@
+"""Benchmark subsystem: try a task on N resource candidates, compare $/step.
+
+Parity: ``sky/benchmark/`` (SURVEY §2.10) — `bench launch` starts one
+cluster per candidate resource config running the same task (instrumented
+with ``skypilot_tpu.callbacks``), `bench show` downloads each cluster's
+step-timing summary and reports steps/sec, $/hr, $/step and ETA, `bench
+down` tears the candidates down.
+"""
+from skypilot_tpu.benchmark.benchmark_utils import down
+from skypilot_tpu.benchmark.benchmark_utils import launch
+from skypilot_tpu.benchmark.benchmark_utils import show
+
+__all__ = ['launch', 'show', 'down']

@@ -1,0 +1,92 @@
+"""sqlite state for benchmarks (parity: sky/benchmark/benchmark_state.py)."""
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import db_utils
+
+_TABLES = """
+    CREATE TABLE IF NOT EXISTS benchmarks (
+        name TEXT PRIMARY KEY,
+        task_name TEXT,
+        launched_at REAL
+    );
+    CREATE TABLE IF NOT EXISTS benchmark_results (
+        benchmark TEXT,
+        cluster TEXT,
+        resources TEXT,
+        hourly_cost REAL,
+        summary_json TEXT,
+        PRIMARY KEY (benchmark, cluster)
+    );
+"""
+
+
+def db_path() -> str:
+    return os.path.join(os.path.expanduser('~'), '.skytpu',
+                        'benchmark.db')
+
+
+_CONN = db_utils.SqliteConn('benchmark', db_path, _TABLES)
+
+
+def _db() -> sqlite3.Connection:
+    return _CONN.get()
+
+
+def add_benchmark(name: str, task_name: Optional[str]) -> None:
+    with _db() as conn:
+        conn.execute('INSERT OR REPLACE INTO benchmarks VALUES (?,?,?)',
+                     (name, task_name, time.time()))
+
+
+def add_result(benchmark: str, cluster: str, resources: str,
+               hourly_cost: float) -> None:
+    with _db() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO benchmark_results '
+            '(benchmark, cluster, resources, hourly_cost) '
+            'VALUES (?,?,?,?)', (benchmark, cluster, resources,
+                                 hourly_cost))
+
+
+def update_summary(benchmark: str, cluster: str,
+                   summary: Dict[str, Any]) -> None:
+    with _db() as conn:
+        conn.execute(
+            'UPDATE benchmark_results SET summary_json=? WHERE '
+            'benchmark=? AND cluster=?',
+            (json.dumps(summary), benchmark, cluster))
+
+
+def get_benchmark(name: str) -> Optional[Dict[str, Any]]:
+    row = _db().execute('SELECT * FROM benchmarks WHERE name=?',
+                        (name,)).fetchone()
+    return dict(row) if row else None
+
+
+def get_benchmarks() -> List[Dict[str, Any]]:
+    rows = _db().execute('SELECT * FROM benchmarks').fetchall()
+    return [dict(r) for r in rows]
+
+
+def get_results(benchmark: str) -> List[Dict[str, Any]]:
+    rows = _db().execute(
+        'SELECT * FROM benchmark_results WHERE benchmark=? '
+        'ORDER BY cluster', (benchmark,)).fetchall()
+    out = []
+    for r in rows:
+        rec = dict(r)
+        raw = rec.pop('summary_json')
+        rec['summary'] = json.loads(raw) if raw else None
+        out.append(rec)
+    return out
+
+
+def remove_benchmark(name: str) -> None:
+    with _db() as conn:
+        conn.execute('DELETE FROM benchmarks WHERE name=?', (name,))
+        conn.execute('DELETE FROM benchmark_results WHERE benchmark=?',
+                     (name,))

@@ -1,0 +1,199 @@
+"""Benchmark orchestration: launch candidates, collect timings, summarize.
+
+Parity: ``sky/benchmark/benchmark_utils.py:437,493,589`` — one cluster per
+candidate resources dict, each running the task with
+``$SKYTPU_BENCH_LOG_DIR`` exported; `show` pulls each cluster's callback
+summary over the cluster's command runner and computes steps/sec, $/step,
+and cost-to-completion.
+"""
+import copy
+import json
+import os
+import posixpath
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.benchmark import benchmark_state
+from skypilot_tpu.callbacks import base as callback_base
+from skypilot_tpu.utils import subprocess_utils
+
+logger = sky_logging.init_logger(__name__)
+
+_REMOTE_BENCH_DIR = '~/.skytpu/bench'
+
+
+def cluster_name(benchmark: str, index: int) -> str:
+    return f'bench-{benchmark}-{index}'
+
+
+def launch(task: task_lib.Task,
+           benchmark: str,
+           candidates: List[Dict[str, Any]],
+           detach: bool = True) -> List[str]:
+    """Launch one cluster per candidate resources override.
+
+    ``candidates`` are resource-override dicts applied on top of the
+    task's resources (parity: CLI --benchmark with candidate configs).
+    Returns the launched cluster names.
+    """
+    from skypilot_tpu import execution
+    if not candidates:
+        raise exceptions.InvalidSkyError('No benchmark candidates.')
+    benchmark_state.add_benchmark(benchmark, task.name)
+    names = []
+    errors = []
+
+    def _launch_one(args) -> None:
+        i, override = args
+        cand_task = copy.copy(task)
+        base = next(iter(task.resources))
+        cand_task.set_resources(base.copy(**override))
+        cand_task.update_envs(
+            {callback_base.ENV_LOG_DIR: _REMOTE_BENCH_DIR})
+        name = cluster_name(benchmark, i)
+        try:
+            execution.launch(cand_task,
+                             cluster_name=name,
+                             detach_run=True,
+                             stream_logs=False)
+        except Exception as e:  # pylint: disable=broad-except
+            errors.append((name, e))
+            return
+        record = global_state.get_cluster_from_name(name)
+        hourly = 0.0
+        if record is not None:
+            hourly = record['handle'].get_hourly_price()
+        benchmark_state.add_result(benchmark, name, str(override), hourly)
+        names.append(name)
+
+    work = list(enumerate(candidates))
+    if detach:
+        subprocess_utils.run_in_parallel(_launch_one, work)
+    else:
+        for w in work:
+            _launch_one(w)
+    for name, e in errors:
+        logger.warning(f'benchmark candidate {name} failed to launch: {e}')
+    if not names:
+        raise exceptions.ResourcesUnavailableError(
+            f'Every benchmark candidate failed: {errors}')
+    return sorted(names)
+
+
+def _fetch_summary(cluster: str) -> Optional[Dict[str, Any]]:
+    record = global_state.get_cluster_from_name(cluster)
+    if record is None:
+        return None
+    handle = record['handle']
+    runner = handle.head_runner()
+    remote = posixpath.join(_REMOTE_BENCH_DIR,
+                            callback_base.SUMMARY_FILE)
+    with tempfile.TemporaryDirectory() as td:
+        local = os.path.join(td, 'summary.json')
+        try:
+            from skypilot_tpu.utils import command_runner as cr
+            if isinstance(runner, cr.LocalProcessRunner):
+                runner.rsync(remote.replace('~/', ''), local, up=False)
+            else:
+                runner.rsync(remote, local, up=False)
+            with open(local, encoding='utf-8') as f:
+                return json.load(f)
+        except Exception:  # pylint: disable=broad-except
+            return None
+
+
+def show(benchmark: str) -> List[Dict[str, Any]]:
+    """Collect fresh summaries and compute the comparison table.
+
+    Each row: cluster, resources, steps/sec, $/hr, $/step, ETA seconds
+    (when total_steps known).
+    """
+    if benchmark_state.get_benchmark(benchmark) is None:
+        raise exceptions.InvalidSkyError(
+            f'Benchmark {benchmark!r} not found.')
+    rows = []
+    for rec in benchmark_state.get_results(benchmark):
+        summary = _fetch_summary(rec['cluster']) or rec['summary']
+        if summary is not None:
+            benchmark_state.update_summary(benchmark, rec['cluster'],
+                                           summary)
+        row = {
+            'cluster': rec['cluster'],
+            'resources': rec['resources'],
+            'hourly_cost': rec['hourly_cost'],
+            'num_steps': None,
+            'steps_per_sec': None,
+            'cost_per_step': None,
+            'eta_seconds': None,
+        }
+        if summary and summary.get('num_steps', 0) > 1 and \
+                summary.get('last_step_time'):
+            steps = summary['num_steps']
+            elapsed = summary['last_step_time'] - summary[
+                'first_step_time']
+            if elapsed > 0:
+                sps = (steps - 1) / elapsed
+                row['num_steps'] = steps
+                row['steps_per_sec'] = sps
+                if rec['hourly_cost']:
+                    row['cost_per_step'] = rec['hourly_cost'] / 3600.0 / sps
+                total = summary.get('total_steps')
+                if total:
+                    row['eta_seconds'] = max(0.0, (total - steps) / sps)
+        rows.append(row)
+    return rows
+
+
+def down(benchmark: str, delete: bool = True) -> None:
+    """Tear down every candidate cluster (and optionally the records)."""
+    from skypilot_tpu import core
+    for rec in benchmark_state.get_results(benchmark):
+        try:
+            core.down(rec['cluster'])
+        except exceptions.ClusterDoesNotExist:
+            pass
+    if delete:
+        benchmark_state.remove_benchmark(benchmark)
+
+
+def format_results(rows: List[Dict[str, Any]]) -> str:
+    header = ('CLUSTER', 'RESOURCES', 'STEPS', 'STEPS/S', '$/HR',
+              '$/STEP', 'ETA')
+    out = []
+    for r in rows:
+        out.append((
+            r['cluster'], r['resources'],
+            str(r['num_steps']) if r['num_steps'] else '-',
+            f"{r['steps_per_sec']:.2f}" if r['steps_per_sec'] else '-',
+            f"{r['hourly_cost']:.2f}",
+            (f"{r['cost_per_step']:.6f}"
+             if r['cost_per_step'] is not None else '-'),
+            (f"{r['eta_seconds']:.0f}s"
+             if r['eta_seconds'] is not None else '-'),
+        ))
+    widths = [
+        max(len(header[i]), *(len(row[i]) for row in out)) if out else
+        len(header[i]) for i in range(len(header))
+    ]
+    lines = ['  '.join(h.ljust(widths[i]) for i, h in enumerate(header))]
+    for row in out:
+        lines.append('  '.join(c.ljust(widths[i])
+                               for i, c in enumerate(row)))
+    return '\n'.join(lines)
+
+
+def wait_for_steps(benchmark: str, min_steps: int,
+                   timeout: float = 300) -> bool:
+    """Block until every candidate has recorded >= min_steps (tests)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        rows = show(benchmark)
+        if rows and all((r['num_steps'] or 0) >= min_steps for r in rows):
+            return True
+        time.sleep(1)
+    return False

@@ -1,0 +1,17 @@
+"""In-training step-timing hooks feeding the benchmark subsystem.
+
+Parity: the ``sky-callback`` package (``sky/callbacks/``, SURVEY §2.10) —
+a tiny, dependency-free logger user training code calls per step; the
+benchmark collector reads the produced JSON to compute steps/sec, $/step
+and ETA. Works with any loop (JAX included) via ``init`` + ``on_step_end``
+or the ``step()`` context manager / ``instrument()`` wrapper.
+"""
+from skypilot_tpu.callbacks.base import BenchmarkCallback
+from skypilot_tpu.callbacks.base import init
+from skypilot_tpu.callbacks.base import instrument
+from skypilot_tpu.callbacks.base import on_step_begin
+from skypilot_tpu.callbacks.base import on_step_end
+from skypilot_tpu.callbacks.base import step
+
+__all__ = ['init', 'on_step_begin', 'on_step_end', 'step', 'instrument',
+           'BenchmarkCallback']

@@ -1,0 +1,132 @@
+"""Benchmark callback core: per-step timestamps → summary JSON.
+
+Parity: ``sky/callbacks/sky_callback/base.py`` — the summary file layout
+(boot time, first/last step timestamps, step count) is what
+``benchmark_utils`` downloads and summarizes. The log dir comes from
+``$SKYTPU_BENCH_LOG_DIR`` (exported by `bench launch`), defaulting to
+``~/.skytpu/bench`` so local runs also record.
+"""
+import contextlib
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Optional
+
+SUMMARY_FILE = 'summary.json'
+ENV_LOG_DIR = 'SKYTPU_BENCH_LOG_DIR'
+
+_global_cb: Optional['BenchmarkCallback'] = None
+
+
+class BenchmarkCallback:
+    """Writes a rolling summary.json with step timing statistics."""
+
+    # Rewrite the summary every N steps (cheap: one small JSON).
+    FLUSH_EVERY = 10
+
+    def __init__(self, log_dir: Optional[str] = None,
+                 total_steps: Optional[int] = None):
+        log_dir = log_dir or os.environ.get(ENV_LOG_DIR) or os.path.join(
+            os.path.expanduser('~'), '.skytpu', 'bench')
+        self.log_dir = os.path.expanduser(log_dir)
+        os.makedirs(self.log_dir, exist_ok=True)
+        self.total_steps = total_steps
+        self.boot_time = time.time()
+        self.first_step_time: Optional[float] = None
+        self.last_step_time: Optional[float] = None
+        self.num_steps = 0
+        self._step_start: Optional[float] = None
+        self._lock = threading.Lock()
+        self._flush()
+
+    def on_step_begin(self) -> None:
+        now = time.time()
+        with self._lock:
+            if self.first_step_time is None:
+                self.first_step_time = now
+            self._step_start = now
+
+    def on_step_end(self) -> None:
+        now = time.time()
+        with self._lock:
+            if self.first_step_time is None:
+                # Loop that only calls on_step_end: first end bounds step 1.
+                self.first_step_time = self._step_start or now
+            self.num_steps += 1
+            self.last_step_time = now
+            if self.num_steps % self.FLUSH_EVERY == 0:
+                self._flush_locked()
+
+    @contextlib.contextmanager
+    def step(self):
+        self.on_step_begin()
+        try:
+            yield
+        finally:
+            self.on_step_end()
+
+    def close(self) -> None:
+        self._flush()
+
+    def _flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        payload = {
+            'boot_time': self.boot_time,
+            'first_step_time': self.first_step_time,
+            'last_step_time': self.last_step_time,
+            'num_steps': self.num_steps,
+            'total_steps': self.total_steps,
+        }
+        tmp = os.path.join(self.log_dir, SUMMARY_FILE + '.tmp')
+        with open(tmp, 'w', encoding='utf-8') as f:
+            json.dump(payload, f)
+        os.replace(tmp, os.path.join(self.log_dir, SUMMARY_FILE))
+
+
+# ------------------------------------------------------------- module API
+
+
+def init(log_dir: Optional[str] = None,
+         total_steps: Optional[int] = None) -> BenchmarkCallback:
+    """Parity: sky_callback.init — module-level singleton."""
+    global _global_cb
+    _global_cb = BenchmarkCallback(log_dir=log_dir,
+                                   total_steps=total_steps)
+    return _global_cb
+
+
+def _cb() -> BenchmarkCallback:
+    global _global_cb
+    if _global_cb is None:
+        _global_cb = BenchmarkCallback()
+    return _global_cb
+
+
+def on_step_begin() -> None:
+    _cb().on_step_begin()
+
+
+def on_step_end() -> None:
+    _cb().on_step_end()
+
+
+@contextlib.contextmanager
+def step():
+    with _cb().step():
+        yield
+
+
+def instrument(fn: Callable[..., Any]) -> Callable[..., Any]:
+    """Wrap a train-step function: each call is one timed step."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with _cb().step():
+            return fn(*args, **kwargs)
+
+    return wrapped

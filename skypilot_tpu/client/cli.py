@@ -356,6 +356,56 @@ def storage_delete(names):
         click.echo(f'Storage {n!r} deleted.')
 
 
+# ------------------------------------------------------------------ bench
+
+
+@cli.group()
+def bench():
+    """Benchmark a task across candidate resources ($/step comparison)."""
+
+
+@bench.command(name='launch')
+@click.argument('entrypoint', required=True)
+@click.option('--benchmark', '-b', required=True, help='Benchmark name.')
+@click.option('--candidate', '-r', 'candidates', multiple=True,
+              required=True,
+              help='Resource override as JSON, e.g. '
+                   '\'{"accelerators": "tpu-v5e:8"}\'. Repeatable.')
+def bench_launch(entrypoint, benchmark, candidates):
+    """Launch one cluster per candidate resources, running ENTRYPOINT."""
+    import json as json_lib
+    from skypilot_tpu import benchmark as bench_lib
+    task = _load_task(entrypoint, {})
+    overrides = []
+    for c in candidates:
+        try:
+            overrides.append(json_lib.loads(c))
+        except json_lib.JSONDecodeError as e:
+            raise click.BadParameter(
+                f'--candidate {c!r} is not valid JSON: {e}') from e
+    names = bench_lib.launch(task, benchmark, overrides)
+    click.echo(f'Benchmark {benchmark!r}: launched {", ".join(names)}')
+
+
+@bench.command(name='show')
+@click.argument('benchmark', required=True)
+def bench_show(benchmark):
+    """Show steps/sec, $/hr, $/step and ETA per candidate."""
+    from skypilot_tpu import benchmark as bench_lib
+    from skypilot_tpu.benchmark import benchmark_utils
+    rows = bench_lib.show(benchmark)
+    click.echo(benchmark_utils.format_results(rows))
+
+
+@bench.command(name='down')
+@click.argument('benchmark', required=True)
+def bench_down(benchmark):
+    """Tear down every candidate cluster of a benchmark."""
+    from skypilot_tpu import benchmark as bench_lib
+    bench_lib.down(benchmark)
+    click.echo(f'Benchmark {benchmark!r} torn down.')
+
+
 # -------------------------------------------------------------------- api
 
 

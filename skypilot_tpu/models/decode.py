@@ -83,12 +83,7 @@ def _block_decode(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
     attn = _attend_cached(q, k_cache, v_cache, cur_len=pos + 1)
     attn = attn.reshape(b, s, cfg.n_heads * hd)
     x = x + (attn @ layer['wo']).astype(cfg.dtype)
-
-    h = llama.rms_norm(x, layer['ffn_norm'], cfg.norm_eps)
-    gate = jax.nn.silu((h @ layer['w1']).astype(jnp.float32))
-    up = (h @ layer['w3']).astype(jnp.float32)
-    down = ((gate * up).astype(cfg.dtype)) @ layer['w2']
-    return x + down.astype(cfg.dtype), k_cache, v_cache
+    return llama.ffn_sublayer(cfg, x, layer), k_cache, v_cache
 
 
 def prefill(params: Params, tokens: jax.Array, cfg: llama.LlamaConfig,
@@ -99,29 +94,17 @@ def prefill(params: Params, tokens: jax.Array, cfg: llama.LlamaConfig,
     tokens [B, S_prompt] (right-padded); returns (logits at each
     sequence's last prompt token [B, vocab], cache).
     """
-    b, s = tokens.shape
+    _, s = tokens.shape
     positions = jnp.arange(s, dtype=jnp.int32)
     cos, sin = llama._rope_freqs(cfg, positions)  # pylint: disable=protected-access
     x = params['tok_embedding'][tokens].astype(cfg.dtype)
-    hd = cfg.head_dim
 
-    def body(carry, layer_kv):
-        xc = carry
-        layer = layer_kv
-        h = llama.rms_norm(xc, layer['attn_norm'], cfg.norm_eps)
-        q = (h @ layer['wq']).reshape(b, s, cfg.n_heads, hd)
-        k = (h @ layer['wk']).reshape(b, s, cfg.n_kv_heads, hd)
-        v = (h @ layer['wv']).reshape(b, s, cfg.n_kv_heads, hd)
-        q = llama.apply_rope(q, cos, sin)
-        k = llama.apply_rope(k, cos, sin)
-        attn = attention_ops.gqa_attention(q, k, v, causal=True)
-        attn = attn.reshape(b, s, cfg.n_heads * hd)
-        xc = xc + (attn @ layer['wo']).astype(cfg.dtype)
-        h = llama.rms_norm(xc, layer['ffn_norm'], cfg.norm_eps)
-        gate = jax.nn.silu((h @ layer['w1']).astype(jnp.float32))
-        up = (h @ layer['w3']).astype(jnp.float32)
-        down = ((gate * up).astype(cfg.dtype)) @ layer['w2']
-        return xc + down.astype(cfg.dtype), (k, v)
+    def body(carry, layer):
+        # Shared sublayers with training (flash attention flag honored —
+        # prefill is exactly where the [S,S] logits would hurt most);
+        # attn_sublayer hands back K/V to seed the cache.
+        xc, k, v = llama.attn_sublayer(cfg, carry, layer, cos, sin)
+        return llama.ffn_sublayer(cfg, xc, layer), (k, v)
 
     x, (ks, vs) = jax.lax.scan(body, x, params['layers'])
     # ks/vs: [L, B, S, Hkv, hd] → cache prefix.
@@ -181,10 +164,11 @@ def generate(params: Params,
     b, s_prompt = prompt.shape
     assert s_prompt + max_new_tokens <= dcfg.max_len
     rng = rng if rng is not None else jax.random.PRNGKey(0)
+    first_key, steps_key = jax.random.split(rng)
     cache = init_kv_cache(cfg, b, dcfg.max_len)
     last_logits, cache = prefill(params, prompt, cfg, cache, prompt_lens)
 
-    first = _sample(last_logits, rng, dcfg.temperature)
+    first = _sample(last_logits, first_key, dcfg.temperature)
     done0 = (jnp.full((b,), False) if dcfg.eos_id is None else
              first == dcfg.eos_id)
 
@@ -197,7 +181,7 @@ def generate(params: Params,
             done = done | (nxt == dcfg.eos_id)
         return (nxt, pos + 1, cache_c, done), nxt
 
-    keys = jax.random.split(rng, max_new_tokens - 1) \
+    keys = jax.random.split(steps_key, max_new_tokens - 1) \
         if max_new_tokens > 1 else jnp.zeros((0, 2), jnp.uint32)
     (_, _, _, _), rest = jax.lax.scan(
         step, (first, prompt_lens, cache, done0), keys)

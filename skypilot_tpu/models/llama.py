@@ -180,9 +180,27 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _block(cfg: LlamaConfig, x: jax.Array, layer: Params, cos: jax.Array,
-           sin: jax.Array, seq_axis_sharded: bool) -> jax.Array:
-    b, s, d = x.shape
+def full_sequence_attention(cfg: LlamaConfig, q: jax.Array, k: jax.Array,
+                            v: jax.Array,
+                            seq_axis_sharded: bool = False) -> jax.Array:
+    """The config-selected attention for full (non-cached) sequences:
+    ring (sequence parallel) > Pallas flash > dense XLA. Single source of
+    truth for train, prefill, and MoE paths."""
+    if seq_axis_sharded:
+        return attention_ops.ring_attention(q, k, v, axis_name=SEQ_AXIS)
+    if cfg.flash_attention:
+        from skypilot_tpu.ops import flash_attention as fa
+        return fa.flash_attention(q, k, v, True)
+    return attention_ops.gqa_attention(q, k, v, causal=True)
+
+
+def attn_sublayer(cfg: LlamaConfig, x: jax.Array, layer: Params,
+                  cos: jax.Array, sin: jax.Array,
+                  seq_axis_sharded: bool = False
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Norm → QKV → RoPE → attention → residual. Returns (x, k, v) so
+    prefill can seed the KV cache from the same code path training uses."""
+    b, s, _ = x.shape
     hd = cfg.head_dim
     h = rms_norm(x, layer['attn_norm'], cfg.norm_eps)
     q = (h @ layer['wq']).reshape(b, s, cfg.n_heads, hd)
@@ -190,21 +208,25 @@ def _block(cfg: LlamaConfig, x: jax.Array, layer: Params, cos: jax.Array,
     v = (h @ layer['wv']).reshape(b, s, cfg.n_kv_heads, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    if seq_axis_sharded:
-        attn_out = attention_ops.ring_attention(q, k, v, axis_name=SEQ_AXIS)
-    elif cfg.flash_attention:
-        from skypilot_tpu.ops import flash_attention as fa
-        attn_out = fa.flash_attention(q, k, v, True)
-    else:
-        attn_out = attention_ops.gqa_attention(q, k, v, causal=True)
+    attn_out = full_sequence_attention(cfg, q, k, v, seq_axis_sharded)
     attn_out = attn_out.reshape(b, s, cfg.n_heads * hd)
-    x = x + (attn_out @ layer['wo']).astype(cfg.dtype)
+    return x + (attn_out @ layer['wo']).astype(cfg.dtype), k, v
 
+
+def ffn_sublayer(cfg: LlamaConfig, x: jax.Array,
+                 layer: Params) -> jax.Array:
+    """Norm → SwiGLU → residual (dense FFN)."""
     h = rms_norm(x, layer['ffn_norm'], cfg.norm_eps)
     gate = jax.nn.silu((h @ layer['w1']).astype(jnp.float32))
     up = (h @ layer['w3']).astype(jnp.float32)
     down = ((gate * up).astype(cfg.dtype)) @ layer['w2']
     return x + down.astype(cfg.dtype)
+
+
+def _block(cfg: LlamaConfig, x: jax.Array, layer: Params, cos: jax.Array,
+           sin: jax.Array, seq_axis_sharded: bool) -> jax.Array:
+    x, _, _ = attn_sublayer(cfg, x, layer, cos, sin, seq_axis_sharded)
+    return ffn_sublayer(cfg, x, layer)
 
 
 def forward(params: Params,

@@ -25,7 +25,6 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from skypilot_tpu.models import llama
-from skypilot_tpu.ops import attention as attention_ops
 from skypilot_tpu.parallel.mesh import EXPERT_AXIS, FSDP_AXIS, MODEL_AXIS
 
 Params = Dict[str, Any]
@@ -158,18 +157,9 @@ def moe_ffn(h: jax.Array, layer: Params, cfg: MoeConfig
 def _moe_block(cfg: MoeConfig, x: jax.Array, layer: Params,
                cos: jax.Array, sin: jax.Array) -> Tuple[jax.Array,
                                                         jax.Array]:
-    b, s, _ = x.shape
-    hd = cfg.head_dim
-    h = llama.rms_norm(x, layer['attn_norm'], cfg.norm_eps)
-    q = (h @ layer['wq']).reshape(b, s, cfg.n_heads, hd)
-    k = (h @ layer['wk']).reshape(b, s, cfg.n_kv_heads, hd)
-    v = (h @ layer['wv']).reshape(b, s, cfg.n_kv_heads, hd)
-    q = llama.apply_rope(q, cos, sin)
-    k = llama.apply_rope(k, cos, sin)
-    attn_out = attention_ops.gqa_attention(q, k, v, causal=True)
-    attn_out = attn_out.reshape(b, s, cfg.n_heads * hd)
-    x = x + (attn_out @ layer['wo']).astype(cfg.dtype)
-
+    # Shared attention sublayer (honors flash/ring config flags); only the
+    # FFN differs from the dense decoder.
+    x, _, _ = llama.attn_sublayer(cfg, x, layer, cos, sin)
     h = llama.rms_norm(x, layer['ffn_norm'], cfg.norm_eps)
     ffn_out, aux = moe_ffn(h, layer, cfg)
     return x + ffn_out, aux

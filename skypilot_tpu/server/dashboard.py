@@ -1,0 +1,93 @@
+"""HTML dashboard: clusters, managed jobs, services at a glance.
+
+Parity: the reference's managed-jobs Flask dashboard
+(``sky/jobs/dashboard/dashboard.py``) + server log HTML — one page served
+by the API server at ``/dashboard``, reading the same sqlite state the
+CLI reads, refreshed client-side.
+"""
+import html
+import time
+from typing import List, Tuple
+
+_PAGE = """<!doctype html>
+<html><head><title>skypilot_tpu</title>
+<meta http-equiv="refresh" content="10">
+<style>
+ body {{ font-family: monospace; margin: 2em; background: #fafafa; }}
+ h2 {{ border-bottom: 1px solid #ccc; padding-bottom: 4px; }}
+ table {{ border-collapse: collapse; margin-bottom: 2em; }}
+ td, th {{ border: 1px solid #ddd; padding: 4px 10px; text-align: left; }}
+ th {{ background: #eee; }}
+ .UP, .READY, .SUCCEEDED, .RUNNING {{ color: #0a7a0a; }}
+ .INIT, .PENDING, .STARTING, .RECOVERING {{ color: #b8860b; }}
+ .FAILED, .FAILED_SETUP, .FAILED_CONTROLLER, .STOPPED {{ color: #b01010; }}
+</style></head><body>
+<h1>skypilot_tpu</h1>
+<p>generated {now} &middot; auto-refreshes every 10s</p>
+{sections}
+</body></html>
+"""
+
+
+def _table(title: str, header: Tuple[str, ...],
+           rows: List[Tuple[str, ...]]) -> str:
+    cells = ''.join(f'<th>{html.escape(h)}</th>' for h in header)
+    body = []
+    for row in rows:
+        tds = []
+        for c in row:
+            c = str(c)
+            cls = f' class="{c}"' if c.isupper() else ''
+            tds.append(f'<td{cls}>{html.escape(c)}</td>')
+        body.append('<tr>' + ''.join(tds) + '</tr>')
+    if not body:
+        body = [f'<tr><td colspan="{len(header)}">none</td></tr>']
+    return (f'<h2>{html.escape(title)}</h2><table><tr>{cells}</tr>'
+            + ''.join(body) + '</table>')
+
+
+def render() -> str:
+    from skypilot_tpu import global_state
+    from skypilot_tpu.jobs import state as jobs_state
+    from skypilot_tpu.serve import serve_state
+
+    sections = []
+
+    clusters = []
+    for rec in global_state.get_clusters():
+        handle = rec['handle']
+        clusters.append(
+            (rec['name'], str(handle.launched_resources),
+             rec['status'].value,
+             time.strftime('%m-%d %H:%M',
+                           time.localtime(rec['launched_at']))))
+    sections.append(_table('Clusters',
+                           ('NAME', 'RESOURCES', 'STATUS', 'LAUNCHED'),
+                           clusters))
+
+    jobs = []
+    for job in jobs_state.get_jobs():
+        status = jobs_state.get_job_status(job['job_id'])
+        tasks = jobs_state.get_tasks(job['job_id'])
+        jobs.append((job['job_id'], job['name'] or '-',
+                     status.value if status else '-',
+                     sum(t['recovery_count'] for t in tasks),
+                     job['schedule_state']))
+    sections.append(_table('Managed jobs',
+                           ('ID', 'NAME', 'STATUS', '#RECOVERIES',
+                            'SCHEDULE'), jobs))
+
+    services = []
+    for svc in serve_state.get_services():
+        replicas = serve_state.get_replicas(svc['name'])
+        ready = sum(1 for r in replicas
+                    if r['status'] == serve_state.ReplicaStatus.READY)
+        services.append((svc['name'], svc['status'].value,
+                         f'{ready}/{len(replicas)}',
+                         f"http://127.0.0.1:{svc['lb_port']}"))
+    sections.append(_table('Services',
+                           ('NAME', 'STATUS', 'READY', 'ENDPOINT'),
+                           services))
+
+    return _PAGE.format(now=time.strftime('%Y-%m-%d %H:%M:%S'),
+                        sections=''.join(sections))

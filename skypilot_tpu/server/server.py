@@ -146,6 +146,14 @@ async def handle_api_cancel(request: web.Request) -> web.Response:
     return web.json_response({'cancelled': ok})
 
 
+async def handle_dashboard(request: web.Request) -> web.Response:
+    del request
+    from skypilot_tpu.server import dashboard
+    page = await asyncio.get_event_loop().run_in_executor(
+        None, dashboard.render)
+    return web.Response(text=page, content_type='text/html')
+
+
 async def handle_health(request: web.Request) -> web.Response:
     del request
     import skypilot_tpu
@@ -165,6 +173,7 @@ def build_app() -> web.Application:
     app.router.add_get('/api/status', handle_api_status)
     app.router.add_post('/api/cancel', handle_api_cancel)
     app.router.add_get('/health', handle_health)
+    app.router.add_get('/dashboard', handle_dashboard)
     return app
 
 

@@ -1,0 +1,83 @@
+"""Benchmark subsystem + callbacks: unit timing + local-cloud e2e."""
+import json
+import os
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_state
+from skypilot_tpu.benchmark import benchmark_state
+from skypilot_tpu.benchmark import benchmark_utils
+from skypilot_tpu.callbacks import base as cb
+
+
+def test_callback_summary(tmp_path):
+    c = cb.BenchmarkCallback(log_dir=str(tmp_path), total_steps=50)
+    for _ in range(12):
+        with c.step():
+            pass
+    c.close()
+    with open(tmp_path / cb.SUMMARY_FILE, encoding='utf-8') as f:
+        s = json.load(f)
+    assert s['num_steps'] == 12
+    assert s['total_steps'] == 50
+    assert s['last_step_time'] >= s['first_step_time']
+
+
+def test_instrument_decorator(tmp_path, monkeypatch):
+    monkeypatch.setenv(cb.ENV_LOG_DIR, str(tmp_path))
+    cb.init(total_steps=None)
+
+    @cb.instrument
+    def train_step(x):
+        return x + 1
+
+    for i in range(cb.BenchmarkCallback.FLUSH_EVERY):
+        train_step(i)
+    with open(tmp_path / cb.SUMMARY_FILE, encoding='utf-8') as f:
+        s = json.load(f)
+    assert s['num_steps'] == cb.BenchmarkCallback.FLUSH_EVERY
+
+
+def test_bench_e2e_local():
+    global_state.set_enabled_clouds(['Local'])
+    # The "training" task: 30 fast steps through the callback API.
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+        sky.__file__)))
+    script = f'''python3 << 'EOF'
+import sys, time
+sys.path.insert(0, {pkg_root!r})
+from skypilot_tpu.callbacks import base as cb
+c = cb.BenchmarkCallback(total_steps=100)
+for _ in range(30):
+    c.on_step_begin(); time.sleep(0.01); c.on_step_end()
+c.close()
+EOF'''
+    task = sky.Task(name='bench-task', run=script)
+    task.set_resources(sky.Resources(cloud='local'))
+
+    names = benchmark_utils.launch(
+        task, 'b1', candidates=[{}, {}])
+    assert names == ['bench-b1-0', 'bench-b1-1']
+    assert benchmark_utils.wait_for_steps('b1', 30, timeout=90), \
+        benchmark_utils.show('b1')
+
+    rows = benchmark_utils.show('b1')
+    assert len(rows) == 2
+    for r in rows:
+        assert r['num_steps'] == 30
+        assert r['steps_per_sec'] > 0
+        assert r['eta_seconds'] is not None
+    out = benchmark_utils.format_results(rows)
+    assert 'bench-b1-0' in out and 'STEPS/S' in out
+
+    benchmark_utils.down('b1')
+    assert sky.status() == []
+    assert benchmark_state.get_benchmark('b1') is None
+
+
+def test_bench_show_unknown():
+    from skypilot_tpu import exceptions
+    with pytest.raises(exceptions.InvalidSkyError):
+        benchmark_utils.show('nope')

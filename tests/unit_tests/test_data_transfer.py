@@ -1,0 +1,62 @@
+"""Data transfer dispatch + local-store paths."""
+import os
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.data import data_transfer
+from skypilot_tpu.data import storage as storage_lib
+
+
+def test_local_bucket_transfer(tmp_path):
+    src = tmp_path / 'src'
+    (src / 'sub').mkdir(parents=True)
+    (src / 'a.txt').write_text('alpha')
+    (src / 'sub' / 'b.txt').write_text('beta')
+    dst = tmp_path / 'dst'
+    data_transfer.local_bucket_to_local_bucket(str(src), str(dst))
+    assert (dst / 'a.txt').read_text() == 'alpha'
+    assert (dst / 'sub' / 'b.txt').read_text() == 'beta'
+
+
+def test_transfer_dispatch_local_scheme(tmp_path, monkeypatch):
+    monkeypatch.setenv('HOME', str(tmp_path))
+    root = os.path.expanduser(storage_lib.LOCAL_BUCKET_ROOT)
+    os.makedirs(os.path.join(root, 'src-bkt'))
+    with open(os.path.join(root, 'src-bkt', 'x.txt'), 'w',
+              encoding='utf-8') as f:
+        f.write('payload')
+    data_transfer.transfer('local://src-bkt', 'local://dst-bkt')
+    with open(os.path.join(root, 'dst-bkt', 'x.txt'),
+              encoding='utf-8') as f:
+        assert f.read() == 'payload'
+
+
+def test_transfer_path_to_local_bucket(tmp_path, monkeypatch):
+    monkeypatch.setenv('HOME', str(tmp_path))
+    src = tmp_path / 'data'
+    src.mkdir()
+    (src / 'f').write_text('x')
+    data_transfer.transfer(str(src), 'local://into-bkt')
+    root = os.path.expanduser(storage_lib.LOCAL_BUCKET_ROOT)
+    assert os.path.exists(os.path.join(root, 'into-bkt', 'f'))
+
+
+def test_transfer_unsupported_pair():
+    with pytest.raises(exceptions.NotSupportedError):
+        data_transfer.transfer('s3://a', 's3://b')
+
+
+def test_transfer_missing_source():
+    with pytest.raises(exceptions.StorageError):
+        data_transfer.local_bucket_to_local_bucket('/nope/missing',
+                                                   '/tmp/whatever')
+
+
+def test_dashboard_renders(tmp_path, monkeypatch):
+    monkeypatch.setenv('HOME', str(tmp_path))
+    from skypilot_tpu.server import dashboard
+    page = dashboard.render()
+    assert 'Clusters' in page
+    assert 'Managed jobs' in page
+    assert 'Services' in page
