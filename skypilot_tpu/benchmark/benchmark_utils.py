@@ -50,7 +50,14 @@ def launch(task: task_lib.Task,
 
     def _launch_one(args) -> None:
         i, override = args
+        if not isinstance(override, dict):
+            errors.append((cluster_name(benchmark, i), TypeError(
+                f'candidate must be a resources dict, got {override!r}')))
+            return
         cand_task = copy.copy(task)
+        # copy.copy shares _envs; detach so the benchmark env var never
+        # leaks into the caller's task.
+        cand_task._envs = task.envs  # pylint: disable=protected-access
         base = next(iter(task.resources))
         cand_task.set_resources(base.copy(**override))
         cand_task.update_envs(
@@ -136,8 +143,12 @@ def show(benchmark: str) -> List[Dict[str, Any]]:
             steps = summary['num_steps']
             elapsed = summary['last_step_time'] - summary[
                 'first_step_time']
-            if elapsed > 0:
-                sps = (steps - 1) / elapsed
+            # begin-instrumented loops: [first, last] spans `steps` full
+            # steps; end-only loops span steps-1 intervals.
+            denom_steps = steps if summary.get('begin_instrumented') \
+                else steps - 1
+            if elapsed > 0 and denom_steps > 0:
+                sps = denom_steps / elapsed
                 row['num_steps'] = steps
                 row['steps_per_sec'] = sps
                 if rec['hourly_cost']:

@@ -37,6 +37,10 @@ class BenchmarkCallback:
         self.first_step_time: Optional[float] = None
         self.last_step_time: Optional[float] = None
         self.num_steps = 0
+        # True once on_step_begin was used: first_step_time is then a step
+        # START, so [first, last] spans num_steps full steps; end-only
+        # loops span num_steps - 1 (the summarizer needs to know which).
+        self.begin_instrumented = False
         self._step_start: Optional[float] = None
         self._lock = threading.Lock()
         self._flush()
@@ -44,6 +48,7 @@ class BenchmarkCallback:
     def on_step_begin(self) -> None:
         now = time.time()
         with self._lock:
+            self.begin_instrumented = True
             if self.first_step_time is None:
                 self.first_step_time = now
             self._step_start = now
@@ -81,6 +86,7 @@ class BenchmarkCallback:
             'last_step_time': self.last_step_time,
             'num_steps': self.num_steps,
             'total_steps': self.total_steps,
+            'begin_instrumented': self.begin_instrumented,
         }
         tmp = os.path.join(self.log_dir, SUMMARY_FILE + '.tmp')
         with open(tmp, 'w', encoding='utf-8') as f:

@@ -22,6 +22,9 @@ from skypilot_tpu import topology as topo_lib
 
 _DATA_DIR = os.path.join(os.path.dirname(__file__), 'data')
 
+# Clouds with a bundled VM catalog CSV (<cloud>_vms.csv).
+VM_CLOUDS = ('gcp', 'aws')
+
 # Catalog override dir for tests / refreshed data.
 CATALOG_DIR_ENV = 'SKYTPU_CATALOG_DIR'
 
@@ -45,12 +48,15 @@ def _tpu_df() -> pd.DataFrame:
     return _read_csv('gcp_tpus.csv')
 
 
-def _vm_df() -> pd.DataFrame:
-    return _read_csv('gcp_vms.csv')
+def _vm_df(cloud: str = 'gcp') -> pd.DataFrame:
+    return _read_csv(f'{cloud.lower()}_vms.csv')
 
 
 def invalidate_cache() -> None:
     _read_csv.cache_clear()
+    # Derived caches over the catalogs must refresh with them.
+    from skypilot_tpu.utils import accelerator_registry
+    accelerator_registry._canonical_names.cache_clear()  # pylint: disable=protected-access
 
 
 @dataclasses.dataclass
@@ -111,13 +117,15 @@ def tpu_slice_hourly_cost(slice_topology: topo_lib.TpuSliceTopology,
 # ------------------------------------------------------------- VM instances
 
 
-def instance_type_exists(instance_type: str) -> bool:
-    return bool((_vm_df()['InstanceType'] == instance_type).any())
+def instance_type_exists(instance_type: str,
+                         cloud: str = 'gcp') -> bool:
+    return bool((_vm_df(cloud)['InstanceType'] == instance_type).any())
 
 
 def get_vcpus_mem_from_instance_type(
-        instance_type: str) -> Tuple[Optional[float], Optional[float]]:
-    df = _vm_df()
+        instance_type: str,
+        cloud: str = 'gcp') -> Tuple[Optional[float], Optional[float]]:
+    df = _vm_df(cloud)
     rows = df[df['InstanceType'] == instance_type]
     if rows.empty:
         return None, None
@@ -127,8 +135,9 @@ def get_vcpus_mem_from_instance_type(
 
 def get_hourly_cost(instance_type: str,
                     region: Optional[str] = None,
-                    use_spot: bool = False) -> Optional[float]:
-    df = _vm_df()
+                    use_spot: bool = False,
+                    cloud: str = 'gcp') -> Optional[float]:
+    df = _vm_df(cloud)
     rows = df[df['InstanceType'] == instance_type]
     if region is not None:
         rows = rows[rows['Region'] == region]
@@ -139,8 +148,9 @@ def get_hourly_cost(instance_type: str,
 
 
 def get_accelerators_from_instance_type(
-        instance_type: str) -> Optional[Dict[str, float]]:
-    df = _vm_df()
+        instance_type: str,
+        cloud: str = 'gcp') -> Optional[Dict[str, float]]:
+    df = _vm_df(cloud)
     rows = df[df['InstanceType'] == instance_type]
     if rows.empty:
         return None
@@ -157,14 +167,15 @@ def get_instance_type_for_accelerator(
         cpus: Optional[str] = None,
         memory: Optional[str] = None,
         region: Optional[str] = None,
-        zone: Optional[str] = None) -> Optional[List[str]]:
+        zone: Optional[str] = None,
+        cloud: str = 'gcp') -> Optional[List[str]]:
     """GPU accelerator → hosting instance types, cheapest first.
 
     Parity: ``service_catalog/common.py:507``
     (get_instance_type_for_accelerator_impl). TPUs never route here — they
     are slices, not instance-attached devices.
     """
-    df = _vm_df()
+    df = _vm_df(cloud)
     rows = df[(df['AcceleratorName'] == acc_name) &
               (df['AcceleratorCount'] == acc_count)]
     if region is not None:
@@ -179,9 +190,10 @@ def get_instance_type_for_accelerator(
 
 
 def get_default_instance_type(cpus: Optional[str] = None,
-                              memory: Optional[str] = None) -> Optional[str]:
+                              memory: Optional[str] = None,
+                              cloud: str = 'gcp') -> Optional[str]:
     """Cheapest CPU-only instance satisfying cpus/memory ('8', '8+')."""
-    df = _vm_df()
+    df = _vm_df(cloud)
     rows = df[df['AcceleratorName'].isna() | (df['AcceleratorName'] == '')]
     if cpus is None and memory is None:
         rows = rows[rows['vCPUs'] >= 8]  # parity: default 8 vCPUs
@@ -210,8 +222,9 @@ def _filter_cpus_mem(rows: pd.DataFrame, cpus: Optional[str],
 
 def vm_regions_zones(instance_type: str,
                      region: Optional[str] = None,
-                     zone: Optional[str] = None) -> List[Tuple[str, str]]:
-    df = _vm_df()
+                     zone: Optional[str] = None,
+                     cloud: str = 'gcp') -> List[Tuple[str, str]]:
+    df = _vm_df(cloud)
     rows = df[df['InstanceType'] == instance_type]
     if region is not None:
         rows = rows[rows['Region'] == region]
@@ -252,23 +265,26 @@ def list_accelerators(
                                      row['SpotPricePerChipHour']),
                                  region=str(row['Region']),
                                  zone=str(row['AvailabilityZone'])))
-    df = _vm_df()
-    gpu_rows = df[df['AcceleratorName'].notna() & (df['AcceleratorName'] != '')]
-    for _, row in gpu_rows.iterrows():
-        name = str(row['AcceleratorName'])
-        if name_filter and name_filter.lower() not in name.lower():
-            continue
-        result.setdefault(name, []).append(
-            InstanceTypeInfo(cloud='GCP',
-                             instance_type=str(row['InstanceType']),
-                             accelerator_name=name,
-                             accelerator_count=float(row['AcceleratorCount']),
-                             cpu_count=float(row['vCPUs']),
-                             memory_gb=float(row['MemoryGiB']),
-                             price=float(row['Price']),
-                             spot_price=float(row['SpotPrice']),
-                             region=str(row['Region']),
-                             zone=str(row['AvailabilityZone'])))
+    for cloud_name in VM_CLOUDS:
+        df = _vm_df(cloud_name)
+        gpu_rows = df[df['AcceleratorName'].notna() &
+                      (df['AcceleratorName'] != '')]
+        for _, row in gpu_rows.iterrows():
+            name = str(row['AcceleratorName'])
+            if name_filter and name_filter.lower() not in name.lower():
+                continue
+            result.setdefault(name, []).append(
+                InstanceTypeInfo(
+                    cloud=cloud_name.upper(),
+                    instance_type=str(row['InstanceType']),
+                    accelerator_name=name,
+                    accelerator_count=float(row['AcceleratorCount']),
+                    cpu_count=float(row['vCPUs']),
+                    memory_gb=float(row['MemoryGiB']),
+                    price=float(row['Price']),
+                    spot_price=float(row['SpotPrice']),
+                    region=str(row['Region']),
+                    zone=str(row['AvailabilityZone'])))
     return result
 
 

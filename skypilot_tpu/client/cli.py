@@ -379,10 +379,15 @@ def bench_launch(entrypoint, benchmark, candidates):
     overrides = []
     for c in candidates:
         try:
-            overrides.append(json_lib.loads(c))
+            parsed = json_lib.loads(c)
         except json_lib.JSONDecodeError as e:
             raise click.BadParameter(
                 f'--candidate {c!r} is not valid JSON: {e}') from e
+        if not isinstance(parsed, dict):
+            raise click.BadParameter(
+                f'--candidate {c!r} must be a JSON object of resource '
+                'overrides, e.g. \'{"accelerators": "tpu-v5e:8"}\'.')
+        overrides.append(parsed)
     names = bench_lib.launch(task, benchmark, overrides)
     click.echo(f'Benchmark {benchmark!r}: launched {", ".join(names)}')
 

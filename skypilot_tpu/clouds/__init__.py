@@ -1,4 +1,5 @@
 """Cloud registry (parity: ``sky/clouds/__init__.py``)."""
+from skypilot_tpu.clouds.aws import AWS
 from skypilot_tpu.clouds.cloud import Cloud
 from skypilot_tpu.clouds.cloud import CloudImplementationFeatures
 from skypilot_tpu.clouds.cloud import Region
@@ -7,6 +8,7 @@ from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.local import Local
 
 __all__ = [
+    'AWS',
     'Cloud',
     'CloudImplementationFeatures',
     'GCP',

@@ -68,7 +68,10 @@ def local_bucket_to_local_bucket(src_dir: str, dst_dir: str) -> None:
 
 
 def transfer(src_uri: str, dst_uri: str) -> None:
-    """Dispatch on URI schemes: gs://, s3://, local://, or a local path."""
+    """Dispatch on URI schemes: gs://, s3://, local://, or a local path.
+
+    Object keys are honored: ``gs://bkt/subdir`` copies only that prefix.
+    """
     from skypilot_tpu.data import storage as storage_lib
     from skypilot_tpu.data import storage_utils
 
@@ -77,30 +80,32 @@ def transfer(src_uri: str, dst_uri: str) -> None:
             return storage_utils.split_bucket_uri(uri)
         return ('path', uri, '')
 
-    def local_bucket_dir(name: str) -> str:
-        return os.path.join(
-            os.path.expanduser(storage_lib.LOCAL_BUCKET_ROOT), name)
+    def local_dir_for(scheme: str, loc: str, key: str) -> str:
+        if scheme == 'path':
+            return loc
+        base = os.path.join(
+            os.path.expanduser(storage_lib.LOCAL_BUCKET_ROOT), loc)
+        return os.path.join(base, key) if key else base
 
-    (s_scheme, s_loc, _), (d_scheme, d_loc, _) = parse(src_uri), \
-        parse(dst_uri)
-    key = (s_scheme, d_scheme)
-    if key == ('gs', 'gs'):
-        gcs_to_gcs(s_loc, d_loc)
-    elif key == ('s3', 'gs'):
-        s3_to_gcs(s_loc, d_loc)
-    elif key == ('gs', 's3'):
-        gcs_to_s3(s_loc, d_loc)
-    elif key == ('path', 'gs'):
-        local_to_gcs(s_loc, d_loc)
-    elif key == ('gs', 'path'):
-        gcs_to_local(s_loc, d_loc)
-    elif key == ('local', 'local'):
-        local_bucket_to_local_bucket(local_bucket_dir(s_loc),
-                                     local_bucket_dir(d_loc))
-    elif key == ('path', 'local'):
-        local_bucket_to_local_bucket(s_loc, local_bucket_dir(d_loc))
-    elif key == ('local', 'path'):
-        local_bucket_to_local_bucket(local_bucket_dir(s_loc), d_loc)
+    (s_scheme, s_loc, s_key), (d_scheme, d_loc, d_key) = \
+        parse(src_uri), parse(dst_uri)
+    cloudy = {'gs', 's3'}
+    if s_scheme in cloudy and d_scheme in cloudy:
+        _run(['gsutil', '-m', 'rsync', '-r', src_uri.rstrip('/'),
+              dst_uri.rstrip('/')], f'{s_scheme}→{d_scheme} rsync')
+    elif s_scheme == 'path' and d_scheme in cloudy:
+        _run(['gsutil', '-m', 'rsync', '-r',
+              os.path.expanduser(s_loc), dst_uri.rstrip('/')],
+             f'local→{d_scheme} rsync')
+    elif s_scheme in cloudy and d_scheme == 'path':
+        dst = os.path.expanduser(d_loc)
+        os.makedirs(dst, exist_ok=True)
+        _run(['gsutil', '-m', 'rsync', '-r', src_uri.rstrip('/'), dst],
+             f'{s_scheme}→local rsync')
+    elif s_scheme in ('local', 'path') and d_scheme in ('local', 'path'):
+        local_bucket_to_local_bucket(
+            local_dir_for(s_scheme, s_loc, s_key),
+            local_dir_for(d_scheme, d_loc, d_key))
     else:
         raise exceptions.NotSupportedError(
             f'No transfer path {src_uri} → {dst_uri}.')

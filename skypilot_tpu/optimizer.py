@@ -126,7 +126,14 @@ class Optimizer:
         for cand in Optimizer._fill_in_launchable_resources(
                 task, blocked_resources):
             est_time = Optimizer._estimate_time_seconds(task, cand)
-            cost = cand.get_cost(est_time) * task.num_nodes
+            # COST ranks over a UNIFORM runtime (task-declared or default):
+            # the FLOPs proxy only scales TPU candidates, and a one-sided
+            # discount would make cost ranking apples-to-oranges across
+            # device families (parity: the reference prices hourly_cost ×
+            # the task's declared runtime for every candidate).
+            est = getattr(task, 'estimated_runtime', None)
+            cost_basis = float(est) if est else _DEFAULT_RUNTIME_SECONDS
+            cost = cand.get_cost(cost_basis) * task.num_nodes
             out.append((cand, cost, est_time))
         key = (lambda t: (t[1], t[2])) if minimize == OptimizeTarget.COST \
             else (lambda t: (t[2], t[1]))

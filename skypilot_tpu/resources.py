@@ -195,6 +195,10 @@ class Resources:
                 'Exactly one accelerator type may be requested, got: '
                 f'{accelerators}')
         name, count = next(iter(accelerators.items()))
+        # Canonicalize user-typed names against the catalogs ('a100' →
+        # 'A100'; parity: accelerator_registry.canonicalize:56).
+        from skypilot_tpu.utils import accelerator_registry
+        name = accelerator_registry.canonicalize_accelerator_name(name)
         if topo_lib.is_tpu_accelerator(name):
             args = self._accelerator_args or {}
             topo = topo_lib.resolve_topology(name, count,

@@ -1,0 +1,38 @@
+"""Accelerator name canonicalization.
+
+Parity: ``sky/utils/accelerator_registry.py:56,48`` — user-typed
+accelerator names ('a100', 'Tpu-V5P') resolve to catalog-canonical names;
+TPUs are "schedulable non-GPU" accelerators (the reference uses this to
+omit the GPU resource from Ray bundles; here it routes requests to the
+slice-topology path instead of instance-SKU lookup).
+"""
+import functools
+from typing import Optional
+
+from skypilot_tpu import topology as topo_lib
+
+
+def is_schedulable_non_gpu_accelerator(accelerator_name: str) -> bool:
+    """Parity: accelerator_registry.py:48 — TPUs (the TPU-first build has
+    no other non-GPU accelerator)."""
+    return topo_lib.is_tpu_accelerator(accelerator_name)
+
+
+@functools.lru_cache(maxsize=None)
+def _canonical_names() -> dict:
+    from skypilot_tpu import catalog
+    return {name.lower(): name
+            for name in catalog.list_accelerators().keys()}
+
+
+def canonicalize_accelerator_name(accelerator: str) -> str:
+    """Case-insensitive resolution against the catalogs.
+
+    Parity: accelerator_registry.py:56. Unknown names pass through
+    unchanged — feasibility filtering happens in the optimizer, which can
+    produce fuzzy hints.
+    """
+    if topo_lib.is_tpu_accelerator(accelerator):
+        # 'TPU-V5P' → 'tpu-v5p' (generation names are lowercase).
+        return accelerator.lower()
+    return _canonical_names().get(accelerator.lower(), accelerator)

@@ -43,8 +43,25 @@ def test_transfer_path_to_local_bucket(tmp_path, monkeypatch):
 
 
 def test_transfer_unsupported_pair():
+    # A local-store bucket has no cloud counterpart to rsync against.
     with pytest.raises(exceptions.NotSupportedError):
-        data_transfer.transfer('s3://a', 's3://b')
+        data_transfer.transfer('local://a', 'gs://b')
+
+
+def test_transfer_honors_object_keys(tmp_path, monkeypatch):
+    # Regression: sub-path URIs must copy only that prefix.
+    monkeypatch.setenv('HOME', str(tmp_path))
+    root = os.path.expanduser(storage_lib.LOCAL_BUCKET_ROOT)
+    os.makedirs(os.path.join(root, 'src', 'subdir'))
+    with open(os.path.join(root, 'src', 'top.txt'), 'w',
+              encoding='utf-8') as f:
+        f.write('top')
+    with open(os.path.join(root, 'src', 'subdir', 'in.txt'), 'w',
+              encoding='utf-8') as f:
+        f.write('inner')
+    data_transfer.transfer('local://src/subdir', 'local://dst')
+    assert os.path.exists(os.path.join(root, 'dst', 'in.txt'))
+    assert not os.path.exists(os.path.join(root, 'dst', 'top.txt'))
 
 
 def test_transfer_missing_source():
