@@ -278,6 +278,12 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                 return None
 
             cloud = candidates[0].cloud
+            if not provision_router.has_provisioner(cloud.name):
+                raise exceptions.NotSupportedError(
+                    f'{cloud} offers these resources in its catalog, but '
+                    'this build has no instance provisioner for it yet. '
+                    'Pin the task to a supported cloud (e.g. '
+                    "resources: {cloud: gcp}).")
             cloud.check_features_are_supported(
                 candidates[0], candidates[0].get_required_cloud_features())
 

@@ -50,25 +50,26 @@ def launch(task: task_lib.Task,
 
     def _launch_one(args) -> None:
         i, override = args
-        if not isinstance(override, dict):
-            errors.append((cluster_name(benchmark, i), TypeError(
-                f'candidate must be a resources dict, got {override!r}')))
-            return
-        cand_task = copy.copy(task)
-        # copy.copy shares _envs; detach so the benchmark env var never
-        # leaks into the caller's task.
-        cand_task._envs = task.envs  # pylint: disable=protected-access
-        base = next(iter(task.resources))
-        cand_task.set_resources(base.copy(**override))
-        cand_task.update_envs(
-            {callback_base.ENV_LOG_DIR: _REMOTE_BENCH_DIR})
         name = cluster_name(benchmark, i)
         try:
+            if not isinstance(override, dict):
+                raise TypeError(
+                    f'candidate must be a resources dict, got {override!r}')
+            cand_task = copy.copy(task)
+            # copy.copy shares _envs; detach so the benchmark env var
+            # never leaks into the caller's task.
+            cand_task._envs = task.envs  # pylint: disable=protected-access
+            base = next(iter(task.resources))
+            cand_task.set_resources(base.copy(**override))
+            cand_task.update_envs(
+                {callback_base.ENV_LOG_DIR: _REMOTE_BENCH_DIR})
             execution.launch(cand_task,
                              cluster_name=name,
                              detach_run=True,
                              stream_logs=False)
         except Exception as e:  # pylint: disable=broad-except
+            # Per-candidate failures (bad override keys included) must not
+            # abort the sibling candidates.
             errors.append((name, e))
             return
         record = global_state.get_cluster_from_name(name)

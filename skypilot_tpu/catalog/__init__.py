@@ -288,6 +288,16 @@ def list_accelerators(
     return result
 
 
+def fuzzy_accelerator_hints(acc_name: str, cloud: str) -> List[str]:
+    """Catalog accelerators on ``cloud`` whose name contains ``acc_name``
+    — the "Did you mean" hints when a GPU request has no matching SKU."""
+    return sorted({
+        n for n, infos in list_accelerators(gpus_only=True).items()
+        if acc_name.lower() in n.lower() and any(
+            i.cloud == cloud.upper() for i in infos)
+    })
+
+
 def validate_region_zone(
         region: Optional[str],
         zone: Optional[str]) -> Tuple[Optional[str], Optional[str]]:

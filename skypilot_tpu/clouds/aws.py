@@ -152,13 +152,7 @@ class AWS(cloud.Cloud):
             zone=resources.zone,
             cloud=_CLOUD)
         if not instance_types:
-            hints = sorted({
-                n for n, infos in catalog.list_accelerators(
-                    gpus_only=True).items()
-                if acc_name.lower() in n.lower() and any(
-                    i.cloud == 'AWS' for i in infos)
-            })
-            return [], hints
+            return [], catalog.fuzzy_accelerator_hints(acc_name, 'AWS')
         return [
             resources.copy(cloud=self, instance_type=instance_types[0])
         ], []
@@ -181,35 +175,29 @@ class AWS(cloud.Cloud):
 
     # ----------------------------------------------------------- identity
 
-    @classmethod
-    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+    @staticmethod
+    def _sts_query(field: str) -> Optional[str]:
         try:
             proc = subprocess.run(
                 ['aws', 'sts', 'get-caller-identity',
-                 '--query', 'Account', '--output', 'text'],
-                capture_output=True,
-                text=True,
-                timeout=20,
-                check=False)
-        except (FileNotFoundError, subprocess.TimeoutExpired):
-            return False, ('aws CLI not found or not responding. Install '
-                           'awscli and run `aws configure`.')
-        if proc.returncode != 0 or not proc.stdout.strip():
-            return False, ('AWS credentials not configured. Run '
-                           '`aws configure`.')
-        return True, None
-
-    @classmethod
-    def get_current_user_identity(cls) -> Optional[List[str]]:
-        try:
-            proc = subprocess.run(
-                ['aws', 'sts', 'get-caller-identity',
-                 '--query', 'Arn', '--output', 'text'],
+                 '--query', field, '--output', 'text'],
                 capture_output=True,
                 text=True,
                 timeout=20,
                 check=False)
         except (FileNotFoundError, subprocess.TimeoutExpired):
             return None
-        arn = proc.stdout.strip()
-        return [arn] if arn and proc.returncode == 0 else None
+        out = proc.stdout.strip()
+        return out if proc.returncode == 0 and out else None
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        if cls._sts_query('Account') is None:
+            return False, ('AWS credentials not configured (or awscli '
+                           'missing). Run `aws configure`.')
+        return True, None
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        arn = cls._sts_query('Arn')
+        return [arn] if arn else None

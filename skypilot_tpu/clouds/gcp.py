@@ -223,15 +223,7 @@ class GCP(cloud.Cloud):
             region=resources.region,
             zone=resources.zone)
         if not instance_types:
-            # Fuzzy hints: other counts/names with this prefix — on GCP
-            # only (the catalog sweep now spans clouds).
-            hints = sorted({
-                n for n, infos in catalog.list_accelerators(
-                    gpus_only=True).items()
-                if acc_name.lower() in n.lower() and any(
-                    i.cloud == 'GCP' for i in infos)
-            })
-            return [], hints
+            return [], catalog.fuzzy_accelerator_hints(acc_name, 'GCP')
         return [
             resources.copy(cloud=self, instance_type=instance_types[0])
         ], []

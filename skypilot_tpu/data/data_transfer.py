@@ -25,37 +25,33 @@ def _run(cmd: List[str], what: str) -> None:
             f'{what} failed ({" ".join(cmd[:3])}…): {proc.stderr[-2000:]}')
 
 
-def gcs_to_gcs(src_bucket: str, dst_bucket: str,
-               key: str = '') -> None:
+# Named pair helpers (parity: data_transfer.py:40,168,280) — thin wrappers
+# over transfer(), which owns the dispatch + key semantics.
+
+
+def gcs_to_gcs(src_bucket: str, dst_bucket: str, key: str = '') -> None:
     """Server-side copy between GCS buckets (no egress through client)."""
-    src = f'gs://{src_bucket}/{key}'.rstrip('/')
-    _run(['gsutil', '-m', 'rsync', '-r', src, f'gs://{dst_bucket}'],
-         'gcs→gcs rsync')
+    src = f'gs://{src_bucket}/{key}' if key else f'gs://{src_bucket}'
+    transfer(src, f'gs://{dst_bucket}')
 
 
 def s3_to_gcs(s3_bucket: str, gs_bucket: str) -> None:
     """Parity: data_transfer.py:40 — the reference uses the GCS Storage
     Transfer Service; the CLI equivalent keeps the copy server-side."""
-    _run(['gsutil', '-m', 'rsync', '-r', f's3://{s3_bucket}',
-          f'gs://{gs_bucket}'], 's3→gcs rsync')
+    transfer(f's3://{s3_bucket}', f'gs://{gs_bucket}')
 
 
 def gcs_to_s3(gs_bucket: str, s3_bucket: str) -> None:
     """Parity: data_transfer.py:168 (gsutil rsync)."""
-    _run(['gsutil', '-m', 'rsync', '-r', f'gs://{gs_bucket}',
-          f's3://{s3_bucket}'], 'gcs→s3 rsync')
+    transfer(f'gs://{gs_bucket}', f's3://{s3_bucket}')
 
 
 def local_to_gcs(local_dir: str, gs_bucket: str) -> None:
-    _run(['gsutil', '-m', 'rsync', '-r', os.path.expanduser(local_dir),
-          f'gs://{gs_bucket}'], 'local→gcs rsync')
+    transfer(local_dir, f'gs://{gs_bucket}')
 
 
 def gcs_to_local(gs_bucket: str, local_dir: str) -> None:
-    dst = os.path.expanduser(local_dir)
-    os.makedirs(dst, exist_ok=True)
-    _run(['gsutil', '-m', 'rsync', '-r', f'gs://{gs_bucket}', dst],
-         'gcs→local rsync')
+    transfer(f'gs://{gs_bucket}', local_dir)
 
 
 def local_bucket_to_local_bucket(src_dir: str, dst_dir: str) -> None:

@@ -14,11 +14,23 @@ _PROVIDER_MODULES = {
 }
 
 
+def has_provisioner(provider_name: str) -> bool:
+    """Whether this build can actually create instances on the cloud.
+
+    Catalog-only clouds (AWS) are rankable by the optimizer but must be
+    rejected BEFORE any cluster records are written.
+    """
+    return provider_name.lower() in _PROVIDER_MODULES
+
+
 def _get_module(provider_name: str):
     key = provider_name.lower()
     if key not in _PROVIDER_MODULES:
-        raise ValueError(f'Unknown provisioner {provider_name!r}. '
-                         f'Known: {sorted(_PROVIDER_MODULES)}')
+        from skypilot_tpu import exceptions
+        raise exceptions.NotSupportedError(
+            f'{provider_name} has no instance provisioner in this build '
+            f'(provisioners exist for: {sorted(_PROVIDER_MODULES)}). '
+            'Pick a different cloud, or pin resources to a supported one.')
     return importlib.import_module(_PROVIDER_MODULES[key])
 
 

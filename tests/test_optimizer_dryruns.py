@@ -116,3 +116,15 @@ def test_cost_ranking_uses_uniform_runtime(all_clouds):
         sky.Resources(accelerators='tpu-v5e:8'),
     }, minimize=OptimizeTarget.TIME)
     assert 'tpu-v5p' in str(fastest.accelerators)
+
+
+def test_provisionerless_cloud_rejected_cleanly(all_clouds):
+    """AWS is catalog-rankable but has no provisioner: a non-dryrun launch
+    must fail with a clear NotSupportedError BEFORE any cluster record."""
+    from skypilot_tpu import global_state as gs
+    task = sky.Task(run='echo hi')
+    task.set_resources(sky.Resources(cloud='aws', accelerators='A10G:1'))
+    with pytest.raises(exceptions.NotSupportedError,
+                       match='no instance provisioner'):
+        sky.launch(task, cluster_name='aws-real', stream_logs=False)
+    assert gs.get_cluster_from_name('aws-real') is None
