@@ -51,15 +51,20 @@ def main() -> None:
     from skypilot_tpu.models import llama, train
 
     on_tpu = jax.devices()[0].platform != 'cpu'
-    # Pallas flash attention: +8% over the dense XLA path at this shape
-    # (32.9k vs 30.5k tok/s on v5e, measured; the dense [S,S] probs are
-    # the HBM pressure point at seq 2048).
-    cfg = dataclasses.replace(llama.CONFIGS['bench-160m'],
-                              flash_attention=True)
-    seq = 2048
-    batch = 16
-    steps = 10
+    # bench-1b: d=2048 GEMMs keep the MXU busy (the earlier 160M model's
+    # d=1024 GEMMs were bandwidth-bound at 27% MFU); chunked CE keeps the
+    # [B,S,32k] logits out of HBM; Pallas flash attention for the [S,S]
+    # path. Knobs are env-overridable for sweeps.
+    model_name = os.environ.get('SKYTPU_BENCH_MODEL', 'bench-1b')
+    cfg = dataclasses.replace(
+        llama.CONFIGS[model_name],
+        flash_attention=True,
+        remat_policy=os.environ.get('SKYTPU_BENCH_REMAT', 'full'))
+    seq = int(os.environ.get('SKYTPU_BENCH_SEQ', '2048'))
+    batch = int(os.environ.get('SKYTPU_BENCH_BATCH', '8'))
+    steps = int(os.environ.get('SKYTPU_BENCH_STEPS', '10'))
     if not on_tpu:  # CPU dev fallback: tiny shapes, still one JSON line
+        model_name = 'debug'
         cfg = llama.CONFIGS['debug']
         seq, batch, steps = 128, 2, 3
 
@@ -95,7 +100,7 @@ def main() -> None:
         'vs_baseline': round(tokens_per_sec / baseline, 3),
     }
     extra = {
-        'model': 'bench-160m' if on_tpu else 'debug',
+        'model': model_name,
         'params': cfg.num_params(),
         'seq_len': seq,
         'batch': batch,

@@ -21,6 +21,7 @@ from skypilot_tpu.backends import backend as backend_lib
 from skypilot_tpu.backends import backend_utils
 from skypilot_tpu.provision import provisioner as provisioner_lib
 from skypilot_tpu.skylet import autostop_lib
+from skypilot_tpu.skylet import constants
 from skypilot_tpu.skylet import job_lib
 from skypilot_tpu.skylet import log_lib
 from skypilot_tpu.utils import command_runner as command_runner_lib
@@ -465,7 +466,8 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         driver = (
             '#!/bin/bash\n'
             'export PYTHONPATH=$HOME/.skytpu/runtime:$PYTHONPATH\n'
-            f'exec python3 -m skypilot_tpu.skylet.gang_run '
+            f'exec env {constants.accel_strip_shell_prefix()}'
+            f'python3 -m skypilot_tpu.skylet.gang_run '
             f'--script {remote_job_dir}/task.sh '
             f'--job-id ${{SKYTPU_JOB_ID:-0}} '
             f'--log-dir {remote_log_dir}\n')

@@ -8,6 +8,7 @@ immediately (the right default for TPU stockouts, which are zonal and
 sticky). Strategies are looked up by name in
 ``JOBS_RECOVERY_STRATEGY_REGISTRY``.
 """
+import os
 import time
 import traceback
 import typing
@@ -28,7 +29,8 @@ logger = sky_logging.init_logger(__name__)
 DEFAULT_RECOVERY_STRATEGY = 'EAGER_NEXT_REGION'
 MAX_JOB_CHECKING_RETRY = 5
 # Backoff between failed full-candidate-list launch sweeps.
-RETRY_INIT_GAP_SECONDS = 10
+RETRY_INIT_GAP_SECONDS = float(
+    os.environ.get('SKYTPU_JOBS_RETRY_GAP_SECONDS', '10'))
 
 
 class StrategyExecutor:

@@ -66,8 +66,9 @@ def _spawn_controller(job_id: int, dag_yaml_path: str) -> None:
                              state.ManagedJobScheduleState.LAUNCHING)
     try:
         import skypilot_tpu
+        from skypilot_tpu.skylet import constants
         pkg_root = os.path.dirname(os.path.dirname(skypilot_tpu.__file__))
-        env = dict(os.environ)
+        env = constants.strip_accel_boot_env(dict(os.environ))
         env['PYTHONPATH'] = pkg_root + (
             os.pathsep + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
         log_path = state.controller_log_path(job_id)

@@ -1,0 +1,124 @@
+"""Model checkpoint save/restore — the spot-TPU resume story.
+
+The reference's recovery recipes checkpoint to a Storage MOUNT and resume
+from the latest step after preemption (``llm/llama-3_1-finetuning/lora.yaml``
+mounts ``/output``; SURVEY §5.4). Here the train loop checkpoints the full
+``TrainState`` (params + Adam moments + step) with orbax into a directory —
+typically a mounted bucket path — and ``restore_latest`` picks up where the
+preempted run stopped.
+
+TPU-first details:
+* orbax OCDBT + zarr3: sharded async-friendly writes, no host gather — on a
+  multi-host slice every host writes its own param shards (orbax handles
+  the cross-host coordination through jax.distributed).
+* ``keep`` bounds retained checkpoints so a mounted bucket doesn't grow
+  unboundedly; retention runs at save time.
+* Restore takes an ``abstract_state`` (from ``jax.eval_shape`` over the
+  init fn) so the restored arrays land directly with the right sharding —
+  params never materialize unsharded.
+"""
+import os
+import re
+from typing import Any, Optional
+
+import jax
+
+STEP_PREFIX = 'step_'
+
+
+def _ckpt_dir(root: str, step: int) -> str:
+    return os.path.join(root, f'{STEP_PREFIX}{step}')
+
+
+def list_steps(root: str) -> list:
+    """Completed checkpoint steps under root, ascending."""
+    if not os.path.isdir(root):
+        return []
+    steps = []
+    for name in os.listdir(root):
+        m = re.fullmatch(f'{STEP_PREFIX}(\\d+)', name)
+        if m and os.path.isdir(os.path.join(root, name)):
+            # orbax writes a commit marker; an interrupted save leaves a
+            # tmp dir that must not be resumed from.
+            if _is_complete(os.path.join(root, name)):
+                steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def _is_complete(path: str) -> bool:
+    try:
+        entries = os.listdir(path)
+    except OSError:
+        return False
+    return not any(e.endswith('.orbax-checkpoint-tmp') or
+                   e == 'tmp' for e in entries) and bool(entries)
+
+
+def save(root: str, state: Any, step: int, keep: int = 3) -> str:
+    """Write state at `step` under root; prune to the newest `keep`."""
+    import orbax.checkpoint as ocp
+    path = _ckpt_dir(os.path.abspath(os.path.expanduser(root)), step)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, state, force=True)
+    ckptr.wait_until_finished()
+    ckptr.close()
+    _prune(os.path.abspath(os.path.expanduser(root)), keep)
+    return path
+
+
+def _prune(root: str, keep: int) -> None:
+    import shutil
+    steps = list_steps(root)
+    for step in steps[:-keep] if keep > 0 else []:
+        shutil.rmtree(_ckpt_dir(root, step), ignore_errors=True)
+
+
+def restore(root: str, step: int, abstract_state: Any) -> Any:
+    """Restore the state saved at `step` (shapes/shardings from
+    abstract_state, e.g. jax.eval_shape of the init fn)."""
+    import orbax.checkpoint as ocp
+    path = _ckpt_dir(os.path.abspath(os.path.expanduser(root)), step)
+    ckptr = ocp.StandardCheckpointer()
+    try:
+        return ckptr.restore(path, abstract_state)
+    finally:
+        ckptr.close()
+
+
+def restore_latest(root: str,
+                   abstract_state: Any) -> Optional[tuple]:
+    """(state, step) from the newest complete checkpoint, or None."""
+    steps = list_steps(os.path.abspath(os.path.expanduser(root)))
+    if not steps:
+        return None
+    step = steps[-1]
+    return restore(root, step, abstract_state), step
+
+
+def abstract_train_state(key: jax.Array, model_cfg, train_cfg,
+                         mesh=None) -> Any:
+    """ShapeDtypeStruct pytree matching ``train.init_train_state`` output,
+    with shardings attached when a mesh is given."""
+    from skypilot_tpu.models import llama, train
+    from skypilot_tpu.parallel import mesh as mesh_lib
+
+    def _init(k):
+        return train.init_train_state(k, model_cfg, train_cfg)
+
+    abstract = jax.eval_shape(_init, key)
+    if mesh is None:
+        return abstract
+    specs = llama.param_partition_specs(model_cfg)
+    param_shardings = mesh_lib.spec_to_sharding(mesh, specs)
+    opt_shardings = train._opt_state_shardings(  # pylint: disable=protected-access
+        abstract.opt_state, param_shardings, mesh)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def attach(x, sh):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+
+    params = jax.tree.map(attach, abstract.params, param_shardings)
+    opt_state = jax.tree.map(attach, abstract.opt_state, opt_shardings)
+    step = jax.ShapeDtypeStruct(abstract.step.shape, abstract.step.dtype,
+                                sharding=NamedSharding(mesh, P()))
+    return train.TrainState(params=params, opt_state=opt_state, step=step)

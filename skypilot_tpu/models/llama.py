@@ -23,6 +23,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from skypilot_tpu.ops import attention as attention_ops
@@ -45,12 +46,22 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     # Remat each block's activations (trade FLOPs for HBM).
     remat: bool = True
+    # Remat policy: 'full' recomputes everything in backward; 'dots' saves
+    # every linear-layer GEMM output (no-batch-dim dots); 'ffn' saves only
+    # the two big FFN GEMM outputs (w1/w3 — ~60% of block FLOPs) and
+    # recomputes the cheap rest. Pick the richest policy HBM allows.
+    remat_policy: str = 'full'
     # Use ring attention (sequence parallelism over the 'seq' mesh axis).
     ring_attention: bool = False
     # Use the Pallas flash-attention kernel (TPU; falls back to the XLA
     # path off-TPU). Wins at long sequence lengths where [S,S] logits
     # would pressure HBM.
     flash_attention: bool = False
+    # Cross-entropy computed in sequence chunks so the [B, S, vocab]
+    # logits tensor never materializes in HBM (1 = unchunked). The lm_head
+    # matmul + softmax run per chunk under jax.checkpoint; backward
+    # recomputes each chunk's logits instead of storing them.
+    ce_chunks: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -85,6 +96,12 @@ CONFIGS: Dict[str, LlamaConfig] = {
     'bench-160m': LlamaConfig(vocab_size=32768, dim=1024, n_layers=12,
                               n_heads=16, n_kv_heads=8, ffn_dim=4096,
                               max_seq_len=2048),
+    # ~1.1B-class model sized to fill a single v5e chip's HBM: d=2048
+    # matmuls keep the MXU busy (the 160M model's d=1024 GEMMs are
+    # bandwidth-bound), chunked CE keeps the logits out of HBM.
+    'bench-1b': LlamaConfig(vocab_size=32768, dim=2048, n_layers=16,
+                            n_heads=16, n_kv_heads=8, ffn_dim=8192,
+                            max_seq_len=2048, ce_chunks=8),
     'debug': LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
                          n_kv_heads=2, ffn_dim=128, max_seq_len=128,
                          remat=False),
@@ -217,8 +234,10 @@ def ffn_sublayer(cfg: LlamaConfig, x: jax.Array,
                  layer: Params) -> jax.Array:
     """Norm → SwiGLU → residual (dense FFN)."""
     h = rms_norm(x, layer['ffn_norm'], cfg.norm_eps)
-    gate = jax.nn.silu((h @ layer['w1']).astype(jnp.float32))
-    up = (h @ layer['w3']).astype(jnp.float32)
+    w1_out = checkpoint_name((h @ layer['w1']), 'ffn_w1')
+    w3_out = checkpoint_name((h @ layer['w3']), 'ffn_w3')
+    gate = jax.nn.silu(w1_out.astype(jnp.float32))
+    up = w3_out.astype(jnp.float32)
     down = ((gate * up).astype(cfg.dtype)) @ layer['w2']
     return x + down.astype(cfg.dtype)
 
@@ -229,16 +248,17 @@ def _block(cfg: LlamaConfig, x: jax.Array, layer: Params, cos: jax.Array,
     return ffn_sublayer(cfg, x, layer)
 
 
-def forward(params: Params,
-            tokens: jax.Array,
-            cfg: LlamaConfig,
-            positions: Optional[jax.Array] = None) -> jax.Array:
-    """tokens [B, S] int32 → logits [B, S, vocab] float32.
+def forward_hidden(params: Params,
+                   tokens: jax.Array,
+                   cfg: LlamaConfig,
+                   positions: Optional[jax.Array] = None) -> jax.Array:
+    """tokens [B, S] int32 → final normed hidden states [B, S, dim].
 
     Scans over the stacked layer params; each block body optionally
     rematerialized.
     """
     b, s = tokens.shape
+    del b
     if positions is None:
         positions = jnp.arange(s, dtype=jnp.int32)
     cos, sin = _rope_freqs(cfg, positions)
@@ -251,19 +271,66 @@ def forward(params: Params,
         return out, None
 
     if cfg.remat:
-        body = jax.checkpoint(body, prevent_cse=False)
+        policy = None
+        if cfg.remat_policy == 'dots':
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif cfg.remat_policy == 'ffn':
+            policy = jax.checkpoint_policies.save_only_these_names(
+                'ffn_w1', 'ffn_w3')
+        body = jax.checkpoint(body, prevent_cse=False, policy=policy)
     x, _ = jax.lax.scan(body, x, params['layers'])
 
-    x = rms_norm(x, params['out_norm'], cfg.norm_eps)
-    logits = (x @ params['lm_head']).astype(jnp.float32)
-    return logits
+    return rms_norm(x, params['out_norm'], cfg.norm_eps)
+
+
+def forward(params: Params,
+            tokens: jax.Array,
+            cfg: LlamaConfig,
+            positions: Optional[jax.Array] = None) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, vocab] float32."""
+    x = forward_hidden(params, tokens, cfg, positions)
+    return (x @ params['lm_head']).astype(jnp.float32)
+
+
+def _xent_from_logits(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Summed (not mean) next-token cross-entropy, fp32."""
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None],
+                               axis=-1).squeeze(-1)
+    return jnp.sum(logz - gold)
+
+
+def chunked_cross_entropy(x: jax.Array, lm_head: jax.Array,
+                          targets: jax.Array, num_chunks: int) -> jax.Array:
+    """Mean CE over [B, S] without ever materializing [B, S, vocab].
+
+    Scans sequence chunks; each chunk's lm_head GEMM + softmax runs under
+    ``jax.checkpoint``, so backward recomputes the chunk's logits rather
+    than holding S×vocab activations (the HBM cliff that caps the bench
+    model's batch size — at vocab 32k, seq 2048, bs 16 the fp32 logits
+    alone are 8 GB).
+    """
+    b, s, d = x.shape
+    assert s % num_chunks == 0, (s, num_chunks)
+    xs = x.reshape(b, num_chunks, s // num_chunks, d).swapaxes(0, 1)
+    ts = targets.reshape(b, num_chunks, s // num_chunks).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def chunk(carry, xt):
+        xc, tc = xt
+        logits = (xc @ lm_head).astype(jnp.float32)
+        return carry + _xent_from_logits(logits, tc), None
+
+    total, _ = jax.lax.scan(chunk, jnp.zeros((), jnp.float32), (xs, ts))
+    return total / (b * s)
 
 
 def loss_fn(params: Params, tokens: jax.Array, targets: jax.Array,
             cfg: LlamaConfig) -> jax.Array:
     """Mean next-token cross-entropy (targets = tokens shifted by caller)."""
-    logits = forward(params, tokens, cfg)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None],
-                               axis=-1).squeeze(-1)
-    return jnp.mean(logz - gold)
+    x = forward_hidden(params, tokens, cfg)
+    if cfg.ce_chunks > 1:
+        return chunked_cross_entropy(x, params['lm_head'], targets,
+                                     cfg.ce_chunks)
+    logits = (x @ params['lm_head']).astype(jnp.float32)
+    return _xent_from_logits(logits, targets) / targets.size

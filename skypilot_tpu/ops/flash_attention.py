@@ -1,4 +1,4 @@
-"""Flash attention for TPU, written in Pallas.
+"""Flash attention for TPU, written in Pallas — forward AND backward.
 
 TPU-native replacement for the dense attention path when sequences are
 long: the [S, S] logits matrix never materializes in HBM — each Q block
@@ -6,14 +6,16 @@ streams K/V blocks through VMEM with an online-softmax accumulator (the
 same recurrence ``parallel/ring.py`` uses across chips, here across VMEM
 blocks within a chip). Causal blocks that are fully masked are skipped.
 
-Forward is the Pallas kernel; backward (for training) recomputes through
-the XLA path via ``jax.custom_vjp`` — correct gradients everywhere, with
-the kernel's memory win applying to inference/prefill and to the remat'd
-forward. Falls back to the XLA path off-TPU (tests run the kernel in
-interpreter mode to check numerics).
+Backward is the FlashAttention-2 recurrence: the forward additionally
+emits the per-row log-normalizer L = m + log(l); backward recomputes
+P = exp(scale·qkᵀ − L) block-by-block in VMEM and accumulates
+dq (one kernel, gridded over Q blocks) and dk/dv (a second kernel,
+gridded over K blocks) — so the [S,S] probabilities are never written to
+HBM in either direction. Falls back to the XLA path off-TPU (tests run
+the kernels in interpreter mode to check numerics).
 """
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -27,11 +29,12 @@ DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_len: int,
-                  causal: bool, scale: float):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, block_k: int,
+                  seq_len: int, causal: bool, scale: float):
     """One (batch·head, q-block) program: stream K/V blocks, fold online.
 
-    q_ref: [1, block_q, d]; k_ref/v_ref: [1, seq_len, d]; o_ref like q_ref.
+    q_ref: [1, block_q, d]; k_ref/v_ref: [1, seq_len, d]; o_ref like q_ref;
+    l_ref: [1, block_q] log-normalizers (m + log l) for the backward pass.
     """
     qi = pl.program_id(1)
     block_q = q_ref.shape[1]
@@ -73,15 +76,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_len: int,
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
     out = acc / jnp.maximum(l, 1e-20)
     o_ref[0] = out.astype(o_ref.dtype)
+    # Log-normalizer per row (finite for causal: row i always sees col i).
+    l_ref[0, :] = (m + jnp.log(jnp.maximum(l, 1e-20)))[:, 0]
 
 
 def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
                    block_q: int, block_k: int,
-                   interpret: bool) -> jax.Array:
-    """q [B,S,H,D], k/v [B,S,Hkv,D] → [B,S,H,D]."""
+                   interpret: bool) -> Tuple[jax.Array, jax.Array]:
+    """q [B,S,H,D], k/v [B,S,Hkv,D] → (out [B,S,H,D], L [B*H,S])."""
     b, s, h, d = q.shape
     hkv = k.shape[2]
     n_rep = h // hkv
@@ -98,6 +103,9 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
     def q_index(bh, qi):
         return (bh, qi, 0)
 
+    def l_index(bh, qi):
+        return (bh, qi)
+
     def kv_index(bh, qi):
         del qi
         # bh indexes [B*H]; its KV row is (batch, kv_head) flattened.
@@ -106,7 +114,7 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
     kernel = functools.partial(_flash_kernel, block_k=block_k,
                                seq_len=s, causal=causal,
                                scale=d**-0.5)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -114,11 +122,198 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
             pl.BlockSpec((1, s, d), kv_index),
             pl.BlockSpec((1, s, d), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), q_index),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_q), l_index),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s), jnp.float32),
+        ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3), lse
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, dsum_ref,
+                         dq_ref, *, block_k: int, seq_len: int, causal: bool,
+                         scale: float):
+    """dq for one (batch·head, q-block): stream K/V blocks, recompute P.
+
+    FlashAttention-2 backward, dq pass: P = exp(scale·qkᵀ − L);
+    dS = P ⊙ (dO·Vᵀ − D); dq = scale · Σⱼ dSⱼ Kⱼ.
+    """
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32)                   # [bq, d]
+    do = do_ref[0].astype(jnp.float32)                 # [bq, d]
+    lse = l_ref[0][:, None]                            # [bq, 1]
+    dsum = dsum_ref[0][:, None]                        # [bq, 1]
+    q_start = qi * block_q
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    num_k_blocks = pl.cdiv(seq_len, block_k)
+    if causal:
+        num_k_blocks = pl.cdiv(q_start + block_q, block_k)
+
+    def body(j, acc):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        logits = scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, bk]
+        p = jnp.exp(logits - lse)
+        if causal:
+            kv_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            p = jnp.where(q_pos >= kv_pos, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, bk]
+        ds_ = p * (dp - dsum)
+        return acc + jax.lax.dot_general(
+            ds_, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, d]
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    dq = scale * jax.lax.fori_loop(0, num_k_blocks, body, acc0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, l_ref, dsum_ref,
+                          dk_ref, dv_ref, *, block_q: int, seq_len: int,
+                          causal: bool, scale: float):
+    """dk/dv for one (batch·head, k-block): stream Q/dO blocks.
+
+    dV = Σᵢ Pᵢᵀ dOᵢ;  dK = scale · Σᵢ dSᵢᵀ Qᵢ.
+    """
+    ki = pl.program_id(1)
+    block_k = k_ref.shape[1]
+    d = k_ref.shape[2]
+    k = k_ref[0].astype(jnp.float32)                   # [bk, d]
+    v = v_ref[0].astype(jnp.float32)                   # [bk, d]
+    k_start = ki * block_k
+    kv_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+
+    num_q_blocks = pl.cdiv(seq_len, block_q)
+    i0 = 0
+    if causal:
+        i0 = k_start // block_q  # q blocks strictly above the diag skip
+
+    def body(i, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(
+            jnp.float32)
+        lse = l_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        dsum = dsum_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        logits = scale * jax.lax.dot_general(
+            q_blk, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, bk]
+        p = jnp.exp(logits - lse)
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            p = jnp.where(q_pos >= kv_pos, p, 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bk, d]
+        dp = jax.lax.dot_general(
+            do_blk, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, bk]
+        ds_ = p * (dp - dsum)
+        dk = dk + jax.lax.dot_general(
+            ds_, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bk, d]
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(i0, num_q_blocks, body, (dk0, dv0))
+    dk_ref[0] = (scale * dk).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
+                    interpret):
+    """Pallas backward: returns (dq, dk, dv) in [B,S,H,D]/[B,S,Hkv,D]."""
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    n_rep = h // hkv
+    bq, bk = min(block_q, s), min(block_k, s)
+
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    # GQA fan-out for the dkv pass: each (batch, q-head) program needs its
+    # kv row writable, so expand here ([B*Hkv,S,D] → [B*H,S,D], 33 MB at
+    # bench shapes) and sum-reduce the reps afterwards.
+    kt = jnp.repeat(k.transpose(0, 2, 1, 3), n_rep,
+                    axis=1).reshape(b * h, s, d)
+    vt = jnp.repeat(v.transpose(0, 2, 1, 3), n_rep,
+                    axis=1).reshape(b * h, s, d)
+    dot = g.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    # D = rowsum(dO ⊙ O), fp32 (cheap elementwise; XLA fuses it).
+    dsum = jnp.sum(dot.astype(jnp.float32) *
+                   out.transpose(0, 2, 1, 3).reshape(b * h, s, d).astype(
+                       jnp.float32), axis=-1)          # [B*H, S]
+
+    def blk3(bh, i):
+        return (bh, i, 0)
+
+    def row(bh, i):
+        del i
+        return (bh, 0, 0)
+
+    def vec(bh, i):
+        del i
+        return (bh, 0)
+
+    scale = d**-0.5
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=bk, seq_len=s,
+                          causal=causal, scale=scale),
+        grid=(b * h, s // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), blk3),     # q
+            pl.BlockSpec((1, s, d), row),       # k (full row)
+            pl.BlockSpec((1, s, d), row),       # v
+            pl.BlockSpec((1, bq, d), blk3),     # dO
+            pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),   # L
+            pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),   # D
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), blk3),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, dsum)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=bq, seq_len=s,
+                          causal=causal, scale=scale),
+        grid=(b * h, s // bk),
+        in_specs=[
+            pl.BlockSpec((1, bk, d), blk3),     # k block
+            pl.BlockSpec((1, bk, d), blk3),     # v block
+            pl.BlockSpec((1, s, d), row),       # q (full row)
+            pl.BlockSpec((1, s, d), row),       # dO
+            pl.BlockSpec((1, s), vec),          # L
+            pl.BlockSpec((1, s), vec),          # D
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), blk3),
+            pl.BlockSpec((1, bk, d), blk3),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        ],
+        interpret=interpret,
+    )(kt, vt, qt, dot, lse, dsum)
+
+    dq = dq.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    # Reduce the GQA reps back to kv heads.
+    dk = dk.reshape(b, hkv, n_rep, s, d).sum(axis=2).transpose(0, 2, 1, 3)
+    dv = dv.reshape(b, hkv, n_rep, s, d).sum(axis=2).transpose(0, 2, 1, 3)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -142,7 +337,8 @@ def flash_attention(q: jax.Array,
         # Off-TPU, or S does not tile: the XLA path is exact and safe
         # (an untiled grid would silently leave output rows unwritten).
         return attention_ops.gqa_attention(q, k, v, causal=causal)
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def _resolve_interpret(interpret: Optional[bool]) -> Optional[bool]:
@@ -155,20 +351,27 @@ def _resolve_interpret(interpret: Optional[bool]) -> Optional[bool]:
 
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    itp = _resolve_interpret(interpret)
+    s = q.shape[1]
+    bq, bk = min(block_q, s), min(block_k, s)
+    if itp is None or s % bq or s % bk:
+        out = attention_ops.gqa_attention(q, k, v, causal=causal)
+        return out, (q, k, v, None, None)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, itp)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, block_q, block_k, interpret, res, g):
-    # Recompute through the XLA path for gradients: exact, lets remat'd
-    # forwards still use the kernel. (A full Pallas backward is a later
-    # optimization; the bench tracks whether it pays.)
-    del block_q, block_k, interpret
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention_ops.gqa_attention(
-            q_, k_, v_, causal=causal), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    if out is None:
+        # Fallback pairing: gradients through the XLA path.
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: attention_ops.gqa_attention(
+                q_, k_, v_, causal=causal), q, k, v)
+        return vjp(g)
+    itp = _resolve_interpret(interpret)
+    return _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
+                           itp)
 
 
 flash_attention.defvjp(_fwd, _bwd)

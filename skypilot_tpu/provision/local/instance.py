@@ -1,12 +1,70 @@
-"""Local node lifecycle: a node = a directory with a state file."""
+"""Local node lifecycle: a node = a directory with a state file.
+
+Teardown semantics mirror a real cloud: stopping/terminating a "node" kills
+every process running on it (skylet daemon, job runners, gang ranks, task
+children) — the analogue of the VM going away. Node processes are found by
+scanning ``/proc/*/environ`` for ``SKYTPU_SKYLET_HOME``/``HOME`` pointing
+inside the node dir (every process the runtime spawns on a local node
+carries one of these; see ``gang_run._make_argv`` and
+``command_runner.LocalProcessRunner``).
+"""
 import json
 import os
 import shutil
+import signal
+import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.provision import common
 
 CLUSTER_ROOT = '~/.skytpu/local_cluster'
+
+
+def _find_node_pids(cluster_dir: str,
+                    workers_only: bool = False) -> List[int]:
+    """PIDs of processes whose home env points inside cluster_dir."""
+    cluster_dir = os.path.realpath(cluster_dir)
+    head_dir = os.path.join(cluster_dir, 'node-0').encode()
+    needles = (f'SKYTPU_SKYLET_HOME={cluster_dir}'.encode(),
+               f'HOME={cluster_dir}'.encode(),
+               f'SKYTPU_NODE_DIR={cluster_dir}'.encode())
+    pids: List[int] = []
+    me = os.getpid()
+    for entry in os.listdir('/proc'):
+        if not entry.isdigit() or int(entry) == me:
+            continue
+        try:
+            with open(f'/proc/{entry}/environ', 'rb') as f:
+                environ = f.read()
+        except OSError:
+            continue
+        for var in environ.split(b'\0'):
+            if any(var == n or var.startswith(n + b'/') for n in needles):
+                if workers_only and head_dir in var:
+                    break
+                pids.append(int(entry))
+                break
+    return pids
+
+
+def _kill_node_processes(cluster_dir: str,
+                         workers_only: bool = False) -> None:
+    """SIGTERM → short grace → SIGKILL every process of this cluster's
+    nodes, so teardown never leaks skylet/gang_run/task trees."""
+    pids = _find_node_pids(cluster_dir, workers_only=workers_only)
+    for sig, grace in ((signal.SIGTERM, 1.0), (signal.SIGKILL, 0.5)):
+        if not pids:
+            return
+        for pid in pids:
+            try:
+                os.kill(pid, sig)
+            except (ProcessLookupError, PermissionError):
+                pass
+        deadline = time.time() + grace
+        while pids and time.time() < deadline:
+            pids = [p for p in pids if os.path.exists(f'/proc/{p}')]
+            if pids:
+                time.sleep(0.05)
 
 
 def _cluster_dir(cluster_name_on_cloud: str) -> str:
@@ -112,6 +170,9 @@ def stop_instances(cluster_name_on_cloud: str,
             continue
         state[node_id] = 'stopped'
     _save_state(cluster_name_on_cloud, state)
+    # A stopped node's processes die with the "VM".
+    _kill_node_processes(_cluster_dir(cluster_name_on_cloud),
+                         workers_only=worker_only)
 
 
 def terminate_instances(cluster_name_on_cloud: str,
@@ -123,7 +184,10 @@ def terminate_instances(cluster_name_on_cloud: str,
             if not node_id.endswith('-0'):
                 state.pop(node_id)
         _save_state(cluster_name_on_cloud, state)
+        _kill_node_processes(_cluster_dir(cluster_name_on_cloud),
+                             workers_only=True)
         return
+    _kill_node_processes(_cluster_dir(cluster_name_on_cloud))
     shutil.rmtree(_cluster_dir(cluster_name_on_cloud), ignore_errors=True)
 
 

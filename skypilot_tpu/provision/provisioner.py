@@ -168,6 +168,7 @@ def post_provision_runtime_setup(
             'echo skylet already running; else '
             'PYTHONPATH=~/.skytpu/runtime:$PYTHONPATH '
             f'{constants.SKYLET_HOME_ENV}=$HOME '
+            f'{constants.accel_strip_shell_prefix()}'
             'nohup python3 -m skypilot_tpu.skylet.skylet '
             '> ~/.skytpu/skylet.log 2>&1 < /dev/null & '
             'echo $! > ~/.skytpu/skylet.pid; fi',

@@ -61,8 +61,9 @@ def up(task: task_lib.Task,
 
 def _spawn_controller(service_name: str) -> None:
     import skypilot_tpu
+    from skypilot_tpu.skylet import constants
     pkg_root = os.path.dirname(os.path.dirname(skypilot_tpu.__file__))
-    env = dict(os.environ)
+    env = constants.strip_accel_boot_env(dict(os.environ))
     env['PYTHONPATH'] = pkg_root + (
         os.pathsep + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
     log_path = serve_state.controller_log_path(service_name)

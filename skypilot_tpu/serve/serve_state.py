@@ -33,6 +33,10 @@ _TABLES = """
         consecutive_failures INTEGER DEFAULT 0,
         PRIMARY KEY (service_name, replica_id)
     );
+    CREATE TABLE IF NOT EXISTS replica_id_seq (
+        service_name TEXT PRIMARY KEY,
+        next_id INTEGER
+    );
 """
 
 
@@ -160,6 +164,8 @@ def remove_service(name: str) -> None:
     with _db() as conn:
         conn.execute('DELETE FROM services WHERE name=?', (name,))
         conn.execute('DELETE FROM replicas WHERE service_name=?', (name,))
+        conn.execute('DELETE FROM replica_id_seq WHERE service_name=?',
+                     (name,))
 
 
 # ---------------------------------------------------------------- replicas
@@ -220,8 +226,23 @@ def remove_replica(service_name: str, replica_id: int) -> None:
 
 
 def next_replica_id(service_name: str) -> int:
+    """Monotonic per-service id — NEVER reused, even after a replica's row
+    is removed (a replacement for a preempted replica 1 is replica 2, so
+    callers can tell recycled capacity from the original; parity with the
+    reference's ever-increasing replica ids)."""
     with _db() as conn:
         row = conn.execute(
-            'SELECT MAX(replica_id) AS m FROM replicas WHERE '
-            'service_name=?', (service_name,)).fetchone()
-    return (row['m'] or 0) + 1
+            'SELECT next_id FROM replica_id_seq WHERE service_name=?',
+            (service_name,)).fetchone()
+        if row is None:
+            mx = conn.execute(
+                'SELECT MAX(replica_id) AS m FROM replicas WHERE '
+                'service_name=?', (service_name,)).fetchone()
+            nxt = (mx['m'] or 0) + 1
+        else:
+            nxt = row['next_id']
+        conn.execute(
+            'INSERT INTO replica_id_seq (service_name, next_id) '
+            'VALUES (?, ?) ON CONFLICT(service_name) DO UPDATE SET '
+            'next_id=?', (service_name, nxt + 1, nxt + 1))
+    return nxt

@@ -70,7 +70,8 @@ def _start_local_server(url: str) -> None:
     port = int(url.rsplit(':', 1)[1])
     import skypilot_tpu
     pkg_root = os.path.dirname(os.path.dirname(skypilot_tpu.__file__))
-    env = dict(os.environ)
+    from skypilot_tpu.skylet import constants
+    env = constants.strip_accel_boot_env(dict(os.environ))
     env['PYTHONPATH'] = pkg_root + (
         os.pathsep + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
     logger.info(f'Starting local API server on port {port}...')

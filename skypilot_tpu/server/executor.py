@@ -38,7 +38,8 @@ def schedule(name: str, payload: Dict[str, Any]) -> str:
 def _spawn_worker(request_id: str) -> None:
     import skypilot_tpu
     pkg_root = os.path.dirname(os.path.dirname(skypilot_tpu.__file__))
-    env = dict(os.environ)
+    from skypilot_tpu.skylet import constants
+    env = constants.strip_accel_boot_env(dict(os.environ))
     env['PYTHONPATH'] = pkg_root + (
         os.pathsep + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
     log_path = requests_db.log_path(request_id)

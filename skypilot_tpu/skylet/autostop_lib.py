@@ -64,4 +64,5 @@ class AutostopCodeGen:
                      cluster_name: str) -> str:
         body = (f'autostop_lib.set_autostop({idle_minutes}, {down}, '
                 f'{cloud!r}, {cluster_name!r})')
-        return f'python3 -u -c {shlex.quote(cls._PRELUDE + body)}'
+        return (f'{constants.accel_strip_shell_prefix()}'
+                f'python3 -u -c {shlex.quote(cls._PRELUDE + body)}')

@@ -62,3 +62,44 @@ TPU_WORKER_ID_ENV = 'TPU_WORKER_ID'
 TPU_WORKER_HOSTNAMES_ENV = 'TPU_WORKER_HOSTNAMES'
 
 SKYLET_VERSION = '1'
+
+# ------------------------------------------------- control-plane interpreters
+# Some accelerator environments register a PJRT plugin from sitecustomize at
+# EVERY interpreter startup when a trigger env var is set — a multi-second
+# jax import. Control-plane processes (skylet, codegen RPC snippets,
+# job_runner, gang_run, jobs/serve controllers) never touch the accelerator,
+# so they run with the trigger moved aside; ``gang_run`` restores it into
+# the task env, where user code DOES need the accelerator.
+ACCEL_BOOT_ENVS = ('PALLAS_AXON_POOL_IPS',)
+_SAVED_SUFFIX = '_SKYTPU_SAVED'
+
+
+def strip_accel_boot_env(env: dict) -> dict:
+    """Move accelerator-boot triggers aside in an env dict (in place)."""
+    for name in ACCEL_BOOT_ENVS:
+        val = env.pop(name, None)
+        if val:
+            env[name + _SAVED_SUFFIX] = val
+    return env
+
+
+def restore_accel_boot_env(env: dict) -> dict:
+    """Task-env counterpart: bring the saved triggers back (in place)."""
+    for name in ACCEL_BOOT_ENVS:
+        saved = os.environ.get(name + _SAVED_SUFFIX) or os.environ.get(name)
+        if saved:
+            env[name] = saved
+    return env
+
+
+def accel_strip_shell_prefix() -> str:
+    """Inline `VAR_SAVED="$VAR" VAR= ` prefix for shell-spawned pythons.
+
+    Falls back to an already-saved value so chained strips (provisioner →
+    skylet → job_runner → driver) don't clobber the original.
+    """
+    parts = []
+    for name in ACCEL_BOOT_ENVS:
+        saved = f'{name}{_SAVED_SUFFIX}'
+        parts.append(f'{saved}="${{{name}:-${{{saved}:-}}}}" {name}=')
+    return ' '.join(parts) + ' ' if parts else ''

@@ -79,6 +79,57 @@ class AutostopEvent(SkyletEvent):
                                      provider_config=provider_config)
 
 
+class ManagedJobEvent(SkyletEvent):
+    """Reconcile the managed-jobs scheduler on the controller host.
+
+    Parity: ``sky/skylet/events.py:73`` ManagedJobEvent — dead controller
+    processes are detected and WAITING jobs pulled in, so a controller
+    cluster self-heals even if no client ever calls in again.
+    """
+    EVENT_CHECKING_INTERVAL_SECONDS = 60
+
+    def run(self) -> None:
+        from skypilot_tpu.jobs import state as jobs_state
+        if not os.path.exists(jobs_state.db_path()):
+            return  # not a jobs controller host
+        from skypilot_tpu.jobs import scheduler
+        scheduler.maybe_schedule_next_jobs()
+
+
+class ServiceUpdateEvent(SkyletEvent):
+    """Restart dead serve controllers (parity: events.py:82).
+
+    A service whose controller process died (host reboot, OOM) is revived
+    so replicas keep being managed.
+    """
+    EVENT_CHECKING_INTERVAL_SECONDS = 60
+
+    def run(self) -> None:
+        from skypilot_tpu.serve import serve_state
+        if not os.path.exists(serve_state.db_path()):
+            return  # not a serve controller host
+        from skypilot_tpu.serve import core as serve_core
+        for svc in serve_state.get_services():
+            if svc['status'].is_terminal():
+                continue  # SHUTDOWN/FAILED: never resurrect
+            if svc.get('shutdown_requested'):
+                continue
+            pid = svc['controller_pid']
+            if pid is not None and _pid_alive(pid):
+                continue
+            serve_core._spawn_controller(svc['name'])  # pylint: disable=protected-access
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
 class UsageHeartbeatReportEvent(SkyletEvent):
     """Telemetry heartbeat (parity: events.py:94); no-op if disabled."""
     EVENT_CHECKING_INTERVAL_SECONDS = 600

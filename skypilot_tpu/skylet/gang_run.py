@@ -117,6 +117,9 @@ def run_gang(script_path: str,
             constants.TPU_WORKER_ID_ENV: str(rank),
             constants.TPU_WORKER_HOSTNAMES_ENV: ','.join(internal_ips),
         }
+        # User code needs the accelerator: undo the control-plane
+        # plugin-boot suppression for the task env.
+        constants.restore_accel_boot_env(env)
         env.update(extra_env or {})
         return env
 
@@ -164,12 +167,24 @@ def run_gang(script_path: str,
 
     # Fate-sharing watchdog: first failure kills the rest of the gang
     # (parity: Ray task cancellation on placement-group member failure).
+    # Event-driven (failed.wait), and the kill sweep REPEATS until every
+    # rank thread has exited — a rank whose Popen landed after the first
+    # sweep would otherwise run to completion (the round-1 flake).
+    grace = float(os.environ.get('SKYTPU_GANG_GRACE_SECONDS', '2'))
     while any(t.is_alive() for t in threads):
-        if failed.is_set():
-            time.sleep(2)  # grace period for peers to exit on their own
-            _kill_stragglers(hosts, procs, rcs, marker)
+        if failed.wait(timeout=0.2):
+            time.sleep(grace)  # let peers exit on their own first
+            # Bounded sweep: repeats catch ranks whose Popen landed after
+            # an earlier pass, the cap keeps a rank stuck pre-Popen (e.g.
+            # scp to a dead worker) from wedging the gang forever.
+            for attempt in range(30):
+                if not any(t.is_alive() for t in threads):
+                    break
+                _kill_stragglers(hosts, procs, rcs, marker,
+                                 sig=15 if attempt < 2 else 9)
+                for t in threads:
+                    t.join(timeout=1)
             break
-        time.sleep(0.2)
     for t in threads:
         t.join(timeout=30)
 
@@ -183,12 +198,12 @@ def run_gang(script_path: str,
     return 0
 
 
-def _kill_stragglers(hosts, procs, rcs, marker: str) -> None:
+def _kill_stragglers(hosts, procs, rcs, marker: str, sig: int = 15) -> None:
     for i, proc in enumerate(procs):
         if rcs[i] is not None or proc is None:
             continue
         try:
-            os.killpg(os.getpgid(proc.pid), 15)
+            os.killpg(os.getpgid(proc.pid), sig)
         except (ProcessLookupError, OSError):
             pass
         host = hosts[i]

@@ -209,7 +209,7 @@ def queue_job(job_id: int) -> None:
 
 
 def _spawn_job_runner(job: Dict[str, Any]) -> None:
-    env = dict(os.environ)
+    env = constants.strip_accel_boot_env(dict(os.environ))
     env[constants.SKYLET_HOME_ENV] = constants.skylet_home()
     # The runner must resolve skypilot_tpu from the synced runtime dir.
     runtime = constants.runtime_dir()
@@ -306,7 +306,10 @@ class JobLibCodeGen:
 
     @classmethod
     def _wrap(cls, body: str) -> str:
-        return f'python3 -u -c {shlex.quote(cls._PRELUDE + body)}'
+        # Control-plane RPC: suppress accelerator-plugin boot — these
+        # snippets run dozens of times per job and never touch the chip.
+        return (f'{constants.accel_strip_shell_prefix()}'
+                f'python3 -u -c {shlex.quote(cls._PRELUDE + body)}')
 
     @classmethod
     def add_job(cls, job_name: Optional[str], username: str,

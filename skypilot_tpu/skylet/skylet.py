@@ -4,6 +4,7 @@ Parity: ``sky/skylet/skylet.py:17-35`` — an infinite loop over the event
 list on the head host (each worker host of a slice also runs one for local
 job bookkeeping, but only the head's drives autostop).
 """
+import os
 import time
 
 from skypilot_tpu.skylet import events
@@ -12,9 +13,11 @@ EVENTS = [
     events.JobSchedulerEvent(),
     events.AutostopEvent(),
     events.UsageHeartbeatReportEvent(),
+    events.ManagedJobEvent(),
+    events.ServiceUpdateEvent(),
 ]
 
-_TICK_SECONDS = 5
+_TICK_SECONDS = float(os.environ.get('SKYTPU_SKYLET_TICK_SECONDS', '5'))
 
 
 def main() -> None:

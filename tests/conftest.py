@@ -33,6 +33,14 @@ def _isolate_state(tmp_path, monkeypatch):
     home.mkdir()
     monkeypatch.setenv('HOME', str(home))
     monkeypatch.setenv('SKYTPU_USER_HASH', 'abcd1234')
+    # Fast control-plane ticks: these env knobs are inherited by every
+    # spawned daemon (skylet, job/serve controllers, gang_run), keeping the
+    # e2e suites seconds- not minutes-long.
+    monkeypatch.setenv('SKYTPU_SKYLET_TICK_SECONDS', '0.3')
+    monkeypatch.setenv('SKYTPU_JOBS_POLL_SECONDS', '0.5')
+    monkeypatch.setenv('SKYTPU_SERVE_CONTROLLER_INTERVAL', '0.5')
+    monkeypatch.setenv('SKYTPU_GANG_GRACE_SECONDS', '0.4')
+    monkeypatch.setenv('SKYTPU_JOBS_RETRY_GAP_SECONDS', '0.5')
     # Reset cached module state that depends on HOME.
     import skypilot_tpu.skypilot_config as config
     config.reload_config()
@@ -41,6 +49,18 @@ def _isolate_state(tmp_path, monkeypatch):
     import skypilot_tpu.utils.locks as locks
     monkeypatch.setattr(locks, 'LOCK_DIR', str(home / '.skytpu' / 'locks'))
     yield
+    # Guaranteed reaping: even a FAILED test must not leak daemons
+    # (skylet/gang_run/controllers). Kill every process whose env points
+    # into this test's isolated home.
+    _kill_test_processes(str(home))
+
+
+def _kill_test_processes(home: str) -> None:
+    # Reuse the local provider's /proc-environ scan+sweep with the test
+    # home as the scan root (it matches HOME/SKYTPU_SKYLET_HOME/
+    # SKYTPU_NODE_DIR prefixes — exactly what per-test daemons carry).
+    from skypilot_tpu.provision.local import instance as local_instance
+    local_instance._kill_node_processes(home)  # pylint: disable=protected-access
 
 
 @pytest.fixture
