@@ -1,0 +1,97 @@
+"""ICI/DCN collective bandwidth benchmark — the nccl-tests rewrite.
+
+TPU-native counterpart of the reference's NCCL all_reduce_perf recipe
+(``examples/nccl_test.yaml:33-43``, whose example output is the
+2.05 GB/s algbw / 3.85 GB/s busbw row in BASELINE.md): a ``psum`` jitted
+over the full device mesh, timed at several payload sizes. XLA lowers the
+psum to ICI all-reduce within a slice (and DCN across slices when the mesh
+spans them) — no NCCL, no MPI; the collective IS the program.
+
+Reported like nccl-tests:
+  algbw = bytes / time
+  busbw = algbw * 2 * (n - 1) / n        (all-reduce wire traffic factor)
+
+Run on every host of a slice via the ``examples/ici_allreduce.yaml``
+recipe (``jax.distributed.initialize()`` picks up the coordinator env the
+gang runtime injects); single-process runs measure whatever devices are
+visible (1 real chip, or a CPU mesh under
+``--xla_force_host_platform_device_count``).
+"""
+import argparse
+import json
+import os
+import time
+from typing import List
+
+import numpy as np
+
+
+def run_allreduce_bench(sizes_mb: List[float], iters: int = 10,
+                        warmup: int = 3) -> List[dict]:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = np.asarray(jax.devices())
+    n = devices.size
+    mesh = Mesh(devices.reshape(n), ('x',))
+    rows = []
+    for size_mb in sizes_mb:
+        nelem = int(size_mb * 1e6 / 4)
+        # Payload sharded over the ring: each device contributes a shard,
+        # psum makes the full reduction visible everywhere (the all-reduce).
+        x = jnp.ones((max(n, 1), max(nelem // max(n, 1), 1)), jnp.float32)
+        x = jax.device_put(x, NamedSharding(mesh, P('x', None)))
+
+        @jax.jit
+        def allreduce(a):
+            return jax.shard_map(lambda s: jax.lax.psum(s, 'x'),
+                                 mesh=mesh,
+                                 in_specs=P('x', None),
+                                 out_specs=P(None, None))(a)
+
+        out = allreduce(x)
+        float(out[0, 0])  # host fetch = the only reliable sync barrier
+        for _ in range(warmup):
+            out = allreduce(x)
+        float(out[0, 0])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = allreduce(x)
+        float(out[0, 0])
+        dt = (time.perf_counter() - t0) / iters
+        nbytes = x.size * 4
+        algbw = nbytes / dt
+        busbw = algbw * (2 * (n - 1) / n if n > 1 else 1.0)
+        rows.append({
+            'size_mb': size_mb,
+            'n_devices': int(n),
+            'time_ms': round(dt * 1e3, 3),
+            'algbw_gbps': round(algbw / 1e9, 3),
+            'busbw_gbps': round(busbw / 1e9, 3),
+        })
+    return rows
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description='psum all-reduce bench')
+    parser.add_argument('--sizes-mb', default='1,16,64,256')
+    parser.add_argument('--iters', type=int, default=10)
+    parser.add_argument('--distributed', action='store_true',
+                        help='call jax.distributed.initialize() (multi-host '
+                        'slice; coordinator env injected by the gang '
+                        'runtime)')
+    args = parser.parse_args()
+    import jax
+    if os.environ.get('JAX_PLATFORMS'):
+        jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+    if args.distributed:
+        jax.distributed.initialize()
+    sizes = [float(s) for s in args.sizes_mb.split(',') if s]
+    rows = run_allreduce_bench(sizes, iters=args.iters)
+    for row in rows:
+        print(json.dumps({'metric': 'allreduce', **row}), flush=True)
+
+
+if __name__ == '__main__':
+    main()

@@ -96,10 +96,12 @@ class JobsController:
 
             status = strategy.job_status()
             if status == job_lib.JobStatus.SUCCEEDED:
-                # Teardown BEFORE the terminal status lands: clients that
-                # see SUCCEEDED must never find the task cluster still up.
-                strategy.cleanup_cluster()
+                # Status first, then teardown: if the controller dies
+                # mid-teardown the job must still read SUCCEEDED (a leaked
+                # cluster is recoverable; a misreported failure is not).
+                # Clients may briefly see the task cluster still up.
                 state.set_succeeded(job_id, task_id, time.time())
+                strategy.cleanup_cluster()
                 logger.info(f'Task {task_id}: SUCCEEDED.')
                 return True
             if status in (job_lib.JobStatus.FAILED,
@@ -122,16 +124,16 @@ class JobsController:
                 failure = (state.ManagedJobStatus.FAILED_SETUP
                            if status == job_lib.JobStatus.FAILED_SETUP else
                            state.ManagedJobStatus.FAILED)
-                strategy.cleanup_cluster()
                 state.set_failed(job_id, task_id, failure,
                                  'Task command exited non-zero.')
+                strategy.cleanup_cluster()
                 return False
             if status == job_lib.JobStatus.CANCELLED:
                 # Cancelled out-of-band on the cluster.
-                strategy.cleanup_cluster()
                 state.set_failed(job_id, task_id,
                                  state.ManagedJobStatus.FAILED,
                                  'Task job was cancelled on the cluster.')
+                strategy.cleanup_cluster()
                 return False
             if status is None:
                 # Cluster gone or unreachable ⇒ preemption.

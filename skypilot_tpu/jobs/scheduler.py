@@ -13,6 +13,7 @@ from typing import Optional
 from skypilot_tpu import sky_logging
 from skypilot_tpu.jobs import state
 from skypilot_tpu.utils import locks
+from skypilot_tpu.utils.subprocess_utils import pid_alive as _pid_alive
 
 logger = sky_logging.init_logger(__name__)
 
@@ -130,16 +131,6 @@ def _reconcile_dead_controllers() -> None:
                         'Controller process died unexpectedly.')
         state.set_schedule_state(job['job_id'],
                                  state.ManagedJobScheduleState.DONE)
-
-
-def _pid_alive(pid: int) -> bool:
-    try:
-        os.kill(pid, 0)
-        return True
-    except ProcessLookupError:
-        return False
-    except PermissionError:
-        return True
 
 
 def controller_pid(job_id: int) -> Optional[int]:

@@ -271,12 +271,16 @@ def forward_hidden(params: Params,
         return out, None
 
     if cfg.remat:
-        policy = None
-        if cfg.remat_policy == 'dots':
+        if cfg.remat_policy == 'full':
+            policy = None
+        elif cfg.remat_policy == 'dots':
             policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         elif cfg.remat_policy == 'ffn':
             policy = jax.checkpoint_policies.save_only_these_names(
                 'ffn_w1', 'ffn_w3')
+        else:
+            raise ValueError(f'unknown remat_policy: {cfg.remat_policy!r} '
+                             "(expected 'full', 'dots' or 'ffn')")
         body = jax.checkpoint(body, prevent_cse=False, policy=policy)
     x, _ = jax.lax.scan(body, x, params['layers'])
 

@@ -151,7 +151,8 @@ def train_loop(model_cfg: llama.LlamaConfig,
                save_every: int = 100,
                keep: int = 3,
                data_seed: int = 0,
-               log_every: int = 10) -> 'TrainState':
+               log_every: int = 10,
+               sleep_per_step: float = 0.0) -> 'TrainState':
     """Run (or RESUME) a training run with periodic checkpointing.
 
     The resume-from-step path the managed-jobs preemption story depends on
@@ -185,13 +186,18 @@ def train_loop(model_cfg: llama.LlamaConfig,
                                     model_cfg.vocab_size)
         targets = jnp.roll(tokens, -1, axis=1)
         state, metrics = step_fn(state, tokens, targets)
+        if sleep_per_step:
+            # Pacing knob for tests/demos (preemption windows).
+            import time
+            time.sleep(sleep_per_step)
         if log_every and (step + 1) % log_every == 0:
             print(f'[train] step {step + 1}/{num_steps} '
                   f'loss={float(metrics["loss"]):.4f}', flush=True)
         if checkpoint_dir and (step + 1) % save_every == 0:
             ckpt_lib.save(checkpoint_dir, state, step + 1, keep=keep)
             print(f'[train] checkpoint @ step {step + 1}', flush=True)
-    if checkpoint_dir and num_steps > start_step:
+    if (checkpoint_dir and num_steps > start_step and
+            num_steps % save_every != 0):  # loop already saved otherwise
         ckpt_lib.save(checkpoint_dir, state, num_steps, keep=keep)
         print(f'[train] final checkpoint @ step {num_steps}', flush=True)
     return state
@@ -200,6 +206,12 @@ def train_loop(model_cfg: llama.LlamaConfig,
 def main() -> None:
     """CLI for recipes: ``python -m skypilot_tpu.models.train ...``."""
     import argparse
+    import os
+    if os.environ.get('JAX_PLATFORMS'):
+        # Some accelerator plugins override platform selection at
+        # registration; restore the standard env semantics for recipes
+        # that pin a backend (e.g. CPU smoke runs).
+        jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
     parser = argparse.ArgumentParser(description='skypilot_tpu train loop')
     parser.add_argument('--model', default='debug',
                         choices=sorted(llama.CONFIGS))
@@ -209,13 +221,15 @@ def main() -> None:
     parser.add_argument('--checkpoint-dir', default=None)
     parser.add_argument('--save-every', type=int, default=10)
     parser.add_argument('--log-every', type=int, default=10)
+    parser.add_argument('--sleep-per-step', type=float, default=0.0)
     args = parser.parse_args()
     cfg = llama.CONFIGS[args.model]
     state = train_loop(cfg, TrainConfig(warmup_steps=5), args.steps,
                        args.batch_size, args.seq_len,
                        checkpoint_dir=args.checkpoint_dir,
                        save_every=args.save_every,
-                       log_every=args.log_every)
+                       log_every=args.log_every,
+                       sleep_per_step=args.sleep_per_step)
     print(f'[train] done at step {int(state.step)}', flush=True)
 
 

@@ -330,15 +330,9 @@ def flash_attention(q: jax.Array,
     the XLA path runs instead unless ``interpret=True`` forces the kernel
     through the Pallas interpreter (tests).
     """
-    interpret = _resolve_interpret(interpret)
-    s = q.shape[1]
-    bq, bk = min(block_q, s), min(block_k, s)
-    if interpret is None or s % bq or s % bk:
-        # Off-TPU, or S does not tile: the XLA path is exact and safe
-        # (an untiled grid would silently leave output rows unwritten).
-        return attention_ops.gqa_attention(q, k, v, causal=causal)
-    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
-    return out
+    # Single source of truth for the kernel-vs-XLA predicate: _fwd. The
+    # primal and the vjp pairing can then never disagree.
+    return _fwd(q, k, v, causal, block_q, block_k, interpret)[0]
 
 
 def _resolve_interpret(interpret: Optional[bool]) -> Optional[bool]:

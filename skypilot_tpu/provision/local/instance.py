@@ -20,6 +20,19 @@ from skypilot_tpu.provision import common
 CLUSTER_ROOT = '~/.skytpu/local_cluster'
 
 
+def _self_matches(cluster_dir: str) -> bool:
+    """Does the CURRENT process live on one of this cluster's nodes?
+
+    True when a node's own skylet drives the teardown (autostop down) —
+    it must self-exit after sweeping its peers, or it would survive its
+    "VM" being terminated.
+    """
+    cluster_dir = os.path.realpath(cluster_dir)
+    home = os.environ.get('SKYTPU_SKYLET_HOME') or os.environ.get('HOME', '')
+    home = os.path.realpath(home) if home else ''
+    return home == cluster_dir or home.startswith(cluster_dir + os.sep)
+
+
 def _find_node_pids(cluster_dir: str,
                     workers_only: bool = False) -> List[int]:
     """PIDs of processes whose home env points inside cluster_dir."""
@@ -171,8 +184,10 @@ def stop_instances(cluster_name_on_cloud: str,
         state[node_id] = 'stopped'
     _save_state(cluster_name_on_cloud, state)
     # A stopped node's processes die with the "VM".
-    _kill_node_processes(_cluster_dir(cluster_name_on_cloud),
-                         workers_only=worker_only)
+    cluster_dir = _cluster_dir(cluster_name_on_cloud)
+    _kill_node_processes(cluster_dir, workers_only=worker_only)
+    if not worker_only and _self_matches(cluster_dir):
+        os._exit(0)  # the calling skylet's own node just "stopped"
 
 
 def terminate_instances(cluster_name_on_cloud: str,
@@ -187,8 +202,11 @@ def terminate_instances(cluster_name_on_cloud: str,
         _kill_node_processes(_cluster_dir(cluster_name_on_cloud),
                              workers_only=True)
         return
-    _kill_node_processes(_cluster_dir(cluster_name_on_cloud))
-    shutil.rmtree(_cluster_dir(cluster_name_on_cloud), ignore_errors=True)
+    cluster_dir = _cluster_dir(cluster_name_on_cloud)
+    _kill_node_processes(cluster_dir)
+    shutil.rmtree(cluster_dir, ignore_errors=True)
+    if _self_matches(cluster_dir):
+        os._exit(0)  # autostop-down from this node's own skylet
 
 
 def open_ports(cluster_name_on_cloud: str,

@@ -17,6 +17,7 @@ from skypilot_tpu import task as task_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.usage import usage_lib
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils.subprocess_utils import pid_alive as _pid_alive
 
 logger = sky_logging.init_logger(__name__)
 
@@ -161,16 +162,6 @@ def _kill_process_tree(pid: int) -> None:
             os.kill(pid, 15)
         except (ProcessLookupError, PermissionError):
             pass
-
-
-def _pid_alive(pid: int) -> bool:
-    try:
-        os.kill(pid, 0)
-        return True
-    except ProcessLookupError:
-        return False
-    except PermissionError:
-        return True
 
 
 @usage_lib.entrypoint(name='serve.tail_logs')

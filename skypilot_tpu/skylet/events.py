@@ -100,34 +100,44 @@ class ServiceUpdateEvent(SkyletEvent):
     """Restart dead serve controllers (parity: events.py:82).
 
     A service whose controller process died (host reboot, OOM) is revived
-    so replicas keep being managed.
+    so replicas keep being managed. Guards:
+    * ``controller_pid is None`` means ``serve.up`` is mid-spawn — only a
+      STALE pidless row (older than one probe window) is considered dead,
+      so the tick never races a fresh ``up`` into duplicate controllers.
+    * A bounded respawn budget per service per skylet lifetime, so a
+      controller that crashes at startup doesn't loop forever.
     """
     EVENT_CHECKING_INTERVAL_SECONDS = 60
+    MAX_RESPAWNS = 3
+    PIDLESS_STALE_SECONDS = 600
+
+    def __init__(self):
+        super().__init__()
+        self._respawns: dict = {}
 
     def run(self) -> None:
         from skypilot_tpu.serve import serve_state
         if not os.path.exists(serve_state.db_path()):
             return  # not a serve controller host
         from skypilot_tpu.serve import core as serve_core
+        from skypilot_tpu.utils import subprocess_utils
         for svc in serve_state.get_services():
             if svc['status'].is_terminal():
                 continue  # SHUTDOWN/FAILED: never resurrect
             if svc.get('shutdown_requested'):
                 continue
             pid = svc['controller_pid']
-            if pid is not None and _pid_alive(pid):
+            if pid is None:
+                age = time.time() - (svc.get('submitted_at') or 0)
+                if age < self.PIDLESS_STALE_SECONDS:
+                    continue  # serve.up is (probably) mid-spawn
+            elif subprocess_utils.pid_alive(pid):
                 continue
-            serve_core._spawn_controller(svc['name'])  # pylint: disable=protected-access
-
-
-def _pid_alive(pid: int) -> bool:
-    try:
-        os.kill(pid, 0)
-        return True
-    except ProcessLookupError:
-        return False
-    except PermissionError:
-        return True
+            name = svc['name']
+            if self._respawns.get(name, 0) >= self.MAX_RESPAWNS:
+                continue
+            self._respawns[name] = self._respawns.get(name, 0) + 1
+            serve_core._spawn_controller(name)  # pylint: disable=protected-access
 
 
 class UsageHeartbeatReportEvent(SkyletEvent):

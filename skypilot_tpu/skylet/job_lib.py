@@ -17,6 +17,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.skylet import constants
+from skypilot_tpu.utils.subprocess_utils import pid_alive as _pid_alive
 
 _TABLE = """
     CREATE TABLE IF NOT EXISTS jobs (
@@ -242,16 +243,6 @@ def update_job_statuses() -> None:
             continue
         if not _pid_alive(pid):
             set_status(job['job_id'], JobStatus.FAILED)
-
-
-def _pid_alive(pid: int) -> bool:
-    try:
-        os.kill(pid, 0)
-        return True
-    except ProcessLookupError:
-        return False
-    except PermissionError:
-        return True
 
 
 def is_cluster_idle(idle_minutes: int) -> bool:

@@ -15,6 +15,17 @@ from skypilot_tpu import sky_logging
 logger = sky_logging.init_logger(__name__)
 
 
+def pid_alive(pid: int) -> bool:
+    """Liveness probe via kill(pid, 0); EPERM counts as alive."""
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
 def get_parallel_threads(n_items: int, max_workers: Optional[int] = None) -> int:
     cpus = os.cpu_count() or 4
     cap = max_workers if max_workers is not None else max(4, cpus * 2)
