@@ -116,10 +116,12 @@ def make_train_step(model_cfg: llama.LlamaConfig, train_cfg: TrainConfig,
     def step_fn(state: TrainState, tokens: jax.Array,
                 targets: jax.Array) -> Tuple[TrainState, Dict[str, Any]]:
         if mesh is not None:
+            spec = mesh_lib.batch_spec(
+                multislice=mesh_lib.DCN_AXIS in mesh.shape)
             tokens = jax.lax.with_sharding_constraint(
-                tokens, NamedSharding(mesh, mesh_lib.batch_spec()))
+                tokens, NamedSharding(mesh, spec))
             targets = jax.lax.with_sharding_constraint(
-                targets, NamedSharding(mesh, mesh_lib.batch_spec()))
+                targets, NamedSharding(mesh, spec))
         loss, grads = jax.value_and_grad(llama.loss_fn)(state.params,
                                                        tokens, targets,
                                                        model_cfg)

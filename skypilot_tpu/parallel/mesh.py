@@ -18,6 +18,11 @@ FSDP_AXIS = 'fsdp'
 EXPERT_AXIS = 'expert'
 MODEL_AXIS = 'model'
 SEQ_AXIS = 'seq'
+# Multislice: the slice axis. Collectives over it cross DCN (between pod
+# slices); everything inside a slice rides ICI. Only pure data
+# parallelism should span it (the scaling-book recipe: gradients
+# all-reduce over DCN once per step; params/activations never cross).
+DCN_AXIS = 'dcn'
 
 AXIS_ORDER = (DATA_AXIS, FSDP_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
 
@@ -82,8 +87,37 @@ def mesh_for_topology(topology, data_parallel: int = 1,
     return make_mesh(cfg, devices)
 
 
-def batch_spec() -> P:
-    """Activations: batch sharded over data+fsdp (the standard recipe)."""
+def make_multislice_mesh(num_slices: int,
+                         per_slice: Optional[MeshConfig] = None,
+                         devices: Optional[Sequence[jax.Device]] = None
+                         ) -> Mesh:
+    """('dcn', data, fsdp, expert, seq, model) mesh over N slices.
+
+    The leading 'dcn' axis is the slice index: on real multislice TPU
+    (MEGASCALE) jax orders devices slice-major, so reshaping
+    [num_slices, per_slice...] puts each slice's devices in its own 'dcn'
+    row and all intra-slice axes on ICI. Shard ONLY the batch over 'dcn'
+    (see ``batch_spec(multislice=True)``): XLA then emits exactly one
+    DCN all-reduce (gradients) per step.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) % num_slices:
+        raise ValueError(
+            f'{len(devices)} devices not divisible into {num_slices} '
+            'slices')
+    per = len(devices) // num_slices
+    per_slice = per_slice or MeshConfig()
+    sizes = per_slice.resolve(per)
+    shape = (num_slices,) + tuple(sizes[a] for a in AXIS_ORDER)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, (DCN_AXIS,) + AXIS_ORDER)
+
+
+def batch_spec(multislice: bool = False) -> P:
+    """Activations: batch sharded over data+fsdp (the standard recipe);
+    multislice meshes add the leading 'dcn' slice axis."""
+    if multislice:
+        return P((DCN_AXIS, DATA_AXIS, FSDP_AXIS))
     return P((DATA_AXIS, FSDP_AXIS))
 
 

@@ -1,0 +1,196 @@
+"""Pipeline parallelism: GPipe over a 'stage' mesh axis, TPU-native.
+
+The reference's pipeline story is Megatron/DeepSpeed PP launched as torch
+processes; here the pipeline IS a jitted program: decoder layers are
+stacked [L, ...] and sharded over the ``stage`` axis (L/S layers per
+stage), microbatches flow stage-to-stage with ``ppermute`` inside one
+``shard_map``, and autodiff derives the backward schedule (the transpose
+of a ppermute ring is the reverse ring — XLA sees one fused SPMD program,
+no per-stage processes, no send/recv glue).
+
+Composes with data parallelism: the mesh is ('data', 'stage'); the
+microbatch batch dim shards over 'data' while params shard over 'stage'.
+Schedule: classic GPipe fill-drain — T = M + S - 1 ticks for M
+microbatches over S stages (bubble fraction (S-1)/T; raise M to amortize).
+"""
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.parallel.mesh import DATA_AXIS
+
+STAGE_AXIS = 'stage'
+
+
+def make_pp_mesh(stage: int, data: int = 1,
+                 devices=None) -> Mesh:
+    """('data', 'stage') mesh: stage innermost so activation hops between
+    consecutive stages ride neighboring ICI links."""
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) != stage * data:
+        raise ValueError(f'{len(devices)} devices != data {data} × '
+                         f'stage {stage}')
+    dev_array = np.asarray(devices).reshape(data, stage)
+    return Mesh(dev_array, (DATA_AXIS, STAGE_AXIS))
+
+
+def _gpipe_shard(stage_fn: Callable, layers, xs: jax.Array,
+                 num_stages: int) -> jax.Array:
+    """Per-device pipeline body (runs inside shard_map).
+
+    layers: this stage's [L/S, ...] slice of the stacked layer params.
+    xs: [M, mb, ...] microbatches (stage 0 consumes them; other stages
+    receive activations from their predecessor).
+    Returns [M, mb, ...] final-stage outputs, replicated over stages.
+    """
+    s_count = num_stages
+    idx = jax.lax.axis_index(STAGE_AXIS)
+    num_mb = xs.shape[0]
+    ticks = num_mb + s_count - 1
+    perm = [(i, (i + 1) % s_count) for i in range(s_count)]
+
+    def tick(carry, t):
+        buf, ys = carry
+        x_t = jax.lax.dynamic_index_in_dim(
+            xs, jnp.clip(t, 0, num_mb - 1), 0, keepdims=False)
+        inp = jnp.where(idx == 0, x_t, buf)
+        out = stage_fn(layers, inp)
+        buf_next = jax.lax.ppermute(out, STAGE_AXIS, perm)
+        # The last stage owns the pipeline's outputs: at tick t it has
+        # finished microbatch t-(S-1).
+        out_idx = jnp.clip(t - (s_count - 1), 0, num_mb - 1)
+        write = jnp.logical_and(idx == s_count - 1, t >= s_count - 1)
+        cur = jax.lax.dynamic_index_in_dim(ys, out_idx, 0, keepdims=False)
+        ys = jax.lax.dynamic_update_index_in_dim(
+            ys, jnp.where(write, out, cur), out_idx, 0)
+        return (buf_next, ys), None
+
+    buf0 = jnp.zeros(xs.shape[1:], xs.dtype)
+    ys0 = jnp.zeros_like(xs)
+    # The carries become device-varying after the first ppermute/write;
+    # mark the (replicated-zero) initial values as varying so the scan's
+    # carry type is stable (shard_map vma check).
+    buf0 = jax.lax.pvary(buf0, (STAGE_AXIS, DATA_AXIS))
+    ys0 = jax.lax.pvary(ys0, (STAGE_AXIS,))
+    (_, ys), _ = jax.lax.scan(tick, (buf0, ys0), jnp.arange(ticks))
+    # Replicate the final-stage outputs across the stage axis (masked
+    # psum; its transpose under AD routes cotangents back to the last
+    # stage, which is exactly the backward pipeline's entry point).
+    ys = jnp.where(idx == s_count - 1, ys, jnp.zeros_like(ys))
+    return jax.lax.psum(ys, STAGE_AXIS)
+
+
+# --------------------------------------------------------- llama + GPipe
+
+
+def pp_param_partition_specs(cfg: llama.LlamaConfig) -> Dict[str, Any]:
+    """Layer-stacked tensors shard their leading (layer) dim over 'stage';
+    embedding/head/norms replicate (they run outside the pipeline body)."""
+    specs = llama.param_partition_specs(cfg)
+    # Layer-stacked leaves: leading (layer) dim over 'stage'; the inner
+    # fsdp/model axes of the base specs don't exist in the
+    # ('data','stage') mesh, so inner dims replicate.
+    specs['layers'] = {
+        k: P(STAGE_AXIS, *([None] * (len(v) - 1)))
+        for k, v in specs['layers'].items()
+    }
+    # Non-layer params run outside the pipeline body: replicated.
+    specs['tok_embedding'] = P()
+    specs['lm_head'] = P()
+    specs['out_norm'] = P()
+    return specs
+
+
+def pipeline_loss_fn(params, tokens: jax.Array, targets: jax.Array,
+                     cfg: llama.LlamaConfig, mesh: Mesh,
+                     num_microbatches: int) -> jax.Array:
+    """Pipelined next-token CE: embed → GPipe decoder stages → head.
+
+    tokens/targets: [B, S] with B divisible by num_microbatches × data.
+    """
+    num_stages = mesh.shape[STAGE_AXIS]
+    assert cfg.n_layers % num_stages == 0, (cfg.n_layers, num_stages)
+    b, s = tokens.shape
+    assert b % num_microbatches == 0, (b, num_microbatches)
+    mb = b // num_microbatches
+
+    positions = jnp.arange(s, dtype=jnp.int32)
+    cos, sin = llama._rope_freqs(cfg, positions)  # pylint: disable=protected-access
+    x = params['tok_embedding'][tokens].astype(cfg.dtype)
+    xs = x.reshape(num_microbatches, mb, s, cfg.dim)
+
+    def stage_fn(layers_local, h):
+        def body(carry, layer):
+            return llama._block(cfg, carry, layer, cos, sin, False), None  # pylint: disable=protected-access
+
+        if cfg.remat:
+            body = jax.checkpoint(body, prevent_cse=False)
+        h, _ = jax.lax.scan(body, h, layers_local)
+        return h
+
+    layer_specs = jax.tree.map(lambda _: P(STAGE_AXIS),
+                               params['layers'])
+    pipelined = jax.shard_map(
+        functools.partial(_gpipe_shard, stage_fn,
+                          num_stages=num_stages),
+        mesh=mesh,
+        in_specs=(layer_specs, P(None, DATA_AXIS)),
+        out_specs=P(None, DATA_AXIS),
+    )
+    ys = pipelined(params['layers'], xs)          # [M, mb, S, D]
+    y = ys.reshape(b, s, cfg.dim)
+    y = llama.rms_norm(y, params['out_norm'], cfg.norm_eps)
+    if cfg.ce_chunks > 1:
+        return llama.chunked_cross_entropy(y, params['lm_head'], targets,
+                                           cfg.ce_chunks)
+    logits = (y @ params['lm_head']).astype(jnp.float32)
+    return llama._xent_from_logits(logits, targets) / targets.size  # pylint: disable=protected-access
+
+
+def make_pp_train_step(cfg: llama.LlamaConfig, train_cfg,
+                       mesh: Mesh, num_microbatches: int):
+    """Jitted GPipe train step (Adam, donated state) over ('data','stage')."""
+    import optax
+    from skypilot_tpu.models import train as train_lib
+
+    tx = train_lib.make_optimizer(train_cfg)
+
+    def step_fn(state, tokens, targets):
+        loss, grads = jax.value_and_grad(pipeline_loss_fn)(
+            state.params, tokens, targets, cfg, mesh, num_microbatches)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return train_lib.TrainState(params=new_params,
+                                    opt_state=new_opt,
+                                    step=state.step + 1), {'loss': loss}
+
+    return jax.jit(step_fn, donate_argnums=(0,))
+
+
+def init_pp_train_state(key: jax.Array, cfg: llama.LlamaConfig, train_cfg,
+                        mesh: Mesh):
+    """Params + Adam state sharded stage-wise from birth."""
+    from skypilot_tpu.models import train as train_lib
+    from skypilot_tpu.parallel import mesh as mesh_lib
+
+    tx = train_lib.make_optimizer(train_cfg)
+    specs = pp_param_partition_specs(cfg)
+
+    def _init(k):
+        params = llama.init_params(k, cfg)
+        return params, tx.init(params)
+
+    param_shardings = mesh_lib.spec_to_sharding(mesh, specs)
+    abstract = jax.eval_shape(_init, key)
+    opt_shardings = train_lib._opt_state_shardings(  # pylint: disable=protected-access
+        abstract[1], param_shardings, mesh)
+    params, opt_state = jax.jit(
+        _init, out_shardings=(param_shardings, opt_shardings))(key)
+    return train_lib.TrainState(params=params, opt_state=opt_state,
+                                step=jnp.zeros((), jnp.int32))

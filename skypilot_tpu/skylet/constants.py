@@ -61,6 +61,15 @@ JAX_COORDINATOR_PORT = 8476
 TPU_WORKER_ID_ENV = 'TPU_WORKER_ID'
 TPU_WORKER_HOSTNAMES_ENV = 'TPU_WORKER_HOSTNAMES'
 
+# Multislice (DCN): libtpu's MEGASCALE transport reads these; injected by
+# gang_run when the cluster spans >1 slice (hosts carry a 'slice_id').
+# SURVEY §2.11 — the reference has no TPU multislice story at all; this is
+# the DCN data plane the 'dcn' mesh axis (parallel/mesh.py) rides on.
+MEGASCALE_COORDINATOR_ENV = 'MEGASCALE_COORDINATOR_ADDRESS'
+MEGASCALE_NUM_SLICES_ENV = 'MEGASCALE_NUM_SLICES'
+MEGASCALE_SLICE_ID_ENV = 'MEGASCALE_SLICE_ID'
+MEGASCALE_PORT = 8080
+
 SKYLET_VERSION = '1'
 
 # ------------------------------------------------- control-plane interpreters

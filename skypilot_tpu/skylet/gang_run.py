@@ -80,29 +80,32 @@ def _push_script(host: dict, script_path: str, remote_path: str) -> None:
     host['_resolved_script'] = remote_path
 
 
-def run_gang(script_path: str,
-             job_id: Optional[int] = None,
-             log_dir: Optional[str] = None,
-             cluster_info: Optional[dict] = None,
-             extra_env: Optional[Dict[str, str]] = None) -> int:
-    """Run the script on all hosts; returns 0 iff every rank returned 0."""
-    info = cluster_info or load_cluster_info()
+def build_rank_envs(info: dict,
+                    extra_env: Optional[Dict[str, str]] = None
+                    ) -> List[Dict[str, str]]:
+    """Per-rank task env: rank identity, jax.distributed rendezvous, and —
+    when hosts carry a 'slice_id' (multislice clusters) — per-slice TPU
+    worker ids plus the MEGASCALE DCN transport envs."""
     hosts: List[dict] = info['hosts']
     num_hosts = len(hosts)
     internal_ips = [h['internal_ip'] for h in hosts]
     coordinator = f'{internal_ips[0]}:{constants.JAX_COORDINATOR_PORT}'
-    log_dir = log_dir or os.path.join(constants.log_dir(),
-                                      f'job-{job_id or "adhoc"}')
-    os.makedirs(log_dir, exist_ok=True)
+    # Normalize arbitrary slice ids to 0..N-1 (libtpu requires contiguous
+    # zero-based ids; provisioners may hand us e.g. queued-resource
+    # node indices {1, 2}).
+    raw_ids = [h.get('slice_id', 0) for h in hosts]
+    id_order = sorted(set(raw_ids))
+    slice_ids = [id_order.index(r) for r in raw_ids]
+    num_slices = len(id_order)
+    slice_hosts: Dict[int, List[str]] = {}
+    for h, sid in zip(hosts, slice_ids):
+        slice_hosts.setdefault(sid, []).append(h['internal_ip'])
 
-    marker = f'skytpu_task_{job_id or int(time.time())}'
-    remote_script = f'/tmp/{marker}.sh'
-
-    procs: List[subprocess.Popen] = [None] * num_hosts  # type: ignore
-    rcs: List[Optional[int]] = [None] * num_hosts
-    failed = threading.Event()
-
-    def _env_for(rank: int) -> Dict[str, str]:
+    envs = []
+    for rank in range(num_hosts):
+        sid = slice_ids[rank]
+        in_slice_ips = slice_hosts[sid]
+        worker_id = in_slice_ips.index(hosts[rank]['internal_ip'])
         env = {
             constants.NODE_RANK_ENV: str(rank),
             constants.NODE_IPS_ENV: '\n'.join(internal_ips),
@@ -114,14 +117,49 @@ def run_gang(script_path: str,
             constants.JAX_COORDINATOR_ENV: coordinator,
             constants.JAX_NUM_PROCESSES_ENV: str(num_hosts),
             constants.JAX_PROCESS_ID_ENV: str(rank),
-            constants.TPU_WORKER_ID_ENV: str(rank),
-            constants.TPU_WORKER_HOSTNAMES_ENV: ','.join(internal_ips),
+            # TPU worker identity is PER SLICE.
+            constants.TPU_WORKER_ID_ENV: str(worker_id),
+            constants.TPU_WORKER_HOSTNAMES_ENV: ','.join(in_slice_ips),
         }
+        if num_slices > 1:
+            env.update({
+                constants.MEGASCALE_COORDINATOR_ENV:
+                    f'{slice_hosts[0][0]}:{constants.MEGASCALE_PORT}',
+                constants.MEGASCALE_NUM_SLICES_ENV: str(num_slices),
+                constants.MEGASCALE_SLICE_ID_ENV: str(sid),
+            })
         # User code needs the accelerator: undo the control-plane
         # plugin-boot suppression for the task env.
         constants.restore_accel_boot_env(env)
         env.update(extra_env or {})
-        return env
+        envs.append(env)
+    return envs
+
+
+def run_gang(script_path: str,
+             job_id: Optional[int] = None,
+             log_dir: Optional[str] = None,
+             cluster_info: Optional[dict] = None,
+             extra_env: Optional[Dict[str, str]] = None) -> int:
+    """Run the script on all hosts; returns 0 iff every rank returned 0."""
+    info = cluster_info or load_cluster_info()
+    hosts: List[dict] = info['hosts']
+    num_hosts = len(hosts)
+    log_dir = log_dir or os.path.join(constants.log_dir(),
+                                      f'job-{job_id or "adhoc"}')
+    os.makedirs(log_dir, exist_ok=True)
+
+    marker = f'skytpu_task_{job_id or int(time.time())}'
+    remote_script = f'/tmp/{marker}.sh'
+
+    procs: List[subprocess.Popen] = [None] * num_hosts  # type: ignore
+    rcs: List[Optional[int]] = [None] * num_hosts
+    failed = threading.Event()
+
+    rank_envs = build_rank_envs(info, extra_env)
+
+    def _env_for(rank: int) -> Dict[str, str]:
+        return rank_envs[rank]
 
     def _run_rank(rank: int) -> None:
         host = hosts[rank]
