@@ -133,8 +133,18 @@ def _update_cluster_status(cluster_name: str) -> Optional[Dict[str, Any]]:
         global_state.remove_cluster(cluster_name, terminate=True)
         return None
     if n_running == expected == len(values):
-        global_state.update_cluster_status(cluster_name,
-                                           global_state.ClusterStatus.UP)
+        # Cloud says READY — but a slice whose host crashed still reads
+        # READY at the instance level. Probe the runtime (skylet alive on
+        # every host; parity: sky/backends/backend_utils.py:1766 probes
+        # the ray cluster) and degrade to INIT on any dead host.
+        if _runtime_healthy(handle):
+            global_state.update_cluster_status(
+                cluster_name, global_state.ClusterStatus.UP)
+        else:
+            logger.debug(f'{cluster_name}: instances READY but runtime '
+                         'probe failed on ≥1 host; marking INIT.')
+            global_state.update_cluster_status(
+                cluster_name, global_state.ClusterStatus.INIT)
     elif n_running == 0 and all(v == 'stopped' for v in values):
         global_state.update_cluster_status(
             cluster_name, global_state.ClusterStatus.STOPPED)
@@ -143,6 +153,40 @@ def _update_cluster_status(cluster_name: str) -> Optional[Dict[str, Any]]:
         global_state.update_cluster_status(cluster_name,
                                            global_state.ClusterStatus.INIT)
     return global_state.get_cluster_from_name(cluster_name)
+
+
+# Liveness = pid exists AND is not a zombie (a crashed skylet whose
+# parent never reaped it still answers kill -0).
+_HEALTH_PROBE_CMD = (
+    'pid="$(cat ~/.skytpu/skylet.pid 2>/dev/null)" && '
+    'kill -0 "$pid" 2>/dev/null && '
+    '[ "$(awk \'{print $3}\' "/proc/$pid/stat" 2>/dev/null)" != "Z" ]')
+
+
+def _runtime_healthy(handle) -> bool:
+    """Every host answers the skylet-liveness probe.
+
+    Disabled via SKYTPU_SKIP_HEALTH_PROBE=1 (bench/unit contexts). A probe
+    error (SSH down) counts as unhealthy — that is the signal.
+    """
+    if os.environ.get('SKYTPU_SKIP_HEALTH_PROBE') == '1':
+        return True
+    try:
+        runners = handle.get_command_runners()
+    except Exception as e:  # pylint: disable=broad-except
+        logger.debug(f'health probe: no runners ({e})')
+        return False
+
+    from skypilot_tpu.utils import subprocess_utils
+
+    def _probe(runner) -> bool:
+        try:
+            return runner.run(_HEALTH_PROBE_CMD, timeout=15) == 0
+        except Exception:  # pylint: disable=broad-except
+            return False
+
+    results = subprocess_utils.run_in_parallel(_probe, runners)
+    return all(results)
 
 
 def check_cluster_available(

@@ -6,6 +6,7 @@ the dag and hands it to the scheduler, which spawns a controller process;
 cancel flag the controller polls; ``tail_logs`` follows either the
 controller log or the task cluster's run log.
 """
+import json
 import os
 import subprocess
 import time
@@ -30,7 +31,10 @@ def launch(entrypoint: Union[task_lib.Task, dag_lib.Dag],
            name: Optional[str] = None) -> int:
     """Submit a managed job (single task or sequential pipeline).
 
-    Returns the managed job id. Parity: jobs client sdk launch.
+    Returns the managed job id. Parity: jobs client sdk launch. In
+    ``cluster`` controller mode (default; see utils/controller_utils) the
+    job is handed to a controller CLUSTER and survives this client; in
+    ``local`` mode the controller is a process on this host.
     """
     if isinstance(entrypoint, task_lib.Task):
         tasks = [entrypoint]
@@ -46,6 +50,10 @@ def launch(entrypoint: Union[task_lib.Task, dag_lib.Dag],
         if t.run is None:
             raise exceptions.InvalidSkyError(
                 f'Managed job task {t.name!r} has no run command.')
+
+    from skypilot_tpu.utils import controller_utils
+    if controller_utils.controller_mode() == 'cluster':
+        return _launch_on_controller_cluster(tasks, name)
 
     os.makedirs(state.dag_dir(), exist_ok=True)
     task_configs = [t.to_yaml_config() for t in tasks]
@@ -65,9 +73,63 @@ def launch(entrypoint: Union[task_lib.Task, dag_lib.Dag],
     return job_id
 
 
+def _launch_on_controller_cluster(tasks: List[task_lib.Task],
+                                  name: Optional[str]) -> int:
+    """Cluster controller mode: translate mounts, ship the dag, RPC submit.
+
+    Parity: the reference's jobs launch path through
+    ``controller_utils.py:688`` (mount translation) + the controller
+    task; here the dag lands on the controller cluster and the job is
+    created + scheduled THERE, so it survives this client process.
+    """
+    import tempfile
+
+    from skypilot_tpu.utils import controller_utils
+
+    for t in tasks:
+        controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+            t, controller_utils.JOBS)
+    controller_utils.ensure_controller_cluster(controller_utils.JOBS)
+
+    task_configs = [t.to_yaml_config() for t in tasks]
+    dag_id = uuid.uuid4().hex
+    runner = controller_utils.head_runner(controller_utils.JOBS)
+    with tempfile.NamedTemporaryFile('w', suffix='.yaml') as f:
+        yaml.safe_dump({'name': name, 'tasks': task_configs}, f)
+        f.flush()
+        runner.run('mkdir -p ~/.skytpu/managed_jobs/dags', timeout=60)
+        runner.rsync(f.name, f'.skytpu/managed_jobs/dags/{dag_id}.yaml',
+                     up=True)
+    task_specs = [{
+        'name': t.name,
+        'resources': ', '.join(str(r) for r in t.resources),
+    } for t in tasks]
+    payload = json.dumps({'name': name, 'dag': dag_id,
+                          'specs': task_specs})
+    job_id = controller_utils.controller_rpc(
+        controller_utils.JOBS,
+        f'import os; p = json.loads({payload!r}); '
+        'from skypilot_tpu.jobs import state, scheduler; '
+        'dag_path = os.path.expanduser('
+        '"~/.skytpu/managed_jobs/dags/" + p["dag"] + ".yaml"); '
+        'jid = state.create_job(p["name"], dag_yaml_path=dag_path, '
+        'task_specs=p["specs"]); '
+        'scheduler.submit_job(jid); emit(jid)')
+    logger.info(f'Managed job {job_id} ({name!r}) submitted to controller '
+                f'cluster {controller_utils.controller_cluster_name("jobs")!r}.')
+    return int(job_id)
+
+
 @usage_lib.entrypoint(name='jobs.queue')
 def queue() -> List[Dict[str, Any]]:
     """All managed jobs with aggregate + per-task status."""
+    from skypilot_tpu.utils import controller_utils
+    if controller_utils.controller_mode() == 'cluster':
+        return controller_utils.controller_rpc(
+            controller_utils.JOBS,
+            'import os; '
+            "os.environ['SKYTPU_CONTROLLER_MODE'] = 'local'; "
+            'from skypilot_tpu.jobs import core; emit(core.queue())')
     scheduler.maybe_schedule_next_jobs()
     out = []
     for job in state.get_jobs():
@@ -97,6 +159,15 @@ def queue() -> List[Dict[str, Any]]:
 def cancel(job_ids: Optional[List[int]] = None,
            all_jobs: bool = False) -> List[int]:
     """Request cancellation; the controller tears the task cluster down."""
+    from skypilot_tpu.utils import controller_utils
+    if controller_utils.controller_mode() == 'cluster':
+        payload = json.dumps({'ids': job_ids, 'all': all_jobs})
+        return controller_utils.controller_rpc(
+            controller_utils.JOBS,
+            f'import os; p = json.loads({payload!r}); '
+            "os.environ['SKYTPU_CONTROLLER_MODE'] = 'local'; "
+            'from skypilot_tpu.jobs import core; '
+            'emit(core.cancel(p["ids"], p["all"]))')
     if all_jobs:
         job_ids = [
             j['job_id'] for j in state.get_jobs()
@@ -118,6 +189,21 @@ def tail_logs(job_id: Optional[int] = None,
               follow: bool = True,
               controller: bool = False) -> int:
     """Follow the controller log (controller=True) or the task run log."""
+    from skypilot_tpu.utils import controller_utils
+    if controller_utils.controller_mode() == 'cluster':
+        # Cluster mode is non-interactive: dump the requested log once
+        # (``follow`` needs a client↔controller stream; dump-now keeps
+        # the verb useful from any client).
+        payload = json.dumps({'job_id': job_id, 'controller': controller})
+        out = controller_utils.controller_rpc(
+            controller_utils.JOBS,
+            f'import os; p = json.loads({payload!r}); '
+            "os.environ['SKYTPU_CONTROLLER_MODE'] = 'local'; "
+            'from skypilot_tpu.jobs import core; '
+            'emit(core.dump_logs(p["job_id"], p["controller"]))',
+            timeout=120)
+        print(out or '')
+        return 0 if out is not None else 1
     if job_id is None:
         jobs = state.get_jobs()
         if not jobs:
@@ -141,6 +227,38 @@ def tail_logs(job_id: Optional[int] = None,
                                  follow=follow)
     # Fall back to the controller log (job finished or not yet launched).
     return _tail_file(state.controller_log_path(job_id), follow)
+
+
+def dump_logs(job_id: Optional[int] = None,
+              controller: bool = False) -> Optional[str]:
+    """Return (not stream) a managed job's log text — the RPC body behind
+    cluster-mode ``tail_logs``. controller=True → controller log; else the
+    task cluster's latest run log (or the controller log as fallback)."""
+    if job_id is None:
+        jobs = state.get_jobs()
+        if not jobs:
+            return None
+        job_id = jobs[0]['job_id']
+    if not controller:
+        from skypilot_tpu import global_state
+        for t in state.get_tasks(job_id):
+            if t['cluster_name'] is None:
+                continue
+            record = global_state.get_cluster_from_name(t['cluster_name'])
+            if record is None:
+                continue
+            runner = record['handle'].head_runner()
+            rc, out, _ = runner.run(
+                'cat "$(ls -t ~/sky_logs/*/run.log 2>/dev/null '
+                '| head -1)" 2>/dev/null',
+                require_outputs=True, timeout=60)
+            if rc == 0 and out:
+                return out
+    path = state.controller_log_path(job_id)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding='utf-8') as f:
+        return f.read()
 
 
 def _tail_file(path: str, follow: bool) -> int:

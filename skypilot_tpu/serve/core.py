@@ -41,6 +41,10 @@ def up(task: task_lib.Task,
             'Provide a service name (task.name or service_name=).')
     common_utils.check_cluster_name_is_valid(service_name)
 
+    from skypilot_tpu.utils import controller_utils
+    if controller_utils.controller_mode() == 'cluster':
+        return _up_on_controller_cluster(task, service_name)
+
     yaml_path = os.path.join(serve_state.task_yaml_dir(),
                              f'{service_name}.yaml')
     lb_port = _free_port()
@@ -58,6 +62,56 @@ def up(task: task_lib.Task,
     endpoint = f'http://127.0.0.1:{lb_port}'
     logger.info(f'Service {service_name!r} starting; endpoint {endpoint}')
     return {'name': service_name, 'endpoint': endpoint}
+
+
+def _up_on_controller_cluster(task: task_lib.Task,
+                              service_name: str) -> Dict[str, Any]:
+    """Cluster controller mode: the serve controller + LB live on the
+    controller cluster, surviving this client (parity:
+    controller_utils.py:88 Controllers.SKY_SERVE_CONTROLLER)."""
+    import json
+    import tempfile
+    import uuid
+
+    from skypilot_tpu.utils import controller_utils
+
+    controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+        task, controller_utils.SERVE)
+    controller_utils.ensure_controller_cluster(controller_utils.SERVE)
+    runner = controller_utils.head_runner(controller_utils.SERVE)
+    yaml_id = uuid.uuid4().hex
+    with tempfile.NamedTemporaryFile('w', suffix='.yaml') as f:
+        common_utils.dump_yaml(f.name, task.to_yaml_config())
+        runner.run('mkdir -p ~/.skytpu/serve/uploads', timeout=60)
+        runner.rsync(f.name, f'.skytpu/serve/uploads/{yaml_id}.yaml',
+                     up=True)
+    payload = json.dumps({'yaml': yaml_id, 'name': service_name})
+    info = controller_utils.controller_rpc(
+        controller_utils.SERVE,
+        f'import os; p = json.loads({payload!r}); '
+        "os.environ['SKYTPU_CONTROLLER_MODE'] = 'local'; "
+        'from skypilot_tpu import task as task_lib; '
+        'from skypilot_tpu.serve import core; '
+        't = task_lib.Task.from_yaml(os.path.expanduser('
+        '"~/.skytpu/serve/uploads/" + p["yaml"] + ".yaml")); '
+        'emit(core.up(t, p["name"]))', timeout=300)
+    host = getattr(runner, 'ip', None) or '127.0.0.1'
+    info['endpoint'] = info['endpoint'].replace('127.0.0.1', host)
+    return info
+
+
+def _controller_rpc_delegate(verb: str, payload: dict,
+                             timeout: float = 300.0):
+    import json as json_lib
+
+    from skypilot_tpu.utils import controller_utils
+    body = json_lib.dumps(payload)
+    return controller_utils.controller_rpc(
+        controller_utils.SERVE,
+        f'import os; p = json.loads({body!r}); '
+        "os.environ['SKYTPU_CONTROLLER_MODE'] = 'local'; "
+        'from skypilot_tpu.serve import core; '
+        f'emit(core.{verb}(**p))', timeout=timeout)
 
 
 def _spawn_controller(service_name: str) -> None:
@@ -82,6 +136,17 @@ def _spawn_controller(service_name: str) -> None:
 
 @usage_lib.entrypoint(name='serve.status')
 def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
+    from skypilot_tpu.utils import controller_utils
+    if controller_utils.controller_mode() == 'cluster':
+        rows = _controller_rpc_delegate('status',
+                                        {'service_name': service_name},
+                                        timeout=120)
+        # LB endpoints bind on the controller host, not this client.
+        runner = controller_utils.head_runner(controller_utils.SERVE)
+        host = getattr(runner, 'ip', None) or '127.0.0.1'
+        for row in rows:
+            row['endpoint'] = row['endpoint'].replace('127.0.0.1', host)
+        return rows
     services = ([serve_state.get_service(service_name)]
                 if service_name else serve_state.get_services())
     out = []
@@ -105,6 +170,11 @@ def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
 
 @usage_lib.entrypoint(name='serve.down')
 def down(service_name: str, purge: bool = False) -> None:
+    from skypilot_tpu.utils import controller_utils
+    if controller_utils.controller_mode() == 'cluster':
+        _controller_rpc_delegate(
+            'down', {'service_name': service_name, 'purge': purge})
+        return
     svc = serve_state.get_service(service_name)
     if svc is None:
         raise exceptions.InvalidSkyError(

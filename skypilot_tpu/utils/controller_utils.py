@@ -1,0 +1,169 @@
+"""Controller-as-cluster: place jobs/serve controllers on a cluster.
+
+Parity: ``sky/utils/controller_utils.py`` (:88 Controllers registry, :688
+maybe_translate_local_file_mounts_and_sync_up, :743 controller task
+download) — redesigned without the reference's Ray/codegen templating:
+
+* The controller is an ordinary cluster (``sky-jobs-controller-<user>``,
+  one per kind per user) provisioned through the normal launch path —
+  which also installs the runtime + skylet, whose ``ManagedJobEvent`` /
+  ``ServiceUpdateEvent`` ticks make the controller host self-healing.
+* Client → controller RPC is the codegen-over-SSH idiom the rest of the
+  control plane already uses (``job_lib.JobLibCodeGen``): short python
+  snippets importing the synced runtime.
+* Local file mounts / workdir are translated to bucket-backed storage
+  before submission, so the controller (and every task cluster it
+  launches) can fetch them without the client machine existing.
+
+Mode: ``SKYTPU_CONTROLLER_MODE`` env or config ``jobs.controller.mode`` —
+``cluster`` (default; parity with the reference) or ``local`` (controller
+processes on the client host; fast unit-test path).
+"""
+import json
+import os
+import shlex
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.skylet import constants
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+JOBS = 'jobs'
+SERVE = 'serve'
+
+
+def controller_mode() -> str:
+    env = os.environ.get('SKYTPU_CONTROLLER_MODE')
+    if env:
+        return env
+    from skypilot_tpu import skypilot_config
+    return skypilot_config.get_nested(('jobs', 'controller', 'mode'),
+                                      'cluster')
+
+
+def controller_cluster_name(kind: str) -> str:
+    return f'sky-{kind}-controller-{common_utils.get_user_hash()[:8]}'
+
+
+def _controller_resources(kind: str):
+    """Resources for the controller cluster (config-overridable)."""
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import skypilot_config
+    cfg = skypilot_config.get_nested((kind, 'controller', 'resources'),
+                                     None)
+    if cfg:
+        return resources_lib.Resources.from_yaml_config(cfg)
+    # Default: cheapest feasible instance (the optimizer picks); on a
+    # local-only setup that is the Local cloud.
+    return resources_lib.Resources()
+
+
+def ensure_controller_cluster(kind: str):
+    """Provision (or reuse) the controller cluster; returns its handle."""
+    from skypilot_tpu import execution
+    from skypilot_tpu import global_state
+    from skypilot_tpu import task as task_lib
+
+    name = controller_cluster_name(kind)
+    record = global_state.get_cluster_from_name(name)
+    if record is not None and \
+            record['status'] == global_state.ClusterStatus.UP:
+        return record['handle']
+    task = task_lib.Task(
+        name=f'{kind}-controller',
+        run='true')  # provisioning installs runtime + skylet; that's all
+    task.set_resources(_controller_resources(kind))
+    _, handle = execution.launch(task,
+                                 cluster_name=name,
+                                 detach_run=True,
+                                 stream_logs=False)
+    return handle
+
+
+def head_runner(kind: str):
+    from skypilot_tpu import global_state
+    record = global_state.get_cluster_from_name(
+        controller_cluster_name(kind))
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'No {kind} controller cluster; submit a job/service first.')
+    return record['handle'].head_runner()
+
+
+_RPC_PRELUDE = (
+    'import sys, json; '
+    'sys.path.insert(0, __import__("os").path.expanduser('
+    '"~/.skytpu/runtime")); ')
+_RPC_MARKER = '__SKYTPU_RPC__'
+
+
+def controller_rpc(kind: str, body: str, timeout: float = 120.0) -> Any:
+    """Run a python snippet on the controller head; returns its
+    ``emit(obj)`` payload (JSON over the RPC marker line)."""
+    prelude = (_RPC_PRELUDE +
+               f'emit = lambda o: print({_RPC_MARKER!r} + json.dumps(o), '
+               'flush=True); ')
+    cmd = (f'{constants.accel_strip_shell_prefix()}'
+           f'python3 -u -c {shlex.quote(prelude + body)}')
+    runner = head_runner(kind)
+    rc, out, err = runner.run(cmd, require_outputs=True, timeout=timeout)
+    if rc != 0:
+        raise exceptions.JobError(
+            f'{kind} controller RPC failed (rc {rc}): {err[-2000:]}')
+    for line in out.splitlines():
+        if line.startswith(_RPC_MARKER):
+            return json.loads(line[len(_RPC_MARKER):])
+    return None
+
+
+# ------------------------------------------------ file mount translation
+
+
+def maybe_translate_local_file_mounts_and_sync_up(task, kind: str) -> None:
+    """Rewrite client-local workdir/file_mounts into bucket-backed
+    storage mounts (parity: controller_utils.py:688).
+
+    The controller and its task clusters must be able to materialize the
+    task's inputs after the client is gone; anything that lives only on
+    the client disk is uploaded to a bucket first and the task spec is
+    rewritten to pull from it.
+    """
+    from skypilot_tpu.data import storage as storage_lib
+
+    run_id = common_utils.get_user_hash()[:6] + hex(int(time.time()))[-6:]
+    subdirs: Dict[str, str] = {}
+    if task.workdir is not None:
+        subdirs['workdir'] = task.workdir
+        task.workdir = None
+    for dst, src in list((task.file_mounts or {}).items()):
+        if not _is_cloud_uri(src):
+            subdirs[dst] = src
+            del task.file_mounts[dst]
+
+    if not subdirs:
+        return
+    for i, (dst, src) in enumerate(subdirs.items()):
+        name = f'skytpu-{kind}-fm-{run_id}-{i}'
+        store = storage_lib.Storage(name=name,
+                                    source=os.path.expanduser(src),
+                                    mode=storage_lib.StorageMode.COPY)
+        store.add_store(store._default_store())  # pylint: disable=protected-access
+        store.sync_all_stores()
+        if dst == 'workdir':
+            # Workdir lands as the task's working directory via a mount
+            # at a fixed path + cd in the run command.
+            mount_path = '/tmp/skytpu_workdir'
+            task.storage_mounts[mount_path] = store
+            task.run = f'cd {mount_path} && {task.run}'
+            if task.setup:
+                task.setup = f'cd {mount_path} && {task.setup}'
+        else:
+            task.storage_mounts[dst] = store
+
+
+def _is_cloud_uri(src: Any) -> bool:
+    return isinstance(src, str) and ('://' in src)

@@ -41,6 +41,9 @@ def _isolate_state(tmp_path, monkeypatch):
     monkeypatch.setenv('SKYTPU_SERVE_CONTROLLER_INTERVAL', '0.5')
     monkeypatch.setenv('SKYTPU_GANG_GRACE_SECONDS', '0.4')
     monkeypatch.setenv('SKYTPU_JOBS_RETRY_GAP_SECONDS', '0.5')
+    # Local-process controllers by default (fast path); the
+    # controller-as-cluster tests opt back into 'cluster'.
+    monkeypatch.setenv('SKYTPU_CONTROLLER_MODE', 'local')
     # Reset cached module state that depends on HOME.
     import skypilot_tpu.skypilot_config as config
     config.reload_config()

@@ -1,0 +1,97 @@
+"""Controller-as-cluster: managed jobs/services survive the client.
+
+Parity: the reference places jobs/serve controllers on a dedicated
+controller cluster (``sky/utils/controller_utils.py:88``); these tests run
+that mode against the Local cloud — the submitting CLIENT PROCESS exits
+immediately after launch, and the job still runs to completion under the
+controller cluster's own process tree.
+"""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_state
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def cluster_mode(monkeypatch):
+    global_state.set_enabled_clouds(['Local'])
+    monkeypatch.setenv('SKYTPU_CONTROLLER_MODE', 'cluster')
+    yield
+
+
+def _client_submit(code: str) -> str:
+    """Run a short-lived CLIENT process that submits and exits."""
+    env = dict(os.environ)
+    env['PYTHONPATH'] = REPO_ROOT + (
+        os.pathsep + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
+    proc = subprocess.run([sys.executable, '-c', code],
+                          env=env, capture_output=True, text=True,
+                          timeout=240)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return proc.stdout
+
+
+def test_managed_job_survives_client_exit(cluster_mode, tmp_path):
+    marker = tmp_path / 'done_marker'
+    out = _client_submit(f'''
+import skypilot_tpu as sky
+job_id = sky.jobs.launch(
+    sky.Task(name='survivor',
+             run='sleep 3; echo survived > {marker}'),
+    name='survivor')
+print('JOB', job_id, flush=True)
+''')
+    job_id = int(out.split('JOB')[1].split()[0])
+    # The client process is GONE (subprocess.run returned). The job must
+    # still finish under the controller cluster.
+    deadline = time.time() + 180
+    status = None
+    while time.time() < deadline:
+        q = sky.jobs.queue()
+        row = next((j for j in q if j['job_id'] == job_id), None)
+        status = row and row['status']
+        if status in ('SUCCEEDED', 'FAILED', 'FAILED_PRECHECKS',
+                      'FAILED_NO_RESOURCE', 'FAILED_CONTROLLER'):
+            break
+        time.sleep(1)
+    assert status == 'SUCCEEDED', status
+    assert marker.read_text().strip() == 'survived'
+    # The controller cluster exists as a first-class cluster record.
+    from skypilot_tpu.utils import controller_utils
+    names = [r['name'] for r in sky.status()]
+    assert controller_utils.controller_cluster_name('jobs') in names
+
+
+def test_file_mounts_translated_to_storage(cluster_mode, tmp_path):
+    """Client-local file mounts are rewritten to bucket storage before
+    submission (parity: controller_utils.py:688)."""
+    src = tmp_path / 'inputs'
+    src.mkdir()
+    (src / 'data.txt').write_text('payload-77')
+    out_file = tmp_path / 'out.txt'
+    task = sky.Task(name='fm',
+                    run=f'cat /tmp/fm-in/data.txt > {out_file}',
+                    file_mounts={'/tmp/fm-in': str(src)})
+    from skypilot_tpu.utils import controller_utils
+    controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+        task, 'jobs')
+    # Plain local mounts are gone; a storage mount took their place.
+    assert not task.file_mounts
+    assert '/tmp/fm-in' in task.storage_mounts
+    job_id = sky.jobs.launch(task, name='fm')
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        q = sky.jobs.queue()
+        row = next((j for j in q if j['job_id'] == job_id), None)
+        if row and row['status'] in ('SUCCEEDED', 'FAILED'):
+            break
+        time.sleep(1)
+    assert row['status'] == 'SUCCEEDED', row
+    assert out_file.read_text().strip() == 'payload-77'

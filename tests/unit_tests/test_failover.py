@@ -1,0 +1,122 @@
+"""Failover blocklist + slice-health-aware status refresh.
+
+Parity targets: ``cloud_vm_ray_backend.py:761,916,948`` (structured
+failover handlers + blocklist) and ``sky/backends/backend_utils.py:1766``
+(runtime health probing behind the cloud's instance state).
+"""
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_state
+from skypilot_tpu.backends import backend_utils, gang_backend
+
+
+# ----------------------------------------------------------- classification
+
+
+def test_classify_capacity_vs_abort():
+    h = gang_backend.FailoverCloudErrorHandler
+    assert h.classify(RuntimeError('STOCKOUT: no more capacity')) == h.ZONE
+    assert h.classify(RuntimeError('Quota exceeded for TPUs')) == h.REGION
+    assert h.classify(RuntimeError('Permission denied for project')) == \
+        h.ABORT
+    from skypilot_tpu.provision.gcp import tpu_api
+    assert h.classify(
+        tpu_api.GcpCapacityError(429, 'zonal stockout')) == h.ZONE
+
+
+def test_blocklist_backoff_and_region_scope():
+    bl = gang_backend.ProvisionBlocklist(base_seconds=0.2)
+    assert not bl.is_blocked('gcp', 'us-central2', 'us-central2-b')
+    bl.block('gcp', 'us-central2', 'us-central2-b')
+    assert bl.is_blocked('gcp', 'us-central2', 'us-central2-b')
+    assert not bl.is_blocked('gcp', 'us-central2', 'us-central2-a')
+    # Region-level block covers every zone in the region.
+    bl.block('gcp', 'europe-west4', None)
+    assert bl.is_blocked('gcp', 'europe-west4', 'europe-west4-a')
+    # Backoff expires...
+    time.sleep(0.25)
+    assert not bl.is_blocked('gcp', 'us-central2', 'us-central2-b')
+    # ...and doubles per strike.
+    bl.block('gcp', 'us-central2', 'us-central2-b')
+    time.sleep(0.25)
+    assert bl.is_blocked('gcp', 'us-central2', 'us-central2-b')
+
+
+def test_persistent_stockout_blocklisted_across_rounds(monkeypatch):
+    """Two provision rounds against a stocked-out fake: round 2 skips the
+    blocked zone without re-hitting the API."""
+    calls = []
+
+    class _Cand:
+
+        def __init__(self):
+            import skypilot_tpu.clouds  # noqa: F401 (registers clouds)
+            from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+            self.cloud = CLOUD_REGISTRY.from_str('gcp')
+            self.region = 'us-west4'
+            self.instance_type = 'TPU-VM'
+            self.accelerators = {'tpu-v5e': 8}
+            self.use_spot = True
+            self.tpu_topology = None
+
+        def copy(self, **kwargs):
+            return self
+
+    cand = _Cand()
+
+    def fake_provision_one(self, cand_, region, zone, name_on_cloud):
+        calls.append(zone)
+        raise RuntimeError('stockout: no more capacity in zone')
+
+    monkeypatch.setattr(gang_backend.RetryingProvisioner, '_provision_one',
+                        fake_provision_one)
+    bl = gang_backend.ProvisionBlocklist(base_seconds=60)
+    from skypilot_tpu import exceptions
+    prov = gang_backend.RetryingProvisioner(cand, 1, 'bl-test', [cand],
+                                            blocklist=bl)
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        prov.provision_with_retries()
+    first_round = len(calls)
+    assert first_round >= 1
+    # Round 2: every zone it hit is now blocked → zero new API calls.
+    prov2 = gang_backend.RetryingProvisioner(cand, 1, 'bl-test', [cand],
+                                             blocklist=bl)
+    with pytest.raises(exceptions.ResourcesUnavailableError) as err:
+        prov2.provision_with_retries()
+    assert len(calls) == first_round
+    assert 'skipped by blocklist' in str(err.value)
+
+
+# ------------------------------------------------------- health-aware status
+
+
+def test_dead_host_degrades_up_to_init(monkeypatch):
+    """Cloud says READY but the skylet is dead → status INIT, not UP."""
+    global_state.set_enabled_clouds(['Local'])
+    task = sky.Task(name='health', run='echo ok')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id, handle = sky.launch(task, cluster_name='t-health',
+                                detach_run=True, stream_logs=False)
+    deadline = time.time() + 60
+    from skypilot_tpu import core
+    while time.time() < deadline:
+        st = core.job_status('t-health', job_id)
+        if st is not None and st.is_terminal():
+            break
+        time.sleep(0.5)
+    rec = backend_utils.refresh_cluster_record('t-health',
+                                               force_refresh=True)
+    assert rec['status'] == global_state.ClusterStatus.UP
+
+    # Kill the node's skylet out-of-band (crashed host); instance state
+    # still says running.
+    runner = handle.head_runner()
+    rc = runner.run('kill -9 "$(cat ~/.skytpu/skylet.pid)"', timeout=15)
+    assert rc == 0
+    rec = backend_utils.refresh_cluster_record('t-health',
+                                               force_refresh=True)
+    assert rec['status'] == global_state.ClusterStatus.INIT
+    sky.down('t-health')
