@@ -101,7 +101,34 @@ def tpu_price_per_chip_hour(generation_name: str,
     if rows.empty:
         return None
     col = 'SpotPricePerChipHour' if use_spot else 'PricePerChipHour'
-    return float(rows.iloc[0][col])
+    price = float(rows.iloc[0][col])
+    # Fetched catalogs leave spot EMPTY where no spot SKU exists.
+    return None if price != price else price  # NaN-safe
+
+
+def tpu_dws_price_per_chip_hour(generation_name: str,
+                                region: str) -> Optional[float]:
+    """DWS / flex-start ("calendar mode") chip-hour price, if published.
+
+    Between on-demand and spot: capacity-assured for a bounded window —
+    the middle rung of the TPU economics ladder the optimizer can rank.
+    """
+    df = _tpu_df()
+    if 'DwsPricePerChipHour' not in df.columns:
+        return None
+    rows = df[(df['AcceleratorName'] == f'tpu-{generation_name}') &
+              (df['Region'] == region)]
+    if rows.empty:
+        return None
+    # DWS is regional; any priced zone row carries it.
+    for val in rows['DwsPricePerChipHour']:
+        try:
+            price = float(val)
+        except (TypeError, ValueError):
+            continue
+        if price == price and price > 0:  # NaN-safe
+            return price
+    return None
 
 
 def tpu_slice_hourly_cost(slice_topology: topo_lib.TpuSliceTopology,

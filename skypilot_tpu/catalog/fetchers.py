@@ -1,0 +1,196 @@
+"""Catalog fetchers: refresh the bundled CSVs from cloud pricing APIs.
+
+Parity: ``sky/clouds/service_catalog/data_fetchers/fetch_gcp.py`` (and
+``fetch_aws.py``) — redesigned around an injectable transport so the
+fetch logic is unit-testable offline (recorded fixtures) and runnable for
+real wherever network + credentials exist:
+
+    python -m skypilot_tpu.catalog.fetchers gcp --out-dir ~/.skytpu/catalog
+    SKYTPU_CATALOG_DIR=~/.skytpu/catalog sky launch ...
+
+GCP source: the Cloud Billing Catalog API (`services.skus.list` for the
+Compute Engine + TPU services). TPU rows are emitted per (generation,
+region, zone) with on-demand, spot, and — where published — DWS/
+flex-start ("calendar mode") chip-hour prices.
+"""
+import argparse
+import csv
+import json
+import os
+import re
+from typing import Callable, Dict, Iterable, List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+# The Cloud Billing Catalog service id for Compute Engine (public,
+# stable) — TPU SKUs live under it.
+_COMPUTE_SERVICE = 'services/6F81-5844-456A'
+_BILLING_URL = (f'https://cloudbilling.googleapis.com/v1/'
+                f'{_COMPUTE_SERVICE}/skus')
+
+# TPU SKU descriptions look like:
+#   "Tpu-v5e Chip Hour in Americas" /
+#   "Tpu v5p chip hours in us-east5" / "Preemptible Tpu-v4 ..."
+_TPU_DESC_RE = re.compile(
+    r'(?P<spot>preemptible\s+)?tpu[ -]?(?P<gen>v\d+[a-z]*)\b.*chip',
+    re.IGNORECASE)
+_DWS_MARKERS = ('dws', 'flex-start', 'calendar mode')
+
+Transport = Callable[[str, Dict[str, str]], dict]
+
+
+def _http_transport(url: str, params: Dict[str, str]) -> dict:
+    """Default transport: GET with the gcloud access token."""
+    import subprocess
+    import urllib.parse
+    import urllib.request
+    token = subprocess.run(['gcloud', 'auth', 'print-access-token'],
+                           capture_output=True, text=True,
+                           check=True).stdout.strip()
+    q = urllib.parse.urlencode(params)
+    req = urllib.request.Request(f'{url}?{q}',
+                                 headers={'Authorization':
+                                          f'Bearer {token}'})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+def _iter_skus(transport: Transport) -> Iterable[dict]:
+    page_token = ''
+    while True:
+        params = {'pageSize': '5000'}
+        if page_token:
+            params['pageToken'] = page_token
+        payload = transport(_BILLING_URL, params)
+        yield from payload.get('skus', [])
+        page_token = payload.get('nextPageToken', '')
+        if not page_token:
+            return
+
+
+def _sku_unit_price(sku: dict) -> Optional[float]:
+    """$/hr from the first pricing tier of the SKU."""
+    infos = sku.get('pricingInfo') or []
+    if not infos:
+        return None
+    tiers = (infos[0].get('pricingExpression') or {}).get('tieredRates')
+    if not tiers:
+        return None
+    money = tiers[-1].get('unitPrice') or {}
+    units = float(money.get('units') or 0)
+    nanos = float(money.get('nanos') or 0)
+    return units + nanos / 1e9
+
+
+def fetch_gcp_tpus(transport: Optional[Transport] = None,
+                   zones_by_region: Optional[Dict[str, List[str]]] = None
+                   ) -> List[Dict[str, str]]:
+    """TPU chip-hour price rows from the billing catalog.
+
+    Returns rows for ``gcp_tpus.csv``:
+    AcceleratorName,Region,AvailabilityZone,PricePerChipHour,
+    SpotPricePerChipHour[,DwsPricePerChipHour]
+    """
+    transport = transport or _http_transport
+    # (gen, region) → {'od': p, 'spot': p, 'dws': p}
+    prices: Dict[tuple, Dict[str, float]] = {}
+    for sku in _iter_skus(transport):
+        desc = sku.get('description', '')
+        m = _TPU_DESC_RE.search(desc)
+        if not m:
+            continue
+        price = _sku_unit_price(sku)
+        if price is None or price <= 0:
+            continue
+        gen = f'tpu-{m.group("gen").lower()}'
+        kind = 'spot' if m.group('spot') else 'od'
+        if any(s in desc.lower() for s in _DWS_MARKERS):
+            kind = 'dws'
+        for region in sku.get('serviceRegions', []):
+            entry = prices.setdefault((gen, region), {})
+            # Keep the lowest published price per kind (duplicate SKUs
+            # exist for committed-use variants; lowest = list).
+            entry[kind] = min(entry.get(kind, float('inf')), price)
+
+    zones_by_region = dict(zones_by_region or {})
+    rows = []
+    for (gen, region), entry in sorted(prices.items()):
+        od = entry.get('od')
+        if od is None:
+            continue
+        # No fabricated data: spot stays EMPTY when no spot SKU exists
+        # (the catalog reads missing as "no spot offering"), and zones
+        # come from the zones map or the bundled catalog — a region with
+        # no known zone is dropped with a warning rather than invented.
+        spot = entry.get('spot')
+        zones = zones_by_region.get(region) or _bundled_zones(gen, region)
+        if not zones:
+            logger.warning(f'{gen} priced in {region} but no known zones; '
+                           'skipping (pass zones_by_region to include).')
+            continue
+        for zone in zones:
+            row = {
+                'AcceleratorName': gen,
+                'Region': region,
+                'AvailabilityZone': zone,
+                'PricePerChipHour': f'{od:.4f}',
+                'SpotPricePerChipHour':
+                    f'{spot:.4f}' if spot is not None else '',
+            }
+            if 'dws' in entry:
+                row['DwsPricePerChipHour'] = f'{entry["dws"]:.4f}'
+            rows.append(row)
+    return rows
+
+
+def _bundled_zones(gen: str, region: str) -> List[str]:
+    """Zones for (gen, region) from the shipped catalog (zone lists are
+    stable; prices are what the fetch refreshes)."""
+    try:
+        from skypilot_tpu import catalog
+        pairs = catalog.tpu_regions_zones(gen.replace('tpu-', ''))
+    except Exception:  # pylint: disable=broad-except
+        return []
+    return [z for r, z in pairs if r == region]
+
+
+def write_csv(rows: List[Dict[str, str]], path: str) -> None:
+    if not rows:
+        raise ValueError('fetch produced no rows; refusing to write an '
+                         'empty catalog')
+    fields: List[str] = []
+    for row in rows:
+        for k in row:
+            if k not in fields:
+                fields.append(k)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.DictWriter(f, fieldnames=fields, restval='')
+        writer.writeheader()
+        writer.writerows(rows)
+    logger.info(f'Wrote {len(rows)} rows to {path}')
+
+
+def fetch_and_write_gcp(out_dir: str,
+                        transport: Optional[Transport] = None) -> str:
+    rows = fetch_gcp_tpus(transport)
+    path = os.path.join(os.path.expanduser(out_dir), 'gcp_tpus.csv')
+    write_csv(rows, path)
+    return path
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description='Refresh catalog CSVs from cloud pricing APIs.')
+    parser.add_argument('cloud', choices=['gcp'])
+    parser.add_argument('--out-dir', default='~/.skytpu/catalog')
+    args = parser.parse_args()
+    path = fetch_and_write_gcp(args.out_dir)
+    print(f'Catalog written: {path}\n'
+          f'Use it with SKYTPU_CATALOG_DIR={args.out_dir}')
+
+
+if __name__ == '__main__':
+    main()

@@ -297,6 +297,18 @@ def serve_up(entrypoint, service_name):
                f"{result['endpoint']}")
 
 
+@serve.command(name='update')
+@click.argument('service_name', required=True)
+@click.argument('entrypoint', required=True)
+def serve_update(service_name, entrypoint):
+    """Rolling-update a service to a new YAML spec."""
+    task = _load_task(entrypoint, {})
+    result = sdk.stream_and_get(
+        sdk.serve_update(task, service_name=service_name))
+    click.echo(f"Service {result['name']!r} updating to "
+               f"v{result['version']} (rolling).")
+
+
 @serve.command(name='status')
 @click.argument('service_name', required=False)
 def serve_status(service_name):

@@ -239,6 +239,11 @@ def serve_up(task: 'task_lib.Task',
                                'service_name': service_name})
 
 
+def serve_update(task: 'task_lib.Task', service_name: str) -> str:
+    return _post('/serve/update', {'task': task.to_yaml_config(),
+                                   'service_name': service_name})
+
+
 def serve_status(service_name: Optional[str] = None) -> str:
     return _post('/serve/status', {'service_name': service_name})
 

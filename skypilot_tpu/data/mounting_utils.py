@@ -61,6 +61,44 @@ def get_gcs_copy_cmd(bucket_name: str, key: str, dst: str) -> str:
            f'{shlex.quote(dst)}'
 
 
+GOOFYS_VERSION = '0.24.0'
+
+_GOOFYS_INSTALL = (
+    'which goofys >/dev/null 2>&1 || ('
+    'sudo curl -fsSL -o /usr/local/bin/goofys '
+    'https://github.com/kahing/goofys/releases/download/'
+    f'v{GOOFYS_VERSION}/goofys && sudo chmod +x /usr/local/bin/goofys) '
+    '|| true; '
+    # rclone fallback needs its 's3' remote defined (env_auth: the same
+    # AWS credential chain goofys/aws-cli use).
+    'if ! which goofys >/dev/null 2>&1 && which rclone >/dev/null 2>&1; '
+    'then rclone config create s3 s3 env_auth true >/dev/null 2>&1 || '
+    'true; fi')
+
+
+def get_s3_mount_cmd(bucket_name: str, mount_path: str) -> str:
+    """goofys mount, rclone as the fallback (parity:
+    sky/data/mounting_utils.py:34-66 goofys + rclone paths)."""
+    b, m = shlex.quote(bucket_name), shlex.quote(mount_path)
+    return (f'if which goofys >/dev/null 2>&1; then '
+            f'goofys --stat-cache-ttl 5s '
+            f'--type-cache-ttl 5s {b} {m}; '
+            f'else rclone mount s3:{b} {m} --daemon --vfs-cache-mode '
+            f'writes; fi')
+
+
+def get_s3_mount_script(bucket_name: str, mount_path: str) -> str:
+    return get_mounting_script(mount_path,
+                               get_s3_mount_cmd(bucket_name, mount_path),
+                               install_cmd=_GOOFYS_INSTALL)
+
+
+def get_s3_copy_cmd(bucket_name: str, key: str, dst: str) -> str:
+    src = f's3://{bucket_name}/{key}'.rstrip('/')
+    return (f'mkdir -p {shlex.quote(dst)} && '
+            f'aws s3 sync {src} {shlex.quote(dst)}')
+
+
 def get_local_mount_script(bucket_dir: str, mount_path: str) -> str:
     """Local store "mount": a symlink into the bucket directory.
 

@@ -11,6 +11,7 @@ from skypilot_tpu.serve.core import down
 from skypilot_tpu.serve.core import status
 from skypilot_tpu.serve.core import tail_logs
 from skypilot_tpu.serve.core import up
+from skypilot_tpu.serve.core import update
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.serve.serve_state import ServiceStatus
 from skypilot_tpu.serve.service_spec import SkyServiceSpec

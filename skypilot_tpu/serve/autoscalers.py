@@ -3,8 +3,11 @@
 Parity: ``sky/serve/autoscalers.py`` (Autoscaler:116, RequestRateAutoscaler
 :441, FallbackRequestRateAutoscaler:557) — scale-up requires the over-target
 signal to persist ``upscale_delay`` seconds, scale-down ``downscale_delay``
-(longer, so transient dips don't churn replicas).
+(longer, so transient dips don't churn replicas). The fallback autoscaler
+splits the target into spot + on-demand: a base on-demand floor plus
+dynamic on-demand covering preempted spot capacity.
 """
+import dataclasses
 import os
 import time
 from typing import List, Optional
@@ -18,6 +21,19 @@ logger = sky_logging.init_logger(__name__)
 def _env_float(name: str, default: float) -> float:
     v = os.environ.get(name)
     return float(v) if v else default
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalePlan:
+    """Target pool sizes. ``default_count`` replicas launch with the task's
+    own resources (spot or not); ``ondemand_fallback_count`` force
+    use_spot=False."""
+    default_count: int
+    ondemand_fallback_count: int = 0
+
+    @property
+    def total(self) -> int:
+        return self.default_count + self.ondemand_fallback_count
 
 
 class Autoscaler:
@@ -35,8 +51,17 @@ class Autoscaler:
         del num_alive, request_timestamps
         return self.spec.min_replicas
 
+    def plan(self, num_ready_default: int, num_alive_default: int,
+             request_timestamps: List[float]) -> ScalePlan:
+        """→ ScalePlan; base autoscalers put everything in the default
+        pool."""
+        del num_ready_default, num_alive_default
+        return ScalePlan(self.evaluate(0, request_timestamps))
+
     @classmethod
     def make(cls, spec: spec_lib.SkyServiceSpec) -> 'Autoscaler':
+        if spec.use_ondemand_fallback:
+            return FallbackRequestRateAutoscaler(spec)
         if spec.autoscaling_enabled:
             return RequestRateAutoscaler(spec)
         return cls(spec)
@@ -53,9 +78,14 @@ class RequestRateAutoscaler(Autoscaler):
     def __init__(self, spec: spec_lib.SkyServiceSpec):
         super().__init__(spec)
         self.qps_window_seconds = _env_float('SKYTPU_SERVE_QPS_WINDOW', 60)
-        self.upscale_delay = _env_float('SKYTPU_SERVE_UPSCALE_DELAY', 300)
-        self.downscale_delay = _env_float('SKYTPU_SERVE_DOWNSCALE_DELAY',
-                                          1200)
+        # Spec-level delays win; env knobs are the test override.
+        self.upscale_delay = (
+            spec.upscale_delay_seconds if spec.upscale_delay_seconds
+            is not None else _env_float('SKYTPU_SERVE_UPSCALE_DELAY', 300))
+        self.downscale_delay = (
+            spec.downscale_delay_seconds if spec.downscale_delay_seconds
+            is not None else _env_float('SKYTPU_SERVE_DOWNSCALE_DELAY',
+                                        1200))
         self._over_since: Optional[float] = None
         self._under_since: Optional[float] = None
         self._target = max(spec.min_replicas, 1)
@@ -100,3 +130,33 @@ class RequestRateAutoscaler(Autoscaler):
             self._under_since = None
         del num_alive
         return self._target
+
+
+class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
+    """Spot + on-demand fallback split (parity: autoscalers.py:557).
+
+    The QPS-derived target is served by spot replicas; on-demand covers
+    ``base_ondemand_fallback_replicas`` always, plus — with
+    ``dynamic_ondemand_fallback`` — the gap left by not-yet-READY spot
+    capacity (preemptions included), so availability holds while spot
+    replacements provision. As spot recovers, the dynamic on-demand pool
+    drains automatically.
+    """
+
+    def plan(self, num_ready_default: int, num_alive_default: int,
+             request_timestamps: List[float]) -> ScalePlan:
+        spec = self.spec
+        if spec.autoscaling_enabled:
+            total = self.evaluate(num_alive_default, request_timestamps)
+        else:
+            total = max(spec.min_replicas, 1)
+        base_od = min(spec.base_ondemand_fallback_replicas, total)
+        spot_target = max(total - base_od, 0)
+        od = base_od
+        if spec.dynamic_ondemand_fallback:
+            # Cover the spot shortfall with on-demand until spot READY
+            # capacity catches up.
+            shortfall = max(spot_target - num_ready_default, 0)
+            od += shortfall
+        return ScalePlan(default_count=spot_target,
+                         ondemand_fallback_count=od)

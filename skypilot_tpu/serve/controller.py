@@ -34,8 +34,10 @@ class SkyServeController:
         assert svc is not None, f'service {service_name} not found'
         self.service_name = service_name
         self.spec = spec_lib.SkyServiceSpec.from_yaml_config(svc['spec'])
+        self.version = svc.get('version', 1) or 1
         self.replica_manager = replica_managers.ReplicaManager(
-            service_name, self.spec, svc['task_yaml_path'])
+            service_name, self.spec, svc['task_yaml_path'],
+            version=self.version)
         self.autoscaler = autoscalers_lib.Autoscaler.make(self.spec)
         self.load_balancer = lb_lib.LoadBalancer(
             svc['lb_port'], self.spec.load_balancing_policy,
@@ -63,12 +65,38 @@ class SkyServeController:
 
     def _tick(self) -> None:
         rm = self.replica_manager
+        self._maybe_apply_update()
         rm.reconcile()
-        target = self.autoscaler.evaluate(
-            len(rm.alive_replicas()),
+        replicas = serve_state.get_replicas(self.service_name)
+        default_pool = [r for r in replicas
+                        if r['is_spot'] and
+                        r.get('version', 1) == rm.version]
+        plan = self.autoscaler.plan(
+            sum(1 for r in default_pool
+                if r['status'] == ReplicaStatus.READY),
+            sum(1 for r in default_pool if r['status'].is_alive()),
             self.load_balancer.snapshot_request_timestamps())
-        rm.scale_to(target)
+        rm.scale_to(plan)
+        rm.rolling_update_tick(plan)
         self._update_service_status()
+
+    def _maybe_apply_update(self) -> None:
+        """Rolling update: pick up a bumped service version (new spec +
+        task yaml) written by ``sky serve update``."""
+        svc = serve_state.get_service(self.service_name)
+        if svc is None:
+            return
+        version = svc.get('version', 1) or 1
+        if version == self.version:
+            return
+        logger.info(f'Rolling update: v{self.version} → v{version}.')
+        self.version = version
+        self.spec = spec_lib.SkyServiceSpec.from_yaml_config(svc['spec'])
+        self.replica_manager.apply_update(version, self.spec,
+                                          svc['task_yaml_path'])
+        # Rebuild (not mutate): the new spec may change the autoscaler
+        # CLASS (fixed ↔ QPS ↔ fallback) and its delay constants.
+        self.autoscaler = autoscalers_lib.Autoscaler.make(self.spec)
 
     def _update_service_status(self) -> None:
         replicas = serve_state.get_replicas(self.service_name)

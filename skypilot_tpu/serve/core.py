@@ -168,6 +168,52 @@ def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
     return out
 
 
+@usage_lib.entrypoint(name='serve.update')
+def update(task: task_lib.Task, service_name: str) -> Dict[str, Any]:
+    """Rolling update: install a new task/spec version; the controller
+    surges new-version replicas and drains old ones once READY (parity:
+    `sky serve update`)."""
+    if task.service is None:
+        raise exceptions.InvalidSkyError(
+            'Task has no service: section; cannot update.')
+    from skypilot_tpu.utils import controller_utils
+    if controller_utils.controller_mode() == 'cluster':
+        import json
+        import tempfile
+        import uuid
+        controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+            task, controller_utils.SERVE)
+        runner = controller_utils.head_runner(controller_utils.SERVE)
+        yaml_id = uuid.uuid4().hex
+        with tempfile.NamedTemporaryFile('w', suffix='.yaml') as f:
+            common_utils.dump_yaml(f.name, task.to_yaml_config())
+            runner.run('mkdir -p ~/.skytpu/serve/uploads', timeout=60)
+            runner.rsync(f.name, f'.skytpu/serve/uploads/{yaml_id}.yaml',
+                         up=True)
+        payload = json.dumps({'yaml': yaml_id, 'name': service_name})
+        return controller_utils.controller_rpc(
+            controller_utils.SERVE,
+            f'import os; p = json.loads({payload!r}); '
+            "os.environ['SKYTPU_CONTROLLER_MODE'] = 'local'; "
+            'from skypilot_tpu import task as task_lib; '
+            'from skypilot_tpu.serve import core; '
+            't = task_lib.Task.from_yaml(os.path.expanduser('
+            '"~/.skytpu/serve/uploads/" + p["yaml"] + ".yaml")); '
+            'emit(core.update(t, p["name"]))', timeout=300)
+    svc = serve_state.get_service(service_name)
+    if svc is None or svc['status'].is_terminal():
+        raise exceptions.InvalidSkyError(
+            f'Service {service_name!r} is not running; use serve.up.')
+    yaml_path = os.path.join(serve_state.task_yaml_dir(),
+                             f'{service_name}.v{svc["version"] + 1}.yaml')
+    common_utils.dump_yaml(yaml_path, task.to_yaml_config())
+    version = serve_state.bump_service_version(
+        service_name, task.service.to_yaml_config(), yaml_path)
+    logger.info(f'Service {service_name!r} updating to v{version} '
+                '(rolling).')
+    return {'name': service_name, 'version': version}
+
+
 @usage_lib.entrypoint(name='serve.down')
 def down(service_name: str, purge: bool = False) -> None:
     from skypilot_tpu.utils import controller_utils

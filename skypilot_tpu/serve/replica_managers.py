@@ -9,7 +9,7 @@ import os
 import threading
 import time
 import typing
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
 
 import requests as requests_lib
 
@@ -43,14 +43,65 @@ class ReplicaManager:
     """Drives the replica pool of one service toward a target size."""
 
     def __init__(self, service_name: str, spec: 'spec_lib.SkyServiceSpec',
-                 task_yaml_path: str):
+                 task_yaml_path: str, version: int = 1):
         self.service_name = service_name
         self.spec = spec
         self.task_yaml_path = task_yaml_path
+        self.version = version
         # Every launch/terminate worker thread ever started; join() must
         # wait for in-flight launches too, or shutdown would orphan a
         # half-provisioned cluster whose replica row is already gone.
         self._threads: List[threading.Thread] = []
+        # Spot placer (parity: spot_placer.py:167): candidate zones come
+        # from the task's resources; empty on zoneless clouds (local).
+        from skypilot_tpu.serve import spot_placer as spot_placer_lib
+        self._placer = spot_placer_lib.SpotPlacer.make(
+            spec, self._candidate_locations())
+        # replica_id → Location, for preemption feedback after the
+        # cluster record is gone.
+        self._replica_locations: Dict[int, Any] = {}
+        # replica_id → the port BAKED INTO the task env at build time.
+        # The prober must use exactly this port: re-deriving it after
+        # launch (from the provider the optimizer picked) can disagree
+        # with what the replica was told to bind.
+        self._replica_ports: Dict[int, int] = {}
+
+    def _candidate_locations(self):
+        from skypilot_tpu.serve import spot_placer as spot_placer_lib
+        try:
+            task = task_lib.Task.from_yaml(self.task_yaml_path)
+        except Exception:  # pylint: disable=broad-except
+            return []
+        locs = []
+        for res in task.resources:
+            cloud = res.cloud
+            if cloud is None or not res.use_spot:
+                continue
+            try:
+                for zones in cloud.zones_provision_loop(
+                        region=res.region, num_nodes=1,
+                        instance_type=res.instance_type,
+                        accelerators=res.accelerators,
+                        use_spot=True):
+                    if zones:
+                        locs.append(spot_placer_lib.Location(
+                            cloud.name, zones[0].region, zones[0].name))
+            except Exception:  # pylint: disable=broad-except
+                continue
+        return locs
+
+    def apply_update(self, version: int, spec: 'spec_lib.SkyServiceSpec',
+                     task_yaml_path: str) -> None:
+        """Rolling update: new replicas launch at `version`; the rolling
+        tick drains old-version replicas once new capacity is READY."""
+        self.version = version
+        self.spec = spec
+        self.task_yaml_path = task_yaml_path
+        # The new spec/task may enable a spot placer or change the
+        # candidate zones — rebuild rather than keep the stale one.
+        from skypilot_tpu.serve import spot_placer as spot_placer_lib
+        self._placer = spot_placer_lib.SpotPlacer.make(
+            spec, self._candidate_locations())
 
     # ------------------------------------------------------------- naming
 
@@ -74,13 +125,31 @@ class ReplicaManager:
         return [r for r in serve_state.get_replicas(self.service_name)
                 if r['status'] == ReplicaStatus.FAILED]
 
-    def scale_to(self, target: int) -> None:
-        alive = self.alive_replicas()
+    def scale_to(self, plan) -> None:
+        """Drive both pools toward the plan (int = default pool only).
+
+        Pool targets count CURRENT-version replicas: during a rolling
+        update, old-version replicas keep serving (and are drained by
+        ``rolling_update_tick``) while new capacity surges in.
+        """
+        from skypilot_tpu.serve import autoscalers as autoscalers_lib
+        if isinstance(plan, int):
+            plan = autoscalers_lib.ScalePlan(plan)
+        alive = [r for r in self.alive_replicas()
+                 if r.get('version', 1) == self.version]
+        self._scale_pool([r for r in alive if r['is_spot']],
+                         plan.default_count, ondemand_fallback=False)
+        self._scale_pool([r for r in alive if not r['is_spot']],
+                         plan.ondemand_fallback_count,
+                         ondemand_fallback=True)
+
+    def _scale_pool(self, alive: List[dict], target: int,
+                    ondemand_fallback: bool) -> None:
         if len(alive) < target:
             if len(self.failed_replicas()) >= _MAX_FAILED_REPLICAS:
                 return  # out of retry budget; service will show FAILED
             for _ in range(target - len(alive)):
-                self._launch_new_replica()
+                self._launch_new_replica(ondemand_fallback)
         elif len(alive) > target:
             # Scale down newest-first (parity: reference terminates the
             # latest-launched replicas first).
@@ -89,42 +158,85 @@ class ReplicaManager:
             for rec in surplus:
                 self.terminate_replica(rec['replica_id'], reason='autoscale')
 
-    def _launch_new_replica(self) -> None:
+    def rolling_update_tick(self, plan) -> None:
+        """Drain one old-version replica per tick once the new version's
+        READY capacity covers the plan (surge-then-drain; the service
+        never dips below target mid-update)."""
+        from skypilot_tpu.serve import autoscalers as autoscalers_lib
+        if isinstance(plan, int):
+            plan = autoscalers_lib.ScalePlan(plan)
+        replicas = serve_state.get_replicas(self.service_name)
+        olds = [r for r in replicas
+                if r['status'].is_alive() and
+                r.get('version', 1) != self.version]
+        if not olds:
+            return
+        ready_new = [r for r in replicas
+                     if r['status'] == ReplicaStatus.READY and
+                     r.get('version', 1) == self.version]
+        if len(ready_new) >= max(plan.total, 1):
+            victim = min(olds, key=lambda r: r['replica_id'])
+            self.terminate_replica(victim['replica_id'],
+                                   reason=f'rolling-update v{self.version}')
+
+    def _launch_new_replica(self, ondemand_fallback: bool = False) -> None:
         replica_id = serve_state.next_replica_id(self.service_name)
         cluster_name = self.replica_cluster_name(replica_id)
         serve_state.add_replica(self.service_name, replica_id, cluster_name,
-                                endpoint=None)
+                                endpoint=None,
+                                is_spot=not ondemand_fallback,
+                                version=self.version)
         serve_state.set_replica_status(self.service_name, replica_id,
                                        ReplicaStatus.PROVISIONING)
         t = threading.Thread(target=self._launch_thread,
-                             args=(replica_id, cluster_name),
+                             args=(replica_id, cluster_name,
+                                   ondemand_fallback),
                              daemon=True,
                              name=f'launch-{cluster_name}')
         self._track(t)
         t.start()
 
-    def _build_replica_task(self, replica_id: int) -> task_lib.Task:
+    def _build_replica_task(self, replica_id: int,
+                            ondemand_fallback: bool = False
+                            ) -> task_lib.Task:
         task = task_lib.Task.from_yaml(self.task_yaml_path)
         task.service = None  # replicas run the task, not the service
         cloud_is_local = self._cloud_is_local(task)
         port = self._replica_port(replica_id, cloud_is_local)
+        self._replica_ports[replica_id] = port
         task.update_envs({
             REPLICA_PORT_ENV: str(port),
             REPLICA_ID_ENV: str(replica_id),
         })
+        if ondemand_fallback:
+            # The fallback pool rides assured capacity.
+            task.set_resources({r.copy(use_spot=False)
+                                for r in task.resources})
+        elif self._placer is not None:
+            loc = self._placer.select()
+            if loc is not None:
+                self._replica_locations[replica_id] = loc
+                task.set_resources({
+                    r.copy(region=loc.region, zone=loc.zone)
+                    if r.use_spot else r for r in task.resources})
         return task
 
     @staticmethod
     def _cloud_is_local(task: task_lib.Task) -> bool:
         for res in task.resources:
-            if res.cloud is not None and res.cloud.name == 'local':
-                return True
-        return False
+            if res.cloud is not None:
+                return res.cloud.name == 'local'
+        # Cloud unpinned: the optimizer can only pick among enabled
+        # clouds — local iff Local is the only one.
+        from skypilot_tpu import global_state
+        enabled = global_state.get_enabled_clouds()
+        return bool(enabled) and all(c.lower() == 'local' for c in enabled)
 
-    def _launch_thread(self, replica_id: int, cluster_name: str) -> None:
+    def _launch_thread(self, replica_id: int, cluster_name: str,
+                       ondemand_fallback: bool = False) -> None:
         from skypilot_tpu import execution
         try:
-            task = self._build_replica_task(replica_id)
+            task = self._build_replica_task(replica_id, ondemand_fallback)
             execution.launch(task,
                              cluster_name=cluster_name,
                              detach_run=True,
@@ -160,15 +272,21 @@ class ReplicaManager:
         if record is None:
             return None
         handle = record['handle']
+        # The port the replica was TOLD to bind (recorded at task-build
+        # time) is authoritative; re-deriving from the launched provider
+        # can disagree when the task left the cloud unpinned.
+        port = self._replica_ports.get(replica_id)
         if handle.provider_name == 'local':
             host = '127.0.0.1'
-            port = self._replica_port(replica_id, cloud_is_local=True)
+            if port is None:
+                port = self._replica_port(replica_id, cloud_is_local=True)
         else:
             if handle.cached_hosts is None:
                 handle.update_cluster_info()
             head = handle.cached_hosts[0]
             host = head.get('ip') or head.get('internal_ip')
-            port = self._replica_port(replica_id, cloud_is_local=False)
+            if port is None:
+                port = self._replica_port(replica_id, cloud_is_local=False)
         return f'http://{host}:{port}'
 
     # ---------------------------------------------------------- terminate
@@ -236,6 +354,9 @@ class ReplicaManager:
             if record is None:
                 # Cluster vanished out from under us: preemption.
                 logger.info(f'Replica {rid} preempted.')
+                if self._placer is not None:
+                    self._placer.handle_preemption(
+                        self._replica_locations.pop(rid, None))
                 serve_state.remove_replica(self.service_name, rid)
                 continue
             if self._job_failed(record['handle']):
@@ -273,6 +394,9 @@ class ReplicaManager:
         if ok:
             if status != ReplicaStatus.READY:
                 logger.info(f'Replica {rid} is READY.')
+                if self._placer is not None:
+                    self._placer.handle_active(
+                        self._replica_locations.get(rid))
             serve_state.set_replica_failures(self.service_name, rid, 0)
             serve_state.set_replica_status(self.service_name, rid,
                                            ReplicaStatus.READY)

@@ -21,7 +21,8 @@ _TABLES = """
         spec_json TEXT,
         task_yaml_path TEXT,
         lb_port INTEGER,
-        shutdown_requested INTEGER DEFAULT 0
+        shutdown_requested INTEGER DEFAULT 0,
+        version INTEGER DEFAULT 1
     );
     CREATE TABLE IF NOT EXISTS replicas (
         service_name TEXT,
@@ -31,6 +32,8 @@ _TABLES = """
         endpoint TEXT,
         launched_at REAL,
         consecutive_failures INTEGER DEFAULT 0,
+        is_spot INTEGER DEFAULT 1,
+        version INTEGER DEFAULT 1,
         PRIMARY KEY (service_name, replica_id)
     );
     CREATE TABLE IF NOT EXISTS replica_id_seq (
@@ -56,7 +59,14 @@ def task_yaml_dir() -> str:
     return d
 
 
-_CONN = db_utils.SqliteConn('serve', db_path, _TABLES)
+_MIGRATIONS = (
+    'ALTER TABLE services ADD COLUMN version INTEGER DEFAULT 1',
+    'ALTER TABLE replicas ADD COLUMN is_spot INTEGER DEFAULT 1',
+    'ALTER TABLE replicas ADD COLUMN version INTEGER DEFAULT 1',
+)
+
+_CONN = db_utils.SqliteConn('serve', db_path, _TABLES,
+                            migrations=_MIGRATIONS)
 
 
 def _db() -> sqlite3.Connection:
@@ -160,6 +170,25 @@ def shutdown_requested(name: str) -> bool:
     return bool(svc and svc['shutdown_requested'])
 
 
+def get_service_version(name: str) -> int:
+    svc = get_service(name)
+    return (svc or {}).get('version', 1) or 1
+
+
+def bump_service_version(name: str, spec_json: Dict[str, Any],
+                         task_yaml_path: str) -> int:
+    """Rolling update entry: install the new spec/task, return the new
+    version. The controller replaces old-version replicas one by one."""
+    with _db() as conn:
+        conn.execute(
+            'UPDATE services SET version=version+1, spec_json=?, '
+            'task_yaml_path=? WHERE name=?',
+            (json.dumps(spec_json), task_yaml_path, name))
+        row = conn.execute('SELECT version FROM services WHERE name=?',
+                           (name,)).fetchone()
+    return row['version']
+
+
 def remove_service(name: str) -> None:
     with _db() as conn:
         conn.execute('DELETE FROM services WHERE name=?', (name,))
@@ -172,12 +201,14 @@ def remove_service(name: str) -> None:
 
 
 def add_replica(service_name: str, replica_id: int, cluster_name: str,
-                endpoint: Optional[str]) -> None:
+                endpoint: Optional[str], is_spot: bool = True,
+                version: int = 1) -> None:
     with _db() as conn:
         conn.execute(
-            'INSERT OR REPLACE INTO replicas VALUES (?,?,?,?,?,?,0)',
+            'INSERT OR REPLACE INTO replicas VALUES (?,?,?,?,?,?,0,?,?)',
             (service_name, replica_id, cluster_name,
-             ReplicaStatus.PENDING.value, endpoint, time.time()))
+             ReplicaStatus.PENDING.value, endpoint, time.time(),
+             1 if is_spot else 0, version))
 
 
 def get_replicas(service_name: str) -> List[Dict[str, Any]]:

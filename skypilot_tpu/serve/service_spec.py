@@ -33,7 +33,12 @@ class SkyServiceSpec:
                  max_replicas: Optional[int] = None,
                  target_qps_per_replica: Optional[float] = None,
                  replica_port: int = 8080,
-                 load_balancing_policy: str = 'least_load'):
+                 load_balancing_policy: str = 'least_load',
+                 upscale_delay_seconds: Optional[float] = None,
+                 downscale_delay_seconds: Optional[float] = None,
+                 base_ondemand_fallback_replicas: int = 0,
+                 dynamic_ondemand_fallback: bool = False,
+                 spot_placer: Optional[str] = None):
         if not readiness_path.startswith('/'):
             raise exceptions.InvalidSkyError(
                 f'readiness_probe path must start with "/": '
@@ -51,6 +56,14 @@ class SkyServiceSpec:
                 raise exceptions.InvalidSkyError(
                     'autoscaling (target_qps_per_replica) requires '
                     'max_replicas.')
+        if base_ondemand_fallback_replicas < 0:
+            raise exceptions.InvalidSkyError(
+                'base_ondemand_fallback_replicas must be >= 0.')
+        if spot_placer is not None and spot_placer not in (
+                'dynamic_fallback',):
+            raise exceptions.InvalidSkyError(
+                f'Unknown spot_placer {spot_placer!r}; expected '
+                "'dynamic_fallback'.")
         self.readiness_path = readiness_path
         self.initial_delay_seconds = initial_delay_seconds
         self.readiness_timeout_seconds = readiness_timeout_seconds
@@ -59,10 +72,23 @@ class SkyServiceSpec:
         self.target_qps_per_replica = target_qps_per_replica
         self.replica_port = replica_port
         self.load_balancing_policy = load_balancing_policy
+        self.upscale_delay_seconds = upscale_delay_seconds
+        self.downscale_delay_seconds = downscale_delay_seconds
+        self.base_ondemand_fallback_replicas = \
+            base_ondemand_fallback_replicas
+        self.dynamic_ondemand_fallback = dynamic_ondemand_fallback
+        self.spot_placer = spot_placer
 
     @property
     def autoscaling_enabled(self) -> bool:
         return self.target_qps_per_replica is not None
+
+    @property
+    def use_ondemand_fallback(self) -> bool:
+        """Spot replicas backed by on-demand capacity (parity:
+        service_spec use_ondemand_fallback)."""
+        return (self.base_ondemand_fallback_replicas > 0 or
+                self.dynamic_ondemand_fallback)
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'SkyServiceSpec':
@@ -87,6 +113,13 @@ class SkyServiceSpec:
             replica_port=config.get('replica_port', 8080),
             load_balancing_policy=config.get('load_balancing_policy',
                                              'least_load'),
+            upscale_delay_seconds=policy.get('upscale_delay_seconds'),
+            downscale_delay_seconds=policy.get('downscale_delay_seconds'),
+            base_ondemand_fallback_replicas=policy.get(
+                'base_ondemand_fallback_replicas', 0),
+            dynamic_ondemand_fallback=policy.get(
+                'dynamic_ondemand_fallback', False),
+            spot_placer=policy.get('spot_placer'),
         )
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -107,6 +140,19 @@ class SkyServiceSpec:
         if self.target_qps_per_replica is not None:
             cfg['replica_policy']['target_qps_per_replica'] = \
                 self.target_qps_per_replica
+        if self.upscale_delay_seconds is not None:
+            cfg['replica_policy']['upscale_delay_seconds'] = \
+                self.upscale_delay_seconds
+        if self.downscale_delay_seconds is not None:
+            cfg['replica_policy']['downscale_delay_seconds'] = \
+                self.downscale_delay_seconds
+        if self.base_ondemand_fallback_replicas:
+            cfg['replica_policy']['base_ondemand_fallback_replicas'] = \
+                self.base_ondemand_fallback_replicas
+        if self.dynamic_ondemand_fallback:
+            cfg['replica_policy']['dynamic_ondemand_fallback'] = True
+        if self.spot_placer is not None:
+            cfg['replica_policy']['spot_placer'] = self.spot_placer
         return cfg
 
     def __repr__(self) -> str:

@@ -1,0 +1,86 @@
+"""Spot placement policy: spread spot replicas across zones by
+preemption history.
+
+Parity: ``sky/serve/spot_placer.py:167`` DynamicFallbackSpotPlacer — zones
+are ranked ACTIVE (no recent preemption) before PREEMPTED (most-recently
+preempted last), so replacement replicas drain away from zones the spot
+market is reclaiming. TPU framing: spot stockouts/preemptions are zonal
+and sticky, so this is the same signal the provision blocklist uses, fed
+by the serve prober instead of the provisioner.
+"""
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Location:
+    """Where a replica can be placed (parity: spot_placer.Location)."""
+    cloud: Optional[str]
+    region: Optional[str]
+    zone: Optional[str]
+
+
+class SpotPlacer:
+    """Base: no preference (the optimizer's order stands)."""
+
+    def __init__(self, locations: List[Location]):
+        self.locations = list(locations)
+
+    def select(self) -> Optional[Location]:
+        return self.locations[0] if self.locations else None
+
+    def handle_active(self, location: Optional[Location]) -> None:
+        pass
+
+    def handle_preemption(self, location: Optional[Location]) -> None:
+        pass
+
+    @classmethod
+    def make(cls, spec, locations: List[Location]) -> Optional['SpotPlacer']:
+        if getattr(spec, 'spot_placer', None) == 'dynamic_fallback':
+            return DynamicFallbackSpotPlacer(locations)
+        return None
+
+
+class DynamicFallbackSpotPlacer(SpotPlacer):
+    """Prefer zones that have not been preempted recently.
+
+    Parity: spot_placer.py:167 — ACTIVE zones round-robin first; if all
+    zones are PREEMPTED, fall back to the least-recently-preempted one
+    (markets recover; oldest strike is the best guess).
+    """
+
+    def __init__(self, locations: List[Location]):
+        super().__init__(locations)
+        self._preempted_at: Dict[Location, float] = {}
+        self._rr = 0
+
+    def active_locations(self) -> List[Location]:
+        return [l for l in self.locations if l not in self._preempted_at]
+
+    def select(self) -> Optional[Location]:
+        active = self.active_locations()
+        if active:
+            choice = active[self._rr % len(active)]
+            self._rr += 1
+            return choice
+        if not self.locations:
+            return None
+        return min(self.locations,
+                   key=lambda l: self._preempted_at.get(l, 0.0))
+
+    def handle_active(self, location: Optional[Location]) -> None:
+        """A replica became READY here: the zone has capacity again."""
+        if location is not None:
+            self._preempted_at.pop(location, None)
+
+    def handle_preemption(self, location: Optional[Location]) -> None:
+        if location is None:
+            return
+        self._preempted_at[location] = time.time()
+        logger.info(f'Spot placer: preemption recorded in {location}.')

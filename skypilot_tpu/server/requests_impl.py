@@ -192,6 +192,12 @@ def _serve_up(payload: Dict[str, Any]) -> Dict[str, Any]:
     return serve.up(task, service_name=payload.get('service_name'))
 
 
+def _serve_update(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import serve
+    task = task_lib.Task.from_yaml_config(payload['task'])
+    return serve.update(task, payload['service_name'])
+
+
 def _serve_status(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
     from skypilot_tpu import serve
     return serve.status(payload.get('service_name'))
@@ -246,6 +252,7 @@ EXECUTORS: Dict[str, Callable[[Dict[str, Any]], Any]] = {
     'jobs_queue': _jobs_queue,
     'jobs_cancel': _jobs_cancel,
     'serve_up': _serve_up,
+    'serve_update': _serve_update,
     'serve_status': _serve_status,
     'serve_down': _serve_down,
     'logs': _tail_logs,
@@ -257,7 +264,7 @@ EXECUTORS: Dict[str, Callable[[Dict[str, Any]], Any]] = {
 # stream logs); everything else is quick state access.
 LONG_REQUESTS = {
     'launch', 'exec', 'start', 'stop', 'down', 'jobs_launch', 'serve_up',
-    'serve_down', 'storage_delete', 'logs', 'jobs_logs', 'serve_logs',
+    'serve_update', 'serve_down', 'storage_delete', 'logs', 'jobs_logs', 'serve_logs',
 }
 
 

@@ -44,6 +44,7 @@ _VERB_ROUTES = {
     '/jobs/cancel': 'jobs_cancel',
     '/jobs/logs': 'jobs_logs',
     '/serve/up': 'serve_up',
+    '/serve/update': 'serve_update',
     '/serve/status': 'serve_status',
     '/serve/down': 'serve_down',
     '/serve/logs': 'serve_logs',

@@ -151,7 +151,6 @@ def maybe_translate_local_file_mounts_and_sync_up(task, kind: str) -> None:
         store = storage_lib.Storage(name=name,
                                     source=os.path.expanduser(src),
                                     mode=storage_lib.StorageMode.COPY)
-        store.add_store(store._default_store())  # pylint: disable=protected-access
         store.sync_all_stores()
         if dst == 'workdir':
             # Workdir lands as the task's working directory via a mount

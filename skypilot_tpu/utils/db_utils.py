@@ -9,18 +9,26 @@ tests that repoint $HOME get a fresh DB.
 import os
 import sqlite3
 import threading
-from typing import Callable
+from typing import Callable, Sequence
 
 _local = threading.local()
 
 
 class SqliteConn:
-    """Factory for thread-local connections to one logical database."""
+    """Factory for thread-local connections to one logical database.
 
-    def __init__(self, name: str, path_fn: Callable[[], str], schema: str):
+    ``migrations`` are ALTER TABLE statements applied best-effort after
+    the schema script: CREATE TABLE IF NOT EXISTS no-ops on pre-existing
+    tables, so column additions must be replayed here ("duplicate column"
+    errors are the already-migrated case and are swallowed).
+    """
+
+    def __init__(self, name: str, path_fn: Callable[[], str], schema: str,
+                 migrations: Sequence[str] = ()):
         self._name = name
         self._path_fn = path_fn
         self._schema = schema
+        self._migrations = tuple(migrations)
 
     def get(self) -> sqlite3.Connection:
         path = os.path.expanduser(self._path_fn())
@@ -34,6 +42,11 @@ class SqliteConn:
             conn = sqlite3.connect(path, timeout=30)
             conn.row_factory = sqlite3.Row
             conn.executescript(self._schema)
+            for stmt in self._migrations:
+                try:
+                    conn.execute(stmt)
+                except sqlite3.OperationalError:
+                    pass  # column already exists
             conn.commit()
             # Drop stale connections for this logical DB (old $HOME).
             for k in [k for k in cache if k[0] == self._name and k != key]:

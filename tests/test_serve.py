@@ -236,3 +236,97 @@ def test_serve_failed_replica_budget(serve_env):
     assert len(recs[0]['replicas']) <= 4
     sky.serve.down('svc-bad')
     assert sky.status() == []
+
+
+# ------------------------------------- spot fallback + placer + updates
+
+
+def test_fallback_autoscaler_covers_preempted_spot():
+    """Spot capacity dips → dynamic on-demand fallback covers the gap;
+    spot recovers → fallback drains (parity: autoscalers.py:557)."""
+    spec = spec_lib.SkyServiceSpec(min_replicas=3, max_replicas=3,
+                                   base_ondemand_fallback_replicas=1,
+                                   dynamic_ondemand_fallback=True)
+    scaler = autoscalers.Autoscaler.make(spec)
+    assert isinstance(scaler, autoscalers.FallbackRequestRateAutoscaler)
+    # All spot READY: 2 spot + 1 base on-demand.
+    plan = scaler.plan(num_ready_default=2, num_alive_default=2,
+                       request_timestamps=[])
+    assert (plan.default_count, plan.ondemand_fallback_count) == (2, 1)
+    # Both spot replicas preempted: on-demand surges to cover.
+    plan = scaler.plan(num_ready_default=0, num_alive_default=0,
+                       request_timestamps=[])
+    assert (plan.default_count, plan.ondemand_fallback_count) == (2, 3)
+    # Spot recovered: fallback back to the base floor.
+    plan = scaler.plan(num_ready_default=2, num_alive_default=2,
+                       request_timestamps=[])
+    assert plan.ondemand_fallback_count == 1
+
+
+def test_spot_placer_prefers_unpreempted_zones():
+    from skypilot_tpu.serve import spot_placer as sp
+    locs = [sp.Location('gcp', 'us-west4', f'us-west4-{z}')
+            for z in 'abc']
+    placer = sp.DynamicFallbackSpotPlacer(locs)
+    # Round-robins across active zones.
+    picks = {placer.select().zone for _ in range(3)}
+    assert picks == {'us-west4-a', 'us-west4-b', 'us-west4-c'}
+    # Preempted zones drop out of rotation.
+    placer.handle_preemption(locs[0])
+    placer.handle_preemption(locs[1])
+    assert all(placer.select().zone == 'us-west4-c' for _ in range(3))
+    # All preempted → least-recently-preempted wins.
+    placer.handle_preemption(locs[2])
+    assert placer.select() == locs[0]
+    # Recovery: a READY replica reactivates its zone.
+    placer.handle_active(locs[1])
+    assert placer.select() == locs[1]
+
+
+def test_service_spec_fallback_validation():
+    with pytest.raises(exceptions.InvalidSkyError):
+        spec_lib.SkyServiceSpec(base_ondemand_fallback_replicas=-1)
+    with pytest.raises(exceptions.InvalidSkyError):
+        spec_lib.SkyServiceSpec(spot_placer='bogus')
+    spec = spec_lib.SkyServiceSpec(
+        min_replicas=1, dynamic_ondemand_fallback=True,
+        spot_placer='dynamic_fallback')
+    assert spec.use_ondemand_fallback
+
+
+def test_serve_rolling_update(serve_env, tmp_path):
+    """`serve update` surges a new-version replica, drains the old one,
+    and the service stays READY throughout."""
+    task = _http_service_task('svc-roll')
+    sky.serve.up(task)
+    rec = _wait_ready('svc-roll')
+    old_ids = {r['replica_id'] for r in rec['replicas']
+               if r['status'] == 'READY'}
+
+    # v2: same server, new marker env (any spec/task change works).
+    task2 = _http_service_task('svc-roll')
+    task2.update_envs({'ROLL_MARKER': 'v2'})
+    result = sky.serve.update(task2, 'svc-roll')
+    assert result['version'] == 2
+
+    deadline = time.time() + 150
+    new_rec = None
+    while time.time() < deadline:
+        recs = sky.serve.status('svc-roll')
+        if recs:
+            ready = [r for r in recs[0]['replicas']
+                     if r['status'] == 'READY']
+            ready_new = [r for r in ready
+                         if r['replica_id'] not in old_ids]
+            if ready_new and all(r['replica_id'] not in old_ids
+                                 for r in ready):
+                new_rec = ready_new[0]
+                break
+        time.sleep(0.5)
+    assert new_rec is not None, sky.serve.status('svc-roll')
+    # Old replicas fully drained; service still READY and serving.
+    recs = sky.serve.status('svc-roll')
+    assert recs[0]['status'] == 'READY'
+    resp = requests.get(recs[0]['endpoint'] + '/', timeout=10)
+    assert resp.status_code == 200
+    sky.serve.down('svc-roll')

@@ -1,0 +1,113 @@
+"""Catalog fetchers against recorded billing-API fixtures (no network).
+
+Parity: sky/clouds/service_catalog/data_fetchers/fetch_gcp.py tests —
+transport is injected, so the SKU-parsing + CSV-writing logic runs
+offline exactly as it would against the live API.
+"""
+import os
+
+import pytest
+
+from skypilot_tpu import catalog
+from skypilot_tpu.catalog import fetchers
+
+
+def _sku(desc, price, regions, spot=False):
+    return {
+        'description': ('Preemptible ' if spot else '') + desc,
+        'serviceRegions': regions,
+        'pricingInfo': [{
+            'pricingExpression': {
+                'tieredRates': [{
+                    'unitPrice': {
+                        'units': str(int(price)),
+                        'nanos': int(round((price % 1) * 1e9)),
+                    }
+                }]
+            }
+        }],
+    }
+
+
+_FIXTURE_PAGES = [
+    {
+        'skus': [
+            _sku('Tpu-v5e Chip Hour', 1.2, ['us-west4', 'us-east1']),
+            _sku('Tpu-v5e Chip Hour', 0.48, ['us-west4', 'us-east1'],
+                 spot=True),
+            _sku('Tpu v5p chip hour', 4.2, ['us-east5']),
+            _sku('N2 Instance Core running in Americas', 0.03,
+                 ['us-west4']),  # non-TPU: ignored
+        ],
+        'nextPageToken': 'page2',
+    },
+    {
+        'skus': [
+            _sku('Tpu-v6e Chip Hour DWS flex-start', 1.89, ['us-east5']),
+            _sku('Tpu-v6e Chip Hour', 2.7, ['us-east5']),
+            _sku('Tpu-v6e Chip Hour', 0.81, ['us-east5'], spot=True),
+        ],
+    },
+]
+
+
+def _fixture_transport(url, params):
+    if params.get('pageToken') == 'page2':
+        return _FIXTURE_PAGES[1]
+    return _FIXTURE_PAGES[0]
+
+
+def test_fetch_gcp_tpus_parses_fixture():
+    rows = fetchers.fetch_gcp_tpus(
+        _fixture_transport,
+        zones_by_region={'us-west4': ['us-west4-a', 'us-west4-b']})
+    by_key = {(r['AcceleratorName'], r['AvailabilityZone']): r
+              for r in rows}
+    # v5e: OD + spot, two zones in us-west4 (from the zones map) and a
+    # synthesized -a zone elsewhere.
+    assert by_key[('tpu-v5e', 'us-west4-a')]['PricePerChipHour'] == \
+        '1.2000'
+    assert by_key[('tpu-v5e', 'us-west4-b')]['SpotPricePerChipHour'] == \
+        '0.4800'
+    # us-east1 zone came from the bundled catalog (us-east1-c), not a
+    # fabricated '-a'.
+    assert ('tpu-v5e', 'us-east1-c') in by_key
+    # v5p had no spot SKU → spot column left EMPTY (never fabricated).
+    assert by_key[('tpu-v5p', 'us-east5-a')]['SpotPricePerChipHour'] == ''
+    # v6e carries the DWS price column.
+    assert by_key[('tpu-v6e', 'us-east5-a')]['DwsPricePerChipHour'] == \
+        '1.8900'
+    # Non-TPU SKUs never leak in.
+    assert all(r['AcceleratorName'].startswith('tpu-') for r in rows)
+
+
+def test_fetched_csv_loads_through_catalog(tmp_path, monkeypatch):
+    """The fetcher's output is a drop-in catalog via SKYTPU_CATALOG_DIR."""
+    rows = fetchers.fetch_gcp_tpus(_fixture_transport)
+    fetchers.write_csv(rows, str(tmp_path / 'gcp_tpus.csv'))
+    monkeypatch.setenv(catalog.CATALOG_DIR_ENV, str(tmp_path))
+    catalog.invalidate_cache()
+    try:
+        assert catalog.tpu_price_per_chip_hour('v5e', 'us-west4') == 1.2
+        assert catalog.tpu_price_per_chip_hour('v6e', 'us-east5',
+                                               use_spot=True) == 0.81
+        assert catalog.tpu_dws_price_per_chip_hour('v6e', 'us-east5') == \
+            1.89
+        assert catalog.tpu_dws_price_per_chip_hour('v5e', 'us-west4') is \
+            None
+    finally:
+        monkeypatch.delenv(catalog.CATALOG_DIR_ENV)
+        catalog.invalidate_cache()
+
+
+def test_write_csv_refuses_empty(tmp_path):
+    with pytest.raises(ValueError):
+        fetchers.write_csv([], str(tmp_path / 'x.csv'))
+
+
+def test_bundled_catalog_has_dws_and_v6e():
+    catalog.invalidate_cache()
+    assert catalog.tpu_dws_price_per_chip_hour('v5e', 'us-west4') is not \
+        None
+    assert catalog.tpu_price_per_chip_hour('v6e', 'us-central2') == 2.7
+    assert len(catalog.tpu_regions_zones('v5p')) >= 5

@@ -154,3 +154,88 @@ def test_gitignore_negation_reincluded(tmp_path):
             storage_utils.list_files_to_upload(str(src))}
     assert 'important.log' in rels
     assert 'a.log' not in rels
+
+
+# ------------------------------------------------------------------- S3
+
+
+@pytest.fixture
+def fake_aws(tmp_path, monkeypatch):
+    """A fake `aws` CLI on PATH: records invocations, emulates a bucket
+    as a directory (head-bucket / mb / sync / cp / rb)."""
+    bindir = tmp_path / 'bin'
+    bindir.mkdir()
+    bucket_root = tmp_path / 's3'
+    bucket_root.mkdir()
+    log = tmp_path / 'aws.log'
+    script = f'''#!/bin/bash
+echo "$@" >> {log}
+root={bucket_root}
+case "$1 $2" in
+  "s3api head-bucket")
+    name="$4"; [ -d "$root/$name" ] || exit 255 ;;
+  "s3 mb")
+    name="${{3#s3://}}"; mkdir -p "$root/$name" ;;
+  "s3 sync")
+    shift 2
+    args=(); skip=0
+    for a in "$@"; do
+      if [ "$skip" = 1 ]; then skip=0; continue; fi
+      case "$a" in
+        --exclude|--include) skip=1 ;;
+        --*) ;;
+        *) args+=("$a") ;;
+      esac
+    done
+    src="${{args[0]}}"; dst="${{args[1]#s3://}}"
+    mkdir -p "$root/$dst"; cp -r "$src"/. "$root/$dst/" ;;
+  "s3 cp")
+    src="$3"; dst="${{4#s3://}}"; mkdir -p "$root/$dst"; cp "$src" "$root/$dst/" ;;
+  "s3 rb")
+    name="${{4#s3://}}"; rm -rf "$root/$name" ;;
+esac
+exit 0
+'''
+    aws = bindir / 'aws'
+    aws.write_text(script)
+    aws.chmod(0o755)
+    monkeypatch.setenv('PATH', f'{bindir}:{os.environ["PATH"]}')
+    return {'log': log, 'root': bucket_root}
+
+
+def test_s3_store_roundtrip(fake_aws, tmp_path):
+    src = tmp_path / 'data'
+    src.mkdir()
+    (src / 'a.txt').write_text('alpha')
+    (src / '.git').mkdir()
+    (src / '.git' / 'junk').write_text('x')
+    store = storage_lib.Storage(name='skytpu-s3-ut', source=str(src),
+                            stores=[storage_lib.StoreType.S3])
+    store.sync_all_stores()
+    s3 = store.stores[storage_lib.StoreType.S3]
+    assert s3.exists()
+    assert (fake_aws['root'] / 'skytpu-s3-ut' / 'a.txt').read_text() == \
+        'alpha'
+    assert s3.get_uri() == 's3://skytpu-s3-ut'
+    calls = fake_aws['log'].read_text()
+    assert 's3 mb s3://skytpu-s3-ut' in calls
+    assert 's3 sync' in calls
+    store.delete()
+    assert not s3.exists()
+
+
+def test_s3_uri_source_infers_store(fake_aws):
+    (fake_aws['root'] / 'existing-bkt').mkdir()
+    st = storage_lib.Storage(source='s3://existing-bkt')
+    assert st.name == 'existing-bkt'
+    st.sync_all_stores()
+    assert storage_lib.StoreType.S3 in st.stores
+
+
+def test_s3_mount_and_copy_commands(fake_aws):
+    from skypilot_tpu.data import mounting_utils
+    script = mounting_utils.get_s3_mount_script('bkt', '/mnt/ckpt')
+    assert 'goofys' in script and 'rclone' in script
+    assert '/mnt/ckpt' in script
+    cmd = mounting_utils.get_s3_copy_cmd('bkt', '', '/tmp/out')
+    assert 'aws s3 sync s3://bkt /tmp/out' in cmd
