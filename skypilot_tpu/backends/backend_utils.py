@@ -16,6 +16,7 @@ from skypilot_tpu import exceptions
 from skypilot_tpu import global_state
 from skypilot_tpu import provision as provision_router
 from skypilot_tpu import sky_logging
+from skypilot_tpu import skypilot_config
 from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import locks
@@ -56,6 +57,11 @@ def make_provision_config(
         'availability_zone': zone_name,
     }
     auth_config: Dict[str, Any] = {}
+    if cloud.name == 'kubernetes':
+        # region == kubeconfig context; namespace from config.
+        provider_config['context'] = region_name
+        provider_config['namespace'] = skypilot_config.get_nested(
+            ('kubernetes', 'namespace'), 'default')
     if cloud.name == 'gcp':
         public_key, private_key = authentication.get_or_generate_keys()
         ssh_user = authentication.DEFAULT_SSH_USER

@@ -137,7 +137,9 @@ class FailoverCloudErrorHandler:
     @classmethod
     def classify(cls, exc: Exception) -> str:
         from skypilot_tpu.provision.gcp import tpu_api
-        if isinstance(exc, tpu_api.GcpCapacityError):
+        from skypilot_tpu.provision.kubernetes import k8s_api
+        if isinstance(exc, (tpu_api.GcpCapacityError,
+                            k8s_api.K8sCapacityError)):
             return cls.ZONE
         text = str(exc).lower()
         if any(s in text for s in cls._ZONE_MARKERS):

@@ -5,6 +5,7 @@ from skypilot_tpu.clouds.cloud import CloudImplementationFeatures
 from skypilot_tpu.clouds.cloud import Region
 from skypilot_tpu.clouds.cloud import Zone
 from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.local import Local
 
 __all__ = [
@@ -12,6 +13,7 @@ __all__ = [
     'Cloud',
     'CloudImplementationFeatures',
     'GCP',
+    'Kubernetes',
     'Local',
     'Region',
     'Zone',

@@ -1,0 +1,277 @@
+"""Kubernetes cloud: GKE TPU podslice nodepools as first-class targets.
+
+Parity: ``sky/clouds/kubernetes.py`` — redesigned around cluster-advertised
+capacity instead of a static catalog: feasibility is decided by what the
+nodes actually carry (GKE TPU labels ``cloud.google.com/gke-tpu-accelerator``
+/ ``gke-tpu-topology`` and the ``google.com/tpu`` resource; parity
+``sky/provision/kubernetes/utils.py:96-102``). Each kubeconfig context is a
+"region"; there are no zones. Cost is 0 — the user already pays for the
+cluster — so the optimizer prefers Kubernetes whenever it is feasible
+(parity: the reference ranks k8s by instance-type heuristics; zero-cost is
+the honest model for bring-your-own-cluster).
+"""
+import os
+import re
+import shutil
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu import topology as topo_lib
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+# Generation → GKE accelerator label value (parity: utils.py:96-102
+# GKE_TPU_ACCELERATOR_TO_GENERATION, inverted; single-host v5e uses the
+# -device flavor).
+_GEN_TO_GKE_ACCELERATOR = {
+    'v4': 'tpu-v4-podslice',
+    'v5e': 'tpu-v5-lite-podslice',
+    'v5p': 'tpu-v5p-slice',
+    'v6e': 'tpu-v6e-slice',
+}
+_GKE_V5E_SINGLE_HOST = 'tpu-v5-lite-device'
+
+_INSTANCE_TYPE_RE = re.compile(r'^cpu(\d+)-mem(\d+)$')
+_DEFAULT_INSTANCE_TYPE = 'cpu4-mem16'
+
+
+def gke_accelerator_for(topo: topo_lib.TpuSliceTopology) -> Optional[str]:
+    """GKE nodepool accelerator label for a slice, or None if GKE cannot
+    host this generation (v2/v3 are not offered on GKE)."""
+    gen = topo.generation.name
+    if gen == 'v5e' and topo.num_hosts == 1:
+        return _GKE_V5E_SINGLE_HOST
+    return _GEN_TO_GKE_ACCELERATOR.get(gen)
+
+
+@CLOUD_REGISTRY.register()
+class Kubernetes(cloud.Cloud):
+    """A Kubernetes cluster (GKE for TPU workloads)."""
+
+    _REPR = 'Kubernetes'
+    # Pod names: RFC 1123 + room for '-{node}-{host}' suffixes.
+    _MAX_CLUSTER_NAME_LEN_LIMIT = 40
+
+    @classmethod
+    def unsupported_features(
+            cls,
+            resources=None) -> Dict[cloud.CloudImplementationFeatures, str]:
+        return {
+            cloud.CloudImplementationFeatures.STOP:
+                'Kubernetes pods cannot be stopped, only terminated.',
+            cloud.CloudImplementationFeatures.AUTOSTOP:
+                'Kubernetes supports autodown, not autostop.',
+            cloud.CloudImplementationFeatures.SPOT_INSTANCE:
+                'Spot scheduling is owned by the cluster autoscaler, not '
+                'the framework.',
+            cloud.CloudImplementationFeatures.IMAGE_ID:
+                'Use docker images (image_id: docker:<image>) instead of '
+                'machine images.',
+            cloud.CloudImplementationFeatures.CLONE_DISK_FROM_CLUSTER:
+                'Pods have no clonable boot disks.',
+        }
+
+    # ----------------------------------------------------------- contexts
+
+    @classmethod
+    def existing_allowed_contexts(cls) -> List[str]:
+        """Kubeconfig contexts this build may target (parity:
+        sky/clouds/kubernetes.py context discovery + allowed_contexts).
+
+        With ``kubernetes.allowed_contexts`` configured, ALL listed
+        contexts are offered, in list order (they are the failover
+        chain); otherwise only the current context.
+        """
+        from skypilot_tpu import skypilot_config
+        allowed = skypilot_config.get_nested(('kubernetes', 'allowed_contexts'),
+                                             None)
+        if allowed is not None:
+            return list(allowed)
+        if os.environ.get('SKYTPU_K8S_FAKE', '0') == '1':
+            return [os.environ.get('SKYTPU_K8S_FAKE_CONTEXT', 'fake-gke')]
+        from skypilot_tpu.provision.kubernetes import k8s_api
+        current = k8s_api.KubectlTransport().current_context()
+        return [current] if current else []
+
+    def regions_with_offering(self, instance_type, accelerators, use_spot,
+                              region, zone) -> List[cloud.Region]:
+        del instance_type, zone
+        if use_spot:
+            return []
+        regions = []
+        for ctx in self.existing_allowed_contexts():
+            if region is not None and region != ctx:
+                continue
+            regions.append(cloud.Region(ctx))
+        return regions
+
+    def zones_provision_loop(self, *, region, num_nodes, instance_type,
+                             accelerators=None, use_spot=False
+                             ) -> Iterator[Optional[List[cloud.Zone]]]:
+        del num_nodes, instance_type, accelerators, use_spot
+        # A context has no zones; one shot per context.
+        yield None
+
+    # ----------------------------------------------------------- pricing
+
+    def instance_type_to_hourly_cost(self, instance_type, use_spot, region,
+                                     zone) -> float:
+        return 0.0
+
+    def accelerators_to_hourly_cost(self, accelerators, use_spot, region,
+                                    zone) -> float:
+        return 0.0
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        return 0.0
+
+    # ----------------------------------------------------------- catalog
+
+    def instance_type_exists(self, instance_type: str) -> bool:
+        return bool(_INSTANCE_TYPE_RE.match(instance_type))
+
+    @classmethod
+    def get_default_instance_type(cls, cpus=None, memory=None,
+                                  disk_tier=None) -> Optional[str]:
+        del disk_tier
+        c = int(float(str(cpus).rstrip('+'))) if cpus else 4
+        m = int(float(str(memory).rstrip('+'))) if memory else 4 * c
+        return f'cpu{c}-mem{m}'
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(
+            cls, instance_type) -> Tuple[Optional[float], Optional[float]]:
+        m = _INSTANCE_TYPE_RE.match(instance_type)
+        if not m:
+            return None, None
+        return float(m.group(1)), float(m.group(2))
+
+    @classmethod
+    def get_accelerators_from_instance_type(cls, instance_type):
+        return None
+
+    # -------------------------------------------------------- feasibility
+
+    @classmethod
+    def _cluster_nodes(cls, context: str) -> List[dict]:
+        from skypilot_tpu.provision.kubernetes import k8s_api
+        try:
+            return k8s_api.make_client(context).list_nodes()
+        except Exception:  # pylint: disable=broad-except
+            return []
+
+    @classmethod
+    def _tpu_offerings(cls, context: str) -> List[Tuple[str, str]]:
+        """(gke_accelerator, topology) pairs the cluster's nodes advertise."""
+        from skypilot_tpu.provision.kubernetes import k8s_api
+        out = []
+        for node in cls._cluster_nodes(context):
+            labels = node.get('metadata', {}).get('labels', {})
+            accel = labels.get(k8s_api.GKE_TPU_ACCELERATOR_LABEL)
+            topo = labels.get(k8s_api.GKE_TPU_TOPOLOGY_LABEL)
+            if accel and topo:
+                out.append((accel, topo))
+        return out
+
+    def get_feasible_launchable_resources(self, resources, num_nodes):
+        del num_nodes
+        if resources.use_spot:
+            return [], []
+        allowed = self.existing_allowed_contexts()
+        if resources.region is not None:
+            if resources.region not in allowed:
+                return [], []
+            contexts = [resources.region]
+        else:
+            contexts = allowed
+        if not contexts:
+            return [], []
+
+        accs = resources.accelerators
+        if accs is None:
+            instance_type = (resources.instance_type if resources.instance_type
+                             and self.instance_type_exists(
+                                 resources.instance_type) else
+                             self.get_default_instance_type(
+                                 resources.cpus, resources.memory))
+            return [
+                resources.copy(cloud=self, instance_type=instance_type)
+            ], []
+
+        acc_name, acc_count = next(iter(accs.items()))
+        if not topo_lib.is_tpu_accelerator(acc_name):
+            # GPU pods (nvidia.com/gpu) are not wired in this build: the
+            # compute stack is TPU-native.
+            return [], []
+        topo = topo_lib.resolve_topology(
+            acc_name, acc_count,
+            (resources.accelerator_args or {}).get('topology'))
+        wanted = gke_accelerator_for(topo)
+        if wanted is None:
+            return [], [f'{topo.name} is not offered on GKE']
+        seen_offerings: List[Tuple[str, str]] = []
+        for ctx in contexts:
+            offerings = self._tpu_offerings(ctx)
+            seen_offerings.extend(offerings)
+            if (wanted, topo.topology_str) in offerings:
+                return [
+                    resources.copy(
+                        cloud=self,
+                        region=ctx if resources.region else None,
+                        instance_type=_DEFAULT_INSTANCE_TYPE,
+                        accelerators={topo.name: topo.num_chips},
+                    )
+                ], []
+        hints = sorted({f'{a} ({t})' for a, t in seen_offerings})
+        return [], hints
+
+    # ----------------------------------------------------------- deploy
+
+    def make_deploy_resources_variables(self, resources,
+                                        cluster_name_on_cloud, region, zones,
+                                        num_nodes) -> Dict[str, object]:
+        del zones
+        cpus, mem = self.get_vcpus_mem_from_instance_type(
+            resources.instance_type or _DEFAULT_INSTANCE_TYPE)
+        image = None
+        if resources.image_id and str(resources.image_id).startswith(
+                'docker:'):
+            image = str(resources.image_id).split('docker:', 1)[1]
+        vars_: Dict[str, object] = {
+            'instance_type': resources.instance_type,
+            'region': region.name,   # kubeconfig context
+            'num_nodes': num_nodes,
+            'cpus': cpus,
+            'memory': mem,
+            'image': image,
+        }
+        topo = resources.tpu_topology
+        if topo is not None:
+            vars_.update({
+                'tpu_accelerator': gke_accelerator_for(topo),
+                'tpu_topology': topo.topology_str,
+                'accelerator_type': topo.gcp_accelerator_type,
+                'num_hosts': topo.num_hosts,
+                'chips_per_host': topo.chips_per_host,
+            })
+        return vars_
+
+    # ----------------------------------------------------------- identity
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        if os.environ.get('SKYTPU_K8S_FAKE', '0') == '1':
+            return True, None
+        if shutil.which('kubectl') is None:
+            return False, ('kubectl not found. Install kubectl and '
+                           'configure a kubeconfig context.')
+        from skypilot_tpu.provision.kubernetes import k8s_api
+        ctx = k8s_api.KubectlTransport().current_context()
+        if not ctx:
+            return False, ('No current kubeconfig context. Run `kubectl '
+                           'config use-context <ctx>`.')
+        return True, None
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        ctxs = cls.existing_allowed_contexts()
+        return ctxs or None

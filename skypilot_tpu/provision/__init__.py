@@ -10,6 +10,7 @@ from skypilot_tpu.provision import common
 
 _PROVIDER_MODULES = {
     'gcp': 'skypilot_tpu.provision.gcp',
+    'kubernetes': 'skypilot_tpu.provision.kubernetes',
     'local': 'skypilot_tpu.provision.local',
 }
 

@@ -85,12 +85,23 @@ class ClusterInfo:
         cluster_info.json, also cached on ClusterHandle)."""
         hosts: List[Dict[str, Any]] = []
         for rank, info in enumerate(self.ordered_host_infos()):
-            if self.provider_name == 'local':
+            if 'node_dir' in info.tags:
+                # Directory-backed host: the local cloud's nodes and the
+                # fake Kubernetes backend's pods.
                 hosts.append({
                     'transport': 'local',
                     'rank': rank,
                     'node_dir': info.tags['node_dir'],
                     'internal_ip': info.tags['node_dir'],
+                })
+            elif 'pod_name' in info.tags:
+                hosts.append({
+                    'transport': 'kubernetes',
+                    'rank': rank,
+                    'pod_name': info.tags['pod_name'],
+                    'namespace': info.tags.get('namespace', 'default'),
+                    'context': info.tags.get('context'),
+                    'internal_ip': info.internal_ip,
                 })
             else:
                 hosts.append({

@@ -1,0 +1,19 @@
+"""Kubernetes provisioner: GKE TPU podslices + CPU/GPU pods.
+
+Parity: ``sky/provision/kubernetes/`` — kubectl-based, with an in-memory
+fake cluster for credential-free end-to-end tests.
+"""
+from skypilot_tpu.provision.kubernetes.instance import cleanup_ports
+from skypilot_tpu.provision.kubernetes.instance import get_cluster_info
+from skypilot_tpu.provision.kubernetes.instance import open_ports
+from skypilot_tpu.provision.kubernetes.instance import query_instances
+from skypilot_tpu.provision.kubernetes.instance import run_instances
+from skypilot_tpu.provision.kubernetes.instance import stop_instances
+from skypilot_tpu.provision.kubernetes.instance import terminate_instances
+from skypilot_tpu.provision.kubernetes.instance import wait_instances
+
+__all__ = [
+    'cleanup_ports', 'get_cluster_info', 'open_ports', 'query_instances',
+    'run_instances', 'stop_instances', 'terminate_instances',
+    'wait_instances'
+]

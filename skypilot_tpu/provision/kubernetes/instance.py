@@ -1,0 +1,306 @@
+"""Kubernetes pod lifecycle: pods as instances, GKE TPU podslices first.
+
+Parity: ``sky/provision/kubernetes/instance.py`` — redesigned around the
+framework's slice model: a logical node is either a plain CPU/GPU pod or a
+TPU podslice whose H hosts fan out to one pod per TPU VM host, mirroring the
+GCP provisioner's ``networkEndpoints[]`` fan-out. Pods carry the GKE TPU
+nodeSelectors (``cloud.google.com/gke-tpu-accelerator`` +
+``gke-tpu-topology``; parity utils.py:96-102) and request ``google.com/tpu``
+chips per host, so GKE schedules all H pods onto one podslice nodepool.
+"""
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.kubernetes import k8s_api
+
+logger = sky_logging.init_logger(__name__)
+
+_CLUSTER_LABEL = 'skytpu-cluster'
+_NODE_LABEL = 'skytpu-node'
+_HOST_LABEL = 'skytpu-host'
+
+_DEFAULT_IMAGE = 'python:3.10-slim'
+
+_PHASE_MAP = {
+    'Pending': 'pending',
+    'Running': 'running',
+    'Succeeded': 'terminated',
+    'Failed': 'terminated',
+    'Unknown': 'pending',
+    'Terminating': 'terminating',
+}
+
+
+def _namespace(provider_config: Dict[str, Any]) -> str:
+    return provider_config.get('namespace') or 'default'
+
+
+def _client(provider_config: Dict[str, Any]):
+    return k8s_api.make_client(provider_config.get('context'))
+
+
+def _pod_name(cluster: str, node_idx: int, host_idx: int,
+              num_hosts: int) -> str:
+    if num_hosts == 1:
+        return f'{cluster}-{node_idx}'
+    return f'{cluster}-{node_idx}-{host_idx}'
+
+
+def _build_manifest(cluster: str, node_idx: int, host_idx: int,
+                    node_cfg: Dict[str, Any]) -> dict:
+    num_hosts = int(node_cfg.get('num_hosts', 1))
+    name = _pod_name(cluster, node_idx, host_idx, num_hosts)
+    image = node_cfg.get('image') or _DEFAULT_IMAGE
+    requests: Dict[str, str] = {}
+    if node_cfg.get('cpus'):
+        requests['cpu'] = str(node_cfg['cpus'])
+    if node_cfg.get('memory'):
+        requests['memory'] = f'{node_cfg["memory"]}Gi'
+    limits: Dict[str, str] = {}
+    selector: Dict[str, str] = dict(node_cfg.get('node_selector', {}))
+    if node_cfg.get('tpu_accelerator'):
+        selector[k8s_api.GKE_TPU_ACCELERATOR_LABEL] = (
+            node_cfg['tpu_accelerator'])
+        selector[k8s_api.GKE_TPU_TOPOLOGY_LABEL] = node_cfg['tpu_topology']
+        chips = str(node_cfg.get('chips_per_host', 0))
+        requests[k8s_api.TPU_RESOURCE_KEY] = chips
+        limits[k8s_api.TPU_RESOURCE_KEY] = chips
+    elif node_cfg.get('gpu'):
+        count = str(int(node_cfg.get('gpu_count', 1)))
+        requests[k8s_api.GPU_RESOURCE_KEY] = count
+        limits[k8s_api.GPU_RESOURCE_KEY] = count
+    container: Dict[str, Any] = {
+        'name': 'skytpu',
+        'image': image,
+        # The pod idles; the runtime (skylet) is started by the
+        # provision orchestrator over the exec transport.
+        'command': ['/bin/bash', '-c', 'sleep infinity'],
+        'resources': {'requests': requests, 'limits': limits},
+    }
+    manifest: Dict[str, Any] = {
+        'apiVersion': 'v1',
+        'kind': 'Pod',
+        'metadata': {
+            'name': name,
+            'labels': {
+                _CLUSTER_LABEL: cluster,
+                _NODE_LABEL: str(node_idx),
+                _HOST_LABEL: str(host_idx),
+            },
+        },
+        'spec': {
+            'restartPolicy': 'Never',
+            'containers': [container],
+        },
+    }
+    if selector:
+        manifest['spec']['nodeSelector'] = selector
+    return manifest
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    """Create the cluster's pods (idempotent per pod name)."""
+    client = _client(config.provider_config)
+    namespace = _namespace(config.provider_config)
+    node_cfg = config.node_config
+    num_hosts = int(node_cfg.get('num_hosts', 1))
+
+    existing = {
+        p['metadata']['name']
+        for p in client.list_pods(namespace,
+                                  f'{_CLUSTER_LABEL}={cluster_name_on_cloud}')
+    }
+    created: List[str] = []
+    head_id: Optional[str] = None
+    for i in range(config.count):
+        instance_id = f'{cluster_name_on_cloud}-{i}'
+        if i == 0:
+            head_id = instance_id
+        for h in range(num_hosts):
+            name = _pod_name(cluster_name_on_cloud, i, h, num_hosts)
+            if name in existing:
+                continue
+            manifest = _build_manifest(cluster_name_on_cloud, i, h, node_cfg)
+            logger.debug(f'Creating pod {namespace}/{name}')
+            client.create_pod(namespace, manifest)
+            created.append(name)
+    assert head_id is not None
+    return common.ProvisionRecord(provider_name='kubernetes',
+                                  region=region,
+                                  zone=None,
+                                  cluster_name=cluster_name_on_cloud,
+                                  head_instance_id=head_id,
+                                  resumed_instance_ids=[],
+                                  created_instance_ids=created)
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = 'running',
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    import time
+    assert provider_config is not None
+    client = _client(provider_config)
+    namespace = _namespace(provider_config)
+    deadline = time.time() + 600
+    while True:
+        pods = client.list_pods(namespace,
+                                f'{_CLUSTER_LABEL}={cluster_name_on_cloud}')
+        phases = [
+            _PHASE_MAP.get(p.get('status', {}).get('phase'), 'pending')
+            for p in pods
+        ]
+        if pods and all(s == state for s in phases):
+            return
+        for pod in pods:
+            status = pod.get('status', {})
+            # A Pending pod the scheduler has rejected is a capacity
+            # signal, not a transient: hand it to the failover engine
+            # (real clusters; the fake raises at create time).
+            for cond in status.get('conditions', []):
+                if (cond.get('type') == 'PodScheduled' and
+                        cond.get('status') == 'False' and
+                        cond.get('reason') == 'Unschedulable'):
+                    raise k8s_api.K8sCapacityError(
+                        f'Pod {pod["metadata"]["name"]} unschedulable: '
+                        f'{cond.get("message", "")}')
+            # Fail fast on terminally-dead pods instead of burning the
+            # whole deadline (OOMKill / container exit; restartPolicy is
+            # Never).
+            if status.get('phase') in ('Failed', 'Succeeded'):
+                raise common.ProvisionerError(
+                    f'Pod {pod["metadata"]["name"]} reached terminal phase '
+                    f'{status.get("phase")} during provisioning.')
+        if time.time() > deadline:
+            raise common.ProvisionerError(
+                f'Timed out waiting for pods of {cluster_name_on_cloud} to '
+                f'reach {state}; current: {phases}')
+        time.sleep(2)
+
+
+def get_cluster_info(
+        region: str,
+        cluster_name_on_cloud: str,
+        provider_config: Optional[Dict[str, Any]] = None
+) -> common.ClusterInfo:
+    assert provider_config is not None
+    client = _client(provider_config)
+    namespace = _namespace(provider_config)
+    pods = client.list_pods(namespace,
+                            f'{_CLUSTER_LABEL}={cluster_name_on_cloud}')
+
+    def _key(pod) -> tuple:
+        labels = pod['metadata'].get('labels', {})
+        return (int(labels.get(_NODE_LABEL, 0)),
+                int(labels.get(_HOST_LABEL, 0)))
+
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    custom: Dict[str, Any] = {}
+    for pod in sorted(pods, key=_key):
+        labels = pod['metadata'].get('labels', {})
+        node_idx = labels.get(_NODE_LABEL, '0')
+        instance_id = f'{cluster_name_on_cloud}-{node_idx}'
+        tags = {
+            'pod_name': pod['metadata']['name'],
+            'namespace': namespace,
+        }
+        if provider_config.get('context'):
+            tags['context'] = provider_config['context']
+        pod_dir = pod['metadata'].get('annotations',
+                                      {}).get('skytpu/pod-dir')
+        if pod_dir:
+            # Fake backend: the pod is a local directory — the runtime
+            # drives it through the local transport.
+            tags['node_dir'] = pod_dir
+        selector = pod.get('spec', {}).get('nodeSelector', {})
+        if k8s_api.GKE_TPU_ACCELERATOR_LABEL in selector and not custom:
+            custom = {
+                'accelerator_type':
+                    selector[k8s_api.GKE_TPU_ACCELERATOR_LABEL],
+                'topology': selector.get(k8s_api.GKE_TPU_TOPOLOGY_LABEL),
+            }
+        instances.setdefault(instance_id, []).append(
+            common.InstanceInfo(
+                instance_id=pod['metadata']['name'],
+                internal_ip=pod.get('status', {}).get('podIP', ''),
+                external_ip=None,
+                tags=tags,
+            ))
+    head_id = f'{cluster_name_on_cloud}-0' if instances else None
+    if head_id is not None and head_id not in instances:
+        head_id = sorted(instances)[0]
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head_id,
+        provider_name='kubernetes',
+        provider_config=provider_config,
+        custom_metadata=custom,
+    )
+
+
+def query_instances(
+        cluster_name_on_cloud: str,
+        provider_config: Optional[Dict[str, Any]] = None,
+        non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
+    assert provider_config is not None
+    client = _client(provider_config)
+    namespace = _namespace(provider_config)
+    # One status per LOGICAL node (slice), like the GCP provisioner: a
+    # multi-host slice is running iff every one of its pods is running.
+    per_node: Dict[str, List[str]] = {}
+    for pod in client.list_pods(namespace,
+                                f'{_CLUSTER_LABEL}={cluster_name_on_cloud}'):
+        status = _PHASE_MAP.get(pod.get('status', {}).get('phase'),
+                                'pending')
+        node_idx = pod['metadata'].get('labels', {}).get(_NODE_LABEL, '0')
+        per_node.setdefault(f'{cluster_name_on_cloud}-{node_idx}',
+                            []).append(status)
+    out: Dict[str, Optional[str]] = {}
+    for node_id, statuses in per_node.items():
+        if all(s == 'running' for s in statuses):
+            agg = 'running'
+        elif any(s == 'terminated' for s in statuses):
+            agg = 'terminated'
+        else:
+            agg = 'pending'
+        if non_terminated_only and agg == 'terminated':
+            continue
+        out[node_id] = agg
+    return out
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    raise common.ProvisionerError(
+        'Kubernetes pods cannot be stopped; only terminated '
+        '(parity: the reference marks STOP unsupported on Kubernetes).')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    assert provider_config is not None
+    client = _client(provider_config)
+    namespace = _namespace(provider_config)
+    for pod in client.list_pods(namespace,
+                                f'{_CLUSTER_LABEL}={cluster_name_on_cloud}'):
+        labels = pod['metadata'].get('labels', {})
+        if worker_only and labels.get(_NODE_LABEL) == '0':
+            continue
+        client.delete_pod(namespace, pod['metadata']['name'])
+
+
+def open_ports(cluster_name_on_cloud: str,
+               ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    # The real path would create a Service/Ingress per port (parity:
+    # sky/provision/kubernetes/network.py); in-cluster traffic needs none.
+    logger.debug(f'open_ports({cluster_name_on_cloud}, {ports})')
+
+
+def cleanup_ports(cluster_name_on_cloud: str,
+                  ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    logger.debug(f'cleanup_ports({cluster_name_on_cloud}, {ports})')

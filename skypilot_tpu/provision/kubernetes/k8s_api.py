@@ -1,0 +1,339 @@
+"""Kubernetes API client (pods + nodes) with a fake backend.
+
+Parity: the reference drives the ``kubernetes`` Python client from
+``sky/provision/kubernetes/utils.py`` / ``instance.py``. This build shells
+out to ``kubectl`` instead (the Python client is not a baked-in dependency)
+and keeps the same two-transport shape as ``provision/gcp/tpu_api.py``:
+
+* :class:`KubectlTransport` — real clusters via the ``kubectl`` binary
+  (``-o json`` everywhere).
+* :class:`FakeK8sService` — an in-memory cluster with GKE-style TPU
+  nodepools, used by tests and when ``SKYTPU_K8S_FAKE=1``. Fake pods are
+  backed by local directories so the full runtime (skylet, gang_run, jobs)
+  runs against them exactly like the local cloud. Fault injection: set
+  ``SKYTPU_K8S_FAKE_UNSCHEDULABLE=1`` to make every pod unschedulable —
+  exercising the failover engine.
+
+Cluster capacity discovery (node labels/allocatable) replaces a static
+service catalog: a Kubernetes "catalog" is whatever the nodes advertise
+(parity: sky/provision/kubernetes/utils.py node-label detectors).
+"""
+import json
+import os
+import shutil
+import subprocess
+import threading
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+# GKE TPU node labels (parity: sky/provision/kubernetes/utils.py:96-102).
+GKE_TPU_ACCELERATOR_LABEL = 'cloud.google.com/gke-tpu-accelerator'
+GKE_TPU_TOPOLOGY_LABEL = 'cloud.google.com/gke-tpu-topology'
+# Resource key Kubernetes uses to track Google TPU chips on nodes
+# (parity: utils.py TPU_RESOURCE_KEY).
+TPU_RESOURCE_KEY = 'google.com/tpu'
+GPU_RESOURCE_KEY = 'nvidia.com/gpu'
+
+_FAKE_STATE_ENV = 'SKYTPU_K8S_FAKE_STATE'  # json file for cross-process fakes
+_FAKE_ROOT = '~/.skytpu/k8s_fake'
+
+
+class K8sApiError(Exception):
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+class K8sCapacityError(K8sApiError):
+    """Pod cannot be scheduled (no node fits) — the failover engine treats
+    this like a zonal stockout and tries the next context."""
+
+
+class KubectlTransport:
+    """Real clusters through the ``kubectl`` binary."""
+
+    def __init__(self, context: Optional[str] = None):
+        self.context = context
+
+    def _base(self) -> List[str]:
+        argv = ['kubectl']
+        if self.context:
+            argv += ['--context', self.context]
+        return argv
+
+    def _run(self, args: List[str],
+             stdin: Optional[str] = None) -> str:
+        proc = subprocess.run(self._base() + args,
+                              input=stdin,
+                              capture_output=True,
+                              text=True,
+                              timeout=120,
+                              check=False)
+        if proc.returncode != 0:
+            msg = proc.stderr.strip() or proc.stdout.strip()
+            lowered = msg.lower()
+            if ('insufficient' in lowered or 'unschedulable' in lowered or
+                    'exceeded quota' in lowered):
+                raise K8sCapacityError(msg)
+            raise K8sApiError(f'kubectl {" ".join(args[:3])}: {msg}')
+        return proc.stdout
+
+    # ------------------------------------------------------------ surface
+
+    def list_nodes(self) -> List[dict]:
+        out = self._run(['get', 'nodes', '-o', 'json'])
+        return json.loads(out).get('items', [])
+
+    def create_pod(self, namespace: str, manifest: dict) -> dict:
+        self._run(['-n', namespace, 'create', '-f', '-'],
+                  stdin=json.dumps(manifest))
+        return self.get_pod(namespace, manifest['metadata']['name'])
+
+    def get_pod(self, namespace: str, name: str) -> dict:
+        out = self._run(['-n', namespace, 'get', 'pod', name, '-o', 'json'])
+        return json.loads(out)
+
+    def list_pods(self, namespace: str,
+                  label_selector: Optional[str] = None) -> List[dict]:
+        args = ['-n', namespace, 'get', 'pods', '-o', 'json']
+        if label_selector:
+            args += ['-l', label_selector]
+        return json.loads(self._run(args)).get('items', [])
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        try:
+            self._run(['-n', namespace, 'delete', 'pod', name,
+                       '--ignore-not-found', '--wait=false'])
+        except K8sApiError as e:
+            logger.debug(f'delete pod {name}: {e}')
+
+    def current_context(self) -> Optional[str]:
+        try:
+            return self._run(['config', 'current-context']).strip() or None
+        except (K8sApiError, FileNotFoundError):
+            return None
+
+
+# Default fake cluster: two CPU nodes plus a 4-host v5e-16 TPU podslice
+# nodepool (GKE labels as on real GKE TPU nodepools). Override with
+# SKYTPU_K8S_FAKE_NODES='{"name": {"labels": {...}, "allocatable": {...}}}'.
+_DEFAULT_FAKE_NODES: Dict[str, Dict[str, Any]] = {
+    **{
+        f'cpu-node-{i}': {
+            'labels': {},
+            'allocatable': {'cpu': 16, 'memory_gib': 64},
+        } for i in range(2)
+    },
+    **{
+        f'tpu-v5e-node-{i}': {
+            'labels': {
+                GKE_TPU_ACCELERATOR_LABEL: 'tpu-v5-lite-podslice',
+                GKE_TPU_TOPOLOGY_LABEL: '4x4',
+            },
+            'allocatable': {'cpu': 24, 'memory_gib': 48,
+                            TPU_RESOURCE_KEY: 4},
+        } for i in range(4)
+    },
+}
+
+
+class FakeK8sService:
+    """In-memory Kubernetes: nodes with GKE TPU labels, schedulable pods.
+
+    State optionally persisted to a JSON file (``SKYTPU_K8S_FAKE_STATE``)
+    so separate processes (CLI invocations in tests) see the same cluster.
+    Each Running pod is backed by a directory under ``~/.skytpu/k8s_fake``;
+    the provisioner exposes it as a local-transport host.
+    """
+
+    _lock = threading.Lock()
+    _pods: Dict[str, Dict[str, Any]] = {}
+
+    def __init__(self, context: Optional[str] = None):
+        self.context = context or 'fake-gke'
+        # File-backed by default: CLI/API-server requests run in separate
+        # processes and must see one consistent fake cluster.
+        self._state_path = os.environ.get(_FAKE_STATE_ENV) or os.path.join(
+            os.path.expanduser(_FAKE_ROOT), 'state.json')
+
+    # -------------------------------------------------------- persistence
+
+    def _load(self) -> Dict[str, Dict[str, Any]]:
+        if self._state_path and os.path.exists(self._state_path):
+            with open(self._state_path, encoding='utf-8') as f:
+                return json.load(f)
+        return FakeK8sService._pods
+
+    def _save(self, pods: Dict[str, Dict[str, Any]]) -> None:
+        if self._state_path:
+            os.makedirs(os.path.dirname(self._state_path), exist_ok=True)
+            with open(self._state_path, 'w', encoding='utf-8') as f:
+                json.dump(pods, f)
+        else:
+            FakeK8sService._pods = pods
+
+    def _nodes(self) -> Dict[str, Dict[str, Any]]:
+        override = os.environ.get('SKYTPU_K8S_FAKE_NODES')
+        if override:
+            return json.loads(override)
+        return _DEFAULT_FAKE_NODES
+
+    # ------------------------------------------------------------ surface
+
+    def list_nodes(self) -> List[dict]:
+        out = []
+        for name, spec in self._nodes().items():
+            alloc = dict(spec.get('allocatable', {}))
+            allocatable = {
+                'cpu': str(alloc.get('cpu', 0)),
+                'memory': f'{alloc.get("memory_gib", 0)}Gi',
+            }
+            if alloc.get(TPU_RESOURCE_KEY):
+                allocatable[TPU_RESOURCE_KEY] = str(alloc[TPU_RESOURCE_KEY])
+            if alloc.get(GPU_RESOURCE_KEY):
+                allocatable[GPU_RESOURCE_KEY] = str(alloc[GPU_RESOURCE_KEY])
+            out.append({
+                'metadata': {'name': name,
+                             'labels': dict(spec.get('labels', {}))},
+                'status': {'allocatable': allocatable},
+            })
+        return out
+
+    @staticmethod
+    def _qty(value) -> float:
+        """Parse a Kubernetes quantity ('500m', '16Gi', '4') to a float in
+        base units (cpu cores / GiB / count)."""
+        s = str(value)
+        if s.endswith('m'):
+            return float(s[:-1]) / 1000.0
+        for suffix, scale in (('Gi', 1.0), ('Mi', 1 / 1024), ('Ki', 2**-20)):
+            if s.endswith(suffix):
+                return float(s[:-len(suffix)]) * scale
+        return float(s)
+
+    def _fits(self, node: Dict[str, Any], selector: Dict[str, str],
+              requests: Dict[str, Any],
+              used: Dict[str, float]) -> bool:
+        labels = node.get('labels', {})
+        if any(labels.get(k) != v for k, v in selector.items()):
+            return False
+        alloc = node.get('allocatable', {})
+        cpu_free = self._qty(alloc.get('cpu', 0)) - used.get('cpu', 0.0)
+        if requests.get('cpu', 0.0) > cpu_free:
+            return False
+        tpu_free = self._qty(alloc.get(TPU_RESOURCE_KEY, 0)) - used.get(
+            TPU_RESOURCE_KEY, 0.0)
+        if requests.get(TPU_RESOURCE_KEY, 0.0) > tpu_free:
+            return False
+        return True
+
+    def _schedule(self, pods: Dict[str, Dict[str, Any]],
+                  manifest: dict) -> str:
+        """Pick a node for the pod; raise K8sCapacityError if none fits."""
+        if os.environ.get('SKYTPU_K8S_FAKE_UNSCHEDULABLE', '0') == '1':
+            raise K8sCapacityError(
+                '0/6 nodes are available: insufficient capacity '
+                '(fault injection).')
+        spec = manifest.get('spec', {})
+        selector = spec.get('nodeSelector', {})
+        containers = spec.get('containers', [])
+        requests: Dict[str, Any] = {}
+        for c in containers:
+            for k, v in c.get('resources', {}).get('requests', {}).items():
+                requests[k] = requests.get(k, 0) + self._qty(v)
+        # Current usage per node.
+        usage: Dict[str, Dict[str, float]] = {}
+        for pod in pods.values():
+            node = pod.get('spec', {}).get('nodeName')
+            if not node:
+                continue
+            per = usage.setdefault(node, {})
+            for c in pod.get('spec', {}).get('containers', []):
+                for k, v in c.get('resources', {}).get('requests',
+                                                       {}).items():
+                    per[k] = per.get(k, 0) + self._qty(v)
+        for name, node in self._nodes().items():
+            if self._fits(node, selector, requests, usage.get(name, {})):
+                return name
+        raise K8sCapacityError(
+            f'0/{len(self._nodes())} nodes are available: insufficient '
+            f'{TPU_RESOURCE_KEY if TPU_RESOURCE_KEY in requests else "cpu"} '
+            f'for selector {selector}.')
+
+    def create_pod(self, namespace: str, manifest: dict) -> dict:
+        with FakeK8sService._lock:
+            pods = self._load()
+            name = manifest['metadata']['name']
+            key = f'{namespace}/{name}'
+            if key in pods:
+                return pods[key]
+            node_name = self._schedule(pods, manifest)
+            pod_dir = os.path.join(os.path.expanduser(_FAKE_ROOT),
+                                   namespace, name)
+            os.makedirs(pod_dir, exist_ok=True)
+            pod = json.loads(json.dumps(manifest))  # deep copy
+            pod['spec']['nodeName'] = node_name
+            pod['status'] = {
+                'phase': 'Running',
+                'podIP': f'10.244.0.{len(pods) + 2}',
+            }
+            pod['metadata']['namespace'] = namespace
+            # Backing directory: the pod's filesystem for the runtime.
+            pod['metadata'].setdefault('annotations',
+                                       {})['skytpu/pod-dir'] = pod_dir
+            pods[key] = pod
+            self._save(pods)
+            return pod
+
+    def get_pod(self, namespace: str, name: str) -> dict:
+        pods = self._load()
+        key = f'{namespace}/{name}'
+        if key not in pods:
+            raise K8sApiError(f'pods "{name}" not found')
+        return pods[key]
+
+    def list_pods(self, namespace: str,
+                  label_selector: Optional[str] = None) -> List[dict]:
+        pods = self._load()
+        wanted: Dict[str, str] = {}
+        if label_selector:
+            for part in label_selector.split(','):
+                k, _, v = part.partition('=')
+                wanted[k] = v
+        out = []
+        for key, pod in pods.items():
+            if not key.startswith(f'{namespace}/'):
+                continue
+            labels = pod.get('metadata', {}).get('labels', {})
+            if all(labels.get(k) == v for k, v in wanted.items()):
+                out.append(pod)
+        return out
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with FakeK8sService._lock:
+            pods = self._load()
+            pod = pods.pop(f'{namespace}/{name}', None)
+            self._save(pods)
+        if pod is None:
+            return
+        pod_dir = pod.get('metadata', {}).get('annotations',
+                                              {}).get('skytpu/pod-dir')
+        if pod_dir and os.path.isdir(pod_dir):
+            # The pod's processes die with the pod, exactly like the local
+            # cloud's nodes (reuses the /proc environ sweep).
+            from skypilot_tpu.provision.local import instance as local_inst
+            local_inst._kill_node_processes(pod_dir)  # pylint: disable=protected-access
+            shutil.rmtree(pod_dir, ignore_errors=True)
+
+    def current_context(self) -> Optional[str]:
+        return self.context
+
+
+def make_client(context: Optional[str] = None):
+    if os.environ.get('SKYTPU_K8S_FAKE', '0') == '1':
+        return FakeK8sService(context)
+    return KubectlTransport(context)

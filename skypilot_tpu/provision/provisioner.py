@@ -49,6 +49,13 @@ def runners_from_host_meta(
             runners.append(
                 command_runner_lib.LocalProcessRunner(
                     node_id, host['node_dir']))
+        elif host['transport'] == 'kubernetes':
+            runners.append(
+                command_runner_lib.KubectlExecRunner(
+                    node_id,
+                    host['pod_name'],
+                    namespace=host.get('namespace', 'default'),
+                    context=host.get('context')))
         else:
             runners.append(
                 command_runner_lib.SSHCommandRunner(
@@ -78,7 +85,9 @@ def bulk_provision(provider_name: str, region: str,
             return record
         except Exception as e:  # pylint: disable=broad-except
             from skypilot_tpu.provision.gcp import tpu_api
-            if isinstance(e, tpu_api.GcpCapacityError):
+            from skypilot_tpu.provision.kubernetes import k8s_api
+            if isinstance(e, (tpu_api.GcpCapacityError,
+                              k8s_api.K8sCapacityError)):
                 raise  # capacity errors go straight to the failover engine
             last_exc = e
             logger.warning(f'Provision attempt {attempt + 1} failed: {e}')

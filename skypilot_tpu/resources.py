@@ -207,10 +207,10 @@ class Resources:
             accelerators = {topo.name: float(topo.num_chips)}
             if self._cloud is None:
                 self._cloud = CLOUD_REGISTRY.from_str('gcp')
-            elif self._cloud.name not in ('gcp',):
+            elif self._cloud.name not in ('gcp', 'kubernetes'):
                 raise exceptions.ResourcesMismatchError(
-                    f'TPU accelerators require GCP; got cloud='
-                    f'{self._cloud}.')
+                    f'TPU accelerators require GCP or Kubernetes (GKE); '
+                    f'got cloud={self._cloud}.')
             if self._accelerator_args is None:
                 self._accelerator_args = {}
             self._accelerator_args.setdefault('tpu_vm', True)

@@ -47,6 +47,10 @@ def _make_argv(host: dict, script_remote_path: str,
         exports2 = ' '.join(f'export {k}={shlex.quote(str(v))};'
                             for k, v in env_vars2.items())
         return ['/bin/bash', '-c', f'{exports2} bash {script_remote_path}']
+    if host['transport'] == 'kubernetes':
+        return _kubectl_base(host) + [
+            'exec', host['pod_name'], '--', '/bin/bash', '-c', bash_cmd
+        ]
     # SSH transport.
     argv = [
         'ssh', '-o', 'StrictHostKeyChecking=no', '-o',
@@ -56,6 +60,13 @@ def _make_argv(host: dict, script_remote_path: str,
         f'{host["ssh_user"]}@{host["ip"]}', bash_cmd
     ]
     return argv
+
+
+def _kubectl_base(host: dict) -> List[str]:
+    argv = ['kubectl']
+    if host.get('context'):
+        argv += ['--context', host['context']]
+    return argv + ['-n', host.get('namespace', 'default')]
 
 
 def _push_script(host: dict, script_path: str, remote_path: str) -> None:
@@ -69,6 +80,18 @@ def _push_script(host: dict, script_path: str, remote_path: str) -> None:
         with open(dst, 'w', encoding='utf-8') as dst_f:
             dst_f.write(content)
         host['_resolved_script'] = dst
+        return
+    if host['transport'] == 'kubernetes':
+        with open(script_path, 'rb') as f:
+            content_b = f.read()
+        proc = subprocess.run(
+            _kubectl_base(host) + [
+                'exec', '-i', host['pod_name'], '--', '/bin/bash', '-c',
+                f'cat > {shlex.quote(remote_path)}'
+            ],
+            input=content_b, capture_output=True, check=True)
+        del proc
+        host['_resolved_script'] = remote_path
         return
     subprocess.run([
         'scp', '-o', 'StrictHostKeyChecking=no', '-o',

@@ -154,6 +154,153 @@ class LocalProcessRunner(CommandRunner):
             shutil.copy2(src, dst)
 
 
+class KubectlExecRunner(CommandRunner):
+    """Runs commands in a pod via ``kubectl exec``; rsync = tar pipe.
+
+    Plays the role of the reference's Kubernetes SSH-jump-pod runner
+    (``sky/utils/command_runner.py`` KubernetesCommandRunner) without
+    requiring sshd in the task image.
+    """
+
+    def __init__(self,
+                 node_id: str,
+                 pod_name: str,
+                 namespace: str = 'default',
+                 context: Optional[str] = None):
+        super().__init__(node_id)
+        self.pod_name = pod_name
+        self.namespace = namespace
+        self.context = context
+
+    def _base(self) -> List[str]:
+        argv = ['kubectl']
+        if self.context:
+            argv += ['--context', self.context]
+        return argv + ['-n', self.namespace]
+
+    def run(self,
+            cmd,
+            *,
+            require_outputs: bool = False,
+            log_path: str = '/dev/null',
+            stream_logs: bool = False,
+            env_vars=None,
+            timeout: Optional[float] = None,
+            **kwargs):
+        full = self._make_cmd(cmd, env_vars)
+        argv = self._base() + [
+            'exec', self.pod_name, '--', '/bin/bash', '-c', full
+        ]
+        try:
+            proc = subprocess.run(argv,
+                                  capture_output=True,
+                                  text=True,
+                                  timeout=timeout,
+                                  check=False)
+        except subprocess.TimeoutExpired:
+            if require_outputs:
+                return 255, '', f'kubectl exec timeout after {timeout}s'
+            return 255
+        _tee(log_path, proc.stdout + proc.stderr, stream_logs)
+        if require_outputs:
+            return proc.returncode, proc.stdout, proc.stderr
+        return proc.returncode
+
+    @staticmethod
+    def _remote_expr(path: str) -> str:
+        """Shell expression for a pod path: quotes everything except a
+        leading ``~/``, which must expand to the pod's $HOME."""
+        if path == '~':
+            return '"$HOME"'
+        if path.startswith('~/'):
+            return '"$HOME"/' + shlex.quote(path[2:])
+        return shlex.quote(path)
+
+    def _exec_in(self, script: str, data: bytes):
+        return subprocess.run(
+            self._base() + [
+                'exec', '-i', self.pod_name, '--', '/bin/bash', '-c', script
+            ],
+            input=data,
+            capture_output=True,
+            check=False)
+
+    def rsync(self, source, target, *, up: bool, log_path='/dev/null'):
+        """rsync semantics over a tar pipe (no rsync/sshd in the pod image):
+
+        * file → exact target path (target ending in '/' = into that dir)
+        * dir with trailing '/' → contents into target
+        * dir without → nested as target/basename
+        """
+        if up:
+            source = os.path.expanduser(source)
+            src_is_dir = os.path.isdir(source.rstrip('/'))
+            if src_is_dir:
+                copy_contents = source.endswith('/')
+                src = source.rstrip('/')
+                if copy_contents:
+                    tar_args = ['-C', src, '.']
+                    dest = target.rstrip('/')
+                else:
+                    tar_args = ['-C', os.path.dirname(src) or '.',
+                                os.path.basename(src)]
+                    dest = target.rstrip('/')
+                pack = subprocess.run(
+                    ['tar', 'cf', '-', '--exclude', '.git',
+                     '--exclude', '__pycache__'] + tar_args,
+                    capture_output=True,
+                    check=False)
+                subprocess_utils.handle_returncode(
+                    pack.returncode, 'tar', f'Failed to pack {source}',
+                    pack.stderr.decode(errors='replace'))
+                dexpr = self._remote_expr(dest)
+                unpack = self._exec_in(
+                    f'mkdir -p {dexpr} && tar xf - -C {dexpr}', pack.stdout)
+            else:
+                if target.endswith('/'):
+                    dest = target + os.path.basename(source)
+                else:
+                    dest = target
+                dexpr = self._remote_expr(dest)
+                dir_expr = self._remote_expr(
+                    os.path.dirname(dest.rstrip('/')) or '.')
+                with open(source, 'rb') as f:
+                    data = f.read()
+                unpack = self._exec_in(
+                    f'mkdir -p {dir_expr} && cat > {dexpr}', data)
+            _tee(log_path, unpack.stderr.decode(errors='replace'), False)
+            subprocess_utils.handle_returncode(
+                unpack.returncode, 'kubectl exec',
+                f'Failed to push {source} -> {self.pod_name}:{target}',
+                unpack.stderr.decode(errors='replace'))
+        else:
+            copy_contents = source.endswith('/')
+            src = source.rstrip('/')
+            sexpr = self._remote_expr(src)
+            if copy_contents:
+                script = (f'if [ -d {sexpr} ]; then tar cf - -C {sexpr} .; '
+                          f'else tar cf - -C "$(dirname {sexpr})" '
+                          f'"$(basename {sexpr})"; fi')
+            else:
+                script = (f'tar cf - -C "$(dirname {sexpr})" '
+                          f'"$(basename {sexpr})"')
+            pack = self._exec_in(script, b'')
+            subprocess_utils.handle_returncode(
+                pack.returncode, 'kubectl exec tar',
+                f'Failed to pack {self.pod_name}:{source}',
+                pack.stderr.decode(errors='replace'))
+            target = os.path.expanduser(target)
+            os.makedirs(target.rstrip('/') or '/', exist_ok=True)
+            unpack = subprocess.run(['tar', 'xf', '-', '-C', target],
+                                    input=pack.stdout,
+                                    capture_output=True,
+                                    check=False)
+            subprocess_utils.handle_returncode(
+                unpack.returncode, 'tar',
+                f'Failed to unpack into {target}',
+                unpack.stderr.decode(errors='replace'))
+
+
 class SSHCommandRunner(CommandRunner):
     """SSH + rsync to one remote host (parity: command_runner.py:437)."""
 

@@ -179,6 +179,15 @@ _CONFIG_SCHEMA: Dict[str, Any] = {
                                           'items': {'type': 'string'}},
             },
         },
+        'kubernetes': {
+            'type': 'object',
+            'additionalProperties': True,
+            'properties': {
+                'namespace': {'type': 'string'},
+                'allowed_contexts': {'type': 'array',
+                                     'items': {'type': 'string'}},
+            },
+        },
         'allowed_clouds': {'type': 'array', 'items': {'type': 'string'}},
         'api_server': {'type': 'object', 'additionalProperties': True},
         'admin_policy': {'type': 'string'},

@@ -1,0 +1,208 @@
+"""Kubernetes (GKE TPU) cloud + provisioner against the fake cluster.
+
+Parity targets: ``sky/clouds/kubernetes.py`` (feasibility from
+cluster-advertised capacity) and ``sky/provision/kubernetes/instance.py``
+(pods as instances, GKE TPU podslice labels — utils.py:96-102).
+"""
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_state
+from skypilot_tpu.clouds import kubernetes as k8s_cloud
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.kubernetes import instance as k8s_instance
+from skypilot_tpu.provision.kubernetes import k8s_api
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+
+@pytest.fixture(autouse=True)
+def fake_k8s(monkeypatch):
+    monkeypatch.setenv('SKYTPU_K8S_FAKE', '1')
+    k8s_api.FakeK8sService._pods = {}  # pylint: disable=protected-access
+    yield
+    k8s_api.FakeK8sService._pods = {}  # pylint: disable=protected-access
+
+
+def _provider_config():
+    return {'context': 'fake-gke', 'namespace': 'default'}
+
+
+def _tpu_node_config():
+    return {
+        'tpu_accelerator': 'tpu-v5-lite-podslice',
+        'tpu_topology': '4x4',
+        'accelerator_type': 'v5e-16',
+        'num_hosts': 4,
+        'chips_per_host': 4,
+        'cpus': 4.0,
+        'memory': 16.0,
+        'image': None,
+    }
+
+
+def _config(count=1, node_config=None):
+    return provision_common.ProvisionConfig(
+        provider_config=_provider_config(),
+        authentication_config={},
+        docker_config={},
+        node_config=node_config or _tpu_node_config(),
+        count=count,
+        tags={},
+        resume_stopped_nodes=False,
+    )
+
+
+# ----------------------------------------------------------------- catalog
+
+
+def test_fake_nodes_advertise_gke_tpu_labels():
+    nodes = k8s_api.make_client('fake-gke').list_nodes()
+    tpu_nodes = [
+        n for n in nodes if k8s_api.GKE_TPU_ACCELERATOR_LABEL in
+        n['metadata']['labels']
+    ]
+    assert len(tpu_nodes) == 4
+    labels = tpu_nodes[0]['metadata']['labels']
+    assert labels[k8s_api.GKE_TPU_ACCELERATOR_LABEL] == \
+        'tpu-v5-lite-podslice'
+    assert labels[k8s_api.GKE_TPU_TOPOLOGY_LABEL] == '4x4'
+    assert all(n['status']['allocatable'][k8s_api.TPU_RESOURCE_KEY] == '4'
+               for n in tpu_nodes)
+
+
+def test_feasibility_matches_cluster_offerings():
+    cloud = CLOUD_REGISTRY.from_str('kubernetes')
+    # v5e-16 matches the fake nodepool (tpu-v5-lite-podslice / 4x4).
+    res = sky.Resources(cloud='kubernetes', accelerators='tpu-v5e:16')
+    feasible, _ = cloud.get_feasible_launchable_resources(res, 1)
+    assert len(feasible) == 1
+    assert feasible[0].accelerators == {'tpu-v5e': 16}
+
+    # v5p is not in the cluster: infeasible, with the offerings as hints.
+    res_v5p = sky.Resources(cloud='kubernetes', accelerators='tpu-v5p:8')
+    feasible, hints = cloud.get_feasible_launchable_resources(res_v5p, 1)
+    assert feasible == []
+    assert any('tpu-v5-lite-podslice' in h for h in hints)
+
+    # CPU-only request resolves to a cpuN-memM pod shape.
+    res_cpu = sky.Resources(cloud='kubernetes', cpus='8')
+    feasible, _ = cloud.get_feasible_launchable_resources(res_cpu, 1)
+    assert feasible[0].instance_type == 'cpu8-mem32'
+
+
+def test_gke_accelerator_mapping():
+    from skypilot_tpu import topology as topo_lib
+    topo = topo_lib.resolve_topology('tpu-v5e', 16, None)
+    assert k8s_cloud.gke_accelerator_for(topo) == 'tpu-v5-lite-podslice'
+    single = topo_lib.resolve_topology('tpu-v5e', 4, None)
+    assert k8s_cloud.gke_accelerator_for(single) == 'tpu-v5-lite-device'
+    v5p = topo_lib.resolve_topology('tpu-v5p', 8, None)
+    assert k8s_cloud.gke_accelerator_for(v5p) == 'tpu-v5p-slice'
+    v2 = topo_lib.resolve_topology('tpu-v2', 4, None)
+    assert k8s_cloud.gke_accelerator_for(v2) is None
+
+
+# --------------------------------------------------------------- lifecycle
+
+
+def test_tpu_podslice_provision_lifecycle():
+    """run → wait → cluster_info fan-out (one pod per TPU host) → down."""
+    record = k8s_instance.run_instances('fake-gke', 'tk8s', _config())
+    assert record.head_instance_id == 'tk8s-0'
+    assert len(record.created_instance_ids) == 4  # v5e-16 = 4 hosts
+
+    k8s_instance.wait_instances('fake-gke', 'tk8s',
+                                provider_config=_provider_config())
+    info = k8s_instance.get_cluster_info('fake-gke', 'tk8s',
+                                         _provider_config())
+    assert info.num_hosts() == 4
+    assert info.custom_metadata['accelerator_type'] == \
+        'tpu-v5-lite-podslice'
+    assert info.custom_metadata['topology'] == '4x4'
+    # Rank order: head instance first, hosts in skytpu-host order, and the
+    # fake pods are directory-backed (local transport).
+    meta = info.ordered_host_meta()
+    assert [h['rank'] for h in meta] == [0, 1, 2, 3]
+    assert all(h['transport'] == 'local' for h in meta)
+
+    statuses = k8s_instance.query_instances('tk8s', _provider_config())
+    assert set(statuses.values()) == {'running'}
+
+    # Pods request google.com/tpu chips and carry the GKE nodeSelectors.
+    client = k8s_api.make_client('fake-gke')
+    pod = client.get_pod('default', 'tk8s-0-0')
+    sel = pod['spec']['nodeSelector']
+    assert sel[k8s_api.GKE_TPU_ACCELERATOR_LABEL] == 'tpu-v5-lite-podslice'
+    assert sel[k8s_api.GKE_TPU_TOPOLOGY_LABEL] == '4x4'
+    limits = pod['spec']['containers'][0]['resources']['limits']
+    assert limits[k8s_api.TPU_RESOURCE_KEY] == '4'
+
+    k8s_instance.terminate_instances('tk8s', _provider_config())
+    assert k8s_instance.query_instances('tk8s', _provider_config()) == {}
+
+
+def test_stop_unsupported():
+    with pytest.raises(provision_common.ProvisionerError):
+        k8s_instance.stop_instances('any', _provider_config())
+
+
+def test_unschedulable_is_capacity_error(monkeypatch):
+    """No fitting node → K8sCapacityError → failover blocklists the
+    context (parity: zonal stockout classification)."""
+    monkeypatch.setenv('SKYTPU_K8S_FAKE_UNSCHEDULABLE', '1')
+    with pytest.raises(k8s_api.K8sCapacityError):
+        k8s_instance.run_instances('fake-gke', 'tcap', _config())
+    from skypilot_tpu.backends import gang_backend
+    handler = gang_backend.FailoverCloudErrorHandler
+    assert handler.classify(k8s_api.K8sCapacityError('insufficient')) == \
+        handler.ZONE
+
+
+def test_oversubscription_is_capacity_error():
+    """The fake schedules against allocatable google.com/tpu: a second
+    v5e-16 slice fits (4 nodes x 4 chips hold exactly one slice each), a
+    third does not."""
+    k8s_instance.run_instances('fake-gke', 'ta', _config())
+    with pytest.raises(k8s_api.K8sCapacityError):
+        k8s_instance.run_instances('fake-gke', 'tb', _config())
+
+
+# --------------------------------------------------------------------- e2e
+
+
+def test_launch_end_to_end_on_fake_k8s():
+    """`sky launch` on the fake Kubernetes cloud: full pipeline
+    (optimizer → provision → skylet → gang job) with directory-backed
+    pods."""
+    import time
+
+    from skypilot_tpu import core
+    from skypilot_tpu.skylet import job_lib
+    global_state.set_enabled_clouds(['Kubernetes'])
+    task = sky.Task(name='hello-k8s',
+                    run='echo "pod rank $SKYTPU_NODE_RANK ok"')
+    task.set_resources(sky.Resources(cloud='kubernetes'))
+    job_id, handle = sky.launch(task,
+                                cluster_name='t-k8s',
+                                detach_run=True,
+                                stream_logs=False)
+    assert handle is not None
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        st = core.job_status('t-k8s', job_id)
+        if st is not None and st.is_terminal():
+            break
+        time.sleep(0.5)
+    assert core.job_status('t-k8s', job_id) == job_lib.JobStatus.SUCCEEDED
+    records = sky.status()
+    assert records[0]['status'] == global_state.ClusterStatus.UP
+    sky.down('t-k8s')
+    assert sky.status() == []
+
+
+def test_kubectl_runner_remote_path_expansion():
+    """'~/' must expand to the pod's $HOME; everything else is quoted."""
+    from skypilot_tpu.utils.command_runner import KubectlExecRunner
+    assert KubectlExecRunner._remote_expr('~/x/y') == '"$HOME"/x/y'
+    assert KubectlExecRunner._remote_expr('~') == '"$HOME"'
+    assert KubectlExecRunner._remote_expr('/tmp/a b') == "'/tmp/a b'"
