@@ -56,6 +56,13 @@ def make_provision_config(
         'region': region_name,
         'availability_zone': zone_name,
     }
+    docker_config: Dict[str, Any] = {}
+    docker_image = resources.extract_docker_image()
+    if docker_image and cloud.name != 'kubernetes':
+        # Kubernetes runs the image natively as the pod image; everywhere
+        # else the provisioner bootstraps a task container on each host.
+        provider_config['docker_image'] = docker_image
+        docker_config['image'] = docker_image
     auth_config: Dict[str, Any] = {}
     if cloud.name == 'kubernetes':
         # region == kubeconfig context; namespace from config.
@@ -72,7 +79,7 @@ def make_provision_config(
     return provision_common.ProvisionConfig(
         provider_config=provider_config,
         authentication_config=auth_config,
-        docker_config={},
+        docker_config=docker_config,
         node_config=node_config,
         count=num_nodes,
         tags={},

@@ -13,6 +13,7 @@ the honest model for bring-your-own-cluster).
 import os
 import re
 import shutil
+import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from skypilot_tpu import topology as topo_lib
@@ -151,13 +152,24 @@ class Kubernetes(cloud.Cloud):
 
     # -------------------------------------------------------- feasibility
 
+    # context → (fetched_at, nodes). Each optimizer pass calls feasibility
+    # once per candidate; without a cache that is one kubectl subprocess
+    # per candidate per context.
+    _node_cache: Dict[str, Tuple[float, List[dict]]] = {}
+    _NODE_CACHE_TTL = 10.0
+
     @classmethod
     def _cluster_nodes(cls, context: str) -> List[dict]:
         from skypilot_tpu.provision.kubernetes import k8s_api
+        hit = cls._node_cache.get(context)
+        if hit is not None and time.time() - hit[0] < cls._NODE_CACHE_TTL:
+            return hit[1]
         try:
-            return k8s_api.make_client(context).list_nodes()
+            nodes = k8s_api.make_client(context).list_nodes()
         except Exception:  # pylint: disable=broad-except
-            return []
+            nodes = []
+        cls._node_cache[context] = (time.time(), nodes)
+        return nodes
 
     @classmethod
     def _tpu_offerings(cls, context: str) -> List[Tuple[str, str]]:
@@ -232,10 +244,7 @@ class Kubernetes(cloud.Cloud):
         del zones
         cpus, mem = self.get_vcpus_mem_from_instance_type(
             resources.instance_type or _DEFAULT_INSTANCE_TYPE)
-        image = None
-        if resources.image_id and str(resources.image_id).startswith(
-                'docker:'):
-            image = str(resources.image_id).split('docker:', 1)[1]
+        image = resources.extract_docker_image()
         vars_: Dict[str, object] = {
             'instance_type': resources.instance_type,
             'region': region.name,   # kubeconfig context

@@ -84,6 +84,10 @@ class ClusterInfo:
 
         cluster_info.json, also cached on ClusterHandle)."""
         hosts: List[Dict[str, Any]] = []
+        # Task container image (docker): stamped on every host so runners
+        # and gang_run wrap execution in `docker exec`. Kubernetes pods
+        # already run the image natively — no stamp there.
+        docker_image = self.provider_config.get('docker_image')
         for rank, info in enumerate(self.ordered_host_infos()):
             if 'node_dir' in info.tags:
                 # Directory-backed host: the local cloud's nodes and the
@@ -113,6 +117,8 @@ class ClusterInfo:
                     'ssh_user': self.ssh_user,
                     'ssh_key': self.ssh_private_key or '~/.skytpu/sky-key',
                 })
+            if docker_image and hosts[-1]['transport'] != 'kubernetes':
+                hosts[-1]['docker_image'] = docker_image
         return hosts
 
     def ip_tuples(self) -> List[tuple]:

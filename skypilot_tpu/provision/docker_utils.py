@@ -1,0 +1,62 @@
+"""Docker task containers on cluster hosts.
+
+Parity: ``sky/provision/docker_utils.py`` (DockerInitializer) — redesigned
+for TPU VMs: the container runs ``--privileged --net=host`` so libtpu sees
+the chips and ``jax.distributed`` rendezvous ports are unchanged, and the
+host home + /tmp are bind-mounted so the runtime state (``~/.skytpu``),
+synced packages, and pushed task scripts are shared between host and
+container. Commands are then wrapped with ``docker exec`` instead of the
+reference's sshd-in-container approach — no second SSH daemon to manage.
+"""
+import shlex
+
+from skypilot_tpu.utils import command_runner as command_runner_lib
+
+# Also hardcoded in skylet/gang_run.py:_docker_wrap — gang_run ships to
+# hosts as a self-contained module and cannot import this one.
+CONTAINER_NAME = 'skytpu-container'
+
+
+def bootstrap_command(image: str) -> str:
+    """Idempotent per-host container bootstrap, run on the RAW host.
+
+    One container per host (each cloud host is one VM). A leftover
+    container from an earlier launch is reused only if it runs the SAME
+    image; otherwise it is replaced — a stale-image container must never
+    serve a new launch.
+    """
+    img = shlex.quote(image)
+    run_flags = (f'-d --name {CONTAINER_NAME} --privileged --net=host '
+                 '-v "$HOME":"$HOME" -v /tmp:/tmp -e HOME="$HOME" '
+                 '-w "$HOME"')
+    return (
+        f'cur="$(docker inspect -f "{{{{.Config.Image}}}}" {CONTAINER_NAME} '
+        '2>/dev/null)"; '
+        f'if [ "$cur" = {img} ]; then '
+        f'docker start {CONTAINER_NAME} >/dev/null 2>&1 || true; '
+        f'else docker rm -f {CONTAINER_NAME} >/dev/null 2>&1 || true; '
+        f'docker run {run_flags} {img} sleep infinity; fi')
+
+
+class DockerRunner(command_runner_lib.CommandRunner):
+    """Wraps a host CommandRunner so run() executes inside the task
+    container; rsync stays on the host (home/tmp are bind-mounted)."""
+
+    def __init__(self, inner: command_runner_lib.CommandRunner):
+        super().__init__(inner.node_id)
+        self.inner = inner
+
+    def run(self, cmd, *, require_outputs=False, log_path='/dev/null',
+            stream_logs=False, env_vars=None, timeout=None, **kwargs):
+        full = self._make_cmd(cmd, env_vars)
+        wrapped = (f'docker exec {CONTAINER_NAME} /bin/bash -c '
+                   f'{shlex.quote(full)}')
+        return self.inner.run(wrapped,
+                              require_outputs=require_outputs,
+                              log_path=log_path,
+                              stream_logs=stream_logs,
+                              timeout=timeout,
+                              **kwargs)
+
+    def rsync(self, source, target, *, up: bool, log_path='/dev/null'):
+        return self.inner.rsync(source, target, up=up, log_path=log_path)

@@ -99,6 +99,13 @@ def _build_manifest(cluster: str, node_idx: int, host_idx: int,
     return manifest
 
 
+def _cleanup_cluster_pods(client, namespace: str,
+                          cluster_name_on_cloud: str) -> None:
+    for pod in client.list_pods(namespace,
+                                f'{_CLUSTER_LABEL}={cluster_name_on_cloud}'):
+        client.delete_pod(namespace, pod['metadata']['name'])
+
+
 def run_instances(region: str, cluster_name_on_cloud: str,
                   config: common.ProvisionConfig) -> common.ProvisionRecord:
     """Create the cluster's pods (idempotent per pod name)."""
@@ -114,18 +121,25 @@ def run_instances(region: str, cluster_name_on_cloud: str,
     }
     created: List[str] = []
     head_id: Optional[str] = None
-    for i in range(config.count):
-        instance_id = f'{cluster_name_on_cloud}-{i}'
-        if i == 0:
-            head_id = instance_id
-        for h in range(num_hosts):
-            name = _pod_name(cluster_name_on_cloud, i, h, num_hosts)
-            if name in existing:
-                continue
-            manifest = _build_manifest(cluster_name_on_cloud, i, h, node_cfg)
-            logger.debug(f'Creating pod {namespace}/{name}')
-            client.create_pod(namespace, manifest)
-            created.append(name)
+    try:
+        for i in range(config.count):
+            instance_id = f'{cluster_name_on_cloud}-{i}'
+            if i == 0:
+                head_id = instance_id
+            for h in range(num_hosts):
+                name = _pod_name(cluster_name_on_cloud, i, h, num_hosts)
+                if name in existing:
+                    continue
+                manifest = _build_manifest(cluster_name_on_cloud, i, h,
+                                           node_cfg)
+                logger.debug(f'Creating pod {namespace}/{name}')
+                client.create_pod(namespace, manifest)
+                created.append(name)
+    except k8s_api.K8sCapacityError:
+        # Failover moves to the next context; pods created here would
+        # otherwise squat on this cluster's capacity forever.
+        _cleanup_cluster_pods(client, namespace, cluster_name_on_cloud)
+        raise
     assert head_id is not None
     return common.ProvisionRecord(provider_name='kubernetes',
                                   region=region,
@@ -162,6 +176,10 @@ def wait_instances(region: str, cluster_name_on_cloud: str,
                 if (cond.get('type') == 'PodScheduled' and
                         cond.get('status') == 'False' and
                         cond.get('reason') == 'Unschedulable'):
+                    # Clean up before failing over: the Pending pods would
+                    # schedule later and squat on the nodepool.
+                    _cleanup_cluster_pods(client, namespace,
+                                          cluster_name_on_cloud)
                     raise k8s_api.K8sCapacityError(
                         f'Pod {pod["metadata"]["name"]} unschedulable: '
                         f'{cond.get("message", "")}')

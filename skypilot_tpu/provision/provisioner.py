@@ -33,15 +33,20 @@ class ProvisionResult:
 
 
 def make_runners(
-        cluster_info: common.ClusterInfo
+        cluster_info: common.ClusterInfo,
+        wrap_docker: bool = True
 ) -> List[command_runner_lib.CommandRunner]:
     """One CommandRunner per host, rank order (head's hosts first)."""
-    return runners_from_host_meta(cluster_info.ordered_host_meta())
+    return runners_from_host_meta(cluster_info.ordered_host_meta(),
+                                  wrap_docker=wrap_docker)
 
 
 def runners_from_host_meta(
-        hosts_meta: List[Dict[str, Any]]
+        hosts_meta: List[Dict[str, Any]],
+        wrap_docker: bool = True
 ) -> List[command_runner_lib.CommandRunner]:
+    """wrap_docker=False yields RAW host runners — needed before the task
+    container exists (connection probes, container bootstrap)."""
     runners: List[command_runner_lib.CommandRunner] = []
     for host in hosts_meta:
         node_id = f'rank-{host["rank"]}'
@@ -65,6 +70,9 @@ def runners_from_host_meta(
                     host['ssh_key'],
                     ssh_control_name=f'{host["ip"]}-{host["rank"]}',
                     port=host.get('ssh_port', 22)))
+        if wrap_docker and host.get('docker_image'):
+            from skypilot_tpu.provision import docker_utils
+            runners[-1] = docker_utils.DockerRunner(runners[-1])
     return runners
 
 
@@ -99,8 +107,10 @@ def bulk_provision(provider_name: str, region: str,
 @timeline.event
 def wait_for_ssh(cluster_info: common.ClusterInfo,
                  timeout: float = 600.0) -> None:
-    """Probe every host until reachable (parity: provisioner.py:353)."""
-    runners = make_runners(cluster_info)
+    """Probe every host until reachable (parity: provisioner.py:353).
+
+    Raw host runners: the task container (if any) does not exist yet."""
+    runners = make_runners(cluster_info, wrap_docker=False)
     deadline = time.time() + timeout
 
     def _wait(runner) -> None:
@@ -134,6 +144,25 @@ def post_provision_runtime_setup(
     skylet per host. Head = rank 0 = TPU worker 0.
     """
     hosts_meta = cluster_info.ordered_host_meta()
+
+    # Task container bootstrap (docker image): pull + start the idle
+    # container on every RAW host first; all later steps run inside it.
+    docker_image = provider_config.get('docker_image')
+    if docker_image:
+        from skypilot_tpu.provision import docker_utils
+        raw_runners = runners_from_host_meta(hosts_meta, wrap_docker=False)
+
+        def _bootstrap_one(runner) -> None:
+            rc, _, err = runner.run(
+                docker_utils.bootstrap_command(docker_image),
+                require_outputs=True,
+                timeout=600)
+            subprocess_utils.handle_returncode(
+                rc, 'docker bootstrap',
+                f'Failed to start task container on {runner.node_id}', err)
+
+        subprocess_utils.run_in_parallel(_bootstrap_one, raw_runners)
+
     runners = runners_from_host_meta(hosts_meta)
 
     info_payload = {
@@ -152,17 +181,20 @@ def post_provision_runtime_setup(
 
     def _setup_one(args) -> None:
         runner, host_meta = args
+        # Transport-level runner (rsync goes to the HOST filesystem; the
+        # container bind-mounts it).
+        base = command_runner_lib.base_runner(runner)
         # 1) sync the framework package → ~/.skytpu/runtime/skypilot_tpu
         runner.run('mkdir -p ~/.skytpu/runtime ~/sky_logs ~/.skytpu/jobs',
                    timeout=60)
-        if isinstance(runner, command_runner_lib.LocalProcessRunner):
-            runner.rsync(pkg_src + '/',
-                         '.skytpu/runtime/skypilot_tpu/',
-                         up=True)
+        if isinstance(base, command_runner_lib.LocalProcessRunner):
+            base.rsync(pkg_src + '/',
+                       '.skytpu/runtime/skypilot_tpu/',
+                       up=True)
         else:
-            runner.rsync(pkg_src,
-                         '~/.skytpu/runtime/',
-                         up=True)
+            base.rsync(pkg_src,
+                       '~/.skytpu/runtime/',
+                       up=True)
         # 2) cluster_info.json on each host
         payload = json.dumps(info_payload)
         runner.run(

@@ -305,6 +305,13 @@ class Resources:
     def image_id(self) -> Optional[str]:
         return self._image_id
 
+    def extract_docker_image(self) -> Optional[str]:
+        """The docker image when ``image_id: docker:<image>`` (parity:
+        sky/resources.py extract_docker_image)."""
+        if self._image_id and str(self._image_id).startswith('docker:'):
+            return str(self._image_id).split('docker:', 1)[1]
+        return None
+
     @property
     def autostop(self) -> Optional[Dict[str, Any]]:
         return self._autostop
@@ -345,7 +352,10 @@ class Resources:
         if self._ports:
             feats.add(cloud_lib.CloudImplementationFeatures.OPEN_PORTS)
         if self._image_id:
-            feats.add(cloud_lib.CloudImplementationFeatures.IMAGE_ID)
+            if self.extract_docker_image() is not None:
+                feats.add(cloud_lib.CloudImplementationFeatures.DOCKER_IMAGE)
+            else:
+                feats.add(cloud_lib.CloudImplementationFeatures.IMAGE_ID)
         if self._autostop is not None:
             if self._autostop.get('down'):
                 feats.add(cloud_lib.CloudImplementationFeatures.AUTODOWN)

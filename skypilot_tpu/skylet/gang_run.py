@@ -34,11 +34,22 @@ def load_cluster_info(path: Optional[str] = None) -> dict:
         return json.load(f)
 
 
+def _docker_wrap(host: dict, bash_cmd: str) -> str:
+    """Run the task inside the host's task container (docker image path);
+    the container bind-mounts $HOME and /tmp, so script paths hold.
+    Container name mirrors provision/docker_utils.CONTAINER_NAME (this
+    module is self-contained — it ships to hosts without the package)."""
+    if not host.get('docker_image'):
+        return bash_cmd
+    return ('docker exec skytpu-container /bin/bash -c '
+            f'{shlex.quote(bash_cmd)}')
+
+
 def _make_argv(host: dict, script_remote_path: str,
                env_vars: Dict[str, str]) -> List[str]:
     exports = ' '.join(f'export {k}={shlex.quote(str(v))};'
                        for k, v in env_vars.items())
-    bash_cmd = f'{exports} bash {script_remote_path}'
+    bash_cmd = _docker_wrap(host, f'{exports} bash {script_remote_path}')
     if host['transport'] == 'local':
         env_vars2 = dict(env_vars)
         env_vars2['SKYTPU_NODE_DIR'] = host['node_dir']
@@ -46,7 +57,8 @@ def _make_argv(host: dict, script_remote_path: str,
         env_vars2['HOME'] = host['node_dir']  # node dir acts as $HOME
         exports2 = ' '.join(f'export {k}={shlex.quote(str(v))};'
                             for k, v in env_vars2.items())
-        return ['/bin/bash', '-c', f'{exports2} bash {script_remote_path}']
+        return ['/bin/bash', '-c',
+                _docker_wrap(host, f'{exports2} bash {script_remote_path}')]
     if host['transport'] == 'kubernetes':
         return _kubectl_base(host) + [
             'exec', host['pod_name'], '--', '/bin/bash', '-c', bash_cmd
@@ -269,9 +281,11 @@ def _kill_stragglers(hosts, procs, rcs, marker: str, sig: int = 15) -> None:
             pass
         host = hosts[i]
         if host['transport'] != 'local':
-            # Also reap the remote process tree.
+            # Also reap the remote process tree (inside the task container
+            # when one is in play).
             subprocess.run(_make_argv(host, '/dev/null', {})[:-1] +
-                           [f'pkill -f {marker} || true'],
+                           [_docker_wrap(host,
+                                         f'pkill -f {marker} || true')],
                            capture_output=True,
                            check=False)
 

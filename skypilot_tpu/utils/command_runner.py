@@ -35,6 +35,13 @@ _DEFAULT_SSH_OPTS = [
 ]
 
 
+def base_runner(runner: 'CommandRunner') -> 'CommandRunner':
+    """Unwrap decorating runners (e.g. DockerRunner) to the transport-level
+    runner — rsync path conventions depend on the transport, not the
+    wrapper."""
+    return getattr(runner, 'inner', runner)
+
+
 class CommandRunner:
     """Abstract transport: run a command on / rsync files to one host."""
 

@@ -1,0 +1,109 @@
+"""Docker task containers (``image_id: docker:<image>``).
+
+Parity: ``sky/provision/docker_utils.py`` — here the container is
+``--privileged --net=host`` with $HOME//tmp bind-mounts and commands are
+wrapped in ``docker exec`` (no sshd-in-container). A stub ``docker`` binary
+stands in for the engine so the whole path runs hermetically.
+"""
+import os
+import stat
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_state
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.provision import docker_utils
+from skypilot_tpu.utils import command_runner as command_runner_lib
+
+_STUB = '''#!/usr/bin/env bash
+echo "$@" >> "${DOCKER_STUB_LOG:-/dev/null}"
+case "$1" in
+  exec)
+    shift
+    [ "$1" = "-i" ] && shift
+    shift  # container name
+    exec "$@"
+    ;;
+  *) exit 0 ;;
+esac
+'''
+
+
+@pytest.fixture
+def docker_stub(tmp_path, monkeypatch):
+    """A fake docker engine on PATH that executes `exec` payloads locally
+    and logs every invocation."""
+    bin_dir = tmp_path / 'stub-bin'
+    bin_dir.mkdir()
+    stub = bin_dir / 'docker'
+    stub.write_text(_STUB)
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    log = tmp_path / 'docker.log'
+    log.touch()
+    monkeypatch.setenv('PATH', f'{bin_dir}:{os.environ["PATH"]}')
+    monkeypatch.setenv('DOCKER_STUB_LOG', str(log))
+    return log
+
+
+def test_docker_image_requires_docker_feature():
+    res = sky.Resources(cloud='local', image_id='docker:python:3.11-slim')
+    assert res.extract_docker_image() == 'python:3.11-slim'
+    feats = res.get_required_cloud_features()
+    assert cloud_lib.CloudImplementationFeatures.DOCKER_IMAGE in feats
+    assert cloud_lib.CloudImplementationFeatures.IMAGE_ID not in feats
+
+
+def test_docker_runner_wraps_exec(tmp_path, docker_stub):
+    node = tmp_path / 'node'
+    inner = command_runner_lib.LocalProcessRunner('n0', str(node))
+    runner = docker_utils.DockerRunner(inner)
+    rc, out, _ = runner.run('echo in-container', require_outputs=True,
+                            timeout=30)
+    assert rc == 0
+    assert 'in-container' in out
+    log = docker_stub.read_text()
+    assert f'exec {docker_utils.CONTAINER_NAME}' in log
+    # rsync bypasses the container (bind-mounted home).
+    assert command_runner_lib.base_runner(runner) is inner
+
+
+def test_bootstrap_command_shape():
+    cmd = docker_utils.bootstrap_command('gcr.io/img:v1')
+    assert '--privileged' in cmd and '--net=host' in cmd
+    assert '-v "$HOME":"$HOME"' in cmd and '-v /tmp:/tmp' in cmd
+    assert 'gcr.io/img:v1' in cmd
+
+
+def test_launch_in_docker_end_to_end(docker_stub):
+    """Local-cloud launch with a docker image: container bootstraps on
+    every host and the gang task runs through `docker exec`."""
+    global_state.set_enabled_clouds(['Local'])
+    task = sky.Task(name='dock',
+                    run='echo "docker rank $SKYTPU_NODE_RANK ok"')
+    task.set_resources(
+        sky.Resources(cloud='local', image_id='docker:python:3.11-slim'))
+    job_id, handle = sky.launch(task,
+                                cluster_name='t-dock',
+                                detach_run=True,
+                                stream_logs=False)
+    assert handle is not None
+
+    from skypilot_tpu import core
+    from skypilot_tpu.skylet import job_lib
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        st = core.job_status('t-dock', job_id)
+        if st is not None and st.is_terminal():
+            break
+        time.sleep(0.5)
+    assert core.job_status('t-dock', job_id) == job_lib.JobStatus.SUCCEEDED
+
+    log = docker_stub.read_text()
+    # Bootstrap checked the existing container's image, created the
+    # container, and the task ran inside it.
+    assert 'inspect -f {{.Config.Image}} skytpu-container' in log
+    assert f'run -d --name {docker_utils.CONTAINER_NAME}' in log
+    assert f'exec {docker_utils.CONTAINER_NAME}' in log
+    sky.down('t-dock')
