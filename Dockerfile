@@ -1,0 +1,42 @@
+# API-server / client image for skypilot_tpu.
+#
+# Parity: the reference `Dockerfile` ships an image with the package, cloud
+# CLIs, and an entrypoint for the API server — redesigned slim: the TPU
+# compute stack (jax) runs on cluster hosts, not in this control-plane
+# image, so the image carries only the orchestrator and its tools.
+FROM python:3.11-slim
+
+RUN apt-get update -y && \
+    apt-get install --no-install-recommends -y \
+        git rsync openssh-client curl ca-certificates gnupg tini && \
+    # kubectl (Kubernetes / GKE TPU target)
+    ARCH=$(case "$(uname -m)" in \
+        x86_64) echo amd64 ;; aarch64) echo arm64 ;; *) uname -m ;; esac) && \
+    curl -fsSLo /usr/local/bin/kubectl \
+        "https://dl.k8s.io/release/v1.31.6/bin/linux/${ARCH}/kubectl" && \
+    chmod 0755 /usr/local/bin/kubectl && \
+    # gcloud CLI (GCP TPU provisioning + GCS storage)
+    curl -fsSL https://packages.cloud.google.com/apt/doc/apt-key.gpg \
+        | gpg --dearmor -o /usr/share/keyrings/cloud.google.gpg && \
+    echo "deb [signed-by=/usr/share/keyrings/cloud.google.gpg] \
+https://packages.cloud.google.com/apt cloud-sdk main" \
+        > /etc/apt/sources.list.d/google-cloud-sdk.list && \
+    apt-get update -y && \
+    apt-get install --no-install-recommends -y google-cloud-cli && \
+    rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY skypilot_tpu /app/skypilot_tpu
+RUN pip install --no-cache-dir aiohttp requests pyyaml jsonschema \
+    networkx pandas
+
+ENV PYTHONPATH=/app \
+    SKYTPU_API_SERVER_HOST=0.0.0.0 \
+    SKYTPU_API_SERVER_PORT=46590
+
+EXPOSE 46590
+
+# tini reaps request-runner children. Host/port come from the env vars
+# above so chart values can override them without replacing the command.
+ENTRYPOINT ["tini", "--"]
+CMD ["python", "-m", "skypilot_tpu.server.server"]

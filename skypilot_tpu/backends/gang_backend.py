@@ -446,11 +446,8 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
 
         def _sync(runner) -> None:
             runner.run('mkdir -p ~/sky_workdir', timeout=60)
-            base = command_runner_lib.base_runner(runner)
-            if isinstance(base, command_runner_lib.LocalProcessRunner):
-                base.rsync(src + '/', 'sky_workdir/', up=True)
-            else:
-                base.rsync(src + '/', '~/sky_workdir/', up=True)
+            command_runner_lib.rsync_home(runner, src + '/',
+                                          '~/sky_workdir/', up=True)
 
         subprocess_utils.run_in_parallel(_sync, runners)
         logger.info(f'Synced workdir {workdir!r} to '
@@ -472,10 +469,8 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                         f'mkdir -p $(dirname {d_expanded or d})',
                         timeout=60)
                     trailing = '/' if os.path.isdir(s) else ''
-                    base = command_runner_lib.base_runner(runner)
-                    base.rsync(s + trailing, d_expanded if isinstance(
-                        base, command_runner_lib.LocalProcessRunner)
-                        else d, up=True)
+                    command_runner_lib.rsync_home(runner, s + trailing, d,
+                                                  up=True)
 
                 subprocess_utils.run_in_parallel(_push, runners)
         if storage_mounts:
@@ -521,13 +516,8 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         def _setup_one(args) -> None:
             i, runner = args
             remote = f'/tmp/skytpu_setup_{handle.cluster_name}.sh'
-            base = command_runner_lib.base_runner(runner)
-            if isinstance(base, command_runner_lib.LocalProcessRunner):
-                remote_rel = remote.lstrip('/')
-                base.rsync(local_script, remote_rel, up=True)
-                remote = os.path.join(base.node_dir, remote_rel)
-            else:
-                base.rsync(local_script, remote, up=True)
+            remote = command_runner_lib.rsync_home(runner, local_script,
+                                                   remote, up=True)
             rc, out, err = runner.run(f'bash {remote}',
                                       require_outputs=True,
                                       timeout=3600)
@@ -582,16 +572,12 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                 f.write(task_script)
             with open(driver_path, 'w', encoding='utf-8') as f:
                 f.write(driver)
-            head_base = command_runner_lib.base_runner(head)
-            if isinstance(head_base, command_runner_lib.LocalProcessRunner):
-                rel = remote_job_dir.replace('~/', '')
-                head_base.rsync(task_path, f'{rel}/task.sh', up=True)
-                head_base.rsync(driver_path, f'{rel}/driver.sh', up=True)
-            else:
-                head_base.rsync(task_path, f'{remote_job_dir}/task.sh',
-                                up=True)
-                head_base.rsync(driver_path, f'{remote_job_dir}/driver.sh',
-                                up=True)
+            command_runner_lib.rsync_home(head, task_path,
+                                          f'{remote_job_dir}/task.sh',
+                                          up=True)
+            command_runner_lib.rsync_home(head, driver_path,
+                                          f'{remote_job_dir}/driver.sh',
+                                          up=True)
 
         # Register the job in the head's queue (codegen-over-SSH idiom).
         resources_str = f'{task.num_nodes}x {task.best_resources or ""}'
@@ -696,12 +682,8 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         remote = job['log_dir']
         target = os.path.join(os.path.expanduser(local_dir),
                               os.path.basename(remote.rstrip('/')))
-        head_base = command_runner_lib.base_runner(head)
-        if isinstance(head_base, command_runner_lib.LocalProcessRunner):
-            head_base.rsync(remote.replace('~/', '') + '/', target + '/',
-                            up=False)
-        else:
-            head_base.rsync(remote + '/', target + '/', up=False)
+        command_runner_lib.rsync_home(head, remote + '/', target + '/',
+                                      up=False)
         return target
 
     # ----------------------------------------------------------- autostop

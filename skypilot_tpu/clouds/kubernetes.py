@@ -167,7 +167,9 @@ class Kubernetes(cloud.Cloud):
         try:
             nodes = k8s_api.make_client(context).list_nodes()
         except Exception:  # pylint: disable=broad-except
-            nodes = []
+            # Transient API failure: serve the stale snapshot if we have
+            # one, and never negatively-cache an empty list.
+            return hit[1] if hit is not None else []
         cls._node_cache[context] = (time.time(), nodes)
         return nodes
 

@@ -181,20 +181,14 @@ def post_provision_runtime_setup(
 
     def _setup_one(args) -> None:
         runner, host_meta = args
-        # Transport-level runner (rsync goes to the HOST filesystem; the
-        # container bind-mounts it).
-        base = command_runner_lib.base_runner(runner)
         # 1) sync the framework package → ~/.skytpu/runtime/skypilot_tpu
+        # (rsync goes to the HOST filesystem; a task container bind-mounts
+        # it).
         runner.run('mkdir -p ~/.skytpu/runtime ~/sky_logs ~/.skytpu/jobs',
                    timeout=60)
-        if isinstance(base, command_runner_lib.LocalProcessRunner):
-            base.rsync(pkg_src + '/',
-                       '.skytpu/runtime/skypilot_tpu/',
-                       up=True)
-        else:
-            base.rsync(pkg_src,
-                       '~/.skytpu/runtime/',
-                       up=True)
+        command_runner_lib.rsync_home(runner, pkg_src + '/',
+                                      '~/.skytpu/runtime/skypilot_tpu/',
+                                      up=True)
         # 2) cluster_info.json on each host
         payload = json.dumps(info_payload)
         runner.run(

@@ -20,7 +20,10 @@ from skypilot_tpu.server import requests_db
 
 logger = sky_logging.init_logger(__name__)
 
-DEFAULT_PORT = 46590
+# Env overrides: containerized deployments (charts/skypilot-tpu) set
+# host/port via env rather than CLI flags.
+DEFAULT_PORT = int(os.environ.get('SKYTPU_API_SERVER_PORT', '46590'))
+DEFAULT_HOST = os.environ.get('SKYTPU_API_SERVER_HOST', '127.0.0.1')
 API_VERSION = '1'
 
 # Verb endpoints → request names (parity: the reference's per-verb routes).
@@ -185,7 +188,7 @@ def run(host: str = '127.0.0.1', port: int = DEFAULT_PORT) -> None:
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument('--host', default='127.0.0.1')
+    parser.add_argument('--host', default=DEFAULT_HOST)
     parser.add_argument('--port', type=int, default=DEFAULT_PORT)
     args = parser.parse_args()
     run(args.host, args.port)

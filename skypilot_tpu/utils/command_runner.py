@@ -42,6 +42,31 @@ def base_runner(runner: 'CommandRunner') -> 'CommandRunner':
     return getattr(runner, 'inner', runner)
 
 
+def rsync_home(runner: 'CommandRunner', source: str, target: str, *,
+               up: bool, log_path: str = '/dev/null') -> str:
+    """rsync where remote paths may be ``~/``-relative, across transports.
+
+    LocalProcessRunner "homes" are node directories, so ``~/`` (or a
+    leading ``/``) is rebased under the node dir; other transports pass
+    paths through. Returns the transport-resolved remote path (usable in
+    a subsequent ``runner.run``).
+    """
+    base = base_runner(runner)
+    remote = target if up else source
+    if isinstance(base, LocalProcessRunner):
+        rel = remote[2:] if remote.startswith('~/') else remote.lstrip('/')
+        if up:
+            base.rsync(source, rel, up=True, log_path=log_path)
+        else:
+            base.rsync(rel, target, up=False, log_path=log_path)
+        return os.path.join(base.node_dir, rel)
+    if up:
+        base.rsync(source, remote, up=True, log_path=log_path)
+    else:
+        base.rsync(remote, target, up=False, log_path=log_path)
+    return remote
+
+
 class CommandRunner:
     """Abstract transport: run a command on / rsync files to one host."""
 
