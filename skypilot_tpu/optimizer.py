@@ -14,7 +14,6 @@ redesign notes:
   general DAGs use exhaustive enumeration (the reference shells out to an ILP
   solver; candidate sets here are small enough to enumerate).
 """
-import collections
 import enum
 from typing import Dict, List, Optional, Tuple
 
@@ -155,59 +154,178 @@ class Optimizer:
                 max(topo.peak_bf16_tflops, 1e-9)
         return _DEFAULT_RUNTIME_SECONDS
 
-    # ------------------------------------------------------------------ DP
+    # -------------------------------------------------------------- egress
+
+    # Assumed inter-cloud transfer bandwidth for TIME-objective egress
+    # (parity: the reference's DEFAULT egress-time model).
+    _EGRESS_GBPS = 1.0
+    # Joint-enumeration budget for general DAGs (placements examined).
+    _ENUM_LIMIT = 50_000
+    # Per-task candidate cap inside the joint enumeration.
+    _ENUM_TOP_K = 6
 
     @staticmethod
-    def _egress_cost(src: Optional[resources_lib.Resources],
-                     dst: resources_lib.Resources,
-                     gigabytes: float = 0.0) -> float:
-        if src is None or gigabytes <= 0:
+    def _egress_penalty(src_cloud, dst_cloud, gigabytes: float,
+                        minimize: OptimizeTarget) -> float:
+        """$ (COST) or seconds (TIME) to move `gigabytes` between clouds."""
+        if (src_cloud is None or gigabytes <= 0 or
+                src_cloud.is_same_cloud(dst_cloud)):
             return 0.0
-        if src.cloud is not None and src.cloud.is_same_cloud(dst.cloud):
-            return 0.0
-        return src.cloud.get_egress_cost(gigabytes)
+        if minimize == OptimizeTarget.COST:
+            return src_cloud.get_egress_cost(gigabytes)
+        return gigabytes * 8.0 / Optimizer._EGRESS_GBPS
+
+    @staticmethod
+    def _node_objective(task: 'task_lib.Task',
+                        cand: resources_lib.Resources, cost: float,
+                        est_time: float,
+                        minimize: OptimizeTarget) -> float:
+        """Candidate objective incl. pulling the task's declared inputs
+        from their home cloud (parity: optimizer.py _egress_cost from
+        inputs)."""
+        obj = cost if minimize == OptimizeTarget.COST else est_time
+        inputs_cloud = task.get_inputs_cloud()
+        gb = task.estimated_inputs_size_gigabytes or 0.0
+        return obj + Optimizer._egress_penalty(inputs_cloud, cand.cloud, gb,
+                                               minimize)
+
+    @staticmethod
+    def _edge_penalty(parent: 'task_lib.Task',
+                      src: resources_lib.Resources,
+                      dst: resources_lib.Resources,
+                      minimize: OptimizeTarget) -> float:
+        """Penalty for shipping `parent`'s declared outputs to the child's
+        placement (parity: optimizer.py per-edge egress)."""
+        gb = parent.estimated_outputs_size_gigabytes or 0.0
+        return Optimizer._egress_penalty(src.cloud, dst.cloud, gb, minimize)
+
+    # ------------------------------------------------------------------ DP
 
     @staticmethod
     def _optimize_by_dp(
         dag: dag_lib.Dag, candidates, minimize: OptimizeTarget
     ) -> Dict['task_lib.Task', Tuple[resources_lib.Resources, float]]:
-        """DP over the task chain (parity: optimizer.py:410)."""
-        order = dag.get_sorted_tasks() if len(dag.tasks) > 1 else dag.tasks
-        # dp[cand] = (total objective, chosen resources chain)
-        prev_best: Dict[int, Tuple[float, list]] = {-1: (0.0, [])}
-        prev_cands: List[Optional[resources_lib.Resources]] = [None]
-        for task in order:
-            cur: Dict[int, Tuple[float, list]] = {}
-            for i, (cand, cost, est_time) in enumerate(candidates[task]):
-                obj = cost if minimize == OptimizeTarget.COST else est_time
-                best_val, best_chain = None, None
-                for j, (val, chain) in prev_best.items():
-                    src = prev_cands[j + 1] if j >= 0 else None
-                    total = val + obj + Optimizer._egress_cost(
-                        src, cand, gigabytes=0.0)
+        """Exact DP over a task chain with per-edge egress (parity:
+        optimizer.py:410 _optimize_by_dp)."""
+        order = dag.get_sorted_tasks() if len(dag.tasks) > 1 else \
+            list(dag.tasks)
+        if not order:
+            return {}
+        first = order[0]
+        # dp[i] = (best total objective ending with candidate i, plan)
+        dp: List[Tuple[float, list]] = [
+            (Optimizer._node_objective(first, cand, cost, est_time,
+                                       minimize), [(first, cand, cost)])
+            for cand, cost, est_time in candidates[first]
+        ]
+        for prev_task, task in zip(order, order[1:]):
+            prev_cands = candidates[prev_task]
+            new_dp: List[Tuple[float, list]] = []
+            for cand, cost, est_time in candidates[task]:
+                node = Optimizer._node_objective(task, cand, cost, est_time,
+                                                 minimize)
+                best_val, best_plan = None, None
+                for j, (val, plan) in enumerate(dp):
+                    total = val + node + Optimizer._edge_penalty(
+                        prev_task, prev_cands[j][0], cand, minimize)
                     if best_val is None or total < best_val:
                         best_val = total
-                        best_chain = chain + [(task, cand, cost)]
-                cur[i] = (best_val, best_chain)
-            prev_best = cur
-            prev_cands = [None] + [c for c, _, _ in candidates[task]]
-        _, chain = min(prev_best.values(), key=lambda v: v[0])
-        return {task: (cand, cost) for task, cand, cost in chain}
+                        best_plan = plan + [(task, cand, cost)]
+                new_dp.append((best_val, best_plan))
+            dp = new_dp
+        _, plan = min(dp, key=lambda v: v[0])
+        return {task: (cand, cost) for task, cand, cost in plan}
+
+    @staticmethod
+    def _topk_cloud_diverse(cands: List[Tuple], k: int) -> List[Tuple]:
+        """Top-k by rank, but guarantee the best candidate of EVERY cloud a
+        slot first — a flat prefix cut can fill all k slots with regional
+        duplicates of one cloud and blind the enumeration to cross-cloud
+        colocation (egress depends only on the cloud)."""
+        picked_idx: List[int] = []
+        seen_clouds = set()
+        for i, (cand, _, _) in enumerate(cands):
+            name = cand.cloud.name if cand.cloud else None
+            if name not in seen_clouds:
+                seen_clouds.add(name)
+                picked_idx.append(i)
+            if len(picked_idx) >= k:
+                break
+        for i in range(len(cands)):
+            if len(picked_idx) >= k:
+                break
+            if i not in picked_idx:
+                picked_idx.append(i)
+        return [cands[i] for i in sorted(picked_idx)]
 
     @staticmethod
     def _optimize_exhaustive(
         dag: dag_lib.Dag, candidates, minimize: OptimizeTarget
     ) -> Dict['task_lib.Task', Tuple[resources_lib.Resources, float]]:
-        """Pick each task's best independently (egress handled pairwise).
+        """General DAGs: joint enumeration over top-K candidates per task
+        when the placement space fits the budget, else topo-order greedy
+        that accounts egress from already-placed parents.
 
-        The reference solves general DAGs with ILP (optimizer.py:471); with
-        our small candidate sets a per-task greedy choice plus pairwise
-        egress is exact when egress is zero and near-exact otherwise.
+        The reference shells out to an ILP solver (optimizer.py:471);
+        bounded enumeration is exact on the same small DAGs and the greedy
+        fallback degrades gracefully on large ones.
         """
-        plan = {}
-        for task in dag.tasks:
-            cand, cost, _ = candidates[task][0]
-            plan[task] = (cand, cost)
+        import itertools
+        order = dag.get_sorted_tasks()
+        topk = {
+            task: Optimizer._topk_cloud_diverse(candidates[task],
+                                                Optimizer._ENUM_TOP_K)
+            for task in order
+        }
+        edges = [(u, v) for u, v in dag.graph.edges]
+        space = 1
+        for task in order:
+            space *= max(1, len(topk[task]))
+        if space <= Optimizer._ENUM_LIMIT:
+            # Precompute per-candidate node objectives: only K*N distinct
+            # values exist across the whole product.
+            node_obj = {
+                t: [
+                    Optimizer._node_objective(t, cand, cost, est_time,
+                                              minimize)
+                    for cand, cost, est_time in topk[t]
+                ] for t in order
+            }
+            best_val, best_choice = None, None
+            for choice in itertools.product(
+                    *(range(len(topk[t])) for t in order)):
+                idx = dict(zip(order, choice))
+                total = sum(node_obj[t][i] for t, i in idx.items())
+                total += sum(
+                    Optimizer._edge_penalty(u, topk[u][idx[u]][0],
+                                            topk[v][idx[v]][0], minimize)
+                    for u, v in edges)
+                if best_val is None or total < best_val:
+                    best_val, best_choice = total, dict(idx)
+            assert best_choice is not None
+            return {
+                t: (topk[t][i][0], topk[t][i][1])
+                for t, i in best_choice.items()
+            }
+        # Greedy fallback: place in topo order, charging egress from the
+        # parents placed so far.
+        logger.debug(f'DAG placement space {space} exceeds enumeration '
+                     'budget; using parent-aware greedy.')
+        plan: Dict['task_lib.Task',
+                   Tuple[resources_lib.Resources, float]] = {}
+        for task in order:
+            parents = list(dag.graph.predecessors(task))
+            best_val, best = None, None
+            for cand, cost, est_time in candidates[task]:
+                total = Optimizer._node_objective(task, cand, cost,
+                                                  est_time, minimize)
+                total += sum(
+                    Optimizer._edge_penalty(p, plan[p][0], cand, minimize)
+                    for p in parents if p in plan)
+                if best_val is None or total < best_val:
+                    best_val, best = total, (cand, cost)
+            assert best is not None
+            plan[task] = best
         return plan
 
     # ---------------------------------------------------------------- print

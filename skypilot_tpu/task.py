@@ -79,6 +79,14 @@ class Task:
         self.service: Optional[Any] = None  # SkyServiceSpec
         # Filled at execution: estimated best resources from the optimizer.
         self.best_resources: Optional[resources_lib.Resources] = None
+        # Declared data flow, used for egress-aware optimization (parity:
+        # sky/task.py set_inputs/set_outputs + estimated sizes).
+        self._inputs: Optional[str] = None
+        self._outputs: Optional[str] = None
+        self._estimated_inputs_size_gigabytes: Optional[float] = None
+        self._estimated_outputs_size_gigabytes: Optional[float] = None
+        # Declared runtime in seconds (parity: set_time_estimator).
+        self.estimated_runtime: Optional[float] = None
 
         self._validate()
 
@@ -205,6 +213,54 @@ class Task:
 
     # ----------------------------------------------------------- service
 
+    # -------------------------------------------------------- data flow
+
+    def set_inputs(self, inputs: str,
+                   estimated_size_gigabytes: float) -> 'Task':
+        """Parity: sky/task.py set_inputs."""
+        self._inputs = inputs
+        self._estimated_inputs_size_gigabytes = float(
+            estimated_size_gigabytes)
+        return self
+
+    def set_outputs(self, outputs: str,
+                    estimated_size_gigabytes: float) -> 'Task':
+        """Parity: sky/task.py set_outputs."""
+        self._outputs = outputs
+        self._estimated_outputs_size_gigabytes = float(
+            estimated_size_gigabytes)
+        return self
+
+    @property
+    def inputs(self) -> Optional[str]:
+        return self._inputs
+
+    @property
+    def outputs(self) -> Optional[str]:
+        return self._outputs
+
+    @property
+    def estimated_inputs_size_gigabytes(self) -> Optional[float]:
+        return self._estimated_inputs_size_gigabytes
+
+    @property
+    def estimated_outputs_size_gigabytes(self) -> Optional[float]:
+        return self._estimated_outputs_size_gigabytes
+
+    def get_inputs_cloud(self):
+        """Cloud the inputs live on, inferred from the path scheme
+        (parity: sky/task.py get_inputs_cloud)."""
+        from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+        if self._inputs is None:
+            return None
+        if self._inputs.startswith('gs://'):
+            return CLOUD_REGISTRY.from_str('gcp')
+        if self._inputs.startswith('s3://'):
+            return CLOUD_REGISTRY.from_str('aws')
+        # r2://: Cloudflare egress is free and R2 is not a compute cloud
+        # here — no egress attribution.
+        return None
+
     def set_service(self, service) -> 'Task':
         self.service = service
         return self
@@ -259,6 +315,24 @@ class Task:
             else:
                 task.set_resources(
                     resources_lib.Resources.from_yaml_config(res_config))
+        # inputs/outputs: {<path>: <estimated_size_gigabytes>} (parity:
+        # the reference task YAML data-flow declaration).
+        for key, setter in (('inputs', task.set_inputs),
+                            ('outputs', task.set_outputs)):
+            spec = config.get(key)
+            if spec:
+                if len(spec) != 1:
+                    raise exceptions.InvalidSkyError(
+                        f'{key}: exactly one path entry is supported, '
+                        f'got {len(spec)}: {sorted(spec)}')
+                path, size = next(iter(spec.items()))
+                if not isinstance(size, (int, float)):
+                    raise exceptions.InvalidSkyError(
+                        f'{key}: {path!r} needs a numeric estimated size '
+                        f'in gigabytes, got {size!r}')
+                setter(path, float(size))
+        if config.get('estimated_runtime') is not None:
+            task.estimated_runtime = float(config['estimated_runtime'])
         if config.get('service') is not None:
             try:
                 from skypilot_tpu.serve import service_spec
@@ -305,6 +379,13 @@ class Task:
         for dst, store in self.storage_mounts.items():
             mounts[dst] = store.to_yaml_config()
         add('file_mounts', mounts or None)
+        if self._inputs is not None:
+            add('inputs',
+                {self._inputs: self._estimated_inputs_size_gigabytes})
+        if self._outputs is not None:
+            add('outputs',
+                {self._outputs: self._estimated_outputs_size_gigabytes})
+        add('estimated_runtime', self.estimated_runtime)
         if self.service is not None:
             add('service', self.service.to_yaml_config())
         return config

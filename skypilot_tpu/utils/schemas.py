@@ -159,6 +159,7 @@ _TASK_SCHEMA: Dict[str, Any] = {
         },
         # Internal/bookkeeping keys accepted on round-trip.
         'inputs': {'type': ['object', 'null'], 'additionalProperties': True},
+        'estimated_runtime': {'type': ['number', 'null'], 'minimum': 0},
         'outputs': {'type': ['object', 'null'], 'additionalProperties': True},
     },
 }

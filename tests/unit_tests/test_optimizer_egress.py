@@ -1,0 +1,167 @@
+"""Egress-aware DAG optimization (parity: sky/optimizer.py:410 chain DP,
+:471 general-DAG solve with per-edge egress)."""
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+
+@pytest.fixture(autouse=True)
+def clouds_enabled():
+    global_state.set_enabled_clouds(['GCP', 'AWS'])
+    yield
+
+
+def _gcp():
+    return CLOUD_REGISTRY.from_str('gcp')
+
+
+def _aws():
+    return CLOUD_REGISTRY.from_str('aws')
+
+
+def test_egress_penalty_units():
+    opt = optimizer_lib.Optimizer
+    # Same cloud: free.
+    assert opt._egress_penalty(_gcp(), _gcp(), 100,
+                               optimizer_lib.OptimizeTarget.COST) == 0.0
+    # Cross-cloud COST: source cloud's egress tariff.
+    cost = opt._egress_penalty(_gcp(), _aws(), 100,
+                               optimizer_lib.OptimizeTarget.COST)
+    assert cost == pytest.approx(_gcp().get_egress_cost(100))
+    # Cross-cloud TIME: transfer seconds at the assumed bandwidth.
+    t = opt._egress_penalty(_gcp(), _aws(), 100,
+                            optimizer_lib.OptimizeTarget.TIME)
+    assert t == pytest.approx(100 * 8.0 / opt._EGRESS_GBPS)
+
+
+def test_chain_colocates_when_egress_dominates():
+    """Producer pinned to AWS with huge outputs; the consumer's cheapest
+    standalone candidate is on GCP — egress must pull it onto AWS."""
+    with sky.Dag() as dag:
+        producer = sky.Task(name='produce', run='echo p')
+        producer.set_resources(
+            sky.Resources(cloud='aws', instance_type='m6i.large'))
+        producer.set_outputs('s3://bucket/data', 5000)  # 5 TB
+        consumer = sky.Task(name='consume', run='echo c')
+        consumer.set_resources({
+            sky.Resources(cloud='aws', instance_type='m6i.xlarge'),
+            # Cheaper per hour than m6i.xlarge -> wins without egress.
+            sky.Resources(cloud='gcp', instance_type='n2-standard-2',
+                          region='us-central1'),
+        })
+    dag.add_edge(producer, consumer)
+    optimizer_lib.Optimizer.optimize(
+        dag, optimizer_lib.OptimizeTarget.COST, quiet=True)
+    assert consumer.best_resources.cloud.name == 'aws'
+
+
+def test_chain_ignores_small_egress():
+    """Tiny outputs: the standalone-cheapest candidate wins."""
+    with sky.Dag() as dag:
+        producer = sky.Task(name='produce', run='echo p')
+        producer.set_resources(
+            sky.Resources(cloud='aws', instance_type='m6i.large'))
+        producer.set_outputs('s3://bucket/data', 0.001)
+        consumer = sky.Task(name='consume', run='echo c')
+        consumer.set_resources({
+            sky.Resources(cloud='aws', instance_type='m6i.xlarge'),
+            sky.Resources(cloud='gcp', instance_type='n2-standard-2',
+                          region='us-central1'),
+        })
+    dag.add_edge(producer, consumer)
+    optimizer_lib.Optimizer.optimize(
+        dag, optimizer_lib.OptimizeTarget.COST, quiet=True)
+    assert consumer.best_resources.cloud.name == 'gcp'
+
+
+def test_inputs_cloud_pull():
+    """A task whose inputs live on GCS is pulled toward GCP when the
+    inputs are big."""
+    with sky.Dag() as dag:
+        t = sky.Task(name='train', run='echo t')
+        t.set_resources({
+            sky.Resources(cloud='aws', instance_type='m6i.large'),
+            sky.Resources(cloud='gcp', instance_type='n2-standard-4',
+                          region='us-central1'),
+        })
+        t.set_inputs('gs://datasets/imagenet', 2000)
+    optimizer_lib.Optimizer.optimize(
+        dag, optimizer_lib.OptimizeTarget.COST, quiet=True)
+    assert t.best_resources.cloud.name == 'gcp'
+
+
+def test_general_dag_joint_enumeration():
+    """Diamond DAG: two producers feed one consumer; the consumer must
+    land with the heavy producer."""
+    with sky.Dag() as dag:
+        heavy = sky.Task(name='heavy', run='echo h')
+        heavy.set_resources(
+            sky.Resources(cloud='aws', instance_type='m6i.large'))
+        heavy.set_outputs('s3://b/heavy', 5000)
+        light = sky.Task(name='light', run='echo l')
+        light.set_resources(
+            sky.Resources(cloud='gcp', instance_type='n2-standard-2',
+                          region='us-central1'))
+        light.set_outputs('gs://b/light', 0.01)
+        sink = sky.Task(name='sink', run='echo s')
+        sink.set_resources({
+            sky.Resources(cloud='aws', instance_type='m6i.xlarge'),
+            sky.Resources(cloud='gcp', instance_type='n2-standard-2',
+                          region='us-central1'),
+        })
+    dag.add_edge(heavy, sink)
+    dag.add_edge(light, sink)
+    optimizer_lib.Optimizer.optimize(
+        dag, optimizer_lib.OptimizeTarget.COST, quiet=True)
+    assert sink.best_resources.cloud.name == 'aws'
+
+
+def test_task_yaml_roundtrip_inputs_outputs():
+    t = sky.Task(name='io', run='echo x')
+    t.set_inputs('gs://in/data', 12.5)
+    t.set_outputs('gs://out/data', 3.0)
+    t.estimated_runtime = 7200.0
+    cfg = t.to_yaml_config()
+    t2 = sky.Task.from_yaml_config(cfg)
+    assert t2.inputs == 'gs://in/data'
+    assert t2.estimated_inputs_size_gigabytes == 12.5
+    assert t2.outputs == 'gs://out/data'
+    assert t2.estimated_outputs_size_gigabytes == 3.0
+    assert t2.estimated_runtime == 7200.0
+    assert t2.get_inputs_cloud().name == 'gcp'
+
+
+def test_topk_keeps_cloud_diversity():
+    """A flat prefix cut over many same-cloud regions must not evict the
+    only candidate of another cloud."""
+    opt = optimizer_lib.Optimizer
+    gcp, aws = _gcp(), _aws()
+
+    class _C:
+
+        def __init__(self, cloud):
+            self.cloud = cloud
+
+    cands = [(_C(gcp), i, 0.0) for i in range(10)] + [(_C(aws), 99, 0.0)]
+    top = opt._topk_cloud_diverse(cands, 6)
+    assert len(top) == 6
+    assert any(c.cloud.name == 'aws' for c, _, _ in top)
+
+
+def test_yaml_rejects_bad_inputs():
+    import pytest as _pytest
+    from skypilot_tpu import exceptions
+    with _pytest.raises(exceptions.InvalidSkyError):
+        sky.Task.from_yaml_config({'run': 'x', 'inputs': {'gs://a': None}})
+    with _pytest.raises(exceptions.InvalidSkyError):
+        sky.Task.from_yaml_config(
+            {'run': 'x', 'inputs': {'gs://a': 1, 'gs://b': 2}})
+
+
+def test_empty_dag_optimizes_to_empty_plan():
+    dag = sky.Dag()
+    optimizer_lib.Optimizer.optimize(
+        dag, optimizer_lib.OptimizeTarget.COST, quiet=True)
