@@ -458,7 +458,8 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         if all_file_mounts:
             runners = handle.get_command_runners()
             for dst, src in all_file_mounts.items():
-                if src.startswith(('gs://', 's3://', 'r2://')):
+                from skypilot_tpu.data import storage as storage_lib
+                if src.startswith(storage_lib.REMOTE_BUCKET_PREFIXES):
                     self._download_bucket_mount(runners, src, dst)
                     continue
                 src_path = os.path.expanduser(src)
@@ -482,11 +483,23 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             storage_mounting.mount_storage(handle, storage_mounts)
 
     def _download_bucket_mount(self, runners, src: str, dst: str) -> None:
+        from skypilot_tpu.data import mounting_utils
+        from skypilot_tpu.data import storage as storage_lib
+        from skypilot_tpu.data import storage_utils
         cmd = None
         if src.startswith('gs://'):
             cmd = f'mkdir -p {dst} && gsutil -m rsync -r {src} {dst}'
         elif src.startswith('s3://'):
             cmd = f'mkdir -p {dst} && aws s3 sync {src} {dst}'
+        elif src.startswith('r2://'):
+            _, bucket, key = storage_utils.split_bucket_uri(src)
+            cmd = mounting_utils.get_r2_copy_cmd(
+                bucket, key, dst, storage_lib.R2Store.endpoint_url())
+        elif src.startswith('azure://'):
+            _, container, key = storage_utils.split_bucket_uri(src)
+            cmd = mounting_utils.get_az_copy_cmd(
+                container, dst, storage_lib.AzureBlobStore.storage_account(),
+                key=key)
         if cmd is None:
             raise exceptions.NotSupportedError(
                 f'Unsupported bucket scheme for file mount: {src}')

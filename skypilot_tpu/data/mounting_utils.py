@@ -99,6 +99,92 @@ def get_s3_copy_cmd(bucket_name: str, key: str, dst: str) -> str:
             f'aws s3 sync {src} {shlex.quote(dst)}')
 
 
+_RCLONE_INSTALL = (
+    'which rclone >/dev/null 2>&1 || '
+    '(curl -fsSL https://rclone.org/install.sh | sudo bash) || true')
+
+
+def get_r2_mount_cmd(bucket_name: str, mount_path: str,
+                     endpoint_url: str) -> str:
+    """rclone mount against the R2 S3 endpoint (parity:
+    sky/data/mounting_utils.py get_r2_mount_cmd — rclone with the
+    ``r2`` profile credentials)."""
+    b, m = shlex.quote(bucket_name), shlex.quote(mount_path)
+    ep = shlex.quote(endpoint_url)
+    return (f'rclone config create r2 s3 provider Cloudflare env_auth true '
+            f'endpoint {ep} >/dev/null 2>&1 || true; '
+            f'AWS_SHARED_CREDENTIALS_FILE=~/.cloudflare/r2.credentials '
+            f'AWS_PROFILE=r2 '
+            f'rclone mount r2:{b} {m} --daemon --vfs-cache-mode writes')
+
+
+def get_r2_mount_script(bucket_name: str, mount_path: str,
+                        endpoint_url: str) -> str:
+    return get_mounting_script(mount_path,
+                               get_r2_mount_cmd(bucket_name, mount_path,
+                                                endpoint_url),
+                               install_cmd=_RCLONE_INSTALL)
+
+
+def get_r2_copy_cmd(bucket_name: str, key: str, dst: str,
+                    endpoint_url: str) -> str:
+    src = f's3://{bucket_name}/{key}'.rstrip('/')
+    return (f'mkdir -p {shlex.quote(dst)} && '
+            f'AWS_SHARED_CREDENTIALS_FILE=~/.cloudflare/r2.credentials '
+            f'aws s3 sync {src} {shlex.quote(dst)} '
+            f'--endpoint-url {shlex.quote(endpoint_url)} --profile r2')
+
+
+BLOBFUSE2_VERSION = '2.3.2'
+
+_BLOBFUSE2_INSTALL = (
+    'which blobfuse2 >/dev/null 2>&1 || ('
+    'curl -fsSL -o /tmp/blobfuse2.deb '
+    'https://github.com/Azure/azure-storage-fuse/releases/download/'
+    f'blobfuse2-{BLOBFUSE2_VERSION}/blobfuse2-{BLOBFUSE2_VERSION}'
+    '-Debian-11.0.x86_64.deb && sudo dpkg -i /tmp/blobfuse2.deb) || true')
+
+
+def get_az_mount_cmd(container_name: str, mount_path: str,
+                     storage_account: str) -> str:
+    """blobfuse2 mount (parity: sky/data/mounting_utils.py
+    get_az_mount_cmd)."""
+    c, m = shlex.quote(container_name), shlex.quote(mount_path)
+    acct = shlex.quote(storage_account)
+    return (f'AZURE_STORAGE_ACCOUNT={acct} '
+            f'blobfuse2 {m} --container-name {c} '
+            f'--use-adls false --tmp-path /tmp/.blobfuse2-{container_name}')
+
+
+def get_az_mount_script(container_name: str, mount_path: str,
+                        storage_account: str) -> str:
+    return get_mounting_script(mount_path,
+                               get_az_mount_cmd(container_name, mount_path,
+                                                storage_account),
+                               install_cmd=_BLOBFUSE2_INSTALL)
+
+
+def get_az_copy_cmd(container_name: str, dst: str, storage_account: str,
+                    key: str = '') -> str:
+    """COPY a container (or a key prefix of it) into dst. download-batch
+    preserves container-relative paths, so a key prefix is downloaded with
+    --pattern and then hoisted so files land directly under dst (matching
+    the gs/s3/r2 copy semantics)."""
+    c, d = shlex.quote(container_name), shlex.quote(dst)
+    acct = shlex.quote(storage_account)
+    key = key.strip('/')
+    cmd = f'mkdir -p {d} && az storage blob download-batch -d {d} -s {c}'
+    if key:
+        cmd += f' --pattern {shlex.quote(key + "/*")}'
+    cmd += f' --account-name {acct}'
+    if key:
+        top = shlex.quote(key.split('/')[0])
+        kq = shlex.quote(key)
+        cmd += (f' && if [ -d {d}/{kq} ]; then '
+                f'cp -a {d}/{kq}/. {d}/ && rm -rf {d}/{top}; fi')
+    return cmd
+
+
 def get_local_mount_script(bucket_dir: str, mount_path: str) -> str:
     """Local store "mount": a symlink into the bucket directory.
 

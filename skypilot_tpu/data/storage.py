@@ -38,14 +38,21 @@ class StoreType(enum.Enum):
     """Bucket backends. Parity: sky/data/storage.py StoreType."""
     GCS = 'GCS'
     S3 = 'S3'
+    R2 = 'R2'
+    AZURE = 'AZURE'
     LOCAL = 'LOCAL'
 
     @classmethod
     def from_store(cls, store: 'AbstractStore') -> 'StoreType':
+        # R2Store subclasses S3Store: check the subclass first.
+        if isinstance(store, R2Store):
+            return cls.R2
         if isinstance(store, GcsStore):
             return cls.GCS
         if isinstance(store, S3Store):
             return cls.S3
+        if isinstance(store, AzureBlobStore):
+            return cls.AZURE
         if isinstance(store, LocalStore):
             return cls.LOCAL
         raise ValueError(f'Unknown store type: {store}')
@@ -209,7 +216,7 @@ class S3Store(AbstractStore):
                 'or GCS store, or install awscli.')
         if not self.exists():
             self._aws('s3', 'mb', f's3://{self.name}')
-            logger.info(f'Created S3 bucket s3://{self.name}')
+            logger.info(f'Created bucket {self.get_uri()}')
 
     def upload(self) -> None:
         if self.source is None:
@@ -244,6 +251,141 @@ class S3Store(AbstractStore):
 
     def get_uri(self) -> str:
         return f's3://{self.name}'
+
+
+class R2Store(S3Store):
+    """Cloudflare R2 bucket: the S3 surface against the R2 endpoint.
+
+    Parity: sky/data/storage.py R2Store:3752 — same aws-CLI control path as
+    S3 with ``--endpoint-url https://<account>.r2.cloudflarestorage.com``
+    and the ``r2`` credentials profile (``~/.cloudflare/r2.credentials``).
+    R2 egress is free, which is why the optimizer attributes no egress
+    cost to r2:// inputs.
+    """
+
+    R2_CREDENTIALS_PATH = '~/.cloudflare/r2.credentials'
+    R2_PROFILE = 'r2'
+
+    @staticmethod
+    def endpoint_url() -> str:
+        from skypilot_tpu import skypilot_config
+        account = skypilot_config.get_nested(
+            ('r2', 'account_id'), None) or os.environ.get('R2_ACCOUNT_ID')
+        if not account:
+            raise exceptions.StorageError(
+                'Cloudflare R2 needs an account id: set r2.account_id in '
+                '~/.skytpu/config.yaml or $R2_ACCOUNT_ID.')
+        return f'https://{account}.r2.cloudflarestorage.com'
+
+    def _aws(self, *args: str,
+             check: bool = True) -> 'subprocess.CompletedProcess':
+        argv = ['aws'] + list(args) + [
+            '--endpoint-url', self.endpoint_url(),
+            '--profile', self.R2_PROFILE,
+        ]
+        env = dict(os.environ)
+        creds = os.path.expanduser(self.R2_CREDENTIALS_PATH)
+        if os.path.exists(creds):
+            env['AWS_SHARED_CREDENTIALS_FILE'] = creds
+        proc = subprocess.run(argv,
+                              capture_output=True,
+                              text=True,
+                              env=env,
+                              check=False)
+        if check and proc.returncode != 0:
+            raise exceptions.StorageError(
+                f'aws (r2) {" ".join(args)} failed: {proc.stderr}')
+        return proc
+
+    def mount_command(self, mount_path: str) -> str:
+        return mounting_utils.get_r2_mount_script(self.name, mount_path,
+                                                  self.endpoint_url())
+
+    def copy_command(self, dst: str) -> str:
+        return mounting_utils.get_r2_copy_cmd(self.name, '', dst,
+                                              self.endpoint_url())
+
+    def get_uri(self) -> str:
+        return f'r2://{self.name}'
+
+
+class AzureBlobStore(AbstractStore):
+    """Azure Blob container driven via the az CLI.
+
+    Parity: sky/data/storage.py AzureBlobStore:2413 — container-level
+    lifecycle against a configured storage account; blobfuse2 does MOUNT
+    duty on the hosts.
+    """
+
+    @staticmethod
+    def storage_account() -> str:
+        from skypilot_tpu import skypilot_config
+        account = skypilot_config.get_nested(
+            ('azure', 'storage_account'),
+            None) or os.environ.get('AZURE_STORAGE_ACCOUNT')
+        if not account:
+            raise exceptions.StorageError(
+                'Azure Blob storage needs a storage account: set '
+                'azure.storage_account in ~/.skytpu/config.yaml or '
+                '$AZURE_STORAGE_ACCOUNT.')
+        return account
+
+    def _az(self, *args: str,
+            check: bool = True) -> 'subprocess.CompletedProcess':
+        proc = subprocess.run(
+            ['az', 'storage'] + list(args) +
+            ['--account-name', self.storage_account()],
+            capture_output=True,
+            text=True,
+            check=False)
+        if check and proc.returncode != 0:
+            raise exceptions.StorageError(
+                f'az storage {" ".join(args)} failed: {proc.stderr}')
+        return proc
+
+    def exists(self) -> bool:
+        proc = self._az('container', 'exists', '--name', self.name,
+                        '-o', 'tsv', '--query', 'exists', check=False)
+        # az's tsv formatter prints Python-style 'True'/'False'.
+        return proc.returncode == 0 and \
+            proc.stdout.strip().lower() == 'true'
+
+    def initialize(self) -> None:
+        if shutil.which('az') is None:
+            raise exceptions.StorageError(
+                'az CLI not found; Azure Blob storage requires it. Use a '
+                'LOCAL or GCS store, or install azure-cli.')
+        if not self.exists():
+            self._az('container', 'create', '--name', self.name)
+            logger.info(f'Created Azure container {self.name}')
+
+    def upload(self) -> None:
+        if self.source is None:
+            return
+        src = os.path.expanduser(self.source)
+        if os.path.isfile(src):
+            self._az('blob', 'upload', '--container-name', self.name,
+                     '--file', src, '--name', os.path.basename(src),
+                     '--overwrite')
+            return
+        self._az('blob', 'upload-batch', '-d', self.name, '-s', src,
+                 '--overwrite')
+
+    def delete(self) -> None:
+        if self.exists():
+            self._az('container', 'delete', '--name', self.name,
+                     check=False)
+
+    def mount_command(self, mount_path: str) -> str:
+        return mounting_utils.get_az_mount_script(self.name, mount_path,
+                                                  self.storage_account())
+
+    def copy_command(self, dst: str) -> str:
+        return mounting_utils.get_az_copy_cmd(self.name, dst,
+                                              self.storage_account())
+
+    def get_uri(self) -> str:
+        return f'azure://{self.name}'
 
 
 class LocalStore(AbstractStore):
@@ -296,8 +438,29 @@ class LocalStore(AbstractStore):
 _STORE_CLASSES = {
     StoreType.GCS: GcsStore,
     StoreType.S3: S3Store,
+    StoreType.R2: R2Store,
+    StoreType.AZURE: AzureBlobStore,
     StoreType.LOCAL: LocalStore,
 }
+
+# Single source of truth for bucket URI schemes; everything else
+# (prefix tuples, default-store inference, backend mount dispatch)
+# derives from this table — add a backend in ONE place.
+SCHEME_TO_STORE: Dict[str, StoreType] = {
+    'gs': StoreType.GCS,
+    's3': StoreType.S3,
+    'r2': StoreType.R2,
+    'azure': StoreType.AZURE,
+    'local': StoreType.LOCAL,
+}
+
+# URI prefixes that name a bucket directly (scheme '://' bucket).
+_BUCKET_URI_PREFIXES = tuple(f'{s}://' for s in SCHEME_TO_STORE)
+
+# Prefixes a cluster host can fetch with cloud CLIs (everything but the
+# client-machine-local scheme).
+REMOTE_BUCKET_PREFIXES = tuple(p for p in _BUCKET_URI_PREFIXES
+                               if p != 'local://')
 
 
 class Storage:
@@ -323,7 +486,7 @@ class Storage:
             raise exceptions.StorageSpecError(
                 'Storage requires a name and/or source.')
         if source is not None and source.startswith(
-                ('gs://', 's3://', 'local://')):
+                _BUCKET_URI_PREFIXES):
             # The URI already names the bucket; a different `name` would
             # silently create a second, empty bucket (parity: the
             # reference rejects name+URI-source combos).
@@ -340,7 +503,7 @@ class Storage:
                 os.path.expanduser(source))).lower().replace('_', '-')
         _validate_name(name)
         if source is not None and not source.startswith(
-            ('gs://', 's3://', 'local://')):
+                _BUCKET_URI_PREFIXES):
             expanded = os.path.expanduser(source)
             if not os.path.exists(expanded):
                 raise exceptions.StorageSourceError(
@@ -384,12 +547,10 @@ class Storage:
             self.add_store(st)
 
     def _default_store(self) -> StoreType:
-        if self.source is not None and self.source.startswith('gs://'):
-            return StoreType.GCS
-        if self.source is not None and self.source.startswith('s3://'):
-            return StoreType.S3
-        if self.source is not None and self.source.startswith('local://'):
-            return StoreType.LOCAL
+        if self.source is not None and '://' in self.source:
+            scheme = self.source.split('://', 1)[0]
+            if scheme in SCHEME_TO_STORE:
+                return SCHEME_TO_STORE[scheme]
         enabled = global_state.get_enabled_clouds()
         if enabled and all(c.lower() == 'local' for c in enabled):
             return StoreType.LOCAL

@@ -291,21 +291,28 @@ class Optimizer:
                     for cand, cost, est_time in topk[t]
                 ] for t in order
             }
+            # Per-edge K×K penalty matrices: each edge has at most K²
+            # distinct values across the whole product.
+            pos = {t: i for i, t in enumerate(order)}
+            edge_mat = [
+                (pos[u], pos[v], [[
+                    Optimizer._edge_penalty(u, cu[0], cv[0], minimize)
+                    for cv in topk[v]
+                ] for cu in topk[u]]) for u, v in edges
+            ]
+            node_rows = [node_obj[t] for t in order]
             best_val, best_choice = None, None
             for choice in itertools.product(
                     *(range(len(topk[t])) for t in order)):
-                idx = dict(zip(order, choice))
-                total = sum(node_obj[t][i] for t, i in idx.items())
-                total += sum(
-                    Optimizer._edge_penalty(u, topk[u][idx[u]][0],
-                                            topk[v][idx[v]][0], minimize)
-                    for u, v in edges)
+                total = sum(row[i] for row, i in zip(node_rows, choice))
+                total += sum(mat[choice[ui]][choice[vi]]
+                             for ui, vi, mat in edge_mat)
                 if best_val is None or total < best_val:
-                    best_val, best_choice = total, dict(idx)
+                    best_val, best_choice = total, choice
             assert best_choice is not None
             return {
                 t: (topk[t][i][0], topk[t][i][1])
-                for t, i in best_choice.items()
+                for t, i in zip(order, best_choice)
             }
         # Greedy fallback: place in topo order, charging egress from the
         # parents placed so far.

@@ -180,6 +180,20 @@ _CONFIG_SCHEMA: Dict[str, Any] = {
                                           'items': {'type': 'string'}},
             },
         },
+        'r2': {
+            'type': 'object',
+            'additionalProperties': True,
+            'properties': {
+                'account_id': {'type': 'string'},
+            },
+        },
+        'azure': {
+            'type': 'object',
+            'additionalProperties': True,
+            'properties': {
+                'storage_account': {'type': 'string'},
+            },
+        },
         'kubernetes': {
             'type': 'object',
             'additionalProperties': True,

@@ -239,3 +239,163 @@ def test_s3_mount_and_copy_commands(fake_aws):
     assert '/mnt/ckpt' in script
     cmd = mounting_utils.get_s3_copy_cmd('bkt', '', '/tmp/out')
     assert 'aws s3 sync s3://bkt /tmp/out' in cmd
+
+
+# ------------------------------------------------------------------- R2
+
+
+@pytest.fixture
+def fake_r2(tmp_path, monkeypatch):
+    """A fake `aws` CLI that understands the R2 global options
+    (--endpoint-url/--profile appended by R2Store)."""
+    bindir = tmp_path / 'r2bin'
+    bindir.mkdir()
+    bucket_root = tmp_path / 'r2'
+    bucket_root.mkdir()
+    log = tmp_path / 'r2.log'
+    script = f'''#!/bin/bash
+echo "$@" >> {log}
+root={bucket_root}
+# Strip global option pairs anywhere in the argv.
+args=(); skip=0
+for a in "$@"; do
+  if [ "$skip" = 1 ]; then skip=0; continue; fi
+  case "$a" in
+    --endpoint-url|--profile|--exclude|--include) skip=1 ;;
+    --*) ;;
+    *) args+=("$a") ;;
+  esac
+done
+case "${{args[0]}} ${{args[1]}}" in
+  "s3api head-bucket")
+    name="${{args[2]}}"; [ -d "$root/$name" ] || exit 255 ;;
+  "s3 mb")
+    name="${{args[2]#s3://}}"; mkdir -p "$root/$name" ;;
+  "s3 sync")
+    src="${{args[2]}}"; dst="${{args[3]#s3://}}"
+    mkdir -p "$root/$dst"; cp -r "$src"/. "$root/$dst/" ;;
+  "s3 cp")
+    src="${{args[2]}}"; dst="${{args[3]#s3://}}"
+    mkdir -p "$root/$dst"; cp "$src" "$root/$dst/" ;;
+  "s3 rb")
+    name="${{args[2]#s3://}}"; rm -rf "$root/$name" ;;
+esac
+exit 0
+'''
+    aws = bindir / 'aws'
+    aws.write_text(script)
+    aws.chmod(0o755)
+    monkeypatch.setenv('PATH', f'{bindir}:{os.environ["PATH"]}')
+    monkeypatch.setenv('R2_ACCOUNT_ID', 'acct123')
+    return {'log': log, 'root': bucket_root}
+
+
+def test_r2_store_roundtrip(fake_r2, tmp_path):
+    src = tmp_path / 'rdata'
+    src.mkdir()
+    (src / 'w.txt').write_text('weights')
+    store = storage_lib.Storage(name='skytpu-r2-ut', source=str(src),
+                                stores=[storage_lib.StoreType.R2])
+    store.sync_all_stores()
+    r2 = store.stores[storage_lib.StoreType.R2]
+    assert r2.exists()
+    assert r2.get_uri() == 'r2://skytpu-r2-ut'
+    assert (fake_r2['root'] / 'skytpu-r2-ut' / 'w.txt').read_text() == \
+        'weights'
+    calls = fake_r2['log'].read_text()
+    # Every call carries the R2 endpoint + profile.
+    assert '--endpoint-url https://acct123.r2.cloudflarestorage.com' in calls
+    assert '--profile r2' in calls
+    store.delete()
+    assert not r2.exists()
+
+
+def test_r2_uri_source_infers_store(fake_r2):
+    (fake_r2['root'] / 'r2-bkt').mkdir()
+    st = storage_lib.Storage(source='r2://r2-bkt')
+    assert st.name == 'r2-bkt'
+    st.sync_all_stores()
+    assert storage_lib.StoreType.R2 in st.stores
+
+
+def test_r2_mount_and_copy_commands(fake_r2):
+    from skypilot_tpu.data import mounting_utils
+    script = mounting_utils.get_r2_mount_script(
+        'bkt', '/mnt/w', 'https://acct123.r2.cloudflarestorage.com')
+    assert 'rclone' in script and 'Cloudflare' in script
+    cmd = mounting_utils.get_r2_copy_cmd(
+        'bkt', '', '/tmp/out', 'https://acct123.r2.cloudflarestorage.com')
+    assert 'aws s3 sync s3://bkt /tmp/out' in cmd
+    assert '--endpoint-url' in cmd
+
+
+# ---------------------------------------------------------------- Azure
+
+
+@pytest.fixture
+def fake_az(tmp_path, monkeypatch):
+    """A fake `az` CLI emulating container lifecycle as directories."""
+    bindir = tmp_path / 'azbin'
+    bindir.mkdir()
+    root = tmp_path / 'az'
+    root.mkdir()
+    log = tmp_path / 'az.log'
+    script = f'''#!/bin/bash
+echo "$@" >> {log}
+root={tmp_path}/az
+get_opt() {{ # get_opt --name "$@"
+  want="$1"; shift
+  while [ $# -gt 0 ]; do
+    if [ "$1" = "$want" ]; then echo "$2"; return; fi
+    shift
+  done
+}}
+case "$2 $3" in
+  "container exists")
+    name=$(get_opt --name "$@")
+    if [ -d "$root/$name" ]; then echo True; else echo False; fi ;;
+  "container create")
+    name=$(get_opt --name "$@"); mkdir -p "$root/$name" ;;
+  "container delete")
+    name=$(get_opt --name "$@"); rm -rf "$root/$name" ;;
+  "blob upload-batch")
+    dst=$(get_opt -d "$@"); src=$(get_opt -s "$@")
+    mkdir -p "$root/$dst"; cp -r "$src"/. "$root/$dst/" ;;
+  "blob upload")
+    c=$(get_opt --container-name "$@"); f=$(get_opt --file "$@")
+    mkdir -p "$root/$c"; cp "$f" "$root/$c/" ;;
+esac
+exit 0
+'''
+    az = bindir / 'az'
+    az.write_text(script)
+    az.chmod(0o755)
+    monkeypatch.setenv('PATH', f'{bindir}:{os.environ["PATH"]}')
+    monkeypatch.setenv('AZURE_STORAGE_ACCOUNT', 'skytpuacct')
+    return {'log': log, 'root': root}
+
+
+def test_azure_store_roundtrip(fake_az, tmp_path):
+    src = tmp_path / 'adata'
+    src.mkdir()
+    (src / 'b.txt').write_text('blob')
+    store = storage_lib.Storage(name='skytpu-az-ut', source=str(src),
+                                stores=[storage_lib.StoreType.AZURE])
+    store.sync_all_stores()
+    az = store.stores[storage_lib.StoreType.AZURE]
+    assert az.exists()
+    assert az.get_uri() == 'azure://skytpu-az-ut'
+    assert (fake_az['root'] / 'skytpu-az-ut' / 'b.txt').read_text() == 'blob'
+    calls = fake_az['log'].read_text()
+    assert '--account-name skytpuacct' in calls
+    store.delete()
+    assert not az.exists()
+
+
+def test_azure_mount_and_copy_commands(fake_az):
+    from skypilot_tpu.data import mounting_utils
+    script = mounting_utils.get_az_mount_script('cont', '/mnt/a',
+                                                'skytpuacct')
+    assert 'blobfuse2' in script
+    cmd = mounting_utils.get_az_copy_cmd('cont', '/tmp/out', 'skytpuacct')
+    assert 'download-batch' in cmd
