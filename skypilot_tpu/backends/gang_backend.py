@@ -456,9 +456,9 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
     def _sync_file_mounts(self, handle: ClusterHandle, all_file_mounts,
                           storage_mounts) -> None:
         if all_file_mounts:
+            from skypilot_tpu.data import storage as storage_lib
             runners = handle.get_command_runners()
             for dst, src in all_file_mounts.items():
-                from skypilot_tpu.data import storage as storage_lib
                 if src.startswith(storage_lib.REMOTE_BUCKET_PREFIXES):
                     self._download_bucket_mount(runners, src, dst)
                     continue

@@ -1,5 +1,6 @@
 """Cloud registry (parity: ``sky/clouds/__init__.py``)."""
 from skypilot_tpu.clouds.aws import AWS
+from skypilot_tpu.clouds.azure import Azure
 from skypilot_tpu.clouds.cloud import Cloud
 from skypilot_tpu.clouds.cloud import CloudImplementationFeatures
 from skypilot_tpu.clouds.cloud import Region
@@ -10,6 +11,7 @@ from skypilot_tpu.clouds.local import Local
 
 __all__ = [
     'AWS',
+    'Azure',
     'Cloud',
     'CloudImplementationFeatures',
     'GCP',

@@ -1,0 +1,202 @@
+"""Azure cloud: GPU/CPU instances for cross-cloud cost ranking.
+
+Parity: ``sky/clouds/azure.py`` — like the AWS build-out
+(``clouds/aws.py``), this covers the catalog / feasibility / pricing
+surface plus credential checks so the optimizer can rank Azure GPU SKUs
+(ND A100/H100 series) against TPU slices; instance lifecycle raises
+NotSupported until an Azure provisioner lands, and `sky check` gates the
+cloud off without az credentials.
+"""
+import subprocess
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+_CLOUD = 'azure'
+
+
+@CLOUD_REGISTRY.register()
+class Azure(cloud.Cloud):
+    """Microsoft Azure."""
+
+    _REPR = 'Azure'
+    # Azure resource-group derived names: keep headroom under 64.
+    _MAX_CLUSTER_NAME_LEN_LIMIT = 42
+
+    @classmethod
+    def unsupported_features(
+        cls,
+        resources=None
+    ) -> Dict[cloud.CloudImplementationFeatures, str]:
+        del resources
+        return {
+            cloud.CloudImplementationFeatures.CLONE_DISK_FROM_CLUSTER:
+                'Disk cloning is not supported yet on Azure.',
+        }
+
+    # ----------------------------------------------------------- regions
+
+    def regions_with_offering(self, instance_type, accelerators, use_spot,
+                              region, zone) -> List[cloud.Region]:
+        del accelerators, use_spot
+        if instance_type is None:
+            return []
+        pairs = catalog.vm_regions_zones(instance_type, region, zone,
+                                         cloud=_CLOUD)
+        regions: Dict[str, cloud.Region] = {}
+        for r, z in pairs:
+            regions.setdefault(r, cloud.Region(r))
+            zone_obj = cloud.Zone(z)
+            zone_obj.region = r
+            regions[r].zones.append(zone_obj)
+        return list(regions.values())
+
+    def zones_provision_loop(self,
+                             *,
+                             region: str,
+                             num_nodes: int,
+                             instance_type: Optional[str],
+                             accelerators=None,
+                             use_spot: bool = False
+                             ) -> Iterator[Optional[List[cloud.Zone]]]:
+        # Azure provisions per-region (zones are a placement hint); yield
+        # the region's zone set at once (parity: azure.py region loop).
+        del num_nodes
+        for r in self.regions_with_offering(instance_type, accelerators,
+                                            use_spot, region, None):
+            yield r.zones
+
+    # ----------------------------------------------------------- pricing
+
+    def instance_type_to_hourly_cost(self, instance_type, use_spot, region,
+                                     zone) -> float:
+        del zone
+        price = catalog.get_hourly_cost(instance_type, region, use_spot,
+                                        cloud=_CLOUD)
+        if price is None:
+            raise exceptions.ResourcesUnavailableError(
+                f'No Azure pricing for {instance_type} in {region}.')
+        return price
+
+    def accelerators_to_hourly_cost(self, accelerators, use_spot, region,
+                                    zone) -> float:
+        # GPU cost is folded into the hosting instance price.
+        del accelerators, use_spot, region, zone
+        return 0.0
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        # Parity: sky/clouds/azure.py egress tiers (internet egress).
+        if num_gigabytes <= 0:
+            return 0.0
+        if num_gigabytes <= 10 * 1024:
+            return num_gigabytes * 0.087
+        cost = 10 * 1024 * 0.087
+        if num_gigabytes <= 50 * 1024:
+            return cost + (num_gigabytes - 10 * 1024) * 0.083
+        return cost + 40 * 1024 * 0.083 + (num_gigabytes - 50 * 1024) * 0.07
+
+    # ----------------------------------------------------------- catalog
+
+    def instance_type_exists(self, instance_type: str) -> bool:
+        return catalog.instance_type_exists(instance_type, cloud=_CLOUD)
+
+    @classmethod
+    def get_default_instance_type(cls,
+                                  cpus=None,
+                                  memory=None,
+                                  disk_tier=None) -> Optional[str]:
+        del disk_tier
+        return catalog.get_default_instance_type(cpus, memory, cloud=_CLOUD)
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(cls, instance_type):
+        return catalog.get_vcpus_mem_from_instance_type(instance_type,
+                                                        cloud=_CLOUD)
+
+    @classmethod
+    def get_accelerators_from_instance_type(cls, instance_type):
+        return catalog.get_accelerators_from_instance_type(instance_type,
+                                                           cloud=_CLOUD)
+
+    def get_feasible_launchable_resources(self, resources, num_nodes):
+        from skypilot_tpu import topology as topo_lib
+        del num_nodes
+        if resources.instance_type is not None and \
+                resources.accelerators is None:
+            if not self.instance_type_exists(resources.instance_type):
+                return [], []
+            return [resources.copy(cloud=self)], []
+
+        accs = resources.accelerators
+        if accs is None:
+            instance_type = self.get_default_instance_type(
+                resources.cpus, resources.memory)
+            if instance_type is None:
+                return [], []
+            return [
+                resources.copy(cloud=self, instance_type=instance_type)
+            ], []
+
+        acc_name, acc_count = next(iter(accs.items()))
+        if topo_lib.is_tpu_accelerator(acc_name):
+            return [], []  # TPUs live on GCP / GKE
+        instance_types = catalog.get_instance_type_for_accelerator(
+            acc_name,
+            acc_count,
+            cpus=resources.cpus,
+            memory=resources.memory,
+            region=resources.region,
+            zone=resources.zone,
+            cloud=_CLOUD)
+        if not instance_types:
+            return [], catalog.fuzzy_accelerator_hints(acc_name, 'Azure')
+        return [
+            resources.copy(cloud=self, instance_type=instance_types[0])
+        ], []
+
+    # ----------------------------------------------------------- deploy
+
+    def make_deploy_resources_variables(self, resources,
+                                        cluster_name_on_cloud, region, zones,
+                                        num_nodes) -> Dict[str, object]:
+        del cluster_name_on_cloud
+        return {
+            'instance_type': resources.instance_type,
+            'region': region.name,
+            'zones': ','.join(z.name for z in zones) if zones else None,
+            'use_spot': resources.use_spot,
+            'disk_size': resources.disk_size,
+            'image_id': resources.image_id,
+            'num_nodes': num_nodes,
+        }
+
+    # ----------------------------------------------------------- identity
+
+    @staticmethod
+    def _az_query(field: str) -> Optional[str]:
+        try:
+            proc = subprocess.run(
+                ['az', 'account', 'show', '--query', field, '-o', 'tsv'],
+                capture_output=True,
+                text=True,
+                timeout=20,
+                check=False)
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            return None
+        out = proc.stdout.strip()
+        return out if proc.returncode == 0 and out else None
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        if cls._az_query('id') is None:
+            return False, ('Azure credentials not configured (or az CLI '
+                           'missing). Run `az login`.')
+        return True, None
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        user = cls._az_query('user.name')
+        return [user] if user else None

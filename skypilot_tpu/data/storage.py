@@ -368,8 +368,20 @@ class AzureBlobStore(AbstractStore):
                      '--file', src, '--name', os.path.basename(src),
                      '--overwrite')
             return
-        self._az('blob', 'upload-batch', '-d', self.name, '-s', src,
-                 '--overwrite')
+        # upload-batch has no gitignore-style filters: stage the same
+        # resolved file set the other stores upload (hard links, so the
+        # staging tree costs no data copies) and batch-upload that.
+        import tempfile
+        with tempfile.TemporaryDirectory() as staging:
+            for abs_path, rel in storage_utils.list_files_to_upload(src):
+                dst = os.path.join(staging, rel)
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                try:
+                    os.link(abs_path, dst)
+                except OSError:
+                    shutil.copy2(abs_path, dst)
+            self._az('blob', 'upload-batch', '-d', self.name, '-s',
+                     staging, '--overwrite')
 
     def delete(self) -> None:
         if self.exists():

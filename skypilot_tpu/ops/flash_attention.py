@@ -28,13 +28,22 @@ NEG_INF = -1e30
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 
+# Minor-dim padding for the per-row L/D vectors: TPU block specs need the
+# last two block dims (8,128)-tiled or equal to the array's, so row
+# vectors ride as [rows, LANE_PAD] with the value replicated across lanes.
+LANE_PAD = 8
+
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, block_k: int,
                   seq_len: int, causal: bool, scale: float):
     """One (batch·head, q-block) program: stream K/V blocks, fold online.
 
     q_ref: [1, block_q, d]; k_ref/v_ref: [1, seq_len, d]; o_ref like q_ref;
-    l_ref: [1, block_q] log-normalizers (m + log l) for the backward pass.
+    l_ref: [1, block_q, LANE_PAD] log-normalizers (m + log l) for the
+    backward pass, replicated across the LANE_PAD minor dim — a bare
+    [1, block_q] block is illegal on TPU (the last two block dims must be
+    (8,128)-tiled or match the array), so the row vector is carried with a
+    small padded lane dim instead.
     """
     qi = pl.program_id(1)
     block_q = q_ref.shape[1]
@@ -80,7 +89,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, block_k: int,
     out = acc / jnp.maximum(l, 1e-20)
     o_ref[0] = out.astype(o_ref.dtype)
     # Log-normalizer per row (finite for causal: row i always sees col i).
-    l_ref[0, :] = (m + jnp.log(jnp.maximum(l, 1e-20)))[:, 0]
+    lse_col = m + jnp.log(jnp.maximum(l, 1e-20))       # [bq, 1]
+    l_ref[0] = jnp.broadcast_to(lse_col, (block_q, LANE_PAD))
 
 
 def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
@@ -103,9 +113,6 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
     def q_index(bh, qi):
         return (bh, qi, 0)
 
-    def l_index(bh, qi):
-        return (bh, qi)
-
     def kv_index(bh, qi):
         del qi
         # bh indexes [B*H]; its KV row is (batch, kv_head) flattened.
@@ -124,15 +131,15 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), q_index),
-            pl.BlockSpec((1, block_q), l_index),
+            pl.BlockSpec((1, block_q, LANE_PAD), q_index),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, s), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s, LANE_PAD), jnp.float32),
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3), lse
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3), lse[:, :, 0]
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, dsum_ref,
@@ -148,8 +155,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, dsum_ref,
     d = q_ref.shape[2]
     q = q_ref[0].astype(jnp.float32)                   # [bq, d]
     do = do_ref[0].astype(jnp.float32)                 # [bq, d]
-    lse = l_ref[0][:, None]                            # [bq, 1]
-    dsum = dsum_ref[0][:, None]                        # [bq, 1]
+    lse = l_ref[0][:, 0:1]                             # [bq, 1]
+    dsum = dsum_ref[0][:, 0:1]                         # [bq, 1]
     q_start = qi * block_q
     q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
 
@@ -206,8 +213,8 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, l_ref, dsum_ref,
         q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(
             jnp.float32)
-        lse = l_ref[0, pl.ds(i * block_q, block_q)][:, None]
-        dsum = dsum_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        lse = l_ref[0, pl.ds(i * block_q, block_q), 0:1]
+        dsum = dsum_ref[0, pl.ds(i * block_q, block_q), 0:1]
         logits = scale * jax.lax.dot_general(
             q_blk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bq, bk]
@@ -256,6 +263,9 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
     dsum = jnp.sum(dot.astype(jnp.float32) *
                    out.transpose(0, 2, 1, 3).reshape(b * h, s, d).astype(
                        jnp.float32), axis=-1)          # [B*H, S]
+    # Lane-replicated layouts for the row vectors (see LANE_PAD).
+    lse3 = jnp.broadcast_to(lse[:, :, None], (b * h, s, LANE_PAD))
+    dsum3 = jnp.broadcast_to(dsum[:, :, None], (b * h, s, LANE_PAD))
 
     def blk3(bh, i):
         return (bh, i, 0)
@@ -263,10 +273,6 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
     def row(bh, i):
         del i
         return (bh, 0, 0)
-
-    def vec(bh, i):
-        del i
-        return (bh, 0)
 
     scale = d**-0.5
     dq = pl.pallas_call(
@@ -278,13 +284,13 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
             pl.BlockSpec((1, s, d), row),       # k (full row)
             pl.BlockSpec((1, s, d), row),       # v
             pl.BlockSpec((1, bq, d), blk3),     # dO
-            pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),   # L
-            pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),   # D
+            pl.BlockSpec((1, bq, LANE_PAD), blk3),          # L
+            pl.BlockSpec((1, bq, LANE_PAD), blk3),          # D
         ],
         out_specs=pl.BlockSpec((1, bq, d), blk3),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, dsum)
+    )(qt, kt, vt, dot, lse3, dsum3)
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=bq, seq_len=s,
@@ -295,8 +301,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
             pl.BlockSpec((1, bk, d), blk3),     # v block
             pl.BlockSpec((1, s, d), row),       # q (full row)
             pl.BlockSpec((1, s, d), row),       # dO
-            pl.BlockSpec((1, s), vec),          # L
-            pl.BlockSpec((1, s), vec),          # D
+            pl.BlockSpec((1, s, LANE_PAD), row),            # L
+            pl.BlockSpec((1, s, LANE_PAD), row),            # D
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), blk3),
@@ -307,7 +313,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
             jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         ],
         interpret=interpret,
-    )(kt, vt, qt, dot, lse, dsum)
+    )(kt, vt, qt, dot, lse3, dsum3)
 
     dq = dq.reshape(b, h, s, d).transpose(0, 2, 1, 3)
     # Reduce the GQA reps back to kv heads.

@@ -257,6 +257,8 @@ class Task:
             return CLOUD_REGISTRY.from_str('gcp')
         if self._inputs.startswith('s3://'):
             return CLOUD_REGISTRY.from_str('aws')
+        if self._inputs.startswith('azure://'):
+            return CLOUD_REGISTRY.from_str('azure')
         # r2://: Cloudflare egress is free and R2 is not a compute cloud
         # here — no egress attribution.
         return None

@@ -87,3 +87,20 @@ def test_launch_pjit_resnet_recipe(local_enabled, tmp_path):
          'STEPS': '2', 'EXTRA_FLAGS': '--image-size 32'},
         'ex-resnet', tmp_path)
     assert 'resnet_train_examples_per_sec' in out
+
+
+def test_launch_gke_tpu_recipe(tmp_path, monkeypatch):
+    """The GKE TPU podslice recipe launches against the fake cluster:
+    YAML → optimizer (capacity from node labels) → 4 pods → gang run."""
+    monkeypatch.setenv('SKYTPU_K8S_FAKE', '1')
+    global_state.set_enabled_clouds(['Kubernetes'])
+    path = os.path.join(EXAMPLES_DIR, 'gke_tpu_docker.yaml')
+    task = sky.Task.from_yaml(path)
+    log = tmp_path / 'out.log'
+    task.run = f'({task.run}) 2>&1 | tee -a {log}'
+    job_id, handle = sky.launch(task, cluster_name='t-gke',
+                                detach_run=True, stream_logs=False)
+    assert handle is not None
+    status = _wait_job('t-gke', job_id)
+    assert status == job_lib.JobStatus.SUCCEEDED
+    sky.down('t-gke')

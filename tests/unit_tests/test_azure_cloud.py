@@ -1,0 +1,61 @@
+"""Azure catalog/feasibility/pricing surface (parity: sky/clouds/azure.py)."""
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import clouds  # noqa: F401 (registers clouds)
+from skypilot_tpu import global_state
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+
+@pytest.fixture(autouse=True)
+def azure_enabled():
+    global_state.set_enabled_clouds(['Azure', 'GCP'])
+    yield
+
+
+def test_accelerator_feasibility_and_pricing():
+    azure = CLOUD_REGISTRY.from_str('azure')
+    res = sky.Resources(cloud='azure', accelerators={'A100-80GB': 8})
+    feasible, _ = azure.get_feasible_launchable_resources(res, 1)
+    assert len(feasible) == 1
+    assert feasible[0].instance_type == 'Standard_ND96amsr_A100_v4'
+    price = azure.instance_type_to_hourly_cost(
+        'Standard_ND96amsr_A100_v4', False, 'eastus', None)
+    assert price == pytest.approx(32.77)
+    spot = azure.instance_type_to_hourly_cost(
+        'Standard_ND96amsr_A100_v4', True, 'eastus', None)
+    assert spot < price
+
+
+def test_cpu_default_instance_type():
+    azure = CLOUD_REGISTRY.from_str('azure')
+    res = sky.Resources(cloud='azure', cpus='8')
+    feasible, _ = azure.get_feasible_launchable_resources(res, 1)
+    assert feasible[0].instance_type.startswith('Standard_D8')
+
+
+def test_regions_and_egress():
+    azure = CLOUD_REGISTRY.from_str('azure')
+    regions = azure.regions_with_offering('Standard_ND96amsr_A100_v4',
+                                          None, False, None, None)
+    names = {r.name for r in regions}
+    assert {'eastus', 'westus2', 'westeurope'} <= names
+    assert azure.get_egress_cost(100) == pytest.approx(8.7)
+
+
+def test_tpu_requests_stay_off_azure():
+    azure = CLOUD_REGISTRY.from_str('azure')
+    res = sky.Resources(accelerators='tpu-v5e:8')
+    feasible, _ = azure.get_feasible_launchable_resources(res, 1)
+    assert feasible == []
+
+
+def test_optimizer_ranks_azure_gpu_against_others():
+    """An A100:8 request with no cloud pin ranks across enabled clouds
+    without error (Azure rows participate)."""
+    from skypilot_tpu import optimizer as opt
+    with sky.Dag() as dag:
+        t = sky.Task(name='gpu', run='echo x')
+        t.set_resources(sky.Resources(accelerators={'A100-80GB': 8}))
+    opt.Optimizer.optimize(dag, opt.OptimizeTarget.COST, quiet=True)
+    assert t.best_resources is not None

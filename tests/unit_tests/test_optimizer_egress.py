@@ -165,3 +165,12 @@ def test_empty_dag_optimizes_to_empty_plan():
     dag = sky.Dag()
     optimizer_lib.Optimizer.optimize(
         dag, optimizer_lib.OptimizeTarget.COST, quiet=True)
+
+
+def test_inputs_cloud_scheme_mapping():
+    t = sky.Task(name='m', run='echo x')
+    for uri, expect in (('gs://b/x', 'gcp'), ('s3://b/x', 'aws'),
+                        ('azure://c/x', 'azure'), ('r2://b/x', None)):
+        t.set_inputs(uri, 1.0)
+        got = t.get_inputs_cloud()
+        assert (got.name if got else None) == expect, uri
