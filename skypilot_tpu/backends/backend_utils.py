@@ -76,6 +76,23 @@ def make_provision_config(
         provider_config['ssh_private_key'] = private_key
         auth_config['ssh_keys'] = f'{ssh_user}:{public_key}'
         auth_config['ssh_user'] = ssh_user
+    if cloud.name == 'aws':
+        _, private_key = authentication.get_or_generate_keys()
+        provider_config['ssh_user'] = 'ubuntu'
+        provider_config['ssh_private_key'] = private_key
+        # Key-pair import is the user's responsibility for now (parity
+        # gap vs the reference's sky-key registration). Fail BEFORE
+        # creating instances: keyless VMs would only surface as a
+        # 10-minute SSH timeout with billing running.
+        key_name = skypilot_config.get_nested(('aws', 'key_name'), None)
+        if key_name is None and os.environ.get('SKYTPU_AWS_FAKE',
+                                               '0') != '1':
+            raise exceptions.NotSupportedError(
+                'AWS launches need an EC2 key pair: import the skytpu key '
+                '(`aws ec2 import-key-pair`) and set aws.key_name in '
+                '~/.skytpu/config.yaml.')
+        auth_config['key_name'] = key_name
+        auth_config['ssh_user'] = 'ubuntu'
     return provision_common.ProvisionConfig(
         provider_config=provider_config,
         authentication_config=auth_config,
@@ -193,10 +210,18 @@ def _runtime_healthy(handle) -> bool:
     from skypilot_tpu.utils import subprocess_utils
 
     def _probe(runner) -> bool:
-        try:
-            return runner.run(_HEALTH_PROBE_CMD, timeout=15) == 0
-        except Exception:  # pylint: disable=broad-except
-            return False
+        # One retry: a single missed probe under host load (fork latency,
+        # transient SSH hiccup) must not degrade a healthy cluster to
+        # INIT — only a host that fails twice in a row reads dead.
+        for attempt in range(2):
+            try:
+                if runner.run(_HEALTH_PROBE_CMD, timeout=15) == 0:
+                    return True
+            except Exception:  # pylint: disable=broad-except
+                pass
+            if attempt == 0:
+                time.sleep(0.5)
+        return False
 
     results = subprocess_utils.run_in_parallel(_probe, runners)
     return all(results)

@@ -136,10 +136,12 @@ class FailoverCloudErrorHandler:
 
     @classmethod
     def classify(cls, exc: Exception) -> str:
+        from skypilot_tpu.provision.aws import ec2_api
         from skypilot_tpu.provision.gcp import tpu_api
         from skypilot_tpu.provision.kubernetes import k8s_api
         if isinstance(exc, (tpu_api.GcpCapacityError,
-                            k8s_api.K8sCapacityError)):
+                            k8s_api.K8sCapacityError,
+                            ec2_api.AwsCapacityError)):
             return cls.ZONE
         text = str(exc).lower()
         if any(s in text for s in cls._ZONE_MARKERS):

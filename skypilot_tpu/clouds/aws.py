@@ -2,11 +2,10 @@
 
 Parity: ``sky/clouds/aws.py`` — the optimizer's core value prop is ranking
 TPU slices against GPU SKUs across clouds (BASELINE north star compares a
-v5p slice with 8xA100 nodes). This implementation covers the catalog /
-feasibility / pricing surface and credential checks; instance lifecycle
-(``skypilot_tpu.provision.aws``) raises NotSupported until an EC2
-provisioner lands — `sky check` gates it off without credentials exactly
-like the reference does for clouds whose SDKs are absent.
+v5p slice with 8xA100 nodes). Covers the catalog / feasibility / pricing
+surface and credential checks; instance lifecycle is
+``skypilot_tpu.provision.aws`` (aws-CLI EC2 provisioner with an in-memory
+fake for tests).
 """
 import subprocess
 from typing import Dict, Iterator, List, Optional, Tuple
@@ -62,12 +61,13 @@ class AWS(cloud.Cloud):
                              accelerators=None,
                              use_spot: bool = False
                              ) -> Iterator[Optional[List[cloud.Zone]]]:
-        # AWS provisions per-region (EC2 fleet picks zones); yield the
-        # region's zone set at once (parity: aws.py yields all zones).
+        # The EC2 provisioner pins one AZ per attempt, so failover walks
+        # zones individually (a stockout in 1a must still try 1b..1f).
         del num_nodes
         for r in self.regions_with_offering(instance_type, accelerators,
                                             use_spot, region, None):
-            yield r.zones
+            for z in r.zones:
+                yield [z]
 
     # ----------------------------------------------------------- pricing
 

@@ -1,0 +1,16 @@
+"""AWS EC2 provisioner (parity: ``sky/provision/aws/``) — aws-CLI based,
+with an in-memory fake for credential-free tests."""
+from skypilot_tpu.provision.aws.instance import cleanup_ports
+from skypilot_tpu.provision.aws.instance import get_cluster_info
+from skypilot_tpu.provision.aws.instance import open_ports
+from skypilot_tpu.provision.aws.instance import query_instances
+from skypilot_tpu.provision.aws.instance import run_instances
+from skypilot_tpu.provision.aws.instance import stop_instances
+from skypilot_tpu.provision.aws.instance import terminate_instances
+from skypilot_tpu.provision.aws.instance import wait_instances
+
+__all__ = [
+    'cleanup_ports', 'get_cluster_info', 'open_ports', 'query_instances',
+    'run_instances', 'stop_instances', 'terminate_instances',
+    'wait_instances'
+]

@@ -1,0 +1,215 @@
+"""EC2 API client with a fake backend.
+
+Parity: the reference drives boto3 from ``sky/provision/aws/instance.py``;
+this build shells out to the ``aws`` CLI (``--output json``) — boto3 is not
+a baked-in dependency and the CLI is what the storage layer already uses —
+with the same two-transport shape as ``provision/gcp/tpu_api.py``:
+
+* :class:`CliTransport` — real EC2 via ``aws ec2 ... --output json``.
+* :class:`FakeEc2Service` — in-memory instances, used by tests and when
+  ``SKYTPU_AWS_FAKE=1``. Fault injection:
+  ``SKYTPU_AWS_FAKE_STOCKOUT='us-east-1a,...'`` makes RunInstances in
+  those zones raise ``InsufficientInstanceCapacity`` — exercising the
+  failover engine.
+"""
+import json
+import os
+import subprocess
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_FAKE_STATE_ENV = 'SKYTPU_AWS_FAKE_STATE'
+
+
+class Ec2ApiError(Exception):
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+class AwsCapacityError(Ec2ApiError):
+    """InsufficientInstanceCapacity / quota — failover blocklists the
+    zone."""
+
+
+# Exact AWS error codes only: a bare 'capacity' substring would also match
+# e.g. InvalidCapacityReservationId config errors and burn the candidate
+# list (see FailoverCloudErrorHandler.classify).
+_CAPACITY_MARKERS = ('insufficientinstancecapacity', 'instancelimitexceeded',
+                     'vcpulimitexceeded', 'maxspotinstancecountexceeded')
+
+
+class CliTransport:
+    """Real EC2 through the aws CLI."""
+
+    def __init__(self, region: str):
+        self.region = region
+
+    def _run(self, args: List[str]) -> dict:
+        proc = subprocess.run(
+            ['aws', 'ec2', '--region', self.region, '--output', 'json'] +
+            args,
+            capture_output=True,
+            text=True,
+            timeout=300,
+            check=False)
+        if proc.returncode != 0:
+            msg = proc.stderr.strip()
+            if any(m in msg.lower() for m in _CAPACITY_MARKERS):
+                raise AwsCapacityError(msg)
+            raise Ec2ApiError(f'aws ec2 {args[0]}: {msg}')
+        return json.loads(proc.stdout) if proc.stdout.strip() else {}
+
+    def run_instances(self, zone: Optional[str], count: int,
+                      config: Dict[str, Any]) -> List[dict]:
+        args = [
+            'run-instances',
+            '--count', str(count),
+            '--instance-type', config['instance_type'],
+            '--image-id', config.get('image_id') or
+            'resolve:ssm:/aws/service/canonical/ubuntu/server/22.04/'
+            'stable/current/amd64/hvm/ebs-gp2/ami-id',
+            '--tag-specifications',
+            json.dumps([{
+                'ResourceType': 'instance',
+                'Tags': [{'Key': k, 'Value': v}
+                         for k, v in config.get('tags', {}).items()],
+            }]),
+        ]
+        if config.get('key_name'):
+            args += ['--key-name', config['key_name']]
+        if zone:
+            args += ['--placement', json.dumps({'AvailabilityZone': zone})]
+        if config.get('use_spot'):
+            args += ['--instance-market-options',
+                     json.dumps({'MarketType': 'spot'})]
+        return self._run(args).get('Instances', [])
+
+    def describe_instances(self,
+                           filters: List[dict]) -> List[dict]:
+        out = self._run(['describe-instances', '--filters',
+                         json.dumps(filters)])
+        instances = []
+        for resv in out.get('Reservations', []):
+            instances.extend(resv.get('Instances', []))
+        return instances
+
+    def stop_instances(self, ids: List[str]) -> None:
+        if ids:
+            self._run(['stop-instances', '--instance-ids'] + ids)
+
+    def start_instances(self, ids: List[str]) -> None:
+        if ids:
+            self._run(['start-instances', '--instance-ids'] + ids)
+
+    def terminate_instances(self, ids: List[str]) -> None:
+        if ids:
+            self._run(['terminate-instances', '--instance-ids'] + ids)
+
+
+class FakeEc2Service:
+    """In-memory EC2: instant state transitions, per-region instances.
+
+    State optionally persisted to a JSON file (``SKYTPU_AWS_FAKE_STATE``)
+    so separate processes see the same cloud.
+    """
+
+    _lock = threading.Lock()
+    _instances: Dict[str, Dict[str, Any]] = {}
+
+    def __init__(self, region: str):
+        self.region = region
+        self._state_path = os.environ.get(_FAKE_STATE_ENV)
+
+    def _load(self) -> Dict[str, Dict[str, Any]]:
+        if self._state_path and os.path.exists(self._state_path):
+            with open(self._state_path, encoding='utf-8') as f:
+                return json.load(f)
+        return FakeEc2Service._instances
+
+    def _save(self, instances: Dict[str, Dict[str, Any]]) -> None:
+        if self._state_path:
+            with open(self._state_path, 'w', encoding='utf-8') as f:
+                json.dump(instances, f)
+        else:
+            FakeEc2Service._instances = instances
+
+    def run_instances(self, zone: Optional[str], count: int,
+                      config: Dict[str, Any]) -> List[dict]:
+        stockout = os.environ.get('SKYTPU_AWS_FAKE_STOCKOUT', '').split(',')
+        if zone and zone in stockout:
+            raise AwsCapacityError(
+                f'An error occurred (InsufficientInstanceCapacity): '
+                f'We currently do not have sufficient capacity in the '
+                f'Availability Zone you requested ({zone}).')
+        with FakeEc2Service._lock:
+            instances = self._load()
+            created = []
+            for _ in range(count):
+                iid = f'i-{uuid.uuid4().hex[:17]}'
+                n = len(instances)
+                inst = {
+                    'InstanceId': iid,
+                    'InstanceType': config['instance_type'],
+                    'State': {'Name': 'running'},
+                    'Placement': {'AvailabilityZone': zone or
+                                  f'{self.region}a'},
+                    'PrivateIpAddress': f'172.31.0.{n + 10}',
+                    'PublicIpAddress': f'54.0.0.{n + 10}',
+                    'Tags': [{'Key': k, 'Value': v}
+                             for k, v in config.get('tags', {}).items()],
+                    'Region': self.region,
+                }
+                instances[iid] = inst
+                created.append(inst)
+            self._save(instances)
+            return created
+
+    def describe_instances(self, filters: List[dict]) -> List[dict]:
+        instances = self._load()
+        out = []
+        for inst in instances.values():
+            if inst.get('Region') != self.region:
+                continue
+            ok = True
+            for f in filters:
+                name, values = f['Name'], f['Values']
+                if name.startswith('tag:'):
+                    key = name[4:]
+                    tags = {t['Key']: t['Value']
+                            for t in inst.get('Tags', [])}
+                    ok = ok and tags.get(key) in values
+                elif name == 'instance-state-name':
+                    ok = ok and inst['State']['Name'] in values
+            if ok:
+                out.append(inst)
+        return out
+
+    def _set_state(self, ids: List[str], state: str) -> None:
+        with FakeEc2Service._lock:
+            instances = self._load()
+            for iid in ids:
+                if iid in instances:
+                    instances[iid]['State']['Name'] = state
+            self._save(instances)
+
+    def stop_instances(self, ids: List[str]) -> None:
+        self._set_state(ids, 'stopped')
+
+    def start_instances(self, ids: List[str]) -> None:
+        self._set_state(ids, 'running')
+
+    def terminate_instances(self, ids: List[str]) -> None:
+        self._set_state(ids, 'terminated')
+
+
+def make_client(region: str):
+    if os.environ.get('SKYTPU_AWS_FAKE', '0') == '1':
+        return FakeEc2Service(region)
+    return CliTransport(region)

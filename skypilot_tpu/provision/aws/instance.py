@@ -1,0 +1,213 @@
+"""AWS EC2 instance lifecycle (parity: ``sky/provision/aws/instance.py``).
+
+A "cluster" of N nodes = N EC2 instances tagged
+``skytpu-cluster=<name>`` + ``skytpu-node=<i>``; one InstanceInfo per
+instance (GPU hosts are single-host nodes — the multi-host fan-out is a
+TPU-slice concept).
+"""
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.aws import ec2_api
+
+logger = sky_logging.init_logger(__name__)
+
+_CLUSTER_TAG = 'skytpu-cluster'
+_NODE_TAG = 'skytpu-node'
+
+_STATE_MAP = {
+    'pending': 'pending',
+    'running': 'running',
+    'stopping': 'stopping',
+    'stopped': 'stopped',
+    'shutting-down': 'terminating',
+    'terminated': 'terminated',
+}
+
+
+def _client(provider_config: Dict[str, Any]) -> Any:
+    return ec2_api.make_client(provider_config['region'])
+
+
+def _cluster_filter(cluster_name_on_cloud: str,
+                    non_terminated: bool = True) -> List[dict]:
+    filters = [{'Name': f'tag:{_CLUSTER_TAG}',
+                'Values': [cluster_name_on_cloud]}]
+    if non_terminated:
+        filters.append({
+            'Name': 'instance-state-name',
+            'Values': ['pending', 'running', 'stopping', 'stopped'],
+        })
+    return filters
+
+
+def _node_index(inst: dict) -> int:
+    tags = {t['Key']: t['Value'] for t in inst.get('Tags', [])}
+    return int(tags.get(_NODE_TAG, 0))
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    client = _client(config.provider_config)
+    zone = config.provider_config.get('availability_zone')
+    existing = client.describe_instances(
+        _cluster_filter(cluster_name_on_cloud))
+    by_index = {_node_index(i): i for i in existing}
+
+    created: List[str] = []
+    resumed: List[str] = []
+    head_id: Optional[str] = None
+    for i in range(config.count):
+        inst = by_index.get(i)
+        if inst is not None:
+            state = inst['State']['Name']
+            if state == 'stopped':
+                if not config.resume_stopped_nodes:
+                    raise common.ProvisionerError(
+                        f'Node {i} of {cluster_name_on_cloud} is stopped '
+                        'and resume_stopped_nodes is False; start the '
+                        'cluster instead.')
+                client.start_instances([inst['InstanceId']])
+                resumed.append(inst['InstanceId'])
+            if i == 0:
+                head_id = inst['InstanceId']
+            continue
+        node_cfg = {
+            'instance_type': config.node_config['instance_type'],
+            'image_id': config.node_config.get('image_id'),
+            'use_spot': config.node_config.get('use_spot', False),
+            'key_name': config.authentication_config.get('key_name'),
+            'tags': {
+                _CLUSTER_TAG: cluster_name_on_cloud,
+                _NODE_TAG: str(i),
+                'Name': f'{cluster_name_on_cloud}-{i}',
+            },
+        }
+        insts = client.run_instances(zone, 1, node_cfg)
+        iid = insts[0]['InstanceId']
+        created.append(iid)
+        if i == 0:
+            head_id = iid
+    assert head_id is not None
+    return common.ProvisionRecord(provider_name='aws',
+                                  region=region,
+                                  zone=zone,
+                                  cluster_name=cluster_name_on_cloud,
+                                  head_instance_id=head_id,
+                                  resumed_instance_ids=resumed,
+                                  created_instance_ids=created)
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = 'running',
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    import time
+    assert provider_config is not None
+    client = _client(provider_config)
+    deadline = time.time() + 600
+    while True:
+        insts = client.describe_instances(
+            _cluster_filter(cluster_name_on_cloud))
+        states = [_STATE_MAP.get(i['State']['Name'], 'pending')
+                  for i in insts]
+        if insts and all(s == state for s in states):
+            return
+        if time.time() > deadline:
+            raise common.ProvisionerError(
+                f'Timed out waiting for {cluster_name_on_cloud} to reach '
+                f'{state}; current: {states}')
+        time.sleep(5)
+
+
+def get_cluster_info(
+        region: str,
+        cluster_name_on_cloud: str,
+        provider_config: Optional[Dict[str, Any]] = None
+) -> common.ClusterInfo:
+    assert provider_config is not None
+    client = _client(provider_config)
+    insts = client.describe_instances(
+        _cluster_filter(cluster_name_on_cloud))
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    head_id = None
+    for inst in sorted(insts, key=_node_index):
+        iid = inst['InstanceId']
+        if head_id is None:  # sorted: node 0 first
+            head_id = iid
+        instances[iid] = [
+            common.InstanceInfo(
+                instance_id=iid,
+                internal_ip=inst.get('PrivateIpAddress', ''),
+                external_ip=inst.get('PublicIpAddress'),
+                tags={t['Key']: t['Value'] for t in inst.get('Tags', [])},
+            )
+        ]
+    if head_id is None and instances:
+        head_id = sorted(instances)[0]
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head_id,
+        provider_name='aws',
+        provider_config=provider_config,
+        ssh_user=provider_config.get('ssh_user', 'ubuntu'),
+        ssh_private_key=provider_config.get('ssh_private_key'),
+    )
+
+
+def query_instances(
+        cluster_name_on_cloud: str,
+        provider_config: Optional[Dict[str, Any]] = None,
+        non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
+    assert provider_config is not None
+    client = _client(provider_config)
+    out: Dict[str, Optional[str]] = {}
+    for inst in client.describe_instances(
+            _cluster_filter(cluster_name_on_cloud,
+                            non_terminated=non_terminated_only)):
+        status = _STATE_MAP.get(inst['State']['Name'], 'pending')
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[inst['InstanceId']] = status
+    return out
+
+
+def _ids(client, cluster_name_on_cloud: str,
+         worker_only: bool) -> List[str]:
+    out = []
+    for inst in client.describe_instances(
+            _cluster_filter(cluster_name_on_cloud)):
+        if worker_only and _node_index(inst) == 0:
+            continue
+        out.append(inst['InstanceId'])
+    return out
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    assert provider_config is not None
+    client = _client(provider_config)
+    client.stop_instances(_ids(client, cluster_name_on_cloud, worker_only))
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    assert provider_config is not None
+    client = _client(provider_config)
+    client.terminate_instances(
+        _ids(client, cluster_name_on_cloud, worker_only))
+
+
+def open_ports(cluster_name_on_cloud: str,
+               ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    # Real path: authorize-security-group-ingress on the cluster SG.
+    logger.debug(f'open_ports({cluster_name_on_cloud}, {ports})')
+
+
+def cleanup_ports(cluster_name_on_cloud: str,
+                  ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    logger.debug(f'cleanup_ports({cluster_name_on_cloud}, {ports})')

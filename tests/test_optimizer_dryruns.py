@@ -119,12 +119,16 @@ def test_cost_ranking_uses_uniform_runtime(all_clouds):
 
 
 def test_provisionerless_cloud_rejected_cleanly(all_clouds):
-    """AWS is catalog-rankable but has no provisioner: a non-dryrun launch
-    must fail with a clear NotSupportedError BEFORE any cluster record."""
+    """Azure is catalog-rankable but has no provisioner: a non-dryrun
+    launch must fail with a clear NotSupportedError BEFORE any cluster
+    record (AWS graduated to a real provisioner; Azure is the remaining
+    catalog-only cloud)."""
     from skypilot_tpu import global_state as gs
+    gs.set_enabled_clouds(['Azure'])
     task = sky.Task(run='echo hi')
-    task.set_resources(sky.Resources(cloud='aws', accelerators='A10G:1'))
+    task.set_resources(
+        sky.Resources(cloud='azure', accelerators={'A100-80GB': 1}))
     with pytest.raises(exceptions.NotSupportedError,
                        match='no instance provisioner'):
-        sky.launch(task, cluster_name='aws-real', stream_logs=False)
-    assert gs.get_cluster_from_name('aws-real') is None
+        sky.launch(task, cluster_name='az-real', stream_logs=False)
+    assert gs.get_cluster_from_name('az-real') is None

@@ -1,0 +1,83 @@
+"""AWS EC2 provisioner against the fake service (parity:
+sky/provision/aws/instance.py)."""
+import pytest
+
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.aws import ec2_api
+from skypilot_tpu.provision.aws import instance as aws_instance
+
+
+@pytest.fixture(autouse=True)
+def fake_aws_cloud(monkeypatch):
+    monkeypatch.setenv('SKYTPU_AWS_FAKE', '1')
+    ec2_api.FakeEc2Service._instances = {}  # pylint: disable=protected-access
+    yield
+    ec2_api.FakeEc2Service._instances = {}  # pylint: disable=protected-access
+
+
+def _provider_config(zone='us-east-1a'):
+    return {'region': 'us-east-1', 'availability_zone': zone,
+            'ssh_user': 'ubuntu'}
+
+
+def _config(count=2):
+    return provision_common.ProvisionConfig(
+        provider_config=_provider_config(),
+        authentication_config={'key_name': None},
+        docker_config={},
+        node_config={'instance_type': 'm6i.large', 'use_spot': False},
+        count=count,
+        tags={},
+        resume_stopped_nodes=True,
+    )
+
+
+def test_lifecycle_run_query_stop_resume_terminate():
+    record = aws_instance.run_instances('us-east-1', 'tec2', _config())
+    assert len(record.created_instance_ids) == 2
+    assert record.head_instance_id == record.created_instance_ids[0]
+
+    aws_instance.wait_instances('us-east-1', 'tec2',
+                                provider_config=_provider_config())
+    info = aws_instance.get_cluster_info('us-east-1', 'tec2',
+                                         _provider_config())
+    assert info.num_hosts() == 2
+    meta = info.ordered_host_meta()
+    assert meta[0]['transport'] == 'ssh'
+    assert meta[0]['ssh_user'] == 'ubuntu'
+    assert [h['rank'] for h in meta] == [0, 1]
+
+    statuses = aws_instance.query_instances('tec2', _provider_config())
+    assert set(statuses.values()) == {'running'}
+
+    aws_instance.stop_instances('tec2', _provider_config())
+    statuses = aws_instance.query_instances('tec2', _provider_config())
+    assert set(statuses.values()) == {'stopped'}
+
+    # Re-run resumes the stopped nodes instead of creating new ones.
+    record2 = aws_instance.run_instances('us-east-1', 'tec2', _config())
+    assert record2.created_instance_ids == []
+    assert len(record2.resumed_instance_ids) == 2
+
+    aws_instance.terminate_instances('tec2', _provider_config())
+    assert aws_instance.query_instances('tec2', _provider_config()) == {}
+
+
+def test_stockout_classified_for_failover(monkeypatch):
+    monkeypatch.setenv('SKYTPU_AWS_FAKE_STOCKOUT', 'us-east-1a')
+    with pytest.raises(ec2_api.AwsCapacityError):
+        aws_instance.run_instances('us-east-1', 'tcap', _config())
+    from skypilot_tpu.backends import gang_backend
+    handler = gang_backend.FailoverCloudErrorHandler
+    assert handler.classify(
+        ec2_api.AwsCapacityError('InsufficientInstanceCapacity')) == \
+        handler.ZONE
+
+
+def test_clusters_isolated_by_tag():
+    aws_instance.run_instances('us-east-1', 'ca', _config(count=1))
+    aws_instance.run_instances('us-east-1', 'cb', _config(count=1))
+    assert len(aws_instance.query_instances('ca', _provider_config())) == 1
+    aws_instance.terminate_instances('ca', _provider_config())
+    assert aws_instance.query_instances('ca', _provider_config()) == {}
+    assert len(aws_instance.query_instances('cb', _provider_config())) == 1
