@@ -139,9 +139,12 @@ class FailoverCloudErrorHandler:
         from skypilot_tpu.provision.aws import ec2_api
         from skypilot_tpu.provision.gcp import tpu_api
         from skypilot_tpu.provision.kubernetes import k8s_api
+        if isinstance(exc, ec2_api.AwsCapacityError):
+            # Quota limits are account/region-wide: sister zones would
+            # fail identically, so blocklist the whole region.
+            return cls.ZONE if exc.scope == 'zone' else cls.REGION
         if isinstance(exc, (tpu_api.GcpCapacityError,
-                            k8s_api.K8sCapacityError,
-                            ec2_api.AwsCapacityError)):
+                            k8s_api.K8sCapacityError)):
             return cls.ZONE
         text = str(exc).lower()
         if any(s in text for s in cls._ZONE_MARKERS):

@@ -181,13 +181,212 @@ def fetch_and_write_gcp(out_dir: str,
     return path
 
 
+# ------------------------------------------------------------------ azure
+
+# The Azure Retail Prices API is public (no auth):
+# https://prices.azure.com/api/retail/prices
+_AZURE_PRICES_URL = 'https://prices.azure.com/api/retail/prices'
+
+# armSkuName → (vCPUs, MemoryGiB, AcceleratorName, AcceleratorCount).
+# The retail price API carries no hardware specs (the reference joins
+# them from the azure SDK's SKU capabilities); this build ships the spec
+# table for the families the catalog ranks. Unknown SKUs are skipped —
+# never guessed.
+_AZURE_SPECS: Dict[str, tuple] = {
+    'Standard_D2s_v5': (2, 8, None, 0),
+    'Standard_D4s_v5': (4, 16, None, 0),
+    'Standard_D8s_v5': (8, 32, None, 0),
+    'Standard_D16s_v5': (16, 64, None, 0),
+    'Standard_D32s_v5': (32, 128, None, 0),
+    'Standard_E8s_v5': (8, 64, None, 0),
+    'Standard_NC4as_T4_v3': (4, 28, 'T4', 1),
+    'Standard_NC8as_T4_v3': (8, 56, 'T4', 1),
+    'Standard_NC6s_v3': (6, 112, 'V100', 1),
+    'Standard_NC12s_v3': (12, 224, 'V100', 2),
+    'Standard_NC24s_v3': (24, 448, 'V100', 4),
+    'Standard_NC24ads_A100_v4': (24, 220, 'A100-80GB', 1),
+    'Standard_NC48ads_A100_v4': (48, 440, 'A100-80GB', 2),
+    'Standard_NC96ads_A100_v4': (96, 880, 'A100-80GB', 4),
+    'Standard_ND96asr_v4': (96, 900, 'A100', 8),
+    'Standard_ND96amsr_A100_v4': (96, 1900, 'A100-80GB', 8),
+    'Standard_ND96isr_H100_v5': (96, 1900, 'H100', 8),
+}
+
+
+def _azure_public_transport(url: str, params: Dict[str, str]) -> dict:
+    """Unauthenticated GET. Pagination links (NextPageLink) already carry
+    their query string — only append params when given."""
+    import urllib.parse
+    import urllib.request
+    if params:
+        sep = '&' if '?' in url else '?'
+        url = f'{url}{sep}{urllib.parse.urlencode(params)}'
+    with urllib.request.urlopen(url, timeout=600) as resp:
+        return json.loads(resp.read())
+
+
+def fetch_azure_vms(transport: Optional[Transport] = None,
+                    regions: Optional[List[str]] = None
+                    ) -> List[Dict[str, str]]:
+    """VM price rows for ``azure_vms.csv`` from the Retail Prices API.
+
+    Parity: ``data_fetchers/fetch_azure.py`` — Linux consumption prices
+    only; spot comes from the same feed ('Spot' meters).
+    """
+    transport = transport or _azure_public_transport
+    regions = regions or ['eastus', 'westus2', 'westeurope']
+    # (sku, region) → {'od': p, 'spot': p}
+    prices: Dict[tuple, Dict[str, float]] = {}
+    for region in regions:
+        params = {
+            '$filter': (f"serviceName eq 'Virtual Machines' and "
+                        f"armRegionName eq '{region}' and "
+                        f"priceType eq 'Consumption'"),
+        }
+        url: Optional[str] = _AZURE_PRICES_URL
+        while url:
+            payload = transport(url, params)
+            for item in payload.get('Items', []):
+                sku = item.get('armSkuName', '')
+                if sku not in _AZURE_SPECS:
+                    continue
+                if 'Windows' in item.get('productName', ''):
+                    continue
+                meter = item.get('meterName', '')
+                if 'Low Priority' in meter:
+                    continue
+                price = float(item.get('retailPrice') or 0)
+                if price <= 0:
+                    continue
+                kind = 'spot' if 'Spot' in meter else 'od'
+                entry = prices.setdefault((sku, region), {})
+                entry[kind] = min(entry.get(kind, float('inf')), price)
+            url = payload.get('NextPageLink') or None
+            params = {}
+    rows = []
+    for (sku, region), entry in sorted(prices.items()):
+        od = entry.get('od')
+        if od is None:
+            continue
+        vcpus, mem, acc, acc_count = _AZURE_SPECS[sku]
+        spot = entry.get('spot')
+        rows.append({
+            'InstanceType': sku,
+            'vCPUs': str(vcpus),
+            'MemoryGiB': str(mem),
+            'AcceleratorName': acc or '',
+            'AcceleratorCount': str(acc_count) if acc else '',
+            'GpuInfo': '',
+            'Region': region,
+            'AvailabilityZone': f'{region}-1',
+            'Price': f'{od:.4f}',
+            'SpotPrice': f'{spot:.4f}' if spot is not None else '',
+        })
+    return rows
+
+
+# -------------------------------------------------------------------- aws
+
+# Public per-region EC2 offer files (no auth):
+_AWS_OFFER_URL = ('https://pricing.us-east-1.amazonaws.com/offers/v1.0/'
+                  'aws/AmazonEC2/current/{region}/index.json')
+
+# GPU name normalization for the offer file's gpu/instance fields.
+_AWS_GPU_BY_FAMILY = {
+    'p3': 'V100', 'p4d': 'A100', 'p4de': 'A100-80GB', 'p5': 'H100',
+    'g4dn': 'T4', 'g5': 'A10G', 'g6': 'L4',
+}
+
+
+def fetch_aws_vms(transport: Optional[Transport] = None,
+                  regions: Optional[List[str]] = None,
+                  families: Optional[List[str]] = None
+                  ) -> List[Dict[str, str]]:
+    """VM price rows for ``aws_vms.csv`` from the public EC2 offer files.
+
+    Parity: ``data_fetchers/fetch_aws.py`` — Linux/Shared/Used on-demand
+    prices; spot prices change continuously and come from the spot API,
+    so the column is left empty on refresh (the bundled CSV keeps
+    hand-curated snapshots).
+    """
+    transport = transport or _azure_public_transport  # plain public GET
+    regions = regions or ['us-east-1', 'us-west-2']
+    logger.warning('EC2 offer files are very large (hundreds of MB to GBs '
+                   'per region); the refresh downloads and parses each in '
+                   'memory — expect several GB of peak RSS.')
+    families = families or ['m6i', 'c6i', 'r6i', 'p4d', 'p4de', 'p5',
+                            'g5', 'g4dn']
+    rows: List[Dict[str, str]] = []
+    for region in regions:
+        payload = transport(_AWS_OFFER_URL.format(region=region), {})
+        products = payload.get('products', {})
+        ondemand = payload.get('terms', {}).get('OnDemand', {})
+        for sku_id, product in products.items():
+            attrs = product.get('attributes', {})
+            itype = attrs.get('instanceType', '')
+            family = itype.split('.')[0]
+            if family not in families:
+                continue
+            if (attrs.get('operatingSystem') != 'Linux' or
+                    attrs.get('tenancy') != 'Shared' or
+                    attrs.get('preInstalledSw', 'NA') != 'NA' or
+                    attrs.get('capacitystatus', 'Used') != 'Used'):
+                continue
+            price = _aws_od_price(ondemand.get(sku_id, {}))
+            if price is None or price <= 0:
+                continue
+            mem = attrs.get('memory', '').replace(' GiB', '').replace(
+                ',', '')
+            gpu_name = _AWS_GPU_BY_FAMILY.get(family, '')
+            gpu_count = attrs.get('gpu', '') if gpu_name else ''
+            rows.append({
+                'InstanceType': itype,
+                'vCPUs': attrs.get('vcpu', ''),
+                'MemoryGiB': mem,
+                'AcceleratorName': gpu_name,
+                'AcceleratorCount': gpu_count,
+                'GpuInfo': '',
+                'Region': region,
+                'AvailabilityZone': f'{region}a',
+                'Price': f'{price:.4f}',
+                'SpotPrice': '',
+            })
+    rows.sort(key=lambda r: (r['Region'], r['InstanceType']))
+    return rows
+
+
+def _aws_od_price(term_group: dict) -> Optional[float]:
+    for term in term_group.values():
+        for dim in term.get('priceDimensions', {}).values():
+            usd = dim.get('pricePerUnit', {}).get('USD')
+            if usd is not None:
+                return float(usd)
+    return None
+
+
+_FETCHERS = {
+    'gcp': lambda out, t: fetch_and_write_gcp(out, t),
+    'azure': lambda out, t: _write_vm_csv(fetch_azure_vms(t), out,
+                                          'azure_vms.csv'),
+    'aws': lambda out, t: _write_vm_csv(fetch_aws_vms(t), out,
+                                        'aws_vms.csv'),
+}
+
+
+def _write_vm_csv(rows: List[Dict[str, str]], out_dir: str,
+                  name: str) -> str:
+    path = os.path.join(os.path.expanduser(out_dir), name)
+    write_csv(rows, path)
+    return path
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(
         description='Refresh catalog CSVs from cloud pricing APIs.')
-    parser.add_argument('cloud', choices=['gcp'])
+    parser.add_argument('cloud', choices=sorted(_FETCHERS))
     parser.add_argument('--out-dir', default='~/.skytpu/catalog')
     args = parser.parse_args()
-    path = fetch_and_write_gcp(args.out_dir)
+    path = _FETCHERS[args.cloud](args.out_dir, None)
     print(f'Catalog written: {path}\n'
           f'Use it with SKYTPU_CATALOG_DIR={args.out_dir}')
 

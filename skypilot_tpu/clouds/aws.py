@@ -163,6 +163,7 @@ class AWS(cloud.Cloud):
                                         cluster_name_on_cloud, region, zones,
                                         num_nodes) -> Dict[str, object]:
         del cluster_name_on_cloud
+        from skypilot_tpu import skypilot_config
         return {
             'instance_type': resources.instance_type,
             'region': region.name,
@@ -171,6 +172,12 @@ class AWS(cloud.Cloud):
             'disk_size': resources.disk_size,
             'image_id': resources.image_id,
             'num_nodes': num_nodes,
+            # Networking: without these the default-VPC default SG blocks
+            # inbound SSH (see provision/aws/ec2_api.py).
+            'security_group_ids': skypilot_config.get_nested(
+                ('aws', 'security_group_ids'), None),
+            'subnet_id': skypilot_config.get_nested(
+                ('aws', 'subnet_id'), None),
         }
 
     # ----------------------------------------------------------- identity

@@ -34,15 +34,32 @@ class Ec2ApiError(Exception):
 
 
 class AwsCapacityError(Ec2ApiError):
-    """InsufficientInstanceCapacity / quota — failover blocklists the
-    zone."""
+    """Capacity exhaustion. ``scope`` tells the failover engine how much
+    to blocklist: 'zone' for a zonal stockout, 'region' for account/region
+    quota limits (retrying sister zones cannot help)."""
+
+    def __init__(self, message: str, scope: str = 'zone'):
+        super().__init__(message)
+        self.scope = scope
 
 
 # Exact AWS error codes only: a bare 'capacity' substring would also match
 # e.g. InvalidCapacityReservationId config errors and burn the candidate
 # list (see FailoverCloudErrorHandler.classify).
-_CAPACITY_MARKERS = ('insufficientinstancecapacity', 'instancelimitexceeded',
-                     'vcpulimitexceeded', 'maxspotinstancecountexceeded')
+_CAPACITY_SCOPES = {
+    'insufficientinstancecapacity': 'zone',
+    'instancelimitexceeded': 'region',
+    'vcpulimitexceeded': 'region',
+    'maxspotinstancecountexceeded': 'region',
+}
+
+
+def _capacity_scope(message: str):
+    lowered = message.lower()
+    for marker, scope in _CAPACITY_SCOPES.items():
+        if marker in lowered:
+            return scope
+    return None
 
 
 class CliTransport:
@@ -61,8 +78,9 @@ class CliTransport:
             check=False)
         if proc.returncode != 0:
             msg = proc.stderr.strip()
-            if any(m in msg.lower() for m in _CAPACITY_MARKERS):
-                raise AwsCapacityError(msg)
+            scope = _capacity_scope(msg)
+            if scope is not None:
+                raise AwsCapacityError(msg, scope=scope)
             raise Ec2ApiError(f'aws ec2 {args[0]}: {msg}')
         return json.loads(proc.stdout) if proc.stdout.strip() else {}
 
@@ -84,6 +102,15 @@ class CliTransport:
         ]
         if config.get('key_name'):
             args += ['--key-name', config['key_name']]
+        # Without an explicit security group the default-VPC default SG
+        # blocks inbound SSH and the launch dies as a wait_for_ssh
+        # timeout; users set aws.security_group_ids / aws.subnet_id in
+        # ~/.skytpu/config.yaml.
+        if config.get('security_group_ids'):
+            args += ['--security-group-ids'] + list(
+                config['security_group_ids'])
+        if config.get('subnet_id'):
+            args += ['--subnet-id', config['subnet_id']]
         if zone:
             args += ['--placement', json.dumps({'AvailabilityZone': zone})]
         if config.get('use_spot'):

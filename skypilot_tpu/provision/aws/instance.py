@@ -55,9 +55,44 @@ def run_instances(region: str, cluster_name_on_cloud: str,
         _cluster_filter(cluster_name_on_cloud))
     by_index = {_node_index(i): i for i in existing}
 
+    # A cluster must live in ONE zone: adopting leftovers from another
+    # zone would silently span AZs while the record claims `zone`.
+    for inst in existing:
+        inst_zone = inst.get('Placement', {}).get('AvailabilityZone')
+        if zone and inst_zone and inst_zone != zone:
+            raise common.ProvisionerError(
+                f'Cluster {cluster_name_on_cloud} has instances in '
+                f'{inst_zone} but {zone} was requested; run `down` first.')
+
     created: List[str] = []
     resumed: List[str] = []
     head_id: Optional[str] = None
+    try:
+        _create_nodes(client, zone, cluster_name_on_cloud, config,
+                      by_index, created, resumed)
+    except ec2_api.AwsCapacityError:
+        # Failover moves on (possibly to another region whose client can
+        # never see these): partially-created nodes would bill forever.
+        if created:
+            client.terminate_instances(created)
+        raise
+    for i in range(config.count):
+        inst = by_index.get(i)
+        if inst is not None and i == 0:
+            head_id = inst['InstanceId']
+    head_id = head_id or (created[0] if created else None)
+    assert head_id is not None
+    return common.ProvisionRecord(provider_name='aws',
+                                  region=region,
+                                  zone=zone,
+                                  cluster_name=cluster_name_on_cloud,
+                                  head_instance_id=head_id,
+                                  resumed_instance_ids=resumed,
+                                  created_instance_ids=created)
+
+
+def _create_nodes(client, zone, cluster_name_on_cloud, config, by_index,
+                  created, resumed) -> None:
     for i in range(config.count):
         inst = by_index.get(i)
         if inst is not None:
@@ -78,6 +113,9 @@ def run_instances(region: str, cluster_name_on_cloud: str,
             'image_id': config.node_config.get('image_id'),
             'use_spot': config.node_config.get('use_spot', False),
             'key_name': config.authentication_config.get('key_name'),
+            'security_group_ids':
+                config.node_config.get('security_group_ids'),
+            'subnet_id': config.node_config.get('subnet_id'),
             'tags': {
                 _CLUSTER_TAG: cluster_name_on_cloud,
                 _NODE_TAG: str(i),
@@ -85,18 +123,7 @@ def run_instances(region: str, cluster_name_on_cloud: str,
             },
         }
         insts = client.run_instances(zone, 1, node_cfg)
-        iid = insts[0]['InstanceId']
-        created.append(iid)
-        if i == 0:
-            head_id = iid
-    assert head_id is not None
-    return common.ProvisionRecord(provider_name='aws',
-                                  region=region,
-                                  zone=zone,
-                                  cluster_name=cluster_name_on_cloud,
-                                  head_instance_id=head_id,
-                                  resumed_instance_ids=resumed,
-                                  created_instance_ids=created)
+        created.append(insts[0]['InstanceId'])
 
 
 def wait_instances(region: str, cluster_name_on_cloud: str,

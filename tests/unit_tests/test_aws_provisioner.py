@@ -81,3 +81,27 @@ def test_clusters_isolated_by_tag():
     aws_instance.terminate_instances('ca', _provider_config())
     assert aws_instance.query_instances('ca', _provider_config()) == {}
     assert len(aws_instance.query_instances('cb', _provider_config())) == 1
+
+
+def test_quota_errors_blocklist_the_region():
+    from skypilot_tpu.backends import gang_backend
+    handler = gang_backend.FailoverCloudErrorHandler
+    zonal = ec2_api.AwsCapacityError('InsufficientInstanceCapacity in 1a',
+                                     scope='zone')
+    quota = ec2_api.AwsCapacityError('VcpuLimitExceeded', scope='region')
+    assert handler.classify(zonal) == handler.ZONE
+    assert handler.classify(quota) == handler.REGION
+    assert ec2_api._capacity_scope('VcpuLimitExceeded: ...') == 'region'
+    assert ec2_api._capacity_scope(
+        'InsufficientInstanceCapacity: no capacity') == 'zone'
+    assert ec2_api._capacity_scope('InvalidCapacityReservationId') is None
+
+
+def test_zone_mismatch_rejected():
+    """Existing instances in another AZ must not be silently adopted."""
+    aws_instance.run_instances('us-east-1', 'tz', _config())
+    cfg = _config()
+    cfg.provider_config['availability_zone'] = 'us-east-1b'
+    with pytest.raises(provision_common.ProvisionerError,
+                       match='us-east-1a'):
+        aws_instance.run_instances('us-east-1', 'tz', cfg)

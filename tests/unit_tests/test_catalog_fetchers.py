@@ -111,3 +111,132 @@ def test_bundled_catalog_has_dws_and_v6e():
         None
     assert catalog.tpu_price_per_chip_hour('v6e', 'us-central2') == 2.7
     assert len(catalog.tpu_regions_zones('v5p')) >= 5
+
+
+# ------------------------------------------------------------------ azure
+
+
+def test_fetch_azure_vms_from_fixture():
+    """Retail Prices API fixture → azure_vms.csv rows (Linux only, spot
+    from Spot meters, unknown SKUs skipped)."""
+    pages = {
+        'eastus': {
+            'Items': [
+                {'armSkuName': 'Standard_D4s_v5', 'armRegionName': 'eastus',
+                 'meterName': 'D4s v5', 'productName':
+                 'Virtual Machines Dsv5 Series', 'retailPrice': 0.192},
+                {'armSkuName': 'Standard_D4s_v5', 'armRegionName': 'eastus',
+                 'meterName': 'D4s v5 Spot', 'productName':
+                 'Virtual Machines Dsv5 Series', 'retailPrice': 0.05},
+                # Windows priced SKU must be ignored.
+                {'armSkuName': 'Standard_D4s_v5', 'armRegionName': 'eastus',
+                 'meterName': 'D4s v5', 'productName':
+                 'Virtual Machines Dsv5 Series Windows',
+                 'retailPrice': 0.38},
+                # Low Priority (classic) must be ignored.
+                {'armSkuName': 'Standard_D4s_v5', 'armRegionName': 'eastus',
+                 'meterName': 'D4s v5 Low Priority', 'productName':
+                 'Virtual Machines Dsv5 Series', 'retailPrice': 0.04},
+                # Unknown SKU: skipped, never guessed.
+                {'armSkuName': 'Standard_M416ms_v2',
+                 'armRegionName': 'eastus', 'meterName': 'M416ms v2',
+                 'productName': 'Virtual Machines MSv2 Series',
+                 'retailPrice': 110.0},
+                {'armSkuName': 'Standard_ND96amsr_A100_v4',
+                 'armRegionName': 'eastus', 'meterName':
+                 'ND96amsr A100 v4', 'productName':
+                 'Virtual Machines NDamsrA100v4 Series',
+                 'retailPrice': 32.77},
+            ],
+        },
+    }
+
+    def transport(url, params):
+        f = params.get('$filter', '')
+        for region, page in pages.items():
+            if f"armRegionName eq '{region}'" in f:
+                return page
+        return {'Items': []}
+
+    rows = fetchers.fetch_azure_vms(transport, regions=['eastus'])
+    by_type = {r['InstanceType']: r for r in rows}
+    assert set(by_type) == {'Standard_D4s_v5', 'Standard_ND96amsr_A100_v4'}
+    d4 = by_type['Standard_D4s_v5']
+    assert d4['Price'] == '0.1920' and d4['SpotPrice'] == '0.0500'
+    assert d4['vCPUs'] == '4' and d4['MemoryGiB'] == '16'
+    nd = by_type['Standard_ND96amsr_A100_v4']
+    assert nd['AcceleratorName'] == 'A100-80GB'
+    assert nd['AcceleratorCount'] == '8'
+
+
+# -------------------------------------------------------------------- aws
+
+
+def test_fetch_aws_vms_from_fixture():
+    """EC2 offer-file fixture → aws_vms.csv rows (Linux/Shared/Used only,
+    family filter applied)."""
+    offer = {
+        'products': {
+            'SKU1': {'attributes': {
+                'instanceType': 'm6i.large', 'vcpu': '2',
+                'memory': '8 GiB', 'operatingSystem': 'Linux',
+                'tenancy': 'Shared', 'preInstalledSw': 'NA',
+                'capacitystatus': 'Used'}},
+            # Windows row ignored.
+            'SKU2': {'attributes': {
+                'instanceType': 'm6i.large', 'vcpu': '2',
+                'memory': '8 GiB', 'operatingSystem': 'Windows',
+                'tenancy': 'Shared', 'preInstalledSw': 'NA',
+                'capacitystatus': 'Used'}},
+            'SKU3': {'attributes': {
+                'instanceType': 'p4d.24xlarge', 'vcpu': '96',
+                'memory': '1,152 GiB', 'gpu': '8',
+                'operatingSystem': 'Linux', 'tenancy': 'Shared',
+                'preInstalledSw': 'NA', 'capacitystatus': 'Used'}},
+            # Excluded family.
+            'SKU4': {'attributes': {
+                'instanceType': 'x2gd.medium', 'vcpu': '1',
+                'memory': '16 GiB', 'operatingSystem': 'Linux',
+                'tenancy': 'Shared', 'preInstalledSw': 'NA',
+                'capacitystatus': 'Used'}},
+        },
+        'terms': {'OnDemand': {
+            'SKU1': {'T1': {'priceDimensions': {'D1': {
+                'pricePerUnit': {'USD': '0.0960000000'}}}}},
+            'SKU2': {'T1': {'priceDimensions': {'D1': {
+                'pricePerUnit': {'USD': '0.1800000000'}}}}},
+            'SKU3': {'T1': {'priceDimensions': {'D1': {
+                'pricePerUnit': {'USD': '32.7726000000'}}}}},
+        }},
+    }
+
+    def transport(url, params):
+        assert 'us-east-1' in url
+        return offer
+
+    rows = fetchers.fetch_aws_vms(transport, regions=['us-east-1'])
+    by_type = {r['InstanceType']: r for r in rows}
+    assert set(by_type) == {'m6i.large', 'p4d.24xlarge'}
+    assert by_type['m6i.large']['Price'] == '0.0960'
+    p4d = by_type['p4d.24xlarge']
+    assert p4d['AcceleratorName'] == 'A100'
+    assert p4d['AcceleratorCount'] == '8'
+    assert p4d['MemoryGiB'] == '1152'
+
+
+def test_written_azure_csv_loads_into_catalog(tmp_path, monkeypatch):
+    """The refreshed CSV round-trips through the catalog override dir."""
+    rows = [{
+        'InstanceType': 'Standard_D4s_v5', 'vCPUs': '4',
+        'MemoryGiB': '16', 'AcceleratorName': '', 'AcceleratorCount': '',
+        'GpuInfo': '', 'Region': 'eastus',
+        'AvailabilityZone': 'eastus-1', 'Price': '0.2000',
+        'SpotPrice': '0.0500',
+    }]
+    fetchers.write_csv(rows, str(tmp_path / 'azure_vms.csv'))
+    monkeypatch.setenv('SKYTPU_CATALOG_DIR', str(tmp_path))
+    from skypilot_tpu import catalog
+    catalog.invalidate_cache()
+    assert catalog.get_hourly_cost('Standard_D4s_v5', 'eastus', False,
+                                   cloud='azure') == 0.2
+    catalog.invalidate_cache()
