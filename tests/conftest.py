@@ -15,6 +15,14 @@ import sys
 os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '') +
                            ' --xla_force_host_platform_device_count=8')
 os.environ['JAX_PLATFORMS'] = 'cpu'
+# Persistent compile cache: jax compiles dominate the slow tests (sharded
+# train steps ~30-75s each); cached re-runs drop them to seconds. A stable
+# path OUTSIDE the per-test isolated $HOME so every test (and spawned
+# skylet/controller subprocess) shares it across runs.
+os.environ.setdefault('JAX_COMPILATION_CACHE_DIR',
+                      f'/tmp/skytpu_jax_cache_{os.getuid()}')
+os.environ.setdefault('JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES', '0')
+os.environ.setdefault('JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS', '0')
 import jax  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')
