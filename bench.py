@@ -23,21 +23,9 @@ sys.path.insert(0, __file__.rsplit('/', 1)[0])
 
 import jax
 
-if os.environ.get('JAX_PLATFORMS'):
-    # Restore env semantics (the TPU plugin overrides platform selection).
-    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+from skypilot_tpu.benchmark import harness
 
-# Device-init watchdog: a wedged TPU tunnel blocks PJRT client creation
-# forever (no timeout in the dial loop). faulthandler's C-level watchdog
-# fires without needing the GIL (a Python Timer could be starved by the
-# very native dial loop it guards); on timeout it prints the hang stack
-# ("Timeout!" + jax.devices() frames = wedged tunnel) and exits.
-_INIT_TIMEOUT = float(os.environ.get('SKYTPU_BENCH_INIT_TIMEOUT', '300'))
-if _INIT_TIMEOUT > 0:
-    import faulthandler
-    faulthandler.dump_traceback_later(_INIT_TIMEOUT, exit=True)
-    jax.devices()  # blocks here when the tunnel is wedged
-    faulthandler.cancel_dump_traceback_later()
+harness.init_devices()  # env restore + wedged-tunnel watchdog
 
 import jax.numpy as jnp
 

@@ -1,0 +1,106 @@
+"""Decode (serving) throughput benchmark: decode-phase tokens/s on one chip.
+
+The inference-side counterpart of ``bench.py`` (train) — the measurement
+surface behind BASELINE.md's serving row (the reference's serving recipes
+are vLLM YAMLs, ``/root/reference/llm/vllm/service.yaml``; here the model
+IS in-tree, so the benchmark drives ``models/decode`` directly:
+static-shape KV-cache prefill + scanned decode). Prefill time is measured
+separately and subtracted, so the reported number is DECODE tokens/s.
+
+Prints ONE JSON line:
+    {"metric": "llama_decode_tokens_per_sec", "value": N,
+     "unit": "tokens/s/chip", ...}
+"""
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from skypilot_tpu.benchmark import harness
+
+import jax
+import jax.numpy as jnp
+
+
+def run_decode_bench(model_name: str, batch: int, prompt_len: int,
+                     new_tokens: int, steps: int = 5) -> dict:
+    from skypilot_tpu.models import decode, llama
+
+    devices = harness.init_devices()
+    on_accelerator = devices[0].platform != 'cpu'
+    if not on_accelerator:
+        # CPU dev fallback: tiny shapes, still one JSON line.
+        model_name, batch, prompt_len, new_tokens = 'debug', 2, 16, 8
+        steps = min(steps, 2)
+
+    cfg = dataclasses.replace(llama.CONFIGS[model_name], remat=False)
+    dcfg = decode.DecodeConfig(max_len=prompt_len + new_tokens,
+                               temperature=0.0)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1),
+                                (batch, prompt_len), 0, cfg.vocab_size)
+    prompt_lens = jnp.full((batch,), prompt_len, jnp.int32)
+
+    gen = jax.jit(lambda p, t, l: decode.generate(
+        p, t, l, cfg, dcfg, new_tokens))
+
+    def prefill_only(p, t, l):
+        cache = decode.init_kv_cache(cfg, batch, dcfg.max_len)
+        logits, _ = decode.prefill(p, t, cfg, cache, l)
+        return logits
+
+    pre = jax.jit(prefill_only)
+
+    def timed(fn, n) -> float:
+        # Warmup/compile; a host fetch is the only reliable sync on the
+        # tunneled TPU platform.
+        _ = float(jnp.sum(fn(params, prompt, prompt_lens).astype(
+            jnp.float32)[0]))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(params, prompt, prompt_lens)
+        _ = float(jnp.sum(out.astype(jnp.float32)[0]))
+        return (time.perf_counter() - t0) / n
+
+    gen_dt = timed(gen, steps)
+    pre_dt = timed(pre, steps)
+    decode_dt = max(gen_dt - pre_dt, 1e-9)
+
+    tokens_per_sec = batch * new_tokens / decode_dt
+    return {
+        'metric': 'llama_decode_tokens_per_sec',
+        'value': round(tokens_per_sec, 1),
+        'unit': 'tokens/s/chip',
+        'detail': {
+            'model': model_name,
+            'params': cfg.num_params(),
+            'batch': batch,
+            'prompt_len': prompt_len,
+            'new_tokens': new_tokens,
+            'steps': steps,
+            'prefill_ms': round(pre_dt * 1e3, 1),
+            'device': str(devices[0]),
+        },
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='bench-1b')
+    parser.add_argument('--batch', type=int, default=16)
+    parser.add_argument('--prompt-len', type=int, default=128)
+    parser.add_argument('--new-tokens', type=int, default=128)
+    parser.add_argument('--steps', type=int, default=5)
+    args = parser.parse_args()
+    print(json.dumps(run_decode_bench(args.model, args.batch,
+                                      args.prompt_len, args.new_tokens,
+                                      args.steps)))
+
+
+if __name__ == '__main__':
+    main()

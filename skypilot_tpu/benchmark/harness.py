@@ -1,0 +1,29 @@
+"""Shared init for the benchmark entrypoints (bench.py, decode_bench).
+
+One place for the two tunneled-TPU gotchas:
+* the plugin force-overrides JAX_PLATFORMS at registration — restore env
+  semantics so `JAX_PLATFORMS=cpu python bench.py` works;
+* a wedged tunnel blocks PJRT client creation forever — arm a C-level
+  faulthandler watchdog around the first device query so the bench fails
+  fast with the hang stack instead of hanging the harness.
+"""
+import os
+
+import jax
+
+
+def init_devices(timeout_env: str = 'SKYTPU_BENCH_INIT_TIMEOUT') -> list:
+    """Restore platform env semantics, then enumerate devices under a
+    watchdog. Returns jax.devices()."""
+    if os.environ.get('JAX_PLATFORMS'):
+        jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+    timeout = float(os.environ.get(timeout_env, '300'))
+    if timeout > 0:
+        import faulthandler
+        # C watchdog: fires without the GIL (the wedged dial loop is
+        # native and may hold it), dumps the stack, exits.
+        faulthandler.dump_traceback_later(timeout, exit=True)
+        devices = jax.devices()
+        faulthandler.cancel_dump_traceback_later()
+        return devices
+    return jax.devices()

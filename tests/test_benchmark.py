@@ -81,3 +81,12 @@ def test_bench_show_unknown():
     from skypilot_tpu import exceptions
     with pytest.raises(exceptions.InvalidSkyError):
         benchmark_utils.show('nope')
+
+
+def test_decode_bench_smoke():
+    """decode_bench emits one well-formed JSON line on the CPU path."""
+    from skypilot_tpu.benchmark import decode_bench
+    result = decode_bench.run_decode_bench('bench-1b', 16, 128, 128)
+    assert result['metric'] == 'llama_decode_tokens_per_sec'
+    assert result['value'] > 0
+    assert result['unit'] == 'tokens/s/chip'
