@@ -33,6 +33,20 @@ class DecodeConfig:
     eos_id: Optional[int] = None
 
 
+def quantize_params(params: Params) -> Params:
+    """Int8-quantize the FFN weights (the FLOPs- and bytes-dominant GEMMs)
+    for serving. Layer weights are stacked [L, in, out]: the contraction
+    axis is 1, so scales are per (layer, output-channel). The quantized
+    tensors flow through scan/jit as pytrees (ops/quant.py)."""
+    from skypilot_tpu.ops import quant
+    out = dict(params)
+    layers = dict(params['layers'])
+    for name in ('w1', 'w3', 'w2'):
+        layers[name] = quant.quantize_int8(layers[name], axis=1)
+    out['layers'] = layers
+    return out
+
+
 def init_kv_cache(cfg: llama.LlamaConfig, batch: int,
                   max_len: int) -> Dict[str, jax.Array]:
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)

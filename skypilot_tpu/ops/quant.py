@@ -1,0 +1,93 @@
+"""Int8 quantized matmul for TPU inference (weight + dynamic activation).
+
+TPU-native serving lever: the v5e/v6e MXU runs int8×int8→int32 at ~2× the
+bf16 rate, and int8 weights halve the HBM traffic that bounds decode. The
+scheme is symmetric per-channel (AQT-style, the approach of public JAX
+quantization libraries):
+
+* weights: per-OUTPUT-channel scale, quantized once offline
+  (:func:`quantize_int8`);
+* activations: per-ROW (token) scale computed dynamically at each call —
+  ``x_int8 = round(x / scale_x)`` — so no calibration pass is needed;
+* ``y = (x_int8 @ w_int8) * scale_x * scale_w`` accumulated in int32
+  (``preferred_element_type``), rescaled to the requested dtype.
+
+No reference counterpart: SkyPilot delegates serving kernels to vLLM;
+here the model is in-tree, so the quantization op is too.
+"""
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_INT8_MAX = 127.0
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantizedTensor:
+    """int8 values + fp32 scale broadcastable against the matmul output."""
+    values: jax.Array   # int8
+    scale: jax.Array    # f32, shape broadcastable to the output
+
+    @property
+    def shape(self):
+        return self.values.shape
+
+
+def _symmetric_quantize(x: jax.Array,
+                        axis: int) -> Tuple[jax.Array, jax.Array]:
+    """One copy of the scheme's numerics (amax → floored scale →
+    round/clip) shared by weight and activation quantization."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis,
+                   keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / _INT8_MAX
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                 -_INT8_MAX, _INT8_MAX).astype(jnp.int8)
+    return q, scale
+
+
+def quantize_int8(w: jax.Array, axis: int = 0) -> QuantizedTensor:
+    """Symmetric per-channel quantization of a weight matrix.
+
+    ``axis`` is the CONTRACTION axis (reduced in the matmul); the scale is
+    computed per remaining (output) channel so each output column keeps
+    its own dynamic range.
+    """
+    q, scale = _symmetric_quantize(w, axis)
+    return QuantizedTensor(values=q, scale=scale)
+
+
+def _quantize_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Dynamic per-row activation quantization: [..., K] → int8 + scale."""
+    return _symmetric_quantize(x, -1)
+
+
+def int8_matmul(x: jax.Array, qw: QuantizedTensor,
+                out_dtype: Optional[jnp.dtype] = None) -> jax.Array:
+    """``x @ w`` with both operands int8 on the MXU.
+
+    x: [..., K] float; qw: quantized [K, N]. Returns [..., N] in
+    ``out_dtype`` (default: x.dtype).
+    """
+    out_dtype = out_dtype or x.dtype
+    assert qw.values.ndim == 2, (
+        'int8_matmul takes a 2-D quantized weight; stacked [L, K, N] '
+        'tensors (decode.quantize_params) are valid only after lax.scan '
+        f'slices the layer axis. Got shape {qw.values.shape}.')
+    xq, sx = _quantize_rows(x)
+    acc = jax.lax.dot_general(
+        xq, qw.values,
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    y = acc.astype(jnp.float32) * sx * qw.scale.reshape(
+        (1,) * (acc.ndim - 1) + (-1,))
+    return y.astype(out_dtype)
+
+
+# QuantizedTensor flows through jit/scan as a pytree (values + scale
+# leaves); the frozen dataclass itself is static-safe metadata-free.
+jax.tree_util.register_pytree_node(
+    QuantizedTensor,
+    lambda qt: ((qt.values, qt.scale), None),
+    lambda _, leaves: QuantizedTensor(values=leaves[0], scale=leaves[1]))
