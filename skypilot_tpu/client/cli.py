@@ -29,11 +29,31 @@ def _table(header, rows) -> str:
 
 
 def _load_task(entrypoint: str, overrides) -> task_lib.Task:
-    task = task_lib.Task.from_yaml(entrypoint)
+    env_map = {}
+    for item in overrides.get('envs') or ():
+        key, eq, value = item.partition('=')
+        if not eq or not key:
+            raise click.BadParameter(
+                f'--env takes KEY=VALUE, got {item!r}')
+        env_map[key] = value
+    # env overrides go through from_yaml so ${VAR} templates in the YAML
+    # (num_nodes, resources, ...) see the CLI values too.
+    task = task_lib.Task.from_yaml(entrypoint, env_overrides=env_map or None)
+    if env_map:
+        task.update_envs(env_map)
     if overrides.get('name'):
         task.name = overrides['name']
     if overrides.get('num_nodes'):
         task.num_nodes = overrides['num_nodes']
+    # Resource overrides (parity: sky launch --cloud/--region/--gpus/...).
+    res_override = {
+        k: overrides[k]
+        for k in ('cloud', 'region', 'zone', 'accelerators', 'cpus',
+                  'memory', 'use_spot')
+        if overrides.get(k) is not None
+    }
+    if res_override:
+        task.set_resources_override(res_override)
     return task
 
 
@@ -68,10 +88,26 @@ def cli():
 @click.option('--idle-minutes-to-autostop', '-i', type=int, default=None)
 @click.option('--down', is_flag=True, default=False,
               help='Autodown after the job finishes.')
+@click.option('--cloud', default=None, help='Override the cloud.')
+@click.option('--region', default=None, help='Override the region.')
+@click.option('--zone', default=None, help='Override the zone.')
+@click.option('--accelerators', '--tpus', '--gpus', default=None,
+              help="Override accelerators, e.g. 'tpu-v5e:16'.")
+@click.option('--cpus', default=None)
+@click.option('--memory', default=None)
+@click.option('--use-spot/--no-use-spot', default=None)
+@click.option('--env', 'envs', multiple=True,
+              help='Override a task env: KEY=VALUE (repeatable).')
 def launch(entrypoint, cluster, name, num_nodes, detach_run, dryrun,
-           retry_until_up, idle_minutes_to_autostop, down):
+           retry_until_up, idle_minutes_to_autostop, down, cloud, region,
+           zone, accelerators, cpus, memory, use_spot, envs):
     """Launch a task from a YAML spec (provision + run)."""
-    task = _load_task(entrypoint, {'name': name, 'num_nodes': num_nodes})
+    task = _load_task(entrypoint, {
+        'name': name, 'num_nodes': num_nodes, 'cloud': cloud,
+        'region': region, 'zone': zone, 'accelerators': accelerators,
+        'cpus': cpus, 'memory': memory, 'use_spot': use_spot,
+        'envs': envs,
+    })
     request_id = sdk.launch(
         task, cluster_name=cluster, retry_until_up=retry_until_up,
         idle_minutes_to_autostop=idle_minutes_to_autostop, dryrun=dryrun,

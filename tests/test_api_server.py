@@ -145,3 +145,22 @@ def test_cli_help_surface():
                 'autostop', 'queue', 'cancel', 'logs', 'jobs', 'serve',
                 'storage', 'check', 'cost-report', 'show-tpus', 'api'):
         assert cmd in res.output, f'missing {cmd}'
+
+
+def test_cli_load_task_overrides(tmp_path):
+    """--cloud/--accelerators/--env overrides rewrite the YAML task
+    (parity: sky launch resource override flags)."""
+    from skypilot_tpu.client import cli as cli_mod
+    yaml_path = tmp_path / 't.yaml'
+    yaml_path.write_text(
+        'name: t\nresources:\n  cloud: local\nrun: echo hi\n')
+    task = cli_mod._load_task(str(yaml_path), {
+        'cloud': 'gcp',
+        'accelerators': 'tpu-v5e:8',
+        'envs': ('A=1', 'B=x=y'),
+    })
+    res = next(iter(task.resources))
+    assert res.cloud.name == 'gcp'
+    assert res.accelerators == {'tpu-v5e': 8}
+    assert task.envs['A'] == '1'
+    assert task.envs['B'] == 'x=y'

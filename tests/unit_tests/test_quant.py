@@ -59,10 +59,11 @@ def test_quantized_tensor_is_a_pytree():
     np.testing.assert_allclose(np.asarray(y), 8.0, rtol=0.02)
 
 
-def test_int8_decode_matches_fp_generation():
-    """Greedy decode with int8 FFN weights stays token-identical on a
-    tiny model (quant noise far below argmax margins at small scale) and
-    prefill logits stay close."""
+def test_int8_decode_runs_and_prefill_stays_close():
+    """Quantized params flow through prefill + scanned decode: prefill
+    logits stay within quantization tolerance of fp, and generation
+    produces well-formed tokens. (Token-level agreement is NOT asserted:
+    a random-init model's argmax margins are below quant noise.)"""
     import dataclasses
 
     from skypilot_tpu.models import decode, llama
