@@ -31,8 +31,24 @@ _GEN_TO_GKE_ACCELERATOR = {
 }
 _GKE_V5E_SINGLE_HOST = 'tpu-v5-lite-device'
 
+# GKE GPU nodepool label key.
+GKE_GPU_LABEL = 'cloud.google.com/gke-accelerator'
+
 _INSTANCE_TYPE_RE = re.compile(r'^cpu(\d+)-mem(\d+)$')
 _DEFAULT_INSTANCE_TYPE = 'cpu4-mem16'
+
+# Accelerator name → GKE GPU nodepool label value
+# (cloud.google.com/gke-accelerator; parity: the reference's
+# GKELabelFormatter).
+_GPU_TO_GKE_LABEL = {
+    'T4': 'nvidia-tesla-t4',
+    'L4': 'nvidia-l4',
+    'V100': 'nvidia-tesla-v100',
+    'P100': 'nvidia-tesla-p100',
+    'A100': 'nvidia-tesla-a100',
+    'A100-80GB': 'nvidia-a100-80gb',
+    'H100': 'nvidia-h100-80gb',
+}
 
 
 def gke_accelerator_for(topo: topo_lib.TpuSliceTopology) -> Optional[str]:
@@ -213,9 +229,44 @@ class Kubernetes(cloud.Cloud):
 
         acc_name, acc_count = next(iter(accs.items()))
         if not topo_lib.is_tpu_accelerator(acc_name):
-            # GPU pods (nvidia.com/gpu) are not wired in this build: the
-            # compute stack is TPU-native.
-            return [], []
+            # GPU pods: feasible when a node advertises the matching GKE
+            # GPU nodepool label with enough nvidia.com/gpu allocatable.
+            if acc_count != int(acc_count):
+                # nvidia.com/gpu is an integer resource; truncating would
+                # silently schedule a 0-GPU pod.
+                return [], [f'{acc_name}:{int(acc_count) + 1}']
+            wanted_label = _GPU_TO_GKE_LABEL.get(acc_name)
+
+            def _advertised(ctx_list) -> List[str]:
+                """Accelerator NAMES the cluster's GPU nodepools offer."""
+                reverse = {v: k for k, v in _GPU_TO_GKE_LABEL.items()}
+                return sorted({
+                    reverse[lbl]
+                    for ctx in ctx_list for node in self._cluster_nodes(ctx)
+                    for lbl in [node.get('metadata', {}).get(
+                        'labels', {}).get(GKE_GPU_LABEL)]
+                    if lbl in reverse
+                })
+
+            if wanted_label is None:
+                return [], _advertised(contexts)
+            from skypilot_tpu.provision.kubernetes import k8s_api
+            for ctx in contexts:
+                for node in self._cluster_nodes(ctx):
+                    labels = node.get('metadata', {}).get('labels', {})
+                    alloc = node.get('status', {}).get('allocatable', {})
+                    if (labels.get(GKE_GPU_LABEL) == wanted_label and
+                            float(alloc.get(k8s_api.GPU_RESOURCE_KEY,
+                                            0)) >= acc_count):
+                        return [
+                            resources.copy(
+                                cloud=self,
+                                region=ctx if resources.region else None,
+                                instance_type=self.get_default_instance_type(
+                                    resources.cpus, resources.memory),
+                            )
+                        ], []
+            return [], _advertised(contexts)
         topo = topo_lib.resolve_topology(
             acc_name, acc_count,
             (resources.accelerator_args or {}).get('topology'))
@@ -231,7 +282,8 @@ class Kubernetes(cloud.Cloud):
                     resources.copy(
                         cloud=self,
                         region=ctx if resources.region else None,
-                        instance_type=_DEFAULT_INSTANCE_TYPE,
+                        instance_type=self.get_default_instance_type(
+                            resources.cpus, resources.memory),
                         accelerators={topo.name: topo.num_chips},
                     )
                 ], []
@@ -263,6 +315,21 @@ class Kubernetes(cloud.Cloud):
                 'accelerator_type': topo.gcp_accelerator_type,
                 'num_hosts': topo.num_hosts,
                 'chips_per_host': topo.chips_per_host,
+            })
+        elif resources.accelerators:
+            acc_name, acc_count = next(iter(resources.accelerators.items()))
+            label = _GPU_TO_GKE_LABEL.get(acc_name)
+            if label is None:
+                # Feasibility gates this; fail fast if reached directly —
+                # an empty selector value would pin the pod to nowhere.
+                from skypilot_tpu import exceptions
+                raise exceptions.InvalidSkyError(
+                    f'{acc_name} has no GKE nodepool label (supported: '
+                    f'{sorted(_GPU_TO_GKE_LABEL)}).')
+            vars_.update({
+                'gpu': acc_name,
+                'gpu_count': int(acc_count),
+                'node_selector': {GKE_GPU_LABEL: label},
             })
         return vars_
 

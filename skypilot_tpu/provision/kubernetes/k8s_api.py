@@ -138,6 +138,10 @@ _DEFAULT_FAKE_NODES: Dict[str, Dict[str, Any]] = {
                             TPU_RESOURCE_KEY: 4},
         } for i in range(4)
     },
+    'gpu-l4-node-0': {
+        'labels': {'cloud.google.com/gke-accelerator': 'nvidia-l4'},
+        'allocatable': {'cpu': 16, 'memory_gib': 64, GPU_RESOURCE_KEY: 4},
+    },
 }
 
 
@@ -225,10 +229,10 @@ class FakeK8sService:
         cpu_free = self._qty(alloc.get('cpu', 0)) - used.get('cpu', 0.0)
         if requests.get('cpu', 0.0) > cpu_free:
             return False
-        tpu_free = self._qty(alloc.get(TPU_RESOURCE_KEY, 0)) - used.get(
-            TPU_RESOURCE_KEY, 0.0)
-        if requests.get(TPU_RESOURCE_KEY, 0.0) > tpu_free:
-            return False
+        for key in (TPU_RESOURCE_KEY, GPU_RESOURCE_KEY):
+            free = self._qty(alloc.get(key, 0)) - used.get(key, 0.0)
+            if requests.get(key, 0.0) > free:
+                return False
         return True
 
     def _schedule(self, pods: Dict[str, Dict[str, Any]],

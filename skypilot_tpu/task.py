@@ -20,18 +20,51 @@ _VAR_PATTERN = re.compile(r'\$\{(\w+)\}')
 RunFn = Callable[[int, List[str]], Optional[str]]
 
 
-def _fill_env_vars(yaml_str: str, env_overrides: Dict[str, str]) -> str:
-    """Substitute ${VAR} from overrides then os.environ (parity task.py:78)."""
+def _typed(value: str):
+    """Full-scalar substitutions keep YAML scalar typing (num_nodes:
+    ${NODES} must become an int) without parsing the value as YAML — a
+    value is only ever a scalar, never structure (no injection)."""
+    for conv in (int, float):
+        try:
+            return conv(value)
+        except ValueError:
+            pass
+    if value.lower() in ('true', 'false'):
+        return value.lower() == 'true'
+    return value
 
-    def repl(m):
-        var = m.group(1)
+
+def _fill_env_vars(config, env_overrides: Dict[str, str]):
+    """Substitute ${VAR} from overrides then os.environ in the PARSED
+    config tree (parity task.py:78). Structure-level substitution: a
+    value containing YAML metacharacters ('a: b', newlines) stays one
+    string — it can never rewrite sibling fields.
+    """
+
+    def lookup(var: str):
         if var in env_overrides:
             return str(env_overrides[var])
-        if var in os.environ:
-            return os.environ[var]
-        return m.group(0)
+        return os.environ.get(var)
 
-    return _VAR_PATTERN.sub(repl, yaml_str)
+    def walk(node):
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, list):
+            return [walk(v) for v in node]
+        if isinstance(node, str):
+            full = _VAR_PATTERN.fullmatch(node.strip())
+            if full:
+                val = lookup(full.group(1))
+                return _typed(val) if val is not None else node
+
+            def repl(m):
+                val = lookup(m.group(1))
+                return val if val is not None else m.group(0)
+
+            return _VAR_PATTERN.sub(repl, node)
+        return node
+
+    return walk(config)
 
 
 class Task:
@@ -275,9 +308,7 @@ class Task:
                          env_overrides: Optional[Dict[str, str]] = None
                          ) -> 'Task':
         if env_overrides:
-            yaml_str = common_utils.dump_yaml_str(config)
-            config = __import__('yaml').safe_load(
-                _fill_env_vars(yaml_str, env_overrides))
+            config = _fill_env_vars(config, env_overrides)
         schemas.validate(config, schemas.get_task_schema(),
                          'Invalid task spec: ')
         config = dict(config)

@@ -206,3 +206,37 @@ def test_kubectl_runner_remote_path_expansion():
     assert KubectlExecRunner._remote_expr('~/x/y') == '"$HOME"/x/y'
     assert KubectlExecRunner._remote_expr('~') == '"$HOME"'
     assert KubectlExecRunner._remote_expr('/tmp/a b') == "'/tmp/a b'"
+
+
+def test_gpu_feasibility_from_gke_labels():
+    """GPU requests match nodes advertising the GKE GPU nodepool label
+    with enough nvidia.com/gpu allocatable."""
+    cloud = CLOUD_REGISTRY.from_str('kubernetes')
+    res = sky.Resources(cloud='kubernetes', accelerators={'L4': 2})
+    feasible, _ = cloud.get_feasible_launchable_resources(res, 1)
+    assert len(feasible) == 1
+    # More GPUs than any node has -> infeasible, advertised pools hinted.
+    res8 = sky.Resources(cloud='kubernetes', accelerators={'L4': 8})
+    feasible, hints = cloud.get_feasible_launchable_resources(res8, 1)
+    assert feasible == []
+    assert 'L4' in hints  # hints name what the cluster advertises
+    # Unknown-to-GKE accelerator: infeasible with the supported list.
+    resx = sky.Resources(cloud='kubernetes', accelerators={'A10G': 1})
+    feasible, hints = cloud.get_feasible_launchable_resources(resx, 1)
+    assert feasible == []
+
+
+def test_gpu_pod_manifest():
+    """GPU pods request nvidia.com/gpu and pin the GKE GPU nodepool."""
+    cfg = _config(node_config={
+        'gpu': 'L4', 'gpu_count': 2, 'cpus': 4.0, 'memory': 16.0,
+        'image': None, 'num_hosts': 1,
+        'node_selector': {'cloud.google.com/gke-accelerator': 'nvidia-l4'},
+    })
+    k8s_instance.run_instances('fake-gke', 'tgpu', cfg)
+    pod = k8s_api.make_client('fake-gke').get_pod('default', 'tgpu-0')
+    limits = pod['spec']['containers'][0]['resources']['limits']
+    assert limits[k8s_api.GPU_RESOURCE_KEY] == '2'
+    sel = pod['spec']['nodeSelector']
+    assert sel['cloud.google.com/gke-accelerator'] == 'nvidia-l4'
+    k8s_instance.terminate_instances('tgpu', _provider_config())
