@@ -227,14 +227,17 @@ class Kubernetes(cloud.Cloud):
                 resources.copy(cloud=self, instance_type=instance_type)
             ], []
 
+        # A user-pinned pod shape wins; otherwise derive from cpus/memory.
+        pod_shape = (resources.instance_type
+                     if resources.instance_type and
+                     self.instance_type_exists(resources.instance_type)
+                     else self.get_default_instance_type(
+                         resources.cpus, resources.memory))
+
         acc_name, acc_count = next(iter(accs.items()))
         if not topo_lib.is_tpu_accelerator(acc_name):
             # GPU pods: feasible when a node advertises the matching GKE
             # GPU nodepool label with enough nvidia.com/gpu allocatable.
-            if acc_count != int(acc_count):
-                # nvidia.com/gpu is an integer resource; truncating would
-                # silently schedule a 0-GPU pod.
-                return [], [f'{acc_name}:{int(acc_count) + 1}']
             wanted_label = _GPU_TO_GKE_LABEL.get(acc_name)
 
             def _advertised(ctx_list) -> List[str]:
@@ -250,6 +253,10 @@ class Kubernetes(cloud.Cloud):
 
             if wanted_label is None:
                 return [], _advertised(contexts)
+            if acc_count != int(acc_count):
+                # nvidia.com/gpu is an integer resource; truncating would
+                # silently schedule a 0-GPU pod.
+                return [], _advertised(contexts)
             from skypilot_tpu.provision.kubernetes import k8s_api
             for ctx in contexts:
                 for node in self._cluster_nodes(ctx):
@@ -262,8 +269,7 @@ class Kubernetes(cloud.Cloud):
                             resources.copy(
                                 cloud=self,
                                 region=ctx if resources.region else None,
-                                instance_type=self.get_default_instance_type(
-                                    resources.cpus, resources.memory),
+                                instance_type=pod_shape,
                             )
                         ], []
             return [], _advertised(contexts)
@@ -282,8 +288,7 @@ class Kubernetes(cloud.Cloud):
                     resources.copy(
                         cloud=self,
                         region=ctx if resources.region else None,
-                        instance_type=self.get_default_instance_type(
-                            resources.cpus, resources.memory),
+                        instance_type=pod_shape,
                         accelerators={topo.name: topo.num_chips},
                     )
                 ], []

@@ -46,9 +46,21 @@ def _fill_env_vars(config, env_overrides: Dict[str, str]):
             return str(env_overrides[var])
         return os.environ.get(var)
 
+    def subst_str(s: str) -> str:
+        def repl(m):
+            val = lookup(m.group(1))
+            return val if val is not None else m.group(0)
+
+        return _VAR_PATTERN.sub(repl, s)
+
     def walk(node):
         if isinstance(node, dict):
-            return {k: walk(v) for k, v in node.items()}
+            # Keys substitute too (parameterized mount paths / env names);
+            # keys stay strings — no scalar typing.
+            return {
+                (subst_str(k) if isinstance(k, str) else k): walk(v)
+                for k, v in node.items()
+            }
         if isinstance(node, list):
             return [walk(v) for v in node]
         if isinstance(node, str):
@@ -56,12 +68,7 @@ def _fill_env_vars(config, env_overrides: Dict[str, str]):
             if full:
                 val = lookup(full.group(1))
                 return _typed(val) if val is not None else node
-
-            def repl(m):
-                val = lookup(m.group(1))
-                return val if val is not None else m.group(0)
-
-            return _VAR_PATTERN.sub(repl, node)
+            return subst_str(node)
         return node
 
     return walk(config)

@@ -112,9 +112,16 @@ def test_dead_host_degrades_up_to_init(monkeypatch):
     assert rec['status'] == global_state.ClusterStatus.UP
 
     # Kill the node's skylet out-of-band (crashed host); instance state
-    # still says running.
+    # still says running. Signal delivery is asynchronous — wait until
+    # the process is actually gone/zombie, or the health probe can race
+    # the kill and legitimately observe a still-running skylet.
     runner = handle.head_runner()
-    rc = runner.run('kill -9 "$(cat ~/.skytpu/skylet.pid)"', timeout=15)
+    rc = runner.run(
+        'pid=$(cat ~/.skytpu/skylet.pid) && kill -9 "$pid" && '
+        'for i in $(seq 1 50); do '
+        's=$(awk \'{print $3}\' "/proc/$pid/stat" 2>/dev/null) || s=gone; '
+        'if [ "$s" = Z ] || [ "$s" = gone ]; then exit 0; fi; '
+        'sleep 0.1; done; exit 1', timeout=15)
     assert rc == 0
     rec = backend_utils.refresh_cluster_record('t-health',
                                                force_refresh=True)
