@@ -44,7 +44,8 @@ def run_decode_bench(model_name: str, batch: int, prompt_len: int,
                                temperature=0.0)
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     if int8:
-        # Int8 FFN weights: ~2x MXU rate + half the weight HBM traffic.
+        # Int8 FFN + attention-projection weights: ~2x MXU rate and
+        # half the weight HBM traffic.
         params = decode.quantize_params(params)
     prompt = jax.random.randint(jax.random.PRNGKey(1),
                                 (batch, prompt_len), 0, cfg.vocab_size)
@@ -102,7 +103,8 @@ def main() -> None:
     parser.add_argument('--new-tokens', type=int, default=128)
     parser.add_argument('--steps', type=int, default=5)
     parser.add_argument('--int8', action='store_true',
-                        help='int8-quantize the FFN weights')
+                        help='int8-quantize the FFN + attention projection '
+                             'weights')
     args = parser.parse_args()
     print(json.dumps(run_decode_bench(args.model, args.batch,
                                       args.prompt_len, args.new_tokens,

@@ -34,14 +34,15 @@ class DecodeConfig:
 
 
 def quantize_params(params: Params) -> Params:
-    """Int8-quantize the FFN weights (the FLOPs- and bytes-dominant GEMMs)
-    for serving. Layer weights are stacked [L, in, out]: the contraction
-    axis is 1, so scales are per (layer, output-channel). The quantized
-    tensors flow through scan/jit as pytrees (ops/quant.py)."""
+    """Int8-quantize the per-layer GEMM weights (FFN + attention
+    projections) for serving. Layer weights are stacked [L, in, out]: the
+    contraction axis is 1, so scales are per (layer, output-channel). The
+    quantized tensors flow through scan/jit as pytrees (ops/quant.py).
+    Embedding/lm_head and the KV cache stay bf16."""
     from skypilot_tpu.ops import quant
     out = dict(params)
     layers = dict(params['layers'])
-    for name in ('w1', 'w3', 'w2'):
+    for name in ('w1', 'w3', 'w2', 'wq', 'wk', 'wv', 'wo'):
         layers[name] = quant.quantize_int8(layers[name], axis=1)
     out['layers'] = layers
     return out
@@ -85,9 +86,11 @@ def _block_decode(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
     b, s, _ = x.shape  # s == 1
     hd = cfg.head_dim
     h = llama.rms_norm(x, layer['attn_norm'], cfg.norm_eps)
-    q = (h @ layer['wq']).reshape(b, s, cfg.n_heads, hd)
-    k = (h @ layer['wk']).reshape(b, s, cfg.n_kv_heads, hd)
-    v = (h @ layer['wv']).reshape(b, s, cfg.n_kv_heads, hd)
+    q = llama.quant_mm(h, layer['wq']).reshape(b, s, cfg.n_heads, hd)
+    k = llama.quant_mm(h, layer['wk']).reshape(b, s,
+                                               cfg.n_kv_heads, hd)
+    v = llama.quant_mm(h, layer['wv']).reshape(b, s,
+                                               cfg.n_kv_heads, hd)
     q = llama.apply_rope(q, cos, sin)
     k = llama.apply_rope(k, cos, sin)
     # Insert this step's K/V at each sequence's current position.
@@ -96,7 +99,7 @@ def _block_decode(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
     v_cache = v_cache.at[b_idx, pos].set(v[:, 0])
     attn = _attend_cached(q, k_cache, v_cache, cur_len=pos + 1)
     attn = attn.reshape(b, s, cfg.n_heads * hd)
-    x = x + (attn @ layer['wo']).astype(cfg.dtype)
+    x = x + llama.quant_mm(attn, layer['wo']).astype(cfg.dtype)
     return llama.ffn_sublayer(cfg, x, layer), k_cache, v_cache
 
 

@@ -220,24 +220,27 @@ def attn_sublayer(cfg: LlamaConfig, x: jax.Array, layer: Params,
     b, s, _ = x.shape
     hd = cfg.head_dim
     h = rms_norm(x, layer['attn_norm'], cfg.norm_eps)
-    q = (h @ layer['wq']).reshape(b, s, cfg.n_heads, hd)
-    k = (h @ layer['wk']).reshape(b, s, cfg.n_kv_heads, hd)
-    v = (h @ layer['wv']).reshape(b, s, cfg.n_kv_heads, hd)
+    q = _mm(h, layer['wq']).reshape(b, s, cfg.n_heads, hd)
+    k = _mm(h, layer['wk']).reshape(b, s, cfg.n_kv_heads, hd)
+    v = _mm(h, layer['wv']).reshape(b, s, cfg.n_kv_heads, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     attn_out = full_sequence_attention(cfg, q, k, v, seq_axis_sharded)
     attn_out = attn_out.reshape(b, s, cfg.n_heads * hd)
-    return x + (attn_out @ layer['wo']).astype(cfg.dtype), k, v
+    return x + _mm(attn_out, layer['wo']).astype(cfg.dtype), k, v
 
 
-def _mm(x: jax.Array, w) -> jax.Array:
+def quant_mm(x: jax.Array, w) -> jax.Array:
     """Matmul that dispatches on int8-quantized weights (serving path;
     see ops/quant.py — int8×int8 runs ~2× on the v5e/v6e MXU and halves
-    weight HBM traffic)."""
+    weight HBM traffic). Public: decode.py routes its projections here."""
     from skypilot_tpu.ops import quant
     if isinstance(w, quant.QuantizedTensor):
         return quant.int8_matmul(x, w)
     return x @ w
+
+
+_mm = quant_mm  # intra-module shorthand
 
 
 def ffn_sublayer(cfg: LlamaConfig, x: jax.Array,
