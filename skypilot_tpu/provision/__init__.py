@@ -51,12 +51,19 @@ def _route(fn_name: str):
 
 
 # Uniform provisioner surface (parity: run/stop/terminate/wait/open_ports/
-# get_cluster_info dispatchers).
-run_instances = _route('run_instances')
-stop_instances = _route('stop_instances')
-terminate_instances = _route('terminate_instances')
-wait_instances = _route('wait_instances')
-get_cluster_info = _route('get_cluster_info')
-query_instances = _route('query_instances')
-open_ports = _route('open_ports')
-cleanup_ports = _route('cleanup_ports')
+# get_cluster_info dispatchers). Single source of truth: the conformance
+# test asserts every provider module implements exactly this set.
+PROVISIONER_SURFACE = (
+    'run_instances',
+    'stop_instances',
+    'terminate_instances',
+    'wait_instances',
+    'get_cluster_info',
+    'query_instances',
+    'open_ports',
+    'cleanup_ports',
+)
+
+for _fn in PROVISIONER_SURFACE:
+    globals()[_fn] = _route(_fn)
+del _fn

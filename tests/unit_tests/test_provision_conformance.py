@@ -8,16 +8,11 @@ import pytest
 from skypilot_tpu import provision
 from skypilot_tpu.utils.registry import CLOUD_REGISTRY
 
-_SURFACE = ('run_instances', 'stop_instances', 'terminate_instances',
-            'wait_instances', 'get_cluster_info', 'query_instances',
-            'open_ports', 'cleanup_ports')
-
-
 @pytest.mark.parametrize('provider', sorted(provision._PROVIDER_MODULES))
 def test_provider_exposes_full_surface(provider):
     module = importlib.import_module(
         provision._PROVIDER_MODULES[provider])
-    missing = [fn for fn in _SURFACE if not callable(
+    missing = [fn for fn in provision.PROVISIONER_SURFACE if not callable(
         getattr(module, fn, None))]
     assert not missing, f'{provider} lacks {missing}'
 
