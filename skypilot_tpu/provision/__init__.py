@@ -52,7 +52,7 @@ def _route(fn_name: str):
 
 # Uniform provisioner surface (parity: run/stop/terminate/wait/open_ports/
 # get_cluster_info dispatchers). Single source of truth: the conformance
-# test asserts every provider module implements exactly this set.
+# test asserts every provider module implements all of this set.
 PROVISIONER_SURFACE = (
     'run_instances',
     'stop_instances',

@@ -1,0 +1,67 @@
+"""Transport-level rsync semantics (utils/command_runner.py).
+
+rsync_home is the single path-convention seam every sync in the backend
+rides (workdir, file mounts, task scripts, log download) — pin its
+semantics directly.
+"""
+import os
+
+from skypilot_tpu.utils import command_runner as crl
+
+
+def _runner(tmp_path):
+    return crl.LocalProcessRunner('n0', str(tmp_path / 'node'))
+
+
+def test_rsync_home_file_to_home_relative_path(tmp_path):
+    src = tmp_path / 'task.sh'
+    src.write_text('echo hi')
+    r = _runner(tmp_path)
+    resolved = crl.rsync_home(r, str(src), '~/.skytpu/jobs/1/task.sh',
+                              up=True)
+    assert resolved == os.path.join(r.node_dir, '.skytpu/jobs/1/task.sh')
+    assert open(resolved).read() == 'echo hi'
+
+
+def test_rsync_home_dir_contents_semantics(tmp_path):
+    src = tmp_path / 'work'
+    src.mkdir()
+    (src / 'a.py').write_text('a')
+    (src / 'sub').mkdir()
+    (src / 'sub' / 'b.py').write_text('b')
+    r = _runner(tmp_path)
+    # Trailing slash: CONTENTS land in the target.
+    crl.rsync_home(r, str(src) + '/', '~/sky_workdir/', up=True)
+    assert open(os.path.join(r.node_dir, 'sky_workdir/a.py')).read() == 'a'
+    assert open(os.path.join(r.node_dir,
+                             'sky_workdir/sub/b.py')).read() == 'b'
+
+
+def test_rsync_home_absolute_path_rebased_under_node_dir(tmp_path):
+    src = tmp_path / 's.sh'
+    src.write_text('x')
+    r = _runner(tmp_path)
+    resolved = crl.rsync_home(r, str(src), '/tmp/skytpu_setup.sh', up=True)
+    # Absolute remote paths rebase under the node dir (the node dir IS
+    # the host's filesystem root for local "hosts").
+    assert resolved == os.path.join(r.node_dir, 'tmp/skytpu_setup.sh')
+    assert os.path.exists(resolved)
+
+
+def test_rsync_home_download(tmp_path):
+    r = _runner(tmp_path)
+    log_dir = os.path.join(r.node_dir, 'sky_logs/job-1')
+    os.makedirs(log_dir)
+    with open(os.path.join(log_dir, 'run.log'), 'w') as f:
+        f.write('done')
+    target = tmp_path / 'out'
+    crl.rsync_home(r, '~/sky_logs/job-1/', str(target) + '/', up=False)
+    assert (target / 'run.log').read_text() == 'done'
+
+
+def test_base_runner_unwraps_decorators(tmp_path):
+    from skypilot_tpu.provision import docker_utils
+    inner = _runner(tmp_path)
+    wrapped = docker_utils.DockerRunner(inner)
+    assert crl.base_runner(wrapped) is inner
+    assert crl.base_runner(inner) is inner

@@ -8,6 +8,7 @@ import pytest
 from skypilot_tpu import provision
 from skypilot_tpu.utils.registry import CLOUD_REGISTRY
 
+
 @pytest.mark.parametrize('provider', sorted(provision._PROVIDER_MODULES))
 def test_provider_exposes_full_surface(provider):
     module = importlib.import_module(
