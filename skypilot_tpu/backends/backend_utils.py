@@ -76,6 +76,18 @@ def make_provision_config(
         provider_config['ssh_private_key'] = private_key
         auth_config['ssh_keys'] = f'{ssh_user}:{public_key}'
         auth_config['ssh_user'] = ssh_user
+    if cloud.name == 'azure':
+        public_key, private_key = authentication.get_or_generate_keys()
+        provider_config['ssh_user'] = 'azureuser'
+        provider_config['ssh_private_key'] = private_key
+        # One resource group per cluster by default; a shared group can
+        # be pinned via azure.resource_group in ~/.skytpu/config.yaml.
+        resource_group = skypilot_config.get_nested(
+            ('azure', 'resource_group'), None)
+        if resource_group:
+            provider_config['resource_group'] = resource_group
+        auth_config['ssh_public_key'] = public_key
+        auth_config['ssh_user'] = 'azureuser'
     if cloud.name == 'aws':
         _, private_key = authentication.get_or_generate_keys()
         provider_config['ssh_user'] = 'ubuntu'

@@ -137,9 +137,11 @@ class FailoverCloudErrorHandler:
     @classmethod
     def classify(cls, exc: Exception) -> str:
         from skypilot_tpu.provision.aws import ec2_api
+        from skypilot_tpu.provision.azure import az_api
         from skypilot_tpu.provision.gcp import tpu_api
         from skypilot_tpu.provision.kubernetes import k8s_api
-        if isinstance(exc, ec2_api.AwsCapacityError):
+        if isinstance(exc, (ec2_api.AwsCapacityError,
+                            az_api.AzureCapacityError)):
             # Quota limits are account/region-wide: sister zones would
             # fail identically, so blocklist the whole region.
             return cls.ZONE if exc.scope == 'zone' else cls.REGION

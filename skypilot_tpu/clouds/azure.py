@@ -1,11 +1,10 @@
 """Azure cloud: GPU/CPU instances for cross-cloud cost ranking.
 
-Parity: ``sky/clouds/azure.py`` — like the AWS build-out
-(``clouds/aws.py``), this covers the catalog / feasibility / pricing
+Parity: ``sky/clouds/azure.py`` — catalog / feasibility / pricing
 surface plus credential checks so the optimizer can rank Azure GPU SKUs
-(ND A100/H100 series) against TPU slices; instance lifecycle raises
-NotSupported until an Azure provisioner lands, and `sky check` gates the
-cloud off without az credentials.
+(ND A100/H100 series) against TPU slices; instance lifecycle is served
+by ``provision/azure`` (az CLI + in-memory fake), and `sky check` gates
+the cloud off without az credentials.
 """
 import subprocess
 from typing import Dict, Iterator, List, Optional, Tuple

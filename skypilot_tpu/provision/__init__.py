@@ -10,6 +10,7 @@ from skypilot_tpu.provision import common
 
 _PROVIDER_MODULES = {
     'aws': 'skypilot_tpu.provision.aws',
+    'azure': 'skypilot_tpu.provision.azure',
     'gcp': 'skypilot_tpu.provision.gcp',
     'kubernetes': 'skypilot_tpu.provision.kubernetes',
     'local': 'skypilot_tpu.provision.local',
@@ -19,8 +20,9 @@ _PROVIDER_MODULES = {
 def has_provisioner(provider_name: str) -> bool:
     """Whether this build can actually create instances on the cloud.
 
-    Catalog-only clouds (Azure) are rankable by the optimizer but must
-    be rejected BEFORE any cluster records are written.
+    Catalog-only clouds (none currently — Azure gained a provisioner)
+    are rankable by the optimizer but must be rejected BEFORE any
+    cluster records are written.
     """
     return provider_name.lower() in _PROVIDER_MODULES
 

@@ -1,0 +1,9 @@
+"""Azure VM provisioner (parity: ``sky/provision/azure/``)."""
+from skypilot_tpu.provision.azure.instance import cleanup_ports
+from skypilot_tpu.provision.azure.instance import get_cluster_info
+from skypilot_tpu.provision.azure.instance import open_ports
+from skypilot_tpu.provision.azure.instance import query_instances
+from skypilot_tpu.provision.azure.instance import run_instances
+from skypilot_tpu.provision.azure.instance import stop_instances
+from skypilot_tpu.provision.azure.instance import terminate_instances
+from skypilot_tpu.provision.azure.instance import wait_instances

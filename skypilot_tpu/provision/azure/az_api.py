@@ -1,0 +1,303 @@
+"""Azure VM API client with a fake backend.
+
+Parity: the reference drives the ``azure-mgmt-compute`` SDK from
+``sky/provision/azure/instance.py``; this build shells out to the ``az``
+CLI (``-o json``) with the same two-transport shape as
+``provision/aws/ec2_api.py``:
+
+* :class:`CliTransport` — real Azure via ``az vm ... -o json``.
+* :class:`FakeAzureService` — in-memory VMs, used by tests and when
+  ``SKYTPU_AZURE_FAKE=1``. Fault injection:
+  ``SKYTPU_AZURE_FAKE_STOCKOUT='eastus-1,...'`` makes create in those
+  zones raise ``ZonalAllocationFailed`` — exercising the failover engine.
+
+Both transports normalize VMs to one dict shape::
+
+    {'name', 'vmSize', 'powerState', 'location', 'zone',
+     'privateIp', 'publicIp', 'tags': {...}}
+"""
+import json
+import os
+import subprocess
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_FAKE_STATE_ENV = 'SKYTPU_AZURE_FAKE_STATE'
+
+
+class AzureApiError(Exception):
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+class AzureCapacityError(AzureApiError):
+    """Capacity exhaustion. ``scope``: 'zone' for a zonal allocation
+    failure, 'region' for SKU/quota exhaustion (sister zones in the same
+    region fail identically)."""
+
+    def __init__(self, message: str, scope: str = 'zone'):
+        super().__init__(message)
+        self.scope = scope
+
+
+# Exact Azure error codes only (see ec2_api._CAPACITY_SCOPES rationale):
+# ZonalAllocationFailed is zone-scoped; AllocationFailed /
+# SkuNotAvailable / QuotaExceeded exhaust the whole region for that SKU.
+_CAPACITY_SCOPES = {
+    'zonalallocationfailed': 'zone',
+    'allocationfailed': 'region',
+    'skunotavailable': 'region',
+    'quotaexceeded': 'region',
+}
+
+
+def _capacity_scope(message: str) -> Optional[str]:
+    lowered = message.lower()
+    # Order matters: 'allocationfailed' is a substring of
+    # 'zonalallocationfailed'.
+    if 'zonalallocationfailed' in lowered:
+        return 'zone'
+    for marker, scope in _CAPACITY_SCOPES.items():
+        if marker in lowered:
+            return scope
+    # OperationNotAllowed covers both quota exhaustion and disallowed VM
+    # state transitions — only the quota-text variant is capacity.
+    if 'operationnotallowed' in lowered and 'quota' in lowered:
+        return 'region'
+    return None
+
+
+class CliTransport:
+    """Real Azure through the az CLI.
+
+    A cluster's VMs live in one resource group
+    (``provider_config['resource_group']``); tags carry cluster/node
+    identity exactly like the EC2 path.
+    """
+
+    def __init__(self, region: str, resource_group: str):
+        self.region = region
+        self.resource_group = resource_group
+
+    def _run(self, args: List[str]) -> Any:
+        proc = subprocess.run(['az'] + args + ['-o', 'json'],
+                              capture_output=True,
+                              text=True,
+                              timeout=600,
+                              check=False)
+        if proc.returncode != 0:
+            msg = proc.stderr.strip()
+            scope = _capacity_scope(msg)
+            if scope is not None:
+                raise AzureCapacityError(msg, scope=scope)
+            raise AzureApiError(f'az {args[0]} {args[1]}: {msg}')
+        return json.loads(proc.stdout) if proc.stdout.strip() else {}
+
+    def ensure_group(self) -> None:
+        # Idempotent: `az group create` on an existing group is a no-op
+        # update. The per-cluster default group ('skytpu-<cluster>')
+        # exists nowhere until this runs.
+        self._run(['group', 'create', '--name', self.resource_group,
+                   '--location', self.region])
+
+    def create_vm(self, name: str, zone: Optional[str],
+                  config: Dict[str, Any]) -> Dict[str, Any]:
+        args = [
+            'vm', 'create',
+            '--resource-group', self.resource_group,
+            '--name', name,
+            '--location', self.region,
+            '--size', config['instance_type'],
+            '--image', config.get('image_id') or 'Ubuntu2204',
+            # Must match the ssh_user the backend probes with
+            # (backend_utils.make_provision_config): without this az
+            # defaults the admin account to the local OS username.
+            '--admin-username', config.get('ssh_user', 'azureuser'),
+            '--tags',
+        ] + [f'{k}={v}' for k, v in config.get('tags', {}).items()]
+        if config.get('ssh_public_key'):
+            args += ['--ssh-key-values', config['ssh_public_key']]
+        else:
+            args += ['--generate-ssh-keys']
+        if zone:
+            # Catalog zones are '<region>-<n>'; az wants the bare number.
+            args += ['--zone', zone.rsplit('-', 1)[-1]]
+        if config.get('use_spot'):
+            args += ['--priority', 'Spot', '--eviction-policy',
+                     'Deallocate']
+        out = self._run(args)
+        return {
+            'name': name,
+            'vmSize': config['instance_type'],
+            'powerState': 'VM running',
+            'location': self.region,
+            'zone': zone,
+            'privateIp': out.get('privateIpAddress', ''),
+            'publicIp': out.get('publicIpAddress'),
+            'tags': dict(config.get('tags', {})),
+        }
+
+    def list_vms(self, tag_filters: Dict[str, str]) -> List[Dict[str, Any]]:
+        out = self._run(['vm', 'list', '--resource-group',
+                         self.resource_group, '-d'])
+        vms = []
+        for vm in out:
+            tags = vm.get('tags') or {}
+            if any(tags.get(k) != v for k, v in tag_filters.items()):
+                continue
+            zones = vm.get('zones') or []
+            vms.append({
+                'name': vm['name'],
+                'vmSize': vm.get('hardwareProfile', {}).get('vmSize', ''),
+                'powerState': vm.get('powerState', 'VM running'),
+                'location': vm.get('location', self.region),
+                'zone': (f"{vm.get('location', self.region)}-{zones[0]}"
+                         if zones else None),
+                'privateIp': vm.get('privateIps', ''),
+                'publicIp': vm.get('publicIps') or None,
+                'tags': tags,
+            })
+        return vms
+
+    def _vm_op(self, op: str, names: List[str]) -> None:
+        for name in names:
+            args = ['vm', op, '--resource-group', self.resource_group,
+                    '--name', name]
+            if op == 'delete':
+                args.append('--yes')
+            self._run(args)
+
+    def stop_vms(self, names: List[str]) -> None:
+        # Deallocate (not just power off) so compute billing stops —
+        # the Azure analogue of a stopped EC2 instance.
+        self._vm_op('deallocate', names)
+
+    def start_vms(self, names: List[str]) -> None:
+        self._vm_op('start', names)
+
+    def delete_vms(self, names: List[str]) -> None:
+        self._vm_op('delete', names)
+
+    def delete_group(self) -> None:
+        # `az vm delete` leaves NICs/public-IPs/OS disks billing; the
+        # per-cluster group teardown removes everything at once.
+        self._run(['group', 'delete', '--name', self.resource_group,
+                   '--yes', '--no-wait'])
+
+
+class FakeAzureService:
+    """In-memory Azure: instant state transitions.
+
+    State optionally persisted to ``SKYTPU_AZURE_FAKE_STATE`` (JSON file)
+    so separate processes see the same cloud.
+    """
+
+    _lock = threading.Lock()
+    _vms: Dict[str, Dict[str, Any]] = {}
+
+    def __init__(self, region: str, resource_group: str):
+        self.region = region
+        self.resource_group = resource_group
+        self._state_path = os.environ.get(_FAKE_STATE_ENV)
+
+    def _load(self) -> Dict[str, Dict[str, Any]]:
+        if self._state_path and os.path.exists(self._state_path):
+            with open(self._state_path, encoding='utf-8') as f:
+                return json.load(f)
+        return FakeAzureService._vms
+
+    def _save(self, vms: Dict[str, Dict[str, Any]]) -> None:
+        if self._state_path:
+            with open(self._state_path, 'w', encoding='utf-8') as f:
+                json.dump(vms, f)
+        else:
+            FakeAzureService._vms = vms
+
+    def create_vm(self, name: str, zone: Optional[str],
+                  config: Dict[str, Any]) -> Dict[str, Any]:
+        stockout = os.environ.get('SKYTPU_AZURE_FAKE_STOCKOUT',
+                                  '').split(',')
+        if zone and zone in stockout:
+            raise AzureCapacityError(
+                f'Allocation failed (ZonalAllocationFailed): the zone '
+                f'{zone} does not have capacity for the requested VM '
+                'size. (fake)')
+        sku_out = os.environ.get('SKYTPU_AZURE_FAKE_SKU_OUT', '').split(',')
+        if self.region in sku_out:
+            raise AzureCapacityError(
+                f'SkuNotAvailable: the requested size is not available '
+                f'in region {self.region}. (fake)')
+        with FakeAzureService._lock:
+            vms = self._load()
+            n = len(vms)
+            key = f'{self.resource_group}/{name}'
+            vm = {
+                'name': name,
+                'vmSize': config['instance_type'],
+                'powerState': 'VM running',
+                'location': self.region,
+                'zone': zone,
+                'privateIp': f'10.0.0.{n + 10}',
+                'publicIp': f'20.0.0.{n + 10}',
+                'tags': dict(config.get('tags', {})),
+                '_rg': self.resource_group,
+                '_id': uuid.uuid4().hex[:8],
+            }
+            vms[key] = vm
+            self._save(vms)
+            return dict(vm)
+
+    def list_vms(self, tag_filters: Dict[str, str]) -> List[Dict[str, Any]]:
+        out = []
+        for vm in self._load().values():
+            if vm.get('_rg') != self.resource_group:
+                continue
+            if vm['powerState'] == 'VM deleted':
+                continue
+            tags = vm.get('tags', {})
+            if any(tags.get(k) != v for k, v in tag_filters.items()):
+                continue
+            out.append(dict(vm))
+        return out
+
+    def _set_state(self, names: List[str], state: str) -> None:
+        with FakeAzureService._lock:
+            vms = self._load()
+            for name in names:
+                key = f'{self.resource_group}/{name}'
+                if key in vms:
+                    vms[key]['powerState'] = state
+            self._save(vms)
+
+    def ensure_group(self) -> None:
+        pass
+
+    def stop_vms(self, names: List[str]) -> None:
+        self._set_state(names, 'VM deallocated')
+
+    def start_vms(self, names: List[str]) -> None:
+        self._set_state(names, 'VM running')
+
+    def delete_vms(self, names: List[str]) -> None:
+        self._set_state(names, 'VM deleted')
+
+    def delete_group(self) -> None:
+        with FakeAzureService._lock:
+            vms = self._load()
+            for vm in vms.values():
+                if vm.get('_rg') == self.resource_group:
+                    vm['powerState'] = 'VM deleted'
+            self._save(vms)
+
+
+def make_client(region: str, resource_group: str):
+    if os.environ.get('SKYTPU_AZURE_FAKE', '0') == '1':
+        return FakeAzureService(region, resource_group)
+    return CliTransport(region, resource_group)

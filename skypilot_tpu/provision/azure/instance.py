@@ -1,0 +1,231 @@
+"""Azure VM instance lifecycle (parity: ``sky/provision/azure/instance.py``).
+
+A "cluster" of N nodes = N VMs in one resource group, tagged
+``skytpu-cluster=<name>`` + ``skytpu-node=<i>``; one InstanceInfo per VM
+(Azure GPU hosts are single-host nodes — multi-host fan-out is a TPU-slice
+concept).
+"""
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.azure import az_api
+
+logger = sky_logging.init_logger(__name__)
+
+_CLUSTER_TAG = 'skytpu-cluster'
+_NODE_TAG = 'skytpu-node'
+
+# Azure powerState strings → the uniform status vocabulary.
+_STATE_MAP = {
+    'VM starting': 'pending',
+    'VM running': 'running',
+    'VM stopping': 'stopping',
+    'VM stopped': 'stopped',
+    'VM deallocating': 'stopping',
+    'VM deallocated': 'stopped',
+    'VM deleted': 'terminated',
+}
+
+
+def _resource_group(provider_config: Dict[str, Any],
+                    cluster_name_on_cloud: str) -> str:
+    return provider_config.get('resource_group',
+                               f'skytpu-{cluster_name_on_cloud}')
+
+
+def _client(provider_config: Dict[str, Any],
+            cluster_name_on_cloud: str) -> Any:
+    return az_api.make_client(
+        provider_config['region'],
+        _resource_group(provider_config, cluster_name_on_cloud))
+
+
+def _node_index(vm: dict) -> int:
+    return int(vm.get('tags', {}).get(_NODE_TAG, 0))
+
+
+def _cluster_vms(client, cluster_name_on_cloud: str) -> List[dict]:
+    return client.list_vms({_CLUSTER_TAG: cluster_name_on_cloud})
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    client = _client(config.provider_config, cluster_name_on_cloud)
+    zone = config.provider_config.get('availability_zone')
+    existing = _cluster_vms(client, cluster_name_on_cloud)
+    by_index = {_node_index(v): v for v in existing}
+
+    # One zone per cluster: adopting leftovers from another zone would
+    # silently span zones while the record claims `zone`.
+    for vm in existing:
+        if zone and vm.get('zone') and vm['zone'] != zone:
+            raise common.ProvisionerError(
+                f'Cluster {cluster_name_on_cloud} has VMs in '
+                f'{vm["zone"]} but {zone} was requested; run `down` '
+                'first.')
+
+    client.ensure_group()
+    created: List[str] = []
+    resumed: List[str] = []
+    try:
+        for i in range(config.count):
+            vm = by_index.get(i)
+            if vm is not None:
+                state = _STATE_MAP.get(vm['powerState'], 'pending')
+                if state == 'stopped':
+                    if not config.resume_stopped_nodes:
+                        raise common.ProvisionerError(
+                            f'Node {i} of {cluster_name_on_cloud} is '
+                            'deallocated and resume_stopped_nodes is '
+                            'False; start the cluster instead.')
+                    client.start_vms([vm['name']])
+                    resumed.append(vm['name'])
+                continue
+            name = f'{cluster_name_on_cloud}-{i}'
+            node_cfg = {
+                'instance_type': config.node_config['instance_type'],
+                'image_id': config.node_config.get('image_id'),
+                'use_spot': config.node_config.get('use_spot', False),
+                'ssh_user':
+                    config.provider_config.get('ssh_user', 'azureuser'),
+                'ssh_public_key':
+                    config.authentication_config.get('ssh_public_key'),
+                'tags': {
+                    _CLUSTER_TAG: cluster_name_on_cloud,
+                    _NODE_TAG: str(i),
+                },
+            }
+            client.create_vm(name, zone, node_cfg)
+            created.append(name)
+    except az_api.AzureCapacityError:
+        # Failover may move to another region/zone: partially-created VMs
+        # would bill forever (mirrors the EC2 partial-create cleanup).
+        if created:
+            if not existing and 'resource_group' not in \
+                    config.provider_config:
+                # Fresh dedicated group: tear down NICs/IPs/disks too.
+                client.delete_group()
+            else:
+                client.delete_vms(created)
+        raise
+    head = by_index.get(0)
+    head_id = head['name'] if head is not None else (
+        created[0] if created else None)
+    assert head_id is not None
+    return common.ProvisionRecord(provider_name='azure',
+                                  region=region,
+                                  zone=zone,
+                                  cluster_name=cluster_name_on_cloud,
+                                  head_instance_id=head_id,
+                                  resumed_instance_ids=resumed,
+                                  created_instance_ids=created)
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = 'running',
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    import time
+    assert provider_config is not None
+    client = _client(provider_config, cluster_name_on_cloud)
+    deadline = time.time() + 600
+    while True:
+        vms = _cluster_vms(client, cluster_name_on_cloud)
+        states = [_STATE_MAP.get(v['powerState'], 'pending') for v in vms]
+        if vms and all(s == state for s in states):
+            return
+        if time.time() > deadline:
+            raise common.ProvisionerError(
+                f'Timed out waiting for {cluster_name_on_cloud} to reach '
+                f'{state}; current: {states}')
+        time.sleep(5)
+
+
+def get_cluster_info(
+        region: str,
+        cluster_name_on_cloud: str,
+        provider_config: Optional[Dict[str, Any]] = None
+) -> common.ClusterInfo:
+    assert provider_config is not None
+    client = _client(provider_config, cluster_name_on_cloud)
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    head_id = None
+    for vm in sorted(_cluster_vms(client, cluster_name_on_cloud),
+                     key=_node_index):
+        if head_id is None:  # sorted: node 0 first
+            head_id = vm['name']
+        instances[vm['name']] = [
+            common.InstanceInfo(
+                instance_id=vm['name'],
+                internal_ip=vm.get('privateIp', ''),
+                external_ip=vm.get('publicIp'),
+                tags=dict(vm.get('tags', {})),
+            )
+        ]
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head_id,
+        provider_name='azure',
+        provider_config=provider_config,
+        ssh_user=provider_config.get('ssh_user', 'azureuser'),
+        ssh_private_key=provider_config.get('ssh_private_key'),
+    )
+
+
+def query_instances(
+        cluster_name_on_cloud: str,
+        provider_config: Optional[Dict[str, Any]] = None,
+        non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
+    assert provider_config is not None
+    client = _client(provider_config, cluster_name_on_cloud)
+    out: Dict[str, Optional[str]] = {}
+    for vm in _cluster_vms(client, cluster_name_on_cloud):
+        status = _STATE_MAP.get(vm['powerState'], 'pending')
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[vm['name']] = status
+    return out
+
+
+def _names(client, cluster_name_on_cloud: str,
+           worker_only: bool) -> List[str]:
+    return [
+        vm['name'] for vm in _cluster_vms(client, cluster_name_on_cloud)
+        if not (worker_only and _node_index(vm) == 0)
+    ]
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    assert provider_config is not None
+    client = _client(provider_config, cluster_name_on_cloud)
+    client.stop_vms(_names(client, cluster_name_on_cloud, worker_only))
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    assert provider_config is not None
+    client = _client(provider_config, cluster_name_on_cloud)
+    whole_cluster = not worker_only
+    dedicated_group = 'resource_group' not in provider_config
+    if whole_cluster and dedicated_group:
+        # Per-cluster group: delete it wholesale — a bare `az vm delete`
+        # leaves NICs/public-IPs/OS disks billing forever.
+        client.delete_group()
+        return
+    client.delete_vms(_names(client, cluster_name_on_cloud, worker_only))
+
+
+def open_ports(cluster_name_on_cloud: str,
+               ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    # Real path: az network nsg rule create on the cluster NSG.
+    logger.debug(f'open_ports({cluster_name_on_cloud}, {ports})')
+
+
+def cleanup_ports(cluster_name_on_cloud: str,
+                  ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    logger.debug(f'cleanup_ports({cluster_name_on_cloud}, {ports})')

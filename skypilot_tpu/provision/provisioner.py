@@ -93,11 +93,13 @@ def bulk_provision(provider_name: str, region: str,
             return record
         except Exception as e:  # pylint: disable=broad-except
             from skypilot_tpu.provision.aws import ec2_api
+            from skypilot_tpu.provision.azure import az_api
             from skypilot_tpu.provision.gcp import tpu_api
             from skypilot_tpu.provision.kubernetes import k8s_api
             if isinstance(e, (tpu_api.GcpCapacityError,
                               k8s_api.K8sCapacityError,
-                              ec2_api.AwsCapacityError)):
+                              ec2_api.AwsCapacityError,
+                              az_api.AzureCapacityError)):
                 raise  # capacity errors go straight to the failover engine
             last_exc = e
             logger.warning(f'Provision attempt {attempt + 1} failed: {e}')

@@ -1,0 +1,153 @@
+"""Azure VM provisioner against the fake service (parity:
+sky/provision/azure/instance.py)."""
+import pytest
+
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.azure import az_api
+from skypilot_tpu.provision.azure import instance as az_instance
+
+
+@pytest.fixture(autouse=True)
+def fake_azure_cloud(monkeypatch):
+    monkeypatch.setenv('SKYTPU_AZURE_FAKE', '1')
+    az_api.FakeAzureService._vms = {}  # pylint: disable=protected-access
+    yield
+    az_api.FakeAzureService._vms = {}  # pylint: disable=protected-access
+
+
+def _provider_config(zone='eastus-1'):
+    return {'region': 'eastus', 'availability_zone': zone,
+            'ssh_user': 'azureuser'}
+
+
+def _config(count=2):
+    return provision_common.ProvisionConfig(
+        provider_config=_provider_config(),
+        authentication_config={'ssh_public_key': 'ssh-ed25519 AAAA test'},
+        docker_config={},
+        node_config={'instance_type': 'Standard_D8s_v5',
+                     'use_spot': False},
+        count=count,
+        tags={},
+        resume_stopped_nodes=True,
+    )
+
+
+def test_lifecycle_run_query_stop_resume_terminate():
+    record = az_instance.run_instances('eastus', 'taz', _config())
+    assert len(record.created_instance_ids) == 2
+    assert record.head_instance_id == record.created_instance_ids[0]
+
+    az_instance.wait_instances('eastus', 'taz',
+                               provider_config=_provider_config())
+    info = az_instance.get_cluster_info('eastus', 'taz',
+                                        _provider_config())
+    assert info.num_hosts() == 2
+    meta = info.ordered_host_meta()
+    assert meta[0]['transport'] == 'ssh'
+    assert meta[0]['ssh_user'] == 'azureuser'
+    assert [h['rank'] for h in meta] == [0, 1]
+
+    statuses = az_instance.query_instances('taz', _provider_config())
+    assert set(statuses.values()) == {'running'}
+
+    az_instance.stop_instances('taz', _provider_config())
+    statuses = az_instance.query_instances('taz', _provider_config())
+    assert set(statuses.values()) == {'stopped'}
+
+    # Re-run resumes the deallocated VMs instead of creating new ones.
+    record2 = az_instance.run_instances('eastus', 'taz', _config())
+    assert record2.created_instance_ids == []
+    assert len(record2.resumed_instance_ids) == 2
+
+    az_instance.terminate_instances('taz', _provider_config())
+    assert az_instance.query_instances('taz', _provider_config()) == {}
+
+
+def test_zonal_stockout_classified_for_failover(monkeypatch):
+    monkeypatch.setenv('SKYTPU_AZURE_FAKE_STOCKOUT', 'eastus-1')
+    with pytest.raises(az_api.AzureCapacityError):
+        az_instance.run_instances('eastus', 'tcap', _config())
+    from skypilot_tpu.backends import gang_backend
+    handler = gang_backend.FailoverCloudErrorHandler
+    zonal = az_api.AzureCapacityError('ZonalAllocationFailed',
+                                      scope='zone')
+    sku = az_api.AzureCapacityError('SkuNotAvailable', scope='region')
+    assert handler.classify(zonal) == handler.ZONE
+    assert handler.classify(sku) == handler.REGION
+
+
+def test_capacity_scope_parsing():
+    assert az_api._capacity_scope(
+        'Allocation failed (ZonalAllocationFailed): zone 1') == 'zone'
+    assert az_api._capacity_scope('AllocationFailed: try later') == \
+        'region'
+    assert az_api._capacity_scope('SkuNotAvailable in eastus') == 'region'
+    assert az_api._capacity_scope('QuotaExceeded for family NDv4') == \
+        'region'
+    # OperationNotAllowed is capacity ONLY with quota text; the bare code
+    # also covers disallowed VM state transitions.
+    assert az_api._capacity_scope(
+        'OperationNotAllowed: quota exceeded for cores') == 'region'
+    assert az_api._capacity_scope(
+        'OperationNotAllowed: VM is being deleted') is None
+    assert az_api._capacity_scope('InvalidParameter: bad size') is None
+
+
+def test_terminate_dedicated_group_removes_everything():
+    az_instance.run_instances('eastus', 'tg', _config())
+    az_instance.terminate_instances('tg', _provider_config())
+    assert az_instance.query_instances('tg', _provider_config()) == {}
+
+
+def test_terminate_shared_group_deletes_only_cluster_vms():
+    cfg = _config()
+    cfg.provider_config['resource_group'] = 'shared-rg'
+    az_instance.run_instances('eastus', 'c1', cfg)
+    cfg2 = _config(count=1)
+    cfg2.provider_config['resource_group'] = 'shared-rg'
+    az_instance.run_instances('eastus', 'c2', cfg2)
+    pc = dict(_provider_config(), resource_group='shared-rg')
+    az_instance.terminate_instances('c1', pc)
+    assert az_instance.query_instances('c1', pc) == {}
+    assert len(az_instance.query_instances('c2', pc)) == 1
+
+
+def test_partial_create_cleaned_up_on_stockout(monkeypatch):
+    # Node 0 lands, node 1's zone is stocked out after the fact: the
+    # partial VM must be deleted before the error propagates.
+    calls = {'n': 0}
+    real_create = az_api.FakeAzureService.create_vm
+
+    def flaky_create(self, name, zone, config):
+        calls['n'] += 1
+        if calls['n'] >= 2:
+            raise az_api.AzureCapacityError(
+                'ZonalAllocationFailed (fake)', scope='zone')
+        return real_create(self, name, zone, config)
+
+    monkeypatch.setattr(az_api.FakeAzureService, 'create_vm',
+                        flaky_create)
+    with pytest.raises(az_api.AzureCapacityError):
+        az_instance.run_instances('eastus', 'tpart', _config(count=2))
+    monkeypatch.setattr(az_api.FakeAzureService, 'create_vm', real_create)
+    assert az_instance.query_instances('tpart', _provider_config()) == {}
+
+
+def test_clusters_isolated_by_resource_group_and_tag():
+    az_instance.run_instances('eastus', 'ca', _config(count=1))
+    az_instance.run_instances('eastus', 'cb', _config(count=1))
+    assert len(az_instance.query_instances('ca', _provider_config())) == 1
+    az_instance.terminate_instances('ca', _provider_config())
+    assert az_instance.query_instances('ca', _provider_config()) == {}
+    assert len(az_instance.query_instances('cb', _provider_config())) == 1
+
+
+def test_zone_mismatch_rejected():
+    """Existing VMs in another zone must not be silently adopted."""
+    az_instance.run_instances('eastus', 'tz', _config())
+    cfg = _config()
+    cfg.provider_config['availability_zone'] = 'eastus-2'
+    with pytest.raises(provision_common.ProvisionerError,
+                       match='eastus-1'):
+        az_instance.run_instances('eastus', 'tz', cfg)

@@ -24,5 +24,5 @@ def test_every_cloud_is_provisionable_or_gated():
     provisionable = {n for n in names if provision.has_provisioner(n)}
     catalog_only = names - provisionable
     # The current split; update deliberately when a provisioner lands.
-    assert provisionable == {'gcp', 'aws', 'kubernetes', 'local'}
-    assert catalog_only == {'azure'}
+    assert provisionable == {'gcp', 'aws', 'azure', 'kubernetes', 'local'}
+    assert catalog_only == set()
