@@ -145,9 +145,15 @@ class FailoverCloudErrorHandler:
             # Quota limits are account/region-wide: sister zones would
             # fail identically, so blocklist the whole region.
             return cls.ZONE if exc.scope == 'zone' else cls.REGION
+        from skypilot_tpu.provision.lambda_cloud import lambda_api
+        from skypilot_tpu.provision.runpod import runpod_api
         if isinstance(exc, (tpu_api.GcpCapacityError,
                             k8s_api.K8sCapacityError)):
             return cls.ZONE
+        if isinstance(exc, (lambda_api.LambdaCapacityError,
+                            runpod_api.RunPodCapacityError)):
+            # Zoneless clouds: the datacenter/region is the failure unit.
+            return cls.REGION
         text = str(exc).lower()
         if any(s in text for s in cls._ZONE_MARKERS):
             return cls.ZONE

@@ -23,7 +23,7 @@ from skypilot_tpu import topology as topo_lib
 _DATA_DIR = os.path.join(os.path.dirname(__file__), 'data')
 
 # Clouds with a bundled VM catalog CSV (<cloud>_vms.csv).
-VM_CLOUDS = ('gcp', 'aws', 'azure')
+VM_CLOUDS = ('gcp', 'aws', 'azure', 'lambda', 'runpod')
 
 # Catalog override dir for tests / refreshed data.
 CATALOG_DIR_ENV = 'SKYTPU_CATALOG_DIR'
@@ -69,7 +69,7 @@ class InstanceTypeInfo:
     cpu_count: Optional[float]
     memory_gb: Optional[float]
     price: float
-    spot_price: float
+    spot_price: Optional[float]  # None = cloud has no spot market
     region: str
     zone: Optional[str]
 
@@ -171,7 +171,10 @@ def get_hourly_cost(instance_type: str,
     if rows.empty:
         return None
     col = 'SpotPrice' if use_spot else 'Price'
-    return float(rows[col].min())
+    price = float(rows[col].min())
+    # Clouds without a spot market leave SpotPrice blank (e.g. Lambda):
+    # NaN must read as "no offering", not as a price.
+    return None if pd.isna(price) else price
 
 
 def get_accelerators_from_instance_type(
@@ -300,6 +303,7 @@ def list_accelerators(
             name = str(row['AcceleratorName'])
             if name_filter and name_filter.lower() not in name.lower():
                 continue
+            spot = float(row['SpotPrice'])
             result.setdefault(name, []).append(
                 InstanceTypeInfo(
                     cloud=cloud_name.upper(),
@@ -309,7 +313,9 @@ def list_accelerators(
                     cpu_count=float(row['vCPUs']),
                     memory_gb=float(row['MemoryGiB']),
                     price=float(row['Price']),
-                    spot_price=float(row['SpotPrice']),
+                    # Blank SpotPrice = no spot market (Lambda): None,
+                    # not NaN, so listings render '-' instead of '$nan'.
+                    spot_price=None if pd.isna(spot) else spot,
                     region=str(row['Region']),
                     zone=str(row['AvailabilityZone'])))
     return result

@@ -7,7 +7,9 @@ from skypilot_tpu.clouds.cloud import Region
 from skypilot_tpu.clouds.cloud import Zone
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.kubernetes import Kubernetes
+from skypilot_tpu.clouds.lambda_cloud import Lambda
 from skypilot_tpu.clouds.local import Local
+from skypilot_tpu.clouds.runpod import RunPod
 
 __all__ = [
     'AWS',
@@ -16,7 +18,9 @@ __all__ = [
     'CloudImplementationFeatures',
     'GCP',
     'Kubernetes',
+    'Lambda',
     'Local',
     'Region',
+    'RunPod',
     'Zone',
 ]

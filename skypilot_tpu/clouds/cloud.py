@@ -55,6 +55,19 @@ class Zone:
         return self.name
 
 
+def regions_from_catalog_pairs(pairs) -> List[Region]:
+    """Group catalog ``(region, zone)`` pairs into Region objects with
+    their zones attached — the shared tail of every cloud's
+    ``regions_with_offering``."""
+    regions: Dict[str, Region] = {}
+    for r, z in pairs:
+        regions.setdefault(r, Region(r))
+        zone_obj = Zone(z)
+        zone_obj.region = r
+        regions[r].zones.append(zone_obj)
+    return list(regions.values())
+
+
 class Cloud:
     """Abstract per-cloud surface. Subclasses register in CLOUD_REGISTRY."""
 

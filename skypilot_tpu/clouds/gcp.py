@@ -74,13 +74,7 @@ class GCP(cloud.Cloud):
             pairs = catalog.vm_regions_zones(instance_type, region, zone)
         else:
             pairs = []
-        regions: Dict[str, cloud.Region] = {}
-        for r, z in pairs:
-            regions.setdefault(r, cloud.Region(r))
-            zone_obj = cloud.Zone(z)
-            zone_obj.region = r
-            regions[r].zones.append(zone_obj)
-        return list(regions.values())
+        return cloud.regions_from_catalog_pairs(pairs)
 
     def zones_provision_loop(self,
                              *,

@@ -1,12 +1,11 @@
-"""Azure cloud: GPU/CPU instances for cross-cloud cost ranking.
+"""Lambda Cloud: single-tenant GPU boxes for cross-cloud cost ranking.
 
-Parity: ``sky/clouds/azure.py`` — catalog / feasibility / pricing
-surface plus credential checks so the optimizer can rank Azure GPU SKUs
-(ND A100/H100 series) against TPU slices; instance lifecycle is served
-by ``provision/azure`` (az CLI + in-memory fake), and `sky check` gates
-the cloud off without az credentials.
+Parity: ``sky/clouds/lambda_cloud.py`` — a flat-priced GPU neocloud with
+region-only placement (no zones), no spot market, and no stop/resume
+(instances only run or terminate). Instance lifecycle is served by
+``provision/lambda_cloud`` (REST API via curl + in-memory fake).
 """
-import subprocess
+import os
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from skypilot_tpu import catalog
@@ -14,16 +13,16 @@ from skypilot_tpu import exceptions
 from skypilot_tpu.clouds import cloud
 from skypilot_tpu.utils.registry import CLOUD_REGISTRY
 
-_CLOUD = 'azure'
+_CLOUD = 'lambda'
 
 
-@CLOUD_REGISTRY.register()
-class Azure(cloud.Cloud):
-    """Microsoft Azure."""
+@CLOUD_REGISTRY.register(name='lambda', aliases=['lambdacloud'])
+class Lambda(cloud.Cloud):
+    """Lambda Cloud (GPU cloud)."""
 
-    _REPR = 'Azure'
-    # Azure resource-group derived names: keep headroom under 64.
-    _MAX_CLUSTER_NAME_LEN_LIMIT = 42
+    _REPR = 'Lambda'
+    # Lambda instance names cap at 64 chars; keep suffix headroom.
+    _MAX_CLUSTER_NAME_LEN_LIMIT = 50
 
     @classmethod
     def unsupported_features(
@@ -32,16 +31,22 @@ class Azure(cloud.Cloud):
     ) -> Dict[cloud.CloudImplementationFeatures, str]:
         del resources
         return {
+            cloud.CloudImplementationFeatures.STOP:
+                'Lambda instances cannot be stopped; only terminated.',
+            cloud.CloudImplementationFeatures.AUTOSTOP:
+                'Autostop requires stop support, which Lambda lacks.',
+            cloud.CloudImplementationFeatures.SPOT_INSTANCE:
+                'Lambda has no spot market.',
             cloud.CloudImplementationFeatures.CLONE_DISK_FROM_CLUSTER:
-                'Disk cloning is not supported yet on Azure.',
+                'Disk cloning is not supported on Lambda.',
         }
 
     # ----------------------------------------------------------- regions
 
     def regions_with_offering(self, instance_type, accelerators, use_spot,
                               region, zone) -> List[cloud.Region]:
-        del accelerators, use_spot
-        if instance_type is None:
+        del accelerators
+        if use_spot or instance_type is None:
             return []
         pairs = catalog.vm_regions_zones(instance_type, region, zone,
                                          cloud=_CLOUD)
@@ -55,8 +60,8 @@ class Azure(cloud.Cloud):
                              accelerators=None,
                              use_spot: bool = False
                              ) -> Iterator[Optional[List[cloud.Zone]]]:
-        # Azure provisions per-region (zones are a placement hint); yield
-        # the region's zone set at once (parity: azure.py region loop).
+        # Region-only placement: yield each region's pseudo-zone (the
+        # region name itself) so the failover walk is one try per region.
         del num_nodes
         for r in self.regions_with_offering(instance_type, accelerators,
                                             use_spot, region, None):
@@ -71,25 +76,19 @@ class Azure(cloud.Cloud):
                                         cloud=_CLOUD)
         if price is None:
             raise exceptions.ResourcesUnavailableError(
-                f'No Azure pricing for {instance_type} in {region}.')
+                f'No Lambda pricing for {instance_type} in {region}.')
         return price
 
     def accelerators_to_hourly_cost(self, accelerators, use_spot, region,
                                     zone) -> float:
-        # GPU cost is folded into the hosting instance price.
+        # GPU cost is folded into the instance price.
         del accelerators, use_spot, region, zone
         return 0.0
 
     def get_egress_cost(self, num_gigabytes: float) -> float:
-        # Parity: sky/clouds/azure.py egress tiers (internet egress).
-        if num_gigabytes <= 0:
-            return 0.0
-        if num_gigabytes <= 10 * 1024:
-            return num_gigabytes * 0.087
-        cost = 10 * 1024 * 0.087
-        if num_gigabytes <= 50 * 1024:
-            return cost + (num_gigabytes - 10 * 1024) * 0.083
-        return cost + 40 * 1024 * 0.083 + (num_gigabytes - 50 * 1024) * 0.07
+        # Lambda does not meter egress.
+        del num_gigabytes
+        return 0.0
 
     # ----------------------------------------------------------- catalog
 
@@ -117,6 +116,8 @@ class Azure(cloud.Cloud):
     def get_feasible_launchable_resources(self, resources, num_nodes):
         from skypilot_tpu import topology as topo_lib
         del num_nodes
+        if resources.use_spot:
+            return [], []  # no spot market
         if resources.instance_type is not None and \
                 resources.accelerators is None:
             if not self.instance_type_exists(resources.instance_type):
@@ -145,7 +146,7 @@ class Azure(cloud.Cloud):
             zone=resources.zone,
             cloud=_CLOUD)
         if not instance_types:
-            return [], catalog.fuzzy_accelerator_hints(acc_name, 'Azure')
+            return [], catalog.fuzzy_accelerator_hints(acc_name, 'Lambda')
         return [
             resources.copy(cloud=self, instance_type=instance_types[0])
         ], []
@@ -160,7 +161,7 @@ class Azure(cloud.Cloud):
             'instance_type': resources.instance_type,
             'region': region.name,
             'zones': ','.join(z.name for z in zones) if zones else None,
-            'use_spot': resources.use_spot,
+            'use_spot': False,
             'disk_size': resources.disk_size,
             'image_id': resources.image_id,
             'num_nodes': num_nodes,
@@ -168,28 +169,29 @@ class Azure(cloud.Cloud):
 
     # ----------------------------------------------------------- identity
 
-    @staticmethod
-    def _az_query(field: str) -> Optional[str]:
-        try:
-            proc = subprocess.run(
-                ['az', 'account', 'show', '--query', field, '-o', 'tsv'],
-                capture_output=True,
-                text=True,
-                timeout=20,
-                check=False)
-        except (FileNotFoundError, subprocess.TimeoutExpired):
-            return None
-        out = proc.stdout.strip()
-        return out if proc.returncode == 0 and out else None
-
     @classmethod
     def check_credentials(cls) -> Tuple[bool, Optional[str]]:
-        if cls._az_query('id') is None:
-            return False, ('Azure credentials not configured (or az CLI '
-                           'missing). Run `az login`.')
+        if cls._api_key() is None:
+            return False, ('Lambda API key not found. Put it in '
+                           '~/.lambda_cloud/lambda_keys (api_key = ...) '
+                           'or set LAMBDA_API_KEY.')
         return True, None
+
+    @staticmethod
+    def _api_key() -> Optional[str]:
+        key = os.environ.get('LAMBDA_API_KEY')
+        if key:
+            return key
+        path = os.path.expanduser('~/.lambda_cloud/lambda_keys')
+        if os.path.exists(path):
+            with open(path, encoding='utf-8') as f:
+                for line in f:
+                    if line.strip().startswith('api_key') and '=' in line:
+                        return line.split('=', 1)[1].strip()
+        return None
 
     @classmethod
     def get_current_user_identity(cls) -> Optional[List[str]]:
-        user = cls._az_query('user.name')
-        return [user] if user else None
+        key = cls._api_key()
+        # The API has no whoami; the key prefix identifies the account.
+        return [f'lambda-key-{key[:8]}'] if key else None

@@ -1,12 +1,11 @@
-"""Azure cloud: GPU/CPU instances for cross-cloud cost ranking.
+"""RunPod: containerized GPU pods for cross-cloud cost ranking.
 
-Parity: ``sky/clouds/azure.py`` — catalog / feasibility / pricing
-surface plus credential checks so the optimizer can rank Azure GPU SKUs
-(ND A100/H100 series) against TPU slices; instance lifecycle is served
-by ``provision/azure`` (az CLI + in-memory fake), and `sky check` gates
-the cloud off without az credentials.
+Parity: ``sky/clouds/runpod.py`` — a GPU neocloud whose "instances" are
+pods in secure datacenters; region-only placement (no zones), spot =
+interruptible community pods, stop/resume supported. Instance lifecycle
+is served by ``provision/runpod`` (REST API via curl + in-memory fake).
 """
-import subprocess
+import os
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from skypilot_tpu import catalog
@@ -14,16 +13,15 @@ from skypilot_tpu import exceptions
 from skypilot_tpu.clouds import cloud
 from skypilot_tpu.utils.registry import CLOUD_REGISTRY
 
-_CLOUD = 'azure'
+_CLOUD = 'runpod'
 
 
 @CLOUD_REGISTRY.register()
-class Azure(cloud.Cloud):
-    """Microsoft Azure."""
+class RunPod(cloud.Cloud):
+    """RunPod (GPU pod cloud)."""
 
-    _REPR = 'Azure'
-    # Azure resource-group derived names: keep headroom under 64.
-    _MAX_CLUSTER_NAME_LEN_LIMIT = 42
+    _REPR = 'RunPod'
+    _MAX_CLUSTER_NAME_LEN_LIMIT = 50
 
     @classmethod
     def unsupported_features(
@@ -32,8 +30,13 @@ class Azure(cloud.Cloud):
     ) -> Dict[cloud.CloudImplementationFeatures, str]:
         del resources
         return {
+            cloud.CloudImplementationFeatures.AUTOSTOP:
+                'Autostop is not implemented for RunPod yet.',
             cloud.CloudImplementationFeatures.CLONE_DISK_FROM_CLUSTER:
-                'Disk cloning is not supported yet on Azure.',
+                'Disk cloning is not supported on RunPod.',
+            cloud.CloudImplementationFeatures.OPEN_PORTS:
+                'Opening arbitrary ports is not supported on RunPod; '
+                'pods expose only the SSH proxy.',
         }
 
     # ----------------------------------------------------------- regions
@@ -55,8 +58,7 @@ class Azure(cloud.Cloud):
                              accelerators=None,
                              use_spot: bool = False
                              ) -> Iterator[Optional[List[cloud.Zone]]]:
-        # Azure provisions per-region (zones are a placement hint); yield
-        # the region's zone set at once (parity: azure.py region loop).
+        # Datacenter == region == pseudo-zone: one try per datacenter.
         del num_nodes
         for r in self.regions_with_offering(instance_type, accelerators,
                                             use_spot, region, None):
@@ -71,25 +73,18 @@ class Azure(cloud.Cloud):
                                         cloud=_CLOUD)
         if price is None:
             raise exceptions.ResourcesUnavailableError(
-                f'No Azure pricing for {instance_type} in {region}.')
+                f'No RunPod pricing for {instance_type} in {region}.')
         return price
 
     def accelerators_to_hourly_cost(self, accelerators, use_spot, region,
                                     zone) -> float:
-        # GPU cost is folded into the hosting instance price.
         del accelerators, use_spot, region, zone
         return 0.0
 
     def get_egress_cost(self, num_gigabytes: float) -> float:
-        # Parity: sky/clouds/azure.py egress tiers (internet egress).
-        if num_gigabytes <= 0:
-            return 0.0
-        if num_gigabytes <= 10 * 1024:
-            return num_gigabytes * 0.087
-        cost = 10 * 1024 * 0.087
-        if num_gigabytes <= 50 * 1024:
-            return cost + (num_gigabytes - 10 * 1024) * 0.083
-        return cost + 40 * 1024 * 0.083 + (num_gigabytes - 50 * 1024) * 0.07
+        # RunPod does not meter egress.
+        del num_gigabytes
+        return 0.0
 
     # ----------------------------------------------------------- catalog
 
@@ -145,7 +140,7 @@ class Azure(cloud.Cloud):
             zone=resources.zone,
             cloud=_CLOUD)
         if not instance_types:
-            return [], catalog.fuzzy_accelerator_hints(acc_name, 'Azure')
+            return [], catalog.fuzzy_accelerator_hints(acc_name, 'RunPod')
         return [
             resources.copy(cloud=self, instance_type=instance_types[0])
         ], []
@@ -168,28 +163,27 @@ class Azure(cloud.Cloud):
 
     # ----------------------------------------------------------- identity
 
-    @staticmethod
-    def _az_query(field: str) -> Optional[str]:
-        try:
-            proc = subprocess.run(
-                ['az', 'account', 'show', '--query', field, '-o', 'tsv'],
-                capture_output=True,
-                text=True,
-                timeout=20,
-                check=False)
-        except (FileNotFoundError, subprocess.TimeoutExpired):
-            return None
-        out = proc.stdout.strip()
-        return out if proc.returncode == 0 and out else None
-
     @classmethod
     def check_credentials(cls) -> Tuple[bool, Optional[str]]:
-        if cls._az_query('id') is None:
-            return False, ('Azure credentials not configured (or az CLI '
-                           'missing). Run `az login`.')
+        if cls._api_key() is None:
+            return False, ('RunPod API key not found. Set RUNPOD_API_KEY '
+                           'or put it in ~/.runpod/config.toml.')
         return True, None
+
+    @staticmethod
+    def _api_key() -> Optional[str]:
+        key = os.environ.get('RUNPOD_API_KEY')
+        if key:
+            return key
+        path = os.path.expanduser('~/.runpod/config.toml')
+        if os.path.exists(path):
+            with open(path, encoding='utf-8') as f:
+                for line in f:
+                    if line.strip().startswith('api_key') and '=' in line:
+                        return line.split('=', 1)[1].strip().strip('"')
+        return None
 
     @classmethod
     def get_current_user_identity(cls) -> Optional[List[str]]:
-        user = cls._az_query('user.name')
-        return [user] if user else None
+        key = cls._api_key()
+        return [f'runpod-key-{key[:8]}'] if key else None

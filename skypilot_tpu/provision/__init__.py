@@ -13,7 +13,9 @@ _PROVIDER_MODULES = {
     'azure': 'skypilot_tpu.provision.azure',
     'gcp': 'skypilot_tpu.provision.gcp',
     'kubernetes': 'skypilot_tpu.provision.kubernetes',
+    'lambda': 'skypilot_tpu.provision.lambda_cloud',
     'local': 'skypilot_tpu.provision.local',
+    'runpod': 'skypilot_tpu.provision.runpod',
 }
 
 

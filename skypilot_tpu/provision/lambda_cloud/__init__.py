@@ -1,0 +1,9 @@
+"""Lambda Cloud provisioner (parity: ``sky/provision/lambda_cloud/``)."""
+from skypilot_tpu.provision.lambda_cloud.instance import cleanup_ports
+from skypilot_tpu.provision.lambda_cloud.instance import get_cluster_info
+from skypilot_tpu.provision.lambda_cloud.instance import open_ports
+from skypilot_tpu.provision.lambda_cloud.instance import query_instances
+from skypilot_tpu.provision.lambda_cloud.instance import run_instances
+from skypilot_tpu.provision.lambda_cloud.instance import stop_instances
+from skypilot_tpu.provision.lambda_cloud.instance import terminate_instances
+from skypilot_tpu.provision.lambda_cloud.instance import wait_instances

@@ -1,0 +1,195 @@
+"""Lambda Cloud instance lifecycle (parity:
+``sky/provision/lambda_cloud/instance.py``).
+
+Lambda has no tags: cluster membership is encoded in the instance NAME
+(``<cluster>-<i>``), mirroring the reference's name-prefix scheme. No
+stop support — instances only run or terminate.
+"""
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.lambda_cloud import lambda_api
+
+logger = sky_logging.init_logger(__name__)
+
+_STATE_MAP = {
+    'booting': 'pending',
+    'active': 'running',
+    'unhealthy': 'running',
+    'terminating': 'terminating',
+    'terminated': 'terminated',
+}
+
+_SSH_KEY_NAME = 'skytpu-key'
+
+
+def _client(provider_config: Dict[str, Any]) -> Any:
+    del provider_config
+    return lambda_api.make_client()
+
+
+def _node_index(inst: dict, cluster_name_on_cloud: str) -> int:
+    suffix = inst['name'][len(cluster_name_on_cloud) + 1:]
+    try:
+        return int(suffix)
+    except ValueError:
+        return 0
+
+
+def _cluster_instances(client,
+                       cluster_name_on_cloud: str,
+                       include_terminated: bool = False) -> List[dict]:
+    # The real API keeps listing terminating/terminated instances for a
+    # while; treating them as live members would make a relaunch after
+    # `down` adopt corpses and hang in wait_instances.
+    return [
+        inst for inst in client.list_instances()
+        if inst['name'].startswith(f'{cluster_name_on_cloud}-') and
+        (include_terminated or
+         _STATE_MAP.get(inst['status']) not in ('terminating',
+                                                'terminated'))
+    ]
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    client = _client(config.provider_config)
+    public_key = config.authentication_config.get('ssh_public_key')
+    if public_key:
+        client.ensure_ssh_key(_SSH_KEY_NAME, public_key)
+    existing = _cluster_instances(client, cluster_name_on_cloud)
+    by_index = {_node_index(i, cluster_name_on_cloud): i for i in existing}
+
+    created: List[str] = []
+    try:
+        for i in range(config.count):
+            if i in by_index:
+                continue  # no stop state: an existing instance is live
+            iid = client.launch(
+                name=f'{cluster_name_on_cloud}-{i}',
+                region=region,
+                instance_type=config.node_config['instance_type'],
+                ssh_key_names=[_SSH_KEY_NAME])
+            created.append(iid)
+    except lambda_api.LambdaCapacityError:
+        # Partial creates bill until terminated; failover may leave this
+        # region for good.
+        if created:
+            client.terminate(created)
+        raise
+    head = by_index.get(0)
+    head_id = head['id'] if head is not None else (
+        created[0] if created else None)
+    assert head_id is not None
+    return common.ProvisionRecord(provider_name='lambda',
+                                  region=region,
+                                  zone=None,
+                                  cluster_name=cluster_name_on_cloud,
+                                  head_instance_id=head_id,
+                                  resumed_instance_ids=[],
+                                  created_instance_ids=created)
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = 'running',
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    import time
+    assert provider_config is not None
+    client = _client(provider_config)
+    deadline = time.time() + 600
+    while True:
+        insts = _cluster_instances(client, cluster_name_on_cloud)
+        states = [_STATE_MAP.get(i['status'], 'pending') for i in insts]
+        if insts and all(s == state for s in states):
+            return
+        if time.time() > deadline:
+            raise common.ProvisionerError(
+                f'Timed out waiting for {cluster_name_on_cloud} to reach '
+                f'{state}; current: {states}')
+        time.sleep(5)
+
+
+def get_cluster_info(
+        region: str,
+        cluster_name_on_cloud: str,
+        provider_config: Optional[Dict[str, Any]] = None
+) -> common.ClusterInfo:
+    assert provider_config is not None
+    client = _client(provider_config)
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    head_id = None
+    insts = _cluster_instances(client, cluster_name_on_cloud)
+    for inst in sorted(insts,
+                       key=lambda i: _node_index(i, cluster_name_on_cloud)):
+        if head_id is None:  # sorted: node 0 first
+            head_id = inst['id']
+        instances[inst['id']] = [
+            common.InstanceInfo(
+                instance_id=inst['id'],
+                internal_ip=inst.get('private_ip', ''),
+                external_ip=inst.get('ip'),
+                tags={'name': inst['name']},
+            )
+        ]
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head_id,
+        provider_name='lambda',
+        provider_config=provider_config,
+        ssh_user=provider_config.get('ssh_user', 'ubuntu'),
+        ssh_private_key=provider_config.get('ssh_private_key'),
+    )
+
+
+def query_instances(
+        cluster_name_on_cloud: str,
+        provider_config: Optional[Dict[str, Any]] = None,
+        non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
+    assert provider_config is not None
+    client = _client(provider_config)
+    out: Dict[str, Optional[str]] = {}
+    for inst in _cluster_instances(client, cluster_name_on_cloud,
+                                   include_terminated=True):
+        status = _STATE_MAP.get(inst['status'], 'pending')
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[inst['id']] = status
+    return out
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    from skypilot_tpu import exceptions
+    raise exceptions.NotSupportedError(
+        'Lambda instances cannot be stopped — only terminated. '
+        '(The Lambda cloud declares STOP unsupported; reaching this is '
+        'a feature-gate bug.)')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    assert provider_config is not None
+    client = _client(provider_config)
+    ids = [
+        inst['id']
+        for inst in _cluster_instances(client, cluster_name_on_cloud)
+        if not (worker_only and
+                _node_index(inst, cluster_name_on_cloud) == 0)
+    ]
+    client.terminate(ids)
+
+
+def open_ports(cluster_name_on_cloud: str,
+               ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    # Lambda exposes all ports on the public IP; nothing to do.
+    logger.debug(f'open_ports({cluster_name_on_cloud}, {ports})')
+
+
+def cleanup_ports(cluster_name_on_cloud: str,
+                  ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    logger.debug(f'cleanup_ports({cluster_name_on_cloud}, {ports})')

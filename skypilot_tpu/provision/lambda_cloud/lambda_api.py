@@ -1,0 +1,204 @@
+"""Lambda Cloud API client with a fake backend.
+
+Parity: the reference wraps the Lambda REST API in
+``sky/provision/lambda_cloud/lambda_utils.py``; same two-transport shape
+as ``provision/aws/ec2_api.py``:
+
+* :class:`RestTransport` — real API via curl against
+  ``https://cloud.lambdalabs.com/api/v1`` (no vendor SDK needed).
+* :class:`FakeLambdaService` — in-memory instances, used by tests and
+  when ``SKYTPU_LAMBDA_FAKE=1``. Fault injection:
+  ``SKYTPU_LAMBDA_FAKE_STOCKOUT='us-east-1,...'`` makes launch in those
+  regions raise ``insufficient-capacity``.
+
+Both transports normalize instances to::
+
+    {'id', 'name', 'instance_type', 'region', 'status', 'ip',
+     'private_ip'}
+
+Lambda statuses: booting | active | terminating | terminated. There is
+no stop state — instances only run or die.
+"""
+import json
+import os
+import subprocess
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_FAKE_STATE_ENV = 'SKYTPU_LAMBDA_FAKE_STATE'
+_API_URL = 'https://cloud.lambdalabs.com/api/v1'
+
+
+class LambdaApiError(Exception):
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+class LambdaCapacityError(LambdaApiError):
+    """Region out of capacity. Lambda has no zones: scope is always
+    'region' — hence no ``scope`` attribute; the failover classifier
+    special-cases the type."""
+
+
+def _is_capacity_code(code: str) -> bool:
+    # Exact API error codes (https://cloud.lambdalabs.com/api/v1/docs):
+    # launch returns instance-operations/launch/insufficient-capacity.
+    return 'insufficient-capacity' in code.lower()
+
+
+class RestTransport:
+    """Real Lambda Cloud through curl + the REST API."""
+
+    def __init__(self, api_key: str):
+        self.api_key = api_key
+
+    def _run(self, method: str, path: str,
+             body: Optional[dict] = None) -> dict:
+        args = ['curl', '-sS', '-X', method,
+                '-u', f'{self.api_key}:',
+                '-H', 'Content-Type: application/json',
+                f'{_API_URL}{path}']
+        if body is not None:
+            args += ['-d', json.dumps(body)]
+        proc = subprocess.run(args, capture_output=True, text=True,
+                              timeout=120, check=False)
+        if proc.returncode != 0:
+            raise LambdaApiError(
+                f'lambda api {path}: {proc.stderr.strip()}')
+        out = json.loads(proc.stdout) if proc.stdout.strip() else {}
+        if 'error' in out:
+            code = out['error'].get('code', '')
+            msg = out['error'].get('message', code)
+            if _is_capacity_code(code):
+                raise LambdaCapacityError(msg)
+            raise LambdaApiError(msg)
+        return out.get('data', out)
+
+    def launch(self, name: str, region: str, instance_type: str,
+               ssh_key_names: List[str]) -> str:
+        data = self._run(
+            'POST', '/instance-operations/launch', {
+                'region_name': region,
+                'instance_type_name': instance_type,
+                'ssh_key_names': ssh_key_names,
+                'quantity': 1,
+                'name': name,
+            })
+        return data['instance_ids'][0]
+
+    def list_instances(self) -> List[Dict[str, Any]]:
+        data = self._run('GET', '/instances')
+        return [{
+            'id': inst['id'],
+            'name': inst.get('name', ''),
+            'instance_type': inst.get('instance_type',
+                                      {}).get('name', ''),
+            'region': inst.get('region', {}).get('name', ''),
+            'status': inst.get('status', 'booting'),
+            'ip': inst.get('ip'),
+            'private_ip': inst.get('private_ip', ''),
+        } for inst in data]
+
+    def terminate(self, ids: List[str]) -> None:
+        if ids:
+            self._run('POST', '/instance-operations/terminate',
+                      {'instance_ids': ids})
+
+    def ensure_ssh_key(self, name: str, public_key: str) -> None:
+        keys = self._run('GET', '/ssh-keys')
+        for k in keys:
+            if k.get('name') != name:
+                continue
+            if k.get('public_key', '').strip() == public_key.strip():
+                return
+            # Same name, different key (local keypair was regenerated):
+            # the stale registration would make every new instance
+            # unreachable over SSH.
+            self._run('DELETE', f'/ssh-keys/{k["id"]}')
+            break
+        self._run('POST', '/ssh-keys', {'name': name,
+                                        'public_key': public_key})
+
+
+class FakeLambdaService:
+    """In-memory Lambda Cloud: instant boot, no zones, no stop."""
+
+    _lock = threading.Lock()
+    _instances: Dict[str, Dict[str, Any]] = {}
+    _ssh_keys: Dict[str, str] = {}
+
+    def __init__(self, api_key: str = 'fake'):
+        self.api_key = api_key
+        self._state_path = os.environ.get(_FAKE_STATE_ENV)
+
+    def _load(self) -> Dict[str, Dict[str, Any]]:
+        if self._state_path and os.path.exists(self._state_path):
+            with open(self._state_path, encoding='utf-8') as f:
+                return json.load(f)
+        return FakeLambdaService._instances
+
+    def _save(self, instances: Dict[str, Dict[str, Any]]) -> None:
+        if self._state_path:
+            with open(self._state_path, 'w', encoding='utf-8') as f:
+                json.dump(instances, f)
+        else:
+            FakeLambdaService._instances = instances
+
+    def launch(self, name: str, region: str, instance_type: str,
+               ssh_key_names: List[str]) -> str:
+        del ssh_key_names
+        stockout = os.environ.get('SKYTPU_LAMBDA_FAKE_STOCKOUT',
+                                  '').split(',')
+        if region in stockout:
+            raise LambdaCapacityError(
+                f'instance-operations/launch/insufficient-capacity: Not '
+                f'enough capacity in {region} to fulfill your request. '
+                '(fake)')
+        with FakeLambdaService._lock:
+            instances = self._load()
+            iid = f'lam-{uuid.uuid4().hex[:12]}'
+            n = len(instances)
+            instances[iid] = {
+                'id': iid,
+                'name': name,
+                'instance_type': instance_type,
+                'region': region,
+                'status': 'active',
+                'ip': f'129.146.0.{n + 10}',
+                'private_ip': f'10.19.0.{n + 10}',
+            }
+            self._save(instances)
+            return iid
+
+    def list_instances(self) -> List[Dict[str, Any]]:
+        return [dict(i) for i in self._load().values()
+                if i['status'] != 'terminated']
+
+    def terminate(self, ids: List[str]) -> None:
+        with FakeLambdaService._lock:
+            instances = self._load()
+            for iid in ids:
+                if iid in instances:
+                    instances[iid]['status'] = 'terminated'
+            self._save(instances)
+
+    def ensure_ssh_key(self, name: str, public_key: str) -> None:
+        FakeLambdaService._ssh_keys[name] = public_key
+
+
+def make_client(api_key: Optional[str] = None):
+    if os.environ.get('SKYTPU_LAMBDA_FAKE', '0') == '1':
+        return FakeLambdaService()
+    if api_key is None:
+        from skypilot_tpu.clouds.lambda_cloud import Lambda
+        api_key = Lambda._api_key()  # pylint: disable=protected-access
+    if api_key is None:
+        raise LambdaApiError('No Lambda API key configured.')
+    return RestTransport(api_key)

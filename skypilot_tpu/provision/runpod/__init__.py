@@ -1,0 +1,9 @@
+"""RunPod pod provisioner (parity: ``sky/provision/runpod/``)."""
+from skypilot_tpu.provision.runpod.instance import cleanup_ports
+from skypilot_tpu.provision.runpod.instance import get_cluster_info
+from skypilot_tpu.provision.runpod.instance import open_ports
+from skypilot_tpu.provision.runpod.instance import query_instances
+from skypilot_tpu.provision.runpod.instance import run_instances
+from skypilot_tpu.provision.runpod.instance import stop_instances
+from skypilot_tpu.provision.runpod.instance import terminate_instances
+from skypilot_tpu.provision.runpod.instance import wait_instances

@@ -1,0 +1,197 @@
+"""RunPod pod lifecycle (parity: ``sky/provision/runpod/instance.py``).
+
+Pods have no tags: cluster membership is encoded in the pod NAME
+(``<cluster>-<i>``), like the Lambda path. Stop/resume map to pod
+stop/start (billing pauses, disk persists); spot = interruptible pods.
+"""
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.runpod import runpod_api
+
+logger = sky_logging.init_logger(__name__)
+
+_STATE_MAP = {
+    'CREATED': 'pending',
+    'RUNNING': 'running',
+    'RESTARTING': 'pending',
+    'EXITED': 'stopped',
+    'TERMINATED': 'terminated',
+}
+
+
+def _client(provider_config: Dict[str, Any]) -> Any:
+    del provider_config
+    return runpod_api.make_client()
+
+
+def _node_index(pod: dict, cluster_name_on_cloud: str) -> int:
+    suffix = pod['name'][len(cluster_name_on_cloud) + 1:]
+    try:
+        return int(suffix)
+    except ValueError:
+        return 0
+
+
+def _cluster_pods(client, cluster_name_on_cloud: str) -> List[dict]:
+    return [
+        pod for pod in client.list_pods()
+        if pod['name'].startswith(f'{cluster_name_on_cloud}-')
+    ]
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    client = _client(config.provider_config)
+    existing = _cluster_pods(client, cluster_name_on_cloud)
+    by_index = {_node_index(p, cluster_name_on_cloud): p for p in existing}
+
+    created: List[str] = []
+    resumed: List[str] = []
+    try:
+        for i in range(config.count):
+            pod = by_index.get(i)
+            if pod is not None:
+                if _STATE_MAP.get(pod['status']) == 'stopped':
+                    if not config.resume_stopped_nodes:
+                        raise common.ProvisionerError(
+                            f'Pod {i} of {cluster_name_on_cloud} is '
+                            'stopped and resume_stopped_nodes is False; '
+                            'start the cluster instead.')
+                    client.start_pod(pod['id'])
+                    resumed.append(pod['id'])
+                continue
+            pid = client.deploy_pod(
+                name=f'{cluster_name_on_cloud}-{i}',
+                region=region,
+                instance_type=config.node_config['instance_type'],
+                interruptible=config.node_config.get('use_spot', False),
+                public_key=config.authentication_config.get(
+                    'ssh_public_key'))
+            created.append(pid)
+    except runpod_api.RunPodCapacityError:
+        # Partial pods bill until terminated; failover may leave this
+        # datacenter for good.
+        for pid in created:
+            client.terminate_pod(pid)
+        raise
+    head = by_index.get(0)
+    head_id = head['id'] if head is not None else (
+        created[0] if created else None)
+    assert head_id is not None
+    return common.ProvisionRecord(provider_name='runpod',
+                                  region=region,
+                                  zone=None,
+                                  cluster_name=cluster_name_on_cloud,
+                                  head_instance_id=head_id,
+                                  resumed_instance_ids=resumed,
+                                  created_instance_ids=created)
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = 'running',
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    import time
+    assert provider_config is not None
+    client = _client(provider_config)
+    deadline = time.time() + 600
+    while True:
+        pods = _cluster_pods(client, cluster_name_on_cloud)
+        states = [_STATE_MAP.get(p['status'], 'pending') for p in pods]
+        if pods and all(s == state for s in states):
+            return
+        if time.time() > deadline:
+            raise common.ProvisionerError(
+                f'Timed out waiting for {cluster_name_on_cloud} to reach '
+                f'{state}; current: {states}')
+        time.sleep(5)
+
+
+def get_cluster_info(
+        region: str,
+        cluster_name_on_cloud: str,
+        provider_config: Optional[Dict[str, Any]] = None
+) -> common.ClusterInfo:
+    assert provider_config is not None
+    client = _client(provider_config)
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    head_id = None
+    pods = _cluster_pods(client, cluster_name_on_cloud)
+    for pod in sorted(pods,
+                      key=lambda p: _node_index(p, cluster_name_on_cloud)):
+        if head_id is None:  # sorted: node 0 first
+            head_id = pod['id']
+        instances[pod['id']] = [
+            common.InstanceInfo(
+                instance_id=pod['id'],
+                internal_ip=pod.get('private_ip', ''),
+                external_ip=pod.get('ip'),
+                tags={'name': pod['name']},
+            )
+        ]
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head_id,
+        provider_name='runpod',
+        provider_config=provider_config,
+        ssh_user=provider_config.get('ssh_user', 'root'),
+        ssh_private_key=provider_config.get('ssh_private_key'),
+    )
+
+
+def query_instances(
+        cluster_name_on_cloud: str,
+        provider_config: Optional[Dict[str, Any]] = None,
+        non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
+    assert provider_config is not None
+    client = _client(provider_config)
+    out: Dict[str, Optional[str]] = {}
+    for pod in _cluster_pods(client, cluster_name_on_cloud):
+        status = _STATE_MAP.get(pod['status'], 'pending')
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[pod['id']] = status
+    return out
+
+
+def _pod_ids(client, cluster_name_on_cloud: str,
+             worker_only: bool) -> List[str]:
+    return [
+        pod['id']
+        for pod in _cluster_pods(client, cluster_name_on_cloud)
+        if not (worker_only and
+                _node_index(pod, cluster_name_on_cloud) == 0)
+    ]
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    assert provider_config is not None
+    client = _client(provider_config)
+    for pid in _pod_ids(client, cluster_name_on_cloud, worker_only):
+        client.stop_pod(pid)
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    assert provider_config is not None
+    client = _client(provider_config)
+    for pid in _pod_ids(client, cluster_name_on_cloud, worker_only):
+        client.terminate_pod(pid)
+
+
+def open_ports(cluster_name_on_cloud: str,
+               ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    # Gated off at the cloud level (OPEN_PORTS unsupported); pods only
+    # expose the proxy/SSH endpoints configured at deploy time.
+    logger.debug(f'open_ports({cluster_name_on_cloud}, {ports})')
+
+
+def cleanup_ports(cluster_name_on_cloud: str,
+                  ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    logger.debug(f'cleanup_ports({cluster_name_on_cloud}, {ports})')

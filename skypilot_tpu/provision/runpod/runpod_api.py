@@ -1,0 +1,210 @@
+"""RunPod API client with a fake backend.
+
+Parity: the reference drives the ``runpod`` SDK from
+``sky/provision/runpod/utils.py``; this build talks to the REST API
+(``https://rest.runpod.io/v1``) via curl with the usual two-transport
+shape:
+
+* :class:`RestTransport` — real pods via curl.
+* :class:`FakeRunPodService` — in-memory pods, used by tests and when
+  ``SKYTPU_RUNPOD_FAKE=1``. Fault injection:
+  ``SKYTPU_RUNPOD_FAKE_STOCKOUT='US-CA-1,...'`` makes deploy in those
+  datacenters raise "no instances available".
+
+Normalized pod dict::
+
+    {'id', 'name', 'instance_type', 'region', 'status', 'ip',
+     'private_ip', 'interruptible'}
+
+Pod statuses: CREATED | RUNNING | EXITED | TERMINATED.
+"""
+import json
+import os
+import subprocess
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_FAKE_STATE_ENV = 'SKYTPU_RUNPOD_FAKE_STATE'
+_API_URL = 'https://rest.runpod.io/v1'
+
+_CAPACITY_MARKERS = ('no instances available',
+                     'no longer any instances available',
+                     'not enough free gpus')
+
+
+class RunPodApiError(Exception):
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+class RunPodCapacityError(RunPodApiError):
+    """Datacenter out of the requested GPU shape. RunPod has no zones:
+    scope is always the datacenter ("region")."""
+
+
+def _raise_for_error(message: str) -> None:
+    lowered = message.lower()
+    if any(m in lowered for m in _CAPACITY_MARKERS):
+        raise RunPodCapacityError(message)
+    raise RunPodApiError(message)
+
+
+class RestTransport:
+    """Real RunPod through curl + the REST API."""
+
+    def __init__(self, api_key: str):
+        self.api_key = api_key
+
+    def _run(self, method: str, path: str,
+             body: Optional[dict] = None) -> Any:
+        args = ['curl', '-sS', '-X', method,
+                '-H', f'Authorization: Bearer {self.api_key}',
+                '-H', 'Content-Type: application/json',
+                f'{_API_URL}{path}']
+        if body is not None:
+            args += ['-d', json.dumps(body)]
+        proc = subprocess.run(args, capture_output=True, text=True,
+                              timeout=120, check=False)
+        if proc.returncode != 0:
+            raise RunPodApiError(
+                f'runpod api {path}: {proc.stderr.strip()}')
+        out = json.loads(proc.stdout) if proc.stdout.strip() else {}
+        if isinstance(out, dict) and out.get('error'):
+            _raise_for_error(str(out['error']))
+        return out
+
+    def deploy_pod(self, name: str, region: str, instance_type: str,
+                   interruptible: bool,
+                   public_key: Optional[str]) -> str:
+        # instance_type '2x_A100-80GB_SECURE' → gpuTypeId + count.
+        count_s, rest = instance_type.split('x_', 1)
+        gpu_type = rest.rsplit('_', 1)[0]
+        body = {
+            'name': name,
+            'dataCenterIds': [region],
+            'gpuTypeIds': [gpu_type],
+            'gpuCount': int(count_s),
+            'interruptible': interruptible,
+            'containerDiskInGb': 50,
+            'imageName': 'runpod/base:0.6.2-cuda12.2.0',
+        }
+        if public_key:
+            body['env'] = {'PUBLIC_KEY': public_key}
+        out = self._run('POST', '/pods', body)
+        return out['id']
+
+    def list_pods(self) -> List[Dict[str, Any]]:
+        out = self._run('GET', '/pods')
+        pods = out if isinstance(out, list) else out.get('pods', [])
+        result = []
+        for pod in pods:
+            result.append({
+                'id': pod['id'],
+                'name': pod.get('name', ''),
+                'instance_type': '',
+                'region': pod.get('dataCenterId', ''),
+                'status': pod.get('desiredStatus',
+                                  pod.get('status', 'CREATED')),
+                'ip': pod.get('publicIp'),
+                'private_ip': pod.get('privateIp', ''),
+                'interruptible': pod.get('interruptible', False),
+            })
+        return result
+
+    def stop_pod(self, pod_id: str) -> None:
+        self._run('POST', f'/pods/{pod_id}/stop')
+
+    def start_pod(self, pod_id: str) -> None:
+        self._run('POST', f'/pods/{pod_id}/start')
+
+    def terminate_pod(self, pod_id: str) -> None:
+        self._run('DELETE', f'/pods/{pod_id}')
+
+
+class FakeRunPodService:
+    """In-memory RunPod: instant transitions, per-datacenter stockout."""
+
+    _lock = threading.Lock()
+    _pods: Dict[str, Dict[str, Any]] = {}
+
+    def __init__(self, api_key: str = 'fake'):
+        self.api_key = api_key
+        self._state_path = os.environ.get(_FAKE_STATE_ENV)
+
+    def _load(self) -> Dict[str, Dict[str, Any]]:
+        if self._state_path and os.path.exists(self._state_path):
+            with open(self._state_path, encoding='utf-8') as f:
+                return json.load(f)
+        return FakeRunPodService._pods
+
+    def _save(self, pods: Dict[str, Dict[str, Any]]) -> None:
+        if self._state_path:
+            with open(self._state_path, 'w', encoding='utf-8') as f:
+                json.dump(pods, f)
+        else:
+            FakeRunPodService._pods = pods
+
+    def deploy_pod(self, name: str, region: str, instance_type: str,
+                   interruptible: bool,
+                   public_key: Optional[str]) -> str:
+        del public_key
+        stockout = os.environ.get('SKYTPU_RUNPOD_FAKE_STOCKOUT',
+                                  '').split(',')
+        if region in stockout:
+            _raise_for_error(
+                f'There are no longer any instances available with the '
+                f'requested specifications in {region}. (fake)')
+        with FakeRunPodService._lock:
+            pods = self._load()
+            pid = f'pod-{uuid.uuid4().hex[:12]}'
+            n = len(pods)
+            pods[pid] = {
+                'id': pid,
+                'name': name,
+                'instance_type': instance_type,
+                'region': region,
+                'status': 'RUNNING',
+                'ip': f'194.26.0.{n + 10}',
+                'private_ip': f'10.65.0.{n + 10}',
+                'interruptible': interruptible,
+            }
+            self._save(pods)
+            return pid
+
+    def list_pods(self) -> List[Dict[str, Any]]:
+        return [dict(p) for p in self._load().values()
+                if p['status'] != 'TERMINATED']
+
+    def _set_state(self, pod_id: str, status: str) -> None:
+        with FakeRunPodService._lock:
+            pods = self._load()
+            if pod_id in pods:
+                pods[pod_id]['status'] = status
+            self._save(pods)
+
+    def stop_pod(self, pod_id: str) -> None:
+        self._set_state(pod_id, 'EXITED')
+
+    def start_pod(self, pod_id: str) -> None:
+        self._set_state(pod_id, 'RUNNING')
+
+    def terminate_pod(self, pod_id: str) -> None:
+        self._set_state(pod_id, 'TERMINATED')
+
+
+def make_client(api_key: Optional[str] = None):
+    if os.environ.get('SKYTPU_RUNPOD_FAKE', '0') == '1':
+        return FakeRunPodService()
+    if api_key is None:
+        from skypilot_tpu.clouds.runpod import RunPod
+        api_key = RunPod._api_key()  # pylint: disable=protected-access
+    if api_key is None:
+        raise RunPodApiError('No RunPod API key configured.')
+    return RestTransport(api_key)

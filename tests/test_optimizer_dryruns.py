@@ -118,12 +118,16 @@ def test_cost_ranking_uses_uniform_runtime(all_clouds):
     assert 'tpu-v5p' in str(fastest.accelerators)
 
 
-def test_provisionerless_cloud_rejected_cleanly(all_clouds):
-    """Azure is catalog-rankable but has no provisioner: a non-dryrun
-    launch must fail with a clear NotSupportedError BEFORE any cluster
-    record (AWS graduated to a real provisioner; Azure is the remaining
-    catalog-only cloud)."""
+def test_provisionerless_cloud_rejected_cleanly(all_clouds, monkeypatch):
+    """A catalog-rankable cloud without a provisioner must fail a
+    non-dryrun launch with a clear NotSupportedError BEFORE any cluster
+    record. Every in-tree cloud now has a provisioner, so simulate the
+    catalog-only state by unregistering Azure's."""
     from skypilot_tpu import global_state as gs
+    from skypilot_tpu import provision as provision_router
+    modules = dict(provision_router._PROVIDER_MODULES)  # pylint: disable=protected-access
+    del modules['azure']
+    monkeypatch.setattr(provision_router, '_PROVIDER_MODULES', modules)
     gs.set_enabled_clouds(['Azure'])
     task = sky.Task(run='echo hi')
     task.set_resources(

@@ -1,0 +1,176 @@
+"""Lambda Cloud + RunPod: catalog/feasibility surface and provisioner
+lifecycle against the fakes (parity: sky/clouds/lambda_cloud.py,
+sky/clouds/runpod.py, sky/provision/{lambda_cloud,runpod}/instance.py)."""
+import pytest
+
+from skypilot_tpu import catalog
+from skypilot_tpu import resources as res_lib
+from skypilot_tpu.clouds import CloudImplementationFeatures
+from skypilot_tpu.clouds.lambda_cloud import Lambda
+from skypilot_tpu.clouds.runpod import RunPod
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.lambda_cloud import instance as lambda_instance
+from skypilot_tpu.provision.lambda_cloud import lambda_api
+from skypilot_tpu.provision.runpod import instance as runpod_instance
+from skypilot_tpu.provision.runpod import runpod_api
+
+
+@pytest.fixture(autouse=True)
+def fake_neoclouds(monkeypatch):
+    monkeypatch.setenv('SKYTPU_LAMBDA_FAKE', '1')
+    monkeypatch.setenv('SKYTPU_RUNPOD_FAKE', '1')
+    lambda_api.FakeLambdaService._instances = {}  # pylint: disable=protected-access
+    runpod_api.FakeRunPodService._pods = {}  # pylint: disable=protected-access
+    yield
+    lambda_api.FakeLambdaService._instances = {}  # pylint: disable=protected-access
+    runpod_api.FakeRunPodService._pods = {}  # pylint: disable=protected-access
+
+
+# ------------------------------------------------------------- catalogs
+
+
+def test_lambda_catalog_feasibility_and_pricing():
+    lam = Lambda()
+    feasible, _ = lam.get_feasible_launchable_resources(
+        res_lib.Resources(accelerators={'H100': 8}), num_nodes=1)
+    assert feasible and feasible[0].instance_type == 'gpu_8x_h100_sxm5'
+    assert lam.instance_type_to_hourly_cost(
+        'gpu_8x_h100_sxm5', False, 'us-east-1', None) == pytest.approx(
+            23.92)
+    # No spot market: spot pricing reads as unavailable, and feasibility
+    # returns nothing for spot requests.
+    assert catalog.get_hourly_cost('gpu_8x_h100_sxm5', 'us-east-1',
+                                   use_spot=True, cloud='lambda') is None
+    feasible, _ = lam.get_feasible_launchable_resources(
+        res_lib.Resources(accelerators={'H100': 8}, use_spot=True),
+        num_nodes=1)
+    assert feasible == []
+    assert CloudImplementationFeatures.STOP in Lambda.unsupported_features()
+    assert CloudImplementationFeatures.SPOT_INSTANCE in \
+        Lambda.unsupported_features()
+
+
+def test_runpod_catalog_feasibility_and_spot_pricing():
+    rp = RunPod()
+    feasible, _ = rp.get_feasible_launchable_resources(
+        res_lib.Resources(accelerators={'A100-80GB': 8}), num_nodes=1)
+    assert feasible and feasible[0].instance_type == '8x_A100-80GB_SECURE'
+    on_demand = rp.instance_type_to_hourly_cost('8x_A100-80GB_SECURE',
+                                                False, 'US-CA-1', None)
+    interruptible = rp.instance_type_to_hourly_cost('8x_A100-80GB_SECURE',
+                                                    True, 'US-CA-1', None)
+    assert interruptible < on_demand
+
+
+def test_neoclouds_rank_in_cross_cloud_listing():
+    accs = catalog.list_accelerators(gpus_only=True, name_filter='H100')
+    clouds = {i.cloud for i in accs['H100']}
+    assert {'LAMBDA', 'RUNPOD'} <= clouds
+
+
+# --------------------------------------------------------- provisioners
+
+
+def _lambda_config(count=2):
+    return provision_common.ProvisionConfig(
+        provider_config={'region': 'us-east-1', 'ssh_user': 'ubuntu'},
+        authentication_config={'ssh_public_key': 'ssh-ed25519 AAAA t'},
+        docker_config={},
+        node_config={'instance_type': 'gpu_1x_a100_sxm4'},
+        count=count,
+        tags={},
+        resume_stopped_nodes=True,
+    )
+
+
+def test_lambda_lifecycle_no_stop():
+    cfg = _lambda_config()
+    record = lambda_instance.run_instances('us-east-1', 'lc', cfg)
+    assert len(record.created_instance_ids) == 2
+    lambda_instance.wait_instances('us-east-1', 'lc',
+                                   provider_config=cfg.provider_config)
+    info = lambda_instance.get_cluster_info('us-east-1', 'lc',
+                                            cfg.provider_config)
+    assert info.num_hosts() == 2
+    assert [h['rank'] for h in info.ordered_host_meta()] == [0, 1]
+
+    # Idempotent re-run adopts the existing instances.
+    record2 = lambda_instance.run_instances('us-east-1', 'lc', cfg)
+    assert record2.created_instance_ids == []
+
+    from skypilot_tpu import exceptions
+    with pytest.raises(exceptions.NotSupportedError):
+        lambda_instance.stop_instances('lc', cfg.provider_config)
+
+    lambda_instance.terminate_instances('lc', cfg.provider_config)
+    assert lambda_instance.query_instances('lc', cfg.provider_config) == {}
+
+
+def test_lambda_stockout_blocklists_region(monkeypatch):
+    monkeypatch.setenv('SKYTPU_LAMBDA_FAKE_STOCKOUT', 'us-east-1')
+    with pytest.raises(lambda_api.LambdaCapacityError):
+        lambda_instance.run_instances('us-east-1', 'lcap',
+                                      _lambda_config())
+    from skypilot_tpu.backends import gang_backend
+    handler = gang_backend.FailoverCloudErrorHandler
+    assert handler.classify(
+        lambda_api.LambdaCapacityError('insufficient-capacity')) == \
+        handler.REGION
+    # Partial creates were cleaned up.
+    assert lambda_instance.query_instances(
+        'lcap', _lambda_config().provider_config) == {}
+
+
+def _runpod_config(count=2, use_spot=False):
+    return provision_common.ProvisionConfig(
+        provider_config={'region': 'US-CA-1', 'ssh_user': 'root'},
+        authentication_config={'ssh_public_key': 'ssh-ed25519 AAAA t'},
+        docker_config={},
+        node_config={'instance_type': '1x_A100-80GB_SECURE',
+                     'use_spot': use_spot},
+        count=count,
+        tags={},
+        resume_stopped_nodes=True,
+    )
+
+
+def test_runpod_lifecycle_stop_resume_terminate():
+    cfg = _runpod_config()
+    record = runpod_instance.run_instances('US-CA-1', 'rp', cfg)
+    assert len(record.created_instance_ids) == 2
+    runpod_instance.wait_instances('US-CA-1', 'rp',
+                                   provider_config=cfg.provider_config)
+    info = runpod_instance.get_cluster_info('US-CA-1', 'rp',
+                                            cfg.provider_config)
+    assert info.num_hosts() == 2
+    assert info.ordered_host_meta()[0]['ssh_user'] == 'root'
+
+    runpod_instance.stop_instances('rp', cfg.provider_config)
+    statuses = runpod_instance.query_instances('rp', cfg.provider_config)
+    assert set(statuses.values()) == {'stopped'}
+
+    record2 = runpod_instance.run_instances('US-CA-1', 'rp', cfg)
+    assert record2.created_instance_ids == []
+    assert len(record2.resumed_instance_ids) == 2
+
+    runpod_instance.terminate_instances('rp', cfg.provider_config)
+    assert runpod_instance.query_instances('rp', cfg.provider_config) == {}
+
+
+def test_runpod_interruptible_flag_reaches_api():
+    cfg = _runpod_config(count=1, use_spot=True)
+    runpod_instance.run_instances('US-CA-1', 'rspot', cfg)
+    pods = runpod_api.FakeRunPodService().list_pods()
+    assert [p['interruptible'] for p in pods
+            if p['name'].startswith('rspot-')] == [True]
+
+
+def test_runpod_stockout_blocklists_region(monkeypatch):
+    monkeypatch.setenv('SKYTPU_RUNPOD_FAKE_STOCKOUT', 'US-CA-1')
+    with pytest.raises(runpod_api.RunPodCapacityError):
+        runpod_instance.run_instances('US-CA-1', 'rcap', _runpod_config())
+    from skypilot_tpu.backends import gang_backend
+    handler = gang_backend.FailoverCloudErrorHandler
+    assert handler.classify(
+        runpod_api.RunPodCapacityError(
+            'no instances available')) == handler.REGION

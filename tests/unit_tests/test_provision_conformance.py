@@ -24,5 +24,6 @@ def test_every_cloud_is_provisionable_or_gated():
     provisionable = {n for n in names if provision.has_provisioner(n)}
     catalog_only = names - provisionable
     # The current split; update deliberately when a provisioner lands.
-    assert provisionable == {'gcp', 'aws', 'azure', 'kubernetes', 'local'}
+    assert provisionable == {'gcp', 'aws', 'azure', 'kubernetes',
+                             'lambda', 'local', 'runpod'}
     assert catalog_only == set()
