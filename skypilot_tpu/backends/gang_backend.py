@@ -504,10 +504,12 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             cmd = f'mkdir -p {dst} && gsutil -m rsync -r {src} {dst}'
         elif src.startswith('s3://'):
             cmd = f'mkdir -p {dst} && aws s3 sync {src} {dst}'
-        elif src.startswith('r2://'):
-            _, bucket, key = storage_utils.split_bucket_uri(src)
-            cmd = mounting_utils.get_r2_copy_cmd(
-                bucket, key, dst, storage_lib.R2Store.endpoint_url())
+        elif src.split('://', 1)[0] in storage_lib.S3_COMPAT_SCHEMES:
+            scheme, bucket, key = storage_utils.split_bucket_uri(src)
+            store_cls = storage_lib.store_class_for_scheme(scheme)
+            cmd = mounting_utils.get_s3_compat_copy_cmd(
+                bucket, key, dst, store_cls.endpoint_url(),
+                store_cls.PROFILE, store_cls.CREDENTIALS_PATH)
         elif src.startswith('azure://'):
             _, container, key = storage_utils.split_bucket_uri(src)
             cmd = mounting_utils.get_az_copy_cmd(

@@ -104,18 +104,56 @@ _RCLONE_INSTALL = (
     '(curl -fsSL https://rclone.org/install.sh | sudo bash) || true')
 
 
+def get_s3_compat_mount_cmd(bucket_name: str, mount_path: str,
+                            endpoint_url: str, profile: str,
+                            credentials_path: str,
+                            rclone_provider: str = 'Other') -> str:
+    """rclone mount against any S3-compatible endpoint (R2, Nebius, OCI,
+    IBM COS). Parity: sky/data/mounting_utils.py get_r2_mount_cmd /
+    get_cos_mount_cmd — one builder, per-backend profile + endpoint."""
+    b, m = shlex.quote(bucket_name), shlex.quote(mount_path)
+    ep = shlex.quote(endpoint_url)
+    p = shlex.quote(profile)
+    return (f'rclone config create {p} s3 provider {rclone_provider} '
+            f'env_auth true '
+            f'endpoint {ep} >/dev/null 2>&1 || true; '
+            f'AWS_SHARED_CREDENTIALS_FILE={credentials_path} '
+            f'AWS_PROFILE={p} '
+            f'rclone mount {profile}:{b} {m} --daemon '
+            f'--vfs-cache-mode writes')
+
+
+def get_s3_compat_mount_script(bucket_name: str, mount_path: str,
+                               endpoint_url: str, profile: str,
+                               credentials_path: str,
+                               rclone_provider: str = 'Other') -> str:
+    return get_mounting_script(
+        mount_path,
+        get_s3_compat_mount_cmd(bucket_name, mount_path, endpoint_url,
+                                profile, credentials_path,
+                                rclone_provider),
+        install_cmd=_RCLONE_INSTALL)
+
+
+def get_s3_compat_copy_cmd(bucket_name: str, key: str, dst: str,
+                           endpoint_url: str, profile: str,
+                           credentials_path: str) -> str:
+    src = f's3://{bucket_name}/{key}'.rstrip('/')
+    return (f'mkdir -p {shlex.quote(dst)} && '
+            f'AWS_SHARED_CREDENTIALS_FILE={credentials_path} '
+            f'aws s3 sync {src} {shlex.quote(dst)} '
+            f'--endpoint-url {shlex.quote(endpoint_url)} '
+            f'--profile {shlex.quote(profile)}')
+
+
 def get_r2_mount_cmd(bucket_name: str, mount_path: str,
                      endpoint_url: str) -> str:
     """rclone mount against the R2 S3 endpoint (parity:
     sky/data/mounting_utils.py get_r2_mount_cmd — rclone with the
     ``r2`` profile credentials)."""
-    b, m = shlex.quote(bucket_name), shlex.quote(mount_path)
-    ep = shlex.quote(endpoint_url)
-    return (f'rclone config create r2 s3 provider Cloudflare env_auth true '
-            f'endpoint {ep} >/dev/null 2>&1 || true; '
-            f'AWS_SHARED_CREDENTIALS_FILE=~/.cloudflare/r2.credentials '
-            f'AWS_PROFILE=r2 '
-            f'rclone mount r2:{b} {m} --daemon --vfs-cache-mode writes')
+    return get_s3_compat_mount_cmd(bucket_name, mount_path, endpoint_url,
+                                   'r2', '~/.cloudflare/r2.credentials',
+                                   'Cloudflare')
 
 
 def get_r2_mount_script(bucket_name: str, mount_path: str,
@@ -128,11 +166,8 @@ def get_r2_mount_script(bucket_name: str, mount_path: str,
 
 def get_r2_copy_cmd(bucket_name: str, key: str, dst: str,
                     endpoint_url: str) -> str:
-    src = f's3://{bucket_name}/{key}'.rstrip('/')
-    return (f'mkdir -p {shlex.quote(dst)} && '
-            f'AWS_SHARED_CREDENTIALS_FILE=~/.cloudflare/r2.credentials '
-            f'aws s3 sync {src} {shlex.quote(dst)} '
-            f'--endpoint-url {shlex.quote(endpoint_url)} --profile r2')
+    return get_s3_compat_copy_cmd(bucket_name, key, dst, endpoint_url,
+                                  'r2', '~/.cloudflare/r2.credentials')
 
 
 BLOBFUSE2_VERSION = '2.3.2'

@@ -2,13 +2,15 @@
 ``sky/provision/lambda_cloud/instance.py``).
 
 Lambda has no tags: cluster membership is encoded in the instance NAME
-(``<cluster>-<i>``), mirroring the reference's name-prefix scheme. No
-stop support — instances only run or terminate.
+(``<cluster>-<i>`` — strict integer suffix, see
+``provision/neocloud_common.py``). No stop support — instances only run
+or terminate.
 """
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.provision import common
+from skypilot_tpu.provision import neocloud_common
 from skypilot_tpu.provision.lambda_cloud import lambda_api
 
 logger = sky_logging.init_logger(__name__)
@@ -29,26 +31,20 @@ def _client(provider_config: Dict[str, Any]) -> Any:
     return lambda_api.make_client()
 
 
-def _node_index(inst: dict, cluster_name_on_cloud: str) -> int:
-    suffix = inst['name'][len(cluster_name_on_cloud) + 1:]
-    try:
-        return int(suffix)
-    except ValueError:
-        return 0
-
-
 def _cluster_instances(client,
                        cluster_name_on_cloud: str,
                        include_terminated: bool = False) -> List[dict]:
     # The real API keeps listing terminating/terminated instances for a
     # while; treating them as live members would make a relaunch after
     # `down` adopt corpses and hang in wait_instances.
+    members = neocloud_common.cluster_members(client.list_instances(),
+                                              cluster_name_on_cloud)
+    if include_terminated:
+        return members
     return [
-        inst for inst in client.list_instances()
-        if inst['name'].startswith(f'{cluster_name_on_cloud}-') and
-        (include_terminated or
-         _STATE_MAP.get(inst['status']) not in ('terminating',
-                                                'terminated'))
+        inst for inst in members
+        if _STATE_MAP.get(inst['status']) not in ('terminating',
+                                                  'terminated')
     ]
 
 
@@ -59,7 +55,10 @@ def run_instances(region: str, cluster_name_on_cloud: str,
     if public_key:
         client.ensure_ssh_key(_SSH_KEY_NAME, public_key)
     existing = _cluster_instances(client, cluster_name_on_cloud)
-    by_index = {_node_index(i, cluster_name_on_cloud): i for i in existing}
+    by_index = {
+        neocloud_common.parse_node_index(i['name'], cluster_name_on_cloud):
+            i for i in existing
+    }
 
     created: List[str] = []
     try:
@@ -94,20 +93,11 @@ def run_instances(region: str, cluster_name_on_cloud: str,
 def wait_instances(region: str, cluster_name_on_cloud: str,
                    state: Optional[str] = 'running',
                    provider_config: Optional[Dict[str, Any]] = None) -> None:
-    import time
     assert provider_config is not None
     client = _client(provider_config)
-    deadline = time.time() + 600
-    while True:
-        insts = _cluster_instances(client, cluster_name_on_cloud)
-        states = [_STATE_MAP.get(i['status'], 'pending') for i in insts]
-        if insts and all(s == state for s in states):
-            return
-        if time.time() > deadline:
-            raise common.ProvisionerError(
-                f'Timed out waiting for {cluster_name_on_cloud} to reach '
-                f'{state}; current: {states}')
-        time.sleep(5)
+    neocloud_common.wait_for_state(
+        lambda: _cluster_instances(client, cluster_name_on_cloud),
+        _STATE_MAP, cluster_name_on_cloud, state)
 
 
 def get_cluster_info(
@@ -117,29 +107,9 @@ def get_cluster_info(
 ) -> common.ClusterInfo:
     assert provider_config is not None
     client = _client(provider_config)
-    instances: Dict[str, List[common.InstanceInfo]] = {}
-    head_id = None
-    insts = _cluster_instances(client, cluster_name_on_cloud)
-    for inst in sorted(insts,
-                       key=lambda i: _node_index(i, cluster_name_on_cloud)):
-        if head_id is None:  # sorted: node 0 first
-            head_id = inst['id']
-        instances[inst['id']] = [
-            common.InstanceInfo(
-                instance_id=inst['id'],
-                internal_ip=inst.get('private_ip', ''),
-                external_ip=inst.get('ip'),
-                tags={'name': inst['name']},
-            )
-        ]
-    return common.ClusterInfo(
-        instances=instances,
-        head_instance_id=head_id,
-        provider_name='lambda',
-        provider_config=provider_config,
-        ssh_user=provider_config.get('ssh_user', 'ubuntu'),
-        ssh_private_key=provider_config.get('ssh_private_key'),
-    )
+    return neocloud_common.build_cluster_info(
+        _cluster_instances(client, cluster_name_on_cloud), 'lambda',
+        provider_config, default_ssh_user='ubuntu')
 
 
 def query_instances(
@@ -148,14 +118,10 @@ def query_instances(
         non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
     assert provider_config is not None
     client = _client(provider_config)
-    out: Dict[str, Optional[str]] = {}
-    for inst in _cluster_instances(client, cluster_name_on_cloud,
-                                   include_terminated=True):
-        status = _STATE_MAP.get(inst['status'], 'pending')
-        if non_terminated_only and status == 'terminated':
-            continue
-        out[inst['id']] = status
-    return out
+    return neocloud_common.query_statuses(
+        _cluster_instances(client, cluster_name_on_cloud,
+                           include_terminated=True), _STATE_MAP,
+        non_terminated_only)
 
 
 def stop_instances(cluster_name_on_cloud: str,
@@ -176,8 +142,8 @@ def terminate_instances(cluster_name_on_cloud: str,
     ids = [
         inst['id']
         for inst in _cluster_instances(client, cluster_name_on_cloud)
-        if not (worker_only and
-                _node_index(inst, cluster_name_on_cloud) == 0)
+        if not (worker_only and neocloud_common.parse_node_index(
+            inst['name'], cluster_name_on_cloud) == 0)
     ]
     client.terminate(ids)
 

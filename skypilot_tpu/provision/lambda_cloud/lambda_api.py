@@ -61,14 +61,16 @@ class RestTransport:
 
     def _run(self, method: str, path: str,
              body: Optional[dict] = None) -> dict:
-        args = ['curl', '-sS', '-X', method,
-                '-u', f'{self.api_key}:',
+        # The API key rides a curl config on stdin (-K -), never argv:
+        # command lines are world-readable via /proc/<pid>/cmdline.
+        args = ['curl', '-sS', '-K', '-', '-X', method,
                 '-H', 'Content-Type: application/json',
                 f'{_API_URL}{path}']
         if body is not None:
             args += ['-d', json.dumps(body)]
-        proc = subprocess.run(args, capture_output=True, text=True,
-                              timeout=120, check=False)
+        secret_cfg = f'user = "{self.api_key}:"\n'
+        proc = subprocess.run(args, input=secret_cfg, capture_output=True,
+                              text=True, timeout=120, check=False)
         if proc.returncode != 0:
             raise LambdaApiError(
                 f'lambda api {path}: {proc.stderr.strip()}')

@@ -1,0 +1,98 @@
+"""Shared scaffolding for zoneless "neocloud" provisioners (Lambda,
+RunPod): these APIs have no tags, so cluster membership is encoded in the
+instance NAME (``<cluster>-<i>``), and the lifecycle surface reduces to a
+client with list/terminate plus per-cloud create/stop verbs.
+
+Keeping the name parsing, polling, and ClusterInfo assembly here means a
+fix lands once, not per cloud.
+"""
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+
+
+def parse_node_index(name: str,
+                     cluster_name_on_cloud: str) -> Optional[int]:
+    """``<cluster>-<i>`` → i; None when the name is NOT a member.
+
+    Strict integer suffix: a foreign instance named
+    ``<cluster>-backup`` must not be adopted as node 0 (it would be
+    terminated by ``down``).
+    """
+    prefix = f'{cluster_name_on_cloud}-'
+    if not name.startswith(prefix):
+        return None
+    suffix = name[len(prefix):]
+    try:
+        return int(suffix)
+    except ValueError:
+        return None
+
+
+def cluster_members(items: List[dict],
+                    cluster_name_on_cloud: str) -> List[dict]:
+    """Filter + rank-sort API listings down to actual cluster members."""
+    members = []
+    for item in items:
+        idx = parse_node_index(item['name'], cluster_name_on_cloud)
+        if idx is not None:
+            members.append((idx, item))
+    return [item for _, item in sorted(members, key=lambda p: p[0])]
+
+
+def wait_for_state(list_fn: Callable[[], List[dict]],
+                   state_map: Dict[str, str],
+                   cluster_name_on_cloud: str,
+                   state: str,
+                   timeout: float = 600.0,
+                   poll: float = 5.0) -> None:
+    deadline = time.time() + timeout
+    while True:
+        items = list_fn()
+        states = [state_map.get(i['status'], 'pending') for i in items]
+        if items and all(s == state for s in states):
+            return
+        if time.time() > deadline:
+            raise common.ProvisionerError(
+                f'Timed out waiting for {cluster_name_on_cloud} to reach '
+                f'{state}; current: {states}')
+        time.sleep(poll)
+
+
+def build_cluster_info(items: List[dict], provider_name: str,
+                       provider_config: Dict[str, Any],
+                       default_ssh_user: str) -> common.ClusterInfo:
+    """Rank-ordered members (from :func:`cluster_members`) → ClusterInfo."""
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    head_id = None
+    for item in items:
+        if head_id is None:  # caller passes rank-sorted members
+            head_id = item['id']
+        instances[item['id']] = [
+            common.InstanceInfo(
+                instance_id=item['id'],
+                internal_ip=item.get('private_ip', ''),
+                external_ip=item.get('ip'),
+                tags={'name': item['name']},
+            )
+        ]
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head_id,
+        provider_name=provider_name,
+        provider_config=provider_config,
+        ssh_user=provider_config.get('ssh_user', default_ssh_user),
+        ssh_private_key=provider_config.get('ssh_private_key'),
+    )
+
+
+def query_statuses(items: List[dict], state_map: Dict[str, str],
+                   non_terminated_only: bool) -> Dict[str, Optional[str]]:
+    out: Dict[str, Optional[str]] = {}
+    for item in items:
+        status = state_map.get(item['status'], 'pending')
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[item['id']] = status
+    return out

@@ -1,13 +1,15 @@
 """RunPod pod lifecycle (parity: ``sky/provision/runpod/instance.py``).
 
 Pods have no tags: cluster membership is encoded in the pod NAME
-(``<cluster>-<i>``), like the Lambda path. Stop/resume map to pod
-stop/start (billing pauses, disk persists); spot = interruptible pods.
+(``<cluster>-<i>`` — strict integer suffix, see
+``provision/neocloud_common.py``). Stop/resume map to pod stop/start
+(billing pauses, disk persists); spot = interruptible pods.
 """
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.provision import common
+from skypilot_tpu.provision import neocloud_common
 from skypilot_tpu.provision.runpod import runpod_api
 
 logger = sky_logging.init_logger(__name__)
@@ -26,26 +28,19 @@ def _client(provider_config: Dict[str, Any]) -> Any:
     return runpod_api.make_client()
 
 
-def _node_index(pod: dict, cluster_name_on_cloud: str) -> int:
-    suffix = pod['name'][len(cluster_name_on_cloud) + 1:]
-    try:
-        return int(suffix)
-    except ValueError:
-        return 0
-
-
 def _cluster_pods(client, cluster_name_on_cloud: str) -> List[dict]:
-    return [
-        pod for pod in client.list_pods()
-        if pod['name'].startswith(f'{cluster_name_on_cloud}-')
-    ]
+    return neocloud_common.cluster_members(client.list_pods(),
+                                           cluster_name_on_cloud)
 
 
 def run_instances(region: str, cluster_name_on_cloud: str,
                   config: common.ProvisionConfig) -> common.ProvisionRecord:
     client = _client(config.provider_config)
     existing = _cluster_pods(client, cluster_name_on_cloud)
-    by_index = {_node_index(p, cluster_name_on_cloud): p for p in existing}
+    by_index = {
+        neocloud_common.parse_node_index(p['name'], cluster_name_on_cloud):
+            p for p in existing
+    }
 
     created: List[str] = []
     resumed: List[str] = []
@@ -71,10 +66,13 @@ def run_instances(region: str, cluster_name_on_cloud: str,
                     'ssh_public_key'))
             created.append(pid)
     except runpod_api.RunPodCapacityError:
-        # Partial pods bill until terminated; failover may leave this
-        # datacenter for good.
+        # Partial pods bill until rolled back; failover may leave this
+        # datacenter for good. Pods resumed THIS attempt go back to
+        # stopped (their prior state) rather than billing unattended.
         for pid in created:
             client.terminate_pod(pid)
+        for pid in resumed:
+            client.stop_pod(pid)
         raise
     head = by_index.get(0)
     head_id = head['id'] if head is not None else (
@@ -92,20 +90,11 @@ def run_instances(region: str, cluster_name_on_cloud: str,
 def wait_instances(region: str, cluster_name_on_cloud: str,
                    state: Optional[str] = 'running',
                    provider_config: Optional[Dict[str, Any]] = None) -> None:
-    import time
     assert provider_config is not None
     client = _client(provider_config)
-    deadline = time.time() + 600
-    while True:
-        pods = _cluster_pods(client, cluster_name_on_cloud)
-        states = [_STATE_MAP.get(p['status'], 'pending') for p in pods]
-        if pods and all(s == state for s in states):
-            return
-        if time.time() > deadline:
-            raise common.ProvisionerError(
-                f'Timed out waiting for {cluster_name_on_cloud} to reach '
-                f'{state}; current: {states}')
-        time.sleep(5)
+    neocloud_common.wait_for_state(
+        lambda: _cluster_pods(client, cluster_name_on_cloud), _STATE_MAP,
+        cluster_name_on_cloud, state)
 
 
 def get_cluster_info(
@@ -115,29 +104,9 @@ def get_cluster_info(
 ) -> common.ClusterInfo:
     assert provider_config is not None
     client = _client(provider_config)
-    instances: Dict[str, List[common.InstanceInfo]] = {}
-    head_id = None
-    pods = _cluster_pods(client, cluster_name_on_cloud)
-    for pod in sorted(pods,
-                      key=lambda p: _node_index(p, cluster_name_on_cloud)):
-        if head_id is None:  # sorted: node 0 first
-            head_id = pod['id']
-        instances[pod['id']] = [
-            common.InstanceInfo(
-                instance_id=pod['id'],
-                internal_ip=pod.get('private_ip', ''),
-                external_ip=pod.get('ip'),
-                tags={'name': pod['name']},
-            )
-        ]
-    return common.ClusterInfo(
-        instances=instances,
-        head_instance_id=head_id,
-        provider_name='runpod',
-        provider_config=provider_config,
-        ssh_user=provider_config.get('ssh_user', 'root'),
-        ssh_private_key=provider_config.get('ssh_private_key'),
-    )
+    return neocloud_common.build_cluster_info(
+        _cluster_pods(client, cluster_name_on_cloud), 'runpod',
+        provider_config, default_ssh_user='root')
 
 
 def query_instances(
@@ -146,13 +115,9 @@ def query_instances(
         non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
     assert provider_config is not None
     client = _client(provider_config)
-    out: Dict[str, Optional[str]] = {}
-    for pod in _cluster_pods(client, cluster_name_on_cloud):
-        status = _STATE_MAP.get(pod['status'], 'pending')
-        if non_terminated_only and status == 'terminated':
-            continue
-        out[pod['id']] = status
-    return out
+    return neocloud_common.query_statuses(
+        _cluster_pods(client, cluster_name_on_cloud), _STATE_MAP,
+        non_terminated_only)
 
 
 def _pod_ids(client, cluster_name_on_cloud: str,
@@ -160,8 +125,8 @@ def _pod_ids(client, cluster_name_on_cloud: str,
     return [
         pod['id']
         for pod in _cluster_pods(client, cluster_name_on_cloud)
-        if not (worker_only and
-                _node_index(pod, cluster_name_on_cloud) == 0)
+        if not (worker_only and neocloud_common.parse_node_index(
+            pod['name'], cluster_name_on_cloud) == 0)
     ]
 
 

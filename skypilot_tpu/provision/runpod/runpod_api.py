@@ -64,14 +64,17 @@ class RestTransport:
 
     def _run(self, method: str, path: str,
              body: Optional[dict] = None) -> Any:
-        args = ['curl', '-sS', '-X', method,
-                '-H', f'Authorization: Bearer {self.api_key}',
+        # The API key rides a curl config on stdin (-K -), never argv:
+        # command lines are world-readable via /proc/<pid>/cmdline.
+        args = ['curl', '-sS', '-K', '-', '-X', method,
                 '-H', 'Content-Type: application/json',
                 f'{_API_URL}{path}']
         if body is not None:
             args += ['-d', json.dumps(body)]
-        proc = subprocess.run(args, capture_output=True, text=True,
-                              timeout=120, check=False)
+        secret_cfg = (f'header = "Authorization: Bearer '
+                      f'{self.api_key}"\n')
+        proc = subprocess.run(args, input=secret_cfg, capture_output=True,
+                              text=True, timeout=120, check=False)
         if proc.returncode != 0:
             raise RunPodApiError(
                 f'runpod api {path}: {proc.stderr.strip()}')
@@ -83,18 +86,25 @@ class RestTransport:
     def deploy_pod(self, name: str, region: str, instance_type: str,
                    interruptible: bool,
                    public_key: Optional[str]) -> str:
-        # instance_type '2x_A100-80GB_SECURE' → gpuTypeId + count.
+        # instance_type '2x_A100-80GB_SECURE' → gpuTypeId + count;
+        # '1x_CPU_SECURE' → a CPU pod (no gpuTypeIds — the API rejects
+        # a GPU request for type 'CPU').
         count_s, rest = instance_type.split('x_', 1)
-        gpu_type = rest.rsplit('_', 1)[0]
+        device_type = rest.rsplit('_', 1)[0]
         body = {
             'name': name,
             'dataCenterIds': [region],
-            'gpuTypeIds': [gpu_type],
-            'gpuCount': int(count_s),
             'interruptible': interruptible,
             'containerDiskInGb': 50,
-            'imageName': 'runpod/base:0.6.2-cuda12.2.0',
         }
+        if device_type == 'CPU':
+            body['computeType'] = 'CPU'
+            body['vcpuCount'] = 4 * int(count_s)
+            body['imageName'] = 'runpod/base:0.6.2'
+        else:
+            body['gpuTypeIds'] = [device_type]
+            body['gpuCount'] = int(count_s)
+            body['imageName'] = 'runpod/base:0.6.2-cuda12.2.0'
         if public_key:
             body['env'] = {'PUBLIC_KEY': public_key}
         out = self._run('POST', '/pods', body)

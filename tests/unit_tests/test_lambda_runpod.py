@@ -106,6 +106,51 @@ def test_lambda_lifecycle_no_stop():
     assert lambda_instance.query_instances('lc', cfg.provider_config) == {}
 
 
+def test_foreign_instance_with_name_prefix_not_adopted():
+    """An unrelated instance named '<cluster>-backup' must not be
+    treated as node 0 (it would be terminated by `down`)."""
+    fake = lambda_api.FakeLambdaService()
+    fake.launch('lc-backup', 'us-east-1', 'gpu_1x_a10', [])
+    cfg = _lambda_config(count=1)
+    record = lambda_instance.run_instances('us-east-1', 'lc', cfg)
+    # A real node 0 was created; the foreign instance is not a member.
+    assert len(record.created_instance_ids) == 1
+    assert len(lambda_instance.query_instances(
+        'lc', cfg.provider_config)) == 1
+    lambda_instance.terminate_instances('lc', cfg.provider_config)
+    # The foreign instance survived `down`.
+    assert any(i['name'] == 'lc-backup' and i['status'] == 'active'
+               for i in fake.list_instances())
+
+
+def test_runpod_capacity_rollback_restops_resumed_pods(monkeypatch):
+    """Resume-then-stockout must re-stop the pods it resumed, not leave
+    them billing after failover leaves the datacenter."""
+    cfg = _runpod_config(count=2)
+    runpod_instance.run_instances('US-CA-1', 'rb', cfg)
+    runpod_instance.stop_instances('rb', cfg.provider_config)
+
+    real_deploy = runpod_api.FakeRunPodService.deploy_pod
+
+    def no_capacity(self, name, region, instance_type, interruptible,
+                    public_key):
+        raise runpod_api.RunPodCapacityError('no instances available')
+
+    monkeypatch.setattr(runpod_api.FakeRunPodService, 'deploy_pod',
+                        no_capacity)
+    # Make node 1 need a fresh deploy: terminate it, keep node 0 stopped.
+    pods = runpod_api.FakeRunPodService().list_pods()
+    for pod in pods:
+        if pod['name'] == 'rb-1':
+            runpod_api.FakeRunPodService().terminate_pod(pod['id'])
+    with pytest.raises(runpod_api.RunPodCapacityError):
+        runpod_instance.run_instances('US-CA-1', 'rb', cfg)
+    monkeypatch.setattr(runpod_api.FakeRunPodService, 'deploy_pod',
+                        real_deploy)
+    statuses = runpod_instance.query_instances('rb', cfg.provider_config)
+    assert set(statuses.values()) == {'stopped'}
+
+
 def test_lambda_stockout_blocklists_region(monkeypatch):
     monkeypatch.setenv('SKYTPU_LAMBDA_FAKE_STOCKOUT', 'us-east-1')
     with pytest.raises(lambda_api.LambdaCapacityError):

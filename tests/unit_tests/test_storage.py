@@ -399,3 +399,64 @@ def test_azure_mount_and_copy_commands(fake_az):
     assert 'blobfuse2' in script
     cmd = mounting_utils.get_az_copy_cmd('cont', '/tmp/out', 'skytpuacct')
     assert 'download-batch' in cmd
+
+
+# ------------------------------------------- S3-compatible store family
+
+
+def test_s3_compat_family_endpoints_and_uris(monkeypatch):
+    monkeypatch.setenv('R2_ACCOUNT_ID', 'acct123')
+    monkeypatch.setenv('OCI_NAMESPACE', 'mytenancy')
+    cases = [
+        (storage_lib.R2Store, 'r2://bkt',
+         'https://acct123.r2.cloudflarestorage.com'),
+        (storage_lib.NebiusStore, 'nebius://bkt',
+         'https://storage.eu-north1.nebius.cloud:443'),
+        (storage_lib.OciStore, 'oci://bkt',
+         'https://mytenancy.compat.objectstorage.us-ashburn-1.'
+         'oraclecloud.com'),
+        (storage_lib.IbmCosStore, 'cos://bkt',
+         'https://s3.us-east.cloud-object-storage.appdomain.cloud'),
+    ]
+    for cls, uri, endpoint in cases:
+        store = cls('bkt')
+        assert store.get_uri() == uri
+        assert cls.endpoint_url() == endpoint
+        mount = store.mount_command('/mnt/x')
+        assert endpoint in mount and cls.PROFILE in mount
+        copy = store.copy_command('/tmp/out')
+        assert endpoint in copy and cls.CREDENTIALS_PATH in copy
+
+
+def test_s3_compat_scheme_table_roundtrip():
+    for scheme in ('r2', 'nebius', 'oci', 'cos'):
+        assert scheme in storage_lib.S3_COMPAT_SCHEMES
+        cls = storage_lib.store_class_for_scheme(scheme)
+        assert issubclass(cls, storage_lib.S3CompatStore)
+        assert storage_lib.StoreType.from_store(cls('bkt')) == \
+            storage_lib.SCHEME_TO_STORE[scheme]
+    # Plain S3 is NOT in the compat family (no custom endpoint).
+    assert 's3' not in storage_lib.S3_COMPAT_SCHEMES
+    assert storage_lib.StoreType.from_store(
+        storage_lib.S3Store('bkt')) == storage_lib.StoreType.S3
+
+
+def test_nebius_store_roundtrip(fake_r2, tmp_path, monkeypatch):
+    """The fake `aws` CLI serves any S3-compatible store; drive Nebius
+    through the full create/upload/delete cycle."""
+    src = tmp_path / 'ndata'
+    src.mkdir()
+    (src / 'n.txt').write_text('nebius')
+    store = storage_lib.Storage(name='skytpu-neb-ut', source=str(src),
+                                stores=[storage_lib.StoreType.NEBIUS])
+    store.sync_all_stores()
+    neb = store.stores[storage_lib.StoreType.NEBIUS]
+    assert neb.exists()
+    assert neb.get_uri() == 'nebius://skytpu-neb-ut'
+    assert (fake_r2['root'] / 'skytpu-neb-ut' / 'n.txt').read_text() == \
+        'nebius'
+    calls = fake_r2['log'].read_text()
+    assert '--endpoint-url https://storage.eu-north1.nebius.cloud' in calls
+    assert '--profile nebius' in calls
+    store.delete()
+    assert not neb.exists()
