@@ -70,7 +70,10 @@ def main() -> None:
         cfg = llama.CONFIGS['debug']
         seq, batch, steps = 128, 2, 3
 
-    tcfg = train.TrainConfig(warmup_steps=10)
+    tcfg = train.TrainConfig(
+        warmup_steps=10,
+        moment_dtype=os.environ.get('SKYTPU_BENCH_MOMENT_DTYPE',
+                                    'float32'))
     state = train.init_train_state(jax.random.PRNGKey(0), cfg, tcfg)
     step = train.make_train_step(cfg, tcfg)
     key = jax.random.PRNGKey(1)

@@ -57,8 +57,8 @@ def get_gcs_mount_script(bucket_name: str, mount_path: str) -> str:
 
 def get_gcs_copy_cmd(bucket_name: str, key: str, dst: str) -> str:
     src = f'gs://{bucket_name}/{key}'.rstrip('/')
-    return f'mkdir -p {shlex.quote(dst)} && gsutil -m rsync -r {src} ' \
-           f'{shlex.quote(dst)}'
+    return f'mkdir -p {shlex.quote(dst)} && gsutil -m rsync -r ' \
+           f'{shlex.quote(src)} {shlex.quote(dst)}'
 
 
 GOOFYS_VERSION = '0.24.0'
@@ -96,7 +96,7 @@ def get_s3_mount_script(bucket_name: str, mount_path: str) -> str:
 def get_s3_copy_cmd(bucket_name: str, key: str, dst: str) -> str:
     src = f's3://{bucket_name}/{key}'.rstrip('/')
     return (f'mkdir -p {shlex.quote(dst)} && '
-            f'aws s3 sync {src} {shlex.quote(dst)}')
+            f'aws s3 sync {shlex.quote(src)} {shlex.quote(dst)}')
 
 
 _RCLONE_INSTALL = (
@@ -141,7 +141,7 @@ def get_s3_compat_copy_cmd(bucket_name: str, key: str, dst: str,
     src = f's3://{bucket_name}/{key}'.rstrip('/')
     return (f'mkdir -p {shlex.quote(dst)} && '
             f'AWS_SHARED_CREDENTIALS_FILE={credentials_path} '
-            f'aws s3 sync {src} {shlex.quote(dst)} '
+            f'aws s3 sync {shlex.quote(src)} {shlex.quote(dst)} '
             f'--endpoint-url {shlex.quote(endpoint_url)} '
             f'--profile {shlex.quote(profile)}')
 

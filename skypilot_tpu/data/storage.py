@@ -309,12 +309,16 @@ class S3CompatStore(S3Store):
         return f'{self.SCHEME}://{self.name}'
 
 
-def _config_or_env(config_key, env_var: str, error: str) -> str:
+def _config_or_env(config_key, env_var: str, error: Optional[str] = None,
+                   default: Optional[str] = None) -> str:
+    """Config file takes precedence over env; no value → ``default`` if
+    given, else a StorageError carrying ``error``."""
     from skypilot_tpu import skypilot_config
     value = skypilot_config.get_nested(config_key, None) or os.environ.get(
-        env_var)
+        env_var) or default
     if not value:
-        raise exceptions.StorageError(error)
+        raise exceptions.StorageError(error or
+                                      f'missing {config_key} / {env_var}')
     return value
 
 
@@ -353,10 +357,8 @@ class NebiusStore(S3CompatStore):
 
     @classmethod
     def endpoint_url(cls) -> str:
-        from skypilot_tpu import skypilot_config
-        region = skypilot_config.get_nested(
-            ('nebius', 'region'), None) or os.environ.get(
-                'NEBIUS_REGION', 'eu-north1')
+        region = _config_or_env(('nebius', 'region'), 'NEBIUS_REGION',
+                                default='eu-north1')
         return f'https://storage.{region}.nebius.cloud:443'
 
 
@@ -373,14 +375,12 @@ class OciStore(S3CompatStore):
 
     @classmethod
     def endpoint_url(cls) -> str:
-        from skypilot_tpu import skypilot_config
         namespace = _config_or_env(
             ('oci', 'namespace'), 'OCI_NAMESPACE',
             'OCI object storage needs the tenancy namespace: set '
             'oci.namespace in ~/.skytpu/config.yaml or $OCI_NAMESPACE.')
-        region = skypilot_config.get_nested(
-            ('oci', 'region'), None) or os.environ.get(
-                'OCI_REGION', 'us-ashburn-1')
+        region = _config_or_env(('oci', 'region'), 'OCI_REGION',
+                                default='us-ashburn-1')
         return (f'https://{namespace}.compat.objectstorage.'
                 f'{region}.oraclecloud.com')
 
@@ -398,10 +398,8 @@ class IbmCosStore(S3CompatStore):
 
     @classmethod
     def endpoint_url(cls) -> str:
-        from skypilot_tpu import skypilot_config
-        region = skypilot_config.get_nested(
-            ('ibm', 'region'), None) or os.environ.get(
-                'IBM_COS_REGION', 'us-east')
+        region = _config_or_env(('ibm', 'region'), 'IBM_COS_REGION',
+                                default='us-east')
         return (f'https://s3.{region}.cloud-object-storage.'
                 'appdomain.cloud')
 

@@ -227,6 +227,10 @@ def attn_sublayer(cfg: LlamaConfig, x: jax.Array, layer: Params,
     k = apply_rope(k, cos, sin)
     attn_out = full_sequence_attention(cfg, q, k, v, seq_axis_sharded)
     attn_out = attn_out.reshape(b, s, cfg.n_heads * hd)
+    # Named for the 'attn' remat policy: saving this [B,S,dim]-sized
+    # tensor (cheap vs the [B,S,ffn_dim] FFN activations) lets the
+    # backward pass skip recomputing QKV projections + the flash kernel.
+    attn_out = checkpoint_name(attn_out, 'attn_out')
     return x + _mm(attn_out, layer['wo']).astype(cfg.dtype), k, v
 
 
@@ -291,9 +295,20 @@ def forward_hidden(params: Params,
         elif cfg.remat_policy == 'ffn':
             policy = jax.checkpoint_policies.save_only_these_names(
                 'ffn_w1', 'ffn_w3')
+        elif cfg.remat_policy == 'ffn1':
+            # Half the 'ffn' policy's [B,S,ffn_dim] stacking cost for
+            # half its recompute saving.
+            policy = jax.checkpoint_policies.save_only_these_names(
+                'ffn_w1')
+        elif cfg.remat_policy == 'attn':
+            # Save the attention outputs ([B,S,dim] per layer — 16x
+            # smaller than the FFN activations the 'ffn' policy stacks):
+            # backward recomputes only norms + FFN, not QKV + flash.
+            policy = jax.checkpoint_policies.save_only_these_names(
+                'attn_out')
         else:
             raise ValueError(f'unknown remat_policy: {cfg.remat_policy!r} '
-                             "(expected 'full', 'dots' or 'ffn')")
+                             "(expected 'full', 'dots', 'ffn' or 'attn')")
         body = jax.checkpoint(body, prevent_cse=False, policy=policy)
     x, _ = jax.lax.scan(body, x, params['layers'])
 

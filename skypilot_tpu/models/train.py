@@ -26,6 +26,45 @@ class TrainConfig:
     beta2: float = 0.95
     grad_clip: float = 1.0
     warmup_steps: int = 100
+    # Storage dtype for the Adam moments. 'bfloat16' halves optimizer
+    # HBM (9.1GB → 4.6GB on a 1.1B model) — on a 16GB v5e that buys a
+    # lighter remat policy worth ~15% step time; the moment update math
+    # still runs in f32. Default stays f32 (exact Adam).
+    moment_dtype: str = 'float32'
+
+
+def _scale_by_adam_low_mem(b1: float, b2: float, eps: float,
+                           moment_dtype) -> optax.GradientTransformation:
+    """Adam moment tracking with mu AND nu stored in ``moment_dtype``
+    (optax.scale_by_adam only casts mu). Math in f32; storage cast."""
+
+    def init_fn(params):
+        zeros = lambda p: jnp.zeros_like(p, dtype=moment_dtype)
+        return optax.ScaleByAdamState(count=jnp.zeros([], jnp.int32),
+                                      mu=jax.tree.map(zeros, params),
+                                      nu=jax.tree.map(zeros, params))
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = optax.safe_int32_increment(state.count)
+        mu32 = jax.tree.map(
+            lambda g, m: m.astype(jnp.float32) * b1 +
+            g.astype(jnp.float32) * (1 - b1), updates, state.mu)
+        nu32 = jax.tree.map(
+            lambda g, v: v.astype(jnp.float32) * b2 +
+            jnp.square(g.astype(jnp.float32)) * (1 - b2), updates,
+            state.nu)
+        bc1 = 1 - b1**count.astype(jnp.float32)
+        bc2 = 1 - b2**count.astype(jnp.float32)
+        scaled = jax.tree.map(
+            lambda m, v: (m / bc1) / (jnp.sqrt(v / bc2) + eps), mu32,
+            nu32)
+        cast = lambda t: jax.tree.map(lambda x: x.astype(moment_dtype), t)
+        return scaled, optax.ScaleByAdamState(count=count,
+                                              mu=cast(mu32),
+                                              nu=cast(nu32))
+
+    return optax.GradientTransformation(init_fn, update_fn)
 
 
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
@@ -34,12 +73,21 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
         peak_value=cfg.learning_rate,
         warmup_steps=cfg.warmup_steps,
         decay_steps=max(10 * cfg.warmup_steps, 1000))
+    if cfg.moment_dtype != 'float32':
+        adam = optax.chain(
+            _scale_by_adam_low_mem(cfg.beta1, cfg.beta2, 1e-8,
+                                   jnp.dtype(cfg.moment_dtype)),
+            optax.add_decayed_weights(cfg.weight_decay),
+            optax.scale_by_learning_rate(schedule),
+        )
+    else:
+        adam = optax.adamw(schedule,
+                           b1=cfg.beta1,
+                           b2=cfg.beta2,
+                           weight_decay=cfg.weight_decay)
     return optax.chain(
         optax.clip_by_global_norm(cfg.grad_clip),
-        optax.adamw(schedule,
-                    b1=cfg.beta1,
-                    b2=cfg.beta2,
-                    weight_decay=cfg.weight_decay),
+        adam,
     )
 
 

@@ -55,10 +55,8 @@ def run_instances(region: str, cluster_name_on_cloud: str,
     if public_key:
         client.ensure_ssh_key(_SSH_KEY_NAME, public_key)
     existing = _cluster_instances(client, cluster_name_on_cloud)
-    by_index = {
-        neocloud_common.parse_node_index(i['name'], cluster_name_on_cloud):
-            i for i in existing
-    }
+    by_index = neocloud_common.members_by_index(existing,
+                                                cluster_name_on_cloud)
 
     created: List[str] = []
     try:
@@ -73,9 +71,14 @@ def run_instances(region: str, cluster_name_on_cloud: str,
             created.append(iid)
     except lambda_api.LambdaCapacityError:
         # Partial creates bill until terminated; failover may leave this
-        # region for good.
+        # region for good. Best-effort: a rollback failure must not mask
+        # the capacity error the failover engine needs.
         if created:
-            client.terminate(created)
+            try:
+                client.terminate(created)
+            except lambda_api.LambdaApiError as cleanup_exc:
+                logger.warning(f'Rollback terminate of {created} failed: '
+                               f'{cleanup_exc}')
         raise
     head = by_index.get(0)
     head_id = head['id'] if head is not None else (

@@ -33,12 +33,19 @@ def parse_node_index(name: str,
 def cluster_members(items: List[dict],
                     cluster_name_on_cloud: str) -> List[dict]:
     """Filter + rank-sort API listings down to actual cluster members."""
+    return list(
+        members_by_index(items, cluster_name_on_cloud).values())
+
+
+def members_by_index(items: List[dict],
+                     cluster_name_on_cloud: str) -> Dict[int, dict]:
+    """Rank → member dict (insertion-ordered by rank)."""
     members = []
     for item in items:
         idx = parse_node_index(item['name'], cluster_name_on_cloud)
         if idx is not None:
             members.append((idx, item))
-    return [item for _, item in sorted(members, key=lambda p: p[0])]
+    return dict(sorted(members, key=lambda p: p[0]))
 
 
 def wait_for_state(list_fn: Callable[[], List[dict]],

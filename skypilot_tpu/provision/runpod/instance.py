@@ -37,10 +37,8 @@ def run_instances(region: str, cluster_name_on_cloud: str,
                   config: common.ProvisionConfig) -> common.ProvisionRecord:
     client = _client(config.provider_config)
     existing = _cluster_pods(client, cluster_name_on_cloud)
-    by_index = {
-        neocloud_common.parse_node_index(p['name'], cluster_name_on_cloud):
-            p for p in existing
-    }
+    by_index = neocloud_common.members_by_index(existing,
+                                                cluster_name_on_cloud)
 
     created: List[str] = []
     resumed: List[str] = []
@@ -69,10 +67,20 @@ def run_instances(region: str, cluster_name_on_cloud: str,
         # Partial pods bill until rolled back; failover may leave this
         # datacenter for good. Pods resumed THIS attempt go back to
         # stopped (their prior state) rather than billing unattended.
+        # Best-effort per pod: one rollback failure must not abort the
+        # rest, nor mask the capacity error the failover engine needs.
         for pid in created:
-            client.terminate_pod(pid)
+            try:
+                client.terminate_pod(pid)
+            except runpod_api.RunPodApiError as cleanup_exc:
+                logger.warning(f'Rollback terminate of {pid} failed: '
+                               f'{cleanup_exc}')
         for pid in resumed:
-            client.stop_pod(pid)
+            try:
+                client.stop_pod(pid)
+            except runpod_api.RunPodApiError as cleanup_exc:
+                logger.warning(f'Rollback stop of {pid} failed: '
+                               f'{cleanup_exc}')
         raise
     head = by_index.get(0)
     head_id = head['id'] if head is not None else (

@@ -56,6 +56,37 @@ def _raise_for_error(message: str) -> None:
     raise RunPodApiError(message)
 
 
+def build_pod_body(name: str, region: str, instance_type: str,
+                   interruptible: bool,
+                   public_key: Optional[str]) -> Dict[str, Any]:
+    """Catalog instance type → REST deploy body.
+
+    '2x_A100-80GB_SECURE' → gpuTypeIds + gpuCount; '1x_CPU_SECURE' → a
+    CPU pod (computeType, no gpuTypeIds — the API rejects a GPU request
+    for type 'CPU'). Split out so the shape is unit-testable without the
+    real endpoint (the fake ignores bodies).
+    """
+    count_s, rest = instance_type.split('x_', 1)
+    device_type = rest.rsplit('_', 1)[0]
+    body: Dict[str, Any] = {
+        'name': name,
+        'dataCenterIds': [region],
+        'interruptible': interruptible,
+        'containerDiskInGb': 50,
+    }
+    if device_type == 'CPU':
+        body['computeType'] = 'CPU'
+        body['vcpuCount'] = 4 * int(count_s)
+        body['imageName'] = 'runpod/base:0.6.2'
+    else:
+        body['gpuTypeIds'] = [device_type]
+        body['gpuCount'] = int(count_s)
+        body['imageName'] = 'runpod/base:0.6.2-cuda12.2.0'
+    if public_key:
+        body['env'] = {'PUBLIC_KEY': public_key}
+    return body
+
+
 class RestTransport:
     """Real RunPod through curl + the REST API."""
 
@@ -86,28 +117,10 @@ class RestTransport:
     def deploy_pod(self, name: str, region: str, instance_type: str,
                    interruptible: bool,
                    public_key: Optional[str]) -> str:
-        # instance_type '2x_A100-80GB_SECURE' → gpuTypeId + count;
-        # '1x_CPU_SECURE' → a CPU pod (no gpuTypeIds — the API rejects
-        # a GPU request for type 'CPU').
-        count_s, rest = instance_type.split('x_', 1)
-        device_type = rest.rsplit('_', 1)[0]
-        body = {
-            'name': name,
-            'dataCenterIds': [region],
-            'interruptible': interruptible,
-            'containerDiskInGb': 50,
-        }
-        if device_type == 'CPU':
-            body['computeType'] = 'CPU'
-            body['vcpuCount'] = 4 * int(count_s)
-            body['imageName'] = 'runpod/base:0.6.2'
-        else:
-            body['gpuTypeIds'] = [device_type]
-            body['gpuCount'] = int(count_s)
-            body['imageName'] = 'runpod/base:0.6.2-cuda12.2.0'
-        if public_key:
-            body['env'] = {'PUBLIC_KEY': public_key}
-        out = self._run('POST', '/pods', body)
+        out = self._run(
+            'POST', '/pods',
+            build_pod_body(name, region, instance_type, interruptible,
+                           public_key))
         return out['id']
 
     def list_pods(self) -> List[Dict[str, Any]]:

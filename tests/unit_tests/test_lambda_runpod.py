@@ -210,6 +210,22 @@ def test_runpod_interruptible_flag_reaches_api():
             if p['name'].startswith('rspot-')] == [True]
 
 
+def test_runpod_pod_body_shapes():
+    """GPU types map to gpuTypeIds/gpuCount; CPU types to a computeType
+    body (the real API rejects a GPU request for type 'CPU')."""
+    gpu = runpod_api.build_pod_body('n-0', 'US-CA-1',
+                                    '2x_A100-80GB_SECURE', True,
+                                    'ssh-ed25519 AAAA')
+    assert gpu['gpuTypeIds'] == ['A100-80GB'] and gpu['gpuCount'] == 2
+    assert gpu['interruptible'] is True
+    assert gpu['env'] == {'PUBLIC_KEY': 'ssh-ed25519 AAAA'}
+    cpu = runpod_api.build_pod_body('n-0', 'EU-RO-1', '1x_CPU_SECURE',
+                                    False, None)
+    assert cpu['computeType'] == 'CPU' and cpu['vcpuCount'] == 4
+    assert 'gpuTypeIds' not in cpu and 'gpuCount' not in cpu
+    assert 'cuda' not in cpu['imageName']
+
+
 def test_runpod_stockout_blocklists_region(monkeypatch):
     monkeypatch.setenv('SKYTPU_RUNPOD_FAKE_STOCKOUT', 'US-CA-1')
     with pytest.raises(runpod_api.RunPodCapacityError):

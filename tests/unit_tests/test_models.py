@@ -34,6 +34,56 @@ def test_remat_matches_no_remat(debug_cfg):
                                rtol=1e-5)
 
 
+def test_all_remat_policies_match(debug_cfg):
+    """Every remat policy computes the same forward AND the same grads
+    as no-remat — policies change memory/recompute, never values."""
+    import dataclasses
+    params = llama.init_params(jax.random.PRNGKey(0), debug_cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                debug_cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    ref_loss, ref_grads = jax.value_and_grad(llama.loss_fn)(
+        params, tokens, targets, debug_cfg)
+    for policy in ('full', 'dots', 'ffn', 'ffn1', 'attn'):
+        cfg = dataclasses.replace(debug_cfg, remat=True,
+                                  remat_policy=policy)
+        loss, grads = jax.value_and_grad(llama.loss_fn)(
+            params, tokens, targets, cfg)
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=1e-5, err_msg=policy)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=2e-2, atol=1e-4), grads, ref_grads)
+
+
+def test_bf16_moment_adam_tracks_f32(debug_cfg):
+    """moment_dtype='bfloat16' must track exact Adam closely (it frees
+    half the optimizer HBM; see TrainConfig.moment_dtype)."""
+    from skypilot_tpu.models import train
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                                debug_cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    losses = {}
+    for md in ('float32', 'bfloat16'):
+        tcfg = train.TrainConfig(warmup_steps=2, moment_dtype=md)
+        state = train.init_train_state(jax.random.PRNGKey(0), debug_cfg,
+                                       tcfg)
+        step = train.make_train_step(debug_cfg, tcfg)
+        for _ in range(6):
+            state, metrics = step(state, tokens, targets)
+        losses[md] = float(metrics['loss'])
+        if md == 'bfloat16':
+            moment_dtypes = {
+                str(x.dtype)
+                for x in jax.tree.leaves(state.opt_state)
+                if hasattr(x, 'dtype') and x.ndim > 0
+            }
+            assert moment_dtypes == {'bfloat16'}, moment_dtypes
+    assert abs(losses['bfloat16'] - losses['float32']) < \
+        0.02 * abs(losses['float32']) + 1e-3, losses
+
+
 def test_param_count_8b():
     cfg = llama.CONFIGS['llama3-8b']
     n = cfg.num_params()
