@@ -88,11 +88,16 @@ def make_provision_config(
             provider_config['resource_group'] = resource_group
         auth_config['ssh_public_key'] = public_key
         auth_config['ssh_user'] = 'azureuser'
-    if cloud.name in ('lambda', 'runpod'):
+    _NEOCLOUD_SSH_USERS = {
+        'lambda': 'ubuntu',  # Lambda boots ubuntu images
+        'runpod': 'root',  # pods run as root
+        'do': 'root',
+        'fluidstack': 'ubuntu',
+        'vast': 'root',
+    }
+    if cloud.name in _NEOCLOUD_SSH_USERS:
         public_key, private_key = authentication.get_or_generate_keys()
-        # Lambda boots ubuntu images; RunPod pods run as root.
-        provider_config['ssh_user'] = ('ubuntu' if cloud.name == 'lambda'
-                                       else 'root')
+        provider_config['ssh_user'] = _NEOCLOUD_SSH_USERS[cloud.name]
         provider_config['ssh_private_key'] = private_key
         auth_config['ssh_public_key'] = public_key
         auth_config['ssh_user'] = provider_config['ssh_user']

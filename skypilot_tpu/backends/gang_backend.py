@@ -136,24 +136,12 @@ class FailoverCloudErrorHandler:
 
     @classmethod
     def classify(cls, exc: Exception) -> str:
-        from skypilot_tpu.provision.aws import ec2_api
-        from skypilot_tpu.provision.azure import az_api
-        from skypilot_tpu.provision.gcp import tpu_api
-        from skypilot_tpu.provision.kubernetes import k8s_api
-        if isinstance(exc, (ec2_api.AwsCapacityError,
-                            az_api.AzureCapacityError)):
-            # Quota limits are account/region-wide: sister zones would
-            # fail identically, so blocklist the whole region.
+        from skypilot_tpu.provision import common as provision_common
+        if isinstance(exc, provision_common.CapacityError):
+            # Every cloud's stockout/quota error inherits CapacityError
+            # with a scope: 'zone' (sister zones may work) or 'region'
+            # (quota / zoneless clouds — they would fail identically).
             return cls.ZONE if exc.scope == 'zone' else cls.REGION
-        from skypilot_tpu.provision.lambda_cloud import lambda_api
-        from skypilot_tpu.provision.runpod import runpod_api
-        if isinstance(exc, (tpu_api.GcpCapacityError,
-                            k8s_api.K8sCapacityError)):
-            return cls.ZONE
-        if isinstance(exc, (lambda_api.LambdaCapacityError,
-                            runpod_api.RunPodCapacityError)):
-            # Zoneless clouds: the datacenter/region is the failure unit.
-            return cls.REGION
         text = str(exc).lower()
         if any(s in text for s in cls._ZONE_MARKERS):
             return cls.ZONE

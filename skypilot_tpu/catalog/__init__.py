@@ -23,7 +23,8 @@ from skypilot_tpu import topology as topo_lib
 _DATA_DIR = os.path.join(os.path.dirname(__file__), 'data')
 
 # Clouds with a bundled VM catalog CSV (<cloud>_vms.csv).
-VM_CLOUDS = ('gcp', 'aws', 'azure', 'lambda', 'runpod')
+VM_CLOUDS = ('gcp', 'aws', 'azure', 'lambda', 'runpod', 'do',
+             'fluidstack', 'vast')
 
 # Catalog override dir for tests / refreshed data.
 CATALOG_DIR_ENV = 'SKYTPU_CATALOG_DIR'

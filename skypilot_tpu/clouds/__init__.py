@@ -5,22 +5,28 @@ from skypilot_tpu.clouds.cloud import Cloud
 from skypilot_tpu.clouds.cloud import CloudImplementationFeatures
 from skypilot_tpu.clouds.cloud import Region
 from skypilot_tpu.clouds.cloud import Zone
+from skypilot_tpu.clouds.do import DO
+from skypilot_tpu.clouds.fluidstack import Fluidstack
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.lambda_cloud import Lambda
 from skypilot_tpu.clouds.local import Local
 from skypilot_tpu.clouds.runpod import RunPod
+from skypilot_tpu.clouds.vast import Vast
 
 __all__ = [
     'AWS',
     'Azure',
     'Cloud',
     'CloudImplementationFeatures',
+    'DO',
+    'Fluidstack',
     'GCP',
     'Kubernetes',
     'Lambda',
     'Local',
     'Region',
     'RunPod',
+    'Vast',
     'Zone',
 ]

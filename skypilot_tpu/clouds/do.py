@@ -1,0 +1,35 @@
+"""DigitalOcean: droplets + GPU droplets for cross-cloud cost ranking.
+
+Parity: ``sky/clouds/do.py`` — region-only placement, no spot market,
+stop/resume supported (powered-off droplets keep billing storage, like
+stopped EC2). Lifecycle: ``provision/do`` (REST via curl + shared fake).
+"""
+from typing import List, Optional, Tuple
+
+from skypilot_tpu.clouds import simple_vm_cloud
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+
+@CLOUD_REGISTRY.register(name='do', aliases=['digitalocean'])
+class DO(simple_vm_cloud.SimpleVmCloud):
+    """DigitalOcean."""
+
+    _REPR = 'DO'
+    _CLOUD_KEY = 'do'
+    _HAS_SPOT = False
+    _EGRESS_PER_GB = 0.01  # $0.01/GB beyond pooled allowance
+    _MAX_CLUSTER_NAME_LEN_LIMIT = 50
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.do import do_api
+        if do_api.api_token() is None:
+            return False, ('DigitalOcean token not found. Set '
+                           '$DIGITALOCEAN_TOKEN or run `doctl auth init`.')
+        return True, None
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        from skypilot_tpu.provision.do import do_api
+        token = do_api.api_token()
+        return [f'do-token-{token[:8]}'] if token else None

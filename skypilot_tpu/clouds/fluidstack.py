@@ -1,0 +1,35 @@
+"""Fluidstack: dedicated GPU servers for cross-cloud cost ranking.
+
+Parity: ``sky/clouds/fluidstack.py`` — region-only placement, no spot
+market, stop/resume supported. Lifecycle: ``provision/fluidstack``
+(REST via curl + shared fake).
+"""
+from typing import List, Optional, Tuple
+
+from skypilot_tpu.clouds import simple_vm_cloud
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+
+@CLOUD_REGISTRY.register()
+class Fluidstack(simple_vm_cloud.SimpleVmCloud):
+    """Fluidstack (GPU cloud)."""
+
+    _REPR = 'Fluidstack'
+    _CLOUD_KEY = 'fluidstack'
+    _HAS_SPOT = False
+    _MAX_CLUSTER_NAME_LEN_LIMIT = 50
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.fluidstack import fluidstack_api
+        if fluidstack_api.api_key() is None:
+            return False, ('Fluidstack API key not found. Set '
+                           '$FLUIDSTACK_API_KEY or write it to '
+                           '~/.fluidstack/api_key.')
+        return True, None
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        from skypilot_tpu.provision.fluidstack import fluidstack_api
+        key = fluidstack_api.api_key()
+        return [f'fluidstack-key-{key[:8]}'] if key else None

@@ -6,21 +6,20 @@ region-only placement (no zones), no spot market, and no stop/resume
 ``provision/lambda_cloud`` (REST API via curl + in-memory fake).
 """
 import os
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
-from skypilot_tpu import catalog
-from skypilot_tpu import exceptions
 from skypilot_tpu.clouds import cloud
+from skypilot_tpu.clouds import simple_vm_cloud
 from skypilot_tpu.utils.registry import CLOUD_REGISTRY
-
-_CLOUD = 'lambda'
 
 
 @CLOUD_REGISTRY.register(name='lambda', aliases=['lambdacloud'])
-class Lambda(cloud.Cloud):
+class Lambda(simple_vm_cloud.SimpleVmCloud):
     """Lambda Cloud (GPU cloud)."""
 
     _REPR = 'Lambda'
+    _CLOUD_KEY = 'lambda'
+    _HAS_SPOT = False
     # Lambda instance names cap at 64 chars; keep suffix headroom.
     _MAX_CLUSTER_NAME_LEN_LIMIT = 50
 
@@ -29,143 +28,14 @@ class Lambda(cloud.Cloud):
         cls,
         resources=None
     ) -> Dict[cloud.CloudImplementationFeatures, str]:
-        del resources
-        return {
+        feats = super().unsupported_features(resources)
+        feats.update({
             cloud.CloudImplementationFeatures.STOP:
                 'Lambda instances cannot be stopped; only terminated.',
             cloud.CloudImplementationFeatures.AUTOSTOP:
                 'Autostop requires stop support, which Lambda lacks.',
-            cloud.CloudImplementationFeatures.SPOT_INSTANCE:
-                'Lambda has no spot market.',
-            cloud.CloudImplementationFeatures.CLONE_DISK_FROM_CLUSTER:
-                'Disk cloning is not supported on Lambda.',
-        }
-
-    # ----------------------------------------------------------- regions
-
-    def regions_with_offering(self, instance_type, accelerators, use_spot,
-                              region, zone) -> List[cloud.Region]:
-        del accelerators
-        if use_spot or instance_type is None:
-            return []
-        pairs = catalog.vm_regions_zones(instance_type, region, zone,
-                                         cloud=_CLOUD)
-        return cloud.regions_from_catalog_pairs(pairs)
-
-    def zones_provision_loop(self,
-                             *,
-                             region: str,
-                             num_nodes: int,
-                             instance_type: Optional[str],
-                             accelerators=None,
-                             use_spot: bool = False
-                             ) -> Iterator[Optional[List[cloud.Zone]]]:
-        # Region-only placement: yield each region's pseudo-zone (the
-        # region name itself) so the failover walk is one try per region.
-        del num_nodes
-        for r in self.regions_with_offering(instance_type, accelerators,
-                                            use_spot, region, None):
-            yield r.zones
-
-    # ----------------------------------------------------------- pricing
-
-    def instance_type_to_hourly_cost(self, instance_type, use_spot, region,
-                                     zone) -> float:
-        del zone
-        price = catalog.get_hourly_cost(instance_type, region, use_spot,
-                                        cloud=_CLOUD)
-        if price is None:
-            raise exceptions.ResourcesUnavailableError(
-                f'No Lambda pricing for {instance_type} in {region}.')
-        return price
-
-    def accelerators_to_hourly_cost(self, accelerators, use_spot, region,
-                                    zone) -> float:
-        # GPU cost is folded into the instance price.
-        del accelerators, use_spot, region, zone
-        return 0.0
-
-    def get_egress_cost(self, num_gigabytes: float) -> float:
-        # Lambda does not meter egress.
-        del num_gigabytes
-        return 0.0
-
-    # ----------------------------------------------------------- catalog
-
-    def instance_type_exists(self, instance_type: str) -> bool:
-        return catalog.instance_type_exists(instance_type, cloud=_CLOUD)
-
-    @classmethod
-    def get_default_instance_type(cls,
-                                  cpus=None,
-                                  memory=None,
-                                  disk_tier=None) -> Optional[str]:
-        del disk_tier
-        return catalog.get_default_instance_type(cpus, memory, cloud=_CLOUD)
-
-    @classmethod
-    def get_vcpus_mem_from_instance_type(cls, instance_type):
-        return catalog.get_vcpus_mem_from_instance_type(instance_type,
-                                                        cloud=_CLOUD)
-
-    @classmethod
-    def get_accelerators_from_instance_type(cls, instance_type):
-        return catalog.get_accelerators_from_instance_type(instance_type,
-                                                           cloud=_CLOUD)
-
-    def get_feasible_launchable_resources(self, resources, num_nodes):
-        from skypilot_tpu import topology as topo_lib
-        del num_nodes
-        if resources.use_spot:
-            return [], []  # no spot market
-        if resources.instance_type is not None and \
-                resources.accelerators is None:
-            if not self.instance_type_exists(resources.instance_type):
-                return [], []
-            return [resources.copy(cloud=self)], []
-
-        accs = resources.accelerators
-        if accs is None:
-            instance_type = self.get_default_instance_type(
-                resources.cpus, resources.memory)
-            if instance_type is None:
-                return [], []
-            return [
-                resources.copy(cloud=self, instance_type=instance_type)
-            ], []
-
-        acc_name, acc_count = next(iter(accs.items()))
-        if topo_lib.is_tpu_accelerator(acc_name):
-            return [], []  # TPUs live on GCP / GKE
-        instance_types = catalog.get_instance_type_for_accelerator(
-            acc_name,
-            acc_count,
-            cpus=resources.cpus,
-            memory=resources.memory,
-            region=resources.region,
-            zone=resources.zone,
-            cloud=_CLOUD)
-        if not instance_types:
-            return [], catalog.fuzzy_accelerator_hints(acc_name, 'Lambda')
-        return [
-            resources.copy(cloud=self, instance_type=instance_types[0])
-        ], []
-
-    # ----------------------------------------------------------- deploy
-
-    def make_deploy_resources_variables(self, resources,
-                                        cluster_name_on_cloud, region, zones,
-                                        num_nodes) -> Dict[str, object]:
-        del cluster_name_on_cloud
-        return {
-            'instance_type': resources.instance_type,
-            'region': region.name,
-            'zones': ','.join(z.name for z in zones) if zones else None,
-            'use_spot': False,
-            'disk_size': resources.disk_size,
-            'image_id': resources.image_id,
-            'num_nodes': num_nodes,
-        }
+        })
+        return feats
 
     # ----------------------------------------------------------- identity
 

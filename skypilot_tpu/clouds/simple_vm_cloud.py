@@ -1,0 +1,171 @@
+"""Shared base for catalog-driven VM clouds.
+
+Every cloud whose surface is "a CSV of priced SKUs + a provisioner"
+(Lambda, RunPod, DigitalOcean, Fluidstack, Vast — and structurally AWS/
+Azure, which keep their own classes for zone semantics and egress tiers)
+implements the same nine methods against ``skypilot_tpu.catalog``. This
+base parameterizes them by class attributes; subclasses add credentials,
+feature gates, and any cloud-specific deploy variables.
+
+Parity note: the reference repeats this surface per cloud under
+``sky/clouds/*.py`` (~12k LoC); here it is one base + thin subclasses.
+"""
+from typing import Dict, Iterator, List, Optional
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import cloud
+
+
+class SimpleVmCloud(cloud.Cloud):
+    """Catalog-driven cloud with region-only (or pseudo-zone) placement."""
+
+    # Subclasses pin these.
+    _CLOUD_KEY = ''  # catalog csv key ('lambda' → lambda_vms.csv)
+    _HAS_SPOT = True  # False → spot requests are infeasible here
+    _EGRESS_PER_GB = 0.0  # flat internet egress $/GB (0 = unmetered)
+
+    @classmethod
+    def unsupported_features(
+        cls,
+        resources=None
+    ) -> Dict[cloud.CloudImplementationFeatures, str]:
+        del resources
+        feats = {
+            cloud.CloudImplementationFeatures.CLONE_DISK_FROM_CLUSTER:
+                f'Disk cloning is not supported on {cls._REPR}.',
+        }
+        if not cls._HAS_SPOT:
+            feats[cloud.CloudImplementationFeatures.SPOT_INSTANCE] = \
+                f'{cls._REPR} has no spot market.'
+        return feats
+
+    # ----------------------------------------------------------- regions
+
+    def regions_with_offering(self, instance_type, accelerators, use_spot,
+                              region, zone) -> List[cloud.Region]:
+        del accelerators
+        if instance_type is None:
+            return []
+        if use_spot and not self._HAS_SPOT:
+            return []
+        pairs = catalog.vm_regions_zones(instance_type, region, zone,
+                                         cloud=self._CLOUD_KEY)
+        return cloud.regions_from_catalog_pairs(pairs)
+
+    def zones_provision_loop(self,
+                             *,
+                             region: str,
+                             num_nodes: int,
+                             instance_type: Optional[str],
+                             accelerators=None,
+                             use_spot: bool = False
+                             ) -> Iterator[Optional[List[cloud.Zone]]]:
+        # Region-only placement: each region's (pseudo-)zone set is one
+        # failover try.
+        del num_nodes
+        for r in self.regions_with_offering(instance_type, accelerators,
+                                            use_spot, region, None):
+            yield r.zones
+
+    # ----------------------------------------------------------- pricing
+
+    def instance_type_to_hourly_cost(self, instance_type, use_spot, region,
+                                     zone) -> float:
+        del zone
+        price = catalog.get_hourly_cost(instance_type, region, use_spot,
+                                        cloud=self._CLOUD_KEY)
+        if price is None:
+            raise exceptions.ResourcesUnavailableError(
+                f'No {self._REPR} pricing for {instance_type} in '
+                f'{region}.')
+        return price
+
+    def accelerators_to_hourly_cost(self, accelerators, use_spot, region,
+                                    zone) -> float:
+        # GPU cost is folded into the instance price.
+        del accelerators, use_spot, region, zone
+        return 0.0
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        return max(num_gigabytes, 0.0) * self._EGRESS_PER_GB
+
+    # ----------------------------------------------------------- catalog
+
+    def instance_type_exists(self, instance_type: str) -> bool:
+        return catalog.instance_type_exists(instance_type,
+                                            cloud=self._CLOUD_KEY)
+
+    @classmethod
+    def get_default_instance_type(cls,
+                                  cpus=None,
+                                  memory=None,
+                                  disk_tier=None) -> Optional[str]:
+        del disk_tier
+        return catalog.get_default_instance_type(cpus, memory,
+                                                 cloud=cls._CLOUD_KEY)
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(cls, instance_type):
+        return catalog.get_vcpus_mem_from_instance_type(
+            instance_type, cloud=cls._CLOUD_KEY)
+
+    @classmethod
+    def get_accelerators_from_instance_type(cls, instance_type):
+        return catalog.get_accelerators_from_instance_type(
+            instance_type, cloud=cls._CLOUD_KEY)
+
+    def get_feasible_launchable_resources(self, resources, num_nodes):
+        from skypilot_tpu import topology as topo_lib
+        del num_nodes
+        if resources.use_spot and not self._HAS_SPOT:
+            return [], []
+        if resources.instance_type is not None and \
+                resources.accelerators is None:
+            if not self.instance_type_exists(resources.instance_type):
+                return [], []
+            return [resources.copy(cloud=self)], []
+
+        accs = resources.accelerators
+        if accs is None:
+            instance_type = self.get_default_instance_type(
+                resources.cpus, resources.memory)
+            if instance_type is None:
+                return [], []
+            return [
+                resources.copy(cloud=self, instance_type=instance_type)
+            ], []
+
+        acc_name, acc_count = next(iter(accs.items()))
+        if topo_lib.is_tpu_accelerator(acc_name):
+            return [], []  # TPUs live on GCP / GKE
+        instance_types = catalog.get_instance_type_for_accelerator(
+            acc_name,
+            acc_count,
+            cpus=resources.cpus,
+            memory=resources.memory,
+            region=resources.region,
+            zone=resources.zone,
+            cloud=self._CLOUD_KEY)
+        if not instance_types:
+            return [], catalog.fuzzy_accelerator_hints(
+                acc_name, self._REPR)
+        return [
+            resources.copy(cloud=self, instance_type=instance_types[0])
+        ], []
+
+    # ----------------------------------------------------------- deploy
+
+    def make_deploy_resources_variables(self, resources,
+                                        cluster_name_on_cloud, region, zones,
+                                        num_nodes) -> Dict[str, object]:
+        del cluster_name_on_cloud
+        return {
+            'instance_type': resources.instance_type,
+            'region': region.name,
+            'zones': ','.join(z.name for z in zones) if zones else None,
+            'use_spot': resources.use_spot and self._HAS_SPOT,
+            'disk_size': resources.disk_size,
+            'image_id': resources.image_id,
+            'num_nodes': num_nodes,
+        }

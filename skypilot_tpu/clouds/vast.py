@@ -1,0 +1,45 @@
+"""Vast.ai: GPU marketplace for cross-cloud cost ranking.
+
+Parity: ``sky/clouds/vast.py`` — "regions" are geolocations (US/EU/...),
+spot = interruptible bids, stop/resume supported. Lifecycle:
+``provision/vast`` (offer search + rent via curl + shared fake).
+"""
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.clouds import simple_vm_cloud
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+
+@CLOUD_REGISTRY.register(name='vast', aliases=['vastai'])
+class Vast(simple_vm_cloud.SimpleVmCloud):
+    """Vast.ai (GPU marketplace)."""
+
+    _REPR = 'Vast'
+    _CLOUD_KEY = 'vast'
+    _HAS_SPOT = True
+    _MAX_CLUSTER_NAME_LEN_LIMIT = 50
+
+    @classmethod
+    def unsupported_features(
+        cls,
+        resources=None
+    ) -> Dict[cloud.CloudImplementationFeatures, str]:
+        feats = super().unsupported_features(resources)
+        feats[cloud.CloudImplementationFeatures.OPEN_PORTS] = \
+            'Vast.ai hosts expose only the mapped SSH port.'
+        return feats
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.vast import vast_api
+        if vast_api.api_key() is None:
+            return False, ('Vast.ai API key not found. Set $VAST_API_KEY '
+                           'or write it to ~/.vast_api_key.')
+        return True, None
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        from skypilot_tpu.provision.vast import vast_api
+        key = vast_api.api_key()
+        return [f'vast-key-{key[:8]}'] if key else None

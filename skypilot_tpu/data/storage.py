@@ -708,7 +708,19 @@ class Storage:
         mode = StorageMode(config.get('mode', 'MOUNT').upper())
         stores = None
         if config.get('store') is not None:
-            stores = [StoreType(str(config['store']).upper())]
+            raw = str(config['store'])
+            try:
+                stores = [StoreType(raw.upper())]
+            except ValueError:
+                # Scheme names are also accepted ('cos' → IBM, 'gs' →
+                # GCS) — they are what the URIs themselves use.
+                if raw.lower() not in SCHEME_TO_STORE:
+                    raise exceptions.StorageError(
+                        f'Unknown store {raw!r}; expected one of '
+                        f'{sorted(s.value.lower() for s in StoreType)} '
+                        f'or a scheme in {sorted(SCHEME_TO_STORE)}.'
+                    ) from None
+                stores = [SCHEME_TO_STORE[raw.lower()]]
         return cls(name=name,
                    source=source,
                    stores=stores,

@@ -11,11 +11,14 @@ from skypilot_tpu.provision import common
 _PROVIDER_MODULES = {
     'aws': 'skypilot_tpu.provision.aws',
     'azure': 'skypilot_tpu.provision.azure',
+    'do': 'skypilot_tpu.provision.do',
+    'fluidstack': 'skypilot_tpu.provision.fluidstack',
     'gcp': 'skypilot_tpu.provision.gcp',
     'kubernetes': 'skypilot_tpu.provision.kubernetes',
     'lambda': 'skypilot_tpu.provision.lambda_cloud',
     'local': 'skypilot_tpu.provision.local',
     'runpod': 'skypilot_tpu.provision.runpod',
+    'vast': 'skypilot_tpu.provision.vast',
 }
 
 

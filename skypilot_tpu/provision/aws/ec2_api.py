@@ -19,6 +19,7 @@ import threading
 import uuid
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu import sky_logging
 
 logger = sky_logging.init_logger(__name__)
@@ -33,7 +34,7 @@ class Ec2ApiError(Exception):
         self.message = message
 
 
-class AwsCapacityError(Ec2ApiError):
+class AwsCapacityError(Ec2ApiError, provision_common.CapacityError):
     """Capacity exhaustion. ``scope`` tells the failover engine how much
     to blocklist: 'zone' for a zonal stockout, 'region' for account/region
     quota limits (retrying sister zones cannot help)."""

@@ -23,6 +23,7 @@ import threading
 import uuid
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu import sky_logging
 
 logger = sky_logging.init_logger(__name__)
@@ -37,7 +38,7 @@ class AzureApiError(Exception):
         self.message = message
 
 
-class AzureCapacityError(AzureApiError):
+class AzureCapacityError(AzureApiError, provision_common.CapacityError):
     """Capacity exhaustion. ``scope``: 'zone' for a zonal allocation
     failure, 'region' for SKU/quota exhaustion (sister zones in the same
     region fail identically)."""

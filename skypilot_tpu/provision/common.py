@@ -138,5 +138,18 @@ class ProvisionerError(RuntimeError):
     errors: List[Dict[str, Any]] = []
 
 
+class CapacityError(Exception):
+    """Mixin base for per-cloud capacity/stockout errors.
+
+    ``scope`` tells the failover engine how much to blocklist: 'zone'
+    (sister zones may still work) or 'region' (quota / zoneless clouds —
+    retrying sister zones cannot help). Per-cloud API errors multiply
+    inherit: ``class AwsCapacityError(Ec2ApiError, CapacityError)`` —
+    so ``bulk_provision`` and ``FailoverCloudErrorHandler.classify``
+    need only this one type, not an import per cloud.
+    """
+    scope: str = 'region'
+
+
 class StopFailoverError(ProvisionerError):
     """Cluster is partially up and must not fail over elsewhere."""

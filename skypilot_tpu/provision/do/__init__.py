@@ -1,0 +1,9 @@
+"""DigitalOcean droplet provisioner (parity: ``sky/provision/do/``)."""
+from skypilot_tpu.provision.do.instance import cleanup_ports
+from skypilot_tpu.provision.do.instance import get_cluster_info
+from skypilot_tpu.provision.do.instance import open_ports
+from skypilot_tpu.provision.do.instance import query_instances
+from skypilot_tpu.provision.do.instance import run_instances
+from skypilot_tpu.provision.do.instance import stop_instances
+from skypilot_tpu.provision.do.instance import terminate_instances
+from skypilot_tpu.provision.do.instance import wait_instances

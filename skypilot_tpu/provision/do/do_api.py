@@ -1,0 +1,142 @@
+"""DigitalOcean API client (parity: ``sky/provision/do/utils.py``).
+
+Two transports: curl against ``https://api.digitalocean.com/v2`` (Bearer
+token from $DIGITALOCEAN_TOKEN or doctl's config), or the shared
+:class:`~skypilot_tpu.provision.neocloud_fake.FakeNeoClient` when
+``SKYTPU_DO_FAKE=1``. Normalized instance dicts per
+``neocloud_common.make_lifecycle``.
+"""
+import json
+import os
+import subprocess
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision import neocloud_fake
+
+_API_URL = 'https://api.digitalocean.com/v2'
+
+# Droplet statuses → uniform vocabulary; the fake's normalized statuses
+# map to themselves.
+STATE_MAP = {
+    'new': 'pending',
+    'active': 'running',
+    'off': 'stopped',
+    'archive': 'terminated',
+    'running': 'running',
+    'stopped': 'stopped',
+    'terminated': 'terminated',
+}
+
+_CAPACITY_MARKERS = ('is currently unavailable', 'droplet limit',
+                     'out of capacity')
+
+
+class DoApiError(Exception):
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+class DoCapacityError(DoApiError, provision_common.CapacityError):
+    """Region cannot serve the size. Zoneless: scope = region."""
+
+
+def api_token() -> Optional[str]:
+    token = os.environ.get('DIGITALOCEAN_TOKEN')
+    if token:
+        return token
+    path = os.path.expanduser('~/.config/doctl/config.yaml')
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                if line.strip().startswith('access-token:'):
+                    return line.split(':', 1)[1].strip().strip('"')
+    return None
+
+
+class RestTransport:
+    """Real DigitalOcean through curl + the REST API."""
+
+    def __init__(self, token: str):
+        self.token = token
+
+    def _run(self, method: str, path: str,
+             body: Optional[dict] = None) -> Any:
+        args = ['curl', '-sS', '-K', '-', '-X', method,
+                '-H', 'Content-Type: application/json',
+                f'{_API_URL}{path}']
+        if body is not None:
+            args += ['-d', json.dumps(body)]
+        secret_cfg = f'header = "Authorization: Bearer {self.token}"\n'
+        proc = subprocess.run(args, input=secret_cfg, capture_output=True,
+                              text=True, timeout=120, check=False)
+        if proc.returncode != 0:
+            raise DoApiError(f'do api {path}: {proc.stderr.strip()}')
+        out = json.loads(proc.stdout) if proc.stdout.strip() else {}
+        if isinstance(out, dict) and out.get('message') and out.get('id'):
+            msg = str(out['message'])
+            if any(m in msg.lower() for m in _CAPACITY_MARKERS):
+                raise DoCapacityError(msg)
+            raise DoApiError(msg)
+        return out
+
+    def deploy(self, name: str, region: str, instance_type: str,
+               use_spot: bool, public_key: Optional[str]) -> str:
+        del use_spot  # DO has no spot market (gated at the cloud level)
+        body: Dict[str, Any] = {
+            'name': name,
+            'region': region,
+            'size': instance_type,
+            'image': 'ubuntu-22-04-x64',
+        }
+        if public_key:
+            body['user_data'] = ('#cloud-config\nssh_authorized_keys:\n'
+                                 f'  - {public_key}\n')
+        out = self._run('POST', '/droplets', body)
+        return str(out['droplet']['id'])
+
+    def list(self) -> List[Dict[str, Any]]:
+        out = self._run('GET', '/droplets?per_page=200')
+        droplets = []
+        for d in out.get('droplets', []):
+            v4 = d.get('networks', {}).get('v4', [])
+            pub = next((n['ip_address'] for n in v4
+                        if n.get('type') == 'public'), None)
+            priv = next((n['ip_address'] for n in v4
+                         if n.get('type') == 'private'), '')
+            droplets.append({
+                'id': str(d['id']),
+                'name': d.get('name', ''),
+                'instance_type': d.get('size_slug', ''),
+                'region': d.get('region', {}).get('slug', ''),
+                'status': d.get('status', 'new'),
+                'ip': pub,
+                'private_ip': priv,
+            })
+        return droplets
+
+    def _action(self, iid: str, action_type: str) -> None:
+        self._run('POST', f'/droplets/{iid}/actions',
+                  {'type': action_type})
+
+    def stop(self, iid: str) -> None:
+        self._action(iid, 'power_off')
+
+    def start(self, iid: str) -> None:
+        self._action(iid, 'power_on')
+
+    def terminate(self, iid: str) -> None:
+        self._run('DELETE', f'/droplets/{iid}')
+
+
+def make_client():
+    if neocloud_fake.fake_enabled('DO'):
+        return neocloud_fake.FakeNeoClient(
+            'DO', lambda region: DoCapacityError(
+                f'size is currently unavailable in {region}. (fake)'))
+    token = api_token()
+    if token is None:
+        raise DoApiError('No DigitalOcean token configured.')
+    return RestTransport(token)

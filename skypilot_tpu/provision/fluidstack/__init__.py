@@ -1,0 +1,9 @@
+"""Fluidstack GPU provisioner (parity: ``sky/provision/fluidstack/``)."""
+from skypilot_tpu.provision.fluidstack.instance import cleanup_ports
+from skypilot_tpu.provision.fluidstack.instance import get_cluster_info
+from skypilot_tpu.provision.fluidstack.instance import open_ports
+from skypilot_tpu.provision.fluidstack.instance import query_instances
+from skypilot_tpu.provision.fluidstack.instance import run_instances
+from skypilot_tpu.provision.fluidstack.instance import stop_instances
+from skypilot_tpu.provision.fluidstack.instance import terminate_instances
+from skypilot_tpu.provision.fluidstack.instance import wait_instances

@@ -1,0 +1,128 @@
+"""Fluidstack API client (parity: ``sky/provision/fluidstack/
+fluidstack_utils.py``).
+
+curl against ``https://platform.fluidstack.io`` (api-key header from
+$FLUIDSTACK_API_KEY or ~/.fluidstack/api_key), or the shared fake when
+``SKYTPU_FLUIDSTACK_FAKE=1``.
+"""
+import json
+import os
+import subprocess
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision import neocloud_fake
+
+_API_URL = 'https://platform.fluidstack.io'
+
+STATE_MAP = {
+    'pending': 'pending',
+    'provisioning': 'pending',
+    'running': 'running',
+    'stopping': 'stopping',
+    'stopped': 'stopped',
+    'terminated': 'terminated',
+    'unhealthy': 'running',
+}
+
+_CAPACITY_MARKERS = ('out of capacity', 'no capacity',
+                     'insufficient capacity')
+
+
+class FluidstackApiError(Exception):
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+class FluidstackCapacityError(FluidstackApiError, provision_common.CapacityError):
+    """Region out of the requested GPU configuration."""
+
+
+def api_key() -> Optional[str]:
+    key = os.environ.get('FLUIDSTACK_API_KEY')
+    if key:
+        return key
+    path = os.path.expanduser('~/.fluidstack/api_key')
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            return f.read().strip() or None
+    return None
+
+
+class RestTransport:
+    """Real Fluidstack through curl + the REST API."""
+
+    def __init__(self, key: str):
+        self.key = key
+
+    def _run(self, method: str, path: str,
+             body: Optional[dict] = None) -> Any:
+        args = ['curl', '-sS', '-K', '-', '-X', method,
+                '-H', 'Content-Type: application/json',
+                f'{_API_URL}{path}']
+        if body is not None:
+            args += ['-d', json.dumps(body)]
+        secret_cfg = f'header = "api-key: {self.key}"\n'
+        proc = subprocess.run(args, input=secret_cfg, capture_output=True,
+                              text=True, timeout=120, check=False)
+        if proc.returncode != 0:
+            raise FluidstackApiError(
+                f'fluidstack api {path}: {proc.stderr.strip()}')
+        out = json.loads(proc.stdout) if proc.stdout.strip() else {}
+        if isinstance(out, dict) and out.get('error'):
+            msg = str(out.get('message', out['error']))
+            if any(m in msg.lower() for m in _CAPACITY_MARKERS):
+                raise FluidstackCapacityError(msg)
+            raise FluidstackApiError(msg)
+        return out
+
+    def deploy(self, name: str, region: str, instance_type: str,
+               use_spot: bool, public_key: Optional[str]) -> str:
+        del use_spot  # no spot market (gated at the cloud level)
+        body: Dict[str, Any] = {
+            'name': name,
+            'region': region,
+            'gpu_type': instance_type.split('x_', 1)[-1],
+            'gpu_count': int(instance_type.split('x_', 1)[0]),
+            'operating_system_label': 'ubuntu_22_04_lts_nvidia',
+        }
+        if public_key:
+            body['ssh_key'] = public_key
+        out = self._run('POST', '/instances', body)
+        return str(out['id'])
+
+    def list(self) -> List[Dict[str, Any]]:
+        out = self._run('GET', '/instances')
+        items = out if isinstance(out, list) else out.get('instances', [])
+        return [{
+            'id': str(i['id']),
+            'name': i.get('name', ''),
+            'instance_type': i.get('gpu_type', ''),
+            'region': i.get('region', ''),
+            'status': i.get('status', 'pending'),
+            'ip': i.get('ip_address'),
+            'private_ip': i.get('private_ip_address', ''),
+        } for i in items]
+
+    def stop(self, iid: str) -> None:
+        self._run('PUT', f'/instances/{iid}/stop')
+
+    def start(self, iid: str) -> None:
+        self._run('PUT', f'/instances/{iid}/start')
+
+    def terminate(self, iid: str) -> None:
+        self._run('DELETE', f'/instances/{iid}')
+
+
+def make_client():
+    if neocloud_fake.fake_enabled('FLUIDSTACK'):
+        return neocloud_fake.FakeNeoClient(
+            'FLUIDSTACK', lambda region: FluidstackCapacityError(
+                f'Out of capacity for the requested configuration in '
+                f'{region}. (fake)'))
+    key = api_key()
+    if key is None:
+        raise FluidstackApiError('No Fluidstack API key configured.')
+    return RestTransport(key)

@@ -22,6 +22,7 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu import sky_logging
 
 logger = sky_logging.init_logger(__name__)
@@ -40,8 +41,9 @@ class TpuApiError(Exception):
         self.body = body or {}
 
 
-class GcpCapacityError(TpuApiError):
+class GcpCapacityError(TpuApiError, provision_common.CapacityError):
     """Stockout / quota errors — the failover engine blocklists the zone."""
+    scope = 'zone'
 
 
 def _get_access_token() -> str:

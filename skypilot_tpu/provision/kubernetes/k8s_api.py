@@ -25,6 +25,7 @@ import subprocess
 import threading
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu import sky_logging
 
 logger = sky_logging.init_logger(__name__)
@@ -48,9 +49,10 @@ class K8sApiError(Exception):
         self.message = message
 
 
-class K8sCapacityError(K8sApiError):
+class K8sCapacityError(K8sApiError, provision_common.CapacityError):
     """Pod cannot be scheduled (no node fits) — the failover engine treats
     this like a zonal stockout and tries the next context."""
+    scope = 'zone'
 
 
 class KubectlTransport:

@@ -71,12 +71,13 @@ def run_instances(region: str, cluster_name_on_cloud: str,
             created.append(iid)
     except lambda_api.LambdaCapacityError:
         # Partial creates bill until terminated; failover may leave this
-        # region for good. Best-effort: a rollback failure must not mask
-        # the capacity error the failover engine needs.
+        # region for good. Best-effort: no rollback failure (API error,
+        # curl timeout, bad JSON) may mask the capacity error the
+        # failover engine needs.
         if created:
             try:
                 client.terminate(created)
-            except lambda_api.LambdaApiError as cleanup_exc:
+            except Exception as cleanup_exc:  # pylint: disable=broad-except
                 logger.warning(f'Rollback terminate of {created} failed: '
                                f'{cleanup_exc}')
         raise

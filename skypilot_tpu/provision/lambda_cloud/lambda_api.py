@@ -26,6 +26,7 @@ import threading
 import uuid
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu import sky_logging
 
 logger = sky_logging.init_logger(__name__)
@@ -41,10 +42,10 @@ class LambdaApiError(Exception):
         self.message = message
 
 
-class LambdaCapacityError(LambdaApiError):
-    """Region out of capacity. Lambda has no zones: scope is always
-    'region' — hence no ``scope`` attribute; the failover classifier
-    special-cases the type."""
+class LambdaCapacityError(LambdaApiError, provision_common.CapacityError):
+    """Region out of capacity. Lambda has no zones, so the inherited
+    ``CapacityError.scope = 'region'`` default is exactly right — the
+    failover engine blocklists the whole region."""
 
 
 def _is_capacity_code(code: str) -> bool:

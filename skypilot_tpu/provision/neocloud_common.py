@@ -9,7 +9,10 @@ fix lands once, not per cloud.
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from skypilot_tpu import sky_logging
 from skypilot_tpu.provision import common
+
+logger = sky_logging.init_logger(__name__)
 
 
 def parse_node_index(name: str,
@@ -103,3 +106,150 @@ def query_statuses(items: List[dict], state_map: Dict[str, str],
             continue
         out[item['id']] = status
     return out
+
+
+def make_lifecycle(provider_name: str, make_client: Callable[[], Any],
+                   state_map: Dict[str, str], capacity_error: type,
+                   default_ssh_user: str,
+                   supports_stop: bool = True) -> Dict[str, Callable]:
+    """Full PROVISIONER_SURFACE for a name-membership REST cloud.
+
+    The client must expose: ``deploy(name, region, instance_type,
+    use_spot, public_key) -> id``, ``list() -> [normalized dicts]``,
+    ``stop(id)``, ``start(id)``, ``terminate(id)``. Clouds with quirks
+    (Lambda's no-stop + SSH-key registry, RunPod's pod bodies) keep
+    hand-written modules; the uniform ones (DigitalOcean, Fluidstack,
+    Vast) use this factory so the lifecycle logic exists once.
+    """
+
+    def _live_members(client, cluster_name_on_cloud: str) -> List[dict]:
+        return [
+            m for m in cluster_members(client.list(), cluster_name_on_cloud)
+            if state_map.get(m['status']) not in ('terminating',
+                                                 'terminated')
+        ]
+
+    def run_instances(region, cluster_name_on_cloud, config):
+        client = make_client()
+        existing = _live_members(client, cluster_name_on_cloud)
+        by_index = members_by_index(existing, cluster_name_on_cloud)
+        created: List[str] = []
+        resumed: List[str] = []
+        try:
+            for i in range(config.count):
+                member = by_index.get(i)
+                if member is not None:
+                    if state_map.get(member['status']) == 'stopped':
+                        if not config.resume_stopped_nodes:
+                            raise common.ProvisionerError(
+                                f'Node {i} of {cluster_name_on_cloud} is '
+                                'stopped and resume_stopped_nodes is '
+                                'False; start the cluster instead.')
+                        client.start(member['id'])
+                        resumed.append(member['id'])
+                    continue
+                iid = client.deploy(
+                    name=f'{cluster_name_on_cloud}-{i}',
+                    region=region,
+                    instance_type=config.node_config['instance_type'],
+                    use_spot=config.node_config.get('use_spot', False),
+                    public_key=config.authentication_config.get(
+                        'ssh_public_key'))
+                created.append(iid)
+        except capacity_error:
+            # Partial creates bill until rolled back; best-effort per
+            # node so NOTHING (API errors, curl timeouts, bad JSON) can
+            # mask the capacity error the failover engine classifies.
+            for iid in created:
+                try:
+                    client.terminate(iid)
+                except Exception as exc:  # pylint: disable=broad-except
+                    logger.warning(
+                        f'Rollback terminate of {iid} failed: {exc}')
+            for iid in resumed:
+                try:
+                    client.stop(iid)
+                except Exception as exc:  # pylint: disable=broad-except
+                    logger.warning(f'Rollback stop of {iid} failed: {exc}')
+            raise
+        head = by_index.get(0)
+        head_id = head['id'] if head is not None else (
+            created[0] if created else None)
+        assert head_id is not None
+        return common.ProvisionRecord(provider_name=provider_name,
+                                      region=region,
+                                      zone=None,
+                                      cluster_name=cluster_name_on_cloud,
+                                      head_instance_id=head_id,
+                                      resumed_instance_ids=resumed,
+                                      created_instance_ids=created)
+
+    def wait_instances(region, cluster_name_on_cloud, state='running',
+                       provider_config=None):
+        del region, provider_config
+        client = make_client()
+        wait_for_state(
+            lambda: _live_members(client, cluster_name_on_cloud),
+            state_map, cluster_name_on_cloud, state)
+
+    def get_cluster_info(region, cluster_name_on_cloud,
+                         provider_config=None):
+        del region
+        assert provider_config is not None
+        client = make_client()
+        return build_cluster_info(
+            _live_members(client, cluster_name_on_cloud), provider_name,
+            provider_config, default_ssh_user=default_ssh_user)
+
+    def query_instances(cluster_name_on_cloud, provider_config=None,
+                        non_terminated_only=True):
+        del provider_config
+        client = make_client()
+        return query_statuses(
+            cluster_members(client.list(), cluster_name_on_cloud),
+            state_map, non_terminated_only)
+
+    def _ids(client, cluster_name_on_cloud: str,
+             worker_only: bool) -> List[str]:
+        return [
+            m['id']
+            for m in _live_members(client, cluster_name_on_cloud)
+            if not (worker_only and parse_node_index(
+                m['name'], cluster_name_on_cloud) == 0)
+        ]
+
+    def stop_instances(cluster_name_on_cloud, provider_config=None,
+                       worker_only=False):
+        del provider_config
+        if not supports_stop:
+            from skypilot_tpu import exceptions
+            raise exceptions.NotSupportedError(
+                f'{provider_name} instances cannot be stopped — only '
+                'terminated.')
+        client = make_client()
+        for iid in _ids(client, cluster_name_on_cloud, worker_only):
+            client.stop(iid)
+
+    def terminate_instances(cluster_name_on_cloud, provider_config=None,
+                            worker_only=False):
+        del provider_config
+        client = make_client()
+        for iid in _ids(client, cluster_name_on_cloud, worker_only):
+            client.terminate(iid)
+
+    def open_ports(cluster_name_on_cloud, ports, provider_config=None):
+        logger.debug(f'open_ports({cluster_name_on_cloud}, {ports})')
+
+    def cleanup_ports(cluster_name_on_cloud, ports, provider_config=None):
+        logger.debug(f'cleanup_ports({cluster_name_on_cloud}, {ports})')
+
+    return {
+        'run_instances': run_instances,
+        'wait_instances': wait_instances,
+        'get_cluster_info': get_cluster_info,
+        'query_instances': query_instances,
+        'stop_instances': stop_instances,
+        'terminate_instances': terminate_instances,
+        'open_ports': open_ports,
+        'cleanup_ports': cleanup_ports,
+    }

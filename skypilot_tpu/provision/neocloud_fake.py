@@ -1,0 +1,98 @@
+"""Shared in-memory fake for factory-built neocloud provisioners
+(DigitalOcean, Fluidstack, Vast — see ``neocloud_common.make_lifecycle``).
+
+Normalized statuses ('running'/'stopped'/'terminated') double as the
+state-map keys, so one fake serves every cloud. Fault injection:
+``SKYTPU_<KEY>_FAKE_STOCKOUT='<region>,...'`` makes deploy raise the
+cloud's capacity error; ``SKYTPU_<KEY>_FAKE_STATE=<path>`` shares state
+across processes.
+"""
+import json
+import os
+import threading
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+# Per-cloud in-process state: cloud key → {instance id → record}.
+_STATES: Dict[str, Dict[str, Dict[str, Any]]] = {}
+_LOCK = threading.Lock()
+
+
+class FakeNeoClient:
+    """deploy/list/stop/start/terminate against in-memory state."""
+
+    def __init__(self, cloud_key: str,
+                 capacity_error: Callable[[str], Exception],
+                 ip_prefix: str = '203.0.113'):
+        self.cloud_key = cloud_key.upper()
+        self.capacity_error = capacity_error
+        self.ip_prefix = ip_prefix
+        self._state_env = f'SKYTPU_{self.cloud_key}_FAKE_STATE'
+        self._stockout_env = f'SKYTPU_{self.cloud_key}_FAKE_STOCKOUT'
+
+    def _load(self) -> Dict[str, Dict[str, Any]]:
+        path = os.environ.get(self._state_env)
+        if path and os.path.exists(path):
+            with open(path, encoding='utf-8') as f:
+                return json.load(f)
+        return _STATES.setdefault(self.cloud_key, {})
+
+    def _save(self, state: Dict[str, Dict[str, Any]]) -> None:
+        path = os.environ.get(self._state_env)
+        if path:
+            with open(path, 'w', encoding='utf-8') as f:
+                json.dump(state, f)
+        else:
+            _STATES[self.cloud_key] = state
+
+    def deploy(self, name: str, region: str, instance_type: str,
+               use_spot: bool, public_key: Optional[str]) -> str:
+        del public_key
+        stockout = os.environ.get(self._stockout_env, '').split(',')
+        if region in stockout:
+            raise self.capacity_error(region)
+        with _LOCK:
+            state = self._load()
+            iid = f'{self.cloud_key.lower()}-{uuid.uuid4().hex[:12]}'
+            n = len(state)
+            state[iid] = {
+                'id': iid,
+                'name': name,
+                'instance_type': instance_type,
+                'region': region,
+                'status': 'running',
+                'ip': f'{self.ip_prefix}.{n + 10}',
+                'private_ip': f'10.100.0.{n + 10}',
+                'use_spot': use_spot,
+            }
+            self._save(state)
+            return iid
+
+    def list(self) -> List[Dict[str, Any]]:
+        return [dict(i) for i in self._load().values()
+                if i['status'] != 'terminated']
+
+    def _set(self, iid: str, status: str) -> None:
+        with _LOCK:
+            state = self._load()
+            if iid in state:
+                state[iid]['status'] = status
+            self._save(state)
+
+    def stop(self, iid: str) -> None:
+        self._set(iid, 'stopped')
+
+    def start(self, iid: str) -> None:
+        self._set(iid, 'running')
+
+    def terminate(self, iid: str) -> None:
+        self._set(iid, 'terminated')
+
+
+def reset(cloud_key: str) -> None:
+    """Test helper: drop the in-process state for one cloud."""
+    _STATES.pop(cloud_key.upper(), None)
+
+
+def fake_enabled(cloud_key: str) -> bool:
+    return os.environ.get(f'SKYTPU_{cloud_key.upper()}_FAKE', '0') == '1'

@@ -72,13 +72,13 @@ def run_instances(region: str, cluster_name_on_cloud: str,
         for pid in created:
             try:
                 client.terminate_pod(pid)
-            except runpod_api.RunPodApiError as cleanup_exc:
+            except Exception as cleanup_exc:  # pylint: disable=broad-except
                 logger.warning(f'Rollback terminate of {pid} failed: '
                                f'{cleanup_exc}')
         for pid in resumed:
             try:
                 client.stop_pod(pid)
-            except runpod_api.RunPodApiError as cleanup_exc:
+            except Exception as cleanup_exc:  # pylint: disable=broad-except
                 logger.warning(f'Rollback stop of {pid} failed: '
                                f'{cleanup_exc}')
         raise

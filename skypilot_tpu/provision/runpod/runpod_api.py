@@ -25,6 +25,7 @@ import threading
 import uuid
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu import sky_logging
 
 logger = sky_logging.init_logger(__name__)
@@ -44,7 +45,7 @@ class RunPodApiError(Exception):
         self.message = message
 
 
-class RunPodCapacityError(RunPodApiError):
+class RunPodCapacityError(RunPodApiError, provision_common.CapacityError):
     """Datacenter out of the requested GPU shape. RunPod has no zones:
     scope is always the datacenter ("region")."""
 

@@ -1,0 +1,9 @@
+"""Vast.ai marketplace provisioner (parity: ``sky/provision/vast/``)."""
+from skypilot_tpu.provision.vast.instance import cleanup_ports
+from skypilot_tpu.provision.vast.instance import get_cluster_info
+from skypilot_tpu.provision.vast.instance import open_ports
+from skypilot_tpu.provision.vast.instance import query_instances
+from skypilot_tpu.provision.vast.instance import run_instances
+from skypilot_tpu.provision.vast.instance import stop_instances
+from skypilot_tpu.provision.vast.instance import terminate_instances
+from skypilot_tpu.provision.vast.instance import wait_instances

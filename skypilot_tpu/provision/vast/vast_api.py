@@ -1,0 +1,158 @@
+"""Vast.ai API client (parity: ``sky/provision/vast/utils.py``).
+
+Vast is a GPU marketplace: deploy = search offers for the GPU shape in
+the asked geography, then create an instance on the cheapest match.
+curl against ``https://console.vast.ai/api/v0`` (Bearer key from
+$VAST_API_KEY or ~/.vast_api_key), or the shared fake when
+``SKYTPU_VAST_FAKE=1``. ``use_spot`` maps to interruptible instances.
+"""
+import json
+import os
+import subprocess
+import urllib.parse
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision import neocloud_fake
+
+_API_URL = 'https://console.vast.ai/api/v0'
+
+STATE_MAP = {
+    'created': 'pending',
+    'loading': 'pending',
+    'running': 'running',
+    'stopping': 'stopping',
+    'stopped': 'stopped',
+    'exited': 'stopped',
+    'terminated': 'terminated',
+}
+
+
+class VastApiError(Exception):
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+class VastCapacityError(VastApiError, provision_common.CapacityError):
+    """No marketplace offers match the GPU shape in the geography."""
+
+
+# Catalog accelerator tokens → Vast marketplace gpu_name strings (the
+# marketplace uses spaced names like 'RTX 4090', 'A100 SXM4 80GB').
+_GPU_NAME_MAP = {
+    'RTX4090': 'RTX 4090',
+    'A100-80GB': 'A100 SXM4 80GB',
+    'H100': 'H100 SXM',
+}
+
+
+def _vast_gpu_name(catalog_token: str) -> str:
+    return _GPU_NAME_MAP.get(catalog_token,
+                             catalog_token.replace('-', ' '))
+
+
+def api_key() -> Optional[str]:
+    key = os.environ.get('VAST_API_KEY')
+    if key:
+        return key
+    path = os.path.expanduser('~/.vast_api_key')
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            return f.read().strip() or None
+    return None
+
+
+class RestTransport:
+    """Real Vast.ai through curl + the REST API."""
+
+    def __init__(self, key: str):
+        self.key = key
+
+    def _run(self, method: str, path: str,
+             body: Optional[dict] = None) -> Any:
+        args = ['curl', '-sS', '-K', '-', '-X', method,
+                '-H', 'Content-Type: application/json',
+                f'{_API_URL}{path}']
+        if body is not None:
+            args += ['-d', json.dumps(body)]
+        secret_cfg = f'header = "Authorization: Bearer {self.key}"\n'
+        proc = subprocess.run(args, input=secret_cfg, capture_output=True,
+                              text=True, timeout=120, check=False)
+        if proc.returncode != 0:
+            raise VastApiError(f'vast api {path}: {proc.stderr.strip()}')
+        out = json.loads(proc.stdout) if proc.stdout.strip() else {}
+        if isinstance(out, dict) and out.get('success') is False:
+            raise VastApiError(str(out.get('msg', out)))
+        return out
+
+    def deploy(self, name: str, region: str, instance_type: str,
+               use_spot: bool, public_key: Optional[str]) -> str:
+        # '1x_RTX4090' → Vast gpu_name + num_gpus 1; region is a
+        # geolocation filter ('US', 'EU', ...).
+        count_s, gpu = instance_type.split('x_', 1)
+        query = {
+            'gpu_name': {'eq': _vast_gpu_name(gpu)},
+            'num_gpus': {'eq': int(count_s)},
+            'geolocation': {'in': [region]},
+            'rentable': {'eq': True},
+            'order': [['dph_total', 'asc']],
+        }
+        # The q parameter is JSON (spaces, quotes, braces): it MUST be
+        # percent-encoded or curl/the server rejects the URL.
+        q = urllib.parse.quote(json.dumps(query))
+        offers = self._run('GET', f'/bundles?q={q}').get('offers', [])
+        if not offers:
+            raise VastCapacityError(
+                f'No rentable {instance_type} offers in {region}.')
+        offer_id = offers[0]['id']
+        body: Dict[str, Any] = {
+            'client_id': 'me',
+            'image': 'vastai/base-image:cuda-12.2',
+            'label': name,
+            'runtype': 'ssh',
+            'target_state': 'running',
+        }
+        if use_spot:
+            body['min_bid'] = offers[0].get('min_bid',
+                                            offers[0]['dph_total'])
+        if public_key:
+            body['env'] = {'SSH_PUBLIC_KEY': public_key}
+        out = self._run('PUT', f'/asks/{offer_id}/', body)
+        return str(out.get('new_contract', out.get('id')))
+
+    def list(self) -> List[Dict[str, Any]]:
+        out = self._run('GET', '/instances')
+        items = out.get('instances', [])
+        return [{
+            'id': str(i['id']),
+            'name': i.get('label', ''),
+            'instance_type': i.get('gpu_name', ''),
+            'region': i.get('geolocation', ''),
+            'status': i.get('actual_status', 'created'),
+            'ip': i.get('public_ipaddr'),
+            'private_ip': i.get('local_ipaddrs', ''),
+        } for i in items]
+
+    def stop(self, iid: str) -> None:
+        self._run('PUT', f'/instances/{iid}/',
+                  {'state': 'stopped'})
+
+    def start(self, iid: str) -> None:
+        self._run('PUT', f'/instances/{iid}/',
+                  {'state': 'running'})
+
+    def terminate(self, iid: str) -> None:
+        self._run('DELETE', f'/instances/{iid}/')
+
+
+def make_client():
+    if neocloud_fake.fake_enabled('VAST'):
+        return neocloud_fake.FakeNeoClient(
+            'VAST', lambda region: VastCapacityError(
+                f'No rentable offers in {region}. (fake)'))
+    key = api_key()
+    if key is None:
+        raise VastApiError('No Vast.ai API key configured.')
+    return RestTransport(key)

@@ -1,0 +1,117 @@
+"""DigitalOcean / Fluidstack / Vast: factory-built lifecycles against the
+shared fake (parity: sky/clouds/{do,fluidstack,vast}.py +
+sky/provision/{do,fluidstack,vast}/instance.py)."""
+import pytest
+
+from skypilot_tpu import resources as res_lib
+from skypilot_tpu.clouds import CloudImplementationFeatures
+from skypilot_tpu.clouds.do import DO
+from skypilot_tpu.clouds.fluidstack import Fluidstack
+from skypilot_tpu.clouds.vast import Vast
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision import neocloud_fake
+from skypilot_tpu.provision.do import do_api
+from skypilot_tpu.provision.do import instance as do_instance
+from skypilot_tpu.provision.fluidstack import instance as fs_instance
+from skypilot_tpu.provision.vast import instance as vast_instance
+from skypilot_tpu.provision.vast import vast_api
+
+_CLOUDS = ('DO', 'FLUIDSTACK', 'VAST')
+
+
+@pytest.fixture(autouse=True)
+def fake_factory_clouds(monkeypatch):
+    for key in _CLOUDS:
+        monkeypatch.setenv(f'SKYTPU_{key}_FAKE', '1')
+        neocloud_fake.reset(key)
+    yield
+    for key in _CLOUDS:
+        neocloud_fake.reset(key)
+
+
+def _config(instance_type, region, use_spot=False, count=2):
+    return provision_common.ProvisionConfig(
+        provider_config={'region': region, 'ssh_user': 'root'},
+        authentication_config={'ssh_public_key': 'ssh-ed25519 AAAA t'},
+        docker_config={},
+        node_config={'instance_type': instance_type,
+                     'use_spot': use_spot},
+        count=count,
+        tags={},
+        resume_stopped_nodes=True,
+    )
+
+
+def test_feasibility_and_features():
+    feasible, _ = DO().get_feasible_launchable_resources(
+        res_lib.Resources(accelerators={'H100': 1}), num_nodes=1)
+    assert feasible and feasible[0].instance_type == 'gpu-h100x1-80gb'
+    assert CloudImplementationFeatures.SPOT_INSTANCE in \
+        DO.unsupported_features()
+
+    feasible, _ = Fluidstack().get_feasible_launchable_resources(
+        res_lib.Resources(accelerators={'A100-80GB': 8}), num_nodes=1)
+    assert feasible and feasible[0].instance_type == '8x_A100-80GB'
+
+    # Vast has spot (interruptible bids) and it is cheaper.
+    vast = Vast()
+    feasible, _ = vast.get_feasible_launchable_resources(
+        res_lib.Resources(accelerators={'RTX4090': 1}, use_spot=True),
+        num_nodes=1)
+    assert feasible
+    assert vast.instance_type_to_hourly_cost('1x_RTX4090', True, 'US',
+                                             None) < \
+        vast.instance_type_to_hourly_cost('1x_RTX4090', False, 'US', None)
+
+
+@pytest.mark.parametrize('mod,instance_type,region', [
+    (do_instance, 's-8vcpu-16gb', 'nyc3'),
+    (fs_instance, '1x_H100', 'us-east'),
+    (vast_instance, '1x_RTX4090', 'US'),
+])
+def test_factory_lifecycle(mod, instance_type, region):
+    cfg = _config(instance_type, region)
+    record = mod.run_instances(region, 'fc', cfg)
+    assert len(record.created_instance_ids) == 2
+    mod.wait_instances(region, 'fc', provider_config=cfg.provider_config)
+    info = mod.get_cluster_info(region, 'fc', cfg.provider_config)
+    assert info.num_hosts() == 2
+    assert [h['rank'] for h in info.ordered_host_meta()] == [0, 1]
+
+    mod.stop_instances('fc', cfg.provider_config)
+    statuses = mod.query_instances('fc', cfg.provider_config)
+    assert set(statuses.values()) == {'stopped'}
+
+    record2 = mod.run_instances(region, 'fc', cfg)
+    assert record2.created_instance_ids == []
+    assert len(record2.resumed_instance_ids) == 2
+
+    mod.terminate_instances('fc', cfg.provider_config)
+    assert mod.query_instances('fc', cfg.provider_config) == {}
+
+
+def test_stockout_classified_region_scope(monkeypatch):
+    monkeypatch.setenv('SKYTPU_DO_FAKE_STOCKOUT', 'nyc3')
+    with pytest.raises(do_api.DoCapacityError):
+        do_instance.run_instances('nyc3', 'dcap',
+                                  _config('s-8vcpu-16gb', 'nyc3'))
+    from skypilot_tpu.backends import gang_backend
+    handler = gang_backend.FailoverCloudErrorHandler
+    assert handler.classify(do_api.DoCapacityError('x')) == handler.REGION
+    assert handler.classify(
+        vast_api.VastCapacityError('no offers')) == handler.REGION
+    # Capacity errors share one base; every scope resolves.
+    assert isinstance(do_api.DoCapacityError('x'),
+                      provision_common.CapacityError)
+
+
+def test_zone_scoped_errors_still_zone():
+    """The shared-base refactor must keep GCP/K8s stockouts zonal."""
+    from skypilot_tpu.backends import gang_backend
+    from skypilot_tpu.provision.gcp import tpu_api
+    from skypilot_tpu.provision.kubernetes import k8s_api
+    handler = gang_backend.FailoverCloudErrorHandler
+    assert handler.classify(
+        tpu_api.GcpCapacityError(429, 'stockout')) == handler.ZONE
+    assert handler.classify(
+        k8s_api.K8sCapacityError('no node fits')) == handler.ZONE

@@ -25,5 +25,6 @@ def test_every_cloud_is_provisionable_or_gated():
     catalog_only = names - provisionable
     # The current split; update deliberately when a provisioner lands.
     assert provisionable == {'gcp', 'aws', 'azure', 'kubernetes',
-                             'lambda', 'local', 'runpod'}
+                             'lambda', 'local', 'runpod', 'do',
+                             'fluidstack', 'vast'}
     assert catalog_only == set()
