@@ -94,6 +94,10 @@ def make_provision_config(
         'do': 'root',
         'fluidstack': 'ubuntu',
         'vast': 'root',
+        'oci': 'ubuntu',
+        'nebius': 'ubuntu',
+        'paperspace': 'paperspace',
+        'cudo': 'root',
     }
     if cloud.name in _NEOCLOUD_SSH_USERS:
         public_key, private_key = authentication.get_or_generate_keys()

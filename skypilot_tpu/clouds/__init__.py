@@ -5,12 +5,16 @@ from skypilot_tpu.clouds.cloud import Cloud
 from skypilot_tpu.clouds.cloud import CloudImplementationFeatures
 from skypilot_tpu.clouds.cloud import Region
 from skypilot_tpu.clouds.cloud import Zone
+from skypilot_tpu.clouds.cudo import Cudo
 from skypilot_tpu.clouds.do import DO
 from skypilot_tpu.clouds.fluidstack import Fluidstack
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.lambda_cloud import Lambda
 from skypilot_tpu.clouds.local import Local
+from skypilot_tpu.clouds.nebius import Nebius
+from skypilot_tpu.clouds.oci import OCI
+from skypilot_tpu.clouds.paperspace import Paperspace
 from skypilot_tpu.clouds.runpod import RunPod
 from skypilot_tpu.clouds.vast import Vast
 
@@ -19,12 +23,16 @@ __all__ = [
     'Azure',
     'Cloud',
     'CloudImplementationFeatures',
+    'Cudo',
     'DO',
     'Fluidstack',
     'GCP',
     'Kubernetes',
     'Lambda',
     'Local',
+    'Nebius',
+    'OCI',
+    'Paperspace',
     'Region',
     'RunPod',
     'Vast',

@@ -1,0 +1,34 @@
+"""Cudo Compute: sustainable-datacenter GPU cloud.
+
+Parity: ``sky/clouds/cudo.py`` — datacenters as regions, no spot market,
+stop/resume supported. Lifecycle: ``provision/cudo`` (REST via curl +
+shared fake).
+"""
+from typing import List, Optional, Tuple
+
+from skypilot_tpu.clouds import simple_vm_cloud
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+
+@CLOUD_REGISTRY.register()
+class Cudo(simple_vm_cloud.SimpleVmCloud):
+    """Cudo Compute."""
+
+    _REPR = 'Cudo'
+    _CLOUD_KEY = 'cudo'
+    _HAS_SPOT = False
+    _MAX_CLUSTER_NAME_LEN_LIMIT = 50
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.cudo import cudo_api
+        if cudo_api.api_key() is None:
+            return False, ('Cudo API key not found. Set $CUDO_API_KEY or '
+                           'run `cudoctl init` (~/.config/cudo/cudo.yml).')
+        return True, None
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        from skypilot_tpu.provision.cudo import cudo_api
+        key = cudo_api.api_key()
+        return [f'cudo-key-{key[:8]}'] if key else None

@@ -1,0 +1,35 @@
+"""Nebius: European H100 GPU cloud (pairs with the Nebius object store).
+
+Parity: ``sky/clouds/nebius.py`` — region-only placement, no spot
+market, stop/resume supported. Lifecycle: ``provision/nebius`` (REST via
+curl + shared fake).
+"""
+from typing import List, Optional, Tuple
+
+from skypilot_tpu.clouds import simple_vm_cloud
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+
+@CLOUD_REGISTRY.register()
+class Nebius(simple_vm_cloud.SimpleVmCloud):
+    """Nebius AI Cloud."""
+
+    _REPR = 'Nebius'
+    _CLOUD_KEY = 'nebius'
+    _HAS_SPOT = False
+    _MAX_CLUSTER_NAME_LEN_LIMIT = 50
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.nebius import nebius_api
+        if nebius_api.iam_token() is None:
+            return False, ('Nebius IAM token not found. Set '
+                           '$NEBIUS_IAM_TOKEN or write it to '
+                           '~/.nebius/iam_token.')
+        return True, None
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        from skypilot_tpu.provision.nebius import nebius_api
+        token = nebius_api.iam_token()
+        return [f'nebius-token-{token[:8]}'] if token else None

@@ -1,0 +1,46 @@
+"""Oracle Cloud Infrastructure: VMs + bare-metal GPU shapes.
+
+Parity: ``sky/clouds/oci.py`` — availability domains modeled as each
+region's pseudo-zone, spot = preemptible instances (terminate on
+reclaim), stop/resume supported. Lifecycle: ``provision/oci`` (oci CLI
++ shared fake).
+"""
+from typing import List, Optional, Tuple
+
+from skypilot_tpu.clouds import simple_vm_cloud
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+
+@CLOUD_REGISTRY.register(name='oci', aliases=['oracle'])
+class OCI(simple_vm_cloud.SimpleVmCloud):
+    """Oracle Cloud Infrastructure."""
+
+    _REPR = 'OCI'
+    _CLOUD_KEY = 'oci'
+    _HAS_SPOT = True  # preemptible instances
+    _EGRESS_PER_GB = 0.0085  # beyond the free 10TB/month tier
+    _MAX_CLUSTER_NAME_LEN_LIMIT = 50
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        import os
+        import subprocess
+        if not os.path.exists(os.path.expanduser('~/.oci/config')):
+            return False, ('OCI config not found: run `oci setup config` '
+                           '(~/.oci/config).')
+        try:
+            proc = subprocess.run(['oci', 'iam', 'region', 'list'],
+                                  capture_output=True,
+                                  timeout=30,
+                                  check=False)
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            return False, 'oci CLI missing or unresponsive.'
+        if proc.returncode != 0:
+            return False, 'OCI credentials rejected (`oci iam` failed).'
+        return True, None
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        from skypilot_tpu.provision.oci import oci_api
+        compartment = oci_api.compartment_id()
+        return [f'oci-{compartment[-12:]}'] if compartment else None

@@ -1,0 +1,35 @@
+"""Paperspace (DigitalOcean Gradient): GPU machines.
+
+Parity: ``sky/clouds/paperspace.py`` — region-only placement, no spot
+market, stop/resume supported. Lifecycle: ``provision/paperspace``
+(REST via curl + shared fake).
+"""
+from typing import List, Optional, Tuple
+
+from skypilot_tpu.clouds import simple_vm_cloud
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+
+@CLOUD_REGISTRY.register()
+class Paperspace(simple_vm_cloud.SimpleVmCloud):
+    """Paperspace."""
+
+    _REPR = 'Paperspace'
+    _CLOUD_KEY = 'paperspace'
+    _HAS_SPOT = False
+    _MAX_CLUSTER_NAME_LEN_LIMIT = 50
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.paperspace import paperspace_api
+        if paperspace_api.api_key() is None:
+            return False, ('Paperspace API key not found. Set '
+                           '$PAPERSPACE_API_KEY or log in with the '
+                           'pspace CLI (~/.paperspace/config.json).')
+        return True, None
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        from skypilot_tpu.provision.paperspace import paperspace_api
+        key = paperspace_api.api_key()
+        return [f'paperspace-key-{key[:8]}'] if key else None

@@ -11,12 +11,16 @@ from skypilot_tpu.provision import common
 _PROVIDER_MODULES = {
     'aws': 'skypilot_tpu.provision.aws',
     'azure': 'skypilot_tpu.provision.azure',
+    'cudo': 'skypilot_tpu.provision.cudo',
     'do': 'skypilot_tpu.provision.do',
     'fluidstack': 'skypilot_tpu.provision.fluidstack',
     'gcp': 'skypilot_tpu.provision.gcp',
     'kubernetes': 'skypilot_tpu.provision.kubernetes',
     'lambda': 'skypilot_tpu.provision.lambda_cloud',
     'local': 'skypilot_tpu.provision.local',
+    'nebius': 'skypilot_tpu.provision.nebius',
+    'oci': 'skypilot_tpu.provision.oci',
+    'paperspace': 'skypilot_tpu.provision.paperspace',
     'runpod': 'skypilot_tpu.provision.runpod',
     'vast': 'skypilot_tpu.provision.vast',
 }

@@ -1,0 +1,9 @@
+"""Cudo Compute provisioner (parity: ``sky/provision/cudo/``)."""
+from skypilot_tpu.provision.cudo.instance import cleanup_ports
+from skypilot_tpu.provision.cudo.instance import get_cluster_info
+from skypilot_tpu.provision.cudo.instance import open_ports
+from skypilot_tpu.provision.cudo.instance import query_instances
+from skypilot_tpu.provision.cudo.instance import run_instances
+from skypilot_tpu.provision.cudo.instance import stop_instances
+from skypilot_tpu.provision.cudo.instance import terminate_instances
+from skypilot_tpu.provision.cudo.instance import wait_instances

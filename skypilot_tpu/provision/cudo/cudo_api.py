@@ -1,0 +1,131 @@
+"""Cudo Compute API client (parity: ``sky/provision/cudo/cudo_wrapper.py``).
+
+curl against ``https://rest.compute.cudo.org/v1`` (Bearer key from
+$CUDO_API_KEY or ~/.config/cudo/cudo.yml), or the shared fake when
+``SKYTPU_CUDO_FAKE=1``.
+"""
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision import neocloud_fake
+from skypilot_tpu.provision import rest_transport
+
+_API_URL = 'https://rest.compute.cudo.org/v1'
+
+STATE_MAP = {
+    'PENDING': 'pending',
+    'BOOTING': 'pending',
+    'ACTIVE': 'running',
+    'STOPPING': 'stopping',
+    'STOPPED': 'stopped',
+    'DELETING': 'terminating',
+    'DELETED': 'terminated',
+    'running': 'running',
+    'stopped': 'stopped',
+    'terminated': 'terminated',
+}
+
+_CAPACITY_MARKERS = ('no hosts available', 'insufficient capacity',
+                     'out of stock')
+
+
+class CudoApiError(Exception):
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+class CudoCapacityError(CudoApiError, provision_common.CapacityError):
+    """Datacenter out of the requested machine configuration."""
+
+
+def api_key() -> Optional[str]:
+    key = os.environ.get('CUDO_API_KEY')
+    if key:
+        return key
+    path = os.path.expanduser('~/.config/cudo/cudo.yml')
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                if line.strip().startswith('key:'):
+                    return line.split(':', 1)[1].strip().strip('"') or None
+    return None
+
+
+def project_id() -> str:
+    from skypilot_tpu import skypilot_config
+    return skypilot_config.get_nested(
+        ('cudo', 'project_id'), None) or os.environ.get(
+            'CUDO_PROJECT_ID', 'default')
+
+
+class RestTransport:
+    """Real Cudo through curl + the REST API."""
+
+    def __init__(self, key: str):
+        self.key = key
+        self.project = project_id()
+
+    def _run(self, method: str, path: str,
+             body: Optional[dict] = None) -> Any:
+        out = rest_transport.curl_json(
+            method, f'{_API_URL}{path}',
+            f'header = "Authorization: Bearer {self.key}"\n', body,
+            api_error=CudoApiError)
+        if isinstance(out, dict) and out.get('code'):
+            msg = str(out.get('message', out))
+            if any(m in msg.lower() for m in _CAPACITY_MARKERS):
+                raise CudoCapacityError(msg)
+            raise CudoApiError(msg)
+        return out
+
+    def deploy(self, name: str, region: str, instance_type: str,
+               use_spot: bool, public_key: Optional[str]) -> str:
+        del use_spot  # no spot market (gated at the cloud level)
+        body: Dict[str, Any] = {
+            'vmId': name,
+            'dataCenterId': region,
+            'machineType': instance_type,
+            'bootDiskImageId': 'ubuntu-2204-nvidia-535-docker-v20240214',
+            'bootDisk': {'sizeGib': 100},
+        }
+        if public_key:
+            body['sshKeySource'] = 'SSH_KEY_SOURCE_NONE'
+            body['customSshKeys'] = [public_key]
+        self._run('POST', f'/projects/{self.project}/vm', body)
+        return name  # Cudo VM ids are caller-chosen
+
+    def list(self) -> List[Dict[str, Any]]:
+        out = self._run('GET', f'/projects/{self.project}/vms')
+        return [{
+            'id': str(vm['id']),
+            'name': str(vm['id']),  # vmId doubles as the name
+            'instance_type': vm.get('machineType', ''),
+            'region': vm.get('dataCenterId', ''),
+            'status': vm.get('vmState', vm.get('state', 'PENDING')),
+            'ip': vm.get('publicIpAddress'),
+            'private_ip': vm.get('internalIpAddress', ''),
+        } for vm in out.get('vms', [])]
+
+    def stop(self, iid: str) -> None:
+        self._run('POST', f'/projects/{self.project}/vms/{iid}/stop')
+
+    def start(self, iid: str) -> None:
+        self._run('POST', f'/projects/{self.project}/vms/{iid}/start')
+
+    def terminate(self, iid: str) -> None:
+        self._run('POST', f'/projects/{self.project}/vms/{iid}/terminate')
+
+
+def make_client(region=None):
+    del region  # global API
+    if neocloud_fake.fake_enabled('CUDO'):
+        return neocloud_fake.FakeNeoClient(
+            'CUDO', lambda region: CudoCapacityError(
+                f'No hosts available in {region}. (fake)'))
+    key = api_key()
+    if key is None:
+        raise CudoApiError('No Cudo API key configured.')
+    return RestTransport(key)

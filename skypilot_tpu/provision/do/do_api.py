@@ -8,11 +8,11 @@ token from $DIGITALOCEAN_TOKEN or doctl's config), or the shared
 """
 import json
 import os
-import subprocess
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu.provision import neocloud_fake
+from skypilot_tpu.provision import rest_transport
 
 _API_URL = 'https://api.digitalocean.com/v2'
 
@@ -64,17 +64,10 @@ class RestTransport:
 
     def _run(self, method: str, path: str,
              body: Optional[dict] = None) -> Any:
-        args = ['curl', '-sS', '-K', '-', '-X', method,
-                '-H', 'Content-Type: application/json',
-                f'{_API_URL}{path}']
-        if body is not None:
-            args += ['-d', json.dumps(body)]
-        secret_cfg = f'header = "Authorization: Bearer {self.token}"\n'
-        proc = subprocess.run(args, input=secret_cfg, capture_output=True,
-                              text=True, timeout=120, check=False)
-        if proc.returncode != 0:
-            raise DoApiError(f'do api {path}: {proc.stderr.strip()}')
-        out = json.loads(proc.stdout) if proc.stdout.strip() else {}
+        out = rest_transport.curl_json(
+            method, f'{_API_URL}{path}',
+            f'header = "Authorization: Bearer {self.token}"\n', body,
+            api_error=DoApiError)
         if isinstance(out, dict) and out.get('message') and out.get('id'):
             msg = str(out['message'])
             if any(m in msg.lower() for m in _CAPACITY_MARKERS):
@@ -131,7 +124,8 @@ class RestTransport:
         self._run('DELETE', f'/droplets/{iid}')
 
 
-def make_client():
+def make_client(region=None):
+    del region  # global API
     if neocloud_fake.fake_enabled('DO'):
         return neocloud_fake.FakeNeoClient(
             'DO', lambda region: DoCapacityError(

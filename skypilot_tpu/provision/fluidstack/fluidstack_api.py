@@ -116,7 +116,8 @@ class RestTransport:
         self._run('DELETE', f'/instances/{iid}')
 
 
-def make_client():
+def make_client(region=None):
+    del region  # global API
     if neocloud_fake.fake_enabled('FLUIDSTACK'):
         return neocloud_fake.FakeNeoClient(
             'FLUIDSTACK', lambda region: FluidstackCapacityError(

@@ -28,6 +28,7 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import rest_transport
 
 logger = sky_logging.init_logger(__name__)
 
@@ -62,20 +63,9 @@ class RestTransport:
 
     def _run(self, method: str, path: str,
              body: Optional[dict] = None) -> dict:
-        # The API key rides a curl config on stdin (-K -), never argv:
-        # command lines are world-readable via /proc/<pid>/cmdline.
-        args = ['curl', '-sS', '-K', '-', '-X', method,
-                '-H', 'Content-Type: application/json',
-                f'{_API_URL}{path}']
-        if body is not None:
-            args += ['-d', json.dumps(body)]
-        secret_cfg = f'user = "{self.api_key}:"\n'
-        proc = subprocess.run(args, input=secret_cfg, capture_output=True,
-                              text=True, timeout=120, check=False)
-        if proc.returncode != 0:
-            raise LambdaApiError(
-                f'lambda api {path}: {proc.stderr.strip()}')
-        out = json.loads(proc.stdout) if proc.stdout.strip() else {}
+        out = rest_transport.curl_json(
+            method, f'{_API_URL}{path}', f'user = "{self.api_key}:"\n',
+            body, api_error=LambdaApiError)
         if 'error' in out:
             code = out['error'].get('code', '')
             msg = out['error'].get('message', code)

@@ -1,0 +1,9 @@
+"""Nebius compute provisioner (parity: ``sky/provision/nebius/``)."""
+from skypilot_tpu.provision.nebius.instance import cleanup_ports
+from skypilot_tpu.provision.nebius.instance import get_cluster_info
+from skypilot_tpu.provision.nebius.instance import open_ports
+from skypilot_tpu.provision.nebius.instance import query_instances
+from skypilot_tpu.provision.nebius.instance import run_instances
+from skypilot_tpu.provision.nebius.instance import stop_instances
+from skypilot_tpu.provision.nebius.instance import terminate_instances
+from skypilot_tpu.provision.nebius.instance import wait_instances

@@ -1,0 +1,127 @@
+"""Nebius compute API client (parity: ``sky/provision/nebius/utils.py``).
+
+curl against the Nebius compute REST surface (IAM Bearer token from
+$NEBIUS_IAM_TOKEN or ~/.nebius/iam_token), or the shared fake when
+``SKYTPU_NEBIUS_FAKE=1``.
+"""
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision import neocloud_fake
+from skypilot_tpu.provision import rest_transport
+
+_API_URL = 'https://api.nebius.cloud/compute/v1'
+
+STATE_MAP = {
+    'PROVISIONING': 'pending',
+    'STARTING': 'pending',
+    'RUNNING': 'running',
+    'STOPPING': 'stopping',
+    'STOPPED': 'stopped',
+    'DELETING': 'terminating',
+    'DELETED': 'terminated',
+    'running': 'running',
+    'stopped': 'stopped',
+    'terminated': 'terminated',
+}
+
+_CAPACITY_MARKERS = ('not enough resources', 'quota', 'resource_exhausted')
+
+
+class NebiusApiError(Exception):
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+class NebiusCapacityError(NebiusApiError, provision_common.CapacityError):
+    """Region out of the requested platform/preset."""
+
+
+def iam_token() -> Optional[str]:
+    token = os.environ.get('NEBIUS_IAM_TOKEN')
+    if token:
+        return token
+    path = os.path.expanduser('~/.nebius/iam_token')
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            return f.read().strip() or None
+    return None
+
+
+class RestTransport:
+    """Real Nebius through curl + the REST API."""
+
+    def __init__(self, token: str):
+        self.token = token
+
+    def _run(self, method: str, path: str,
+             body: Optional[dict] = None) -> Any:
+        out = rest_transport.curl_json(
+            method, f'{_API_URL}{path}',
+            f'header = "Authorization: Bearer {self.token}"\n', body,
+            api_error=NebiusApiError)
+        if isinstance(out, dict) and out.get('code'):
+            msg = str(out.get('message', out))
+            if any(m in msg.lower() for m in _CAPACITY_MARKERS):
+                raise NebiusCapacityError(msg)
+            raise NebiusApiError(msg)
+        return out
+
+    def deploy(self, name: str, region: str, instance_type: str,
+               use_spot: bool, public_key: Optional[str]) -> str:
+        del use_spot  # no spot market (gated at the cloud level)
+        body: Dict[str, Any] = {
+            'name': name,
+            'zoneId': region,
+            'platformId': instance_type,
+            'bootDiskSpec': {'size': '137438953472',
+                             'imageFamily': 'ubuntu-22-04'},
+        }
+        if public_key:
+            body['metadata'] = {
+                'user-data': ('#cloud-config\nssh_authorized_keys:\n'
+                              f'  - {public_key}\n')
+            }
+        out = self._run('POST', '/instances', body)
+        instance_id = out.get('metadata', {}).get('instanceId') or \
+            out.get('id')
+        if not instance_id:
+            raise NebiusApiError(
+                f'Instance create returned no id: {out!r}')
+        return str(instance_id)
+
+    def list(self) -> List[Dict[str, Any]]:
+        out = self._run('GET', '/instances')
+        return [{
+            'id': str(i['id']),
+            'name': i.get('name', ''),
+            'instance_type': i.get('platformId', ''),
+            'region': i.get('zoneId', ''),
+            'status': i.get('status', 'PROVISIONING'),
+            'ip': i.get('publicIp'),
+            'private_ip': i.get('privateIp', ''),
+        } for i in out.get('instances', [])]
+
+    def stop(self, iid: str) -> None:
+        self._run('POST', f'/instances/{iid}:stop')
+
+    def start(self, iid: str) -> None:
+        self._run('POST', f'/instances/{iid}:start')
+
+    def terminate(self, iid: str) -> None:
+        self._run('DELETE', f'/instances/{iid}')
+
+
+def make_client(region=None):
+    del region  # global API
+    if neocloud_fake.fake_enabled('NEBIUS'):
+        return neocloud_fake.FakeNeoClient(
+            'NEBIUS', lambda region: NebiusCapacityError(
+                f'Not enough resources in {region}. (fake)'))
+    token = iam_token()
+    if token is None:
+        raise NebiusApiError('No Nebius IAM token configured.')
+    return RestTransport(token)

@@ -108,19 +108,29 @@ def query_statuses(items: List[dict], state_map: Dict[str, str],
     return out
 
 
-def make_lifecycle(provider_name: str, make_client: Callable[[], Any],
+def make_lifecycle(provider_name: str,
+                   make_client: Callable[[Optional[str]], Any],
                    state_map: Dict[str, str], capacity_error: type,
                    default_ssh_user: str,
                    supports_stop: bool = True) -> Dict[str, Callable]:
     """Full PROVISIONER_SURFACE for a name-membership REST cloud.
 
-    The client must expose: ``deploy(name, region, instance_type,
-    use_spot, public_key) -> id``, ``list() -> [normalized dicts]``,
-    ``stop(id)``, ``start(id)``, ``terminate(id)``. Clouds with quirks
-    (Lambda's no-stop + SSH-key registry, RunPod's pod bodies) keep
-    hand-written modules; the uniform ones (DigitalOcean, Fluidstack,
-    Vast) use this factory so the lifecycle logic exists once.
+    ``make_client(region)`` builds the API client — region-scoped APIs
+    (OCI) use it, global ones ignore it. The client must expose:
+    ``deploy(name, region, instance_type, use_spot, public_key) -> id``,
+    ``list() -> [normalized dicts]``, ``stop(id)``, ``start(id)``,
+    ``terminate(id)``. Clouds with quirks (Lambda's no-stop + SSH-key
+    registry, RunPod's pod bodies) keep hand-written modules; the
+    uniform ones (DigitalOcean, Fluidstack, Vast, OCI, Nebius,
+    Paperspace, Cudo) use this factory so the lifecycle logic exists
+    once.
     """
+
+    def _client_for(region: Optional[str],
+                    provider_config: Optional[Dict[str, Any]] = None):
+        if region is None and provider_config:
+            region = provider_config.get('region')
+        return make_client(region)
 
     def _live_members(client, cluster_name_on_cloud: str) -> List[dict]:
         return [
@@ -130,7 +140,7 @@ def make_lifecycle(provider_name: str, make_client: Callable[[], Any],
         ]
 
     def run_instances(region, cluster_name_on_cloud, config):
-        client = make_client()
+        client = _client_for(region, config.provider_config)
         existing = _live_members(client, cluster_name_on_cloud)
         by_index = members_by_index(existing, cluster_name_on_cloud)
         created: List[str] = []
@@ -186,25 +196,22 @@ def make_lifecycle(provider_name: str, make_client: Callable[[], Any],
 
     def wait_instances(region, cluster_name_on_cloud, state='running',
                        provider_config=None):
-        del region, provider_config
-        client = make_client()
+        client = _client_for(region, provider_config)
         wait_for_state(
             lambda: _live_members(client, cluster_name_on_cloud),
             state_map, cluster_name_on_cloud, state)
 
     def get_cluster_info(region, cluster_name_on_cloud,
                          provider_config=None):
-        del region
         assert provider_config is not None
-        client = make_client()
+        client = _client_for(region, provider_config)
         return build_cluster_info(
             _live_members(client, cluster_name_on_cloud), provider_name,
             provider_config, default_ssh_user=default_ssh_user)
 
     def query_instances(cluster_name_on_cloud, provider_config=None,
                         non_terminated_only=True):
-        del provider_config
-        client = make_client()
+        client = _client_for(None, provider_config)
         return query_statuses(
             cluster_members(client.list(), cluster_name_on_cloud),
             state_map, non_terminated_only)
@@ -220,20 +227,18 @@ def make_lifecycle(provider_name: str, make_client: Callable[[], Any],
 
     def stop_instances(cluster_name_on_cloud, provider_config=None,
                        worker_only=False):
-        del provider_config
         if not supports_stop:
             from skypilot_tpu import exceptions
             raise exceptions.NotSupportedError(
                 f'{provider_name} instances cannot be stopped — only '
                 'terminated.')
-        client = make_client()
+        client = _client_for(None, provider_config)
         for iid in _ids(client, cluster_name_on_cloud, worker_only):
             client.stop(iid)
 
     def terminate_instances(cluster_name_on_cloud, provider_config=None,
                             worker_only=False):
-        del provider_config
-        client = make_client()
+        client = _client_for(None, provider_config)
         for iid in _ids(client, cluster_name_on_cloud, worker_only):
             client.terminate(iid)
 

@@ -1,0 +1,9 @@
+"""OCI compute provisioner (parity: ``sky/provision/oci/``)."""
+from skypilot_tpu.provision.oci.instance import cleanup_ports
+from skypilot_tpu.provision.oci.instance import get_cluster_info
+from skypilot_tpu.provision.oci.instance import open_ports
+from skypilot_tpu.provision.oci.instance import query_instances
+from skypilot_tpu.provision.oci.instance import run_instances
+from skypilot_tpu.provision.oci.instance import stop_instances
+from skypilot_tpu.provision.oci.instance import terminate_instances
+from skypilot_tpu.provision.oci.instance import wait_instances

@@ -1,0 +1,22 @@
+"""OCI instance lifecycle via the shared neocloud factory
+(parity: ``sky/provision/oci/instance.py``)."""
+from skypilot_tpu.provision import neocloud_common
+from skypilot_tpu.provision.oci import oci_api
+
+_impl = neocloud_common.make_lifecycle(
+    provider_name='oci',
+    make_client=oci_api.make_client,
+    state_map=oci_api.STATE_MAP,
+    capacity_error=oci_api.OciCapacityError,
+    default_ssh_user='ubuntu',
+    supports_stop=True,
+)
+
+run_instances = _impl['run_instances']
+wait_instances = _impl['wait_instances']
+get_cluster_info = _impl['get_cluster_info']
+query_instances = _impl['query_instances']
+stop_instances = _impl['stop_instances']
+terminate_instances = _impl['terminate_instances']
+open_ports = _impl['open_ports']
+cleanup_ports = _impl['cleanup_ports']

@@ -1,0 +1,175 @@
+"""OCI compute API client (parity: ``sky/provision/oci/query_utils.py``).
+
+Drives the ``oci`` CLI (``--output json``; the reference uses the oci
+SDK), or the shared fake when ``SKYTPU_OCI_FAKE=1``. Instances carry
+cluster membership in their display name (``<cluster>-<i>``) — the
+factory's name scheme — plus a freeform tag. Spot = preemptible
+instances (~50% off, terminated-on-reclaim).
+"""
+import json
+import os
+import subprocess
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision import neocloud_fake
+
+STATE_MAP = {
+    'PROVISIONING': 'pending',
+    'STARTING': 'pending',
+    'RUNNING': 'running',
+    'STOPPING': 'stopping',
+    'STOPPED': 'stopped',
+    'TERMINATING': 'terminating',
+    'TERMINATED': 'terminated',
+    'running': 'running',
+    'stopped': 'stopped',
+    'terminated': 'terminated',
+}
+
+_CAPACITY_MARKERS = ('out of host capacity', 'outofhostcapacity',
+                     'limitexceeded', 'quotaexceeded')
+
+
+class OciApiError(Exception):
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+class OciCapacityError(OciApiError, provision_common.CapacityError):
+    """Out-of-host-capacity / service limits. OCI availability domains
+    are modeled as pseudo-zones of the region; capacity errors blocklist
+    the region (ADs share shape limits)."""
+
+
+def compartment_id() -> Optional[str]:
+    from skypilot_tpu import skypilot_config
+    return skypilot_config.get_nested(
+        ('oci', 'compartment_id'), None) or os.environ.get(
+            'OCI_COMPARTMENT_ID')
+
+
+class CliTransport:
+    """Real OCI through the oci CLI, scoped to one region.
+
+    The factory builds the client with the operation's region (from the
+    failover walk or the cluster's provider_config), so list/terminate
+    see the same region deploy used; config's oci.region / $OCI_REGION
+    is only the fallback.
+    """
+
+    def __init__(self, region: Optional[str] = None):
+        from skypilot_tpu import skypilot_config
+        self.region = region or skypilot_config.get_nested(
+            ('oci', 'region'), None) or os.environ.get(
+                'OCI_REGION', 'us-ashburn-1')
+        self.compartment = compartment_id()
+        if not self.compartment:
+            raise OciApiError(
+                'OCI launches need oci.compartment_id in '
+                '~/.skytpu/config.yaml or $OCI_COMPARTMENT_ID.')
+
+    def _required(self, key: str, env: str) -> str:
+        from skypilot_tpu import skypilot_config
+        value = skypilot_config.get_nested(('oci', key),
+                                           None) or os.environ.get(env)
+        if not value:
+            raise OciApiError(
+                f'OCI launches need oci.{key} in ~/.skytpu/config.yaml '
+                f'or ${env} (instance launch requires it).')
+        return value
+
+    def _run(self, args: List[str],
+             region: Optional[str] = None) -> Any:
+        proc = subprocess.run(
+            ['oci', '--region', region or self.region, '--output',
+             'json'] + args,
+            capture_output=True, text=True, timeout=300, check=False)
+        if proc.returncode != 0:
+            msg = proc.stderr.strip()
+            if any(m in msg.lower() for m in _CAPACITY_MARKERS):
+                raise OciCapacityError(msg)
+            raise OciApiError(f'oci {args[0]}: {msg}')
+        return json.loads(proc.stdout) if proc.stdout.strip() else {}
+
+    def deploy(self, name: str, region: str, instance_type: str,
+               use_spot: bool, public_key: Optional[str]) -> str:
+        # `oci compute instance launch` REQUIRES an availability domain,
+        # a subnet, and a real image OCID — all tenancy-specific; fail
+        # with actionable config guidance instead of a CLI usage error.
+        args = [
+            'compute', 'instance', 'launch',
+            '--compartment-id', self.compartment,
+            '--availability-domain',
+            self._required('availability_domain',
+                           'OCI_AVAILABILITY_DOMAIN'),
+            '--subnet-id', self._required('subnet_id', 'OCI_SUBNET_ID'),
+            '--image-id', self._required('image_id', 'OCI_IMAGE_ID'),
+            '--display-name', name,
+            '--shape', instance_type,
+            '--freeform-tags', json.dumps({'skytpu': name}),
+        ]
+        if use_spot:
+            args += ['--preemptible-instance-config',
+                     json.dumps({'preemptionAction': {
+                         'type': 'TERMINATE',
+                         'preserveBootVolume': False}})]
+        if public_key:
+            args += ['--metadata',
+                     json.dumps({'ssh_authorized_keys': public_key})]
+        out = self._run(args, region=region)
+        return out['data']['id']
+
+    def _vnic_ips(self, instance_id: str) -> Dict[str, Optional[str]]:
+        # Instance listings carry no addresses on OCI; the primary vnic
+        # does.
+        out = self._run(['compute', 'instance', 'list-vnics',
+                         '--instance-id', instance_id])
+        for vnic in out.get('data', []):
+            if vnic.get('is-primary', True):
+                return {'ip': vnic.get('public-ip'),
+                        'private_ip': vnic.get('private-ip', '')}
+        return {'ip': None, 'private_ip': ''}
+
+    def list(self) -> List[Dict[str, Any]]:
+        out = self._run(['compute', 'instance', 'list',
+                         '--compartment-id', self.compartment])
+        items = []
+        for inst in out.get('data', []):
+            state = inst.get('lifecycle-state', 'PROVISIONING')
+            ips = {'ip': None, 'private_ip': ''}
+            if state in ('RUNNING', 'STARTING', 'STOPPING', 'STOPPED'):
+                ips = self._vnic_ips(inst['id'])
+            items.append({
+                'id': inst['id'],
+                'name': inst.get('display-name', ''),
+                'instance_type': inst.get('shape', ''),
+                'region': inst.get('region', self.region),
+                'status': state,
+                **ips,
+            })
+        return items
+
+    def _action(self, iid: str, action: str) -> None:
+        self._run(['compute', 'instance', 'action',
+                   '--instance-id', iid, '--action', action])
+
+    def stop(self, iid: str) -> None:
+        self._action(iid, 'STOP')
+
+    def start(self, iid: str) -> None:
+        self._action(iid, 'START')
+
+    def terminate(self, iid: str) -> None:
+        self._run(['compute', 'instance', 'terminate',
+                   '--instance-id', iid, '--force'])
+
+
+def make_client(region=None):
+    if neocloud_fake.fake_enabled('OCI'):
+        return neocloud_fake.FakeNeoClient(
+            'OCI', lambda r: OciCapacityError(
+                f'Out of host capacity in {r}. (fake)'))
+    return CliTransport(region)

@@ -1,0 +1,9 @@
+"""Paperspace machine provisioner (parity: ``sky/provision/paperspace/``)."""
+from skypilot_tpu.provision.paperspace.instance import cleanup_ports
+from skypilot_tpu.provision.paperspace.instance import get_cluster_info
+from skypilot_tpu.provision.paperspace.instance import open_ports
+from skypilot_tpu.provision.paperspace.instance import query_instances
+from skypilot_tpu.provision.paperspace.instance import run_instances
+from skypilot_tpu.provision.paperspace.instance import stop_instances
+from skypilot_tpu.provision.paperspace.instance import terminate_instances
+from skypilot_tpu.provision.paperspace.instance import wait_instances

@@ -1,0 +1,137 @@
+"""Paperspace API client (parity: ``sky/provision/paperspace/utils.py``).
+
+curl against ``https://api.paperspace.com/v1`` (Bearer key from
+$PAPERSPACE_API_KEY or ~/.paperspace/config.json), or the shared fake
+when ``SKYTPU_PAPERSPACE_FAKE=1``.
+"""
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision import neocloud_fake
+from skypilot_tpu.provision import rest_transport
+
+_API_URL = 'https://api.paperspace.com/v1'
+
+STATE_MAP = {
+    'provisioning': 'pending',
+    'starting': 'pending',
+    'ready': 'running',
+    'stopping': 'stopping',
+    'off': 'stopped',
+    'releasing': 'terminating',
+    'released': 'terminated',
+    'running': 'running',
+    'stopped': 'stopped',
+    'terminated': 'terminated',
+}
+
+_CAPACITY_MARKERS = ('out of stock', 'no machines available',
+                     'insufficient capacity')
+
+
+class PaperspaceApiError(Exception):
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+class PaperspaceCapacityError(PaperspaceApiError,
+                              provision_common.CapacityError):
+    """Region out of the requested machine type."""
+
+
+def api_key() -> Optional[str]:
+    key = os.environ.get('PAPERSPACE_API_KEY')
+    if key:
+        return key
+    path = os.path.expanduser('~/.paperspace/config.json')
+    if os.path.exists(path):
+        try:
+            with open(path, encoding='utf-8') as f:
+                return json.load(f).get('apiKey') or None
+        except (json.JSONDecodeError, OSError):
+            return None
+    return None
+
+
+class RestTransport:
+    """Real Paperspace through curl + the REST API."""
+
+    def __init__(self, key: str):
+        self.key = key
+
+    def _run(self, method: str, path: str,
+             body: Optional[dict] = None) -> Any:
+        out = rest_transport.curl_json(
+            method, f'{_API_URL}{path}',
+            f'header = "Authorization: Bearer {self.key}"\n', body,
+            api_error=PaperspaceApiError)
+        if isinstance(out, dict) and out.get('error'):
+            msg = str(out.get('message', out['error']))
+            if any(m in msg.lower() for m in _CAPACITY_MARKERS):
+                raise PaperspaceCapacityError(msg)
+            raise PaperspaceApiError(msg)
+        return out
+
+    def deploy(self, name: str, region: str, instance_type: str,
+               use_spot: bool, public_key: Optional[str]) -> str:
+        del use_spot  # no spot market (gated at the cloud level)
+        body: Dict[str, Any] = {
+            'name': name,
+            'region': region,
+            'machineType': instance_type,
+            'templateId': 'ubuntu-22.04',
+            'diskSize': 100,
+        }
+        if public_key:
+            # Startup scripts run as root; the key must land in the
+            # 'paperspace' login user's authorized_keys, not /root/.ssh.
+            body['startupScript'] = (
+                'mkdir -p /home/paperspace/.ssh && '
+                f'echo {json.dumps(public_key)} >> '
+                '/home/paperspace/.ssh/authorized_keys && '
+                'chown -R paperspace:paperspace /home/paperspace/.ssh && '
+                'chmod 700 /home/paperspace/.ssh')
+        out = self._run('POST', '/machines', body)
+        machine_id = out.get('id') or out.get('data', {}).get('id')
+        if not machine_id:
+            raise PaperspaceApiError(
+                f'Machine create returned no id: {out!r}')
+        return str(machine_id)
+
+    def list(self) -> List[Dict[str, Any]]:
+        out = self._run('GET', '/machines')
+        items = out if isinstance(out, list) else out.get('items', [])
+        return [{
+            'id': str(m['id']),
+            'name': m.get('name', ''),
+            'instance_type': m.get('machineType', ''),
+            'region': m.get('region', ''),
+            'status': m.get('state', 'provisioning'),
+            'ip': m.get('publicIp'),
+            'private_ip': m.get('privateIp', ''),
+        } for m in items]
+
+    def stop(self, iid: str) -> None:
+        self._run('PATCH', f'/machines/{iid}/stop')
+
+    def start(self, iid: str) -> None:
+        self._run('PATCH', f'/machines/{iid}/start')
+
+    def terminate(self, iid: str) -> None:
+        self._run('DELETE', f'/machines/{iid}')
+
+
+def make_client(region=None):
+    del region  # global API
+    if neocloud_fake.fake_enabled('PAPERSPACE'):
+        return neocloud_fake.FakeNeoClient(
+            'PAPERSPACE', lambda region: PaperspaceCapacityError(
+                f'Out of stock in {region}. (fake)'))
+    key = api_key()
+    if key is None:
+        raise PaperspaceApiError('No Paperspace API key configured.')
+    return RestTransport(key)

@@ -1,0 +1,38 @@
+"""Shared curl-based JSON REST helper for neocloud transports.
+
+Secrets ride a curl config on stdin (``-K -``) — never argv, which is
+world-readable via /proc/<pid>/cmdline.
+"""
+import json
+import subprocess
+from typing import Any, Optional, Type
+
+
+def curl_json(method: str, url: str, secret_config: str,
+              body: Optional[dict] = None,
+              api_error: Type[Exception] = RuntimeError,
+              timeout: int = 120) -> Any:
+    """One JSON request; raises ``api_error`` on transport failure.
+
+    ``secret_config`` is a curl config snippet, e.g.
+    ``'header = "Authorization: Bearer <key>"\\n'``.
+    """
+    args = ['curl', '-sS', '-K', '-', '-X', method,
+            '-H', 'Content-Type: application/json', url]
+    if body is not None:
+        args += ['-d', json.dumps(body)]
+    proc = subprocess.run(args, input=secret_config, capture_output=True,
+                          text=True, timeout=timeout, check=False)
+    if proc.returncode != 0:
+        raise api_error(f'{method} {url}: {proc.stderr.strip()}')
+    if not proc.stdout.strip():
+        return {}
+    try:
+        return json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        # Gateways answer 5xx with HTML; that must classify as the
+        # cloud's API error (feeding retry/rollback), not leak a raw
+        # JSONDecodeError past neocloud_common's handling.
+        raise api_error(
+            f'{method} {url}: non-JSON response '
+            f'{proc.stdout.strip()[:200]!r}') from None

@@ -26,6 +26,7 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision import rest_transport
 from skypilot_tpu import sky_logging
 
 logger = sky_logging.init_logger(__name__)
@@ -96,21 +97,10 @@ class RestTransport:
 
     def _run(self, method: str, path: str,
              body: Optional[dict] = None) -> Any:
-        # The API key rides a curl config on stdin (-K -), never argv:
-        # command lines are world-readable via /proc/<pid>/cmdline.
-        args = ['curl', '-sS', '-K', '-', '-X', method,
-                '-H', 'Content-Type: application/json',
-                f'{_API_URL}{path}']
-        if body is not None:
-            args += ['-d', json.dumps(body)]
-        secret_cfg = (f'header = "Authorization: Bearer '
-                      f'{self.api_key}"\n')
-        proc = subprocess.run(args, input=secret_cfg, capture_output=True,
-                              text=True, timeout=120, check=False)
-        if proc.returncode != 0:
-            raise RunPodApiError(
-                f'runpod api {path}: {proc.stderr.strip()}')
-        out = json.loads(proc.stdout) if proc.stdout.strip() else {}
+        out = rest_transport.curl_json(
+            method, f'{_API_URL}{path}',
+            f'header = "Authorization: Bearer {self.api_key}"\n', body,
+            api_error=RunPodApiError)
         if isinstance(out, dict) and out.get('error'):
             _raise_for_error(str(out['error']))
         return out

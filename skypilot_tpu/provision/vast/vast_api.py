@@ -8,12 +8,12 @@ $VAST_API_KEY or ~/.vast_api_key), or the shared fake when
 """
 import json
 import os
-import subprocess
 import urllib.parse
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu.provision import neocloud_fake
+from skypilot_tpu.provision import rest_transport
 
 _API_URL = 'https://console.vast.ai/api/v0'
 
@@ -72,17 +72,10 @@ class RestTransport:
 
     def _run(self, method: str, path: str,
              body: Optional[dict] = None) -> Any:
-        args = ['curl', '-sS', '-K', '-', '-X', method,
-                '-H', 'Content-Type: application/json',
-                f'{_API_URL}{path}']
-        if body is not None:
-            args += ['-d', json.dumps(body)]
-        secret_cfg = f'header = "Authorization: Bearer {self.key}"\n'
-        proc = subprocess.run(args, input=secret_cfg, capture_output=True,
-                              text=True, timeout=120, check=False)
-        if proc.returncode != 0:
-            raise VastApiError(f'vast api {path}: {proc.stderr.strip()}')
-        out = json.loads(proc.stdout) if proc.stdout.strip() else {}
+        out = rest_transport.curl_json(
+            method, f'{_API_URL}{path}',
+            f'header = "Authorization: Bearer {self.key}"\n', body,
+            api_error=VastApiError)
         if isinstance(out, dict) and out.get('success') is False:
             raise VastApiError(str(out.get('msg', out)))
         return out
@@ -147,7 +140,8 @@ class RestTransport:
         self._run('DELETE', f'/instances/{iid}/')
 
 
-def make_client():
+def make_client(region=None):
+    del region  # global API
     if neocloud_fake.fake_enabled('VAST'):
         return neocloud_fake.FakeNeoClient(
             'VAST', lambda region: VastCapacityError(

@@ -16,7 +16,8 @@ from skypilot_tpu.provision.fluidstack import instance as fs_instance
 from skypilot_tpu.provision.vast import instance as vast_instance
 from skypilot_tpu.provision.vast import vast_api
 
-_CLOUDS = ('DO', 'FLUIDSTACK', 'VAST')
+_CLOUDS = ('DO', 'FLUIDSTACK', 'VAST', 'OCI', 'NEBIUS', 'PAPERSPACE',
+           'CUDO')
 
 
 @pytest.fixture(autouse=True)
@@ -42,6 +43,32 @@ def _config(instance_type, region, use_spot=False, count=2):
     )
 
 
+def test_new_cloud_feasibility_and_spot():
+    from skypilot_tpu.clouds.cudo import Cudo
+    from skypilot_tpu.clouds.nebius import Nebius
+    from skypilot_tpu.clouds.oci import OCI
+    from skypilot_tpu.clouds.paperspace import Paperspace
+    # OCI preemptible = spot, at half price.
+    oci = OCI()
+    feasible, _ = oci.get_feasible_launchable_resources(
+        res_lib.Resources(accelerators={'A100-80GB': 8}, use_spot=True),
+        num_nodes=1)
+    assert feasible and feasible[0].instance_type == 'BM.GPU.A100-v2.8'
+    assert oci.instance_type_to_hourly_cost(
+        'BM.GPU.A100-v2.8', True, 'us-ashburn-1', None) == \
+        pytest.approx(16.0)
+    # The no-spot clouds gate spot requests out of feasibility.
+    for cls, acc in ((Nebius, 'H100'), (Paperspace, 'A100'),
+                     (Cudo, 'A100-80GB')):
+        feasible, _ = cls().get_feasible_launchable_resources(
+            res_lib.Resources(accelerators={acc: 1}, use_spot=True),
+            num_nodes=1)
+        assert feasible == [], cls
+        feasible, _ = cls().get_feasible_launchable_resources(
+            res_lib.Resources(accelerators={acc: 1}), num_nodes=1)
+        assert feasible, cls
+
+
 def test_feasibility_and_features():
     feasible, _ = DO().get_feasible_launchable_resources(
         res_lib.Resources(accelerators={'H100': 1}), num_nodes=1)
@@ -64,10 +91,20 @@ def test_feasibility_and_features():
         vast.instance_type_to_hourly_cost('1x_RTX4090', False, 'US', None)
 
 
+from skypilot_tpu.provision.cudo import instance as cudo_instance
+from skypilot_tpu.provision.nebius import instance as nebius_instance
+from skypilot_tpu.provision.oci import instance as oci_instance
+from skypilot_tpu.provision.paperspace import instance as ps_instance
+
+
 @pytest.mark.parametrize('mod,instance_type,region', [
     (do_instance, 's-8vcpu-16gb', 'nyc3'),
     (fs_instance, '1x_H100', 'us-east'),
     (vast_instance, '1x_RTX4090', 'US'),
+    (oci_instance, 'VM.GPU.A10.1', 'us-ashburn-1'),
+    (nebius_instance, 'gpu-h100-sxm-8', 'eu-north1'),
+    (ps_instance, 'A100', 'NY2'),
+    (cudo_instance, 'a100-pcie-1', 'se-smedjebacken-1'),
 ])
 def test_factory_lifecycle(mod, instance_type, region):
     cfg = _config(instance_type, region)

@@ -26,5 +26,6 @@ def test_every_cloud_is_provisionable_or_gated():
     # The current split; update deliberately when a provisioner lands.
     assert provisionable == {'gcp', 'aws', 'azure', 'kubernetes',
                              'lambda', 'local', 'runpod', 'do',
-                             'fluidstack', 'vast'}
+                             'fluidstack', 'vast', 'oci', 'nebius',
+                             'paperspace', 'cudo'}
     assert catalog_only == set()
