@@ -98,6 +98,9 @@ def make_provision_config(
         'nebius': 'ubuntu',
         'paperspace': 'paperspace',
         'cudo': 'root',
+        'ibm': 'ubuntu',
+        'scp': 'root',
+        'vsphere': 'ubuntu',
     }
     if cloud.name in _NEOCLOUD_SSH_USERS:
         public_key, private_key = authentication.get_or_generate_keys()
@@ -105,6 +108,18 @@ def make_provision_config(
         provider_config['ssh_private_key'] = private_key
         auth_config['ssh_public_key'] = public_key
         auth_config['ssh_user'] = provider_config['ssh_user']
+        if cloud.name == 'ibm' and os.environ.get('SKYTPU_IBM_FAKE',
+                                                  '0') != '1':
+            # VPC attaches registered keys, not raw public keys: fail
+            # BEFORE creating instances (the AWS key_name pattern) —
+            # keyless VMs only surface as a 10-min SSH timeout billing.
+            if skypilot_config.get_nested(('ibm', 'key_id'),
+                                          None) is None and \
+                    os.environ.get('IBM_KEY_ID') is None:
+                raise exceptions.NotSupportedError(
+                    'IBM VPC launches need a registered SSH key: import '
+                    'the skytpu key (`ibmcloud is key-create`) and set '
+                    'ibm.key_id in ~/.skytpu/config.yaml.')
     if cloud.name == 'aws':
         _, private_key = authentication.get_or_generate_keys()
         provider_config['ssh_user'] = 'ubuntu'

@@ -25,7 +25,7 @@ _DATA_DIR = os.path.join(os.path.dirname(__file__), 'data')
 # Clouds with a bundled VM catalog CSV (<cloud>_vms.csv).
 VM_CLOUDS = ('gcp', 'aws', 'azure', 'lambda', 'runpod', 'do',
              'fluidstack', 'vast', 'oci', 'nebius', 'paperspace',
-             'cudo')
+             'cudo', 'ibm', 'scp', 'vsphere')
 
 # Catalog override dir for tests / refreshed data.
 CATALOG_DIR_ENV = 'SKYTPU_CATALOG_DIR'

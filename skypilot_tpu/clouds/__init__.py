@@ -9,6 +9,7 @@ from skypilot_tpu.clouds.cudo import Cudo
 from skypilot_tpu.clouds.do import DO
 from skypilot_tpu.clouds.fluidstack import Fluidstack
 from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.ibm import IBM
 from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.lambda_cloud import Lambda
 from skypilot_tpu.clouds.local import Local
@@ -16,7 +17,9 @@ from skypilot_tpu.clouds.nebius import Nebius
 from skypilot_tpu.clouds.oci import OCI
 from skypilot_tpu.clouds.paperspace import Paperspace
 from skypilot_tpu.clouds.runpod import RunPod
+from skypilot_tpu.clouds.scp import SCP
 from skypilot_tpu.clouds.vast import Vast
+from skypilot_tpu.clouds.vsphere import Vsphere
 
 __all__ = [
     'AWS',
@@ -27,6 +30,7 @@ __all__ = [
     'DO',
     'Fluidstack',
     'GCP',
+    'IBM',
     'Kubernetes',
     'Lambda',
     'Local',
@@ -35,6 +39,8 @@ __all__ = [
     'Paperspace',
     'Region',
     'RunPod',
+    'SCP',
     'Vast',
+    'Vsphere',
     'Zone',
 ]

@@ -1,0 +1,37 @@
+"""IBM Cloud VPC: V100/L4 GPU instances (pairs with the IBM COS store).
+
+Parity: ``sky/clouds/ibm.py`` — region-only placement, no spot market,
+stop/resume supported. Lifecycle: ``provision/ibm`` (ibmcloud CLI +
+shared fake).
+"""
+import os
+from typing import List, Optional, Tuple
+
+from skypilot_tpu.clouds import simple_vm_cloud
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+
+@CLOUD_REGISTRY.register()
+class IBM(simple_vm_cloud.SimpleVmCloud):
+    """IBM Cloud (VPC Gen2)."""
+
+    _REPR = 'IBM'
+    _CLOUD_KEY = 'ibm'
+    _HAS_SPOT = False
+    _EGRESS_PER_GB = 0.09
+    _MAX_CLUSTER_NAME_LEN_LIMIT = 50
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        if os.environ.get('IBMCLOUD_API_KEY'):
+            return True, None
+        path = os.path.expanduser('~/.bluemix/config.json')
+        if os.path.exists(path):
+            return True, None
+        return False, ('IBM Cloud credentials not found. Run '
+                       '`ibmcloud login` or set $IBMCLOUD_API_KEY.')
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        key = os.environ.get('IBMCLOUD_API_KEY')
+        return [f'ibm-key-{key[:8]}'] if key else ['ibm-cli-session']

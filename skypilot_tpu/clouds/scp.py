@@ -1,0 +1,35 @@
+"""Samsung Cloud Platform: Korean-region VMs + GPU servers.
+
+Parity: ``sky/clouds/scp.py`` — service zones as regions, no spot
+market, stop/resume supported. Lifecycle: ``provision/scp`` (open API
+via curl + shared fake).
+"""
+from typing import List, Optional, Tuple
+
+from skypilot_tpu.clouds import simple_vm_cloud
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+
+@CLOUD_REGISTRY.register()
+class SCP(simple_vm_cloud.SimpleVmCloud):
+    """Samsung Cloud Platform."""
+
+    _REPR = 'SCP'
+    _CLOUD_KEY = 'scp'
+    _HAS_SPOT = False
+    _MAX_CLUSTER_NAME_LEN_LIMIT = 50
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.scp import scp_api
+        if scp_api.access_key() is None:
+            return False, ('SCP access key not found. Set '
+                           '$SCP_ACCESS_KEY or write it to '
+                           '~/.scp/scp_credential.')
+        return True, None
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        from skypilot_tpu.provision.scp import scp_api
+        key = scp_api.access_key()
+        return [f'scp-key-{key[:8]}'] if key else None

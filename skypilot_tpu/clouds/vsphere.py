@@ -1,0 +1,38 @@
+"""vSphere: on-prem VMware clusters as a provisioning target.
+
+Parity: ``sky/clouds/vsphere.py`` — one vCenter endpoint, "on-prem"
+pseudo-region, $0 catalog prices (capacity is owned, not rented), no
+spot, stop/resume supported. Lifecycle: ``provision/vsphere`` (govc CLI
+clone-from-template + shared fake).
+"""
+import os
+from typing import List, Optional, Tuple
+
+from skypilot_tpu.clouds import simple_vm_cloud
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+
+@CLOUD_REGISTRY.register()
+class Vsphere(simple_vm_cloud.SimpleVmCloud):
+    """VMware vSphere (on-prem)."""
+
+    _REPR = 'Vsphere'
+    _CLOUD_KEY = 'vsphere'
+    _HAS_SPOT = False
+    _MAX_CLUSTER_NAME_LEN_LIMIT = 50
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu import skypilot_config
+        if os.environ.get('GOVC_URL') or skypilot_config.get_nested(
+                ('vsphere', 'url'), None):
+            return True, None
+        return False, ('vSphere endpoint not configured. Set $GOVC_URL '
+                       '(+ $GOVC_USERNAME/$GOVC_PASSWORD) or vsphere.url '
+                       'in ~/.skytpu/config.yaml.')
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        url = os.environ.get('GOVC_URL')
+        user = os.environ.get('GOVC_USERNAME', 'vsphere-user')
+        return [f'{user}@{url}'] if url else None

@@ -15,6 +15,7 @@ _PROVIDER_MODULES = {
     'do': 'skypilot_tpu.provision.do',
     'fluidstack': 'skypilot_tpu.provision.fluidstack',
     'gcp': 'skypilot_tpu.provision.gcp',
+    'ibm': 'skypilot_tpu.provision.ibm',
     'kubernetes': 'skypilot_tpu.provision.kubernetes',
     'lambda': 'skypilot_tpu.provision.lambda_cloud',
     'local': 'skypilot_tpu.provision.local',
@@ -22,7 +23,9 @@ _PROVIDER_MODULES = {
     'oci': 'skypilot_tpu.provision.oci',
     'paperspace': 'skypilot_tpu.provision.paperspace',
     'runpod': 'skypilot_tpu.provision.runpod',
+    'scp': 'skypilot_tpu.provision.scp',
     'vast': 'skypilot_tpu.provision.vast',
+    'vsphere': 'skypilot_tpu.provision.vsphere',
 }
 
 

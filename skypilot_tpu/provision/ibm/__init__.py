@@ -1,0 +1,9 @@
+"""IBM VPC provisioner (parity: ``sky/provision/ibm/``)."""
+from skypilot_tpu.provision.ibm.instance import cleanup_ports
+from skypilot_tpu.provision.ibm.instance import get_cluster_info
+from skypilot_tpu.provision.ibm.instance import open_ports
+from skypilot_tpu.provision.ibm.instance import query_instances
+from skypilot_tpu.provision.ibm.instance import run_instances
+from skypilot_tpu.provision.ibm.instance import stop_instances
+from skypilot_tpu.provision.ibm.instance import terminate_instances
+from skypilot_tpu.provision.ibm.instance import wait_instances

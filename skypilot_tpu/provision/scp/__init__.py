@@ -1,0 +1,9 @@
+"""Samsung Cloud Platform provisioner (parity: ``sky/provision/scp/``)."""
+from skypilot_tpu.provision.scp.instance import cleanup_ports
+from skypilot_tpu.provision.scp.instance import get_cluster_info
+from skypilot_tpu.provision.scp.instance import open_ports
+from skypilot_tpu.provision.scp.instance import query_instances
+from skypilot_tpu.provision.scp.instance import run_instances
+from skypilot_tpu.provision.scp.instance import stop_instances
+from skypilot_tpu.provision.scp.instance import terminate_instances
+from skypilot_tpu.provision.scp.instance import wait_instances

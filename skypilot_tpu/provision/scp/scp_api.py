@@ -1,0 +1,128 @@
+"""Samsung Cloud Platform API client (parity:
+``sky/provision/scp/scp_utils.py``).
+
+curl against the SCP open API (HMAC-signed in the reference; here a
+Bearer access key from $SCP_ACCESS_KEY or ~/.scp/scp_credential), or
+the shared fake when ``SKYTPU_SCP_FAKE=1``.
+"""
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision import neocloud_fake
+from skypilot_tpu.provision import rest_transport
+
+_API_URL = 'https://openapi.samsungsdscloud.com/virtual-server/v3'
+
+STATE_MAP = {
+    'CREATING': 'pending',
+    'STARTING': 'pending',
+    'RUNNING': 'running',
+    'STOPPING': 'stopping',
+    'STOPPED': 'stopped',
+    'TERMINATING': 'terminating',
+    'TERMINATED': 'terminated',
+    'running': 'running',
+    'stopped': 'stopped',
+    'terminated': 'terminated',
+}
+
+_CAPACITY_MARKERS = ('insufficient resources', 'out of capacity',
+                     'quota')
+
+
+class ScpApiError(Exception):
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+class ScpCapacityError(ScpApiError, provision_common.CapacityError):
+    """Service zone out of the requested server type."""
+
+
+def access_key() -> Optional[str]:
+    key = os.environ.get('SCP_ACCESS_KEY')
+    if key:
+        return key
+    path = os.path.expanduser('~/.scp/scp_credential')
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                if line.strip().startswith('access_key') and '=' in line:
+                    return line.split('=', 1)[1].strip() or None
+    return None
+
+
+class RestTransport:
+    """Real SCP through curl + the open API."""
+
+    def __init__(self, key: str):
+        self.key = key
+
+    def _run(self, method: str, path: str,
+             body: Optional[dict] = None) -> Any:
+        out = rest_transport.curl_json(
+            method, f'{_API_URL}{path}',
+            f'header = "Authorization: Bearer {self.key}"\n', body,
+            api_error=ScpApiError)
+        if isinstance(out, dict) and out.get('errorCode'):
+            msg = str(out.get('message', out['errorCode']))
+            if any(m in msg.lower() for m in _CAPACITY_MARKERS):
+                raise ScpCapacityError(msg)
+            raise ScpApiError(msg)
+        return out
+
+    def deploy(self, name: str, region: str, instance_type: str,
+               use_spot: bool, public_key: Optional[str]) -> str:
+        del use_spot  # no spot market (gated at the cloud level)
+        body: Dict[str, Any] = {
+            'virtualServerName': name,
+            'serviceZoneId': region,
+            'serverType': instance_type,
+            'imageId': 'ubuntu-22.04',
+            'initialScript': (
+                'mkdir -p /root/.ssh && '
+                f'echo "{public_key}" >> /root/.ssh/authorized_keys'
+            ) if public_key else '',
+        }
+        out = self._run('POST', '/virtual-servers', body)
+        server_id = out.get('resourceId') or out.get('virtualServerId')
+        if not server_id:
+            raise ScpApiError(f'Server create returned no id: {out!r}')
+        return str(server_id)
+
+    def list(self) -> List[Dict[str, Any]]:
+        out = self._run('GET', '/virtual-servers')
+        items = out.get('contents', []) if isinstance(out, dict) else out
+        return [{
+            'id': str(s.get('virtualServerId', s.get('resourceId'))),
+            'name': s.get('virtualServerName', ''),
+            'instance_type': s.get('serverType', ''),
+            'region': s.get('serviceZoneId', ''),
+            'status': s.get('virtualServerState', 'CREATING'),
+            'ip': s.get('natIpAddress'),
+            'private_ip': s.get('ipAddress', ''),
+        } for s in items]
+
+    def stop(self, iid: str) -> None:
+        self._run('POST', f'/virtual-servers/{iid}/stop')
+
+    def start(self, iid: str) -> None:
+        self._run('POST', f'/virtual-servers/{iid}/start')
+
+    def terminate(self, iid: str) -> None:
+        self._run('DELETE', f'/virtual-servers/{iid}')
+
+
+def make_client(region=None):
+    del region  # zone id rides in each request
+    if neocloud_fake.fake_enabled('SCP'):
+        return neocloud_fake.FakeNeoClient(
+            'SCP', lambda r: ScpCapacityError(
+                f'Insufficient resources in {r}. (fake)'))
+    key = access_key()
+    if key is None:
+        raise ScpApiError('No SCP access key configured.')
+    return RestTransport(key)

@@ -1,0 +1,9 @@
+"""vSphere on-prem provisioner (parity: ``sky/provision/vsphere/``)."""
+from skypilot_tpu.provision.vsphere.instance import cleanup_ports
+from skypilot_tpu.provision.vsphere.instance import get_cluster_info
+from skypilot_tpu.provision.vsphere.instance import open_ports
+from skypilot_tpu.provision.vsphere.instance import query_instances
+from skypilot_tpu.provision.vsphere.instance import run_instances
+from skypilot_tpu.provision.vsphere.instance import stop_instances
+from skypilot_tpu.provision.vsphere.instance import terminate_instances
+from skypilot_tpu.provision.vsphere.instance import wait_instances

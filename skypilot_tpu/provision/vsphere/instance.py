@@ -1,0 +1,22 @@
+"""vSphere VM lifecycle via the shared neocloud factory
+(parity: ``sky/provision/vsphere/instance.py``)."""
+from skypilot_tpu.provision import neocloud_common
+from skypilot_tpu.provision.vsphere import vsphere_api
+
+_impl = neocloud_common.make_lifecycle(
+    provider_name='vsphere',
+    make_client=vsphere_api.make_client,
+    state_map=vsphere_api.STATE_MAP,
+    capacity_error=vsphere_api.VsphereCapacityError,
+    default_ssh_user='ubuntu',
+    supports_stop=True,
+)
+
+run_instances = _impl['run_instances']
+wait_instances = _impl['wait_instances']
+get_cluster_info = _impl['get_cluster_info']
+query_instances = _impl['query_instances']
+stop_instances = _impl['stop_instances']
+terminate_instances = _impl['terminate_instances']
+open_ports = _impl['open_ports']
+cleanup_ports = _impl['cleanup_ports']

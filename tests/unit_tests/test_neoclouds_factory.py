@@ -17,7 +17,7 @@ from skypilot_tpu.provision.vast import instance as vast_instance
 from skypilot_tpu.provision.vast import vast_api
 
 _CLOUDS = ('DO', 'FLUIDSTACK', 'VAST', 'OCI', 'NEBIUS', 'PAPERSPACE',
-           'CUDO')
+           'CUDO', 'IBM', 'SCP', 'VSPHERE')
 
 
 @pytest.fixture(autouse=True)
@@ -92,9 +92,12 @@ def test_feasibility_and_features():
 
 
 from skypilot_tpu.provision.cudo import instance as cudo_instance
+from skypilot_tpu.provision.ibm import instance as ibm_instance
 from skypilot_tpu.provision.nebius import instance as nebius_instance
 from skypilot_tpu.provision.oci import instance as oci_instance
 from skypilot_tpu.provision.paperspace import instance as ps_instance
+from skypilot_tpu.provision.scp import instance as scp_instance
+from skypilot_tpu.provision.vsphere import instance as vs_instance
 
 
 @pytest.mark.parametrize('mod,instance_type,region', [
@@ -105,6 +108,9 @@ from skypilot_tpu.provision.paperspace import instance as ps_instance
     (nebius_instance, 'gpu-h100-sxm-8', 'eu-north1'),
     (ps_instance, 'A100', 'NY2'),
     (cudo_instance, 'a100-pcie-1', 'se-smedjebacken-1'),
+    (ibm_instance, 'gx2-8x64x1v100', 'us-south'),
+    (scp_instance, 'gpu1v8m64-t4', 'kr-west1'),
+    (vs_instance, 'vm-8x64-a100', 'on-prem'),
 ])
 def test_factory_lifecycle(mod, instance_type, region):
     cfg = _config(instance_type, region)

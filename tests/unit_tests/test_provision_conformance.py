@@ -27,5 +27,6 @@ def test_every_cloud_is_provisionable_or_gated():
     assert provisionable == {'gcp', 'aws', 'azure', 'kubernetes',
                              'lambda', 'local', 'runpod', 'do',
                              'fluidstack', 'vast', 'oci', 'nebius',
-                             'paperspace', 'cudo'}
+                             'paperspace', 'cudo', 'ibm', 'scp',
+                             'vsphere'}
     assert catalog_only == set()
