@@ -33,5 +33,8 @@ class IBM(simple_vm_cloud.SimpleVmCloud):
 
     @classmethod
     def get_current_user_identity(cls) -> Optional[List[str]]:
+        # None = identity unknown → ownership check skipped. Returning a
+        # constant here would hard-mismatch against key-derived owners
+        # when the same user switches between env-key and CLI sessions.
         key = os.environ.get('IBMCLOUD_API_KEY')
-        return [f'ibm-key-{key[:8]}'] if key else ['ibm-cli-session']
+        return [f'ibm-key-{key[:8]}'] if key else None

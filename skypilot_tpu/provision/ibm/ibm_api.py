@@ -87,7 +87,13 @@ class CliTransport:
             '--keys', self._required('key_id', 'IBM_KEY_ID'),
         ]
         out = self._run(args)
-        return str(out['id'])
+        instance_id = str(out['id'])
+        # VPC instances have only 10.x addresses until a floating IP is
+        # attached — without one, SSH bootstrap can never reach the host
+        # and the launch dies as a 10-minute timeout with billing on.
+        self._run(['floating-ip-reserve', f'{name}-fip', '--nic',
+                   'primary', '--in', instance_id])
+        return instance_id
 
     def list(self) -> List[Dict[str, Any]]:
         out = self._run(['instances'])

@@ -14,8 +14,11 @@ import os
 import subprocess
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu import sky_logging
 from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu.provision import neocloud_fake
+
+logger = sky_logging.init_logger(__name__)
 
 STATE_MAP = {
     'poweredOn': 'running',
@@ -115,8 +118,7 @@ class GovcTransport:
                        f'{home}/.ssh/authorized_keys && '
                        f'chown -R {self.ssh_user} {home}/.ssh'])
         elif public_key:
-            import logging
-            logging.getLogger(__name__).warning(
+            logger.warning(
                 'vsphere.guest_login/$GOVC_GUEST_LOGIN not set: skipping '
                 'SSH key injection — the template must already trust the '
                 'skytpu key.')
@@ -136,9 +138,15 @@ class GovcTransport:
         # duplicate records when names collide across folders.
         info = self._run(['vm.info', '-json'] + paths)
         try:
-            vms = json.loads(info).get('virtualMachines') or []
-        except json.JSONDecodeError:
-            return []
+            parsed = json.loads(info)
+        except json.JSONDecodeError as e:
+            # Silently returning [] would make wait_instances time out
+            # and status refresh declare live VMs terminated.
+            raise VsphereApiError(
+                f'vm.info returned non-JSON output: {e}') from None
+        # govc <0.29 capitalizes the key.
+        vms = parsed.get('virtualMachines') or parsed.get(
+            'VirtualMachines') or []
         items = []
         for vm in vms:
             name = vm.get('name', '')
