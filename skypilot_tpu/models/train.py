@@ -272,10 +272,33 @@ def main() -> None:
     parser.add_argument('--save-every', type=int, default=10)
     parser.add_argument('--log-every', type=int, default=10)
     parser.add_argument('--sleep-per-step', type=float, default=0.0)
+    # Multislice: the gang runtime exports MEGASCALE_NUM_SLICES on
+    # multislice clusters (num_nodes > 1 TPU slices); the flag overrides.
+    parser.add_argument('--num-slices', type=int,
+                        default=int(os.environ.get('MEGASCALE_NUM_SLICES',
+                                                   '1')))
     args = parser.parse_args()
+    # Multi-host gangs: the runtime injects JAX_COORDINATOR_ADDRESS /
+    # JAX_NUM_PROCESSES / JAX_PROCESS_ID (gang_run.build_rank_envs).
+    # jax only auto-reads the coordinator address from env — process
+    # count/id must be passed explicitly or non-auto-detectable
+    # clusters raise at startup.
+    if int(os.environ.get('JAX_NUM_PROCESSES', '1')) > 1:
+        jax.distributed.initialize(
+            coordinator_address=os.environ['JAX_COORDINATOR_ADDRESS'],
+            num_processes=int(os.environ['JAX_NUM_PROCESSES']),
+            process_id=int(os.environ['JAX_PROCESS_ID']))
     cfg = llama.CONFIGS[args.model]
+    n_devices = len(jax.devices())
+    if args.num_slices > 1:
+        mesh = mesh_lib.make_multislice_mesh(args.num_slices)
+    elif n_devices > 1:
+        mesh = mesh_lib.make_mesh()  # fsdp over every chip by default
+    else:
+        mesh = None
     state = train_loop(cfg, TrainConfig(warmup_steps=5), args.steps,
                        args.batch_size, args.seq_len,
+                       mesh=mesh,
                        checkpoint_dir=args.checkpoint_dir,
                        save_every=args.save_every,
                        log_every=args.log_every,

@@ -119,6 +119,10 @@ class ClusterInfo:
                 })
             if docker_image and hosts[-1]['transport'] != 'kubernetes':
                 hosts[-1]['docker_image'] = docker_image
+            # Multislice: gang_run derives per-slice TPU worker ids and
+            # MEGASCALE envs from this (absent → single slice).
+            if 'slice_index' in info.tags:
+                hosts[-1]['slice_id'] = int(info.tags['slice_index'])
         return hosts
 
     def ip_tuples(self) -> List[tuple]:

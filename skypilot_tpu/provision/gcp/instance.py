@@ -171,7 +171,8 @@ def get_cluster_info(
     instances: Dict[str, List[common.InstanceInfo]] = {}
     head_id = None
     custom = {}
-    for node in sorted(nodes, key=lambda n: n['name']):
+    for slice_idx, node in enumerate(sorted(nodes,
+                                            key=lambda n: n['name'])):
         name = node['name'].split('/')[-1]
         if head_id is None:
             head_id = name
@@ -182,14 +183,17 @@ def get_cluster_info(
             }
         infos = []
         # One InstanceInfo per worker host of the slice (parity:
-        # instance_utils.py:1635-1656).
+        # instance_utils.py:1635-1656). The slice index rides along so
+        # multislice clusters (num_nodes > 1 TPU nodes) get per-slice
+        # TPU worker ids + MEGASCALE DCN envs (gang_run.build_rank_envs).
         for worker_idx, ep in enumerate(node.get('networkEndpoints', [])):
             infos.append(
                 common.InstanceInfo(
                     instance_id=f'{name}/worker-{worker_idx}',
                     internal_ip=ep.get('ipAddress', ''),
                     external_ip=ep.get('accessConfig', {}).get('externalIp'),
-                    tags={'worker_index': str(worker_idx)},
+                    tags={'worker_index': str(worker_idx),
+                          'slice_index': str(slice_idx)},
                 ))
         instances[name] = infos
     ssh_user = provider_config.get('ssh_user', 'skytpu')

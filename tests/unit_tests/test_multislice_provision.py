@@ -1,0 +1,80 @@
+"""Multislice provisioning: num_nodes>1 TPU clusters carry per-host
+slice ids from the provisioner through ClusterInfo into gang_run's
+MEGASCALE env injection (SURVEY §2.11 multislice/DCN row — the data
+path `parallel/mesh.py` covers is wired to the control path here)."""
+import pytest
+
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.gcp import instance as gcp_instance
+from skypilot_tpu.provision.gcp import tpu_api
+from skypilot_tpu.skylet import constants
+from skypilot_tpu.skylet import gang_run
+
+
+@pytest.fixture(autouse=True)
+def fake_gcp(monkeypatch):
+    monkeypatch.setenv('SKYTPU_GCP_FAKE', '1')
+    monkeypatch.setenv('GOOGLE_CLOUD_PROJECT', 'proj-test')
+    tpu_api.FakeTpuService._nodes = {}  # pylint: disable=protected-access
+    yield
+    tpu_api.FakeTpuService._nodes = {}  # pylint: disable=protected-access
+
+
+def _config(count):
+    return provision_common.ProvisionConfig(
+        provider_config={'region': 'us-east5',
+                         'availability_zone': 'us-east5-b',
+                         'ssh_user': 'skytpu'},
+        authentication_config={'ssh_keys': 'skytpu:ssh-ed25519 AAAA'},
+        docker_config={},
+        node_config={'accelerator_type': 'v5e-16',
+                     'runtime_version': 'tpu-ubuntu2204-base'},
+        count=count,
+        tags={},
+        resume_stopped_nodes=True,
+    )
+
+
+def test_two_slice_cluster_host_meta_and_megascale_envs():
+    # num_nodes=2 with a TPU accelerator = 2 slice nodes = multislice.
+    gcp_instance.run_instances('us-east5', 'ms', _config(count=2))
+    info = gcp_instance.get_cluster_info(
+        'us-east5', 'ms', _config(count=2).provider_config)
+    hosts = info.ordered_host_meta()
+    # v5e-16 = 4 hosts per slice (16 chips / 4 per host); 2 slices =
+    # 8 ranked hosts.
+    assert [h['rank'] for h in hosts] == list(range(8))
+    assert [h['slice_id'] for h in hosts] == [0] * 4 + [1] * 4
+
+    envs = gang_run.build_rank_envs({
+        'hosts': hosts,
+        'cluster_name': 'ms',
+        'chips_per_host': 4,
+    })
+    assert len(envs) == 8
+    for rank, env in enumerate(envs):
+        assert env[constants.NODE_RANK_ENV] == str(rank)
+        assert env[constants.MEGASCALE_NUM_SLICES_ENV] == '2'
+    # TPU worker ids restart per slice; slice ids are contiguous.
+    assert [e[constants.TPU_WORKER_ID_ENV] for e in envs] == \
+        ['0', '1', '2', '3'] * 2
+    assert [e[constants.MEGASCALE_SLICE_ID_ENV] for e in envs] == \
+        ['0'] * 4 + ['1'] * 4
+    # All ranks agree on one MEGASCALE coordinator (slice 0's head).
+    coords = {e[constants.MEGASCALE_COORDINATOR_ENV] for e in envs}
+    assert len(coords) == 1
+
+
+def test_single_slice_cluster_has_no_megascale_envs():
+    gcp_instance.run_instances('us-east5', 'ss', _config(count=1))
+    info = gcp_instance.get_cluster_info(
+        'us-east5', 'ss', _config(count=1).provider_config)
+    hosts = info.ordered_host_meta()
+    assert [h.get('slice_id') for h in hosts] == [0] * len(hosts)
+    envs = gang_run.build_rank_envs({
+        'hosts': hosts,
+        'cluster_name': 'ss',
+        'chips_per_host': 4,
+    })
+    for env in envs:
+        assert constants.MEGASCALE_NUM_SLICES_ENV not in env
