@@ -202,7 +202,8 @@ def train_loop(model_cfg: llama.LlamaConfig,
                keep: int = 3,
                data_seed: int = 0,
                log_every: int = 10,
-               sleep_per_step: float = 0.0) -> 'TrainState':
+               sleep_per_step: float = 0.0,
+               dataset: Optional['Any'] = None) -> 'TrainState':
     """Run (or RESUME) a training run with periodic checkpointing.
 
     The resume-from-step path the managed-jobs preemption story depends on
@@ -231,10 +232,18 @@ def train_loop(model_cfg: llama.LlamaConfig,
     step_fn = make_train_step(model_cfg, train_cfg, mesh=mesh)
 
     for step in range(start_step, num_steps):
-        dkey = jax.random.fold_in(jax.random.PRNGKey(data_seed), step)
-        tokens = jax.random.randint(dkey, (batch_size, seq_len), 0,
-                                    model_cfg.vocab_size)
-        targets = jnp.roll(tokens, -1, axis=1)
+        if dataset is not None:
+            # Real data: batches are pure in (seed, step) — resume at
+            # step N replays the exact unpreempted stream (models/data).
+            tokens_np, targets_np = dataset.batch(step, batch_size,
+                                                  seq_len, seed=data_seed)
+            tokens = jnp.asarray(tokens_np)
+            targets = jnp.asarray(targets_np)
+        else:
+            dkey = jax.random.fold_in(jax.random.PRNGKey(data_seed), step)
+            tokens = jax.random.randint(dkey, (batch_size, seq_len), 0,
+                                        model_cfg.vocab_size)
+            targets = jnp.roll(tokens, -1, axis=1)
         state, metrics = step_fn(state, tokens, targets)
         if sleep_per_step:
             # Pacing knob for tests/demos (preemption windows).
@@ -277,6 +286,9 @@ def main() -> None:
     parser.add_argument('--num-slices', type=int,
                         default=int(os.environ.get('MEGASCALE_NUM_SLICES',
                                                    '1')))
+    parser.add_argument('--data', default=None,
+                        help='token file (models/data.py format); '
+                        'default: deterministic synthetic stream')
     args = parser.parse_args()
     # Multi-host gangs: the runtime injects JAX_COORDINATOR_ADDRESS /
     # JAX_NUM_PROCESSES / JAX_PROCESS_ID (gang_run.build_rank_envs).
@@ -296,13 +308,22 @@ def main() -> None:
         mesh = mesh_lib.make_mesh()  # fsdp over every chip by default
     else:
         mesh = None
+    dataset = None
+    if args.data:
+        from skypilot_tpu.models import data as data_lib
+        dataset = data_lib.TokenDataset.open(args.data)
+        if dataset.vocab_size > cfg.vocab_size:
+            raise SystemExit(
+                f'Dataset vocab {dataset.vocab_size} exceeds model '
+                f'vocab {cfg.vocab_size}.')
     state = train_loop(cfg, TrainConfig(warmup_steps=5), args.steps,
                        args.batch_size, args.seq_len,
                        mesh=mesh,
                        checkpoint_dir=args.checkpoint_dir,
                        save_every=args.save_every,
                        log_every=args.log_every,
-                       sleep_per_step=args.sleep_per_step)
+                       sleep_per_step=args.sleep_per_step,
+                       dataset=dataset)
     print(f'[train] done at step {int(state.step)}', flush=True)
 
 
