@@ -29,10 +29,19 @@ import jax.numpy as jnp
 
 def run_decode_bench(model_name: str, batch: int, prompt_len: int,
                      new_tokens: int, steps: int = 5,
-                     int8: bool = False) -> dict:
+                     int8: bool = False, beat=None) -> dict:
     from skypilot_tpu.models import decode, llama
 
-    devices = harness.init_devices()
+    # When a supervising caller passes `beat`, devices are already up
+    # (bench.py's payload ran init_devices) — don't re-init: it would
+    # overwrite the caller's decode-phase heartbeat with 'init'/
+    # 'devices_ok' and put the decode compile under the wrong deadline.
+    if beat is None:
+        beat = lambda *_a, **_k: None
+        devices = harness.init_devices()
+    else:
+        import jax as _jax
+        devices = _jax.devices()
     on_accelerator = devices[0].platform != 'cpu'
     if not on_accelerator:
         # CPU dev fallback: tiny shapes, still one JSON line.
@@ -61,11 +70,14 @@ def run_decode_bench(model_name: str, batch: int, prompt_len: int,
 
     pre = jax.jit(prefill_only)
 
+    run_phase = 'decode_int8_run' if int8 else 'decode_run'
+
     def timed(fn, n) -> float:
         # Warmup/compile; a host fetch is the only reliable sync on the
         # tunneled TPU platform.
         _ = float(jnp.sum(fn(params, prompt, prompt_lens).astype(
             jnp.float32)[0]))
+        beat(run_phase)
         t0 = time.perf_counter()
         for _ in range(n):
             out = fn(params, prompt, prompt_lens)
