@@ -496,7 +496,7 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             scheme, bucket, key = storage_utils.split_bucket_uri(src)
             store_cls = storage_lib.store_class_for_scheme(scheme)
             cmd = mounting_utils.get_s3_compat_copy_cmd(
-                bucket, key, dst, store_cls.endpoint_url(),
+                bucket, key, dst, store_cls.endpoint_for_uri(src),
                 store_cls.PROFILE, store_cls.CREDENTIALS_PATH)
         elif src.startswith('azure://'):
             _, container, key = storage_utils.split_bucket_uri(src)

@@ -274,10 +274,24 @@ class S3CompatStore(S3Store):
     def endpoint_url(cls) -> str:
         raise NotImplementedError
 
+    def _endpoint(self) -> str:
+        """Instance hook: stores that carry per-bucket endpoint state
+        (IBM COS region from the URI) override this."""
+        return self.endpoint_url()
+
+    @classmethod
+    def endpoint_for_uri(cls, uri: str) -> str:
+        """Endpoint for a bucket URI. Default: the URI carries no
+        endpoint state; stores whose URIs do (IBM COS region) override.
+        Keeps URI-driven callers (backend bucket fetch) scheme-agnostic.
+        """
+        del uri
+        return cls.endpoint_url()
+
     def _aws(self, *args: str,
              check: bool = True) -> 'subprocess.CompletedProcess':
         argv = ['aws'] + list(args) + [
-            '--endpoint-url', self.endpoint_url(),
+            '--endpoint-url', self._endpoint(),
             '--profile', self.PROFILE,
         ]
         env = dict(os.environ)
@@ -297,12 +311,12 @@ class S3CompatStore(S3Store):
 
     def mount_command(self, mount_path: str) -> str:
         return mounting_utils.get_s3_compat_mount_script(
-            self.name, mount_path, self.endpoint_url(), self.PROFILE,
+            self.name, mount_path, self._endpoint(), self.PROFILE,
             self.CREDENTIALS_PATH, self.RCLONE_PROVIDER)
 
     def copy_command(self, dst: str) -> str:
         return mounting_utils.get_s3_compat_copy_cmd(
-            self.name, '', dst, self.endpoint_url(), self.PROFILE,
+            self.name, '', dst, self._endpoint(), self.PROFILE,
             self.CREDENTIALS_PATH)
 
     def get_uri(self) -> str:
@@ -388,7 +402,10 @@ class OciStore(S3CompatStore):
 class IbmCosStore(S3CompatStore):
     """IBM Cloud Object Storage bucket via its S3-compatible endpoint.
 
-    Parity: sky/data/storage.py IBMCosStore:3284 (``cos://`` scheme).
+    Parity: sky/data/storage.py IBMCosStore:3284. URI format is the
+    reference's ``cos://<region>/<bucket>`` (sky/data/data_utils
+    ``split_cos_path``) — the region segment selects the endpoint;
+    without it, ``ibm.region`` config / $IBM_COS_REGION applies.
     """
 
     PROFILE = 'ibm'
@@ -396,12 +413,30 @@ class IbmCosStore(S3CompatStore):
     RCLONE_PROVIDER = 'IBMCOS'
     SCHEME = 'cos'
 
+    def __init__(self, name: str, source: Optional[str] = None,
+                 region: Optional[str] = None):
+        super().__init__(name, source)
+        self.region = region
+
     @classmethod
-    def endpoint_url(cls) -> str:
-        region = _config_or_env(('ibm', 'region'), 'IBM_COS_REGION',
-                                default='us-east')
+    def endpoint_url(cls, region: Optional[str] = None) -> str:
+        region = region or _config_or_env(
+            ('ibm', 'region'), 'IBM_COS_REGION', default='us-east')
         return (f'https://s3.{region}.cloud-object-storage.'
                 'appdomain.cloud')
+
+    def _endpoint(self) -> str:
+        return self.endpoint_url(self.region)
+
+    @classmethod
+    def endpoint_for_uri(cls, uri: str) -> str:
+        region, _, _ = storage_utils.split_cos_uri(uri)
+        return cls.endpoint_url(region)
+
+    def get_uri(self) -> str:
+        if self.region:
+            return f'cos://{self.region}/{self.name}'
+        return super().get_uri()
 
 
 class AzureBlobStore(AbstractStore):
@@ -648,7 +683,13 @@ class Storage:
         source = None
         if self.source is not None and '://' not in self.source:
             source = self.source
-        store = _STORE_CLASSES[store_type](self.name, source)
+        if (store_type is StoreType.IBM and self.source is not None and
+                self.source.startswith('cos://')):
+            # cos://<region>/<bucket>: the URI's region pins the endpoint.
+            region, _, _ = storage_utils.split_cos_uri(self.source)
+            store = IbmCosStore(self.name, source, region=region)
+        else:
+            store = _STORE_CLASSES[store_type](self.name, source)
         store.initialize()
         global_state.add_or_update_storage(self.name, self.handle(),
                                            StorageStatus.INIT.value)

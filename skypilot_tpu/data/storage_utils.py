@@ -6,7 +6,7 @@ stores (parity: ``sky/data/data_utils.py``).
 """
 import fnmatch
 import os
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 SKYIGNORE_FILE = '.skyignore'
 GITIGNORE_FILE = '.gitignore'
@@ -110,9 +110,53 @@ def list_files_to_upload(src_dir: str) -> List[Tuple[str, str]]:
     return out
 
 
-def split_bucket_uri(uri: str) -> Tuple[str, str, str]:
-    """'gs://bucket/some/key' → ('gs', 'bucket', 'some/key')."""
+# IBM COS location ids (cross-region + regional + single-site), accepted
+# as the first path segment of a ``cos://`` URI. The reference's format
+# is ``cos://<region>/<bucket>`` (sky/data/data_utils.split_cos_path,
+# sky/data/storage.py:868) — a migrating user's URIs parse identically
+# here.
+IBM_COS_REGIONS = frozenset({
+    'us', 'eu', 'ap', 'us-south', 'us-east', 'eu-gb', 'eu-de', 'eu-es',
+    'au-syd', 'jp-tok', 'jp-osa', 'ca-tor', 'ca-mon', 'br-sao', 'in-che',
+    'ams03', 'che01', 'mil01', 'mon01', 'par01', 'sjc04', 'sng01',
+})
+
+
+def split_cos_uri(uri: str) -> Tuple[Optional[str], str, str]:
+    """'cos://<region>/<bucket>[/key]' → (region, bucket, key).
+
+    Reference-compatible (sky/data/data_utils.split_cos_path): the first
+    segment is the COS location when it names a known one. A bare
+    ``cos://bucket[/key]`` (no region) is also accepted — region then
+    comes from ``ibm.region`` config — unless the bucket name collides
+    with a region name, which is ambiguous and rejected.
+    """
     scheme, rest = uri.split('://', maxsplit=1)
+    assert scheme == 'cos', uri
+    parts = rest.split('/', 2)
+    if len(parts) >= 2 and parts[0] in IBM_COS_REGIONS:
+        return (parts[0], parts[1], parts[2] if len(parts) == 3 else '')
+    if parts[0] in IBM_COS_REGIONS:
+        from skypilot_tpu import exceptions
+        raise exceptions.StorageSpecError(
+            f'Ambiguous COS URI {uri!r}: {parts[0]!r} is an IBM COS '
+            'location id; use cos://<region>/<bucket>.')
+    return (None, parts[0],
+            '/'.join(parts[1:]) if len(parts) > 1 else '')
+
+
+def split_bucket_uri(uri: str) -> Tuple[str, str, str]:
+    """'gs://bucket/some/key' → ('gs', 'bucket', 'some/key').
+
+    ``cos://`` URIs carry an optional leading region segment
+    (reference format ``cos://<region>/<bucket>``); it is stripped here
+    so the returned bucket is always the actual bucket name.
+    """
+    scheme = uri.split('://', maxsplit=1)[0]
+    if scheme == 'cos':
+        _, bucket, key = split_cos_uri(uri)
+        return scheme, bucket, key
+    rest = uri.split('://', maxsplit=1)[1]
     if '/' in rest:
         bucket, key = rest.split('/', maxsplit=1)
     else:

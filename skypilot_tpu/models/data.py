@@ -63,8 +63,11 @@ class TokenDataset:
 
         Epoch ordering is a seeded permutation of window indices;
         consecutive steps walk it, wrapping to a re-seeded permutation
-        per epoch. All hosts compute the same permutation (same seed),
-        then take their per-host slice of the global batch upstream.
+        per epoch. All hosts compute the same permutation (same seed)
+        and feed the same full global numpy batch to the jitted train
+        step — replicated-numpy inputs are valid in multi-process jit,
+        which shards them per the step's sharding constraint
+        (models/train.train_loop).
         """
         windows = self.num_windows(seq_len)
         if windows == 0:

@@ -231,19 +231,27 @@ def train_loop(model_cfg: llama.LlamaConfig,
 
     step_fn = make_train_step(model_cfg, train_cfg, mesh=mesh)
 
+    import numpy as np
+
     for step in range(start_step, num_steps):
+        # Batches stay HOST numpy all the way into the jitted step: in a
+        # multi-process gang every host computes the same (seed, step)-
+        # deterministic global batch, and replicated-numpy inputs are
+        # valid multi-process jit arguments — jit shards them per the
+        # step's with_sharding_constraint. (Committing per-process with
+        # jnp.asarray would produce non-globally-addressable arrays and
+        # fail under a multi-host mesh.)
         if dataset is not None:
-            # Real data: batches are pure in (seed, step) — resume at
-            # step N replays the exact unpreempted stream (models/data).
-            tokens_np, targets_np = dataset.batch(step, batch_size,
-                                                  seq_len, seed=data_seed)
-            tokens = jnp.asarray(tokens_np)
-            targets = jnp.asarray(targets_np)
+            # Real data: pure in (seed, step) — resume at step N replays
+            # the exact unpreempted stream (models/data).
+            tokens, targets = dataset.batch(step, batch_size, seq_len,
+                                            seed=data_seed)
         else:
-            dkey = jax.random.fold_in(jax.random.PRNGKey(data_seed), step)
-            tokens = jax.random.randint(dkey, (batch_size, seq_len), 0,
-                                        model_cfg.vocab_size)
-            targets = jnp.roll(tokens, -1, axis=1)
+            rng = np.random.default_rng(
+                np.random.SeedSequence([data_seed, step]))
+            tokens = rng.integers(0, model_cfg.vocab_size,
+                                  (batch_size, seq_len), dtype=np.int32)
+            targets = np.roll(tokens, -1, axis=1)
         state, metrics = step_fn(state, tokens, targets)
         if sleep_per_step:
             # Pacing knob for tests/demos (preemption windows).

@@ -76,8 +76,8 @@ def _gpipe_shard(stage_fn: Callable, layers, xs: jax.Array,
     # The carries become device-varying after the first ppermute/write;
     # mark the (replicated-zero) initial values as varying so the scan's
     # carry type is stable (shard_map vma check).
-    buf0 = jax.lax.pvary(buf0, (STAGE_AXIS, DATA_AXIS))
-    ys0 = jax.lax.pvary(ys0, (STAGE_AXIS,))
+    buf0 = jax.lax.pcast(buf0, (STAGE_AXIS, DATA_AXIS), to='varying')
+    ys0 = jax.lax.pcast(ys0, (STAGE_AXIS,), to='varying')
     (_, ys), _ = jax.lax.scan(tick, (buf0, ys0), jnp.arange(ticks))
     # Replicate the final-stage outputs across the stage axis (masked
     # psum; its transpose under AD routes cotangents back to the last

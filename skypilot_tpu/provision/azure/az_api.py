@@ -186,11 +186,17 @@ class CliTransport:
     def delete_vms(self, names: List[str]) -> None:
         self._vm_op('delete', names)
 
-    def delete_group(self) -> None:
+    def delete_group(self, wait: bool = False) -> None:
         # `az vm delete` leaves NICs/public-IPs/OS disks billing; the
         # per-cluster group teardown removes everything at once.
-        self._run(['group', 'delete', '--name', self.resource_group,
-                   '--yes', '--no-wait'])
+        # ``wait=True`` on the capacity-rollback path: a zone failover
+        # recreates the SAME group name, and `az group create` can
+        # collide with an in-flight async delete — the retry would then
+        # fail for a non-capacity reason.
+        args = ['group', 'delete', '--name', self.resource_group, '--yes']
+        if not wait:
+            args.append('--no-wait')
+        self._run(args)
 
 
 class FakeAzureService:
@@ -289,7 +295,8 @@ class FakeAzureService:
     def delete_vms(self, names: List[str]) -> None:
         self._set_state(names, 'VM deleted')
 
-    def delete_group(self) -> None:
+    def delete_group(self, wait: bool = False) -> None:
+        del wait  # the fake deletes synchronously either way
         with FakeAzureService._lock:
             vms = self._load()
             for vm in vms.values():

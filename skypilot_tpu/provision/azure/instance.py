@@ -102,12 +102,26 @@ def run_instances(region: str, cluster_name_on_cloud: str,
         # Failover may move to another region/zone: partially-created VMs
         # would bill forever (mirrors the EC2 partial-create cleanup).
         if created:
-            if not existing and 'resource_group' not in \
-                    config.provider_config:
-                # Fresh dedicated group: tear down NICs/IPs/disks too.
-                client.delete_group()
-            else:
-                client.delete_vms(created)
+            # Cleanup must never mask the capacity error: the failover
+            # engine only fails over on CapacityError, so a cleanup
+            # timeout/API error escaping here would abort provisioning
+            # instead of moving to the next zone.
+            try:
+                if not existing and 'resource_group' not in \
+                        config.provider_config:
+                    # Fresh dedicated group: tear down NICs/IPs/disks
+                    # too. Synchronous: a zone failover reuses the group
+                    # name, and `az group create` conflicts with an
+                    # in-flight async delete (the retry would then fail
+                    # for a non-capacity reason).
+                    client.delete_group(wait=True)
+                else:
+                    client.delete_vms(created)
+            except Exception as cleanup_exc:  # pylint: disable=broad-except
+                logger.warning(
+                    f'Capacity rollback cleanup for '
+                    f'{cluster_name_on_cloud} failed (continuing with '
+                    f'failover): {cleanup_exc}')
         raise
     head = by_index.get(0)
     head_id = head['name'] if head is not None else (

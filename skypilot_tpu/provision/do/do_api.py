@@ -64,16 +64,17 @@ class RestTransport:
 
     def _run(self, method: str, path: str,
              body: Optional[dict] = None) -> Any:
-        out = rest_transport.curl_json(
+        def classify(o: dict) -> None:
+            if o.get('message') and o.get('id'):
+                msg = str(o['message'])
+                if any(m in msg.lower() for m in _CAPACITY_MARKERS):
+                    raise DoCapacityError(msg)
+                raise DoApiError(msg)
+
+        return rest_transport.classified_curl_json(
             method, f'{_API_URL}{path}',
             f'header = "Authorization: Bearer {self.token}"\n', body,
-            api_error=DoApiError)
-        if isinstance(out, dict) and out.get('message') and out.get('id'):
-            msg = str(out['message'])
-            if any(m in msg.lower() for m in _CAPACITY_MARKERS):
-                raise DoCapacityError(msg)
-            raise DoApiError(msg)
-        return out
+            api_error=DoApiError, classify=classify)
 
     def deploy(self, name: str, region: str, instance_type: str,
                use_spot: bool, public_key: Optional[str]) -> str:

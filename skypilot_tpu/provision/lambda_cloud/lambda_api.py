@@ -61,17 +61,24 @@ class RestTransport:
     def __init__(self, api_key: str):
         self.api_key = api_key
 
-    def _run(self, method: str, path: str,
-             body: Optional[dict] = None) -> dict:
-        out = rest_transport.curl_json(
-            method, f'{_API_URL}{path}', f'user = "{self.api_key}:"\n',
-            body, api_error=LambdaApiError)
+    @staticmethod
+    def _classify(out: dict) -> None:
+        """Marker check for Lambda error bodies (200 or 4xx alike)."""
         if 'error' in out:
-            code = out['error'].get('code', '')
-            msg = out['error'].get('message', code)
+            err = out['error']
+            code = err.get('code', '') if isinstance(err, dict) else \
+                str(err)
+            msg = err.get('message', code) if isinstance(err, dict) else \
+                code
             if _is_capacity_code(code):
                 raise LambdaCapacityError(msg)
             raise LambdaApiError(msg)
+
+    def _run(self, method: str, path: str,
+             body: Optional[dict] = None) -> dict:
+        out = rest_transport.classified_curl_json(
+            method, f'{_API_URL}{path}', f'user = "{self.api_key}:"\n',
+            body, api_error=LambdaApiError, classify=self._classify)
         return out.get('data', out)
 
     def launch(self, name: str, region: str, instance_type: str,

@@ -59,16 +59,17 @@ class RestTransport:
 
     def _run(self, method: str, path: str,
              body: Optional[dict] = None) -> Any:
-        out = rest_transport.curl_json(
+        def classify(o: dict) -> None:
+            if o.get('code'):
+                msg = str(o.get('message', o))
+                if any(m in msg.lower() for m in _CAPACITY_MARKERS):
+                    raise NebiusCapacityError(msg)
+                raise NebiusApiError(msg)
+
+        return rest_transport.classified_curl_json(
             method, f'{_API_URL}{path}',
             f'header = "Authorization: Bearer {self.token}"\n', body,
-            api_error=NebiusApiError)
-        if isinstance(out, dict) and out.get('code'):
-            msg = str(out.get('message', out))
-            if any(m in msg.lower() for m in _CAPACITY_MARKERS):
-                raise NebiusCapacityError(msg)
-            raise NebiusApiError(msg)
-        return out
+            api_error=NebiusApiError, classify=classify)
 
     def deploy(self, name: str, region: str, instance_type: str,
                use_spot: bool, public_key: Optional[str]) -> str:

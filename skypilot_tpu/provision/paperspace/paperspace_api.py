@@ -65,16 +65,17 @@ class RestTransport:
 
     def _run(self, method: str, path: str,
              body: Optional[dict] = None) -> Any:
-        out = rest_transport.curl_json(
+        def classify(o: dict) -> None:
+            if o.get('error'):
+                msg = str(o.get('message', o['error']))
+                if any(m in msg.lower() for m in _CAPACITY_MARKERS):
+                    raise PaperspaceCapacityError(msg)
+                raise PaperspaceApiError(msg)
+
+        return rest_transport.classified_curl_json(
             method, f'{_API_URL}{path}',
             f'header = "Authorization: Bearer {self.key}"\n', body,
-            api_error=PaperspaceApiError)
-        if isinstance(out, dict) and out.get('error'):
-            msg = str(out.get('message', out['error']))
-            if any(m in msg.lower() for m in _CAPACITY_MARKERS):
-                raise PaperspaceCapacityError(msg)
-            raise PaperspaceApiError(msg)
-        return out
+            api_error=PaperspaceApiError, classify=classify)
 
     def deploy(self, name: str, region: str, instance_type: str,
                use_spot: bool, public_key: Optional[str]) -> str:

@@ -8,6 +8,33 @@ import subprocess
 from typing import Any, Optional, Type
 
 
+def classified_curl_json(method: str, url: str, secret_config: str,
+                         body: Optional[dict] = None,
+                         api_error: Type[Exception] = RuntimeError,
+                         classify=None, timeout: int = 120) -> Any:
+    """:func:`curl_json` + error-body classification.
+
+    ``classify(body_dict)`` is the transport's marker check: it raises
+    the cloud's richer error (e.g. its CapacityError subclass — feeding
+    the failover engine) when the body carries the cloud's error shape,
+    and returns None otherwise. It runs on BOTH success bodies (APIs
+    that answer 200 + error payload) and HTTP >= 400 bodies (APIs that
+    answer 4xx + error payload); an HTTP error whose body classify
+    doesn't recognize raises the generic ``api_error``.
+    """
+    try:
+        out = curl_json(method, url, secret_config, body,
+                        api_error=api_error, timeout=timeout)
+    except api_error as exc:
+        http_body = getattr(exc, 'http_body', None)
+        if classify is not None and isinstance(http_body, dict):
+            classify(http_body)  # may raise the richer error
+        raise
+    if classify is not None and isinstance(out, dict):
+        classify(out)
+    return out
+
+
 def curl_json(method: str, url: str, secret_config: str,
               body: Optional[dict] = None,
               api_error: Type[Exception] = RuntimeError,
@@ -17,22 +44,47 @@ def curl_json(method: str, url: str, secret_config: str,
     ``secret_config`` is a curl config snippet, e.g.
     ``'header = "Authorization: Bearer <key>"\\n'``.
     """
+    # '\n<status>' trailer on stdout: curl's -w write-out is the only
+    # way to see the HTTP status without -i header parsing. An error
+    # status whose JSON body happens to lack the per-cloud marker shape
+    # (e.g. a 401 {"detail": ...}) must classify as an API error here,
+    # not surface later as a KeyError in deploy/list.
     args = ['curl', '-sS', '-K', '-', '-X', method,
-            '-H', 'Content-Type: application/json', url]
+            '-H', 'Content-Type: application/json',
+            '-w', '\n%{http_code}', url]
     if body is not None:
         args += ['-d', json.dumps(body)]
     proc = subprocess.run(args, input=secret_config, capture_output=True,
                           text=True, timeout=timeout, check=False)
     if proc.returncode != 0:
         raise api_error(f'{method} {url}: {proc.stderr.strip()}')
-    if not proc.stdout.strip():
+    payload, _, status_str = proc.stdout.rpartition('\n')
+    try:
+        status = int(status_str.strip() or '0')
+    except ValueError:
+        status, payload = 0, proc.stdout
+    if status >= 400:
+        # Clouds report capacity stockouts as 4xx JSON. The raised
+        # api_error carries the parsed body (``http_body``/``http_status``
+        # attributes) so the per-cloud transport can re-classify it into
+        # its CapacityError taxonomy and keep the failover engine fed.
+        try:
+            parsed = json.loads(payload)
+        except json.JSONDecodeError:
+            parsed = None
+        exc = api_error(
+            f'{method} {url}: HTTP {status}: {payload.strip()[:500]}')
+        exc.http_status = status
+        exc.http_body = parsed
+        raise exc
+    if not payload.strip():
         return {}
     try:
-        return json.loads(proc.stdout)
+        return json.loads(payload)
     except json.JSONDecodeError:
         # Gateways answer 5xx with HTML; that must classify as the
         # cloud's API error (feeding retry/rollback), not leak a raw
         # JSONDecodeError past neocloud_common's handling.
         raise api_error(
             f'{method} {url}: non-JSON response '
-            f'{proc.stdout.strip()[:200]!r}') from None
+            f'{payload.strip()[:200]!r}') from None

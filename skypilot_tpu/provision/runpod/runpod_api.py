@@ -97,13 +97,14 @@ class RestTransport:
 
     def _run(self, method: str, path: str,
              body: Optional[dict] = None) -> Any:
-        out = rest_transport.curl_json(
+        def classify(o: dict) -> None:
+            if o.get('error'):
+                _raise_for_error(str(o['error']))
+
+        return rest_transport.classified_curl_json(
             method, f'{_API_URL}{path}',
             f'header = "Authorization: Bearer {self.api_key}"\n', body,
-            api_error=RunPodApiError)
-        if isinstance(out, dict) and out.get('error'):
-            _raise_for_error(str(out['error']))
-        return out
+            api_error=RunPodApiError, classify=classify)
 
     def deploy_pod(self, name: str, region: str, instance_type: str,
                    interruptible: bool,

@@ -63,16 +63,17 @@ class RestTransport:
 
     def _run(self, method: str, path: str,
              body: Optional[dict] = None) -> Any:
-        out = rest_transport.curl_json(
+        def classify(o: dict) -> None:
+            if o.get('errorCode'):
+                msg = str(o.get('message', o['errorCode']))
+                if any(m in msg.lower() for m in _CAPACITY_MARKERS):
+                    raise ScpCapacityError(msg)
+                raise ScpApiError(msg)
+
+        return rest_transport.classified_curl_json(
             method, f'{_API_URL}{path}',
             f'header = "Authorization: Bearer {self.key}"\n', body,
-            api_error=ScpApiError)
-        if isinstance(out, dict) and out.get('errorCode'):
-            msg = str(out.get('message', out['errorCode']))
-            if any(m in msg.lower() for m in _CAPACITY_MARKERS):
-                raise ScpCapacityError(msg)
-            raise ScpApiError(msg)
-        return out
+            api_error=ScpApiError, classify=classify)
 
     def deploy(self, name: str, region: str, instance_type: str,
                use_spot: bool, public_key: Optional[str]) -> str:

@@ -72,13 +72,14 @@ class RestTransport:
 
     def _run(self, method: str, path: str,
              body: Optional[dict] = None) -> Any:
-        out = rest_transport.curl_json(
+        def classify(o: dict) -> None:
+            if o.get('success') is False:
+                raise VastApiError(str(o.get('msg', o)))
+
+        return rest_transport.classified_curl_json(
             method, f'{_API_URL}{path}',
             f'header = "Authorization: Bearer {self.key}"\n', body,
-            api_error=VastApiError)
-        if isinstance(out, dict) and out.get('success') is False:
-            raise VastApiError(str(out.get('msg', out)))
-        return out
+            api_error=VastApiError, classify=classify)
 
     def deploy(self, name: str, region: str, instance_type: str,
                use_spot: bool, public_key: Optional[str]) -> str:

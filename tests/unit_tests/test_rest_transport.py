@@ -1,0 +1,105 @@
+"""curl_json: HTTP status classification (an error status with a valid
+JSON body must raise the per-cloud api_error, not parse as success)."""
+import http.server
+import json
+import threading
+
+import pytest
+
+from skypilot_tpu.provision import rest_transport
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    status = 200
+    payload: dict = {'ok': True}
+
+    def _respond(self):
+        body = json.dumps(type(self).payload).encode()
+        self.send_response(type(self).status)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_GET = do_POST = _respond
+
+    def log_message(self, *args):
+        pass
+
+
+class _ApiError(Exception):
+    pass
+
+
+@pytest.fixture()
+def server():
+    srv = http.server.HTTPServer(('127.0.0.1', 0), _Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f'http://127.0.0.1:{srv.server_port}'
+    srv.shutdown()
+
+
+def test_ok_json(server):
+    _Handler.status, _Handler.payload = 200, {'items': [1, 2]}
+    out = rest_transport.curl_json('GET', server, '', api_error=_ApiError)
+    assert out == {'items': [1, 2]}
+
+
+def test_error_status_with_json_body_raises(server):
+    # A 401 whose body lacks the per-cloud error marker shape used to
+    # return as success and blow up later as a KeyError.
+    _Handler.status, _Handler.payload = 401, {'detail': 'bad key'}
+    with pytest.raises(_ApiError, match='HTTP 401'):
+        rest_transport.curl_json('GET', server, '', api_error=_ApiError)
+
+
+def test_server_error_raises(server):
+    _Handler.status, _Handler.payload = 503, {'message': 'overloaded'}
+    with pytest.raises(_ApiError, match='HTTP 503'):
+        rest_transport.curl_json('POST', server, '', body={'a': 1},
+                                 api_error=_ApiError)
+
+
+def test_connection_refused_raises():
+    with pytest.raises(_ApiError):
+        rest_transport.curl_json('GET', 'http://127.0.0.1:9/none', '',
+                                 api_error=_ApiError)
+
+
+def test_http_error_body_still_classifies_capacity(server):
+    """A 4xx whose JSON body carries the cloud's capacity marker must
+    classify as the cloud's CapacityError (feeding failover), not the
+    generic api_error."""
+    class _CapacityError(_ApiError):
+        pass
+
+    def classify(body):
+        if body.get('error'):
+            msg = str(body['error'].get('message', ''))
+            if 'insufficient capacity' in msg.lower():
+                raise _CapacityError(msg)
+            raise _ApiError(msg)
+
+    _Handler.status = 400
+    _Handler.payload = {
+        'error': {'code': 'launch/insufficient-capacity',
+                  'message': 'Insufficient capacity in region'}}
+    with pytest.raises(_CapacityError):
+        rest_transport.classified_curl_json(
+            'POST', server, '', body={}, api_error=_ApiError,
+            classify=classify)
+    # Unrecognized 4xx body -> generic api_error (not success/KeyError).
+    _Handler.status, _Handler.payload = 401, {'detail': 'bad key'}
+    with pytest.raises(_ApiError) as ei:
+        rest_transport.classified_curl_json(
+            'GET', server, '', api_error=_ApiError, classify=classify)
+    assert not isinstance(ei.value, _CapacityError)
+    # Success body with error marker still classifies (200-with-error
+    # APIs).
+    _Handler.status = 200
+    _Handler.payload = {
+        'error': {'code': 'x', 'message': 'Insufficient Capacity'}}
+    with pytest.raises(_CapacityError):
+        rest_transport.classified_curl_json(
+            'GET', server, '', api_error=_ApiError, classify=classify)

@@ -460,3 +460,49 @@ def test_nebius_store_roundtrip(fake_r2, tmp_path, monkeypatch):
     assert '--profile nebius' in calls
     store.delete()
     assert not neb.exists()
+
+
+# ------------------------------------------------------- cos:// URI region
+
+
+def test_split_cos_uri_region_forms():
+    """Reference format cos://<region>/<bucket>[/key] parses the region
+    (sky/data/data_utils.split_cos_path); bare cos://bucket still works."""
+    from skypilot_tpu.data import storage_utils as su
+    assert su.split_cos_uri('cos://us-east/my-bucket') == (
+        'us-east', 'my-bucket', '')
+    assert su.split_cos_uri('cos://eu-de/b/some/key') == (
+        'eu-de', 'b', 'some/key')
+    assert su.split_cos_uri('cos://plainbucket/k1/k2') == (
+        None, 'plainbucket', 'k1/k2')
+    # A bucket that IS a region name with no second segment is ambiguous
+    # (StorageSpecError so CLI/storage callers report it cleanly).
+    import pytest as _pytest
+    from skypilot_tpu import exceptions
+    with _pytest.raises(exceptions.StorageSpecError):
+        su.split_cos_uri('cos://us-east')
+
+
+def test_split_bucket_uri_strips_cos_region():
+    from skypilot_tpu.data import storage_utils as su
+    assert su.split_bucket_uri('cos://us-east/my-bucket/key') == (
+        'cos', 'my-bucket', 'key')
+    assert su.split_bucket_uri('gs://bucket/key') == ('gs', 'bucket', 'key')
+
+
+def test_ibm_cos_uri_region_selects_endpoint(monkeypatch):
+    from skypilot_tpu.data import storage as storage_lib
+    store = storage_lib.IbmCosStore('bkt', region='eu-gb')
+    assert 's3.eu-gb.cloud-object-storage' in store._endpoint()
+    assert store.get_uri() == 'cos://eu-gb/bkt'
+    # Without a URI region the config/env default applies.
+    monkeypatch.setenv('IBM_COS_REGION', 'jp-tok')
+    store2 = storage_lib.IbmCosStore('bkt')
+    assert 's3.jp-tok.cloud-object-storage' in store2._endpoint()
+    assert store2.get_uri() == 'cos://bkt'
+
+
+def test_storage_cos_uri_source_names_bucket_not_region():
+    from skypilot_tpu.data import storage as storage_lib
+    st = storage_lib.Storage(source='cos://us-east/my-bucket')
+    assert st.name == 'my-bucket'
