@@ -21,13 +21,43 @@ if typing.TYPE_CHECKING:
 logger = sky_logging.init_logger(__name__)
 
 
-def _post(path: str, payload: Dict[str, Any]) -> str:
-    url = server_common.check_server_healthy_or_start()
+def _post(path: str, payload: Dict[str, Any],
+          url: Optional[str] = None) -> str:
+    url = url or server_common.check_server_healthy_or_start()
     resp = requests_lib.post(f'{url}{path}', json=payload, timeout=30)
     if resp.status_code != 200:
         raise exceptions.ApiServerError(
             f'POST {path} → {resp.status_code}: {resp.text[:500]}')
     return resp.json()['request_id']
+
+
+def _maybe_upload_local_sources(tasks, payload: Dict[str, Any],
+                                url: str) -> None:
+    """Ship client-local workdir/file-mount sources to a remote server.
+
+    A local server shares this filesystem — paths work as-is. A remote
+    one (helm/container deployments) can't see them: zip + POST /upload
+    first and tag the payload with the upload id so the server rewrites
+    task paths to its extraction (parity: sky/client/sdk.py:300 +
+    sky/server/server.py:313). ``SKYTPU_ALWAYS_UPLOAD=1`` forces the
+    upload path (tests).
+    """
+    import os
+    if server_common.is_local_url(url) and \
+            os.environ.get('SKYTPU_ALWAYS_UPLOAD') != '1':
+        return
+    from skypilot_tpu.server import uploads
+    packaged = uploads.package_tasks(list(tasks))
+    if packaged is None:
+        return
+    upload_id, data = packaged
+    resp = requests_lib.post(f'{url}/upload',
+                             params={'upload_id': upload_id},
+                             data=data, timeout=600)
+    if resp.status_code != 200:
+        raise exceptions.ApiServerError(
+            f'POST /upload → {resp.status_code}: {resp.text[:500]}')
+    payload['upload_id'] = upload_id
 
 
 def _reconstruct_exception(err: Dict[str, str]) -> Exception:
@@ -112,18 +142,17 @@ def api_status(limit: int = 100) -> List[Dict[str, Any]]:
 # ------------------------------------------------------------------ verbs
 
 
-def _dag_payload(entrypoint: Union['task_lib.Task', 'dag_lib.Dag']
-                 ) -> Dict[str, Any]:
-    from skypilot_tpu import dag as dag_lib_  # noqa: F401
+def _tasks_of(entrypoint: Union['task_lib.Task', 'dag_lib.Dag']) -> list:
     from skypilot_tpu import task as task_lib_
     if isinstance(entrypoint, task_lib_.Task):
-        tasks = [entrypoint]
-        name = entrypoint.name
-    else:
-        tasks = list(entrypoint.tasks)
-        name = entrypoint.name
-    return {'dag_name': name,
-            'tasks': [t.to_yaml_config() for t in tasks]}
+        return [entrypoint]
+    return list(entrypoint.tasks)
+
+
+def _dag_payload(entrypoint: Union['task_lib.Task', 'dag_lib.Dag']
+                 ) -> Dict[str, Any]:
+    return {'dag_name': entrypoint.name,
+            'tasks': [t.to_yaml_config() for t in _tasks_of(entrypoint)]}
 
 
 def launch(task: Union['task_lib.Task', 'dag_lib.Dag'],
@@ -140,14 +169,20 @@ def launch(task: Union['task_lib.Task', 'dag_lib.Dag'],
                    dryrun=dryrun,
                    down=down,
                    no_setup=no_setup)
-    return _post('/launch', payload)
+    url = server_common.check_server_healthy_or_start()
+    if not dryrun:
+        # Dry runs provision nothing — don't pay the zip/upload.
+        _maybe_upload_local_sources(_tasks_of(task), payload, url)
+    return _post('/launch', payload, url=url)
 
 
 def exec_(task: Union['task_lib.Task', 'dag_lib.Dag'],
           cluster_name: str) -> str:
     payload = _dag_payload(task)
     payload.update(cluster_name=cluster_name)
-    return _post('/exec', payload)
+    url = server_common.check_server_healthy_or_start()
+    _maybe_upload_local_sources(_tasks_of(task), payload, url)
+    return _post('/exec', payload, url=url)
 
 
 def status(cluster_names: Optional[List[str]] = None,
@@ -214,7 +249,9 @@ def jobs_launch(task: Union['task_lib.Task', 'dag_lib.Dag'],
                 name: Optional[str] = None) -> str:
     payload = _dag_payload(task)
     payload.update(name=name)
-    return _post('/jobs/launch', payload)
+    url = server_common.check_server_healthy_or_start()
+    _maybe_upload_local_sources(_tasks_of(task), payload, url)
+    return _post('/jobs/launch', payload, url=url)
 
 
 def jobs_queue() -> str:
@@ -235,13 +272,19 @@ def jobs_logs(job_id: Optional[int] = None, follow: bool = True,
 
 def serve_up(task: 'task_lib.Task',
              service_name: Optional[str] = None) -> str:
-    return _post('/serve/up', {'task': task.to_yaml_config(),
-                               'service_name': service_name})
+    payload: Dict[str, Any] = {'task': task.to_yaml_config(),
+                               'service_name': service_name}
+    url = server_common.check_server_healthy_or_start()
+    _maybe_upload_local_sources([task], payload, url)
+    return _post('/serve/up', payload, url=url)
 
 
 def serve_update(task: 'task_lib.Task', service_name: str) -> str:
-    return _post('/serve/update', {'task': task.to_yaml_config(),
-                                   'service_name': service_name})
+    payload: Dict[str, Any] = {'task': task.to_yaml_config(),
+                               'service_name': service_name}
+    url = server_common.check_server_healthy_or_start()
+    _maybe_upload_local_sources([task], payload, url)
+    return _post('/serve/update', payload, url=url)
 
 
 def serve_status(service_name: Optional[str] = None) -> str:

@@ -31,6 +31,14 @@ def server_url() -> str:
     return f'http://127.0.0.1:{DEFAULT_PORT}'
 
 
+def is_local_url(url: str) -> bool:
+    """One definition of 'this API server shares my filesystem' — used
+    both by auto-start (only local servers are started) and by the SDK's
+    upload decision (only remote servers need file-mount uploads)."""
+    return url.startswith(('http://127.0.0.1', 'http://localhost',
+                           'http://[::1]'))
+
+
 def server_log_path() -> str:
     d = os.path.join(os.path.expanduser('~'), '.skytpu', 'api')
     os.makedirs(d, exist_ok=True)
@@ -51,7 +59,7 @@ def check_server_healthy_or_start(start_timeout: float = 30.0) -> str:
     url = server_url()
     if is_healthy(url):
         return url
-    if not url.startswith(('http://127.0.0.1', 'http://localhost')):
+    if not is_local_url(url):
         raise exceptions.ApiServerError(
             f'API server {url} is unreachable (and is remote, so it will '
             'not be auto-started).')

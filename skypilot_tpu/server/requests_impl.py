@@ -13,6 +13,10 @@ from skypilot_tpu import task as task_lib
 
 
 def _dag_from_payload(payload: Dict[str, Any]) -> dag_lib.Dag:
+    from skypilot_tpu.server import uploads
+    # Remote clients ship workdir/local file mounts as an uploaded zip;
+    # rewrite task paths to the extraction before building the dag.
+    uploads.localize_payload(payload)
     dag = dag_lib.Dag()
     dag.name = payload.get('dag_name')
     for cfg in payload['tasks']:
@@ -186,15 +190,21 @@ def _jobs_cancel(payload: Dict[str, Any]) -> Dict[str, Any]:
     return {'cancelled': cancelled}
 
 
+def _serve_task_from_payload(payload: Dict[str, Any]) -> task_lib.Task:
+    from skypilot_tpu.server import uploads
+    uploads.localize_payload(payload)
+    return task_lib.Task.from_yaml_config(payload['task'])
+
+
 def _serve_up(payload: Dict[str, Any]) -> Dict[str, Any]:
     from skypilot_tpu import serve
-    task = task_lib.Task.from_yaml_config(payload['task'])
+    task = _serve_task_from_payload(payload)
     return serve.up(task, service_name=payload.get('service_name'))
 
 
 def _serve_update(payload: Dict[str, Any]) -> Dict[str, Any]:
     from skypilot_tpu import serve
-    task = task_lib.Task.from_yaml_config(payload['task'])
+    task = _serve_task_from_payload(payload)
     return serve.update(task, payload['service_name'])
 
 

@@ -139,6 +139,39 @@ async def handle_api_stream(request: web.Request) -> web.StreamResponse:
     return resp
 
 
+async def handle_upload(request: web.Request) -> web.Response:
+    """Client→server zip of workdir/local file mounts, extracted to the
+    per-upload dir ``localize_payload`` later rewrites task paths to.
+    Parity: sky/server/server.py:313 (/upload). The body streams to a
+    temp file — a near-cap zip must not hold ~2× its size in RSS."""
+    import tempfile
+
+    from skypilot_tpu import exceptions as exc_lib
+    from skypilot_tpu.server import uploads
+    upload_id = request.query.get('upload_id', '')
+    with tempfile.NamedTemporaryFile(suffix='.zip',
+                                     delete=False) as tmp:
+        tmp_path = tmp.name
+        async for chunk in request.content.iter_chunked(1 << 20):
+            tmp.write(chunk)
+    try:
+        count = await asyncio.get_event_loop().run_in_executor(
+            None, uploads.save_upload, upload_id, tmp_path)
+    except exc_lib.ApiServerError as exc:
+        # Client's fault: bad id / bad zip / unsafe member paths.
+        return web.json_response({'error': _json_error(exc)}, status=400)
+    except Exception as exc:  # pylint: disable=broad-except
+        # Server's fault (disk full, permissions): report it as such.
+        logger.exception('upload extraction failed')
+        return web.json_response({'error': _json_error(exc)}, status=500)
+    finally:
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+    return web.json_response({'upload_id': upload_id, 'files': count})
+
+
 async def handle_api_status(request: web.Request) -> web.Response:
     limit = int(request.query.get('limit', '100'))
     return web.json_response(requests_db.list_requests(limit=limit))
@@ -169,9 +202,12 @@ async def handle_health(request: web.Request) -> web.Response:
 
 
 def build_app() -> web.Application:
-    app = web.Application()
+    # client_max_size bounds /upload zips (workdir + local file mounts).
+    app = web.Application(client_max_size=int(
+        os.environ.get('SKYTPU_API_MAX_UPLOAD_BYTES', str(512 * 2**20))))
     for path in _VERB_ROUTES:
         app.router.add_post(path, handle_verb)
+    app.router.add_post('/upload', handle_upload)
     app.router.add_get('/api/get', handle_api_get)
     app.router.add_get('/api/stream', handle_api_stream)
     app.router.add_get('/api/status', handle_api_status)

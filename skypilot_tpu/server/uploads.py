@@ -1,0 +1,189 @@
+"""Client → API-server file-mount uploads.
+
+When the API server is remote (helm/container deployments), the client
+and server share no filesystem, so ``workdir:`` and local
+``file_mounts:`` sources must travel with the request. Parity:
+``sky/server/server.py:313`` (``/upload`` zip endpoint) +
+``sky/client/sdk.py:300`` (client-side zip packaging).
+
+Wire format: ONE zip per request, uploaded to ``POST
+/upload?upload_id=<uuid>`` before the verb POST. Inside the zip::
+
+    manifest.json           {"tasks": [{"workdir": "t0/workdir",
+                                        "file_mounts":
+                                          {"/dst": "t0/m0", ...}}, ...]}
+    t0/workdir/**           the task-0 workdir tree
+    t0/m0                   (file) or t0/m0/** (dir) per local mount
+
+The verb payload then carries ``upload_id``; :func:`localize_payload`
+rewrites each task config's local paths to the server-side extraction
+before the dag is built.
+"""
+import io
+import json
+import os
+import shutil
+import time
+import uuid
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from skypilot_tpu import exceptions
+
+MANIFEST = 'manifest.json'
+
+# Extractions older than this are swept on the next upload (a remote
+# server otherwise grows disk without bound, one workdir per launch).
+TTL_SECONDS = int(os.environ.get('SKYTPU_UPLOAD_TTL_SECONDS',
+                                 str(7 * 24 * 3600)))
+
+# Sources that are NOT client-local (bucket URIs, etc.) never upload.
+_REMOTE_PREFIX_MARKER = '://'
+
+
+def uploads_root() -> str:
+    root = os.path.join(os.path.expanduser('~'), '.skytpu', 'api',
+                        'uploads')
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+# --------------------------------------------------------------- server
+
+
+def sweep_expired(now: Optional[float] = None) -> int:
+    """Delete extractions older than TTL_SECONDS. Returns count swept."""
+    now = now or time.time()
+    root = uploads_root()
+    swept = 0
+    for entry in os.listdir(root):
+        path = os.path.join(root, entry)
+        try:
+            if now - os.path.getmtime(path) > TTL_SECONDS:
+                shutil.rmtree(path, ignore_errors=True)
+                swept += 1
+        except OSError:
+            continue
+    return swept
+
+
+def save_upload(upload_id: str, data: Union[bytes, str]) -> int:
+    """Extract an uploaded zip under uploads_root()/<upload_id>.
+
+    ``data``: raw zip bytes, or a path to a zip on disk (the server
+    streams large request bodies to a temp file instead of buffering).
+    Returns the number of extracted members. Rejects absolute paths and
+    parent-escapes (zip-slip).
+    """
+    if not upload_id or any(c in upload_id for c in '/\\.'):
+        raise exceptions.ApiServerError(
+            f'Invalid upload id {upload_id!r}')
+    sweep_expired()
+    dest = os.path.join(uploads_root(), upload_id)
+    os.makedirs(dest, exist_ok=True)
+    count = 0
+    src = data if isinstance(data, str) else io.BytesIO(data)
+    try:
+        zf = zipfile.ZipFile(src)
+    except zipfile.BadZipFile as exc:
+        raise exceptions.ApiServerError(f'Bad upload zip: {exc}') from None
+    with zf:
+        for info in zf.infolist():
+            name = info.filename
+            if name.startswith(('/', '\\')) or '..' in name.split('/'):
+                raise exceptions.ApiServerError(
+                    f'Unsafe path in upload: {name!r}')
+            zf.extract(info, dest)
+            # Restore the executable bit (zip stores POSIX modes in
+            # external_attr) so uploaded scripts stay runnable.
+            mode = (info.external_attr >> 16) & 0o777
+            if mode and not info.is_dir():
+                os.chmod(os.path.join(dest, name), mode)
+            count += 1
+    return count
+
+
+def localize_payload(payload: Dict[str, Any]) -> None:
+    """Rewrite task configs' local paths to the extracted upload.
+
+    No-op without ``upload_id``. Mutates ``payload['tasks']`` in place
+    (and ``payload['task']`` for serve verbs).
+    """
+    upload_id = payload.get('upload_id')
+    if not upload_id:
+        return
+    dest = os.path.join(uploads_root(), str(upload_id))
+    manifest_path = os.path.join(dest, MANIFEST)
+    if not os.path.exists(manifest_path):
+        raise exceptions.ApiServerError(
+            f'Upload {upload_id!r} not found on the server; upload it '
+            'via POST /upload first.')
+    with open(manifest_path, encoding='utf-8') as f:
+        manifest = json.load(f)
+    configs = payload.get('tasks')
+    if configs is None and payload.get('task') is not None:
+        configs = [payload['task']]
+    for i, cfg in enumerate(configs or []):
+        entry = manifest['tasks'][i] if i < len(manifest['tasks']) else {}
+        if entry.get('workdir'):
+            cfg['workdir'] = os.path.join(dest, entry['workdir'])
+        for dst, rel in (entry.get('file_mounts') or {}).items():
+            mounts = cfg.setdefault('file_mounts', {})
+            mounts[dst] = os.path.join(dest, rel)
+
+
+# --------------------------------------------------------------- client
+
+
+def _is_local_source(src: Any) -> bool:
+    return isinstance(src, str) and _REMOTE_PREFIX_MARKER not in src
+
+
+def _add_tree(zf: zipfile.ZipFile, src: str, arc_prefix: str) -> None:
+    from skypilot_tpu.data import storage_utils
+    src = os.path.expanduser(src)
+    if os.path.isfile(src):
+        zf.write(src, arc_prefix)
+        return
+    wrote_any = False
+    for abs_path, rel in storage_utils.list_files_to_upload(src):
+        zf.write(abs_path, f'{arc_prefix}/{rel}')
+        wrote_any = True
+    if not wrote_any:
+        # Keep empty dirs representable: a dir entry.
+        zf.writestr(zipfile.ZipInfo(f'{arc_prefix}/'), b'')
+
+
+def package_tasks(tasks: List[Any]) -> Optional[Tuple[str, bytes]]:
+    """Zip every client-local workdir/file-mount source of ``tasks``.
+
+    Returns (upload_id, zip_bytes), or None when nothing is local (all
+    sources are bucket URIs or the tasks carry no mounts).
+    """
+    manifest: Dict[str, Any] = {'tasks': []}
+    buf = io.BytesIO()
+    have_local = False
+    with zipfile.ZipFile(buf, 'w', zipfile.ZIP_DEFLATED) as zf:
+        for i, t in enumerate(tasks):
+            entry: Dict[str, Any] = {}
+            if t.workdir and _is_local_source(t.workdir):
+                tag = f't{i}/workdir'
+                _add_tree(zf, t.workdir, tag)
+                entry['workdir'] = tag
+                have_local = True
+            mounts: Dict[str, str] = {}
+            for j, (dst, src) in enumerate(
+                    sorted((t.file_mounts or {}).items())):
+                if not _is_local_source(src):
+                    continue
+                tag = f't{i}/m{j}'
+                _add_tree(zf, src, tag)
+                mounts[dst] = tag
+                have_local = True
+            if mounts:
+                entry['file_mounts'] = mounts
+            manifest['tasks'].append(entry)
+        zf.writestr(MANIFEST, json.dumps(manifest))
+    if not have_local:
+        return None
+    return uuid.uuid4().hex, buf.getvalue()

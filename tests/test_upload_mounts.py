@@ -1,0 +1,181 @@
+"""Remote-client file mounts: zip upload → server-side path rewrite.
+
+The VERDICT-r3 scenario: an API server deployed remotely (helm chart)
+shares no filesystem with the client, so ``workdir:`` and local
+``file_mounts:`` must ship with the request (parity:
+sky/server/server.py:313 /upload + sky/client/sdk.py:300 packaging).
+
+The e2e test forces the upload path (SKYTPU_ALWAYS_UPLOAD=1) and then
+DELETES the client-side sources right after ``launch`` returns — the
+task can only succeed from the server-side extraction.
+"""
+import io
+import json
+import os
+import shutil
+import socket
+import subprocess
+import time
+import zipfile
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu.client import sdk
+from skypilot_tpu.server import uploads
+
+
+# ------------------------------------------------------------- unit tier
+
+
+def test_package_tasks_zip_and_manifest(tmp_path):
+    wd = tmp_path / 'wd'
+    (wd / 'sub').mkdir(parents=True)
+    (wd / 'a.txt').write_text('A')
+    (wd / 'sub' / 'b.txt').write_text('B')
+    mnt = tmp_path / 'data.bin'
+    mnt.write_bytes(b'DATA')
+    task = sky.Task(name='t', run='true', workdir=str(wd),
+                    file_mounts={'/inputs/data.bin': str(mnt),
+                                 '/from/bucket': 'gs://bkt/key'})
+    packaged = uploads.package_tasks([task])
+    assert packaged is not None
+    upload_id, data = packaged
+    with zipfile.ZipFile(io.BytesIO(data)) as zf:
+        names = set(zf.namelist())
+        manifest = json.loads(zf.read(uploads.MANIFEST))
+    assert 't0/workdir/a.txt' in names
+    assert 't0/workdir/sub/b.txt' in names
+    entry = manifest['tasks'][0]
+    assert entry['workdir'] == 't0/workdir'
+    # Only the LOCAL mount is packaged; the bucket URI stays remote.
+    assert list(entry['file_mounts'].keys()) == ['/inputs/data.bin']
+    assert len(upload_id) == 32
+
+
+def test_package_tasks_none_when_nothing_local():
+    task = sky.Task(name='t', run='true',
+                    file_mounts={'/d': 's3://bucket/key'})
+    assert uploads.package_tasks([task]) is None
+
+
+def test_save_upload_rejects_zip_slip(tmp_path, monkeypatch):
+    monkeypatch.setenv('HOME', str(tmp_path))
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, 'w') as zf:
+        zf.writestr('../escape.txt', 'x')
+    with pytest.raises(exceptions.ApiServerError, match='Unsafe path'):
+        uploads.save_upload('u1', buf.getvalue())
+    with pytest.raises(exceptions.ApiServerError, match='Invalid upload'):
+        uploads.save_upload('../u2', b'')
+
+
+def test_localize_payload_rewrites_paths(tmp_path, monkeypatch):
+    monkeypatch.setenv('HOME', str(tmp_path))
+    wd = tmp_path / 'wd'
+    wd.mkdir()
+    (wd / 'f.sh').write_text('echo hi')
+    os.chmod(wd / 'f.sh', 0o755)
+    task = sky.Task(name='t', run='true', workdir=str(wd),
+                    file_mounts={'/m': str(wd / 'f.sh')})
+    upload_id, data = uploads.package_tasks([task])
+    uploads.save_upload(upload_id, data)
+    payload = {'tasks': [task.to_yaml_config()], 'upload_id': upload_id}
+    uploads.localize_payload(payload)
+    new_wd = payload['tasks'][0]['workdir']
+    assert new_wd != str(wd) and os.path.isdir(new_wd)
+    assert (open(os.path.join(new_wd, 'f.sh')).read() == 'echo hi')
+    # Executable bit survives the zip round-trip.
+    assert os.access(os.path.join(new_wd, 'f.sh'), os.X_OK)
+    new_mnt = payload['tasks'][0]['file_mounts']['/m']
+    assert os.path.isfile(new_mnt)
+
+
+def test_localize_payload_missing_upload_raises(tmp_path, monkeypatch):
+    monkeypatch.setenv('HOME', str(tmp_path))
+    with pytest.raises(exceptions.ApiServerError, match='not found'):
+        uploads.localize_payload({'tasks': [{}],
+                                  'upload_id': 'deadbeef' * 4})
+
+
+# -------------------------------------------------------------- e2e tier
+
+
+@pytest.fixture
+def api_env(monkeypatch):
+    global_state.set_enabled_clouds(['Local'])
+    with socket.socket() as s:
+        s.bind(('', 0))
+        port = s.getsockname()[1]
+    monkeypatch.setenv('SKYTPU_API_SERVER_URL',
+                       f'http://127.0.0.1:{port}')
+    monkeypatch.setenv('SKYTPU_ALWAYS_UPLOAD', '1')
+    yield port
+    subprocess.run(['pkill', '-f',
+                    f'skypilot_tpu.server.server --port {port}'],
+                   check=False)
+
+
+def test_uploaded_workdir_survives_client_deletion(api_env, tmp_path):
+    """Client scratch dir → upload → delete client copy → task still
+    sees its workdir + file mount (the remote-server contract)."""
+    scratch = tmp_path / 'client_scratch'
+    (scratch / 'wd').mkdir(parents=True)
+    (scratch / 'wd' / 'hello.txt').write_text('workdir-proof-7391')
+    (scratch / 'extra.txt').write_text('mount-proof-4817')
+
+    task = sky.Task(
+        name='upload-e2e',
+        run='cat hello.txt && cat ~/input/extra.txt',
+        workdir=str(scratch / 'wd'),
+        file_mounts={'~/input/extra.txt': str(scratch / 'extra.txt')})
+    task.set_resources(sky.Resources(cloud='local'))
+
+    rid = sdk.launch(task, cluster_name='up-c1')
+    # The zip is uploaded synchronously inside launch(); the client
+    # copies are now redundant. Deleting them proves the task runs from
+    # the server-side extraction.
+    shutil.rmtree(scratch)
+
+    result = sdk.get(rid)
+    assert result['job_id'] == 1
+
+    deadline = time.time() + 90
+    status = None
+    while time.time() < deadline:
+        jobs = sdk.get(sdk.queue('up-c1'))
+        if jobs and jobs[0]['status'] in ('SUCCEEDED', 'FAILED'):
+            status = jobs[0]['status']
+            break
+        time.sleep(0.5)
+    assert status == 'SUCCEEDED'
+
+    buf = io.StringIO()
+    sdk.stream_and_get(sdk.tail_logs('up-c1', 1, follow=False),
+                       output=buf)
+    out = buf.getvalue()
+    assert 'workdir-proof-7391' in out
+    assert 'mount-proof-4817' in out
+
+    sdk.get(sdk.down('up-c1'))
+
+
+def test_sweep_expired_uploads(tmp_path, monkeypatch):
+    monkeypatch.setenv('HOME', str(tmp_path))
+    root = uploads.uploads_root()
+    old = os.path.join(root, 'old1')
+    new = os.path.join(root, 'new1')
+    os.makedirs(old)
+    os.makedirs(new)
+    past = time.time() - uploads.TTL_SECONDS - 60
+    os.utime(old, (past, past))
+    assert uploads.sweep_expired() == 1
+    assert not os.path.exists(old) and os.path.exists(new)
+
+
+def test_save_upload_bad_zip_is_client_error(tmp_path, monkeypatch):
+    monkeypatch.setenv('HOME', str(tmp_path))
+    with pytest.raises(exceptions.ApiServerError, match='Bad upload zip'):
+        uploads.save_upload('u3', b'this is not a zip')
