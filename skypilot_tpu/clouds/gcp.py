@@ -16,6 +16,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 from skypilot_tpu import catalog
 from skypilot_tpu import exceptions
+from skypilot_tpu import skypilot_config
 from skypilot_tpu import topology as topo_lib
 from skypilot_tpu.clouds import cloud
 from skypilot_tpu.utils.registry import CLOUD_REGISTRY
@@ -251,6 +252,19 @@ class GCP(cloud.Cloud):
                     _DEFAULT_RUNTIME_VERSIONS[topo.generation.name]),
                 'num_hosts': topo.num_hosts,
                 'chips_per_host': topo.chips_per_host,
+                # Queued-resources (DWS-style) capacity: per-Resources
+                # accelerator_args win over ~/.skytpu/config.yaml's
+                # gcp.use_queued_resources / gcp.provision_timeout.
+                'use_queued_resources': bool(
+                    args.get(
+                        'queued_resources',
+                        skypilot_config.get_nested(
+                            ('gcp', 'use_queued_resources'), False))),
+                'provision_timeout': int(
+                    args.get(
+                        'provision_timeout',
+                        skypilot_config.get_nested(
+                            ('gcp', 'provision_timeout'), 900))),
             })
         elif resources.accelerators:
             acc_name, acc_count = next(iter(resources.accelerators.items()))

@@ -75,6 +75,7 @@ def run_instances(region: str, cluster_name_on_cloud: str,
 
     created: List[str] = []
     resumed: List[str] = []
+    to_create: List[Dict[str, Any]] = []
     head_id: Optional[str] = None
     for i in range(config.count):
         name = _node_name(cluster_name_on_cloud, i)
@@ -117,10 +118,40 @@ def run_instances(region: str, cluster_name_on_cloud: str,
             body['schedulingConfig'] = {
                 **body.get('schedulingConfig', {}), 'reserved': True
             }
-        logger.debug(f'Creating TPU node {name} in {zone}: '
-                     f'{node_cfg["accelerator_type"]}')
-        client.create_node(zone, name, body)
+        if node_cfg.get('use_queued_resources'):
+            to_create.append({'node_id': name, 'node': body})
+        else:
+            logger.debug(f'Creating TPU node {name} in {zone}: '
+                         f'{node_cfg["accelerator_type"]}')
+            client.create_node(zone, name, body)
         created.append(name)
+
+    if to_create:
+        # Queued-resources path: how real v5p capacity is obtained when
+        # immediate create stocks out. ONE QR carries every missing node
+        # (all-or-nothing gang grant, one wait); the id is unique per
+        # attempt so a retry after preemption can never 409 against a
+        # stale record — teardown sweeps all of the cluster's QRs by
+        # prefix. Denied / timed-out requests classify as
+        # GcpCapacityError so the failover engine blocklists the zone
+        # (parity intent: sky/provision/gcp/mig_utils.py DWS +
+        # instance_utils.py:311).
+        import uuid as uuid_lib
+        reservation = skypilot_config.get_nested(
+            ('gcp', 'specific_reservations'), None)
+        timeout = float(node_cfg.get('provision_timeout', 900))
+        qr_id = (f'{_qr_prefix(cluster_name_on_cloud)}'
+                 f'{uuid_lib.uuid4().hex[:8]}')
+        logger.debug(
+            f'Requesting queued resource {qr_id} in {zone}: '
+            f'{len(to_create)}× {node_cfg["accelerator_type"]} '
+            f'(timeout {int(timeout)}s)')
+        client.create_queued_resource(
+            zone, qr_id, to_create,
+            valid_until_s=timeout,
+            spot=bool(config.node_config.get('use_spot')),
+            reserved=bool(reservation))
+        client.wait_queued_resource(zone, qr_id, timeout=timeout)
 
     assert head_id is not None
     return common.ProvisionRecord(provider_name='gcp',
@@ -130,6 +161,10 @@ def run_instances(region: str, cluster_name_on_cloud: str,
                                   head_instance_id=head_id,
                                   resumed_instance_ids=resumed,
                                   created_instance_ids=created)
+
+
+def _qr_prefix(cluster_name_on_cloud: str) -> str:
+    return f'{cluster_name_on_cloud}-qr-'
 
 
 def _accel_config_type(accelerator_type: str) -> str:
@@ -253,6 +288,16 @@ def terminate_instances(cluster_name_on_cloud: str,
         if worker_only and name.endswith('-0'):
             continue
         client.delete_node(zone, name)
+    if not worker_only:
+        # Sweep the cluster's queued-resource records by id prefix —
+        # including STILL-PENDING requests whose nodes never
+        # materialized (a grant racing teardown would otherwise create
+        # an orphan, billed slice).
+        prefix = _qr_prefix(cluster_name_on_cloud)
+        for qr in client.list_queued_resources(zone):
+            qr_id = qr.get('name', '').split('/')[-1]
+            if qr_id.startswith(prefix):
+                client.delete_queued_resource(zone, qr_id)
 
 
 def open_ports(cluster_name_on_cloud: str,

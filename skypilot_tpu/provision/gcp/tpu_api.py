@@ -144,6 +144,9 @@ class FakeTpuService:
         # projects/{p}/locations/{zone}/nodes[...]
         zone = parts[3] if len(parts) > 3 else ''
         stockout_zones = os.environ.get('SKYTPU_GCP_FAKE_STOCKOUT', '')
+        if '/queuedResources' in path:
+            return self._dispatch_qr(method, path, body, params, zone,
+                                     nodes)
         if method == 'POST' and parts[-1] == 'nodes':
             if zone in stockout_zones.split(','):
                 raise GcpCapacityError(
@@ -191,6 +194,67 @@ class FakeTpuService:
                 self._save(nodes)
             return {'name': f'op/{uuid.uuid4()}', 'done': True}
         raise TpuApiError(400, f'Fake: unsupported {method} {path}')
+
+    def _dispatch_qr(self, method: str, path: str, body: dict,
+                     params: dict, zone: str, nodes: dict) -> dict:
+        """Queued-resources surface. Fault injection (comma-separated
+        zone lists):
+
+        * ``SKYTPU_GCP_FAKE_QR_DENY`` — QR transitions to FAILED.
+        * ``SKYTPU_GCP_FAKE_QR_WAIT`` — QR stays WAITING_FOR_RESOURCES
+          forever (exercises the provision_timeout cancel path).
+        * otherwise the QR is granted: state ACTIVE + nodes created.
+        """
+        key = path.strip('/')
+        deny = os.environ.get('SKYTPU_GCP_FAKE_QR_DENY', '').split(',')
+        hold = os.environ.get('SKYTPU_GCP_FAKE_QR_WAIT', '').split(',')
+        if method == 'POST' and key.endswith('queuedResources'):
+            qr_id = params['queuedResourceId']
+            full = f'{key}/{qr_id}'
+            if zone in deny:
+                # Real-API shape: reason under state.failedData.error.
+                state = {'state': 'FAILED',
+                         'stateInitiator': 'SERVICE',
+                         'failedData': {'error': {
+                             'code': 8,
+                             'message': 'no capacity available in zone '
+                                        f'{zone}'}}}
+            elif zone in hold:
+                state = {'state': 'WAITING_FOR_RESOURCES'}
+            else:
+                state = {'state': 'ACTIVE'}
+                # Granted: materialize the requested nodes.
+                for spec in body.get('tpu', {}).get('nodeSpec', []):
+                    node_body = dict(spec.get('node', {}))
+                    node_path = f"{spec['parent']}/nodes/{spec['nodeId']}"
+                    accel = node_body.get('acceleratorType', 'v5e-8')
+                    node_body['name'] = node_path
+                    node_body['state'] = 'READY'
+                    node_body['networkEndpoints'] = \
+                        self._make_endpoints(accel)
+                    nodes[node_path] = node_body
+            qr = dict(body)
+            qr['name'] = full
+            qr['state'] = state
+            nodes[full] = qr
+            self._save(nodes)
+            return {'name': f'op/{uuid.uuid4()}', 'done': True,
+                    'response': qr}
+        if method == 'GET' and key.endswith('queuedResources'):
+            prefix = key + '/'
+            return {'queuedResources':
+                    [v for k, v in nodes.items()
+                     if k.startswith(prefix)]}
+        if method == 'GET':
+            if key not in nodes:
+                raise TpuApiError(404, f'Queued resource {key} not found')
+            return nodes[key]
+        if method == 'DELETE':
+            if nodes.pop(key, None) is None:
+                raise TpuApiError(404, f'Queued resource {key} not found')
+            self._save(nodes)
+            return {'name': f'op/{uuid.uuid4()}', 'done': True}
+        raise TpuApiError(400, f'Fake: unsupported QR {method} {path}')
 
     @staticmethod
     def _make_endpoints(accelerator_type: str) -> List[dict]:
@@ -253,6 +317,100 @@ class TpuClient:
         op = self.transport.request(
             'POST', f'{self._loc(zone)}/nodes/{node_id}:start')
         return self.wait_operation(op)
+
+    # -------------------------------------------------- queued resources
+    # Parity: the reference's DWS/capacity paths (mig_utils.py MIG +
+    # instance_utils.py:311) — for TPUs the real mechanism is the
+    # queued-resources API, how v5p slices are actually obtained when
+    # on-demand create stocks out.
+
+    def create_queued_resource(self, zone: str, qr_id: str,
+                               node_specs: List[Dict[str, Any]],
+                               valid_until_s: Optional[float] = None,
+                               spot: bool = False,
+                               reserved: bool = False) -> dict:
+        """``node_specs``: [(node_id, node_body) dicts] — ONE QR for the
+        whole gang, so multi-node clusters get an all-or-nothing grant
+        instead of holding node 0's capacity while node N queues."""
+        body: Dict[str, Any] = {
+            'tpu': {
+                'nodeSpec': [{
+                    'parent': self._loc(zone),
+                    'nodeId': spec['node_id'],
+                    'node': spec['node'],
+                } for spec in node_specs]
+            }
+        }
+        if spot:
+            body['spot'] = {}
+        elif reserved:
+            body['guaranteed'] = {'reserved': True}
+        if valid_until_s:
+            body['queueingPolicy'] = {
+                'validUntilDuration': f'{int(valid_until_s)}s'
+            }
+        return self.transport.request(
+            'POST', f'{self._loc(zone)}/queuedResources', body=body,
+            params={'queuedResourceId': qr_id})
+
+    def get_queued_resource(self, zone: str, qr_id: str) -> dict:
+        return self.transport.request(
+            'GET', f'{self._loc(zone)}/queuedResources/{qr_id}')
+
+    def list_queued_resources(self, zone: str) -> List[dict]:
+        resp = self.transport.request(
+            'GET', f'{self._loc(zone)}/queuedResources')
+        return resp.get('queuedResources', [])
+
+    def delete_queued_resource(self, zone: str, qr_id: str) -> None:
+        try:
+            op = self.transport.request(
+                'DELETE', f'{self._loc(zone)}/queuedResources/{qr_id}',
+                params={'force': 'true'})
+        except TpuApiError as exc:
+            if exc.status == 404:
+                return
+            raise
+        self.wait_operation(op)
+
+    def wait_queued_resource(self, zone: str, qr_id: str,
+                             timeout: float = 900.0) -> dict:
+        """Poll a queued resource until granted, denied, or timed out.
+
+        Terminal classification feeds the failover blocklist:
+        * ACTIVE → the slice was granted; return the QR.
+        * FAILED / SUSPENDED (stockout, quota, deadline exceeded) →
+          GcpCapacityError (zone scope).
+        * still WAITING past ``timeout`` → cancel the QR and raise
+          GcpCapacityError so the failover engine moves on — an
+          ungranted request held forever blocks the whole launch.
+        """
+        deadline = time.time() + timeout
+        backoff = 2.0
+        while True:
+            qr = self.get_queued_resource(zone, qr_id)
+            state = (qr.get('state') or {}).get('state', 'ACCEPTED')
+            if state == 'ACTIVE':
+                return qr
+            if state in ('FAILED', 'SUSPENDING', 'SUSPENDED'):
+                # The v2 API puts the denial reason in
+                # state.failedData.error (stateInitiator is just an
+                # enum of WHO moved the state).
+                st = qr.get('state') or {}
+                err = (st.get('failedData') or {}).get('error') or {}
+                detail = err.get('message') or json.dumps(st)
+                self.delete_queued_resource(zone, qr_id)
+                raise GcpCapacityError(
+                    429, f'Queued resource {qr_id} in {zone} was not '
+                    f'granted (state={state}): {detail}', qr)
+            if time.time() > deadline:
+                self.delete_queued_resource(zone, qr_id)
+                raise GcpCapacityError(
+                    429, f'Queued resource {qr_id} in {zone} not granted '
+                    f'within {int(timeout)}s (state={state}); cancelled '
+                    'and failing over.', qr)
+            time.sleep(backoff)
+            backoff = min(backoff * 1.5, 15.0)
 
     def wait_operation(self, op: dict, timeout: float = 1800.0) -> dict:
         """Poll a long-running operation (parity: instance_utils.py

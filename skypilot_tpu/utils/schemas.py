@@ -41,6 +41,8 @@ _RESOURCES_SCHEMA: Dict[str, Any] = {
                 'topology': {'type': 'string'},
                 'runtime_version': {'type': 'string'},
                 'tpu_vm': {'type': 'boolean'},
+                'queued_resources': {'type': 'boolean'},
+                'provision_timeout': {'type': 'integer'},
             },
         },
         'use_spot': {'type': ['boolean', 'null']},
@@ -178,6 +180,9 @@ _CONFIG_SCHEMA: Dict[str, Any] = {
                 'project_id': {'type': 'string'},
                 'specific_reservations': {'type': 'array',
                                           'items': {'type': 'string'}},
+                # TPU queued-resources (DWS-style) capacity requests.
+                'use_queued_resources': {'type': 'boolean'},
+                'provision_timeout': {'type': 'integer'},
             },
         },
         'r2': {

@@ -1,0 +1,147 @@
+"""GCP queued-resources (DWS-style) capacity path.
+
+Parity intent: sky/provision/gcp/mig_utils.py (DWS MIG) +
+instance_utils.py:311 — for TPUs the real mechanism is the
+queued-resources API: request capacity, poll until granted, classify
+denial/timeout as GcpCapacityError so the failover engine blocklists the
+zone and walks on.
+"""
+import pytest
+
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.gcp import instance as gcp_instance
+from skypilot_tpu.provision.gcp import tpu_api
+
+
+@pytest.fixture(autouse=True)
+def fake_gcp(monkeypatch):
+    monkeypatch.setenv('SKYTPU_GCP_FAKE', '1')
+    monkeypatch.setenv('GOOGLE_CLOUD_PROJECT', 'proj-test')
+    tpu_api.FakeTpuService._nodes = {}  # pylint: disable=protected-access
+    yield
+    tpu_api.FakeTpuService._nodes = {}  # pylint: disable=protected-access
+
+
+def _config(count=1, timeout=1.0):
+    return provision_common.ProvisionConfig(
+        provider_config={'region': 'us-east5',
+                         'availability_zone': 'us-east5-b',
+                         'ssh_user': 'skytpu'},
+        authentication_config={'ssh_keys': 'skytpu:ssh-ed25519 AAAA'},
+        docker_config={},
+        node_config={'accelerator_type': 'v5p-16',
+                     'runtime_version': 'tpu-ubuntu2204-base',
+                     'use_queued_resources': True,
+                     'provision_timeout': timeout},
+        count=count,
+        tags={},
+        resume_stopped_nodes=True,
+    )
+
+
+def test_qr_granted_creates_ready_nodes():
+    record = gcp_instance.run_instances('us-east5', 'qrc', _config())
+    assert record.created_instance_ids == ['qrc-0']
+    info = gcp_instance.get_cluster_info(
+        'us-east5', 'qrc', _config().provider_config)
+    # v5p-16 = 8 chips = 2 hosts.
+    assert len(info.ordered_host_meta()) == 2
+    # The QR record exists (unique per-attempt id, cluster prefix) and
+    # is ACTIVE.
+    client = tpu_api.TpuClient('proj-test')
+    qrs = client.list_queued_resources('us-east5-b')
+    assert len(qrs) == 1
+    assert qrs[0]['name'].split('/')[-1].startswith('qrc-qr-')
+    assert qrs[0]['state']['state'] == 'ACTIVE'
+
+
+def test_qr_multinode_single_gang_request():
+    """count=2 submits ONE multi-nodeSpec QR (all-or-nothing grant),
+    not two sequential per-node QRs."""
+    record = gcp_instance.run_instances('us-east5', 'qrm',
+                                        _config(count=2))
+    assert record.created_instance_ids == ['qrm-0', 'qrm-1']
+    client = tpu_api.TpuClient('proj-test')
+    qrs = client.list_queued_resources('us-east5-b')
+    assert len(qrs) == 1
+    specs = qrs[0]['tpu']['nodeSpec']
+    assert [s['nodeId'] for s in specs] == ['qrm-0', 'qrm-1']
+    assert len(client.list_nodes('us-east5-b')) == 2
+
+
+def test_qr_denied_raises_capacity_error(monkeypatch):
+    monkeypatch.setenv('SKYTPU_GCP_FAKE_QR_DENY', 'us-east5-b')
+    with pytest.raises(tpu_api.GcpCapacityError) as err:
+        gcp_instance.run_instances('us-east5', 'qrd', _config())
+    assert err.value.scope == 'zone'
+    assert 'not granted' in str(err.value)
+    # The failed QR was cancelled — nothing left queued.
+    client = tpu_api.TpuClient('proj-test')
+    assert client.list_queued_resources('us-east5-b') == []
+
+
+def test_qr_timeout_cancels_and_raises_capacity_error(monkeypatch):
+    monkeypatch.setenv('SKYTPU_GCP_FAKE_QR_WAIT', 'us-east5-b')
+    with pytest.raises(tpu_api.GcpCapacityError) as err:
+        gcp_instance.run_instances('us-east5', 'qrw',
+                                   _config(timeout=0.05))
+    assert 'not granted within' in str(err.value)
+    client = tpu_api.TpuClient('proj-test')
+    assert client.list_queued_resources('us-east5-b') == []
+
+
+def test_qr_teardown_cancels_queued_record():
+    gcp_instance.run_instances('us-east5', 'qrt', _config())
+    gcp_instance.terminate_instances('qrt', _config().provider_config)
+    client = tpu_api.TpuClient('proj-test')
+    assert client.list_queued_resources('us-east5-b') == []
+    assert client.list_nodes('us-east5-b') == []
+
+
+def test_qr_teardown_cancels_pending_request_without_nodes(monkeypatch):
+    """A WAITING QR whose nodes never materialized (crash between
+    submit and grant) is still cancelled by teardown — otherwise a
+    later grant creates an orphan, billed slice."""
+    client = tpu_api.TpuClient('proj-test')
+    monkeypatch.setenv('SKYTPU_GCP_FAKE_QR_WAIT', 'us-east5-b')
+    client.create_queued_resource(
+        'us-east5-b', 'qrp-qr-deadbeef',
+        [{'node_id': 'qrp-0',
+          'node': {'acceleratorType': 'v5p-16'}}])
+    assert client.list_nodes('us-east5-b') == []
+    gcp_instance.terminate_instances('qrp', _config().provider_config)
+    assert client.list_queued_resources('us-east5-b') == []
+
+
+def test_qr_denial_feeds_failover_blocklist(monkeypatch):
+    """A QR denial classifies as zone-scope capacity for the failover
+    engine (gang_backend.FailoverCloudErrorHandler)."""
+    from skypilot_tpu.backends import gang_backend
+    monkeypatch.setenv('SKYTPU_GCP_FAKE_QR_DENY', 'us-east5-b')
+    try:
+        gcp_instance.run_instances('us-east5', 'qrf', _config())
+        raise AssertionError('expected GcpCapacityError')
+    except tpu_api.GcpCapacityError as exc:
+        h = gang_backend.FailoverCloudErrorHandler
+        assert h.classify(exc) == h.ZONE
+
+
+def test_deploy_vars_surface_qr_knobs(monkeypatch):
+    """Resources(accelerator_args={'queued_resources': ..}) reaches the
+    provisioner's node_config; config fallback applies otherwise."""
+    import skypilot_tpu as sky
+    res = sky.Resources(cloud='gcp', accelerators='tpu-v5p:8',
+                        instance_type='TPU-VM',
+                        accelerator_args={'queued_resources': True,
+                                          'provision_timeout': 300})
+    from skypilot_tpu.backends import backend_utils
+    cfg = backend_utils.make_provision_config(res, 1, 'qv', 'us-east5',
+                                              'us-east5-b')
+    assert cfg.node_config['use_queued_resources'] is True
+    assert cfg.node_config['provision_timeout'] == 300
+    res2 = sky.Resources(cloud='gcp', accelerators='tpu-v5p:8',
+                         instance_type='TPU-VM')
+    cfg2 = backend_utils.make_provision_config(res2, 1, 'qv2', 'us-east5',
+                                               'us-east5-b')
+    assert cfg2.node_config['use_queued_resources'] is False
+    assert cfg2.node_config['provision_timeout'] == 900
