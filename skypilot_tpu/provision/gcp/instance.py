@@ -119,7 +119,12 @@ def run_instances(region: str, cluster_name_on_cloud: str,
                 **body.get('schedulingConfig', {}), 'reserved': True
             }
         if node_cfg.get('use_queued_resources'):
-            to_create.append({'node_id': name, 'node': body})
+            # The capacity tier (spot/guaranteed) is expressed at the
+            # QR level, not per node — a node-level schedulingConfig
+            # alongside it is contradictory and 400s on the real API.
+            qr_body = {k: v for k, v in body.items()
+                       if k != 'schedulingConfig'}
+            to_create.append({'node_id': name, 'node': qr_body})
         else:
             logger.debug(f'Creating TPU node {name} in {zone}: '
                          f'{node_cfg["accelerator_type"]}')
@@ -289,14 +294,18 @@ def terminate_instances(cluster_name_on_cloud: str,
             continue
         client.delete_node(zone, name)
     if not worker_only:
-        # Sweep the cluster's queued-resource records by id prefix —
-        # including STILL-PENDING requests whose nodes never
-        # materialized (a grant racing teardown would otherwise create
-        # an orphan, billed slice).
-        prefix = _qr_prefix(cluster_name_on_cloud)
+        # Sweep the cluster's queued-resource records — including
+        # STILL-PENDING requests whose nodes never materialized (a
+        # grant racing teardown would otherwise create an orphan,
+        # billed slice). Ids match '<cluster>-qr-<8 hex>' EXACTLY so a
+        # sibling cluster literally named '<cluster>-qr' can't be swept.
+        import re
+        pattern = re.compile(
+            re.escape(_qr_prefix(cluster_name_on_cloud)) +
+            r'[0-9a-f]{8}$')
         for qr in client.list_queued_resources(zone):
             qr_id = qr.get('name', '').split('/')[-1]
-            if qr_id.startswith(prefix):
+            if pattern.fullmatch(qr_id):
                 client.delete_queued_resource(zone, qr_id)
 
 

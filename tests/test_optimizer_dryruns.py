@@ -136,3 +136,52 @@ def test_provisionerless_cloud_rejected_cleanly(all_clouds, monkeypatch):
                        match='no instance provisioner'):
         sky.launch(task, cluster_name='az-real', stream_logs=False)
     assert gs.get_cluster_from_name('az-real') is None
+
+
+def test_catalog_breadth_v5p_vs_h100_tokens_per_dollar(all_clouds):
+    """VERDICT-r3 item 5: the 'TPU vs GPU tokens/$' comparison the
+    project exists for must be computable from the bundled catalogs —
+    current H100/H200/A100 SKUs across several clouds, all TPU gens, and
+    >= 1000 total rows."""
+    import glob
+    import os
+
+    from skypilot_tpu import catalog
+
+    data_dir = os.path.join(os.path.dirname(catalog.__file__), 'data')
+    total = 0
+    for path in glob.glob(os.path.join(data_dir, '*.csv')):
+        with open(path, encoding='utf-8') as f:
+            total += sum(1 for _ in f) - 1
+    assert total >= 1000, f'catalog has only {total} rows'
+
+    # Every TPU generation is priced (on-demand + spot) in at least
+    # one region.
+    gen_regions = {'v2': 'us-central1', 'v3': 'us-central1',
+                   'v4': 'us-central2', 'v5e': 'us-central1',
+                   'v5p': 'us-east5', 'v6e': 'us-east5'}
+    for gen, region in gen_regions.items():
+        od = catalog.tpu_price_per_chip_hour(gen, region, use_spot=False)
+        sp = catalog.tpu_price_per_chip_hour(gen, region, use_spot=True)
+        assert od and sp and sp < od, (gen, od, sp)
+
+    # H100 rows exist across multiple clouds; H200 exists somewhere.
+    accels = catalog.list_accelerators(gpus_only=True)
+    h100_clouds = {i.cloud for i in accels.get('H100', [])}
+    assert len(h100_clouds) >= 3, h100_clouds
+    assert accels.get('H200'), 'no H200 rows'
+
+    # The ranking itself: flops/$ for a v5p chip vs an H100 GPU —
+    # both sides computable from catalog prices alone.
+    v5p_price = catalog.tpu_price_per_chip_hour('v5p', 'us-east5',
+                                                use_spot=False)
+    # vsphere's on-prem rows are $0 (no cloud bill) — exclude them from
+    # the market-price comparison.
+    h100_per_gpu = min(i.price / (i.accelerator_count or 1)
+                       for i in accels['H100'] if i.price > 0)
+    v5p_flops_per_dollar = 459e12 / v5p_price
+    h100_flops_per_dollar = 989e12 / h100_per_gpu
+    ranking = sorted([('tpu-v5p', v5p_flops_per_dollar),
+                      ('H100', h100_flops_per_dollar)],
+                     key=lambda kv: -kv[1])
+    assert all(v > 0 for _, v in ranking)
