@@ -181,7 +181,8 @@ def test_catalog_breadth_v5p_vs_h100_tokens_per_dollar(all_clouds):
                        for i in accels['H100'] if i.price > 0)
     v5p_flops_per_dollar = 459e12 / v5p_price
     h100_flops_per_dollar = 989e12 / h100_per_gpu
-    ranking = sorted([('tpu-v5p', v5p_flops_per_dollar),
-                      ('H100', h100_flops_per_dollar)],
-                     key=lambda kv: -kv[1])
-    assert all(v > 0 for _, v in ranking)
+    # Sanity bounds: the two sides are within 100× of each other (a
+    # broken price scale — cents vs dollars, per-chip vs per-VM —
+    # would blow way past this) and v5p list price stays competitive.
+    ratio = v5p_flops_per_dollar / h100_flops_per_dollar
+    assert 0.01 < ratio < 100, ratio
