@@ -1,19 +1,26 @@
-"""Per-service controller process: autoscaler + prober + load balancer.
+"""Per-service controller process: autoscaler + prober + LB supervision.
 
-Parity: ``sky/serve/controller.py`` (SkyServeController:36) + ``service.py``
-_start — the reference spawns controller and load-balancer as separate
-processes on a controller VM and syncs them over HTTP; here both run in one
-detached process (LB in a thread), sharing the replica set and request
-timestamps in-proc. Recovery/scaling semantics are unchanged.
+Parity: ``sky/serve/controller.py`` (SkyServeController:36) +
+``service.py:139`` _start — controller and load balancer are SEPARATE
+processes, synced over HTTP: the controller runs a tiny /sync endpoint
+(ready replica set out, request timestamps in) and spawns/monitors/
+restarts the LB subprocess. A busy service's proxy traffic never
+contends with control-loop ticks for this process's GIL.
 """
 import argparse
+import http.server
+import json
 import os
+import subprocess
+import sys
+import threading
 import time
 import traceback
+from collections import deque
+from typing import Deque, List, Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.serve import autoscalers as autoscalers_lib
-from skypilot_tpu.serve import load_balancer as lb_lib
 from skypilot_tpu.serve import replica_managers
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
@@ -26,6 +33,60 @@ def controller_interval_seconds() -> float:
     return float(os.environ.get('SKYTPU_SERVE_CONTROLLER_INTERVAL', '10'))
 
 
+class _LbSyncServer:
+    """The controller half of the LB↔controller sync protocol.
+
+    POST /sync {"request_timestamps": [...]} →
+        {"ready_urls": [...]}  (parity: load_balancer.py:73)
+    """
+
+    def __init__(self, get_ready_urls):
+        self._get_ready_urls = get_ready_urls
+        self._ts_lock = threading.Lock()
+        self._timestamps: Deque[float] = deque(maxlen=100_000)
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+
+            def do_POST(self):  # noqa: N802
+                if self.path != '/sync':
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get('Content-Length', '0'))
+                try:
+                    body = json.loads(self.rfile.read(length) or b'{}')
+                except json.JSONDecodeError:
+                    body = {}
+                with outer._ts_lock:
+                    outer._timestamps.extend(
+                        body.get('request_timestamps', []))
+                payload = json.dumps(
+                    {'ready_urls': outer._get_ready_urls()}).encode()
+                self.send_response(200)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *args):
+                pass
+
+        self._server = http.server.ThreadingHTTPServer(('127.0.0.1', 0),
+                                                       Handler)
+        self.port = self._server.server_port
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True,
+                                        name='skytpu-lb-sync')
+        self._thread.start()
+
+    def snapshot_request_timestamps(self) -> List[float]:
+        with self._ts_lock:
+            return list(self._timestamps)
+
+    def close(self) -> None:
+        self._server.shutdown()
+
+
 class SkyServeController:
     """Drives one service until shutdown."""
 
@@ -35,16 +96,58 @@ class SkyServeController:
         self.service_name = service_name
         self.spec = spec_lib.SkyServiceSpec.from_yaml_config(svc['spec'])
         self.version = svc.get('version', 1) or 1
+        self.lb_port = svc['lb_port']
         self.replica_manager = replica_managers.ReplicaManager(
             service_name, self.spec, svc['task_yaml_path'],
             version=self.version)
         self.autoscaler = autoscalers_lib.Autoscaler.make(self.spec)
-        self.load_balancer = lb_lib.LoadBalancer(
-            svc['lb_port'], self.spec.load_balancing_policy,
-            get_ready_urls=self.replica_manager.ready_urls)
+        self._sync = _LbSyncServer(self.replica_manager.ready_urls)
+        self._lb_proc: Optional[subprocess.Popen] = None
+
+    # ------------------------------------------------------ LB subprocess
+
+    def _lb_log_path(self) -> str:
+        d = os.path.join(os.path.expanduser('~'), '.skytpu', 'serve',
+                         self.service_name)
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, 'load_balancer.log')
+
+    def _spawn_lb(self) -> None:
+        with open(self._lb_log_path(), 'ab') as log_f:
+            self._lb_proc = subprocess.Popen(
+                [sys.executable, '-u', '-m',
+                 'skypilot_tpu.serve.load_balancer',
+                 '--port', str(self.lb_port),
+                 '--policy', self.spec.load_balancing_policy,
+                 '--controller-url',
+                 f'http://127.0.0.1:{self._sync.port}'],
+                stdout=log_f, stderr=subprocess.STDOUT,
+                stdin=subprocess.DEVNULL, start_new_session=True)
+        logger.info(f'Load balancer subprocess pid='
+                    f'{self._lb_proc.pid} on :{self.lb_port}.')
+
+    def _ensure_lb_alive(self) -> None:
+        """Restart a dead LB (crash/OOM/kill) — replica serving must
+        survive proxy death without operator action."""
+        if self._lb_proc is None or self._lb_proc.poll() is not None:
+            if self._lb_proc is not None:
+                logger.warning(
+                    f'Load balancer exited rc={self._lb_proc.poll()}; '
+                    'restarting.')
+                # The old LB's socket may linger briefly; the new one
+                # retries bind via SO_REUSEADDR in aiohttp.
+            self._spawn_lb()
+
+    def _stop_lb(self) -> None:
+        if self._lb_proc is not None and self._lb_proc.poll() is None:
+            self._lb_proc.terminate()
+            try:
+                self._lb_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._lb_proc.kill()
 
     def run(self) -> None:
-        self.load_balancer.start()
+        self._spawn_lb()
         serve_state.set_service_status(self.service_name,
                                        ServiceStatus.REPLICA_INIT)
         interval = controller_interval_seconds()
@@ -56,12 +159,14 @@ class SkyServeController:
                                                ServiceStatus.SHUTDOWN)
                 break
             try:
+                self._ensure_lb_alive()
                 self._tick()
             except Exception:  # pylint: disable=broad-except
                 logger.error(f'Controller tick failed: '
                              f'{traceback.format_exc()}')
             time.sleep(interval)
-        self.load_balancer.stop()
+        self._stop_lb()
+        self._sync.close()
 
     def _tick(self) -> None:
         rm = self.replica_manager
@@ -75,7 +180,7 @@ class SkyServeController:
             sum(1 for r in default_pool
                 if r['status'] == ReplicaStatus.READY),
             sum(1 for r in default_pool if r['status'].is_alive()),
-            self.load_balancer.snapshot_request_timestamps())
+            self._sync.snapshot_request_timestamps())
         rm.scale_to(plan)
         rm.rolling_update_tick(plan)
         self._update_service_status()

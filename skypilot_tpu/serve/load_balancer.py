@@ -1,12 +1,20 @@
 """HTTP load balancer: async reverse proxy over ready replicas.
 
-Parity: ``sky/serve/load_balancer.py`` (SkyServeLoadBalancer:22) — the
-reference is a FastAPI+httpx proxy that syncs the replica set from the
-controller and reports QPS back; here the LB runs in the controller process
-(aiohttp server in a thread), reads the ready set via a shared callback, and
-records request timestamps the autoscaler consumes directly.
+Parity: ``sky/serve/load_balancer.py`` (SkyServeLoadBalancer:22,
+``_sync_with_controller:73``) — like the reference, the LB is its OWN
+process (``python -m skypilot_tpu.serve.load_balancer``): one busy
+service's proxy traffic must not contend with controller ticks for a
+GIL. It syncs with the controller over HTTP: every sync it reports the
+request timestamps observed since the last one and receives the current
+ready-replica set. The controller spawns, monitors, and restarts it
+(serve/controller.py).
+
+An in-process mode (``get_ready_urls`` callback) remains for unit tests
+of the proxy itself.
 """
+import argparse
 import asyncio
+import json
 import threading
 import time
 from collections import deque
@@ -32,17 +40,29 @@ _HOP_HEADERS = {
 }
 
 
+def lb_sync_interval_seconds() -> float:
+    import os
+    return float(os.environ.get('SKYTPU_SERVE_LB_SYNC_INTERVAL', '2'))
+
+
 class LoadBalancer:
-    """aiohttp reverse proxy with a pluggable policy."""
+    """aiohttp reverse proxy with a pluggable policy.
+
+    Ready replicas come from ``get_ready_urls`` (in-proc mode) or from
+    controller syncs (``controller_url`` mode — the production path).
+    """
 
     def __init__(self, port: int, policy_name: str,
-                 get_ready_urls: Callable[[], List[str]]):
+                 get_ready_urls: Optional[Callable[[], List[str]]] = None,
+                 controller_url: Optional[str] = None):
         self.port = port
         self.policy = lb_policies.LoadBalancingPolicy.make(policy_name)
         self._get_ready_urls = get_ready_urls
+        self._controller_url = controller_url
+        self._synced_urls: List[str] = []
         # Request arrival timestamps for the autoscaler (QPS window).
-        # Guarded by a lock: the aiohttp thread appends while the
-        # controller thread snapshots.
+        # Guarded by a lock: the aiohttp thread appends while another
+        # thread (in-proc mode) or the sync task snapshots.
         self._ts_lock = threading.Lock()
         self._request_timestamps: Deque[float] = deque(maxlen=100_000)
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -52,6 +72,7 @@ class LoadBalancer:
     # ---------------------------------------------------------- lifecycle
 
     def start(self) -> None:
+        """In-proc mode: run the proxy in a daemon thread (tests)."""
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name='skytpu-lb')
         self._thread.start()
@@ -64,16 +85,22 @@ class LoadBalancer:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
-    def _run(self) -> None:
+    def run_forever(self) -> None:
+        """Standalone mode: proxy + controller sync in the main thread."""
         self._loop = asyncio.new_event_loop()
         asyncio.set_event_loop(self._loop)
         self._loop.run_until_complete(self._setup())
         self._started.set()
+        if self._controller_url:
+            self._loop.create_task(self._sync_loop())
         try:
             self._loop.run_forever()
         finally:
             self._loop.run_until_complete(self._teardown())
             self._loop.close()
+
+    def _run(self) -> None:
+        self.run_forever()
 
     async def _setup(self) -> None:
         # No total timeout: LLM generations stream for minutes; stalls are
@@ -93,47 +120,174 @@ class LoadBalancer:
         await self._session.close()
         await self._runner.cleanup()
 
+    # ---------------------------------------------------- controller sync
+
+    async def _sync_once(self) -> bool:
+        """One controller round-trip. Returns success.
+
+        Timestamps are fire-and-forget: a lost RESPONSE after the
+        controller consumed the POST would double-count requests on a
+        requeue, inflating QPS and upscaling for nothing — dropping the
+        occasional batch only under-counts briefly.
+        """
+        with self._ts_lock:
+            fresh = list(self._request_timestamps)
+            self._request_timestamps.clear()
+        try:
+            async with self._session.post(
+                    f'{self._controller_url}/sync',
+                    json={'request_timestamps': fresh},
+                    timeout=aiohttp.ClientTimeout(total=10)) as resp:
+                body = await resp.json()
+            self._synced_urls = list(body.get('ready_urls', []))
+            return True
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                json.JSONDecodeError) as e:
+            logger.warning(f'Controller sync failed: {e}')
+            return False
+
+    async def _sync_loop(self) -> None:
+        """Report fresh request timestamps; receive the ready set.
+
+        Parity: load_balancer.py:73 _sync_with_controller. A briefly
+        unreachable controller → keep serving the last-known replica set
+        (a controller restart must not black-hole live replicas). A
+        controller gone past SKYTPU_SERVE_LB_ORPHAN_TIMEOUT (120 s) →
+        exit: nothing will ever refresh the replica set again, and an
+        orphaned LB would hold the service port forever (the controller
+        that spawned this process is also the only thing supervising
+        it).
+        """
+        import os
+        interval = lb_sync_interval_seconds()
+        orphan_timeout = float(
+            os.environ.get('SKYTPU_SERVE_LB_ORPHAN_TIMEOUT', '120'))
+        last_ok = time.time()
+        while True:
+            if await self._sync_once():
+                last_ok = time.time()
+            elif time.time() - last_ok > orphan_timeout:
+                logger.error(
+                    f'Controller unreachable for {int(orphan_timeout)}s '
+                    '— orphaned; exiting to release the port.')
+                # Hard exit: a SystemExit inside an asyncio task would
+                # only kill the task, not the process.
+                os._exit(1)
+            await asyncio.sleep(interval)
+
     # ------------------------------------------------------------- proxy
 
     def snapshot_request_timestamps(self) -> list:
         with self._ts_lock:
             return list(self._request_timestamps)
 
+    def _ready_urls(self) -> List[str]:
+        if self._get_ready_urls is not None:
+            return self._get_ready_urls()
+        return self._synced_urls
+
     async def _handle(self, request: web.Request) -> web.StreamResponse:
         with self._ts_lock:
             self._request_timestamps.append(time.time())
-        self.policy.set_ready_replicas(self._get_ready_urls())
+        self.policy.set_ready_replicas(self._ready_urls())
         url = self.policy.select_replica()
+        if url is None and self._controller_url is not None:
+            # Empty ready set: sync on demand before 503ing — bounds
+            # first-request latency after startup or a replica-set flip
+            # to a controller round-trip instead of a full sync
+            # interval. One brief retry absorbs the READY-in-sqlite →
+            # sync-visible race.
+            for _ in range(2):
+                await self._sync_once()
+                self.policy.set_ready_replicas(self._ready_urls())
+                url = self.policy.select_replica()
+                if url is not None:
+                    break
+                await asyncio.sleep(0.2)
         if url is None:
             return web.Response(
                 status=503,
                 text='No ready replicas. Use `sky serve status` to check '
                      'the service.')
-        target = url.rstrip('/') + '/' + request.match_info['tail']
-        if request.query_string:
-            target += '?' + request.query_string
-        self.policy.request_started(url)
-        try:
-            body = await request.read()
-            headers = {k: v for k, v in request.headers.items()
-                       if k.lower() not in _HOP_HEADERS}
-            async with self._session.request(request.method, target,
-                                             headers=headers,
-                                             data=body) as resp:
-                out_headers = {k: v for k, v in resp.headers.items()
-                               if k.lower() not in _HOP_HEADERS}
-                # Stream chunk-by-chunk: token streams (SSE/chunked LLM
-                # responses) must reach the client as they are produced,
-                # not after the replica finishes.
-                out = web.StreamResponse(status=resp.status,
-                                         headers=out_headers)
-                await out.prepare(request)
-                async for chunk in resp.content.iter_chunked(64 * 1024):
-                    await out.write(chunk)
-                await out.write_eof()
-                return out
-        except aiohttp.ClientError as e:
-            return web.Response(status=502,
-                                text=f'Replica request failed: {e}')
-        finally:
-            self.policy.request_finished(url)
+        body = await request.read()
+        headers = {k: v for k, v in request.headers.items()
+                   if k.lower() not in _HOP_HEADERS}
+        last_err: Optional[Exception] = None
+        tried = set()
+        # Connect-level failures retry ONCE against a freshly-synced
+        # replica set: a rolling update / preemption can kill a replica
+        # inside the sync window, and its requests should fail over,
+        # not 502. Errors after bytes flowed are NOT retried (the
+        # request may not be idempotent mid-stream).
+        for attempt in range(2):
+            if url is None or url in tried:
+                break
+            current = url
+            tried.add(current)
+            target = (current.rstrip('/') + '/' +
+                      request.match_info['tail'])
+            if request.query_string:
+                target += '?' + request.query_string
+            self.policy.request_started(current)
+            out: Optional[web.StreamResponse] = None
+            try:
+                async with self._session.request(request.method, target,
+                                                 headers=headers,
+                                                 data=body) as resp:
+                    out_headers = {k: v for k, v in resp.headers.items()
+                                   if k.lower() not in _HOP_HEADERS}
+                    # Stream chunk-by-chunk: token streams (SSE/chunked
+                    # LLM responses) must reach the client as they are
+                    # produced, not after the replica finishes.
+                    out = web.StreamResponse(status=resp.status,
+                                             headers=out_headers)
+                    await out.prepare(request)
+                    async for chunk in resp.content.iter_chunked(
+                            64 * 1024):
+                        await out.write(chunk)
+                    await out.write_eof()
+                    return out
+            except (aiohttp.ClientConnectorError,
+                    aiohttp.ServerDisconnectedError) as e:
+                if out is not None:
+                    # Headers already went out: terminate the stream
+                    # hard (force_close drops keep-alive so the client
+                    # sees truncation, not a clean end); a second
+                    # response on the same request is impossible.
+                    out.force_close()
+                    return out
+                last_err = e
+                if self._controller_url is not None:
+                    await self._sync_once()
+                # Pick a DIFFERENT replica from a local candidate list —
+                # rewriting the shared policy's ready set here would
+                # reset its in-flight accounting mid-traffic.
+                candidates = [u for u in self._ready_urls()
+                              if u not in tried]
+                url = candidates[0] if candidates else None
+                continue
+            except aiohttp.ClientError as e:
+                if out is not None:
+                    out.force_close()
+                    return out
+                last_err = e
+                break
+            finally:
+                self.policy.request_finished(current)
+        return web.Response(status=502,
+                            text=f'Replica request failed: {last_err}')
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--port', type=int, required=True)
+    parser.add_argument('--policy', default='least_load')
+    parser.add_argument('--controller-url', required=True)
+    args = parser.parse_args()
+    lb = LoadBalancer(args.port, args.policy,
+                      controller_url=args.controller_url)
+    lb.run_forever()
+
+
+if __name__ == '__main__':
+    main()

@@ -4,6 +4,8 @@ E2e replicas are real launched clusters running ``python3 -m http.server``
 on the injected ``$SKYTPU_REPLICA_PORT`` — the full controller → replica
 manager → prober → load balancer path, no mocks.
 """
+import os
+import signal
 import time
 
 import pytest
@@ -109,6 +111,7 @@ def test_least_load_policy():
 def serve_env(monkeypatch):
     global_state.set_enabled_clouds(['Local'])
     monkeypatch.setenv('SKYTPU_SERVE_CONTROLLER_INTERVAL', '0.5')
+    monkeypatch.setenv('SKYTPU_SERVE_LB_SYNC_INTERVAL', '0.5')
     monkeypatch.setenv('SKYTPU_SERVE_QPS_WINDOW', '5')
     monkeypatch.setenv('SKYTPU_SERVE_UPSCALE_DELAY', '0.5')
     monkeypatch.setenv('SKYTPU_SERVE_DOWNSCALE_DELAY', '60')
@@ -330,3 +333,85 @@ def test_serve_rolling_update(serve_env, tmp_path):
     resp = requests.get(recs[0]['endpoint'] + '/', timeout=10)
     assert resp.status_code == 200
     sky.serve.down('svc-roll')
+
+
+def test_serve_lb_process_isolation_and_recovery(serve_env):
+    """VERDICT-r3 item 7: the LB runs as its OWN process (parity:
+    sky/serve/service.py:139); killing it must not take the service
+    down — the controller respawns it and traffic resumes."""
+    task = _http_service_task('svc-lbkill')
+    info = sky.serve.up(task)
+    _wait_ready('svc-lbkill')
+    resp = requests.get(info['endpoint'] + '/', timeout=10)
+    assert resp.status_code == 200
+
+    # The LB is a separate process: find it (its argv names the module
+    # and the public port) and SIGKILL it.
+    import subprocess as sp
+    out = sp.run(['pgrep', '-f',
+                  f'skypilot_tpu.serve.load_balancer --port '
+                  f'{info["endpoint"].rsplit(":", 1)[1]}'],
+                 capture_output=True, text=True, check=False)
+    pids = [int(p) for p in out.stdout.split()]
+    assert pids, 'LB subprocess not found — is it running in-process?'
+    for pid in pids:
+        os.kill(pid, signal.SIGKILL)
+
+    # Controller notices within a tick and respawns; traffic resumes.
+    deadline = time.time() + 30
+    ok = False
+    while time.time() < deadline:
+        try:
+            if requests.get(info['endpoint'] + '/',
+                            timeout=5).status_code == 200:
+                ok = True
+                break
+        except requests.RequestException:
+            pass
+        time.sleep(0.5)
+    assert ok, 'LB did not recover after SIGKILL'
+    sky.serve.down('svc-lbkill')
+
+
+def test_lb_inproc_proxy_unit():
+    """In-process LB mode (get_ready_urls callback): unit-tests the
+    proxy itself — selection, forwarding, 503-on-empty — without a
+    controller or subprocesses."""
+    import http.server
+    import threading
+
+    from skypilot_tpu.serve import load_balancer as lb_lib
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+
+        def do_GET(self):
+            body = b'replica-ok'
+            self.send_response(200)
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    backend = http.server.ThreadingHTTPServer(('127.0.0.1', 0), Handler)
+    threading.Thread(target=backend.serve_forever, daemon=True).start()
+    ready = [f'http://127.0.0.1:{backend.server_port}']
+
+    import socket as socket_lib
+    with socket_lib.socket() as s:
+        s.bind(('', 0))
+        lb_port = s.getsockname()[1]
+    lb = lb_lib.LoadBalancer(lb_port, 'round_robin',
+                             get_ready_urls=lambda: list(ready))
+    lb.start()
+    try:
+        resp = requests.get(f'http://127.0.0.1:{lb_port}/x', timeout=10)
+        assert resp.status_code == 200 and resp.text == 'replica-ok'
+        assert len(lb.snapshot_request_timestamps()) == 1
+        ready.clear()
+        resp = requests.get(f'http://127.0.0.1:{lb_port}/x', timeout=10)
+        assert resp.status_code == 503
+    finally:
+        lb.stop()
+        backend.shutdown()
