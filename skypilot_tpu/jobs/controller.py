@@ -106,20 +106,25 @@ class JobsController:
                 return True
             if status in (job_lib.JobStatus.FAILED,
                           job_lib.JobStatus.FAILED_SETUP):
+                if strategy.cluster_degraded():
+                    # A FAILED job on a degraded cluster is slice/host
+                    # death (fate-sharing killed the gang), NOT user
+                    # code: whole-job preemption recovery, no restart
+                    # budget consumed.
+                    self._recover(strategy, task_id,
+                                  'job failed with a degraded cluster — '
+                                  'treating as slice preemption')
+                    continue
                 # User-code failure: recovery will not help (parity:
                 # max_restarts_on_errors budget).
                 if (strategy.restart_cnt_on_failure <
                         strategy.max_restarts_on_errors):
                     strategy.restart_cnt_on_failure += 1
-                    logger.info(
-                        f'Task {task_id}: user failure, restart '
+                    self._recover(
+                        strategy, task_id,
+                        f'user failure, restart '
                         f'{strategy.restart_cnt_on_failure}/'
-                        f'{strategy.max_restarts_on_errors}.')
-                    state.set_recovering(job_id, task_id)
-                    recovered = strategy.recover()
-                    if recovered is None:  # cancelled mid-recovery
-                        continue
-                    state.set_recovered(job_id, task_id, recovered)
+                        f'{strategy.max_restarts_on_errors}')
                     continue
                 failure = (state.ManagedJobStatus.FAILED_SETUP
                            if status == job_lib.JobStatus.FAILED_SETUP else
@@ -136,16 +141,22 @@ class JobsController:
                 strategy.cleanup_cluster()
                 return False
             if status is None:
-                # Cluster gone or unreachable ⇒ preemption.
-                logger.info(f'Task {task_id}: cluster preempted/unreachable;'
-                            ' recovering.')
-                state.set_recovering(job_id, task_id)
-                recovered = strategy.recover()
-                if recovered is None:  # cancelled mid-recovery
-                    continue
-                state.set_recovered(job_id, task_id, recovered)
+                self._recover(strategy, task_id,
+                              'cluster preempted/unreachable')
                 continue
             time.sleep(poll_interval_seconds())
+
+    def _recover(self, strategy, task_id: int, reason: str) -> None:
+        """One recovery round: RECOVERING → relaunch → RECOVERED.
+
+        A cancel mid-recovery leaves the task RECOVERING; the main loop's
+        next iteration observes the cancel flag and finishes the job.
+        """
+        logger.info(f'Task {task_id}: {reason}; recovering.')
+        state.set_recovering(self.job_id, task_id)
+        recovered = strategy.recover()
+        if recovered is not None:
+            state.set_recovered(self.job_id, task_id, recovered)
 
 
 def main() -> None:

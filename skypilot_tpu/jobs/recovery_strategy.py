@@ -104,6 +104,46 @@ class StrategyExecutor:
         latest = max(jobs, key=lambda j: j['job_id'])
         return job_lib.JobStatus(latest['status'])
 
+    def cluster_degraded(self) -> bool:
+        """Is the task cluster less than fully UP?
+
+        Disambiguates a FAILED job: one slice's hosts dying SIGKILLs its
+        ranks, and gang fate-sharing then fails the whole job — which
+        looks exactly like a user-code failure from the job queue. The
+        health probe (backend_utils.refresh_cluster_record) sees the
+        dead skylet behind the cloud's 'running' state and degrades
+        UP → INIT; a FAILED job on a degraded cluster is slice death ⇒
+        preemption recovery, no restart budget consumed (parity: the
+        reference classifies via _update_cluster_status before blaming
+        user code).
+
+        Classification errs toward USER failure (bounded restarts): we
+        only reach here after job_status() succeeded — the head is
+        reachable — so a probe that errors out signals broken probe
+        infrastructure, not slice death; calling that 'degraded' would
+        recover a deterministic crash forever with no budget. A stale
+        record from status-lock contention (a concurrent refresh
+        probing dead hosts can hold the lock ~30s) is retried for a
+        fresh read first.
+        """
+        from skypilot_tpu.backends import backend_utils
+        record = None
+        for attempt in range(3):
+            probe_start = time.time()
+            try:
+                record = backend_utils.refresh_cluster_record(
+                    self.cluster_name, force_refresh=True)
+            except Exception:  # pylint: disable=broad-except
+                return False
+            if record is None:
+                return True  # terminated behind our back = preemption
+            updated_at = record.get('status_updated_at') or 0
+            if updated_at >= probe_start - 1:
+                break  # fresh read (ours, or a probe that just finished)
+            time.sleep(5)
+        return (record is not None and
+                record['status'] != global_state.ClusterStatus.UP)
+
     # -------------------------------------------------------------- launch
 
     def launch(self) -> float:

@@ -157,7 +157,13 @@ def get_cluster_info(
             common.InstanceInfo(instance_id=node_id,
                                 internal_ip=node_dir,
                                 external_ip=None,
-                                tags={'node_dir': node_dir})
+                                # One local node = one "slice" (matching
+                                # GCP, where a TPU node IS a slice): a
+                                # num_nodes>1 local cluster is the
+                                # multislice test double — gang envs get
+                                # per-slice worker ids + MEGASCALE.
+                                tags={'node_dir': node_dir,
+                                      'slice_index': str(idx)})
         ]
     return common.ClusterInfo(instances=instances,
                               head_instance_id=head_id,

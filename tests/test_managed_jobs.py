@@ -216,3 +216,75 @@ def test_scheduler_reconciles_dead_controller():
     assert state.get_job_status(job_id) == \
         state.ManagedJobStatus.FAILED_CONTROLLER
     assert state.get_job(job_id)['schedule_state'] == 'DONE'
+
+
+def test_multislice_slice_death_recovers_from_checkpoint(tmp_path):
+    """VERDICT-r3 item 8: a 2-slice managed job loses one slice's hosts
+    mid-run → WHOLE-job recovery relaunches the gang (slice-aware env
+    regeneration: both runs see MEGASCALE_NUM_SLICES=2 and slice ids
+    {0,1}) and training resumes from the latest checkpoint step.
+    """
+    ckpt = tmp_path / 'ckpts'
+    log = tmp_path / 'train.log'
+    envlog = tmp_path / 'env.log'
+    done = tmp_path / 'done'
+    run = (
+        f'echo "slice=$MEGASCALE_SLICE_ID '
+        f'nslices=$MEGASCALE_NUM_SLICES '
+        f'worker=$TPU_WORKER_ID rank=$SKYTPU_NODE_RANK" >> {envlog}; '
+        'if [ "$SKYTPU_NODE_RANK" = "0" ]; then '
+        # Rank 0 trains (single-process CPU smoke: override the gang's
+        # distributed envs — local nodes have no real DCN/ICI).
+        'env JAX_NUM_PROCESSES=1 MEGASCALE_NUM_SLICES=1 '
+        'python3 -m skypilot_tpu.models.train --model debug --steps 12 '
+        '--batch-size 2 --seq-len 64 '
+        f'--checkpoint-dir {ckpt} --save-every 3 --log-every 1 '
+        f'--sleep-per-step 0.6 >> {log} 2>&1 && touch {done}; '
+        # The other slice's host waits for rank 0 (a stand-in for its
+        # share of the sharded step); it exits 0 once training is done.
+        f'else while [ ! -f {done} ]; do sleep 0.5; done; fi')
+    task = sky.Task(name='ms-job', run=run, num_nodes=2)
+    task.set_resources(sky.Resources(cloud='local'))
+    task.update_envs({'JAX_PLATFORMS': 'cpu'})
+    job_id = sky.jobs.launch(task)
+
+    # Wait for the first checkpoint from run 1.
+    from skypilot_tpu.models import checkpoint as ck
+    deadline = time.time() + 120
+    while time.time() < deadline and not ck.list_steps(str(ckpt)):
+        time.sleep(0.5)
+    assert ck.list_steps(str(ckpt)), _controller_log(job_id)
+
+    # Kill slice 1's hosts out-of-band (the node's whole process tree —
+    # skylet included — dies, like a preempted TPU slice's hosts).
+    cluster = state.get_task(job_id, 0)['cluster_name']
+    handle = global_state.get_cluster_from_name(cluster)['handle']
+    from skypilot_tpu.provision.local import instance as local_instance
+    cluster_dir = local_instance._cluster_dir(  # pylint: disable=protected-access
+        handle.cluster_name_on_cloud)
+    local_instance._kill_node_processes(  # pylint: disable=protected-access
+        cluster_dir, workers_only=True)
+
+    _wait_status(job_id, state.ManagedJobStatus.SUCCEEDED, timeout=240)
+    assert state.get_task(job_id, 0)['recovery_count'] == 1
+
+    # Training resumed from a checkpointed step, not step 0.
+    text = log.read_text()
+    import re
+    m = re.search(r'resumed from step (\d+)', text)
+    assert m and int(m.group(1)) > 0, f'no resume line:\n{text[-2000:]}'
+    assert 'done at step 12' in text
+    assert text.count('step 1/12 ') == 1, text[-2000:]
+
+    # Slice-aware gang envs were REGENERATED on recovery: two runs × two
+    # slices, every line sees 2 slices; both slice ids appear per run.
+    lines = envlog.read_text().strip().splitlines()
+    assert len(lines) == 4, lines
+    assert all('nslices=2' in l for l in lines), lines
+    first, second = lines[:2], lines[2:]
+    for run_lines in (first, second):
+        assert {l.split()[0] for l in run_lines} == \
+            {'slice=0', 'slice=1'}, run_lines
+        # TPU worker ids restart per slice.
+        assert all('worker=0' in l for l in run_lines), run_lines
+    _wait_no_clusters()
