@@ -1,13 +1,15 @@
-"""HTML dashboard: clusters, managed jobs, services at a glance.
+"""HTML dashboard: clusters, managed jobs, services, API requests.
 
 Parity: the reference's managed-jobs Flask dashboard
-(``sky/jobs/dashboard/dashboard.py``) + server log HTML — one page served
-by the API server at ``/dashboard``, reading the same sqlite state the
-CLI reads, refreshed client-side.
+(``sky/jobs/dashboard/dashboard.py``) + the server log-viewer page
+(``sky/server/html/log.html``) — served by the API server at
+``/dashboard`` (overview) and ``/dashboard/log?request_id=...``
+(per-request log), reading the same sqlite state the CLI reads,
+refreshed client-side.
 """
 import html
 import time
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 _PAGE = """<!doctype html>
 <html><head><title>skypilot_tpu</title>
@@ -29,16 +31,50 @@ _PAGE = """<!doctype html>
 """
 
 
-def _table(title: str, header: Tuple[str, ...],
-           rows: List[Tuple[str, ...]]) -> str:
+_LOG_PAGE = """<!doctype html>
+<html><head><title>request {request_id}</title>
+{refresh}
+<style>
+ body {{ font-family: monospace; margin: 2em; background: #fafafa; }}
+ pre {{ background: #111; color: #ddd; padding: 1em; overflow-x: auto;
+       white-space: pre-wrap; }}
+ .meta {{ color: #666; margin-bottom: 1em; }}
+</style></head><body>
+<h1>request {request_id}</h1>
+<p class="meta">{name} &middot; status <b class="{status}">{status}</b>
+ &middot; <a href="/dashboard">dashboard</a>
+ &middot; <a href="/api/stream?request_id={request_id}">raw stream</a>
+ {refresh_note}</p>
+<pre>{log}</pre>
+</body></html>
+"""
+
+
+class _Cell:
+    """A table cell carrying an optional hyperlink."""
+
+    def __init__(self, text, href: Optional[str] = None):
+        self.text = str(text)
+        self.href = href
+
+
+def _table(title: str, header: Tuple[str, ...], rows: List[Tuple]) -> str:
     cells = ''.join(f'<th>{html.escape(h)}</th>' for h in header)
     body = []
     for row in rows:
         tds = []
         for c in row:
+            href = None
+            if isinstance(c, _Cell):
+                href = c.href
+                c = c.text
             c = str(c)
             cls = f' class="{c}"' if c.isupper() else ''
-            tds.append(f'<td{cls}>{html.escape(c)}</td>')
+            inner = html.escape(c)
+            if href:
+                inner = f'<a href="{html.escape(href, quote=True)}">' \
+                        f'{inner}</a>'
+            tds.append(f'<td{cls}>{inner}</td>')
         body.append('<tr>' + ''.join(tds) + '</tr>')
     if not body:
         body = [f'<tr><td colspan="{len(header)}">none</td></tr>']
@@ -89,5 +125,54 @@ def render() -> str:
                            ('NAME', 'STATUS', 'READY', 'ENDPOINT'),
                            services))
 
+    from skypilot_tpu.server import requests_db
+    reqs = []
+    for rec in requests_db.list_requests(limit=50):
+        rid = rec['request_id']
+        reqs.append((_Cell(rid[:12],
+                           href=f'/dashboard/log?request_id={rid}'),
+                     rec['name'], rec['status'],
+                     time.strftime('%m-%d %H:%M',
+                                   time.localtime(rec['created_at']))))
+    sections.append(_table('API requests (last 50)',
+                           ('REQUEST', 'VERB', 'STATUS', 'CREATED'),
+                           reqs))
+
     return _PAGE.format(now=time.strftime('%Y-%m-%d %H:%M:%S'),
                         sections=''.join(sections))
+
+
+def render_log(request_id: str, tail_bytes: int = 256 * 1024) -> str:
+    """Per-request log page (parity: sky/server/html/log.html).
+
+    Auto-refreshes while the request is live; final once terminal.
+    """
+    import os
+
+    from skypilot_tpu.server import requests_db
+    rec = requests_db.get_request(request_id)
+    if rec is None:
+        return _LOG_PAGE.format(request_id=html.escape(request_id),
+                                name='-', status='UNKNOWN',
+                                refresh='', refresh_note='',
+                                log='No such request.')
+    log_path = requests_db.log_path(request_id)
+    try:
+        size = os.path.getsize(log_path)
+        with open(log_path, 'rb') as f:
+            if size > tail_bytes:
+                f.seek(size - tail_bytes)
+            text = f.read().decode('utf-8', errors='replace')
+        if size > tail_bytes:
+            text = f'... (showing last {tail_bytes} bytes)\n' + text
+    except OSError:
+        text = '<no log yet>'
+    live = not rec['status'].is_terminal()
+    return _LOG_PAGE.format(
+        request_id=html.escape(request_id),
+        name=html.escape(rec['name']),
+        status=html.escape(rec['status'].value),
+        refresh=('<meta http-equiv="refresh" content="3">'
+                 if live else ''),
+        refresh_note=('&middot; auto-refreshing' if live else ''),
+        log=html.escape(text))

@@ -191,6 +191,14 @@ async def handle_dashboard(request: web.Request) -> web.Response:
     return web.Response(text=page, content_type='text/html')
 
 
+async def handle_dashboard_log(request: web.Request) -> web.Response:
+    from skypilot_tpu.server import dashboard
+    request_id = request.query.get('request_id', '')
+    page = await asyncio.get_event_loop().run_in_executor(
+        None, dashboard.render_log, request_id)
+    return web.Response(text=page, content_type='text/html')
+
+
 async def handle_health(request: web.Request) -> web.Response:
     del request
     import skypilot_tpu
@@ -214,6 +222,7 @@ def build_app() -> web.Application:
     app.router.add_post('/api/cancel', handle_api_cancel)
     app.router.add_get('/health', handle_health)
     app.router.add_get('/dashboard', handle_dashboard)
+    app.router.add_get('/dashboard/log', handle_dashboard_log)
     return app
 
 
