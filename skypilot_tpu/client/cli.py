@@ -5,6 +5,7 @@ and streams/prints its result).
 Run: ``python -m skypilot_tpu.client.cli <command>`` (or the ``skytpu``
 entrypoint once installed).
 """
+import os
 import time
 from typing import Optional
 
@@ -36,9 +37,22 @@ def _load_task(entrypoint: str, overrides) -> task_lib.Task:
             raise click.BadParameter(
                 f'--env takes KEY=VALUE, got {item!r}')
         env_map[key] = value
-    # env overrides go through from_yaml so ${VAR} templates in the YAML
-    # (num_nodes, resources, ...) see the CLI values too.
-    task = task_lib.Task.from_yaml(entrypoint, env_overrides=env_map or None)
+    expanded = os.path.expanduser(entrypoint)
+    is_yaml_name = entrypoint.endswith(('.yaml', '.yml'))
+    if is_yaml_name and os.path.isfile(expanded):
+        # env overrides go through from_yaml so ${VAR} templates in the
+        # YAML (num_nodes, resources, ...) see the CLI values too.
+        task = task_lib.Task.from_yaml(entrypoint,
+                                       env_overrides=env_map or None)
+    elif is_yaml_name and ' ' not in entrypoint:
+        # A bare YAML path that doesn't exist is a typo, not a command.
+        raise click.BadParameter(f'Task YAML not found: {entrypoint}')
+    else:
+        # Bare shell command (parity: `sky launch "echo hi"` — anything
+        # that isn't a YAML file path runs as the task's command; a
+        # command merely MENTIONING a .yaml, like
+        # `python gen.py --out config.yaml`, stays a command).
+        task = task_lib.Task(run=entrypoint)
     if env_map:
         task.update_envs(env_map)
     if overrides.get('name'):
@@ -276,9 +290,18 @@ def jobs():
 @jobs.command(name='launch')
 @click.argument('entrypoint', required=True)
 @click.option('--name', '-n', default=None)
-def jobs_launch(entrypoint, name):
-    """Submit a managed job from a YAML spec."""
-    task = _load_task(entrypoint, {'name': name})
+@click.option('--cloud', default=None, help='Override the cloud.')
+@click.option('--accelerators', '--tpus', '--gpus', default=None,
+              help='Override accelerators (e.g. tpu-v5e:8).')
+@click.option('--use-spot/--no-use-spot', default=None)
+@click.option('--env', 'envs', multiple=True,
+              help='Override a task env: KEY=VALUE (repeatable).')
+def jobs_launch(entrypoint, name, cloud, accelerators, use_spot, envs):
+    """Submit a managed job from a YAML spec or a shell command."""
+    task = _load_task(entrypoint, {
+        'name': name, 'cloud': cloud, 'accelerators': accelerators,
+        'use_spot': use_spot, 'envs': envs,
+    })
     result = sdk.get(sdk.jobs_launch(task, name=name))
     click.echo(f"Managed job {result['job_id']} submitted.")
 

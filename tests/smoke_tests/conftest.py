@@ -1,0 +1,30 @@
+"""Smoke-tier fixtures: cloud selection + credential gating.
+
+``--generic-cloud local`` (default) runs every scenario against the
+Local cloud — full end-to-end through the real CLI, no credentials.
+Real clouds are selected with ``--generic-cloud gcp`` etc. and are
+SKIPPED (not failed) when `skytpu check` finds no working credentials
+(reference: tests/conftest.py cloud marks + --generic-cloud).
+"""
+import pytest
+
+from skypilot_tpu import global_state
+
+
+def pytest_addoption(parser):
+    parser.addoption('--generic-cloud', default='local',
+                     help='cloud for smoke scenarios (default: local)')
+
+
+@pytest.fixture
+def generic_cloud(request):
+    cloud = request.config.getoption('--generic-cloud').lower()
+    if cloud == 'local':
+        global_state.set_enabled_clouds(['Local'])
+        return cloud
+    from skypilot_tpu import check as check_lib
+    enabled = check_lib.check(quiet=True, clouds=[cloud])
+    if cloud not in [c.lower() for c in enabled]:
+        pytest.skip(f'no working credentials for {cloud!r} '
+                    '(run `skytpu check`)')
+    return cloud

@@ -1,0 +1,69 @@
+"""Basic cluster lifecycle smoke (parity: smoke_tests/test_basic.py):
+launch → status → queue → logs → exec → cancel → autostop → down, all
+through the real CLI as a user would drive it."""
+from tests.smoke_tests import smoke_utils
+from tests.smoke_tests.smoke_utils import Test
+
+
+def test_launch_exec_logs_down(generic_cloud):
+    name = smoke_utils.unique_name('smoke-basic')
+    smoke_utils.run_one_test(
+        Test(
+            name='basic',
+            commands=[
+                '{skytpu} launch -c ' + name +
+                ' --cloud {cloud} -d "echo smoke-hello-proof"',
+                '{skytpu} status | grep ' + name,
+                'for i in $(seq 1 60); do '
+                '{skytpu} queue ' + name + ' | grep -q SUCCEEDED && '
+                'break; sleep 2; done',
+                '{skytpu} queue ' + name + ' | grep SUCCEEDED',
+                '{skytpu} logs ' + name + ' 1 --no-follow | '
+                'grep smoke-hello-proof',
+                # exec on the existing cluster.
+                '{skytpu} exec "echo smoke-exec-ok" -c ' + name + ' -d',
+                'for i in $(seq 1 60); do '
+                '{skytpu} queue ' + name +
+                ' | grep 2 | grep -q SUCCEEDED && break; sleep 2; done',
+                '{skytpu} logs ' + name + ' 2 --no-follow | '
+                'grep smoke-exec-ok',
+            ],
+            teardown='{skytpu} down ' + name,
+            timeout=10 * 60,
+        ), generic_cloud)
+
+
+def test_cancel_job(generic_cloud):
+    name = smoke_utils.unique_name('smoke-cancel')
+    smoke_utils.run_one_test(
+        Test(
+            name='cancel',
+            commands=[
+                '{skytpu} launch -c ' + name +
+                ' --cloud {cloud} -d "sleep 600"',
+                'for i in $(seq 1 60); do '
+                '{skytpu} queue ' + name + ' | grep -q RUNNING && break; '
+                'sleep 2; done',
+                '{skytpu} cancel ' + name + ' -j 1',
+                'for i in $(seq 1 30); do '
+                '{skytpu} queue ' + name + ' | grep -q CANCELLED && '
+                'break; sleep 2; done',
+                '{skytpu} queue ' + name + ' | grep CANCELLED',
+            ],
+            teardown='{skytpu} down ' + name,
+        ), generic_cloud)
+
+
+def test_autostop_flag(generic_cloud):
+    name = smoke_utils.unique_name('smoke-astop')
+    smoke_utils.run_one_test(
+        Test(
+            name='autostop',
+            commands=[
+                '{skytpu} launch -c ' + name +
+                ' --cloud {cloud} -d "echo ok"',
+                '{skytpu} autostop ' + name + ' -i 60 --down',
+                '{skytpu} status | grep ' + name,
+            ],
+            teardown='{skytpu} down ' + name,
+        ), generic_cloud)

@@ -1,0 +1,21 @@
+"""Managed-jobs smoke (parity: smoke_tests/test_managed_job.py):
+`skytpu jobs launch` through to SUCCEEDED via the controller, plus log
+retrieval — the release-readiness check for the recovery tier."""
+from tests.smoke_tests import smoke_utils
+from tests.smoke_tests.smoke_utils import Test
+
+
+def test_managed_job_to_success(generic_cloud):
+    smoke_utils.run_one_test(
+        Test(
+            name='managed-job',
+            commands=[
+                '{skytpu} jobs launch "echo managed-smoke-ok" '
+                '--cloud {cloud} -n smoke-mj',
+                'for i in $(seq 1 90); do '
+                '{skytpu} jobs queue | grep smoke-mj | '
+                'grep -q SUCCEEDED && break; sleep 2; done',
+                '{skytpu} jobs queue | grep smoke-mj | grep SUCCEEDED',
+            ],
+            timeout=10 * 60,
+        ), generic_cloud)

@@ -1,0 +1,38 @@
+"""Workdir/file-mount smoke (parity: smoke_tests/test_mount_and_storage
+.py): a local workdir and a file mount are visible to the task."""
+from tests.smoke_tests import smoke_utils
+from tests.smoke_tests.smoke_utils import Test
+
+
+def test_workdir_and_file_mount(generic_cloud):
+    name = smoke_utils.unique_name('smoke-mnt')
+    smoke_utils.run_one_test(
+        Test(
+            name='mounts',
+            commands=[
+                'mkdir -p /tmp/' + name + '/wd && '
+                'echo wd-proof > /tmp/' + name + '/wd/hello.txt && '
+                'echo mnt-proof > /tmp/' + name + '/extra.txt',
+                'cat > /tmp/' + name + '.yaml <<EOF\n'
+                'name: ' + name + '\n'
+                'resources:\n'
+                '  cloud: {cloud}\n'
+                'workdir: /tmp/' + name + '/wd\n'
+                'file_mounts:\n'
+                '  ~/input/extra.txt: /tmp/' + name + '/extra.txt\n'
+                'run: cat hello.txt && cat ~/input/extra.txt\n'
+                'EOF',
+                '{skytpu} launch /tmp/' + name + '.yaml -c ' + name +
+                ' -d',
+                'for i in $(seq 1 60); do '
+                '{skytpu} queue ' + name + ' | grep -q SUCCEEDED && '
+                'break; sleep 2; done',
+                '{skytpu} logs ' + name + ' 1 --no-follow | '
+                'grep wd-proof',
+                '{skytpu} logs ' + name + ' 1 --no-follow | '
+                'grep mnt-proof',
+            ],
+            teardown='{skytpu} down ' + name + '; rm -rf /tmp/' + name +
+                     ' /tmp/' + name + '.yaml',
+            timeout=10 * 60,
+        ), generic_cloud)

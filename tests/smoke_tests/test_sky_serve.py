@@ -1,0 +1,41 @@
+"""Serving smoke (parity: smoke_tests/test_sky_serve.py): serve up →
+replicas READY → traffic through the LB → down, via the real CLI."""
+from tests.smoke_tests import smoke_utils
+from tests.smoke_tests.smoke_utils import Test
+
+
+def test_serve_up_traffic_down(generic_cloud):
+    name = smoke_utils.unique_name('smoke-svc')
+    yaml_cmd = (
+        'port=$((20000 + RANDOM % 20000)); '
+        'cat > /tmp/' + name + '.yaml <<EOF\n'
+        'name: ' + name + '\n'
+        'resources:\n'
+        '  cloud: {cloud}\n'
+        'service:\n'
+        '  readiness_probe:\n'
+        '    path: /\n'
+        '    initial_delay_seconds: 60\n'
+        '  replicas: 1\n'
+        '  replica_port: $port\n'
+        'run: exec python3 -m http.server \\$SKYTPU_REPLICA_PORT\n'
+        'EOF')
+    smoke_utils.run_one_test(
+        Test(
+            name='serve',
+            commands=[
+                yaml_cmd,
+                '{skytpu} serve up /tmp/' + name + '.yaml -n ' + name,
+                'for i in $(seq 1 90); do '
+                '{skytpu} serve status ' + name +
+                ' | grep -q READY && break; sleep 2; done',
+                '{skytpu} serve status ' + name + ' | grep READY',
+                # Real traffic through the load balancer.
+                'ep=$({skytpu} serve status ' + name +
+                ' | grep -oE "http://[0-9.:]+" | head -1); '
+                'curl -sf "$ep/" | grep -q "Directory listing"',
+            ],
+            teardown='{skytpu} serve down ' + name +
+                     '; rm -f /tmp/' + name + '.yaml',
+            timeout=10 * 60,
+        ), generic_cloud)

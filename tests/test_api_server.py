@@ -174,28 +174,30 @@ def test_dashboard_overview_and_log_pages(api_env):
     rid = sdk.launch(_local_task('dash-task', 'echo dash-proof-819'),
                      cluster_name='dash-c1')
     sdk.get(rid)
-    url = os.environ['SKYTPU_API_SERVER_URL']
+    try:
+        url = os.environ['SKYTPU_API_SERVER_URL']
 
-    page = requests_lib.get(f'{url}/dashboard', timeout=10).text
-    # Overview sections render with live state.
-    for needle in ('Clusters', 'Managed jobs', 'Services',
-                   'API requests', 'dash-c1', 'launch'):
-        assert needle in page, f'missing {needle!r} in dashboard'
-    # The request row links to its log page.
-    assert f'/dashboard/log?request_id={rid}' in page
+        page = requests_lib.get(f'{url}/dashboard', timeout=10).text
+        # Overview sections render with live state.
+        for needle in ('Clusters', 'Managed jobs', 'Services',
+                       'API requests', 'dash-c1', 'launch'):
+            assert needle in page, f'missing {needle!r} in dashboard'
+        # The request row links to its log page.
+        assert f'/dashboard/log?request_id={rid}' in page
 
-    log_page = requests_lib.get(f'{url}/dashboard/log',
-                                params={'request_id': rid},
-                                timeout=10).text
-    assert rid in log_page
-    assert 'launch' in log_page
-    assert 'SUCCEEDED' in log_page
-    assert f'/api/stream?request_id={rid}' in log_page
+        log_page = requests_lib.get(f'{url}/dashboard/log',
+                                    params={'request_id': rid},
+                                    timeout=10).text
+        assert rid in log_page
+        assert 'launch' in log_page
+        assert 'SUCCEEDED' in log_page
+        assert f'/api/stream?request_id={rid}' in log_page
 
-    # Unknown request ids render a friendly page, not a 500.
-    missing = requests_lib.get(f'{url}/dashboard/log',
-                               params={'request_id': 'nope'}, timeout=10)
-    assert missing.status_code == 200
-    assert 'No such request' in missing.text
-
-    sdk.get(sdk.down('dash-c1'))
+        # Unknown request ids render a friendly page, not a 500.
+        missing = requests_lib.get(f'{url}/dashboard/log',
+                                   params={'request_id': 'nope'},
+                                   timeout=10)
+        assert missing.status_code == 200
+        assert 'No such request' in missing.text
+    finally:
+        sdk.get(sdk.down('dash-c1'))
