@@ -364,12 +364,26 @@ def _aws_od_price(term_group: dict) -> Optional[float]:
     return None
 
 
+def _neocloud_writer(cloud: str):
+    def write(out, t):
+        from skypilot_tpu.catalog import neocloud_fetchers
+        rows = neocloud_fetchers.FETCHERS[cloud](t)
+        return _write_vm_csv(rows, out, f'{cloud}_vms.csv')
+
+    return write
+
+
 _FETCHERS = {
     'gcp': lambda out, t: fetch_and_write_gcp(out, t),
     'azure': lambda out, t: _write_vm_csv(fetch_azure_vms(t), out,
                                           'azure_vms.csv'),
     'aws': lambda out, t: _write_vm_csv(fetch_aws_vms(t), out,
                                         'aws_vms.csv'),
+    # Neocloud fetchers (catalog/neocloud_fetchers.py): parity with the
+    # reference's per-cloud data_fetchers breadth.
+    **{cloud: _neocloud_writer(cloud)
+       for cloud in ('lambda', 'runpod', 'vast', 'cudo', 'do',
+                     'paperspace', 'fluidstack', 'oci')},
 }
 
 

@@ -1,0 +1,400 @@
+"""Catalog fetchers for the neocloud providers.
+
+Parity: the reference ships ~10 per-cloud fetchers under
+``sky/clouds/service_catalog/data_fetchers/`` (fetch_lambda_cloud.py,
+fetch_vast.py, fetch_cudo.py, fetch_fluidstack.py, ...). Same design as
+``catalog/fetchers.py``: every fetcher takes an injectable ``transport``
+so the parsing is unit-testable offline (recorded fixtures) and runnable
+for real wherever network + credentials exist:
+
+    python -m skypilot_tpu.catalog.fetchers lambda --out-dir ~/.skytpu/catalog
+
+Pricing APIs rarely carry full hardware specs; the spec side (vCPUs,
+memory, accelerator) joins from the curated tables in
+``catalog/data_gen.py`` — the fetcher refreshes the PRICES, the
+generator remains the source of truth for shapes.
+"""
+import functools
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.catalog import data_gen
+
+logger = sky_logging.init_logger(__name__)
+
+Transport = Callable[[str, Dict[str, str]], dict]
+
+
+def _public_get(url: str, params: Dict[str, str]) -> dict:
+    """Default transport. Reserved params (popped, never sent):
+
+    * ``_auth_env`` — env var holding a Bearer token.
+    * ``_auth_query`` — ``(param_name, env_var)``: key rides the query
+      string (RunPod-style APIs).
+    * ``_post_json`` — dict body: issue a POST instead of a GET.
+    """
+    import json
+    import urllib.parse
+    import urllib.request
+    params = dict(params)
+    headers = {}
+    token_env = params.pop('_auth_env', None)
+    if token_env:
+        token = os.environ.get(token_env)
+        if not token:
+            raise RuntimeError(f'Set ${token_env} to refresh this '
+                               'catalog.')
+        headers['Authorization'] = f'Bearer {token}'
+    auth_query = params.pop('_auth_query', None)
+    if auth_query:
+        pname, env = auth_query
+        token = os.environ.get(env)
+        if not token:
+            raise RuntimeError(f'Set ${env} to refresh this catalog.')
+        params[pname] = token
+    body = params.pop('_post_json', None)
+    if params:
+        sep = '&' if '?' in url else '?'
+        url = f'{url}{sep}{urllib.parse.urlencode(params)}'
+    data = None
+    if body is not None:
+        data = json.dumps(body).encode()
+        headers['Content-Type'] = 'application/json'
+    req = urllib.request.Request(url, data=data, headers=headers)
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return json.loads(resp.read())
+
+
+@functools.lru_cache(maxsize=None)
+def _specs(cloud: str) -> Dict[str, Tuple]:
+    """instance name → (vcpus, mem, accel, count, info) from the curated
+    generator tables."""
+    instances, _ = data_gen._NEOCLOUDS[cloud]  # pylint: disable=protected-access
+    return {inst[0]: inst[1:6] for inst in instances}
+
+
+def _row(cloud: str, instance: str, region: str, price: float,
+         spot: Optional[float]) -> Optional[Dict[str, str]]:
+    spec = _specs(cloud).get(instance)
+    if spec is None:
+        return None  # unknown shape: the generator table gates the SKUs
+    vcpus, mem, accel, count, info = spec
+    return {
+        'InstanceType': instance,
+        'vCPUs': str(vcpus),
+        'MemoryGiB': str(mem),
+        'AcceleratorName': accel or '',
+        'AcceleratorCount': str(count) if accel else '',
+        'GpuInfo': info or '',
+        'Region': region,
+        'AvailabilityZone': region,
+        'Price': f'{price:.4f}',
+        'SpotPrice': f'{spot:.4f}' if spot is not None else '',
+    }
+
+
+# ------------------------------------------------------------- lambda
+
+_LAMBDA_URL = 'https://cloud.lambdalabs.com/api/v1/instance-types'
+
+
+def fetch_lambda_vms(transport: Optional[Transport] = None
+                     ) -> List[Dict[str, str]]:
+    """Lambda's instance-types endpoint: price (cents/hr) + the regions
+    currently offering each type (parity: fetch_lambda_cloud.py)."""
+    transport = transport or _public_get
+    payload = transport(_LAMBDA_URL, {'_auth_env': 'LAMBDA_API_KEY'})
+    rows = []
+    for entry in payload.get('data', {}).values():
+        itype = entry.get('instance_type', {})
+        name = itype.get('name', '')
+        price = float(itype.get('price_cents_per_hour') or 0) / 100.0
+        if price <= 0:
+            continue
+        # No capacity anywhere → the type is absent from the refreshed
+        # catalog (fabricating a region would make the optimizer plan a
+        # SKU Lambda isn't offering).
+        regions = [r.get('name') for r in
+                   entry.get('regions_with_capacity_available', [])]
+        for region in regions:
+            row = _row('lambda', name, region, price, None)
+            if row:
+                rows.append(row)
+    return sorted(rows, key=lambda r: (r['Region'], r['InstanceType']))
+
+
+# -------------------------------------------------------------- runpod
+
+_RUNPOD_URL = 'https://api.runpod.io/graphql'
+_RUNPOD_QUERY = ('query { gpuTypes { id displayName memoryInGb '
+                 'securePrice communityPrice } }')
+# RunPod prices are per GPU: catalog instance name → (gpu id, count).
+_RUNPOD_INSTANCES = {
+    '1x_RTX4090_SECURE': ('NVIDIA GeForce RTX 4090', 1),
+    '1x_L40S_SECURE': ('NVIDIA L40S', 1),
+    '1x_A100-80GB_SECURE': ('NVIDIA A100 80GB PCIe', 1),
+    '8x_A100-80GB_SECURE': ('NVIDIA A100 80GB PCIe', 8),
+    '1x_H100_SECURE': ('NVIDIA H100 80GB HBM3', 1),
+    '8x_H100_SECURE': ('NVIDIA H100 80GB HBM3', 8),
+    '1x_H200_SECURE': ('NVIDIA H200', 1),
+    '8x_H200_SECURE': ('NVIDIA H200', 8),
+}
+
+
+def fetch_runpod_vms(transport: Optional[Transport] = None
+                     ) -> List[Dict[str, str]]:
+    """RunPod GraphQL gpuTypes: secure (on-demand analogue) and
+    community (interruptible) per-GPU prices."""
+    transport = transport or _public_get
+    # RunPod's GraphQL endpoint takes POST with the key as an api_key
+    # query parameter.
+    payload = transport(_RUNPOD_URL, {
+        '_post_json': {'query': _RUNPOD_QUERY},
+        '_auth_query': ('api_key', 'RUNPOD_API_KEY'),
+    })
+    by_gpu = {g.get('id'): g
+              for g in payload.get('data', {}).get('gpuTypes', [])}
+    _, regions = data_gen._NEOCLOUDS['runpod']  # pylint: disable=protected-access
+    rows = []
+    for inst, (gpu_id, count) in _RUNPOD_INSTANCES.items():
+        gpu = by_gpu.get(gpu_id)
+        if not gpu:
+            continue
+        secure = float(gpu.get('securePrice') or 0) * count
+        community = float(gpu.get('communityPrice') or 0) * count
+        if secure <= 0:
+            continue
+        for region in regions:
+            row = _row('runpod', inst, region, secure,
+                       community if community > 0 else None)
+            if row:
+                rows.append(row)
+    return sorted(rows, key=lambda r: (r['Region'], r['InstanceType']))
+
+
+# ---------------------------------------------------------------- vast
+
+_VAST_URL = 'https://console.vast.ai/api/v0/bundles'
+
+# Vast geolocations end in ISO country codes ('Sweden, SE'); bin them
+# into the catalog's coarse US/EU/ASIA marketplace regions.
+_VAST_NA = {'US', 'CA', 'MX'}
+_VAST_EU = {'SE', 'NO', 'FI', 'DK', 'IS', 'GB', 'UK', 'IE', 'NL', 'BE',
+            'LU', 'DE', 'FR', 'ES', 'PT', 'IT', 'CH', 'AT', 'PL', 'CZ',
+            'SK', 'SI', 'HU', 'RO', 'BG', 'GR', 'EE', 'LV', 'LT', 'UA',
+            'HR', 'RS', 'EU'}
+
+
+def _vast_region(geo_code: str) -> str:
+    code = geo_code.upper()
+    if code in _VAST_NA:
+        return 'US'
+    if code in _VAST_EU:
+        return 'EU'
+    return 'ASIA'
+
+
+def fetch_vast_vms(transport: Optional[Transport] = None
+                   ) -> List[Dict[str, str]]:
+    """Vast marketplace offers: min dph_total per (gpu, count, geo).
+
+    The marketplace has no fixed SKUs; the fetcher maps the cheapest
+    live offers onto the catalog's curated instance names
+    (parity: fetch_vast.py)."""
+    transport = transport or _public_get
+    payload = transport(_VAST_URL, {'q': '{"rentable": {"eq": true}}'})
+    # (gpu_name, count, geo) → min $/hr on-demand, min bid (spot).
+    best: Dict[tuple, Dict[str, float]] = {}
+    for offer in payload.get('offers', []):
+        gpu = str(offer.get('gpu_name', '')).replace(' ', '')
+        count = int(offer.get('num_gpus') or 0)
+        geo = str(offer.get('geolocation') or 'US').split(',')[-1].strip()
+        region = _vast_region(geo)
+        dph = float(offer.get('dph_total') or 0)
+        bid = float(offer.get('min_bid') or 0)
+        if count <= 0 or dph <= 0:
+            continue
+        entry = best.setdefault((gpu, count, region), {})
+        entry['od'] = min(entry.get('od', float('inf')), dph)
+        if bid > 0:
+            entry['spot'] = min(entry.get('spot', float('inf')), bid)
+    rows = []
+    for inst in _specs('vast'):
+        count_s, gpu = inst.split('x_', 1)
+        for region in ('US', 'EU', 'ASIA'):
+            entry = best.get((gpu, int(count_s), region))
+            if not entry:
+                continue
+            row = _row('vast', inst, region, entry['od'],
+                       entry.get('spot'))
+            if row:
+                rows.append(row)
+    return sorted(rows, key=lambda r: (r['Region'], r['InstanceType']))
+
+
+# ---------------------------------------------------------------- cudo
+
+_CUDO_URL = 'https://rest.compute.cudo.org/v1/machine-types'
+
+
+def fetch_cudo_vms(transport: Optional[Transport] = None
+                   ) -> List[Dict[str, str]]:
+    """Cudo machine types with per-data-center hourly pricing."""
+    transport = transport or _public_get
+    payload = transport(_CUDO_URL, {})
+    rows = []
+    for mt in payload.get('machineTypes', []):
+        name = mt.get('machineType', '')
+        dc = mt.get('dataCenterId', '')
+        price = float((mt.get('totalPriceHr') or {}).get('value') or 0)
+        if price <= 0:
+            continue
+        row = _row('cudo', name, dc, price, None)
+        if row:
+            rows.append(row)
+    return sorted(rows, key=lambda r: (r['Region'], r['InstanceType']))
+
+
+# ------------------------------------------------------------------ do
+
+_DO_URL = 'https://api.digitalocean.com/v2/sizes'
+
+
+def fetch_do_vms(transport: Optional[Transport] = None
+                 ) -> List[Dict[str, str]]:
+    """DigitalOcean droplet sizes: price_hourly + per-size regions."""
+    transport = transport or _public_get
+    payload = transport(_DO_URL, {'per_page': '200',
+                                  '_auth_env': 'DIGITALOCEAN_TOKEN'})
+    rows = []
+    for size in payload.get('sizes', []):
+        slug = size.get('slug', '')
+        price = float(size.get('price_hourly') or 0)
+        if price <= 0 or not size.get('available', True):
+            continue
+        for region in size.get('regions', []):
+            row = _row('do', slug, region, price, None)
+            if row:
+                rows.append(row)
+    return sorted(rows, key=lambda r: (r['Region'], r['InstanceType']))
+
+
+# ------------------------------------------------------------ paperspace
+
+_PAPERSPACE_URL = 'https://api.paperspace.com/v1/machine-types'
+
+
+def fetch_paperspace_vms(transport: Optional[Transport] = None
+                         ) -> List[Dict[str, str]]:
+    """Paperspace machine types: defaultUsageRate per region."""
+    transport = transport or _public_get
+    payload = transport(_PAPERSPACE_URL,
+                        {'_auth_env': 'PAPERSPACE_API_KEY'})
+    items = payload.get('items', payload.get('machineTypes', []))
+    rows = []
+    for mt in items:
+        label = mt.get('label', mt.get('machineType', ''))
+        price = float(mt.get('defaultUsageRate') or 0)
+        if price <= 0:
+            continue
+        regions = mt.get('availableRegions') or \
+            data_gen._NEOCLOUDS['paperspace'][1]  # pylint: disable=protected-access
+        for region in regions:
+            row = _row('paperspace', label, region, price, None)
+            if row:
+                rows.append(row)
+    return sorted(rows, key=lambda r: (r['Region'], r['InstanceType']))
+
+
+# ------------------------------------------------------------ fluidstack
+
+_FLUIDSTACK_URL = ('https://platform.fluidstack.io/'
+                   'list_available_configurations')
+
+
+def fetch_fluidstack_vms(transport: Optional[Transport] = None
+                         ) -> List[Dict[str, str]]:
+    """FluidStack configurations: per-GPU hourly price × count."""
+    transport = transport or _public_get
+    payload = transport(_FLUIDSTACK_URL,
+                        {'_auth_env': 'FLUIDSTACK_API_KEY'})
+    configs = payload if isinstance(payload, list) else \
+        payload.get('configurations', [])
+    best: Dict[str, float] = {}
+    for cfg in configs:
+        gpu = str(cfg.get('gpu_type', '')).replace('_', '-')
+        count = int(cfg.get('gpu_count') or 0)
+        price = float(cfg.get('price_per_gpu_hr') or 0) * count
+        if count <= 0 or price <= 0:
+            continue
+        best_key = f'{count}x_{gpu}'
+        best[best_key] = min(best.get(best_key, float('inf')), price)
+    _, regions = data_gen._NEOCLOUDS['fluidstack']  # pylint: disable=protected-access
+    rows = []
+    for inst, price in best.items():
+        for region in regions:
+            row = _row('fluidstack', inst, region, price, None)
+            if row:
+                rows.append(row)
+    return sorted(rows, key=lambda r: (r['Region'], r['InstanceType']))
+
+
+# ------------------------------------------------------------------ oci
+
+# Oracle's PUBLIC price-list API (no auth).
+_OCI_URL = ('https://apexapps.oracle.com/pls/apex/cetools/api/v1/'
+            'products/')
+# catalog instance → (OCPU part description substring, unit multiplier).
+_OCI_PARTS = {
+    'BM.GPU.A100-v2.8': ('GPU4', 8),
+    'BM.GPU.H100.8': ('GPU.H100', 8),
+    'VM.GPU.A10.1': ('GPU.A10', 1),
+}
+
+
+def fetch_oci_vms(transport: Optional[Transport] = None
+                  ) -> List[Dict[str, str]]:
+    """OCI public price list: GPU-hour parts × GPU count per shape."""
+    transport = transport or _public_get
+    payload = transport(_OCI_URL, {'currencyCode': 'USD'})
+    items = payload.get('items', [])
+    rows = []
+    _, regions = data_gen._NEOCLOUDS['oci']  # pylint: disable=protected-access
+    import re
+    for inst, (marker, count) in _OCI_PARTS.items():
+        # Boundary-guarded match: 'GPU.A10' must NOT match 'GPU.A100'.
+        pattern = re.compile(re.escape(marker) + r'(?![0-9])',
+                             re.IGNORECASE)
+        unit = None
+        for item in items:
+            if pattern.search(str(item.get('partNumber', ''))) or \
+                    pattern.search(str(item.get('displayName', ''))):
+                for cur in item.get('currencyCodeLocalizations', []) or \
+                        [item]:
+                    for price in cur.get('prices', []):
+                        if price.get('model') == 'PAY_AS_YOU_GO':
+                            unit = float(price.get('value') or 0)
+                if unit:
+                    break
+        if not unit:
+            continue
+        total = unit * count
+        for region in regions:
+            # OCI preemptible capacity is half the on-demand rate.
+            row = _row('oci', inst, region, total, total / 2)
+            if row:
+                rows.append(row)
+    return sorted(rows, key=lambda r: (r['Region'], r['InstanceType']))
+
+
+FETCHERS = {
+    'lambda': fetch_lambda_vms,
+    'runpod': fetch_runpod_vms,
+    'vast': fetch_vast_vms,
+    'cudo': fetch_cudo_vms,
+    'do': fetch_do_vms,
+    'paperspace': fetch_paperspace_vms,
+    'fluidstack': fetch_fluidstack_vms,
+    'oci': fetch_oci_vms,
+}
