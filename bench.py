@@ -245,6 +245,11 @@ def _run_attempt(hb_path: str, budget_left: float) -> tuple:
             line = line.strip()
             if line:
                 lines.append(line)
+                # Forward IMMEDIATELY: payload lines are cumulative
+                # (train-only, then train+decode) and the driver takes
+                # the last stdout line — so even if the whole bench is
+                # killed mid-decode, the train result is already out.
+                print(line, flush=True)
 
     t = threading.Thread(target=_reader, daemon=True)
     t.start()
@@ -350,7 +355,8 @@ def _supervise() -> int:
     if best_line is None:
         log('[bench] FATAL: no result after all attempts')
         return 3
-    print(best_line, flush=True)
+    # Result lines were forwarded live by the attempt reader; the last
+    # stdout line is the (most complete) result.
     return 0
 
 

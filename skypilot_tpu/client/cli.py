@@ -254,6 +254,27 @@ def check(clouds):
     click.echo(f'Enabled clouds: {", ".join(enabled)}')
 
 
+@cli.group()
+def local():
+    """The zero-credential Local cloud (parity: `sky local`)."""
+
+
+@local.command(name='up')
+def local_up():
+    """Enable the Local cloud: processes as hosts, no credentials."""
+    enabled = sdk.get(sdk.local_up())
+    click.echo(f'Local cloud enabled. Enabled clouds: '
+               f'{", ".join(enabled)}')
+
+
+@local.command(name='down')
+def local_down():
+    """Tear down all Local clusters and disable the Local cloud."""
+    torn_down = sdk.get(sdk.local_down())
+    click.echo(f'Local cloud disabled. Torn down: '
+               f'{", ".join(torn_down) or "none"}')
+
+
 @cli.command(name='show-tpus')
 @click.option('--name-filter', '-f', default=None)
 @click.option('--gpus-only', is_flag=True, default=False)

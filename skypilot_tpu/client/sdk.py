@@ -237,6 +237,14 @@ def check(clouds: Optional[List[str]] = None) -> str:
     return _post('/check', {'clouds': clouds})
 
 
+def local_up() -> str:
+    return _post('/local/up', {})
+
+
+def local_down() -> str:
+    return _post('/local/down', {})
+
+
 def storage_ls() -> str:
     return _post('/storage/ls', {})
 

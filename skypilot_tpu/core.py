@@ -191,3 +191,47 @@ def cost_report() -> List[Dict[str, Any]]:
             'total_cost': cost,
         })
     return out
+
+
+@usage_lib.entrypoint(name='local_up')
+def local_up() -> List[str]:
+    """Enable the zero-credential Local cloud (parity: core.py:280
+    `sky local up`, which boots a kind cluster — the TPU-native test
+    double is the Local cloud: processes as hosts, no Kubernetes
+    needed). Returns the enabled-cloud list.
+
+    Runs the full credential probe first so persisting a non-empty
+    enabled set here can't suppress the first-use probe of every OTHER
+    cloud (the cache only auto-refreshes when empty).
+    """
+    from skypilot_tpu import check as check_lib
+    try:
+        enabled = set(check_lib.check(quiet=True))
+    except exceptions.NoCloudAccessError:
+        enabled = set()
+    enabled.add('Local')
+    out = sorted(enabled)
+    global_state.set_enabled_clouds(out)
+    return out
+
+
+@usage_lib.entrypoint(name='local_down')
+def local_down() -> List[str]:
+    """Tear down every Local-cloud cluster and disable the cloud
+    (parity: core.py:1061 `sky local down`). Returns the torn-down
+    cluster names. NOTE: the Local cloud needs no credentials, so a
+    later full probe (`skytpu check` / an empty-cache refresh)
+    re-enables it.
+    """
+    torn_down = []
+    for record in global_state.get_clusters():
+        handle = record['handle']
+        if str(handle.launched_resources.cloud).lower() == 'local':
+            # Reuse the ordinary down verb: owner-identity guard,
+            # locking, and history bookkeeping stay in ONE place.
+            down(record['name'], purge=True)
+            torn_down.append(record['name'])
+    enabled = [c for c in (global_state.get_enabled_clouds() or [])
+               if c.lower() != 'local']
+    global_state.set_enabled_clouds(enabled)
+    return torn_down

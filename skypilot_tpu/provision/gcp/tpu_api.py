@@ -169,6 +169,24 @@ class FakeTpuService:
                 k.split('/nodes/')[0] == path.strip('/').rsplit('/nodes')[0]
             ]
             return {'nodes': matched}
+        if method == 'GET' and parts[-1] == 'operations':
+            # Zone operations log: synthesize a preempted-type op per
+            # PREEMPTED node (matching what the real log retains after
+            # spot reclamation).
+            loc = path.strip('/').rsplit('/operations', 1)[0]
+            ops = []
+            for key, node in nodes.items():
+                if node.get('state') == 'PREEMPTED' and \
+                        key.startswith(loc + '/nodes/'):
+                    ops.append({
+                        'name': f'{loc}/operations/preempt-'
+                                f'{key.rsplit("/", 1)[1]}',
+                        'metadata': {'type': 'preempted', 'target': key,
+                                     'createTime':
+                                         '2026-01-01T00:00:00Z'},
+                        'done': True,
+                    })
+            return {'operations': ops}
         if method == 'GET':
             key = path.strip('/')
             if key.startswith('op/') or '/operations/' in key:
@@ -317,6 +335,40 @@ class TpuClient:
         op = self.transport.request(
             'POST', f'{self._loc(zone)}/nodes/{node_id}:start')
         return self.wait_operation(op)
+
+    def list_preemption_events(self, zone: str) -> List[dict]:
+        """Recent preemption events for spot slices in ``zone``.
+
+        The TPU API surfaces preemptions two ways: the node transitions
+        to PREEMPTED (visible in list_nodes until cleanup), and the
+        zone's operations log records a ``preempted``-type operation —
+        the only trace left AFTER a preempted node is deleted. The
+        managed-jobs recovery path uses node state; this query is the
+        audit/debug surface (parity: the reference's preemption-event
+        checks on GCE instances, instance_utils.py).
+        """
+        # No server-side filter: operations.list's AIP-160 filter
+        # grammar is fiddly across API versions — list and classify
+        # client-side by the operation TYPE (strict; an op merely
+        # mentioning 'preempt' in free text must not count).
+        try:
+            resp = self.transport.request(
+                'GET', f'{self._loc(zone)}/operations')
+        except TpuApiError as exc:
+            if exc.status == 404:
+                return []
+            raise
+        out = []
+        for op in resp.get('operations', []):
+            meta = op.get('metadata', {})
+            op_type = str(meta.get('type', '')).lower()
+            if 'preempt' in op_type:
+                out.append({
+                    'operation': op.get('name', ''),
+                    'target': meta.get('target', ''),
+                    'time': meta.get('createTime', ''),
+                })
+        return out
 
     # -------------------------------------------------- queued resources
     # Parity: the reference's DWS/capacity paths (mig_utils.py MIG +

@@ -136,6 +136,18 @@ def _check(payload: Dict[str, Any]) -> Dict[str, Any]:
                            clouds=payload.get('clouds'))
 
 
+def _local_up(payload: Dict[str, Any]) -> List[str]:
+    from skypilot_tpu import core
+    del payload
+    return core.local_up()
+
+
+def _local_down(payload: Dict[str, Any]) -> List[str]:
+    from skypilot_tpu import core
+    del payload
+    return core.local_down()
+
+
 def _storage_ls(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
     from skypilot_tpu import global_state
     del payload
@@ -256,6 +268,8 @@ EXECUTORS: Dict[str, Callable[[Dict[str, Any]], Any]] = {
     'cancel': _cancel,
     'cost_report': _cost_report,
     'check': _check,
+    'local_up': _local_up,
+    'local_down': _local_down,
     'storage_ls': _storage_ls,
     'storage_delete': _storage_delete,
     'jobs_launch': _jobs_launch,

@@ -39,6 +39,8 @@ _VERB_ROUTES = {
     '/cancel': 'cancel',
     '/cost_report': 'cost_report',
     '/check': 'check',
+    '/local/up': 'local_up',
+    '/local/down': 'local_down',
     '/logs': 'logs',
     '/storage/ls': 'storage_ls',
     '/storage/delete': 'storage_delete',

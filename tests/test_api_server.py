@@ -201,3 +201,24 @@ def test_dashboard_overview_and_log_pages(api_env):
         assert 'No such request' in missing.text
     finally:
         sdk.get(sdk.down('dash-c1'))
+
+
+def test_local_up_down_cli(api_env):
+    """`skytpu local up/down` (parity: sky local up) — enable the Local
+    cloud, run something, tear every Local cluster down with it."""
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli as cli_mod
+    runner = CliRunner()
+    res = runner.invoke(cli_mod.cli, ['local', 'up'])
+    assert res.exit_code == 0, res.output
+    assert 'Local' in res.output
+
+    res = runner.invoke(cli_mod.cli,
+                        ['launch', 'echo lu-ok', '-c', 'lu-c1',
+                         '--cloud', 'local', '-d'])
+    assert res.exit_code == 0, res.output
+
+    res = runner.invoke(cli_mod.cli, ['local', 'down'])
+    assert res.exit_code == 0, res.output
+    assert 'lu-c1' in res.output
+    assert sdk.get(sdk.status()) == []

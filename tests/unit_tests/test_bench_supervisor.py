@@ -193,7 +193,10 @@ def test_supervisor_success_takes_last_line():
             'SKYTPU_BENCH_PAYLOAD_CMD': payload,
         }, timeout=60)
         assert res.returncode == 0
-        assert json.loads(res.stdout.strip()) == {'v': 2}
+        # Cumulative lines are forwarded live; the LAST line is the
+        # (most complete) result — the driver's parse rule.
+        assert json.loads(res.stdout.strip().splitlines()[-1]) == \
+            {'v': 2}
     finally:
         relay.close()
 

@@ -145,3 +145,24 @@ def test_deploy_vars_surface_qr_knobs(monkeypatch):
                                                'us-east5-b')
     assert cfg2.node_config['use_queued_resources'] is False
     assert cfg2.node_config['provision_timeout'] == 900
+
+
+def test_preemption_event_query():
+    """Spot-slice preemption leaves a queryable trace: node state
+    PREEMPTED + a preempted-type zone operation (the only trace after
+    the node record is cleaned up)."""
+    gcp_instance.run_instances('us-east5', 'pe', _config())
+    client = tpu_api.TpuClient('proj-test')
+    assert client.list_preemption_events('us-east5-b') == []
+    # Reclaim the slice out-of-band (what GCP does to spot capacity).
+    nodes = tpu_api.FakeTpuService._nodes  # pylint: disable=protected-access
+    for key, node in nodes.items():
+        if '/nodes/pe-0' in key:
+            node['state'] = 'PREEMPTED'
+    events = client.list_preemption_events('us-east5-b')
+    assert len(events) == 1
+    assert events[0]['target'].endswith('/nodes/pe-0')
+    # query_instances surfaces the terminal state to the failover ring.
+    statuses = gcp_instance.query_instances(
+        'pe', _config().provider_config, non_terminated_only=False)
+    assert statuses == {'pe-0': 'terminated'}
