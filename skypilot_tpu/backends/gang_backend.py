@@ -296,6 +296,15 @@ class RetryingProvisioner:
         record = provisioner_lib.bulk_provision(cand.cloud.name, region,
                                                 cluster_name_on_cloud,
                                                 config)
+        if cand.ports:
+            # `ports:` exposure rides the provisioner's open_ports verb
+            # (k8s: NodePort service; VM clouds: firewall rules where
+            # the cloud needs them — many neoclouds are open-by-default
+            # no-ops). Parity: provisioner.py post-provision open_ports.
+            provision_router.open_ports(
+                cand.cloud.name, cluster_name_on_cloud,
+                [str(p) for p in cand.ports],
+                provider_config=config.provider_config)
         cluster_info = provision_router.get_cluster_info(
             cand.cloud.name,
             region,

@@ -308,17 +308,79 @@ def terminate_instances(cluster_name_on_cloud: str,
         if worker_only and labels.get(_NODE_LABEL) == '0':
             continue
         client.delete_pod(namespace, pod['metadata']['name'])
+    if not worker_only:
+        # Drop the cluster's port-exposure service with its pods.
+        client.delete_service(namespace,
+                              _ports_service_name(cluster_name_on_cloud))
+
+
+def _ports_service_name(cluster_name_on_cloud: str) -> str:
+    return f'{cluster_name_on_cloud}-ports'
+
+
+def _parse_ports(ports: List[str]) -> List[int]:
+    out: List[int] = []
+    for p in ports:
+        s = str(p)
+        if '-' in s:
+            lo, hi = s.split('-', 1)
+            if int(hi) < int(lo):
+                raise common.ProvisionerError(
+                    f'Invalid port range {s!r}: end < start.')
+            out.extend(range(int(lo), int(hi) + 1))
+        else:
+            out.append(int(s))
+    if not out:
+        raise common.ProvisionerError(f'No ports parsed from {ports!r}.')
+    return sorted(set(out))
+
+
+def _real_open_ports(cluster_name_on_cloud: str, ports: List[str],
+                     provider_config: Dict[str, Any]) -> None:
+    """Expose ports via ONE NodePort service selecting the cluster's
+    head pod (parity: the reference's network_utils NodePort services
+    for `ports:`)."""
+    client = _client(provider_config)
+    namespace = _namespace(provider_config)
+    manifest = {
+        'apiVersion': 'v1',
+        'kind': 'Service',
+        'metadata': {
+            'name': _ports_service_name(cluster_name_on_cloud),
+            'labels': {_CLUSTER_LABEL: cluster_name_on_cloud},
+        },
+        'spec': {
+            'type': 'NodePort',
+            'selector': {_CLUSTER_LABEL: cluster_name_on_cloud,
+                         _NODE_LABEL: '0', _HOST_LABEL: '0'},
+            'ports': [{'name': f'port-{p}', 'port': p,
+                       'targetPort': p, 'protocol': 'TCP'}
+                      for p in _parse_ports(ports)],
+        },
+    }
+    svc = client.create_service(namespace, manifest)
+    mapping = {str(p.get('port')): p.get('nodePort')
+               for p in svc.get('spec', {}).get('ports', [])}
+    logger.info(f'Opened ports on {cluster_name_on_cloud}: '
+                f'port→NodePort {mapping}')
 
 
 def open_ports(cluster_name_on_cloud: str,
                ports: List[str],
                provider_config: Optional[Dict[str, Any]] = None) -> None:
-    # The real path would create a Service/Ingress per port (parity:
-    # sky/provision/kubernetes/network.py); in-cluster traffic needs none.
-    logger.debug(f'open_ports({cluster_name_on_cloud}, {ports})')
+    """NodePort service exposing the head pod's ports (parity:
+    sky/provision/kubernetes/network.py NodePort mode)."""
+    if not ports:
+        return
+    assert provider_config is not None
+    _real_open_ports(cluster_name_on_cloud, ports, provider_config)
 
 
 def cleanup_ports(cluster_name_on_cloud: str,
                   ports: List[str],
                   provider_config: Optional[Dict[str, Any]] = None) -> None:
-    logger.debug(f'cleanup_ports({cluster_name_on_cloud}, {ports})')
+    del ports
+    assert provider_config is not None
+    client = _client(provider_config)
+    client.delete_service(_namespace(provider_config),
+                          _ports_service_name(cluster_name_on_cloud))

@@ -119,6 +119,28 @@ class KubectlTransport:
         except (K8sApiError, FileNotFoundError):
             return None
 
+    # Services (port exposure — parity: the reference creates NodePort
+    # services for `ports:` via network_utils).
+
+    def create_service(self, namespace: str, manifest: dict) -> dict:
+        # apply -o json returns the created object (assigned nodePorts
+        # included) in one round trip.
+        out = self._run(['-n', namespace, 'apply', '-f', '-', '-o',
+                         'json'], stdin=json.dumps(manifest))
+        return json.loads(out)
+
+    def get_service(self, namespace: str, name: str) -> dict:
+        out = self._run(['-n', namespace, 'get', 'service', name, '-o',
+                         'json'])
+        return json.loads(out)
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        try:
+            self._run(['-n', namespace, 'delete', 'service', name,
+                       '--ignore-not-found', '--wait=false'])
+        except K8sApiError as e:
+            logger.debug(f'delete service {name}: {e}')
+
 
 # Default fake cluster: two CPU nodes plus a 4-host v5e-16 TPU podslice
 # nodepool (GKE labels as on real GKE TPU nodepools). Override with
@@ -337,6 +359,36 @@ class FakeK8sService:
 
     def current_context(self) -> Optional[str]:
         return self.context
+
+    # Services: stored under 'svc:{ns}/{name}' keys, disjoint from pod
+    # keys by the ':' (pod names are DNS labels, no colons).
+
+    def create_service(self, namespace: str, manifest: dict) -> dict:
+        with FakeK8sService._lock:
+            pods = self._load()
+            name = manifest['metadata']['name']
+            svc = json.loads(json.dumps(manifest))
+            # Assign NodePorts like a real apiserver would.
+            for i, port in enumerate(
+                    svc.get('spec', {}).get('ports', [])):
+                port.setdefault('nodePort', 30000 + i)
+            svc['metadata']['namespace'] = namespace
+            pods[f'svc:{namespace}/{name}'] = svc
+            self._save(pods)
+            return svc
+
+    def get_service(self, namespace: str, name: str) -> dict:
+        pods = self._load()
+        key = f'svc:{namespace}/{name}'
+        if key not in pods:
+            raise K8sApiError(f'services "{name}" not found')
+        return pods[key]
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        with FakeK8sService._lock:
+            pods = self._load()
+            pods.pop(f'svc:{namespace}/{name}', None)
+            self._save(pods)
 
 
 def make_client(context: Optional[str] = None):

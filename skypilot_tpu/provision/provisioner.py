@@ -226,6 +226,14 @@ def teardown_cluster(provider_name: str, cluster_name_on_cloud: str,
                      terminate: bool) -> None:
     """Parity: provisioner.py:204 teardown_cluster."""
     if terminate:
+        try:
+            # Port exposure (NodePort services / firewall rules) dies
+            # with the cluster; best-effort — a missing service must
+            # not block instance teardown.
+            provision.cleanup_ports(provider_name, cluster_name_on_cloud,
+                                    [], provider_config=provider_config)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'cleanup_ports({cluster_name_on_cloud}): {e}')
         provision.terminate_instances(provider_name, cluster_name_on_cloud,
                                       provider_config=provider_config)
     else:

@@ -240,3 +240,55 @@ def test_gpu_pod_manifest():
     sel = pod['spec']['nodeSelector']
     assert sel['cloud.google.com/gke-accelerator'] == 'nvidia-l4'
     k8s_instance.terminate_instances('tgpu', _provider_config())
+
+
+def test_open_ports_nodeport_service(fake_k8s):
+    """`ports:` exposure = ONE NodePort service selecting the head pod
+    (parity: sky/provision/kubernetes/network.py); teardown removes it
+    with the pods."""
+    from skypilot_tpu.provision.kubernetes import k8s_api
+    cfg = _config(count=1)
+    k8s_instance.run_instances('ctx', 'svc-ports', cfg)
+    k8s_instance.open_ports('svc-ports', ['8080', '9000-9002'],
+                            cfg.provider_config)
+    client = k8s_api.make_client(None)
+    svc = client.get_service('default', 'svc-ports-ports')
+    ports = svc['spec']['ports']
+    assert [p['port'] for p in ports] == [8080, 9000, 9001, 9002]
+    assert all(p.get('nodePort') for p in ports)
+    assert svc['spec']['type'] == 'NodePort'
+    assert svc['spec']['selector']['skytpu-cluster'] == 'svc-ports'
+
+    # Reversed ranges fail loudly instead of applying an empty
+    # Service the apiserver would reject with an opaque error.
+    with pytest.raises(provision_common.ProvisionerError):
+        k8s_instance.open_ports('svc-ports', ['9002-9000'],
+                                cfg.provider_config)
+
+    # cleanup_ports removes the service; terminate is also sufficient.
+    k8s_instance.cleanup_ports('svc-ports', [], cfg.provider_config)
+    with pytest.raises(k8s_api.K8sApiError):
+        client.get_service('default', 'svc-ports-ports')
+    k8s_instance.open_ports('svc-ports', ['8080'], cfg.provider_config)
+    k8s_instance.terminate_instances('svc-ports', cfg.provider_config)
+    with pytest.raises(k8s_api.K8sApiError):
+        client.get_service('default', 'svc-ports-ports')
+
+
+def test_launch_with_ports_creates_service_e2e():
+    """`ports:` flows launch → provision → open_ports: the NodePort
+    service exists while the cluster is up and dies with it."""
+    global_state.set_enabled_clouds(['Kubernetes'])
+    task = sky.Task(name='ports-k8s', run='echo ok')
+    task.set_resources(sky.Resources(cloud='kubernetes', ports=[8888]))
+    _, handle = sky.launch(task, cluster_name='t-k8s-ports',
+                           detach_run=True, stream_logs=False)
+    assert handle is not None
+    client = k8s_api.make_client(None)
+    svc = client.get_service(
+        'default', f'{handle.cluster_name_on_cloud}-ports')
+    assert [p['port'] for p in svc['spec']['ports']] == [8888]
+    sky.down('t-k8s-ports')
+    with pytest.raises(k8s_api.K8sApiError):
+        client.get_service('default',
+                           f'{handle.cluster_name_on_cloud}-ports')
