@@ -1,0 +1,256 @@
+"""GCE instance API client (compute.googleapis.com v1) with a fake.
+
+Parity: ``GCPComputeInstance`` in
+``sky/provision/gcp/instance_utils.py:141`` — the reference provisions
+GCE VMs (GPU + CPU) alongside TPU nodes; this module is the GCE half of
+the GCP provisioner (``tpu_api.py`` is the TPU half). Same two-transport
+shape:
+
+* :class:`RestTransport` — real HTTP with a ``gcloud`` bearer token.
+* :class:`FakeGceService` — in-memory instances, used by tests and when
+  ``SKYTPU_GCP_FAKE=1``. Fault injection:
+  ``SKYTPU_GCP_FAKE_GCE_STOCKOUT='zone1,...'`` makes insert in those
+  zones raise a zonal capacity error (ZONE_RESOURCE_POOL_EXHAUSTED).
+"""
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision.gcp import tpu_api
+
+logger = sky_logging.init_logger(__name__)
+
+_API_BASE = 'https://compute.googleapis.com/compute/v1'
+
+_FAKE_STATE_ENV = 'SKYTPU_GCP_GCE_FAKE_STATE'
+
+# GCE status → framework status strings.
+STATE_MAP = {
+    'PROVISIONING': 'pending',
+    'STAGING': 'pending',
+    'RUNNING': 'running',
+    'STOPPING': 'stopping',
+    'SUSPENDING': 'stopping',
+    'SUSPENDED': 'stopped',
+    'TERMINATED': 'stopped',  # GCE TERMINATED = stopped (not deleted)
+}
+
+# Accelerator name → GCE guestAccelerator type for machine families that
+# do NOT embed their GPUs (n1). a2/a3/g2 machine types embed theirs.
+GUEST_ACCELERATORS = {
+    'V100': 'nvidia-tesla-v100',
+    'T4': 'nvidia-tesla-t4',
+    'P100': 'nvidia-tesla-p100',
+}
+
+
+class RestTransport:
+    """compute.googleapis.com through requests + gcloud token (same
+    auth pattern as tpu_api.RestTransport)."""
+
+    def __init__(self):
+        import requests
+        self._session = requests.Session()
+        self._token: Optional[str] = None
+        self._token_time = 0.0
+
+    def _headers(self) -> Dict[str, str]:
+        if self._token is None or time.time() - self._token_time > 1800:
+            self._token = tpu_api._get_access_token()  # pylint: disable=protected-access
+            self._token_time = time.time()
+        return {'Authorization': f'Bearer {self._token}',
+                'Content-Type': 'application/json'}
+
+    def request(self, method: str, path: str,
+                body: Optional[dict] = None,
+                params: Optional[dict] = None) -> dict:
+        url = f'{_API_BASE}/{path.lstrip("/")}'
+        resp = self._session.request(method, url,
+                                     headers=self._headers(), json=body,
+                                     params=params, timeout=60)
+        if resp.status_code >= 400:
+            try:
+                payload = resp.json()
+            except ValueError:
+                payload = {'error': {'message': resp.text}}
+            message = payload.get('error', {}).get('message', resp.text)
+            lowered = message.lower()
+            if ('resource_pool_exhausted' in lowered or
+                    'zone_resource_pool_exhausted' in lowered or
+                    'does not have enough resources' in lowered or
+                    'quota' in lowered):
+                raise tpu_api.GcpCapacityError(resp.status_code, message,
+                                               payload)
+            raise tpu_api.TpuApiError(resp.status_code, message, payload)
+        return resp.json() if resp.text else {}
+
+
+class FakeGceService:
+    """In-memory GCE: instances + instant operations."""
+
+    _lock = threading.Lock()
+    _instances: Dict[str, Dict[str, Any]] = {}
+
+    def __init__(self):
+        self._state_path = os.environ.get(_FAKE_STATE_ENV)
+
+    def _load(self) -> Dict[str, Dict[str, Any]]:
+        if self._state_path and os.path.exists(self._state_path):
+            with open(self._state_path, encoding='utf-8') as f:
+                return json.load(f)
+        return FakeGceService._instances
+
+    def _save(self, instances: Dict[str, Dict[str, Any]]) -> None:
+        if self._state_path:
+            with open(self._state_path, 'w', encoding='utf-8') as f:
+                json.dump(instances, f)
+        else:
+            FakeGceService._instances = instances
+
+    def request(self, method: str, path: str,
+                body: Optional[dict] = None,
+                params: Optional[dict] = None) -> dict:
+        with FakeGceService._lock:
+            return self._dispatch(method, path, body or {}, params or {})
+
+    def _dispatch(self, method: str, path: str, body: dict,
+                  params: dict) -> dict:
+        instances = self._load()
+        parts = path.strip('/').split('/')
+        # projects/{p}/zones/{zone}/instances[/...]
+        zone = parts[3] if len(parts) > 3 else ''
+        if method == 'POST' and parts[-1] == 'instances':
+            stockout = os.environ.get('SKYTPU_GCP_FAKE_GCE_STOCKOUT', '')
+            if zone in stockout.split(','):
+                raise tpu_api.GcpCapacityError(
+                    403, 'ZONE_RESOURCE_POOL_EXHAUSTED: The zone '
+                    f'"{zone}" does not have enough resources.')
+            name = body['name']
+            key = f'{path.strip("/")}/{name}'
+            inst = dict(body)
+            inst['status'] = 'RUNNING'
+            idx = len(instances) + 2
+            inst['networkInterfaces'] = [{
+                'networkIP': f'10.1.0.{idx}',
+                'accessConfigs': [{'natIP': f'35.1.0.{idx}'}],
+            }]
+            instances[key] = inst
+            self._save(instances)
+            return {'name': f'op/{uuid.uuid4()}', 'status': 'DONE'}
+        if method == 'GET' and parts[-1] == 'instances':
+            prefix = path.strip('/') + '/'
+            items = [v for k, v in instances.items()
+                     if k.startswith(prefix)]
+            flt = params.get('filter', '')
+            if flt:
+                # 'labels.<k>=<v>' — the single filter shape we emit.
+                k, _, v = flt.replace('labels.', '').partition('=')
+                items = [i for i in items
+                         if i.get('labels', {}).get(k) == v.strip('"')]
+            return {'items': items}
+        key = path.strip('/')
+        if method == 'GET':
+            if key.startswith('op/'):
+                return {'name': key, 'status': 'DONE'}
+            if key not in instances:
+                raise tpu_api.TpuApiError(404, f'{key} not found')
+            return instances[key]
+        if method == 'DELETE':
+            instances.pop(key, None)
+            self._save(instances)
+            return {'name': f'op/{uuid.uuid4()}', 'status': 'DONE'}
+        if method == 'POST' and key.endswith('/stop'):
+            inst = instances.get(key.rsplit('/', 1)[0])
+            if inst is not None:
+                inst['status'] = 'TERMINATED'
+                self._save(instances)
+            return {'name': f'op/{uuid.uuid4()}', 'status': 'DONE'}
+        if method == 'POST' and key.endswith('/start'):
+            inst = instances.get(key.rsplit('/', 1)[0])
+            if inst is not None:
+                inst['status'] = 'RUNNING'
+                self._save(instances)
+            return {'name': f'op/{uuid.uuid4()}', 'status': 'DONE'}
+        raise tpu_api.TpuApiError(400, f'Fake GCE: unsupported '
+                                  f'{method} {path}')
+
+
+def make_transport():
+    if os.environ.get('SKYTPU_GCP_FAKE', '0') == '1':
+        return FakeGceService()
+    return RestTransport()
+
+
+class GceClient:
+    """Typed wrapper over the instances surface."""
+
+    def __init__(self, project: str, transport=None):
+        self.project = project
+        self.transport = transport or make_transport()
+
+    def _zone(self, zone: str) -> str:
+        return f'projects/{self.project}/zones/{zone}'
+
+    def insert(self, zone: str, body: Dict[str, Any]) -> dict:
+        op = self.transport.request('POST',
+                                    f'{self._zone(zone)}/instances',
+                                    body=body)
+        return self.wait_operation(zone, op)
+
+    def list_instances(self, zone: str,
+                       label: Optional[tuple] = None) -> List[dict]:
+        params = {}
+        if label is not None:
+            params['filter'] = f'labels.{label[0]}="{label[1]}"'
+        resp = self.transport.request(
+            'GET', f'{self._zone(zone)}/instances', params=params)
+        return resp.get('items', [])
+
+    def delete(self, zone: str, name: str) -> dict:
+        op = self.transport.request(
+            'DELETE', f'{self._zone(zone)}/instances/{name}')
+        return self.wait_operation(zone, op)
+
+    def stop(self, zone: str, name: str) -> dict:
+        op = self.transport.request(
+            'POST', f'{self._zone(zone)}/instances/{name}/stop')
+        return self.wait_operation(zone, op)
+
+    def start(self, zone: str, name: str) -> dict:
+        op = self.transport.request(
+            'POST', f'{self._zone(zone)}/instances/{name}/start')
+        return self.wait_operation(zone, op)
+
+    def wait_operation(self, zone: str, op: dict,
+                       timeout: float = 900.0) -> dict:
+        deadline = time.time() + timeout
+        backoff = 1.0
+        while op.get('status') != 'DONE':
+            if time.time() > deadline:
+                raise tpu_api.TpuApiError(
+                    504, f'GCE operation {op.get("name")} timed out.')
+            time.sleep(backoff)
+            backoff = min(backoff * 1.5, 10.0)
+            # Real zonal operations come back as BARE ids
+            # ('operation-abc...'); the poll URL is the zonal
+            # operations resource. A full resource path (the fake's
+            # 'op/...' never reaches here: the fake returns DONE) is
+            # used as-is.
+            name = op['name']
+            if not name.startswith('projects/'):
+                name = (f'{self._zone(zone)}/operations/'
+                        f'{name.rsplit("/", 1)[-1]}')
+            op = self.transport.request('GET', name)
+        if 'error' in op:
+            errors = op['error'].get('errors', [])
+            message = '; '.join(e.get('message', e.get('code', ''))
+                                for e in errors) or str(op['error'])
+            lowered = message.lower()
+            if 'exhausted' in lowered or 'quota' in lowered:
+                raise tpu_api.GcpCapacityError(429, message, op)
+            raise tpu_api.TpuApiError(500, message, op)
+        return op

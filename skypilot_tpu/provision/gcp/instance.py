@@ -63,9 +63,21 @@ def _cluster_nodes(client: tpu_api.TpuClient, zone: str,
     ]
 
 
+def _is_tpu_config(node_cfg: Dict[str, Any]) -> bool:
+    return bool(node_cfg.get('accelerator_type'))
+
+
 def run_instances(region: str, cluster_name_on_cloud: str,
                   config: common.ProvisionConfig) -> common.ProvisionRecord:
-    """Create (or resume) the cluster's TPU slice nodes."""
+    """Create (or resume) the cluster's nodes.
+
+    Two backends behind one surface (parity: the reference's handler
+    dispatch, instance_utils.py:141 GCPComputeInstance vs :1191
+    GCPTPUVMInstance): TPU slice nodes via tpu.googleapis.com, GPU/CPU
+    VMs via compute.googleapis.com.
+    """
+    if not _is_tpu_config(config.node_config):
+        return _run_gce_instances(region, cluster_name_on_cloud, config)
     zone = config.provider_config['availability_zone']
     client = _client(config.provider_config)
     node_cfg = config.node_config
@@ -172,6 +184,136 @@ def _qr_prefix(cluster_name_on_cloud: str) -> str:
     return f'{cluster_name_on_cloud}-qr-'
 
 
+# ------------------------------------------------------------------- GCE
+
+
+def _gce_client(provider_config: Dict[str, Any]):
+    from skypilot_tpu.provision.gcp import gce_api
+    return gce_api.GceClient(_project_id(provider_config))
+
+
+_GCE_IMAGE = ('projects/ubuntu-os-cloud/global/images/family/'
+              'ubuntu-2204-lts')
+
+
+def _run_gce_instances(region: str, cluster_name_on_cloud: str,
+                       config: common.ProvisionConfig
+                       ) -> common.ProvisionRecord:
+    """GPU/CPU VMs via the GCE instances API (parity:
+    GCPComputeInstance, instance_utils.py:141)."""
+    from skypilot_tpu.provision.gcp import gce_api
+    zone = config.provider_config['availability_zone']
+    client = _gce_client(config.provider_config)
+    node_cfg = config.node_config
+    machine_type = node_cfg['instance_type']
+
+    existing = {i['name']: i for i in client.list_instances(
+        zone, label=(_CLUSTER_LABEL, cluster_name_on_cloud))}
+    created: List[str] = []
+    resumed: List[str] = []
+    head_id: Optional[str] = None
+    for i in range(config.count):
+        name = _node_name(cluster_name_on_cloud, i)
+        if i == 0:
+            head_id = name
+        inst = existing.get(name)
+        if inst is not None:
+            status = gce_api.STATE_MAP.get(inst.get('status'), 'pending')
+            if status == 'running':
+                continue
+            if status == 'stopped':
+                if not config.resume_stopped_nodes:
+                    # Fail NOW: wait_instances can't transition a
+                    # stopped VM, it would just time out for 30 min.
+                    raise common.ProvisionerError(
+                        f'Instance {name} is stopped and '
+                        'resume_stopped_nodes is False; start the '
+                        'cluster instead.')
+                client.start(zone, name)
+                resumed.append(name)
+                continue
+            continue  # pending/stopping: wait_instances handles it
+        body: Dict[str, Any] = {
+            'name': name,
+            'machineType': f'zones/{zone}/machineTypes/{machine_type}',
+            'disks': [{
+                'boot': True,
+                'autoDelete': True,
+                'initializeParams': {
+                    'sourceImage': node_cfg.get('image_id') or _GCE_IMAGE,
+                    'diskSizeGb': str(node_cfg.get('disk_size', 256)),
+                },
+            }],
+            'networkInterfaces': [{
+                'network': 'global/networks/default',
+                'accessConfigs': [{'type': 'ONE_TO_ONE_NAT',
+                                   'name': 'External NAT'}],
+            }],
+            'labels': {_CLUSTER_LABEL: cluster_name_on_cloud,
+                       **node_cfg.get('labels', {})},
+            'metadata': {'items': [{
+                'key': 'ssh-keys',
+                'value': config.authentication_config.get('ssh_keys', ''),
+            }]},
+        }
+        # n1-family GPUs attach as guestAccelerators (a2/a3/g2 embed
+        # theirs in the machine type) and require host-maintenance
+        # TERMINATE.
+        gpu = node_cfg.get('gpu')
+        if gpu and gpu in gce_api.GUEST_ACCELERATORS:
+            body['guestAccelerators'] = [{
+                'acceleratorType':
+                    f'zones/{zone}/acceleratorTypes/'
+                    f'{gce_api.GUEST_ACCELERATORS[gpu]}',
+                'acceleratorCount': int(node_cfg.get('gpu_count', 1)),
+            }]
+        scheduling: Dict[str, Any] = {}
+        if gpu:
+            scheduling['onHostMaintenance'] = 'TERMINATE'
+        if node_cfg.get('use_spot'):
+            scheduling.update({'provisioningModel': 'SPOT',
+                               'preemptible': True,
+                               'automaticRestart': False})
+        if scheduling:
+            body['scheduling'] = scheduling
+        logger.debug(f'Creating GCE instance {name} in {zone}: '
+                     f'{machine_type}')
+        client.insert(zone, body)
+        created.append(name)
+
+    assert head_id is not None
+    return common.ProvisionRecord(provider_name='gcp',
+                                  region=region,
+                                  zone=zone,
+                                  cluster_name=cluster_name_on_cloud,
+                                  head_instance_id=head_id,
+                                  resumed_instance_ids=resumed,
+                                  created_instance_ids=created)
+
+
+def _gce_cluster_instances(provider_config: Dict[str, Any],
+                           cluster_name_on_cloud: str,
+                           best_effort: bool = False,
+                           client=None) -> List[dict]:
+    """GCE instances labeled with the cluster.
+
+    ``best_effort=True`` for paths that must keep working on TPU-only
+    projects WITHOUT the Compute Engine API enabled (status polls,
+    teardown): an API error there reads as 'no GCE instances', it must
+    not abort TPU teardown or mask the queued-resource sweep.
+    """
+    zone = provider_config['availability_zone']
+    client = client or _gce_client(provider_config)
+    try:
+        return client.list_instances(
+            zone, label=(_CLUSTER_LABEL, cluster_name_on_cloud))
+    except tpu_api.TpuApiError as exc:
+        if best_effort:
+            logger.debug(f'GCE list for {cluster_name_on_cloud}: {exc}')
+            return []
+        raise
+
+
 def _accel_config_type(accelerator_type: str) -> str:
     gen = accelerator_type.split('-')[0].upper()  # v5p → V5P
     return {'V2': 'V2', 'V3': 'V3', 'V4': 'V4', 'V5E': 'V5LITE_POD',
@@ -181,16 +323,28 @@ def _accel_config_type(accelerator_type: str) -> str:
 def wait_instances(region: str, cluster_name_on_cloud: str,
                    state: Optional[str] = 'running',
                    provider_config: Optional[Dict[str, Any]] = None) -> None:
-    """Block until every slice node reaches `state`."""
+    """Block until every node (TPU slice or GCE VM) reaches `state`."""
     import time
+
+    from skypilot_tpu.provision.gcp import gce_api
     assert provider_config is not None
     zone = provider_config['availability_zone']
     client = _client(provider_config)
+    gce_client = _gce_client(provider_config)  # hoisted: token cache
     deadline = time.time() + 1800
     while True:
         nodes = _cluster_nodes(client, zone, cluster_name_on_cloud)
-        statuses = [_STATE_MAP.get(n.get('state'), 'pending') for n in nodes]
-        if nodes and all(s == state for s in statuses):
+        statuses = [_STATE_MAP.get(n.get('state'), 'pending')
+                    for n in nodes]
+        if not nodes:
+            statuses = [
+                gce_api.STATE_MAP.get(i.get('status'), 'pending')
+                for i in _gce_cluster_instances(provider_config,
+                                                cluster_name_on_cloud,
+                                                best_effort=True,
+                                                client=gce_client)
+            ]
+        if statuses and all(s == state for s in statuses):
             return
         if time.time() > deadline:
             raise common.ProvisionerError(
@@ -208,6 +362,12 @@ def get_cluster_info(
     zone = provider_config['availability_zone']
     client = _client(provider_config)
     nodes = _cluster_nodes(client, zone, cluster_name_on_cloud)
+    if not nodes:
+        gce = _gce_cluster_instances(provider_config,
+                                     cluster_name_on_cloud)
+        if gce:
+            return _gce_cluster_info(gce, cluster_name_on_cloud,
+                                     provider_config)
     instances: Dict[str, List[common.InstanceInfo]] = {}
     head_id = None
     custom = {}
@@ -248,20 +408,60 @@ def get_cluster_info(
     )
 
 
+def _gce_cluster_info(gce_instances: List[dict],
+                      cluster_name_on_cloud: str,
+                      provider_config: Dict[str, Any]
+                      ) -> common.ClusterInfo:
+    del cluster_name_on_cloud
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    for inst in sorted(gce_instances, key=lambda i: i['name']):
+        name = inst['name']
+        nic = (inst.get('networkInterfaces') or [{}])[0]
+        access = (nic.get('accessConfigs') or [{}])[0]
+        instances[name] = [
+            common.InstanceInfo(instance_id=name,
+                                internal_ip=nic.get('networkIP', ''),
+                                external_ip=access.get('natIP'),
+                                tags={})
+        ]
+    heads = [n for n in instances if n.endswith('-0')]
+    head_id = heads[0] if heads else (sorted(instances)[0]
+                                      if instances else None)
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head_id,
+        provider_name='gcp',
+        provider_config=provider_config,
+        ssh_user=provider_config.get('ssh_user', 'skytpu'),
+        ssh_private_key=provider_config.get('ssh_private_key'),
+    )
+
+
 def query_instances(
         cluster_name_on_cloud: str,
         provider_config: Optional[Dict[str, Any]] = None,
         non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
     """instance_id → status string (parity: query_instances)."""
+    from skypilot_tpu.provision.gcp import gce_api
     assert provider_config is not None
     zone = provider_config['availability_zone']
     client = _client(provider_config)
     out: Dict[str, Optional[str]] = {}
-    for node in _cluster_nodes(client, zone, cluster_name_on_cloud):
+    nodes = _cluster_nodes(client, zone, cluster_name_on_cloud)
+    for node in nodes:
         status = _STATE_MAP.get(node.get('state'), 'pending')
         if non_terminated_only and status == 'terminated':
             continue
         out[node['name'].split('/')[-1]] = status
+    if nodes:
+        # A TPU cluster (even fully preempted/filtered) never has GCE
+        # instances — skip the compute API entirely.
+        return out
+    for inst in _gce_cluster_instances(provider_config,
+                                       cluster_name_on_cloud,
+                                       best_effort=True):
+        out[inst['name']] = gce_api.STATE_MAP.get(inst.get('status'),
+                                                  'pending')
     return out
 
 
@@ -280,6 +480,13 @@ def stop_instances(cluster_name_on_cloud: str,
                 f'TPU slice {name} is multi-host and cannot be stopped; '
                 'only terminate is supported (GCP limitation).')
         client.stop_node(zone, name)
+    gce = _gce_client(provider_config)
+    for inst in _gce_cluster_instances(provider_config,
+                                       cluster_name_on_cloud,
+                                       best_effort=True, client=gce):
+        if worker_only and inst['name'].endswith('-0'):
+            continue
+        gce.stop(zone, inst['name'])
 
 
 def terminate_instances(cluster_name_on_cloud: str,
@@ -307,6 +514,16 @@ def terminate_instances(cluster_name_on_cloud: str,
             qr_id = qr.get('name', '').split('/')[-1]
             if pattern.fullmatch(qr_id):
                 client.delete_queued_resource(zone, qr_id)
+    # GCE half LAST and best-effort: a TPU-only project without the
+    # Compute Engine API enabled must still complete TPU teardown and
+    # the queued-resource sweep above.
+    gce = _gce_client(provider_config)
+    for inst in _gce_cluster_instances(provider_config,
+                                       cluster_name_on_cloud,
+                                       best_effort=True, client=gce):
+        if worker_only and inst['name'].endswith('-0'):
+            continue
+        gce.delete(zone, inst['name'])
 
 
 def open_ports(cluster_name_on_cloud: str,

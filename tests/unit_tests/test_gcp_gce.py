@@ -1,0 +1,161 @@
+"""GCP GCE (GPU/CPU VM) provisioning — the compute half of the GCP
+provisioner (parity: GCPComputeInstance, instance_utils.py:141; the TPU
+half is tested in test_multislice_provision/test_queued_resources)."""
+import pytest
+
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.gcp import gce_api
+from skypilot_tpu.provision.gcp import instance as gcp_instance
+from skypilot_tpu.provision.gcp import tpu_api
+
+
+@pytest.fixture(autouse=True)
+def fake_gcp(monkeypatch):
+    monkeypatch.setenv('SKYTPU_GCP_FAKE', '1')
+    monkeypatch.setenv('GOOGLE_CLOUD_PROJECT', 'proj-test')
+    gce_api.FakeGceService._instances = {}  # pylint: disable=protected-access
+    tpu_api.FakeTpuService._nodes = {}  # pylint: disable=protected-access
+    yield
+    gce_api.FakeGceService._instances = {}  # pylint: disable=protected-access
+    tpu_api.FakeTpuService._nodes = {}  # pylint: disable=protected-access
+
+
+def _config(count=1, instance_type='a3-highgpu-8g', gpu=None,
+            use_spot=False):
+    node_cfg = {'instance_type': instance_type, 'use_spot': use_spot}
+    if gpu:
+        node_cfg.update(gpu)
+    return provision_common.ProvisionConfig(
+        provider_config={'region': 'us-central1',
+                         'availability_zone': 'us-central1-a',
+                         'ssh_user': 'skytpu'},
+        authentication_config={'ssh_keys': 'skytpu:ssh-ed25519 AAAA'},
+        docker_config={},
+        node_config=node_cfg,
+        count=count,
+        tags={},
+        resume_stopped_nodes=True,
+    )
+
+
+def test_gce_lifecycle_run_query_stop_resume_terminate():
+    cfg = _config(count=2)
+    record = gcp_instance.run_instances('us-central1', 'g1', cfg)
+    assert record.created_instance_ids == ['g1-0', 'g1-1']
+    assert record.head_instance_id == 'g1-0'
+
+    statuses = gcp_instance.query_instances('g1', cfg.provider_config)
+    assert statuses == {'g1-0': 'running', 'g1-1': 'running'}
+
+    info = gcp_instance.get_cluster_info('us-central1', 'g1',
+                                         cfg.provider_config)
+    hosts = info.ordered_host_meta()
+    assert len(hosts) == 2
+    assert info.head_instance_id == 'g1-0'
+
+    gcp_instance.stop_instances('g1', cfg.provider_config)
+    statuses = gcp_instance.query_instances('g1', cfg.provider_config)
+    assert set(statuses.values()) == {'stopped'}
+
+    # Re-run resumes the stopped VMs instead of creating new ones.
+    record2 = gcp_instance.run_instances('us-central1', 'g1', cfg)
+    assert record2.created_instance_ids == []
+    assert len(record2.resumed_instance_ids) == 2
+
+    gcp_instance.terminate_instances('g1', cfg.provider_config)
+    assert gcp_instance.query_instances('g1', cfg.provider_config) == {}
+
+
+def test_gce_stockout_classifies_capacity(monkeypatch):
+    monkeypatch.setenv('SKYTPU_GCP_FAKE_GCE_STOCKOUT', 'us-central1-a')
+    with pytest.raises(tpu_api.GcpCapacityError) as err:
+        gcp_instance.run_instances('us-central1', 'g2', _config())
+    assert 'RESOURCE_POOL_EXHAUSTED' in str(err.value)
+    assert err.value.scope == 'zone'
+
+
+def test_gce_n1_gpu_guest_accelerators_and_spot():
+    cfg = _config(instance_type='n1-standard-8',
+                  gpu={'gpu': 'V100', 'gpu_count': 1}, use_spot=True)
+    gcp_instance.run_instances('us-central1', 'g3', cfg)
+    inst = gce_api.GceClient('proj-test').list_instances(
+        'us-central1-a', label=('skytpu-cluster', 'g3'))[0]
+    accels = inst['guestAccelerators']
+    assert accels[0]['acceleratorType'].endswith('nvidia-tesla-v100')
+    assert inst['scheduling']['provisioningModel'] == 'SPOT'
+    assert inst['scheduling']['onHostMaintenance'] == 'TERMINATE'
+
+
+def test_gce_embedded_gpu_machine_has_no_guest_accelerators():
+    """a2/a3/g2 embed their GPUs in the machine type."""
+    cfg = _config(instance_type='a3-highgpu-8g',
+                  gpu={'gpu': 'H100', 'gpu_count': 8})
+    gcp_instance.run_instances('us-central1', 'g4', cfg)
+    inst = gce_api.GceClient('proj-test').list_instances(
+        'us-central1-a', label=('skytpu-cluster', 'g4'))[0]
+    assert 'guestAccelerators' not in inst
+    assert inst['scheduling']['onHostMaintenance'] == 'TERMINATE'
+
+
+def test_tpu_and_gce_clusters_coexist():
+    """Routing: TPU configs hit tpu.googleapis.com, VM configs hit
+    compute; queries don't cross-talk."""
+    tpu_cfg = provision_common.ProvisionConfig(
+        provider_config={'region': 'us-central1',
+                         'availability_zone': 'us-central1-a',
+                         'ssh_user': 'skytpu'},
+        authentication_config={'ssh_keys': 'k'},
+        docker_config={},
+        node_config={'accelerator_type': 'v5e-8',
+                     'runtime_version': 'tpu-ubuntu2204-base'},
+        count=1, tags={}, resume_stopped_nodes=True)
+    gcp_instance.run_instances('us-central1', 'mix-tpu', tpu_cfg)
+    gcp_instance.run_instances('us-central1', 'mix-gce', _config())
+    assert set(gcp_instance.query_instances(
+        'mix-tpu', tpu_cfg.provider_config)) == {'mix-tpu-0'}
+    assert set(gcp_instance.query_instances(
+        'mix-gce', _config().provider_config)) == {'mix-gce-0'}
+    info = gcp_instance.get_cluster_info('us-central1', 'mix-gce',
+                                         _config().provider_config)
+    assert info.provider_name == 'gcp'
+
+
+def test_gce_stopped_without_resume_fails_fast():
+    cfg = _config(count=1)
+    gcp_instance.run_instances('us-central1', 'g5', cfg)
+    gcp_instance.stop_instances('g5', cfg.provider_config)
+    import dataclasses
+    no_resume = dataclasses.replace(cfg, resume_stopped_nodes=False)
+    with pytest.raises(provision_common.ProvisionerError,
+                       match='stopped'):
+        gcp_instance.run_instances('us-central1', 'g5', no_resume)
+
+
+def test_tpu_teardown_survives_gce_api_errors(monkeypatch):
+    """A TPU-only project without the Compute API: teardown still
+    deletes nodes and sweeps queued resources (GCE half best-effort)."""
+    tpu_cfg = provision_common.ProvisionConfig(
+        provider_config={'region': 'us-central1',
+                         'availability_zone': 'us-central1-a',
+                         'ssh_user': 'skytpu'},
+        authentication_config={'ssh_keys': 'k'},
+        docker_config={},
+        node_config={'accelerator_type': 'v5e-8',
+                     'runtime_version': 'tpu-ubuntu2204-base',
+                     'use_queued_resources': True,
+                     'provision_timeout': 1.0},
+        count=1, tags={}, resume_stopped_nodes=True)
+    gcp_instance.run_instances('us-central1', 'g6', tpu_cfg)
+
+    def boom(*args, **kwargs):
+        raise tpu_api.TpuApiError(
+            403, 'Compute Engine API has not been used in project')
+
+    monkeypatch.setattr(gce_api.GceClient, 'list_instances', boom)
+    gcp_instance.terminate_instances('g6', tpu_cfg.provider_config)
+    client = tpu_api.TpuClient('proj-test')
+    assert client.list_nodes('us-central1-a') == []
+    assert client.list_queued_resources('us-central1-a') == []
+    # Status polls are equally resilient.
+    assert gcp_instance.query_instances(
+        'g6', tpu_cfg.provider_config) == {}
