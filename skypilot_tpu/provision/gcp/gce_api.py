@@ -123,6 +123,34 @@ class FakeGceService:
         parts = path.strip('/').split('/')
         # projects/{p}/zones/{zone}/instances[/...]
         zone = parts[3] if len(parts) > 3 else ''
+        if '/global/firewalls' in path:
+            key = path.strip('/')
+            if method == 'POST':
+                name = body['name']
+                full = f'{key}/{name}'
+                if full in instances:
+                    # Real-API fidelity: duplicate insert is 409, NOT a
+                    # silent replace (callers must PATCH).
+                    raise tpu_api.TpuApiError(
+                        409, f"The resource '{name}' already exists")
+                instances[full] = dict(body)
+                self._save(instances)
+                return {'name': f'op/{uuid.uuid4()}', 'status': 'DONE'}
+            if method == 'PATCH':
+                if key not in instances:
+                    raise tpu_api.TpuApiError(404, f'{key} not found')
+                instances[key] = dict(body)
+                self._save(instances)
+                return {'name': f'op/{uuid.uuid4()}', 'status': 'DONE'}
+            if method == 'GET':
+                if key not in instances:
+                    raise tpu_api.TpuApiError(404, f'{key} not found')
+                return instances[key]
+            if method == 'DELETE':
+                if instances.pop(key, None) is None:
+                    raise tpu_api.TpuApiError(404, f'{key} not found')
+                self._save(instances)
+                return {'name': f'op/{uuid.uuid4()}', 'status': 'DONE'}
         if method == 'POST' and parts[-1] == 'instances':
             stockout = os.environ.get('SKYTPU_GCP_FAKE_GCE_STOCKOUT', '')
             if zone in stockout.split(','):
@@ -225,8 +253,47 @@ class GceClient:
             'POST', f'{self._zone(zone)}/instances/{name}/start')
         return self.wait_operation(zone, op)
 
-    def wait_operation(self, zone: str, op: dict,
+    # Firewalls (global resources) — `ports:` exposure targets the
+    # cluster's network tag.
+
+    def _global(self) -> str:
+        return f'projects/{self.project}/global'
+
+    def upsert_firewall(self, body: Dict[str, Any]) -> None:
+        """Create or update the rule (idempotent: relaunching a cluster
+        with `ports:` re-applies the same rule; changed ports patch
+        through). Both mutations are polled to completion — a
+        fire-and-forget insert would report 'opened' while an async
+        quota failure left the ports closed."""
+        try:
+            op = self.transport.request(
+                'POST', f'{self._global()}/firewalls', body=body)
+        except tpu_api.TpuApiError as exc:
+            if exc.status != 409:
+                raise
+            op = self.transport.request(
+                'PATCH', f'{self._global()}/firewalls/{body["name"]}',
+                body=body)
+        self.wait_operation(None, op)
+
+    def get_firewall(self, name: str) -> dict:
+        return self.transport.request(
+            'GET', f'{self._global()}/firewalls/{name}')
+
+    def delete_firewall(self, name: str) -> None:
+        try:
+            op = self.transport.request(
+                'DELETE', f'{self._global()}/firewalls/{name}')
+        except tpu_api.TpuApiError as exc:
+            if exc.status != 404:
+                raise
+            return
+        self.wait_operation(None, op)
+
+    def wait_operation(self, zone: Optional[str], op: dict,
                        timeout: float = 900.0) -> dict:
+        """Poll a zonal (``zone`` set) or global (``zone=None``)
+        operation to DONE."""
         deadline = time.time() + timeout
         backoff = 1.0
         while op.get('status') != 'DONE':
@@ -235,14 +302,16 @@ class GceClient:
                     504, f'GCE operation {op.get("name")} timed out.')
             time.sleep(backoff)
             backoff = min(backoff * 1.5, 10.0)
-            # Real zonal operations come back as BARE ids
-            # ('operation-abc...'); the poll URL is the zonal
+            # Real operations come back as BARE ids
+            # ('operation-abc...'); the poll URL is the zonal/global
             # operations resource. A full resource path (the fake's
             # 'op/...' never reaches here: the fake returns DONE) is
             # used as-is.
             name = op['name']
             if not name.startswith('projects/'):
-                name = (f'{self._zone(zone)}/operations/'
+                scope = (self._zone(zone) if zone is not None
+                         else self._global())
+                name = (f'{scope}/operations/'
                         f'{name.rsplit("/", 1)[-1]}')
             op = self.transport.request('GET', name)
         if 'error' in op:

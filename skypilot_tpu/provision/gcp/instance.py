@@ -110,6 +110,8 @@ def run_instances(region: str, cluster_name_on_cloud: str,
             'acceleratorType': node_cfg['accelerator_type'],
             'runtimeVersion': node_cfg['runtime_version'],
             'networkConfig': {'enableExternalIps': True},
+            # Network tag: open_ports firewall rules target it.
+            'tags': [_network_tag(cluster_name_on_cloud)],
             'labels': {_CLUSTER_LABEL: cluster_name_on_cloud,
                        **node_cfg.get('labels', {})},
             'metadata': {
@@ -251,6 +253,8 @@ def _run_gce_instances(region: str, cluster_name_on_cloud: str,
             }],
             'labels': {_CLUSTER_LABEL: cluster_name_on_cloud,
                        **node_cfg.get('labels', {})},
+            # Network tag: open_ports firewall rules target it.
+            'tags': {'items': [_network_tag(cluster_name_on_cloud)]},
             'metadata': {'items': [{
                 'key': 'ssh-keys',
                 'value': config.authentication_config.get('ssh_keys', ''),
@@ -526,15 +530,46 @@ def terminate_instances(cluster_name_on_cloud: str,
         gce.delete(zone, inst['name'])
 
 
+def _network_tag(cluster_name_on_cloud: str) -> str:
+    return f'skytpu-{cluster_name_on_cloud}'
+
+
+def _firewall_name(cluster_name_on_cloud: str) -> str:
+    return f'skytpu-{cluster_name_on_cloud}-ports'
+
+
 def open_ports(cluster_name_on_cloud: str,
                ports: List[str],
                provider_config: Optional[Dict[str, Any]] = None) -> None:
-    # Firewall management is a no-op in the fake; the real path would create
-    # a VPC firewall rule targeting the slice's network tags.
-    logger.debug(f'open_ports({cluster_name_on_cloud}, {ports})')
+    """ONE VPC firewall rule allowing the task's `ports:` to the
+    cluster's network tag (parity: the reference's GCP firewall
+    bootstrap in provision/gcp/config.py)."""
+    if not ports:
+        return
+    assert provider_config is not None
+    client = _gce_client(provider_config)
+    client.upsert_firewall({
+        'name': _firewall_name(cluster_name_on_cloud),
+        'network': 'global/networks/default',
+        'direction': 'INGRESS',
+        'sourceRanges': ['0.0.0.0/0'],
+        'targetTags': [_network_tag(cluster_name_on_cloud)],
+        'allowed': [{'IPProtocol': 'tcp',
+                     'ports': [str(p) for p in ports]}],
+    })
+    logger.info(f'Opened ports {ports} for {cluster_name_on_cloud} '
+                '(VPC firewall rule).')
 
 
 def cleanup_ports(cluster_name_on_cloud: str,
                   ports: List[str],
                   provider_config: Optional[Dict[str, Any]] = None) -> None:
-    logger.debug(f'cleanup_ports({cluster_name_on_cloud}, {ports})')
+    del ports
+    assert provider_config is not None
+    client = _gce_client(provider_config)
+    try:
+        client.delete_firewall(_firewall_name(cluster_name_on_cloud))
+    except tpu_api.TpuApiError as exc:
+        # Best-effort: a project without the Compute API (TPU-only,
+        # never opened ports) must not fail teardown here.
+        logger.debug(f'cleanup_ports({cluster_name_on_cloud}): {exc}')

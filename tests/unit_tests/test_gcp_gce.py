@@ -159,3 +159,54 @@ def test_tpu_teardown_survives_gce_api_errors(monkeypatch):
     # Status polls are equally resilient.
     assert gcp_instance.query_instances(
         'g6', tpu_cfg.provider_config) == {}
+
+
+def test_open_ports_firewall_rule_lifecycle():
+    """`ports:` on GCP = one VPC firewall rule targeting the cluster's
+    network tag; instances carry the tag; cleanup removes the rule."""
+    cfg = _config(count=1)
+    gcp_instance.run_instances('us-central1', 'g7', cfg)
+    inst = gce_api.GceClient('proj-test').list_instances(
+        'us-central1-a', label=('skytpu-cluster', 'g7'))[0]
+    assert inst['tags']['items'] == ['skytpu-g7']
+
+    gcp_instance.open_ports('g7', ['8080', '9000-9001'],
+                            cfg.provider_config)
+    client = gce_api.GceClient('proj-test')
+    rule = client.get_firewall('skytpu-g7-ports')
+    assert rule['targetTags'] == ['skytpu-g7']
+    assert rule['allowed'][0]['ports'] == ['8080', '9000-9001']
+
+    gcp_instance.cleanup_ports('g7', [], cfg.provider_config)
+    with pytest.raises(tpu_api.TpuApiError):
+        client.get_firewall('skytpu-g7-ports')
+    # Idempotent: cleaning up again (or with no rule ever created) is
+    # fine — TPU-only projects hit this on every teardown.
+    gcp_instance.cleanup_ports('g7', [], cfg.provider_config)
+
+
+def test_tpu_nodes_carry_network_tag():
+    tpu_cfg = provision_common.ProvisionConfig(
+        provider_config={'region': 'us-central1',
+                         'availability_zone': 'us-central1-a',
+                         'ssh_user': 'skytpu'},
+        authentication_config={'ssh_keys': 'k'},
+        docker_config={},
+        node_config={'accelerator_type': 'v5e-8',
+                     'runtime_version': 'tpu-ubuntu2204-base'},
+        count=1, tags={}, resume_stopped_nodes=True)
+    gcp_instance.run_instances('us-central1', 'g8', tpu_cfg)
+    node = tpu_api.TpuClient('proj-test').list_nodes('us-central1-a')[0]
+    assert node['tags'] == ['skytpu-g8']
+
+
+def test_open_ports_is_idempotent_and_patches():
+    """Relaunching a cluster with ports re-applies the rule (the real
+    API 409s on duplicate insert); changed ports patch through."""
+    cfg = _config(count=1)
+    gcp_instance.open_ports('g9', ['8080'], cfg.provider_config)
+    gcp_instance.open_ports('g9', ['8080'], cfg.provider_config)
+    gcp_instance.open_ports('g9', ['8080', '9999'], cfg.provider_config)
+    rule = gce_api.GceClient('proj-test').get_firewall('skytpu-g9-ports')
+    assert rule['allowed'][0]['ports'] == ['8080', '9999']
+    gcp_instance.cleanup_ports('g9', [], cfg.provider_config)
