@@ -38,12 +38,14 @@ class _FakeRelay:
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(('127.0.0.1', self.port))
         self._sock.listen(8)
+        # Before the thread starts: close() racing settimeout would
+        # EBADF in the accept loop.
+        self._sock.settimeout(0.2)
         self._stop = False
         self._t = threading.Thread(target=self._loop, daemon=True)
         self._t.start()
 
     def _loop(self):
-        self._sock.settimeout(0.2)
         while not self._stop:
             try:
                 conn, _ = self._sock.accept()
