@@ -733,9 +733,12 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         cluster_name = handle.cluster_name
         with locks.cluster_status_lock(cluster_name):
             try:
+                res = handle.launched_resources
                 provisioner_lib.teardown_cluster(
                     handle.provider_name, handle.cluster_name_on_cloud,
-                    handle.provider_config, terminate)
+                    handle.provider_config, terminate,
+                    ports=[str(p) for p in (res.ports or [])]
+                    if res is not None else [])
             except Exception as e:  # pylint: disable=broad-except
                 if not purge:
                     raise

@@ -140,6 +140,23 @@ class CliTransport:
         if ids:
             self._run(['terminate-instances', '--instance-ids'] + ids)
 
+    # Security-group ingress (`ports:` exposure — parity: the
+    # reference authorizes task ports on the cluster's SG).
+
+    def authorize_ingress(self, group_id: str,
+                          permissions: List[dict]) -> None:
+        # Raw API fidelity (Duplicate errors propagate); idempotency is
+        # the caller's policy (instance.open_ports).
+        self._run(['authorize-security-group-ingress', '--group-id',
+                   group_id, '--ip-permissions',
+                   json.dumps(permissions)])
+
+    def revoke_ingress(self, group_id: str,
+                       permissions: List[dict]) -> None:
+        self._run(['revoke-security-group-ingress', '--group-id',
+                   group_id, '--ip-permissions',
+                   json.dumps(permissions)])
+
 
 class FakeEc2Service:
     """In-memory EC2: instant state transitions, per-region instances.
@@ -190,6 +207,8 @@ class FakeEc2Service:
                                   f'{self.region}a'},
                     'PrivateIpAddress': f'172.31.0.{n + 10}',
                     'PublicIpAddress': f'54.0.0.{n + 10}',
+                    'SecurityGroups': [{'GroupId': 'sg-fake0001',
+                                        'GroupName': 'default'}],
                     'Tags': [{'Key': k, 'Value': v}
                              for k, v in config.get('tags', {}).items()],
                     'Region': self.region,
@@ -235,6 +254,52 @@ class FakeEc2Service:
 
     def terminate_instances(self, ids: List[str]) -> None:
         self._set_state(ids, 'terminated')
+
+    # Security-group rules live under 'sg:{region}/{gid}' keys (':'
+    # keeps them disjoint from 'i-...' instance ids).
+
+    def _sg_key(self, group_id: str) -> str:
+        return f'sg:{self.region}/{group_id}'
+
+    def authorize_ingress(self, group_id: str,
+                          permissions: List[dict]) -> None:
+        with FakeEc2Service._lock:
+            instances = self._load()
+            rules = instances.setdefault(self._sg_key(group_id),
+                                         {'rules': []})['rules']
+            # Validate-then-apply (the real API rejects the whole call).
+            for perm in permissions:
+                if perm in rules:
+                    # Real-API fidelity: duplicates error (callers
+                    # swallow it for idempotent relaunches).
+                    raise Ec2ApiError(
+                        'An error occurred '
+                        '(InvalidPermission.Duplicate): the specified '
+                        'rule already exists')
+            rules.extend(permissions)
+            self._save(instances)
+
+    def revoke_ingress(self, group_id: str,
+                       permissions: List[dict]) -> None:
+        with FakeEc2Service._lock:
+            instances = self._load()
+            entry = instances.get(self._sg_key(group_id))
+            rules = entry['rules'] if entry else []
+            # Validate-then-apply (real-API atomicity — in-memory mode
+            # shares the live dict, a mid-loop raise must not leave a
+            # half-applied revoke).
+            for perm in permissions:
+                if perm not in rules:
+                    raise Ec2ApiError(
+                        'An error occurred '
+                        '(InvalidPermission.NotFound): rule not found')
+            for perm in permissions:
+                rules.remove(perm)
+            self._save(instances)
+
+    def ingress_rules(self, group_id: str) -> List[dict]:
+        entry = self._load().get(self._sg_key(group_id))
+        return list(entry['rules']) if entry else []
 
 
 def make_client(region: str):

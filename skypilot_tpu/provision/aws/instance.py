@@ -227,14 +227,103 @@ def terminate_instances(cluster_name_on_cloud: str,
         _ids(client, cluster_name_on_cloud, worker_only))
 
 
+def _port_permissions(ports: List[str]) -> List[dict]:
+    perms = []
+    for p in sorted({str(p) for p in ports}):
+        if '-' in p:
+            lo, hi = p.split('-', 1)
+            if int(hi) < int(lo):
+                raise common.ProvisionerError(
+                    f'Invalid port range {p!r}: end < start.')
+        else:
+            lo = hi = p
+        perms.append({'IpProtocol': 'tcp',
+                      'FromPort': int(lo),
+                      'ToPort': int(hi),
+                      'IpRanges': [{'CidrIp': '0.0.0.0/0'}]})
+    return perms
+
+
+def _configured_security_groups() -> Optional[List[str]]:
+    from skypilot_tpu import skypilot_config
+    return skypilot_config.get_nested(('aws', 'security_group_ids'),
+                                      None)
+
+
+def _cluster_security_groups(client, cluster_name_on_cloud: str
+                             ) -> List[str]:
+    group_ids = []
+    for inst in client.describe_instances(
+            _cluster_filter(cluster_name_on_cloud)):
+        for sg in inst.get('SecurityGroups', []):
+            gid = sg.get('GroupId')
+            if gid and gid not in group_ids:
+                group_ids.append(gid)
+    return group_ids
+
+
 def open_ports(cluster_name_on_cloud: str,
                ports: List[str],
                provider_config: Optional[Dict[str, Any]] = None) -> None:
-    # Real path: authorize-security-group-ingress on the cluster SG.
-    logger.debug(f'open_ports({cluster_name_on_cloud}, {ports})')
+    """Authorize the task's `ports:` on the cluster's security groups
+    (parity: the reference's SG ingress for `ports:`).
+
+    Per-permission calls: a relaunch that ADDS a port must not lose the
+    new rule to a batched Duplicate rejection of an old one.
+    """
+    if not ports:
+        return
+    assert provider_config is not None
+    client = _client(provider_config)
+    group_ids = _configured_security_groups() or \
+        _cluster_security_groups(client, cluster_name_on_cloud)
+    if not group_ids:
+        logger.warning(
+            f'open_ports({cluster_name_on_cloud}): no security groups '
+            'found (no instances?) — nothing authorized.')
+        return
+    for gid in group_ids:
+        for perm in _port_permissions(ports):
+            try:
+                client.authorize_ingress(gid, [perm])
+            except ec2_api.Ec2ApiError as exc:
+                # Idempotent relaunch: this rule already exists.
+                if 'InvalidPermission.Duplicate' not in str(exc):
+                    raise
+    logger.info(f'Opened ports {ports} for {cluster_name_on_cloud} '
+                f'(security-group ingress on {group_ids}).')
 
 
 def cleanup_ports(cluster_name_on_cloud: str,
                   ports: List[str],
                   provider_config: Optional[Dict[str, Any]] = None) -> None:
-    logger.debug(f'cleanup_ports({cluster_name_on_cloud}, {ports})')
+    """Revoke the exact rules open_ports added — but ONLY on
+    explicitly-configured security groups (``aws.security_group_ids``).
+
+    On the implicit shared default-VPC SG, rules are left in place:
+    another live cluster on the same SG may have opened (or be
+    borrowing) the same port, and EC2 rules carry no per-cluster
+    ownership, so revoking there can silently cut a neighbor's traffic.
+    Configured SGs also need no instance discovery, so cleanup works
+    even after the instances are already gone (spot reclaim, partial
+    teardown).
+    """
+    if not ports:
+        return
+    assert provider_config is not None
+    group_ids = _configured_security_groups()
+    if not group_ids:
+        logger.warning(
+            f'cleanup_ports({cluster_name_on_cloud}): ports {ports} '
+            'were opened on the shared default security group; leaving '
+            'the rules (another cluster may rely on them). Configure '
+            'aws.security_group_ids for revocable per-deployment '
+            'groups.')
+        return
+    client = _client(provider_config)
+    for gid in group_ids:
+        for perm in _port_permissions(ports):
+            try:
+                client.revoke_ingress(gid, [perm])
+            except ec2_api.Ec2ApiError as exc:
+                logger.debug(f'revoke ingress on {gid}: {exc}')

@@ -223,15 +223,22 @@ def _jsonable(d: Dict[str, Any]) -> Dict[str, Any]:
 @timeline.event
 def teardown_cluster(provider_name: str, cluster_name_on_cloud: str,
                      provider_config: Dict[str, Any],
-                     terminate: bool) -> None:
-    """Parity: provisioner.py:204 teardown_cluster."""
+                     terminate: bool,
+                     ports: Optional[List[str]] = None) -> None:
+    """Parity: provisioner.py:204 teardown_cluster.
+
+    ``ports``: the cluster's opened `ports:` (from the handle) — clouds
+    whose exposure lives on SHARED objects (AWS security-group rules)
+    need the exact rules to revoke; per-cluster objects (k8s service,
+    GCP firewall) ignore it and delete by name.
+    """
     if terminate:
         try:
-            # Port exposure (NodePort services / firewall rules) dies
-            # with the cluster; best-effort — a missing service must
-            # not block instance teardown.
+            # Port exposure dies with the cluster; best-effort — a
+            # missing service/rule must not block instance teardown.
             provision.cleanup_ports(provider_name, cluster_name_on_cloud,
-                                    [], provider_config=provider_config)
+                                    list(ports or []),
+                                    provider_config=provider_config)
         except Exception as e:  # pylint: disable=broad-except
             logger.debug(f'cleanup_ports({cluster_name_on_cloud}): {e}')
         provision.terminate_instances(provider_name, cluster_name_on_cloud,

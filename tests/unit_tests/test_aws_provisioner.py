@@ -105,3 +105,36 @@ def test_zone_mismatch_rejected():
     with pytest.raises(provision_common.ProvisionerError,
                        match='us-east-1a'):
         aws_instance.run_instances('us-east-1', 'tz', cfg)
+
+
+def test_open_ports_security_group_ingress(monkeypatch):
+    """`ports:` on AWS = SG ingress rules: idempotent relaunch, ADDED
+    ports still authorize, shared-default-SG rules survive another
+    cluster's teardown, configured SGs revoke exactly."""
+    cfg = _config(count=1)
+    aws_instance.run_instances('us-east-1', 'sg1', cfg)
+    aws_instance.open_ports('sg1', ['8080', '9000-9002'],
+                            cfg.provider_config)
+    # Idempotent relaunch that ADDS a port: old rules dedupe, the new
+    # one still lands (per-permission authorize).
+    aws_instance.open_ports('sg1', ['8080', '9000-9002', '7000'],
+                            cfg.provider_config)
+    client = ec2_api.make_client('us-east-1')
+    rules = client.ingress_rules('sg-fake0001')
+    assert {(r['FromPort'], r['ToPort']) for r in rules} == \
+        {(8080, 8080), (9000, 9002), (7000, 7000)}
+
+    # Shared default SG: cleanup leaves the rules (another cluster may
+    # rely on them) — by design, with a warning.
+    aws_instance.cleanup_ports('sg1', ['8080'], cfg.provider_config)
+    assert {(r['FromPort'], r['ToPort']) for r in client.ingress_rules(
+        'sg-fake0001')} == {(8080, 8080), (9000, 9002), (7000, 7000)}
+
+    # Configured per-deployment SG: exact revoke works even with NO
+    # live instances (spot reclaim / late teardown).
+    monkeypatch.setattr(aws_instance, '_configured_security_groups',
+                        lambda: ['sg-fake0001'])
+    aws_instance.terminate_instances('sg1', cfg.provider_config)
+    aws_instance.cleanup_ports('sg1', ['8080', '9000-9002', '7000'],
+                               cfg.provider_config)
+    assert client.ingress_rules('sg-fake0001') == []
