@@ -186,6 +186,39 @@ class CliTransport:
     def delete_vms(self, names: List[str]) -> None:
         self._vm_op('delete', names)
 
+    # ONE named rule per VM NSG, upserted by NAME at a FIXED priority:
+    # `az network nsg rule create` is create_or_update, so a relaunch
+    # with a CHANGED port set updates the same rule in place instead of
+    # colliding on priority (az vm open-port names rules after the port
+    # string — two different port sets at one priority would conflict).
+    NSG_RULE_NAME = 'skytpu-ports'
+    NSG_RULE_PRIORITY = 900
+
+    def upsert_nsg_rule(self, names: List[str],
+                        ports: List[str]) -> None:
+        """Allow the task's `ports:` on each VM's auto-created NSG
+        (`<vm>NSG` — parity: the reference's Azure NSG handling)."""
+        for name in names:
+            self._run(['network', 'nsg', 'rule', 'create',
+                       '--resource-group', self.resource_group,
+                       '--nsg-name', f'{name}NSG',
+                       '--name', self.NSG_RULE_NAME,
+                       '--priority', str(self.NSG_RULE_PRIORITY),
+                       '--access', 'Allow', '--direction', 'Inbound',
+                       '--protocol', 'Tcp',
+                       '--destination-port-ranges'] +
+                      [str(p) for p in ports])
+
+    def delete_nsg_rule(self, names: List[str]) -> None:
+        for name in names:
+            try:
+                self._run(['network', 'nsg', 'rule', 'delete',
+                           '--resource-group', self.resource_group,
+                           '--nsg-name', f'{name}NSG',
+                           '--name', self.NSG_RULE_NAME])
+            except AzureApiError as e:
+                logger.debug(f'delete nsg rule on {name}: {e}')
+
     def delete_group(self, wait: bool = False) -> None:
         # `az vm delete` leaves NICs/public-IPs/OS disks billing; the
         # per-cluster group teardown removes everything at once.
@@ -294,6 +327,31 @@ class FakeAzureService:
 
     def delete_vms(self, names: List[str]) -> None:
         self._set_state(names, 'VM deleted')
+
+    NSG_RULE_NAME = 'skytpu-ports'
+
+    def upsert_nsg_rule(self, names: List[str],
+                        ports: List[str]) -> None:
+        # Real-API fidelity: create_or_update REPLACES the named rule
+        # (a changed port set swaps in, it does not merge).
+        with FakeAzureService._lock:
+            vms = self._load()
+            for name in names:
+                key = f'{self.resource_group}/{name}'
+                if key in vms:
+                    vms[key].setdefault('nsgRules', {})[
+                        self.NSG_RULE_NAME] = [str(p) for p in ports]
+            self._save(vms)
+
+    def delete_nsg_rule(self, names: List[str]) -> None:
+        with FakeAzureService._lock:
+            vms = self._load()
+            for name in names:
+                key = f'{self.resource_group}/{name}'
+                if key in vms:
+                    vms[key].get('nsgRules', {}).pop(
+                        self.NSG_RULE_NAME, None)
+            self._save(vms)
 
     def delete_group(self, wait: bool = False) -> None:
         del wait  # the fake deletes synchronously either way

@@ -235,11 +235,38 @@ def terminate_instances(cluster_name_on_cloud: str,
 def open_ports(cluster_name_on_cloud: str,
                ports: List[str],
                provider_config: Optional[Dict[str, Any]] = None) -> None:
-    # Real path: az network nsg rule create on the cluster NSG.
-    logger.debug(f'open_ports({cluster_name_on_cloud}, {ports})')
+    """ONE named allow rule upserted on each VM's auto-created NSG
+    (parity: the reference's Azure NSG rules for `ports:`). Upsert is
+    by rule NAME at a fixed priority, so relaunches — including with a
+    CHANGED port set — update in place instead of conflicting."""
+    if not ports:
+        return
+    assert provider_config is not None
+    client = _client(provider_config, cluster_name_on_cloud)
+    vms = _cluster_vms(client, cluster_name_on_cloud)
+    names = [v['name'] for v in vms]
+    if not names:
+        logger.warning(f'open_ports({cluster_name_on_cloud}): no VMs '
+                       'found — nothing opened.')
+        return
+    client.upsert_nsg_rule(names, [str(p) for p in ports])
+    logger.info(f'Opened ports {ports} for {cluster_name_on_cloud} '
+                f'(NSG rules on {len(names)} VM(s)).')
 
 
 def cleanup_ports(cluster_name_on_cloud: str,
                   ports: List[str],
                   provider_config: Optional[Dict[str, Any]] = None) -> None:
-    logger.debug(f'cleanup_ports({cluster_name_on_cloud}, {ports})')
+    """In the default per-cluster resource group, the rules die with
+    the group. In a USER-CONFIGURED shared group, `az vm delete` leaves
+    NICs/NSGs behind — delete the skytpu rule explicitly while the VMs
+    still exist (teardown_cluster calls this before terminate)."""
+    del ports
+    assert provider_config is not None
+    if 'resource_group' not in provider_config:
+        return  # dedicated group: teardown removes the NSGs wholesale
+    client = _client(provider_config, cluster_name_on_cloud)
+    names = [v['name'] for v in
+             _cluster_vms(client, cluster_name_on_cloud)]
+    if names:
+        client.delete_nsg_rule(names)

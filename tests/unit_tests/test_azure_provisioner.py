@@ -151,3 +151,43 @@ def test_zone_mismatch_rejected():
     with pytest.raises(provision_common.ProvisionerError,
                        match='eastus-1'):
         az_instance.run_instances('eastus', 'tz', cfg)
+
+
+def test_open_ports_nsg_rules():
+    """`ports:` on Azure = ONE named allow rule per VM NSG, upserted by
+    name: a relaunch with a CHANGED port set replaces it (no priority
+    conflict); shared-resource-group cleanup deletes the rule."""
+    cfg = _config(count=2)
+    az_instance.run_instances('eastus', 'nsg1', cfg)
+    az_instance.open_ports('nsg1', ['8080', '9000-9002'],
+                           cfg.provider_config)
+    client = az_api.make_client(
+        'eastus', az_instance._resource_group(cfg.provider_config,
+                                              'nsg1'))
+    vms = client.list_vms({})
+    assert len(vms) == 2
+    for vm in vms:
+        assert vm['nsgRules']['skytpu-ports'] == ['8080', '9000-9002']
+    # Relaunch with a CHANGED set: the named rule is REPLACED in place.
+    az_instance.open_ports('nsg1', ['8080', '7777'], cfg.provider_config)
+    assert client.list_vms({})[0]['nsgRules']['skytpu-ports'] == \
+        ['8080', '7777']
+    # Dedicated group (default): cleanup_ports defers to group teardown.
+    az_instance.cleanup_ports('nsg1', ['8080'], cfg.provider_config)
+    assert client.list_vms({})[0]['nsgRules']['skytpu-ports']
+    az_instance.terminate_instances('nsg1', cfg.provider_config)
+
+
+def test_cleanup_ports_shared_resource_group():
+    """A user-configured (shared) resource group: `az vm delete` leaves
+    NSGs behind, so cleanup deletes the skytpu rule explicitly."""
+    cfg = _config(count=1)
+    cfg.provider_config['resource_group'] = 'user-shared-rg'
+    az_instance.run_instances('eastus', 'nsg2', cfg)
+    az_instance.open_ports('nsg2', ['8080'], cfg.provider_config)
+    client = az_api.make_client('eastus', 'user-shared-rg')
+    assert client.list_vms({})[0]['nsgRules']['skytpu-ports'] == ['8080']
+    az_instance.cleanup_ports('nsg2', ['8080'], cfg.provider_config)
+    assert 'skytpu-ports' not in client.list_vms({})[0].get('nsgRules',
+                                                            {})
+    az_instance.terminate_instances('nsg2', cfg.provider_config)
