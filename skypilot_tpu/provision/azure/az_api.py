@@ -28,6 +28,10 @@ from skypilot_tpu import sky_logging
 
 logger = sky_logging.init_logger(__name__)
 
+# The single skytpu NSG rule name (`ports:` exposure) — shared by the
+# real and fake transports (and tests) so they can never drift.
+NSG_RULE_NAME = 'skytpu-ports'
+
 _FAKE_STATE_ENV = 'SKYTPU_AZURE_FAKE_STATE'
 
 
@@ -191,7 +195,7 @@ class CliTransport:
     # with a CHANGED port set updates the same rule in place instead of
     # colliding on priority (az vm open-port names rules after the port
     # string — two different port sets at one priority would conflict).
-    NSG_RULE_NAME = 'skytpu-ports'
+    NSG_RULE_NAME = NSG_RULE_NAME
     NSG_RULE_PRIORITY = 900
 
     def upsert_nsg_rule(self, names: List[str],
@@ -328,7 +332,7 @@ class FakeAzureService:
     def delete_vms(self, names: List[str]) -> None:
         self._set_state(names, 'VM deleted')
 
-    NSG_RULE_NAME = 'skytpu-ports'
+    NSG_RULE_NAME = NSG_RULE_NAME
 
     def upsert_nsg_rule(self, names: List[str],
                         ports: List[str]) -> None:

@@ -261,7 +261,8 @@ def cleanup_ports(cluster_name_on_cloud: str,
     the group. In a USER-CONFIGURED shared group, `az vm delete` leaves
     NICs/NSGs behind — delete the skytpu rule explicitly while the VMs
     still exist (teardown_cluster calls this before terminate)."""
-    del ports
+    if not ports:
+        return  # nothing was ever opened — skip the per-VM az calls
     assert provider_config is not None
     if 'resource_group' not in provider_config:
         return  # dedicated group: teardown removes the NSGs wholesale
