@@ -239,3 +239,33 @@ def test_cpu_payload_end_to_end():
     assert 'decode' in out['detail']
     assert out['detail']['decode']['bf16']['tokens_per_sec'] > 0
     assert out['detail']['decode']['int8']['tokens_per_sec'] > 0
+
+
+def test_graft_entry_guard_falls_back_on_down_tunnel():
+    """__graft_entry__ must never wedge the driver's compile check: with
+    the axon tunnel in use but its relay down, import falls back to CPU
+    loudly; without axon (plain CPU env), no probe and no warning."""
+    env = {**os.environ,
+           'JAX_PLATFORMS': 'axon',
+           'PALLAS_AXON_POOL_IPS': '127.0.0.1',
+           harness.RELAY_ENV: f'127.0.0.1:{_free_port()}'}
+    res = subprocess.run(
+        [sys.executable, '-c',
+         'import __graft_entry__\n'
+         'import jax\n'
+         'print("platform:", jax.devices()[0].platform)'],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=REPO_ROOT)
+    assert res.returncode == 0, res.stderr[-1500:]
+    assert 'falling back to the CPU backend' in res.stdout
+    assert 'platform: cpu' in res.stdout
+
+    env_cpu = {**os.environ, 'JAX_PLATFORMS': 'cpu'}
+    env_cpu.pop('PALLAS_AXON_POOL_IPS', None)
+    res = subprocess.run(
+        [sys.executable, '-c',
+         'import __graft_entry__\nprint("ok")'],
+        capture_output=True, text=True, timeout=120, env=env_cpu,
+        cwd=REPO_ROOT)
+    assert res.returncode == 0
+    assert 'falling back' not in res.stdout
