@@ -254,6 +254,16 @@ def check(clouds):
     click.echo(f'Enabled clouds: {", ".join(enabled)}')
 
 
+@cli.command()
+def dashboard():
+    """Print the web dashboard URL (clusters/jobs/services/requests +
+    per-request log viewer), starting a local API server if needed.
+    Parity: the reference's jobs dashboard."""
+    from skypilot_tpu.server import common as server_common
+    url = server_common.check_server_healthy_or_start()
+    click.echo(f'Dashboard: {url}/dashboard')
+
+
 @cli.group()
 def local():
     """The zero-credential Local cloud (parity: `sky local`)."""

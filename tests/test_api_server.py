@@ -203,6 +203,18 @@ def test_dashboard_overview_and_log_pages(api_env):
         sdk.get(sdk.down('dash-c1'))
 
 
+def test_dashboard_cli(api_env):
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli as cli_mod
+    res = CliRunner().invoke(cli_mod.cli, ['dashboard'])
+    assert res.exit_code == 0, res.output
+    url = os.environ['SKYTPU_API_SERVER_URL']
+    assert f'{url}/dashboard' in res.output
+    import requests as requests_lib
+    page = requests_lib.get(f'{url}/dashboard', timeout=10)
+    assert page.status_code == 200 and 'Clusters' in page.text
+
+
 def test_local_up_down_cli(api_env):
     """`skytpu local up/down` (parity: sky local up) — enable the Local
     cloud, run something, tear every Local cluster down with it."""
