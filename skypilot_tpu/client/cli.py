@@ -545,6 +545,40 @@ def api_logs(request_id):
     sdk.stream_and_get(request_id)
 
 
+@api.command(name='info')
+def api_info():
+    """Show the API server's URL, health, and version (parity:
+    `sky api info`)."""
+    import requests as requests_lib
+
+    from skypilot_tpu.server import common as server_common
+    url = server_common.server_url()
+    # ONE guarded fetch: health and version come from the same request,
+    # so a server dying between two calls can't traceback.
+    try:
+        info = requests_lib.get(f'{url}/health', timeout=5).json()
+    except (requests_lib.RequestException, ValueError):
+        click.echo(f'API server: {url} (unreachable)')
+        return
+    click.echo(f'API server: {url} (healthy)')
+    click.echo(f"version: {info.get('version')} "
+               f"(api v{info.get('api_version')})")
+
+
+@api.command(name='stop')
+def api_stop():
+    """Stop the LOCAL auto-started API server (parity: `sky api stop`;
+    a configured remote server is never touched)."""
+    from skypilot_tpu import exceptions as exc_lib
+    from skypilot_tpu.server import common as server_common
+    try:
+        port = server_common.stop_local_server()
+    except exc_lib.ApiServerError as e:
+        raise click.ClickException(str(e))
+    click.echo(f'Stopped local API server on :{port} '
+               '(if it was running).')
+
+
 def main() -> None:
     try:
         cli()  # pylint: disable=no-value-for-parameter

@@ -74,8 +74,34 @@ def check_server_healthy_or_start(start_timeout: float = 30.0) -> str:
         f'{server_log_path()}')
 
 
+def _url_port(url: str) -> int:
+    """Port of a server URL; the default port when the URL omits it."""
+    tail = url.rsplit(':', 1)[-1]
+    return int(tail) if tail.isdigit() else DEFAULT_PORT
+
+
+def stop_local_server(url: Optional[str] = None) -> int:
+    """Stop the LOCAL auto-started server for ``url``. Returns its port.
+
+    Lives next to :func:`_start_local_server` so the kill pattern can
+    never drift from the spawn argv. Raises ApiServerError for remote
+    URLs. The pattern is anchored on the port (a prefix port like 4659
+    must not match 46590).
+    """
+    url = url or server_url()
+    if not is_local_url(url):
+        raise exceptions.ApiServerError(
+            f'API server {url} is remote; not stopping it.')
+    port = _url_port(url)
+    subprocess.run(
+        ['pkill', '-f',
+         f'skypilot_tpu.server.server --port {port}$'],
+        check=False)
+    return port
+
+
 def _start_local_server(url: str) -> None:
-    port = int(url.rsplit(':', 1)[1])
+    port = _url_port(url)
     import skypilot_tpu
     pkg_root = os.path.dirname(os.path.dirname(skypilot_tpu.__file__))
     from skypilot_tpu.skylet import constants

@@ -27,9 +27,8 @@ def api_env(monkeypatch):
     monkeypatch.setenv('SKYTPU_API_SERVER_URL',
                        f'http://127.0.0.1:{port}')
     yield port
-    subprocess.run(['pkill', '-f',
-                    f'skypilot_tpu.server.server --port {port}'],
-                   check=False)
+    from skypilot_tpu.server import common as server_common
+    server_common.stop_local_server(f'http://127.0.0.1:{port}')
 
 
 def _local_task(name, run):
@@ -213,6 +212,37 @@ def test_dashboard_cli(api_env):
     import requests as requests_lib
     page = requests_lib.get(f'{url}/dashboard', timeout=10)
     assert page.status_code == 200 and 'Clusters' in page.text
+
+
+def test_api_info_and_stop_cli(api_env):
+    """`skytpu api info` reports health/version; `api stop` kills the
+    LOCAL auto-started server (and refuses on remote URLs)."""
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli as cli_mod
+    runner = CliRunner()
+    # Boot the server via any verb, then inspect it.
+    sdk.get(sdk.status())
+    res = runner.invoke(cli_mod.cli, ['api', 'info'])
+    assert res.exit_code == 0, res.output
+    assert 'healthy' in res.output and 'version:' in res.output
+
+    res = runner.invoke(cli_mod.cli, ['api', 'stop'])
+    assert res.exit_code == 0, res.output
+    deadline = time.time() + 10
+    from skypilot_tpu.server import common as server_common
+    while time.time() < deadline and server_common.is_healthy():
+        time.sleep(0.5)
+    res = runner.invoke(cli_mod.cli, ['api', 'info'])
+    assert 'unreachable' in res.output
+
+    # Remote URLs are refused.
+    os.environ['SKYTPU_API_SERVER_URL'] = 'http://10.9.9.9:12345'
+    try:
+        res = runner.invoke(cli_mod.cli, ['api', 'stop'])
+        assert res.exit_code != 0
+        assert 'remote' in res.output
+    finally:
+        os.environ.pop('SKYTPU_API_SERVER_URL', None)
 
 
 def test_local_up_down_cli(api_env):

@@ -113,9 +113,8 @@ def api_env(monkeypatch):
                        f'http://127.0.0.1:{port}')
     monkeypatch.setenv('SKYTPU_ALWAYS_UPLOAD', '1')
     yield port
-    subprocess.run(['pkill', '-f',
-                    f'skypilot_tpu.server.server --port {port}'],
-                   check=False)
+    from skypilot_tpu.server import common as server_common
+    server_common.stop_local_server(f'http://127.0.0.1:{port}')
 
 
 def test_uploaded_workdir_survives_client_deletion(api_env, tmp_path):
