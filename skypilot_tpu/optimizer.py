@@ -54,14 +54,17 @@ class Optimizer:
                                                  blocked_resources or [])
             for task in dag.tasks
         }
+        used_greedy = False
         if dag.is_chain() or len(dag.tasks) <= 1:
             plan = Optimizer._optimize_by_dp(dag, candidates, minimize)
         else:
-            plan = Optimizer._optimize_exhaustive(dag, candidates, minimize)
+            plan, used_greedy = Optimizer._optimize_exhaustive(
+                dag, candidates, minimize)
         for task, (resources, _) in plan.items():
             task.best_resources = resources
         if not quiet:
-            Optimizer.print_plan(dag, plan, candidates, minimize)
+            Optimizer.print_plan(dag, plan, candidates, minimize,
+                                 greedy_note=used_greedy)
         return dag
 
     # ------------------------------------------------------------ candidates
@@ -297,10 +300,15 @@ class Optimizer:
     @staticmethod
     def _optimize_exhaustive(
         dag: dag_lib.Dag, candidates, minimize: OptimizeTarget
-    ) -> Dict['task_lib.Task', Tuple[resources_lib.Resources, float]]:
+    ) -> Tuple[Dict['task_lib.Task',
+                    Tuple[resources_lib.Resources, float]], bool]:
         """General DAGs: joint enumeration over top-K candidates per task
         when the placement space fits the budget, else topo-order greedy
         that accounts egress from already-placed parents.
+
+        Returns ``(plan, used_greedy)`` — the caller surfaces the greedy
+        fallback loudly (plan-table footnote), because a greedy plan
+        carries no optimality guarantee.
 
         The reference shells out to an ILP solver (optimizer.py:471);
         bounded enumeration is exact on the same small DAGs and the greedy
@@ -349,11 +357,16 @@ class Optimizer:
             return {
                 t: (topk[t][i][0], topk[t][i][1])
                 for t, i in zip(order, best_choice)
-            }
+            }, False
         # Greedy fallback: place in topo order, charging egress from the
-        # parents placed so far.
-        logger.debug(f'DAG placement space {space} exceeds enumeration '
-                     'budget; using parent-aware greedy.')
+        # parents placed so far. This is NOT cost-optimal in general —
+        # warn loudly so the user knows the plan has no guarantee.
+        logger.warning(
+            f'DAG placement space ({space:,} combinations) exceeds the '
+            f'enumeration budget ({Optimizer._ENUM_LIMIT:,}); falling '
+            'back to a parent-aware greedy placement with NO optimality '
+            'guarantee. Consider splitting the DAG into chains or '
+            'reducing per-task resource alternatives.')
         plan: Dict['task_lib.Task',
                    Tuple[resources_lib.Resources, float]] = {}
         for task in order:
@@ -369,12 +382,13 @@ class Optimizer:
                     best_val, best = total, (cand, cost)
             assert best is not None
             plan[task] = best
-        return plan
+        return plan, True
 
     # ---------------------------------------------------------------- print
 
     @staticmethod
-    def print_plan(dag, plan, candidates, minimize) -> None:
+    def print_plan(dag, plan, candidates, minimize,
+                   greedy_note: bool = False) -> None:
         rows = []
         for task, (chosen, cost) in plan.items():
             n = task.num_nodes
@@ -403,3 +417,9 @@ class Optimizer:
         for r in rows:
             print('  ' + '  '.join(c.ljust(widths[i])
                                    for i, c in enumerate(r)))
+        if greedy_note:
+            print('  NOTE: the DAG placement space exceeded the '
+                  'enumeration budget; this plan was produced by a '
+                  'greedy heuristic and may not be '
+                  f'{minimize.value}-optimal. Splitting the DAG into '
+                  'chains restores the exact optimizer.')

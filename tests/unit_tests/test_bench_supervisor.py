@@ -32,8 +32,8 @@ def _free_port() -> int:
 class _FakeRelay:
     """Accept-and-close listener standing in for the axon relay."""
 
-    def __init__(self):
-        self.port = _free_port()
+    def __init__(self, port=None):
+        self.port = port if port is not None else _free_port()
         self._sock = socket.socket()
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(('127.0.0.1', self.port))
@@ -135,6 +135,55 @@ def test_supervisor_down_tunnel_fails_fast():
     assert res.returncode == 2
     assert 'tunnel is down' in res.stderr
     assert time.time() - t0 < 30
+
+
+def test_supervisor_wait_seconds_overrides_preflight():
+    """SKYTPU_BENCH_WAIT_SECONDS (driver long-wait) takes precedence
+    over the interactive 90 s fast-fail and still bounds the vigil."""
+    t0 = time.time()
+    res = _run_bench({
+        'JAX_PLATFORMS': 'axon',
+        harness.RELAY_ENV: f'127.0.0.1:{_free_port()}',
+        'SKYTPU_BENCH_WAIT_SECONDS': '3',
+        'SKYTPU_BENCH_PREFLIGHT_TIMEOUT': '600',  # must be ignored
+    }, timeout=60)
+    assert res.returncode == 2
+    assert time.time() - t0 < 30
+
+
+def test_supervisor_rides_out_relay_outage():
+    """Relay comes up mid-wait: the bench proceeds, and the attempt
+    budget starts AFTER preflight (a long vigil never starves the
+    bench itself)."""
+    port = _free_port()
+    relay_box = {}
+
+    def _bring_up():
+        time.sleep(12)
+        relay_box['r'] = _FakeRelay(port=port)
+
+    t = threading.Thread(target=_bring_up, daemon=True)
+    t.start()
+    try:
+        res = _run_bench({
+            'JAX_PLATFORMS': 'axon',
+            harness.RELAY_ENV: f'127.0.0.1:{port}',
+            'SKYTPU_BENCH_WAIT_SECONDS': '120',
+            'SKYTPU_BENCH_PAYLOAD_CMD':
+                'import json; print(json.dumps({"ok": 1}), flush=True)',
+            # Tiny total budget, relay up only after 12 s: passes only
+            # because the attempt clock starts AFTER preflight (with
+            # the old pre-preflight clock the budget would already be
+            # spent waiting → rc=3).
+            'SKYTPU_BENCH_TOTAL_TIMEOUT': '10',
+        }, timeout=180)
+        assert res.returncode == 0, res.stderr[-1500:]
+        assert json.loads(res.stdout.strip().splitlines()[-1]) == \
+            {'ok': 1}
+    finally:
+        t.join(timeout=10)
+        if 'r' in relay_box:
+            relay_box['r'].close()
 
 
 def test_supervisor_kills_stalled_payload_and_retries():
