@@ -177,8 +177,12 @@ def _payload() -> None:
             harness.beat(phase)
             out = decode_bench.run_decode_bench(
                 model_name if on_tpu else 'debug',
+                # bs 32 won the decode batch sweep on v5e (tok/s: 16→
+                # 2864, 24→3689, 32→3996, 40→3913, 48→3498, 64→3125):
+                # decode M=16 uses 1/8 of the MXU's M dim; past 40 the
+                # KV-cache attention cost overtakes the matmul gain.
                 batch=int(os.environ.get('SKYTPU_BENCH_DECODE_BATCH',
-                                         '16')),
+                                         '32')),
                 prompt_len=128, new_tokens=128,
                 steps=3, int8=int8,
                 beat=harness.beat)

@@ -198,6 +198,8 @@ class ProvisionBlocklist:
         strikes = min(strikes + 1, self.MAX_STRIKES)
         until = now + self._base * (2**(strikes - 1))
         self._entries[key] = (strikes, until)
+        record_blocklist_event(cloud, region, zone, resource_key,
+                               strikes, until)
 
     def is_blocked(self, cloud: str, region: str, zone: Optional[str],
                    resource_key: str = '') -> bool:
@@ -207,6 +209,60 @@ class ProvisionBlocklist:
             if entry and time.time() < entry[1]:
                 return True
         return False
+
+
+# Blocklist hits are also appended to a jsonl spool so the dashboard
+# can show WHY a launch failed over (the in-memory blocklist dies with
+# the process; the history should not).
+_BLOCKLIST_EVENTS_CAP = 500
+
+
+def _blocklist_events_path() -> str:
+    d = os.path.join(os.path.expanduser('~'), '.skytpu')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, 'blocklist_events.jsonl')
+
+
+def record_blocklist_event(cloud: str, region: str, zone: Optional[str],
+                           resource_key: str, strikes: int,
+                           until: float) -> None:
+    import json
+    try:
+        path = _blocklist_events_path()
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write(json.dumps({
+                'ts': time.time(), 'cloud': cloud, 'region': region,
+                'zone': zone, 'resource': resource_key,
+                'strikes': strikes, 'until': until,
+            }) + '\n')
+        # Bound the spool. Size check first (O(1)): a full readlines()
+        # per append would put an O(n) file scan on the launch path
+        # during failover storms. ~200 bytes/line → truncate past 2x
+        # the cap's byte budget.
+        if os.path.getsize(path) > 2 * _BLOCKLIST_EVENTS_CAP * 200:
+            with open(path, encoding='utf-8') as f:
+                lines = f.readlines()
+            with open(path, 'w', encoding='utf-8') as f:
+                f.writelines(lines[-_BLOCKLIST_EVENTS_CAP:])
+    except OSError:
+        pass  # history is best-effort; never fail a launch over it
+
+
+def read_blocklist_events(limit: int = 20) -> list:
+    import json
+    try:
+        with open(_blocklist_events_path(), encoding='utf-8') as f:
+            lines = f.readlines()
+    except OSError:
+        return []
+    out = []
+    for line in lines[-limit:]:
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            continue
+    out.reverse()  # newest first
+    return out
 
 
 # Process-wide blocklist (the controller/recovery loop shares it across

@@ -270,6 +270,29 @@ def vm_regions_zones(instance_type: str,
 # -------------------------------------------------------------- listings
 
 
+def provenance() -> dict:
+    """Origin stamp of the bundled pricing CSVs (written by
+    ``data_gen.main`` / the live fetchers). Empty dict when absent so
+    old checkouts keep working."""
+    import json
+    path = os.path.join(_DATA_DIR, 'provenance.json')
+    try:
+        with open(path, encoding='utf-8') as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def provenance_line() -> str:
+    """One-line human stamp for CLI surfaces (show-tpus, cost-report)."""
+    p = provenance()
+    if not p:
+        return ''
+    return (f'Catalog: {p.get("source", "unknown origin")} '
+            f'[generated {p.get("generated_at", "?")} by '
+            f'{p.get("generated_by", "?")}]')
+
+
 def list_accelerators(
         gpus_only: bool = False,
         name_filter: Optional[str] = None) -> Dict[str, List[InstanceTypeInfo]]:

@@ -244,6 +244,10 @@ def cost_report():
              f"${r['total_cost']:.2f}" if r['total_cost'] is not None
              else '-') for r in records]
     click.echo(_table(('NAME', 'DURATION', 'RESOURCES', 'COST'), rows))
+    from skypilot_tpu import catalog
+    stamp = catalog.provenance_line()
+    if stamp:
+        click.echo(stamp)
 
 
 @cli.command()
@@ -308,6 +312,9 @@ def show_tpus(name_filter, gpus_only):
     click.echo(_table(
         ('ACCELERATOR', 'CLOUD', 'CHEAPEST REGION', '$/HR', 'SPOT $/HR'),
         rows))
+    stamp = catalog.provenance_line()
+    if stamp:
+        click.echo(stamp)
 
 
 # ------------------------------------------------------------------- jobs

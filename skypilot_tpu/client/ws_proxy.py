@@ -1,0 +1,71 @@
+"""SSH ProxyCommand that tunnels through the API server's websocket.
+
+Parity: the reference pairs its ``/kubernetes-pod-ssh-proxy`` endpoint
+(``sky/server/server.py:1016``) with a client-side websocket proxy so
+users whose only access is the API server URL can still ``ssh`` into
+Kubernetes pods. Usage (what ``skytpu ssh`` generates):
+
+    ssh -o ProxyCommand='python -m skypilot_tpu.client.ws_proxy \
+        http://API_HOST:PORT my-cluster --port 22' user@my-cluster
+
+Bridges this process's stdio to the server's
+``/k8s-pod-ssh-proxy?cluster=...&port=...`` websocket with aiohttp.
+"""
+import asyncio
+import os
+import sys
+from typing import List, Optional
+
+import aiohttp
+
+
+async def _run(server_url: str, cluster: str, port: int) -> int:
+    url = (f'{server_url.rstrip("/")}/k8s-pod-ssh-proxy'
+           f'?cluster={cluster}&port={port}')
+    loop = asyncio.get_event_loop()
+    async with aiohttp.ClientSession() as session:
+        async with session.ws_connect(url, max_msg_size=0) as ws:
+
+            stdin_fd = sys.stdin.fileno()
+            stdout_fd = sys.stdout.fileno()
+
+            async def stdin_to_ws():
+                while True:
+                    data = await loop.run_in_executor(
+                        None, os.read, stdin_fd, 65536)
+                    if not data:
+                        await ws.close()
+                        break
+                    await ws.send_bytes(data)
+
+            async def ws_to_stdout():
+                async for msg in ws:
+                    if msg.type == aiohttp.WSMsgType.BINARY:
+                        await loop.run_in_executor(
+                            None, os.write, stdout_fd, msg.data)
+                    elif msg.type in (aiohttp.WSMsgType.CLOSED,
+                                      aiohttp.WSMsgType.ERROR):
+                        break
+
+            reader_task = asyncio.ensure_future(stdin_to_ws())
+            try:
+                await ws_to_stdout()
+            finally:
+                reader_task.cancel()
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        description='stdio <-> API-server websocket SSH proxy')
+    parser.add_argument('server_url')
+    parser.add_argument('cluster')
+    parser.add_argument('--port', type=int, default=22)
+    args = parser.parse_args(argv)
+    return asyncio.get_event_loop().run_until_complete(
+        _run(args.server_url, args.cluster, args.port))
+
+
+if __name__ == '__main__':
+    sys.exit(main())

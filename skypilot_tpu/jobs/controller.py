@@ -153,7 +153,7 @@ class JobsController:
         next iteration observes the cancel flag and finishes the job.
         """
         logger.info(f'Task {task_id}: {reason}; recovering.')
-        state.set_recovering(self.job_id, task_id)
+        state.set_recovering(self.job_id, task_id, reason)
         recovered = strategy.recover()
         if recovered is not None:
             state.set_recovered(self.job_id, task_id, recovered)

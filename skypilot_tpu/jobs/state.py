@@ -41,6 +41,13 @@ _TABLES = """
         run_timestamp TEXT,
         PRIMARY KEY (job_id, task_id)
     );
+    CREATE TABLE IF NOT EXISTS recovery_events (
+        job_id INTEGER,
+        task_id INTEGER,
+        ts REAL,
+        event TEXT,
+        detail TEXT
+    );
 """
 
 
@@ -206,7 +213,7 @@ def set_started(job_id: int, task_id: int, start_time: float) -> None:
          start_at=start_time, last_recovered_at=start_time)
 
 
-def set_recovering(job_id: int, task_id: int) -> None:
+def set_recovering(job_id: int, task_id: int, reason: str = '') -> None:
     task = get_task(job_id, task_id)
     assert task is not None
     # Accumulate healthy runtime before the preemption.
@@ -215,6 +222,7 @@ def set_recovering(job_id: int, task_id: int) -> None:
         duration += time.time() - task['last_recovered_at']
     _set(job_id, task_id, status=ManagedJobStatus.RECOVERING.value,
          job_duration=duration)
+    add_recovery_event(job_id, task_id, 'RECOVERING', reason)
 
 
 def set_recovered(job_id: int, task_id: int, recovered_time: float) -> None:
@@ -223,6 +231,30 @@ def set_recovered(job_id: int, task_id: int, recovered_time: float) -> None:
     _set(job_id, task_id, status=ManagedJobStatus.RUNNING.value,
          last_recovered_at=recovered_time,
          recovery_count=task['recovery_count'] + 1)
+    add_recovery_event(job_id, task_id, 'RECOVERED',
+                       f'recovery #{task["recovery_count"] + 1}')
+
+
+# ------------------------------------------------------ recovery history
+# Per-job failover history for the dashboard (parity: the reference's
+# jobs dashboard surfaces recovery context —
+# sky/jobs/dashboard/dashboard.py).
+
+
+def add_recovery_event(job_id: int, task_id: int, event: str,
+                       detail: str = '') -> None:
+    with _db() as conn:
+        conn.execute(
+            'INSERT INTO recovery_events (job_id, task_id, ts, event, '
+            'detail) VALUES (?, ?, ?, ?, ?)',
+            (job_id, task_id, time.time(), event, detail))
+
+
+def get_recovery_events(limit: int = 20) -> List[Dict[str, Any]]:
+    rows = _db().execute(
+        'SELECT job_id, task_id, ts, event, detail FROM recovery_events '
+        'ORDER BY ts DESC LIMIT ?', (limit,)).fetchall()
+    return [dict(r) for r in rows]
 
 
 def set_succeeded(job_id: int, task_id: int, end_time: float) -> None:

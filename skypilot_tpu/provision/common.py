@@ -105,6 +105,8 @@ class ClusterInfo:
                     'pod_name': info.tags['pod_name'],
                     'namespace': info.tags.get('namespace', 'default'),
                     'context': info.tags.get('context'),
+                    'access_mode': info.tags.get('access_mode',
+                                                 'kubectl-exec'),
                     'internal_ip': info.internal_ip,
                 })
             else:

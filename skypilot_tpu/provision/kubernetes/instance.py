@@ -33,7 +33,14 @@ _PHASE_MAP = {
 
 
 def _namespace(provider_config: Dict[str, Any]) -> str:
-    return provider_config.get('namespace') or 'default'
+    ns = provider_config.get('namespace')
+    if ns:
+        return ns
+    # In-cluster auth defaults to the service account's own namespace.
+    if (k8s_api.resolve_context(provider_config.get('context')) ==
+            k8s_api.IN_CLUSTER_CONTEXT):
+        return k8s_api.in_cluster_namespace()
+    return 'default'
 
 
 def _client(provider_config: Dict[str, Any]):
@@ -225,6 +232,11 @@ def get_cluster_info(
         }
         if provider_config.get('context'):
             tags['context'] = provider_config['context']
+        # Access mode (parity: the reference's networking_mode):
+        # 'kubectl-exec' (default, no sshd needed) or 'portforward-ssh'
+        # (sshd in the pod, SSH over kubectl port-forward).
+        if provider_config.get('access_mode'):
+            tags['access_mode'] = provider_config['access_mode']
         pod_dir = pod['metadata'].get('annotations',
                                       {}).get('skytpu/pod-dir')
         if pod_dir:

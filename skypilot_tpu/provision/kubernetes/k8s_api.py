@@ -41,6 +41,127 @@ GPU_RESOURCE_KEY = 'nvidia.com/gpu'
 _FAKE_STATE_ENV = 'SKYTPU_K8S_FAKE_STATE'  # json file for cross-process fakes
 _FAKE_ROOT = '~/.skytpu/k8s_fake'
 
+# ---------------------------------------------------------------- auth
+# Two auth modes (parity: sky/provision/kubernetes/utils.py:1468-1598
+# load_kube_config vs in-cluster service-account resolution):
+#
+# * kubeconfig — the default: kubectl resolves $KUBECONFIG /
+#   ~/.kube/config, optionally pinned with --context.
+# * in-cluster — the API server itself runs inside a cluster (helm
+#   deployment): auth from the pod's mounted service-account token,
+#   addressed by the reserved context name ``in-cluster``.
+
+IN_CLUSTER_CONTEXT = 'in-cluster'
+_SA_DIR_ENV = 'SKYTPU_K8S_SA_DIR'  # test override for the mount path
+_DEFAULT_SA_DIR = '/var/run/secrets/kubernetes.io/serviceaccount'
+
+
+def _sa_dir() -> str:
+    return os.environ.get(_SA_DIR_ENV, _DEFAULT_SA_DIR)
+
+
+def in_cluster_available() -> bool:
+    """True when a pod service account is mounted AND the apiserver env
+    is present — i.e. we are running inside a Kubernetes cluster."""
+    d = _sa_dir()
+    return (os.environ.get('KUBERNETES_SERVICE_HOST') is not None and
+            os.path.isfile(os.path.join(d, 'token')) and
+            os.path.isfile(os.path.join(d, 'ca.crt')))
+
+
+def in_cluster_namespace() -> str:
+    """The namespace the service account lives in (defaults to
+    'default' when the mount lacks a namespace file)."""
+    path = os.path.join(_sa_dir(), 'namespace')
+    try:
+        with open(path, encoding='utf-8') as f:
+            return f.read().strip() or 'default'
+    except OSError:
+        return 'default'
+
+
+def _in_cluster_flags() -> List[str]:
+    """kubectl flags replacing --context for in-cluster auth.
+
+    The service-account token must NOT ride on argv (`--token` is
+    world-readable via /proc/*/cmdline); instead a 0600 kubeconfig
+    referencing the token FILE is materialized — kubectl re-reads
+    `tokenFile` per request, so projected-token rotation works too.
+    """
+    d = _sa_dir()
+    host = os.environ['KUBERNETES_SERVICE_HOST']
+    port = os.environ.get('KUBERNETES_SERVICE_PORT', '443')
+    cfg_dir = os.path.join(os.path.expanduser('~'), '.skytpu', 'k8s')
+    os.makedirs(cfg_dir, exist_ok=True)
+    path = os.path.join(cfg_dir, 'incluster.kubeconfig')
+    content = (
+        'apiVersion: v1\n'
+        'kind: Config\n'
+        'clusters:\n'
+        '- name: in-cluster\n'
+        '  cluster:\n'
+        f'    server: https://{host}:{port}\n'
+        f'    certificate-authority: {os.path.join(d, "ca.crt")}\n'
+        'users:\n'
+        '- name: sa\n'
+        '  user:\n'
+        f'    tokenFile: {os.path.join(d, "token")}\n'
+        'contexts:\n'
+        '- name: in-cluster\n'
+        '  context:\n'
+        '    cluster: in-cluster\n'
+        '    user: sa\n'
+        'current-context: in-cluster\n')
+    # Rewrite only on change; always enforce owner-only perms.
+    try:
+        with open(path, encoding='utf-8') as f:
+            current = f.read()
+    except OSError:
+        current = None
+    if current != content:
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, 'w', encoding='utf-8') as f:
+            f.write(content)
+    os.chmod(path, 0o600)
+    return ['--kubeconfig', path]
+
+
+def available_contexts() -> List[str]:
+    """kubeconfig contexts plus the in-cluster pseudo-context (parity:
+    utils.py:1578 — the reference appends its in-cluster context name
+    the same way). Used by `sky check` and multi-context failover."""
+    out: List[str] = []
+    try:
+        proc = subprocess.run(
+            ['kubectl', 'config', 'get-contexts', '-o', 'name'],
+            capture_output=True, text=True, timeout=30, check=False)
+        if proc.returncode == 0:
+            out = [l.strip() for l in proc.stdout.splitlines()
+                   if l.strip()]
+    except (FileNotFoundError, subprocess.TimeoutExpired):
+        pass
+    if in_cluster_available():
+        out.append(IN_CLUSTER_CONTEXT)
+    return out
+
+
+def resolve_context(context: Optional[str]) -> Optional[str]:
+    """Auth resolution: an explicit context wins; otherwise use the
+    kubeconfig default when one exists, else fall back to in-cluster
+    when available (the helm-deployed API server path)."""
+    if context:
+        return context
+    # $KUBECONFIG is a colon-separated path LIST (kubectl merges them);
+    # any existing entry means kubeconfig auth wins over in-cluster.
+    kubeconfig = os.environ.get('KUBECONFIG',
+                                os.path.expanduser('~/.kube/config'))
+    for path in kubeconfig.split(os.pathsep):
+        if path and os.path.exists(os.path.expanduser(path)):
+            return None  # kubectl resolves the current kubeconfig context
+    if in_cluster_available():
+        return IN_CLUSTER_CONTEXT
+    return None
+
 
 class K8sApiError(Exception):
 
@@ -56,14 +177,17 @@ class K8sCapacityError(K8sApiError, provision_common.CapacityError):
 
 
 class KubectlTransport:
-    """Real clusters through the ``kubectl`` binary."""
+    """Real clusters through the ``kubectl`` binary (kubeconfig or
+    in-cluster service-account auth — see module auth notes)."""
 
     def __init__(self, context: Optional[str] = None):
-        self.context = context
+        self.context = resolve_context(context)
 
     def _base(self) -> List[str]:
         argv = ['kubectl']
-        if self.context:
+        if self.context == IN_CLUSTER_CONTEXT:
+            argv += _in_cluster_flags()
+        elif self.context:
             argv += ['--context', self.context]
         return argv
 
@@ -114,6 +238,8 @@ class KubectlTransport:
             logger.debug(f'delete pod {name}: {e}')
 
     def current_context(self) -> Optional[str]:
+        if self.context == IN_CLUSTER_CONTEXT:
+            return IN_CLUSTER_CONTEXT
         try:
             return self._run(['config', 'current-context']).strip() or None
         except (K8sApiError, FileNotFoundError):

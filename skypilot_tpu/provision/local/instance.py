@@ -81,6 +81,16 @@ def _kill_node_processes(cluster_dir: str,
 
 
 def _cluster_dir(cluster_name_on_cloud: str) -> str:
+    # Inside a node (skylet autostop/self-down), $HOME IS the node dir,
+    # so the ~-relative root would resolve to a path that doesn't exist
+    # and every lifecycle call would silently no-op. The node's own
+    # position (SKYTPU_NODE_DIR = <root>/<cluster>/node-N) locates the
+    # real cluster dir.
+    node_dir = os.environ.get('SKYTPU_NODE_DIR', '').rstrip('/')
+    if node_dir:
+        cand = os.path.dirname(node_dir)
+        if os.path.basename(cand) == cluster_name_on_cloud:
+            return cand
     return os.path.expanduser(
         os.path.join(CLUSTER_ROOT, cluster_name_on_cloud))
 

@@ -55,12 +55,26 @@ def runners_from_host_meta(
                 command_runner_lib.LocalProcessRunner(
                     node_id, host['node_dir']))
         elif host['transport'] == 'kubernetes':
-            runners.append(
-                command_runner_lib.KubectlExecRunner(
-                    node_id,
-                    host['pod_name'],
-                    namespace=host.get('namespace', 'default'),
-                    context=host.get('context')))
+            if host.get('access_mode') == 'portforward-ssh':
+                # SSH over kubectl port-forward (pod image runs sshd) —
+                # the reference's 'portforward' networking mode.
+                runners.append(
+                    command_runner_lib.PortForwardSSHRunner(
+                        node_id,
+                        host['pod_name'],
+                        ssh_user=host.get('ssh_user', 'skytpu'),
+                        ssh_private_key=host.get(
+                            'ssh_key', '~/.ssh/skytpu-key'),
+                        namespace=host.get('namespace', 'default'),
+                        context=host.get('context'),
+                        ssh_control_name=f'{host["pod_name"]}'))
+            else:
+                runners.append(
+                    command_runner_lib.KubectlExecRunner(
+                        node_id,
+                        host['pod_name'],
+                        namespace=host.get('namespace', 'default'),
+                        context=host.get('context')))
         else:
             runners.append(
                 command_runner_lib.SSHCommandRunner(

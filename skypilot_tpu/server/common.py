@@ -54,8 +54,15 @@ def is_healthy(url: Optional[str] = None, timeout: float = 2.0) -> bool:
         return False
 
 
-def check_server_healthy_or_start(start_timeout: float = 30.0) -> str:
-    """Returns a healthy server URL, auto-starting a local one if needed."""
+def check_server_healthy_or_start(start_timeout: float = 60.0) -> str:
+    """Returns a healthy server URL, auto-starting a local one if needed.
+
+    Start is serialized behind a per-port file lock: N concurrent
+    clients (threads OR processes — e.g. a load test or parallel CLI
+    invocations) must produce exactly ONE server spawn, with everyone
+    else just waiting on /health. An unserialized start spawns N
+    interpreters that race for the bind and starve the winner.
+    """
     url = server_url()
     if is_healthy(url):
         return url
@@ -63,12 +70,24 @@ def check_server_healthy_or_start(start_timeout: float = 30.0) -> str:
         raise exceptions.ApiServerError(
             f'API server {url} is unreachable (and is remote, so it will '
             'not be auto-started).')
-    _start_local_server(url)
-    deadline = time.time() + start_timeout
-    while time.time() < deadline:
+    from skypilot_tpu.utils import locks
+    lock = locks.FileLock(
+        os.path.join(locks.LOCK_DIR, f'api_server.{_url_port(url)}.lock'),
+        timeout=start_timeout)
+    with lock:
+        # Someone else may have started it while we waited on the lock.
         if is_healthy(url):
             return url
-        time.sleep(0.2)
+        # Hold the lock through the health wait: releasing right after
+        # Popen lets every waiter observe "still unhealthy" during the
+        # server's import phase and spawn again — N interpreters
+        # booting at once starve the one that will win the bind.
+        _start_local_server(url)
+        deadline = time.time() + start_timeout
+        while time.time() < deadline:
+            if is_healthy(url):
+                return url
+            time.sleep(0.2)
     raise exceptions.ApiServerError(
         f'Local API server failed to become healthy; see '
         f'{server_log_path()}')

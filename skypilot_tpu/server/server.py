@@ -201,6 +201,104 @@ async def handle_dashboard_log(request: web.Request) -> web.Response:
     return web.Response(text=page, content_type='text/html')
 
 
+async def handle_pod_ssh_proxy(request: web.Request) -> web.StreamResponse:
+    """SSH-over-websocket proxy to a cluster's head host (parity:
+    ``sky/server/server.py:1016`` kubernetes_pod_ssh_proxy).
+
+    A remote client with no kubeconfig bridges raw SSH bytes over this
+    websocket; the server reaches the pod via ``kubectl port-forward``
+    (Kubernetes transport). Local/fake-pod hosts bridge straight to
+    loopback, SSH hosts straight to the node IP — so one endpoint
+    covers every transport and is testable without a real cluster.
+
+    Query: ``?cluster=<name>&port=<tcp port, default 22>``.
+    Client side: ``python -m skypilot_tpu.client.ws_proxy <url>`` as
+    the SSH ProxyCommand.
+    """
+    from skypilot_tpu import global_state
+
+    cluster = request.query.get('cluster', '')
+    try:
+        port = int(request.query.get('port', '22'))
+    except ValueError:
+        raise web.HTTPBadRequest(
+            text=f'port={request.query.get("port")!r} is not an integer')
+    rec = await asyncio.get_event_loop().run_in_executor(
+        None, global_state.get_cluster_from_name, cluster)
+    if rec is None or rec.get('handle') is None:
+        raise web.HTTPNotFound(text=f'cluster {cluster!r} not found')
+    hosts = getattr(rec['handle'], 'cached_hosts', None) or []
+    if not hosts:
+        raise web.HTTPBadRequest(
+            text=f'cluster {cluster!r} has no reachable hosts')
+    head = hosts[0]
+
+    ws = web.WebSocketResponse()
+    await ws.prepare(request)
+
+    pf = None
+    try:
+        if head['transport'] == 'kubernetes':
+            from skypilot_tpu.utils import k8s_port_forward
+            pf = k8s_port_forward.PortForward(
+                head['pod_name'], port,
+                namespace=head.get('namespace', 'default'),
+                context=head.get('context'))
+            await asyncio.get_event_loop().run_in_executor(None, pf.start)
+            target = ('127.0.0.1', pf.local_port)
+        elif head['transport'] == 'local':
+            target = ('127.0.0.1', port)
+        else:
+            target = (head['ip'], port)
+        try:
+            reader, writer = await asyncio.open_connection(*target)
+        except OSError as e:
+            await ws.close(code=1011,
+                           message=f'connect {target}: {e}'.encode())
+            return ws
+
+        async def ws_to_tcp():
+            try:
+                async for msg in ws:
+                    if msg.type == web.WSMsgType.BINARY:
+                        writer.write(msg.data)
+                        await writer.drain()
+                    elif msg.type in (web.WSMsgType.CLOSE,
+                                      web.WSMsgType.ERROR):
+                        break
+            except (ConnectionError, RuntimeError):
+                pass  # peer reset mid-send: tear down cleanly below
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+        async def tcp_to_ws():
+            try:
+                while True:
+                    data = await reader.read(65536)
+                    if not data:
+                        break
+                    await ws.send_bytes(data)
+            except (ConnectionError, RuntimeError):
+                pass
+            try:
+                await ws.close()
+            except RuntimeError:
+                pass
+
+        # return_exceptions: one leg failing must not orphan the other
+        # mid-await (an abandoned task later touches the finalized
+        # response) nor 500 a websocket that just needs closing.
+        await asyncio.gather(ws_to_tcp(), tcp_to_ws(),
+                             return_exceptions=True)
+    finally:
+        if pf is not None:
+            pf.close()
+    return ws
+
+
 async def handle_health(request: web.Request) -> web.Response:
     del request
     import skypilot_tpu
@@ -223,6 +321,7 @@ def build_app() -> web.Application:
     app.router.add_get('/api/status', handle_api_status)
     app.router.add_post('/api/cancel', handle_api_cancel)
     app.router.add_get('/health', handle_health)
+    app.router.add_get('/k8s-pod-ssh-proxy', handle_pod_ssh_proxy)
     app.router.add_get('/dashboard', handle_dashboard)
     app.router.add_get('/dashboard/log', handle_dashboard_log)
     return app

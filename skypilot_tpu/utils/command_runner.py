@@ -414,6 +414,57 @@ class SSHCommandRunner(CommandRunner):
             proc.stderr)
 
 
+class PortForwardSSHRunner(SSHCommandRunner):
+    """SSH to a pod's sshd through ``kubectl port-forward`` — the
+    reference's ``portforward`` networking mode
+    (``sky/utils/command_runner.py:713`` port_forward_command + the
+    proxy-command script of ``sky/provision/kubernetes/utils.py``).
+
+    The ProxyCommand runs ``python -m skypilot_tpu.utils.
+    k8s_port_forward``, which spawns the port-forward and bridges SSH's
+    stdio to the forwarded socket — so every ``run``/``rsync`` inherits
+    the full SSH feature set (control master, rsync -e) while the
+    traffic rides the Kubernetes apiserver instead of a reachable IP.
+    Requires sshd in the pod image; pods without sshd use
+    :class:`KubectlExecRunner` (the default ``kubectl-exec`` mode).
+    """
+
+    def __init__(self,
+                 node_id: str,
+                 pod_name: str,
+                 ssh_user: str,
+                 ssh_private_key: str,
+                 namespace: str = 'default',
+                 context: Optional[str] = None,
+                 remote_port: int = 22,
+                 ssh_control_name: Optional[str] = None):
+        import sys as _sys
+        proxy = (f'{shlex.quote(_sys.executable)} -m '
+                 f'skypilot_tpu.utils.k8s_port_forward '
+                 f'{shlex.quote(namespace)} {shlex.quote(pod_name)} '
+                 f'{remote_port}')
+        if context:
+            proxy += f' --context {shlex.quote(context)}'
+        super().__init__(node_id,
+                         ip='127.0.0.1',
+                         ssh_user=ssh_user,
+                         ssh_private_key=ssh_private_key,
+                         ssh_control_name=ssh_control_name,
+                         port=remote_port,
+                         proxy_command=proxy)
+        self.pod_name = pod_name
+        self.namespace = namespace
+        self.context = context
+
+    def port_forward_command(self, remote_port: int) -> List[str]:
+        """kubectl argv for forwarding an ephemeral local port to
+        ``remote_port`` on this pod (used by the API server's
+        SSH-over-websocket proxy)."""
+        from skypilot_tpu.utils import k8s_port_forward
+        return k8s_port_forward.port_forward_command(
+            self.pod_name, remote_port, self.namespace, self.context)
+
+
 def _tee(log_path: str, content: str, stream: bool) -> None:
     if stream and content:
         print(content, end='' if content.endswith('\n') else '\n')

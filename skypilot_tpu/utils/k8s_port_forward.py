@@ -1,0 +1,196 @@
+"""Kubernetes port-forward access mode: reach TCP ports inside pods.
+
+Parity: the reference's ``portforward`` networking mode —
+``sky/utils/command_runner.py:713`` (KubernetesCommandRunner.
+port_forward_command) and the proxy-command script it materializes
+(``sky/provision/kubernetes/utils.py`` PORT_FORWARD_PROXY_CMD_TEMPLATE).
+TPU-native redesign: one module owns the whole mode —
+
+* :class:`PortForward` — context manager around
+  ``kubectl port-forward pod/<name> :<port>``: spawns, parses the
+  ephemeral local port from kubectl's stdout, kills on exit.
+* ``python -m skypilot_tpu.utils.k8s_port_forward NS POD PORT`` — an SSH
+  ``ProxyCommand`` that bridges stdio to the forwarded socket (the
+  reference ships a bash script using socat; this is the same bridge in
+  stdlib Python, no socat dependency).
+
+The ``kubectl`` binary is resolved from ``$PATH`` (tests drop a fake
+kubectl in front to emulate the apiserver without a cluster).
+"""
+import os
+import select
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_FORWARD_READY_PREFIX = 'Forwarding from 127.0.0.1:'
+
+
+def port_forward_command(pod_name: str,
+                         remote_port: int,
+                         namespace: str = 'default',
+                         context: Optional[str] = None,
+                         local_port: Optional[int] = None) -> List[str]:
+    """The kubectl argv for forwarding ``local_port`` (ephemeral when
+    None) to ``remote_port`` on the pod."""
+    argv = ['kubectl']
+    if context:
+        argv += ['--context', context]
+    local = str(local_port) if local_port is not None else ''
+    argv += [
+        '-n', namespace, 'port-forward', f'pod/{pod_name}',
+        f'{local}:{remote_port}'
+    ]
+    return argv
+
+
+class PortForward:
+    """``kubectl port-forward`` as a context manager.
+
+    >>> with PortForward('pod-0', 22, namespace='default') as pf:
+    ...     sock = socket.create_connection(('127.0.0.1', pf.local_port))
+    """
+
+    def __init__(self,
+                 pod_name: str,
+                 remote_port: int,
+                 namespace: str = 'default',
+                 context: Optional[str] = None,
+                 local_port: Optional[int] = None,
+                 ready_timeout: float = 30.0):
+        self.pod_name = pod_name
+        self.remote_port = remote_port
+        self.namespace = namespace
+        self.context = context
+        self.local_port = local_port
+        self.ready_timeout = ready_timeout
+        self._proc: Optional[subprocess.Popen] = None
+
+    def start(self) -> 'PortForward':
+        argv = port_forward_command(self.pod_name, self.remote_port,
+                                    self.namespace, self.context,
+                                    self.local_port)
+        self._proc = subprocess.Popen(argv,
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT,
+                                      text=True)
+        deadline = time.time() + self.ready_timeout
+        assert self._proc.stdout is not None
+        out_fd = self._proc.stdout.fileno()
+        buf = ''
+        while time.time() < deadline:
+            # select-gate the read: a bare readline() blocks forever on
+            # a kubectl that connected but never prints (hung
+            # apiserver), defeating ready_timeout entirely.
+            readable, _, _ = select.select([out_fd], [], [],
+                                           min(1.0, deadline - time.time()))
+            if not readable:
+                continue
+            chunk = os.read(out_fd, 4096).decode(errors='replace')
+            if not chunk:
+                rc = self._proc.poll()
+                self.close()
+                raise ConnectionError(
+                    f'kubectl port-forward to {self.pod_name}:'
+                    f'{self.remote_port} exited rc={rc} before becoming '
+                    'ready')
+            buf += chunk
+            if _FORWARD_READY_PREFIX in buf and '->' in buf.split(
+                    _FORWARD_READY_PREFIX, 1)[1]:
+                # "Forwarding from 127.0.0.1:40123 -> 22" (the '->'
+                # guard: a chunk boundary can split the port digits).
+                after = buf.split(_FORWARD_READY_PREFIX, 1)[1]
+                self.local_port = int(after.split('->')[0].strip())
+                # Drain further kubectl chatter so its pipe never blocks.
+                t = threading.Thread(target=self._drain, daemon=True)
+                t.start()
+                return self
+        self.close()
+        raise TimeoutError(
+            f'kubectl port-forward to {self.pod_name}:{self.remote_port} '
+            f'not ready within {self.ready_timeout}s')
+
+    def _drain(self) -> None:
+        try:
+            assert self._proc is not None and self._proc.stdout is not None
+            for _ in self._proc.stdout:
+                pass
+        except (ValueError, OSError):
+            pass
+
+    def close(self) -> None:
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+        self._proc = None
+
+    def __enter__(self) -> 'PortForward':
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _bridge_stdio(host: str, port: int) -> None:
+    """Pump raw bytes between our stdio and a TCP socket (the SSH
+    ProxyCommand contract)."""
+    sock = socket.create_connection((host, port))
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    stdin_fd = sys.stdin.fileno()
+    stdout_fd = sys.stdout.fileno()
+    watch: list = [stdin_fd, sock]
+    try:
+        while True:
+            readable, _, _ = select.select(watch, [], [])
+            if stdin_fd in readable:
+                data = os.read(stdin_fd, 65536)
+                if not data:
+                    # stdin EOF: half-close the write side and keep
+                    # draining the socket until the peer closes —
+                    # otherwise in-flight response bytes are lost.
+                    watch.remove(stdin_fd)
+                    try:
+                        sock.shutdown(socket.SHUT_WR)
+                    except OSError:
+                        pass
+                else:
+                    sock.sendall(data)
+            if sock in readable:
+                data = sock.recv(65536)
+                if not data:
+                    break
+                os.write(stdout_fd, data)
+    finally:
+        sock.close()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        description='SSH ProxyCommand: stdio <-> kubectl port-forward')
+    parser.add_argument('namespace')
+    parser.add_argument('pod_name')
+    parser.add_argument('remote_port', type=int)
+    parser.add_argument('--context', default=None)
+    args = parser.parse_args(argv)
+    with PortForward(args.pod_name,
+                     args.remote_port,
+                     namespace=args.namespace,
+                     context=args.context) as pf:
+        assert pf.local_port is not None
+        _bridge_stdio('127.0.0.1', pf.local_port)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

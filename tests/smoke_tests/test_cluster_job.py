@@ -1,0 +1,119 @@
+"""Cluster-job matrix smoke (parity: smoke_tests/test_cluster_job.py):
+multi-job queues, logs-follow, multi-node gang output, cancel-one-of-
+many — the flows a user hits daily on a long-lived cluster."""
+from tests.smoke_tests import smoke_utils
+from tests.smoke_tests.smoke_utils import Test
+
+
+def test_multi_job_queue_and_follow(generic_cloud):
+    """Three jobs on one cluster: ids increase in submission order, all
+    succeed, `logs` in FOLLOW mode streams to completion and exits."""
+    name = smoke_utils.unique_name('smoke-matrix')
+    smoke_utils.run_one_test(
+        Test(
+            name='cluster-job-matrix',
+            commands=[
+                '{skytpu} launch -c ' + name +
+                ' --cloud {cloud} -d "echo job-one-out"',
+                '{skytpu} exec "echo job-two-out" -c ' + name + ' -d',
+                '{skytpu} exec "echo job-three-out" -c ' + name + ' -d',
+                # All three listed, ids in submission order.
+                'for i in $(seq 1 90); do '
+                'n=$({skytpu} queue ' + name +
+                ' | grep -c SUCCEEDED); test "$n" = 3 && break; '
+                'sleep 2; done',
+                '{skytpu} queue ' + name + ' | grep SUCCEEDED | wc -l '
+                '| grep -q 3',
+                # logs FOLLOW mode (no --no-follow): streams the whole
+                # job then exits on its own — bounded by `timeout` so a
+                # follow-forever regression fails rather than hangs.
+                'timeout 60 {skytpu} logs ' + name + ' 2 | '
+                'grep job-two-out',
+                # Jobs 1 and 3 retrievable after completion too.
+                '{skytpu} logs ' + name + ' 1 --no-follow | '
+                'grep job-one-out',
+                '{skytpu} logs ' + name + ' 3 --no-follow | '
+                'grep job-three-out',
+            ],
+            teardown='{skytpu} down ' + name,
+            timeout=10 * 60,
+        ), generic_cloud)
+
+
+def test_cancel_one_of_many(generic_cloud):
+    """Cancel one job on a busy cluster; the others are untouched."""
+    name = smoke_utils.unique_name('smoke-cmany')
+    smoke_utils.run_one_test(
+        Test(
+            name='cancel-one-of-many',
+            commands=[
+                '{skytpu} launch -c ' + name +
+                ' --cloud {cloud} -d "sleep 600"',
+                '{skytpu} exec "echo survivor-out" -c ' + name + ' -d',
+                'for i in $(seq 1 60); do '
+                '{skytpu} queue ' + name + ' | grep -q RUNNING && '
+                'break; sleep 2; done',
+                '{skytpu} cancel ' + name + ' -j 1',
+                'for i in $(seq 1 30); do '
+                '{skytpu} queue ' + name + ' | grep -q CANCELLED && '
+                'break; sleep 2; done',
+                # Job 1 cancelled; job 2 still completes fine.
+                '{skytpu} queue ' + name + ' | grep CANCELLED',
+                'for i in $(seq 1 60); do '
+                '{skytpu} queue ' + name + ' | grep -q SUCCEEDED && '
+                'break; sleep 2; done',
+                '{skytpu} logs ' + name + ' 2 --no-follow | '
+                'grep survivor-out',
+            ],
+            teardown='{skytpu} down ' + name,
+        ), generic_cloud)
+
+
+def test_multi_node_gang_output(generic_cloud):
+    """--num-nodes 2: the gang runtime fans the job out to every rank
+    and aggregates both ranks' output into the job log."""
+    name = smoke_utils.unique_name('smoke-gang')
+    smoke_utils.run_one_test(
+        Test(
+            name='multi-node-gang',
+            commands=[
+                '{skytpu} launch -c ' + name + ' --cloud {cloud} '
+                '--num-nodes 2 -d "echo rank-proof-\\$SKYTPU_NODE_RANK"',
+                'for i in $(seq 1 90); do '
+                '{skytpu} queue ' + name + ' | grep -q SUCCEEDED && '
+                'break; sleep 2; done',
+                '{skytpu} logs ' + name + ' 1 --no-follow | '
+                'grep rank-proof-0',
+                '{skytpu} logs ' + name + ' 1 --no-follow | '
+                'grep rank-proof-1',
+            ],
+            teardown='{skytpu} down ' + name,
+            timeout=10 * 60,
+        ), generic_cloud)
+
+
+def test_autostop_down_waits_to_zero(generic_cloud):
+    """autostop -i 0 --down actually removes the idle cluster (parity:
+    the reference's autostop wait scenarios, not just flag-setting)."""
+    name = smoke_utils.unique_name('smoke-adown')
+    smoke_utils.run_one_test(
+        Test(
+            name='autostop-down-wait',
+            commands=[
+                '{skytpu} launch -c ' + name +
+                ' --cloud {cloud} -d "echo ok"',
+                'for i in $(seq 1 60); do '
+                '{skytpu} queue ' + name + ' | grep -q SUCCEEDED && '
+                'break; sleep 2; done',
+                '{skytpu} autostop ' + name + ' -i 0 --down',
+                # The skylet notices idleness and tears the cluster
+                # down on its own — poll `status -r` (the refresh
+                # reconciles the registry against the dead cluster).
+                'for i in $(seq 1 40); do '
+                '{skytpu} status -r | grep -q ' + name +
+                ' || break; sleep 2; done',
+                '! {skytpu} status -r | grep ' + name,
+            ],
+            teardown='{skytpu} down ' + name + ' || true',
+            timeout=10 * 60,
+        ), generic_cloud)

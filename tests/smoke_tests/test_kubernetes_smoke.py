@@ -1,0 +1,33 @@
+"""Kubernetes smoke: the full CLI pipeline against the fake GKE cluster
+(directory-backed pods, real scheduler semantics). Runs only under the
+local tier — on real clouds the generic scenarios already cover k8s via
+--generic-cloud kubernetes with a live kubeconfig."""
+import pytest
+
+from skypilot_tpu import global_state
+from tests.smoke_tests import smoke_utils
+from tests.smoke_tests.smoke_utils import Test
+
+
+def test_k8s_fake_launch_cli(generic_cloud):
+    if generic_cloud != 'local':
+        pytest.skip('fake-GKE smoke is a local-tier scenario')
+    global_state.set_enabled_clouds(['Kubernetes'])
+    name = smoke_utils.unique_name('smoke-k8s')
+    smoke_utils.run_one_test(
+        Test(
+            name='k8s-fake-launch',
+            commands=[
+                '{skytpu} launch -c ' + name + ' --cloud kubernetes '
+                '-d "echo k8s-pod-proof"',
+                '{skytpu} status | grep ' + name,
+                'for i in $(seq 1 90); do '
+                '{skytpu} queue ' + name + ' | grep -q SUCCEEDED && '
+                'break; sleep 2; done',
+                '{skytpu} logs ' + name + ' 1 --no-follow | '
+                'grep k8s-pod-proof',
+            ],
+            teardown='{skytpu} down ' + name,
+            env={'SKYTPU_K8S_FAKE': '1'},
+            timeout=10 * 60,
+        ), generic_cloud)

@@ -1,0 +1,33 @@
+"""Region/zone pinning smoke (parity: smoke_tests/test_region_and_zone
+.py): a pinned launch lands (and an impossible pin fails fast with a
+useful error instead of provisioning anyway)."""
+from tests.smoke_tests import smoke_utils
+from tests.smoke_tests.smoke_utils import Test
+
+# Every cloud's well-known pinnable region for the smoke tier; the
+# Local cloud advertises exactly one region named 'local'.
+_PIN_REGION = {'local': 'local', 'gcp': 'us-central1', 'aws': 'us-east-1'}
+
+
+def test_region_pinned_launch(generic_cloud):
+    name = smoke_utils.unique_name('smoke-region')
+    region = _PIN_REGION.get(generic_cloud, 'local')
+    smoke_utils.run_one_test(
+        Test(
+            name='region-pin',
+            commands=[
+                '{skytpu} launch -c ' + name + ' --cloud {cloud} '
+                '--region ' + region + ' -d "echo region-ok"',
+                'for i in $(seq 1 60); do '
+                '{skytpu} queue ' + name + ' | grep -q SUCCEEDED && '
+                'break; sleep 2; done',
+                '{skytpu} logs ' + name + ' 1 --no-follow | '
+                'grep region-ok',
+                # An impossible region is refused by the optimizer
+                # before any provisioning starts.
+                '! {skytpu} launch -c ' + name + '-bad --cloud {cloud} '
+                '--region no-such-region-xyz -d "echo nope"',
+            ],
+            teardown='{skytpu} down ' + name + ' || true',
+            timeout=10 * 60,
+        ), generic_cloud)

@@ -39,3 +39,94 @@ def test_serve_up_traffic_down(generic_cloud):
                      '; rm -f /tmp/' + name + '.yaml',
             timeout=10 * 60,
         ), generic_cloud)
+
+
+def _service_yaml(name: str) -> str:
+    return (
+        'port=$((20000 + RANDOM % 20000)); '
+        'cat > /tmp/' + name + '.yaml <<EOF\n'
+        'name: ' + name + '\n'
+        'resources:\n'
+        '  cloud: {cloud}\n'
+        'service:\n'
+        '  readiness_probe:\n'
+        '    path: /\n'
+        '    initial_delay_seconds: 60\n'
+        '  replicas: 1\n'
+        '  replica_port: $port\n'
+        'run: exec python3 -m http.server \\$SKYTPU_REPLICA_PORT\n'
+        'EOF')
+
+
+_WAIT_READY = ('for i in $(seq 1 90); do '
+               '{skytpu} serve status NAME | grep -q READY && break; '
+               'sleep 2; done')
+_CURL_LB = ('ep=$({skytpu} serve status NAME | '
+            'grep -oE "http://[0-9.:]+" | head -1); '
+            'curl -sf "$ep/" | grep -q "Directory listing"')
+
+
+def test_serve_lb_kill_recovery(generic_cloud):
+    """Kill the load-balancer PROCESS under a live service: the
+    controller's supervision loop restarts it and traffic succeeds
+    again — the process-model guarantee, driven via the real CLI."""
+    name = smoke_utils.unique_name('smoke-lbk')
+    smoke_utils.run_one_test(
+        Test(
+            name='serve-lb-kill',
+            commands=[
+                _service_yaml(name),
+                '{skytpu} serve up /tmp/' + name + '.yaml -n ' + name,
+                _WAIT_READY.replace('NAME', name),
+                _CURL_LB.replace('NAME', name),
+                # Find the LB port from the endpoint and kill exactly
+                # that LB process.
+                'ep=$({skytpu} serve status ' + name + ' | '
+                'grep -oE "http://[0-9.:]+" | head -1); '
+                'lbport=$(echo $ep | grep -oE "[0-9]+$"); '
+                'pkill -f "serve.load_balancer --port $lbport"',
+                # Controller notices the dead LB and respawns it; the
+                # endpoint answers again.
+                'for i in $(seq 1 60); do '
+                'ep=$({skytpu} serve status ' + name + ' | '
+                'grep -oE "http://[0-9.:]+" | head -1); '
+                'curl -sf "$ep/" 2>/dev/null | '
+                'grep -q "Directory listing" && break; sleep 2; done',
+                _CURL_LB.replace('NAME', name),
+            ],
+            teardown='{skytpu} serve down ' + name +
+                     '; rm -f /tmp/' + name + '.yaml',
+            timeout=10 * 60,
+        ), generic_cloud)
+
+
+def test_serve_rolling_update(generic_cloud):
+    """`serve update`: replicas roll to the new spec while the service
+    stays up, and traffic succeeds after the roll (parity: the
+    reference's rolling-update smoke)."""
+    name = smoke_utils.unique_name('smoke-roll')
+    smoke_utils.run_one_test(
+        Test(
+            name='serve-rolling-update',
+            commands=[
+                _service_yaml(name),
+                '{skytpu} serve up /tmp/' + name + '.yaml -n ' + name,
+                _WAIT_READY.replace('NAME', name),
+                _CURL_LB.replace('NAME', name),
+                # Update with a fresh replica_port: replicas roll.
+                _service_yaml(name),
+                '{skytpu} serve update ' + name + ' /tmp/' + name +
+                '.yaml',
+                'sleep 5',
+                _WAIT_READY.replace('NAME', name),
+                'for i in $(seq 1 90); do '
+                'ep=$({skytpu} serve status ' + name + ' | '
+                'grep -oE "http://[0-9.:]+" | head -1); '
+                'curl -sf "$ep/" 2>/dev/null | '
+                'grep -q "Directory listing" && break; sleep 2; done',
+                _CURL_LB.replace('NAME', name),
+            ],
+            teardown='{skytpu} serve down ' + name +
+                     '; rm -f /tmp/' + name + '.yaml',
+            timeout=10 * 60,
+        ), generic_cloud)

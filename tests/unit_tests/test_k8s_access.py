@@ -1,0 +1,266 @@
+"""Kubernetes access modes: port-forward transport + in-cluster auth.
+
+Parity targets: ``sky/utils/command_runner.py:713`` (port-forward
+networking mode), ``sky/provision/kubernetes/utils.py:1468-1598`` (auth
+resolution). All tests are fake-backed: a fake ``kubectl`` on $PATH
+emulates the apiserver's port-forward (listens locally and bridges to a
+target server), so no cluster is needed.
+"""
+import os
+import socket
+import stat
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from skypilot_tpu.provision.kubernetes import k8s_api
+from skypilot_tpu.utils import command_runner as cr
+from skypilot_tpu.utils import k8s_port_forward
+
+_FAKE_KUBECTL = '''#!%(python)s
+"""Fake kubectl: emulates port-forward + config get-contexts."""
+import os, socket, sys, threading
+
+def bridge(conn, target_port):
+    try:
+        dst = socket.create_connection(('127.0.0.1', target_port))
+    except OSError:
+        conn.close(); return
+    def pump(a, b):
+        try:
+            while True:
+                d = a.recv(65536)
+                if not d: break
+                b.sendall(d)
+        except OSError: pass
+        finally:
+            try: b.shutdown(socket.SHUT_WR)
+            except OSError: pass
+    t = threading.Thread(target=pump, args=(conn, dst), daemon=True)
+    t.start(); pump(dst, conn); t.join()
+
+args = sys.argv[1:]
+if args[:3] == ['config', 'get-contexts', '-o']:
+    print('ctx-a\\nctx-b'); sys.exit(0)
+if 'port-forward' in args:
+    i = args.index('port-forward')
+    spec = args[i + 2]            # 'LOCAL:REMOTE' or ':REMOTE'
+    local = int(spec.split(':')[0] or 0)
+    target = int(os.environ['FAKE_KUBECTL_TARGET_PORT'])
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(('127.0.0.1', local)); srv.listen(8)
+    print('Forwarding from 127.0.0.1:%%d -> %%s'
+          %% (srv.getsockname()[1], spec.split(':')[1]), flush=True)
+    while True:
+        conn, _ = srv.accept()
+        threading.Thread(target=bridge, args=(conn, target),
+                         daemon=True).start()
+sys.exit(1)
+''' % {'python': sys.executable}
+
+
+class _EchoServer:
+    """TCP server echoing every byte back, standing in for pod sshd."""
+
+    def __init__(self):
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(('127.0.0.1', 0))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._echo, args=(conn,),
+                             daemon=True).start()
+
+    @staticmethod
+    def _echo(conn):
+        try:
+            while True:
+                data = conn.recv(65536)
+                if not data:
+                    break
+                conn.sendall(data)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self._stop = True
+        self._sock.close()
+
+
+@pytest.fixture
+def fake_kubectl(tmp_path, monkeypatch):
+    """Fake kubectl on $PATH bridging port-forwards to an echo server."""
+    path = tmp_path / 'kubectl'
+    path.write_text(_FAKE_KUBECTL)
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+    echo = _EchoServer()
+    monkeypatch.setenv('PATH', f'{tmp_path}{os.pathsep}'
+                       f'{os.environ["PATH"]}')
+    monkeypatch.setenv('FAKE_KUBECTL_TARGET_PORT', str(echo.port))
+    yield echo
+    echo.close()
+
+
+# ------------------------------------------------------- port-forward
+
+
+def test_port_forward_command_argv():
+    argv = k8s_port_forward.port_forward_command(
+        'pod-3', 22, namespace='ns1', context='gke_x')
+    assert argv == ['kubectl', '--context', 'gke_x', '-n', 'ns1',
+                    'port-forward', 'pod/pod-3', ':22']
+    argv = k8s_port_forward.port_forward_command('pod-3', 8080,
+                                                 local_port=9000)
+    assert argv[-1] == '9000:8080'
+
+
+def test_port_forward_context_manager(fake_kubectl):
+    """PortForward spawns kubectl, parses the ephemeral port, and the
+    forwarded socket reaches the 'pod' (echo server)."""
+    with k8s_port_forward.PortForward('pod-0', 22) as pf:
+        assert pf.local_port
+        with socket.create_connection(('127.0.0.1', pf.local_port),
+                                      timeout=10) as s:
+            s.sendall(b'hello-pod')
+            assert s.recv(65536) == b'hello-pod'
+
+
+def test_port_forward_failure_is_loud(fake_kubectl, monkeypatch):
+    """kubectl dying before the ready line raises, not hangs."""
+    monkeypatch.setenv('FAKE_KUBECTL_TARGET_PORT', 'x')  # script crashes
+    with pytest.raises((ConnectionError, TimeoutError)):
+        k8s_port_forward.PortForward('pod-0', 22,
+                                     ready_timeout=15).start()
+
+
+def test_proxycommand_bridges_stdio(fake_kubectl):
+    """python -m skypilot_tpu.utils.k8s_port_forward == SSH
+    ProxyCommand: stdio bytes flow to the pod and back."""
+    proc = subprocess.run(
+        [sys.executable, '-m', 'skypilot_tpu.utils.k8s_port_forward',
+         'default', 'pod-0', '22'],
+        input=b'proxy-bytes',
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        timeout=60,
+        check=False,
+        cwd=os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+    assert proc.returncode == 0, proc.stderr.decode()[-1500:]
+    assert proc.stdout == b'proxy-bytes'
+
+
+def test_portforward_ssh_runner_shape():
+    """The runner embeds the module ProxyCommand and exposes the
+    port_forward_command the websocket proxy uses."""
+    runner = cr.PortForwardSSHRunner('rank-0', 'pod-7', 'skytpu',
+                                     '~/.ssh/key', namespace='ns',
+                                     context='ctx')
+    base = runner._ssh_base()  # pylint: disable=protected-access
+    proxy = [a for a in base if 'k8s_port_forward' in a]
+    assert proxy, base
+    assert 'ns pod-7 22' in proxy[0]
+    assert '--context ctx' in proxy[0]
+    assert runner.port_forward_command(22)[-2:] == ['pod/pod-7', ':22']
+
+
+def test_runner_selection_by_access_mode():
+    """provisioner picks the runner from host access_mode (default
+    kubectl-exec; portforward-ssh opts into SSH-over-port-forward)."""
+    from skypilot_tpu.provision import provisioner
+    hosts = [{
+        'transport': 'kubernetes', 'rank': 0, 'pod_name': 'p0',
+        'namespace': 'default', 'context': None,
+        'access_mode': 'kubectl-exec',
+    }, {
+        'transport': 'kubernetes', 'rank': 1, 'pod_name': 'p1',
+        'namespace': 'default', 'context': None,
+        'access_mode': 'portforward-ssh',
+    }]
+    runners = provisioner.runners_from_host_meta(hosts)
+    assert isinstance(runners[0], cr.KubectlExecRunner)
+    assert isinstance(runners[1], cr.PortForwardSSHRunner)
+
+
+# ---------------------------------------------------------------- auth
+
+
+@pytest.fixture
+def sa_mount(tmp_path, monkeypatch):
+    """A fake service-account mount + apiserver env (in-cluster)."""
+    sa = tmp_path / 'serviceaccount'
+    sa.mkdir()
+    (sa / 'token').write_text('tok-123')
+    (sa / 'ca.crt').write_text('CERT')
+    (sa / 'namespace').write_text('skytpu-system')
+    monkeypatch.setenv('SKYTPU_K8S_SA_DIR', str(sa))
+    monkeypatch.setenv('KUBERNETES_SERVICE_HOST', '10.0.0.1')
+    monkeypatch.setenv('KUBERNETES_SERVICE_PORT', '6443')
+    return sa
+
+
+def test_in_cluster_detection(sa_mount, monkeypatch):
+    assert k8s_api.in_cluster_available()
+    assert k8s_api.in_cluster_namespace() == 'skytpu-system'
+    monkeypatch.delenv('KUBERNETES_SERVICE_HOST')
+    assert not k8s_api.in_cluster_available()
+
+
+def test_in_cluster_transport_flags(sa_mount, tmp_path, monkeypatch):
+    """in-cluster transport authenticates via a materialized 0600
+    kubeconfig that references the token FILE — the SA token must
+    never ride on argv (visible in /proc/*/cmdline)."""
+    monkeypatch.setenv('HOME', str(tmp_path))
+    t = k8s_api.KubectlTransport(k8s_api.IN_CLUSTER_CONTEXT)
+    base = t._base()  # pylint: disable=protected-access
+    assert '--kubeconfig' in base
+    assert '--context' not in base
+    assert all('tok-123' not in a for a in base)  # token not on argv
+    cfg_path = base[base.index('--kubeconfig') + 1]
+    assert os.stat(cfg_path).st_mode & 0o777 == 0o600
+    content = open(cfg_path, encoding='utf-8').read()
+    assert 'server: https://10.0.0.1:6443' in content
+    assert f'tokenFile: {sa_mount}/token' in content
+    assert f'certificate-authority: {sa_mount}/ca.crt' in content
+    assert 'tok-123' not in content  # file path, not the secret itself
+    assert t.current_context() == k8s_api.IN_CLUSTER_CONTEXT
+
+
+def test_resolve_context_fallback(sa_mount, monkeypatch, tmp_path):
+    # Explicit context always wins.
+    assert k8s_api.resolve_context('gke_prod') == 'gke_prod'
+    # No kubeconfig + in-cluster mount -> in-cluster.
+    monkeypatch.setenv('KUBECONFIG', str(tmp_path / 'nope'))
+    assert k8s_api.resolve_context(None) == k8s_api.IN_CLUSTER_CONTEXT
+    # A kubeconfig present -> kubectl's default context (None).
+    cfg = tmp_path / 'kube.config'
+    cfg.write_text('apiVersion: v1')
+    monkeypatch.setenv('KUBECONFIG', str(cfg))
+    assert k8s_api.resolve_context(None) is None
+
+
+def test_available_contexts_merges_in_cluster(sa_mount, fake_kubectl):
+    ctxs = k8s_api.available_contexts()
+    assert 'ctx-a' in ctxs and 'ctx-b' in ctxs
+    assert k8s_api.IN_CLUSTER_CONTEXT in ctxs
+
+
+def test_in_cluster_namespace_default(sa_mount, monkeypatch, tmp_path):
+    empty = tmp_path / 'sa2'
+    empty.mkdir()
+    monkeypatch.setenv('SKYTPU_K8S_SA_DIR', str(empty))
+    assert k8s_api.in_cluster_namespace() == 'default'

@@ -1,0 +1,174 @@
+"""Depth pass for the three thinnest provisioners (VERDICT-r4 item 9):
+vSphere / SCP / IBM — auth-mode resolution, error taxonomies, and
+capacity classification, all fake- or monkeypatch-backed.
+
+Parity targets: ``sky/provision/vsphere/`` (2,163 LoC of pyvmomi),
+``sky/provision/scp/scp_utils.py``, ``sky/provision/ibm/utils.py``.
+This build drives govc / the SCP open API / the ibmcloud CLI instead;
+what must match the reference is the BEHAVIOR under failure: typed
+errors, capacity scopes the failover engine understands, and loud
+misconfiguration messages.
+"""
+import subprocess
+
+import pytest
+
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.ibm import ibm_api
+from skypilot_tpu.provision.scp import scp_api
+from skypilot_tpu.provision.vsphere import vsphere_api
+
+_CANONICAL_STATES = {'pending', 'running', 'stopping', 'stopped',
+                     'terminating', 'terminated'}
+
+
+# ------------------------------------------------------------- taxonomy
+
+
+@pytest.mark.parametrize('api', [vsphere_api, scp_api, ibm_api])
+def test_state_maps_are_canonical(api):
+    """Every provider state maps into the canonical lifecycle set the
+    status refresh/state machine understands."""
+    assert set(api.STATE_MAP.values()) <= _CANONICAL_STATES
+    # The three states every lifecycle path needs must be reachable.
+    assert {'running', 'stopped'} <= set(api.STATE_MAP.values())
+
+
+@pytest.mark.parametrize('api,err,cap', [
+    (vsphere_api, vsphere_api.VsphereApiError,
+     vsphere_api.VsphereCapacityError),
+    (scp_api, scp_api.ScpApiError, scp_api.ScpCapacityError),
+    (ibm_api, ibm_api.IbmApiError, ibm_api.IbmCapacityError),
+])
+def test_capacity_errors_are_typed_and_classified(api, err, cap):
+    """Capacity subclasses the cloud's ApiError AND the shared
+    CapacityError base; the failover handler resolves a scope."""
+    e = cap('out of capacity')
+    assert isinstance(e, err)
+    assert isinstance(e, provision_common.CapacityError)
+    from skypilot_tpu.backends import gang_backend
+    scope = gang_backend.FailoverCloudErrorHandler.classify(e)
+    assert scope in (gang_backend.FailoverCloudErrorHandler.ZONE,
+                     gang_backend.FailoverCloudErrorHandler.REGION)
+
+
+def test_vsphere_govc_error_classification(monkeypatch):
+    """govc stderr carrying a placement-failure marker raises the
+    capacity type; anything else the generic type with the verb."""
+    monkeypatch.setenv('GOVC_URL', 'https://vcenter.local')
+
+    def _fake_run(argv, **kwargs):
+        return subprocess.CompletedProcess(
+            argv, 1, stdout='',
+            stderr='No host is compatible with the virtual machine')
+
+    monkeypatch.setattr(subprocess, 'run', _fake_run)
+    t = vsphere_api.GovcTransport()
+    with pytest.raises(vsphere_api.VsphereCapacityError):
+        t._run(['vm.clone'])  # pylint: disable=protected-access
+
+    def _fake_run2(argv, **kwargs):
+        return subprocess.CompletedProcess(
+            argv, 1, stdout='', stderr='permission denied')
+
+    monkeypatch.setattr(subprocess, 'run', _fake_run2)
+    with pytest.raises(vsphere_api.VsphereApiError) as ei:
+        t._run(['vm.clone'])
+    assert 'vm.clone' in str(ei.value)  # names the failing verb
+
+
+def test_ibm_cli_error_classification(monkeypatch):
+    """ibmcloud stderr with a quota marker is a capacity error."""
+    def _fake_run(argv, **kwargs):
+        return subprocess.CompletedProcess(
+            argv, 1, stdout='',
+            stderr='Quota exceeded for instance profile')
+
+    monkeypatch.setattr(subprocess, 'run', _fake_run)
+    t = ibm_api.CliTransport(region='us-south')
+    with pytest.raises(ibm_api.IbmCapacityError):
+        t._run(['instance-create'])  # pylint: disable=protected-access
+
+
+# ------------------------------------------------------------ auth modes
+
+
+def test_scp_auth_env_then_credential_file(monkeypatch, tmp_path):
+    """SCP key resolution order: $SCP_ACCESS_KEY, then the reference's
+    ~/.scp/scp_credential format; neither -> loud typed error."""
+    monkeypatch.setenv('SCP_ACCESS_KEY', 'env-key')
+    assert scp_api.access_key() == 'env-key'
+
+    monkeypatch.delenv('SCP_ACCESS_KEY')
+    monkeypatch.setenv('HOME', str(tmp_path))
+    monkeypatch.delenv('SKYTPU_SCP_FAKE', raising=False)
+    assert scp_api.access_key() is None
+    with pytest.raises(scp_api.ScpApiError) as ei:
+        scp_api.make_client()
+    assert 'access key' in str(ei.value).lower()
+
+    cred = tmp_path / '.scp'
+    cred.mkdir()
+    (cred / 'scp_credential').write_text(
+        'access_key = file-key\nsecret_key = s\n')
+    assert scp_api.access_key() == 'file-key'
+
+
+def test_vsphere_auth_config_or_env(monkeypatch, tmp_path):
+    """vSphere credentials come from config OR $GOVC_* env; neither is
+    a typed, actionable error (not a credless govc launch)."""
+    monkeypatch.setenv('HOME', str(tmp_path))
+    for var in ('GOVC_URL', 'GOVC_USERNAME', 'GOVC_PASSWORD'):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.delenv('SKYTPU_VSPHERE_FAKE', raising=False)
+    import skypilot_tpu.skypilot_config as config
+    config.reload_config()
+    with pytest.raises(vsphere_api.VsphereApiError) as ei:
+        vsphere_api.make_client()
+    assert 'GOVC_URL' in str(ei.value)
+
+    # Config-file auth mode: url in ~/.skytpu/config.yaml suffices.
+    cfgdir = tmp_path / '.skytpu'
+    cfgdir.mkdir()
+    (cfgdir / 'config.yaml').write_text(
+        'vsphere:\n  url: https://vc.corp\n  username: u\n'
+        '  password: p\n')
+    config.reload_config()
+    t = vsphere_api.make_client()
+    assert t.url == 'https://vc.corp'
+    assert t.username == 'u'
+
+
+def test_ibm_region_config_fallback(monkeypatch, tmp_path):
+    """IBM region resolves config -> $IBM_REGION -> us-south default."""
+    monkeypatch.setenv('HOME', str(tmp_path))
+    monkeypatch.delenv('IBM_REGION', raising=False)
+    import skypilot_tpu.skypilot_config as config
+    config.reload_config()
+    assert ibm_api.CliTransport().region == 'us-south'
+    monkeypatch.setenv('IBM_REGION', 'eu-de')
+    assert ibm_api.CliTransport().region == 'eu-de'
+    cfgdir = tmp_path / '.skytpu'
+    cfgdir.mkdir()
+    (cfgdir / 'config.yaml').write_text('ibm:\n  region: jp-tok\n')
+    config.reload_config()
+    assert ibm_api.CliTransport().region == 'jp-tok'
+
+
+# ----------------------------------------------------- stockout (fakes)
+
+
+@pytest.mark.parametrize('cloud_key,api,cap', [
+    ('SCP', scp_api, scp_api.ScpCapacityError),
+    ('VSPHERE', vsphere_api, vsphere_api.VsphereCapacityError),
+    ('IBM', ibm_api, ibm_api.IbmCapacityError),
+])
+def test_fake_stockout_raises_cloud_typed_capacity(monkeypatch,
+                                                   cloud_key, api, cap):
+    """The shared fake's stockout injection surfaces each cloud's OWN
+    capacity type (what the failover engine blocklists on)."""
+    monkeypatch.setenv(f'SKYTPU_{cloud_key}_FAKE', '1')
+    monkeypatch.setenv(f'SKYTPU_{cloud_key}_FAKE_STOCKOUT', 'r1')
+    client = api.make_client()
+    with pytest.raises(cap):
+        client.deploy('n0', 'r1', 'any-type', False, None)
