@@ -103,9 +103,18 @@ class ClusterHandle(backend_lib.ResourceHandle):
         return self.get_command_runners()[0]
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
-        # Forward-migration hook (parity: handle __setstate__:2595).
+        # Forward-migration hook (parity: handle __setstate__:2595):
+        # a handle pickled by an OLDER release must unpickle usable —
+        # every attribute added since version 0 gets its default here,
+        # so `sky status` after an upgrade never AttributeErrors on
+        # old rows.
         state.setdefault('_version', 0)
+        state.setdefault('cached_hosts', None)
+        state.setdefault('ssh_user', 'skytpu')
+        state.setdefault('ssh_private_key', None)
+        state.setdefault('provider_config', {})
         self.__dict__.update(state)
+        self._version = self._VERSION
 
     def __repr__(self) -> str:
         return (f'ClusterHandle({self.cluster_name!r}, '
@@ -420,8 +429,22 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                 candidates = [to_provision]
             else:
                 cloud = to_provision.cloud
+                # Only a USER region pin restricts the failover chain.
+                # to_provision (the optimizer's pick) always carries a
+                # region — deriving feasibility from it unmodified
+                # would collapse cross-region/cross-context failover
+                # to a single region (the k8s allowed_contexts chain,
+                # GCP regional stockouts).
+                # A pin counts with OR without an explicit cloud
+                # (`--region us-east-1` alone must still restrict).
+                user_pinned = any(
+                    r.region is not None and
+                    (r.cloud is None or r.cloud.is_same_cloud(cloud))
+                    for r in task.resources)
+                probe = to_provision if user_pinned else \
+                    to_provision.copy(region=None, zone=None)
                 feasible, _ = cloud.get_feasible_launchable_resources(
-                    to_provision, task.num_nodes)
+                    probe, task.num_nodes)
                 candidates = []
                 for f in feasible:
                     regions = cloud.regions_with_offering(
@@ -430,10 +453,15 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                     candidates.extend(
                         f.copy(region=r.name) for r in regions)
                 if to_provision.region is not None:
-                    candidates = [
-                        c for c in candidates
-                        if c.region == to_provision.region
-                    ]
+                    if user_pinned:
+                        candidates = [
+                            c for c in candidates
+                            if c.region == to_provision.region
+                        ]
+                    else:
+                        # Optimizer's choice first, rest as failover.
+                        candidates.sort(
+                            key=lambda c: c.region != to_provision.region)
             if not candidates:
                 raise exceptions.ResourcesUnavailableError(
                     f'No launchable candidates for {to_provision}.')
@@ -497,6 +525,14 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                                                requested_resources=set(
                                                    task.resources),
                                                ready=True)
+            # `ssh <cluster>` entry (parity: cluster_utils.py
+            # SSHConfigHelper.add_cluster) — best-effort, transport-
+            # dependent.
+            from skypilot_tpu.utils import cluster_ssh
+            cluster_ssh.add_cluster(cluster_name,
+                                    handle.cached_hosts or [],
+                                    handle.ssh_user,
+                                    handle.ssh_private_key)
             logger.info(
                 ux_utils.finishing_message(
                     f'Cluster {cluster_name!r} is up '
@@ -801,6 +837,8 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                 logger.warning(f'teardown: ignoring error due to --purge: '
                                f'{e}')
             global_state.remove_cluster(cluster_name, terminate=terminate)
+            from skypilot_tpu.utils import cluster_ssh
+            cluster_ssh.remove_cluster(cluster_name)
         verb = 'Terminated' if terminate else 'Stopped'
         logger.info(
             ux_utils.finishing_message(

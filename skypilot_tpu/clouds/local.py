@@ -30,8 +30,10 @@ class Local(cloud.Cloud):
                 'Local cloud has no spot market.',
             cloud.CloudImplementationFeatures.IMAGE_ID:
                 'Local cloud has no machine images.',
-            cloud.CloudImplementationFeatures.OPEN_PORTS:
-                'Local ports are already reachable.',
+            # `ports:` IS supported — exposure is a no-op (loopback is
+            # already reachable) but the declaration matters: the API
+            # server's ws-proxy only tunnels declared ports, and smoke
+            # scenarios declare ports on Local like any cloud.
         }
 
     def regions_with_offering(self, instance_type, accelerators, use_spot,

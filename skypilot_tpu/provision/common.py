@@ -108,6 +108,10 @@ class ClusterInfo:
                     'access_mode': info.tags.get('access_mode',
                                                  'kubectl-exec'),
                     'internal_ip': info.internal_ip,
+                    # For the portforward-ssh access mode (sshd in the
+                    # pod): same credentials as the ssh transport.
+                    'ssh_user': self.ssh_user,
+                    'ssh_key': self.ssh_private_key or '~/.skytpu/sky-key',
                 })
             else:
                 hosts.append({

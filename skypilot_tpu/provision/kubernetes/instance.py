@@ -8,6 +8,7 @@ nodeSelectors (``cloud.google.com/gke-tpu-accelerator`` +
 ``gke-tpu-topology``; parity utils.py:96-102) and request ``google.com/tpu``
 chips per host, so GKE schedules all H pods onto one podslice nodepool.
 """
+import json
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import sky_logging
@@ -103,7 +104,65 @@ def _build_manifest(cluster: str, node_idx: int, host_idx: int,
     }
     if selector:
         manifest['spec']['nodeSelector'] = selector
+    overlay = _pod_config_overlay(node_cfg)
+    if overlay:
+        _merge_pod_config(manifest, overlay)
     return manifest
+
+
+def _pod_config_overlay(node_cfg: Dict[str, Any]) -> Dict[str, Any]:
+    """The user's pod-spec overlay: the global ``kubernetes.pod_config``
+    from ~/.skytpu/config.yaml, optionally overridden by a
+    ``pod_config`` key a caller places in node_config (the provisioner-
+    level hook for per-launch overlays).
+
+    This is how PVC volumes, tolerations, imagePullSecrets, and any
+    other pod field the framework doesn't model directly reach the pod
+    (parity: sky/provision/kubernetes/utils.py:2234
+    combine_pod_config_fields).
+    """
+    from skypilot_tpu import skypilot_config
+    overlay: Dict[str, Any] = {}
+    global_cfg = skypilot_config.get_nested(('kubernetes', 'pod_config'),
+                                            None)
+    if global_cfg:
+        _merge_pod_config(overlay, global_cfg)
+    task_cfg = node_cfg.get('pod_config')
+    if task_cfg:
+        _merge_pod_config(overlay, task_cfg)
+    return overlay
+
+
+def _merge_pod_config(dst: Dict[str, Any], src: Dict[str, Any]) -> None:
+    """Deep-merge ``src`` into ``dst`` with the reference's semantics
+    (utils.py combine_pod_config_fields): nested dicts merge key-by-
+    key, lists APPEND, scalars overwrite — with ONE exception:
+    ``containers`` merges positionally, so a pod_config
+    ``containers[0].volumeMounts`` lands on the skytpu container
+    instead of adding a second container. Appending everywhere else is
+    what lets two overlay sources each contribute a volume /
+    toleration / imagePullSecret without clobbering each other."""
+    for key, value in src.items():
+        if (key in dst and isinstance(dst[key], dict) and
+                isinstance(value, dict)):
+            _merge_pod_config(dst[key], value)
+        elif (key in dst and isinstance(dst[key], list) and
+                isinstance(value, list)):
+            if key == 'containers':
+                for i, item in enumerate(value):
+                    if (i < len(dst[key]) and
+                            isinstance(dst[key][i], dict) and
+                            isinstance(item, dict)):
+                        _merge_pod_config(dst[key][i], item)
+                    else:
+                        dst[key].append(item)
+            else:
+                dst[key].extend(
+                    json.loads(json.dumps(item)) if isinstance(
+                        item, (dict, list)) else item for item in value)
+        else:
+            dst[key] = (json.loads(json.dumps(value))
+                        if isinstance(value, (dict, list)) else value)
 
 
 def _cleanup_cluster_pods(client, namespace: str,

@@ -88,6 +88,12 @@ def _in_cluster_flags() -> List[str]:
     referencing the token FILE is materialized — kubectl re-reads
     `tokenFile` per request, so projected-token rotation works too.
     """
+    if not in_cluster_available():
+        raise K8sApiError(
+            "Context 'in-cluster' was requested but this process is "
+            'not running inside a Kubernetes pod (no service-account '
+            'mount / KUBERNETES_SERVICE_HOST). Use a kubeconfig '
+            'context instead.')
     d = _sa_dir()
     host = os.environ['KUBERNETES_SERVICE_HOST']
     port = os.environ.get('KUBERNETES_SERVICE_PORT', '443')
@@ -388,10 +394,15 @@ class FakeK8sService:
     def _schedule(self, pods: Dict[str, Dict[str, Any]],
                   manifest: dict) -> str:
         """Pick a node for the pod; raise K8sCapacityError if none fits."""
-        if os.environ.get('SKYTPU_K8S_FAKE_UNSCHEDULABLE', '0') == '1':
+        # Fault injection: '1' = every context unschedulable; a comma
+        # list = only those contexts (exercises the multi-context
+        # failover chain: ctx-a stocks out, ctx-b takes the pods).
+        unsched = os.environ.get('SKYTPU_K8S_FAKE_UNSCHEDULABLE', '0')
+        if unsched == '1' or (unsched not in ('0', '') and
+                              self.context in unsched.split(',')):
             raise K8sCapacityError(
-                '0/6 nodes are available: insufficient capacity '
-                '(fault injection).')
+                f'0/6 nodes are available in context {self.context}: '
+                'insufficient capacity (fault injection).')
         spec = manifest.get('spec', {})
         selector = spec.get('nodeSelector', {})
         containers = spec.get('containers', [])

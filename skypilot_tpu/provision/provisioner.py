@@ -64,7 +64,7 @@ def runners_from_host_meta(
                         host['pod_name'],
                         ssh_user=host.get('ssh_user', 'skytpu'),
                         ssh_private_key=host.get(
-                            'ssh_key', '~/.ssh/skytpu-key'),
+                            'ssh_key', '~/.skytpu/sky-key'),
                         namespace=host.get('namespace', 'default'),
                         context=host.get('context'),
                         ssh_control_name=f'{host["pod_name"]}'))

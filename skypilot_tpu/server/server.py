@@ -233,6 +233,27 @@ async def handle_pod_ssh_proxy(request: web.Request) -> web.StreamResponse:
             text=f'cluster {cluster!r} has no reachable hosts')
     head = hosts[0]
 
+    # Port allowlist: SSH plus the cluster's DECLARED `ports:` — an
+    # arbitrary client-chosen port would make this endpoint a raw
+    # tunnel to any loopback/node service on the target host.
+    allowed = {22}
+    res = getattr(rec['handle'], 'launched_resources', None)
+    if res is not None and getattr(res, 'ports', None):
+        for p in res.ports:
+            s = str(p)
+            try:
+                if '-' in s:
+                    lo, hi = s.split('-', 1)
+                    allowed.update(range(int(lo), int(hi) + 1))
+                else:
+                    allowed.add(int(s))
+            except ValueError:
+                continue
+    if port not in allowed:
+        raise web.HTTPForbidden(
+            text=f'port {port} is not exposed by cluster {cluster!r} '
+                 f'(declared ports + 22 only)')
+
     ws = web.WebSocketResponse()
     await ws.prepare(request)
 

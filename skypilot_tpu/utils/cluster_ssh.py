@@ -1,0 +1,112 @@
+"""`ssh <cluster>` integration: per-cluster SSH config entries.
+
+Parity: ``sky/utils/cluster_utils.py`` SSHConfigHelper — every UP
+cluster gets a host block under ``~/.skytpu/generated/ssh/<cluster>``
+and ``~/.ssh/config`` gains one ``Include`` line, so a plain
+``ssh <cluster>`` (and scp/rsync/IDE remote extensions) reaches the
+head node with the cluster's key.
+
+Transport mapping:
+* ssh hosts — direct HostName/User/IdentityFile/Port block;
+* kubernetes pods with the ``portforward-ssh`` access mode —
+  ProxyCommand via ``python -m skypilot_tpu.utils.k8s_port_forward``
+  (sshd in the pod, traffic over the apiserver);
+* local / kubectl-exec pods — no sshd to reach: no entry is written
+  (``skytpu exec`` is the path there).
+"""
+import os
+import shlex
+import sys
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+GENERATED_SSH_DIR = '~/.skytpu/generated/ssh'
+_SSH_CONF = '~/.ssh/config'
+_INCLUDE_LINE = f'Include {GENERATED_SSH_DIR}/*\n'
+_AUTOGEN = '# Added by skytpu (removed on `skytpu down <cluster>`)'
+
+
+def _entry_path(cluster_name: str) -> str:
+    return os.path.join(os.path.expanduser(GENERATED_SSH_DIR),
+                        cluster_name)
+
+
+def _ensure_include() -> None:
+    """Prepend the Include to ~/.ssh/config once (ssh applies the FIRST
+    matching option, and Include must appear before any Host block to
+    apply globally)."""
+    path = os.path.expanduser(_SSH_CONF)
+    os.makedirs(os.path.dirname(path), mode=0o700, exist_ok=True)
+    content = ''
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            content = f.read()
+    if _INCLUDE_LINE.strip() in content:
+        return
+    # Atomic replace: an in-place O_TRUNC rewrite interrupted mid-write
+    # would destroy the user's personal SSH config.
+    tmp = f'{path}.skytpu-tmp-{os.getpid()}'
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    with os.fdopen(fd, 'w', encoding='utf-8') as f:
+        f.write(f'{_AUTOGEN}\n{_INCLUDE_LINE}\n{content}')
+    os.replace(tmp, path)
+
+
+def _host_block(cluster_name: str, host: Dict[str, Any], ssh_user: str,
+                key_path: Optional[str]) -> Optional[str]:
+    transport = host.get('transport')
+    lines: List[str] = [f'{_AUTOGEN}', f'Host {cluster_name}']
+    if transport == 'ssh':
+        lines += [f'  HostName {host["ip"]}',
+                  f'  Port {host.get("ssh_port", 22)}']
+    elif (transport == 'kubernetes' and
+          host.get('access_mode') == 'portforward-ssh'):
+        proxy = (f'{shlex.quote(sys.executable)} -m '
+                 'skypilot_tpu.utils.k8s_port_forward '
+                 f'{shlex.quote(host.get("namespace", "default"))} '
+                 f'{shlex.quote(host["pod_name"])} 22')
+        if host.get('context'):
+            proxy += f' --context {shlex.quote(host["context"])}'
+        lines += ['  HostName 127.0.0.1', f'  ProxyCommand {proxy}']
+    else:
+        return None  # no sshd reachable on this transport
+    lines += [f'  User {ssh_user}']
+    if key_path:
+        lines += [f'  IdentityFile {key_path}', '  IdentitiesOnly yes']
+    lines += [
+        '  StrictHostKeyChecking no',
+        '  UserKnownHostsFile=/dev/null',
+        '  GlobalKnownHostsFile=/dev/null',
+    ]
+    return '\n'.join(lines) + '\n'
+
+
+def add_cluster(cluster_name: str, hosts: List[Dict[str, Any]],
+                ssh_user: str, key_path: Optional[str]) -> bool:
+    """Write the cluster's SSH entry (head host). Returns True when an
+    entry was written (False: transport has no sshd to reach)."""
+    if not hosts:
+        return False
+    block = _host_block(cluster_name, hosts[0], ssh_user, key_path)
+    if block is None:
+        return False
+    try:
+        d = os.path.expanduser(GENERATED_SSH_DIR)
+        os.makedirs(d, mode=0o700, exist_ok=True)
+        with open(_entry_path(cluster_name), 'w', encoding='utf-8') as f:
+            f.write(block)
+        _ensure_include()
+        return True
+    except OSError as e:  # never fail a launch over ssh-config IO
+        logger.debug(f'ssh config entry for {cluster_name}: {e}')
+        return False
+
+
+def remove_cluster(cluster_name: str) -> None:
+    try:
+        os.unlink(_entry_path(cluster_name))
+    except OSError:
+        pass

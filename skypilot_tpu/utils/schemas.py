@@ -206,6 +206,10 @@ _CONFIG_SCHEMA: Dict[str, Any] = {
                 'namespace': {'type': 'string'},
                 'allowed_contexts': {'type': 'array',
                                      'items': {'type': 'string'}},
+                # Arbitrary pod-spec overlay deep-merged into every pod
+                # (PVC volumes, tolerations, imagePullSecrets, ...).
+                'pod_config': {'type': 'object',
+                               'additionalProperties': True},
             },
         },
         'allowed_clouds': {'type': 'array', 'items': {'type': 'string'}},

@@ -45,12 +45,22 @@ def test_ws_pod_proxy_reaches_cluster(generic_cloud):
         Test(
             name='ws-pod-proxy',
             commands=[
-                # Pick a port once, persist for later commands.
+                # Pick a port once, persist for later commands. The
+                # port must be DECLARED in resources.ports — the proxy
+                # only tunnels declared ports (+22).
                 'port=$((21000 + RANDOM % 20000)); '
                 'echo $port > /tmp/' + name + '.port',
-                '{skytpu} launch -c ' + name + ' --cloud {cloud} -d '
-                '"nohup python3 -m http.server $(cat /tmp/' + name +
-                '.port) >/dev/null 2>&1 & sleep 2; echo serving"',
+                'port=$(cat /tmp/' + name + '.port); '
+                'cat > /tmp/' + name + '.yaml <<EOF\n'
+                'name: ' + name + '\n'
+                'resources:\n'
+                '  cloud: {cloud}\n'
+                '  ports: [$port]\n'
+                'run: nohup python3 -m http.server $port '
+                '>/dev/null 2>&1 & sleep 2; echo serving\n'
+                'EOF',
+                '{skytpu} launch /tmp/' + name + '.yaml -c ' + name +
+                ' -d',
                 'for i in $(seq 1 60); do '
                 '{skytpu} queue ' + name + ' | grep -q SUCCEEDED && '
                 'break; sleep 2; done',
@@ -65,6 +75,6 @@ def test_ws_pod_proxy_reaches_cluster(generic_cloud):
                 'grep -q "200 OK"',
             ],
             teardown='{skytpu} down ' + name + '; rm -f /tmp/' + name +
-                     '.port',
+                     '.port /tmp/' + name + '.yaml',
             timeout=10 * 60,
         ), generic_cloud)

@@ -296,16 +296,18 @@ def test_ws_ssh_proxy_roundtrip(api_env):
     import socket
     import threading
 
-    rid = sdk.launch(_local_task('ws-proxy-c', 'sleep 1'),
-                     cluster_name='ws-c1')
-    sdk.get(rid)
-
-    # Echo "sshd" on loopback.
+    # Echo "sshd" on loopback (bound first: the port must be DECLARED
+    # on the cluster — the proxy only tunnels declared ports + 22).
     srv = socket.socket()
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     srv.bind(('127.0.0.1', 0))
     srv.listen(4)
     echo_port = srv.getsockname()[1]
+
+    task = sky.Task(name='ws-proxy-c', run='sleep 1')
+    task.set_resources(sky.Resources(cloud='local', ports=[echo_port]))
+    rid = sdk.launch(task, cluster_name='ws-c1')
+    sdk.get(rid)
 
     def _serve():
         conn, _ = srv.accept()
@@ -336,16 +338,24 @@ def test_ws_ssh_proxy_roundtrip(api_env):
         data = asyncio.new_event_loop().run_until_complete(_roundtrip())
         assert data == b'SSH-2.0-probe\r\n'
 
-        # Unknown cluster -> HTTP error, not a hang.
-        async def _missing():
-            url = (f'{os.environ["SKYTPU_API_SERVER_URL"]}'
-                   f'/k8s-pod-ssh-proxy?cluster=nope&port=22')
+        # Unknown cluster -> HTTP error, not a hang; an UNDECLARED
+        # port -> 403 (the proxy must not be an arbitrary tunnel).
+        async def _rejections():
             async with aiohttp.ClientSession() as session:
+                url = (f'{os.environ["SKYTPU_API_SERVER_URL"]}'
+                       f'/k8s-pod-ssh-proxy?cluster=nope&port=22')
                 with pytest.raises(aiohttp.WSServerHandshakeError):
                     async with session.ws_connect(url):
                         pass
+                url = (f'{os.environ["SKYTPU_API_SERVER_URL"]}'
+                       f'/k8s-pod-ssh-proxy?cluster=ws-c1&port=6379')
+                with pytest.raises(
+                        aiohttp.WSServerHandshakeError) as ei:
+                    async with session.ws_connect(url):
+                        pass
+                assert ei.value.status == 403
 
-        asyncio.new_event_loop().run_until_complete(_missing())
+        asyncio.new_event_loop().run_until_complete(_rejections())
     finally:
         srv.close()
         sdk.get(sdk.down('ws-c1'))

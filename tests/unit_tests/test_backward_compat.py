@@ -1,0 +1,60 @@
+"""Backward compatibility: state written by an older release must load
+(parity: tests/smoke_tests/test_backward_compat.py — the reference
+upgrades a live deployment and asserts old clusters/jobs still work;
+here the persisted artifacts themselves are exercised).
+
+Covers the two long-lived on-disk contracts:
+* pickled ``ClusterHandle`` blobs in the clusters table (version
+  migration via ``__setstate__``);
+* sqlite schemas opened by a newer binary (CREATE TABLE IF NOT EXISTS
+  must tolerate pre-existing rows).
+"""
+import pickle
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_state
+from skypilot_tpu.backends.gang_backend import ClusterHandle
+
+
+def _v0_handle_bytes() -> bytes:
+    """A handle as an old release would have pickled it: no _version,
+    none of the post-v0 attributes (cached_hosts, ssh_*)."""
+    handle = ClusterHandle.__new__(ClusterHandle)
+    handle.__dict__.update({
+        'cluster_name': 'old-c1',
+        'cluster_name_on_cloud': 'old-c1-abcd1234',
+        'launched_nodes': 2,
+        'launched_resources': sky.Resources(cloud='local'),
+        'provider_name': 'local',
+    })
+    return pickle.dumps(handle)
+
+
+def test_v0_handle_unpickles_with_defaults():
+    h = pickle.loads(_v0_handle_bytes())
+    # Post-v0 attributes exist with their defaults — no AttributeError
+    # on any surface that touches old rows.
+    assert h.cached_hosts is None
+    assert h.ssh_user == 'skytpu'
+    assert h.ssh_private_key is None
+    assert h.provider_config == {}
+    assert h._version == ClusterHandle._VERSION  # pylint: disable=protected-access
+    assert h.cluster_name == 'old-c1'
+    repr(h)  # __repr__ touches launched_nodes/resources/num_hosts
+
+
+def test_status_over_old_handle_row():
+    """A registry row carrying a v0 handle flows through get_clusters
+    and the dashboard renderer without error."""
+    old = pickle.loads(_v0_handle_bytes())
+    global_state.add_or_update_cluster('old-c1', old, ready=True)
+    try:
+        recs = [r for r in global_state.get_clusters()
+                if r['name'] == 'old-c1']
+        assert len(recs) == 1
+        assert recs[0]['handle'].cached_hosts is None
+        from skypilot_tpu.server import dashboard
+        page = dashboard.render()
+        assert 'old-c1' in page
+    finally:
+        global_state.remove_cluster('old-c1', terminate=True)

@@ -1,0 +1,74 @@
+"""`ssh <cluster>` config entries (parity: sky/utils/cluster_utils.py
+SSHConfigHelper): entry per transport, Include wiring, down-removal."""
+import os
+
+from skypilot_tpu.utils import cluster_ssh
+
+
+def _ssh_host():
+    return {'transport': 'ssh', 'rank': 0, 'ip': '10.1.2.3',
+            'ssh_port': 2222}
+
+
+def test_ssh_transport_entry(monkeypatch, tmp_path):
+    monkeypatch.setenv('HOME', str(tmp_path))
+    ok = cluster_ssh.add_cluster('c1', [_ssh_host()], 'skytpu',
+                                 '~/.ssh/skytpu-key')
+    assert ok
+    entry = open(tmp_path / '.skytpu/generated/ssh/c1',
+                 encoding='utf-8').read()
+    assert 'Host c1' in entry
+    assert 'HostName 10.1.2.3' in entry
+    assert 'Port 2222' in entry
+    assert 'User skytpu' in entry
+    assert 'IdentityFile ~/.ssh/skytpu-key' in entry
+    # ~/.ssh/config gains the Include ONCE, at the top.
+    conf = open(tmp_path / '.ssh/config', encoding='utf-8').read()
+    assert conf.count('Include') == 1
+    assert conf.splitlines()[1].startswith('Include')
+    # Second cluster: no duplicate Include.
+    cluster_ssh.add_cluster('c2', [_ssh_host()], 'skytpu', None)
+    conf = open(tmp_path / '.ssh/config', encoding='utf-8').read()
+    assert conf.count('Include') == 1
+
+    cluster_ssh.remove_cluster('c1')
+    assert not os.path.exists(tmp_path / '.skytpu/generated/ssh/c1')
+    assert os.path.exists(tmp_path / '.skytpu/generated/ssh/c2')
+
+
+def test_existing_ssh_config_preserved(monkeypatch, tmp_path):
+    """A user's pre-existing ~/.ssh/config content survives, below the
+    prepended Include (first-match-wins ssh semantics)."""
+    monkeypatch.setenv('HOME', str(tmp_path))
+    sshdir = tmp_path / '.ssh'
+    sshdir.mkdir()
+    (sshdir / 'config').write_text('Host work\n  HostName w.example\n')
+    cluster_ssh.add_cluster('c1', [_ssh_host()], 'skytpu', None)
+    conf = (sshdir / 'config').read_text()
+    assert 'Host work' in conf
+    assert conf.index('Include') < conf.index('Host work')
+
+
+def test_portforward_pod_entry(monkeypatch, tmp_path):
+    monkeypatch.setenv('HOME', str(tmp_path))
+    host = {'transport': 'kubernetes', 'rank': 0, 'pod_name': 'p0',
+            'namespace': 'ns1', 'context': 'gke_x',
+            'access_mode': 'portforward-ssh'}
+    assert cluster_ssh.add_cluster('ck8s', [host], 'skytpu', None)
+    entry = open(tmp_path / '.skytpu/generated/ssh/ck8s',
+                 encoding='utf-8').read()
+    assert 'ProxyCommand' in entry
+    assert 'k8s_port_forward ns1 p0 22' in entry
+    assert '--context gke_x' in entry
+
+
+def test_no_entry_for_sshless_transports(monkeypatch, tmp_path):
+    monkeypatch.setenv('HOME', str(tmp_path))
+    local = {'transport': 'local', 'rank': 0, 'node_dir': '/x'}
+    execpod = {'transport': 'kubernetes', 'rank': 0, 'pod_name': 'p0',
+               'namespace': 'ns', 'access_mode': 'kubectl-exec'}
+    assert not cluster_ssh.add_cluster('cl', [local], 'u', None)
+    assert not cluster_ssh.add_cluster('ce', [execpod], 'u', None)
+    assert not os.path.exists(tmp_path / '.skytpu/generated/ssh/cl')
+    # ~/.ssh/config untouched when nothing was written.
+    assert not os.path.exists(tmp_path / '.ssh/config')

@@ -292,3 +292,103 @@ def test_launch_with_ports_creates_service_e2e():
     with pytest.raises(k8s_api.K8sApiError):
         client.get_service('default',
                            f'{handle.cluster_name_on_cloud}-ports')
+
+
+def test_pod_config_overlay_pvc(monkeypatch, tmp_path):
+    """`kubernetes.pod_config` in config.yaml deep-merges into every
+    pod manifest — the reference's mechanism (utils.py:2234
+    combine_pod_config_fields) for PVC volumes / imagePullSecrets /
+    tolerations. Dicts merge, container[0] fields land on the skytpu
+    container, volumes append."""
+    monkeypatch.setenv('HOME', str(tmp_path))
+    cfgdir = tmp_path / '.skytpu'
+    cfgdir.mkdir()
+    (cfgdir / 'config.yaml').write_text(
+        'kubernetes:\n'
+        '  pod_config:\n'
+        '    spec:\n'
+        '      imagePullSecrets:\n'
+        '        - name: regcred\n'
+        '      tolerations:\n'
+        '        - key: gpu\n'
+        '          operator: Exists\n'
+        '      volumes:\n'
+        '        - name: ckpts\n'
+        '          persistentVolumeClaim:\n'
+        '            claimName: ckpt-pvc\n'
+        '      containers:\n'
+        '        - volumeMounts:\n'
+        '            - name: ckpts\n'
+        '              mountPath: /ckpts\n')
+    import skypilot_tpu.skypilot_config as config
+    config.reload_config()
+    m = k8s_instance._build_manifest('pvc-c', 0, 0, _tpu_node_config())
+    spec = m['spec']
+    assert spec['imagePullSecrets'] == [{'name': 'regcred'}]
+    assert spec['tolerations'][0]['key'] == 'gpu'
+    assert spec['volumes'][0]['persistentVolumeClaim']['claimName'] == \
+        'ckpt-pvc'
+    # volumeMounts merged INTO the skytpu container (not a new one).
+    assert len(spec['containers']) == 1
+    c = spec['containers'][0]
+    assert c['name'] == 'skytpu'
+    assert c['volumeMounts'][0]['mountPath'] == '/ckpts'
+    # The framework's own fields survive the merge.
+    assert c['resources']['limits'][k8s_api.TPU_RESOURCE_KEY] == '4'
+    assert m['spec']['nodeSelector'][k8s_api.GKE_TPU_ACCELERATOR_LABEL] \
+        == 'tpu-v5-lite-podslice'
+
+
+def test_pod_config_merge_semantics():
+    """Merge rules: nested dicts merge, scalars overwrite, generic
+    lists APPEND (two sources each contribute a volume without
+    clobbering), and ONLY `containers` merges positionally (so
+    overlay fields land on the skytpu container)."""
+    dst = {'a': {'x': 1, 'y': 2}, 'volumes': [{'name': 'v1'}],
+           'containers': [{'name': 'skytpu'}], 's': 'old'}
+    k8s_instance._merge_pod_config(
+        dst, {'a': {'y': 3, 'z': 4},
+              'volumes': [{'name': 'v2'}],
+              'containers': [{'image': 'x'}, {'name': 'sidecar'}],
+              's': 'new'})
+    assert dst['a'] == {'x': 1, 'y': 3, 'z': 4}
+    assert dst['volumes'] == [{'name': 'v1'}, {'name': 'v2'}]
+    assert dst['containers'] == [{'name': 'skytpu', 'image': 'x'},
+                                 {'name': 'sidecar'}]
+    assert dst['s'] == 'new'
+
+
+def test_multi_context_failover_e2e(monkeypatch, tmp_path):
+    """kubernetes.allowed_contexts is a failover chain: ctx-a stocking
+    out (unschedulable) must land the launch on ctx-b (parity: the
+    reference's multi-context failover, sky/clouds/kubernetes.py)."""
+    import time
+
+    monkeypatch.setenv('HOME', str(tmp_path))
+    cfgdir = tmp_path / '.skytpu'
+    cfgdir.mkdir()
+    (cfgdir / 'config.yaml').write_text(
+        'kubernetes:\n  allowed_contexts: [ctx-a, ctx-b]\n')
+    import skypilot_tpu.skypilot_config as config
+    config.reload_config()
+    monkeypatch.setenv('SKYTPU_K8S_FAKE_UNSCHEDULABLE', 'ctx-a')
+    global_state.set_enabled_clouds(['Kubernetes'])
+
+    from skypilot_tpu import core
+    from skypilot_tpu.skylet import job_lib
+    task = sky.Task(name='ctx-fo', run='echo ctx-failover-ok')
+    task.set_resources(sky.Resources(cloud='kubernetes'))
+    job_id, handle = sky.launch(task, cluster_name='t-ctx-fo',
+                                detach_run=True, stream_logs=False)
+    assert handle is not None
+    # Landed on the second context after ctx-a's capacity error.
+    assert handle.provider_config.get('context') == 'ctx-b'
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        st = core.job_status('t-ctx-fo', job_id)
+        if st is not None and st.is_terminal():
+            break
+        time.sleep(0.5)
+    assert core.job_status('t-ctx-fo', job_id) == \
+        job_lib.JobStatus.SUCCEEDED
+    sky.down('t-ctx-fo')
