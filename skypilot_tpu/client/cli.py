@@ -268,6 +268,48 @@ def dashboard():
     click.echo(f'Dashboard: {url}/dashboard')
 
 
+@cli.command()
+@click.argument('shell',
+                type=click.Choice(['bash', 'zsh', 'fish']),
+                required=True)
+@click.option('--install', is_flag=True, default=False,
+              help='Append the completion hook to your shell rc file.')
+def completion(shell, install):
+    """Shell tab-completion (parity: sky's --install-shell-completion).
+
+    Prints the hook to eval; --install appends it to ~/.bashrc /
+    ~/.zshrc / fish config instead.
+    """
+    hooks = {
+        'bash': 'eval "$(_SKYTPU_COMPLETE=bash_source skytpu)"',
+        'zsh': 'eval "$(_SKYTPU_COMPLETE=zsh_source skytpu)"',
+        'fish': '_SKYTPU_COMPLETE=fish_source skytpu | source',
+    }
+    rc_files = {
+        'bash': '~/.bashrc',
+        'zsh': '~/.zshrc',
+        'fish': '~/.config/fish/completions/skytpu.fish',
+    }
+    hook = hooks[shell]
+    if not install:
+        click.echo(hook)
+        return
+    path = os.path.expanduser(rc_files[shell])
+    marker = '# skytpu shell completion'
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    content = ''
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            content = f.read()
+    if marker in content:
+        click.echo(f'Completion already installed in {path}.')
+        return
+    with open(path, 'a', encoding='utf-8') as f:
+        f.write(f'\n{marker}\n{hook}\n')
+    click.echo(f'Installed {shell} completion in {path}; restart your '
+               'shell or source the file.')
+
+
 @cli.group()
 def local():
     """The zero-credential Local cloud (parity: `sky local`)."""
@@ -323,6 +365,15 @@ def show_tpus(name_filter, gpus_only):
 @cli.group()
 def jobs():
     """Managed jobs with automatic recovery."""
+
+
+@jobs.command(name='dashboard')
+def jobs_dashboard():
+    """Print the dashboard URL (managed-jobs table + recovery events
+    live there; parity: `sky jobs dashboard`)."""
+    from skypilot_tpu.server import common as server_common
+    url = server_common.check_server_healthy_or_start()
+    click.echo(f'Jobs dashboard: {url}/dashboard')
 
 
 @jobs.command(name='launch')
@@ -520,6 +571,37 @@ def bench_down(benchmark):
     click.echo(f'Benchmark {benchmark!r} torn down.')
 
 
+@bench.command(name='ls')
+def bench_ls():
+    """List benchmarks and their candidate counts (parity: sky bench
+    ls)."""
+    from skypilot_tpu.benchmark import benchmark_state
+    rows = []
+    for b in benchmark_state.get_benchmarks():
+        results = benchmark_state.get_results(b['name'])
+        done = sum(1 for r in results if r.get('summary'))
+        rows.append((b['name'], b.get('task_name') or '-',
+                     f'{done}/{len(results)}'))
+    if not rows:
+        click.echo('No benchmarks.')
+        return
+    click.echo(_table(('BENCHMARK', 'TASK', 'MEASURED/CANDIDATES'),
+                      rows))
+
+
+@bench.command(name='delete')
+@click.argument('benchmarks', nargs=-1, required=True)
+def bench_delete(benchmarks):
+    """Delete benchmark RECORDS (clusters are `bench down`'s job)."""
+    from skypilot_tpu.benchmark import benchmark_state
+    for name in benchmarks:
+        if benchmark_state.get_benchmark(name) is None:
+            click.echo(f'Benchmark {name!r} not found.')
+            continue
+        benchmark_state.remove_benchmark(name)
+        click.echo(f'Deleted benchmark records for {name!r}.')
+
+
 # -------------------------------------------------------------------- api
 
 
@@ -570,6 +652,72 @@ def api_info():
     click.echo(f'API server: {url} (healthy)')
     click.echo(f"version: {info.get('version')} "
                f"(api v{info.get('api_version')})")
+
+
+def _persist_endpoint(endpoint: str) -> None:
+    """Write api_server.endpoint to the USER config (the same file the
+    loader resolves — $SKYTPU_CONFIG aware), atomically."""
+    import yaml as yaml_lib
+
+    import skypilot_tpu.skypilot_config as config_lib
+    path = config_lib.config_path()
+    cfg = {}
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            cfg = yaml_lib.safe_load(f) or {}
+    cfg.setdefault('api_server', {})['endpoint'] = endpoint
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f'{path}.tmp-{os.getpid()}'
+    with open(tmp, 'w', encoding='utf-8') as f:
+        yaml_lib.safe_dump(cfg, f)
+    os.replace(tmp, path)
+    config_lib.reload_config()
+
+
+@api.command(name='start')
+@click.option('--port', type=int, default=None,
+              help='Port for the local server (default: configured).')
+def api_start(port):
+    """Start the local API server explicitly (parity: `sky api start`;
+    normally any verb auto-starts it). With --port, the endpoint is
+    persisted to the user config so every later command (and `api
+    stop`) targets the same server."""
+    from skypilot_tpu.server import common as server_common
+    if port is not None:
+        endpoint = f'http://127.0.0.1:{port}'
+        os.environ['SKYTPU_API_SERVER_URL'] = endpoint
+        # Without persistence the next CLI invocation would compute the
+        # default URL and auto-start a SECOND server, orphaning this
+        # one.
+        _persist_endpoint(endpoint)
+    url = server_common.check_server_healthy_or_start()
+    click.echo(f'API server running at {url}.')
+
+
+@api.command(name='login')
+@click.argument('endpoint', required=True)
+def api_login(endpoint):
+    """Point this client at an API server (parity: `sky api login`):
+    writes api_server.endpoint to ~/.skytpu/config.yaml."""
+    import requests as requests_lib
+
+    endpoint = endpoint.rstrip('/')
+    if not endpoint.startswith(('http://', 'https://')):
+        raise click.BadParameter(
+            f'{endpoint!r} must start with http:// or https://')
+    try:
+        resp = requests_lib.get(f'{endpoint}/health', timeout=10)
+        if resp.status_code != 200:
+            raise click.ClickException(
+                f'{endpoint}/health returned HTTP {resp.status_code}; '
+                'not logging in.')
+        info = resp.json()
+    except (requests_lib.RequestException, ValueError) as e:
+        raise click.ClickException(
+            f'{endpoint} did not answer /health: {e}')
+    _persist_endpoint(endpoint)
+    click.echo(f'Logged in to {endpoint} '
+               f"(server version {info.get('version')}).")
 
 
 @api.command(name='stop')

@@ -241,6 +241,9 @@ def set_recovered(job_id: int, task_id: int, recovered_time: float) -> None:
 # sky/jobs/dashboard/dashboard.py).
 
 
+_RECOVERY_EVENTS_CAP = 500
+
+
 def add_recovery_event(job_id: int, task_id: int, event: str,
                        detail: str = '') -> None:
     with _db() as conn:
@@ -248,6 +251,12 @@ def add_recovery_event(job_id: int, task_id: int, event: str,
             'INSERT INTO recovery_events (job_id, task_id, ts, event, '
             'detail) VALUES (?, ?, ?, ?, ?)',
             (job_id, task_id, time.time(), event, detail))
+        # Bounded history: a controller recovering for weeks must not
+        # grow this table without limit.
+        conn.execute(
+            'DELETE FROM recovery_events WHERE rowid NOT IN '
+            '(SELECT rowid FROM recovery_events ORDER BY ts DESC '
+            'LIMIT ?)', (_RECOVERY_EVENTS_CAP,))
 
 
 def get_recovery_events(limit: int = 20) -> List[Dict[str, Any]]:

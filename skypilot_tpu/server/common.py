@@ -74,20 +74,27 @@ def check_server_healthy_or_start(start_timeout: float = 60.0) -> str:
     lock = locks.FileLock(
         os.path.join(locks.LOCK_DIR, f'api_server.{_url_port(url)}.lock'),
         timeout=start_timeout)
-    with lock:
-        # Someone else may have started it while we waited on the lock.
-        if is_healthy(url):
-            return url
-        # Hold the lock through the health wait: releasing right after
-        # Popen lets every waiter observe "still unhealthy" during the
-        # server's import phase and spawn again — N interpreters
-        # booting at once starve the one that will win the bind.
-        _start_local_server(url)
-        deadline = time.time() + start_timeout
-        while time.time() < deadline:
+    try:
+        with lock:
+            # Someone else may have started it while we waited.
             if is_healthy(url):
                 return url
-            time.sleep(0.2)
+            # Hold the lock through the health wait: releasing right
+            # after Popen lets every waiter observe "still unhealthy"
+            # during the server's import phase and spawn again — N
+            # interpreters booting at once starve the one that will
+            # win the bind.
+            _start_local_server(url)
+            deadline = time.time() + start_timeout
+            while time.time() < deadline:
+                if is_healthy(url):
+                    return url
+                time.sleep(0.2)
+    except locks.LockTimeout as e:
+        raise exceptions.ApiServerError(
+            f'Another process has been starting the API server for '
+            f'>{start_timeout:.0f}s without it becoming healthy; see '
+            f'{server_log_path()}.') from e
     raise exceptions.ApiServerError(
         f'Local API server failed to become healthy; see '
         f'{server_log_path()}')

@@ -35,6 +35,13 @@ def _config_path() -> str:
         os.environ.get(ENV_VAR_CONFIG_PATH, DEFAULT_CONFIG_PATH))
 
 
+def config_path() -> str:
+    """The resolved user config path ($SKYTPU_CONFIG or the default) —
+    the one writer-surfaces (api login) must target so the loader reads
+    what they wrote."""
+    return _config_path()
+
+
 def _load() -> Dict[str, Any]:
     global _global_config, _loaded_path
     path = _config_path()

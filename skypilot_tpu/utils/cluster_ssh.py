@@ -90,6 +90,10 @@ def add_cluster(cluster_name: str, hosts: List[Dict[str, Any]],
     entry was written (False: transport has no sshd to reach)."""
     if not hosts:
         return False
+    # The handle's ssh_private_key is None for Kubernetes clusters;
+    # fall back to the host-meta key (what the runners themselves use)
+    # so portforward-ssh entries always carry an IdentityFile.
+    key_path = key_path or hosts[0].get('ssh_key')
     block = _host_block(cluster_name, hosts[0], ssh_user, key_path)
     if block is None:
         return False

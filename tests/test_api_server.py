@@ -406,3 +406,93 @@ def test_dashboard_failover_visibility(api_env):
         assert 'fv-job' in page
     finally:
         sdk.get(sdk.down('fv-c1'))
+
+
+def test_api_start_and_login_cli(api_env):
+    """`api start` boots the local server explicitly; `api login`
+    verifies /health and persists api_server.endpoint (parity:
+    sky api start / sky api login)."""
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli as cli_mod
+    runner = CliRunner()
+    res = runner.invoke(cli_mod.cli, ['api', 'start'])
+    assert res.exit_code == 0, res.output
+    assert 'running at' in res.output
+
+    url = os.environ['SKYTPU_API_SERVER_URL']
+    # --port persists the endpoint so later commands (and `api stop`)
+    # target the SAME server instead of auto-starting a second one.
+    port = int(url.rsplit(':', 1)[1])
+    res = runner.invoke(cli_mod.cli, ['api', 'start', '--port',
+                                      str(port)])
+    assert res.exit_code == 0, res.output
+    import yaml
+    cfg_path = os.path.expanduser('~/.skytpu/config.yaml')
+    cfg = yaml.safe_load(open(cfg_path, encoding='utf-8'))
+    assert cfg['api_server']['endpoint'] == url
+    res = runner.invoke(cli_mod.cli, ['api', 'login', url])
+    assert res.exit_code == 0, res.output
+    assert 'Logged in' in res.output
+    cfg_path = os.path.expanduser('~/.skytpu/config.yaml')
+    import yaml
+    cfg = yaml.safe_load(open(cfg_path, encoding='utf-8'))
+    assert cfg['api_server']['endpoint'] == url
+
+    # A dead endpoint is refused (no silent misconfiguration).
+    res = runner.invoke(cli_mod.cli,
+                        ['api', 'login', 'http://127.0.0.1:1'])
+    assert res.exit_code != 0
+    # Refusal must not clobber the working login.
+    cfg = yaml.safe_load(open(cfg_path, encoding='utf-8'))
+    assert cfg['api_server']['endpoint'] == url
+
+
+def test_bench_ls_and_delete_cli(api_env):
+    """`bench ls` lists recorded benchmarks; `bench delete` removes
+    records only (parity: sky bench ls / delete)."""
+    from click.testing import CliRunner
+    from skypilot_tpu.benchmark import benchmark_state
+    from skypilot_tpu.client import cli as cli_mod
+    runner = CliRunner()
+    res = runner.invoke(cli_mod.cli, ['bench', 'ls'])
+    assert res.exit_code == 0
+    assert 'No benchmarks' in res.output
+
+    benchmark_state.add_benchmark('b1', 'task-x')
+    benchmark_state.add_result('b1', 'bench-b1-0',
+                               '{"cloud": "local"}', 0.0)
+    res = runner.invoke(cli_mod.cli, ['bench', 'ls'])
+    assert res.exit_code == 0, res.output
+    assert 'b1' in res.output and 'task-x' in res.output
+    assert '0/1' in res.output
+
+    res = runner.invoke(cli_mod.cli, ['bench', 'delete', 'b1'])
+    assert res.exit_code == 0, res.output
+    assert benchmark_state.get_benchmark('b1') is None
+    res = runner.invoke(cli_mod.cli, ['bench', 'delete', 'nope'])
+    assert 'not found' in res.output
+
+
+def test_completion_and_jobs_dashboard_cli(tmp_path, monkeypatch):
+    """`completion` prints/install the click hook; `jobs dashboard`
+    prints the dashboard URL (parity: sky shell completion + sky jobs
+    dashboard)."""
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli as cli_mod
+    runner = CliRunner()
+    res = runner.invoke(cli_mod.cli, ['completion', 'bash'])
+    assert res.exit_code == 0
+    assert '_SKYTPU_COMPLETE=bash_source' in res.output
+
+    monkeypatch.setenv('HOME', str(tmp_path))
+    res = runner.invoke(cli_mod.cli,
+                        ['completion', 'bash', '--install'])
+    assert res.exit_code == 0, res.output
+    rc = (tmp_path / '.bashrc').read_text()
+    assert '_SKYTPU_COMPLETE=bash_source' in rc
+    # Idempotent.
+    res = runner.invoke(cli_mod.cli,
+                        ['completion', 'bash', '--install'])
+    assert 'already installed' in res.output
+    assert (tmp_path / '.bashrc').read_text().count(
+        '_SKYTPU_COMPLETE') == 1
