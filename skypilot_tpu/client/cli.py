@@ -151,9 +151,28 @@ def exec_cmd(entrypoint, cluster, detach_run):
 
 @cli.command()
 @click.option('--refresh', '-r', is_flag=True, default=False)
+@click.option('--endpoints', 'show_endpoints', is_flag=True,
+              default=False,
+              help='Show URLs of the cluster\'s declared ports.')
+@click.option('--endpoint', 'one_endpoint', type=int, default=None,
+              help='Show the URL of ONE declared port.')
 @click.argument('clusters', nargs=-1)
-def status(refresh, clusters):
-    """Show clusters."""
+def status(refresh, show_endpoints, one_endpoint, clusters):
+    """Show clusters (parity incl. `sky status --endpoints`)."""
+    if show_endpoints or one_endpoint is not None:
+        if len(clusters) != 1:
+            raise click.UsageError(
+                '--endpoints/--endpoint take exactly one CLUSTER.')
+        eps = sdk.get(sdk.endpoints(clusters[0], port=one_endpoint))
+        if not eps:
+            click.echo(f'Cluster {clusters[0]!r} declares no ports.')
+            return
+        if one_endpoint is not None:
+            click.echo(eps[str(one_endpoint)])
+            return
+        for p, url in sorted(eps.items(), key=lambda kv: int(kv[0])):
+            click.echo(f'{p}: {url}')
+        return
     records = sdk.get(sdk.status(list(clusters) or None, refresh=refresh))
     if not records:
         click.echo('No existing clusters.')
@@ -656,22 +675,46 @@ def api_info():
 
 def _persist_endpoint(endpoint: str) -> None:
     """Write api_server.endpoint to the USER config (the same file the
-    loader resolves — $SKYTPU_CONFIG aware), atomically."""
-    import yaml as yaml_lib
+    loader resolves — $SKYTPU_CONFIG aware), atomically and
+    SURGICALLY: users hand-maintain this file (pod_config overlays,
+    comments), so only the endpoint line may change — no yaml
+    round-trip that would strip comments/ordering."""
+    import re
 
     import skypilot_tpu.skypilot_config as config_lib
     path = config_lib.config_path()
-    cfg = {}
+    content = ''
     if os.path.exists(path):
         with open(path, encoding='utf-8') as f:
-            cfg = yaml_lib.safe_load(f) or {}
-    cfg.setdefault('api_server', {})['endpoint'] = endpoint
+            content = f.read()
+    block = f'api_server:\n  endpoint: {endpoint}\n'
+    # An existing `endpoint:` under `api_server:` gets rewritten in
+    # place; an existing `api_server:` without one gains the key; else
+    # the block is appended.
+    ep_re = re.compile(
+        r'(^api_server:\s*\n(?:[ \t]+.*\n)*?[ \t]+endpoint:)[^\n]*',
+        re.MULTILINE)
+    sec_re = re.compile(r'^api_server:[ \t]*\n', re.MULTILINE)
+    if ep_re.search(content):
+        content = ep_re.sub(rf'\1 {endpoint}', content, count=1)
+    elif sec_re.search(content):
+        content = sec_re.sub(f'api_server:\n  endpoint: {endpoint}\n',
+                             content, count=1)
+    else:
+        sep = '' if (not content or content.endswith('\n')) else '\n'
+        content = f'{content}{sep}{block}'
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = f'{path}.tmp-{os.getpid()}'
     with open(tmp, 'w', encoding='utf-8') as f:
-        yaml_lib.safe_dump(cfg, f)
+        f.write(content)
     os.replace(tmp, path)
     config_lib.reload_config()
+    env = os.environ.get('SKYTPU_API_SERVER_URL')
+    if env and env.rstrip('/') != endpoint:
+        click.echo(
+            f'WARNING: $SKYTPU_API_SERVER_URL={env} is set and takes '
+            'precedence over the persisted endpoint — unset it for '
+            'this login to take effect.')
 
 
 @api.command(name='start')
@@ -683,14 +726,18 @@ def api_start(port):
     persisted to the user config so every later command (and `api
     stop`) targets the same server."""
     from skypilot_tpu.server import common as server_common
+    endpoint = None
     if port is not None:
         endpoint = f'http://127.0.0.1:{port}'
         os.environ['SKYTPU_API_SERVER_URL'] = endpoint
-        # Without persistence the next CLI invocation would compute the
-        # default URL and auto-start a SECOND server, orphaning this
-        # one.
-        _persist_endpoint(endpoint)
     url = server_common.check_server_healthy_or_start()
+    if endpoint is not None:
+        # Persist only AFTER the server is confirmed healthy — a
+        # failed bind must not leave every later command pointed at a
+        # dead endpoint. Without persistence the next CLI invocation
+        # would compute the default URL and auto-start a SECOND
+        # server, orphaning this one.
+        _persist_endpoint(endpoint)
     click.echo(f'API server running at {url}.')
 
 

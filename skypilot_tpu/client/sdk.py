@@ -191,6 +191,13 @@ def status(cluster_names: Optional[List[str]] = None,
                              'refresh': refresh})
 
 
+def endpoints(cluster_name: str, port: Optional[int] = None) -> str:
+    """URLs for a cluster's declared ports (parity: sky status
+    --endpoints)."""
+    return _post('/endpoints', {'cluster_name': cluster_name,
+                                'port': port})
+
+
 def start(cluster_name: str, retry_until_up: bool = False) -> str:
     return _post('/start', {'cluster_name': cluster_name,
                             'retry_until_up': retry_until_up})

@@ -32,6 +32,70 @@ def status(cluster_names: Optional[Union[str, List[str]]] = None,
                                      cluster_names=cluster_names)
 
 
+def cluster_endpoints(cluster_name: str,
+                      port: Optional[int] = None) -> Dict[int, str]:
+    """URLs for a cluster's declared ``ports:`` (parity: `sky status
+    --endpoints`, core.py endpoints).
+
+    Per transport: ssh hosts → the head's IP; local → loopback;
+    kubernetes → the NodePort the ports Service assigned (the node IP
+    is cluster-specific, so the node-port mapping is the useful part).
+    """
+    record = global_state.get_cluster_from_name(cluster_name)
+    if record is None or record.get('handle') is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    # Only UP clusters have live endpoints: a STOPPED cluster's cached
+    # host IPs are stale (and likely change on restart) — printing
+    # them would be wrong, not just useless.
+    if record['status'] != global_state.ClusterStatus.UP:
+        raise exceptions.InvalidSkyError(
+            f'Cluster {cluster_name!r} is '
+            f"{record['status'].value}, not UP — endpoints are only "
+            'live on running clusters.')
+    handle = record['handle']
+    res = handle.launched_resources
+    from skypilot_tpu.utils import common_utils
+    declared: List[int] = common_utils.expand_ports(
+        res.ports if res is not None else [])
+    if port is not None:
+        if port not in declared:
+            raise exceptions.InvalidSkyError(
+                f'Port {port} is not declared by {cluster_name!r} '
+                f'(declared: {declared or "none"}).')
+        declared = [port]
+    hosts = handle.cached_hosts or []
+    if not hosts:
+        raise exceptions.InvalidSkyError(
+            f'Cluster {cluster_name!r} has no cached hosts; run '
+            '`skytpu status -r` to refresh.')
+    head = hosts[0]
+    out: Dict[int, str] = {}
+    if head['transport'] == 'kubernetes':
+        from skypilot_tpu.provision.kubernetes import instance as k8s_inst
+        from skypilot_tpu.provision.kubernetes import k8s_api
+        client = k8s_api.make_client(head.get('context'))
+        try:
+            svc = client.get_service(
+                head.get('namespace', 'default'),
+                k8s_inst._ports_service_name(  # pylint: disable=protected-access
+                    handle.cluster_name_on_cloud))
+            node_ports = {
+                int(sp['port']): int(sp.get('nodePort', sp['port']))
+                for sp in svc.get('spec', {}).get('ports', [])
+            }
+        except k8s_api.K8sApiError:
+            node_ports = {}
+        for p in declared:
+            out[p] = f'http://<node-ip>:{node_ports.get(p, p)}'
+    else:
+        ip = ('127.0.0.1' if head['transport'] == 'local' else
+              head.get('ip', head.get('internal_ip', '')))
+        for p in declared:
+            out[p] = f'http://{ip}:{p}'
+    return out
+
+
 @usage_lib.entrypoint(name='start')
 def start(cluster_name: str,
           idle_minutes_to_autostop: Optional[int] = None,

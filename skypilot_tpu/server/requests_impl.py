@@ -76,6 +76,13 @@ def _status(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
     return out
 
 
+def _endpoints(payload: Dict[str, Any]) -> Dict[str, str]:
+    from skypilot_tpu import core
+    out = core.cluster_endpoints(payload['cluster_name'],
+                                 port=payload.get('port'))
+    return {str(k): v for k, v in out.items()}
+
+
 def _start(payload: Dict[str, Any]) -> Dict[str, Any]:
     from skypilot_tpu import core
     handle = core.start(payload['cluster_name'],
@@ -260,6 +267,7 @@ EXECUTORS: Dict[str, Callable[[Dict[str, Any]], Any]] = {
     'launch': _launch,
     'exec': _exec,
     'status': _status,
+    'endpoints': _endpoints,
     'start': _start,
     'stop': _stop,
     'down': _down,

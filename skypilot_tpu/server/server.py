@@ -31,6 +31,7 @@ _VERB_ROUTES = {
     '/launch': 'launch',
     '/exec': 'exec',
     '/status': 'status',
+    '/endpoints': 'endpoints',
     '/start': 'start',
     '/stop': 'stop',
     '/down': 'down',
@@ -239,16 +240,11 @@ async def handle_pod_ssh_proxy(request: web.Request) -> web.StreamResponse:
     allowed = {22}
     res = getattr(rec['handle'], 'launched_resources', None)
     if res is not None and getattr(res, 'ports', None):
-        for p in res.ports:
-            s = str(p)
-            try:
-                if '-' in s:
-                    lo, hi = s.split('-', 1)
-                    allowed.update(range(int(lo), int(hi) + 1))
-                else:
-                    allowed.add(int(s))
-            except ValueError:
-                continue
+        from skypilot_tpu.utils import common_utils
+        try:
+            allowed.update(common_utils.expand_ports(res.ports))
+        except ValueError:
+            pass  # malformed declaration exposes nothing extra
     if port not in allowed:
         raise web.HTTPForbidden(
             text=f'port {port} is not exposed by cluster {cluster!r} '

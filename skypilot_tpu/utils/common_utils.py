@@ -188,3 +188,22 @@ def fn_qualname(fn: Callable) -> str:
     mod = inspect.getmodule(fn)
     prefix = f'{mod.__name__}.' if mod else ''
     return prefix + getattr(fn, '__qualname__', str(fn))
+
+
+def expand_ports(ports) -> list:
+    """Expand a declared ``ports:`` list ('8080', 8080, '9000-9010')
+    into sorted ints. ONE shared implementation — the same expansion
+    previously lived per-call-site, with validation drifting between
+    copies. Raises ValueError on malformed or reversed ranges."""
+    out = set()
+    for p in ports or []:
+        s = str(p)
+        if '-' in s:
+            lo_s, _, hi_s = s.partition('-')
+            lo, hi = int(lo_s), int(hi_s)
+            if hi < lo:
+                raise ValueError(f'Invalid port range {s!r}: end < start.')
+            out.update(range(lo, hi + 1))
+        else:
+            out.add(int(s))
+    return sorted(out)

@@ -37,6 +37,12 @@ def _local_task(name, run):
     return task
 
 
+def _free_url() -> str:
+    with socket.socket() as s:
+        s.bind(('', 0))
+        return f'http://127.0.0.1:{s.getsockname()[1]}'
+
+
 def test_sdk_roundtrip(api_env):
     # launch auto-starts the server, provisions, runs.
     rid = sdk.launch(_local_task('api-hello', 'echo api-hello-out'),
@@ -430,13 +436,24 @@ def test_api_start_and_login_cli(api_env):
     cfg_path = os.path.expanduser('~/.skytpu/config.yaml')
     cfg = yaml.safe_load(open(cfg_path, encoding='utf-8'))
     assert cfg['api_server']['endpoint'] == url
+    # A hand-maintained config (comments!) must survive the login
+    # surgically — only the endpoint line may change.
+    with open(cfg_path, 'w', encoding='utf-8') as f:
+        f.write('# my precious comment\n'
+                'kubernetes:\n  namespace: prod  # inline note\n')
     res = runner.invoke(cli_mod.cli, ['api', 'login', url])
     assert res.exit_code == 0, res.output
     assert 'Logged in' in res.output
-    cfg_path = os.path.expanduser('~/.skytpu/config.yaml')
-    import yaml
-    cfg = yaml.safe_load(open(cfg_path, encoding='utf-8'))
+    raw = open(cfg_path, encoding='utf-8').read()
+    assert '# my precious comment' in raw
+    assert '# inline note' in raw
+    cfg = yaml.safe_load(raw)
     assert cfg['api_server']['endpoint'] == url
+    assert cfg['kubernetes']['namespace'] == 'prod'
+    # Re-login rewrites the SAME endpoint line, not a duplicate.
+    res = runner.invoke(cli_mod.cli, ['api', 'login', url])
+    assert open(cfg_path,
+                encoding='utf-8').read().count('endpoint:') == 1
 
     # A dead endpoint is refused (no silent misconfiguration).
     res = runner.invoke(cli_mod.cli,
@@ -484,6 +501,7 @@ def test_completion_and_jobs_dashboard_cli(tmp_path, monkeypatch):
     assert res.exit_code == 0
     assert '_SKYTPU_COMPLETE=bash_source' in res.output
 
+    isolated_home = os.environ['HOME']  # conftest's per-test home
     monkeypatch.setenv('HOME', str(tmp_path))
     res = runner.invoke(cli_mod.cli,
                         ['completion', 'bash', '--install'])
@@ -496,3 +514,44 @@ def test_completion_and_jobs_dashboard_cli(tmp_path, monkeypatch):
     assert 'already installed' in res.output
     assert (tmp_path / '.bashrc').read_text().count(
         '_SKYTPU_COMPLETE') == 1
+
+    # `jobs dashboard` prints the dashboard URL (auto-starting the
+    # server like the bare `dashboard` verb).
+    monkeypatch.setenv('HOME', isolated_home)
+    monkeypatch.setenv('SKYTPU_API_SERVER_URL', _free_url())
+    try:
+        res = runner.invoke(cli_mod.cli, ['jobs', 'dashboard'])
+        assert res.exit_code == 0, res.output
+        assert res.output.strip().endswith('/dashboard')
+    finally:
+        from skypilot_tpu.server import common as server_common
+        server_common.stop_local_server()
+
+
+def test_status_endpoints_cli(api_env):
+    """`status --endpoints` / `--endpoint P` resolve declared-port URLs
+    through the server (parity: sky status --endpoints)."""
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli as cli_mod
+    task = sky.Task(name='ep-task', run='echo ok')
+    task.set_resources(sky.Resources(cloud='local',
+                                     ports=[8441, '8450-8451']))
+    sdk.get(sdk.launch(task, cluster_name='ep-c1'))
+    try:
+        runner = CliRunner()
+        res = runner.invoke(cli_mod.cli,
+                            ['status', '--endpoints', 'ep-c1'])
+        assert res.exit_code == 0, res.output
+        assert '8441: http://127.0.0.1:8441' in res.output
+        assert '8450: http://127.0.0.1:8450' in res.output
+        assert '8451: http://127.0.0.1:8451' in res.output
+        res = runner.invoke(cli_mod.cli,
+                            ['status', '--endpoint', '8441', 'ep-c1'])
+        assert res.exit_code == 0, res.output
+        assert res.output.strip() == 'http://127.0.0.1:8441'
+        # Undeclared port is a loud error.
+        res = runner.invoke(cli_mod.cli,
+                            ['status', '--endpoint', '9', 'ep-c1'])
+        assert res.exit_code != 0
+    finally:
+        sdk.get(sdk.down('ep-c1'))

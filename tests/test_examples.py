@@ -104,3 +104,49 @@ def test_launch_gke_tpu_recipe(tmp_path, monkeypatch):
     status = _wait_job('t-gke', job_id)
     assert status == job_lib.JobStatus.SUCCEEDED
     sky.down('t-gke')
+
+
+def test_launch_k8s_pvc_recipe(tmp_path, monkeypatch):
+    """The PVC example end-to-end on the fake GKE cluster: pod_config
+    overlay mounts the 'PVC' (a host dir in the fake), the job
+    checkpoints there, and a SECOND run resumes from it."""
+    monkeypatch.setenv('SKYTPU_K8S_FAKE', '1')
+    pvc_dir = tmp_path / 'pvc'
+    pvc_dir.mkdir()
+    home_cfg = os.path.expanduser('~/.skytpu')
+    os.makedirs(home_cfg, exist_ok=True)
+    with open(os.path.join(home_cfg, 'config.yaml'), 'w',
+              encoding='utf-8') as f:
+        f.write('kubernetes:\n  pod_config:\n    spec:\n'
+                '      volumes:\n        - name: ckpts\n'
+                '          hostPath:\n'
+                f'            path: {pvc_dir}\n'
+                '      containers:\n        - volumeMounts:\n'
+                '            - name: ckpts\n'
+                '              mountPath: /ckpts\n')
+    import skypilot_tpu.skypilot_config as config
+    config.reload_config()
+    global_state.set_enabled_clouds(['Kubernetes'])
+
+    path = os.path.join(EXAMPLES_DIR, 'k8s_pvc_checkpoints.yaml')
+    task = sky.Task.from_yaml(path)
+    # CPU-sized for the fake cluster; checkpoint "PVC" = the host dir
+    # (fake pods run on this host, so hostPath and PVC are equivalent
+    # for the resume semantics under test).
+    task.set_resources(sky.Resources(cloud='kubernetes'))
+    task.update_envs({'CKPT_DIR': str(pvc_dir / 'run1'), 'STEPS': '5'})
+    job_id, _ = sky.launch(task, cluster_name='ex-pvc',
+                           detach_run=True, stream_logs=False)
+    assert _wait_job('ex-pvc', job_id) == job_lib.JobStatus.SUCCEEDED
+    assert (pvc_dir / 'run1' / 'step.txt').read_text() == '5'
+
+    # Second run resumes from the checkpoint marker.
+    task2 = sky.Task.from_yaml(path)
+    task2.set_resources(sky.Resources(cloud='kubernetes'))
+    task2.update_envs({'CKPT_DIR': str(pvc_dir / 'run1'),
+                       'STEPS': '7'})
+    job_id2, _ = sky.launch(task2, cluster_name='ex-pvc',
+                            detach_run=True, stream_logs=False)
+    assert _wait_job('ex-pvc', job_id2) == job_lib.JobStatus.SUCCEEDED
+    assert (pvc_dir / 'run1' / 'step.txt').read_text() == '7'
+    sky.down('ex-pvc')
