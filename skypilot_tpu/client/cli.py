@@ -156,9 +156,24 @@ def exec_cmd(entrypoint, cluster, detach_run):
               help='Show URLs of the cluster\'s declared ports.')
 @click.option('--endpoint', 'one_endpoint', type=int, default=None,
               help='Show the URL of ONE declared port.')
+@click.option('--kubernetes', '-k', 'show_k8s', is_flag=True,
+              default=False,
+              help='Show framework pods across allowed k8s contexts.')
 @click.argument('clusters', nargs=-1)
-def status(refresh, show_endpoints, one_endpoint, clusters):
-    """Show clusters (parity incl. `sky status --endpoints`)."""
+def status(refresh, show_endpoints, one_endpoint, show_k8s, clusters):
+    """Show clusters (parity incl. `sky status --endpoints` and
+    `sky status --kubernetes`)."""
+    if show_k8s:
+        records = sdk.get(sdk.kubernetes_status())
+        if not records:
+            click.echo('No framework pods in any allowed Kubernetes '
+                       'context.')
+            return
+        rows = [(r['context'], r['cluster_name_on_cloud'],
+                 str(r['pods']), ','.join(r['phases'])) for r in records]
+        click.echo(_table(('CONTEXT', 'CLUSTER', 'PODS', 'PHASES'),
+                          rows))
+        return
     if show_endpoints or one_endpoint is not None:
         if len(clusters) != 1:
             raise click.UsageError(
@@ -679,30 +694,47 @@ def _persist_endpoint(endpoint: str) -> None:
     SURGICALLY: users hand-maintain this file (pod_config overlays,
     comments), so only the endpoint line may change — no yaml
     round-trip that would strip comments/ordering."""
-    import re
-
     import skypilot_tpu.skypilot_config as config_lib
     path = config_lib.config_path()
-    content = ''
+    lines: list = []
     if os.path.exists(path):
         with open(path, encoding='utf-8') as f:
-            content = f.read()
-    block = f'api_server:\n  endpoint: {endpoint}\n'
-    # An existing `endpoint:` under `api_server:` gets rewritten in
-    # place; an existing `api_server:` without one gains the key; else
-    # the block is appended.
-    ep_re = re.compile(
-        r'(^api_server:\s*\n(?:[ \t]+.*\n)*?[ \t]+endpoint:)[^\n]*',
-        re.MULTILINE)
-    sec_re = re.compile(r'^api_server:[ \t]*\n', re.MULTILINE)
-    if ep_re.search(content):
-        content = ep_re.sub(rf'\1 {endpoint}', content, count=1)
-    elif sec_re.search(content):
-        content = sec_re.sub(f'api_server:\n  endpoint: {endpoint}\n',
-                             content, count=1)
+            lines = f.read().splitlines(keepends=True)
+    # Line-walk, not regex: the endpoint must be a DIRECT child of a
+    # top-level `api_server:` block (blank lines allowed inside it; a
+    # nested `auth.endpoint` must not be touched).
+    sec_start = next(
+        (i for i, l in enumerate(lines)
+         if l.split('#', 1)[0].rstrip() == 'api_server:'), None)
+    def _indent(s: str) -> int:
+        return len(s) - len(s.lstrip(' \t'))
+    if sec_start is not None:
+        child_indent = None
+        ep_line = None
+        for i in range(sec_start + 1, len(lines)):
+            line = lines[i]
+            if line.strip() == '':
+                continue  # blank lines inside the block are fine
+            if _indent(line) == 0:
+                break  # next top-level key: block ended
+            if child_indent is None:
+                child_indent = _indent(line)
+            if (_indent(line) == child_indent and
+                    line.split('#', 1)[0].strip().startswith(
+                        'endpoint:')):
+                ep_line = i
+                break
+        pad = ' ' * (child_indent or 2)
+        new_line = f'{pad}endpoint: {endpoint}\n'
+        if ep_line is not None:
+            lines[ep_line] = new_line
+        else:
+            lines.insert(sec_start + 1, new_line)
     else:
-        sep = '' if (not content or content.endswith('\n')) else '\n'
-        content = f'{content}{sep}{block}'
+        if lines and not lines[-1].endswith('\n'):
+            lines[-1] += '\n'
+        lines += ['api_server:\n', f'  endpoint: {endpoint}\n']
+    content = ''.join(lines)
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = f'{path}.tmp-{os.getpid()}'
     with open(tmp, 'w', encoding='utf-8') as f:

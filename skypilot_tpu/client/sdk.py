@@ -198,6 +198,12 @@ def endpoints(cluster_name: str, port: Optional[int] = None) -> str:
                                 'port': port})
 
 
+def kubernetes_status() -> str:
+    """Framework pods across allowed k8s contexts (parity: sky status
+    --kubernetes)."""
+    return _post('/kubernetes_status', {})
+
+
 def start(cluster_name: str, retry_until_up: bool = False) -> str:
     return _post('/start', {'cluster_name': cluster_name,
                             'retry_until_up': retry_until_up})

@@ -32,6 +32,45 @@ def status(cluster_names: Optional[Union[str, List[str]]] = None,
                                      cluster_names=cluster_names)
 
 
+def kubernetes_status() -> List[Dict[str, Any]]:
+    """Framework pods across every allowed Kubernetes context (parity:
+    `sky status --kubernetes` / _status_kubernetes): the cloud-side
+    truth, independent of the local registry — finds pods this client
+    forgot about (wiped state, another operator's launches)."""
+    from skypilot_tpu.clouds import kubernetes as k8s_cloud
+    from skypilot_tpu.provision.kubernetes import instance as k8s_inst
+    from skypilot_tpu.provision.kubernetes import k8s_api
+    out: List[Dict[str, Any]] = []
+    for ctx in k8s_cloud.Kubernetes.existing_allowed_contexts():
+        client = k8s_api.make_client(ctx)
+        # Same namespace resolution as provisioning (config
+        # kubernetes.namespace / in-cluster SA namespace) — listing
+        # 'default' would miss every pod of a namespaced deployment.
+        namespace = k8s_inst._namespace({'context': ctx})  # pylint: disable=protected-access
+        try:
+            pods = client.list_pods(namespace, k8s_inst._CLUSTER_LABEL)  # pylint: disable=protected-access
+        except k8s_api.K8sApiError as e:
+            logger.debug(f'status --kubernetes: context {ctx}: {e}')
+            continue
+        by_cluster: Dict[str, List[dict]] = {}
+        for pod in pods:
+            name = pod['metadata']['labels'].get(
+                k8s_inst._CLUSTER_LABEL, '?')  # pylint: disable=protected-access
+            by_cluster.setdefault(name, []).append(pod)
+        for name, cluster_pods in sorted(by_cluster.items()):
+            phases = [p.get('status', {}).get('phase', '?')
+                      for p in cluster_pods]
+            out.append({
+                'context': ctx,
+                'cluster_name_on_cloud': name,
+                'pods': len(cluster_pods),
+                'phases': sorted(set(phases)),
+                'pod_names': sorted(p['metadata']['name']
+                                    for p in cluster_pods),
+            })
+    return out
+
+
 def cluster_endpoints(cluster_name: str,
                       port: Optional[int] = None) -> Dict[int, str]:
     """URLs for a cluster's declared ``ports:`` (parity: `sky status
@@ -56,8 +95,14 @@ def cluster_endpoints(cluster_name: str,
     handle = record['handle']
     res = handle.launched_resources
     from skypilot_tpu.utils import common_utils
-    declared: List[int] = common_utils.expand_ports(
-        res.ports if res is not None else [])
+    # Per-entry tolerance: one malformed declaration must not hide the
+    # valid ports of a cluster the framework itself launched.
+    declared: List[int] = []
+    for p in (res.ports or []) if res is not None else []:
+        try:
+            declared.extend(common_utils.expand_ports([p]))
+        except ValueError as e:
+            logger.debug(f'endpoints: skipping port entry {p!r}: {e}')
     if port is not None:
         if port not in declared:
             raise exceptions.InvalidSkyError(

@@ -390,20 +390,16 @@ def _ports_service_name(cluster_name_on_cloud: str) -> str:
 
 
 def _parse_ports(ports: List[str]) -> List[int]:
-    out: List[int] = []
-    for p in ports:
-        s = str(p)
-        if '-' in s:
-            lo, hi = s.split('-', 1)
-            if int(hi) < int(lo):
-                raise common.ProvisionerError(
-                    f'Invalid port range {s!r}: end < start.')
-            out.extend(range(int(lo), int(hi) + 1))
-        else:
-            out.append(int(s))
+    # Delegates to the ONE shared expansion; the provisioner surface
+    # keeps its typed error.
+    from skypilot_tpu.utils import common_utils
+    try:
+        out = common_utils.expand_ports(ports)
+    except ValueError as e:
+        raise common.ProvisionerError(str(e)) from e
     if not out:
         raise common.ProvisionerError(f'No ports parsed from {ports!r}.')
-    return sorted(set(out))
+    return out
 
 
 def _real_open_ports(cluster_name_on_cloud: str, ports: List[str],

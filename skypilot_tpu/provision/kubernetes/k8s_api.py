@@ -464,17 +464,23 @@ class FakeK8sService:
     def list_pods(self, namespace: str,
                   label_selector: Optional[str] = None) -> List[dict]:
         pods = self._load()
-        wanted: Dict[str, str] = {}
+        # Selector terms: 'k=v' (equality) or bare 'k' (existence) —
+        # the two forms kubectl -l accepts that the framework uses.
+        wanted: Dict[str, Optional[str]] = {}
         if label_selector:
             for part in label_selector.split(','):
-                k, _, v = part.partition('=')
-                wanted[k] = v
+                if '=' in part:
+                    k, _, v = part.partition('=')
+                    wanted[k] = v
+                else:
+                    wanted[part] = None  # existence
         out = []
         for key, pod in pods.items():
             if not key.startswith(f'{namespace}/'):
                 continue
             labels = pod.get('metadata', {}).get('labels', {})
-            if all(labels.get(k) == v for k, v in wanted.items()):
+            if all((k in labels) if v is None else (labels.get(k) == v)
+                   for k, v in wanted.items()):
                 out.append(pod)
         return out
 

@@ -76,6 +76,12 @@ def _status(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
     return out
 
 
+def _kubernetes_status(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    del payload
+    from skypilot_tpu import core
+    return core.kubernetes_status()
+
+
 def _endpoints(payload: Dict[str, Any]) -> Dict[str, str]:
     from skypilot_tpu import core
     out = core.cluster_endpoints(payload['cluster_name'],
@@ -268,6 +274,7 @@ EXECUTORS: Dict[str, Callable[[Dict[str, Any]], Any]] = {
     'exec': _exec,
     'status': _status,
     'endpoints': _endpoints,
+    'kubernetes_status': _kubernetes_status,
     'start': _start,
     'stop': _stop,
     'down': _down,

@@ -32,6 +32,7 @@ _VERB_ROUTES = {
     '/exec': 'exec',
     '/status': 'status',
     '/endpoints': 'endpoints',
+    '/kubernetes_status': 'kubernetes_status',
     '/start': 'start',
     '/stop': 'stop',
     '/down': 'down',
@@ -241,10 +242,11 @@ async def handle_pod_ssh_proxy(request: web.Request) -> web.StreamResponse:
     res = getattr(rec['handle'], 'launched_resources', None)
     if res is not None and getattr(res, 'ports', None):
         from skypilot_tpu.utils import common_utils
-        try:
-            allowed.update(common_utils.expand_ports(res.ports))
-        except ValueError:
-            pass  # malformed declaration exposes nothing extra
+        for p in res.ports:
+            try:
+                allowed.update(common_utils.expand_ports([p]))
+            except ValueError:
+                continue  # one bad entry must not drop the valid ones
     if port not in allowed:
         raise web.HTTPForbidden(
             text=f'port {port} is not exposed by cluster {cluster!r} '

@@ -455,6 +455,19 @@ def test_api_start_and_login_cli(api_env):
     assert open(cfg_path,
                 encoding='utf-8').read().count('endpoint:') == 1
 
+    # Hostile-but-legal YAML: blank line inside the block and a NESTED
+    # endpoint under a sub-key — only the direct child is rewritten.
+    with open(cfg_path, 'w', encoding='utf-8') as f:
+        f.write('api_server:\n  auth:\n    endpoint: keepme\n\n'
+                '  endpoint: http://old\n')
+    res = runner.invoke(cli_mod.cli, ['api', 'login', url])
+    assert res.exit_code == 0, res.output
+    raw = open(cfg_path, encoding='utf-8').read()
+    assert 'endpoint: keepme' in raw          # nested key untouched
+    assert 'http://old' not in raw            # direct child replaced
+    import yaml as yaml_lib
+    assert yaml_lib.safe_load(raw)['api_server']['endpoint'] == url
+
     # A dead endpoint is refused (no silent misconfiguration).
     res = runner.invoke(cli_mod.cli,
                         ['api', 'login', 'http://127.0.0.1:1'])

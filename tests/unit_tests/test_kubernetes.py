@@ -392,3 +392,25 @@ def test_multi_context_failover_e2e(monkeypatch, tmp_path):
     assert core.job_status('t-ctx-fo', job_id) == \
         job_lib.JobStatus.SUCCEEDED
     sky.down('t-ctx-fo')
+
+
+def test_status_kubernetes_across_contexts(monkeypatch):
+    """core.kubernetes_status lists framework pods per allowed context
+    (parity: sky status --kubernetes) — cloud-side truth, label-
+    selected, independent of the local registry."""
+    from skypilot_tpu import core
+    monkeypatch.setenv('SKYTPU_K8S_FAKE_CONTEXT', 'ctx-a')
+    k8s_instance.run_instances('ctx-a', 'ksts', _config(count=1))
+    try:
+        records = core.kubernetes_status()
+        mine = [r for r in records if r['cluster_name_on_cloud'] == 'ksts']
+        assert len(mine) == 1
+        rec = mine[0]
+        assert rec['context'] == 'ctx-a'
+        assert rec['pods'] == 4  # v5e-16 = 4 host pods
+        assert rec['phases'] == ['Running']
+        assert all(n.startswith('ksts-') for n in rec['pod_names'])
+    finally:
+        k8s_instance.terminate_instances('ksts', _provider_config())
+    assert all(r['cluster_name_on_cloud'] != 'ksts'
+               for r in core.kubernetes_status())
