@@ -109,3 +109,20 @@ def test_ports_validation():
     assert r.ports == ['8080', '9000-9010']
     with pytest.raises(exceptions.InvalidSkyError):
         Resources(ports='http')
+
+
+def test_expand_ports_shared_helper():
+    """The ONE port-expansion implementation: strings/ints/ranges,
+    dedup+sort, loud on reversed or malformed ranges."""
+    import pytest as _pytest
+
+    from skypilot_tpu.utils import common_utils
+    assert common_utils.expand_ports(['8080', 8081, '9000-9002']) == \
+        [8080, 8081, 9000, 9001, 9002]
+    assert common_utils.expand_ports(['8080', '8080']) == [8080]
+    assert common_utils.expand_ports([]) == []
+    assert common_utils.expand_ports(None) == []
+    with _pytest.raises(ValueError):
+        common_utils.expand_ports(['9002-9000'])
+    with _pytest.raises(ValueError):
+        common_utils.expand_ports(['http'])
