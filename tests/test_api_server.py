@@ -568,3 +568,68 @@ def test_status_endpoints_cli(api_env):
         assert res.exit_code != 0
     finally:
         sdk.get(sdk.down('ep-c1'))
+
+
+def test_ws_ssh_proxy_kubernetes_transport(api_env, tmp_path,
+                                           monkeypatch):
+    """The ws-proxy's KUBERNETES branch: the server spawns kubectl
+    port-forward for the head pod and bridges the websocket to the
+    forwarded socket. Fake kubectl (on $PATH) emulates the apiserver by
+    listening locally and piping to an 'sshd' echo server."""
+    import asyncio
+    import pickle
+    import stat
+    import threading
+
+    import aiohttp
+
+    from skypilot_tpu.backends.gang_backend import ClusterHandle
+    from tests.unit_tests.test_k8s_access import _FAKE_KUBECTL, \
+        _EchoServer
+
+    # Fake kubectl + echo "sshd".
+    kubectl = tmp_path / 'kubectl'
+    kubectl.write_text(_FAKE_KUBECTL)
+    kubectl.chmod(kubectl.stat().st_mode | stat.S_IEXEC)
+    echo = _EchoServer()
+    monkeypatch.setenv('PATH',
+                       f'{tmp_path}{os.pathsep}{os.environ["PATH"]}')
+    monkeypatch.setenv('FAKE_KUBECTL_TARGET_PORT', str(echo.port))
+
+    # A registry row whose head host is a kubernetes pod.
+    handle = ClusterHandle.__new__(ClusterHandle)
+    handle.__dict__.update({
+        '_version': 1,
+        'cluster_name': 'wsk8s-c1',
+        'cluster_name_on_cloud': 'wsk8s-c1-ab12cd34',
+        'launched_nodes': 1,
+        'launched_resources': sky.Resources(cloud='kubernetes'),
+        'provider_name': 'kubernetes',
+        'provider_config': {'namespace': 'ns1'},
+        'cached_hosts': [{
+            'transport': 'kubernetes', 'rank': 0,
+            'pod_name': 'wsk8s-c1-ab12cd34-0', 'namespace': 'ns1',
+            'context': None, 'access_mode': 'portforward-ssh',
+        }],
+        'ssh_user': 'skytpu', 'ssh_private_key': None,
+    })
+    global_state.add_or_update_cluster('wsk8s-c1', handle, ready=True)
+    try:
+        async def _roundtrip():
+            url = (f'{os.environ["SKYTPU_API_SERVER_URL"]}'
+                   '/k8s-pod-ssh-proxy?cluster=wsk8s-c1&port=22')
+            async with aiohttp.ClientSession() as session:
+                async with session.ws_connect(url) as ws:
+                    await ws.send_bytes(b'SSH-2.0-k8s-probe\r\n')
+                    msg = await asyncio.wait_for(ws.receive(),
+                                                 timeout=60)
+                    assert msg.type == aiohttp.WSMsgType.BINARY, msg
+                    return msg.data
+
+        # Boot the server first (inherits the fake-kubectl PATH).
+        sdk.get(sdk.status())
+        data = asyncio.new_event_loop().run_until_complete(_roundtrip())
+        assert data == b'SSH-2.0-k8s-probe\r\n'
+    finally:
+        echo.close()
+        global_state.remove_cluster('wsk8s-c1', terminate=True)
