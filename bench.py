@@ -65,6 +65,8 @@ _PHASE_DEADLINES = {
     'decode_run': 150,
     'decode_int8_compile': 180,
     'decode_int8_run': 150,
+    'decode_kv_int8_compile': 180,
+    'decode_kv_int8_run': 150,
 }
 
 
@@ -171,8 +173,21 @@ def _payload() -> None:
     del state, metrics, tokens, targets
     decode_detail = {}
     from skypilot_tpu.benchmark import decode_bench
-    for name, int8 in (('bf16', False), ('int8', True)):
-        phase = 'decode_compile' if not int8 else 'decode_int8_compile'
+    # The flash-decode kernel (ops/decode_attention.py) is the default
+    # attention path; SKYTPU_BENCH_DECODE_ATTN=xla runs the grouped-
+    # einsum XLA path for A/B (itself already lighter than the round-5
+    # repeat_kv path — the kernel delta understates the total win).
+    # kv_int8 additionally stores the KV cache int8 (half the cache
+    # bandwidth decode is bound by).
+    decode_attn = os.environ.get('SKYTPU_BENCH_DECODE_ATTN', 'kernel')
+    configs = (
+        ('bf16', dict(int8=False, kv_int8=False)),
+        ('int8', dict(int8=True, kv_int8=False)),
+        ('kv_int8', dict(int8=False, kv_int8=True)),
+    )
+    for name, kwargs in configs:
+        phase = ('decode_compile' if name == 'bf16' else
+                 f'decode_{name}_compile')
         try:
             harness.beat(phase)
             out = decode_bench.run_decode_bench(
@@ -184,20 +199,24 @@ def _payload() -> None:
                 batch=int(os.environ.get('SKYTPU_BENCH_DECODE_BATCH',
                                          '32')),
                 prompt_len=128, new_tokens=128,
-                steps=3, int8=int8,
+                steps=3, attn=decode_attn, **kwargs,
                 beat=harness.beat)
             decode_detail[name] = {
                 'tokens_per_sec': out['value'],
                 **{k: out['detail'][k]
                    for k in ('batch', 'prompt_len', 'new_tokens',
-                             'prefill_ms')},
+                             'prefill_ms', 'kv_cache_dtype',
+                             'decode_attention')},
             }
         except Exception as exc:  # decode is best-effort
             decode_detail[name] = {'error': f'{type(exc).__name__}: {exc}'}
     bf16 = decode_detail.get('bf16', {}).get('tokens_per_sec')
     i8 = decode_detail.get('int8', {}).get('tokens_per_sec')
+    kv8 = decode_detail.get('kv_int8', {}).get('tokens_per_sec')
     if bf16 and i8:
         decode_detail['int8_speedup'] = round(i8 / bf16, 3)
+    if bf16 and kv8:
+        decode_detail['kv_int8_speedup'] = round(kv8 / bf16, 3)
     result['detail']['decode'] = decode_detail
     # Cumulative line #2: train + decode. Last line wins.
     print(json.dumps(result), flush=True)
@@ -292,12 +311,12 @@ def _supervise() -> int:
     from skypilot_tpu.benchmark import harness
 
     log = lambda m: print(m, file=sys.stderr, flush=True)
-    # 900 s default: a COLD run (empty XLA compile cache after a tunnel
-    # restart) needs headroom for train + 2 decode compiles; warm runs
-    # finish in ~6 min. Real wedges still die at the per-phase
-    # deadlines, and cumulative line forwarding means a partial (train-
-    # only) result lands even if the tail is cut.
-    total = float(os.environ.get('SKYTPU_BENCH_TOTAL_TIMEOUT', '900'))
+    # 1080 s default: a COLD run (empty XLA compile cache after a tunnel
+    # restart) needs headroom for train + 3 decode compiles (bf16, int8
+    # weights, int8 KV); warm runs finish in ~6 min. Real wedges still
+    # die at the per-phase deadlines, and cumulative line forwarding
+    # means a partial (train-only) result lands even if the tail is cut.
+    total = float(os.environ.get('SKYTPU_BENCH_TOTAL_TIMEOUT', '1080'))
     attempts = int(os.environ.get('SKYTPU_BENCH_ATTEMPTS', '3'))
 
     # TPU mode iff the platform env names the tunneled backend, or is

@@ -7,6 +7,11 @@ IS in-tree, so the benchmark drives ``models/decode`` directly:
 static-shape KV-cache prefill + scanned decode). Prefill time is measured
 separately and subtracted, so the reported number is DECODE tokens/s.
 
+Serving knobs under test: ``--int8`` (weight GEMMs), ``--kv-int8``
+(int8 KV cache — halves the cache bandwidth decode is bound by) and
+``--attn kernel|xla`` (the Pallas flash-decode kernel of
+``ops/decode_attention.py`` vs the grouped-einsum XLA path).
+
 Prints ONE JSON line:
     {"metric": "llama_decode_tokens_per_sec", "value": N,
      "unit": "tokens/s/chip", ...}
@@ -29,7 +34,8 @@ import jax.numpy as jnp
 
 def run_decode_bench(model_name: str, batch: int, prompt_len: int,
                      new_tokens: int, steps: int = 5,
-                     int8: bool = False, beat=None) -> dict:
+                     int8: bool = False, kv_int8: bool = False,
+                     attn: str = 'kernel', beat=None) -> dict:
     from skypilot_tpu.models import decode, llama
 
     # When a supervising caller passes `beat`, devices are already up
@@ -49,8 +55,11 @@ def run_decode_bench(model_name: str, batch: int, prompt_len: int,
         steps = min(steps, 2)
 
     cfg = dataclasses.replace(llama.CONFIGS[model_name], remat=False)
-    dcfg = decode.DecodeConfig(max_len=prompt_len + new_tokens,
-                               temperature=0.0)
+    dcfg = decode.DecodeConfig(
+        max_len=prompt_len + new_tokens,
+        temperature=0.0,
+        decode_attention=attn,
+        kv_cache_dtype='int8' if kv_int8 else 'bf16')
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     if int8:
         # Int8 FFN + attention-projection weights: ~2x MXU rate and
@@ -60,17 +69,26 @@ def run_decode_bench(model_name: str, batch: int, prompt_len: int,
                                 (batch, prompt_len), 0, cfg.vocab_size)
     prompt_lens = jnp.full((batch,), prompt_len, jnp.int32)
 
-    gen = jax.jit(lambda p, t, l: decode.generate(
-        p, t, l, cfg, dcfg, new_tokens))
+    # decode.generate is already jit-compiled (static cfg/dcfg) — no
+    # second jax.jit wrapper. Internally it donates the cache into the
+    # jitted impl, so the per-call carry updates happen in place.
+    def gen(p, t, l):
+        return decode.generate(p, t, l, cfg, dcfg, new_tokens)
 
     def prefill_only(p, t, l):
-        cache = decode.init_kv_cache(cfg, batch, dcfg.max_len)
+        cache = decode.init_kv_cache(cfg, batch, dcfg.max_len,
+                                     dcfg.kv_cache_dtype)
         logits, _ = decode.prefill(p, t, cfg, cache, l)
         return logits
 
     pre = jax.jit(prefill_only)
 
-    run_phase = 'decode_int8_run' if int8 else 'decode_run'
+    if kv_int8:
+        run_phase = 'decode_kv_int8_run'
+    elif int8:
+        run_phase = 'decode_int8_run'
+    else:
+        run_phase = 'decode_run'
 
     def timed(fn, n) -> float:
         # Warmup/compile; a host fetch is the only reliable sync on the
@@ -89,6 +107,12 @@ def run_decode_bench(model_name: str, batch: int, prompt_len: int,
     decode_dt = max(gen_dt - pre_dt, 1e-9)
 
     tokens_per_sec = batch * new_tokens / decode_dt
+    # Report the attention path that actually RAN, not the requested one:
+    # 'kernel' silently falls back to XLA off-TPU / on non-tiling max_len.
+    from skypilot_tpu.ops import decode_attention as decode_attention_ops
+    resolved_attn = (decode_attention_ops.resolved_path(
+        dcfg.max_len, dcfg.kernel_block_k, dcfg.kernel_interpret)
+        if dcfg.decode_attention == 'kernel' else 'xla')
     return {
         'metric': 'llama_decode_tokens_per_sec',
         'value': round(tokens_per_sec, 1),
@@ -100,6 +124,9 @@ def run_decode_bench(model_name: str, batch: int, prompt_len: int,
             'prompt_len': prompt_len,
             'new_tokens': new_tokens,
             'int8': int8,
+            'kv_cache_dtype': dcfg.kv_cache_dtype,
+            'decode_attention': resolved_attn,
+            'decode_attention_requested': dcfg.decode_attention,
             'steps': steps,
             'prefill_ms': round(pre_dt * 1e3, 1),
             'device': str(devices[0]),
@@ -117,10 +144,19 @@ def main() -> None:
     parser.add_argument('--int8', action='store_true',
                         help='int8-quantize the FFN + attention projection '
                              'weights')
+    parser.add_argument('--kv-int8', action='store_true',
+                        help='store the KV cache int8 (per-position/head '
+                             'scales); halves decode cache bandwidth')
+    parser.add_argument('--attn', choices=('kernel', 'xla'),
+                        default='kernel',
+                        help='cached-attention path: Pallas flash-decode '
+                             'kernel (TPU) or grouped-einsum XLA')
     args = parser.parse_args()
     print(json.dumps(run_decode_bench(args.model, args.batch,
                                       args.prompt_len, args.new_tokens,
-                                      args.steps, int8=args.int8)))
+                                      args.steps, int8=args.int8,
+                                      kv_int8=args.kv_int8,
+                                      attn=args.attn)))
 
 
 if __name__ == '__main__':

@@ -6,10 +6,18 @@ static-shape engine):
 
 * KV cache preallocated at [L, B, max_len, Hkv, hd] — static shapes, so
   one compiled decode step serves every position (XLA requirement).
+  Optionally int8 (``kv_cache_dtype='int8'``) with per-(position, kv-head)
+  fp32 scales, halving the cache HBM traffic that bounds decode.
 * Prefill runs the full forward once (flash/ring attention applies),
   writing the cache; decode is a ``lax.scan`` of single-token steps whose
-  attention reads the cache with a position mask (no recompilation, MXU
-  does [B,1,d]x[d,*] matmuls batched over the whole batch).
+  attention reads the cache through the Pallas flash-decode kernel
+  (``ops/decode_attention.py``: online softmax over cache blocks, GQA
+  in-kernel, dead blocks past each row's cur_len never read) or a
+  grouped-einsum XLA fallback (``decode_attention='xla'``, and
+  automatically off-TPU).
+* The public ``decode_step``/``generate`` entry points donate the cache
+  (``donate_argnums``), so XLA updates the [L,B,max_len,Hkv,hd] carry in
+  place instead of allocating + copying it each call.
 * Greedy or temperature sampling; generation stops per-sequence on EOS
   via a done mask (static loop length, masked writes).
 """
@@ -21,9 +29,12 @@ import jax
 import jax.numpy as jnp
 
 from skypilot_tpu.models import llama
-from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.ops import decode_attention as decode_attention_ops
 
 Params = Dict[str, Any]
+# Cache pytree: {'k', 'v'} [L,B,max_len,Hkv,hd] (+ {'k_scale','v_scale'}
+# [L,B,max_len,Hkv] fp32 when the cache is int8).
+Cache = Dict[str, jax.Array]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -31,6 +42,16 @@ class DecodeConfig:
     max_len: int = 2048
     temperature: float = 0.0          # 0 = greedy
     eos_id: Optional[int] = None
+    # Cached-attention implementation: 'kernel' = Pallas flash-decode
+    # (TPU; falls back to the XLA path off-TPU), 'xla' = grouped einsum.
+    decode_attention: str = 'kernel'
+    # KV cache storage: 'bf16' (model dtype) or 'int8' (+ fp32 scales).
+    kv_cache_dtype: str = 'bf16'
+    # KV block streamed per kernel grid step (block skipping granularity).
+    kernel_block_k: int = decode_attention_ops.DEFAULT_BLOCK_K
+    # None: auto (compiled on TPU, XLA fallback elsewhere); True forces
+    # the Pallas interpreter (CPU numerics tests).
+    kernel_interpret: Optional[bool] = None
 
 
 def quantize_params(params: Params) -> Params:
@@ -38,7 +59,8 @@ def quantize_params(params: Params) -> Params:
     projections) for serving. Layer weights are stacked [L, in, out]: the
     contraction axis is 1, so scales are per (layer, output-channel). The
     quantized tensors flow through scan/jit as pytrees (ops/quant.py).
-    Embedding/lm_head and the KV cache stay bf16."""
+    Embedding/lm_head stay bf16; the KV cache quantizes separately via
+    ``DecodeConfig.kv_cache_dtype``."""
     from skypilot_tpu.ops import quant
     out = dict(params)
     layers = dict(params['layers'])
@@ -48,41 +70,66 @@ def quantize_params(params: Params) -> Params:
     return out
 
 
-def init_kv_cache(cfg: llama.LlamaConfig, batch: int,
-                  max_len: int) -> Dict[str, jax.Array]:
+def init_kv_cache(cfg: llama.LlamaConfig, batch: int, max_len: int,
+                  kv_cache_dtype: str = 'bf16') -> Cache:
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    if kv_cache_dtype == 'int8':
+        return {
+            'k': jnp.zeros(shape, jnp.int8),
+            'v': jnp.zeros(shape, jnp.int8),
+            'k_scale': jnp.zeros(shape[:-1], jnp.float32),
+            'v_scale': jnp.zeros(shape[:-1], jnp.float32),
+        }
+    assert kv_cache_dtype == 'bf16', kv_cache_dtype
     return {
         'k': jnp.zeros(shape, cfg.dtype),
         'v': jnp.zeros(shape, cfg.dtype),
     }
 
 
-def _attend_cached(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+def _attend_cached(dcfg: DecodeConfig, q: jax.Array, lcache: Cache,
                    cur_len: jax.Array) -> jax.Array:
-    """q [B,1,H,hd] against cache [B,max_len,Hkv,hd]; positions >= cur_len
-    masked out."""
-    b, _, h, hd = q.shape
-    hkv = k_cache.shape[2]
-    k = attention_ops.repeat_kv(k_cache, h // hkv)
-    v = attention_ops.repeat_kv(v_cache, h // hkv)
-    scale = hd**-0.5
-    logits = jnp.einsum('bshd,bthd->bhst', q, k,
-                        preferred_element_type=jnp.float32) * scale
-    kv_pos = jnp.arange(k.shape[1])
-    mask = kv_pos[None, :] < cur_len[:, None]          # [B, max_len]
-    logits = jnp.where(mask[:, None, None, :], logits,
-                       attention_ops.NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    out = jnp.einsum('bhst,bthd->bshd', probs, v,
-                     preferred_element_type=jnp.float32)
-    return out.astype(q.dtype)
+    """q [B,1,H,hd] against one layer's cache [B,max_len,Hkv,hd];
+    positions >= cur_len masked out (kernel path: never even read)."""
+    k_scale = lcache.get('k_scale')
+    v_scale = lcache.get('v_scale')
+    if dcfg.decode_attention == 'kernel':
+        return decode_attention_ops.decode_attention(
+            q, lcache['k'], lcache['v'], cur_len,
+            k_scale=k_scale, v_scale=v_scale,
+            block_k=dcfg.kernel_block_k,
+            interpret=dcfg.kernel_interpret)
+    assert dcfg.decode_attention == 'xla', dcfg.decode_attention
+    return decode_attention_ops.decode_attention_xla(
+        q, lcache['k'], lcache['v'], cur_len,
+        k_scale=k_scale, v_scale=v_scale)
 
 
-def _block_decode(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
-                  k_cache: jax.Array, v_cache: jax.Array,
-                  cos: jax.Array, sin: jax.Array, pos: jax.Array
-                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One decoder block for one new token; returns (x, k_new, v_new)."""
+def _write_kv(cache: Cache, idx, k: jax.Array, v: jax.Array) -> Cache:
+    """Write K/V into ``cache[...]`` at index tuple ``idx``, quantizing
+    on the way in when the cache is int8 — the single copy of the
+    write-side scheme, shared by prefill (whole [L,B,S,...] prefix) and
+    decode ([b_idx, pos] scatter)."""
+    cache = dict(cache)
+    if 'k_scale' in cache:
+        from skypilot_tpu.ops import quant
+        kq, ks = quant.quantize_kv(k)
+        vq, vs = quant.quantize_kv(v)
+        cache['k'] = cache['k'].at[idx].set(kq)
+        cache['v'] = cache['v'].at[idx].set(vq)
+        cache['k_scale'] = cache['k_scale'].at[idx].set(ks)
+        cache['v_scale'] = cache['v_scale'].at[idx].set(vs)
+    else:
+        cache['k'] = cache['k'].at[idx].set(k.astype(cache['k'].dtype))
+        cache['v'] = cache['v'].at[idx].set(v.astype(cache['v'].dtype))
+    return cache
+
+
+def _block_decode(cfg: llama.LlamaConfig, dcfg: DecodeConfig, x: jax.Array,
+                  layer: Params, lcache: Cache, cos: jax.Array,
+                  sin: jax.Array, pos: jax.Array
+                  ) -> Tuple[jax.Array, Cache]:
+    """One decoder block for one new token; returns (x, updated cache)."""
     b, s, _ = x.shape  # s == 1
     hd = cfg.head_dim
     h = llama.rms_norm(x, layer['attn_norm'], cfg.norm_eps)
@@ -93,23 +140,22 @@ def _block_decode(cfg: llama.LlamaConfig, x: jax.Array, layer: Params,
                                                cfg.n_kv_heads, hd)
     q = llama.apply_rope(q, cos, sin)
     k = llama.apply_rope(k, cos, sin)
-    # Insert this step's K/V at each sequence's current position.
-    b_idx = jnp.arange(b)
-    k_cache = k_cache.at[b_idx, pos].set(k[:, 0])
-    v_cache = v_cache.at[b_idx, pos].set(v[:, 0])
-    attn = _attend_cached(q, k_cache, v_cache, cur_len=pos + 1)
+    lcache = _write_kv(lcache, (jnp.arange(b), pos), k[:, 0], v[:, 0])
+    attn = _attend_cached(dcfg, q, lcache, cur_len=pos + 1)
     attn = attn.reshape(b, s, cfg.n_heads * hd)
     x = x + llama.quant_mm(attn, layer['wo']).astype(cfg.dtype)
-    return llama.ffn_sublayer(cfg, x, layer), k_cache, v_cache
+    return llama.ffn_sublayer(cfg, x, layer), lcache
 
 
 def prefill(params: Params, tokens: jax.Array, cfg: llama.LlamaConfig,
-            cache: Dict[str, jax.Array], prompt_lens: jax.Array
-            ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+            cache: Cache, prompt_lens: jax.Array
+            ) -> Tuple[jax.Array, Cache]:
     """Run the prompt through the model, filling the cache.
 
     tokens [B, S_prompt] (right-padded); returns (logits at each
-    sequence's last prompt token [B, vocab], cache).
+    sequence's last prompt token [B, vocab], cache). An int8 cache
+    (extra scale entries in the pytree) quantizes the K/V prefix at
+    write time.
     """
     _, s = tokens.shape
     positions = jnp.arange(s, dtype=jnp.int32)
@@ -125,10 +171,7 @@ def prefill(params: Params, tokens: jax.Array, cfg: llama.LlamaConfig,
 
     x, (ks, vs) = jax.lax.scan(body, x, params['layers'])
     # ks/vs: [L, B, S, Hkv, hd] → cache prefix.
-    cache = {
-        'k': cache['k'].at[:, :, :s].set(ks),
-        'v': cache['v'].at[:, :, :s].set(vs),
-    }
+    cache = _write_kv(cache, jnp.index_exp[:, :, :s], ks, vs)
     x = llama.rms_norm(x, params['out_norm'], cfg.norm_eps)
     logits = (x @ params['lm_head']).astype(jnp.float32)  # [B, S, V]
     last = jnp.take_along_axis(
@@ -136,27 +179,33 @@ def prefill(params: Params, tokens: jax.Array, cfg: llama.LlamaConfig,
     return last, cache
 
 
-def decode_step(params: Params, token: jax.Array, pos: jax.Array,
-                cfg: llama.LlamaConfig, cache: Dict[str, jax.Array]
-                ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+def _decode_step(params: Params, token: jax.Array, pos: jax.Array,
+                 cfg: llama.LlamaConfig, dcfg: DecodeConfig, cache: Cache
+                 ) -> Tuple[jax.Array, Cache]:
     """token [B] at positions pos [B] → (logits [B, vocab], cache)."""
-    b = token.shape[0]
     cos, sin = llama._rope_freqs(cfg, pos[:, None])  # pylint: disable=protected-access
     x = params['tok_embedding'][token][:, None].astype(cfg.dtype)
 
-    def body(carry, layer_kv):
-        xc = carry
-        layer, k_cache, v_cache = layer_kv
-        xc, k_new, v_new = _block_decode(cfg, xc, layer, k_cache, v_cache,
-                                         cos, sin, pos)
-        return xc, (k_new, v_new)
+    def body(carry, layer_lcache):
+        layer, lcache = layer_lcache
+        xc, lcache = _block_decode(cfg, dcfg, carry, layer, lcache,
+                                   cos, sin, pos)
+        return xc, lcache
 
-    x, (ks, vs) = jax.lax.scan(body, x,
-                               (params['layers'], cache['k'], cache['v']))
-    cache = {'k': ks, 'v': vs}
+    x, cache = jax.lax.scan(body, x, (params['layers'], cache))
     x = llama.rms_norm(x, params['out_norm'], cfg.norm_eps)
     logits = (x[:, 0] @ params['lm_head']).astype(jnp.float32)
     return logits, cache
+
+
+# Step-serving entry point. BREAKING vs the pre-flash-decode signature
+# (new dcfg arg, so old call sites fail loudly at the TypeError, not
+# silently): the cache is DONATED — its buffers are reused for the
+# returned cache instead of copying ~GBs per token, so callers must
+# rebind to the returned cache (`logits, cache = decode_step(...)`);
+# touching the donated input afterwards raises on TPU.
+decode_step = jax.jit(_decode_step, static_argnames=('cfg', 'dcfg'),
+                      donate_argnums=(5,))
 
 
 def _sample(logits: jax.Array, key: jax.Array,
@@ -168,21 +217,15 @@ def _sample(logits: jax.Array, key: jax.Array,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=('cfg', 'dcfg', 'max_new_tokens'))
-def generate(params: Params,
-             prompt: jax.Array,
-             prompt_lens: jax.Array,
-             cfg: llama.LlamaConfig,
-             dcfg: DecodeConfig,
-             max_new_tokens: int,
-             rng: Optional[jax.Array] = None) -> jax.Array:
-    """prompt [B, S_prompt] right-padded → generated tokens
-    [B, max_new_tokens] (post-EOS positions hold eos_id)."""
-    b, s_prompt = prompt.shape
-    assert s_prompt + max_new_tokens <= dcfg.max_len
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
+                   static_argnames=('cfg', 'dcfg', 'max_new_tokens'),
+                   donate_argnums=(7,))
+def _generate_impl(params: Params, prompt: jax.Array,
+                   prompt_lens: jax.Array, cfg: llama.LlamaConfig,
+                   dcfg: DecodeConfig, max_new_tokens: int,
+                   rng: jax.Array, cache: Cache
+                   ) -> Tuple[jax.Array, Cache]:
+    b, _ = prompt.shape
     first_key, steps_key = jax.random.split(rng)
-    cache = init_kv_cache(cfg, b, dcfg.max_len)
     last_logits, cache = prefill(params, prompt, cfg, cache, prompt_lens)
 
     first = _sample(last_logits, first_key, dcfg.temperature)
@@ -191,7 +234,8 @@ def generate(params: Params,
 
     def step(carry, key):
         token, pos, cache_c, done = carry
-        logits, cache_c = decode_step(params, token, pos, cfg, cache_c)
+        logits, cache_c = _decode_step(params, token, pos, cfg, dcfg,
+                                       cache_c)
         nxt = _sample(logits, key, dcfg.temperature)
         if dcfg.eos_id is not None:
             nxt = jnp.where(done, dcfg.eos_id, nxt)
@@ -200,6 +244,35 @@ def generate(params: Params,
 
     keys = jax.random.split(steps_key, max_new_tokens - 1) \
         if max_new_tokens > 1 else jnp.zeros((0, 2), jnp.uint32)
-    (_, _, _, _), rest = jax.lax.scan(
+    (_, _, cache, _), rest = jax.lax.scan(
         step, (first, prompt_lens, cache, done0), keys)
-    return jnp.concatenate([first[:, None], rest.T], axis=1)
+    # The cache is returned so the donated input buffers alias an output
+    # (true in-place update); `generate` drops it for API compatibility.
+    return jnp.concatenate([first[:, None], rest.T], axis=1), cache
+
+
+def generate(params: Params,
+             prompt: jax.Array,
+             prompt_lens: jax.Array,
+             cfg: llama.LlamaConfig,
+             dcfg: DecodeConfig,
+             max_new_tokens: int,
+             rng: Optional[jax.Array] = None) -> jax.Array:
+    """prompt [B, S_prompt] right-padded → generated tokens
+    [B, max_new_tokens] (post-EOS positions hold eos_id).
+
+    The KV cache is allocated here and donated into the jitted impl, so
+    prefill writes and per-step updates land in the same buffers rather
+    than copying the [L,B,max_len,Hkv,hd] carry. Callers needing
+    buffer reuse ACROSS requests should drive ``decode_step`` (which
+    donates and returns its cache) plus ``prefill`` wrapped in their own
+    donating jit — ``prefill`` itself is left untraced/undonated so it
+    composes with outer jits.
+    """
+    b, s_prompt = prompt.shape
+    assert s_prompt + max_new_tokens <= dcfg.max_len
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    cache = init_kv_cache(cfg, b, dcfg.max_len, dcfg.kv_cache_dtype)
+    tokens, _ = _generate_impl(params, prompt, prompt_lens, cfg, dcfg,
+                               max_new_tokens, rng, cache)
+    return tokens

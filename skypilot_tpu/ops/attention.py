@@ -36,22 +36,25 @@ def gqa_attention(q: jax.Array,
     """
     b, s, h, d = q.shape
     hkv = k.shape[2]
-    k = repeat_kv(k, h // hkv)
-    v = repeat_kv(v, h // hkv)
+    g = h // hkv
     scale = d**-0.5
-    # [B, H, S, Skv]
-    logits = jnp.einsum('bshd,bthd->bhst', q, k,
+    # Grouped contraction: fold the GQA fan-out into the einsum instead
+    # of materializing repeat_kv-expanded K/V (H/Hkv x the HBM traffic).
+    # Query head kv*g + r rides in group slot (kv, r) — repeat_kv order.
+    qg = q.reshape(b, s, hkv, g, d)
+    # [B, Hkv, G, S, Skv]
+    logits = jnp.einsum('bskgd,btkd->bkgst', qg, k,
                         preferred_element_type=jnp.float32) * scale
     if causal:
         skv = k.shape[1]
         q_pos = jnp.arange(s) + q_offset
         kv_pos = jnp.arange(skv)
         mask = q_pos[:, None] >= kv_pos[None, :]
-        logits = jnp.where(mask[None, None], logits, NEG_INF)
+        logits = jnp.where(mask[None, None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    out = jnp.einsum('bhst,bthd->bshd', probs, v,
+    out = jnp.einsum('bkgst,btkd->bskgd', probs, v,
                      preferred_element_type=jnp.float32)
-    return out.astype(q.dtype)
+    return out.reshape(b, s, h, d).astype(q.dtype)
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -1,0 +1,275 @@
+"""Flash-decode attention for the KV-cache serving path, in Pallas.
+
+Decode is HBM-bandwidth bound: every step reads the whole KV cache to
+produce one token per sequence. The XLA path pays for that twice — it
+reads the *padded* cache (``max_len`` positions regardless of how many
+are live) and, with GQA, used to materialize ``repeat_kv``-expanded K/V
+(``H/Hkv``x the traffic). This kernel fixes both:
+
+* **Grid (batch, kv-blocks)**: each program streams one ``block_k`` slab
+  of one sequence's cache through VMEM. All ``Hkv`` kv heads ride in the
+  slab (``[block_k, Hkv, hd]`` is contiguous in the cache layout), so
+  the cache is read exactly once per step — not once per query head.
+* **GQA inside the kernel**: all ``H/Hkv`` query heads of each kv head
+  attend against the slab in one pass (one ``[G, block_k]`` logits tile
+  per kv head); the expanded K/V never exist.
+* **Online softmax** across kv blocks (the recurrence of
+  ``ops/flash_attention.py``, here over cache blocks): running max ``m``,
+  normalizer ``l`` and weighted-value accumulator live in VMEM scratch
+  that persists across the sequential kv-block grid dimension.
+* **Block skipping**: ``cur_len`` rides in as a scalar-prefetch operand,
+  so the BlockSpec index map clamps past-the-end block indices to the
+  last live block. Pallas only issues a DMA when the block index
+  *changes*, so fully-dead blocks are never read from HBM and per-token
+  cost tracks the actual sequence length, not ``max_len``. Compute for
+  those iterations is predicated off with ``pl.when``.
+* **int8 KV (optional)**: the cache may be stored int8 with per
+  (position, kv-head) fp32 scales (``ops/quant.py`` numerics), halving
+  cache bandwidth; dequantization happens in-kernel after the DMA.
+
+Off-TPU the grouped-einsum XLA path below runs instead (tests force the
+kernel through the Pallas interpreter to check numerics on CPU).
+"""
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+# Default KV block: small enough that skipping tracks cur_len closely at
+# serving lengths (prompt 128 + 128 new = 2 blocks), large enough that
+# the [G, block_k] logits tiles keep the MXU's N dim full.
+DEFAULT_BLOCK_K = 128
+
+
+def _decode_kernel(cur_ref, q_ref, k_ref, v_ref, *rest, block_k: int,
+                   n_blocks: int, n_kv_heads: int, scale: float,
+                   quantized: bool):
+    """One (batch, kv-block) program.
+
+    cur_ref: scalar-prefetch [B] int32 live lengths; q_ref [1, H, hd];
+    k_ref/v_ref [1, block_k, Hkv, hd] (bf16/f32, or int8 when
+    ``quantized`` with ks_ref/vs_ref [1, block_k, Hkv] fp32 scales);
+    o_ref like q_ref; m/l scratch [H, 128] (lane-replicated row
+    vectors — a bare [H] vector is not a legal TPU vreg shape), acc
+    scratch [H, hd]. Scratch carries the online-softmax state across the
+    sequential kv-block grid dimension.
+    """
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+    bi = pl.program_id(0)
+    j = pl.program_id(1)
+    h = q_ref.shape[1]
+    groups = h // n_kv_heads
+    cur = cur_ref[bi]
+    live_blocks = pl.cdiv(cur, block_k)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(j < live_blocks)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale            # [H, hd]
+        k_blk = k_ref[0]                                    # [bk, Hkv, hd]
+        v_blk = v_ref[0]
+        if quantized:
+            k_blk = k_blk.astype(jnp.float32) * ks_ref[0][:, :, None]
+            v_blk = v_blk.astype(jnp.float32) * vs_ref[0][:, :, None]
+        else:
+            k_blk = k_blk.astype(jnp.float32)
+            v_blk = v_blk.astype(jnp.float32)
+
+        # GQA in one pass: each kv head's G query heads hit its K slab;
+        # the tiles stack back to [H, block_k] (head order kv*G + r —
+        # the repeat_kv fan-out order).
+        logits = jnp.concatenate([
+            jax.lax.dot_general(
+                q[g * groups:(g + 1) * groups], k_blk[:, g, :],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            for g in range(n_kv_heads)
+        ], axis=0)                                          # [H, bk]
+        pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        mask = pos < cur                                    # [1, bk]
+        logits = jnp.where(mask, logits, NEG_INF)
+
+        m = m_scr[:, 0:1]                                   # [H, 1]
+        l = l_scr[:, 0:1]
+        m_blk = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        safe_m = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        p = jnp.where(mask, jnp.exp(logits - safe_m), 0.0)  # [H, bk]
+        correction = jnp.where(m == NEG_INF, 0.0, jnp.exp(m - safe_m))
+        pv = jnp.concatenate([
+            jax.lax.dot_general(
+                p[g * groups:(g + 1) * groups], v_blk[:, g, :],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            for g in range(n_kv_heads)
+        ], axis=0)                                          # [H, hd]
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(
+            l * correction + jnp.sum(p, axis=-1, keepdims=True),
+            l_scr.shape)
+        acc_scr[...] = acc_scr[...] * correction + pv
+
+    @pl.when(j == n_blocks - 1)
+    def _finalize():
+        l = l_scr[:, 0:1]
+        o_ref[0] = (acc_scr[...] /
+                    jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+
+
+def decode_attention_kernel(q: jax.Array, k_cache: jax.Array,
+                            v_cache: jax.Array, cur_len: jax.Array,
+                            k_scale: Optional[jax.Array] = None,
+                            v_scale: Optional[jax.Array] = None,
+                            block_k: int = DEFAULT_BLOCK_K,
+                            interpret: bool = False) -> jax.Array:
+    """q [B,1,H,hd] vs cache [B,max_len,Hkv,hd] → [B,1,H,hd].
+
+    ``cur_len`` [B] int32: live positions per sequence (positions >=
+    cur_len are dead — never read, courtesy of the clamped index map).
+    ``k_scale``/``v_scale`` [B,max_len,Hkv] fp32 mark an int8 cache.
+    """
+    b, s_q, h, hd = q.shape
+    assert s_q == 1, q.shape
+    _, max_len, hkv, _ = k_cache.shape
+    block_k = min(block_k, max_len)
+    assert max_len % block_k == 0, (max_len, block_k)
+    n_blocks = max_len // block_k
+    quantized = k_scale is not None
+
+    def q_index(bi, j, cur_ref):
+        del j, cur_ref
+        return (bi, 0, 0)
+
+    def _clamp(bi, j, cur_ref):
+        # Past-the-end blocks re-map to the last live block: an unchanged
+        # block index means Pallas skips the DMA, so dead cache is never
+        # read. max(live-1, 0) guards cur_len == 0 rows.
+        live = pl.cdiv(cur_ref[bi], block_k)
+        return jnp.minimum(j, jnp.maximum(live - 1, 0))
+
+    def kv_index(bi, j, cur_ref):
+        return (bi, _clamp(bi, j, cur_ref), 0, 0)
+
+    def scale_index(bi, j, cur_ref):
+        return (bi, _clamp(bi, j, cur_ref), 0)
+
+    in_specs = [
+        pl.BlockSpec((1, h, hd), q_index),
+        pl.BlockSpec((1, block_k, hkv, hd), kv_index),
+        pl.BlockSpec((1, block_k, hkv, hd), kv_index),
+    ]
+    operands = [q[:, 0], k_cache, v_cache]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, block_k, hkv), scale_index),
+            pl.BlockSpec((1, block_k, hkv), scale_index),
+        ]
+        operands += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, n_blocks),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, h, hd), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((h, 128), jnp.float32),   # m (lane-replicated)
+            pltpu.VMEM((h, 128), jnp.float32),   # l
+            pltpu.VMEM((h, hd), jnp.float32),    # acc
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, block_k=block_k, n_blocks=n_blocks,
+        n_kv_heads=hkv, scale=hd**-0.5, quantized=quantized)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, hd), q.dtype),
+        interpret=interpret,
+    )(cur_len.astype(jnp.int32), *operands)
+    return out[:, None]
+
+
+def decode_attention_xla(q: jax.Array, k_cache: jax.Array,
+                         v_cache: jax.Array, cur_len: jax.Array,
+                         k_scale: Optional[jax.Array] = None,
+                         v_scale: Optional[jax.Array] = None) -> jax.Array:
+    """Grouped-einsum reference/fallback (CPU and odd shapes).
+
+    Same contract as :func:`decode_attention_kernel`. GQA is a grouped
+    contraction over [B,S,Hkv,G,hd] — the ``repeat_kv``-expanded K/V are
+    never materialized, so even the fallback reads the cache once.
+    """
+    b, s, h, hd = q.shape
+    hkv = k_cache.shape[2]
+    g = h // hkv
+    k, v = k_cache, v_cache
+    if k_scale is not None:
+        k = (k.astype(jnp.float32) * k_scale[..., None]).astype(q.dtype)
+        v = (v.astype(jnp.float32) * v_scale[..., None]).astype(q.dtype)
+    qg = q.reshape(b, s, hkv, g, hd)
+    logits = jnp.einsum('bskgd,btkd->bkgst', qg, k,
+                        preferred_element_type=jnp.float32) * hd**-0.5
+    mask = jnp.arange(k.shape[1])[None, :] < cur_len[:, None]  # [B, T]
+    logits = jnp.where(mask[:, None, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    # A fully-dead row (cur_len == 0) softmaxes to uniform over garbage;
+    # re-masking the probs zeroes it (live rows are untouched: their
+    # masked probs already underflowed to exactly 0), matching the
+    # kernel's zero output for empty rows.
+    probs = jnp.where(mask[:, None, None, None, :], probs, 0)
+    out = jnp.einsum('bkgst,btkd->bskgd', probs, v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, s, h, hd).astype(q.dtype)
+
+
+def _resolve_interpret(interpret: Optional[bool]) -> Optional[bool]:
+    """True → interpreter, False → compiled kernel, None → XLA fallback
+    (same contract as ``flash_attention._resolve_interpret``)."""
+    if interpret is True:
+        return True
+    if interpret is False:
+        return False
+    return False if jax.default_backend() == 'tpu' else None
+
+
+def resolved_path(max_len: int, block_k: int = DEFAULT_BLOCK_K,
+                  interpret: Optional[bool] = None) -> str:
+    """Which implementation :func:`decode_attention` will actually run for
+    this config on this backend: 'kernel' or 'xla'. Single source of
+    truth for the dispatch below; benchmarks report it so A/B numbers
+    are attributed to the path that executed, not the one requested."""
+    itp = _resolve_interpret(interpret)
+    if itp is None or max_len % min(block_k, max_len):
+        return 'xla'
+    return 'kernel'
+
+
+def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                     cur_len: jax.Array,
+                     k_scale: Optional[jax.Array] = None,
+                     v_scale: Optional[jax.Array] = None,
+                     block_k: int = DEFAULT_BLOCK_K,
+                     interpret: Optional[bool] = None) -> jax.Array:
+    """Kernel when it can run (TPU, or forced interpreter), XLA otherwise."""
+    max_len = k_cache.shape[1]
+    if resolved_path(max_len, block_k, interpret) == 'xla':
+        return decode_attention_xla(q, k_cache, v_cache, cur_len,
+                                    k_scale, v_scale)
+    return decode_attention_kernel(q, k_cache, v_cache, cur_len,
+                                   k_scale, v_scale,
+                                   block_k=block_k,
+                                   interpret=_resolve_interpret(interpret))

@@ -63,6 +63,18 @@ def _quantize_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return _symmetric_quantize(x, -1)
 
 
+def quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Int8-quantize K/V cache entries over the head_dim axis.
+
+    [..., Hkv, hd] → (int8 values [..., Hkv, hd], fp32 scales [..., Hkv])
+    — one scale per (position, kv-head), the granularity the decode
+    kernel dequantizes at (``ops/decode_attention.py``). Halves KV-cache
+    HBM traffic, the bandwidth bound of the decode step.
+    """
+    q, scale = _symmetric_quantize(x, -1)
+    return q, scale[..., 0]
+
+
 def int8_matmul(x: jax.Array, qw: QuantizedTensor,
                 out_dtype: Optional[jnp.dtype] = None) -> jax.Array:
     """``x @ w`` with both operands int8 on the MXU.

@@ -1,0 +1,183 @@
+"""Flash-decode kernel numerics (ops/decode_attention.py), interpreter mode.
+
+Tier-1 (fast, CPU): the Pallas kernel runs through the interpreter so
+its online-softmax accumulation, GQA grouping, cur_len block skipping
+and in-kernel int8 dequant are exercised on every test run — no TPU
+needed. The XLA grouped-einsum path doubles as the reference.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import decode, llama
+from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.ops import decode_attention as da
+from skypilot_tpu.ops import quant
+
+
+def _rand_case(key, b, t, h, hkv, hd, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, 1, h, hd), dtype)
+    k = jax.random.normal(kk, (b, t, hkv, hd), dtype)
+    v = jax.random.normal(kv, (b, t, hkv, hd), dtype)
+    return q, k, v
+
+
+def _naive_reference(q, k, v, cur_len):
+    """repeat_kv + mask reference (the pre-kernel XLA decode path)."""
+    b, _, h, hd = q.shape
+    hkv = k.shape[2]
+    kr = attention_ops.repeat_kv(k, h // hkv)
+    vr = attention_ops.repeat_kv(v, h // hkv)
+    logits = jnp.einsum('bshd,bthd->bhst', q, kr,
+                        preferred_element_type=jnp.float32) * hd**-0.5
+    mask = jnp.arange(kr.shape[1])[None, :] < cur_len[:, None]
+    logits = jnp.where(mask[:, None, None, :], logits, da.NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum('bhst,bthd->bshd', probs, vr,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+@pytest.mark.parametrize('cur_lens', [
+    # block_k=16: lengths straddling block boundaries in every way —
+    # mid-block, exactly at a boundary, one past, full, and minimal.
+    (1, 15, 16),
+    (17, 33, 64),
+    (16, 31, 48),
+])
+def test_kernel_matches_xla_at_block_boundaries(cur_lens):
+    q, k, v = _rand_case(jax.random.PRNGKey(0), b=3, t=64, h=8, hkv=2,
+                         hd=32)
+    cur = jnp.array(cur_lens, jnp.int32)
+    out = da.decode_attention_kernel(q, k, v, cur, block_k=16,
+                                     interpret=True)
+    ref = da.decode_attention_xla(q, k, v, cur)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_xla_grouped_einsum_matches_naive_repeat():
+    q, k, v = _rand_case(jax.random.PRNGKey(1), b=2, t=32, h=8, hkv=2,
+                         hd=16)
+    cur = jnp.array([5, 32], jnp.int32)
+    out = da.decode_attention_xla(q, k, v, cur)
+    ref = _naive_reference(q, k, v, cur)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_kernel_gqa_head_grouping():
+    """Head order: query head kv*G + r must read kv head kv (the
+    repeat_kv fan-out) — checked against the naive expanded reference."""
+    q, k, v = _rand_case(jax.random.PRNGKey(2), b=2, t=32, h=8, hkv=4,
+                         hd=16)
+    cur = jnp.array([9, 23], jnp.int32)
+    out = da.decode_attention_kernel(q, k, v, cur, block_k=16,
+                                     interpret=True)
+    ref = _naive_reference(q, k, v, cur)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_kernel_int8_kv_within_tolerance():
+    q, k, v = _rand_case(jax.random.PRNGKey(3), b=2, t=64, h=4, hkv=2,
+                         hd=32)
+    cur = jnp.array([31, 49], jnp.int32)
+    kq, ks = quant.quantize_kv(k)
+    vq, vs = quant.quantize_kv(v)
+    assert kq.dtype == jnp.int8 and ks.shape == k.shape[:-1]
+    out = da.decode_attention_kernel(q, kq, vq, cur, ks, vs,
+                                     block_k=16, interpret=True)
+    # int8 kernel vs int8 XLA: same numerics modulo accumulation order.
+    ref_q = da.decode_attention_xla(q, kq, vq, cur, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_q),
+                               atol=1e-4, rtol=1e-4)
+    # int8 vs fp reference: bounded by quantization noise.
+    ref_fp = da.decode_attention_xla(q, k, v, cur)
+    err = float(jnp.max(jnp.abs(out - ref_fp)))
+    scale = float(jnp.max(jnp.abs(ref_fp)))
+    assert err / scale < 0.05, (err, scale)
+
+
+def test_cur_len_zero_rows_are_zero_on_both_paths():
+    """Inactive batch slots (cur_len == 0) must output exactly zero on
+    the kernel AND XLA paths — not a uniform average of dead cache."""
+    q, k, v = _rand_case(jax.random.PRNGKey(7), b=2, t=32, h=4, hkv=2,
+                         hd=16)
+    cur = jnp.array([0, 20], jnp.int32)
+    out_k = da.decode_attention_kernel(q, k, v, cur, block_k=16,
+                                       interpret=True)
+    out_x = da.decode_attention_xla(q, k, v, cur)
+    assert float(jnp.max(jnp.abs(out_k[0]))) == 0.0
+    assert float(jnp.max(jnp.abs(out_x[0]))) == 0.0
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_x),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_dispatch_falls_back_to_xla_off_tpu():
+    """interpret=None off-TPU must route to the XLA path (no Pallas
+    lowering attempted on CPU)."""
+    q, k, v = _rand_case(jax.random.PRNGKey(4), b=1, t=16, h=2, hkv=2,
+                         hd=8)
+    cur = jnp.array([7], jnp.int32)
+    out = da.decode_attention(q, k, v, cur)
+    ref = da.decode_attention_xla(q, k, v, cur)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-6)
+
+
+def _teacher_forced_logits(params, cfg, dcfg, tokens, prompt_len):
+    """prefill + decode_step over teacher-forced tokens → logits at each
+    decoded position [n_steps, B, vocab]."""
+    b, total = tokens.shape
+    cache = decode.init_kv_cache(cfg, b, dcfg.max_len,
+                                 dcfg.kv_cache_dtype)
+    lens = jnp.full((b,), prompt_len, jnp.int32)
+    logits, cache = decode.prefill(params, tokens[:, :prompt_len], cfg,
+                                   cache, lens)
+    outs = [logits]
+    for i in range(prompt_len, total - 1):
+        pos = jnp.full((b,), i, jnp.int32)
+        logits, cache = decode.decode_step(params, tokens[:, i], pos,
+                                           cfg, dcfg, cache)
+        outs.append(logits)
+    return jnp.stack(outs)
+
+
+@pytest.mark.parametrize('kv_dtype,tol', [('bf16', 0.05), ('int8', 0.12)])
+def test_kernel_prefill_decode_matches_forward_logits(kv_dtype, tol):
+    """Kernel-path (interpreter) cached decode vs the full llama.forward:
+    per-position logits agree within bf16/quantization tolerance. The
+    decoded positions straddle the block_k=16 boundary (cur_len 15..19),
+    so block skipping at partial final blocks is on the hot path."""
+    cfg = llama.CONFIGS['debug']
+    dcfg = decode.DecodeConfig(max_len=32, kv_cache_dtype=kv_dtype,
+                               decode_attention='kernel',
+                               kernel_block_k=16, kernel_interpret=True)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    b, prompt_len, total = 2, 14, 20
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (b, total), 0,
+                                cfg.vocab_size)
+    got = _teacher_forced_logits(params, cfg, dcfg, tokens, prompt_len)
+    full = llama.forward(params, tokens, cfg)  # [B, total, vocab]
+    want = jnp.stack([full[:, i] for i in range(prompt_len - 1, total - 1)])
+    err = float(jnp.max(jnp.abs(got - want)))
+    scale = float(jnp.max(jnp.abs(want)))
+    assert err / scale < tol, (err, scale)
+
+
+def test_generate_kernel_path_matches_xla_path_tokens():
+    """Greedy generate: forced-interpreter kernel path and XLA path pick
+    identical tokens on the debug model."""
+    cfg = llama.CONFIGS['debug']
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(6), (2, 8), 0,
+                                cfg.vocab_size)
+    lens = jnp.full((2,), 8, jnp.int32)
+    base = decode.DecodeConfig(max_len=32, decode_attention='xla')
+    kern = decode.DecodeConfig(max_len=32, decode_attention='kernel',
+                               kernel_block_k=16, kernel_interpret=True)
+    g_x = decode.generate(params, prompt, lens, cfg, base, 6)
+    g_k = decode.generate(params, prompt, lens, cfg, kern, 6)
+    np.testing.assert_array_equal(np.asarray(g_x), np.asarray(g_k))
