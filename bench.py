@@ -49,9 +49,6 @@ sys.path.insert(0, REPO_ROOT)
 A100_PEAK_BF16 = 312e12
 A100_ASSUMED_MFU = 0.40
 
-# Per-chip peak bf16 FLOPs by platform for MFU reporting.
-_TPU_PEAKS = {'v5e': 197e12, 'v5p': 459e12, 'v6e': 918e12, 'v4': 275e12}
-
 # Per-phase heartbeat deadlines (seconds since last beat). Phases are
 # emitted by the payload via harness.beat().
 _PHASE_DEADLINES = {
@@ -68,16 +65,6 @@ _PHASE_DEADLINES = {
     'decode_kv_int8_compile': 180,
     'decode_kv_int8_run': 150,
 }
-
-
-def _detect_peak(device) -> float:
-    kind = getattr(device, 'device_kind', '').lower().replace(' ', '')
-    for name, peak in _TPU_PEAKS.items():
-        if name in kind:
-            return peak
-    if 'v5lite' in kind:
-        return _TPU_PEAKS['v5e']
-    return 0.0  # unknown (e.g. CPU dev runs)
 
 
 def _payload() -> None:
@@ -143,7 +130,10 @@ def _payload() -> None:
     harness.beat('train_done')
 
     tokens_per_sec = steps * batch * seq / dt
-    peak = _detect_peak(devices[0])
+    # Peak-FLOPs table lives in utils/accelerator_registry (shared with
+    # the observability layer's MFU gauge).
+    from skypilot_tpu.utils import accelerator_registry
+    peak = accelerator_registry.peak_bf16_flops(devices[0])
     mfu = train.tokens_per_second_to_mfu(tokens_per_sec, cfg, seq,
                                          peak) if peak else None
     baseline = A100_ASSUMED_MFU * A100_PEAK_BF16 / cfg.flops_per_token(seq)

@@ -17,6 +17,7 @@ from skypilot_tpu import exceptions
 from skypilot_tpu import global_state
 from skypilot_tpu import provision as provision_router
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import metrics
 from skypilot_tpu.backends import backend as backend_lib
 from skypilot_tpu.backends import backend_utils
 from skypilot_tpu.provision import provisioner as provisioner_lib
@@ -324,6 +325,10 @@ class RetryingProvisioner:
                     logger.debug(f'Skipping blocklisted '
                                  f'{cloud_name} {cand.region}/{zone_name}')
                     continue
+                metrics.counter(
+                    'skytpu_backend_provision_attempts_total',
+                    'Provisioning attempts by cloud.',
+                    labels=('cloud',)).inc(labels=(cloud_name,))
                 try:
                     result = self._provision_one(cand, cand.region,
                                                  zone_name,
@@ -332,6 +337,12 @@ class RetryingProvisioner:
                         zone_name, result
                 except Exception as e:  # pylint: disable=broad-except
                     kind = FailoverCloudErrorHandler.classify(e)
+                    metrics.counter(
+                        'skytpu_backend_provision_failures_total',
+                        'Provisioning failures by cloud and failover '
+                        'classification.',
+                        labels=('cloud', 'kind')).inc(
+                            labels=(cloud_name, kind))
                     if kind == FailoverCloudErrorHandler.ABORT:
                         raise
                     self._blocklist.block(
@@ -713,6 +724,8 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
         rc, out, err = head.run(queue_cmd, require_outputs=True, timeout=120)
         subprocess_utils.handle_returncode(rc, 'queue_job',
                                            'Failed to queue job', err)
+        metrics.counter('skytpu_backend_jobs_submitted_total',
+                        'Jobs submitted to cluster job queues.').inc()
         logger.info(
             ux_utils.finishing_message(
                 f'Job submitted, ID: {job_id} (cluster '

@@ -107,6 +107,15 @@ def run_decode_bench(model_name: str, batch: int, prompt_len: int,
     decode_dt = max(gen_dt - pre_dt, 1e-9)
 
     tokens_per_sec = batch * new_tokens / decode_dt
+    # Serving telemetry: prefill latency IS the time-to-first-token of
+    # this static-shape engine, and the decode-phase residual divided by
+    # new_tokens is the per-token latency — exactly the split this bench
+    # already measures, published through the metrics registry.
+    from skypilot_tpu.observability import runtime_metrics
+    runtime_metrics.record_decode_phase(
+        prefill_seconds=pre_dt, decode_seconds=decode_dt,
+        batch=batch, new_tokens=new_tokens,
+        kv_cache_dtype=dcfg.kv_cache_dtype)
     # Report the attention path that actually RAN, not the requested one:
     # 'kernel' silently falls back to XLA off-TPU / on non-tiling max_len.
     from skypilot_tpu.ops import decode_attention as decode_attention_ops

@@ -129,12 +129,22 @@ def reap_holders(log=print) -> List[int]:
 
 
 def beat(phase: str, **extra) -> None:
-    """Record a phase heartbeat for the supervising parent (no-op when
-    unsupervised)."""
+    """Record a phase heartbeat: to the supervising parent via the
+    status file (no-op when unsupervised), and ALWAYS to the metrics
+    registry so bench phase progress is scrapeable like everything
+    else (skytpu_bench_heartbeats_total / _last_heartbeat_*)."""
+    ts = time.time()
+    from skypilot_tpu.observability import metrics
+    metrics.counter('skytpu_bench_heartbeats_total',
+                    'Benchmark phase heartbeats.',
+                    labels=('phase',)).inc(labels=(phase,))
+    metrics.gauge('skytpu_bench_last_heartbeat_timestamp_seconds',
+                  'Unix time of the most recent benchmark heartbeat.'
+                  ).set(ts)
     path = os.environ.get(HEARTBEAT_ENV)
     if not path:
         return
-    payload = {'phase': phase, 'ts': time.time(), **extra}
+    payload = {'phase': phase, 'ts': ts, **extra}
     tmp = f'{path}.tmp'
     with open(tmp, 'w', encoding='utf-8') as f:
         json.dump(payload, f)

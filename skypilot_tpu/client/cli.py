@@ -293,6 +293,36 @@ def check(clouds):
 
 
 @cli.command()
+@click.argument('endpoint', required=False, default=None)
+def metrics(endpoint):
+    """Dump current metrics in Prometheus text format.
+
+    ENDPOINT is a metrics exporter base URL (e.g. the serve
+    controller's or load balancer's ``http://host:port`` mounted via
+    SKYTPU_SERVE_METRICS_PORT / SKYTPU_LB_METRICS_PORT). Without an
+    endpoint, dumps THIS process's registry — useful mainly for
+    debugging instrumented scripts.
+    """
+    if endpoint is None:
+        from skypilot_tpu.observability import metrics as metrics_lib
+        click.echo(metrics_lib.generate_latest().decode('utf-8'),
+                   nl=False)
+        return
+    import urllib.error
+    import urllib.request
+    url = endpoint.rstrip('/')
+    if '://' not in url:
+        url = 'http://' + url
+    if not url.endswith('/metrics'):
+        url += '/metrics'
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            click.echo(resp.read().decode('utf-8'), nl=False)
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        raise click.ClickException(f'Could not scrape {url}: {e}')
+
+
+@cli.command()
 def dashboard():
     """Print the web dashboard URL (clusters/jobs/services/requests +
     per-request log viewer), starting a local API server if needed.

@@ -273,6 +273,17 @@ def generate(params: Params,
     assert s_prompt + max_new_tokens <= dcfg.max_len
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     cache = init_kv_cache(cfg, b, dcfg.max_len, dcfg.kv_cache_dtype)
+    # Host-side serving telemetry: KV-cache capacity/occupancy + dtype
+    # gauges and a request counter. Latency histograms (TTFT, per-token)
+    # are recorded by callers that own a sync boundary (decode_bench) —
+    # timing the async dispatch here would measure nothing real.
+    from skypilot_tpu.observability import metrics as metrics_lib
+    from skypilot_tpu.observability import runtime_metrics
+    runtime_metrics.record_kv_cache(b, dcfg.max_len,
+                                    s_prompt + max_new_tokens,
+                                    dcfg.kv_cache_dtype)
+    metrics_lib.counter('skytpu_decode_requests_total',
+                        'Decode requests (batched generate calls).').inc()
     tokens, _ = _generate_impl(params, prompt, prompt_lens, cfg, dcfg,
                                max_new_tokens, rng, cache)
     return tokens

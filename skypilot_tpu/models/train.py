@@ -233,7 +233,18 @@ def train_loop(model_cfg: llama.LlamaConfig,
 
     import numpy as np
 
+    # Telemetry: step-time histogram + tokens/sec + MFU gauges through
+    # the process metrics registry, and an env-gated (SKYTPU_PROFILE_DIR)
+    # jax.profiler capture window. Host-side only — no extra syncs.
+    from skypilot_tpu.observability import runtime_metrics
+    telemetry = runtime_metrics.TrainTelemetry(model_cfg=model_cfg,
+                                               seq_len=seq_len)
+    profiler = runtime_metrics.StepProfiler(tag='train')
+    # No explicit step_start(): the first record_step silently arms the
+    # timer, so the compile-dominated first step never pollutes the
+    # step-time histogram.
     for step in range(start_step, num_steps):
+        profiler.step()
         # Batches stay HOST numpy all the way into the jitted step: in a
         # multi-process gang every host computes the same (seed, step)-
         # deterministic global batch, and replicated-numpy inputs are
@@ -253,6 +264,7 @@ def train_loop(model_cfg: llama.LlamaConfig,
                                   (batch_size, seq_len), dtype=np.int32)
             targets = np.roll(tokens, -1, axis=1)
         state, metrics = step_fn(state, tokens, targets)
+        telemetry.record_step(tokens=batch_size * seq_len)
         if sleep_per_step:
             # Pacing knob for tests/demos (preemption windows).
             import time
@@ -263,6 +275,7 @@ def train_loop(model_cfg: llama.LlamaConfig,
         if checkpoint_dir and (step + 1) % save_every == 0:
             ckpt_lib.save(checkpoint_dir, state, step + 1, keep=keep)
             print(f'[train] checkpoint @ step {step + 1}', flush=True)
+    profiler.stop()
     if (checkpoint_dir and num_steps > start_step and
             num_steps % save_every != 0):  # loop already saved otherwise
         ckpt_lib.save(checkpoint_dir, state, num_steps, keep=keep)

@@ -1,0 +1,35 @@
+"""Unified metrics + tracing layer.
+
+The observability substrate every perf/robustness subsystem reports
+through (ISSUE 2): a dependency-free Prometheus-style metrics registry
+(:mod:`~skypilot_tpu.observability.metrics`), a stdlib ``/metrics`` +
+``/healthz`` HTTP exporter (:mod:`~skypilot_tpu.observability.exporter`),
+and JAX-side runtime telemetry helpers — train step time/MFU, decode
+TTFT/per-token latency, profiler capture —
+(:mod:`~skypilot_tpu.observability.runtime_metrics`).
+
+Every metric in the codebase is named ``skytpu_<snake_case>`` (enforced
+by the registry and a tier-1 lint test) and registered against the
+process-global registry by default, so a single exporter mount exposes
+the whole process: serve controller ticks, load-balancer proxy traffic,
+backend provisioning, benchmark heartbeats, and timeline spans all land
+in one ``/metrics`` page.
+"""
+from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability.metrics import (Counter, Gauge, Histogram,
+                                                MetricsRegistry, counter,
+                                                gauge, generate_latest,
+                                                get_registry, histogram)
+
+__all__ = [
+    'metrics',
+    'Counter',
+    'Gauge',
+    'Histogram',
+    'MetricsRegistry',
+    'counter',
+    'gauge',
+    'histogram',
+    'generate_latest',
+    'get_registry',
+]

@@ -1,0 +1,91 @@
+"""Stdlib HTTP exporter: ``/metrics`` (Prometheus text) + ``/healthz``.
+
+Mounted by long-lived processes (serve controller, load balancer) so the
+autoscaler's signals, proxy traffic counters, and runtime telemetry are
+scrapeable. ``http.server.ThreadingHTTPServer`` on a daemon thread — no
+third-party dependency, and a wedged scrape can never block the process
+it is observing.
+"""
+import http.server
+import os
+import threading
+from typing import Optional
+
+from skypilot_tpu.observability import metrics as metrics_lib
+
+METRICS_HOST_ENV = 'SKYTPU_METRICS_HOST'
+
+
+class MetricsExporter:
+    """Serve ``/metrics`` and ``/healthz`` for one registry.
+
+    ``port=0`` binds an ephemeral port (tests); read :attr:`port` after
+    :meth:`start`. Binds loopback by default — metrics name services,
+    replica topology, and failure breakdowns, which must not leak from a
+    public VM IP. Set ``SKYTPU_METRICS_HOST=0.0.0.0`` (or pass ``host``)
+    to expose to a real scraper network deliberately.
+    """
+
+    def __init__(self, port: int = 0, host: Optional[str] = None,
+                 registry: Optional[metrics_lib.MetricsRegistry] = None):
+        self._requested_port = port
+        self._host = host or os.environ.get(METRICS_HOST_ENV, '127.0.0.1')
+        # Resolved lazily so an exporter constructed before a test swaps
+        # the global registry still serves the active one.
+        self._registry = registry
+        self._server: Optional[http.server.ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None, 'exporter not started'
+        return self._server.server_port
+
+    def url(self, path: str = '/metrics') -> str:
+        host = '127.0.0.1' if self._host == '0.0.0.0' else self._host
+        return f'http://{host}:{self.port}{path}'
+
+    def start(self) -> int:
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+
+            def do_GET(self):  # noqa: N802
+                if self.path.split('?', 1)[0] == '/metrics':
+                    registry = (outer._registry or
+                                metrics_lib.get_registry())
+                    payload = registry.generate_latest()
+                    self._reply(200, payload,
+                                metrics_lib.CONTENT_TYPE_LATEST)
+                elif self.path.split('?', 1)[0] == '/healthz':
+                    self._reply(200, b'ok\n', 'text/plain; charset=utf-8')
+                else:
+                    self.send_error(404)
+
+            def _reply(self, code: int, payload: bytes,
+                       content_type: str) -> None:
+                self.send_response(code)
+                self.send_header('Content-Type', content_type)
+                self.send_header('Content-Length', str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *args):
+                pass  # scrapes must not spam the observed process's logs
+
+        self._server = http.server.ThreadingHTTPServer(
+            (self._host, self._requested_port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True,
+                                        name='skytpu-metrics-exporter')
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

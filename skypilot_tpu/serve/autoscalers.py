@@ -10,12 +10,18 @@ dynamic on-demand covering preempted spot capacity.
 import dataclasses
 import os
 import time
-from typing import List, Optional
+from typing import List, Optional, Union
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import metrics
 from skypilot_tpu.serve import service_spec as spec_lib
 
 logger = sky_logging.init_logger(__name__)
+
+# The request signal: either a raw timestamp list (legacy/unit tests) or
+# a registry-backed ``metrics.RateTracker`` (the controller's path — the
+# SAME counter /metrics exposes drives scaling decisions).
+RequestSignal = Union[List[float], 'metrics.RateTracker']
 
 
 def _env_float(name: str, default: float) -> float:
@@ -45,18 +51,18 @@ class Autoscaler:
     def update_spec(self, spec: spec_lib.SkyServiceSpec) -> None:
         self.spec = spec
 
-    def evaluate(self, num_alive: int, request_timestamps: List[float]
+    def evaluate(self, num_alive: int, request_signal: RequestSignal
                  ) -> int:
         """→ target number of replicas."""
-        del num_alive, request_timestamps
+        del num_alive, request_signal
         return self.spec.min_replicas
 
     def plan(self, num_ready_default: int, num_alive_default: int,
-             request_timestamps: List[float]) -> ScalePlan:
+             request_signal: RequestSignal) -> ScalePlan:
         """→ ScalePlan; base autoscalers put everything in the default
         pool."""
         del num_ready_default, num_alive_default
-        return ScalePlan(self.evaluate(0, request_timestamps))
+        return ScalePlan(self.evaluate(0, request_signal))
 
     @classmethod
     def make(cls, spec: spec_lib.SkyServiceSpec) -> 'Autoscaler':
@@ -90,17 +96,22 @@ class RequestRateAutoscaler(Autoscaler):
         self._under_since: Optional[float] = None
         self._target = max(spec.min_replicas, 1)
 
-    def current_qps(self, request_timestamps: List[float]) -> float:
-        now = time.time()
+    def current_qps(self, request_signal: RequestSignal) -> float:
+        """Windowed request rate. A ``metrics.RateTracker`` (the registry
+        path) computes the identical trailing-window rate the raw
+        timestamp list did, so decisions are unchanged."""
         window = self.qps_window_seconds
-        recent = [t for t in request_timestamps if t > now - window]
+        if isinstance(request_signal, metrics.RateTracker):
+            return request_signal.qps(window)
+        now = time.time()
+        recent = [t for t in request_signal if t > now - window]
         return len(recent) / window
 
-    def evaluate(self, num_alive: int, request_timestamps: List[float]
+    def evaluate(self, num_alive: int, request_signal: RequestSignal
                  ) -> int:
         spec = self.spec
         assert spec.target_qps_per_replica is not None
-        qps = self.current_qps(request_timestamps)
+        qps = self.current_qps(request_signal)
         # Raw demand, bounded by [min, max].
         import math
         demand = math.ceil(qps / spec.target_qps_per_replica) if qps else 0
@@ -144,10 +155,10 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
     """
 
     def plan(self, num_ready_default: int, num_alive_default: int,
-             request_timestamps: List[float]) -> ScalePlan:
+             request_signal: RequestSignal) -> ScalePlan:
         spec = self.spec
         if spec.autoscaling_enabled:
-            total = self.evaluate(num_alive_default, request_timestamps)
+            total = self.evaluate(num_alive_default, request_signal)
         else:
             total = max(spec.min_replicas, 1)
         base_od = min(spec.base_ondemand_fallback_replicas, total)

@@ -16,10 +16,11 @@ import sys
 import threading
 import time
 import traceback
-from collections import deque
-from typing import Deque, List, Optional
+from typing import Optional
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import exporter as exporter_lib
+from skypilot_tpu.observability import metrics
 from skypilot_tpu.serve import autoscalers as autoscalers_lib
 from skypilot_tpu.serve import replica_managers
 from skypilot_tpu.serve import serve_state
@@ -27,6 +28,8 @@ from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
 
 logger = sky_logging.init_logger(__name__)
+
+CONTROLLER_METRICS_PORT_ENV = 'SKYTPU_SERVE_METRICS_PORT'
 
 
 def controller_interval_seconds() -> float:
@@ -40,10 +43,15 @@ class _LbSyncServer:
         {"ready_urls": [...]}  (parity: load_balancer.py:73)
     """
 
-    def __init__(self, get_ready_urls):
+    def __init__(self, get_ready_urls, service_name: str = ''):
         self._get_ready_urls = get_ready_urls
-        self._ts_lock = threading.Lock()
-        self._timestamps: Deque[float] = deque(maxlen=100_000)
+        # Registry-backed request signal: the autoscaler reads its QPS
+        # from this tracker, and /metrics exposes the same counter
+        # (skytpu_serve_requests_total) — one signal, two consumers.
+        self.tracker = metrics.RateTracker(
+            'skytpu_serve_requests_total',
+            'Requests observed by the serve controller (LB sync).',
+            labels=('service',), label_values=(service_name,))
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -57,9 +65,8 @@ class _LbSyncServer:
                     body = json.loads(self.rfile.read(length) or b'{}')
                 except json.JSONDecodeError:
                     body = {}
-                with outer._ts_lock:
-                    outer._timestamps.extend(
-                        body.get('request_timestamps', []))
+                outer.tracker.extend(
+                    body.get('request_timestamps', []))
                 payload = json.dumps(
                     {'ready_urls': outer._get_ready_urls()}).encode()
                 self.send_response(200)
@@ -79,10 +86,6 @@ class _LbSyncServer:
                                         name='skytpu-lb-sync')
         self._thread.start()
 
-    def snapshot_request_timestamps(self) -> List[float]:
-        with self._ts_lock:
-            return list(self._timestamps)
-
     def close(self) -> None:
         self._server.shutdown()
 
@@ -101,8 +104,28 @@ class SkyServeController:
             service_name, self.spec, svc['task_yaml_path'],
             version=self.version)
         self.autoscaler = autoscalers_lib.Autoscaler.make(self.spec)
-        self._sync = _LbSyncServer(self.replica_manager.ready_urls)
+        self._sync = _LbSyncServer(self.replica_manager.ready_urls,
+                                   service_name=service_name)
         self._lb_proc: Optional[subprocess.Popen] = None
+        # Controller-side /metrics + /healthz (env-gated; '0' binds an
+        # ephemeral port and logs it).
+        self._exporter: Optional[exporter_lib.MetricsExporter] = None
+        metrics_port = os.environ.get(CONTROLLER_METRICS_PORT_ENV)
+        if metrics_port:  # truthy: '' (unset-var expansion) ≠ enabled
+            # Degrade, never die: per-service controllers share this env,
+            # so a fixed port collides for the second service (use 0 for
+            # an ephemeral port there), and a bad value must not take the
+            # whole service down with it.
+            try:
+                self._exporter = exporter_lib.MetricsExporter(
+                    port=int(metrics_port))
+                bound = self._exporter.start()
+                logger.info(f'Controller metrics on :{bound}/metrics.')
+            except (ValueError, OSError, OverflowError) as e:
+                logger.warning(f'Metrics exporter disabled '
+                               f'({CONTROLLER_METRICS_PORT_ENV}='
+                               f'{metrics_port!r}): {e}')
+                self._exporter = None
 
     # ------------------------------------------------------ LB subprocess
 
@@ -113,15 +136,17 @@ class SkyServeController:
         return os.path.join(d, 'load_balancer.log')
 
     def _spawn_lb(self) -> None:
+        cmd = [sys.executable, '-u', '-m',
+               'skypilot_tpu.serve.load_balancer',
+               '--port', str(self.lb_port),
+               '--policy', self.spec.load_balancing_policy,
+               '--controller-url',
+               f'http://127.0.0.1:{self._sync.port}']
+        # The LB subprocess inherits env, so SKYTPU_LB_METRICS_PORT (if
+        # set) mounts its own /metrics without an explicit flag here.
         with open(self._lb_log_path(), 'ab') as log_f:
             self._lb_proc = subprocess.Popen(
-                [sys.executable, '-u', '-m',
-                 'skypilot_tpu.serve.load_balancer',
-                 '--port', str(self.lb_port),
-                 '--policy', self.spec.load_balancing_policy,
-                 '--controller-url',
-                 f'http://127.0.0.1:{self._sync.port}'],
-                stdout=log_f, stderr=subprocess.STDOUT,
+                cmd, stdout=log_f, stderr=subprocess.STDOUT,
                 stdin=subprocess.DEVNULL, start_new_session=True)
         logger.info(f'Load balancer subprocess pid='
                     f'{self._lb_proc.pid} on :{self.lb_port}.')
@@ -167,6 +192,8 @@ class SkyServeController:
             time.sleep(interval)
         self._stop_lb()
         self._sync.close()
+        if self._exporter is not None:
+            self._exporter.stop()
 
     def _tick(self) -> None:
         rm = self.replica_manager
@@ -180,10 +207,31 @@ class SkyServeController:
             sum(1 for r in default_pool
                 if r['status'] == ReplicaStatus.READY),
             sum(1 for r in default_pool if r['status'].is_alive()),
-            self._sync.snapshot_request_timestamps())
+            self._sync.tracker)
         rm.scale_to(plan)
         rm.rolling_update_tick(plan)
         self._update_service_status()
+        svc_gauge = metrics.gauge(
+            'skytpu_serve_replicas',
+            'Replica counts per service by kind '
+            '(ready / alive / target).', labels=('service', 'kind'))
+        svc = self.service_name
+        svc_gauge.set(sum(1 for r in replicas
+                          if r['status'] == ReplicaStatus.READY),
+                      labels=(svc, 'ready'))
+        svc_gauge.set(sum(1 for r in replicas if r['status'].is_alive()),
+                      labels=(svc, 'alive'))
+        svc_gauge.set(plan.total, labels=(svc, 'target'))
+        # The autoscaler's windowed request rate, labeled per service so
+        # co-resident controllers don't clobber each other's series.
+        window = getattr(self.autoscaler, 'qps_window_seconds', 60.0)
+        metrics.gauge('skytpu_serve_qps',
+                      'Windowed request rate seen by the autoscaler.',
+                      labels=('service',)).set(
+                          self._sync.tracker.qps(window), labels=(svc,))
+        metrics.counter('skytpu_serve_controller_ticks_total',
+                        'Controller reconcile ticks.',
+                        labels=('service',)).inc(labels=(svc,))
 
     def _maybe_apply_update(self) -> None:
         """Rolling update: pick up a bumped service version (new spec +

@@ -24,9 +24,32 @@ import aiohttp
 from aiohttp import web
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import exporter as exporter_lib
+from skypilot_tpu.observability import metrics
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
 
 logger = sky_logging.init_logger(__name__)
+
+LB_METRICS_PORT_ENV = 'SKYTPU_LB_METRICS_PORT'
+
+
+def _observe_request(replica: str, code, t0: float) -> None:
+    """Per-replica request count + latency (resolved at call time so a
+    test-swapped registry is honored)."""
+    metrics.counter('skytpu_lb_requests_total',
+                    'Requests proxied by the load balancer.',
+                    labels=('replica', 'code')).inc(
+                        labels=(replica, str(code)))
+    metrics.histogram('skytpu_lb_request_seconds',
+                      'End-to-end proxied request latency.',
+                      labels=('replica',)).observe(
+                          time.perf_counter() - t0, labels=(replica,))
+
+
+def _observe_proxy_error(replica: str, kind: str) -> None:
+    metrics.counter('skytpu_lb_proxy_errors_total',
+                    'Upstream proxy failures by replica.',
+                    labels=('replica', 'kind')).inc(labels=(replica, kind))
 
 _HOP_HEADERS = {
     'connection', 'keep-alive', 'proxy-authenticate',
@@ -54,11 +77,15 @@ class LoadBalancer:
 
     def __init__(self, port: int, policy_name: str,
                  get_ready_urls: Optional[Callable[[], List[str]]] = None,
-                 controller_url: Optional[str] = None):
+                 controller_url: Optional[str] = None,
+                 metrics_port: Optional[int] = None):
         self.port = port
         self.policy = lb_policies.LoadBalancingPolicy.make(policy_name)
         self._get_ready_urls = get_ready_urls
         self._controller_url = controller_url
+        # /metrics + /healthz exporter (None = disabled; 0 = ephemeral).
+        self._metrics_port = metrics_port
+        self._exporter: Optional[exporter_lib.MetricsExporter] = None
         self._synced_urls: List[str] = []
         # Request arrival timestamps for the autoscaler (QPS window).
         # Guarded by a lock: the aiohttp thread appends while another
@@ -115,10 +142,32 @@ class LoadBalancer:
         site = web.TCPSite(self._runner, '0.0.0.0', self.port)
         await site.start()
         logger.info(f'Load balancer listening on :{self.port}.')
+        if self._metrics_port is not None:
+            # Degrade, never die: per-service LBs inherit the same env
+            # port, so a fixed port collides for the second service —
+            # the proxy must keep serving without its exporter.
+            try:
+                self._exporter = exporter_lib.MetricsExporter(
+                    port=self._metrics_port)
+                bound = self._exporter.start()
+                logger.info(f'Load balancer metrics on '
+                            f':{bound}/metrics.')
+            except (OSError, OverflowError) as e:  # Overflow: port >65535
+                logger.warning(
+                    f'Metrics exporter disabled (port '
+                    f'{self._metrics_port}): {e}')
+                self._exporter = None
 
     async def _teardown(self) -> None:
+        if self._exporter is not None:
+            self._exporter.stop()
+            self._exporter = None
         await self._session.close()
         await self._runner.cleanup()
+
+    @property
+    def metrics_port(self) -> Optional[int]:
+        return self._exporter.port if self._exporter is not None else None
 
     # ---------------------------------------------------- controller sync
 
@@ -187,6 +236,7 @@ class LoadBalancer:
         return self._synced_urls
 
     async def _handle(self, request: web.Request) -> web.StreamResponse:
+        t_start = time.perf_counter()
         with self._ts_lock:
             self._request_timestamps.append(time.time())
         self.policy.set_ready_replicas(self._ready_urls())
@@ -205,6 +255,7 @@ class LoadBalancer:
                     break
                 await asyncio.sleep(0.2)
         if url is None:
+            _observe_request('none', 503, t_start)
             return web.Response(
                 status=503,
                 text='No ready replicas. Use `sky serve status` to check '
@@ -246,15 +297,18 @@ class LoadBalancer:
                             64 * 1024):
                         await out.write(chunk)
                     await out.write_eof()
+                    _observe_request(current, resp.status, t_start)
                     return out
             except (aiohttp.ClientConnectorError,
                     aiohttp.ServerDisconnectedError) as e:
+                _observe_proxy_error(current, type(e).__name__)
                 if out is not None:
                     # Headers already went out: terminate the stream
                     # hard (force_close drops keep-alive so the client
                     # sees truncation, not a clean end); a second
                     # response on the same request is impossible.
                     out.force_close()
+                    _observe_request(current, 'truncated', t_start)
                     return out
                 last_err = e
                 if self._controller_url is not None:
@@ -267,25 +321,43 @@ class LoadBalancer:
                 url = candidates[0] if candidates else None
                 continue
             except aiohttp.ClientError as e:
+                _observe_proxy_error(current, type(e).__name__)
                 if out is not None:
                     out.force_close()
+                    _observe_request(current, 'truncated', t_start)
                     return out
                 last_err = e
                 break
             finally:
                 self.policy.request_finished(current)
+        # `current` is always bound here: the 503 path above returned
+        # before the loop, so iteration 1 ran at least to the assignment.
+        _observe_request(current, 502, t_start)
         return web.Response(status=502,
                             text=f'Replica request failed: {last_err}')
 
 
 def main() -> None:
+    import os
     parser = argparse.ArgumentParser()
     parser.add_argument('--port', type=int, required=True)
     parser.add_argument('--policy', default='least_load')
     parser.add_argument('--controller-url', required=True)
+    parser.add_argument('--metrics-port', type=int, default=None,
+                        help='Expose /metrics + /healthz on this port '
+                             '(0 = ephemeral; default: env '
+                             f'{LB_METRICS_PORT_ENV}, else disabled).')
     args = parser.parse_args()
+    metrics_port = args.metrics_port
+    if metrics_port is None and os.environ.get(LB_METRICS_PORT_ENV):
+        try:
+            metrics_port = int(os.environ[LB_METRICS_PORT_ENV])
+        except ValueError:
+            logger.warning(f'Ignoring non-integer {LB_METRICS_PORT_ENV}='
+                           f'{os.environ[LB_METRICS_PORT_ENV]!r}.')
     lb = LoadBalancer(args.port, args.policy,
-                      controller_url=args.controller_url)
+                      controller_url=args.controller_url,
+                      metrics_port=metrics_port)
     lb.run_forever()
 
 

@@ -15,6 +15,7 @@ import requests as requests_lib
 
 from skypilot_tpu import global_state
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import metrics
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
@@ -108,6 +109,29 @@ class ReplicaManager:
     def replica_cluster_name(self, replica_id: int) -> str:
         return f'{self.service_name}-replica-{replica_id}'
 
+    def _set_status(self, replica_id: int, status: ReplicaStatus,
+                    prev: Optional[ReplicaStatus] = None) -> None:
+        """Single choke point for replica state transitions: persists
+        the status AND exports it as a transition counter
+        (skytpu_serve_replica_transitions_total). Steady-state re-sets
+        (e.g. READY re-confirmed every probe tick) don't count.
+
+        Hot callers (the per-tick probe loop) pass the ``prev`` status
+        they already hold; only cold paths fall back to the DB lookup.
+        """
+        if prev is None:
+            prev = next((r['status']
+                         for r in serve_state.get_replicas(
+                             self.service_name)
+                         if r['replica_id'] == replica_id), None)
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       status)
+        if prev != status:
+            metrics.counter('skytpu_serve_replica_transitions_total',
+                            'Replica state transitions by target status.',
+                            labels=('service', 'to_status')).inc(
+                                labels=(self.service_name, status.name))
+
     def _replica_port(self, replica_id: int, cloud_is_local: bool) -> int:
         # Real clouds: every replica is its own host → same port. Local
         # cloud: replicas share this machine → offset per replica.
@@ -186,8 +210,8 @@ class ReplicaManager:
                                 endpoint=None,
                                 is_spot=not ondemand_fallback,
                                 version=self.version)
-        serve_state.set_replica_status(self.service_name, replica_id,
-                                       ReplicaStatus.PROVISIONING)
+        self._set_status(replica_id, ReplicaStatus.PROVISIONING,
+                         prev=ReplicaStatus.PENDING)
         t = threading.Thread(target=self._launch_thread,
                              args=(replica_id, cluster_name,
                                    ondemand_fallback),
@@ -243,8 +267,7 @@ class ReplicaManager:
                              stream_logs=False)
         except Exception as e:  # pylint: disable=broad-except
             logger.error(f'Replica {replica_id} launch failed: {e}')
-            serve_state.set_replica_status(self.service_name, replica_id,
-                                           ReplicaStatus.FAILED)
+            self._set_status(replica_id, ReplicaStatus.FAILED)
             self._teardown_cluster(cluster_name)
             return
         # Shutdown may have raced the launch: if the record is gone or
@@ -257,13 +280,11 @@ class ReplicaManager:
             return
         endpoint = self._resolve_endpoint(replica_id, cluster_name)
         if endpoint is None:
-            serve_state.set_replica_status(self.service_name, replica_id,
-                                           ReplicaStatus.FAILED)
+            self._set_status(replica_id, ReplicaStatus.FAILED)
             return
         serve_state.set_replica_endpoint(self.service_name, replica_id,
                                          endpoint)
-        serve_state.set_replica_status(self.service_name, replica_id,
-                                       ReplicaStatus.STARTING)
+        self._set_status(replica_id, ReplicaStatus.STARTING)
         logger.info(f'Replica {replica_id} up at {endpoint}; probing.')
 
     def _resolve_endpoint(self, replica_id: int,
@@ -293,8 +314,7 @@ class ReplicaManager:
 
     def terminate_replica(self, replica_id: int, reason: str,
                           remove_record: bool = True) -> None:
-        serve_state.set_replica_status(self.service_name, replica_id,
-                                       ReplicaStatus.SHUTTING_DOWN)
+        self._set_status(replica_id, ReplicaStatus.SHUTTING_DOWN)
         cluster_name = self.replica_cluster_name(replica_id)
         logger.info(f'Terminating replica {replica_id} ({reason}).')
 
@@ -361,8 +381,7 @@ class ReplicaManager:
                 continue
             if self._job_failed(record['handle']):
                 logger.info(f'Replica {rid} job failed.')
-                serve_state.set_replica_status(self.service_name, rid,
-                                               ReplicaStatus.FAILED)
+                self._set_status(rid, ReplicaStatus.FAILED, prev=status)
                 self._teardown_cluster(cluster_name)
                 continue
             self._probe_one(rec)
@@ -398,8 +417,7 @@ class ReplicaManager:
                     self._placer.handle_active(
                         self._replica_locations.get(rid))
             serve_state.set_replica_failures(self.service_name, rid, 0)
-            serve_state.set_replica_status(self.service_name, rid,
-                                           ReplicaStatus.READY)
+            self._set_status(rid, ReplicaStatus.READY, prev=status)
             return
         failures = rec['consecutive_failures'] + 1
         serve_state.set_replica_failures(self.service_name, rid, failures)
@@ -408,15 +426,14 @@ class ReplicaManager:
             if elapsed > self.spec.initial_delay_seconds:
                 logger.info(f'Replica {rid} failed its initial probe '
                             f'window ({elapsed:.0f}s).')
-                serve_state.set_replica_status(self.service_name, rid,
-                                               ReplicaStatus.FAILED)
+                self._set_status(rid, ReplicaStatus.FAILED,
+                                 prev=status)
                 self._teardown_cluster(self.replica_cluster_name(rid))
             return
         if failures >= _RECYCLE_THRESHOLD:
             self.terminate_replica(rid, reason='unhealthy')
         elif failures >= _NOT_READY_THRESHOLD:
-            serve_state.set_replica_status(self.service_name, rid,
-                                           ReplicaStatus.NOT_READY)
+            self._set_status(rid, ReplicaStatus.NOT_READY, prev=status)
 
     # ------------------------------------------------------------- views
 

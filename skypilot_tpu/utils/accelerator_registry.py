@@ -1,15 +1,49 @@
-"""Accelerator name canonicalization.
+"""Accelerator name canonicalization + per-chip peak FLOPs.
 
 Parity: ``sky/utils/accelerator_registry.py:56,48`` — user-typed
 accelerator names ('a100', 'Tpu-V5P') resolve to catalog-canonical names;
 TPUs are "schedulable non-GPU" accelerators (the reference uses this to
 omit the GPU resource from Ray bundles; here it routes requests to the
 slice-topology path instead of instance-SKU lookup).
+
+This module is also the single owner of the per-chip peak bf16 FLOPs
+table: ``bench.py``'s MFU report and the observability layer's
+``skytpu_train_mfu`` gauge share :func:`peak_bf16_flops` instead of each
+keeping a private copy.
 """
 import functools
 from typing import Optional
 
 from skypilot_tpu import topology as topo_lib
+
+# Per-chip peak bf16 FLOPs/sec by TPU generation (datasheet numbers).
+TPU_PEAK_BF16_FLOPS = {
+    'v4': 275e12,
+    'v5e': 197e12,
+    'v5p': 459e12,
+    'v6e': 918e12,
+}
+
+
+def peak_bf16_flops(device_or_kind) -> float:
+    """Peak bf16 FLOPs/sec for a jax device (or its device_kind string).
+
+    Matching is substring-based over the lowercased, space-stripped kind
+    ('TPU v5e', 'TPU v5 lite', 'v5litepod-8', ...); marketing aliases
+    map to their generation ('v5lite*' → v5e, 'v6lite*' → v6e). Returns
+    0.0 for unknown hardware (e.g. CPU dev runs) so callers can skip the
+    MFU computation instead of reporting garbage.
+    """
+    kind = getattr(device_or_kind, 'device_kind', device_or_kind)
+    kind = str(kind).lower().replace(' ', '')
+    for name, peak in TPU_PEAK_BF16_FLOPS.items():
+        if name in kind:
+            return peak
+    if 'v5lite' in kind:
+        return TPU_PEAK_BF16_FLOPS['v5e']
+    if 'v6lite' in kind:
+        return TPU_PEAK_BF16_FLOPS['v6e']
+    return 0.0
 
 
 def is_schedulable_non_gpu_accelerator(accelerator_name: str) -> bool:

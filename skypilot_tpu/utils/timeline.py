@@ -3,6 +3,14 @@
 ``@timeline.event`` wraps entrypoints; with ``SKYTPU_DEBUG=1`` the accumulated
 events are dumped as Chrome trace JSON at process exit to
 ``~/.skytpu/timelines/<run_id>.json`` (load in ``chrome://tracing`` / Perfetto).
+
+Enablement is resolved PER RECORD (not at import), so tests and
+long-lived controllers can toggle ``SKYTPU_DEBUG`` after import.
+
+Spans also double-publish to the metrics registry as
+``skytpu_span_seconds{name=...}`` histogram observations — always, not
+just under ``SKYTPU_DEBUG`` — so the wall-clock timeline and the
+always-on metrics layer report the same durations.
 """
 import atexit
 import functools
@@ -14,8 +22,17 @@ from typing import Callable, List, Optional, Union
 
 _events: List[dict] = []
 _events_lock = threading.Lock()
-_enabled = os.environ.get('SKYTPU_DEBUG', '0') == '1'
 _save_registered = False
+
+# Buckets wide enough for both sub-second API calls and multi-minute
+# provision/teardown spans.
+_SPAN_BUCKETS = (0.005, 0.025, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0,
+                 1200.0)
+
+
+def _enabled() -> bool:
+    """Chrome-trace capture toggle, read lazily per record."""
+    return os.environ.get('SKYTPU_DEBUG', '0') == '1'
 
 
 class Event:
@@ -24,9 +41,16 @@ class Event:
     def __init__(self, name: str, message: Optional[str] = None):
         self._name = name
         self._message = message
+        self._t0: Optional[float] = None
+
+    # The histogram label for this span. FileLockEvent overrides: its
+    # event NAME embeds the lock path (fine for a trace, unbounded
+    # cardinality for a metric label).
+    def _metric_name(self) -> str:
+        return self._name
 
     def _record(self, phase: str) -> None:
-        if not _enabled:
+        if not _enabled():
             return
         evt = {
             'name': self._name,
@@ -42,10 +66,21 @@ class Event:
         _ensure_save_hook()
 
     def begin(self):
+        self._t0 = time.perf_counter()
         self._record('B')
 
     def end(self):
         self._record('E')
+        if self._t0 is not None:
+            from skypilot_tpu.observability import metrics
+            metrics.histogram(
+                'skytpu_span_seconds',
+                'Duration of timeline-traced spans.',
+                labels=('name',),
+                buckets=_SPAN_BUCKETS).observe(
+                    time.perf_counter() - self._t0,
+                    labels=(self._metric_name(),))
+            self._t0 = None
 
     def __enter__(self):
         self.begin()
@@ -87,6 +122,9 @@ class FileLockEvent(Event):
     def __init__(self, lockpath: str):
         super().__init__(f'filelock:{lockpath}')
 
+    def _metric_name(self) -> str:
+        return 'filelock'  # lock paths would explode label cardinality
+
 
 def save_timeline(path: Optional[str] = None) -> Optional[str]:
     if not _events:
@@ -104,7 +142,7 @@ def save_timeline(path: Optional[str] = None) -> Optional[str]:
 
 def _ensure_save_hook() -> None:
     global _save_registered
-    if _save_registered or not _enabled:
+    if _save_registered or not _enabled():
         return
     _save_registered = True
     atexit.register(save_timeline)

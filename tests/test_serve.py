@@ -254,15 +254,15 @@ def test_fallback_autoscaler_covers_preempted_spot():
     assert isinstance(scaler, autoscalers.FallbackRequestRateAutoscaler)
     # All spot READY: 2 spot + 1 base on-demand.
     plan = scaler.plan(num_ready_default=2, num_alive_default=2,
-                       request_timestamps=[])
+                       request_signal=[])
     assert (plan.default_count, plan.ondemand_fallback_count) == (2, 1)
     # Both spot replicas preempted: on-demand surges to cover.
     plan = scaler.plan(num_ready_default=0, num_alive_default=0,
-                       request_timestamps=[])
+                       request_signal=[])
     assert (plan.default_count, plan.ondemand_fallback_count) == (2, 3)
     # Spot recovered: fallback back to the base floor.
     plan = scaler.plan(num_ready_default=2, num_alive_default=2,
-                       request_timestamps=[])
+                       request_signal=[])
     assert plan.ondemand_fallback_count == 1
 
 

@@ -1,0 +1,346 @@
+"""Observability layer: registry, exposition, exporter, telemetry.
+
+Tier-1, CPU-only. Covers the ISSUE-2 acceptance surface: exposition
+format (label escaping, histogram buckets/+Inf/_sum/_count), thread
+safety, the /metrics + /healthz exporter, shared peak-FLOPs detection,
+lazy timeline enablement with span double-publish, and end-to-end
+"a CPU train/decode run records its histograms".
+"""
+import os
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from skypilot_tpu.observability import exporter as exporter_lib
+from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import runtime_metrics
+
+pytestmark = pytest.mark.metrics
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    """Isolate the process-global registry per test (instrumentation
+    sites resolve it at call time, so the swap is honored)."""
+    prev = metrics.set_registry(metrics.MetricsRegistry())
+    yield
+    metrics.set_registry(prev)
+
+
+def _parse_samples(text: str):
+    """name{labels} value → {(name, labels_str): float} (no HELP/TYPE)."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith('#'):
+            continue
+        m = re.match(r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? (\S+)$', line)
+        assert m, f'unparseable exposition line: {line!r}'
+        value = float('inf') if m.group(3) == '+Inf' else float(m.group(3))
+        out[(m.group(1), m.group(2) or '')] = value
+    return out
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_counter_and_gauge_basics():
+    c = metrics.counter('skytpu_req_total', 'reqs', labels=('code',))
+    c.inc(labels=('200',))
+    c.inc(2, labels=('200',))
+    c.inc(labels=('500',))
+    assert c.value(labels=('200',)) == 3
+    assert c.value(labels=('500',)) == 1
+    assert c.value(labels=('404',)) == 0
+    with pytest.raises(ValueError):
+        c.inc(-1, labels=('200',))
+
+    g = metrics.gauge('skytpu_temp')
+    g.set(5)
+    g.inc(2)
+    g.dec()
+    assert g.value() == 6
+
+
+def test_get_or_create_identity_and_conflicts():
+    c1 = metrics.counter('skytpu_x_total', 'x', labels=('a',))
+    c2 = metrics.counter('skytpu_x_total', 'different help',
+                         labels=('a',))
+    assert c1 is c2
+    with pytest.raises(ValueError):
+        metrics.gauge('skytpu_x_total')  # type conflict
+    with pytest.raises(ValueError):
+        metrics.counter('skytpu_x_total', labels=('b',))  # label conflict
+    h1 = metrics.histogram('skytpu_x_seconds', buckets=(1.0, 2.0))
+    assert metrics.histogram('skytpu_x_seconds',
+                             buckets=(2.0, 1.0, float('inf'))) is h1
+    with pytest.raises(ValueError):
+        metrics.histogram('skytpu_x_seconds', buckets=(5.0,))  # drift
+
+
+def test_metric_name_validation():
+    for bad in ('requests_total', 'skytpu_Bad', 'skytpu-foo',
+                'skytpu_foo.bar', 'SKYTPU_FOO'):
+        with pytest.raises(ValueError):
+            metrics.counter(bad)
+    with pytest.raises(ValueError):
+        metrics.counter('skytpu_ok_total', labels=('bad-label',))
+    with pytest.raises(ValueError):
+        c = metrics.counter('skytpu_ok_total', labels=('a', 'b'))
+        c.inc(labels=('only-one',))  # label arity mismatch
+
+
+def test_label_escaping_in_exposition():
+    c = metrics.counter('skytpu_esc_total', 'escapes', labels=('path',))
+    c.inc(labels=('a"b\\c\nd',))
+    text = metrics.generate_latest().decode()
+    assert r'path="a\"b\\c\nd"' in text
+
+
+def test_histogram_buckets_inf_sum_count():
+    h = metrics.histogram('skytpu_lat_seconds', 'lat',
+                          buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    assert h.count() == 5
+    assert h.sum() == pytest.approx(56.05)
+    samples = _parse_samples(metrics.generate_latest().decode())
+    # Cumulative bucket counts.
+    assert samples[('skytpu_lat_seconds_bucket', '{le="0.1"}')] == 1
+    assert samples[('skytpu_lat_seconds_bucket', '{le="1"}')] == 3
+    assert samples[('skytpu_lat_seconds_bucket', '{le="10"}')] == 4
+    assert samples[('skytpu_lat_seconds_bucket', '{le="+Inf"}')] == 5
+    assert samples[('skytpu_lat_seconds_sum', '')] == pytest.approx(56.05)
+    assert samples[('skytpu_lat_seconds_count', '')] == 5
+
+
+def test_boundary_observation_lands_in_bucket():
+    h = metrics.histogram('skytpu_b_seconds', buckets=(1.0, 2.0))
+    h.observe(1.0)  # le is INCLUSIVE
+    samples = _parse_samples(metrics.generate_latest().decode())
+    assert samples[('skytpu_b_seconds_bucket', '{le="1"}')] == 1
+
+
+def test_concurrent_increments_from_threads():
+    c = metrics.counter('skytpu_conc_total')
+    h = metrics.histogram('skytpu_conc_seconds', buckets=(0.5,))
+    n_threads, n_iters = 8, 500
+
+    def work():
+        for _ in range(n_iters):
+            c.inc()
+            h.observe(0.1)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == n_threads * n_iters
+    assert h.count() == n_threads * n_iters
+
+
+def test_rate_tracker_qps_and_counter():
+    tr = metrics.RateTracker('skytpu_events_total', 'evts',
+                             labels=('svc',), label_values=('s1',))
+    now = 1000.0
+    tr.extend([now - 30, now - 5, now - 4, now - 3])
+    tr.note(now - 1)
+    assert tr.total() == 5
+    # 10s window: 4 of 5 inside.
+    assert tr.qps(10, now=now) == pytest.approx(0.4)
+    text = metrics.generate_latest().decode()
+    assert 'skytpu_events_total{svc="s1"} 5' in text
+
+
+# ------------------------------------------------------------- exporter
+
+
+def test_exporter_serves_metrics_and_healthz():
+    metrics.counter('skytpu_exp_total').inc(7)
+    exp = exporter_lib.MetricsExporter(port=0, host='127.0.0.1')
+    port = exp.start()
+    try:
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/metrics', timeout=5) as resp:
+            assert resp.status == 200
+            assert 'text/plain' in resp.headers['Content-Type']
+            body = resp.read().decode()
+        assert 'skytpu_exp_total 7' in body
+        _parse_samples(body)  # whole page parseable
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/healthz', timeout=5) as resp:
+            assert resp.status == 200
+            assert resp.read() == b'ok\n'
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f'http://127.0.0.1:{port}/nope',
+                                   timeout=5)
+    finally:
+        exp.stop()
+
+
+# ------------------------------------------------- peak FLOPs detection
+
+
+@pytest.mark.parametrize('kind,expected', [
+    ('TPU v4', 275e12),
+    ('TPU v5e', 197e12),
+    ('TPU v5p', 459e12),
+    ('v5litepod-8', 197e12),   # marketing alias → v5e
+    ('TPU v5 lite', 197e12),
+    ('TPU v6e', 918e12),
+    ('TPU v6 lite', 918e12),
+    ('cpu', 0.0),              # unknown hardware → 0.0 (skip MFU)
+    ('NVIDIA A100', 0.0),
+])
+def test_peak_flops_detection(kind, expected):
+    from skypilot_tpu.utils import accelerator_registry
+
+    class FakeDevice:
+        device_kind = kind
+
+    assert accelerator_registry.peak_bf16_flops(kind) == expected
+    assert accelerator_registry.peak_bf16_flops(FakeDevice()) == expected
+
+
+def test_peak_flops_env_override(monkeypatch):
+    monkeypatch.setenv(runtime_metrics.PEAK_FLOPS_ENV, '1e12')
+    assert runtime_metrics.peak_flops('cpu') == 1e12
+
+
+# ------------------------------------------------------- metric name lint
+
+
+def test_all_registered_metric_names_match_convention():
+    """Lint: every metric name in the package matches
+    ^skytpu_[a-z0-9_]+$ (prevents exposition-format drift)."""
+    pattern = re.compile(
+        r"""(?:\.(?:counter|gauge|histogram)|RateTracker)\(\s*
+            ['"]([^'"]+)['"]""", re.VERBOSE)
+    name_re = re.compile(metrics.METRIC_NAME_PATTERN)
+    found = []
+    pkg = os.path.join(REPO_ROOT, 'skypilot_tpu')
+    sources = [os.path.join(REPO_ROOT, 'bench.py')]
+    for dirpath, _, files in os.walk(pkg):
+        sources += [os.path.join(dirpath, f) for f in files
+                    if f.endswith('.py')]
+    for path in sources:
+        with open(path, encoding='utf-8') as f:
+            src = f.read()
+        for m in pattern.finditer(src):
+            found.append((os.path.relpath(path, REPO_ROOT), m.group(1)))
+    bad = [(p, n) for p, n in found if not name_re.match(n)]
+    assert not bad, f'metric names violating the skytpu_ convention: {bad}'
+    # The scan itself must see the instrumentation (guard against the
+    # regex silently matching nothing).
+    names = {n for _, n in found}
+    for expected in ('skytpu_lb_requests_total', 'skytpu_span_seconds',
+                     'skytpu_train_step_seconds',
+                     'skytpu_serve_requests_total'):
+        assert expected in names, f'{expected} not found by lint scan'
+
+
+# ------------------------------------------------------ timeline spans
+
+
+def test_timeline_lazy_enablement_and_span_histogram(monkeypatch):
+    from skypilot_tpu.utils import timeline
+
+    monkeypatch.delenv('SKYTPU_DEBUG', raising=False)
+    before = len(timeline._events)  # pylint: disable=protected-access
+    with timeline.Event('skytpu.test.span'):
+        pass
+    # Trace capture off → no Chrome-trace events, but the span STILL
+    # publishes its histogram observation.
+    assert len(timeline._events) == before  # pylint: disable=protected-access
+    h = metrics.get_registry().get('skytpu_span_seconds')
+    assert h.count(labels=('skytpu.test.span',)) == 1
+
+    # Toggled on AFTER import (the old import-time read would miss it).
+    monkeypatch.setenv('SKYTPU_DEBUG', '1')
+    with timeline.Event('skytpu.test.span2'):
+        pass
+    events = timeline._events[before:]  # pylint: disable=protected-access
+    assert [e['ph'] for e in events] == ['B', 'E']
+    assert h.count(labels=('skytpu.test.span2',)) == 1
+
+
+def test_filelock_event_uses_bounded_metric_label(monkeypatch):
+    from skypilot_tpu.utils import timeline
+    monkeypatch.delenv('SKYTPU_DEBUG', raising=False)
+    with timeline.FileLockEvent('/tmp/some/unique/path.lock'):
+        pass
+    h = metrics.get_registry().get('skytpu_span_seconds')
+    assert h.count(labels=('filelock',)) == 1
+
+
+# ---------------------------------------------- train/decode telemetry
+
+
+def test_train_telemetry_records_step_and_mfu(monkeypatch):
+    monkeypatch.setenv(runtime_metrics.PEAK_FLOPS_ENV, '1e12')
+    from skypilot_tpu.models import llama
+    cfg = llama.CONFIGS['debug']
+    t = runtime_metrics.TrainTelemetry(model_cfg=cfg, seq_len=64)
+    t.record_step(tokens=128, step_seconds=0.5)
+    t.record_step(tokens=128, step_seconds=0.25)
+    h = metrics.histogram('skytpu_train_step_seconds',
+                          buckets=runtime_metrics.TRAIN_STEP_BUCKETS)
+    assert h.count() == 2
+    tps = metrics.gauge('skytpu_train_tokens_per_second').value()
+    assert tps == pytest.approx(128 / 0.25)
+    mfu = metrics.gauge('skytpu_train_mfu').value()
+    assert mfu == pytest.approx(tps * cfg.flops_per_token(64) / 1e12)
+    assert metrics.counter('skytpu_train_steps_total').value() == 2
+
+
+def test_train_loop_records_metrics(monkeypatch):
+    """Acceptance: a CPU train_loop run records skytpu_train_step_seconds
+    observations and an MFU gauge."""
+    monkeypatch.setenv(runtime_metrics.PEAK_FLOPS_ENV, '1e12')
+    from skypilot_tpu.models import llama, train
+    train.train_loop(llama.CONFIGS['debug'],
+                     train.TrainConfig(warmup_steps=1),
+                     num_steps=3, batch_size=2, seq_len=16, log_every=0)
+    h = metrics.histogram('skytpu_train_step_seconds',
+                          buckets=runtime_metrics.TRAIN_STEP_BUCKETS)
+    # First record arms the timer; steps 2..3 are observed.
+    assert h.count() >= 2
+    assert metrics.gauge('skytpu_train_mfu').value() > 0
+
+
+def test_decode_bench_records_ttft_and_token_latency():
+    """Acceptance: a CPU decode run records TTFT and per-token latency
+    histograms (and decode.generate the KV gauges/request counter)."""
+    from skypilot_tpu.benchmark import decode_bench
+    out = decode_bench.run_decode_bench('debug', batch=2, prompt_len=16,
+                                        new_tokens=8, steps=1, attn='xla')
+    assert out['value'] > 0
+    ttft = metrics.histogram('skytpu_decode_ttft_seconds',
+                             labels=('kv_cache_dtype',),
+                             buckets=runtime_metrics.TTFT_BUCKETS)
+    tok = metrics.histogram('skytpu_decode_token_seconds',
+                            labels=('kv_cache_dtype',),
+                            buckets=runtime_metrics.TOKEN_LATENCY_BUCKETS)
+    assert ttft.count(labels=('bf16',)) == 1
+    assert tok.count(labels=('bf16',)) == 1
+    assert metrics.counter('skytpu_decode_requests_total').value() >= 1
+    g = metrics.gauge('skytpu_decode_kv_cache_tokens', labels=('kind',))
+    assert g.value(labels=('capacity',)) == 2 * (16 + 8)
+    dtype_g = metrics.gauge('skytpu_decode_kv_cache_dtype_info',
+                            labels=('dtype',))
+    assert dtype_g.value(labels=('bf16',)) == 1
+
+
+def test_step_profiler_noop_without_env(monkeypatch):
+    monkeypatch.delenv(runtime_metrics.PROFILE_DIR_ENV, raising=False)
+    p = runtime_metrics.StepProfiler()
+    for _ in range(5):
+        p.step()
+    p.stop()
+    assert metrics.counter('skytpu_profile_captures_total').value() == 0
