@@ -65,10 +65,15 @@ def make_provision_config(
         docker_config['image'] = docker_image
     auth_config: Dict[str, Any] = {}
     if cloud.name == 'kubernetes':
-        # region == kubeconfig context; namespace from config.
+        # region == kubeconfig context; namespace from config. No
+        # 'default' fallback here: a None namespace lets the provisioner's
+        # _namespace() resolve the in-cluster service-account namespace,
+        # keeping the launch path and kubernetes_status() in agreement
+        # (ADVICE r5 #1 — a hardcoded default made them disagree when
+        # running inside a cluster).
         provider_config['context'] = region_name
         provider_config['namespace'] = skypilot_config.get_nested(
-            ('kubernetes', 'namespace'), 'default')
+            ('kubernetes', 'namespace'), None)
     if cloud.name == 'gcp':
         public_key, private_key = authentication.get_or_generate_keys()
         ssh_user = authentication.DEFAULT_SSH_USER

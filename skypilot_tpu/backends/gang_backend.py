@@ -17,7 +17,9 @@ from skypilot_tpu import exceptions
 from skypilot_tpu import global_state
 from skypilot_tpu import provision as provision_router
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import journal
 from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import trace
 from skypilot_tpu.backends import backend as backend_lib
 from skypilot_tpu.backends import backend_utils
 from skypilot_tpu.provision import provisioner as provisioner_lib
@@ -305,6 +307,7 @@ class RetryingProvisioner:
         failover_history: List[Exception] = []
         skipped_blocked = 0
         cloud_name = None
+        entity = f'cluster:{self._cluster_name}'
         for cand in self._candidates:
             cloud = cand.cloud
             cloud_name = cloud.name
@@ -329,10 +332,17 @@ class RetryingProvisioner:
                     'skytpu_backend_provision_attempts_total',
                     'Provisioning attempts by cloud.',
                     labels=('cloud',)).inc(labels=(cloud_name,))
+                journal.event(journal.EventKind.PROVISION_ATTEMPT, entity,
+                              {'cloud': cloud_name, 'region': cand.region,
+                               'zone': zone_name})
                 try:
                     result = self._provision_one(cand, cand.region,
                                                  zone_name,
                                                  cluster_name_on_cloud)
+                    journal.event(journal.EventKind.PROVISION_DONE, entity,
+                                  {'cloud': cloud_name,
+                                   'region': cand.region,
+                                   'zone': zone_name})
                     return cand.copy(zone=zone_name), cand.region, \
                         zone_name, result
                 except Exception as e:  # pylint: disable=broad-except
@@ -343,6 +353,11 @@ class RetryingProvisioner:
                         'classification.',
                         labels=('cloud', 'kind')).inc(
                             labels=(cloud_name, kind))
+                    journal.event(
+                        journal.EventKind.PROVISION_FAILOVER, entity,
+                        {'cloud': cloud_name, 'region': cand.region,
+                         'zone': zone_name, 'kind': kind,
+                         'error': f'{type(e).__name__}: {e}'})
                     if kind == FailoverCloudErrorHandler.ABORT:
                         raise
                     self._blocklist.block(
@@ -526,7 +541,8 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             global_state.set_owner_identity_for_cluster(
                 cluster_name, type(cloud).get_current_user_identity())
 
-            provisioner_lib.wait_for_ssh(result.cluster_info)
+            provisioner_lib.wait_for_ssh(result.cluster_info,
+                                         cluster_name=cluster_name)
             provisioner_lib.post_provision_runtime_setup(
                 cluster_name, result.record.cluster_name,
                 result.cluster_info, result.cluster_info.provider_config)
@@ -708,11 +724,17 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                                           up=True)
 
         # Register the job in the head's queue (codegen-over-SSH idiom).
+        # The trace context rides along twice: persisted into the head's
+        # job row (authoritative — survives a skylet-tick respawn) and as
+        # env on the codegen commands (covers the immediate spawn path).
         resources_str = f'{task.num_nodes}x {task.best_resources or ""}'
+        trace_prefix = trace.shell_env_prefix()
         add_cmd = job_lib.JobLibCodeGen.add_job(
             task.name, common_utils.get_user_name(), run_timestamp,
-            resources_str, f'{remote_job_dir}/driver.sh', remote_log_dir)
-        rc, out, err = head.run(add_cmd, require_outputs=True, timeout=120)
+            resources_str, f'{remote_job_dir}/driver.sh', remote_log_dir,
+            trace_id=trace.get_trace_id(), span_id=trace.get_span_id())
+        rc, out, err = head.run(trace_prefix + add_cmd,
+                                require_outputs=True, timeout=120)
         subprocess_utils.handle_returncode(rc, 'add_job',
                                            'Failed to register job', err)
         job_id = self._parse_marker(out, _JOB_ID_MARKER)
@@ -721,11 +743,15 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
                 f'Could not parse job id from: {out!r} {err!r}')
         job_id = int(job_id)
         queue_cmd = job_lib.JobLibCodeGen.queue_job(job_id)
-        rc, out, err = head.run(queue_cmd, require_outputs=True, timeout=120)
+        rc, out, err = head.run(trace_prefix + queue_cmd,
+                                require_outputs=True, timeout=120)
         subprocess_utils.handle_returncode(rc, 'queue_job',
                                            'Failed to queue job', err)
         metrics.counter('skytpu_backend_jobs_submitted_total',
                         'Jobs submitted to cluster job queues.').inc()
+        journal.event(journal.EventKind.BACKEND_JOB_SUBMIT,
+                      f'cluster:{handle.cluster_name}',
+                      {'job_id': job_id, 'task': task.name})
         logger.info(
             ux_utils.finishing_message(
                 f'Job submitted, ID: {job_id} (cluster '
@@ -852,6 +878,9 @@ class TpuGangBackend(backend_lib.Backend[ClusterHandle]):
             global_state.remove_cluster(cluster_name, terminate=terminate)
             from skypilot_tpu.utils import cluster_ssh
             cluster_ssh.remove_cluster(cluster_name)
+        journal.event(journal.EventKind.CLUSTER_TEARDOWN,
+                      f'cluster:{cluster_name}',
+                      {'terminate': terminate, 'purge': purge})
         verb = 'Terminated' if terminate else 'Stopped'
         logger.info(
             ux_utils.finishing_message(

@@ -323,6 +323,92 @@ def metrics(endpoint):
 
 
 @cli.command()
+@click.option('--job', '-j', 'job_id', type=int, default=None,
+              help='Only events of one managed job.')
+@click.option('--cluster', '-c', 'cluster', default=None,
+              help='Only events of one cluster.')
+@click.option('--service', '-s', 'service', default=None,
+              help='Only events of one service (replica lifecycle).')
+@click.option('--kind', '-k', 'kinds', multiple=True,
+              help='Only these event kinds (repeatable).')
+@click.option('--limit', '-n', type=int, default=50,
+              help='Max events to show (most recent).')
+@click.option('--follow', '-f', is_flag=True, default=False,
+              help='Poll for new events until interrupted.')
+def events(job_id, cluster, service, kinds, limit, follow):
+    """Show the control-plane flight recorder (journal) as a timeline.
+
+    Reads this host's ~/.skytpu/journal.db — provision failover
+    attempts, managed-job phase transitions, recovery rounds, replica
+    lifecycle. Each row carries a trace id; follow one with
+    `skytpu trace <id>`.
+    """
+    from skypilot_tpu.observability import journal
+    filters = [f for f in (job_id, cluster, service) if f is not None]
+    if len(filters) > 1:
+        raise click.UsageError(
+            'Use at most one of --job/--cluster/--service.')
+    entity = None
+    entity_prefix = None
+    if job_id is not None:
+        entity = f'job:{job_id}'
+    elif cluster is not None:
+        entity = f'cluster:{cluster}'
+    elif service is not None:
+        entity_prefix = f'replica:{service}/'
+    for k in kinds:
+        if k not in journal.KINDS:
+            raise click.UsageError(
+                f'Unknown event kind {k!r}. Known kinds: '
+                f'{", ".join(sorted(journal.KINDS))}')
+    rows = journal.query(kinds=kinds or None, entity=entity,
+                         entity_prefix=entity_prefix, limit=limit)
+    rows.reverse()  # oldest first reads as a timeline
+    click.echo(journal.format_events(rows))
+    if not follow:
+        return
+    last_id = rows[-1]['event_id'] if rows else 0
+    try:
+        while True:
+            time.sleep(1.0)
+            fresh = journal.query(kinds=kinds or None, entity=entity,
+                                  entity_prefix=entity_prefix,
+                                  since_id=last_id, limit=1000,
+                                  ascending=True)
+            for e in fresh:
+                click.echo(journal.format_event_line(e))
+                last_id = e['event_id']
+    except KeyboardInterrupt:
+        pass
+
+
+@cli.command()
+@click.argument('trace_id', required=True)
+def trace(trace_id):
+    """Render one trace's span tree (launch → failover attempts →
+    recovery rounds → job phases) from the local journal.
+
+    TRACE_ID may be a unique prefix (as printed by `skytpu events`).
+    """
+    from skypilot_tpu.observability import journal
+    rows = journal.query(trace_id=trace_id, ascending=True, limit=10000)
+    if not rows:
+        # Prefix match: `skytpu events` prints 8-char trace ids.
+        matches = journal.resolve_trace_prefix(trace_id)
+        if len(matches) == 1:
+            trace_id = matches[0]
+            rows = journal.query(trace_id=trace_id, ascending=True,
+                                 limit=10000)
+        elif len(matches) > 1:
+            raise click.UsageError(
+                f'Trace prefix {trace_id!r} is ambiguous: '
+                f'{", ".join(m[:12] for m in matches)}')
+    if not rows:
+        raise click.ClickException(f'No events for trace {trace_id!r}.')
+    click.echo(journal.format_trace(trace_id, rows))
+
+
+@cli.command()
 def dashboard():
     """Print the web dashboard URL (clusters/jobs/services/requests +
     per-request log viewer), starting a local API server if needed.

@@ -175,7 +175,8 @@ def start(cluster_name: str,
         if handle.launched_resources.tpu_topology is not None:
             cluster_info.custom_metadata['chips_per_host'] = \
                 handle.launched_resources.tpu_topology.chips_per_host
-        provisioner_lib.wait_for_ssh(cluster_info)
+        provisioner_lib.wait_for_ssh(cluster_info,
+                                     cluster_name=cluster_name)
         provisioner_lib.post_provision_runtime_setup(
             cluster_name, handle.cluster_name_on_cloud, cluster_info,
             cluster_info.provider_config)

@@ -15,6 +15,8 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.backends import backend_utils
 from skypilot_tpu.backends import gang_backend
+from skypilot_tpu.observability import journal
+from skypilot_tpu.observability import trace
 from skypilot_tpu.usage import usage_lib
 from skypilot_tpu.utils import timeline
 
@@ -71,58 +73,90 @@ def _execute(
     backend = backend or gang_backend.TpuGangBackend()
     stages = stages or list(Stage)
 
+    entity = f'cluster:{cluster_name}' if cluster_name else \
+        f'task:{task.name or "unnamed"}'
+    with trace.span('execution.launch', entity):
+        journal.event(journal.EventKind.LAUNCH_START, entity,
+                      {'task': task.name, 'num_nodes': task.num_nodes,
+                       'dryrun': dryrun})
+        try:
+            job_id, handle = _run_stages(
+                task, dag, stages, backend, handle, cluster_name, dryrun,
+                stream_logs, detach_run, retry_until_up, no_setup,
+                idle_minutes_to_autostop, down)
+        except Exception as e:
+            journal.event(journal.EventKind.LAUNCH_ERROR, entity,
+                          {'error': f'{type(e).__name__}: {e}'})
+            raise
+        journal.event(journal.EventKind.LAUNCH_DONE, entity,
+                      {'job_id': job_id})
+        return job_id, handle
+
+
+def _run_stages(
+    task: task_lib.Task,
+    dag: dag_lib.Dag,
+    stages: List[Stage],
+    backend: gang_backend.TpuGangBackend,
+    handle: Optional[gang_backend.ClusterHandle],
+    cluster_name: Optional[str],
+    dryrun: bool,
+    stream_logs: bool,
+    detach_run: bool,
+    retry_until_up: bool,
+    no_setup: bool,
+    idle_minutes_to_autostop: Optional[int],
+    down: bool,
+) -> Tuple[Optional[int], Optional[gang_backend.ClusterHandle]]:
     job_id = None
-    try:
-        if Stage.OPTIMIZE in stages and task.best_resources is None:
-            optimizer_lib.Optimizer.optimize(
-                dag,
-                minimize=optimizer_lib.OptimizeTarget.COST,
-                quiet=not stream_logs)
-        if dryrun and Stage.PROVISION not in stages:
+    if Stage.OPTIMIZE in stages and task.best_resources is None:
+        optimizer_lib.Optimizer.optimize(
+            dag,
+            minimize=optimizer_lib.OptimizeTarget.COST,
+            quiet=not stream_logs)
+    if dryrun and Stage.PROVISION not in stages:
+        return None, None
+
+    if Stage.PROVISION in stages:
+        handle = backend.provision(
+            task,
+            task.best_resources,
+            dryrun=dryrun,
+            stream_logs=stream_logs,
+            cluster_name=cluster_name,
+            retry_until_up=retry_until_up)
+        if dryrun:
             return None, None
+        assert handle is not None
 
-        if Stage.PROVISION in stages:
-            handle = backend.provision(
-                task,
-                task.best_resources,
-                dryrun=dryrun,
-                stream_logs=stream_logs,
-                cluster_name=cluster_name,
-                retry_until_up=retry_until_up)
-            if dryrun:
-                return None, None
-            assert handle is not None
+    if Stage.SYNC_WORKDIR in stages and task.workdir is not None:
+        backend.sync_workdir(handle, task.workdir)
 
-        if Stage.SYNC_WORKDIR in stages and task.workdir is not None:
-            backend.sync_workdir(handle, task.workdir)
+    if Stage.SYNC_FILE_MOUNTS in stages and (task.file_mounts or
+                                             task.storage_mounts):
+        task.sync_storage_mounts()
+        backend.sync_file_mounts(handle, task.file_mounts,
+                                 task.storage_mounts)
 
-        if Stage.SYNC_FILE_MOUNTS in stages and (task.file_mounts or
-                                                 task.storage_mounts):
-            task.sync_storage_mounts()
-            backend.sync_file_mounts(handle, task.file_mounts,
-                                     task.storage_mounts)
+    if Stage.SETUP in stages and not no_setup:
+        backend.setup(handle, task)
 
-        if Stage.SETUP in stages and not no_setup:
-            backend.setup(handle, task)
+    if Stage.PRE_EXEC in stages:
+        autostop = idle_minutes_to_autostop
+        autostop_down = down
+        if autostop is None:
+            res = task.best_resources or next(iter(task.resources))
+            if res.autostop is not None:
+                autostop = res.autostop['idle_minutes']
+                autostop_down = res.autostop['down']
+        if autostop is not None and autostop >= 0:
+            backend.set_autostop(handle, autostop, autostop_down)
 
-        if Stage.PRE_EXEC in stages:
-            autostop = idle_minutes_to_autostop
-            autostop_down = down
-            if autostop is None:
-                res = task.best_resources or next(iter(task.resources))
-                if res.autostop is not None:
-                    autostop = res.autostop['idle_minutes']
-                    autostop_down = res.autostop['down']
-            if autostop is not None and autostop >= 0:
-                backend.set_autostop(handle, autostop, autostop_down)
+    if Stage.EXEC in stages:
+        job_id = backend.execute(handle, task, detach_run=detach_run)
 
-        if Stage.EXEC in stages:
-            job_id = backend.execute(handle, task, detach_run=detach_run)
-
-        if Stage.DOWN in stages and down and idle_minutes_to_autostop is None:
-            backend.teardown(handle, terminate=True)
-    finally:
-        pass
+    if Stage.DOWN in stages and down and idle_minutes_to_autostop is None:
+        backend.teardown(handle, terminate=True)
     return job_id, handle
 
 

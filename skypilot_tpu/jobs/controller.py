@@ -22,6 +22,8 @@ from skypilot_tpu import task as task_lib
 from skypilot_tpu.jobs import recovery_strategy
 from skypilot_tpu.jobs import scheduler
 from skypilot_tpu.jobs import state
+from skypilot_tpu.observability import journal
+from skypilot_tpu.observability import trace
 from skypilot_tpu.skylet import job_lib
 
 logger = sky_logging.init_logger(__name__)
@@ -50,14 +52,19 @@ class JobsController:
         ]
 
     def run(self) -> None:
-        cancelled = False
-        for task_id, task in enumerate(self.tasks):
-            done = self._run_one_task(task_id, task)
-            if not done:
-                cancelled = state.cancel_requested(self.job_id)
-                break
-        if cancelled:
-            state.set_cancelled(self.job_id)
+        # Re-attach to the job's flight-recorder trace (persisted at
+        # create time), then run the whole controller under one span so
+        # every provision attempt / recovery round nests beneath it.
+        trace.attach(state.get_job_trace_id(self.job_id))
+        with trace.span('jobs.controller', f'job:{self.job_id}'):
+            cancelled = False
+            for task_id, task in enumerate(self.tasks):
+                done = self._run_one_task(task_id, task)
+                if not done:
+                    cancelled = state.cancel_requested(self.job_id)
+                    break
+            if cancelled:
+                state.set_cancelled(self.job_id)
 
     def _run_one_task(self, task_id: int, task: task_lib.Task) -> bool:
         """Returns True iff the task SUCCEEDED."""
@@ -154,7 +161,16 @@ class JobsController:
         """
         logger.info(f'Task {task_id}: {reason}; recovering.')
         state.set_recovering(self.job_id, task_id, reason)
-        recovered = strategy.recover()
+        entity = f'job:{self.job_id}'
+        with trace.span('jobs.recover', entity, task_id=task_id):
+            journal.event(journal.EventKind.JOB_RECOVER_START, entity,
+                          {'task_id': task_id, 'reason': reason})
+            t0 = time.time()
+            recovered = strategy.recover()
+            journal.event(journal.EventKind.JOB_RECOVER_DONE, entity,
+                          {'task_id': task_id,
+                           'recovered': recovered is not None,
+                           'seconds': round(time.time() - t0, 3)})
         if recovered is not None:
             state.set_recovered(self.job_id, task_id, recovered)
 

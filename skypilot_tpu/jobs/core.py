@@ -104,8 +104,13 @@ def _launch_on_controller_cluster(tasks: List[task_lib.Task],
         'name': t.name,
         'resources': ', '.join(str(r) for r in t.resources),
     } for t in tasks]
+    # The client's flight-recorder trace rides into the controller
+    # cluster explicitly: the RPC's env does not cross the SSH hop, and
+    # the job row THERE is what its controller process re-attaches to.
+    from skypilot_tpu.observability import trace as trace_lib
+    trace_id = trace_lib.get_trace_id() or trace_lib.new_trace_id()
     payload = json.dumps({'name': name, 'dag': dag_id,
-                          'specs': task_specs})
+                          'specs': task_specs, 'trace': trace_id})
     job_id = controller_utils.controller_rpc(
         controller_utils.JOBS,
         f'import os; p = json.loads({payload!r}); '
@@ -113,7 +118,7 @@ def _launch_on_controller_cluster(tasks: List[task_lib.Task],
         'dag_path = os.path.expanduser('
         '"~/.skytpu/managed_jobs/dags/" + p["dag"] + ".yaml"); '
         'jid = state.create_job(p["name"], dag_yaml_path=dag_path, '
-        'task_specs=p["specs"]); '
+        'task_specs=p["specs"], trace_id=p["trace"]); '
         'scheduler.submit_job(jid); emit(jid)')
     logger.info(f'Managed job {job_id} ({name!r}) submitted to controller '
                 f'cluster {controller_utils.controller_cluster_name("jobs")!r}.')

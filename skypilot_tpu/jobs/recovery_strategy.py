@@ -17,6 +17,7 @@ from typing import Callable, Optional
 from skypilot_tpu import exceptions
 from skypilot_tpu import global_state
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import journal
 from skypilot_tpu.skylet import job_lib
 from skypilot_tpu.utils import registry
 
@@ -253,6 +254,11 @@ class StrategyExecutor:
                                zone: Optional[str] = None,
                                max_retry: Optional[int] = None
                                ) -> Optional[float]:
+        journal.event(journal.EventKind.RECOVERY_SWEEP,
+                      f'cluster:{self.cluster_name}',
+                      {'strategy': type(self).__name__,
+                       'region': region, 'zone': zone,
+                       'max_retry': max_retry})
         self.cleanup_cluster()
         return self._launch(max_retry=max_retry, raise_on_failure=False,
                             region=region, zone=zone)

@@ -72,6 +72,14 @@ def _spawn_controller(job_id: int, dag_yaml_path: str) -> None:
         env = constants.strip_accel_boot_env(dict(os.environ))
         env['PYTHONPATH'] = pkg_root + (
             os.pathsep + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
+        # Hand the job's flight-recorder trace to the controller over env
+        # (the row is authoritative; env covers code that reads it before
+        # attaching). A skylet-tick respawn goes through here too, so a
+        # job recovered days later still journals into its own trace.
+        from skypilot_tpu.observability import trace as trace_lib
+        trace_id = state.get_job_trace_id(job_id)
+        if trace_id:
+            env[trace_lib.TRACE_ID_ENV] = trace_id
         log_path = state.controller_log_path(job_id)
         with open(log_path, 'ab') as log_f:
             proc = subprocess.Popen(

@@ -22,7 +22,8 @@ _TABLES = """
         schedule_state TEXT,
         controller_pid INTEGER DEFAULT NULL,
         dag_yaml_path TEXT,
-        cancel_requested INTEGER DEFAULT 0
+        cancel_requested INTEGER DEFAULT 0,
+        trace_id TEXT DEFAULT NULL
     );
     CREATE TABLE IF NOT EXISTS tasks (
         job_id INTEGER,
@@ -68,7 +69,9 @@ def controller_log_path(job_id: int) -> str:
     return os.path.join(d, f'{job_id}.log')
 
 
-_CONN = db_utils.SqliteConn('managed_jobs', db_path, _TABLES)
+_CONN = db_utils.SqliteConn(
+    'managed_jobs', db_path, _TABLES,
+    migrations=('ALTER TABLE jobs ADD COLUMN trace_id TEXT DEFAULT NULL',))
 
 
 def _db() -> sqlite3.Connection:
@@ -120,14 +123,23 @@ class ManagedJobScheduleState(enum.Enum):
 
 
 def create_job(name: Optional[str], dag_yaml_path: str,
-               task_specs: List[Dict[str, Any]]) -> int:
-    """Insert job + one PENDING task row per pipeline stage."""
+               task_specs: List[Dict[str, Any]],
+               trace_id: Optional[str] = None) -> int:
+    """Insert job + one PENDING task row per pipeline stage.
+
+    ``trace_id`` is the flight-recorder trace this job belongs to; it is
+    persisted so the controller process (spawned now, or respawned by a
+    skylet tick days later) re-attaches to the SAME trace.
+    """
+    from skypilot_tpu.observability import trace as trace_lib
+    if trace_id is None:
+        trace_id = trace_lib.get_trace_id() or trace_lib.new_trace_id()
     with _db() as conn:
         cur = conn.execute(
             'INSERT INTO jobs (name, submitted_at, schedule_state, '
-            'dag_yaml_path) VALUES (?,?,?,?)',
+            'dag_yaml_path, trace_id) VALUES (?,?,?,?,?)',
             (name, time.time(), ManagedJobScheduleState.WAITING.value,
-             dag_yaml_path))
+             dag_yaml_path, trace_id))
         job_id = cur.lastrowid
         for task_id, spec in enumerate(task_specs):
             conn.execute(
@@ -136,7 +148,41 @@ def create_job(name: Optional[str], dag_yaml_path: str,
                 (job_id, task_id, spec.get('name'),
                  json.dumps(spec.get('resources')),
                  ManagedJobStatus.PENDING.value, time.time()))
+    from skypilot_tpu.observability import journal
+    journal.event(journal.EventKind.JOB_CREATED, f'job:{job_id}',
+                  {'name': name, 'tasks': len(task_specs)},
+                  trace_id=trace_id)
+    # Seed the goodput integral: the job is QUEUED from this instant.
+    _journal_phase(job_id, 0, ManagedJobStatus.PENDING,
+                   trace_id=trace_id)
     return job_id
+
+
+def get_job_trace_id(job_id: int) -> Optional[str]:
+    job = get_job(job_id)
+    return job.get('trace_id') if job else None
+
+
+def _journal_phase(job_id: int, task_id: int, status: ManagedJobStatus,
+                   detail: str = '',
+                   trace_id: Optional[str] = None) -> None:
+    """One choke point for managed-job phase events: every status
+    transition lands in the journal (stamped with the job's stored
+    trace), and the goodput gauges are refreshed from the new integral.
+    Best-effort by design — accounting must never wedge a transition."""
+    from skypilot_tpu.observability import goodput
+    from skypilot_tpu.observability import journal
+    if trace_id is None:
+        trace_id = get_job_trace_id(job_id)
+    payload: Dict[str, Any] = {'task_id': task_id, 'status': status.value}
+    if detail:
+        payload['detail'] = detail
+    journal.event(journal.EventKind.JOB_PHASE, f'job:{job_id}', payload,
+                  trace_id=trace_id)
+    try:
+        goodput.publish(job_id)
+    except Exception:  # pylint: disable=broad-except
+        pass
 
 
 def set_dag_yaml_path(job_id: int, path: str) -> None:
@@ -202,15 +248,19 @@ def set_submitted(job_id: int, task_id: int, run_timestamp: str,
                   cluster_name: str) -> None:
     _set(job_id, task_id, status=ManagedJobStatus.SUBMITTED.value,
          run_timestamp=run_timestamp, cluster_name=cluster_name)
+    _journal_phase(job_id, task_id, ManagedJobStatus.SUBMITTED,
+                   detail=cluster_name)
 
 
 def set_starting(job_id: int, task_id: int) -> None:
     _set(job_id, task_id, status=ManagedJobStatus.STARTING.value)
+    _journal_phase(job_id, task_id, ManagedJobStatus.STARTING)
 
 
 def set_started(job_id: int, task_id: int, start_time: float) -> None:
     _set(job_id, task_id, status=ManagedJobStatus.RUNNING.value,
          start_at=start_time, last_recovered_at=start_time)
+    _journal_phase(job_id, task_id, ManagedJobStatus.RUNNING)
 
 
 def set_recovering(job_id: int, task_id: int, reason: str = '') -> None:
@@ -223,6 +273,8 @@ def set_recovering(job_id: int, task_id: int, reason: str = '') -> None:
     _set(job_id, task_id, status=ManagedJobStatus.RECOVERING.value,
          job_duration=duration)
     add_recovery_event(job_id, task_id, 'RECOVERING', reason)
+    _journal_phase(job_id, task_id, ManagedJobStatus.RECOVERING,
+                   detail=reason)
 
 
 def set_recovered(job_id: int, task_id: int, recovered_time: float) -> None:
@@ -233,6 +285,8 @@ def set_recovered(job_id: int, task_id: int, recovered_time: float) -> None:
          recovery_count=task['recovery_count'] + 1)
     add_recovery_event(job_id, task_id, 'RECOVERED',
                        f'recovery #{task["recovery_count"] + 1}')
+    _journal_phase(job_id, task_id, ManagedJobStatus.RUNNING,
+                   detail=f'recovery #{task["recovery_count"] + 1}')
 
 
 # ------------------------------------------------------ recovery history
@@ -269,6 +323,7 @@ def get_recovery_events(limit: int = 20) -> List[Dict[str, Any]]:
 def set_succeeded(job_id: int, task_id: int, end_time: float) -> None:
     _set(job_id, task_id, status=ManagedJobStatus.SUCCEEDED.value,
          end_at=end_time)
+    _journal_phase(job_id, task_id, ManagedJobStatus.SUCCEEDED)
 
 
 def set_failed(job_id: int, task_id: int, failure_type: ManagedJobStatus,
@@ -277,11 +332,13 @@ def set_failed(job_id: int, task_id: int, failure_type: ManagedJobStatus,
     assert failure_type.is_failed(), failure_type
     _set(job_id, task_id, status=failure_type.value,
          failure_reason=failure_reason, end_at=end_time or time.time())
+    _journal_phase(job_id, task_id, failure_type, detail=failure_reason)
 
 
 def set_cancelling(job_id: int) -> None:
     """Mark every nonterminal task CANCELLING + raise the cancel flag the
     controller polls."""
+    cancelling = []
     with _db() as conn:
         conn.execute('UPDATE jobs SET cancel_requested=1 WHERE job_id=?',
                      (job_id,))
@@ -291,15 +348,23 @@ def set_cancelling(job_id: int) -> None:
                     'UPDATE tasks SET status=? WHERE job_id=? AND task_id=?',
                     (ManagedJobStatus.CANCELLING.value, job_id,
                      t['task_id']))
+                cancelling.append(t['task_id'])
+    for task_id in cancelling:
+        _journal_phase(job_id, task_id, ManagedJobStatus.CANCELLING)
 
 
 def set_cancelled(job_id: int) -> None:
+    cancelled = [t['task_id'] for t in get_tasks(job_id)
+                 if ManagedJobStatus(t['status']) ==
+                 ManagedJobStatus.CANCELLING]
     with _db() as conn:
         conn.execute(
             'UPDATE tasks SET status=?, end_at=? WHERE job_id=? '
             'AND status=?',
             (ManagedJobStatus.CANCELLED.value, time.time(), job_id,
              ManagedJobStatus.CANCELLING.value))
+    for task_id in cancelled:
+        _journal_phase(job_id, task_id, ManagedJobStatus.CANCELLED)
 
 
 def cancel_requested(job_id: int) -> bool:

@@ -1,0 +1,107 @@
+"""Job goodput accounting: where did a managed job's wall-clock go?
+
+At TPU-pod scale, delivered throughput is decided by time lost to
+preemption/recovery, not step time ("Exploring the limits of Concurrency
+in ML Training on Google TPUs", arXiv:2011.03641) — so the phase split
+must be a first-class queryable signal, not something reconstructed from
+logs. This module derives it from the journal's ``job.phase`` events
+(one per managed-job status transition, written by ``jobs/state``) and
+publishes:
+
+* ``skytpu_job_phase_seconds_total{job, phase}`` — cumulative seconds a
+  job has spent in each phase (QUEUED / PROVISIONING / SETUP /
+  RECOVERING / RUNNING);
+* ``skytpu_job_goodput_ratio{job}`` — RUNNING seconds over total tracked
+  seconds: the fraction of the job's life that produced work.
+
+Both are gauges: every refresh recomputes the full integral from the
+journal, so restarts and replays converge to the same numbers instead of
+double-counting.
+"""
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.observability import journal
+from skypilot_tpu.observability import metrics
+
+PHASES = ('QUEUED', 'PROVISIONING', 'SETUP', 'RECOVERING', 'RUNNING')
+
+# ManagedJobStatus value → accounting phase. Terminal statuses close the
+# integral; unknown/None statuses pause it (no phase accrues).
+_STATUS_TO_PHASE = {
+    'PENDING': 'QUEUED',
+    'SUBMITTED': 'PROVISIONING',
+    'STARTING': 'PROVISIONING',
+    'SETUP': 'SETUP',
+    'RUNNING': 'RUNNING',
+    'RECOVERING': 'RECOVERING',
+}
+_TERMINAL = {
+    'SUCCEEDED', 'CANCELLED', 'FAILED', 'FAILED_SETUP',
+    'FAILED_PRECHECKS', 'FAILED_NO_RESOURCE', 'FAILED_CONTROLLER',
+    'CANCELLING',
+}
+
+
+def job_entity(job_id: int) -> str:
+    return f'job:{job_id}'
+
+
+def phase_seconds(events: List[Dict[str, Any]],
+                  now: Optional[float] = None) -> Dict[str, float]:
+    """Integrate ``job.phase`` events (oldest-first) into per-phase
+    seconds. Each event's phase holds until the next event; a live
+    (non-terminal) tail phase accrues up to ``now``."""
+    now = time.time() if now is None else now
+    totals = {p: 0.0 for p in PHASES}
+    current: Optional[str] = None
+    current_since = 0.0
+    for e in events:
+        payload = e.get('payload') or {}
+        status = payload.get('status')
+        phase = payload.get('phase') or _STATUS_TO_PHASE.get(status)
+        ts = e['ts']
+        if current is not None:
+            totals[current] += max(0.0, ts - current_since)
+        if status in _TERMINAL:
+            current = None
+        else:
+            current = phase if phase in totals else None
+            current_since = ts
+    if current is not None:
+        totals[current] += max(0.0, now - current_since)
+    return totals
+
+
+def compute(job_id: int,
+            now: Optional[float] = None) -> Dict[str, Any]:
+    """Phase split + goodput ratio for one managed job, from the journal."""
+    events = journal.query(kinds=[journal.EventKind.JOB_PHASE],
+                           entity=job_entity(job_id),
+                           ascending=True,
+                           limit=10000)
+    totals = phase_seconds(events, now=now)
+    tracked = sum(totals.values())
+    ratio = (totals['RUNNING'] / tracked) if tracked > 0 else 0.0
+    return {
+        'job_id': job_id,
+        'phase_seconds': totals,
+        'tracked_seconds': tracked,
+        'goodput_ratio': ratio,
+    }
+
+
+def publish(job_id: int, now: Optional[float] = None) -> Dict[str, Any]:
+    """Recompute and push one job's split into the process registry."""
+    result = compute(job_id, now=now)
+    phase_g = metrics.gauge(
+        'skytpu_job_phase_seconds_total',
+        'Cumulative seconds a managed job has spent per phase.',
+        labels=('job', 'phase'))
+    for phase, secs in result['phase_seconds'].items():
+        phase_g.set(secs, labels=(str(job_id), phase))
+    metrics.gauge(
+        'skytpu_job_goodput_ratio',
+        'RUNNING seconds over total tracked seconds per managed job.',
+        labels=('job',)).set(result['goodput_ratio'], labels=(str(job_id),))
+    return result

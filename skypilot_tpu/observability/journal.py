@@ -1,0 +1,380 @@
+"""Control-plane flight recorder: an append-only structured event journal.
+
+PR 2 gave the data plane numbers (``skytpu_*`` metrics); this is the
+control plane's black box. Every orchestration step that decides a job's
+fate — provision failover attempts, gang job submits, managed-job phase
+transitions, recovery rounds, serve replica lifecycle — appends one
+structured row here, stamped with the trace context
+(``observability/trace``), so "why did my job take 40 minutes to
+recover" is answerable *after the fact* from one sqlite file instead of
+grepping process logs that may no longer exist.
+
+Design rules:
+
+* **Bounded vocabulary.** Event kinds come from :class:`EventKind` —
+  an unregistered kind raises immediately (and a tier-1 lint scans call
+  sites), so the journal stays greppable and dashboards don't chase
+  free-text drift.
+* **Best-effort writes.** A full disk or locked DB must never fail a
+  launch: sqlite/OS errors are swallowed (the kind check is a
+  programming error and is not).
+* **Bounded size.** The table self-prunes to ``SKYTPU_JOURNAL_MAX_EVENTS``
+  (default 20000) rows by rowid — O(1) per insert, no table scans on the
+  control path.
+* **Local by design.** Each host journals to its own
+  ``~/.skytpu/journal.db``; the controller host's journal is the
+  control-plane record the CLI/dashboard read. Cross-host linkage is by
+  trace id, not by a shared database.
+"""
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from skypilot_tpu.observability import trace as trace_lib
+from skypilot_tpu.utils import db_utils
+
+DISABLE_ENV = 'SKYTPU_JOURNAL_DISABLED'
+MAX_EVENTS_ENV = 'SKYTPU_JOURNAL_MAX_EVENTS'
+DEFAULT_MAX_EVENTS = 20000
+# job.phase rows are exempt from the generic prune (goodput recomputes
+# from them) and capped separately, much higher — see event().
+PHASE_EVENTS_CAP = 50000
+
+
+class EventKind(enum.Enum):
+    """The journal's full vocabulary. Add here FIRST; the tier-1 lint
+    (test_observability.py) rejects call sites using strings that are
+    not registered values."""
+    # Span structure (emitted by trace.span()).
+    SPAN_START = 'span.start'
+    SPAN_END = 'span.end'
+    # execution.py lifecycle.
+    LAUNCH_START = 'launch.start'
+    LAUNCH_DONE = 'launch.done'
+    LAUNCH_ERROR = 'launch.error'
+    # Provision failover engine (gang_backend.RetryingProvisioner).
+    PROVISION_ATTEMPT = 'provision.attempt'
+    PROVISION_FAILOVER = 'provision.failover'
+    PROVISION_DONE = 'provision.done'
+    # Provision orchestrator phases (provision/provisioner.py).
+    PROVISION_WAIT_SSH = 'provision.wait_ssh'
+    PROVISION_RUNTIME_SETUP = 'provision.runtime_setup'
+    # Cluster backend (gang_backend.TpuGangBackend).
+    BACKEND_JOB_SUBMIT = 'backend.job_submit'
+    CLUSTER_TEARDOWN = 'cluster.teardown'
+    # On-cluster runtime (skylet/).
+    SKYLET_JOB_START = 'skylet.job_start'
+    SKYLET_JOB_END = 'skylet.job_end'
+    SKYLET_AUTOSTOP = 'skylet.autostop'
+    # Managed jobs (jobs/).
+    JOB_CREATED = 'job.created'
+    JOB_PHASE = 'job.phase'
+    JOB_RECOVER_START = 'job.recover_start'
+    JOB_RECOVER_DONE = 'job.recover_done'
+    RECOVERY_SWEEP = 'recovery.sweep'
+    # Serve replica lifecycle (serve/replica_managers.py).
+    REPLICA_TRANSITION = 'replica.transition'
+
+
+KINDS = frozenset(k.value for k in EventKind)
+
+_TABLE = """
+    CREATE TABLE IF NOT EXISTS events (
+        event_id INTEGER PRIMARY KEY AUTOINCREMENT,
+        ts REAL,
+        kind TEXT,
+        entity TEXT,
+        payload TEXT,
+        trace_id TEXT,
+        span_id TEXT,
+        parent_span_id TEXT
+    );
+    CREATE INDEX IF NOT EXISTS idx_events_trace ON events(trace_id);
+    CREATE INDEX IF NOT EXISTS idx_events_entity ON events(entity);
+"""
+
+
+def db_path() -> str:
+    return os.path.join(os.path.expanduser('~'), '.skytpu', 'journal.db')
+
+
+_CONN = db_utils.SqliteConn('journal', db_path, _TABLE)
+
+
+def _db() -> sqlite3.Connection:
+    return _CONN.get()
+
+
+def max_events() -> int:
+    try:
+        return int(os.environ.get(MAX_EVENTS_ENV, DEFAULT_MAX_EVENTS))
+    except ValueError:
+        return DEFAULT_MAX_EVENTS
+
+
+def enabled() -> bool:
+    return os.environ.get(DISABLE_ENV, '0') != '1'
+
+
+def event(kind: Union[EventKind, str],
+          entity: str,
+          payload: Optional[Dict[str, Any]] = None,
+          *,
+          trace_id: Optional[str] = None,
+          span_id: Optional[str] = None,
+          parent_span_id: Optional[str] = None,
+          ts: Optional[float] = None) -> None:
+    """Append one event. Trace/span default to the ambient context
+    (``observability/trace``); entity is a ``type:name`` string, e.g.
+    ``cluster:train-1-0``, ``job:3``, ``replica:svc/2``."""
+    kind_value = kind.value if isinstance(kind, EventKind) else str(kind)
+    if kind_value not in KINDS:
+        raise ValueError(
+            f'Unregistered journal event kind {kind_value!r}; add it to '
+            'observability.journal.EventKind first.')
+    if not enabled():
+        return
+    trace_id = trace_id or trace_lib.get_trace_id()
+    span_id = span_id or trace_lib.get_span_id()
+    if parent_span_id is None:
+        parent_span_id = trace_lib.get_parent_span_id()
+    try:
+        with _db() as conn:
+            cur = conn.execute(
+                'INSERT INTO events (ts, kind, entity, payload, trace_id, '
+                'span_id, parent_span_id) VALUES (?,?,?,?,?,?,?)',
+                (time.time() if ts is None else ts, kind_value,
+                 entity or '', json.dumps(payload or {}, default=str),
+                 trace_id, span_id, parent_span_id))
+            # Rowid-window prune: O(1) via the PK index, no ORDER BY
+            # scan. job.phase rows are exempt — the goodput integral is
+            # recomputed from them, and letting chatty span/provision
+            # traffic evict a long-lived job's early phase events would
+            # silently shrink its phase_seconds. They get their own much
+            # larger cap below (they are low-volume: a handful per
+            # transition, not per poll).
+            cap = max_events()
+            if cur.lastrowid is not None and cur.lastrowid > cap:
+                conn.execute(
+                    'DELETE FROM events WHERE event_id <= ? AND '
+                    'kind != ?',
+                    (cur.lastrowid - cap, EventKind.JOB_PHASE.value))
+            if kind_value == EventKind.JOB_PHASE.value:
+                conn.execute(
+                    'DELETE FROM events WHERE kind = ? AND event_id '
+                    'NOT IN (SELECT event_id FROM events WHERE kind = ? '
+                    'ORDER BY event_id DESC LIMIT ?)',
+                    (kind_value, kind_value, PHASE_EVENTS_CAP))
+    except (sqlite3.Error, OSError):
+        pass  # the flight recorder must never take the plane down
+
+
+def query(kinds: Optional[Sequence[Union[EventKind, str]]] = None,
+          entity: Optional[str] = None,
+          entity_prefix: Optional[str] = None,
+          trace_id: Optional[str] = None,
+          since_id: Optional[int] = None,
+          limit: int = 200,
+          ascending: bool = False) -> List[Dict[str, Any]]:
+    """Read events, newest first by default (``ascending=True`` for
+    timeline/trace rendering). Payloads come back as dicts."""
+    clauses, args = [], []
+    if kinds:
+        values = [k.value if isinstance(k, EventKind) else str(k)
+                  for k in kinds]
+        clauses.append(
+            f'kind IN ({",".join("?" * len(values))})')
+        args.extend(values)
+    if entity is not None:
+        clauses.append('entity = ?')
+        args.append(entity)
+    if entity_prefix is not None:
+        # Escape LIKE wildcards: entities legitimately contain '_'.
+        escaped = (entity_prefix.replace('\\', '\\\\')
+                   .replace('%', '\\%').replace('_', '\\_'))
+        clauses.append("entity LIKE ? ESCAPE '\\'")
+        args.append(escaped + '%')
+    if trace_id is not None:
+        clauses.append('trace_id = ?')
+        args.append(trace_id)
+    if since_id is not None:
+        clauses.append('event_id > ?')
+        args.append(since_id)
+    where = f' WHERE {" AND ".join(clauses)}' if clauses else ''
+    order = 'ASC' if ascending else 'DESC'
+    try:
+        rows = _db().execute(
+            f'SELECT * FROM events{where} ORDER BY event_id {order} '
+            'LIMIT ?', (*args, limit)).fetchall()
+    except (sqlite3.Error, OSError):
+        return []
+    out = []
+    for r in rows:
+        d = dict(r)
+        try:
+            d['payload'] = json.loads(d['payload'] or '{}')
+        except ValueError:
+            d['payload'] = {}
+        out.append(d)
+    return out
+
+
+def resolve_trace_prefix(prefix: str) -> List[str]:
+    """Full trace ids matching a prefix — resolved in SQL so even traces
+    whose events sit deep in the journal are found (`skytpu events`
+    prints 8-char prefixes)."""
+    escaped = (prefix.replace('\\', '\\\\')
+               .replace('%', '\\%').replace('_', '\\_'))
+    try:
+        rows = _db().execute(
+            "SELECT DISTINCT trace_id FROM events WHERE trace_id "
+            "LIKE ? ESCAPE '\\'", (escaped + '%',)).fetchall()
+    except (sqlite3.Error, OSError):
+        return []
+    return sorted(r['trace_id'] for r in rows if r['trace_id'])
+
+
+# ------------------------------------------------------------- rendering
+
+
+def _fmt_ts(ts: float) -> str:
+    return time.strftime('%m-%d %H:%M:%S', time.localtime(ts))
+
+
+def _fmt_payload(payload: Dict[str, Any], skip: Sequence[str] = ()) -> str:
+    # One line per event, always: payload values (error strings with
+    # embedded stderr, multi-line reasons) must not break the table.
+    parts = [f'{k}={v}'.replace('\n', '\\n').replace('\r', '')
+             for k, v in payload.items()
+             if k not in skip and v not in (None, '', {})]
+    return ' '.join(parts)
+
+
+def format_event_line(e: Dict[str, Any]) -> str:
+    """One event as a stable, non-tabular line (the --follow stream —
+    per-event table widths would make columns jump on every row)."""
+    return (f'{_fmt_ts(e["ts"])}  {e["kind"]:<24} '
+            f'{(e["entity"] or "-"):<24} '
+            f'{(e["trace_id"] or "")[:8] or "-":<8}  '
+            f'{_fmt_payload(e["payload"]) or "-"}')
+
+
+def format_events(events: List[Dict[str, Any]]) -> str:
+    """Flat timeline table for ``skytpu events`` (pass oldest-first)."""
+    if not events:
+        return 'No journal events.'
+    header = ('TIME', 'KIND', 'ENTITY', 'TRACE', 'DETAIL')
+    rows = []
+    for e in events:
+        rows.append((_fmt_ts(e['ts']), e['kind'], e['entity'] or '-',
+                     (e['trace_id'] or '')[:8] or '-',
+                     _fmt_payload(e['payload']) or '-'))
+    widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+              for i in range(len(header))]
+    lines = ['  '.join(h.ljust(widths[i]) for i, h in enumerate(header))]
+    for r in rows:
+        lines.append('  '.join(c.ljust(widths[i]) for i, c in enumerate(r)))
+    return '\n'.join(lines)
+
+
+class _SpanNode:
+    __slots__ = ('span_id', 'name', 'entity', 'start', 'end', 'error',
+                 'events', 'children', 'parent')
+
+    def __init__(self, span_id: Optional[str]):
+        self.span_id = span_id
+        self.name: Optional[str] = None
+        self.entity = ''
+        self.start: Optional[float] = None
+        self.end: Optional[float] = None
+        self.error: Optional[str] = None
+        self.events: List[Dict[str, Any]] = []
+        self.children: List['_SpanNode'] = []
+        self.parent: Optional[str] = None
+
+
+def span_tree(events: List[Dict[str, Any]]) -> List[_SpanNode]:
+    """Group one trace's events (oldest-first) into a span forest.
+
+    Spans are declared by span.start/span.end pairs; events whose span id
+    never got a span.start (e.g. emitted by a process that inherited the
+    span over env) still show up, attached to a synthetic node.
+    """
+    nodes: Dict[Optional[str], _SpanNode] = {}
+
+    def node(span_id: Optional[str]) -> _SpanNode:
+        if span_id not in nodes:
+            nodes[span_id] = _SpanNode(span_id)
+        return nodes[span_id]
+
+    for e in events:
+        n = node(e['span_id'])
+        kind = e['kind']
+        if kind == EventKind.SPAN_START.value:
+            n.name = e['payload'].get('name')
+            n.entity = e['entity'] or n.entity
+            n.start = e['ts']
+            n.parent = e['parent_span_id']
+        elif kind == EventKind.SPAN_END.value:
+            n.end = e['ts']
+            n.error = e['payload'].get('error')
+        else:
+            n.events.append(e)
+            n.entity = n.entity or (e['entity'] or '')
+            if n.start is None:
+                n.start = e['ts']
+            if n.parent is None:
+                n.parent = e['parent_span_id']
+    roots: List[_SpanNode] = []
+    for n in nodes.values():
+        parent = nodes.get(n.parent) if n.parent else None
+        if parent is not None and parent is not n:
+            parent.children.append(n)
+        else:
+            roots.append(n)
+    for n in nodes.values():
+        n.children.sort(key=lambda c: c.start or 0)
+    roots.sort(key=lambda c: c.start or 0)
+    return roots
+
+
+def format_trace(trace_id: str,
+                 events: Optional[List[Dict[str, Any]]] = None) -> str:
+    """Render one trace as an indented span tree with durations."""
+    if events is None:
+        events = query(trace_id=trace_id, ascending=True, limit=10000)
+    if not events:
+        return f'No events for trace {trace_id!r}.'
+    t0 = events[0]['ts']
+    t_last = max(e['ts'] for e in events)
+    lines = [f'trace {trace_id}  ({len(events)} events, '
+             f'{t_last - t0:.1f}s, started {_fmt_ts(t0)})']
+
+    def _dur(n: _SpanNode) -> str:
+        if n.start is None:
+            return ''
+        end = n.end if n.end is not None else t_last
+        return f'  {end - n.start:.1f}s'
+
+    def _render(n: _SpanNode, indent: str) -> None:
+        # Events journaled outside any span (e.g. the client process
+        # before the controller exists) collect under '(no span)'.
+        label = n.name or (f'span {n.span_id}' if n.span_id
+                           else '(no span)')
+        suffix = f'  [{n.entity}]' if n.entity else ''
+        err = f'  ERROR: {n.error}' if n.error else ''
+        lines.append(f'{indent}{label}{suffix}{_dur(n)}{err}')
+        for e in n.events:
+            detail = _fmt_payload(e['payload'], skip=('name',))
+            detail = f'  {detail}' if detail else ''
+            lines.append(f'{indent}  +{e["ts"] - t0:7.1f}s '
+                         f'{e["kind"]}  {e["entity"] or "-"}{detail}')
+        for c in n.children:
+            _render(c, indent + '  ')
+
+    for root in span_tree(events):
+        _render(root, '  ')
+    return '\n'.join(lines)

@@ -1,0 +1,155 @@
+"""Trace context: link control-plane work across processes and hosts.
+
+One *trace* covers one logical operation end to end — a ``launch`` walking
+provision failover, a managed job recovering through three preemptions, a
+serve replica being replaced. Within a trace, *spans* nest: each span has
+an id and a parent id, and every journal event (``observability/journal``)
+records the (trace, span, parent) triple active where it fired, so
+``skytpu trace <id>`` can rebuild the tree afterwards.
+
+Propagation:
+
+* In-process: ``contextvars`` — thread- and async-safe, and a span opened
+  in a worker thread inherits the spawning context only if the caller
+  copies it (control-plane threads that matter run the span inline).
+* Across processes (controller spawn, skylet → job_runner): the
+  ``SKYTPU_TRACE_ID`` / ``SKYTPU_SPAN_ID`` env vars. ``get_trace_id``
+  falls back to the env, so a freshly spawned process is already inside
+  its parent's trace with no code at all; :func:`context_env` /
+  :func:`shell_env_prefix` build the vars for ``Popen`` envs and
+  codegen-over-SSH command strings.
+* Across state (a managed job whose controller is respawned days later):
+  persist ``get_trace_id()`` next to the row and :func:`attach` it at
+  process start — env vars die with the parent, sqlite does not.
+
+No clocks, no sampling, no wire format: ids are opaque hex, and the
+journal is the only consumer.
+"""
+import contextlib
+import contextvars
+import os
+import uuid
+from typing import Dict, Iterator, Optional
+
+TRACE_ID_ENV = 'SKYTPU_TRACE_ID'
+SPAN_ID_ENV = 'SKYTPU_SPAN_ID'
+
+_trace_id: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    'skytpu_trace_id', default=None)
+_span_id: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    'skytpu_span_id', default=None)
+_parent_span_id: contextvars.ContextVar[Optional[str]] = \
+    contextvars.ContextVar('skytpu_parent_span_id', default=None)
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def get_trace_id() -> Optional[str]:
+    """Active trace id: contextvar first, then the inherited env."""
+    return _trace_id.get() or os.environ.get(TRACE_ID_ENV) or None
+
+
+def get_span_id() -> Optional[str]:
+    return _span_id.get() or os.environ.get(SPAN_ID_ENV) or None
+
+
+def get_parent_span_id() -> Optional[str]:
+    # The env carries only (trace, span): a spawned process knows which
+    # span it runs under but not that span's own parent.
+    return _parent_span_id.get()
+
+
+def attach(trace_id: Optional[str],
+           span_id: Optional[str] = None) -> None:
+    """Adopt a persisted trace context (process start from a DB row)."""
+    if trace_id:
+        _trace_id.set(trace_id)
+    if span_id:
+        _span_id.set(span_id)
+
+
+def ensure_trace() -> str:
+    """Return the active trace id, starting a new trace if none."""
+    tid = get_trace_id()
+    if tid is None:
+        tid = new_trace_id()
+        _trace_id.set(tid)
+    return tid
+
+
+def context_env() -> Dict[str, str]:
+    """Env vars that carry the active context into a child process."""
+    env = {}
+    tid = get_trace_id()
+    sid = get_span_id()
+    if tid:
+        env[TRACE_ID_ENV] = tid
+    if sid:
+        env[SPAN_ID_ENV] = sid
+    return env
+
+
+def shell_env_prefix() -> str:
+    """``SKYTPU_TRACE_ID=... SKYTPU_SPAN_ID=... `` for command strings
+    (codegen-over-SSH); empty when no trace is active. Ids are uuid hex,
+    so no quoting is needed."""
+    parts = [f'{k}={v}' for k, v in context_env().items()]
+    return ' '.join(parts) + ' ' if parts else ''
+
+
+class SpanHandle:
+    """What :func:`span` yields: the ids this span runs under."""
+
+    __slots__ = ('trace_id', 'span_id', 'parent_span_id', 'name')
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_span_id: Optional[str], name: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_span_id = parent_span_id
+        self.name = name
+
+
+@contextlib.contextmanager
+def span(name: str, entity: str = '',
+         **payload) -> Iterator[SpanHandle]:
+    """Open a child span (a new trace if none is active) and journal its
+    begin/end. Journal events fired inside carry this span's ids; the
+    exception (if any) is recorded on the end event, then re-raised.
+
+    A trace STARTED by this span ends with it: a root span resets the
+    trace contextvar on exit, so two back-to-back launches in one
+    process get two traces instead of silently merging into the first.
+    An inherited trace (env, attach()) is left in place."""
+    from skypilot_tpu.observability import journal
+    tid = get_trace_id()
+    t_trace = None
+    if tid is None:
+        tid = new_trace_id()
+        t_trace = _trace_id.set(tid)
+    sid = new_span_id()
+    parent = get_span_id()
+    t_span = _span_id.set(sid)
+    t_parent = _parent_span_id.set(parent)
+    handle = SpanHandle(tid, sid, parent, name)
+    journal.event(journal.EventKind.SPAN_START, entity,
+                  dict(payload, name=name))
+    try:
+        yield handle
+    except BaseException as e:
+        journal.event(journal.EventKind.SPAN_END, entity,
+                      {'name': name, 'error': f'{type(e).__name__}: {e}'})
+        raise
+    else:
+        journal.event(journal.EventKind.SPAN_END, entity, {'name': name})
+    finally:
+        _span_id.reset(t_span)
+        _parent_span_id.reset(t_parent)
+        if t_trace is not None:
+            _trace_id.reset(t_trace)

@@ -135,13 +135,20 @@ def _pod_config_overlay(node_cfg: Dict[str, Any]) -> Dict[str, Any]:
 
 def _merge_pod_config(dst: Dict[str, Any], src: Dict[str, Any]) -> None:
     """Deep-merge ``src`` into ``dst`` with the reference's semantics
-    (utils.py combine_pod_config_fields): nested dicts merge key-by-
-    key, lists APPEND, scalars overwrite — with ONE exception:
-    ``containers`` merges positionally, so a pod_config
-    ``containers[0].volumeMounts`` lands on the skytpu container
-    instead of adding a second container. Appending everywhere else is
-    what lets two overlay sources each contribute a volume /
-    toleration / imagePullSecret without clobbering each other."""
+    (utils.py combine_pod_config_fields / config_utils.merge_k8s_configs):
+    nested dicts merge key-by-key, lists APPEND, scalars overwrite — with
+    TWO exceptions:
+
+    * ``containers`` merges positionally, so a pod_config
+      ``containers[0].volumeMounts`` lands on the skytpu container
+      instead of adding a second container;
+    * ``volumes``/``volumeMounts`` entries merge BY ``name`` (append when
+      the name is new): duplicate volume names from two overlay sources
+      would make the apiserver reject the pod outright.
+
+    Appending everywhere else is what lets two overlay sources each
+    contribute a toleration / imagePullSecret without clobbering each
+    other."""
     for key, value in src.items():
         if (key in dst and isinstance(dst[key], dict) and
                 isinstance(value, dict)):
@@ -156,6 +163,18 @@ def _merge_pod_config(dst: Dict[str, Any], src: Dict[str, Any]) -> None:
                         _merge_pod_config(dst[key][i], item)
                     else:
                         dst[key].append(item)
+            elif key in ('volumes', 'volumeMounts'):
+                by_name = {item['name']: item for item in dst[key]
+                           if isinstance(item, dict) and 'name' in item}
+                for item in value:
+                    name = item.get('name') if isinstance(item, dict) \
+                        else None
+                    if name is not None and name in by_name:
+                        _merge_pod_config(by_name[name], item)
+                    else:
+                        dst[key].append(
+                            json.loads(json.dumps(item)) if isinstance(
+                                item, (dict, list)) else item)
             else:
                 dst[key].extend(
                     json.loads(json.dumps(item)) if isinstance(

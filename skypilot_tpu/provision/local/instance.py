@@ -114,8 +114,36 @@ def _save_state(cluster_name_on_cloud: str, state: Dict[str, str]) -> None:
         json.dump(state, f)
 
 
+class LocalCapacityError(common.CapacityError):
+    """Injected stockout (see SKYTPU_LOCAL_PROVISION_FAIL_FILE)."""
+    scope = 'zone'
+
+
+def _maybe_inject_capacity_failure() -> None:
+    """Fault injection for recovery/failover tests: if
+    ``SKYTPU_LOCAL_PROVISION_FAIL_FILE`` names a file holding an integer
+    N > 0, decrement it and raise a zonal stockout. The file (not an env
+    count) makes the budget shared across the controller's spawned
+    processes and lets a test arm failures mid-run."""
+    path = os.environ.get('SKYTPU_LOCAL_PROVISION_FAIL_FILE')
+    if not path:
+        return
+    try:
+        with open(path, encoding='utf-8') as f:
+            remaining = int(f.read().strip() or '0')
+    except (OSError, ValueError):
+        return
+    if remaining <= 0:
+        return
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(str(remaining - 1))
+    raise LocalCapacityError(
+        f'injected local stockout ({remaining - 1} left)')
+
+
 def run_instances(region: str, cluster_name_on_cloud: str,
                   config: common.ProvisionConfig) -> common.ProvisionRecord:
+    _maybe_inject_capacity_failure()
     state = _load_state(cluster_name_on_cloud)
     created, resumed = [], []
     for i in range(config.count):

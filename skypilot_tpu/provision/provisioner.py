@@ -15,6 +15,7 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import provision
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import journal
 from skypilot_tpu.provision import common
 from skypilot_tpu.skylet import constants
 from skypilot_tpu.utils import command_runner as command_runner_lib
@@ -117,12 +118,14 @@ def bulk_provision(provider_name: str, region: str,
 
 @timeline.event
 def wait_for_ssh(cluster_info: common.ClusterInfo,
-                 timeout: float = 600.0) -> None:
+                 timeout: float = 600.0,
+                 cluster_name: Optional[str] = None) -> None:
     """Probe every host until reachable (parity: provisioner.py:353).
 
     Raw host runners: the task container (if any) does not exist yet."""
     runners = make_runners(cluster_info, wrap_docker=False)
     deadline = time.time() + timeout
+    t0 = time.time()
 
     def _wait(runner) -> None:
         backoff = 1.0
@@ -136,6 +139,10 @@ def wait_for_ssh(cluster_info: common.ClusterInfo,
             backoff = min(backoff * 1.5, 10.0)
 
     subprocess_utils.run_in_parallel(_wait, runners)
+    journal.event(journal.EventKind.PROVISION_WAIT_SSH,
+                  f'cluster:{cluster_name}' if cluster_name else '',
+                  {'hosts': len(runners),
+                   'seconds': round(time.time() - t0, 3)})
 
 
 def _runtime_sync_source() -> str:
@@ -227,6 +234,8 @@ def post_provision_runtime_setup(
 
     subprocess_utils.run_in_parallel(_setup_one,
                                      list(zip(runners, hosts_meta)))
+    journal.event(journal.EventKind.PROVISION_RUNTIME_SETUP,
+                  f'cluster:{cluster_name}', {'hosts': len(runners)})
     logger.debug(f'Runtime setup complete on {len(runners)} host(s).')
 
 

@@ -15,6 +15,7 @@ import requests as requests_lib
 
 from skypilot_tpu import global_state
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import journal
 from skypilot_tpu.observability import metrics
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.serve import serve_state
@@ -131,6 +132,11 @@ class ReplicaManager:
                             'Replica state transitions by target status.',
                             labels=('service', 'to_status')).inc(
                                 labels=(self.service_name, status.name))
+            journal.event(
+                journal.EventKind.REPLICA_TRANSITION,
+                f'replica:{self.service_name}/{replica_id}',
+                {'from': prev.name if prev is not None else None,
+                 'to': status.name})
 
     def _replica_port(self, replica_id: int, cloud_is_local: bool) -> int:
         # Real clouds: every replica is its own host → same port. Local

@@ -144,6 +144,26 @@ def render() -> str:
                             'STRIKES', 'WHEN', 'BLOCKED UNTIL'),
                            blocks))
 
+    # Flight recorder: the journal's most recent control-plane events.
+    # Span bookkeeping rows are filtered in SQL (during span-heavy
+    # activity they would crowd real events out of any fixed window).
+    # The TRACE column is the id to feed `skytpu trace <id>`.
+    from skypilot_tpu.observability import journal as journal_lib
+    real_kinds = [k for k in journal_lib.EventKind
+                  if k not in (journal_lib.EventKind.SPAN_START,
+                               journal_lib.EventKind.SPAN_END)]
+    journal_rows = []
+    for e in journal_lib.query(kinds=real_kinds, limit=30):
+        detail = ' '.join(
+            f'{k}={v}' for k, v in (e['payload'] or {}).items()
+            if v not in (None, '', {}))
+        journal_rows.append((_ts(e['ts']), e['kind'], e['entity'] or '-',
+                             (e['trace_id'] or '')[:8] or '-',
+                             detail[:120] or '-'))
+    sections.append(_table('Journal (last 30 events)',
+                           ('WHEN', 'KIND', 'ENTITY', 'TRACE', 'DETAIL'),
+                           journal_rows))
+
     services = []
     for svc in serve_state.get_services():
         replicas = serve_state.get_replicas(svc['name'])

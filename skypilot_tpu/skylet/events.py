@@ -70,6 +70,13 @@ class AutostopEvent(SkyletEvent):
         provider = info.get('provider_name')
         provider_config = info.get('provider_config', {})
         cluster_name = info.get('cluster_name_on_cloud')
+        # Flight-recorder breadcrumb BEFORE acting: if the stop call takes
+        # this very host down, the decision is already on record.
+        from skypilot_tpu.observability import journal
+        journal.event(journal.EventKind.SKYLET_AUTOSTOP,
+                      f'cluster:{info.get("cluster_name") or cluster_name}',
+                      {'down': bool(cfg.get('down')),
+                       'idle_minutes': cfg.get('autostop_idle_minutes')})
         from skypilot_tpu import provision
         if cfg.get('down'):
             provision.terminate_instances(provider, cluster_name,

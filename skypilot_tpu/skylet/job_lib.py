@@ -17,6 +17,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.skylet import constants
+from skypilot_tpu.utils import db_utils
 from skypilot_tpu.utils.subprocess_utils import pid_alive as _pid_alive
 
 _TABLE = """
@@ -32,9 +33,16 @@ _TABLE = """
         resources TEXT,
         pid INTEGER DEFAULT -1,
         script_path TEXT,
-        log_dir TEXT
+        log_dir TEXT,
+        trace_id TEXT DEFAULT NULL,
+        span_id TEXT DEFAULT NULL
     );
 """
+
+_MIGRATIONS = (
+    'ALTER TABLE jobs ADD COLUMN trace_id TEXT DEFAULT NULL',
+    'ALTER TABLE jobs ADD COLUMN span_id TEXT DEFAULT NULL',
+)
 
 
 class JobStatus(enum.Enum):
@@ -68,28 +76,39 @@ _TERMINAL = {
 _MAX_PARALLEL_JOBS = int(os.environ.get('SKYTPU_MAX_PARALLEL_JOBS', '1'))
 
 
+# Thread-local cached connection with one-time schema + migration replay
+# (db_utils.SqliteConn) — the skylet tick and codegen snippets hit this
+# on every poll, and the path re-resolves per call so local-cloud nodes
+# with different skylet homes stay isolated.
+_CONN = db_utils.SqliteConn('cluster_jobs', constants.job_db_path, _TABLE,
+                            migrations=_MIGRATIONS)
+
+
 def _db() -> sqlite3.Connection:
-    path = constants.job_db_path()
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    conn = sqlite3.connect(path, timeout=30)
-    conn.row_factory = sqlite3.Row
-    conn.executescript(_TABLE)
-    return conn
+    return _CONN.get()
 
 
 # ------------------------------------------------------------------- CRUD
 
 
 def add_job(job_name: str, username: str, run_timestamp: str,
-            resources_str: str, script_path: str, log_dir: str) -> int:
-    """Insert INIT job; returns job_id (parity: add_job:311)."""
+            resources_str: str, script_path: str, log_dir: str,
+            trace_id: Optional[str] = None,
+            span_id: Optional[str] = None) -> int:
+    """Insert INIT job; returns job_id (parity: add_job:311).
+
+    ``trace_id``/``span_id`` link the row to the submitter's
+    flight-recorder trace; the job runner is spawned with them in env so
+    on-cluster journal events join the submit-side trace.
+    """
     with _db() as conn:
         cur = conn.execute(
             'INSERT INTO jobs (job_name, username, submitted_at, status, '
-            'run_timestamp, resources, script_path, log_dir) '
-            'VALUES (?,?,?,?,?,?,?,?)',
+            'run_timestamp, resources, script_path, log_dir, trace_id, '
+            'span_id) VALUES (?,?,?,?,?,?,?,?,?,?)',
             (job_name, username, time.time(), JobStatus.INIT.value,
-             run_timestamp, resources_str, script_path, log_dir))
+             run_timestamp, resources_str, script_path, log_dir,
+             trace_id, span_id))
         return cur.lastrowid
 
 
@@ -212,6 +231,14 @@ def queue_job(job_id: int) -> None:
 def _spawn_job_runner(job: Dict[str, Any]) -> None:
     env = constants.strip_accel_boot_env(dict(os.environ))
     env[constants.SKYLET_HOME_ENV] = constants.skylet_home()
+    # Attach the runner to the submitter's trace: the row is the source
+    # of truth (this spawn may come from a later skylet tick whose env
+    # carries no context).
+    from skypilot_tpu.observability import trace as trace_lib
+    if job.get('trace_id'):
+        env[trace_lib.TRACE_ID_ENV] = job['trace_id']
+    if job.get('span_id'):
+        env[trace_lib.SPAN_ID_ENV] = job['span_id']
     # The runner must resolve skypilot_tpu from the synced runtime dir.
     runtime = constants.runtime_dir()
     env['PYTHONPATH'] = runtime + (
@@ -305,9 +332,10 @@ class JobLibCodeGen:
     @classmethod
     def add_job(cls, job_name: Optional[str], username: str,
                 run_timestamp: str, resources_str: str, script_path: str,
-                log_dir: str) -> str:
+                log_dir: str, trace_id: Optional[str] = None,
+                span_id: Optional[str] = None) -> str:
         args = json.dumps([job_name, username, run_timestamp, resources_str,
-                           script_path, log_dir])
+                           script_path, log_dir, trace_id, span_id])
         return cls._wrap(
             f'import json; a = json.loads({args!r}); '
             'job_id = job_lib.add_job(*a); '

@@ -5,10 +5,18 @@ The analogue of the reference's generated Ray driver program
 lifecycle on the cluster — status transitions, running the task script
 (which for multi-host slices fans out via ``gang_run``), and recording the
 final state. Runs detached from skylet/SSH sessions.
+
+Journals ``skylet.job_start``/``skylet.job_end`` into the HOST's flight
+recorder, attached (via the job row → env) to the submitter's trace id,
+so a cross-host trace can be assembled by id even though each host keeps
+its own journal file.
 """
 import os
 import sys
+import time
 
+from skypilot_tpu.observability import journal
+from skypilot_tpu.observability import trace
 from skypilot_tpu.skylet import job_lib
 from skypilot_tpu.skylet import log_lib
 
@@ -19,22 +27,33 @@ def main() -> int:
     if job is None:
         print(f'job {job_id} not found', file=sys.stderr)
         return 1
+    trace.attach(job.get('trace_id'), job.get('span_id'))
     script_path = os.path.expanduser(job['script_path'])
     log_dir = os.path.expanduser(job['log_dir'])
     os.makedirs(log_dir, exist_ok=True)
     run_log = os.path.join(log_dir, 'run.log')
 
+    entity = f'skylet_job:{job_id}'
     job_lib.set_status(job_id, job_lib.JobStatus.RUNNING)
+    journal.event(journal.EventKind.SKYLET_JOB_START, entity,
+                  {'job_name': job.get('job_name')})
+    t0 = time.time()
+    env_vars = {'SKYTPU_JOB_ID': str(job_id)}
+    # The task inherits the trace too, so user code (or nested skytpu
+    # calls) can journal into the same trace.
+    env_vars.update(trace.context_env())
     try:
         returncode = log_lib.run_with_log(['/bin/bash', script_path],
                                           run_log,
                                           stream_logs=False,
-                                          env_vars={'SKYTPU_JOB_ID':
-                                                    str(job_id)})
+                                          env_vars=env_vars)
     except Exception as e:  # pylint: disable=broad-except
         with open(run_log, 'a', encoding='utf-8') as f:
             f.write(f'\njob_runner error: {e}\n')
         job_lib.set_status(job_id, job_lib.JobStatus.FAILED)
+        journal.event(journal.EventKind.SKYLET_JOB_END, entity,
+                      {'status': 'FAILED', 'error': str(e),
+                       'seconds': round(time.time() - t0, 3)})
         return 1
     if returncode == 0:
         job_lib.set_status(job_id, job_lib.JobStatus.SUCCEEDED)
@@ -43,6 +62,10 @@ def main() -> int:
             f.write(f'\nJob {job_id} failed with return code '
                     f'{returncode}.\n')
         job_lib.set_status(job_id, job_lib.JobStatus.FAILED)
+    journal.event(journal.EventKind.SKYLET_JOB_END, entity,
+                  {'status': 'SUCCEEDED' if returncode == 0 else 'FAILED',
+                   'returncode': returncode,
+                   'seconds': round(time.time() - t0, 3)})
     # Pull the next pending job, keeping the queue moving.
     job_lib.schedule_step()
     return returncode

@@ -194,16 +194,24 @@ def expand_ports(ports) -> list:
     """Expand a declared ``ports:`` list ('8080', 8080, '9000-9010')
     into sorted ints. ONE shared implementation — the same expansion
     previously lived per-call-site, with validation drifting between
-    copies. Raises ValueError on malformed or reversed ranges."""
+    copies. Raises ValueError on malformed/reversed ranges and ports
+    outside 1-65535 (these feed the ws-proxy allowlist and k8s Services,
+    where a bad port only surfaces later as an opaque apiserver error)."""
+
+    def _check(port: int) -> int:
+        if not 1 <= port <= 65535:
+            raise ValueError(f'Invalid port {port}: must be 1-65535.')
+        return port
+
     out = set()
     for p in ports or []:
         s = str(p)
         if '-' in s:
             lo_s, _, hi_s = s.partition('-')
-            lo, hi = int(lo_s), int(hi_s)
+            lo, hi = _check(int(lo_s)), _check(int(hi_s))
             if hi < lo:
                 raise ValueError(f'Invalid port range {s!r}: end < start.')
             out.update(range(lo, hi + 1))
         else:
-            out.add(int(s))
+            out.add(_check(int(s)))
     return sorted(out)

@@ -85,33 +85,42 @@ class PortForward:
         assert self._proc.stdout is not None
         out_fd = self._proc.stdout.fileno()
         buf = ''
-        while time.time() < deadline:
-            # select-gate the read: a bare readline() blocks forever on
-            # a kubectl that connected but never prints (hung
-            # apiserver), defeating ready_timeout entirely.
-            readable, _, _ = select.select([out_fd], [], [],
-                                           min(1.0, deadline - time.time()))
-            if not readable:
-                continue
-            chunk = os.read(out_fd, 4096).decode(errors='replace')
-            if not chunk:
-                rc = self._proc.poll()
-                self.close()
-                raise ConnectionError(
-                    f'kubectl port-forward to {self.pod_name}:'
-                    f'{self.remote_port} exited rc={rc} before becoming '
-                    'ready')
-            buf += chunk
-            if _FORWARD_READY_PREFIX in buf and '->' in buf.split(
-                    _FORWARD_READY_PREFIX, 1)[1]:
-                # "Forwarding from 127.0.0.1:40123 -> 22" (the '->'
-                # guard: a chunk boundary can split the port digits).
-                after = buf.split(_FORWARD_READY_PREFIX, 1)[1]
-                self.local_port = int(after.split('->')[0].strip())
-                # Drain further kubectl chatter so its pipe never blocks.
-                t = threading.Thread(target=self._drain, daemon=True)
-                t.start()
-                return self
+        # close() on ANY exit but success: the deadline can expire
+        # between the while-check and the select (making the timeout
+        # negative — clamped below), and any raise in this loop must not
+        # leak the spawned kubectl child.
+        try:
+            while time.time() < deadline:
+                # select-gate the read: a bare readline() blocks forever
+                # on a kubectl that connected but never prints (hung
+                # apiserver), defeating ready_timeout entirely.
+                readable, _, _ = select.select(
+                    [out_fd], [], [],
+                    max(0.0, min(1.0, deadline - time.time())))
+                if not readable:
+                    continue
+                chunk = os.read(out_fd, 4096).decode(errors='replace')
+                if not chunk:
+                    rc = self._proc.poll()
+                    raise ConnectionError(
+                        f'kubectl port-forward to {self.pod_name}:'
+                        f'{self.remote_port} exited rc={rc} before '
+                        'becoming ready')
+                buf += chunk
+                if _FORWARD_READY_PREFIX in buf and '->' in buf.split(
+                        _FORWARD_READY_PREFIX, 1)[1]:
+                    # "Forwarding from 127.0.0.1:40123 -> 22" (the '->'
+                    # guard: a chunk boundary can split the port digits).
+                    after = buf.split(_FORWARD_READY_PREFIX, 1)[1]
+                    self.local_port = int(after.split('->')[0].strip())
+                    # Drain further kubectl chatter so its pipe never
+                    # blocks.
+                    t = threading.Thread(target=self._drain, daemon=True)
+                    t.start()
+                    return self
+        except BaseException:
+            self.close()
+            raise
         self.close()
         raise TimeoutError(
             f'kubectl port-forward to {self.pod_name}:{self.remote_port} '

@@ -1,0 +1,334 @@
+"""Flight recorder: journal write/query/rotation, trace propagation,
+goodput math, and the `skytpu events` / `skytpu trace` CLI rendering.
+
+Tier-1, CPU-only, no clusters. The e2e managed-job trace (launch →
+failover → recovery → RUNNING) lives in tests/test_flight_recorder.py.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from skypilot_tpu.observability import goodput
+from skypilot_tpu.observability import journal
+from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import trace
+
+pytestmark = pytest.mark.metrics
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    prev = metrics.set_registry(metrics.MetricsRegistry())
+    yield
+    metrics.set_registry(prev)
+
+
+@pytest.fixture(autouse=True)
+def fresh_trace_context():
+    """Contextvars persist across tests in one thread; reset them."""
+    t = trace._trace_id.set(None)  # pylint: disable=protected-access
+    s = trace._span_id.set(None)  # pylint: disable=protected-access
+    p = trace._parent_span_id.set(None)  # pylint: disable=protected-access
+    yield
+    trace._trace_id.reset(t)  # pylint: disable=protected-access
+    trace._span_id.reset(s)  # pylint: disable=protected-access
+    trace._parent_span_id.reset(p)  # pylint: disable=protected-access
+
+
+# -------------------------------------------------------------- journal
+
+
+def test_event_write_and_query_roundtrip():
+    journal.event(journal.EventKind.PROVISION_ATTEMPT, 'cluster:c1',
+                  {'cloud': 'gcp', 'zone': 'us-central2-b'})
+    journal.event(journal.EventKind.PROVISION_FAILOVER, 'cluster:c1',
+                  {'kind': 'zone'})
+    journal.event(journal.EventKind.JOB_PHASE, 'job:1',
+                  {'status': 'RUNNING'})
+    rows = journal.query(ascending=True)
+    assert [r['kind'] for r in rows] == [
+        'provision.attempt', 'provision.failover', 'job.phase']
+    assert rows[0]['payload'] == {'cloud': 'gcp', 'zone': 'us-central2-b'}
+    # Filters: by entity, by kind, newest-first default.
+    assert [r['kind'] for r in journal.query(entity='cluster:c1')] == [
+        'provision.failover', 'provision.attempt']
+    assert len(journal.query(
+        kinds=[journal.EventKind.JOB_PHASE])) == 1
+    assert len(journal.query(entity_prefix='cluster:')) == 2
+
+
+def test_event_kind_must_be_registered():
+    with pytest.raises(ValueError):
+        journal.event('made.up_kind', 'cluster:x')
+    # String form of a registered kind is accepted.
+    journal.event('launch.start', 'cluster:x')
+    assert journal.query()[0]['kind'] == 'launch.start'
+
+
+def test_event_disabled_by_env(monkeypatch):
+    monkeypatch.setenv(journal.DISABLE_ENV, '1')
+    journal.event(journal.EventKind.LAUNCH_START, 'cluster:x')
+    monkeypatch.delenv(journal.DISABLE_ENV)
+    assert journal.query() == []
+
+
+def test_journal_rotation_caps_row_count(monkeypatch):
+    monkeypatch.setenv(journal.MAX_EVENTS_ENV, '50')
+    for i in range(130):
+        journal.event(journal.EventKind.PROVISION_ATTEMPT, 'cluster:c1',
+                      {'i': i})
+    rows = journal.query(limit=1000, ascending=True)
+    assert len(rows) <= 50
+    # The survivors are the NEWEST events.
+    assert rows[-1]['payload']['i'] == 129
+    assert rows[0]['payload']['i'] >= 80
+
+
+def test_journal_rotation_spares_job_phase_events(monkeypatch):
+    """job.phase rows feed the goodput integral: chatty span/provision
+    traffic must not evict a long-lived job's early phase history."""
+    monkeypatch.setenv(journal.MAX_EVENTS_ENV, '50')
+    journal.event(journal.EventKind.JOB_PHASE, 'job:1',
+                  {'status': 'PENDING'}, ts=1.0)
+    for i in range(200):
+        journal.event(journal.EventKind.PROVISION_ATTEMPT, 'cluster:c1',
+                      {'i': i})
+    phases = journal.query(kinds=[journal.EventKind.JOB_PHASE],
+                           limit=100)
+    assert len(phases) == 1  # survived 200 generic evictions
+    assert phases[0]['payload']['status'] == 'PENDING'
+
+
+# ---------------------------------------------------------------- trace
+
+
+def test_span_nesting_links_parent_ids():
+    with trace.span('outer', 'cluster:c1') as outer:
+        with trace.span('inner', 'cluster:c1') as inner:
+            journal.event(journal.EventKind.PROVISION_ATTEMPT,
+                          'cluster:c1')
+    assert inner.trace_id == outer.trace_id
+    assert inner.parent_span_id == outer.span_id
+    rows = journal.query(kinds=[journal.EventKind.PROVISION_ATTEMPT])
+    assert rows[0]['trace_id'] == outer.trace_id
+    assert rows[0]['span_id'] == inner.span_id
+    assert rows[0]['parent_span_id'] == outer.span_id
+    # Context restored after the spans exit.
+    assert trace.get_span_id() is None
+
+
+def test_span_records_error_on_end_event():
+    with pytest.raises(RuntimeError):
+        with trace.span('doomed', 'cluster:c1'):
+            raise RuntimeError('boom')
+    ends = journal.query(kinds=[journal.EventKind.SPAN_END])
+    assert 'RuntimeError: boom' in ends[0]['payload']['error']
+
+
+def test_trace_context_env_roundtrip_through_fake_ssh():
+    """The codegen-over-SSH propagation path: a command string prefixed
+    with the env assignments runs in a child shell (the fake SSH hop) and
+    journals an event that joins the SAME trace and span."""
+    with trace.span('launch', 'cluster:c1') as handle:
+        prefix = trace.shell_env_prefix()
+        assert f'{trace.TRACE_ID_ENV}={handle.trace_id}' in prefix
+        assert f'{trace.SPAN_ID_ENV}={handle.span_id}' in prefix
+        snippet = (
+            'import sys; sys.path.insert(0, sys.argv[1]); '
+            'from skypilot_tpu.observability import journal; '
+            "journal.event(journal.EventKind.SKYLET_JOB_START, "
+            "'skylet_job:9')")
+        cmd = (f'{prefix}{sys.executable} -c "{snippet}" {REPO_ROOT}')
+        # env -i keeps the hop honest: ONLY the prefix carries the trace.
+        proc = subprocess.run(
+            ['/bin/bash', '-c', cmd],
+            env={'HOME': os.environ['HOME'], 'PATH': os.environ['PATH'],
+                 'JAX_PLATFORMS': 'cpu'},
+            capture_output=True, text=True, check=False, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+    rows = journal.query(kinds=[journal.EventKind.SKYLET_JOB_START])
+    assert rows and rows[0]['trace_id'] == handle.trace_id
+    assert rows[0]['span_id'] == handle.span_id
+
+
+def test_attach_adopts_persisted_trace():
+    tid = trace.new_trace_id()
+    trace.attach(tid)
+    assert trace.get_trace_id() == tid
+    journal.event(journal.EventKind.JOB_CREATED, 'job:5')
+    assert journal.query()[0]['trace_id'] == tid
+
+
+# -------------------------------------------------------------- goodput
+
+
+def _phase_event(job_id, status, ts):
+    journal.event(journal.EventKind.JOB_PHASE, f'job:{job_id}',
+                  {'task_id': 0, 'status': status}, ts=ts)
+
+
+def test_goodput_math_from_synthetic_sequence():
+    t0 = 1_000.0
+    # QUEUED 5s → PROVISIONING 10s → RUNNING 60s → RECOVERING 20s →
+    # RUNNING 40s → SUCCEEDED.
+    seq = [('PENDING', 0), ('STARTING', 5), ('RUNNING', 15),
+           ('RECOVERING', 75), ('RUNNING', 95), ('SUCCEEDED', 135)]
+    for status, offset in seq:
+        _phase_event(3, status, t0 + offset)
+    result = goodput.compute(3, now=t0 + 500)  # terminal: now is ignored
+    phases = result['phase_seconds']
+    assert phases['QUEUED'] == pytest.approx(5)
+    assert phases['PROVISIONING'] == pytest.approx(10)
+    assert phases['RECOVERING'] == pytest.approx(20)
+    assert phases['RUNNING'] == pytest.approx(100)
+    assert result['tracked_seconds'] == pytest.approx(135)
+    assert result['goodput_ratio'] == pytest.approx(100 / 135)
+
+
+def test_goodput_live_job_accrues_to_now():
+    t0 = 2_000.0
+    _phase_event(4, 'PENDING', t0)
+    _phase_event(4, 'RUNNING', t0 + 10)
+    result = goodput.compute(4, now=t0 + 110)
+    assert result['phase_seconds']['RUNNING'] == pytest.approx(100)
+    assert result['goodput_ratio'] == pytest.approx(100 / 110)
+
+
+def test_goodput_publish_sets_gauges():
+    t0 = 3_000.0
+    _phase_event(8, 'PENDING', t0)
+    _phase_event(8, 'RUNNING', t0 + 4)
+    _phase_event(8, 'SUCCEEDED', t0 + 20)
+    goodput.publish(8)
+    phase_g = metrics.get_registry().get('skytpu_job_phase_seconds_total')
+    assert phase_g.value(labels=('8', 'RUNNING')) == pytest.approx(16)
+    assert phase_g.value(labels=('8', 'QUEUED')) == pytest.approx(4)
+    ratio = metrics.get_registry().get('skytpu_job_goodput_ratio')
+    assert ratio.value(labels=('8',)) == pytest.approx(16 / 20)
+    # Re-publish converges (recompute, not accumulate).
+    goodput.publish(8)
+    assert phase_g.value(labels=('8', 'RUNNING')) == pytest.approx(16)
+
+
+def test_jobs_state_transitions_feed_goodput():
+    """The real choke point: jobs/state setters write job.phase events
+    the goodput integral reads, stamped with the job's stored trace."""
+    from skypilot_tpu.jobs import state as jobs_state
+    job_id = jobs_state.create_job('gp', 'x.yaml',
+                                   [{'name': 't', 'resources': ''}])
+    tid = jobs_state.get_job_trace_id(job_id)
+    assert tid
+    jobs_state.set_starting(job_id, 0)
+    jobs_state.set_started(job_id, 0, __import__('time').time())
+    jobs_state.set_recovering(job_id, 0, 'preempted')
+    jobs_state.set_recovered(job_id, 0, __import__('time').time())
+    jobs_state.set_succeeded(job_id, 0, __import__('time').time())
+    events = journal.query(kinds=[journal.EventKind.JOB_PHASE],
+                           entity=f'job:{job_id}', ascending=True)
+    assert [e['payload']['status'] for e in events] == [
+        'PENDING', 'STARTING', 'RUNNING', 'RECOVERING', 'RUNNING',
+        'SUCCEEDED']
+    assert all(e['trace_id'] == tid for e in events)
+    # Transition setters already published the gauges.
+    ratio = metrics.get_registry().get('skytpu_job_goodput_ratio')
+    assert ratio is not None
+    assert 0.0 <= ratio.value(labels=(str(job_id),)) <= 1.0
+
+
+# ------------------------------------------------------------ rendering
+
+
+def test_format_trace_renders_span_tree():
+    with trace.span('execution.launch', 'cluster:c9') as root:
+        journal.event(journal.EventKind.PROVISION_ATTEMPT, 'cluster:c9',
+                      {'zone': 'z1'})
+        with trace.span('jobs.recover', 'job:2'):
+            journal.event(journal.EventKind.RECOVERY_SWEEP, 'cluster:c9')
+    text = journal.format_trace(root.trace_id)
+    lines = text.splitlines()
+    assert root.trace_id in lines[0]
+    # Tree shape: recover nested (more indented) under launch.
+    launch_line = next(l for l in lines if 'execution.launch' in l)
+    recover_line = next(l for l in lines if 'jobs.recover' in l)
+    indent = lambda s: len(s) - len(s.lstrip())  # noqa: E731
+    assert indent(recover_line) > indent(launch_line)
+    assert 'provision.attempt' in text
+    assert 'recovery.sweep' in text
+
+
+def test_format_events_table():
+    journal.event(journal.EventKind.LAUNCH_START, 'cluster:c1',
+                  {'task': 'demo'})
+    rows = journal.query(ascending=True)
+    text = journal.format_events(rows)
+    assert 'launch.start' in text
+    assert 'cluster:c1' in text
+    assert 'task=demo' in text
+    assert journal.format_events([]) == 'No journal events.'
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def _cli():
+    from skypilot_tpu.client import cli as cli_mod
+    return cli_mod.cli
+
+
+def test_cli_events_and_trace_render(monkeypatch):
+    from click.testing import CliRunner
+    with trace.span('execution.launch', 'cluster:demo') as root:
+        journal.event(journal.EventKind.PROVISION_ATTEMPT, 'cluster:demo',
+                      {'zone': 'z1'})
+    journal.event(journal.EventKind.JOB_PHASE, 'job:11',
+                  {'status': 'RUNNING'})
+    runner = CliRunner()
+
+    out = runner.invoke(_cli(), ['events'])
+    assert out.exit_code == 0, out.output
+    assert 'provision.attempt' in out.output
+    assert 'job.phase' in out.output
+
+    out = runner.invoke(_cli(), ['events', '--job', '11'])
+    assert out.exit_code == 0, out.output
+    assert 'job.phase' in out.output
+    assert 'provision.attempt' not in out.output
+
+    out = runner.invoke(_cli(), ['events', '--cluster', 'demo',
+                                 '--kind', 'provision.attempt'])
+    assert out.exit_code == 0, out.output
+    assert 'provision.attempt' in out.output
+    assert 'job.phase' not in out.output
+
+    # Full id and the 8-char prefix `skytpu events` prints both work.
+    for ref in (root.trace_id, root.trace_id[:8]):
+        out = runner.invoke(_cli(), ['trace', ref])
+        assert out.exit_code == 0, out.output
+        assert 'execution.launch' in out.output
+        assert 'provision.attempt' in out.output
+
+
+def test_cli_events_rejects_bad_filters():
+    from click.testing import CliRunner
+    runner = CliRunner()
+    out = runner.invoke(_cli(), ['events', '--job', '1', '--cluster', 'c'])
+    assert out.exit_code != 0
+    out = runner.invoke(_cli(), ['events', '--kind', 'nope.nope'])
+    assert out.exit_code != 0
+    out = runner.invoke(_cli(), ['trace', 'deadbeef'])
+    assert out.exit_code != 0
+
+
+def test_dashboard_renders_journal_section():
+    journal.event(journal.EventKind.PROVISION_FAILOVER, 'cluster:dash',
+                  {'kind': 'zone'})
+    from skypilot_tpu.server import dashboard
+    html = dashboard.render()
+    assert 'Journal (last 30 events)' in html
+    assert 'provision.failover' in html
+    assert 'cluster:dash' in html

