@@ -151,6 +151,8 @@ def exec_cmd(entrypoint, cluster, detach_run):
 
 @cli.command()
 @click.option('--refresh', '-r', is_flag=True, default=False)
+@click.option('--verbose', '-v', is_flag=True, default=False,
+              help='Append a fleet telemetry snapshot per UP cluster.')
 @click.option('--endpoints', 'show_endpoints', is_flag=True,
               default=False,
               help='Show URLs of the cluster\'s declared ports.')
@@ -160,7 +162,8 @@ def exec_cmd(entrypoint, cluster, detach_run):
               default=False,
               help='Show framework pods across allowed k8s contexts.')
 @click.argument('clusters', nargs=-1)
-def status(refresh, show_endpoints, one_endpoint, show_k8s, clusters):
+def status(refresh, verbose, show_endpoints, one_endpoint, show_k8s,
+           clusters):
     """Show clusters (parity incl. `sky status --endpoints` and
     `sky status --kubernetes`)."""
     if show_k8s:
@@ -188,7 +191,8 @@ def status(refresh, show_endpoints, one_endpoint, show_k8s, clusters):
         for p, url in sorted(eps.items(), key=lambda kv: int(kv[0])):
             click.echo(f'{p}: {url}')
         return
-    records = sdk.get(sdk.status(list(clusters) or None, refresh=refresh))
+    records = sdk.get(sdk.status(list(clusters) or None, refresh=refresh,
+                                 verbose=verbose))
     if not records:
         click.echo('No existing clusters.')
         return
@@ -198,6 +202,14 @@ def status(refresh, show_endpoints, one_endpoint, show_k8s, clusters):
               if r['autostop'] >= 0 else '-')) for r in records]
     click.echo(_table(('NAME', 'RESOURCES', 'STATUS', 'AGE', 'AUTOSTOP'),
                       rows))
+    if verbose:
+        from skypilot_tpu.observability import fleet as fleet_lib
+        for r in records:
+            summary = r.get('fleet')
+            if not summary:
+                continue
+            click.echo(f"\n{r['name']}: "
+                       f'{fleet_lib.format_status_line(summary)}')
 
 
 @cli.command()
@@ -320,6 +332,54 @@ def metrics(endpoint):
             click.echo(resp.read().decode('utf-8'), nl=False)
     except (urllib.error.URLError, OSError, ValueError) as e:
         raise click.ClickException(f'Could not scrape {url}: {e}')
+
+
+@cli.command()
+@click.argument('cluster', required=False, default=None)
+@click.option('--watch', '-w', is_flag=True, default=False,
+              help='Refresh the table until interrupted.')
+@click.option('--interval', type=float, default=2.0,
+              help='Refresh interval for --watch (seconds).')
+@click.option('--window', type=float, default=120.0,
+              help='Trailing sample window to aggregate (seconds).')
+def top(cluster, watch, interval, window):
+    """Live per-node resource table for CLUSTER (default: all UP
+    clusters) — the fleet telemetry plane's `htop`.
+
+    Pulls each host's latest sample window (CPU, memory, disk,
+    accelerator HBM, skylet heartbeat) over the cluster's command
+    runners, with straggler/stale flags and mean/max/p95 rollups. Runs
+    client-side off the local cluster registry (like `skytpu events`):
+    a --watch loop refreshing through the API server would pay a
+    request roundtrip per frame for no added authority.
+    """
+    from skypilot_tpu import core
+    from skypilot_tpu.observability import fleet as fleet_lib
+
+    def _render() -> str:
+        summaries = core.fleet_status(cluster, window_seconds=window)
+        if not summaries:
+            return 'No existing clusters.'
+        blocks = []
+        for s in summaries:
+            if s.get('error'):
+                blocks.append(f"== {s['cluster']} ==\n  {s['error']}")
+            else:
+                blocks.append(fleet_lib.format_top(s))
+        return '\n\n'.join(blocks)
+
+    if not watch:
+        click.echo(_render())
+        return
+    try:
+        while True:
+            text = _render()
+            click.clear()
+            click.echo(time.strftime('%H:%M:%S'))
+            click.echo(text)
+            time.sleep(max(interval, 0.2))
+    except KeyboardInterrupt:
+        pass
 
 
 @cli.command()

@@ -186,9 +186,16 @@ def exec_(task: Union['task_lib.Task', 'dag_lib.Dag'],
 
 
 def status(cluster_names: Optional[List[str]] = None,
-           refresh: bool = False) -> str:
+           refresh: bool = False, verbose: bool = False) -> str:
     return _post('/status', {'cluster_names': cluster_names,
-                             'refresh': refresh})
+                             'refresh': refresh, 'verbose': verbose})
+
+
+def fleet(cluster_names: Optional[List[str]] = None,
+          window_seconds: float = 120.0) -> str:
+    """Fleet telemetry snapshots (per-node utilization windows)."""
+    return _post('/fleet', {'cluster_names': cluster_names,
+                            'window_seconds': window_seconds})
 
 
 def endpoints(cluster_name: str, port: Optional[int] = None) -> str:

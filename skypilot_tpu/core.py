@@ -32,6 +32,50 @@ def status(cluster_names: Optional[Union[str, List[str]]] = None,
                                      cluster_names=cluster_names)
 
 
+@usage_lib.entrypoint(name='fleet_status')
+def fleet_status(cluster_names: Optional[Union[str, List[str]]] = None,
+                 window_seconds: float = 120.0,
+                 timeout: float = 30.0) -> List[Dict[str, Any]]:
+    """Fleet telemetry snapshots: per-node resource windows pulled from
+    every host of each UP cluster, aggregated (mean/max/p95, straggler +
+    stale flags) and published as ``skytpu_node_*``/``skytpu_cluster_*``
+    gauges. Backs ``skytpu top``, ``skytpu status -v`` and the
+    dashboard's Fleet pane.
+    """
+    from skypilot_tpu.observability import fleet
+    if isinstance(cluster_names, str):
+        cluster_names = [cluster_names]
+    records = backend_utils.get_clusters(cluster_names=cluster_names)
+    if cluster_names:
+        missing = set(cluster_names) - {r['name'] for r in records}
+        if missing:
+            raise exceptions.ClusterDoesNotExist(
+                f'Cluster(s) {", ".join(sorted(missing))} do not exist.')
+    out = []
+    for record in records:
+        if record['status'] != global_state.ClusterStatus.UP:
+            out.append({'cluster': record['name'], 'ts': time.time(),
+                        'nodes': [], 'rollup': {}, 'stale_nodes': [],
+                        'stragglers': [],
+                        'error': f"cluster is {record['status'].value}"})
+            continue
+        handle = record['handle']
+        try:
+            runners = handle.get_command_runners()
+            out.append(fleet.collect_cluster(
+                record['name'], runners, window_seconds=window_seconds,
+                timeout=timeout))
+        except Exception as e:  # pylint: disable=broad-except
+            # One unreachable cluster must not hide the rest of the
+            # fleet from `skytpu top`.
+            logger.debug(f"fleet_status({record['name']}): {e}")
+            out.append({'cluster': record['name'], 'ts': time.time(),
+                        'nodes': [], 'rollup': {}, 'stale_nodes': [],
+                        'stragglers': [],
+                        'error': f'{type(e).__name__}: {e}'})
+    return out
+
+
 def kubernetes_status() -> List[Dict[str, Any]]:
     """Framework pods across every allowed Kubernetes context (parity:
     `sky status --kubernetes` / _status_kubernetes): the cloud-side

@@ -5,15 +5,27 @@ autoscaler's signals, proxy traffic counters, and runtime telemetry are
 scrapeable. ``http.server.ThreadingHTTPServer`` on a daemon thread — no
 third-party dependency, and a wedged scrape can never block the process
 it is observing.
+
+``/healthz`` reports **staleness**, not a bare 200: the body carries
+``staleness_seconds`` (time since the observed process last showed signs
+of life — the registry's most recent metric write, or an explicit
+``heartbeat_fn`` such as the skylet's tick clock) and the status flips
+to 503 once that exceeds ``max_staleness_seconds`` /
+``SKYTPU_HEALTHZ_MAX_STALENESS_SECONDS``. A process whose HTTP thread
+survives while its main loop is wedged therefore LOOKS unhealthy to load
+balancers and tests, which is the point. Without a configured bound the
+endpoint stays 200 (but still reports the number).
 """
 import http.server
 import os
 import threading
-from typing import Optional
+import time
+from typing import Callable, Optional
 
 from skypilot_tpu.observability import metrics as metrics_lib
 
 METRICS_HOST_ENV = 'SKYTPU_METRICS_HOST'
+HEALTHZ_MAX_STALENESS_ENV = 'SKYTPU_HEALTHZ_MAX_STALENESS_SECONDS'
 
 
 class MetricsExporter:
@@ -24,15 +36,30 @@ class MetricsExporter:
     replica topology, and failure breakdowns, which must not leak from a
     public VM IP. Set ``SKYTPU_METRICS_HOST=0.0.0.0`` (or pass ``host``)
     to expose to a real scraper network deliberately.
+
+    ``heartbeat_fn`` (→ unix timestamp of last liveness) overrides the
+    default registry-write signal for /healthz; ``max_staleness_seconds``
+    (or the env) turns staleness into a 503.
     """
 
     def __init__(self, port: int = 0, host: Optional[str] = None,
-                 registry: Optional[metrics_lib.MetricsRegistry] = None):
+                 registry: Optional[metrics_lib.MetricsRegistry] = None,
+                 heartbeat_fn: Optional[Callable[[], float]] = None,
+                 max_staleness_seconds: Optional[float] = None):
         self._requested_port = port
         self._host = host or os.environ.get(METRICS_HOST_ENV, '127.0.0.1')
         # Resolved lazily so an exporter constructed before a test swaps
         # the global registry still serves the active one.
         self._registry = registry
+        self._heartbeat_fn = heartbeat_fn
+        if max_staleness_seconds is None:
+            env = os.environ.get(HEALTHZ_MAX_STALENESS_ENV)
+            try:
+                max_staleness_seconds = float(env) if env else None
+            except ValueError:
+                max_staleness_seconds = None
+        self._max_staleness = max_staleness_seconds
+        self._started_at: Optional[float] = None
         self._server: Optional[http.server.ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -44,6 +71,32 @@ class MetricsExporter:
     def url(self, path: str = '/metrics') -> str:
         host = '127.0.0.1' if self._host == '0.0.0.0' else self._host
         return f'http://{host}:{self.port}{path}'
+
+    def staleness_seconds(self) -> float:
+        """Seconds since the observed process last showed life.
+
+        With a ``heartbeat_fn`` that signal is authoritative; otherwise
+        the registry's last metric write counts, floored by exporter
+        start so a freshly started quiet process reads as healthy.
+        """
+        now = time.time()
+        if self._heartbeat_fn is not None:
+            try:
+                beat = float(self._heartbeat_fn() or 0.0)
+            except Exception:  # pylint: disable=broad-except
+                beat = 0.0
+            if beat <= 0.0:
+                # No beat YET (heartbeat file absent / fn failing at
+                # startup): grace-floor at exporter start so the first
+                # seconds of life don't read as epoch-scale stale. An
+                # old-but-present beat is NOT floored — a wedged main
+                # loop must look stale even right after a restart.
+                beat = self._started_at or 0.0
+        else:
+            registry = self._registry or metrics_lib.get_registry()
+            beat = max(getattr(registry, 'last_write_ts', 0.0),
+                       self._started_at or 0.0)
+        return max(0.0, now - beat)
 
     def start(self) -> int:
         outer = self
@@ -58,7 +111,14 @@ class MetricsExporter:
                     self._reply(200, payload,
                                 metrics_lib.CONTENT_TYPE_LATEST)
                 elif self.path.split('?', 1)[0] == '/healthz':
-                    self._reply(200, b'ok\n', 'text/plain; charset=utf-8')
+                    staleness = outer.staleness_seconds()
+                    stale = (outer._max_staleness is not None and
+                             staleness > outer._max_staleness)
+                    body = (f'{"stale" if stale else "ok"} '
+                            f'staleness_seconds={staleness:.3f}\n')
+                    self._reply(503 if stale else 200,
+                                body.encode('utf-8'),
+                                'text/plain; charset=utf-8')
                 else:
                     self.send_error(404)
 
@@ -75,6 +135,7 @@ class MetricsExporter:
 
         self._server = http.server.ThreadingHTTPServer(
             (self._host, self._requested_port), Handler)
+        self._started_at = time.time()
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True,
                                         name='skytpu-metrics-exporter')

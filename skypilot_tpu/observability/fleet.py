@@ -1,0 +1,447 @@
+"""Head-side fleet aggregator: pull node windows, roll up, flag, publish.
+
+The consumer half of the fleet telemetry plane. Each host keeps its own
+``observability/timeseries`` ring buffer; this module pulls the latest
+window from every host of a cluster over the ordinary command-runner
+path (the same codegen-over-SSH idiom as job submit), computes
+per-cluster rollups (mean / max / p95 per resource), flags **stale**
+nodes (no fresh sample / dead skylet heartbeat) and **stragglers**
+(utilization deviating from the slice mean by more than a configurable
+threshold — the per-host-trace methodology of MLPerf-scale TPU pod
+studies), publishes ``skytpu_node_*{cluster,node}`` and
+``skytpu_cluster_*{cluster,stat}`` gauges through the process registry,
+and journals ``node.stale`` / ``node.straggler`` events.
+
+Consumers: ``skytpu top`` / ``skytpu status -v`` / the dashboard's
+Fleet pane (via ``core.fleet_status``), the utilization-aware
+``AutostopEvent`` (via :func:`local_cluster_snapshot`, running ON the
+head), and optionally the serve autoscaler's utilization blend.
+"""
+import json
+import os
+import shlex
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import journal
+from skypilot_tpu.observability import metrics
+from skypilot_tpu.skylet import constants
+from skypilot_tpu.utils import subprocess_utils
+
+logger = sky_logging.init_logger(__name__)
+
+STRAGGLER_THRESHOLD_ENV = 'SKYTPU_STRAGGLER_THRESHOLD'
+DEFAULT_STRAGGLER_THRESHOLD = 0.25  # |node − slice mean| in util points
+STALE_SECONDS_ENV = 'SKYTPU_NODE_STALE_SECONDS'
+DEFAULT_STALE_SECONDS = 120.0
+DEFAULT_WINDOW_SECONDS = 120.0
+
+_STATS_MARKER = '__NODE_STATS__'
+
+# The resources rolled up per cluster and shown per node. Keys are the
+# timeseries metric names; all are 0..1 utilizations.
+UTIL_METRICS = ('cpu_util', 'mem_util', 'disk_util', 'accel_mem_util')
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    try:
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
+class FleetCodeGen:
+    """Snippet run on each host to print its telemetry snapshot (the
+    worker-pull "RPC", same idiom as ``job_lib.JobLibCodeGen``)."""
+
+    _PRELUDE = (
+        'import sys; '
+        'sys.path.insert(0, __import__("os").path.expanduser('
+        '"~/.skytpu/runtime")); '
+        'from skypilot_tpu.observability import timeseries; ')
+
+    @classmethod
+    def node_snapshot(cls, window_seconds: float) -> str:
+        body = (
+            'import json; '
+            f'snap = timeseries.node_snapshot({float(window_seconds)}); '
+            f'print({_STATS_MARKER!r} + json.dumps(snap), flush=True)')
+        return (f'{constants.accel_strip_shell_prefix()}'
+                f'python3 -u -c {shlex.quote(cls._PRELUDE + body)}')
+
+
+def parse_snapshot(output: str) -> Optional[Dict[str, Any]]:
+    for line in output.splitlines():
+        if line.startswith(_STATS_MARKER):
+            try:
+                return json.loads(line[len(_STATS_MARKER):])
+            except ValueError:
+                return None
+    return None
+
+
+def collect(runners: Sequence[Any],
+            window_seconds: float = DEFAULT_WINDOW_SECONDS,
+            timeout: float = 30.0) -> List[Optional[Dict[str, Any]]]:
+    """Pull one snapshot per runner (parallel); unreachable hosts yield
+    None — a node that cannot answer is exactly what the stale flag is
+    for, so collection never raises for one bad host."""
+    cmd = FleetCodeGen.node_snapshot(window_seconds)
+
+    def _pull(runner) -> Optional[Dict[str, Any]]:
+        try:
+            rc, out, _ = runner.run(cmd, require_outputs=True,
+                                    timeout=timeout)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'fleet pull {runner.node_id}: {e}')
+            return None
+        if rc != 0:
+            return None
+        return parse_snapshot(out)
+
+    return list(subprocess_utils.run_in_parallel(_pull, list(runners)))
+
+
+def percentile(values: Sequence[float], q: float) -> float:
+    """Linear-interpolation percentile (q in [0, 100])."""
+    vs = sorted(float(v) for v in values)
+    if not vs:
+        return 0.0
+    if len(vs) == 1:
+        return vs[0]
+    pos = (len(vs) - 1) * q / 100.0
+    lo = int(pos)
+    hi = min(lo + 1, len(vs) - 1)
+    return vs[lo] + (vs[hi] - vs[lo]) * (pos - lo)
+
+
+def aggregate(cluster_name: str,
+              node_names: Sequence[str],
+              snapshots: Sequence[Optional[Dict[str, Any]]],
+              straggler_threshold: Optional[float] = None,
+              stale_after: Optional[float] = None,
+              now: Optional[float] = None) -> Dict[str, Any]:
+    """Pure rollup over per-node snapshots → the fleet summary dict.
+
+    Straggler rule: with ≥ 2 reporting nodes, a node whose window-mean
+    utilization differs from the slice mean by more than the threshold
+    (absolute, in utilization points) on CPU or accelerator memory is
+    flagged — a TPU gang runs in lockstep, so one under- (or over-)
+    utilized host is the canonical symptom of a wedged rank.
+    """
+    now = time.time() if now is None else now
+    if straggler_threshold is None:
+        straggler_threshold = _env_float(STRAGGLER_THRESHOLD_ENV,
+                                         DEFAULT_STRAGGLER_THRESHOLD)
+    if stale_after is None:
+        stale_after = _env_float(STALE_SECONDS_ENV, DEFAULT_STALE_SECONDS)
+
+    nodes: List[Dict[str, Any]] = []
+    for name, snap in zip(node_names, snapshots):
+        node: Dict[str, Any] = {'node': name, 'stale': False,
+                                'straggler': False}
+        if snap is None:
+            node.update(unreachable=True, stale=True, sample_age=None,
+                        skylet_tick_age=None)
+            nodes.append(node)
+            continue
+        node['unreachable'] = False
+        mean = snap.get('mean') or {}
+        mx = snap.get('max') or {}
+        last = snap.get('last') or {}
+        for key in UTIL_METRICS + ('load1', 'cpu_cores_used'):
+            if key in mean:
+                node[key] = mean[key]
+                node[key + '_max'] = mx.get(key, mean[key])
+            if key in last:
+                node[key + '_last'] = last[key]
+        node['sample_age'] = snap.get('sample_age')
+        node['skylet_tick_age'] = snap.get('skylet_tick_age')
+        ages = [a for a in (node['sample_age'], node['skylet_tick_age'])
+                if a is not None]
+        node['stale'] = (not ages) or max(ages) > stale_after
+        nodes.append(node)
+
+    live = [n for n in nodes if not n['stale']]
+    rollup: Dict[str, Dict[str, float]] = {}
+    for key in UTIL_METRICS:
+        vals = [n[key] for n in live if key in n]
+        if not vals:
+            continue
+        rollup[key] = {
+            'mean': sum(vals) / len(vals),
+            'max': max(vals),
+            'p95': percentile(vals, 95.0),
+        }
+    # Straggler detection against the slice mean.
+    if len(live) >= 2:
+        for key in ('cpu_util', 'accel_mem_util'):
+            stats = rollup.get(key)
+            if stats is None:
+                continue
+            for n in live:
+                if key in n and abs(n[key] - stats['mean']) > \
+                        straggler_threshold:
+                    n['straggler'] = True
+                    n.setdefault('straggler_reason', []).append(
+                        f'{key}={n[key]:.2f} vs mean '
+                        f'{stats["mean"]:.2f}')
+    return {
+        'cluster': cluster_name,
+        'ts': now,
+        'nodes': nodes,
+        'rollup': rollup,
+        'stale_nodes': [n['node'] for n in nodes if n['stale']],
+        'stragglers': [n['node'] for n in nodes if n['straggler']],
+    }
+
+
+# Last journaled (stale, straggler) flags per (cluster, node): the
+# journal records *transitions* into a flagged state, not every
+# observation — publish() runs on every read path (`skytpu top --watch`,
+# the dashboard's auto-refresh, status -v), and one persistently stale
+# node journaled per refresh would evict the flight-recorder history
+# the bounded journal exists to keep. Gauges carry the steady state.
+_journaled_flags: Dict[Any, Any] = {}
+
+
+def publish(summary: Dict[str, Any]) -> None:
+    """Gauges + journal events for one fleet summary.
+
+    Node gauges carry ``{cluster, node}``; cluster rollups carry
+    ``{cluster, stat}`` with stat ∈ mean/max/p95 — both label sets are
+    bounded by fleet size.
+    """
+    cluster = summary['cluster']
+    for node in summary['nodes']:
+        labels = (cluster, node['node'])
+        if 'cpu_util' in node:
+            metrics.gauge('skytpu_node_cpu_util',
+                          'Per-node CPU utilization (window mean).',
+                          labels=('cluster', 'node')).set(
+                              node['cpu_util'], labels=labels)
+        if 'mem_util' in node:
+            metrics.gauge('skytpu_node_mem_util',
+                          'Per-node memory utilization.',
+                          labels=('cluster', 'node')).set(
+                              node['mem_util'], labels=labels)
+        if 'disk_util' in node:
+            metrics.gauge('skytpu_node_disk_util',
+                          'Per-node disk utilization of the skylet home '
+                          'filesystem.',
+                          labels=('cluster', 'node')).set(
+                              node['disk_util'], labels=labels)
+        if 'accel_mem_util' in node:
+            metrics.gauge('skytpu_node_accel_mem_util',
+                          'Per-node accelerator (HBM) memory '
+                          'utilization.',
+                          labels=('cluster', 'node')).set(
+                              node['accel_mem_util'], labels=labels)
+        if node.get('skylet_tick_age') is not None:
+            metrics.gauge('skytpu_skylet_tick_age_seconds',
+                          'Seconds since the node\'s skylet completed a '
+                          'tick loop (heartbeat age; a dead skylet '
+                          'grows without bound).',
+                          labels=('cluster', 'node')).set(
+                              node['skylet_tick_age'], labels=labels)
+        metrics.gauge('skytpu_node_stale',
+                      '1 when the node has no fresh sample or skylet '
+                      'heartbeat.',
+                      labels=('cluster', 'node')).set(
+                          1.0 if node['stale'] else 0.0, labels=labels)
+        prev_stale, prev_strag = _journaled_flags.get(
+            (cluster, node['node']), (False, False))
+        if node['stale'] and not prev_stale:
+            journal.event(journal.EventKind.NODE_STALE,
+                          f'cluster:{cluster}',
+                          {'node': node['node'],
+                           'sample_age': node.get('sample_age'),
+                           'skylet_tick_age': node.get(
+                               'skylet_tick_age'),
+                           'unreachable': node.get('unreachable')})
+        if node['straggler'] and not prev_strag:
+            journal.event(journal.EventKind.NODE_STRAGGLER,
+                          f'cluster:{cluster}',
+                          {'node': node['node'],
+                           'reason': '; '.join(
+                               node.get('straggler_reason', []))})
+        _journaled_flags[(cluster, node['node'])] = (node['stale'],
+                                                    node['straggler'])
+    stat_labels = ('cluster', 'stat')
+    # Literal names so the tier-1 metric-name lint sees each family.
+    gauges = {
+        'cpu_util': metrics.gauge(
+            'skytpu_cluster_cpu_util',
+            'Cluster CPU utilization rollup.', labels=stat_labels),
+        'mem_util': metrics.gauge(
+            'skytpu_cluster_mem_util',
+            'Cluster memory utilization rollup.', labels=stat_labels),
+        'disk_util': metrics.gauge(
+            'skytpu_cluster_disk_util',
+            'Cluster disk utilization rollup.', labels=stat_labels),
+        'accel_mem_util': metrics.gauge(
+            'skytpu_cluster_accel_mem_util',
+            'Cluster accelerator (HBM) memory rollup.',
+            labels=stat_labels),
+    }
+    for key, stats in summary['rollup'].items():
+        g = gauges.get(key)
+        if g is None:
+            continue
+        for stat, value in stats.items():
+            g.set(value, labels=(summary['cluster'], stat))
+
+
+def collect_cluster(cluster_name: str, runners: Sequence[Any],
+                    window_seconds: float = DEFAULT_WINDOW_SECONDS,
+                    timeout: float = 30.0) -> Dict[str, Any]:
+    """collect → aggregate → publish for one cluster's runners."""
+    snaps = collect(runners, window_seconds=window_seconds,
+                    timeout=timeout)
+    names = [getattr(r, 'node_id', f'rank-{i}')
+             for i, r in enumerate(runners)]
+    summary = aggregate(cluster_name, names, snaps)
+    publish(summary)
+    return summary
+
+
+# ----------------------------------------------- on-cluster (head) view
+
+
+def local_cluster_snapshot(window_seconds: float = 30.0,
+                           timeout: float = 15.0
+                           ) -> Optional[Dict[str, Any]]:
+    """Cluster utilization as seen FROM the head host (the autostop
+    consumer): this node's timeseries directly, plus a best-effort pull
+    of the other slice hosts from ``cluster_info.json``. Returns None
+    when telemetry is unavailable (no samples yet, no cluster info) —
+    callers must fall back to queue-only semantics, never block on it.
+    """
+    from skypilot_tpu.observability import timeseries
+    info_path = constants.cluster_info_path()
+    hosts: List[Dict[str, Any]] = []
+    cluster_name = ''
+    if os.path.exists(info_path):
+        try:
+            with open(info_path, encoding='utf-8') as f:
+                info = json.load(f)
+            hosts = info.get('hosts') or []
+            cluster_name = info.get('cluster_name') or ''
+        except (OSError, ValueError):
+            hosts = []
+    snaps: List[Optional[Dict[str, Any]]] = [
+        timeseries.node_snapshot(window_seconds)]
+    names = ['rank-0']
+    if len(hosts) > 1:
+        try:
+            from skypilot_tpu.provision import provisioner
+            workers = provisioner.runners_from_host_meta(hosts[1:])
+            snaps.extend(collect(workers, window_seconds=window_seconds,
+                                 timeout=timeout))
+            names.extend(getattr(r, 'node_id', f'rank-{i + 1}')
+                         for i, r in enumerate(workers))
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'fleet: worker pull failed: {e}')
+    if all(s is None or not s.get('samples') for s in snaps):
+        return None
+    return aggregate(cluster_name or 'local', names, snaps)
+
+
+def busiest_node(summary: Dict[str, Any],
+                 keys: Sequence[str] = ('cpu_util_max', 'cpu_util_last',
+                                        'cpu_util')
+                 ) -> Optional[Dict[str, Any]]:
+    """The node with the highest utilization by the first available of
+    ``keys`` per node — the autostop evidence."""
+    best = None
+    best_val = -1.0
+    for node in summary['nodes']:
+        val = next((node[k] for k in keys if node.get(k) is not None),
+                   None)
+        if val is not None and val > best_val:
+            best, best_val = node, val
+    return best
+
+
+# ------------------------------------------------------------- rendering
+
+
+def _fmt_pct(v: Optional[float]) -> str:
+    return f'{v * 100:5.1f}%' if v is not None else '-'
+
+
+def _fmt_age(v: Optional[float]) -> str:
+    if v is None:
+        return '-'
+    return f'{v:.0f}s' if v < 120 else f'{v / 60:.0f}m'
+
+
+def node_flags(node: Dict[str, Any]) -> str:
+    """``UNREACHABLE``/``STALE``/``STRAGGLER`` cell text for one node —
+    shared by `skytpu top` and the dashboard's fleet pane."""
+    flags = []
+    if node.get('unreachable'):
+        flags.append('UNREACHABLE')
+    elif node.get('stale'):
+        flags.append('STALE')
+    if node.get('straggler'):
+        flags.append('STRAGGLER')
+    return ','.join(flags) or '-'
+
+
+def format_top(summary: Dict[str, Any]) -> str:
+    """The ``skytpu top`` table: one row per node plus a rollup line."""
+    header = ('NODE', 'CPU', 'CPU(MAX)', 'MEM', 'DISK', 'ACCELMEM',
+              'LOAD1', 'TICK', 'FLAGS')
+    rows = []
+    for n in summary['nodes']:
+        rows.append((
+            n['node'],
+            _fmt_pct(n.get('cpu_util')),
+            _fmt_pct(n.get('cpu_util_max')),
+            _fmt_pct(n.get('mem_util')),
+            _fmt_pct(n.get('disk_util')),
+            _fmt_pct(n.get('accel_mem_util')),
+            f"{n['load1']:.2f}" if n.get('load1') is not None else '-',
+            _fmt_age(n.get('skylet_tick_age')),
+            node_flags(n),
+        ))
+    widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+              if rows else len(header[i]) for i in range(len(header))]
+    lines = [f"== {summary['cluster']} "
+             f"({len(summary['nodes'])} node(s)) =="]
+    lines.append('  '.join(h.ljust(widths[i])
+                           for i, h in enumerate(header)))
+    for r in rows:
+        lines.append('  '.join(c.ljust(widths[i])
+                               for i, c in enumerate(r)))
+    parts = []
+    for key, label in (('cpu_util', 'cpu'), ('mem_util', 'mem'),
+                       ('accel_mem_util', 'accelmem')):
+        stats = summary['rollup'].get(key)
+        if stats:
+            parts.append(f'{label} mean={stats["mean"] * 100:.1f}% '
+                         f'max={stats["max"] * 100:.1f}% '
+                         f'p95={stats["p95"] * 100:.1f}%')
+    if parts:
+        lines.append('rollup: ' + '  '.join(parts))
+    return '\n'.join(lines)
+
+
+def format_status_line(summary: Dict[str, Any]) -> str:
+    """One-line fleet digest for ``skytpu status -v``."""
+    cpu = summary['rollup'].get('cpu_util')
+    mem = summary['rollup'].get('mem_util')
+    bits = [f"{len(summary['nodes'])} node(s)"]
+    if cpu:
+        bits.append(f'cpu {cpu["mean"] * 100:.0f}%/'
+                    f'{cpu["max"] * 100:.0f}%max')
+    if mem:
+        bits.append(f'mem {mem["mean"] * 100:.0f}%')
+    if summary['stale_nodes']:
+        bits.append(f"stale: {','.join(summary['stale_nodes'])}")
+    if summary['stragglers']:
+        bits.append(f"stragglers: {','.join(summary['stragglers'])}")
+    return '  '.join(bits)

@@ -69,6 +69,10 @@ class EventKind(enum.Enum):
     SKYLET_JOB_START = 'skylet.job_start'
     SKYLET_JOB_END = 'skylet.job_end'
     SKYLET_AUTOSTOP = 'skylet.autostop'
+    SKYLET_EVENT_ERROR = 'skylet.event_error'
+    # Fleet telemetry (observability/fleet.py).
+    NODE_STALE = 'node.stale'
+    NODE_STRAGGLER = 'node.straggler'
     # Managed jobs (jobs/).
     JOB_CREATED = 'job.created'
     JOB_PHASE = 'job.phase'

@@ -40,6 +40,10 @@ DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 CONTENT_TYPE_LATEST = 'text/plain; version=0.0.4; charset=utf-8'
 
 
+def _noop_write() -> None:
+    pass
+
+
 def format_float(v: float) -> str:
     """Prometheus sample-value formatting ('+Inf', integers without
     trailing '.0')."""
@@ -92,6 +96,12 @@ class Metric:
         self.label_names = tuple(labels)
         self._lock = threading.Lock()
         self._children: Dict[Tuple[str, ...], object] = {}
+        # Wired to the owning registry's write stamp at registration;
+        # metrics constructed outside a registry keep the no-op.
+        self._on_write: 'callable' = _noop_write
+
+    def _note_write(self) -> None:
+        self._on_write()
 
     def _key(self, labels: Sequence[str]) -> Tuple[str, ...]:
         key = tuple(str(v) for v in labels)
@@ -130,6 +140,7 @@ class Counter(Metric):
         key = self._key(labels)
         with self._lock:
             self._children[key] = self._children.get(key, 0.0) + amount
+        self._note_write()
 
     def value(self, labels: Sequence[str] = ()) -> float:
         with self._lock:
@@ -151,11 +162,13 @@ class Gauge(Metric):
         key = self._key(labels)
         with self._lock:
             self._children[key] = float(value)
+        self._note_write()
 
     def inc(self, amount: float = 1.0, labels: Sequence[str] = ()) -> None:
         key = self._key(labels)
         with self._lock:
             self._children[key] = self._children.get(key, 0.0) + amount
+        self._note_write()
 
     def dec(self, amount: float = 1.0, labels: Sequence[str] = ()) -> None:
         self.inc(-amount, labels)
@@ -211,6 +224,7 @@ class Histogram(Metric):
                 child.bucket_counts[-1] += 1  # > largest bound → +Inf only
             child.total += value
             child.count += 1
+        self._note_write()
 
     def count(self, labels: Sequence[str] = ()) -> int:
         with self._lock:
@@ -242,11 +256,22 @@ class Histogram(Metric):
 
 
 class MetricsRegistry:
-    """Name → Metric map with get-or-create registration."""
+    """Name → Metric map with get-or-create registration.
+
+    ``last_write_ts`` is stamped on every metric mutation — the
+    exporter's ``/healthz`` uses it to report how stale this process's
+    telemetry is (a wedged process keeps serving but stops writing).
+    """
 
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: Dict[str, Metric] = {}
+        self.last_write_ts = 0.0
+
+    def _stamp_write(self) -> None:
+        # Plain float store: atomic under the GIL, and a heartbeat may
+        # be a hair late without consequence — no lock on the hot path.
+        self.last_write_ts = time.time()
 
     def _get_or_create(self, cls, name: str, help_text: str,
                        labels: Sequence[str], **kwargs) -> Metric:
@@ -264,6 +289,7 @@ class MetricsRegistry:
                         f'{existing.label_names}, not {labels}')
                 return existing
             metric = cls(name, help_text, labels, **kwargs)
+            metric._on_write = self._stamp_write  # pylint: disable=protected-access
             self._metrics[name] = metric
             return metric
 

@@ -1,0 +1,506 @@
+"""Per-node resource time series: sampler + size-bounded sqlite ring buffer.
+
+The machine-telemetry leg of the observability stack (events → journal,
+in-process numbers → metrics registry, **machines → here**). Each host
+samples itself from ``/proc`` (CPU, memory, disk, load) plus accelerator
+state (JAX ``device.memory_stats()`` when a non-CPU platform is
+configured; graceful omission on CPU-only nodes) into a local sqlite
+ring buffer, downsampled in place (raw → 1m → 10m rollups) so retention
+stays bounded like the journal. The head-side aggregator
+(``observability/fleet.py``) pulls each worker's latest window over the
+ordinary command-runner path.
+
+Design rules (mirroring ``observability/journal.py``):
+
+* **Best-effort writes** — a full disk must never kill the skylet tick
+  loop; sqlite/OS errors are swallowed by the sampler event.
+* **Bounded size** — every resolution is pruned to
+  ``SKYTPU_TIMESERIES_MAX_ROWS`` rows by rowid window (O(1) per insert).
+* **Local by design** — each host writes its own
+  ``<skylet home>/.skytpu/timeseries.db``; cross-host aggregation is a
+  pull, not a shared database.
+
+CPU semantics: on a real host, utilization comes from the machine-wide
+``/proc/stat`` jiffy deltas (one node == one host). On a Local-cloud
+"node" (a process tree sharing the machine with its siblings —
+``SKYTPU_NODE_DIR`` set), machine-wide counters would charge every node
+with every other node's work, so utilization is computed from the node's
+OWN process tree instead (the same ``/proc/*/environ`` membership scan
+teardown uses), normalized by the machine's core count. Either way the
+published number is "fraction of this node's cores in use".
+"""
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu.skylet import constants
+from skypilot_tpu.utils import db_utils
+
+MAX_ROWS_ENV = 'SKYTPU_TIMESERIES_MAX_ROWS'
+DEFAULT_MAX_ROWS = 4096
+PROC_ROOT_ENV = 'SKYTPU_PROC_ROOT'  # test override for /proc parsing
+
+# Resolution ladder: raw samples roll into 1-minute rows, 1-minute rows
+# into 10-minute rows. Completed buckets only — a bucket is aggregated
+# once its window has fully elapsed. Source rows outlive their rollup by
+# the retention below (the fleet pull reads trailing RAW windows), then
+# are dropped; the row cap is the hard bound either way.
+ROLLUPS: Tuple[Tuple[str, str, float], ...] = (
+    ('raw', '1m', 60.0),
+    ('1m', '10m', 600.0),
+)
+RESOLUTIONS = ('raw', '1m', '10m')
+RETENTION_SECONDS = {'raw': 600.0, '1m': 7200.0}
+
+_TABLE = """
+    CREATE TABLE IF NOT EXISTS samples (
+        sample_id INTEGER PRIMARY KEY AUTOINCREMENT,
+        ts REAL,
+        res TEXT,
+        n INTEGER DEFAULT 1,
+        metrics TEXT
+    );
+    CREATE INDEX IF NOT EXISTS idx_samples_res_ts ON samples(res, ts);
+    CREATE TABLE IF NOT EXISTS rollup_state (
+        res TEXT PRIMARY KEY,
+        through_ts REAL
+    );
+"""
+
+
+def db_path() -> str:
+    return os.path.join(constants.skytpu_dir(), 'timeseries.db')
+
+
+_CONN = db_utils.SqliteConn('timeseries', db_path, _TABLE)
+
+
+def _db() -> sqlite3.Connection:
+    return _CONN.get()
+
+
+def max_rows() -> int:
+    try:
+        return int(os.environ.get(MAX_ROWS_ENV, DEFAULT_MAX_ROWS))
+    except ValueError:
+        return DEFAULT_MAX_ROWS
+
+
+def _loads(blob: Optional[str]) -> Dict[str, float]:
+    try:
+        return json.loads(blob or '{}')
+    except ValueError:
+        return {}
+
+
+# ----------------------------------------------------------------- writes
+
+
+def record(metrics: Dict[str, float], ts: Optional[float] = None) -> None:
+    """Append one raw sample (numeric metrics only) and prune."""
+    ts = time.time() if ts is None else ts
+    clean = {k: float(v) for k, v in metrics.items()
+             if isinstance(v, (int, float))}
+    with _db() as conn:
+        conn.execute(
+            'INSERT INTO samples (ts, res, n, metrics) VALUES (?,?,1,?)',
+            (ts, 'raw', json.dumps(clean)))
+        _prune(conn, 'raw')
+
+
+def _prune(conn: sqlite3.Connection, res: str) -> None:
+    # Per-resolution cap (journal idiom, adapted): rowids are shared
+    # across resolutions, so the window is expressed as "delete this
+    # resolution's oldest overflow" — indexed on (res, ts), no full
+    # scans at the default cap.
+    cap = max_rows()
+    n = conn.execute('SELECT COUNT(*) AS c FROM samples WHERE res=?',
+                     (res,)).fetchone()['c']
+    if n > cap:
+        conn.execute(
+            'DELETE FROM samples WHERE sample_id IN ('
+            'SELECT sample_id FROM samples WHERE res=? '
+            'ORDER BY sample_id ASC LIMIT ?)', (res, n - cap))
+
+
+def _merge_window(rows: List[Tuple[int, Dict[str, float]]]
+                  ) -> Tuple[int, Dict[str, float]]:
+    """n-weighted mean per metric plus ``<metric>_max`` across
+    ``(n, metrics)`` pairs.
+
+    Rolled-up rows already carry ``_max`` keys; maxes merge by max, means
+    by weight, so a 10m row is exact over its 1m inputs.
+    """
+    total_n = 0
+    sums: Dict[str, float] = {}
+    maxes: Dict[str, float] = {}
+    counts: Dict[str, int] = {}
+    for n, m in rows:
+        n = int(n or 1)
+        total_n += n
+        for k, v in m.items():
+            if not isinstance(v, (int, float)):
+                continue
+            if k.endswith('_max'):
+                maxes[k] = max(maxes.get(k, v), v)
+            else:
+                sums[k] = sums.get(k, 0.0) + v * n
+                counts[k] = counts.get(k, 0) + n
+                mk = k + '_max'
+                maxes[mk] = max(maxes.get(mk, v), v)
+    out = {k: s / counts[k] for k, s in sums.items() if counts.get(k)}
+    out.update(maxes)
+    return max(total_n, 1), out
+
+
+def rollup(now: Optional[float] = None) -> None:
+    """Aggregate completed windows one rung down the resolution ladder.
+
+    Idempotent and cheap: each (src → dst) pair remembers the timestamp
+    it has rolled through, so a tick only touches buckets that completed
+    since the last call.
+    """
+    now = time.time() if now is None else now
+    with _db() as conn:
+        for src, dst, width in ROLLUPS:
+            row = conn.execute(
+                'SELECT through_ts FROM rollup_state WHERE res=?',
+                (dst,)).fetchone()
+            through = row['through_ts'] if row else None
+            if through is None:
+                first = conn.execute(
+                    'SELECT MIN(ts) AS t FROM samples WHERE res=?',
+                    (src,)).fetchone()['t']
+                if first is None:
+                    continue
+                through = (first // width) * width
+            horizon = (now // width) * width  # current bucket: incomplete
+            bucket = through
+            while bucket + width <= horizon:
+                rows = conn.execute(
+                    'SELECT n, metrics FROM samples WHERE res=? AND '
+                    'ts>=? AND ts<?', (src, bucket, bucket + width)
+                ).fetchall()
+                if rows:
+                    n, merged = _merge_window([
+                        (r['n'], _loads(r['metrics'])) for r in rows])
+                    conn.execute(
+                        'INSERT INTO samples (ts, res, n, metrics) '
+                        'VALUES (?,?,?,?)',
+                        (bucket, dst, n, json.dumps(merged)))
+                bucket += width
+            if bucket != through:
+                conn.execute(
+                    'INSERT INTO rollup_state (res, through_ts) '
+                    'VALUES (?,?) ON CONFLICT(res) DO UPDATE SET '
+                    'through_ts=excluded.through_ts', (dst, bucket))
+                _prune(conn, dst)
+            # Retention: a source row is deletable once its bucket has
+            # been rolled (ts < through) AND it has aged out of the
+            # trailing-window reads.
+            keep = RETENTION_SECONDS.get(src)
+            if keep is not None:
+                conn.execute(
+                    'DELETE FROM samples WHERE res=? AND ts<? AND ts<?',
+                    (src, bucket, now - keep))
+
+
+# ------------------------------------------------------------------ reads
+
+
+def query(res: str = 'raw', since: Optional[float] = None,
+          limit: int = 1000) -> List[Dict[str, Any]]:
+    clauses: List[str] = ['res=?']
+    args: List[Any] = [res]
+    if since is not None:
+        clauses.append('ts>=?')
+        args.append(since)
+    try:
+        rows = _db().execute(
+            f'SELECT * FROM samples WHERE {" AND ".join(clauses)} '
+            'ORDER BY ts ASC LIMIT ?', (*args, limit)).fetchall()
+    except (sqlite3.Error, OSError):
+        return []
+    out = []
+    for r in rows:
+        d = dict(r)
+        try:
+            d['metrics'] = json.loads(d['metrics'] or '{}')
+        except ValueError:
+            d['metrics'] = {}
+        out.append(d)
+    return out
+
+
+def window(seconds: float, now: Optional[float] = None
+           ) -> Dict[str, Any]:
+    """Aggregate the trailing raw window: per-metric mean + max, the
+    newest sample verbatim, and the age of that sample."""
+    now = time.time() if now is None else now
+    rows = query('raw', since=now - seconds)
+    if not rows:
+        return {'samples': 0, 'mean': {}, 'max': {}, 'last': {},
+                'last_ts': None}
+    _, merged = _merge_window([(r['n'], r['metrics']) for r in rows])
+    mean = {k: v for k, v in merged.items() if not k.endswith('_max')}
+    mx = {k[:-4]: v for k, v in merged.items() if k.endswith('_max')}
+    last = rows[-1]
+    return {'samples': len(rows), 'mean': mean, 'max': mx,
+            'last': last['metrics'], 'last_ts': last['ts']}
+
+
+def skylet_heartbeat_path() -> str:
+    return os.path.join(constants.skytpu_dir(), 'skylet.heartbeat')
+
+
+def node_snapshot(window_seconds: float = 120.0,
+                  now: Optional[float] = None) -> Dict[str, Any]:
+    """What the fleet aggregator pulls from each host: the trailing
+    window, sample freshness, and the skylet tick age (heartbeat file
+    mtime — a dead skylet stops touching it, so the head can tell a
+    wedged daemon from a quiet one)."""
+    now = time.time() if now is None else now
+    snap = window(window_seconds, now=now)
+    snap['sample_age'] = (None if snap['last_ts'] is None
+                          else max(0.0, now - snap['last_ts']))
+    try:
+        snap['skylet_tick_age'] = max(
+            0.0, now - os.path.getmtime(skylet_heartbeat_path()))
+    except OSError:
+        snap['skylet_tick_age'] = None
+    return snap
+
+
+# ---------------------------------------------------------------- sampler
+
+
+def _proc_root() -> str:
+    return os.environ.get(PROC_ROOT_ENV, '/proc')
+
+
+def _read_proc_stat_jiffies(proc: str) -> Optional[Tuple[float, float]]:
+    """(busy, total) jiffies from the aggregate cpu line."""
+    try:
+        with open(os.path.join(proc, 'stat'), encoding='utf-8') as f:
+            line = f.readline()
+    except OSError:
+        return None
+    parts = line.split()
+    if not parts or parts[0] != 'cpu':
+        return None
+    vals = [float(v) for v in parts[1:]]
+    if len(vals) < 5:
+        return None
+    total = sum(vals)
+    idle = vals[3] + vals[4]  # idle + iowait
+    return total - idle, total
+
+
+def _node_pids(proc: str, node_dir: str) -> List[int]:
+    """PIDs whose env homes them in this local-cloud node (the provision
+    teardown scan, reused for per-node CPU accounting)."""
+    node_dir = os.path.realpath(node_dir)
+    needles = tuple(
+        f'{var}={node_dir}'.encode()
+        for var in ('SKYTPU_SKYLET_HOME', 'HOME', 'SKYTPU_NODE_DIR'))
+    pids: List[int] = []
+    try:
+        entries = os.listdir(proc)
+    except OSError:
+        return pids
+    for entry in entries:
+        if not entry.isdigit():
+            continue
+        try:
+            with open(os.path.join(proc, entry, 'environ'), 'rb') as f:
+                environ = f.read()
+        except OSError:
+            continue
+        for var in environ.split(b'\0'):
+            if any(var == n or var.startswith(n + b'/') for n in needles):
+                pids.append(int(entry))
+                break
+    return pids
+
+
+def _pid_jiffies(proc: str, pid: int) -> Optional[float]:
+    """utime+stime of one process (fields 14/15 of /proc/pid/stat)."""
+    try:
+        with open(os.path.join(proc, str(pid), 'stat'),
+                  encoding='utf-8') as f:
+            data = f.read()
+    except OSError:
+        return None
+    # comm may contain spaces/parens; fields are counted after the
+    # closing paren.
+    rparen = data.rfind(')')
+    fields = data[rparen + 1:].split()
+    if len(fields) < 13:
+        return None
+    return float(fields[11]) + float(fields[12])
+
+
+class HostSampler:
+    """Stateful sampler: CPU utilization needs deltas between calls, so
+    one instance lives for the skylet's lifetime. The first call returns
+    memory/disk/load only; CPU appears from the second call on."""
+
+    def __init__(self):
+        self._prev_host: Optional[Tuple[float, float]] = None
+        self._prev_node: Dict[int, float] = {}
+        self._prev_node_ts: Optional[float] = None
+        try:
+            self._clk_tck = os.sysconf('SC_CLK_TCK') or 100
+        except (ValueError, OSError):
+            self._clk_tck = 100
+
+    def sample(self) -> Dict[str, float]:
+        proc = _proc_root()
+        out: Dict[str, float] = {}
+        ncpu = os.cpu_count() or 1
+        out['ncpu'] = float(ncpu)
+        node_dir = os.environ.get('SKYTPU_NODE_DIR')
+        if node_dir:
+            cpu = self._sample_node_cpu(proc, node_dir, ncpu)
+        else:
+            cpu = self._sample_host_cpu(proc, ncpu)
+        if cpu is not None:
+            out['cpu_util'], out['cpu_cores_used'] = cpu
+        out.update(self._sample_memory(proc))
+        out.update(self._sample_disk())
+        out.update(self._sample_load(proc))
+        out.update(sample_accelerator())
+        return out
+
+    def _sample_host_cpu(self, proc: str, ncpu: int
+                         ) -> Optional[Tuple[float, float]]:
+        cur = _read_proc_stat_jiffies(proc)
+        prev, self._prev_host = self._prev_host, cur
+        if cur is None or prev is None:
+            return None
+        dbusy, dtotal = cur[0] - prev[0], cur[1] - prev[1]
+        if dtotal <= 0:
+            return None
+        util = min(max(dbusy / dtotal, 0.0), 1.0)
+        return util, util * ncpu
+
+    def _sample_node_cpu(self, proc: str, node_dir: str, ncpu: int
+                         ) -> Optional[Tuple[float, float]]:
+        now = time.time()
+        cur: Dict[int, float] = {}
+        for pid in _node_pids(proc, node_dir):
+            j = _pid_jiffies(proc, pid)
+            if j is not None:
+                cur[pid] = j
+        prev, self._prev_node = self._prev_node, cur
+        prev_ts, self._prev_node_ts = self._prev_node_ts, now
+        if prev_ts is None or now <= prev_ts:
+            return None
+        # Only pids seen in both samples contribute (a new pid's
+        # lifetime CPU would be misattributed to this interval; an
+        # exited pid's usage is simply dropped — undercount, never
+        # overcount).
+        delta = sum(max(0.0, cur[p] - prev[p]) for p in cur if p in prev)
+        cores = delta / self._clk_tck / (now - prev_ts)
+        return min(cores / ncpu, 1.0), cores
+
+    @staticmethod
+    def _sample_memory(proc: str) -> Dict[str, float]:
+        try:
+            fields = {}
+            with open(os.path.join(proc, 'meminfo'),
+                      encoding='utf-8') as f:
+                for line in f:
+                    key, _, rest = line.partition(':')
+                    vals = rest.split()
+                    if vals:
+                        fields[key] = float(vals[0]) * 1024  # kB
+        except OSError:
+            return {}
+        total = fields.get('MemTotal')
+        avail = fields.get('MemAvailable')
+        if not total or avail is None:
+            return {}
+        return {'mem_total_bytes': total,
+                'mem_used_bytes': total - avail,
+                'mem_util': min(max(1.0 - avail / total, 0.0), 1.0)}
+
+    @staticmethod
+    def _sample_disk() -> Dict[str, float]:
+        try:
+            st = os.statvfs(constants.skylet_home())
+        except OSError:
+            return {}
+        total = st.f_blocks * st.f_frsize
+        if total <= 0:
+            return {}
+        free = st.f_bavail * st.f_frsize
+        return {'disk_total_bytes': float(total),
+                'disk_util': min(max(1.0 - free / total, 0.0), 1.0)}
+
+    @staticmethod
+    def _sample_load(proc: str) -> Dict[str, float]:
+        try:
+            with open(os.path.join(proc, 'loadavg'),
+                      encoding='utf-8') as f:
+                return {'load1': float(f.read().split()[0])}
+        except (OSError, ValueError, IndexError):
+            return {}
+
+
+ACCEL_SAMPLING_ENV = 'SKYTPU_SAMPLER_ACCEL'
+
+
+def sample_accelerator() -> Dict[str, float]:
+    """Accelerator memory stats via JAX, or ``{}`` on CPU-only nodes.
+
+    Gated on ``JAX_PLATFORMS`` naming a non-CPU backend before importing
+    jax at all — control-plane processes run with accelerator boot
+    stripped (see ``skylet/constants.ACCEL_BOOT_ENVS``) and must not pay
+    a multi-second plugin import on every sampler tick just to learn
+    there is no chip. The gate is deliberate on real TPU VMs too: libtpu
+    is single-client, so a sampler probing the chip would SEIZE it from
+    the user's job. Hosts whose runtime can share device stats opt in
+    with ``SKYTPU_SAMPLER_ACCEL=1`` (``0`` forces it off; default
+    ``auto`` = the ``JAX_PLATFORMS`` gate — see docs/tpu-guide.md).
+    ``device.memory_stats()`` is optional per backend; any failure
+    degrades to "no accelerator numbers" rather than an error.
+    """
+    mode = os.environ.get(ACCEL_SAMPLING_ENV, 'auto').strip().lower()
+    if mode in ('0', 'off', 'false', 'disabled'):
+        return {}
+    if mode not in ('1', 'on', 'true', 'force'):
+        platforms = os.environ.get('JAX_PLATFORMS', '')
+        wanted = {p.strip().lower()
+                  for p in platforms.split(',') if p.strip()}
+        if not wanted or wanted <= {'cpu'}:
+            return {}
+    try:
+        import jax
+        devices = [d for d in jax.local_devices()
+                   if d.platform.lower() != 'cpu']
+    except Exception:  # pylint: disable=broad-except
+        return {}
+    in_use = limit = 0.0
+    seen = 0
+    for dev in devices:
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:  # pylint: disable=broad-except
+            continue
+        bytes_in_use = stats.get('bytes_in_use')
+        bytes_limit = stats.get('bytes_limit') or stats.get(
+            'bytes_reservable_limit')
+        if bytes_in_use is None or not bytes_limit:
+            continue
+        in_use += float(bytes_in_use)
+        limit += float(bytes_limit)
+        seen += 1
+    if not seen or limit <= 0:
+        return {}
+    return {'accel_count': float(len(devices)),
+            'accel_mem_used_bytes': in_use,
+            'accel_mem_total_bytes': limit,
+            'accel_mem_util': min(max(in_use / limit, 0.0), 1.0)}

@@ -42,6 +42,39 @@ class ScalePlan:
         return self.default_count + self.ondemand_fallback_count
 
 
+# Optional utilization blend (fleet telemetry → scaling): when enabled,
+# the QPS-derived demand is floored by what the replicas' measured CPU
+# utilization says is needed to get back under the target utilization.
+# Catches workloads whose cost-per-request grows (long generations,
+# heavy prompts) faster than their request RATE does.
+UTIL_BLEND_ENV = 'SKYTPU_SERVE_UTIL_BLEND'
+TARGET_UTIL_ENV = 'SKYTPU_SERVE_TARGET_UTIL'
+DEFAULT_TARGET_UTIL = 0.8
+
+
+def util_blend_enabled() -> bool:
+    return os.environ.get(UTIL_BLEND_ENV, '0') == '1'
+
+
+def utilization_demand(num_ready: int,
+                       utilization: Optional[float]) -> int:
+    """Replicas needed to bring mean replica utilization under target:
+    current capacity scaled by util/target (the standard
+    capacity-planning identity), conservative by ceiling.
+
+    ``num_ready`` must be the count the utilization mean was measured
+    over (READY replicas) — multiplying a READY-only mean by a count
+    that includes STARTING replicas would inflate demand exactly while
+    a scale-up is already in flight."""
+    if utilization is None or num_ready <= 0:
+        return 0
+    target = _env_float(TARGET_UTIL_ENV, DEFAULT_TARGET_UTIL)
+    if target <= 0:
+        return 0
+    import math
+    return math.ceil(num_ready * min(max(utilization, 0.0), 1.0) / target)
+
+
 class Autoscaler:
     """Base: fixed replica count (no autoscaling)."""
 
@@ -51,18 +84,22 @@ class Autoscaler:
     def update_spec(self, spec: spec_lib.SkyServiceSpec) -> None:
         self.spec = spec
 
-    def evaluate(self, num_alive: int, request_signal: RequestSignal
-                 ) -> int:
-        """→ target number of replicas."""
-        del num_alive, request_signal
+    def evaluate(self, num_ready: int, request_signal: RequestSignal,
+                 utilization: Optional[float] = None) -> int:
+        """→ target number of replicas. ``num_ready`` is the count the
+        ``utilization`` mean was measured over (READY replicas)."""
+        del num_ready, request_signal, utilization
         return self.spec.min_replicas
 
     def plan(self, num_ready_default: int, num_alive_default: int,
-             request_signal: RequestSignal) -> ScalePlan:
+             request_signal: RequestSignal,
+             utilization: Optional[float] = None) -> ScalePlan:
         """→ ScalePlan; base autoscalers put everything in the default
-        pool."""
-        del num_ready_default, num_alive_default
-        return ScalePlan(self.evaluate(0, request_signal))
+        pool. ``utilization`` is the mean replica utilization (0..1)
+        from the fleet plane, or None when unavailable/disabled."""
+        del num_alive_default
+        return ScalePlan(self.evaluate(num_ready_default, request_signal,
+                                       utilization=utilization))
 
     @classmethod
     def make(cls, spec: spec_lib.SkyServiceSpec) -> 'Autoscaler':
@@ -107,14 +144,18 @@ class RequestRateAutoscaler(Autoscaler):
         recent = [t for t in request_signal if t > now - window]
         return len(recent) / window
 
-    def evaluate(self, num_alive: int, request_signal: RequestSignal
-                 ) -> int:
+    def evaluate(self, num_ready: int, request_signal: RequestSignal,
+                 utilization: Optional[float] = None) -> int:
         spec = self.spec
         assert spec.target_qps_per_replica is not None
         qps = self.current_qps(request_signal)
         # Raw demand, bounded by [min, max].
         import math
         demand = math.ceil(qps / spec.target_qps_per_replica) if qps else 0
+        # Utilization blend: QPS undercounts demand when per-request
+        # cost grows; the measured-capacity floor covers that case and
+        # NEVER scales below what QPS asks (max, not replace).
+        demand = max(demand, utilization_demand(num_ready, utilization))
         demand = min(max(demand, spec.min_replicas),
                      spec.max_replicas or demand)
         now = time.time()
@@ -139,7 +180,6 @@ class RequestRateAutoscaler(Autoscaler):
         else:
             self._over_since = None
             self._under_since = None
-        del num_alive
         return self._target
 
 
@@ -155,10 +195,12 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
     """
 
     def plan(self, num_ready_default: int, num_alive_default: int,
-             request_signal: RequestSignal) -> ScalePlan:
+             request_signal: RequestSignal,
+             utilization: Optional[float] = None) -> ScalePlan:
         spec = self.spec
         if spec.autoscaling_enabled:
-            total = self.evaluate(num_alive_default, request_signal)
+            total = self.evaluate(num_ready_default, request_signal,
+                                  utilization=utilization)
         else:
             total = max(spec.min_replicas, 1)
         base_od = min(spec.base_ondemand_fallback_replicas, total)

@@ -26,6 +26,7 @@ from skypilot_tpu.serve import replica_managers
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+from skypilot_tpu.utils import subprocess_utils
 
 logger = sky_logging.init_logger(__name__)
 
@@ -207,7 +208,11 @@ class SkyServeController:
             sum(1 for r in default_pool
                 if r['status'] == ReplicaStatus.READY),
             sum(1 for r in default_pool if r['status'].is_alive()),
-            self._sync.tracker)
+            self._sync.tracker,
+            # Measured over the same set num_ready_default counts —
+            # utilization_demand multiplies the mean by that count, so
+            # mixing in fallback/old-version replicas would skew it.
+            utilization=self._replica_utilization(default_pool))
         rm.scale_to(plan)
         rm.rolling_update_tick(plan)
         self._update_service_status()
@@ -232,6 +237,48 @@ class SkyServeController:
         metrics.counter('skytpu_serve_controller_ticks_total',
                         'Controller reconcile ticks.',
                         labels=('service',)).inc(labels=(svc,))
+
+    def _replica_utilization(self, replicas) -> Optional[float]:
+        """Mean CPU utilization across READY replicas' clusters, from
+        the fleet telemetry plane — or None (the autoscaler then runs
+        QPS-only). Opt-in via SKYTPU_SERVE_UTIL_BLEND=1: the pull costs
+        one codegen round per replica host per tick, which an operator
+        should choose, not inherit. Pass the same replica set whose
+        READY count the autoscaler multiplies the mean by."""
+        if not autoscalers_lib.util_blend_enabled():
+            return None
+        from skypilot_tpu import global_state
+        from skypilot_tpu.observability import fleet as fleet_lib
+        ready = [r for r in replicas
+                 if r['status'] == ReplicaStatus.READY]
+
+        def _pull(r) -> Optional[float]:
+            record = global_state.get_cluster_from_name(r['cluster_name'])
+            if record is None or record.get('handle') is None:
+                return None
+            try:
+                summary = fleet_lib.collect_cluster(
+                    r['cluster_name'],
+                    record['handle'].get_command_runners(),
+                    window_seconds=60.0, timeout=10.0)
+            except Exception:  # pylint: disable=broad-except
+                return None
+            stats = summary['rollup'].get('cpu_util')
+            return stats['mean'] if stats else None
+
+        # Parallel across replicas: one slow/unreachable replica must
+        # not stack 10s timeouts serially and stall the reconcile tick.
+        utils = [u for u in subprocess_utils.run_in_parallel(_pull, ready)
+                 if u is not None]
+        if not utils:
+            return None
+        mean = sum(utils) / len(utils)
+        metrics.gauge('skytpu_serve_replica_util',
+                      'Mean CPU utilization across READY replicas '
+                      '(autoscaler blend signal).',
+                      labels=('service',)).set(
+                          mean, labels=(self.service_name,))
+        return mean
 
     def _maybe_apply_update(self) -> None:
         """Rolling update: pick up a bumped service version (new spec +

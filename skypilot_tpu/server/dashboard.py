@@ -82,6 +82,45 @@ def _table(title: str, header: Tuple[str, ...], rows: List[Tuple]) -> str:
             + ''.join(body) + '</table>')
 
 
+# The fleet sweep costs a codegen round per host of every UP cluster,
+# so it must not run synchronously inside a page render that
+# auto-refreshes every 10s (one unreachable cluster blocking to the SSH
+# timeout would stack refreshes and wedge the server's handler pool).
+# Snapshots are cached for a TTL and pulled with a short per-host
+# timeout; a slow sweep serves the previous rows.
+_FLEET_TTL_SECONDS = 15.0
+_FLEET_PULL_TIMEOUT = 5.0
+_fleet_cache: dict = {'ts': 0.0, 'rows': []}
+
+
+def _fleet_rows() -> List[Tuple]:
+    now = time.time()
+    if now - _fleet_cache['ts'] < _FLEET_TTL_SECONDS:
+        return _fleet_cache['rows']
+    rows: List[Tuple] = []
+
+    def _pct(v):
+        return f'{v * 100:.0f}%' if v is not None else '-'
+
+    try:
+        from skypilot_tpu import core
+        from skypilot_tpu.observability import fleet as fleet_lib
+        for summary in core.fleet_status(timeout=_FLEET_PULL_TIMEOUT):
+            for node in summary.get('nodes', []):
+                tick = node.get('skylet_tick_age')
+                rows.append(
+                    (summary['cluster'], node['node'],
+                     _pct(node.get('cpu_util')),
+                     _pct(node.get('mem_util')),
+                     _pct(node.get('accel_mem_util')),
+                     f'{tick:.0f}s' if tick is not None else '-',
+                     fleet_lib.node_flags(node)))
+    except Exception:  # pylint: disable=broad-except
+        rows = _fleet_cache['rows']
+    _fleet_cache.update(ts=now, rows=rows)
+    return rows
+
+
 def render() -> str:
     from skypilot_tpu import global_state
     from skypilot_tpu.jobs import state as jobs_state
@@ -106,6 +145,10 @@ def render() -> str:
     sections.append(_table('Clusters',
                            ('NAME', 'RESOURCES', 'STATUS', 'LAUNCHED',
                             'LAST REFRESH'), clusters))
+
+    sections.append(_table('Fleet (per-node utilization)',
+                           ('CLUSTER', 'NODE', 'CPU', 'MEM', 'ACCELMEM',
+                            'SKYLET TICK', 'FLAGS'), _fleet_rows()))
 
     jobs = []
     for job in jobs_state.get_jobs():

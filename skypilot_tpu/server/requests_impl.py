@@ -59,10 +59,24 @@ def _status(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
     from skypilot_tpu import core
     records = core.status(cluster_names=payload.get('cluster_names'),
                           refresh=payload.get('refresh', False))
+    fleet_by_name: Dict[str, Any] = {}
+    if payload.get('verbose') and records:
+        # Fleet snapshots ride the same response so `status -v` costs
+        # one request; best-effort — telemetry failing must not break
+        # plain status. Guarded on non-empty records: an empty list
+        # must not degenerate into a None "all clusters" sweep whose
+        # results would all be dropped anyway.
+        try:
+            for summary in core.fleet_status(
+                    cluster_names=[r['name'] for r in records]):
+                if not summary.get('error'):
+                    fleet_by_name[summary['cluster']] = summary
+        except Exception:  # pylint: disable=broad-except
+            pass
     out = []
     for r in records:
         handle = r['handle']
-        out.append({
+        rec = {
             'name': r['name'],
             'status': r['status'].value,
             'launched_at': r['launched_at'],
@@ -72,8 +86,18 @@ def _status(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
             'autostop': r['autostop'],
             'to_down': r['to_down'],
             'last_use': r['last_use'],
-        })
+        }
+        if r['name'] in fleet_by_name:
+            rec['fleet'] = fleet_by_name[r['name']]
+        out.append(rec)
     return out
+
+
+def _fleet(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    from skypilot_tpu import core
+    return core.fleet_status(
+        cluster_names=payload.get('cluster_names'),
+        window_seconds=payload.get('window_seconds', 120.0))
 
 
 def _kubernetes_status(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
@@ -273,6 +297,7 @@ EXECUTORS: Dict[str, Callable[[Dict[str, Any]], Any]] = {
     'launch': _launch,
     'exec': _exec,
     'status': _status,
+    'fleet': _fleet,
     'endpoints': _endpoints,
     'kubernetes_status': _kubernetes_status,
     'start': _start,

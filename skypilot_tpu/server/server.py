@@ -31,6 +31,7 @@ _VERB_ROUTES = {
     '/launch': 'launch',
     '/exec': 'exec',
     '/status': 'status',
+    '/fleet': 'fleet',
     '/endpoints': 'endpoints',
     '/kubernetes_status': 'kubernetes_status',
     '/start': 'start',

@@ -3,6 +3,7 @@ import os
 import subprocess
 import time
 import traceback
+from typing import Optional
 
 from skypilot_tpu.skylet import autostop_lib
 from skypilot_tpu.skylet import constants
@@ -23,11 +24,32 @@ class SkyletEvent:
         self._last_run = now
         try:
             self.run()
-        except Exception:  # pylint: disable=broad-except
+        except Exception as e:  # pylint: disable=broad-except
+            # One failing event must not kill the others (the loop in
+            # skylet.py keeps ticking); the failure is both logged and
+            # journaled so a cluster whose autostop silently died is
+            # diagnosable from `skytpu events` after the fact.
             traceback.print_exc()
+            journal_event_error(self, e)
 
     def run(self) -> None:
         raise NotImplementedError
+
+
+def journal_event_error(event: 'SkyletEvent', exc: Exception) -> None:
+    """Best-effort ``skylet.event_error`` breadcrumb."""
+    try:
+        import socket
+        from skypilot_tpu.observability import journal
+        node = os.path.basename(
+            os.environ.get('SKYTPU_NODE_DIR', '').rstrip('/')) or \
+            socket.gethostname()
+        journal.event(journal.EventKind.SKYLET_EVENT_ERROR,
+                      f'skylet:{node}',
+                      {'event': type(event).__name__,
+                       'error': f'{type(exc).__name__}: {exc}'})
+    except Exception:  # pylint: disable=broad-except
+        pass  # the journal must never take the tick loop down with it
 
 
 class JobSchedulerEvent(SkyletEvent):
@@ -38,14 +60,96 @@ class JobSchedulerEvent(SkyletEvent):
         job_lib.schedule_step()
 
 
+class MetricsSamplerEvent(SkyletEvent):
+    """Sample this host's resources into the local time-series buffer.
+
+    Every host of a slice runs one (the fleet aggregator pulls each
+    host's window from the head). Interval is env-tunable so tests can
+    tick sub-second; production default matches the generic event
+    cadence.
+    """
+    EVENT_CHECKING_INTERVAL_SECONDS = 20
+
+    def __init__(self):
+        super().__init__()
+        try:
+            self.EVENT_CHECKING_INTERVAL_SECONDS = float(
+                os.environ.get('SKYTPU_SAMPLER_INTERVAL_SECONDS',
+                               self.EVENT_CHECKING_INTERVAL_SECONDS))
+        except ValueError:
+            pass
+        self._sampler = None
+
+    def run(self) -> None:
+        from skypilot_tpu.observability import timeseries
+        if self._sampler is None:
+            self._sampler = timeseries.HostSampler()
+        timeseries.record(self._sampler.sample())
+        timeseries.rollup()
+
+
 class AutostopEvent(SkyletEvent):
     """Idle detection → stop/down via the cloud API (parity: events.py:33).
 
     On a TPU slice the skylet's host cannot stop itself through the
     hypervisor; it calls the provisioner's stop/terminate with the cluster
     identity recorded at setup time.
+
+    Idleness is utilization-aware: an empty job queue alone is not idle
+    when the cluster is demonstrably busy (a forgotten background
+    process, a wedged-but-RUNNING workload launched outside the queue).
+    The fleet telemetry window must also be below
+    ``SKYTPU_AUTOSTOP_UTIL_THRESHOLD`` for the whole idle window — busy
+    ticks reset the idle clock exactly like a queued job. Set the env to
+    ``off`` (or a negative number) to restore queue-only behavior; when
+    telemetry is unavailable (sampler just started, pull failed) the
+    decision falls back to queue-only rather than blocking forever.
     """
     EVENT_CHECKING_INTERVAL_SECONDS = 60
+
+    UTIL_THRESHOLD_ENV = 'SKYTPU_AUTOSTOP_UTIL_THRESHOLD'
+    DEFAULT_UTIL_THRESHOLD = 0.9
+    BUSY_CORES_ENV = 'SKYTPU_AUTOSTOP_BUSY_CORES'
+    DEFAULT_BUSY_CORES = 1.0
+
+    def __init__(self):
+        super().__init__()
+        try:
+            self.EVENT_CHECKING_INTERVAL_SECONDS = float(
+                os.environ.get('SKYTPU_AUTOSTOP_INTERVAL_SECONDS',
+                               self.EVENT_CHECKING_INTERVAL_SECONDS))
+        except ValueError:
+            pass
+        self._deferral_journaled = False
+
+    @classmethod
+    def util_threshold(cls) -> float:
+        """Utilization gate; negative disables (queue-only autostop)."""
+        raw = os.environ.get(cls.UTIL_THRESHOLD_ENV, '')
+        if raw.strip().lower() in ('off', 'none', 'disabled'):
+            return -1.0
+        try:
+            return float(raw) if raw else cls.DEFAULT_UTIL_THRESHOLD
+        except ValueError:
+            return cls.DEFAULT_UTIL_THRESHOLD
+
+    @classmethod
+    def busy_cores_threshold(cls) -> Optional[float]:
+        """Absolute-cores busy floor, or None when disabled.
+
+        The fraction threshold alone is inert on big hosts (one
+        runaway single-threaded process on 96 cores is ~1% CPU), so a
+        node is also "busy" when at least this many cores are in use —
+        the canonical forgotten-busy-loop signature — regardless of the
+        machine's core count.
+        """
+        raw = os.environ.get(cls.BUSY_CORES_ENV, '')
+        if raw.strip().lower() in ('off', 'none', 'disabled'):
+            return None
+        try:
+            return float(raw) if raw else cls.DEFAULT_BUSY_CORES
+        except ValueError:
+            return cls.DEFAULT_BUSY_CORES
 
     def run(self) -> None:
         cfg = autostop_lib.get_autostop_config()
@@ -54,29 +158,126 @@ class AutostopEvent(SkyletEvent):
             return
         if not job_lib.is_cluster_idle(idle_minutes):
             autostop_lib.set_last_active_time_to_now()
+            # A fresh busy-outside-queue episode after queue activity is
+            # a new decision — journal its deferral again.
+            self._deferral_journaled = False
             return
+        threshold = self.util_threshold()
+        # The pull costs a codegen round per worker — only pay it while
+        # the gate is on (the escape hatch restores queue-only exactly).
+        evidence = (self._utilization_evidence() if threshold >= 0
+                    else None)
+        if threshold >= 0 and self._is_busy(evidence, threshold):
+            # Busy by machine telemetry: reset the idle clock so the
+            # cluster must be BOTH queue-idle and quiet for the whole
+            # window before stopping.
+            autostop_lib.set_last_active_time_to_now()
+            if not self._deferral_journaled:
+                self._journal_decision('deferred', cfg, evidence,
+                                       threshold)
+                self._deferral_journaled = True
+            return
+        self._deferral_journaled = False
         last_active = cfg.get('last_active_time', time.time())
         if time.time() - last_active < idle_minutes * 60:
             return
-        self._stop_cluster(cfg)
+        self._stop_cluster(cfg, evidence, threshold)
 
-    def _stop_cluster(self, cfg: dict) -> None:
-        cluster_info_path = constants.cluster_info_path()
-        if not os.path.exists(cluster_info_path):
+    @classmethod
+    def _is_busy(cls, evidence: Optional[dict],
+                 threshold: float) -> bool:
+        """Busy when the fraction gate OR the absolute-cores floor
+        trips on the busiest node's window max."""
+        if evidence is None:
+            return False
+        util = evidence.get('busiest_util')
+        if util is not None and util >= threshold:
+            return True
+        cores_gate = cls.busy_cores_threshold()
+        cores = evidence.get('busiest_cores')
+        return (cores_gate is not None and cores is not None and
+                cores >= cores_gate)
+
+    @staticmethod
+    def _utilization_evidence() -> Optional[dict]:
+        """Cluster utilization over the trailing window, or None.
+
+        The decision metric is each node's window MAX: "idle" means the
+        utilization stayed below the threshold for the whole window, so
+        one recent busy sample keeps the cluster up — and the signal is
+        robust to a single quiet sample on a contended host.
+        """
+        window = 30.0
+        try:
+            window = float(os.environ.get(
+                'SKYTPU_AUTOSTOP_UTIL_WINDOW_SECONDS', window))
+        except ValueError:
+            pass
+        try:
+            from skypilot_tpu.observability import fleet
+            summary = fleet.local_cluster_snapshot(window_seconds=window)
+        except Exception:  # pylint: disable=broad-except
+            return None
+        if summary is None:
+            return None
+        node = fleet.busiest_node(
+            summary, keys=('cpu_util_max', 'cpu_util_last', 'cpu_util'))
+        if node is None:
+            return None
+        util = node.get('cpu_util_max',
+                        node.get('cpu_util_last', node.get('cpu_util')))
+        cores = node.get('cpu_cores_used_max',
+                         node.get('cpu_cores_used_last',
+                                  node.get('cpu_cores_used')))
+        accel = node.get('accel_mem_util_max',
+                         node.get('accel_mem_util'))
+        # The gate is CPU-only: HBM occupancy deliberately does NOT
+        # gate autostop (a parked model keeps HBM full while doing no
+        # work, so an accel gate would keep every loaded cluster up
+        # forever — see docs/tpu-guide.md). The HBM number still rides
+        # along as evidence for `skytpu events`.
+        return {'busiest_node': node['node'],
+                'busiest_util': util,
+                'busiest_cpu_util': util,
+                'busiest_cores': cores,
+                'busiest_accel_mem_util': accel,
+                'util_window': window,
+                'nodes': len(summary['nodes'])}
+
+    @staticmethod
+    def _journal_decision(decision: str, cfg: dict,
+                          evidence: Optional[dict],
+                          threshold: float) -> None:
+        from skypilot_tpu.observability import journal
+        info = _read_cluster_info()
+        entity = 'cluster:' + (
+            (info or {}).get('cluster_name') or
+            (info or {}).get('cluster_name_on_cloud') or 'unknown')
+        payload = {'decision': decision,
+                   'down': bool(cfg.get('down')),
+                   'idle_minutes': cfg.get('autostop_idle_minutes'),
+                   'util_threshold': threshold if threshold >= 0 else
+                   'off'}
+        if evidence:
+            payload.update(evidence)
+        else:
+            payload['utilization'] = 'unavailable'
+        journal.event(journal.EventKind.SKYLET_AUTOSTOP, entity, payload)
+
+    def _stop_cluster(self, cfg: dict, evidence: Optional[dict] = None,
+                      threshold: float = -1.0) -> None:
+        info = _read_cluster_info()
+        if info is None:
             return
-        import json
-        with open(cluster_info_path, encoding='utf-8') as f:
-            info = json.load(f)
         provider = info.get('provider_name')
         provider_config = info.get('provider_config', {})
         cluster_name = info.get('cluster_name_on_cloud')
-        # Flight-recorder breadcrumb BEFORE acting: if the stop call takes
-        # this very host down, the decision is already on record.
-        from skypilot_tpu.observability import journal
-        journal.event(journal.EventKind.SKYLET_AUTOSTOP,
-                      f'cluster:{info.get("cluster_name") or cluster_name}',
-                      {'down': bool(cfg.get('down')),
-                       'idle_minutes': cfg.get('autostop_idle_minutes')})
+        # Flight-recorder breadcrumb BEFORE acting — with the utilization
+        # evidence the decision was made on: if the stop call takes this
+        # very host down, `skytpu events -k skylet.autostop` can still
+        # answer "why did my cluster stop".
+        self._journal_decision('down' if cfg.get('down') else 'stop',
+                               cfg, evidence, threshold)
         from skypilot_tpu import provision
         if cfg.get('down'):
             provision.terminate_instances(provider, cluster_name,
@@ -84,6 +285,18 @@ class AutostopEvent(SkyletEvent):
         else:
             provision.stop_instances(provider, cluster_name,
                                      provider_config=provider_config)
+
+
+def _read_cluster_info() -> Optional[dict]:
+    path = constants.cluster_info_path()
+    if not os.path.exists(path):
+        return None
+    import json
+    try:
+        with open(path, encoding='utf-8') as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 class ManagedJobEvent(SkyletEvent):

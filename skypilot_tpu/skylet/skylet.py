@@ -3,14 +3,24 @@
 Parity: ``sky/skylet/skylet.py:17-35`` — an infinite loop over the event
 list on the head host (each worker host of a slice also runs one for local
 job bookkeeping, but only the head's drives autostop).
+
+Hardening: each event ticks inside its own try/except, so one failing
+event (a sampler import error, a corrupted serve DB) can no longer kill
+autostop and job scheduling for the whole cluster — the error is logged,
+journaled as ``skylet.event_error``, and the loop keeps going. Every
+completed loop touches a heartbeat file whose age the fleet telemetry
+plane exports as ``skytpu_skylet_tick_age_seconds``, so a dead or wedged
+skylet is detectable from the head.
 """
 import os
 import time
+import traceback
 
 from skypilot_tpu.skylet import events
 
 EVENTS = [
     events.JobSchedulerEvent(),
+    events.MetricsSamplerEvent(),
     events.AutostopEvent(),
     events.UsageHeartbeatReportEvent(),
     events.ManagedJobEvent(),
@@ -20,10 +30,30 @@ EVENTS = [
 _TICK_SECONDS = float(os.environ.get('SKYTPU_SKYLET_TICK_SECONDS', '5'))
 
 
+def _touch_heartbeat() -> None:
+    try:
+        from skypilot_tpu.observability import timeseries
+        path = timeseries.skylet_heartbeat_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, 'a', encoding='utf-8'):
+            pass
+        os.utime(path, None)
+    except OSError:
+        pass
+
+
 def main() -> None:
     while True:
         for event in EVENTS:
-            event.tick()
+            try:
+                event.tick()
+            except Exception as e:  # pylint: disable=broad-except
+                # tick() already guards run(); this catches failures in
+                # the event machinery itself (imports, clock math) so
+                # the remaining events still run.
+                traceback.print_exc()
+                events.journal_event_error(event, e)
+        _touch_heartbeat()
         time.sleep(_TICK_SECONDS)
 
 

@@ -45,6 +45,8 @@ def _isolate_state(tmp_path, monkeypatch):
     # spawned daemon (skylet, job/serve controllers, gang_run), keeping the
     # e2e suites seconds- not minutes-long.
     monkeypatch.setenv('SKYTPU_SKYLET_TICK_SECONDS', '0.3')
+    monkeypatch.setenv('SKYTPU_AUTOSTOP_INTERVAL_SECONDS', '1')
+    monkeypatch.setenv('SKYTPU_SAMPLER_INTERVAL_SECONDS', '1')
     monkeypatch.setenv('SKYTPU_JOBS_POLL_SECONDS', '0.5')
     monkeypatch.setenv('SKYTPU_SERVE_CONTROLLER_INTERVAL', '0.5')
     monkeypatch.setenv('SKYTPU_GANG_GRACE_SECONDS', '0.4')

@@ -176,12 +176,48 @@ def test_exporter_serves_metrics_and_healthz():
         with urllib.request.urlopen(
                 f'http://127.0.0.1:{port}/healthz', timeout=5) as resp:
             assert resp.status == 200
-            assert resp.read() == b'ok\n'
+            text = resp.read().decode()
+        # Staleness is reported, and fresh (the counter write above).
+        assert text.startswith('ok staleness_seconds=')
+        assert float(text.split('=', 1)[1]) < 60
         with pytest.raises(urllib.error.HTTPError):
             urllib.request.urlopen(f'http://127.0.0.1:{port}/nope',
                                    timeout=5)
     finally:
         exp.stop()
+
+
+def test_healthz_reports_staleness_503():
+    """A wedged process (live HTTP thread, dead main loop) flips
+    /healthz to 503 once the liveness signal ages past the bound."""
+    import time as time_lib
+    exp = exporter_lib.MetricsExporter(
+        port=0, host='127.0.0.1',
+        heartbeat_fn=lambda: time_lib.time() - 100.0,
+        max_staleness_seconds=5.0)
+    port = exp.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(f'http://127.0.0.1:{port}/healthz',
+                                   timeout=5)
+        assert exc_info.value.code == 503
+        body = exc_info.value.read().decode()
+        assert body.startswith('stale staleness_seconds=')
+        assert float(body.split('=', 1)[1]) >= 100.0
+    finally:
+        exp.stop()
+
+
+def test_registry_stamps_last_write():
+    reg = metrics.MetricsRegistry()
+    assert reg.last_write_ts == 0.0
+    reg.counter('skytpu_w_total').inc()
+    t1 = reg.last_write_ts
+    assert t1 > 0
+    reg.gauge('skytpu_w_gauge').set(1.0)
+    assert reg.last_write_ts >= t1
+    reg.histogram('skytpu_w_seconds', buckets=(1.0,)).observe(0.5)
+    assert reg.last_write_ts >= t1
 
 
 # ------------------------------------------------- peak FLOPs detection
@@ -243,7 +279,12 @@ def test_all_registered_metric_names_match_convention():
                      'skytpu_train_step_seconds',
                      'skytpu_serve_requests_total',
                      'skytpu_job_phase_seconds_total',
-                     'skytpu_job_goodput_ratio'):
+                     'skytpu_job_goodput_ratio',
+                     # Fleet telemetry plane (ISSUE 4).
+                     'skytpu_node_cpu_util', 'skytpu_node_mem_util',
+                     'skytpu_cluster_cpu_util',
+                     'skytpu_skylet_tick_age_seconds',
+                     'skytpu_serve_replica_util'):
         assert expected in names, f'{expected} not found by lint scan'
 
 
@@ -282,7 +323,10 @@ def test_all_journal_event_kinds_are_registered():
     attr_names = {n for _, n in found_attrs}
     for expected in ('PROVISION_FAILOVER', 'JOB_PHASE', 'JOB_CREATED',
                      'REPLICA_TRANSITION', 'SKYLET_JOB_START',
-                     'BACKEND_JOB_SUBMIT'):
+                     'BACKEND_JOB_SUBMIT',
+                     # Fleet telemetry plane (ISSUE 4).
+                     'NODE_STALE', 'NODE_STRAGGLER',
+                     'SKYLET_EVENT_ERROR', 'SKYLET_AUTOSTOP'):
         assert expected in attr_names, \
             f'EventKind.{expected} not found by lint scan'
 

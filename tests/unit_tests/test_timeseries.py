@@ -1,0 +1,461 @@
+"""Fleet telemetry plane: time-series ring buffer rollup/retention math,
+the fleet aggregator (mean/max/p95, straggler + stale detection), the
+worker-pull codegen round-trip through a fake SSH hop, the sampler's
+/proc parsing against a synthetic proc root, and the utilization-aware
+autoscaler blend.
+
+Tier-1, CPU-only, no clusters. The 2-node e2e (skytpu top, exposition,
+utilization-aware autostop) lives in tests/test_fleet_telemetry.py.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from skypilot_tpu.observability import fleet
+from skypilot_tpu.observability import journal
+from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import timeseries
+
+pytestmark = pytest.mark.metrics
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    prev = metrics.set_registry(metrics.MetricsRegistry())
+    yield
+    metrics.set_registry(prev)
+
+
+# -------------------------------------------------------------- rollups
+
+
+def test_record_window_and_rollup_math():
+    t0 = 12_000.0  # bucket-aligned: rollup windows floor to multiples
+    # Two full minutes of 1 Hz samples with a known ramp.
+    for i in range(120):
+        timeseries.record({'cpu_util': 0.2 if i < 60 else 0.8,
+                           'mem_util': 0.5}, ts=t0 + i)
+    timeseries.rollup(now=t0 + 180)
+    one_m = timeseries.query('1m')
+    assert [r['ts'] for r in one_m] == [t0, t0 + 60]
+    assert one_m[0]['n'] == 60
+    assert one_m[0]['metrics']['cpu_util'] == pytest.approx(0.2)
+    assert one_m[0]['metrics']['cpu_util_max'] == pytest.approx(0.2)
+    assert one_m[1]['metrics']['cpu_util'] == pytest.approx(0.8)
+    # Window aggregate over the trailing raw rows.
+    w = timeseries.window(60, now=t0 + 120)
+    assert w['samples'] == 60
+    assert w['mean']['cpu_util'] == pytest.approx(0.8)
+    assert w['max']['cpu_util'] == pytest.approx(0.8)
+    assert w['last']['mem_util'] == pytest.approx(0.5)
+
+
+def test_second_tier_rollup_weighted_mean():
+    t0 = 50_000.0  # multiple of 600 so bucket edges are clean
+    # Minute 0: 10 samples at 0.0; minute 1: 30 samples at 1.0 — the
+    # 10m row must weight by sample count (0.75), not average the
+    # minute means (0.5).
+    for i in range(10):
+        timeseries.record({'cpu_util': 0.0}, ts=t0 + i)
+    for i in range(30):
+        timeseries.record({'cpu_util': 1.0}, ts=t0 + 60 + i)
+    timeseries.rollup(now=t0 + 700)
+    ten_m = timeseries.query('10m')
+    assert len(ten_m) == 1
+    assert ten_m[0]['n'] == 40
+    assert ten_m[0]['metrics']['cpu_util'] == pytest.approx(0.75)
+    assert ten_m[0]['metrics']['cpu_util_max'] == pytest.approx(1.0)
+
+
+def test_rollup_is_idempotent():
+    t0 = 21_600.0
+    for i in range(60):
+        timeseries.record({'cpu_util': 0.5}, ts=t0 + i)
+    timeseries.rollup(now=t0 + 120)
+    timeseries.rollup(now=t0 + 121)  # second call: no new buckets
+    assert len(timeseries.query('1m')) == 1
+
+
+def test_retention_prunes_rolled_raw_rows():
+    t0 = 30_000.0
+    for i in range(60):
+        timeseries.record({'cpu_util': 0.5}, ts=t0 + i)
+    # Rolled AND aged past RETENTION_SECONDS['raw'] → raw rows drop.
+    timeseries.rollup(now=t0 + timeseries.RETENTION_SECONDS['raw'] + 120)
+    assert timeseries.query('raw', limit=10000) == []
+    assert len(timeseries.query('1m')) == 1
+
+
+def test_row_cap_under_env(monkeypatch):
+    monkeypatch.setenv(timeseries.MAX_ROWS_ENV, '50')
+    t0 = 40_000.0
+    for i in range(130):
+        timeseries.record({'cpu_util': float(i)}, ts=t0 + i)
+    rows = timeseries.query('raw', limit=10000)
+    assert len(rows) <= 50
+    # Survivors are the NEWEST samples.
+    assert rows[-1]['metrics']['cpu_util'] == 129.0
+    assert rows[0]['metrics']['cpu_util'] >= 80.0
+
+
+# -------------------------------------------------------------- sampler
+
+
+def _write_proc(tmp_path, busy, total, pids=()):
+    proc = tmp_path / 'proc'
+    proc.mkdir(exist_ok=True)
+    rest = total - busy
+    (proc / 'stat').write_text(
+        f'cpu  {busy} 0 0 {rest} 0 0 0 0 0 0\n')
+    (proc / 'meminfo').write_text(
+        'MemTotal:       1000000 kB\nMemAvailable:    250000 kB\n')
+    (proc / 'loadavg').write_text('1.50 1.00 0.50 1/100 12345\n')
+    for pid, jiffies in pids:
+        d = proc / str(pid)
+        d.mkdir(exist_ok=True)
+        (d / 'stat').write_text(
+            f'{pid} (spin x) R 1 1 1 0 -1 0 0 0 0 0 '
+            f'{jiffies} {jiffies} 0 0 20 0 1 0 0 0 0\n')
+    return str(proc)
+
+
+def test_host_sampler_cpu_delta_and_memory(tmp_path, monkeypatch):
+    monkeypatch.delenv('SKYTPU_NODE_DIR', raising=False)
+    monkeypatch.setenv(timeseries.PROC_ROOT_ENV,
+                       _write_proc(tmp_path, busy=1000, total=10000))
+    s = timeseries.HostSampler()
+    first = s.sample()
+    assert 'cpu_util' not in first  # no delta yet
+    assert first['mem_util'] == pytest.approx(0.75)
+    assert first['load1'] == pytest.approx(1.5)
+    # 500 busy of 1000 total new jiffies → 50% utilization.
+    _write_proc(tmp_path, busy=1500, total=11000)
+    second = s.sample()
+    assert second['cpu_util'] == pytest.approx(0.5)
+    ncpu = os.cpu_count() or 1
+    assert second['cpu_cores_used'] == pytest.approx(0.5 * ncpu)
+
+
+def test_sampler_graceful_without_proc(tmp_path, monkeypatch):
+    monkeypatch.delenv('SKYTPU_NODE_DIR', raising=False)
+    monkeypatch.setenv(timeseries.PROC_ROOT_ENV,
+                       str(tmp_path / 'nonexistent'))
+    m = timeseries.HostSampler().sample()
+    # CPU-only node, no /proc: disk + ncpu still report; nothing raises.
+    assert m['ncpu'] >= 1
+    assert 'accel_mem_util' not in m
+
+
+def test_accelerator_sampling_skipped_on_cpu(monkeypatch):
+    monkeypatch.setenv('JAX_PLATFORMS', 'cpu')
+    assert timeseries.sample_accelerator() == {}
+    monkeypatch.delenv('JAX_PLATFORMS')
+    assert timeseries.sample_accelerator() == {}
+
+
+# ----------------------------------------------------------- aggregator
+
+
+def _snap(cpu, mem=0.4, age=1.0, tick=0.5, accel=None):
+    mean = {'cpu_util': cpu, 'mem_util': mem}
+    last = {'cpu_util': cpu}
+    if accel is not None:
+        mean['accel_mem_util'] = accel
+    return {'samples': 5, 'mean': mean,
+            'max': {'cpu_util': min(cpu + 0.05, 1.0)}, 'last': last,
+            'last_ts': 0.0, 'sample_age': age, 'skylet_tick_age': tick}
+
+
+def test_aggregate_mean_max_p95():
+    cpus = [0.1, 0.2, 0.3, 0.4]
+    s = fleet.aggregate('c1', [f'rank-{i}' for i in range(4)],
+                        [_snap(c) for c in cpus],
+                        straggler_threshold=1.0)
+    roll = s['rollup']['cpu_util']
+    assert roll['mean'] == pytest.approx(0.25)
+    assert roll['max'] == pytest.approx(0.4)
+    assert roll['p95'] == pytest.approx(0.385)
+    assert s['stragglers'] == []
+    assert s['stale_nodes'] == []
+
+
+def test_straggler_detection_flags_outlier():
+    s = fleet.aggregate('c1', ['rank-0', 'rank-1', 'rank-2', 'rank-3'],
+                        [_snap(0.9), _snap(0.85), _snap(0.88),
+                         _snap(0.1)],
+                        straggler_threshold=0.3)
+    assert s['stragglers'] == ['rank-3']
+    node = next(n for n in s['nodes'] if n['node'] == 'rank-3')
+    assert 'cpu_util' in node['straggler_reason'][0]
+
+
+def test_stale_and_unreachable_nodes():
+    s = fleet.aggregate(
+        'c1', ['rank-0', 'rank-1', 'rank-2'],
+        [_snap(0.5), _snap(0.5, age=500.0, tick=500.0), None],
+        stale_after=120.0)
+    assert s['stale_nodes'] == ['rank-1', 'rank-2']
+    unreachable = next(n for n in s['nodes'] if n['node'] == 'rank-2')
+    assert unreachable['unreachable']
+    # Stale nodes are excluded from the rollup.
+    assert s['rollup']['cpu_util']['mean'] == pytest.approx(0.5)
+
+
+def test_publish_sets_gauges_and_journals_flags():
+    fleet._journaled_flags.clear()
+    s = fleet.aggregate('c1', ['rank-0', 'rank-1', 'rank-2', 'rank-3'],
+                        [_snap(0.9), _snap(0.5), _snap(0.88),
+                         _snap(0.1, age=500.0, tick=500.0)],
+                        straggler_threshold=0.2, stale_after=120.0)
+    assert s['stragglers'] == ['rank-1']  # stale rank-3 is excluded
+    fleet.publish(s)
+    reg = metrics.get_registry()
+    node_cpu = reg.get('skytpu_node_cpu_util')
+    assert node_cpu.value(labels=('c1', 'rank-0')) == pytest.approx(0.9)
+    cluster_cpu = reg.get('skytpu_cluster_cpu_util')
+    assert cluster_cpu.value(labels=('c1', 'max')) == pytest.approx(0.9)
+    tick_age = reg.get('skytpu_skylet_tick_age_seconds')
+    assert tick_age.value(labels=('c1', 'rank-3')) == pytest.approx(500.0)
+    assert reg.get('skytpu_node_stale').value(
+        labels=('c1', 'rank-3')) == 1.0
+    stale_events = journal.query(kinds=[journal.EventKind.NODE_STALE])
+    assert stale_events and stale_events[0]['payload']['node'] == 'rank-3'
+    straggler_events = journal.query(
+        kinds=[journal.EventKind.NODE_STRAGGLER])
+    assert straggler_events
+    assert straggler_events[0]['entity'] == 'cluster:c1'
+    assert straggler_events[0]['payload']['node'] == 'rank-1'
+    # Transition-based journaling: publish() runs on every read path
+    # (`top --watch`, dashboard refresh), so re-publishing the same
+    # flagged state must NOT append events — only a fresh transition
+    # into the flag does, after the node recovered in between.
+    fleet.publish(s)
+    assert len(journal.query(
+        kinds=[journal.EventKind.NODE_STALE])) == len(stale_events)
+    assert len(journal.query(
+        kinds=[journal.EventKind.NODE_STRAGGLER])) == \
+        len(straggler_events)
+    recovered = fleet.aggregate(
+        'c1', ['rank-0', 'rank-1', 'rank-2', 'rank-3'],
+        [_snap(0.9), _snap(0.88), _snap(0.88), _snap(0.89)],
+        straggler_threshold=0.2, stale_after=120.0)
+    assert not recovered['stragglers'] and not recovered['stale_nodes']
+    fleet.publish(recovered)
+    fleet.publish(s)  # regression: flags re-raise → journaled again
+    assert len(journal.query(
+        kinds=[journal.EventKind.NODE_STALE])) == len(stale_events) + 1
+    assert len(journal.query(
+        kinds=[journal.EventKind.NODE_STRAGGLER])) == \
+        len(straggler_events) + 1
+
+
+def test_percentile_interpolation():
+    assert fleet.percentile([], 95) == 0.0
+    assert fleet.percentile([3.0], 95) == 3.0
+    assert fleet.percentile([1.0, 2.0, 3.0, 4.0], 50) == pytest.approx(2.5)
+    assert fleet.percentile([1.0, 2.0], 100) == 2.0
+
+
+def test_format_top_renders_rows_and_rollup():
+    s = fleet.aggregate('demo', ['rank-0', 'rank-1'],
+                        [_snap(0.42), _snap(0.44)],
+                        straggler_threshold=1.0)
+    text = fleet.format_top(s)
+    assert 'rank-0' in text and 'rank-1' in text
+    assert '42.0%' in text
+    assert 'rollup:' in text
+    line = fleet.format_status_line(s)
+    assert '2 node(s)' in line and 'cpu' in line
+
+
+# ----------------------------------------------- codegen / fake-SSH hop
+
+
+def test_node_snapshot_codegen_roundtrip_through_fake_ssh(tmp_path):
+    """The worker-pull path end to end: samples written under a fake
+    node home, the FleetCodeGen snippet executed in a child shell with
+    ONLY that home (the fake SSH hop), snapshot parsed from the marker
+    line — same style as test_journal's trace env round-trip."""
+    node_home = tmp_path / 'node'
+    (node_home / '.skytpu').mkdir(parents=True)
+    seed = (
+        'import sys, time; sys.path.insert(0, sys.argv[1]); '
+        'from skypilot_tpu.observability import timeseries; '
+        'now = time.time(); '
+        "[timeseries.record({'cpu_util': 0.25, 'mem_util': 0.5}, "
+        'ts=now - i) for i in range(5)]')
+    env = {'HOME': str(node_home), 'PATH': os.environ['PATH'],
+           'JAX_PLATFORMS': 'cpu'}
+    proc = subprocess.run(
+        [sys.executable, '-c', seed, REPO_ROOT],
+        env=env, capture_output=True, text=True, check=False, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    # Heartbeat file → snapshot carries a skylet tick age.
+    (node_home / '.skytpu' / 'skylet.heartbeat').write_text('')
+
+    cmd = fleet.FleetCodeGen.node_snapshot(window_seconds=60)
+    # The codegen resolves the package from ~/.skytpu/runtime — point it
+    # at the repo the way post_provision_runtime_setup's sync would.
+    (node_home / '.skytpu' / 'runtime').mkdir()
+    os.symlink(os.path.join(REPO_ROOT, 'skypilot_tpu'),
+               node_home / '.skytpu' / 'runtime' / 'skypilot_tpu')
+    hop = subprocess.run(['/bin/bash', '-c', cmd], env=env,
+                         capture_output=True, text=True, check=False,
+                         timeout=60)
+    assert hop.returncode == 0, hop.stderr
+    snap = fleet.parse_snapshot(hop.stdout)
+    assert snap is not None
+    assert snap['samples'] == 5
+    assert snap['mean']['cpu_util'] == pytest.approx(0.25)
+    assert snap['sample_age'] < 60
+    assert snap['skylet_tick_age'] is not None
+
+
+def test_parse_snapshot_ignores_noise():
+    assert fleet.parse_snapshot('garbage\nmore') is None
+    payload = json.dumps({'samples': 1})
+    out = f'warning: something\n__NODE_STATS__{payload}\n'
+    assert fleet.parse_snapshot(out) == {'samples': 1}
+
+
+# -------------------------------------------- autoscaler utilization blend
+
+
+def test_utilization_demand_math(monkeypatch):
+    from skypilot_tpu.serve import autoscalers
+    monkeypatch.setenv(autoscalers.TARGET_UTIL_ENV, '0.8')
+    assert autoscalers.utilization_demand(4, None) == 0
+    assert autoscalers.utilization_demand(0, 0.9) == 0
+    # 4 replicas at 90% mean util vs 80% target → need ceil(4.5) = 5.
+    assert autoscalers.utilization_demand(4, 0.9) == 5
+    assert autoscalers.utilization_demand(4, 0.4) == 2
+
+
+def test_autoscaler_blends_utilization_floor(monkeypatch):
+    from skypilot_tpu.serve import autoscalers
+    from skypilot_tpu.serve import service_spec
+    monkeypatch.setenv('SKYTPU_SERVE_UPSCALE_DELAY', '0')
+    monkeypatch.setenv('SKYTPU_SERVE_DOWNSCALE_DELAY', '0')
+    monkeypatch.setenv(autoscalers.TARGET_UTIL_ENV, '0.8')
+    spec = service_spec.SkyServiceSpec.from_yaml_config({
+        'readiness_probe': '/', 'replica_policy': {
+            'min_replicas': 1, 'max_replicas': 10,
+            'target_qps_per_replica': 1.0}})
+    a = autoscalers.RequestRateAutoscaler(spec)
+    # No traffic, no utilization → min replicas.
+    assert a.evaluate(2, []) == 1
+    # No traffic but replicas measurably hot → utilization floor wins
+    # (two calls: hysteresis arms on the first over-target tick even
+    # with a zero delay).
+    a.evaluate(2, [], utilization=0.95)
+    assert a.evaluate(2, [], utilization=0.95) == 3
+
+# ------------------------------------------- autostop decision details
+
+
+def test_autostop_evidence_gates_on_cpu_not_hbm(monkeypatch):
+    """HBM occupancy must not gate autostop (a parked model keeps HBM
+    full while doing no work) — it rides along as evidence only."""
+    from skypilot_tpu.skylet import events as events_mod
+    summary = fleet.aggregate('c1', ['rank-0'],
+                              [_snap(0.1, accel=0.97)],
+                              straggler_threshold=1.0)
+    monkeypatch.setattr(
+        fleet, 'local_cluster_snapshot',
+        lambda window_seconds: summary)
+    ev = events_mod.AutostopEvent._utilization_evidence()
+    # Gate value is the CPU window max (_snap: cpu + 0.05), not HBM.
+    assert ev['busiest_util'] == pytest.approx(0.15)
+    assert ev['busiest_accel_mem_util'] == pytest.approx(0.97)
+
+
+def test_autostop_rejournals_new_busy_episode(monkeypatch):
+    """Deferrals dedupe within one busy episode but a NEW episode after
+    intervening queue activity journals again — `skytpu events` must
+    show evidence for why the cluster is (still) up."""
+    from skypilot_tpu.skylet import events as events_mod
+    ev = events_mod.AutostopEvent()
+    journaled = []
+    monkeypatch.setattr(
+        ev, '_journal_decision',
+        lambda decision, *a, **k: journaled.append(decision))
+    monkeypatch.setattr(events_mod.autostop_lib, 'get_autostop_config',
+                        lambda: {'autostop_idle_minutes': 10})
+    monkeypatch.setattr(events_mod.autostop_lib,
+                        'set_last_active_time_to_now', lambda: None)
+    monkeypatch.setenv(events_mod.AutostopEvent.UTIL_THRESHOLD_ENV,
+                       '0.5')
+    monkeypatch.setattr(
+        events_mod.AutostopEvent, '_utilization_evidence',
+        staticmethod(lambda: {'busiest_node': 'rank-0',
+                              'busiest_util': 0.9}))
+    idle = {'v': True}
+    monkeypatch.setattr(events_mod.job_lib, 'is_cluster_idle',
+                        lambda _m: idle['v'])
+    ev.run()
+    ev.run()
+    assert journaled == ['deferred']  # deduped within the episode
+    idle['v'] = False
+    ev.run()                          # queue became active
+    idle['v'] = True
+    ev.run()                          # fresh busy-outside-queue episode
+    assert journaled == ['deferred', 'deferred']
+
+
+def test_autostop_busy_cores_floor_defers(monkeypatch):
+    """The absolute-cores floor makes the busy-loop protection real at
+    DEFAULT thresholds: one pegged core on a many-core host is a tiny
+    CPU fraction but still busy."""
+    from skypilot_tpu.skylet import events as events_mod
+    ev = events_mod.AutostopEvent()
+    journaled = []
+    monkeypatch.setattr(
+        ev, '_journal_decision',
+        lambda decision, *a, **k: journaled.append(decision))
+    monkeypatch.setattr(events_mod.autostop_lib, 'get_autostop_config',
+                        lambda: {'autostop_idle_minutes': 0,
+                                 'last_active_time': 0.0})
+    monkeypatch.setattr(events_mod.autostop_lib,
+                        'set_last_active_time_to_now', lambda: None)
+    monkeypatch.setattr(events_mod.job_lib, 'is_cluster_idle',
+                        lambda _m: True)
+    monkeypatch.delenv(events_mod.AutostopEvent.UTIL_THRESHOLD_ENV,
+                       raising=False)
+    monkeypatch.delenv(events_mod.AutostopEvent.BUSY_CORES_ENV,
+                       raising=False)
+    # 1.5 cores pegged on a 96-core host: fraction 0.016 << 0.9, but
+    # the default 1.0-core floor trips → deferred, not stopped.
+    monkeypatch.setattr(
+        events_mod.AutostopEvent, '_utilization_evidence',
+        staticmethod(lambda: {'busiest_node': 'rank-0',
+                              'busiest_util': 1.5 / 96,
+                              'busiest_cores': 1.5}))
+    ev.run()
+    assert journaled == ['deferred']
+    # With the floor off, the same evidence reads idle → stop path.
+    monkeypatch.setenv(events_mod.AutostopEvent.BUSY_CORES_ENV, 'off')
+    stopped = []
+    monkeypatch.setattr(ev, '_stop_cluster',
+                        lambda *a, **k: stopped.append(1))
+    ev.run()
+    assert stopped == [1]
+
+
+def test_accel_sampling_env_gate(monkeypatch):
+    monkeypatch.setenv('JAX_PLATFORMS', 'tpu')
+    monkeypatch.setenv(timeseries.ACCEL_SAMPLING_ENV, '0')
+    # Kill switch wins even when JAX_PLATFORMS names a chip.
+    assert timeseries.sample_accelerator() == {}
+    # Force-on attempts the probe even without JAX_PLATFORMS; on this
+    # CPU-only host there are no non-CPU devices → still {} (and no
+    # exception from the import path).
+    monkeypatch.setenv('JAX_PLATFORMS', 'cpu')
+    monkeypatch.setenv(timeseries.ACCEL_SAMPLING_ENV, '1')
+    assert timeseries.sample_accelerator() == {}
