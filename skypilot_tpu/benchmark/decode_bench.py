@@ -32,22 +32,28 @@ import jax
 import jax.numpy as jnp
 
 
-def run_decode_bench(model_name: str, batch: int, prompt_len: int,
-                     new_tokens: int, steps: int = 5,
-                     int8: bool = False, kv_int8: bool = False,
-                     attn: str = 'kernel', beat=None) -> dict:
-    from skypilot_tpu.models import decode, llama
-
-    # When a supervising caller passes `beat`, devices are already up
-    # (bench.py's payload ran init_devices) — don't re-init: it would
-    # overwrite the caller's decode-phase heartbeat with 'init'/
-    # 'devices_ok' and put the decode compile under the wrong deadline.
+def _init(beat):
+    """Device init shared by both workloads. When a supervising caller
+    passes `beat`, devices are already up (bench.py's payload ran
+    init_devices) — don't re-init: it would overwrite the caller's
+    decode-phase heartbeat with 'init'/'devices_ok' and put the decode
+    compile under the wrong deadline."""
     if beat is None:
         beat = lambda *_a, **_k: None
         devices = harness.init_devices()
     else:
         import jax as _jax
         devices = _jax.devices()
+    return beat, devices
+
+
+def run_decode_bench(model_name: str, batch: int, prompt_len: int,
+                     new_tokens: int, steps: int = 5,
+                     int8: bool = False, kv_int8: bool = False,
+                     attn: str = 'kernel', eos_id=None, beat=None) -> dict:
+    from skypilot_tpu.models import decode, llama
+
+    beat, devices = _init(beat)
     on_accelerator = devices[0].platform != 'cpu'
     if not on_accelerator:
         # CPU dev fallback: tiny shapes, still one JSON line.
@@ -58,6 +64,7 @@ def run_decode_bench(model_name: str, batch: int, prompt_len: int,
     dcfg = decode.DecodeConfig(
         max_len=prompt_len + new_tokens,
         temperature=0.0,
+        eos_id=eos_id,
         decode_attention=attn,
         kv_cache_dtype='int8' if kv_int8 else 'bf16')
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
@@ -90,7 +97,7 @@ def run_decode_bench(model_name: str, batch: int, prompt_len: int,
     else:
         run_phase = 'decode_run'
 
-    def timed(fn, n) -> float:
+    def timed(fn, n):
         # Warmup/compile; a host fetch is the only reliable sync on the
         # tunneled TPU platform.
         _ = float(jnp.sum(fn(params, prompt, prompt_lens).astype(
@@ -100,13 +107,18 @@ def run_decode_bench(model_name: str, batch: int, prompt_len: int,
         for _ in range(n):
             out = fn(params, prompt, prompt_lens)
         _ = float(jnp.sum(out.astype(jnp.float32)[0]))
-        return (time.perf_counter() - t0) / n
+        return (time.perf_counter() - t0) / n, out
 
-    gen_dt = timed(gen, steps)
-    pre_dt = timed(pre, steps)
+    gen_dt, gen_out = timed(gen, steps)
+    pre_dt, _ = timed(pre, steps)
     decode_dt = max(gen_dt - pre_dt, 1e-9)
 
-    tokens_per_sec = batch * new_tokens / decode_dt
+    # Tokens/s counts COMPLETED tokens: with eos_id set, `generate` pads
+    # post-EOS positions with eos_id — counting those as generated
+    # inflates throughput by exactly the early-stopping fraction.
+    completed = decode.completed_token_counts(gen_out, dcfg.eos_id)
+    completed_total = int(completed.sum())
+    tokens_per_sec = completed_total / decode_dt
     # Serving telemetry: prefill latency IS the time-to-first-token of
     # this static-shape engine, and the decode-phase residual divided by
     # new_tokens is the per-token latency — exactly the split this bench
@@ -115,7 +127,8 @@ def run_decode_bench(model_name: str, batch: int, prompt_len: int,
     runtime_metrics.record_decode_phase(
         prefill_seconds=pre_dt, decode_seconds=decode_dt,
         batch=batch, new_tokens=new_tokens,
-        kv_cache_dtype=dcfg.kv_cache_dtype)
+        kv_cache_dtype=dcfg.kv_cache_dtype,
+        completed_tokens=completed_total)
     # Report the attention path that actually RAN, not the requested one:
     # 'kernel' silently falls back to XLA off-TPU / on non-tiling max_len.
     from skypilot_tpu.ops import decode_attention as decode_attention_ops
@@ -138,6 +151,176 @@ def run_decode_bench(model_name: str, batch: int, prompt_len: int,
             'decode_attention_requested': dcfg.decode_attention,
             'steps': steps,
             'prefill_ms': round(pre_dt * 1e3, 1),
+            'eos_id': dcfg.eos_id,
+            'completed_tokens': completed_total,
+            'completed_tokens_per_seq': completed.tolist(),
+            'device': str(devices[0]),
+        },
+    }
+
+
+def _mixed_requests(vocab_size: int, num_slots: int, n_requests: int,
+                    prompt_lens, new_token_mix, seed: int = 0):
+    """Deterministic mixed-length workload: (prompt, max_new) pairs.
+
+    new_token_mix cycles, so every static batch of ``num_slots``
+    arrival-ordered requests contains at least one long request — the
+    run-to-completion worst case continuous batching exists to fix.
+    """
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    reqs = []
+    for i in range(n_requests):
+        plen = int(prompt_lens[i % len(prompt_lens)])
+        prompt = rng.randint(0, vocab_size, size=plen).tolist()
+        reqs.append((prompt, int(new_token_mix[i % len(new_token_mix)])))
+    return reqs
+
+
+def run_mixed_bench(model_name: str, num_slots: int,
+                    n_requests: int = 0, step_chunk: int = 4,
+                    int8: bool = False, kv_int8: bool = False,
+                    attn: str = 'kernel', eos_id=None,
+                    steps: int = 2, beat=None) -> dict:
+    """Continuous engine vs static batching on mixed-length traffic.
+
+    Both serve the SAME request list end to end (prefill included).
+    Static = the pre-engine serving reality: requests admitted in
+    arrival-order batches of ``num_slots``, every batch padded to one
+    compiled shape and scanned to the global max_new_tokens (one shape =
+    one compile is the whole point of a static engine). Engine = slots
+    evict on per-request EOS/budget and refill from the queue.
+    Throughput counts COMPLETED tokens only, both sides.
+
+    The flight recorder is silenced for the measured passes: the static
+    side journals nothing, and a synthetic bench's admit/evict stream is
+    noise in a real deployment's journal — per-tick sqlite commits would
+    tax only the engine side of the comparison.
+    """
+    import numpy as np
+
+    from skypilot_tpu.models import decode, llama
+    from skypilot_tpu.models import engine as engine_lib
+
+    beat, devices = _init(beat)
+    on_accelerator = devices[0].platform != 'cpu'
+    if on_accelerator:
+        prompt_lens = (64, 96, 128, 192)
+        new_token_mix = (16, 16, 16, 128)  # 3:1 short:long
+        n_requests = n_requests or 3 * num_slots
+        max_len = 384
+    else:
+        # CPU dev fallback: bench-cpu is sized so a decode step is
+        # compute-dominated (the debug model's sub-ms steps would make
+        # this a dispatch-overhead bench); chunk 8 amortizes what
+        # dispatch cost remains.
+        model_name, num_slots, step_chunk = 'bench-cpu', 4, 8
+        prompt_lens = (4, 6, 9, 12)
+        new_token_mix = (6, 6, 6, 96)
+        n_requests = min(n_requests or 16, 16)
+        max_len = 128
+        steps = min(steps, 2)
+
+    cfg = dataclasses.replace(llama.CONFIGS[model_name], remat=False)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    if int8:
+        params = decode.quantize_params(params)
+    dcfg = decode.DecodeConfig(
+        max_len=max_len, temperature=0.0, eos_id=eos_id,
+        decode_attention=attn,
+        kv_cache_dtype='int8' if kv_int8 else 'bf16')
+    requests = _mixed_requests(cfg.vocab_size, num_slots, n_requests,
+                               prompt_lens, new_token_mix)
+    max_new = max(m for _, m in requests)
+    s_static = max(len(p) for p, _ in requests)
+    assert s_static + max_new <= max_len
+
+    def run_static():
+        """Arrival-order batches, one compiled shape, run to
+        completion. Returns (useful_tokens, lane_steps_executed)."""
+        useful = 0
+        batches = 0
+        for i in range(0, len(requests), num_slots):
+            chunk = requests[i:i + num_slots]
+            # Ragged tail: pad with a repeat of the last request — the
+            # static engine must launch its one compiled [B, S] shape.
+            padded = chunk + [chunk[-1]] * (num_slots - len(chunk))
+            prompt = np.zeros((num_slots, s_static), np.int32)
+            lens = np.zeros((num_slots,), np.int32)
+            for j, (p, _) in enumerate(padded):
+                prompt[j, :len(p)] = p
+                lens[j] = len(p)
+            out = decode.generate(params, jnp.asarray(prompt),
+                                  jnp.asarray(lens), cfg, dcfg, max_new)
+            counts = decode.completed_token_counts(out, dcfg.eos_id)
+            for j, (_, m) in enumerate(chunk):
+                useful += int(min(counts[j], m))
+            batches += 1
+        return useful, batches * max_new * num_slots
+
+    def run_engine():
+        eng = engine_lib.DecodeEngine(params, cfg, dcfg, num_slots,
+                                      step_chunk=step_chunk,
+                                      name='decode-bench')
+        reqs = [engine_lib.Request(p, m) for p, m in requests]
+        for r in reqs:
+            eng.submit(r)
+        while not all(r.done for r in reqs):
+            eng.step()
+        return sum(len(r.tokens) for r in reqs), eng.mean_occupancy()
+
+    def timed(fn, n):
+        fn()  # warmup: compiles cached for the measured passes
+        beat('decode_mixed_run')
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn()
+        return (time.perf_counter() - t0) / n, out
+
+    beat('decode_mixed_compile')
+    from skypilot_tpu.observability import journal as journal_lib
+    prev_journal = os.environ.get(journal_lib.DISABLE_ENV)
+    os.environ[journal_lib.DISABLE_ENV] = '1'
+    try:
+        static_dt, (static_useful, static_lane_steps) = timed(run_static,
+                                                              steps)
+        engine_dt, (engine_useful, engine_occupancy) = timed(run_engine,
+                                                             steps)
+    finally:
+        if prev_journal is None:
+            os.environ.pop(journal_lib.DISABLE_ENV, None)
+        else:
+            os.environ[journal_lib.DISABLE_ENV] = prev_journal
+    static_tps = static_useful / max(static_dt, 1e-9)
+    engine_tps = engine_useful / max(engine_dt, 1e-9)
+
+    from skypilot_tpu.ops import decode_attention as decode_attention_ops
+    resolved_attn = (decode_attention_ops.resolved_path(
+        dcfg.max_len, dcfg.kernel_block_k, dcfg.kernel_interpret)
+        if dcfg.decode_attention == 'kernel' else 'xla')
+    return {
+        'metric': 'llama_decode_mixed_tokens_per_sec',
+        'value': round(engine_tps, 1),
+        'unit': 'tokens/s/chip',
+        'detail': {
+            'workload': 'mixed',
+            'model': model_name,
+            'num_slots': num_slots,
+            'n_requests': len(requests),
+            'new_token_mix': list(new_token_mix),
+            'prompt_lens': list(prompt_lens),
+            'step_chunk': step_chunk,
+            'engine_tokens_per_sec': round(engine_tps, 1),
+            'static_tokens_per_sec': round(static_tps, 1),
+            'speedup_vs_static': round(engine_tps / max(static_tps, 1e-9),
+                                       3),
+            'engine_occupancy': round(engine_occupancy, 4),
+            'static_occupancy': round(
+                static_useful / max(static_lane_steps, 1), 4),
+            'useful_tokens': engine_useful,
+            'kv_cache_dtype': dcfg.kv_cache_dtype,
+            'decode_attention': resolved_attn,
+            'steps': steps,
             'device': str(devices[0]),
         },
     }
@@ -146,10 +329,27 @@ def run_decode_bench(model_name: str, batch: int, prompt_len: int,
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='bench-1b')
+    parser.add_argument('--workload', choices=('static', 'mixed'),
+                        default='static',
+                        help='static: one fixed-shape generate() batch; '
+                             'mixed: continuous engine vs static '
+                             'batching on mixed-length traffic')
     parser.add_argument('--batch', type=int, default=16)
     parser.add_argument('--prompt-len', type=int, default=128)
     parser.add_argument('--new-tokens', type=int, default=128)
     parser.add_argument('--steps', type=int, default=5)
+    parser.add_argument('--eos-id', type=int, default=None,
+                        help='stop rows at this token; tokens/s counts '
+                             'completed tokens only')
+    parser.add_argument('--num-slots', type=int, default=32,
+                        help='mixed workload: engine slots / static '
+                             'batch width')
+    parser.add_argument('--requests', type=int, default=0,
+                        help='mixed workload: request count '
+                             '(default 3x slots)')
+    parser.add_argument('--step-chunk', type=int, default=4,
+                        help='mixed workload: fused decode steps per '
+                             'engine tick')
     parser.add_argument('--int8', action='store_true',
                         help='int8-quantize the FFN + attention projection '
                              'weights')
@@ -161,11 +361,19 @@ def main() -> None:
                         help='cached-attention path: Pallas flash-decode '
                              'kernel (TPU) or grouped-einsum XLA')
     args = parser.parse_args()
-    print(json.dumps(run_decode_bench(args.model, args.batch,
-                                      args.prompt_len, args.new_tokens,
-                                      args.steps, int8=args.int8,
-                                      kv_int8=args.kv_int8,
-                                      attn=args.attn)))
+    if args.workload == 'mixed':
+        out = run_mixed_bench(args.model, args.num_slots,
+                              n_requests=args.requests,
+                              step_chunk=args.step_chunk,
+                              int8=args.int8, kv_int8=args.kv_int8,
+                              attn=args.attn, eos_id=args.eos_id,
+                              steps=min(args.steps, 3))
+    else:
+        out = run_decode_bench(args.model, args.batch, args.prompt_len,
+                               args.new_tokens, args.steps,
+                               int8=args.int8, kv_int8=args.kv_int8,
+                               attn=args.attn, eos_id=args.eos_id)
+    print(json.dumps(out))
 
 
 if __name__ == '__main__':

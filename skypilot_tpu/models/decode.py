@@ -147,16 +147,16 @@ def _block_decode(cfg: llama.LlamaConfig, dcfg: DecodeConfig, x: jax.Array,
     return llama.ffn_sublayer(cfg, x, layer), lcache
 
 
-def prefill(params: Params, tokens: jax.Array, cfg: llama.LlamaConfig,
-            cache: Cache, prompt_lens: jax.Array
-            ) -> Tuple[jax.Array, Cache]:
-    """Run the prompt through the model, filling the cache.
-
-    tokens [B, S_prompt] (right-padded); returns (logits at each
-    sequence's last prompt token [B, vocab], cache). An int8 cache
-    (extra scale entries in the pytree) quantizes the K/V prefix at
-    write time.
-    """
+def _prefill_forward(params: Params, tokens: jax.Array,
+                     cfg: llama.LlamaConfig
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The prompt forward pass shared by batch prefill and the engine's
+    slot-targeted prefill: tokens [B, S] → (logits [B, S, V],
+    ks, vs [L, B, S, Hkv, hd]). Causal, so each row's activations depend
+    only on its own prefix — identical whether a prompt runs here in a
+    [B, S] batch or alone in a [1, S_bucket] bucket (what makes the
+    continuous engine's per-request prefill token-equivalent to static
+    batched prefill)."""
     _, s = tokens.shape
     positions = jnp.arange(s, dtype=jnp.int32)
     cos, sin = llama._rope_freqs(cfg, positions)  # pylint: disable=protected-access
@@ -170,13 +170,57 @@ def prefill(params: Params, tokens: jax.Array, cfg: llama.LlamaConfig,
         return llama.ffn_sublayer(cfg, xc, layer), (k, v)
 
     x, (ks, vs) = jax.lax.scan(body, x, params['layers'])
-    # ks/vs: [L, B, S, Hkv, hd] → cache prefix.
-    cache = _write_kv(cache, jnp.index_exp[:, :, :s], ks, vs)
     x = llama.rms_norm(x, params['out_norm'], cfg.norm_eps)
     logits = (x @ params['lm_head']).astype(jnp.float32)  # [B, S, V]
+    return logits, ks, vs
+
+
+def prefill(params: Params, tokens: jax.Array, cfg: llama.LlamaConfig,
+            cache: Cache, prompt_lens: jax.Array
+            ) -> Tuple[jax.Array, Cache]:
+    """Run the prompt through the model, filling the cache.
+
+    tokens [B, S_prompt] (right-padded); returns (logits at each
+    sequence's last prompt token [B, vocab], cache). An int8 cache
+    (extra scale entries in the pytree) quantizes the K/V prefix at
+    write time.
+    """
+    _, s = tokens.shape
+    logits, ks, vs = _prefill_forward(params, tokens, cfg)
+    # ks/vs: [L, B, S, Hkv, hd] → cache prefix.
+    cache = _write_kv(cache, jnp.index_exp[:, :, :s], ks, vs)
     last = jnp.take_along_axis(
         logits, (prompt_lens - 1)[:, None, None], axis=1)[:, 0]
     return last, cache
+
+
+def _prefill_into_slot(params: Params, tokens: jax.Array,
+                       prompt_len: jax.Array, slot: jax.Array,
+                       cfg: llama.LlamaConfig, cache: Cache
+                       ) -> Tuple[jax.Array, Cache]:
+    """Prefill ONE request into ONE lane of a multi-slot cache.
+
+    tokens [1, S_bucket] right-padded, prompt_len/slot scalar int32;
+    cache [L, num_slots, max_len, Hkv, hd]. The K/V prefix scatters into
+    lane ``slot`` positions [0, S_bucket) (int8 caches quantize on the
+    way in via ``_write_kv``); every other lane's entries are untouched,
+    so the continuous engine can refill a freed slot while its neighbors
+    are mid-decode. Returns (last-prompt-token logits [vocab], cache).
+    """
+    _, s = tokens.shape
+    logits, ks, vs = _prefill_forward(params, tokens, cfg)
+    # Lane scatter: value [L, S, Hkv, hd] lands at [:, slot, :s].
+    cache = _write_kv(cache, jnp.index_exp[:, slot, :s],
+                      ks[:, 0], vs[:, 0])
+    return logits[0, prompt_len - 1], cache
+
+
+# Engine-serving entry point (models/engine.py): the multi-slot cache is
+# DONATED — prefilling a slot updates the persistent cache buffers in
+# place; callers must rebind to the returned cache. One compile per
+# prompt bucket length.
+prefill_into_slot = jax.jit(_prefill_into_slot, static_argnames=('cfg',),
+                            donate_argnums=(5,))
 
 
 def _decode_step(params: Params, token: jax.Array, pos: jax.Array,
@@ -287,3 +331,22 @@ def generate(params: Params,
     tokens, _ = _generate_impl(params, prompt, prompt_lens, cfg, dcfg,
                                max_new_tokens, rng, cache)
     return tokens
+
+
+def completed_token_counts(tokens, eos_id: Optional[int]):
+    """Per-sequence GENERATED token counts of a [B, T] generation.
+
+    The EOS token itself counts (the model produced it); the post-EOS
+    positions — which ``generate`` pads with ``eos_id`` — do not.
+    Benchmarks must divide by this, not ``B * T``: counting the padding
+    inflates tokens/s by exactly the fraction of the batch that stopped
+    early. ``eos_id=None`` → every position counts. Host-side numpy.
+    """
+    import numpy as np
+    t = np.asarray(tokens)
+    b, n = t.shape
+    if eos_id is None:
+        return np.full((b,), n, dtype=np.int64)
+    is_eos = t == eos_id
+    return np.where(is_eos.any(axis=1), is_eos.argmax(axis=1) + 1,
+                    n).astype(np.int64)

@@ -1,0 +1,507 @@
+"""Continuous-batching decode engine: slot-scheduled serving on one cache.
+
+``decode.generate`` is static-batch, run-to-completion: a batch is
+admitted together, the scan runs every ``max_new_tokens`` step even after
+all rows hit EOS, and a new request waits behind the longest sequence in
+flight. On accelerators that is the dominant serving throughput loss —
+the chip's batch lanes sit idle exactly when traffic is mixed-length,
+which is always (the TPU concurrency-utilization problem of PAPERS.md's
+"Exploring the limits of Concurrency in ML Training on Google TPUs",
+applied to inference).
+
+:class:`DecodeEngine` replaces run-to-completion with slot scheduling
+(the vLLM/JetStream continuous-batching model, on the in-tree
+flash-decode path):
+
+* **One persistent KV cache** of ``num_slots`` lanes
+  (``[L, num_slots, max_len, Hkv, hd]``, bf16 or int8+scales), donated
+  through every jitted call so prefill scatters and per-step updates
+  mutate the same HBM buffers for the life of the process.
+* **insert()** prefills a single request ([1, S_bucket] — prompt lengths
+  round up to a small set of bucket shapes so compiles stay bounded) and
+  scatters its K/V prefix into a free lane via
+  ``decode.prefill_into_slot``; the first token samples from the prefill
+  logits, so TTFT does not wait for a decode step.
+* **step()** runs ``step_chunk`` batched ``decode_step`` s across ALL
+  slots with per-slot positions; per-slot EOS/budget masks freeze
+  finished lanes (their emitted positions are forced to EOS exactly like
+  ``generate``'s done mask, which is what makes greedy engine output
+  token-identical to static ``generate``).
+* Finished slots are **evicted and immediately refilled** from the
+  admission queue, so a short request never waits for a long one and
+  lane occupancy stays high. Occupancy is measured, not assumed:
+  ``stats()['mean_occupancy']`` is delivered-tokens / lane-steps.
+
+Host/device split: per-slot scheduling state (which request owns which
+lane, budgets, done flags) lives in numpy mirrors; each ``step()`` makes
+one jitted call of ``step_chunk`` fused decode steps and one host fetch.
+``step_chunk`` amortizes dispatch overhead; 1 gives token-granular
+streaming and exact occupancy accounting.
+
+Telemetry: ``skytpu_engine_*`` metrics through the process registry
+(queue depth, slot occupancy, admitted/evicted counters, TTFT and
+per-token histograms) and ``engine.admit``/``engine.evict`` flight-
+recorder events, so a serving replica's scheduling decisions are
+reconstructable after the fact.
+"""
+import collections
+import functools
+import itertools
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.models import decode, llama
+from skypilot_tpu.observability import journal
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import runtime_metrics
+
+IDLE_SLEEP_ENV = 'SKYTPU_ENGINE_IDLE_SLEEP_SECONDS'
+
+
+class Request:
+    """One generation request tracked through the engine.
+
+    ``on_token(token, done)`` (optional) fires from the engine loop
+    thread per generated token — the model server bridges it onto its
+    asyncio loop for SSE streaming. ``tokens`` accumulates the full
+    generation; ``wait()`` blocks until eviction.
+    """
+    _ids = itertools.count()
+
+    def __init__(self, prompt: Sequence[int], max_new_tokens: int,
+                 on_token: Optional[Callable[[int, bool], None]] = None,
+                 request_id: Optional[str] = None):
+        if max_new_tokens < 1:
+            raise ValueError(f'max_new_tokens must be >= 1, got '
+                             f'{max_new_tokens}')
+        self.prompt = [int(t) for t in prompt]
+        if not self.prompt:
+            raise ValueError('empty prompt')
+        self.max_new_tokens = int(max_new_tokens)
+        self.on_token = on_token
+        self.id = (request_id if request_id is not None
+                   else f'r{next(self._ids)}')
+        self.tokens: List[int] = []
+        self.finish_reason: Optional[str] = None
+        self.enqueue_ts: Optional[float] = None
+        self.first_token_ts: Optional[float] = None
+        self.finish_ts: Optional[float] = None
+        self._done = threading.Event()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    # ------------------------------------------------- engine-side hooks
+
+    def _deliver(self, token: int, done: bool) -> None:
+        self.tokens.append(token)
+        if self.first_token_ts is None:
+            self.first_token_ts = time.perf_counter()
+        if self.on_token is not None:
+            self.on_token(token, done)
+
+    def _finish(self, reason: str) -> None:
+        self.finish_reason = reason
+        self.finish_ts = time.perf_counter()
+        self._done.set()
+
+
+@functools.partial(jax.jit,
+                   static_argnames=('cfg', 'dcfg', 'n_steps'),
+                   donate_argnums=(6,))
+def _engine_steps_impl(params, token, pos, done, remaining, keys, cache,
+                       cfg: llama.LlamaConfig, dcfg: decode.DecodeConfig,
+                       n_steps: int):
+    """``n_steps`` fused decode steps over every slot.
+
+    token/pos/remaining [num_slots] int32, done [num_slots] bool, keys
+    [n_steps, 2] uint32 (sampling; unused for greedy), cache donated.
+    Per-step semantics mirror ``decode._generate_impl.step`` exactly for
+    live lanes (same sample → EOS-mask → done-fold order, so greedy
+    output is token-identical); done lanes additionally FREEZE their
+    position instead of advancing, bounding writes for lanes that idle
+    across many chunks (emitted tokens are forced to EOS either way, so
+    the freeze is unobservable in the output stream).
+
+    Returns (tokens [n_steps, num_slots], token, pos, done, remaining,
+    cache).
+    """
+    def step(carry, key):
+        tok, p, dn, rem, cache_c = carry
+        logits, cache_c = decode._decode_step(  # pylint: disable=protected-access
+            params, tok, p, cfg, dcfg, cache_c)
+        nxt = decode._sample(logits, key, dcfg.temperature)  # pylint: disable=protected-access
+        if dcfg.eos_id is not None:
+            nxt = jnp.where(dn, dcfg.eos_id, nxt)
+            dn_new = dn | (nxt == dcfg.eos_id)
+        else:
+            nxt = jnp.where(dn, tok, nxt)
+            dn_new = dn
+        # One budget unit per live step; exhaustion folds into done.
+        rem = rem - jnp.where(dn, 0, 1)
+        dn_new = dn_new | (rem <= 0)
+        p = jnp.where(dn, p, p + 1)
+        return (nxt, p, dn_new, rem, cache_c), nxt
+
+    (token, pos, done, remaining, cache), toks = jax.lax.scan(
+        step, (token, pos, done, remaining, cache), keys)
+    return toks, token, pos, done, remaining, cache
+
+
+@functools.partial(jax.jit, static_argnames=('cfg',), donate_argnums=(4,))
+def _prefill_greedy_impl(params, tokens, prompt_len, slot, cache,
+                         cfg: llama.LlamaConfig):
+    """Greedy insert fast path: prefill + first-token argmax in ONE
+    dispatch. Sampling (temperature > 0) keeps the two-call path — it
+    needs the raw logits on the engine side."""
+    last, cache = decode._prefill_into_slot(  # pylint: disable=protected-access
+        params, tokens, prompt_len, slot, cfg, cache)
+    return jnp.argmax(last).astype(jnp.int32), cache
+
+
+def _default_buckets(max_len: int) -> Tuple[int, ...]:
+    """Prompt-length buckets: powers of two from 8 up to max_len (one
+    prefill compile per bucket actually used)."""
+    buckets = []
+    b = 8
+    while b < max_len:
+        buckets.append(min(b, max_len))
+        b *= 2
+    if not buckets or buckets[-1] < max_len:
+        buckets.append(max_len)
+    return tuple(buckets)
+
+
+class DecodeEngine:
+    """Slot-based continuous-batching engine over ``models/decode``.
+
+    Thread model: ``submit()`` is thread-safe (the server's request
+    handlers call it); ``insert()``/``step()``/``run_forever()`` must run
+    on ONE engine loop thread (they own the donated cache).
+    """
+
+    def __init__(self, params, cfg: llama.LlamaConfig,
+                 dcfg: decode.DecodeConfig, num_slots: int,
+                 step_chunk: int = 1,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 rng: Optional[jax.Array] = None,
+                 name: str = 'engine'):
+        if num_slots < 1:
+            raise ValueError(f'num_slots must be >= 1, got {num_slots}')
+        if step_chunk < 1:
+            raise ValueError(f'step_chunk must be >= 1, got {step_chunk}')
+        self.params = params
+        self.cfg = cfg
+        self.dcfg = dcfg
+        self.num_slots = num_slots
+        self.step_chunk = step_chunk
+        self.name = name
+        self._buckets = (tuple(sorted(int(b) for b in prefill_buckets))
+                         if prefill_buckets
+                         else _default_buckets(dcfg.max_len))
+        assert self._buckets[-1] <= dcfg.max_len, self._buckets
+        self._cache = decode.init_kv_cache(cfg, num_slots, dcfg.max_len,
+                                           dcfg.kv_cache_dtype)
+        # Host mirrors of per-slot device state.
+        self._slots: List[Optional[Request]] = [None] * num_slots
+        self._token = np.zeros((num_slots,), np.int32)
+        self._pos = np.zeros((num_slots,), np.int32)
+        self._done = np.ones((num_slots,), bool)
+        self._remaining = np.zeros((num_slots,), np.int32)
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        # Greedy decoding ignores sampling keys; reuse one zero buffer
+        # instead of allocating [step_chunk, 2] on every tick.
+        self._zero_keys = jnp.zeros((step_chunk, 2), jnp.uint32)
+        # Admission queue: appended by any thread, drained by the loop.
+        self._queue_lock = threading.Lock()
+        self._queue: collections.deque = collections.deque()
+        # Occupancy accounting: tokens delivered from decode steps vs
+        # lane-steps executed (prefill-sampled first tokens excluded —
+        # they cost a prefill, not a decode lane-step).
+        self._decode_steps = 0
+        self._decode_emitted = 0
+        self._admitted = 0
+        self._evicted = 0
+        # Flight-recorder buffer: admit/evict events batch into ONE
+        # sqlite transaction per tick (journal.event_batch) — a per-event
+        # commit costs an fsync, which at token-loop rates would dominate
+        # the decode step itself on slow filesystems. Locked: stats()
+        # flushes from the HTTP thread while the loop appends.
+        self._journal_lock = threading.Lock()
+        self._journal_buf: List[tuple] = []
+        self._m = metrics_lib
+        self._m.gauge('skytpu_engine_num_slots',
+                      'Configured KV-cache lanes.').set(num_slots)
+        self._publish_slot_gauges()
+
+    # ------------------------------------------------------------ intake
+
+    def submit(self, request: Request) -> Request:
+        """Enqueue a request for admission (thread-safe)."""
+        request.enqueue_ts = time.perf_counter()
+        with self._queue_lock:
+            self._queue.append(request)
+            depth = len(self._queue)
+        self._m.gauge('skytpu_engine_queue_depth',
+                      'Requests waiting for a free slot.').set(depth)
+        return request
+
+    def queue_depth(self) -> int:
+        with self._queue_lock:
+            return len(self._queue)
+
+    def free_slots(self) -> int:
+        return sum(1 for r in self._slots if r is None)
+
+    def active_slots(self) -> int:
+        return self.num_slots - self.free_slots()
+
+    # --------------------------------------------------------- admission
+
+    def insert(self, request: Request) -> int:
+        """Prefill one request and scatter its K/V prefix into a free
+        slot; the first token samples from the prefill logits. Returns
+        the slot index. Raises RuntimeError when no slot is free (use
+        ``submit`` + the engine loop for queued admission)."""
+        slot = next((i for i, r in enumerate(self._slots) if r is None),
+                    None)
+        if slot is None:
+            raise RuntimeError('no free slot')
+        p = len(request.prompt)
+        if p + request.max_new_tokens > self.dcfg.max_len:
+            raise ValueError(
+                f'prompt ({p}) + max_new_tokens '
+                f'({request.max_new_tokens}) exceeds max_len '
+                f'{self.dcfg.max_len}')
+        bucket = next(b for b in self._buckets if b >= p)
+        if request.enqueue_ts is None:
+            request.enqueue_ts = time.perf_counter()
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :p] = request.prompt
+        if self.dcfg.temperature == 0.0:
+            first_dev, self._cache = _prefill_greedy_impl(
+                self.params, jnp.asarray(padded), jnp.int32(p),
+                jnp.int32(slot), self._cache, cfg=self.cfg)
+            first = int(first_dev)
+        else:
+            last, self._cache = decode.prefill_into_slot(
+                self.params, jnp.asarray(padded), jnp.int32(p),
+                jnp.int32(slot), self.cfg, self._cache)
+            first = int(self._sample_first(last))
+        self._m.histogram(
+            'skytpu_engine_ttft_seconds',
+            'Time from enqueue to first token (includes queueing).',
+            buckets=runtime_metrics.TTFT_BUCKETS).observe(
+                time.perf_counter() - request.enqueue_ts)
+        self._admitted += 1
+        self._m.counter('skytpu_engine_admitted_total',
+                        'Requests admitted into a slot.').inc()
+        self._m.counter('skytpu_engine_tokens_total',
+                        'Tokens generated by the engine.').inc()
+        self._journal(journal.EventKind.ENGINE_ADMIT, request, slot,
+                      prompt_len=p, bucket=bucket,
+                      max_new_tokens=request.max_new_tokens)
+        hit_eos = (self.dcfg.eos_id is not None and
+                   first == self.dcfg.eos_id)
+        first_done = hit_eos or request.max_new_tokens == 1
+        request._deliver(first, done=first_done)  # pylint: disable=protected-access
+        self._slots[slot] = request
+        if first_done:
+            # One-token request (or immediate EOS): never occupies a
+            # decode lane.
+            self._evict(slot, 'eos' if hit_eos else 'length')
+            return slot
+        self._token[slot] = first
+        self._pos[slot] = p
+        self._done[slot] = False
+        self._remaining[slot] = request.max_new_tokens - 1
+        self._publish_slot_gauges()
+        return slot
+
+    def _sample_first(self, last_logits: jax.Array) -> int:
+        self._rng, key = jax.random.split(self._rng)
+        return int(decode._sample(last_logits[None], key,  # pylint: disable=protected-access
+                                  self.dcfg.temperature)[0])
+
+    def _admit(self) -> int:
+        """Fill free slots from the queue; returns admissions made."""
+        n = 0
+        while True:
+            if self.free_slots() == 0:
+                break
+            with self._queue_lock:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+                depth = len(self._queue)
+            self._m.gauge('skytpu_engine_queue_depth',
+                          'Requests waiting for a free slot.').set(depth)
+            try:
+                self.insert(req)
+                n += 1
+            except ValueError as e:
+                # Oversized request: fail it, keep serving the rest.
+                req._finish(f'error: {e}')  # pylint: disable=protected-access
+        return n
+
+    # ------------------------------------------------------------- step
+
+    def step(self) -> int:
+        """Admit, then run one chunk of fused decode steps across all
+        slots. Returns the number of slots that were active (0 = idle:
+        nothing queued, nothing decoding)."""
+        self._admit()
+        active = self.active_slots()
+        if active == 0:
+            return 0
+        n = self.step_chunk
+        if self.dcfg.temperature > 0.0:
+            self._rng, sub = jax.random.split(self._rng)
+            keys = jax.random.split(sub, n)
+        else:
+            keys = self._zero_keys
+        t0 = time.perf_counter()
+        toks, token, pos, done, remaining, self._cache = \
+            _engine_steps_impl(self.params, jnp.asarray(self._token),
+                               jnp.asarray(self._pos),
+                               jnp.asarray(self._done),
+                               jnp.asarray(self._remaining), keys,
+                               self._cache, cfg=self.cfg, dcfg=self.dcfg,
+                               n_steps=n)
+        # One fused host fetch (the sync point); np.array copies because
+        # the transferred buffers are read-only and the slot mirrors are
+        # mutated by eviction/refill.
+        toks_np, token, pos, done, remaining = jax.device_get(
+            (toks, token, pos, done, remaining))
+        self._token = np.array(token)
+        self._pos = np.array(pos)
+        self._done = np.array(done)
+        self._remaining = np.array(remaining)
+        dt = time.perf_counter() - t0
+        self._decode_steps += n
+        self._m.counter('skytpu_engine_steps_total',
+                        'Batched decode steps executed.').inc(n)
+        self._m.histogram('skytpu_engine_token_seconds',
+                          'Per-token decode step latency.',
+                          buckets=runtime_metrics.TOKEN_LATENCY_BUCKETS
+                          ).observe(dt / n)
+        self._deliver_chunk(toks_np)
+        # Refill freed lanes NOW so the next chunk runs full.
+        self._admit()
+        self.flush_journal()
+        return active
+
+    def _deliver_chunk(self, toks_np: np.ndarray) -> None:
+        eos = self.dcfg.eos_id
+        emitted = 0
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            budget = req.max_new_tokens - len(req.tokens)
+            reason = None
+            for j in range(toks_np.shape[0]):
+                t = int(toks_np[j, slot])
+                budget -= 1
+                emitted += 1
+                hit_eos = eos is not None and t == eos
+                req._deliver(t, done=hit_eos or budget <= 0)  # pylint: disable=protected-access
+                if hit_eos:
+                    reason = 'eos'
+                    break
+                if budget <= 0:
+                    reason = 'length'
+                    break
+            if reason is not None:
+                self._evict(slot, reason)
+        self._decode_emitted += emitted
+        self._m.counter('skytpu_engine_tokens_total',
+                        'Tokens generated by the engine.').inc(emitted)
+
+    def _evict(self, slot: int, reason: str) -> None:
+        req = self._slots[slot]
+        assert req is not None
+        self._slots[slot] = None
+        self._done[slot] = True
+        self._remaining[slot] = 0
+        self._evicted += 1
+        self._m.counter('skytpu_engine_evicted_total',
+                        'Requests evicted from a slot (finished).').inc()
+        self._journal(journal.EventKind.ENGINE_EVICT, req, slot,
+                      reason=reason, generated=len(req.tokens))
+        req._finish(reason)  # pylint: disable=protected-access
+        self._publish_slot_gauges()
+
+    # ------------------------------------------------------------- loop
+
+    def run_forever(self, stop_event: threading.Event) -> None:
+        """Engine loop: step while there is work, sleep briefly when
+        idle. Run on a dedicated thread; ``stop_event`` ends it."""
+        try:
+            idle = float(os.environ.get(IDLE_SLEEP_ENV, '0.02'))
+        except ValueError:
+            idle = 0.02
+        while not stop_event.is_set():
+            if self.step() == 0:
+                self.flush_journal()  # one-token admissions while idle
+                time.sleep(idle)
+
+    # ------------------------------------------------------------ stats
+
+    def mean_occupancy(self) -> float:
+        """Delivered decode tokens / executed lane-steps: the measured
+        fraction of batch lanes doing useful work."""
+        lane_steps = self._decode_steps * self.num_slots
+        return self._decode_emitted / lane_steps if lane_steps else 0.0
+
+    def stats(self) -> dict:
+        self.flush_journal()
+        return {
+            'num_slots': self.num_slots,
+            'active_slots': self.active_slots(),
+            'queue_depth': self.queue_depth(),
+            'admitted': self._admitted,
+            'evicted': self._evicted,
+            'decode_steps': self._decode_steps,
+            'decode_tokens': self._decode_emitted,
+            'mean_occupancy': round(self.mean_occupancy(), 4),
+            'step_chunk': self.step_chunk,
+            'kv_cache_dtype': self.dcfg.kv_cache_dtype,
+            'max_len': self.dcfg.max_len,
+        }
+
+    # ---------------------------------------------------------- plumbing
+
+    def _publish_slot_gauges(self) -> None:
+        self._m.gauge('skytpu_engine_active_slots',
+                      'Slots currently decoding.').set(self.active_slots())
+        self._m.gauge(
+            'skytpu_engine_slot_occupancy',
+            'Measured decode-lane occupancy (delivered tokens / '
+            'lane-steps).').set(self.mean_occupancy())
+
+    def _journal(self, kind, request: Request, slot: int,
+                 **payload) -> None:
+        with self._journal_lock:
+            self._journal_buf.append(
+                (kind, f'engine:{self.name}',
+                 {'request': request.id, 'slot': slot, **payload},
+                 time.time()))
+
+    def flush_journal(self) -> None:
+        """Write buffered admit/evict events in one transaction. Called
+        per tick by ``step()``; direct ``insert()`` drivers (tests) call
+        it, or ``stats()``, to see their rows."""
+        with self._journal_lock:
+            buf, self._journal_buf = self._journal_buf, []
+        if buf:
+            journal.event_batch(buf)

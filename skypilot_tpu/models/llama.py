@@ -102,6 +102,13 @@ CONFIGS: Dict[str, LlamaConfig] = {
     'bench-1b': LlamaConfig(vocab_size=32768, dim=2048, n_layers=16,
                             n_heads=16, n_kv_heads=8, ffn_dim=8192,
                             max_seq_len=2048, ce_chunks=8),
+    # CPU-scale bench model: big enough that a decode step is compute-
+    # (not dispatch-) dominated on a laptop/CI core, so scheduling
+    # benches (decode_bench --workload mixed) measure batching policy
+    # rather than framework overhead. 'debug' stays the test model.
+    'bench-cpu': LlamaConfig(vocab_size=2048, dim=256, n_layers=3,
+                             n_heads=4, n_kv_heads=2, ffn_dim=768,
+                             max_seq_len=256, remat=False),
     'debug': LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
                          n_kv_heads=2, ffn_dim=128, max_seq_len=128,
                          remat=False),

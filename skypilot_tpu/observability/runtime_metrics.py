@@ -113,9 +113,13 @@ class TrainTelemetry:
 
 def record_decode_phase(prefill_seconds: float, decode_seconds: float,
                         batch: int, new_tokens: int,
-                        kv_cache_dtype: str = 'bf16') -> None:
+                        kv_cache_dtype: str = 'bf16',
+                        completed_tokens: Optional[int] = None) -> None:
     """Record one decode run: TTFT (prefill latency) and per-token decode
-    latency histograms, plus generated-token/request counters."""
+    latency histograms, plus generated-token/request counters.
+    ``completed_tokens`` overrides the ``batch * new_tokens`` token count
+    when the caller knows how many tokens were actually generated (EOS
+    stops rows early; the padding is not generated output)."""
     metrics.histogram('skytpu_decode_ttft_seconds',
                       'Time to first token (prefill latency).',
                       labels=('kv_cache_dtype',),
@@ -129,7 +133,9 @@ def record_decode_phase(prefill_seconds: float, decode_seconds: float,
                               decode_seconds / new_tokens,
                               labels=(kv_cache_dtype,))
     metrics.counter('skytpu_decode_tokens_total',
-                    'Tokens generated by decode.').inc(batch * new_tokens)
+                    'Tokens generated by decode.').inc(
+                        batch * new_tokens if completed_tokens is None
+                        else completed_tokens)
     # skytpu_decode_requests_total is incremented by decode.generate
     # itself (every serving call), not here — this helper only adds the
     # latency view that needs a sync boundary.

@@ -1,0 +1,345 @@
+"""First-party model server: HTTP + SSE streaming over the decode engine.
+
+The serve plane (LB → autoscaler → replicas) used to proxy to arbitrary
+replica commands — ``python3 -m http.server`` in the examples. This is
+the real data plane: an asyncio HTTP server whose request queue feeds a
+:class:`skypilot_tpu.models.engine.DecodeEngine` running on a background
+thread, so every replica launched by ``skytpu serve up`` is a genuine
+continuous-batching token-streaming worker.
+
+Endpoints:
+
+* ``POST /generate`` — body ``{"prompt": [token ids...]}`` or
+  ``{"text": "..."}`` plus optional ``max_new_tokens`` and
+  ``stream`` (default true). Streaming responses are Server-Sent
+  Events, one ``data: {"token": ..., "text": ..., "done": ...}`` event
+  per generated token as the engine emits it (the LB already streams
+  chunk-by-chunk, so tokens reach the client while the replica is still
+  decoding); the final event carries ``finish_reason`` and counts.
+  ``stream: false`` returns one JSON object after eviction.
+* ``GET /healthz`` — readiness probe target: 200 with engine stats
+  while the engine loop thread is alive, 503 after it dies.
+* ``GET /metrics`` — Prometheus text exposition of the process registry
+  (all ``skytpu_engine_*`` series plus whatever else the process
+  records), so the fleet scrape path needs no extra exporter port.
+
+Tokenizer note: the in-tree models are research checkpoints without a
+shipped tokenizer, so ``text`` uses a byte-level demo codec (UTF-8 bytes
+→ ids; ids → bytes mod 256). Real deployments send token ids.
+
+Request flow: the aiohttp handler builds an ``engine.Request`` whose
+``on_token`` callback trampolines tokens onto the asyncio loop via
+``call_soon_threadsafe`` into a per-request ``asyncio.Queue`` — the
+engine thread never blocks on a slow client, and a slow client only
+backlogs its own queue.
+"""
+import argparse
+import asyncio
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.models import decode
+from skypilot_tpu.models import engine as engine_lib
+from skypilot_tpu.models import llama
+from skypilot_tpu.observability import metrics as metrics_lib
+
+logger = sky_logging.init_logger(__name__)
+
+REPLICA_PORT_ENV = 'SKYTPU_REPLICA_PORT'
+# Cap on one request's SSE lifetime: a wedged engine must not hold LB
+# connections forever (the LB's sock_read timeout is 300s).
+REQUEST_TIMEOUT_ENV = 'SKYTPU_MODEL_SERVER_REQUEST_TIMEOUT'
+
+
+def encode_text(text: str, vocab_size: int) -> list:
+    """Demo byte-level codec: UTF-8 bytes → token ids (< vocab_size)."""
+    return [b % vocab_size for b in text.encode('utf-8')]
+
+
+def decode_tokens(tokens) -> str:
+    """Inverse demo codec: ids → bytes (mod 256), lossy for vocab>256."""
+    return bytes(t % 256 for t in tokens).decode('utf-8',
+                                                 errors='replace')
+
+
+class ModelServer:
+    """aiohttp front end + engine loop thread, one process per replica."""
+
+    def __init__(self, engine: engine_lib.DecodeEngine, port: int,
+                 host: str = '0.0.0.0',
+                 default_max_new_tokens: int = 128):
+        self.engine = engine
+        self.host = host
+        self.port = port  # rebound to the OS-assigned port when 0
+        self.default_max_new_tokens = default_max_new_tokens
+        try:
+            self.request_timeout = float(
+                os.environ.get(REQUEST_TIMEOUT_ENV, '300'))
+        except ValueError:
+            self.request_timeout = 300.0
+        self._stop = threading.Event()
+        self._engine_thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> int:
+        """In-proc mode (tests): serve from a daemon thread; returns the
+        bound port."""
+        self._thread = threading.Thread(target=self.run_forever,
+                                        daemon=True,
+                                        name='skytpu-model-server')
+        self._thread.start()
+        if not self._started.wait(timeout=60):
+            raise RuntimeError('Model server failed to start.')
+        return self.port
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._engine_thread is not None:
+            self._engine_thread.join(timeout=10)
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def run_forever(self) -> None:
+        """Standalone mode: engine thread + HTTP server until stopped."""
+        self._engine_thread = threading.Thread(
+            target=self.engine.run_forever, args=(self._stop,),
+            daemon=True, name='skytpu-engine')
+        self._engine_thread.start()
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_until_complete(self._setup())
+        self._started.set()
+        try:
+            self._loop.run_forever()
+        finally:
+            self._stop.set()
+            self._loop.run_until_complete(self._teardown())
+            self._loop.close()
+
+    async def _setup(self) -> None:
+        app = web.Application()
+        app.router.add_post('/generate', self._handle_generate)
+        app.router.add_get('/healthz', self._handle_healthz)
+        app.router.add_get('/metrics', self._handle_metrics)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = site._server.sockets[0].getsockname()[1]  # pylint: disable=protected-access
+        logger.info(f'Model server listening on :{self.port} '
+                    f'({self.engine.num_slots} slots, '
+                    f'max_len {self.engine.dcfg.max_len}).')
+
+    async def _teardown(self) -> None:
+        await self._runner.cleanup()
+
+    # ----------------------------------------------------------- handlers
+
+    async def _handle_generate(self, request: web.Request
+                               ) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return web.json_response({'error': 'invalid JSON body'},
+                                     status=400)
+        vocab = self.engine.cfg.vocab_size
+        if 'prompt' in body:
+            try:
+                tokens = [int(t) % vocab for t in body['prompt']]
+            except (TypeError, ValueError):
+                return web.json_response(
+                    {'error': 'prompt must be a list of token ids'},
+                    status=400)
+        elif 'text' in body and isinstance(body['text'], str):
+            tokens = encode_text(body['text'], vocab)
+        else:
+            return web.json_response(
+                {'error': 'body needs "prompt" (token ids) or "text"'},
+                status=400)
+        if not tokens:
+            return web.json_response({'error': 'empty prompt'},
+                                     status=400)
+        try:
+            max_new = int(body.get('max_new_tokens',
+                                   self.default_max_new_tokens))
+        except (TypeError, ValueError):
+            return web.json_response(
+                {'error': 'max_new_tokens must be an integer'},
+                status=400)
+        limit = self.engine.dcfg.max_len - len(tokens)
+        if limit < 1:
+            return web.json_response(
+                {'error': f'prompt too long: {len(tokens)} tokens, '
+                          f'max_len {self.engine.dcfg.max_len}'},
+                status=400)
+        max_new = max(1, min(max_new, limit))
+        stream = bool(body.get('stream', True))
+
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def on_token(token: int, done: bool) -> None:
+            loop.call_soon_threadsafe(q.put_nowait, (token, done))
+
+        req = engine_lib.Request(tokens, max_new, on_token=on_token)
+        self.engine.submit(req)
+        metrics_lib.counter('skytpu_engine_requests_total',
+                            'HTTP /generate requests accepted.',
+                            labels=('stream',)).inc(
+                                labels=(str(stream).lower(),))
+        if stream:
+            return await self._stream_response(request, req, q)
+        return await self._unary_response(req, q)
+
+    async def _next_token(self, q: asyncio.Queue):
+        return await asyncio.wait_for(q.get(),
+                                      timeout=self.request_timeout)
+
+    async def _stream_response(self, http_request: web.Request,
+                               req: engine_lib.Request, q: asyncio.Queue
+                               ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={'Content-Type': 'text/event-stream',
+                     'Cache-Control': 'no-cache',
+                     'X-Accel-Buffering': 'no'})
+        await resp.prepare(http_request)
+        try:
+            while True:
+                token, done = await self._next_token(q)
+                event = {'token': token,
+                         'text': decode_tokens([token]), 'done': done}
+                if done:
+                    event['finish_reason'] = req.finish_reason
+                    event['generated'] = len(req.tokens)
+                await resp.write(
+                    f'data: {json.dumps(event)}\n\n'.encode())
+                if done:
+                    break
+        except asyncio.TimeoutError:
+            await resp.write(
+                f'data: {json.dumps({"error": "timeout"})}\n\n'.encode())
+        await resp.write_eof()
+        return resp
+
+    async def _unary_response(self, req: engine_lib.Request,
+                              q: asyncio.Queue) -> web.Response:
+        try:
+            while True:
+                _, done = await self._next_token(q)
+                if done:
+                    break
+        except asyncio.TimeoutError:
+            return web.json_response({'error': 'timeout'}, status=504)
+        return web.json_response({
+            'tokens': req.tokens,
+            'text': decode_tokens(req.tokens),
+            'finish_reason': req.finish_reason,
+            'generated': len(req.tokens),
+        })
+
+    async def _handle_healthz(self, request: web.Request) -> web.Response:
+        alive = (self._engine_thread is not None and
+                 self._engine_thread.is_alive())
+        stats = self.engine.stats()
+        line = ' '.join(f'{k}={v}' for k, v in stats.items())
+        if not alive:
+            return web.Response(status=503,
+                                text=f'engine thread dead {line}\n')
+        return web.Response(text=f'ok {line}\n')
+
+    async def _handle_metrics(self, request: web.Request) -> web.Response:
+        return web.Response(body=metrics_lib.generate_latest(),
+                            content_type='text/plain', charset='utf-8')
+
+
+def build_engine(model: str, num_slots: int, max_len: int,
+                 temperature: float = 0.0, eos_id: Optional[int] = None,
+                 kv_int8: bool = False, int8: bool = False,
+                 attn: str = 'kernel', step_chunk: int = 4,
+                 checkpoint_dir: Optional[str] = None, seed: int = 0
+                 ) -> engine_lib.DecodeEngine:
+    """Assemble params + configs into a DecodeEngine (CLI + tests)."""
+    import jax
+    cfg = llama.CONFIGS[model]
+    params = llama.init_params(jax.random.PRNGKey(seed), cfg)
+    if checkpoint_dir:
+        from skypilot_tpu.models import checkpoint
+        restored = checkpoint.restore_latest(checkpoint_dir, params)
+        if restored is None:
+            logger.warning(f'No complete checkpoint under '
+                           f'{checkpoint_dir}; serving random init.')
+        else:
+            params, step = restored
+            logger.info(f'Restored checkpoint step {step} from '
+                        f'{checkpoint_dir}.')
+    if int8:
+        params = decode.quantize_params(params)
+    dcfg = decode.DecodeConfig(
+        max_len=max_len, temperature=temperature, eos_id=eos_id,
+        decode_attention=attn,
+        kv_cache_dtype='int8' if kv_int8 else 'bf16')
+    return engine_lib.DecodeEngine(params, cfg, dcfg, num_slots,
+                                   step_chunk=step_chunk, name=model)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description='First-party continuous-batching model server.')
+    parser.add_argument('--port', type=int,
+                        default=int(os.environ.get(REPLICA_PORT_ENV,
+                                                   '8000')))
+    parser.add_argument('--host', default='0.0.0.0')
+    parser.add_argument('--model', default='debug',
+                        choices=sorted(llama.CONFIGS))
+    parser.add_argument('--num-slots', type=int, default=8,
+                        help='KV-cache lanes (continuous batch width); '
+                             'see docs/serving.md for the HBM math')
+    parser.add_argument('--max-len', type=int, default=2048,
+                        help='per-slot KV capacity (prompt + generation)')
+    parser.add_argument('--max-new-tokens', type=int, default=128,
+                        help='default generation budget per request')
+    parser.add_argument('--step-chunk', type=int, default=4,
+                        help='fused decode steps per engine tick '
+                             '(dispatch amortization vs stream '
+                             'granularity)')
+    parser.add_argument('--temperature', type=float, default=0.0)
+    parser.add_argument('--eos-id', type=int, default=None)
+    parser.add_argument('--int8', action='store_true',
+                        help='int8-quantize the GEMM weights')
+    parser.add_argument('--kv-int8', action='store_true',
+                        help='int8 KV cache')
+    parser.add_argument('--attn', choices=('kernel', 'xla'),
+                        default='kernel')
+    parser.add_argument('--checkpoint-dir', default=None,
+                        help='restore params from models/checkpoint '
+                             'layout (default: random init — demo mode)')
+    parser.add_argument('--seed', type=int, default=0)
+    args = parser.parse_args()
+    engine = build_engine(args.model, args.num_slots, args.max_len,
+                          temperature=args.temperature,
+                          eos_id=args.eos_id, kv_int8=args.kv_int8,
+                          int8=args.int8, attn=args.attn,
+                          step_chunk=args.step_chunk,
+                          checkpoint_dir=args.checkpoint_dir,
+                          seed=args.seed)
+    server = ModelServer(engine, args.port, host=args.host,
+                         default_max_new_tokens=args.max_new_tokens)
+    server.run_forever()
+
+
+if __name__ == '__main__':
+    main()

@@ -51,6 +51,11 @@ def _isolate_state(tmp_path, monkeypatch):
     monkeypatch.setenv('SKYTPU_SERVE_CONTROLLER_INTERVAL', '0.5')
     monkeypatch.setenv('SKYTPU_GANG_GRACE_SECONDS', '0.4')
     monkeypatch.setenv('SKYTPU_JOBS_RETRY_GAP_SECONDS', '0.5')
+    # Fast engine ticks: the model-server e2e's replica engines poll
+    # their admission queue at this idle interval, so first-token
+    # latency through the full LB path stays milliseconds, not the
+    # production 20ms.
+    monkeypatch.setenv('SKYTPU_ENGINE_IDLE_SLEEP_SECONDS', '0.002')
     # Local-process controllers by default (fast path); the
     # controller-as-cluster tests opt back into 'cluster'.
     monkeypatch.setenv('SKYTPU_CONTROLLER_MODE', 'local')

@@ -65,6 +65,84 @@ def test_eos_masking():
     assert np.asarray(gen == first).all()
 
 
+def test_post_eos_positions_hold_eos_id_ragged():
+    """``generate()``'s docstring promise, actually asserted: once a row
+    emits ``eos_id`` every later position holds ``eos_id``, per row, on
+    a ragged-length batch where rows stop at different steps (the
+    property the engine's eviction logic and the benchmark's
+    completed-token accounting both lean on)."""
+    params = _params()
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (3, 8), 0,
+                                CFG.vocab_size)
+    lens = jnp.array([8, 5, 3], jnp.int32)
+    n_new = 8
+    probe = np.asarray(decode.generate(params, prompt, lens, CFG,
+                                       decode.DecodeConfig(max_len=32),
+                                       n_new))
+    # Row 0's 2nd greedy token as EOS (distinct from its 1st) → that row
+    # stops after exactly 2 tokens; other rows stop wherever (or never)
+    # that id shows up for them.
+    eos = int(probe[0, 1])
+    assert eos != int(probe[0, 0])
+    dcfg = decode.DecodeConfig(max_len=32, eos_id=eos)
+    gen = np.asarray(decode.generate(params, prompt, lens, CFG, dcfg,
+                                     n_new))
+    counts = decode.completed_token_counts(gen, eos)
+    assert counts[0] == 2
+    for b in range(3):
+        c = int(counts[b])
+        # Pre-EOS (and the EOS itself) the masked run emits exactly the
+        # unmasked greedy tokens — masking only rewrites the suffix...
+        np.testing.assert_array_equal(gen[b, :c], probe[b, :c])
+        # ...and the entire suffix is eos_id, nothing else.
+        assert (gen[b, c:] == eos).all(), (b, gen[b].tolist())
+    # eos_id=None counts every position.
+    np.testing.assert_array_equal(
+        decode.completed_token_counts(gen, None), [n_new] * 3)
+
+
+def test_eos_and_ragged_lens_int8_kv_interpret():
+    """EOS masking + per-row ``prompt_lens`` hold on the int8-KV cache
+    path with the Pallas kernel forced into interpret mode (CPU): token
+    stream identical to the int8 XLA path, post-EOS suffix is all
+    ``eos_id``, and a right-padded shorter row decodes from its declared
+    length, not the padded width."""
+    params = _params()
+    prompt = jax.random.randint(jax.random.PRNGKey(6), (2, 8), 0,
+                                CFG.vocab_size)
+    prompt = prompt.at[1].set(prompt[0])  # same tokens, shorter declared
+    lens = jnp.array([8, 5], jnp.int32)
+    kw = dict(max_len=32, kv_cache_dtype='int8')
+    probe = np.asarray(decode.generate(
+        params, prompt, lens, CFG,
+        decode.DecodeConfig(decode_attention='xla', **kw), 6))
+    eos = int(probe[0, 1])
+    assert eos != int(probe[0, 0])
+    gen_xla = np.asarray(decode.generate(
+        params, prompt, lens, CFG,
+        decode.DecodeConfig(decode_attention='xla', eos_id=eos, **kw), 6))
+    kern = decode.DecodeConfig(decode_attention='kernel',
+                               kernel_block_k=16, kernel_interpret=True,
+                               eos_id=eos, **kw)
+    from skypilot_tpu.ops import decode_attention as decode_attention_ops
+    assert decode_attention_ops.resolved_path(
+        kern.max_len, kern.kernel_block_k,
+        kern.kernel_interpret) == 'kernel'
+    gen_kern = np.asarray(decode.generate(params, prompt, lens, CFG,
+                                          kern, 6))
+    np.testing.assert_array_equal(gen_kern, gen_xla)
+    counts = decode.completed_token_counts(gen_kern, eos)
+    assert counts[0] == 2  # the engineered early stop fired on this path
+    for b in range(2):
+        assert (gen_kern[b, counts[b]:] == eos).all()
+    # Per-row lens: identical token content, different declared lengths
+    # → row 1's first generated token comes from position 4's logits,
+    # which must equal a fresh run of just the 5-token prefix.
+    solo = np.asarray(decode.generate(
+        params, prompt[1:, :5], jnp.array([5], jnp.int32), CFG, kern, 6))
+    np.testing.assert_array_equal(gen_kern[1], solo[0])
+
+
 def test_sampled_decode_is_finite_and_in_range():
     params = _params()
     prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 4), 0,

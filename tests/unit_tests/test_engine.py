@@ -1,0 +1,218 @@
+"""Continuous-batching decode engine: greedy equivalence vs static
+``generate()``, slot eviction/refill, EOS/budget semantics, occupancy
+accounting, and flight-recorder/metrics wiring. Tier-1, CPU.
+
+The load-bearing property is TOKEN-FOR-TOKEN equivalence: slot
+scheduling (per-request prefill into a shared cache, mixed per-slot
+positions, mid-run eviction + refill) must be invisible in the output —
+greedy engine tokens equal the static batch's rows exactly, trimmed to
+each request's own budget/EOS.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import decode
+from skypilot_tpu.models import engine as engine_lib
+from skypilot_tpu.models import llama
+from skypilot_tpu.observability import journal
+from skypilot_tpu.observability import metrics
+
+pytestmark = pytest.mark.engine
+
+CFG = llama.CONFIGS['debug']
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    prev = metrics.set_registry(metrics.MetricsRegistry())
+    yield
+    metrics.set_registry(prev)
+
+
+def _params():
+    return llama.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _prompts(n=5, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, CFG.vocab_size,
+                        size=int(rng.randint(3, 10))).tolist()
+            for _ in range(n)]
+
+
+def _static(params, prompts, dcfg, max_new):
+    s = max(len(p) for p in prompts)
+    batch = np.zeros((len(prompts), s), np.int32)
+    for i, p in enumerate(prompts):
+        batch[i, :len(p)] = p
+    lens = jnp.asarray([len(p) for p in prompts], jnp.int32)
+    return np.asarray(decode.generate(params, jnp.asarray(batch), lens,
+                                      CFG, dcfg, max_new))
+
+
+def _drain(eng, reqs, max_steps=500, submit=True):
+    if submit:
+        for r in reqs:
+            eng.submit(r)
+    steps = 0
+    while not all(r.done for r in reqs):
+        eng.step()
+        steps += 1
+        assert steps < max_steps, 'engine did not converge'
+
+
+@pytest.mark.parametrize('step_chunk', [1, 4])
+def test_greedy_engine_matches_static_generate(step_chunk):
+    """5 requests through 2 slots: slots evict and refill mid-run
+    (request 3+ only admits after an earlier one finishes), and every
+    request's tokens equal its static-batch row trimmed to its own
+    budget."""
+    params = _params()
+    prompts = _prompts()
+    max_news = [4, 8, 3, 6, 8]
+    dcfg = decode.DecodeConfig(max_len=32)
+    static = _static(params, prompts, dcfg, max_new=8)
+
+    eng = engine_lib.DecodeEngine(params, CFG, dcfg, num_slots=2,
+                                  step_chunk=step_chunk,
+                                  prefill_buckets=(16,))
+    reqs = [engine_lib.Request(p, m) for p, m in zip(prompts, max_news)]
+    _drain(eng, reqs)
+    for i, r in enumerate(reqs):
+        assert r.tokens == static[i, :max_news[i]].tolist(), i
+        assert r.finish_reason == 'length'
+    stats = eng.stats()
+    assert stats['admitted'] == stats['evicted'] == 5
+    assert stats['active_slots'] == 0 and stats['queue_depth'] == 0
+
+
+def test_engine_eos_matches_static_and_strips_padding():
+    """EOS mid-run: the engine emits exactly the completed prefix
+    (EOS inclusive) that static generate pads out to max_new."""
+    params = _params()
+    prompts = _prompts()
+    dcfg0 = decode.DecodeConfig(max_len=32)
+    probe = _static(params, prompts, dcfg0, max_new=8)
+    eos = int(probe[0, 1])  # row 0's 2nd greedy token → early stop
+    dcfg = decode.DecodeConfig(max_len=32, eos_id=eos)
+    static = _static(params, prompts, dcfg, max_new=8)
+    counts = decode.completed_token_counts(static, eos)
+    assert counts[0] == 2  # the engineered early stop actually fired
+
+    eng = engine_lib.DecodeEngine(params, CFG, dcfg, num_slots=2,
+                                  step_chunk=3, prefill_buckets=(16,))
+    reqs = [engine_lib.Request(p, 8) for p in prompts]
+    _drain(eng, reqs)
+    for i, r in enumerate(reqs):
+        assert r.tokens == static[i, :counts[i]].tolist(), i
+    assert reqs[0].finish_reason == 'eos'
+
+
+def test_engine_int8_kv_matches_static_int8():
+    """The slot-targeted prefill quantizes its K/V scatter exactly like
+    batch prefill: int8-cache engine == int8-cache static, per token."""
+    params = _params()
+    prompts = _prompts(n=3, seed=7)
+    dcfg = decode.DecodeConfig(max_len=32, kv_cache_dtype='int8',
+                               decode_attention='xla')
+    static = _static(params, prompts, dcfg, max_new=5)
+    eng = engine_lib.DecodeEngine(params, CFG, dcfg, num_slots=2,
+                                  step_chunk=2, prefill_buckets=(16,))
+    reqs = [engine_lib.Request(p, 5) for p in prompts]
+    _drain(eng, reqs)
+    for i, r in enumerate(reqs):
+        assert r.tokens == static[i].tolist(), i
+
+
+def test_insert_requires_free_slot_and_validates():
+    params = _params()
+    dcfg = decode.DecodeConfig(max_len=32)
+    eng = engine_lib.DecodeEngine(params, CFG, dcfg, num_slots=1,
+                                  prefill_buckets=(16,))
+    eng.insert(engine_lib.Request([1, 2, 3], 4))
+    with pytest.raises(RuntimeError):
+        eng.insert(engine_lib.Request([1, 2, 3], 4))
+    with pytest.raises(ValueError):
+        # prompt + budget exceeds max_len
+        engine_lib.Request([1] * 16, 20)
+        eng2 = engine_lib.DecodeEngine(params, CFG, dcfg, num_slots=1,
+                                       prefill_buckets=(16,))
+        eng2.insert(engine_lib.Request([1] * 16, 20))
+    with pytest.raises(ValueError):
+        engine_lib.Request([], 4)
+    with pytest.raises(ValueError):
+        engine_lib.Request([1], 0)
+
+
+def test_one_token_request_never_occupies_a_lane():
+    params = _params()
+    dcfg = decode.DecodeConfig(max_len=32)
+    eng = engine_lib.DecodeEngine(params, CFG, dcfg, num_slots=1,
+                                  prefill_buckets=(16,))
+    r = engine_lib.Request([5, 6, 7], 1)
+    eng.insert(r)
+    assert r.done and len(r.tokens) == 1
+    assert r.finish_reason == 'length'
+    assert eng.free_slots() == 1
+    assert eng.stats()['decode_steps'] == 0
+
+
+def test_streaming_callback_order_and_done_flag():
+    params = _params()
+    dcfg = decode.DecodeConfig(max_len=32)
+    eng = engine_lib.DecodeEngine(params, CFG, dcfg, num_slots=1,
+                                  prefill_buckets=(16,))
+    seen = []
+    r = engine_lib.Request([3, 1, 4], 4,
+                           on_token=lambda t, d: seen.append((t, d)))
+    _drain(eng, [r])
+    assert [t for t, _ in seen] == r.tokens
+    assert [d for _, d in seen] == [False, False, False, True]
+
+
+def test_occupancy_and_metrics_and_journal():
+    params = _params()
+    dcfg = decode.DecodeConfig(max_len=32)
+    eng = engine_lib.DecodeEngine(params, CFG, dcfg, num_slots=2,
+                                  step_chunk=1, prefill_buckets=(16,),
+                                  name='t-eng')
+    reqs = [engine_lib.Request(p, 6) for p in _prompts(n=4, seed=3)]
+    _drain(eng, reqs)
+    stats = eng.stats()
+    # 4 requests x 5 decode tokens (first comes from prefill) over 2
+    # lanes: occupancy is well-defined and high for equal-length work.
+    assert 0.5 < stats['mean_occupancy'] <= 1.0
+    assert stats['decode_tokens'] == 4 * 5
+    # Metrics surfaced through the (test-fresh) registry.
+    reg = metrics.get_registry()
+    assert reg.get('skytpu_engine_admitted_total').value() == 4
+    assert reg.get('skytpu_engine_evicted_total').value() == 4
+    assert reg.get('skytpu_engine_ttft_seconds').count() == 4
+    assert reg.get('skytpu_engine_active_slots').value() == 0
+    assert reg.get('skytpu_engine_tokens_total').value() == 4 * 6
+    # Admission/eviction journaled (batched per tick) with request ids.
+    admits = journal.query(kinds=[journal.EventKind.ENGINE_ADMIT],
+                           entity='engine:t-eng', limit=50)
+    evicts = journal.query(kinds=[journal.EventKind.ENGINE_EVICT],
+                           entity='engine:t-eng', limit=50)
+    assert len(admits) == 4 and len(evicts) == 4
+    assert {e['payload']['request'] for e in evicts} == \
+        {r.id for r in reqs}
+    assert all(e['payload']['reason'] == 'length' for e in evicts)
+
+
+def test_fifo_admission_order():
+    params = _params()
+    dcfg = decode.DecodeConfig(max_len=32)
+    eng = engine_lib.DecodeEngine(params, CFG, dcfg, num_slots=1,
+                                  prefill_buckets=(16,))
+    reqs = [engine_lib.Request([i + 1, i + 2], 2) for i in range(3)]
+    finished = []
+    for r in reqs:
+        r.on_token = (lambda rr: lambda t, d:
+                      finished.append(rr.id) if d else None)(r)
+        eng.submit(r)
+    _drain(eng, reqs, submit=False)  # already submitted; just drive
+    assert finished == [r.id for r in reqs]

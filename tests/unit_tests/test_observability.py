@@ -284,7 +284,20 @@ def test_all_registered_metric_names_match_convention():
                      'skytpu_node_cpu_util', 'skytpu_node_mem_util',
                      'skytpu_cluster_cpu_util',
                      'skytpu_skylet_tick_age_seconds',
-                     'skytpu_serve_replica_util'):
+                     'skytpu_serve_replica_util',
+                     # Continuous-batching engine + model server
+                     # (ISSUE 5).
+                     'skytpu_engine_num_slots',
+                     'skytpu_engine_queue_depth',
+                     'skytpu_engine_active_slots',
+                     'skytpu_engine_slot_occupancy',
+                     'skytpu_engine_tokens_total',
+                     'skytpu_engine_steps_total',
+                     'skytpu_engine_admitted_total',
+                     'skytpu_engine_evicted_total',
+                     'skytpu_engine_ttft_seconds',
+                     'skytpu_engine_token_seconds',
+                     'skytpu_engine_requests_total'):
         assert expected in names, f'{expected} not found by lint scan'
 
 
@@ -326,7 +339,9 @@ def test_all_journal_event_kinds_are_registered():
                      'BACKEND_JOB_SUBMIT',
                      # Fleet telemetry plane (ISSUE 4).
                      'NODE_STALE', 'NODE_STRAGGLER',
-                     'SKYLET_EVENT_ERROR', 'SKYLET_AUTOSTOP'):
+                     'SKYLET_EVENT_ERROR', 'SKYLET_AUTOSTOP',
+                     # Decode engine slot scheduling (ISSUE 5).
+                     'ENGINE_ADMIT', 'ENGINE_EVICT'):
         assert expected in attr_names, \
             f'EventKind.{expected} not found by lint scan'
 
