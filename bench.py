@@ -64,6 +64,11 @@ _PHASE_DEADLINES = {
     'decode_int8_run': 150,
     'decode_kv_int8_compile': 180,
     'decode_kv_int8_run': 150,
+    'decode_prefix_compile': 180,
+    'decode_prefix_run': 150,
+    # CPU failover tier (engine-scheduler phase; ROADMAP item 5).
+    'sched_compile': 240,
+    'sched_run': 150,
 }
 
 
@@ -200,6 +205,28 @@ def _payload() -> None:
             }
         except Exception as exc:  # decode is best-effort
             decode_detail[name] = {'error': f'{type(exc).__name__}: {exc}'}
+    # Paged KV + prefix reuse: the shared-prefix workload reports the
+    # admitted-concurrency win of the paged engine vs the dense cache
+    # at the SAME HBM budget (plus prefill tokens saved). Best-effort
+    # like the rest of the decode tail.
+    try:
+        harness.beat('decode_prefix_compile')
+        out = decode_bench.run_prefix_bench(
+            model_name if on_tpu else 'debug',
+            num_slots=int(os.environ.get('SKYTPU_BENCH_PREFIX_SLOTS',
+                                         '8')),
+            beat=harness.beat)
+        decode_detail['prefix'] = {
+            'tokens_per_sec': out['value'],
+            **{k: out['detail'][k]
+               for k in ('prefix_share', 'dense_admitted_concurrency',
+                         'paged_admitted_concurrency',
+                         'concurrency_gain', 'prefill_tokens_saved',
+                         'prefix_hit_ratio', 'block_k')},
+        }
+    except Exception as exc:
+        decode_detail['prefix'] = {
+            'error': f'{type(exc).__name__}: {exc}'}
     bf16 = decode_detail.get('bf16', {}).get('tokens_per_sec')
     i8 = decode_detail.get('int8', {}).get('tokens_per_sec')
     kv8 = decode_detail.get('kv_int8', {}).get('tokens_per_sec')
@@ -210,6 +237,20 @@ def _payload() -> None:
     result['detail']['decode'] = decode_detail
     # Cumulative line #2: train + decode. Last line wins.
     print(json.dumps(result), flush=True)
+
+
+def _payload_sched() -> None:
+    """CPU failover payload: the device-agnostic engine-scheduler bench
+    (continuous-batching + paged/prefix scheduling on the debug model).
+    Spawned by the supervisor with JAX_PLATFORMS=cpu when the TPU path
+    produced nothing, so a perf round NEVER goes dark — the emitted
+    line carries a ``platform`` tag to keep trends attributable."""
+    from skypilot_tpu.benchmark import harness
+
+    harness.beat('start')
+    from skypilot_tpu.benchmark import decode_bench
+    out = decode_bench.run_scheduler_bench(beat=harness.beat)
+    print(json.dumps(out), flush=True)
 
 
 # ---------------------------------------------------------------------------
@@ -230,21 +271,27 @@ def _kill_group(proc: subprocess.Popen) -> None:
             continue
 
 
-def _run_attempt(hb_path: str, budget_left: float) -> tuple:
+def _run_attempt(hb_path: str, budget_left: float,
+                 payload_flag: str = '--payload',
+                 extra_env: dict = None,
+                 cmd_override_env: str = 'SKYTPU_BENCH_PAYLOAD_CMD'
+                 ) -> tuple:
     """One supervised payload run. Returns (result_line|None, reason)."""
     from skypilot_tpu.benchmark import harness
 
     env = dict(os.environ)
+    env.update(extra_env or {})
     env[harness.HEARTBEAT_ENV] = hb_path
     try:
         os.unlink(hb_path)
     except OSError:
         pass
-    # Test hook: SKYTPU_BENCH_PAYLOAD_CMD simulates stalled/failing
-    # payloads without real TPU init.
-    cmd_override = os.environ.get('SKYTPU_BENCH_PAYLOAD_CMD')
+    # Test hooks: SKYTPU_BENCH_PAYLOAD_CMD (and its *_SCHED_* twin for
+    # the CPU failover tier) simulate stalled/failing payloads without
+    # real TPU init.
+    cmd_override = os.environ.get(cmd_override_env)
     cmd = ([sys.executable, '-c', cmd_override] if cmd_override else
-           [sys.executable, os.path.abspath(__file__), '--payload'])
+           [sys.executable, os.path.abspath(__file__), payload_flag])
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE, stderr=sys.stderr,
         text=True, start_new_session=True, env=env, cwd=REPO_ROOT)
@@ -340,7 +387,7 @@ def _supervise() -> int:
             log(f'[bench] FATAL: relay never came up within {preflight}s '
                 '— TPU tunnel is down; not attempting PJRT init (it '
                 'would hang forever). See BENCH notes in harness.py.')
-            return 2
+            return _cpu_fallback(log, rc=2)
         reaped = harness.reap_holders(log=log)
         if reaped:
             log(f'[bench] reaped {len(reaped)} stale client(s); '
@@ -382,14 +429,53 @@ def _supervise() -> int:
         pass
     if best_line is None:
         log('[bench] FATAL: no result after all attempts')
-        return 3
+        return _cpu_fallback(log, rc=3)
     # Result lines were forwarded live by the attempt reader; the last
     # stdout line is the (most complete) result.
     return 0
 
 
+def _cpu_fallback(log, rc: int) -> int:
+    """The TPU path produced NOTHING — run the device-agnostic
+    engine-scheduler phase on the CPU backend so the round still lands
+    a (platform-tagged) perf line instead of going dark (ROADMAP item
+    5: BENCH r03-r05 measured nothing). Returns 0 when the fallback
+    emits a result, else the original failure rc. Opt out with
+    SKYTPU_BENCH_CPU_FALLBACK=0 (used by tests asserting the hard-fail
+    paths)."""
+    if os.environ.get('SKYTPU_BENCH_CPU_FALLBACK', '1') != '1':
+        return rc
+    log('[bench] failing over to the CPU engine-scheduler phase '
+        '(platform-tagged result; scheduler logic is device-agnostic)')
+    budget = float(os.environ.get('SKYTPU_BENCH_FALLBACK_TIMEOUT',
+                                  '300'))
+    hb_path = os.path.join(tempfile.gettempdir(),
+                           f'skytpu_bench_fb_hb_{os.getpid()}.json')
+    try:
+        line, reason = _run_attempt(
+            hb_path, budget, payload_flag='--payload-sched',
+            # Empty PALLAS_AXON_POOL_IPS: the axon plugin's own gate
+            # reads truthiness, so this cleanly de-arms the TPU tunnel
+            # in the child without needing env deletion.
+            extra_env={'JAX_PLATFORMS': 'cpu',
+                       'PALLAS_AXON_POOL_IPS': ''},
+            cmd_override_env='SKYTPU_BENCH_SCHED_PAYLOAD_CMD')
+    finally:
+        try:
+            os.unlink(hb_path)
+        except OSError:
+            pass
+    if line is None:
+        log(f'[bench] CPU fallback also failed: {reason}')
+        return rc
+    log('[bench] CPU fallback landed a scheduler-phase result')
+    return 0
+
+
 if __name__ == '__main__':
-    if '--payload' in sys.argv:
+    if '--payload-sched' in sys.argv:
+        _payload_sched()
+    elif '--payload' in sys.argv:
         _payload()
     else:
         sys.exit(_supervise())

@@ -8,9 +8,19 @@ static-shape KV-cache prefill + scanned decode). Prefill time is measured
 separately and subtracted, so the reported number is DECODE tokens/s.
 
 Serving knobs under test: ``--int8`` (weight GEMMs), ``--kv-int8``
-(int8 KV cache — halves the cache bandwidth decode is bound by) and
+(int8 KV cache — halves the cache bandwidth decode is bound by),
 ``--attn kernel|xla`` (the Pallas flash-decode kernel of
-``ops/decode_attention.py`` vs the grouped-einsum XLA path).
+``ops/decode_attention.py`` vs the grouped-einsum XLA path),
+``--paged``/``--block-k`` (block-pooled paged KV + radix prefix cache
+in the engine workloads) and ``--prefix-share`` (fraction of the
+``prefix`` workload's requests sharing one long system-prompt prefix).
+
+Workloads: ``static`` (fixed-shape generate), ``mixed`` (continuous
+engine vs static batching), ``prefix`` (shared-prefix traffic: paged
+engine at the DENSE cache's exact HBM budget vs the dense engine —
+reports admitted concurrency, prefill tokens saved, prefix-hit ratio)
+and ``sched`` (device-agnostic engine-scheduler phase, the CPU
+failover tier of bench.py — same heartbeat schema, ``platform`` tag).
 
 Prints ONE JSON line:
     {"metric": "llama_decode_tokens_per_sec", "value": N,
@@ -30,6 +40,26 @@ from skypilot_tpu.benchmark import harness
 
 import jax
 import jax.numpy as jnp
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _journal_disabled():
+    """Silence the flight recorder for measured engine passes: a
+    synthetic bench's admit/evict stream is journal noise, and per-tick
+    sqlite commits would tax only the engine side of a comparison."""
+    from skypilot_tpu.observability import journal as journal_lib
+    prev = os.environ.get(journal_lib.DISABLE_ENV)
+    os.environ[journal_lib.DISABLE_ENV] = '1'
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(journal_lib.DISABLE_ENV, None)
+        else:
+            os.environ[journal_lib.DISABLE_ENV] = prev
 
 
 def _init(beat):
@@ -181,7 +211,8 @@ def run_mixed_bench(model_name: str, num_slots: int,
                     n_requests: int = 0, step_chunk: int = 4,
                     int8: bool = False, kv_int8: bool = False,
                     attn: str = 'kernel', eos_id=None,
-                    steps: int = 2, beat=None) -> dict:
+                    steps: int = 2, beat=None,
+                    paged: bool = False, block_k=None) -> dict:
     """Continuous engine vs static batching on mixed-length traffic.
 
     Both serve the SAME request list end to end (prefill included).
@@ -225,9 +256,13 @@ def run_mixed_bench(model_name: str, num_slots: int,
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     if int8:
         params = decode.quantize_params(params)
+    if block_k is None:
+        # Paged pools block at the kernel KV-block size; the CPU dev
+        # fallback's tiny max_len needs a matching tiny block.
+        block_k = 128 if on_accelerator else 16
     dcfg = decode.DecodeConfig(
         max_len=max_len, temperature=0.0, eos_id=eos_id,
-        decode_attention=attn,
+        decode_attention=attn, kernel_block_k=block_k,
         kv_cache_dtype='int8' if kv_int8 else 'bf16')
     requests = _mixed_requests(cfg.vocab_size, num_slots, n_requests,
                                prompt_lens, new_token_mix)
@@ -261,7 +296,7 @@ def run_mixed_bench(model_name: str, num_slots: int,
     def run_engine():
         eng = engine_lib.DecodeEngine(params, cfg, dcfg, num_slots,
                                       step_chunk=step_chunk,
-                                      name='decode-bench')
+                                      name='decode-bench', paged=paged)
         reqs = [engine_lib.Request(p, m) for p, m in requests]
         for r in reqs:
             eng.submit(r)
@@ -278,19 +313,11 @@ def run_mixed_bench(model_name: str, num_slots: int,
         return (time.perf_counter() - t0) / n, out
 
     beat('decode_mixed_compile')
-    from skypilot_tpu.observability import journal as journal_lib
-    prev_journal = os.environ.get(journal_lib.DISABLE_ENV)
-    os.environ[journal_lib.DISABLE_ENV] = '1'
-    try:
+    with _journal_disabled():
         static_dt, (static_useful, static_lane_steps) = timed(run_static,
                                                               steps)
         engine_dt, (engine_useful, engine_occupancy) = timed(run_engine,
                                                              steps)
-    finally:
-        if prev_journal is None:
-            os.environ.pop(journal_lib.DISABLE_ENV, None)
-        else:
-            os.environ[journal_lib.DISABLE_ENV] = prev_journal
     static_tps = static_useful / max(static_dt, 1e-9)
     engine_tps = engine_useful / max(engine_dt, 1e-9)
 
@@ -305,6 +332,8 @@ def run_mixed_bench(model_name: str, num_slots: int,
         'detail': {
             'workload': 'mixed',
             'model': model_name,
+            'paged': paged,
+            'block_k': block_k if paged else None,
             'num_slots': num_slots,
             'n_requests': len(requests),
             'new_token_mix': list(new_token_mix),
@@ -326,14 +355,251 @@ def run_mixed_bench(model_name: str, num_slots: int,
     }
 
 
+def _prefix_requests(vocab_size: int, n_requests: int, prefix_len: int,
+                     suffix_lens, new_token_mix, prefix_share: float,
+                     seed: int = 0):
+    """Shared-prefix workload: ``prefix_share`` of the requests open
+    with ONE common prefix (the system-prompt/few-shot-template shape of
+    production traffic) followed by a unique suffix; the rest are fully
+    unique. Short decodes, so cache capacity — not decode FLOPs — is
+    what limits concurrency."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    shared = rng.randint(0, vocab_size, size=prefix_len).tolist()
+    reqs = []
+    for i in range(n_requests):
+        suf = rng.randint(
+            0, vocab_size,
+            size=int(suffix_lens[i % len(suffix_lens)])).tolist()
+        prompt = (shared + suf if i < prefix_share * n_requests
+                  else rng.randint(0, vocab_size,
+                                   size=prefix_len).tolist() + suf)
+        reqs.append((prompt, int(new_token_mix[i % len(new_token_mix)])))
+    rng.shuffle(reqs)
+    return reqs
+
+
+def _drive_engine(eng, engine_lib, requests):
+    """Submit all requests, step to drain; returns (useful_tokens,
+    max_concurrent_active, steps)."""
+    reqs = [engine_lib.Request(p, m) for p, m in requests]
+    for r in reqs:
+        eng.submit(r)
+    max_active = 0
+    steps = 0
+    while not all(r.done for r in reqs):
+        eng.step()
+        steps += 1
+        max_active = max(max_active, eng.active_slots())
+    return sum(len(r.tokens) for r in reqs), max_active, steps
+
+
+def run_prefix_bench(model_name: str, num_slots: int = 8,
+                     n_requests: int = 0, prefix_share: float = 0.75,
+                     block_k=None, step_chunk: int = 4,
+                     kv_int8: bool = False, attn: str = 'kernel',
+                     steps: int = 2, beat=None) -> dict:
+    """Paged+prefix engine vs the dense engine at EQUAL HBM budget on
+    shared-prefix traffic.
+
+    The dense engine gets ``num_slots`` lanes of ``max_len``; the paged
+    engine gets a pool of exactly the same token capacity
+    (``num_slots * max_len / block_k`` blocks) but 4x the lanes — its
+    admitted concurrency is bounded by *blocks*, so every block the
+    radix cache shares converts directly into extra in-flight requests.
+    Reports admitted-concurrency (max simultaneously active slots),
+    prefill tokens saved, and the prefix-hit ratio.
+    """
+    from skypilot_tpu.models import decode, llama
+    from skypilot_tpu.models import engine as engine_lib
+
+    beat, devices = _init(beat)
+    on_accelerator = devices[0].platform != 'cpu'
+    if on_accelerator:
+        prefix_len, suffix_lens = 256, (16, 32, 64)
+        new_token_mix = (16, 32)
+        max_len = 512
+        block_k = block_k or 128
+        n_requests = n_requests or 6 * num_slots
+    else:
+        # CPU dev fallback: scheduler behavior is identical at tiny
+        # shapes; only the wall-clock numbers shrink.
+        model_name, num_slots, step_chunk = 'debug', 4, 4
+        prefix_len, suffix_lens = 24, (3, 5, 8)
+        new_token_mix = (4, 8)
+        max_len = 64
+        block_k = block_k or 8
+        n_requests = min(n_requests or 24, 24)
+        steps = min(steps, 2)
+
+    cfg = dataclasses.replace(llama.CONFIGS[model_name], remat=False)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    dcfg = decode.DecodeConfig(
+        max_len=max_len, temperature=0.0, decode_attention=attn,
+        kernel_block_k=block_k,
+        kv_cache_dtype='int8' if kv_int8 else 'bf16')
+    requests = _prefix_requests(cfg.vocab_size, n_requests, prefix_len,
+                                suffix_lens, new_token_mix, prefix_share)
+    # Equal HBM: the paged pool holds exactly the dense cache's tokens.
+    num_blocks = num_slots * (max_len // block_k) + 1
+    paged_slots = min(4 * num_slots, n_requests)
+
+    def run(paged):
+        if paged:
+            eng = engine_lib.DecodeEngine(
+                params, cfg, dcfg, paged_slots, step_chunk=step_chunk,
+                name='prefix-bench-paged', paged=True,
+                num_blocks=num_blocks)
+        else:
+            eng = engine_lib.DecodeEngine(
+                params, cfg, dcfg, num_slots, step_chunk=step_chunk,
+                name='prefix-bench-dense')
+        useful, max_active, n_steps = _drive_engine(eng, engine_lib,
+                                                    requests)
+        return useful, max_active, n_steps, eng.stats()
+
+    def timed(fn, n):
+        fn()  # warmup/compile
+        beat('decode_prefix_run')
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn()
+        return (time.perf_counter() - t0) / n, out
+
+    beat('decode_prefix_compile')
+    with _journal_disabled():
+        dense_dt, (dense_useful, dense_conc, _, _) = timed(
+            lambda: run(False), steps)
+        paged_dt, (paged_useful, paged_conc, _, pstats) = timed(
+            lambda: run(True), steps)
+    paged_tps = paged_useful / max(paged_dt, 1e-9)
+    dense_tps = dense_useful / max(dense_dt, 1e-9)
+    total_prompt = sum(len(p) for p, _ in requests)
+    return {
+        'metric': 'llama_decode_prefix_tokens_per_sec',
+        'value': round(paged_tps, 1),
+        'unit': 'tokens/s/chip',
+        'detail': {
+            'workload': 'prefix',
+            'model': model_name,
+            'block_k': block_k,
+            'prefix_share': prefix_share,
+            'prefix_len': prefix_len,
+            'n_requests': len(requests),
+            'hbm_budget_tokens': num_slots * max_len,
+            'dense_num_slots': num_slots,
+            'paged_num_blocks': num_blocks - 1,
+            # Admitted concurrency at the same HBM: the headline.
+            'dense_admitted_concurrency': dense_conc,
+            'paged_admitted_concurrency': paged_conc,
+            'concurrency_gain': round(paged_conc / max(dense_conc, 1),
+                                      2),
+            'paged_tokens_per_sec': round(paged_tps, 1),
+            'dense_tokens_per_sec': round(dense_tps, 1),
+            'prefill_tokens_total': total_prompt,
+            'prefill_tokens_saved': pstats['prefill_tokens_saved'],
+            'prefix_hit_ratio': pstats['prefix_hit_ratio'],
+            'kv_cache_dtype': dcfg.kv_cache_dtype,
+            'steps': steps,
+            'device': str(devices[0]),
+        },
+    }
+
+
+def run_scheduler_bench(steps: int = 2, beat=None, seed: int = 0) -> dict:
+    """Device-agnostic engine-SCHEDULER phase: the CPU failover tier.
+
+    Runs the continuous-batching scheduler (dense and paged+prefix) on a
+    deterministic synthetic trace with the debug model, so it completes
+    in seconds on any platform — the numbers that matter here
+    (tokens/step, occupancy, prefix-hit ratio, admitted concurrency)
+    are properties of the SCHEDULING logic, not the chip. Emitted in
+    the same heartbeat/JSON schema as the TPU phases with a
+    ``platform`` tag so perf trends never go dark when PJRT is
+    unreachable (ROADMAP item 5). The tier-1 perf-regression gate
+    replays the same trace against a checked-in envelope.
+    """
+    from skypilot_tpu.models import decode, llama
+    from skypilot_tpu.models import engine as engine_lib
+
+    beat, devices = _init(beat)
+    platform = devices[0].platform
+    model_name, num_slots, block_k, max_len = 'debug', 4, 8, 64
+    cfg = dataclasses.replace(llama.CONFIGS[model_name], remat=False)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    dcfg = decode.DecodeConfig(max_len=max_len, temperature=0.0,
+                               decode_attention='xla',
+                               kernel_block_k=block_k)
+    requests = _prefix_requests(cfg.vocab_size, n_requests=24,
+                                prefix_len=24, suffix_lens=(3, 5, 8),
+                                new_token_mix=(4, 8),
+                                prefix_share=0.75, seed=seed)
+    num_blocks = num_slots * (max_len // block_k) + 1
+
+    beat('sched_compile')
+    with _journal_disabled():
+        def run(paged):
+            eng = engine_lib.DecodeEngine(
+                params, cfg, dcfg, 16 if paged else num_slots,
+                step_chunk=4, name='sched-bench',
+                paged=paged, num_blocks=num_blocks if paged else None)
+            useful, conc, n_steps = _drive_engine(eng, engine_lib,
+                                                  requests)
+            st = eng.stats()
+            return {
+                'useful_tokens': useful,
+                'admitted_concurrency': conc,
+                'engine_steps': n_steps,
+                # Scheduler-level throughput: decode tokens delivered
+                # per engine step — deterministic for a fixed trace,
+                # platform-independent (the perf-gate signal).
+                'tokens_per_step': round(
+                    st['decode_tokens'] / max(st['decode_steps'], 1),
+                    4),
+                'occupancy': st['mean_occupancy'],
+                'prefix_hit_ratio': st.get('prefix_hit_ratio', 0.0),
+            }
+
+        dense = run(False)          # also warms the compile cache
+        paged = run(True)
+        beat('sched_run')
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            paged = run(True)
+        dt = (time.perf_counter() - t0) / max(steps, 1)
+    return {
+        'metric': 'engine_scheduler_tokens_per_step',
+        'value': paged['tokens_per_step'],
+        'unit': 'tokens/step',
+        'platform': platform,
+        'detail': {
+            'workload': 'sched',
+            'model': model_name,
+            'block_k': block_k,
+            'n_requests': len(requests),
+            'paged': paged,
+            'dense': dense,
+            'paged_wall_seconds': round(dt, 3),
+            'paged_tokens_per_sec': round(
+                paged['useful_tokens'] / max(dt, 1e-9), 1),
+            'device': str(devices[0]),
+        },
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='bench-1b')
-    parser.add_argument('--workload', choices=('static', 'mixed'),
+    parser.add_argument('--workload',
+                        choices=('static', 'mixed', 'prefix', 'sched'),
                         default='static',
                         help='static: one fixed-shape generate() batch; '
                              'mixed: continuous engine vs static '
-                             'batching on mixed-length traffic')
+                             'batching on mixed-length traffic; '
+                             'prefix: paged+radix engine vs dense at '
+                             'equal HBM on shared-prefix traffic; '
+                             'sched: device-agnostic engine-scheduler '
+                             'phase (the CPU failover tier)')
     parser.add_argument('--batch', type=int, default=16)
     parser.add_argument('--prompt-len', type=int, default=128)
     parser.add_argument('--new-tokens', type=int, default=128)
@@ -360,14 +626,34 @@ def main() -> None:
                         default='kernel',
                         help='cached-attention path: Pallas flash-decode '
                              'kernel (TPU) or grouped-einsum XLA')
+    parser.add_argument('--paged', action='store_true',
+                        help='engine workloads: paged KV pool + radix '
+                             'prefix cache instead of dense lanes')
+    parser.add_argument('--block-k', type=int, default=None,
+                        help='paged pool block size in tokens (default '
+                             '128 on TPU, 16 on the CPU fallback)')
+    parser.add_argument('--prefix-share', type=float, default=0.75,
+                        help='prefix workload: fraction of requests '
+                             'opening with the shared prefix')
     args = parser.parse_args()
-    if args.workload == 'mixed':
+    if args.workload == 'sched':
+        out = run_scheduler_bench(steps=min(args.steps, 3))
+    elif args.workload == 'prefix':
+        out = run_prefix_bench(args.model, args.num_slots,
+                               n_requests=args.requests,
+                               prefix_share=args.prefix_share,
+                               block_k=args.block_k,
+                               step_chunk=args.step_chunk,
+                               kv_int8=args.kv_int8, attn=args.attn,
+                               steps=min(args.steps, 3))
+    elif args.workload == 'mixed':
         out = run_mixed_bench(args.model, args.num_slots,
                               n_requests=args.requests,
                               step_chunk=args.step_chunk,
                               int8=args.int8, kv_int8=args.kv_int8,
                               attn=args.attn, eos_id=args.eos_id,
-                              steps=min(args.steps, 3))
+                              steps=min(args.steps, 3),
+                              paged=args.paged, block_k=args.block_k)
     else:
         out = run_decode_bench(args.model, args.batch, args.prompt_len,
                                args.new_tokens, args.steps,

@@ -8,6 +8,19 @@ static-shape engine):
   one compiled decode step serves every position (XLA requirement).
   Optionally int8 (``kv_cache_dtype='int8'``) with per-(position, kv-head)
   fp32 scales, halving the cache HBM traffic that bounds decode.
+* PAGED alternative (the serving engine's cache): one global block pool
+  [L, n_blocks, block_k, Hkv, hd] (``init_block_pool``) plus per-sequence
+  int32 block tables — position ``p`` of a sequence lives in pool block
+  ``table[p // block_k]`` at offset ``p % block_k``. Prefill
+  (``paged_prefill`` / ``paged_prefill_with_prefix``) and the per-step
+  ``_write_kv`` scatter through the table; attention gathers through it
+  (``ops/decode_attention.paged_decode_attention``). HBM then scales
+  with *live tokens*, not ``num_slots × max_len``, and sequences whose
+  tables name the same blocks share prompt prefixes copy-free
+  (``models/engine.py``'s radix prefix cache). The with-prefix prefill
+  additionally SKIPS the forward pass over an already-cached prefix:
+  only the suffix runs through the model, attending over prefix K/V
+  gathered from the pool.
 * Prefill runs the full forward once (flash/ring attention applies),
   writing the cache; decode is a ``lax.scan`` of single-token steps whose
   attention reads the cache through the Pallas flash-decode kernel
@@ -73,6 +86,29 @@ def quantize_params(params: Params) -> Params:
 def init_kv_cache(cfg: llama.LlamaConfig, batch: int, max_len: int,
                   kv_cache_dtype: str = 'bf16') -> Cache:
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    if kv_cache_dtype == 'int8':
+        return {
+            'k': jnp.zeros(shape, jnp.int8),
+            'v': jnp.zeros(shape, jnp.int8),
+            'k_scale': jnp.zeros(shape[:-1], jnp.float32),
+            'v_scale': jnp.zeros(shape[:-1], jnp.float32),
+        }
+    assert kv_cache_dtype == 'bf16', kv_cache_dtype
+    return {
+        'k': jnp.zeros(shape, cfg.dtype),
+        'v': jnp.zeros(shape, cfg.dtype),
+    }
+
+
+def init_block_pool(cfg: llama.LlamaConfig, num_blocks: int, block_k: int,
+                    kv_cache_dtype: str = 'bf16') -> Cache:
+    """Global paged KV pool: [L, num_blocks, block_k, Hkv, hd] (+ scale
+    planes [L, num_blocks, block_k, Hkv] when int8). Block 0 is reserved
+    by the engine as a write-off scratch block (frozen lanes and bucket
+    padding scatter there), so usable capacity is ``num_blocks - 1``
+    blocks."""
+    shape = (cfg.n_layers, num_blocks, block_k, cfg.n_kv_heads,
+             cfg.head_dim)
     if kv_cache_dtype == 'int8':
         return {
             'k': jnp.zeros(shape, jnp.int8),
@@ -223,6 +259,227 @@ prefill_into_slot = jax.jit(_prefill_into_slot, static_argnames=('cfg',),
                             donate_argnums=(5,))
 
 
+# ------------------------------------------------------------------ paged
+
+
+def _attend_paged(dcfg: DecodeConfig, q: jax.Array, lpool: Cache,
+                  block_tables: jax.Array, cur_len: jax.Array) -> jax.Array:
+    """q [B,1,H,hd] against one layer's pool [n_blocks, block_k, Hkv, hd]
+    through ``block_tables`` [B, max_blocks]."""
+    k_scale = lpool.get('k_scale')
+    v_scale = lpool.get('v_scale')
+    if dcfg.decode_attention == 'kernel':
+        return decode_attention_ops.paged_decode_attention(
+            q, lpool['k'], lpool['v'], block_tables, cur_len,
+            k_scale=k_scale, v_scale=v_scale,
+            interpret=dcfg.kernel_interpret)
+    assert dcfg.decode_attention == 'xla', dcfg.decode_attention
+    return decode_attention_ops.paged_decode_attention_xla(
+        q, lpool['k'], lpool['v'], block_tables, cur_len,
+        k_scale=k_scale, v_scale=v_scale)
+
+
+def _paged_block_decode(cfg: llama.LlamaConfig, dcfg: DecodeConfig,
+                        x: jax.Array, layer: Params, lpool: Cache,
+                        cos: jax.Array, sin: jax.Array, pos: jax.Array,
+                        block_tables: jax.Array
+                        ) -> Tuple[jax.Array, Cache]:
+    """One decoder block for one new token per sequence, paged cache:
+    the K/V write scatters to (table[pos // block_k], pos % block_k)."""
+    b, s, _ = x.shape  # s == 1
+    hd = cfg.head_dim
+    block_k = lpool['k'].shape[1]
+    h = llama.rms_norm(x, layer['attn_norm'], cfg.norm_eps)
+    q = llama.quant_mm(h, layer['wq']).reshape(b, s, cfg.n_heads, hd)
+    k = llama.quant_mm(h, layer['wk']).reshape(b, s, cfg.n_kv_heads, hd)
+    v = llama.quant_mm(h, layer['wv']).reshape(b, s, cfg.n_kv_heads, hd)
+    q = llama.apply_rope(q, cos, sin)
+    k = llama.apply_rope(k, cos, sin)
+    blk = jnp.take_along_axis(block_tables,
+                              (pos // block_k)[:, None], axis=1)[:, 0]
+    lpool = _write_kv(lpool, (blk, pos % block_k), k[:, 0], v[:, 0])
+    attn = _attend_paged(dcfg, q, lpool, block_tables, cur_len=pos + 1)
+    attn = attn.reshape(b, s, cfg.n_heads * hd)
+    x = x + llama.quant_mm(attn, layer['wo']).astype(cfg.dtype)
+    return llama.ffn_sublayer(cfg, x, layer), lpool
+
+
+def _paged_decode_step(params: Params, token: jax.Array, pos: jax.Array,
+                       block_tables: jax.Array, cfg: llama.LlamaConfig,
+                       dcfg: DecodeConfig, pool: Cache
+                       ) -> Tuple[jax.Array, Cache]:
+    """token [B] at positions pos [B], tables [B, max_blocks] →
+    (logits [B, vocab], pool)."""
+    cos, sin = llama._rope_freqs(cfg, pos[:, None])  # pylint: disable=protected-access
+    x = params['tok_embedding'][token][:, None].astype(cfg.dtype)
+
+    def body(carry, layer_lpool):
+        layer, lpool = layer_lpool
+        xc, lpool = _paged_block_decode(cfg, dcfg, carry, layer, lpool,
+                                        cos, sin, pos, block_tables)
+        return xc, lpool
+
+    x, pool = jax.lax.scan(body, x, (params['layers'], pool))
+    x = llama.rms_norm(x, params['out_norm'], cfg.norm_eps)
+    logits = (x[:, 0] @ params['lm_head']).astype(jnp.float32)
+    return logits, pool
+
+
+def _paged_prefill(params: Params, tokens: jax.Array,
+                   prompt_len: jax.Array, block_row: jax.Array,
+                   cfg: llama.LlamaConfig, pool: Cache
+                   ) -> Tuple[jax.Array, Cache]:
+    """Prefill ONE request into pool blocks named by ``block_row``.
+
+    tokens [1, S_bucket] right-padded (S_bucket % block_k == 0),
+    block_row [S_bucket // block_k] int32; positions [j*block_k,
+    (j+1)*block_k) land in pool block ``block_row[j]``. Bucket-padding
+    positions write garbage into whatever block covers them — the engine
+    points table rows past the allocation at the scratch block, and
+    attention masks by cur_len, so it is never read. Returns
+    (last-prompt-token logits [vocab], pool).
+    """
+    _, s = tokens.shape
+    block_k = pool['k'].shape[2]
+    nb = s // block_k
+    logits, ks, vs = _prefill_forward(params, tokens, cfg)
+    # ks/vs [L, 1, S, Hkv, hd] → [L, nb, block_k, Hkv, hd] block scatter.
+    shp = (ks.shape[0], nb, block_k) + ks.shape[3:]
+    pool = _write_kv(pool, jnp.index_exp[:, block_row],
+                     ks[:, 0].reshape(shp), vs[:, 0].reshape(shp))
+    return logits[0, prompt_len - 1], pool
+
+
+def _prefix_suffix_attention(q: jax.Array, pk: jax.Array, pv: jax.Array,
+                             sk: jax.Array, sv: jax.Array,
+                             prefix_len: jax.Array) -> jax.Array:
+    """Suffix-prefill attention: suffix queries attend the gathered
+    prefix K/V (positions < prefix_len) plus the suffix itself
+    (causal). q/sk/sv [1, S, ...], pk/pv [1, P_buf, Hkv, hd]; grouped
+    GQA einsum, no repeat_kv materialization."""
+    _, s, h, hd = q.shape
+    p_buf = pk.shape[1]
+    hkv = pk.shape[2]
+    g = h // hkv
+    k = jnp.concatenate([pk, sk], axis=1)
+    v = jnp.concatenate([pv, sv], axis=1)
+    qg = q.reshape(1, s, hkv, g, hd)
+    logits = jnp.einsum('bskgd,btkd->bkgst', qg, k,
+                        preferred_element_type=jnp.float32) * hd**-0.5
+    t_idx = jnp.arange(p_buf + s)
+    j_idx = jnp.arange(s)
+    # [S, P_buf + S]: prefix entries gate on prefix_len, suffix causal.
+    mask = jnp.where(t_idx[None, :] < p_buf,
+                     t_idx[None, :] < prefix_len,
+                     (t_idx[None, :] - p_buf) <= j_idx[:, None])
+    logits = jnp.where(mask[None, None, None, :, :], logits,
+                       decode_attention_ops.NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum('bkgst,btkd->bskgd', probs, v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(1, s, h, hd).astype(q.dtype)
+
+
+def _gather_prefix_kv(pool: Cache, prefix_blocks: jax.Array,
+                      dtype) -> Tuple[jax.Array, jax.Array]:
+    """Pool → contiguous prefix K/V [L, Npb*block_k, Hkv, hd] (int8
+    pools dequantize here; the suffix forward runs in model dtype)."""
+    block_k = pool['k'].shape[2]
+    npb = prefix_blocks.shape[0]
+
+    def flat(x):
+        g = x[:, prefix_blocks]          # [L, Npb, block_k, ...]
+        return g.reshape((x.shape[0], npb * block_k) + x.shape[3:])
+
+    pk, pv = flat(pool['k']), flat(pool['v'])
+    if 'k_scale' in pool:
+        pk = pk.astype(jnp.float32) * flat(pool['k_scale'])[..., None]
+        pv = pv.astype(jnp.float32) * flat(pool['v_scale'])[..., None]
+    return pk.astype(dtype), pv.astype(dtype)
+
+
+def _paged_prefill_with_prefix(params: Params, tokens: jax.Array,
+                               suffix_len: jax.Array,
+                               prefix_len: jax.Array,
+                               prefix_blocks: jax.Array,
+                               block_row: jax.Array,
+                               cfg: llama.LlamaConfig, pool: Cache
+                               ) -> Tuple[jax.Array, Cache]:
+    """Prefix-skipping prefill: run ONLY the prompt suffix through the
+    model, attending over prefix K/V already resident in the pool.
+
+    tokens [1, S_bucket] holds the suffix (prompt[prefix_len:]) right-
+    padded; ``prefix_blocks`` [Npb] names the pool blocks covering
+    positions [0, prefix_len) (padded entries masked by ``prefix_len``);
+    ``block_row`` [S_bucket // block_k + 1] names the blocks receiving
+    the suffix K/V writes, starting at the block containing position
+    ``prefix_len`` (offset ``prefix_len % block_k`` — the engine hands a
+    copy-on-write clone there when that block is shared). This is where
+    prefix reuse saves compute: the forward pass (QKV, FFN, lm_head) runs
+    over S_bucket tokens instead of prefix_len + S_bucket. Returns
+    (last-suffix-token logits [vocab], pool).
+    """
+    _, s = tokens.shape
+    block_k = pool['k'].shape[2]
+    positions = prefix_len + jnp.arange(s, dtype=jnp.int32)
+    cos, sin = llama._rope_freqs(cfg, positions)  # pylint: disable=protected-access
+    x = params['tok_embedding'][tokens].astype(cfg.dtype)
+    pk, pv = _gather_prefix_kv(pool, prefix_blocks, cfg.dtype)
+
+    def body(carry, layer_pkv):
+        layer, lpk, lpv = layer_pkv
+        b, sl, _ = carry.shape
+        hd = cfg.head_dim
+        h = llama.rms_norm(carry, layer['attn_norm'], cfg.norm_eps)
+        q = llama.quant_mm(h, layer['wq']).reshape(b, sl, cfg.n_heads, hd)
+        k = llama.quant_mm(h, layer['wk']).reshape(b, sl,
+                                                   cfg.n_kv_heads, hd)
+        v = llama.quant_mm(h, layer['wv']).reshape(b, sl,
+                                                   cfg.n_kv_heads, hd)
+        q = llama.apply_rope(q, cos, sin)
+        k = llama.apply_rope(k, cos, sin)
+        attn = _prefix_suffix_attention(q, lpk[None], lpv[None], k, v,
+                                        prefix_len)
+        attn = attn.reshape(b, sl, cfg.n_heads * hd)
+        xc = carry + llama.quant_mm(attn, layer['wo']).astype(cfg.dtype)
+        return llama.ffn_sublayer(cfg, xc, layer), (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params['layers'], pk, pv))
+    x = llama.rms_norm(x, params['out_norm'], cfg.norm_eps)
+    logits = (x @ params['lm_head']).astype(jnp.float32)  # [1, S, V]
+    # Scatter suffix K/V: token i sits at global position prefix_len + i
+    # → write slot (block_row[(off0 + i) // block_k], (off0 + i) %
+    # block_k) with off0 = prefix_len % block_k. Bucket padding spills
+    # into scratch-pointed rows exactly like _paged_prefill.
+    g = (prefix_len % block_k) + jnp.arange(s, dtype=jnp.int32)
+    blk = block_row[g // block_k]
+    pool = _write_kv(pool, jnp.index_exp[:, blk, g % block_k],
+                     ks[:, 0], vs[:, 0])
+    return logits[0, suffix_len - 1], pool
+
+
+def _copy_block(pool: Cache, src: jax.Array, dst: jax.Array) -> Cache:
+    """Copy one pool block (all layers, scales included) — the device
+    half of copy-on-write: the engine clones a shared block before a new
+    request writes into its tail."""
+    out = dict(pool)
+    for name in pool:
+        out[name] = pool[name].at[:, dst].set(pool[name][:, src])
+    return out
+
+
+# Engine-serving entry points for the paged cache. The pool is DONATED
+# everywhere — block scatters mutate the persistent HBM buffers; callers
+# rebind to the returned pool. One compile per (bucket, prefix-bucket)
+# shape actually used.
+paged_prefill = jax.jit(_paged_prefill, static_argnames=('cfg',),
+                        donate_argnums=(5,))
+paged_prefill_with_prefix = jax.jit(_paged_prefill_with_prefix,
+                                    static_argnames=('cfg',),
+                                    donate_argnums=(7,))
+copy_block = jax.jit(_copy_block, donate_argnums=(0,))
+
+
 def _decode_step(params: Params, token: jax.Array, pos: jax.Array,
                  cfg: llama.LlamaConfig, dcfg: DecodeConfig, cache: Cache
                  ) -> Tuple[jax.Array, Cache]:
@@ -314,7 +571,14 @@ def generate(params: Params,
     composes with outer jits.
     """
     b, s_prompt = prompt.shape
-    assert s_prompt + max_new_tokens <= dcfg.max_len
+    if s_prompt + max_new_tokens > dcfg.max_len:
+        # A real error, not an assert: serving callers (the engine's
+        # admission path) must be able to catch and reject/clamp an
+        # over-budget request instead of dying mid-loop — and asserts
+        # vanish under `python -O`.
+        raise ValueError(
+            f'prompt ({s_prompt}) + max_new_tokens ({max_new_tokens}) '
+            f'exceeds max_len {dcfg.max_len}')
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     cache = init_kv_cache(cfg, b, dcfg.max_len, dcfg.kv_cache_dtype)
     # Host-side serving telemetry: KV-cache capacity/occupancy + dtype

@@ -38,14 +38,47 @@ one jitted call of ``step_chunk`` fused decode steps and one host fetch.
 ``step_chunk`` amortizes dispatch overhead; 1 gives token-granular
 streaming and exact occupancy accounting.
 
+**Paged mode** (``paged=True``) replaces the dense per-slot
+``[max_len]`` lanes with a global block pool
+(``decode.init_block_pool``: ``[L, n_blocks, block_k, Hkv, hd]``) plus
+per-slot int32 block tables, so HBM scales with live tokens instead of
+``num_slots × max_len``:
+
+* A host-side :class:`BlockAllocator` hands out pool blocks with
+  refcounting (a block may be owned by several slots AND the prefix
+  cache at once) and copy-on-write (``cow``: a shared block about to be
+  written is cloned first via ``decode.copy_block``).
+* A :class:`RadixPrefixCache` — a radix tree over block-sized token
+  runs — remembers which pool blocks hold which prompt prefixes.
+  Admissions whose prompt matches a cached branch reference those
+  blocks copy-free and prefill ONLY the suffix
+  (``decode.paged_prefill_with_prefix``), skipping the forward pass
+  over the shared prefix entirely. Unreferenced branches are LRU-
+  evicted when the pool runs dry.
+* Admission reserves the request's worst-case blocks
+  (``ceil((prompt + max_new_tokens)/block_k)`` minus shared ones), so a
+  running request can never hit pool exhaustion mid-decode; when the
+  reservation cannot be met even after eviction, the request stays
+  queued.
+
+Admission is **per-tenant fair**: the queue is one FIFO per tenant
+drained round-robin, so one tenant's burst cannot monopolize slots or
+pool blocks. Over-budget requests are clamped (budget) or rejected
+(prompt too long) with a journaled ``engine.reject`` instead of
+crashing the loop.
+
 Telemetry: ``skytpu_engine_*`` metrics through the process registry
 (queue depth, slot occupancy, admitted/evicted counters, TTFT and
-per-token histograms) and ``engine.admit``/``engine.evict`` flight-
-recorder events, so a serving replica's scheduling decisions are
-reconstructable after the fact.
+per-token histograms; paged mode adds ``skytpu_engine_blocks_total`` /
+``skytpu_engine_blocks_used``, ``skytpu_engine_prefix_hit_ratio`` and
+``skytpu_engine_prefill_tokens_saved_total``) and
+``engine.admit``/``engine.evict``/``engine.reject`` flight-recorder
+events, so a serving replica's scheduling decisions are reconstructable
+after the fact.
 """
 import collections
 import functools
+import heapq
 import itertools
 import os
 import threading
@@ -63,20 +96,292 @@ from skypilot_tpu.observability import runtime_metrics
 
 IDLE_SLEEP_ENV = 'SKYTPU_ENGINE_IDLE_SLEEP_SECONDS'
 
+# The pool's block 0 is engine-owned scratch: freed slots' table rows
+# point at it so frozen lanes write harmlessly, and bucket-padding
+# prefill writes spill into it. The allocator never hands it out.
+SCRATCH_BLOCK = 0
+
+
+class PoolExhausted(RuntimeError):
+    """Raised when an admission's block reservation cannot be met (even
+    after prefix-cache eviction). The admission loop leaves the request
+    queued and retries after the next eviction frees blocks."""
+
+
+class BlockAllocator:
+    """Refcounted free-list allocator over the paged KV pool's blocks.
+
+    Blocks are plain ints in [1, num_blocks). A block's refcount is the
+    number of owners: each slot whose table references it, plus the
+    radix prefix cache if a tree node holds it. ``free`` is implicit —
+    the refcount hitting zero returns the block to the free list.
+    Host-side only; device buffers never move.
+    """
+
+    def __init__(self, num_blocks: int, reserved: int = 1):
+        if num_blocks <= reserved:
+            raise ValueError(f'num_blocks must be > {reserved}, got '
+                             f'{num_blocks}')
+        self.num_blocks = num_blocks
+        self._reserved = reserved
+        self._free: List[int] = list(range(num_blocks - 1, reserved - 1,
+                                           -1))
+        self._ref = np.zeros((num_blocks,), np.int32)
+
+    def available(self) -> int:
+        return len(self._free)
+
+    def used(self) -> int:
+        return (self.num_blocks - self._reserved) - len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` blocks (refcount 1 each); raises PoolExhausted."""
+        if n > len(self._free):
+            raise PoolExhausted(
+                f'need {n} blocks, {len(self._free)} free')
+        out = [self._free.pop() for _ in range(n)]
+        self._ref[out] = 1
+        return out
+
+    def incref(self, blocks) -> None:
+        for b in blocks:
+            assert self._ref[b] > 0, f'incref of free block {b}'
+            self._ref[b] += 1
+
+    def decref(self, blocks) -> List[int]:
+        """Drop one ref per block; returns the blocks actually freed."""
+        freed = []
+        for b in blocks:
+            assert self._ref[b] > 0, f'decref of free block {b}'
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                self._free.append(b)
+                freed.append(b)
+        return freed
+
+    def refcount(self, block: int) -> int:
+        return int(self._ref[block])
+
+    def cow(self, block: int) -> Tuple[int, bool]:
+        """Copy-on-write: a caller holding one ref and about to WRITE
+        ``block``. Sole owner → write in place (no copy). Shared →
+        allocate a clone target; the caller device-copies
+        (``decode.copy_block``), keeps its ref on the original until
+        release, and writes the clone. Returns (writable_block,
+        needs_copy)."""
+        if self._ref[block] == 1:
+            return block, False
+        return self.alloc(1)[0], True
+
+
+class _RadixNode:
+    """One edge of the prefix tree: a run of whole blocks. ``keys[i]``
+    is the tuple of block_k token ids cached in pool block
+    ``blocks[i]``. ``lock`` counts in-flight requests whose admission
+    matched through this node (evicting it would free blocks they
+    read)."""
+
+    __slots__ = ('keys', 'blocks', 'children', 'parent', 'lock', 'last')
+
+    def __init__(self, keys, blocks, parent):
+        self.keys: List[tuple] = keys
+        self.blocks: List[int] = blocks
+        self.children: dict = {}
+        self.parent = parent
+        self.lock = 0
+        self.last = 0
+
+
+class RadixPrefixCache:
+    """Radix tree mapping prompt-token prefixes → pool blocks.
+
+    Granularity is one block (``block_k`` tokens): edges hold runs of
+    whole blocks, children are keyed by their first block's token
+    tuple, and matching/splitting happen at block boundaries — partial
+    blocks are never shared (so shared blocks are immutable and
+    copy-on-write is only ever needed at the one boundary block of a
+    full-prompt hit). The tree owns one allocator ref per held block;
+    ``evict`` LRU-walks unlocked leaves and drops those refs under pool
+    pressure.
+    """
+
+    def __init__(self, block_k: int, allocator: BlockAllocator):
+        self.block_k = block_k
+        self._alloc = allocator
+        self._root = _RadixNode([], [], None)
+        self._clock = 0
+        self._n_blocks = 0          # blocks currently held by the tree
+
+    # ------------------------------------------------------------ utils
+
+    def _block_keys(self, tokens) -> List[tuple]:
+        bk = self.block_k
+        return [tuple(tokens[i * bk:(i + 1) * bk])
+                for i in range(len(tokens) // bk)]
+
+    def held_blocks(self) -> int:
+        return self._n_blocks
+
+    def _touch(self, node: '_RadixNode') -> None:
+        self._clock += 1
+        node.last = self._clock
+
+    # ------------------------------------------------------------ match
+
+    def match(self, tokens) -> Tuple[List[int], List['_RadixNode']]:
+        """Longest cached prefix of ``tokens`` in whole blocks.
+
+        Returns (blocks, path): pool blocks holding the matched prefix
+        in order, and the tree nodes traversed. The caller receives one
+        allocator ref per matched block and a lock on every path node —
+        both must be returned via :meth:`release` when the request
+        finishes."""
+        keys = self._block_keys(tokens)
+        blocks: List[int] = []
+        path: List[_RadixNode] = []
+        node = self._root
+        i = 0
+        while i < len(keys):
+            child = node.children.get(keys[i])
+            if child is None:
+                break
+            n = 0
+            while (n < len(child.keys) and i + n < len(keys) and
+                   child.keys[n] == keys[i + n]):
+                n += 1
+            if n == 0:
+                break
+            blocks.extend(child.blocks[:n])
+            path.append(child)
+            self._touch(child)
+            i += n
+            if n < len(child.keys):
+                break
+            node = child
+        if blocks:
+            self._alloc.incref(blocks)
+            for p in path:
+                p.lock += 1
+        return blocks, path
+
+    def release(self, path) -> None:
+        for p in path:
+            assert p.lock > 0
+            p.lock -= 1
+
+    # ----------------------------------------------------------- insert
+
+    def insert(self, tokens, blocks) -> int:
+        """Record that ``blocks[i]`` holds tokens
+        ``tokens[i*block_k:(i+1)*block_k]`` (len(tokens) must be a
+        whole number of blocks). Already-cached prefixes are deduped
+        against the existing branch; only the divergent suffix is
+        adopted (tree increfs those blocks). Returns the number of
+        blocks newly adopted."""
+        keys = self._block_keys(tokens)
+        assert len(keys) == len(blocks), (len(keys), len(blocks))
+        node = self._root
+        i = 0
+        while i < len(keys):
+            child = node.children.get(keys[i])
+            if child is None:
+                new = _RadixNode(keys[i:], list(blocks[i:]), node)
+                node.children[keys[i]] = new
+                self._touch(new)
+                adopted = len(new.blocks)
+                self._alloc.incref(new.blocks)
+                self._n_blocks += adopted
+                return adopted
+            n = 0
+            while (n < len(child.keys) and i + n < len(keys) and
+                   child.keys[n] == keys[i + n]):
+                n += 1
+            self._touch(child)
+            if n < len(child.keys):
+                if i + n == len(keys):
+                    return 0        # new prompt is a prefix of the edge
+                self._split(child, n)
+            i += n
+            node = child
+        return 0
+
+    def _split(self, node: '_RadixNode', at: int) -> None:
+        """Split an edge at block index ``at`` (0 < at < len): the node
+        keeps the prefix, a new child takes the tail (and the node's
+        children). Locks stay on the prefix node — a lock protects the
+        whole path above it, and the tail's blocks keep their tree
+        refs via the new child."""
+        tail = _RadixNode(node.keys[at:], node.blocks[at:], node)
+        tail.children = node.children
+        for c in tail.children.values():
+            c.parent = tail
+        tail.last = node.last
+        node.keys = node.keys[:at]
+        node.blocks = node.blocks[:at]
+        node.children = {tail.keys[0]: tail}
+
+    # ------------------------------------------------------------ evict
+
+    def evict(self, need_blocks: int) -> int:
+        """LRU-evict unlocked leaves until ``need_blocks`` allocator
+        blocks came free (or nothing is evictable). Returns blocks
+        freed.
+
+        A leaf is only worth evicting if at least one of its blocks is
+        SOLELY tree-held (refcount 1): dropping an entry whose blocks
+        an active slot still pins frees zero HBM — it would just
+        destroy future prefix hits for nothing, so those leaves are
+        skipped (they become reclaimable once their slots evict).
+
+        One tree walk collects the LRU-ordered leaf list; evicting a
+        leaf can turn its parent into a fresh leaf, which is appended
+        to the candidate heap directly — no per-victim re-walk, so a
+        pressure episode is O(nodes log nodes), not O(nodes^2)."""
+        freed = 0
+        heap = [(n.last, id(n), n) for n in self._iter_nodes()
+                if not n.children and n is not self._root]
+        heapq.heapify(heap)
+        while freed < need_blocks and heap:
+            _, _, victim = heapq.heappop(heap)
+            if victim.lock != 0 or victim.children:
+                continue            # locked, or stale entry
+            if all(self._alloc.refcount(b) > 1 for b in victim.blocks):
+                continue            # pinned by slots: freeing gains 0
+            freed += len(self._alloc.decref(victim.blocks))
+            self._n_blocks -= len(victim.blocks)
+            parent = victim.parent
+            del parent.children[victim.keys[0]]
+            if (parent is not self._root and not parent.children):
+                heapq.heappush(heap, (parent.last, id(parent), parent))
+        return freed
+
+    def _iter_nodes(self):
+        stack = [self._root]
+        while stack:
+            n = stack.pop()
+            yield n
+            stack.extend(n.children.values())
+
 
 class Request:
     """One generation request tracked through the engine.
 
     ``on_token(token, done)`` (optional) fires from the engine loop
     thread per generated token — the model server bridges it onto its
-    asyncio loop for SSE streaming. ``tokens`` accumulates the full
+    asyncio loop for SSE streaming. ``on_finish()`` (optional
+    attribute) fires once when the request reaches a terminal state —
+    including rejections and admission errors that never produced a
+    token, which ``on_token`` alone would miss (a client waiting on
+    the token stream must not hang out a timeout to learn its request
+    was rejected instantly). ``tokens`` accumulates the full
     generation; ``wait()`` blocks until eviction.
     """
     _ids = itertools.count()
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int,
                  on_token: Optional[Callable[[int, bool], None]] = None,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None,
+                 tenant: str = 'default'):
         if max_new_tokens < 1:
             raise ValueError(f'max_new_tokens must be >= 1, got '
                              f'{max_new_tokens}')
@@ -85,6 +390,11 @@ class Request:
             raise ValueError('empty prompt')
         self.max_new_tokens = int(max_new_tokens)
         self.on_token = on_token
+        self.on_finish: Optional[Callable[[], None]] = None
+        # Admission-fairness key: requests queue per tenant and admit
+        # round-robin across tenants (the model server maps X-Tenant /
+        # body "tenant" here).
+        self.tenant = str(tenant)
         self.id = (request_id if request_id is not None
                    else f'r{next(self._ids)}')
         self.tokens: List[int] = []
@@ -114,32 +424,26 @@ class Request:
         self.finish_reason = reason
         self.finish_ts = time.perf_counter()
         self._done.set()
+        if self.on_finish is not None:
+            self.on_finish()
 
 
-@functools.partial(jax.jit,
-                   static_argnames=('cfg', 'dcfg', 'n_steps'),
-                   donate_argnums=(6,))
-def _engine_steps_impl(params, token, pos, done, remaining, keys, cache,
-                       cfg: llama.LlamaConfig, dcfg: decode.DecodeConfig,
-                       n_steps: int):
-    """``n_steps`` fused decode steps over every slot.
+def _scan_engine_steps(decode_fn, dcfg: decode.DecodeConfig, token, pos,
+                       done, remaining, keys, cache):
+    """The ONE copy of the engine's per-step scheduling semantics,
+    shared by the dense and paged twins (only ``decode_fn`` — how
+    (token, pos, cache) → (logits, cache) — differs between them).
 
-    token/pos/remaining [num_slots] int32, done [num_slots] bool, keys
-    [n_steps, 2] uint32 (sampling; unused for greedy), cache donated.
-    Per-step semantics mirror ``decode._generate_impl.step`` exactly for
-    live lanes (same sample → EOS-mask → done-fold order, so greedy
-    output is token-identical); done lanes additionally FREEZE their
-    position instead of advancing, bounding writes for lanes that idle
-    across many chunks (emitted tokens are forced to EOS either way, so
-    the freeze is unobservable in the output stream).
-
-    Returns (tokens [n_steps, num_slots], token, pos, done, remaining,
-    cache).
-    """
+    Per-step semantics mirror ``decode._generate_impl.step`` exactly
+    for live lanes (same sample → EOS-mask → done-fold order, so greedy
+    output is token-identical to static ``generate``); done lanes
+    additionally FREEZE their position instead of advancing, bounding
+    writes for lanes that idle across many chunks (emitted tokens are
+    forced to EOS either way, so the freeze is unobservable in the
+    output stream)."""
     def step(carry, key):
         tok, p, dn, rem, cache_c = carry
-        logits, cache_c = decode._decode_step(  # pylint: disable=protected-access
-            params, tok, p, cfg, dcfg, cache_c)
+        logits, cache_c = decode_fn(tok, p, cache_c)
         nxt = decode._sample(logits, key, dcfg.temperature)  # pylint: disable=protected-access
         if dcfg.eos_id is not None:
             nxt = jnp.where(dn, dcfg.eos_id, nxt)
@@ -156,6 +460,52 @@ def _engine_steps_impl(params, token, pos, done, remaining, keys, cache,
     (token, pos, done, remaining, cache), toks = jax.lax.scan(
         step, (token, pos, done, remaining, cache), keys)
     return toks, token, pos, done, remaining, cache
+
+
+@functools.partial(jax.jit,
+                   static_argnames=('cfg', 'dcfg', 'n_steps'),
+                   donate_argnums=(6,))
+def _engine_steps_impl(params, token, pos, done, remaining, keys, cache,
+                       cfg: llama.LlamaConfig, dcfg: decode.DecodeConfig,
+                       n_steps: int):
+    """``n_steps`` fused decode steps over every slot.
+
+    token/pos/remaining [num_slots] int32, done [num_slots] bool, keys
+    [n_steps, 2] uint32 (sampling; unused for greedy), cache donated.
+    Returns (tokens [n_steps, num_slots], token, pos, done, remaining,
+    cache). Step semantics: :func:`_scan_engine_steps`.
+    """
+    del n_steps
+
+    def decode_fn(tok, p, cache_c):
+        return decode._decode_step(  # pylint: disable=protected-access
+            params, tok, p, cfg, dcfg, cache_c)
+
+    return _scan_engine_steps(decode_fn, dcfg, token, pos, done,
+                              remaining, keys, cache)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=('cfg', 'dcfg', 'n_steps'),
+                   donate_argnums=(7,))
+def _engine_paged_steps_impl(params, token, pos, done, remaining, keys,
+                             block_tables, cache,
+                             cfg: llama.LlamaConfig,
+                             dcfg: decode.DecodeConfig, n_steps: int):
+    """Paged twin of :func:`_engine_steps_impl`: identical per-step
+    semantics, but the cache is the global block pool and every K/V
+    read/write indirects through ``block_tables`` [num_slots,
+    max_len // block_k] (frozen lanes keep writing their frozen
+    position — eviction repoints their table rows at the scratch block,
+    so those writes can never land in a reallocated block)."""
+    del n_steps
+
+    def decode_fn(tok, p, cache_c):
+        return decode._paged_decode_step(  # pylint: disable=protected-access
+            params, tok, p, block_tables, cfg, dcfg, cache_c)
+
+    return _scan_engine_steps(decode_fn, dcfg, token, pos, done,
+                              remaining, keys, cache)
 
 
 @functools.partial(jax.jit, static_argnames=('cfg',), donate_argnums=(4,))
@@ -195,7 +545,9 @@ class DecodeEngine:
                  step_chunk: int = 1,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  rng: Optional[jax.Array] = None,
-                 name: str = 'engine'):
+                 name: str = 'engine',
+                 paged: bool = False,
+                 num_blocks: Optional[int] = None):
         if num_slots < 1:
             raise ValueError(f'num_slots must be >= 1, got {num_slots}')
         if step_chunk < 1:
@@ -206,12 +558,52 @@ class DecodeEngine:
         self.num_slots = num_slots
         self.step_chunk = step_chunk
         self.name = name
+        self.paged = paged
+        self._block_k = dcfg.kernel_block_k
         self._buckets = (tuple(sorted(int(b) for b in prefill_buckets))
                          if prefill_buckets
                          else _default_buckets(dcfg.max_len))
         assert self._buckets[-1] <= dcfg.max_len, self._buckets
-        self._cache = decode.init_kv_cache(cfg, num_slots, dcfg.max_len,
-                                           dcfg.kv_cache_dtype)
+        if paged:
+            bk = self._block_k
+            if dcfg.max_len % bk:
+                raise ValueError(
+                    f'paged mode needs max_len ({dcfg.max_len}) '
+                    f'divisible by block_k ({bk})')
+            # Prefill scatters whole blocks: snap buckets up to block
+            # multiples (dedup keeps the compile count bounded).
+            self._buckets = tuple(sorted({
+                min(-(-b // bk) * bk, dcfg.max_len)
+                for b in self._buckets}))
+            self._max_blocks = dcfg.max_len // bk
+            # Default pool: the same token capacity the dense cache
+            # would reserve (+1 scratch) — equal HBM, so any extra
+            # concurrency is pure paging/prefix-sharing win.
+            self.num_blocks = (num_blocks if num_blocks is not None
+                               else num_slots * self._max_blocks + 1)
+            self._cache = decode.init_block_pool(cfg, self.num_blocks,
+                                                 bk, dcfg.kv_cache_dtype)
+            self._allocator = BlockAllocator(self.num_blocks)
+            self._radix = RadixPrefixCache(bk, self._allocator)
+            # Per-slot block-table mirror; rows of freed slots point at
+            # SCRATCH_BLOCK (0). The device copy is cached and
+            # invalidated only on admission/eviction, so steady-state
+            # ticks skip the host→device upload.
+            self._block_table_np = np.zeros(
+                (num_slots, self._max_blocks), np.int32)
+            self._block_table_dev = None
+            # Per-slot allocator refs to drop at eviction + radix path
+            # locks to release.
+            self._slot_refs: List[List[int]] = [[] for _ in
+                                                range(num_slots)]
+            self._slot_nodes: List[list] = [[] for _ in range(num_slots)]
+            self._prompt_tokens_total = 0
+            self._prompt_tokens_saved = 0
+        else:
+            self.num_blocks = 0
+            self._cache = decode.init_kv_cache(cfg, num_slots,
+                                               dcfg.max_len,
+                                               dcfg.kv_cache_dtype)
         # Host mirrors of per-slot device state.
         self._slots: List[Optional[Request]] = [None] * num_slots
         self._token = np.zeros((num_slots,), np.int32)
@@ -222,9 +614,13 @@ class DecodeEngine:
         # Greedy decoding ignores sampling keys; reuse one zero buffer
         # instead of allocating [step_chunk, 2] on every tick.
         self._zero_keys = jnp.zeros((step_chunk, 2), jnp.uint32)
-        # Admission queue: appended by any thread, drained by the loop.
+        # Admission queues: one FIFO per tenant, appended by any thread,
+        # drained round-robin by the loop (per-tenant fairness — one
+        # tenant's burst queues behind its own requests, not everyone's).
         self._queue_lock = threading.Lock()
-        self._queue: collections.deque = collections.deque()
+        self._queues: 'collections.OrderedDict[str, collections.deque]' \
+            = collections.OrderedDict()
+        self._rr_offset = 0
         # Occupancy accounting: tokens delivered from decode steps vs
         # lane-steps executed (prefill-sampled first tokens excluded —
         # they cost a prefill, not a decode lane-step).
@@ -250,15 +646,65 @@ class DecodeEngine:
         """Enqueue a request for admission (thread-safe)."""
         request.enqueue_ts = time.perf_counter()
         with self._queue_lock:
-            self._queue.append(request)
-            depth = len(self._queue)
+            q = self._queues.get(request.tenant)
+            if q is None:
+                q = self._queues[request.tenant] = collections.deque()
+            q.append(request)
+            depth = sum(len(d) for d in self._queues.values())
         self._m.gauge('skytpu_engine_queue_depth',
                       'Requests waiting for a free slot.').set(depth)
         return request
 
     def queue_depth(self) -> int:
         with self._queue_lock:
-            return len(self._queue)
+            return sum(len(d) for d in self._queues.values())
+
+    def _pop_next(self) -> Optional[Request]:
+        """Round-robin pop across tenant queues (call without lock)."""
+        with self._queue_lock:
+            tenants = list(self._queues)
+            if not tenants:
+                return None
+            for i in range(len(tenants)):
+                tenant = tenants[(self._rr_offset + i) % len(tenants)]
+                q = self._queues[tenant]
+                if q:
+                    # Next round starts at the FOLLOWING tenant, so one
+                    # deep queue cannot shadow the others.
+                    self._rr_offset = \
+                        (self._rr_offset + i + 1) % len(tenants)
+                    req = q.popleft()
+                    if not q:
+                        del self._queues[tenant]
+                    return req
+            # Unreachable while the invariant "every dict entry is a
+            # non-empty deque" holds (submit appends, pops delete
+            # emptied queues); surface a violation instead of silently
+            # dropping queued requests.
+            assert not any(self._queues.values()), self._queues
+            return None
+
+    def _requeue_front(self, request: Request) -> None:
+        """Put an un-admittable request back at the head of its tenant
+        queue AND park the round-robin pointer on that tenant, so the
+        next admission round retries it FIRST — without the pointer
+        reset, other tenants' smaller requests would keep draining the
+        freed pool ahead of the blocked head-of-line request and starve
+        it indefinitely."""
+        with self._queue_lock:
+            q = self._queues.get(request.tenant)
+            if q is None:
+                q = self._queues[request.tenant] = collections.deque()
+                self._queues.move_to_end(request.tenant, last=False)
+            q.appendleft(request)
+            self._rr_offset = list(self._queues).index(request.tenant)
+            depth = sum(len(d) for d in self._queues.values())
+        # Restore the gauge _admit just decremented for the pop — the
+        # request is back in the queue, and a starved head-of-line
+        # request reading as depth 0 would hide exactly the backlog
+        # the pool-pressure runbook tells operators to look for.
+        self._m.gauge('skytpu_engine_queue_depth',
+                      'Requests waiting for a free slot.').set(depth)
 
     def free_slots(self) -> int:
         return sum(1 for r in self._slots if r is None)
@@ -272,7 +718,9 @@ class DecodeEngine:
         """Prefill one request and scatter its K/V prefix into a free
         slot; the first token samples from the prefill logits. Returns
         the slot index. Raises RuntimeError when no slot is free (use
-        ``submit`` + the engine loop for queued admission)."""
+        ``submit`` + the engine loop for queued admission) and
+        PoolExhausted when the paged pool cannot cover the request even
+        after prefix-cache eviction (nothing is mutated; requeue)."""
         slot = next((i for i, r in enumerate(self._slots) if r is None),
                     None)
         if slot is None:
@@ -283,21 +731,25 @@ class DecodeEngine:
                 f'prompt ({p}) + max_new_tokens '
                 f'({request.max_new_tokens}) exceeds max_len '
                 f'{self.dcfg.max_len}')
-        bucket = next(b for b in self._buckets if b >= p)
         if request.enqueue_ts is None:
             request.enqueue_ts = time.perf_counter()
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :p] = request.prompt
-        if self.dcfg.temperature == 0.0:
-            first_dev, self._cache = _prefill_greedy_impl(
-                self.params, jnp.asarray(padded), jnp.int32(p),
-                jnp.int32(slot), self._cache, cfg=self.cfg)
-            first = int(first_dev)
+        if self.paged:
+            first, shared_tokens = self._prefill_paged(slot, request)
         else:
-            last, self._cache = decode.prefill_into_slot(
-                self.params, jnp.asarray(padded), jnp.int32(p),
-                jnp.int32(slot), self.cfg, self._cache)
-            first = int(self._sample_first(last))
+            shared_tokens = 0
+            bucket = self._bucket_for(p)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :p] = request.prompt
+            if self.dcfg.temperature == 0.0:
+                first_dev, self._cache = _prefill_greedy_impl(
+                    self.params, jnp.asarray(padded), jnp.int32(p),
+                    jnp.int32(slot), self._cache, cfg=self.cfg)
+                first = int(first_dev)
+            else:
+                last, self._cache = decode.prefill_into_slot(
+                    self.params, jnp.asarray(padded), jnp.int32(p),
+                    jnp.int32(slot), self.cfg, self._cache)
+                first = int(self._sample_first(last))
         self._m.histogram(
             'skytpu_engine_ttft_seconds',
             'Time from enqueue to first token (includes queueing).',
@@ -309,7 +761,7 @@ class DecodeEngine:
         self._m.counter('skytpu_engine_tokens_total',
                         'Tokens generated by the engine.').inc()
         self._journal(journal.EventKind.ENGINE_ADMIT, request, slot,
-                      prompt_len=p, bucket=bucket,
+                      prompt_len=p, prefix_hit_tokens=shared_tokens,
                       max_new_tokens=request.max_new_tokens)
         hit_eos = (self.dcfg.eos_id is not None and
                    first == self.dcfg.eos_id)
@@ -328,31 +780,204 @@ class DecodeEngine:
         self._publish_slot_gauges()
         return slot
 
+    def _prefill_paged(self, slot: int, request: Request
+                       ) -> Tuple[int, int]:
+        """Paged admission: radix-match the prompt, reserve blocks,
+        copy-on-write the boundary block of a full hit, prefill only
+        the un-cached suffix, then publish the prompt's full blocks to
+        the prefix cache. Returns (first token, shared prefix tokens).
+
+        Raises PoolExhausted with NO state mutated when the
+        reservation cannot be met (caller requeues the request)."""
+        bk = self._block_k
+        p = len(request.prompt)
+        blocks, path = self._radix.match(request.prompt)
+        m_full = len(blocks) * bk
+        # Keep >= 1 suffix token: the first generated token samples from
+        # the last prompt position's logits, which only a forward pass
+        # produces (a full-prompt hit caches K/V, not logits).
+        m = min(m_full, p - 1)
+        first_owned = m // bk
+        n_total = -(-(p + request.max_new_tokens) // bk)
+        need = n_total - first_owned
+        short = need - self._allocator.available()
+        if short > 0:
+            self._radix.evict(short)
+        cow_dst = cow_src = None
+        try:
+            if m < m_full:
+                # Full-prompt hit, m snapped back mid-block: the suffix
+                # rewrite lands inside a SHARED block (the tree and our
+                # own match ref pin it) — the allocator's copy-on-write
+                # hands us a writable clone target.
+                cow_src = blocks[first_owned]
+                cow_dst, needs_copy = self._allocator.cow(cow_src)
+                # The source is pinned by the tree AND our match ref,
+                # so cow() always cloned; an in-place grant would alias
+                # cow_dst into `blocks` and double-release at evict.
+                assert needs_copy, (cow_src, cow_dst)
+                owned = ([cow_dst] +
+                         self._allocator.alloc(need - 1))
+            else:
+                needs_copy = False
+                owned = self._allocator.alloc(need)
+        except PoolExhausted:
+            if cow_dst is not None and cow_dst != cow_src:
+                self._allocator.decref([cow_dst])
+            self._allocator.decref(blocks)
+            self._radix.release(path)
+            raise
+        table = blocks[:first_owned] + owned
+        try:
+            if needs_copy:
+                self._cache = decode.copy_block(
+                    self._cache, jnp.int32(cow_src), jnp.int32(cow_dst))
+            if m == 0:
+                bucket = self._bucket_for(p)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :p] = request.prompt
+                row = np.full((bucket // bk,), SCRATCH_BLOCK, np.int32)
+                nrow = min(len(table), len(row))
+                row[:nrow] = table[:nrow]
+                last, self._cache = decode.paged_prefill(
+                    self.params, jnp.asarray(padded), jnp.int32(p),
+                    jnp.asarray(row), self.cfg, self._cache)
+            else:
+                suf = p - m
+                bucket = self._bucket_for(suf)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :suf] = request.prompt[m:]
+                # Gathered-prefix block count buckets to powers of two
+                # so compiles stay bounded; padding rows point at
+                # scratch and are masked out by prefix_len.
+                npb = -(-m // bk)
+                npb_bucket = 1
+                while npb_bucket < npb:
+                    npb_bucket *= 2
+                pref = np.full((npb_bucket,), SCRATCH_BLOCK, np.int32)
+                pref[:npb] = table[:npb]
+                # Suffix writes start inside block m // bk at offset
+                # m % bk (the COW clone on a full hit, a fresh block
+                # otherwise).
+                start = m // bk
+                row = np.full((bucket // bk + 1,), SCRATCH_BLOCK,
+                              np.int32)
+                avail = table[start:start + len(row)]
+                row[:len(avail)] = avail
+                last, self._cache = decode.paged_prefill_with_prefix(
+                    self.params, jnp.asarray(padded), jnp.int32(suf),
+                    jnp.int32(m), jnp.asarray(pref), jnp.asarray(row),
+                    self.cfg, self._cache)
+                self._prompt_tokens_saved += m
+                self._m.counter(
+                    'skytpu_engine_prefill_tokens_saved_total',
+                    'Prompt tokens NOT prefilled thanks to prefix-'
+                    'cache hits.').inc(m)
+            self._prompt_tokens_total += p
+            # Publish the prompt's whole blocks to the prefix cache
+            # (the partial tail block — and COW clones — stay
+            # private).
+            full = p // bk
+            if full:
+                self._radix.insert(request.prompt[:full * bk],
+                                   table[:full])
+        except Exception:
+            # ANY failure past allocation (device prefill, tracing,
+            # bucket lookup) must return the reservation — leaking the
+            # refs would shrink the pool forever while _admit's
+            # reject path keeps the loop alive. The tree keeps refs it
+            # took in insert(); we only return the request's own.
+            self._allocator.decref(blocks + owned)
+            self._radix.release(path)
+            raise
+        self._slot_refs[slot] = blocks + owned
+        self._slot_nodes[slot] = path
+        self._block_table_np[slot, :] = SCRATCH_BLOCK
+        self._block_table_np[slot, :n_total] = table
+        self._block_table_dev = None
+        self._publish_block_gauges()
+        if self.dcfg.temperature == 0.0:
+            # Device-side argmax: ship 4 bytes, not the vocab-sized
+            # logits row (the dense fast path fuses this into the
+            # prefill dispatch; one extra tiny dispatch is fine here).
+            first = int(jnp.argmax(last))
+        else:
+            first = int(self._sample_first(last))
+        return first, m
+
+    def _bucket_for(self, n: int) -> int:
+        """Smallest prefill bucket covering ``n`` tokens; a proper
+        ValueError (→ journaled reject) instead of a loop-killing
+        StopIteration when custom buckets don't reach max_len."""
+        for b in self._buckets:
+            if b >= n:
+                return b
+        raise ValueError(f'no prefill bucket >= {n} '
+                         f'(buckets: {self._buckets})')
+
     def _sample_first(self, last_logits: jax.Array) -> int:
         self._rng, key = jax.random.split(self._rng)
         return int(decode._sample(last_logits[None], key,  # pylint: disable=protected-access
                                   self.dcfg.temperature)[0])
 
     def _admit(self) -> int:
-        """Fill free slots from the queue; returns admissions made."""
+        """Fill free slots from the tenant queues (round-robin);
+        returns admissions made. Over-budget requests are clamped or
+        rejected with a journaled ``engine.reject`` — the serve loop
+        never dies on one bad request."""
         n = 0
         while True:
             if self.free_slots() == 0:
                 break
-            with self._queue_lock:
-                if not self._queue:
-                    break
-                req = self._queue.popleft()
-                depth = len(self._queue)
-            self._m.gauge('skytpu_engine_queue_depth',
-                          'Requests waiting for a free slot.').set(depth)
+            req = self._pop_next()
+            if req is None:
+                break
+            self._m.gauge(
+                'skytpu_engine_queue_depth',
+                'Requests waiting for a free slot.').set(
+                    self.queue_depth())
+            p = len(req.prompt)
+            budget = self.dcfg.max_len - p
+            if self.paged:
+                # An undersized pool caps the servable length below
+                # max_len: a reservation larger than the whole pool
+                # would otherwise requeue forever (livelock, not
+                # backpressure).
+                budget = min(budget,
+                             (self.num_blocks - 1) * self._block_k - p)
+            if budget < 1:
+                self._reject(req, 'prompt_too_long', prompt_len=p,
+                             max_len=self.dcfg.max_len)
+                continue
+            if req.max_new_tokens > budget:
+                # Clamp rather than kill: the prompt fits, only the
+                # generation budget overshoots. Journaled so the
+                # truncation is attributable after the fact.
+                self._journal(journal.EventKind.ENGINE_REJECT, req, -1,
+                              action='clamp', prompt_len=p,
+                              requested=req.max_new_tokens,
+                              clamped_to=budget)
+                req.max_new_tokens = budget
             try:
                 self.insert(req)
                 n += 1
+            except PoolExhausted:
+                # Not an error: blocks are busy. Head-of-line blocks
+                # until eviction frees pool space (admission order is
+                # preserved — skipping ahead would starve big
+                # requests).
+                self._requeue_front(req)
+                break
             except ValueError as e:
-                # Oversized request: fail it, keep serving the rest.
-                req._finish(f'error: {e}')  # pylint: disable=protected-access
+                self._reject(req, f'error: {e}')
         return n
+
+    def _reject(self, req: Request, reason: str, **payload) -> None:
+        self._journal(journal.EventKind.ENGINE_REJECT, req, -1,
+                      action='reject', reason=reason, **payload)
+        self._m.counter('skytpu_engine_rejected_total',
+                        'Requests rejected at admission.').inc()
+        req._finish(f'rejected: {reason}')  # pylint: disable=protected-access
 
     # ------------------------------------------------------------- step
 
@@ -371,13 +996,24 @@ class DecodeEngine:
         else:
             keys = self._zero_keys
         t0 = time.perf_counter()
-        toks, token, pos, done, remaining, self._cache = \
-            _engine_steps_impl(self.params, jnp.asarray(self._token),
-                               jnp.asarray(self._pos),
-                               jnp.asarray(self._done),
-                               jnp.asarray(self._remaining), keys,
-                               self._cache, cfg=self.cfg, dcfg=self.dcfg,
-                               n_steps=n)
+        if self.paged:
+            if self._block_table_dev is None:
+                self._block_table_dev = jnp.asarray(self._block_table_np)
+            toks, token, pos, done, remaining, self._cache = \
+                _engine_paged_steps_impl(
+                    self.params, jnp.asarray(self._token),
+                    jnp.asarray(self._pos), jnp.asarray(self._done),
+                    jnp.asarray(self._remaining), keys,
+                    self._block_table_dev, self._cache,
+                    cfg=self.cfg, dcfg=self.dcfg, n_steps=n)
+        else:
+            toks, token, pos, done, remaining, self._cache = \
+                _engine_steps_impl(
+                    self.params, jnp.asarray(self._token),
+                    jnp.asarray(self._pos), jnp.asarray(self._done),
+                    jnp.asarray(self._remaining), keys,
+                    self._cache, cfg=self.cfg, dcfg=self.dcfg,
+                    n_steps=n)
         # One fused host fetch (the sync point); np.array copies because
         # the transferred buffers are read-only and the slot mirrors are
         # mutated by eviction/refill.
@@ -433,6 +1069,18 @@ class DecodeEngine:
         self._slots[slot] = None
         self._done[slot] = True
         self._remaining[slot] = 0
+        if self.paged:
+            # Drop the request's block refs (prefix-cache-held blocks
+            # survive; decode-only blocks free) and repoint the table
+            # row at scratch so the frozen lane's writes can never land
+            # in a block reallocated to someone else.
+            self._allocator.decref(self._slot_refs[slot])
+            self._radix.release(self._slot_nodes[slot])
+            self._slot_refs[slot] = []
+            self._slot_nodes[slot] = []
+            self._block_table_np[slot, :] = SCRATCH_BLOCK
+            self._block_table_dev = None
+            self._publish_block_gauges()
         self._evicted += 1
         self._m.counter('skytpu_engine_evicted_total',
                         'Requests evicted from a slot (finished).').inc()
@@ -463,9 +1111,16 @@ class DecodeEngine:
         lane_steps = self._decode_steps * self.num_slots
         return self._decode_emitted / lane_steps if lane_steps else 0.0
 
+    def prefix_hit_ratio(self) -> float:
+        """Fraction of admitted prompt tokens served from the prefix
+        cache (prefill skipped) — paged mode only."""
+        if not self.paged or not self._prompt_tokens_total:
+            return 0.0
+        return self._prompt_tokens_saved / self._prompt_tokens_total
+
     def stats(self) -> dict:
         self.flush_journal()
-        return {
+        out = {
             'num_slots': self.num_slots,
             'active_slots': self.active_slots(),
             'queue_depth': self.queue_depth(),
@@ -477,7 +1132,18 @@ class DecodeEngine:
             'step_chunk': self.step_chunk,
             'kv_cache_dtype': self.dcfg.kv_cache_dtype,
             'max_len': self.dcfg.max_len,
+            'paged': self.paged,
         }
+        if self.paged:
+            out.update({
+                'block_k': self._block_k,
+                'blocks_total': self.num_blocks - 1,
+                'blocks_used': self._allocator.used(),
+                'prefix_cache_blocks': self._radix.held_blocks(),
+                'prefix_hit_ratio': round(self.prefix_hit_ratio(), 4),
+                'prefill_tokens_saved': self._prompt_tokens_saved,
+            })
+        return out
 
     # ---------------------------------------------------------- plumbing
 
@@ -488,6 +1154,18 @@ class DecodeEngine:
             'skytpu_engine_slot_occupancy',
             'Measured decode-lane occupancy (delivered tokens / '
             'lane-steps).').set(self.mean_occupancy())
+
+    def _publish_block_gauges(self) -> None:
+        self._m.gauge('skytpu_engine_blocks_total',
+                      'Usable KV pool blocks (scratch excluded).').set(
+                          self.num_blocks - 1)
+        self._m.gauge('skytpu_engine_blocks_used',
+                      'KV pool blocks currently referenced (slots or '
+                      'prefix cache).').set(self._allocator.used())
+        self._m.gauge(
+            'skytpu_engine_prefix_hit_ratio',
+            'Cumulative fraction of prompt tokens served from the '
+            'prefix cache.').set(self.prefix_hit_ratio())
 
     def _journal(self, kind, request: Request, slot: int,
                  **payload) -> None:

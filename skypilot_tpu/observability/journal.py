@@ -86,6 +86,9 @@ class EventKind(enum.Enum):
     # replica's latency, reconstructable per request id.
     ENGINE_ADMIT = 'engine.admit'
     ENGINE_EVICT = 'engine.evict'
+    # Admission-control decisions: over-budget requests clamped or
+    # rejected instead of crashing the serve loop.
+    ENGINE_REJECT = 'engine.reject'
 
 
 KINDS = frozenset(k.value for k in EventKind)

@@ -27,8 +27,23 @@ are live) and, with GQA, used to materialize ``repeat_kv``-expanded K/V
   (position, kv-head) fp32 scales (``ops/quant.py`` numerics), halving
   cache bandwidth; dequantization happens in-kernel after the DMA.
 
+* **Paged KV (block tables)**: the cache may live in a *global block
+  pool* ``[n_blocks, block_k, Hkv, hd]`` instead of one dense
+  ``[B, max_len, ...]`` lane per sequence; each sequence then owns an
+  int32 ``block_tables[B, max_blocks]`` row naming its blocks in order.
+  The paged kernel generalizes the ``cur_len`` clamping above: the
+  scalar-prefetched block table rides next to ``cur_len``, and the
+  BlockSpec index map *indirects* through it — grid step ``j`` of batch
+  row ``bi`` DMAs pool block ``block_tables[bi, j]``. Dead entries past
+  ``cur_len`` clamp to the last live table slot exactly like the dense
+  kernel, so fully-dead blocks are still never read. This is what lets
+  the serving engine share prompt-prefix blocks across sequences
+  (``models/engine.py``'s radix prefix cache): two rows whose tables
+  name the same block read the same HBM, copy-free.
+
 Off-TPU the grouped-einsum XLA path below runs instead (tests force the
-kernel through the Pallas interpreter to check numerics on CPU).
+kernel through the Pallas interpreter to check numerics on CPU); its
+paged variant gathers pool blocks through the table first.
 """
 import functools
 from typing import Optional
@@ -234,6 +249,149 @@ def decode_attention_xla(q: jax.Array, k_cache: jax.Array,
     out = jnp.einsum('bkgst,btkd->bskgd', probs, v,
                      preferred_element_type=jnp.float32)
     return out.reshape(b, s, h, hd).astype(q.dtype)
+
+
+# ------------------------------------------------------------------ paged
+
+
+def _paged_decode_kernel(cur_ref, bt_ref, *args, **kwargs):
+    """Paged program body == dense body: the block table only changes
+    *which* pool block each grid step DMAs (the index maps below); the
+    online-softmax accumulation over the delivered ``[block_k]`` slab is
+    identical, so the dense kernel is reused verbatim."""
+    del bt_ref  # consumed by the BlockSpec index maps, not the body
+    return _decode_kernel(cur_ref, *args, **kwargs)
+
+
+def paged_decode_attention_kernel(q: jax.Array, k_pool: jax.Array,
+                                  v_pool: jax.Array,
+                                  block_tables: jax.Array,
+                                  cur_len: jax.Array,
+                                  k_scale: Optional[jax.Array] = None,
+                                  v_scale: Optional[jax.Array] = None,
+                                  interpret: bool = False) -> jax.Array:
+    """q [B,1,H,hd] vs a block pool [n_blocks, block_k, Hkv, hd] indirected
+    through ``block_tables`` [B, max_blocks] int32 → [B,1,H,hd].
+
+    Sequence ``bi``'s position ``p`` lives in pool block
+    ``block_tables[bi, p // block_k]`` at offset ``p % block_k``;
+    ``cur_len`` [B] masks exactly like the dense kernel (table entries
+    past the last live block are never dereferenced — the index map
+    clamps first, then indirects). ``k_scale``/``v_scale``
+    [n_blocks, block_k, Hkv] fp32 mark an int8 pool.
+    """
+    b, s_q, h, hd = q.shape
+    assert s_q == 1, q.shape
+    _, block_k, hkv, _ = k_pool.shape
+    n_bt = block_tables.shape[1]
+    assert block_tables.shape[0] == b, (block_tables.shape, b)
+    quantized = k_scale is not None
+
+    def q_index(bi, j, cur_ref, bt_ref):
+        del j, cur_ref, bt_ref
+        return (bi, 0, 0)
+
+    def _table_block(bi, j, cur_ref, bt_ref):
+        # Clamp THEN indirect: dead grid steps re-deliver the last live
+        # block (unchanged index → Pallas skips the DMA), and table rows
+        # past a sequence's allocation are never read.
+        live = pl.cdiv(cur_ref[bi], block_k)
+        jc = jnp.minimum(j, jnp.maximum(live - 1, 0))
+        return bt_ref[bi, jc]
+
+    def kv_index(bi, j, cur_ref, bt_ref):
+        return (_table_block(bi, j, cur_ref, bt_ref), 0, 0, 0)
+
+    def scale_index(bi, j, cur_ref, bt_ref):
+        return (_table_block(bi, j, cur_ref, bt_ref), 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, h, hd), q_index),
+        pl.BlockSpec((1, block_k, hkv, hd), kv_index),
+        pl.BlockSpec((1, block_k, hkv, hd), kv_index),
+    ]
+    operands = [q[:, 0], k_pool, v_pool]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, block_k, hkv), scale_index),
+            pl.BlockSpec((1, block_k, hkv), scale_index),
+        ]
+        operands += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, n_bt),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, h, hd), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((h, 128), jnp.float32),   # m (lane-replicated)
+            pltpu.VMEM((h, 128), jnp.float32),   # l
+            pltpu.VMEM((h, hd), jnp.float32),    # acc
+        ],
+    )
+    kernel = functools.partial(
+        _paged_decode_kernel, block_k=block_k, n_blocks=n_bt,
+        n_kv_heads=hkv, scale=hd**-0.5, quantized=quantized)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, hd), q.dtype),
+        interpret=interpret,
+    )(cur_len.astype(jnp.int32), block_tables.astype(jnp.int32),
+      *operands)
+    return out[:, None]
+
+
+def gather_paged_kv(k_pool: jax.Array, v_pool: jax.Array,
+                    block_tables: jax.Array,
+                    k_scale: Optional[jax.Array] = None,
+                    v_scale: Optional[jax.Array] = None):
+    """Materialize each sequence's cache view from the pool:
+    [n_blocks, block_k, ...] + tables [B, max_blocks] →
+    (k, v [B, max_blocks*block_k, Hkv, hd], scales or None). The XLA
+    fallback (and host-side debugging) path; the kernel never does this.
+    """
+    b, n_bt = block_tables.shape
+    block_k = k_pool.shape[1]
+
+    def flat(pool):
+        g = pool[block_tables]            # [B, n_bt, block_k, ...]
+        return g.reshape((b, n_bt * block_k) + pool.shape[2:])
+
+    ks = flat(k_scale) if k_scale is not None else None
+    vs = flat(v_scale) if v_scale is not None else None
+    return flat(k_pool), flat(v_pool), ks, vs
+
+
+def paged_decode_attention_xla(q: jax.Array, k_pool: jax.Array,
+                               v_pool: jax.Array, block_tables: jax.Array,
+                               cur_len: jax.Array,
+                               k_scale: Optional[jax.Array] = None,
+                               v_scale: Optional[jax.Array] = None
+                               ) -> jax.Array:
+    """Grouped-einsum fallback over a table-gathered cache view (CPU and
+    odd shapes). Same contract as the paged kernel."""
+    k, v, ks, vs = gather_paged_kv(k_pool, v_pool, block_tables,
+                                   k_scale, v_scale)
+    return decode_attention_xla(q, k, v, cur_len, ks, vs)
+
+
+def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
+                           v_pool: jax.Array, block_tables: jax.Array,
+                           cur_len: jax.Array,
+                           k_scale: Optional[jax.Array] = None,
+                           v_scale: Optional[jax.Array] = None,
+                           interpret: Optional[bool] = None) -> jax.Array:
+    """Kernel when it can run (TPU, or forced interpreter), XLA otherwise
+    (mirrors :func:`decode_attention`; the pool's block_k is the kernel
+    block size, so there is no divisibility fallback to consider)."""
+    itp = _resolve_interpret(interpret)
+    if itp is None:
+        return paged_decode_attention_xla(q, k_pool, v_pool, block_tables,
+                                          cur_len, k_scale, v_scale)
+    return paged_decode_attention_kernel(q, k_pool, v_pool, block_tables,
+                                         cur_len, k_scale, v_scale,
+                                         interpret=itp)
 
 
 def _resolve_interpret(interpret: Optional[bool]) -> Optional[bool]:

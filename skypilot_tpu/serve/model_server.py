@@ -16,7 +16,14 @@ Endpoints:
   per generated token as the engine emits it (the LB already streams
   chunk-by-chunk, so tokens reach the client while the replica is still
   decoding); the final event carries ``finish_reason`` and counts.
-  ``stream: false`` returns one JSON object after eviction.
+  ``stream: false`` returns one JSON object after eviction. Requests
+  carry an optional tenant key (``X-Tenant`` header or body
+  ``tenant``) — the engine admits round-robin across tenants, so one
+  tenant's burst cannot monopolize the batch. When the engine's
+  admission queue reaches ``SKYTPU_SERVE_MAX_QUEUE`` (default 256,
+  0 disables) the server answers **429** with ``Retry-After`` and
+  counts ``skytpu_server_rejected_total`` instead of queueing without
+  bound.
 * ``GET /healthz`` — readiness probe target: 200 with engine stats
   while the engine loop thread is alive, 503 after it dies.
 * ``GET /metrics`` — Prometheus text exposition of the process registry
@@ -55,6 +62,12 @@ REPLICA_PORT_ENV = 'SKYTPU_REPLICA_PORT'
 # Cap on one request's SSE lifetime: a wedged engine must not hold LB
 # connections forever (the LB's sock_read timeout is 300s).
 REQUEST_TIMEOUT_ENV = 'SKYTPU_MODEL_SERVER_REQUEST_TIMEOUT'
+# Admission-queue backpressure: when the engine's queue depth reaches
+# this, /generate answers 429 + Retry-After instead of queueing without
+# bound (an unbounded queue converts overload into unbounded memory and
+# client timeouts instead of an actionable signal). 0 disables.
+MAX_QUEUE_ENV = 'SKYTPU_SERVE_MAX_QUEUE'
+DEFAULT_MAX_QUEUE = 256
 
 
 def encode_text(text: str, vocab_size: int) -> list:
@@ -83,6 +96,11 @@ class ModelServer:
                 os.environ.get(REQUEST_TIMEOUT_ENV, '300'))
         except ValueError:
             self.request_timeout = 300.0
+        try:
+            self.max_queue = int(
+                os.environ.get(MAX_QUEUE_ENV, str(DEFAULT_MAX_QUEUE)))
+        except ValueError:
+            self.max_queue = DEFAULT_MAX_QUEUE
         self._stop = threading.Event()
         self._engine_thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -187,6 +205,22 @@ class ModelServer:
                 status=400)
         max_new = max(1, min(max_new, limit))
         stream = bool(body.get('stream', True))
+        # Backpressure BEFORE enqueueing: a full admission queue answers
+        # 429 with a (fixed 1 s) Retry-After hint instead of parking
+        # the client behind an unbounded backlog.
+        if self.max_queue > 0:
+            depth = self.engine.queue_depth()
+            if depth >= self.max_queue:
+                metrics_lib.counter(
+                    'skytpu_server_rejected_total',
+                    'Requests rejected with 429 (queue full).').inc()
+                return web.json_response(
+                    {'error': f'queue full ({depth} waiting)'},
+                    status=429, headers={'Retry-After': '1'})
+        # Per-tenant fairness key: explicit header wins, body field
+        # next; anonymous traffic shares one bucket.
+        tenant = (request.headers.get('X-Tenant')
+                  or body.get('tenant') or 'default')
 
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
@@ -194,7 +228,14 @@ class ModelServer:
         def on_token(token: int, done: bool) -> None:
             loop.call_soon_threadsafe(q.put_nowait, (token, done))
 
-        req = engine_lib.Request(tokens, max_new, on_token=on_token)
+        req = engine_lib.Request(tokens, max_new, on_token=on_token,
+                                 tenant=str(tenant))
+        # Terminal sentinel: a request the engine rejects (or fails at
+        # admission) finishes WITHOUT ever emitting a token — without
+        # this, the handler would sit on the empty queue until the
+        # request timeout while the rejection is already known.
+        req.on_finish = lambda: loop.call_soon_threadsafe(
+            q.put_nowait, (None, True))
         self.engine.submit(req)
         metrics_lib.counter('skytpu_engine_requests_total',
                             'HTTP /generate requests accepted.',
@@ -220,6 +261,15 @@ class ModelServer:
         try:
             while True:
                 token, done = await self._next_token(q)
+                if token is None:
+                    # Terminal sentinel with no token: engine-side
+                    # rejection/error. (After a normal final token the
+                    # loop has already returned, so this only fires for
+                    # empty generations.)
+                    await resp.write(
+                        f'data: {json.dumps({"error": req.finish_reason, "done": True})}'
+                        '\n\n'.encode())
+                    break
                 event = {'token': token,
                          'text': decode_tokens([token]), 'done': done}
                 if done:
@@ -239,11 +289,16 @@ class ModelServer:
                               q: asyncio.Queue) -> web.Response:
         try:
             while True:
-                _, done = await self._next_token(q)
+                token, done = await self._next_token(q)
                 if done:
                     break
         except asyncio.TimeoutError:
             return web.json_response({'error': 'timeout'}, status=504)
+        if token is None and not req.tokens:
+            # Engine-side rejection: known instantly, surfaced as a
+            # client error instead of a request-timeout 504.
+            return web.json_response({'error': req.finish_reason},
+                                     status=422)
         return web.json_response({
             'tokens': req.tokens,
             'text': decode_tokens(req.tokens),
@@ -270,7 +325,9 @@ def build_engine(model: str, num_slots: int, max_len: int,
                  temperature: float = 0.0, eos_id: Optional[int] = None,
                  kv_int8: bool = False, int8: bool = False,
                  attn: str = 'kernel', step_chunk: int = 4,
-                 checkpoint_dir: Optional[str] = None, seed: int = 0
+                 checkpoint_dir: Optional[str] = None, seed: int = 0,
+                 paged: bool = False, num_blocks: Optional[int] = None,
+                 block_k: Optional[int] = None
                  ) -> engine_lib.DecodeEngine:
     """Assemble params + configs into a DecodeEngine (CLI + tests)."""
     import jax
@@ -288,12 +345,16 @@ def build_engine(model: str, num_slots: int, max_len: int,
                         f'{checkpoint_dir}.')
     if int8:
         params = decode.quantize_params(params)
-    dcfg = decode.DecodeConfig(
+    dcfg_kwargs = dict(
         max_len=max_len, temperature=temperature, eos_id=eos_id,
         decode_attention=attn,
         kv_cache_dtype='int8' if kv_int8 else 'bf16')
+    if block_k is not None:
+        dcfg_kwargs['kernel_block_k'] = block_k
+    dcfg = decode.DecodeConfig(**dcfg_kwargs)
     return engine_lib.DecodeEngine(params, cfg, dcfg, num_slots,
-                                   step_chunk=step_chunk, name=model)
+                                   step_chunk=step_chunk, name=model,
+                                   paged=paged, num_blocks=num_blocks)
 
 
 def main() -> None:
@@ -324,6 +385,17 @@ def main() -> None:
                         help='int8 KV cache')
     parser.add_argument('--attn', choices=('kernel', 'xla'),
                         default='kernel')
+    parser.add_argument('--paged', action='store_true',
+                        help='paged KV cache + radix prefix reuse: HBM '
+                             'scales with live tokens, shared prompt '
+                             'prefixes share pool blocks copy-free')
+    parser.add_argument('--num-blocks', type=int, default=None,
+                        help='paged pool size in blocks (default: the '
+                             'dense cache equivalent, '
+                             'num_slots*max_len/block_k + 1)')
+    parser.add_argument('--block-k', type=int, default=None,
+                        help='paged pool block size in tokens (default: '
+                             'the kernel KV block, 128)')
     parser.add_argument('--checkpoint-dir', default=None,
                         help='restore params from models/checkpoint '
                              'layout (default: random init — demo mode)')
@@ -335,7 +407,9 @@ def main() -> None:
                           int8=args.int8, attn=args.attn,
                           step_chunk=args.step_chunk,
                           checkpoint_dir=args.checkpoint_dir,
-                          seed=args.seed)
+                          seed=args.seed, paged=args.paged,
+                          num_blocks=args.num_blocks,
+                          block_k=args.block_k)
     server = ModelServer(engine, args.port, host=args.host,
                          default_max_new_tokens=args.max_new_tokens)
     server.run_forever()

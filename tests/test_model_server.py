@@ -121,6 +121,87 @@ def test_healthz_and_metrics(server):
     assert 'skytpu_engine_requests_total' in m.text
 
 
+def test_generate_accepts_tenant_key(server):
+    """Tenant plumbing: header and body tenants route through the
+    engine's per-tenant queues without perturbing results."""
+    r = requests.post(f'{server}/generate',
+                      json={'prompt': [3, 1, 4], 'max_new_tokens': 2,
+                            'stream': False},
+                      headers={'X-Tenant': 'acme'}, timeout=120)
+    assert r.status_code == 200 and r.json()['generated'] == 2
+    r = requests.post(f'{server}/generate',
+                      json={'prompt': [3, 1, 4], 'max_new_tokens': 2,
+                            'stream': False, 'tenant': 'bravo'},
+                      timeout=120)
+    assert r.status_code == 200 and r.json()['generated'] == 2
+
+
+def test_queue_backpressure_returns_429(monkeypatch):
+    """SKYTPU_SERVE_MAX_QUEUE: a full admission queue answers 429 +
+    Retry-After and counts skytpu_server_rejected_total instead of
+    queueing without bound."""
+    from skypilot_tpu.observability import metrics as metrics_lib
+    monkeypatch.setenv('SKYTPU_SERVE_MAX_QUEUE', '1')
+    # Park the engine loop in a long idle sleep so the queued request
+    # stays queued for the duration of the test (and stop() only waits
+    # out one sleep).
+    monkeypatch.setenv('SKYTPU_ENGINE_IDLE_SLEEP_SECONDS', '5')
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    eng = engine_lib.DecodeEngine(params, CFG,
+                                  decode.DecodeConfig(max_len=64),
+                                  num_slots=1, prefill_buckets=(16,),
+                                  name='bp-server')
+    srv = model_server.ModelServer(eng, port=0, host='127.0.0.1')
+    assert srv.max_queue == 1
+    port = srv.start()
+    try:
+        time.sleep(0.5)  # loop has hit its (30 s) idle sleep
+        eng.submit(engine_lib.Request([1, 2], 1))  # depth == max_queue
+        before = requests.get(f'http://127.0.0.1:{port}/metrics',
+                              timeout=30).text
+        assert 'skytpu_server_rejected_total' not in before
+        r = requests.post(f'http://127.0.0.1:{port}/generate',
+                          json={'prompt': [1, 2, 3], 'stream': False},
+                          timeout=30)
+        assert r.status_code == 429
+        assert r.headers['Retry-After'] == '1'
+        assert 'queue full' in r.json()['error']
+        m = requests.get(f'http://127.0.0.1:{port}/metrics',
+                         timeout=30).text
+        assert 'skytpu_server_rejected_total 1' in m
+    finally:
+        srv.stop()
+    assert metrics_lib.get_registry().get(
+        'skytpu_server_rejected_total').value() == 1
+
+
+def test_engine_rejection_surfaces_immediately(monkeypatch):
+    """A request the engine rejects at admission (here: paged pool too
+    small for the prompt, which the server's max_len pre-check cannot
+    see) must answer the client right away via the on_finish terminal
+    sentinel — not hang out the 300 s request timeout."""
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    dcfg = decode.DecodeConfig(max_len=128, decode_attention='xla',
+                               kernel_block_k=8)
+    # 3 usable blocks = 24 servable tokens, max_len 128: a 40-token
+    # prompt passes the HTTP pre-check but can never be admitted.
+    eng = engine_lib.DecodeEngine(params, CFG, dcfg, num_slots=1,
+                                  prefill_buckets=(64,), paged=True,
+                                  num_blocks=4, name='rej-server')
+    srv = model_server.ModelServer(eng, port=0, host='127.0.0.1')
+    port = srv.start()
+    try:
+        t0 = time.time()
+        r = requests.post(f'http://127.0.0.1:{port}/generate',
+                          json={'prompt': [7] * 40, 'stream': False,
+                                'max_new_tokens': 4}, timeout=60)
+        assert r.status_code == 422, (r.status_code, r.text)
+        assert 'rejected' in r.json()['error']
+        assert time.time() - t0 < 30  # sentinel, not timeout
+    finally:
+        srv.stop()
+
+
 def test_demo_codec_roundtrip():
     ids = model_server.encode_text('hello tpu', 256)
     assert model_server.decode_tokens(ids) == 'hello tpu'

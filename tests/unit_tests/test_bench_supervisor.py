@@ -131,6 +131,7 @@ def test_supervisor_down_tunnel_fails_fast():
         'JAX_PLATFORMS': 'axon',
         harness.RELAY_ENV: f'127.0.0.1:{_free_port()}',
         'SKYTPU_BENCH_PREFLIGHT_TIMEOUT': '3',
+        'SKYTPU_BENCH_CPU_FALLBACK': '0',  # assert the HARD-fail path
     }, timeout=60)
     assert res.returncode == 2
     assert 'tunnel is down' in res.stderr
@@ -146,6 +147,7 @@ def test_supervisor_wait_seconds_overrides_preflight():
         harness.RELAY_ENV: f'127.0.0.1:{_free_port()}',
         'SKYTPU_BENCH_WAIT_SECONDS': '3',
         'SKYTPU_BENCH_PREFLIGHT_TIMEOUT': '600',  # must be ignored
+        'SKYTPU_BENCH_CPU_FALLBACK': '0',
     }, timeout=60)
     assert res.returncode == 2
     assert time.time() - t0 < 30
@@ -199,11 +201,72 @@ def test_supervisor_kills_stalled_payload_and_retries():
             'SKYTPU_BENCH_DEADLINE_SCALE': '0.02',  # start: 1.2s
             'SKYTPU_BENCH_ATTEMPTS': '2',
             'SKYTPU_BENCH_TOTAL_TIMEOUT': '30',
+            'SKYTPU_BENCH_CPU_FALLBACK': '0',  # assert the HARD rc=3
         }, timeout=60)
         assert res.returncode == 3
         assert res.stderr.count('stalled') == 2
     finally:
         relay.close()
+
+
+def test_supervisor_down_tunnel_fails_over_to_cpu_sched_phase():
+    """Bench never goes dark (ROADMAP item 5): with the relay down and
+    fallback enabled (the default), the supervisor lands a platform-
+    tagged engine-scheduler result with rc=0 instead of rc=2."""
+    payload = ('import json\n'
+               'print(json.dumps({"metric": "engine_scheduler_tokens'
+               '_per_step", "value": 7.5, "platform": "cpu"}), '
+               'flush=True)\n')
+    res = _run_bench({
+        'JAX_PLATFORMS': 'axon',
+        harness.RELAY_ENV: f'127.0.0.1:{_free_port()}',
+        'SKYTPU_BENCH_PREFLIGHT_TIMEOUT': '3',
+        'SKYTPU_BENCH_SCHED_PAYLOAD_CMD': payload,
+    }, timeout=120)
+    assert res.returncode == 0, res.stderr[-1500:]
+    assert 'failing over' in res.stderr
+    out = json.loads(res.stdout.strip().splitlines()[-1])
+    assert out['platform'] == 'cpu'
+    assert out['metric'] == 'engine_scheduler_tokens_per_step'
+
+
+def test_supervisor_all_attempts_dead_falls_over_to_cpu_sched_phase():
+    """The rc=3 path (payload wedges every attempt) also fails over."""
+    relay = _FakeRelay()
+    payload = ('import json\n'
+               'print(json.dumps({"metric": "engine_scheduler_tokens'
+               '_per_step", "value": 7.5, "platform": "cpu"}), '
+               'flush=True)\n')
+    try:
+        res = _run_bench({
+            'JAX_PLATFORMS': 'axon',
+            harness.RELAY_ENV: f'127.0.0.1:{relay.port}',
+            'SKYTPU_BENCH_PAYLOAD_CMD': 'import time; time.sleep(120)',
+            'SKYTPU_BENCH_DEADLINE_SCALE': '0.02',
+            'SKYTPU_BENCH_ATTEMPTS': '1',
+            'SKYTPU_BENCH_TOTAL_TIMEOUT': '20',
+            'SKYTPU_BENCH_SCHED_PAYLOAD_CMD': payload,
+        }, timeout=120)
+        assert res.returncode == 0, res.stderr[-1500:]
+        out = json.loads(res.stdout.strip().splitlines()[-1])
+        assert out['platform'] == 'cpu'
+    finally:
+        relay.close()
+
+
+def test_cpu_sched_payload_end_to_end():
+    """The REAL --payload-sched (no fake): a platform-tagged scheduler
+    result with paged-vs-dense detail, runnable on plain CPU."""
+    res = subprocess.run(
+        [sys.executable, BENCH, '--payload-sched'],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, 'JAX_PLATFORMS': 'cpu'}, cwd=REPO_ROOT)
+    assert res.returncode == 0, res.stderr[-2000:]
+    out = json.loads(res.stdout.strip().splitlines()[-1])
+    assert out['platform'] == 'cpu'
+    assert out['value'] > 0
+    assert out['detail']['paged']['prefix_hit_ratio'] > 0
+    assert out['detail']['dense']['tokens_per_step'] > 0
 
 
 def test_supervisor_accepts_partial_result_on_decode_wedge():

@@ -1,0 +1,203 @@
+"""Host-side paged-KV bookkeeping: BlockAllocator (alloc/free/refcount/
+copy-on-write/pool exhaustion) and RadixPrefixCache (insert/match/split/
+LRU evict). Pure host logic — no jax arrays touched. Tier-1, CPU.
+"""
+import pytest
+
+from skypilot_tpu.models.engine import (BlockAllocator, PoolExhausted,
+                                        RadixPrefixCache)
+
+pytestmark = pytest.mark.engine
+
+BK = 4
+
+
+def _toks(*blocks):
+    """Block-aligned token list from per-block seeds: (1, 2) →
+    [1,1,1,1, 2,2,2,2]."""
+    out = []
+    for b in blocks:
+        out += [b] * BK
+    return out
+
+
+# ------------------------------------------------------------- allocator
+
+
+def test_alloc_free_roundtrip():
+    a = BlockAllocator(8)            # block 0 reserved (scratch)
+    assert a.available() == 7 and a.used() == 0
+    blocks = a.alloc(3)
+    assert len(set(blocks)) == 3 and 0 not in blocks
+    assert a.available() == 4 and a.used() == 3
+    assert all(a.refcount(b) == 1 for b in blocks)
+    freed = a.decref(blocks)
+    assert sorted(freed) == sorted(blocks)
+    assert a.available() == 7 and a.used() == 0
+
+
+def test_refcount_shared_block_survives_one_release():
+    a = BlockAllocator(4)
+    (b,) = a.alloc(1)
+    a.incref([b])                    # second owner (e.g. the radix tree)
+    assert a.refcount(b) == 2
+    assert a.decref([b]) == []       # still owned
+    assert a.available() == 2
+    assert a.decref([b]) == [b]      # last owner gone → freed
+    assert a.available() == 3
+
+
+def test_pool_exhaustion_raises_and_leaves_state_intact():
+    a = BlockAllocator(4)
+    a.alloc(2)
+    with pytest.raises(PoolExhausted):
+        a.alloc(2)
+    assert a.available() == 1        # failed alloc took nothing
+    a.alloc(1)
+    assert a.available() == 0
+
+
+def test_cow_sole_owner_writes_in_place():
+    a = BlockAllocator(8)
+    (b,) = a.alloc(1)
+    writable, needs_copy = a.cow(b)
+    assert writable == b and not needs_copy
+
+
+def test_cow_shared_block_clones():
+    a = BlockAllocator(8)
+    (b,) = a.alloc(1)
+    a.incref([b])                    # shared
+    writable, needs_copy = a.cow(b)
+    assert needs_copy and writable != b
+    assert a.refcount(writable) == 1
+    assert a.refcount(b) == 2        # original untouched
+
+
+def test_double_free_asserts():
+    a = BlockAllocator(4)
+    (b,) = a.alloc(1)
+    a.decref([b])
+    with pytest.raises(AssertionError):
+        a.decref([b])
+
+
+# ------------------------------------------------------------ radix tree
+
+
+def test_match_empty_tree_and_sub_block_prompts():
+    a = BlockAllocator(16)
+    t = RadixPrefixCache(BK, a)
+    assert t.match(_toks(1, 2)) == ([], [])
+    # Prompts shorter than one block can never share.
+    t.insert(_toks(1), a.alloc(1))
+    blocks, path = t.match([1, 1])   # 2 tokens < BK
+    assert blocks == [] and path == []
+
+
+def test_insert_then_match_increfs_and_locks():
+    a = BlockAllocator(16)
+    t = RadixPrefixCache(BK, a)
+    owned = a.alloc(2)
+    adopted = t.insert(_toks(1, 2), owned)
+    assert adopted == 2 and t.held_blocks() == 2
+    assert all(a.refcount(b) == 2 for b in owned)  # requester + tree
+    a.decref(owned)                  # requester finished
+    assert all(a.refcount(b) == 1 for b in owned)  # tree keeps them
+
+    blocks, path = t.match(_toks(1, 2, 9))
+    assert blocks == owned           # 2 full blocks shared, ref'd again
+    assert all(a.refcount(b) == 2 for b in owned)
+    assert len(path) == 1 and path[0].lock == 1
+    t.release(path)
+    assert path[0].lock == 0
+
+
+def test_partial_edge_match_counts_whole_blocks_only():
+    a = BlockAllocator(16)
+    t = RadixPrefixCache(BK, a)
+    owned = a.alloc(3)
+    t.insert(_toks(1, 2, 3), owned)
+    blocks, path = t.match(_toks(1, 2, 7))   # diverges in block 3
+    assert blocks == owned[:2]
+    t.release(path)
+    a.decref(blocks)
+
+
+def test_insert_divergent_suffix_splits_edge():
+    a = BlockAllocator(32)
+    t = RadixPrefixCache(BK, a)
+    first = a.alloc(3)
+    t.insert(_toks(1, 2, 3), first)
+    # Same first block, divergent rest → edge splits at block 1.
+    second = a.alloc(3)
+    adopted = t.insert(_toks(1, 8, 9), second)
+    assert adopted == 2              # block for (1,) deduped
+    assert t.held_blocks() == 5
+    b1, _ = t.match(_toks(1, 2, 3))
+    b2, _ = t.match(_toks(1, 8, 9))
+    assert b1 == first
+    assert b2 == [first[0]] + second[1:]
+    # The duplicate block the second insert did NOT adopt stays solely
+    # with its requester.
+    assert a.refcount(second[0]) == 1
+
+
+def test_insert_prefix_of_existing_edge_dedupes_fully():
+    a = BlockAllocator(16)
+    t = RadixPrefixCache(BK, a)
+    owned = a.alloc(3)
+    t.insert(_toks(1, 2, 3), owned)
+    dup = a.alloc(2)
+    assert t.insert(_toks(1, 2), dup) == 0
+    assert t.held_blocks() == 3
+
+
+def test_lru_evict_frees_oldest_unlocked_leaf_first():
+    a = BlockAllocator(16)
+    t = RadixPrefixCache(BK, a)
+    old = a.alloc(2)
+    t.insert(_toks(1, 2), old)
+    a.decref(old)                    # only the tree holds them
+    new = a.alloc(2)
+    t.insert(_toks(5, 6), new)
+    a.decref(new)
+    t.match(_toks(1, 2))             # touch the OLD branch → newer now
+    freed = t.evict(1)
+    assert freed == 2                # whole LRU leaf (the 5,6 branch)
+    assert t.match(_toks(5, 6))[0] == []
+    assert t.match(_toks(1, 2))[0] != []
+
+
+def test_evict_skips_locked_nodes():
+    a = BlockAllocator(16)
+    t = RadixPrefixCache(BK, a)
+    owned = a.alloc(2)
+    t.insert(_toks(1, 2), owned)
+    a.decref(owned)
+    blocks, path = t.match(_toks(1, 2))  # active request: locked
+    assert t.evict(5) == 0
+    t.release(path)
+    a.decref(blocks)
+    assert t.evict(5) == 2
+
+
+def test_evict_skips_slot_pinned_entries_then_reclaims():
+    """A leaf whose blocks an active slot still pins frees zero HBM —
+    evicting it would only destroy future prefix hits, so evict()
+    skips it; once the slot releases its refs the entry is
+    reclaimable."""
+    a = BlockAllocator(16)
+    t = RadixPrefixCache(BK, a)
+    owned = a.alloc(2)               # the "slot" keeps its refs
+    t.insert(_toks(1, 2), owned)
+    assert t.evict(2) == 0           # nothing freeable: entry survives
+    assert t.held_blocks() == 2
+    assert all(a.refcount(b) == 2 for b in owned)  # slot + tree intact
+    hit, path = t.match(_toks(1, 2))
+    assert hit == owned              # still a cache hit
+    t.release(path)
+    a.decref(hit)                    # the match's refs
+    a.decref(owned)                  # the slot evicts
+    assert t.evict(2) == 2           # now reclaimable
+    assert a.available() == 15

@@ -154,3 +154,17 @@ def test_sampled_decode_is_finite_and_in_range():
     assert gen.shape == (2, 8)
     assert (np.asarray(gen) >= 0).all()
     assert (np.asarray(gen) < CFG.vocab_size).all()
+
+
+def test_generate_over_budget_raises_value_error():
+    """prompt + max_new_tokens > max_len is a catchable ValueError, not
+    an assert — serving admission paths reject/clamp instead of dying
+    (and `python -O` doesn't silently disable the check)."""
+    import pytest
+    params = _params()
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (1, 8), 0,
+                                CFG.vocab_size)
+    lens = jnp.array([8], jnp.int32)
+    dcfg = decode.DecodeConfig(max_len=16)
+    with pytest.raises(ValueError, match='exceeds max_len'):
+        decode.generate(params, prompt, lens, CFG, dcfg, 9)

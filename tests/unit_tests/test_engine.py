@@ -203,6 +203,196 @@ def test_occupancy_and_metrics_and_journal():
     assert all(e['payload']['reason'] == 'length' for e in evicts)
 
 
+# ------------------------------------------------------------- paged mode
+
+
+def _paged_engine(params, dcfg, num_slots=2, num_blocks=None, chunk=2,
+                  buckets=(16, 32), name='t-paged'):
+    return engine_lib.DecodeEngine(params, CFG, dcfg, num_slots,
+                                   step_chunk=chunk,
+                                   prefill_buckets=buckets,
+                                   paged=True, num_blocks=num_blocks,
+                                   name=name)
+
+
+@pytest.mark.parametrize('kv_dtype', ['bf16', 'int8'])
+def test_paged_engine_matches_static_generate(kv_dtype):
+    """Paged cache + radix sharing must be invisible in the output:
+    greedy paged-engine tokens == static generate rows, through
+    mid-run evict/refill AND shared-prefix admissions."""
+    params = _params()
+    rng = np.random.RandomState(3)
+    shared = rng.randint(0, CFG.vocab_size, size=16).tolist()
+    prompts = [shared + rng.randint(0, CFG.vocab_size,
+                                    size=int(e)).tolist()
+               for e in (3, 7, 0, 5, 9)]
+    max_news = [4, 8, 3, 6, 8]
+    dcfg = decode.DecodeConfig(max_len=64, kv_cache_dtype=kv_dtype,
+                               decode_attention='xla', kernel_block_k=8)
+    static = _static(params, prompts, dcfg, max_new=8)
+    eng = _paged_engine(params, dcfg, num_blocks=40)
+    reqs = [engine_lib.Request(p, m) for p, m in zip(prompts, max_news)]
+    _drain(eng, reqs)
+    for i, r in enumerate(reqs):
+        assert r.tokens == static[i, :max_news[i]].tolist(), i
+    stats = eng.stats()
+    assert stats['paged'] and stats['prefill_tokens_saved'] > 0
+    assert stats['active_slots'] == 0 and stats['queue_depth'] == 0
+
+
+def test_paged_prefix_sharing_e2e_128_token_prefix():
+    """Two requests sharing a 128-token prefix PROVABLY reuse blocks:
+    the second admission's table names the first's physical blocks, the
+    prefix-hit gauge goes positive, and prefill skipped the shared
+    tokens (the FLOPs saving is exactly the skipped prefill tokens)."""
+    params = _params()
+    rng = np.random.RandomState(11)
+    prefix = rng.randint(0, CFG.vocab_size, size=128).tolist()
+    p1 = prefix + rng.randint(0, CFG.vocab_size, size=5).tolist()
+    p2 = prefix + rng.randint(0, CFG.vocab_size, size=9).tolist()
+    dcfg = decode.DecodeConfig(max_len=192, decode_attention='xla',
+                               kernel_block_k=16)
+    eng = _paged_engine(params, dcfg, num_slots=2, num_blocks=64,
+                        buckets=(16, 64, 160), chunk=1)
+    r1 = engine_lib.Request(p1, 3)
+    r2 = engine_lib.Request(p2, 3)
+    s1 = eng.insert(r1)
+    saved_before = eng.stats()['prefill_tokens_saved']
+    assert saved_before == 0
+    s2 = eng.insert(r2)
+    # Physical block sharing: the 128-token prefix is 8 blocks of 16;
+    # both slots' tables must name the SAME pool blocks for them.
+    t1 = eng._block_table_np[s1, :8].tolist()  # pylint: disable=protected-access
+    t2 = eng._block_table_np[s2, :8].tolist()  # pylint: disable=protected-access
+    assert t1 == t2 and len(set(t1)) == 8
+    # ...and the blocks past the prefix diverge.
+    assert eng._block_table_np[s1, 8] != eng._block_table_np[s2, 8]  # pylint: disable=protected-access
+    stats = eng.stats()
+    assert stats['prefill_tokens_saved'] == 128
+    assert stats['prefix_hit_ratio'] > 0
+    reg = metrics.get_registry()
+    assert reg.get('skytpu_engine_prefix_hit_ratio').value() > 0
+    assert reg.get(
+        'skytpu_engine_prefill_tokens_saved_total').value() == 128
+    assert reg.get('skytpu_engine_blocks_used').value() > 0
+    # Output correctness rides along: both match static generate.
+    static = _static(params, [p1, p2], dcfg, max_new=3)
+    _drain(eng, [r1, r2], submit=False)
+    assert r1.tokens == static[0].tolist()
+    assert r2.tokens == static[1].tolist()
+
+
+def test_paged_pool_exhaustion_queues_instead_of_failing():
+    """A pool too small for two concurrent requests serializes them
+    (head-of-line waits for blocks) — nothing errors, everyone
+    finishes, and the pool never over-commits."""
+    params = _params()
+    dcfg = decode.DecodeConfig(max_len=64, decode_attention='xla',
+                               kernel_block_k=8)
+    # 5 usable blocks; each request reserves ceil((16+8)/8) = 3.
+    eng = _paged_engine(params, dcfg, num_slots=2, num_blocks=6,
+                        chunk=1, buckets=(16,))
+    reqs = [engine_lib.Request([i + 1] * 16, 8) for i in range(3)]
+    _drain(eng, reqs)
+    assert all(r.finish_reason == 'length' for r in reqs)
+    assert all(len(r.tokens) == 8 for r in reqs)
+    assert eng.stats()['blocks_used'] <= 5
+
+
+def test_paged_pool_blocked_request_is_not_starved_by_small_ones():
+    """A request whose reservation is waiting on pool blocks must not
+    be overtaken forever by other tenants' smaller requests: the
+    round-robin pointer parks on the blocked tenant, so it admits as
+    soon as blocks free — ahead of later arrivals."""
+    params = _params()
+    dcfg = decode.DecodeConfig(max_len=64, decode_attention='xla',
+                               kernel_block_k=8)
+    # 6 usable blocks. big needs ceil((16+24)/8) = 5; smalls need 2.
+    eng = _paged_engine(params, dcfg, num_slots=2, num_blocks=7,
+                        chunk=1, buckets=(16,))
+    finished = []
+    def mk(prompt, max_new, tenant):
+        r = engine_lib.Request(prompt, max_new, tenant=tenant)
+        r.on_token = (lambda rr: lambda t, d:
+                      finished.append(rr.id) if d else None)(r)
+        return r
+    first_small = mk([1] * 9, 7, 'small')     # admits, 2 blocks
+    big = mk([2] * 16, 24, 'big')             # blocked behind it
+    later = [mk([i + 3] * 9, 7, 'small') for i in range(3)]
+    reqs = [first_small, big] + later
+    _drain(eng, reqs)
+    assert all(r.finish_reason == 'length' for r in reqs)
+    # big ran second — the later smalls waited behind it.
+    assert finished.index(big.id) == 1, finished
+
+
+def test_paged_admission_failure_releases_reservation():
+    """A failure AFTER block allocation (here: no prefill bucket covers
+    the prompt) must return the reservation — otherwise every such
+    reject would shrink the pool forever."""
+    params = _params()
+    dcfg = decode.DecodeConfig(max_len=64, decode_attention='xla',
+                               kernel_block_k=8)
+    eng = _paged_engine(params, dcfg, num_slots=2, num_blocks=10,
+                        chunk=1, buckets=(16,))
+    bad = engine_lib.Request([1] * 40, 4)  # fits pool, no bucket >= 40
+    good = engine_lib.Request([2] * 10, 3)
+    _drain(eng, [bad, good])
+    assert bad.finish_reason.startswith('rejected'), bad.finish_reason
+    assert good.finish_reason == 'length' and len(good.tokens) == 3
+    # Nothing leaked: only the prefix cache's published blocks remain.
+    assert eng._allocator.available() == \
+        9 - eng._radix.held_blocks()  # pylint: disable=protected-access
+
+
+def test_engine_clamps_and_rejects_over_budget_admissions():
+    """Queued over-budget requests no longer kill the loop: budget
+    overshoot clamps (journaled engine.reject/action=clamp), an
+    unservable prompt rejects (action=reject) — and serving continues
+    for everyone else."""
+    params = _params()
+    dcfg = decode.DecodeConfig(max_len=32)
+    eng = engine_lib.DecodeEngine(params, CFG, dcfg, num_slots=1,
+                                  prefill_buckets=(16,), name='t-rej')
+    ok = engine_lib.Request([1, 2, 3], 4)
+    clamped = engine_lib.Request([5] * 10, 500)
+    rejected = engine_lib.Request([7] * 32, 4)
+    _drain(eng, [ok, clamped, rejected])
+    assert ok.finish_reason == 'length' and len(ok.tokens) == 4
+    assert len(clamped.tokens) == 22 and clamped.finish_reason == 'length'
+    assert rejected.finish_reason.startswith('rejected')
+    assert rejected.tokens == []
+    eng.flush_journal()
+    evs = journal.query(kinds=[journal.EventKind.ENGINE_REJECT],
+                        entity='engine:t-rej', limit=10)
+    assert sorted(e['payload']['action'] for e in evs) == \
+        ['clamp', 'reject']
+    reg = metrics.get_registry()
+    assert reg.get('skytpu_engine_rejected_total').value() == 1
+
+
+def test_tenant_round_robin_admission():
+    """One tenant's burst cannot monopolize the (single) slot: the
+    late-arriving other tenant admits second, not fifth."""
+    params = _params()
+    dcfg = decode.DecodeConfig(max_len=32)
+    eng = engine_lib.DecodeEngine(params, CFG, dcfg, num_slots=1,
+                                  prefill_buckets=(16,))
+    finished = []
+    def mk(tag):
+        r = engine_lib.Request([3, 1, 4], 2, tenant=tag)
+        r.on_token = (lambda rr: lambda t, d:
+                      finished.append(rr.tenant) if d else None)(r)
+        return r
+    burst = [mk('noisy') for _ in range(4)]
+    quiet = mk('quiet')
+    for r in burst:
+        eng.submit(r)
+    eng.submit(quiet)
+    _drain(eng, burst + [quiet], submit=False)
+    assert finished.index('quiet') == 1, finished
+
+
 def test_fifo_admission_order():
     params = _params()
     dcfg = decode.DecodeConfig(max_len=32)

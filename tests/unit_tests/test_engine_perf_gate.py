@@ -1,0 +1,88 @@
+"""Tier-1 perf-regression gate for the engine scheduler (ROADMAP item 5).
+
+Replays the deterministic synthetic trace of
+``decode_bench.run_scheduler_bench`` — the same one the bench harness's
+CPU failover tier emits — and compares the SCHEDULER-level numbers
+(decode tokens per engine step, prefix-hit ratio, admitted concurrency)
+against the checked-in envelope in
+``tests/data/engine_scheduler_envelope.json``. These are properties of
+the scheduling logic, not the machine: for a fixed trace they are
+exactly reproducible on any platform, so the gate is wall-clock-free
+and CI-stable. A >20% regression fails tier-1; an intentional scheduler
+change re-ratifies by updating the envelope in the same PR.
+"""
+import json
+import os
+
+import pytest
+
+pytestmark = pytest.mark.engine
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+ENVELOPE_PATH = os.path.join(REPO_ROOT, 'tests', 'data',
+                             'engine_scheduler_envelope.json')
+
+
+@pytest.fixture(scope='module')
+def sched_result():
+    from skypilot_tpu.benchmark import decode_bench
+    return decode_bench.run_scheduler_bench(steps=1)
+
+
+def _envelope():
+    with open(ENVELOPE_PATH, encoding='utf-8') as f:
+        return json.load(f)
+
+
+def test_envelope_is_checked_in_and_sane():
+    env = _envelope()
+    assert env['paged_tokens_per_step'] > 0
+    assert 0 < env['regression_tolerance'] < 1
+
+
+def test_scheduler_tokens_per_step_within_envelope(sched_result):
+    env = _envelope()
+    floor = 1 - env['regression_tolerance']
+    paged = sched_result['detail']['paged']
+    dense = sched_result['detail']['dense']
+    assert paged['tokens_per_step'] >= \
+        env['paged_tokens_per_step'] * floor, (
+            f"paged scheduler regressed: {paged['tokens_per_step']} "
+            f"tokens/step vs envelope {env['paged_tokens_per_step']} "
+            f"(>{env['regression_tolerance']:.0%} drop)")
+    assert dense['tokens_per_step'] >= \
+        env['dense_tokens_per_step'] * floor, (
+            f"dense scheduler regressed: {dense['tokens_per_step']} "
+            f"vs envelope {env['dense_tokens_per_step']}")
+
+
+def test_prefix_hit_ratio_within_envelope(sched_result):
+    env = _envelope()
+    floor = 1 - env['regression_tolerance']
+    got = sched_result['detail']['paged']['prefix_hit_ratio']
+    assert got >= env['paged_prefix_hit_ratio'] * floor, (
+        f'prefix-hit ratio regressed: {got} vs envelope '
+        f"{env['paged_prefix_hit_ratio']}")
+
+
+def test_admitted_concurrency_within_envelope(sched_result):
+    env = _envelope()
+    floor = 1 - env['regression_tolerance']
+    got = sched_result['detail']['paged']['admitted_concurrency']
+    assert got >= env['paged_admitted_concurrency'] * floor
+    # The acceptance bar that motivated paging: >= 2x the dense
+    # engine's concurrency at the same HBM budget on shared-prefix
+    # traffic.
+    dense = sched_result['detail']['dense']['admitted_concurrency']
+    assert got >= 2 * dense, (got, dense)
+
+
+def test_result_is_platform_tagged(sched_result):
+    """The failover tier's contract: the emitted line must carry the
+    platform that actually ran so trends stay attributable when TPU
+    rounds go dark (tier-1 pins jax to CPU, but the tag must simply be
+    truthful, not literally 'cpu')."""
+    import jax
+    assert sched_result['platform'] == jax.devices()[0].platform
+    assert sched_result['metric'] == 'engine_scheduler_tokens_per_step'

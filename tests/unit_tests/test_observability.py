@@ -297,7 +297,14 @@ def test_all_registered_metric_names_match_convention():
                      'skytpu_engine_evicted_total',
                      'skytpu_engine_ttft_seconds',
                      'skytpu_engine_token_seconds',
-                     'skytpu_engine_requests_total'):
+                     'skytpu_engine_requests_total',
+                     # Paged KV cache + radix prefix reuse (ISSUE 8).
+                     'skytpu_engine_blocks_total',
+                     'skytpu_engine_blocks_used',
+                     'skytpu_engine_prefix_hit_ratio',
+                     'skytpu_engine_prefill_tokens_saved_total',
+                     'skytpu_engine_rejected_total',
+                     'skytpu_server_rejected_total'):
         assert expected in names, f'{expected} not found by lint scan'
 
 
@@ -340,8 +347,9 @@ def test_all_journal_event_kinds_are_registered():
                      # Fleet telemetry plane (ISSUE 4).
                      'NODE_STALE', 'NODE_STRAGGLER',
                      'SKYLET_EVENT_ERROR', 'SKYLET_AUTOSTOP',
-                     # Decode engine slot scheduling (ISSUE 5).
-                     'ENGINE_ADMIT', 'ENGINE_EVICT'):
+                     # Decode engine slot scheduling (ISSUE 5) +
+                     # admission control (ISSUE 8).
+                     'ENGINE_ADMIT', 'ENGINE_EVICT', 'ENGINE_REJECT'):
         assert expected in attr_names, \
             f'EventKind.{expected} not found by lint scan'
 
