@@ -302,7 +302,8 @@ def run_mixed_bench(model_name: str, num_slots: int,
             eng.submit(r)
         while not all(r.done for r in reqs):
             eng.step()
-        return sum(len(r.tokens) for r in reqs), eng.mean_occupancy()
+        return (sum(len(r.tokens) for r in reqs), eng.mean_occupancy(),
+                eng.telemetry.slo())
 
     def timed(fn, n):
         fn()  # warmup: compiles cached for the measured passes
@@ -316,8 +317,8 @@ def run_mixed_bench(model_name: str, num_slots: int,
     with _journal_disabled():
         static_dt, (static_useful, static_lane_steps) = timed(run_static,
                                                               steps)
-        engine_dt, (engine_useful, engine_occupancy) = timed(run_engine,
-                                                             steps)
+        engine_dt, (engine_useful, engine_occupancy, engine_slo) = timed(
+            run_engine, steps)
     static_tps = static_useful / max(static_dt, 1e-9)
     engine_tps = engine_useful / max(engine_dt, 1e-9)
 
@@ -344,6 +345,12 @@ def run_mixed_bench(model_name: str, num_slots: int,
             'speedup_vs_static': round(engine_tps / max(static_tps, 1e-9),
                                        3),
             'engine_occupancy': round(engine_occupancy, 4),
+            # Per-request phase percentiles from the engine's
+            # request-telemetry plane (the measured pass's window) —
+            # the same split /slo serves in production.
+            'request_phases': {
+                k: engine_slo[f'{k}_seconds']
+                for k in ('queue_wait', 'ttft', 'per_token', 'total')},
             'static_occupancy': round(
                 static_useful / max(static_lane_steps, 1), 4),
             'useful_tokens': engine_useful,
@@ -456,7 +463,8 @@ def run_prefix_bench(model_name: str, num_slots: int = 8,
                 name='prefix-bench-dense')
         useful, max_active, n_steps = _drive_engine(eng, engine_lib,
                                                     requests)
-        return useful, max_active, n_steps, eng.stats()
+        return (useful, max_active, n_steps, eng.stats(),
+                eng.telemetry.slo())
 
     def timed(fn, n):
         fn()  # warmup/compile
@@ -468,9 +476,9 @@ def run_prefix_bench(model_name: str, num_slots: int = 8,
 
     beat('decode_prefix_compile')
     with _journal_disabled():
-        dense_dt, (dense_useful, dense_conc, _, _) = timed(
+        dense_dt, (dense_useful, dense_conc, _, _, _) = timed(
             lambda: run(False), steps)
-        paged_dt, (paged_useful, paged_conc, _, pstats) = timed(
+        paged_dt, (paged_useful, paged_conc, _, pstats, pslo) = timed(
             lambda: run(True), steps)
     paged_tps = paged_useful / max(paged_dt, 1e-9)
     dense_tps = dense_useful / max(dense_dt, 1e-9)
@@ -499,6 +507,9 @@ def run_prefix_bench(model_name: str, num_slots: int = 8,
             'prefill_tokens_total': total_prompt,
             'prefill_tokens_saved': pstats['prefill_tokens_saved'],
             'prefix_hit_ratio': pstats['prefix_hit_ratio'],
+            'request_phases': {
+                k: pslo[f'{k}_seconds']
+                for k in ('queue_wait', 'ttft', 'per_token', 'total')},
             'kv_cache_dtype': dcfg.kv_cache_dtype,
             'steps': steps,
             'device': str(devices[0]),
@@ -546,6 +557,7 @@ def run_scheduler_bench(steps: int = 2, beat=None, seed: int = 0) -> dict:
             useful, conc, n_steps = _drive_engine(eng, engine_lib,
                                                   requests)
             st = eng.stats()
+            eslo = eng.telemetry.slo()
             return {
                 'useful_tokens': useful,
                 'admitted_concurrency': conc,
@@ -558,6 +570,15 @@ def run_scheduler_bench(steps: int = 2, beat=None, seed: int = 0) -> dict:
                     4),
                 'occupancy': st['mean_occupancy'],
                 'prefix_hit_ratio': st.get('prefix_hit_ratio', 0.0),
+                # The step profiler is ALWAYS on during the replay: the
+                # tier-1 perf gate asserts this stayed true while the
+                # tokens/step envelope held, pinning the telemetry
+                # plane's overhead inside the regression tolerance.
+                'profiler_steps': eng.profiler.steps_recorded(),
+                'request_phase_p95': {
+                    k: eslo[f'{k}_seconds']['p95']
+                    for k in ('queue_wait', 'ttft', 'per_token',
+                              'total')},
             }
 
         dense = run(False)          # also warms the compile cache

@@ -334,6 +334,61 @@ def metrics(endpoint):
         raise click.ClickException(f'Could not scrape {url}: {e}')
 
 
+def _fetch_server_json(endpoint, path):
+    """GET a JSON body from a model-server telemetry endpoint.
+
+    ENDPOINT defaults to the model server's default local port; scheme
+    defaults to http (the `skytpu metrics` normalization idiom)."""
+    import json as json_lib
+    import urllib.error
+    import urllib.request
+    url = (endpoint or 'http://127.0.0.1:8000').rstrip('/')
+    if url.startswith(':'):
+        url = '127.0.0.1' + url  # bare ':8000' port form
+    if '://' not in url:
+        url = 'http://' + url
+    url += path
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return json_lib.loads(resp.read().decode('utf-8'))
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        raise click.ClickException(f'Could not fetch {url}: {e}')
+
+
+@cli.command(name='requests')
+@click.argument('endpoint', required=False, default=None)
+@click.option('--limit', '-n', type=int, default=20,
+              help='Completed requests to show (most recent).')
+def requests_cmd(endpoint, limit):
+    """Per-request phase breakdowns from a model server.
+
+    Reads ENDPOINT's /debug/requests (default
+    http://127.0.0.1:8000 — the model server's default port): in-flight
+    requests first, then the newest completed ones, each with queue
+    wait / prefill / TTFT / per-token / total latency and the trace id
+    (follow one with `skytpu trace <id>`).
+    """
+    from skypilot_tpu.observability import request_trace
+    snap = _fetch_server_json(endpoint, f'/debug/requests?n={limit}')
+    click.echo(request_trace.format_requests(snap, limit=limit))
+
+
+@cli.command()
+@click.argument('endpoint', required=False, default=None)
+def slo(endpoint):
+    """Rolling SLO surface of a model server.
+
+    Reads ENDPOINT's /slo (default http://127.0.0.1:8000): p50/p95/p99
+    for queue wait, prefill, TTFT, per-token and total request latency
+    over the completed-request window, plus reject/error/slow rates and
+    the active SKYTPU_SLOW_REQUEST_SECONDS / SKYTPU_TTFT_SLO_SECONDS
+    thresholds.
+    """
+    from skypilot_tpu.observability import request_trace
+    click.echo(request_trace.format_slo(
+        _fetch_server_json(endpoint, '/slo')))
+
+
 @cli.command()
 @click.argument('cluster', required=False, default=None)
 @click.option('--watch', '-w', is_flag=True, default=False,

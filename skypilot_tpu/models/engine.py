@@ -92,6 +92,7 @@ import numpy as np
 from skypilot_tpu.models import decode, llama
 from skypilot_tpu.observability import journal
 from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import request_trace
 from skypilot_tpu.observability import runtime_metrics
 
 IDLE_SLEEP_ENV = 'SKYTPU_ENGINE_IDLE_SLEEP_SECONDS'
@@ -381,7 +382,8 @@ class Request:
     def __init__(self, prompt: Sequence[int], max_new_tokens: int,
                  on_token: Optional[Callable[[int, bool], None]] = None,
                  request_id: Optional[str] = None,
-                 tenant: str = 'default'):
+                 tenant: str = 'default',
+                 trace_id: Optional[str] = None):
         if max_new_tokens < 1:
             raise ValueError(f'max_new_tokens must be >= 1, got '
                              f'{max_new_tokens}')
@@ -397,6 +399,11 @@ class Request:
         self.tenant = str(tenant)
         self.id = (request_id if request_id is not None
                    else f'r{next(self._ids)}')
+        # Per-request trace id (the server's X-Request-Id): the engine
+        # stamps this request's journal rows with it, so `skytpu trace
+        # <id>` joins the HTTP request to its engine timeline. None →
+        # rows carry the ambient process trace context.
+        self.trace_id = trace_id
         self.tokens: List[int] = []
         self.finish_reason: Optional[str] = None
         self.enqueue_ts: Optional[float] = None
@@ -635,6 +642,11 @@ class DecodeEngine:
         # flushes from the HTTP thread while the loop appends.
         self._journal_lock = threading.Lock()
         self._journal_buf: List[tuple] = []
+        # Request-telemetry plane: per-request phase records assembled
+        # at the admit/evict/reject choke points (the per-token hot path
+        # stays untouched) + the per-step profiler behind /debug/engine.
+        self.telemetry = request_trace.RequestTelemetry(name=name)
+        self.profiler = request_trace.EngineStepProfiler(name=name)
         self._m = metrics_lib
         self._m.gauge('skytpu_engine_num_slots',
                       'Configured KV-cache lanes.').set(num_slots)
@@ -645,14 +657,14 @@ class DecodeEngine:
     def submit(self, request: Request) -> Request:
         """Enqueue a request for admission (thread-safe)."""
         request.enqueue_ts = time.perf_counter()
+        self.telemetry.on_enqueue(request)
         with self._queue_lock:
             q = self._queues.get(request.tenant)
             if q is None:
                 q = self._queues[request.tenant] = collections.deque()
             q.append(request)
             depth = sum(len(d) for d in self._queues.values())
-        self._m.gauge('skytpu_engine_queue_depth',
-                      'Requests waiting for a free slot.').set(depth)
+        self._publish_queue_depth(depth)
         return request
 
     def queue_depth(self) -> int:
@@ -703,8 +715,17 @@ class DecodeEngine:
         # request is back in the queue, and a starved head-of-line
         # request reading as depth 0 would hide exactly the backlog
         # the pool-pressure runbook tells operators to look for.
+        self._publish_queue_depth(depth)
+
+    def _publish_queue_depth(self, depth: Optional[int] = None) -> int:
+        """The ONE writer of the queue-depth gauge (submit, requeue,
+        admission, and the step profiler all read/publish through
+        here)."""
+        if depth is None:
+            depth = self.queue_depth()
         self._m.gauge('skytpu_engine_queue_depth',
                       'Requests waiting for a free slot.').set(depth)
+        return depth
 
     def free_slots(self) -> int:
         return sum(1 for r in self._slots if r is None)
@@ -733,6 +754,7 @@ class DecodeEngine:
                 f'{self.dcfg.max_len}')
         if request.enqueue_ts is None:
             request.enqueue_ts = time.perf_counter()
+        admit_ts = time.perf_counter()
         if self.paged:
             first, shared_tokens = self._prefill_paged(slot, request)
         else:
@@ -760,6 +782,11 @@ class DecodeEngine:
                         'Requests admitted into a slot.').inc()
         self._m.counter('skytpu_engine_tokens_total',
                         'Tokens generated by the engine.').inc()
+        self.telemetry.on_admit(
+            request, slot, admit_ts=admit_ts,
+            prefix_hit_tokens=shared_tokens,
+            blocks_reserved=(len(self._slot_refs[slot]) if self.paged
+                             else 0))
         self._journal(journal.EventKind.ENGINE_ADMIT, request, slot,
                       prompt_len=p, prefix_hit_tokens=shared_tokens,
                       max_new_tokens=request.max_new_tokens)
@@ -932,10 +959,7 @@ class DecodeEngine:
             req = self._pop_next()
             if req is None:
                 break
-            self._m.gauge(
-                'skytpu_engine_queue_depth',
-                'Requests waiting for a free slot.').set(
-                    self.queue_depth())
+            self._publish_queue_depth()
             p = len(req.prompt)
             budget = self.dcfg.max_len - p
             if self.paged:
@@ -978,6 +1002,10 @@ class DecodeEngine:
         self._m.counter('skytpu_engine_rejected_total',
                         'Requests rejected at admission.').inc()
         req._finish(f'rejected: {reason}')  # pylint: disable=protected-access
+        slow = self.telemetry.on_finish(req, req.finish_reason)
+        if slow is not None:
+            self._journal(journal.EventKind.ENGINE_SLOW_REQUEST, req, -1,
+                          **slow)
 
     # ------------------------------------------------------------- step
 
@@ -1031,7 +1059,16 @@ class DecodeEngine:
                           'Per-token decode step latency.',
                           buckets=runtime_metrics.TOKEN_LATENCY_BUCKETS
                           ).observe(dt / n)
+        emitted_before = self._decode_emitted
         self._deliver_chunk(toks_np)
+        stall = self.profiler.record(
+            dt, chunk=n, active=active,
+            delivered=self._decode_emitted - emitted_before,
+            queue_depth=self._publish_queue_depth(),
+            blocks_used=self._allocator.used() if self.paged else 0,
+            blocks_total=(self.num_blocks - 1) if self.paged else 0)
+        if stall is not None:
+            self._journal_raw(journal.EventKind.ENGINE_STALL, stall)
         # Refill freed lanes NOW so the next chunk runs full.
         self._admit()
         self.flush_journal()
@@ -1087,6 +1124,10 @@ class DecodeEngine:
         self._journal(journal.EventKind.ENGINE_EVICT, req, slot,
                       reason=reason, generated=len(req.tokens))
         req._finish(reason)  # pylint: disable=protected-access
+        slow = self.telemetry.on_finish(req, reason)
+        if slow is not None:
+            self._journal(journal.EventKind.ENGINE_SLOW_REQUEST, req,
+                          slot, **slow)
         self._publish_slot_gauges()
 
     # ------------------------------------------------------------- loop
@@ -1099,6 +1140,10 @@ class DecodeEngine:
         except ValueError:
             idle = 0.02
         while not stop_event.is_set():
+            # Liveness beat every iteration (idle included): the model
+            # server's /healthz staleness reads this, and an idle-but-
+            # alive engine must not decay into a 503.
+            self.profiler.beat()
             if self.step() == 0:
                 self.flush_journal()  # one-token admissions while idle
                 time.sleep(idle)
@@ -1129,6 +1174,7 @@ class DecodeEngine:
             'decode_steps': self._decode_steps,
             'decode_tokens': self._decode_emitted,
             'mean_occupancy': round(self.mean_occupancy(), 4),
+            'stalls': self.profiler.stall_count(),
             'step_chunk': self.step_chunk,
             'kv_cache_dtype': self.dcfg.kv_cache_dtype,
             'max_len': self.dcfg.max_len,
@@ -1169,11 +1215,19 @@ class DecodeEngine:
 
     def _journal(self, kind, request: Request, slot: int,
                  **payload) -> None:
+        self._journal_raw(kind,
+                          {'request': request.id, 'slot': slot, **payload},
+                          trace_id=request.trace_id)
+
+    def _journal_raw(self, kind, payload: dict,
+                     trace_id: Optional[str] = None) -> None:
+        """Buffer one engine-entity event; a per-request ``trace_id``
+        overrides the ambient trace for that row (the X-Request-Id
+        join)."""
         with self._journal_lock:
             self._journal_buf.append(
-                (kind, f'engine:{self.name}',
-                 {'request': request.id, 'slot': slot, **payload},
-                 time.time()))
+                (kind, f'engine:{self.name}', payload, time.time(),
+                 trace_id))
 
     def flush_journal(self) -> None:
         """Write buffered admit/evict events in one transaction. Called

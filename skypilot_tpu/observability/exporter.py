@@ -23,6 +23,7 @@ import time
 from typing import Callable, Optional
 
 from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.utils import common_utils
 
 METRICS_HOST_ENV = 'SKYTPU_METRICS_HOST'
 HEALTHZ_MAX_STALENESS_ENV = 'SKYTPU_HEALTHZ_MAX_STALENESS_SECONDS'
@@ -53,11 +54,8 @@ class MetricsExporter:
         self._registry = registry
         self._heartbeat_fn = heartbeat_fn
         if max_staleness_seconds is None:
-            env = os.environ.get(HEALTHZ_MAX_STALENESS_ENV)
-            try:
-                max_staleness_seconds = float(env) if env else None
-            except ValueError:
-                max_staleness_seconds = None
+            max_staleness_seconds = common_utils.env_optional_float(
+                HEALTHZ_MAX_STALENESS_ENV)
         self._max_staleness = max_staleness_seconds
         self._started_at: Optional[float] = None
         self._server: Optional[http.server.ThreadingHTTPServer] = None

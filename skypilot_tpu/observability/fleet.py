@@ -27,6 +27,7 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu.observability import journal
 from skypilot_tpu.observability import metrics
 from skypilot_tpu.skylet import constants
+from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import subprocess_utils
 
 logger = sky_logging.init_logger(__name__)
@@ -44,12 +45,8 @@ _STATS_MARKER = '__NODE_STATS__'
 UTIL_METRICS = ('cpu_util', 'mem_util', 'disk_util', 'accel_mem_util')
 
 
-def _env_float(name: str, default: float) -> float:
-    v = os.environ.get(name)
-    try:
-        return float(v) if v else default
-    except ValueError:
-        return default
+# Env-knob parsing: the shared helper (bad values degrade to defaults).
+_env_float = common_utils.env_float
 
 
 class FleetCodeGen:
@@ -104,17 +101,9 @@ def collect(runners: Sequence[Any],
     return list(subprocess_utils.run_in_parallel(_pull, list(runners)))
 
 
-def percentile(values: Sequence[float], q: float) -> float:
-    """Linear-interpolation percentile (q in [0, 100])."""
-    vs = sorted(float(v) for v in values)
-    if not vs:
-        return 0.0
-    if len(vs) == 1:
-        return vs[0]
-    pos = (len(vs) - 1) * q / 100.0
-    lo = int(pos)
-    hi = min(lo + 1, len(vs) - 1)
-    return vs[lo] + (vs[hi] - vs[lo]) * (pos - lo)
+# Linear-interpolation percentile — the shared copy (the serving SLO
+# surface computes its p50/p95/p99 with the same semantics).
+percentile = common_utils.percentile
 
 
 def aggregate(cluster_name: str,

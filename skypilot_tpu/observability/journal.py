@@ -89,6 +89,13 @@ class EventKind(enum.Enum):
     # Admission-control decisions: over-budget requests clamped or
     # rejected instead of crashing the serve loop.
     ENGINE_REJECT = 'engine.reject'
+    # Request-telemetry plane (observability/request_trace.py): a
+    # completed request that breached SKYTPU_SLOW_REQUEST_SECONDS or
+    # the TTFT SLO journals its full phase timeline under the request's
+    # own trace id (X-Request-Id), and an engine step that blew past
+    # the stall threshold journals the step profile evidence.
+    ENGINE_SLOW_REQUEST = 'engine.slow_request'
+    ENGINE_STALL = 'engine.stall'
 
 
 KINDS = frozenset(k.value for k in EventKind)
@@ -204,11 +211,17 @@ def event_batch(items: Sequence[tuple]) -> None:
     caller at buffer time, so batching does not skew the timeline.
     Trace context is resolved once at write time (the buffering caller
     is single-threaded per engine loop, so ambient context is stable).
+    An optional fifth element overrides the trace id for THAT row: the
+    engine stamps request-scoped events (admit/evict/slow_request) with
+    the request's own trace id (the server's ``X-Request-Id``), so
+    ``skytpu trace <request-id>`` reconstructs one request's timeline.
     """
     if not items:
         return
     rows = []
-    for kind, entity, payload, ts in items:
+    for item in items:
+        kind, entity, payload, ts = item[:4]
+        row_trace = item[4] if len(item) > 4 else None
         kind_value = (kind.value if isinstance(kind, EventKind)
                       else str(kind))
         if kind_value not in KINDS:
@@ -216,7 +229,7 @@ def event_batch(items: Sequence[tuple]) -> None:
                 f'Unregistered journal event kind {kind_value!r}; add it '
                 'to observability.journal.EventKind first.')
         rows.append((ts, kind_value, entity or '',
-                     json.dumps(payload or {}, default=str)))
+                     json.dumps(payload or {}, default=str), row_trace))
     if not enabled():
         return
     trace_id = trace_lib.get_trace_id()
@@ -225,13 +238,15 @@ def event_batch(items: Sequence[tuple]) -> None:
     try:
         with _db() as conn:
             cur = None
-            for ts, kind_value, entity, payload_json in rows:
+            for ts, kind_value, entity, payload_json, row_trace in rows:
                 cur = conn.execute(
                     'INSERT INTO events (ts, kind, entity, payload, '
                     'trace_id, span_id, parent_span_id) '
                     'VALUES (?,?,?,?,?,?,?)',
-                    (ts, kind_value, entity, payload_json, trace_id,
-                     span_id, parent))
+                    (ts, kind_value, entity, payload_json,
+                     row_trace or trace_id,
+                     None if row_trace else span_id,
+                     None if row_trace else parent))
             cap = max_events()
             if cur is not None and cur.lastrowid is not None \
                     and cur.lastrowid > cap:

@@ -30,8 +30,11 @@ TRAIN_STEP_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
 # Decode per-token latencies sit in the 100us–100ms band on TPU.
 TOKEN_LATENCY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
                          0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+# The long-tail end (... 2.5/5/10/30/60 s) matters as much as the fast
+# end: prefill-heavy requests on a saturated replica land there, and
+# without those bounds p99 TTFT saturates into +Inf and is unreadable.
 TTFT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
-                1.0, 2.5, 5.0, 10.0, 30.0)
+                1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
 
 def peak_flops(device=None) -> float:

@@ -15,6 +15,7 @@ from typing import List, Optional, Union
 from skypilot_tpu import sky_logging
 from skypilot_tpu.observability import metrics
 from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.utils import common_utils
 
 logger = sky_logging.init_logger(__name__)
 
@@ -24,9 +25,9 @@ logger = sky_logging.init_logger(__name__)
 RequestSignal = Union[List[float], 'metrics.RateTracker']
 
 
-def _env_float(name: str, default: float) -> float:
-    v = os.environ.get(name)
-    return float(v) if v else default
+# Env-knob parsing: the shared helper (bad values degrade to defaults
+# instead of raising — same contract the fleet plane uses).
+_env_float = common_utils.env_float
 
 
 @dataclasses.dataclass(frozen=True)

@@ -24,11 +24,31 @@ Endpoints:
   0 disables) the server answers **429** with ``Retry-After`` and
   counts ``skytpu_server_rejected_total`` instead of queueing without
   bound.
-* ``GET /healthz`` — readiness probe target: 200 with engine stats
-  while the engine loop thread is alive, 503 after it dies.
+* ``GET /healthz`` — readiness probe target with the exporter's
+  staleness semantics: 200 with engine stats and
+  ``staleness_seconds=<age of the engine loop's heartbeat>``; 503 when
+  the engine thread died OR the heartbeat aged past
+  ``SKYTPU_HEALTHZ_MAX_STALENESS_SECONDS`` (a wedged loop must look
+  unhealthy even while its HTTP thread survives).
 * ``GET /metrics`` — Prometheus text exposition of the process registry
   (all ``skytpu_engine_*`` series plus whatever else the process
   records), so the fleet scrape path needs no extra exporter port.
+* ``GET /debug/requests`` — the request-telemetry plane: in-flight +
+  last-N completed requests with full phase breakdowns (queue wait,
+  prefill, TTFT, per-token, total), per request id/tenant/trace.
+  ``?n=`` bounds the completed list.
+* ``GET /debug/engine`` — engine stats + the step profiler's ring
+  (per-step wall time, chunk, occupancy, queue depth, block-pool
+  utilization, stall count).
+* ``GET /slo`` — rolling p50/p95/p99 TTFT / per-token / total latency
+  and reject/error rates over the completed-request ring (rendered by
+  ``skytpu slo``).
+
+Every ``/generate`` carries an ``X-Request-Id``: the client's header
+value if present, else a fresh trace id — echoed on the response and
+used as the engine request's trace id, so a slow request's
+``engine.slow_request`` journal entry is joined to the HTTP request
+(``skytpu trace <X-Request-Id>``).
 
 Tokenizer note: the in-tree models are research checkpoints without a
 shipped tokenizer, so ``text`` uses a byte-level demo codec (UTF-8 bytes
@@ -54,7 +74,10 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu.models import decode
 from skypilot_tpu.models import engine as engine_lib
 from skypilot_tpu.models import llama
+from skypilot_tpu.observability import exporter as exporter_lib
 from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import trace as trace_lib
+from skypilot_tpu.utils import common_utils
 
 logger = sky_logging.init_logger(__name__)
 
@@ -101,6 +124,11 @@ class ModelServer:
                 os.environ.get(MAX_QUEUE_ENV, str(DEFAULT_MAX_QUEUE)))
         except ValueError:
             self.max_queue = DEFAULT_MAX_QUEUE
+        # /healthz staleness bound — the exporter's semantics, with the
+        # engine loop's heartbeat as the freshness signal.
+        self.max_staleness = common_utils.env_optional_float(
+            exporter_lib.HEALTHZ_MAX_STALENESS_ENV)
+        self._started_at: Optional[float] = None
         self._stop = threading.Event()
         self._engine_thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -131,6 +159,7 @@ class ModelServer:
 
     def run_forever(self) -> None:
         """Standalone mode: engine thread + HTTP server until stopped."""
+        self._started_at = time.time()
         self._engine_thread = threading.Thread(
             target=self.engine.run_forever, args=(self._stop,),
             daemon=True, name='skytpu-engine')
@@ -151,6 +180,9 @@ class ModelServer:
         app.router.add_post('/generate', self._handle_generate)
         app.router.add_get('/healthz', self._handle_healthz)
         app.router.add_get('/metrics', self._handle_metrics)
+        app.router.add_get('/debug/requests', self._handle_debug_requests)
+        app.router.add_get('/debug/engine', self._handle_debug_engine)
+        app.router.add_get('/slo', self._handle_slo)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -221,6 +253,13 @@ class ModelServer:
         # next; anonymous traffic shares one bucket.
         tenant = (request.headers.get('X-Tenant')
                   or body.get('tenant') or 'default')
+        # Request-id / trace propagation: honor the client's
+        # X-Request-Id, else mint a trace id. It doubles as the engine
+        # request's trace id, so this request's journal rows
+        # (admit/evict/slow_request) are joined to the HTTP exchange —
+        # `skytpu trace <X-Request-Id>` after `curl -i` shows both.
+        request_id = (request.headers.get('X-Request-Id')
+                      or trace_lib.new_trace_id())
 
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
@@ -228,8 +267,13 @@ class ModelServer:
         def on_token(token: int, done: bool) -> None:
             loop.call_soon_threadsafe(q.put_nowait, (token, done))
 
+        # The header value rides as trace_id ONLY: engine request ids
+        # stay server-generated and unique, so a client retrying with
+        # the same X-Request-Id (or two clients colliding) cannot
+        # cross-contaminate the telemetry plane's per-id records.
         req = engine_lib.Request(tokens, max_new, on_token=on_token,
-                                 tenant=str(tenant))
+                                 tenant=str(tenant),
+                                 trace_id=request_id)
         # Terminal sentinel: a request the engine rejects (or fails at
         # admission) finishes WITHOUT ever emitting a token — without
         # this, the handler would sit on the empty queue until the
@@ -256,6 +300,7 @@ class ModelServer:
             status=200,
             headers={'Content-Type': 'text/event-stream',
                      'Cache-Control': 'no-cache',
+                     'X-Request-Id': req.trace_id or req.id,
                      'X-Accel-Buffering': 'no'})
         await resp.prepare(http_request)
         try:
@@ -287,38 +332,80 @@ class ModelServer:
 
     async def _unary_response(self, req: engine_lib.Request,
                               q: asyncio.Queue) -> web.Response:
+        rid = {'X-Request-Id': req.trace_id or req.id}
         try:
             while True:
                 token, done = await self._next_token(q)
                 if done:
                     break
         except asyncio.TimeoutError:
-            return web.json_response({'error': 'timeout'}, status=504)
+            return web.json_response({'error': 'timeout'}, status=504,
+                                     headers=rid)
         if token is None and not req.tokens:
             # Engine-side rejection: known instantly, surfaced as a
             # client error instead of a request-timeout 504.
             return web.json_response({'error': req.finish_reason},
-                                     status=422)
+                                     status=422, headers=rid)
         return web.json_response({
             'tokens': req.tokens,
             'text': decode_tokens(req.tokens),
             'finish_reason': req.finish_reason,
             'generated': len(req.tokens),
-        })
+        }, headers=rid)
+
+    def staleness_seconds(self) -> float:
+        """Age of the engine loop's heartbeat (the exporter's /healthz
+        semantics: a wedged loop behind a live HTTP thread must read
+        stale). Floored at server start so a just-launched engine that
+        has not beaten yet reads fresh, not epoch-old."""
+        beat = max(self.engine.profiler.heartbeat_ts(),
+                   self._started_at or 0.0)
+        return max(0.0, time.time() - beat)
 
     async def _handle_healthz(self, request: web.Request) -> web.Response:
         alive = (self._engine_thread is not None and
                  self._engine_thread.is_alive())
+        staleness = self.staleness_seconds()
         stats = self.engine.stats()
         line = ' '.join(f'{k}={v}' for k, v in stats.items())
         if not alive:
-            return web.Response(status=503,
-                                text=f'engine thread dead {line}\n')
-        return web.Response(text=f'ok {line}\n')
+            return web.Response(
+                status=503,
+                text=f'engine thread dead '
+                     f'staleness_seconds={staleness:.3f} {line}\n')
+        if (self.max_staleness is not None and
+                staleness > self.max_staleness):
+            return web.Response(
+                status=503,
+                text=f'stale staleness_seconds={staleness:.3f} {line}\n')
+        return web.Response(
+            text=f'ok staleness_seconds={staleness:.3f} {line}\n')
 
     async def _handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(body=metrics_lib.generate_latest(),
                             content_type='text/plain', charset='utf-8')
+
+    async def _handle_debug_requests(self, request: web.Request
+                                     ) -> web.Response:
+        try:
+            last_n = int(request.query.get('n', '50'))
+        except ValueError:
+            last_n = 50
+        return web.json_response(self.engine.telemetry.snapshot(last_n))
+
+    async def _handle_debug_engine(self, request: web.Request
+                                   ) -> web.Response:
+        try:
+            last_n = int(request.query.get('n', '32'))
+        except ValueError:
+            last_n = 32
+        return web.json_response({
+            'stats': self.engine.stats(),
+            'step_profile': self.engine.profiler.snapshot(last_n),
+        })
+
+    async def _handle_slo(self, request: web.Request) -> web.Response:
+        return web.json_response(self.engine.telemetry.slo())
 
 
 def build_engine(model: str, num_slots: int, max_len: int,

@@ -22,6 +22,52 @@ _user_hash_cache: Optional[str] = None
 CLUSTER_NAME_VALID_REGEX = re.compile(r'^[a-zA-Z]([-_.a-zA-Z0-9]*[a-zA-Z0-9])?$')
 
 
+def env_float(name: str, default: float) -> float:
+    """Float env knob: unset, empty, or unparseable → ``default`` (a
+    mistyped tuning var degrades to the default, never kills the
+    process). The ONE copy — fleet/autoscaler/request-trace knobs all
+    read through here."""
+    v = os.environ.get(name)
+    try:
+        return float(v) if v else default
+    except (TypeError, ValueError):
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    """Integer twin of :func:`env_float`."""
+    v = os.environ.get(name)
+    try:
+        return int(v) if v else default
+    except (TypeError, ValueError):
+        return default
+
+
+def env_optional_float(name: str) -> Optional[float]:
+    """Float env knob with NO default: unset/empty/unparseable → None
+    (the /healthz max-staleness contract — absent means 'no bound')."""
+    v = os.environ.get(name)
+    try:
+        return float(v) if v else None
+    except (TypeError, ValueError):
+        return None
+
+
+def percentile(values, q: float) -> float:
+    """Linear-interpolation percentile (q in [0, 100]); 0.0 for empty
+    input. The ONE copy — the fleet rollups and the serving SLO surface
+    must not drift apart on p95 semantics."""
+    vs = sorted(float(v) for v in values)
+    if not vs:
+        return 0.0
+    if len(vs) == 1:
+        return vs[0]
+    pos = (len(vs) - 1) * q / 100.0
+    lo = int(pos)
+    hi = min(lo + 1, len(vs) - 1)
+    return vs[lo] + (vs[hi] - vs[lo]) * (pos - lo)
+
+
 def get_user_hash() -> str:
     """Stable 8-hex id for this user on this machine (parity: user_hash)."""
     global _user_hash_cache

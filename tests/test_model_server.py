@@ -202,6 +202,104 @@ def test_engine_rejection_surfaces_immediately(monkeypatch):
         srv.stop()
 
 
+def test_request_telemetry_plane_e2e(server, monkeypatch):
+    """ISSUE-9 acceptance: concurrent requests through the server leave
+    per-phase breakdowns on /debug/requests; a request that breaches the
+    slow-request SLO journals engine.slow_request under the trace id
+    returned as X-Request-Id (rendered by `skytpu trace`); /slo reports
+    non-zero p95 TTFT; /debug/engine shows the step profile."""
+    from skypilot_tpu.observability import journal
+    # Every completed request is "artificially slow" against a sub-µs
+    # threshold — the breach path without wall-clock sleeps.
+    monkeypatch.setenv('SKYTPU_SLOW_REQUEST_SECONDS', '0.0000001')
+    import concurrent.futures
+    custom = 'feedc0de' * 4
+
+    def post(i):
+        headers = {'X-Request-Id': custom} if i == 0 else {}
+        return requests.post(
+            f'{server}/generate',
+            json={'prompt': [i + 1, 2, 3], 'max_new_tokens': 4,
+                  'stream': False},
+            headers=headers, timeout=120)
+
+    with concurrent.futures.ThreadPoolExecutor(4) as ex:
+        rs = list(ex.map(post, range(4)))
+    assert all(r.status_code == 200 for r in rs)
+    # X-Request-Id: client-supplied id echoed, server-minted otherwise.
+    assert rs[0].headers['X-Request-Id'] == custom
+    assert all(r.headers.get('X-Request-Id') for r in rs)
+
+    dbg = requests.get(f'{server}/debug/requests', timeout=30).json()
+    # Engine request ids stay server-generated (a colliding client
+    # X-Request-Id must not merge records); the header value is the
+    # record's trace_id.
+    bytrace = {r['trace_id']: r for r in dbg['completed']}
+    assert custom in bytrace
+    rec = bytrace[custom]
+    for phase in ('queue_wait', 'prefill', 'ttft', 'per_token', 'total'):
+        assert rec['phases'][phase] is not None, phase
+        assert rec['phases'][phase] >= 0, phase
+    assert rec['generated'] == 4
+    assert rec['trace_id'] == custom
+
+    slo = requests.get(f'{server}/slo', timeout=30).json()
+    assert slo['ttft_seconds']['p95'] > 0
+    assert slo['rates']['finished_total'] >= 4
+    assert slo['rates']['slow_total'] >= 4
+
+    eng_dbg = requests.get(f'{server}/debug/engine', timeout=30).json()
+    assert eng_dbg['step_profile']['steps_recorded'] > 0
+    assert eng_dbg['step_profile']['recent']
+    assert eng_dbg['stats']['num_slots'] == 2
+
+    # Trace join: the slow-request journal row carries the SAME id the
+    # client saw in X-Request-Id (the /debug/engine stats call above
+    # flushed the engine's journal buffer).
+    rows = journal.query(kinds=[journal.EventKind.ENGINE_SLOW_REQUEST],
+                         limit=50)
+    assert custom in {r['trace_id'] for r in rows}
+
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli as cli_mod
+    res = CliRunner().invoke(cli_mod.cli, ['trace', custom])
+    assert res.exit_code == 0, res.output
+    assert 'engine.slow_request' in res.output
+    assert 'engine.admit' in res.output
+
+    # CLI renderers against the live server.
+    res = CliRunner().invoke(cli_mod.cli, ['requests', server])
+    assert res.exit_code == 0, res.output
+    assert custom[:8] in res.output and 'TTFT' in res.output
+    res = CliRunner().invoke(cli_mod.cli, ['slo', server])
+    assert res.exit_code == 0, res.output
+    assert 'P95' in res.output and 'thresholds' in res.output
+
+
+def test_healthz_staleness_503_when_loop_wedged(monkeypatch):
+    """/healthz reuses the exporter's staleness semantics: an engine
+    loop parked past SKYTPU_HEALTHZ_MAX_STALENESS_SECONDS answers 503
+    'stale' even though the HTTP thread is perfectly alive."""
+    monkeypatch.setenv('SKYTPU_HEALTHZ_MAX_STALENESS_SECONDS', '0.05')
+    monkeypatch.setenv('SKYTPU_ENGINE_IDLE_SLEEP_SECONDS', '2')
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    eng = engine_lib.DecodeEngine(params, CFG,
+                                  decode.DecodeConfig(max_len=64),
+                                  num_slots=1, prefill_buckets=(16,),
+                                  name='stale-server')
+    srv = model_server.ModelServer(eng, port=0, host='127.0.0.1')
+    assert srv.max_staleness == 0.05
+    port = srv.start()
+    try:
+        time.sleep(0.5)  # loop is deep in its 2 s idle sleep
+        r = requests.get(f'http://127.0.0.1:{port}/healthz', timeout=30)
+        assert r.status_code == 503, r.text
+        assert r.text.startswith('stale staleness_seconds=')
+        assert float(r.text.split('=', 1)[1].split()[0]) > 0.05
+    finally:
+        srv.stop()
+
+
 def test_demo_codec_roundtrip():
     ids = model_server.encode_text('hello tpu', 256)
     assert model_server.decode_tokens(ids) == 'hello tpu'

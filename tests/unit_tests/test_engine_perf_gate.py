@@ -78,6 +78,20 @@ def test_admitted_concurrency_within_envelope(sched_result):
     assert got >= 2 * dense, (got, dense)
 
 
+def test_replay_runs_with_step_profiler_enabled(sched_result):
+    """ISSUE-9: the envelope replay runs with the request-telemetry
+    plane's step profiler ON (it is always on — one ring append + one
+    histogram observe per engine step), so the tokens/step assertions
+    above double as the telemetry-overhead gate: if the plane ever got
+    expensive enough to drop scheduler throughput >20%, tier-1 fails."""
+    for side in ('paged', 'dense'):
+        d = sched_result['detail'][side]
+        assert d['profiler_steps'] == d['engine_steps'] > 0, side
+    # The replay's requests flowed through the phase plane too.
+    p95 = sched_result['detail']['paged']['request_phase_p95']
+    assert p95['ttft'] > 0 and p95['total'] > 0
+
+
 def test_result_is_platform_tagged(sched_result):
     """The failover tier's contract: the emitted line must carry the
     platform that actually ran so trends stay attributable when TPU

@@ -304,7 +304,17 @@ def test_all_registered_metric_names_match_convention():
                      'skytpu_engine_prefix_hit_ratio',
                      'skytpu_engine_prefill_tokens_saved_total',
                      'skytpu_engine_rejected_total',
-                     'skytpu_server_rejected_total'):
+                     'skytpu_server_rejected_total',
+                     # Request-telemetry plane (ISSUE 9).
+                     'skytpu_request_queue_wait_seconds',
+                     'skytpu_request_prefill_seconds',
+                     'skytpu_request_ttft_seconds',
+                     'skytpu_request_per_token_seconds',
+                     'skytpu_request_total_seconds',
+                     'skytpu_request_finished_total',
+                     'skytpu_request_slow_total',
+                     'skytpu_engine_step_seconds',
+                     'skytpu_engine_stalls_total'):
         assert expected in names, f'{expected} not found by lint scan'
 
 
@@ -349,7 +359,9 @@ def test_all_journal_event_kinds_are_registered():
                      'SKYLET_EVENT_ERROR', 'SKYLET_AUTOSTOP',
                      # Decode engine slot scheduling (ISSUE 5) +
                      # admission control (ISSUE 8).
-                     'ENGINE_ADMIT', 'ENGINE_EVICT', 'ENGINE_REJECT'):
+                     'ENGINE_ADMIT', 'ENGINE_EVICT', 'ENGINE_REJECT',
+                     # Request-telemetry plane (ISSUE 9).
+                     'ENGINE_SLOW_REQUEST', 'ENGINE_STALL'):
         assert expected in attr_names, \
             f'EventKind.{expected} not found by lint scan'
 

@@ -1,0 +1,408 @@
+"""Request-telemetry plane: ring-buffer lifecycle records, the engine
+step profiler with stall detection, the SLO surface, CLI renderers, and
+the engine wiring (choke points + per-request trace join).
+
+Tier-1, CPU-only. The HTTP surface (/debug/requests, /slo, X-Request-Id
+propagation) is covered end-to-end in tests/test_model_server.py.
+"""
+import itertools
+import threading
+
+import pytest
+
+from skypilot_tpu.observability import journal
+from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import request_trace
+
+pytestmark = pytest.mark.metrics
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    prev = metrics.set_registry(metrics.MetricsRegistry())
+    yield
+    metrics.set_registry(prev)
+
+
+class FakeReq:
+    """Duck-typed engine Request: just the attributes the plane reads."""
+    _ids = itertools.count()
+
+    def __init__(self, prompt_len=4, max_new=8, tenant='default',
+                 trace_id=None, rid=None):
+        self.id = rid if rid is not None else f'q{next(self._ids)}'
+        self.tenant = tenant
+        self.prompt = [1] * prompt_len
+        self.max_new_tokens = max_new
+        self.tokens = []
+        self.enqueue_ts = None
+        self.first_token_ts = None
+        self.finish_ts = None
+        self.finish_reason = None
+        self.trace_id = trace_id
+
+
+def _complete(plane, req, enqueue=0.0, admit=0.01, first=0.03,
+              finish=0.1, generated=5, reason='length', slot=0,
+              prefix_hit=0):
+    """Drive one request through the full lifecycle with synthetic
+    perf_counter stamps (phases become exact, assertable numbers)."""
+    req.enqueue_ts = enqueue
+    plane.on_enqueue(req)
+    plane.on_admit(req, slot=slot, admit_ts=admit,
+                   prefix_hit_tokens=prefix_hit)
+    req.first_token_ts = first
+    req.tokens = list(range(generated))
+    req.finish_ts = finish
+    req.finish_reason = reason
+    return plane.on_finish(req, reason)
+
+
+# ----------------------------------------------------------- phase math
+
+
+def test_phase_breakdown_exact():
+    plane = request_trace.RequestTelemetry(capacity=8)
+    _complete(plane, FakeReq(), enqueue=1.0, admit=1.01, first=1.03,
+              finish=1.1, generated=5)
+    rec = plane.snapshot()['completed'][0]
+    ph = rec['phases']
+    assert ph['queue_wait'] == pytest.approx(0.01)
+    assert ph['prefill'] == pytest.approx(0.02)
+    assert ph['ttft'] == pytest.approx(0.03)
+    assert ph['decode'] == pytest.approx(0.07)
+    # First token came from prefill: decode amortizes over the other 4.
+    assert ph['per_token'] == pytest.approx(0.07 / 4)
+    assert ph['total'] == pytest.approx(0.1)
+    assert rec['state'] == 'done' and rec['reason_class'] == 'length'
+
+
+def test_rejected_request_has_no_prefill_phases():
+    plane = request_trace.RequestTelemetry(capacity=8)
+    req = FakeReq()
+    req.enqueue_ts = 2.0
+    plane.on_enqueue(req)
+    req.finish_ts = 2.5
+    req.finish_reason = 'rejected: prompt_too_long'
+    plane.on_finish(req, req.finish_reason)
+    rec = plane.snapshot()['completed'][0]
+    assert rec['reason_class'] == 'rejected'
+    ph = rec['phases']
+    assert ph['prefill'] is None and ph['ttft'] is None
+    # Never admitted: the whole life was queue wait.
+    assert ph['queue_wait'] == pytest.approx(0.5)
+    assert plane.slo()['rates']['rejected_total'] == 1
+    c = metrics.get_registry().get('skytpu_request_finished_total')
+    assert c.value(labels=('default', 'rejected')) == 1
+
+
+def test_request_histograms_are_tenant_labeled():
+    plane = request_trace.RequestTelemetry(capacity=8)
+    _complete(plane, FakeReq(tenant='acme'))
+    _complete(plane, FakeReq(tenant='acme'))
+    _complete(plane, FakeReq(tenant='bravo'))
+    reg = metrics.get_registry()
+    for name in ('skytpu_request_queue_wait_seconds',
+                 'skytpu_request_prefill_seconds',
+                 'skytpu_request_ttft_seconds',
+                 'skytpu_request_per_token_seconds',
+                 'skytpu_request_total_seconds'):
+        h = reg.get(name)
+        assert h is not None, name
+        assert h.count(labels=('acme',)) == 2, name
+        assert h.count(labels=('bravo',)) == 1, name
+    # Long-tail buckets: the 60 s bound exists for TTFT/total, so a
+    # prefill-heavy p99 does not saturate into +Inf.
+    assert 60.0 in reg.get('skytpu_request_ttft_seconds').buckets
+    assert 60.0 in reg.get('skytpu_request_total_seconds').buckets
+
+
+# ----------------------------------------------------------- ring buffer
+
+
+def test_completed_ring_wraparound():
+    plane = request_trace.RequestTelemetry(capacity=4)
+    reqs = [FakeReq(rid=f'w{i}') for i in range(10)]
+    for r in reqs:
+        _complete(plane, r)
+    snap = plane.snapshot()
+    assert len(snap['completed']) == 4
+    # Newest first, oldest dropped.
+    assert [r['id'] for r in snap['completed']] == ['w9', 'w8', 'w7',
+                                                    'w6']
+    # Monotonic totals survive the wraparound.
+    assert plane.slo()['rates']['finished_total'] == 10
+
+
+def test_capacity_env_override(monkeypatch):
+    monkeypatch.setenv(request_trace.CAPACITY_ENV, '3')
+    assert request_trace.RequestTelemetry().capacity == 3
+    monkeypatch.setenv(request_trace.CAPACITY_ENV, 'junk')
+    assert request_trace.RequestTelemetry().capacity == \
+        request_trace.DEFAULT_CAPACITY
+
+
+def test_snapshot_tracks_in_flight_states():
+    plane = request_trace.RequestTelemetry(capacity=8)
+    queued, active = FakeReq(), FakeReq()
+    queued.enqueue_ts = 1.0
+    active.enqueue_ts = 1.0
+    plane.on_enqueue(queued)
+    plane.on_enqueue(active)
+    plane.on_admit(active, slot=1, admit_ts=1.5, prefix_hit_tokens=16)
+    snap = plane.snapshot()
+    states = {r['id']: r for r in snap['in_flight']}
+    assert states[queued.id]['state'] == 'queued'
+    assert states[active.id]['state'] == 'active'
+    assert states[active.id]['slot'] == 1
+    assert states[active.id]['prefix_hit_tokens'] == 16
+    assert snap['completed'] == []
+    assert plane.slo()['in_flight'] == 2
+    assert plane.slo()['queued'] == 1
+    # Finishing moves the record out of in-flight.
+    active.finish_ts = 2.0
+    plane.on_finish(active, 'length')
+    snap = plane.snapshot()
+    assert [r['id'] for r in snap['in_flight']] == [queued.id]
+    assert [r['id'] for r in snap['completed']] == [active.id]
+
+
+def test_concurrent_writers_consistent():
+    """8 threads × 50 full lifecycles racing snapshot/slo readers: no
+    exceptions, no lost records."""
+    plane = request_trace.RequestTelemetry(capacity=64)
+    n_threads, n_reqs = 8, 50
+    errors = []
+
+    def writer(t):
+        try:
+            for i in range(n_reqs):
+                _complete(plane, FakeReq(rid=f't{t}_{i}',
+                                         tenant=f'tn{t}'))
+        except Exception as e:  # pylint: disable=broad-except
+            errors.append(e)
+
+    def reader():
+        try:
+            for _ in range(200):
+                snap = plane.snapshot()
+                assert len(snap['completed']) <= 64
+                plane.slo()
+        except Exception as e:  # pylint: disable=broad-except
+            errors.append(e)
+
+    threads = ([threading.Thread(target=writer, args=(t,))
+                for t in range(n_threads)] +
+               [threading.Thread(target=reader) for _ in range(2)])
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    slo = plane.slo()
+    assert slo['rates']['finished_total'] == n_threads * n_reqs
+    assert len(plane.snapshot()['completed']) == 64
+
+
+# ------------------------------------------------------------- slow SLO
+
+
+def test_slow_request_breach_payload(monkeypatch):
+    monkeypatch.setenv(request_trace.SLOW_REQUEST_ENV, '0.05')
+    plane = request_trace.RequestTelemetry(capacity=8)
+    assert _complete(plane, FakeReq(), finish=0.04) is None  # fast
+    slow = _complete(plane, FakeReq(tenant='acme'), finish=1.0)
+    assert slow is not None
+    assert slow['breached'] == ['total']
+    assert slow['total_seconds'] == pytest.approx(1.0)
+    assert slow['tenant'] == 'acme'
+    assert plane.slo()['rates']['slow_total'] == 1
+    c = metrics.get_registry().get('skytpu_request_slow_total')
+    assert c.value(labels=('acme',)) == 1
+
+
+def test_ttft_slo_breach(monkeypatch):
+    monkeypatch.setenv(request_trace.SLOW_REQUEST_ENV, '0')
+    monkeypatch.setenv(request_trace.TTFT_SLO_ENV, '0.02')
+    plane = request_trace.RequestTelemetry(capacity=8)
+    slow = _complete(plane, FakeReq(), first=0.05, finish=0.06)
+    assert slow is not None and slow['breached'] == ['ttft']
+    monkeypatch.setenv(request_trace.TTFT_SLO_ENV, '0')
+    assert _complete(plane, FakeReq(), first=0.05, finish=0.06) is None
+
+
+def test_percentiles_match_fleet_semantics():
+    """One percentile implementation across the observability package:
+    /slo's numbers use the same linear interpolation as the fleet
+    rollups (`common_utils.percentile`)."""
+    from skypilot_tpu.utils import common_utils
+    vals = [i / 100 for i in range(1, 101)]
+    p = request_trace.percentiles(vals)
+    for q, key in ((50, 'p50'), (95, 'p95'), (99, 'p99')):
+        assert p[key] == pytest.approx(
+            common_utils.percentile(vals, q), abs=1e-6)
+    assert request_trace.percentiles([0.0, 1.0])['p50'] == \
+        pytest.approx(0.5)
+    assert request_trace.percentiles([0.7])['p99'] == pytest.approx(0.7)
+    assert request_trace.percentiles([]) == {'p50': 0.0, 'p95': 0.0,
+                                             'p99': 0.0}
+
+
+def test_slo_surface_shape():
+    plane = request_trace.RequestTelemetry(capacity=8)
+    for i in range(4):
+        _complete(plane, FakeReq(), finish=0.1 * (i + 1))
+    slo = plane.slo()
+    assert slo['window']['completed'] == 4
+    assert slo['ttft_seconds']['p95'] > 0
+    # Linear interpolation over [0.1, 0.2, 0.3, 0.4]: p99 sits just
+    # under the max.
+    assert slo['total_seconds']['p99'] == pytest.approx(0.397)
+    assert slo['rates']['reject_rate'] == 0.0
+    assert 'slow_request_seconds' in slo['slo']
+
+
+# -------------------------------------------------------- step profiler
+
+
+def test_profiler_ring_and_snapshot():
+    prof = request_trace.EngineStepProfiler(capacity=4, stall_factor=10,
+                                            stall_min_seconds=0.0)
+    for i in range(10):
+        prof.record(0.01, chunk=4, active=2, delivered=8,
+                    queue_depth=i, blocks_used=3, blocks_total=16)
+    snap = prof.snapshot(last_n=2)
+    assert snap['steps_recorded'] == 10
+    assert len(snap['recent']) == 2
+    assert prof.snapshot(last_n=0)['recent'] == []  # not the whole ring
+    assert snap['recent'][0]['queue_depth'] == 9  # newest first
+    assert snap['recent'][0]['blocks_total'] == 16
+    assert snap['rolling_median_seconds'] == pytest.approx(0.01)
+    assert snap['step_seconds']['p95'] == pytest.approx(0.01)
+    h = metrics.get_registry().get('skytpu_engine_step_seconds')
+    assert h.count() == 10
+
+
+def test_profiler_stall_detection():
+    prof = request_trace.EngineStepProfiler(capacity=64, stall_factor=5,
+                                            stall_min_seconds=0.0)
+    # Below the minimum sample count nothing can stall.
+    assert prof.record(10.0, 1, 1, 1, 0) is None
+    for _ in range(8):
+        assert prof.record(0.01, 1, 1, 1, 0) is None
+    stall = prof.record(1.0, 1, 1, 1, queue_depth=7)
+    assert stall is not None
+    assert stall['step_seconds'] == pytest.approx(1.0)
+    assert stall['queue_depth'] == 7
+    assert stall['rolling_median_seconds'] == pytest.approx(0.01)
+    assert prof.stall_count() == 1
+    c = metrics.get_registry().get('skytpu_engine_stalls_total')
+    assert c.value() == 1
+    # The absolute floor suppresses micro-step jitter.
+    floored = request_trace.EngineStepProfiler(capacity=64,
+                                               stall_factor=5,
+                                               stall_min_seconds=10.0)
+    for _ in range(8):
+        floored.record(0.01, 1, 1, 1, 0)
+    assert floored.record(1.0, 1, 1, 1, 0) is None
+
+
+def test_profiler_heartbeat():
+    prof = request_trace.EngineStepProfiler()
+    assert prof.heartbeat_ts() == 0.0
+    prof.beat()
+    assert prof.heartbeat_ts() > 0
+    t0 = prof.heartbeat_ts()
+    prof.record(0.01, 1, 1, 1, 0)
+    assert prof.heartbeat_ts() >= t0
+
+
+# ---------------------------------------------------------- renderers
+
+
+def test_format_requests_table():
+    plane = request_trace.RequestTelemetry(capacity=8)
+    _complete(plane, FakeReq(rid='abc', tenant='acme',
+                             trace_id='f' * 32))
+    live = FakeReq(rid='live1')
+    live.enqueue_ts = 0.0
+    plane.on_enqueue(live)
+    out = request_trace.format_requests(plane.snapshot())
+    assert 'TTFT' in out and 'PER-TOK' in out
+    assert 'abc' in out and 'acme' in out and 'ffffffff' in out
+    assert 'live1' in out and 'queued' in out
+    assert request_trace.format_requests(
+        {'in_flight': [], 'completed': []}) == 'No tracked requests.'
+
+
+def test_format_slo_renders(monkeypatch):
+    monkeypatch.setenv(request_trace.SLOW_REQUEST_ENV, '30')
+    monkeypatch.setenv(request_trace.TTFT_SLO_ENV, '0')
+    plane = request_trace.RequestTelemetry(capacity=8)
+    _complete(plane, FakeReq())
+    out = request_trace.format_slo(plane.slo())
+    assert 'P95' in out and 'ttft' in out and 'per_token' in out
+    assert 'slow_request=30s' in out and 'ttft_slo=off' in out
+
+
+# -------------------------------------------------- journal trace join
+
+
+def test_event_batch_per_row_trace_override():
+    journal.event_batch([
+        (journal.EventKind.ENGINE_ADMIT, 'engine:t', {'request': 'a'},
+         100.0, 'a' * 32),
+        (journal.EventKind.ENGINE_EVICT, 'engine:t', {'request': 'b'},
+         101.0),
+    ])
+    rows = journal.query(kinds=[journal.EventKind.ENGINE_ADMIT])
+    assert rows and rows[0]['trace_id'] == 'a' * 32
+    rows = journal.query(kinds=[journal.EventKind.ENGINE_EVICT])
+    assert rows and rows[0]['trace_id'] is None  # ambient (none active)
+
+
+# ------------------------------------------------------- engine wiring
+
+
+def test_engine_wiring_end_to_end(monkeypatch):
+    """The real engine populates the plane at its choke points: phase
+    records for completed requests, profiler steps, and slow-request
+    journal rows carrying the per-request trace id."""
+    monkeypatch.setenv(request_trace.SLOW_REQUEST_ENV, '0.0000001')
+    import jax
+    from skypilot_tpu.models import decode
+    from skypilot_tpu.models import engine as engine_lib
+    from skypilot_tpu.models import llama
+    cfg = llama.CONFIGS['debug']
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    eng = engine_lib.DecodeEngine(params, cfg,
+                                  decode.DecodeConfig(max_len=32),
+                                  num_slots=2, step_chunk=2,
+                                  prefill_buckets=(16,), name='wiring')
+    reqs = [engine_lib.Request([1, 2, 3 + i], 4,
+                               trace_id=f'{i:032x}') for i in range(3)]
+    for r in reqs:
+        eng.submit(r)
+    steps = 0
+    while not all(r.done for r in reqs):
+        eng.step()
+        steps += 1
+        assert steps < 100
+    eng.flush_journal()
+    snap = eng.telemetry.snapshot()
+    assert len(snap['completed']) == 3 and not snap['in_flight']
+    for rec in snap['completed']:
+        ph = rec['phases']
+        assert ph['ttft'] is not None and ph['ttft'] >= 0
+        assert ph['total'] is not None and ph['total'] >= ph['ttft']
+        assert rec['generated'] == 4
+    assert eng.profiler.steps_recorded() == steps
+    assert eng.telemetry.slo()['ttft_seconds']['p95'] > 0
+    # Every (instantly-breached) slow request journaled under ITS trace.
+    rows = journal.query(kinds=[journal.EventKind.ENGINE_SLOW_REQUEST],
+                         limit=10)
+    assert {r['trace_id'] for r in rows} == {f'{i:032x}'
+                                             for i in range(3)}
+    # Queue-depth gauge drained back to zero through the one helper.
+    g = metrics.get_registry().get('skytpu_engine_queue_depth')
+    assert g.value() == 0
