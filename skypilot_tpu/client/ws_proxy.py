@@ -23,7 +23,12 @@ async def _run(server_url: str, cluster: str, port: int) -> int:
     url = (f'{server_url.rstrip("/")}/k8s-pod-ssh-proxy'
            f'?cluster={cluster}&port={port}')
     loop = asyncio.get_event_loop()
-    async with aiohttp.ClientSession() as session:
+    # Connect-only timeout: the websocket itself is a long-lived duplex
+    # stream (no total/read bound), but a dead server must fail the
+    # dial instead of hanging the client forever.
+    async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=None,
+                                          sock_connect=30)) as session:
         async with session.ws_connect(url, max_msg_size=0) as ws:
 
             stdin_fd = sys.stdin.fileno()

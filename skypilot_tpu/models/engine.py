@@ -83,6 +83,7 @@ import itertools
 import os
 import threading
 import time
+import traceback
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
@@ -94,8 +95,20 @@ from skypilot_tpu.observability import journal
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import request_trace
 from skypilot_tpu.observability import runtime_metrics
+from skypilot_tpu.utils import chaos
+from skypilot_tpu.utils import common_utils
 
 IDLE_SLEEP_ENV = 'SKYTPU_ENGINE_IDLE_SLEEP_SECONDS'
+# Supervisor restart budget: a step() crash fails in-flight requests
+# fast, rebuilds device state, and restarts the loop — at most
+# MAX_RESTARTS times within a rolling RESTART_WINDOW. One more crash
+# inside the window marks the engine permanently failed (/healthz goes
+# 503 for good; the replica manager's probe/retry machinery replaces
+# the replica).
+MAX_RESTARTS_ENV = 'SKYTPU_ENGINE_MAX_RESTARTS'
+DEFAULT_MAX_RESTARTS = 3
+RESTART_WINDOW_ENV = 'SKYTPU_ENGINE_RESTART_WINDOW_SECONDS'
+DEFAULT_RESTART_WINDOW_SECONDS = 300.0
 
 # The pool's block 0 is engine-owned scratch: freed slots' table rows
 # point at it so frozen lanes write harmlessly, and bucket-padding
@@ -588,35 +601,11 @@ class DecodeEngine:
             # concurrency is pure paging/prefix-sharing win.
             self.num_blocks = (num_blocks if num_blocks is not None
                                else num_slots * self._max_blocks + 1)
-            self._cache = decode.init_block_pool(cfg, self.num_blocks,
-                                                 bk, dcfg.kv_cache_dtype)
-            self._allocator = BlockAllocator(self.num_blocks)
-            self._radix = RadixPrefixCache(bk, self._allocator)
-            # Per-slot block-table mirror; rows of freed slots point at
-            # SCRATCH_BLOCK (0). The device copy is cached and
-            # invalidated only on admission/eviction, so steady-state
-            # ticks skip the host→device upload.
-            self._block_table_np = np.zeros(
-                (num_slots, self._max_blocks), np.int32)
-            self._block_table_dev = None
-            # Per-slot allocator refs to drop at eviction + radix path
-            # locks to release.
-            self._slot_refs: List[List[int]] = [[] for _ in
-                                                range(num_slots)]
-            self._slot_nodes: List[list] = [[] for _ in range(num_slots)]
-            self._prompt_tokens_total = 0
-            self._prompt_tokens_saved = 0
         else:
             self.num_blocks = 0
-            self._cache = decode.init_kv_cache(cfg, num_slots,
-                                               dcfg.max_len,
-                                               dcfg.kv_cache_dtype)
-        # Host mirrors of per-slot device state.
-        self._slots: List[Optional[Request]] = [None] * num_slots
-        self._token = np.zeros((num_slots,), np.int32)
-        self._pos = np.zeros((num_slots,), np.int32)
-        self._done = np.ones((num_slots,), bool)
-        self._remaining = np.zeros((num_slots,), np.int32)
+        self._prompt_tokens_total = 0
+        self._prompt_tokens_saved = 0
+        self._init_runtime_state()
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         # Greedy decoding ignores sampling keys; reuse one zero buffer
         # instead of allocating [step_chunk, 2] on every tick.
@@ -647,10 +636,55 @@ class DecodeEngine:
         # stays untouched) + the per-step profiler behind /debug/engine.
         self.telemetry = request_trace.RequestTelemetry(name=name)
         self.profiler = request_trace.EngineStepProfiler(name=name)
+        # Supervisor state (run_forever): crash timestamps for the
+        # rolling restart budget; `failed` flips once the budget is
+        # exhausted and never flips back (/healthz reads it).
+        self.failed = False
+        self.fail_reason: Optional[str] = None
+        self._restarts = 0
+        self._crash_times: List[float] = []
         self._m = metrics_lib
         self._m.gauge('skytpu_engine_num_slots',
                       'Configured KV-cache lanes.').set(num_slots)
         self._publish_slot_gauges()
+
+    def _init_runtime_state(self) -> None:
+        """(Re)build everything a crashed step may have corrupted: the
+        device cache/block pool, the allocator + radix prefix cache
+        (dropped — its blocks lived in the old pool), block tables, and
+        the per-slot host mirrors. Called at construction and by the
+        supervisor's restart path; cumulative stats and the admission
+        queues are deliberately NOT touched — queued requests survive a
+        restart and re-prefill against the fresh pool."""
+        num_slots = self.num_slots
+        if self.paged:
+            bk = self._block_k
+            self._cache = decode.init_block_pool(
+                self.cfg, self.num_blocks, bk, self.dcfg.kv_cache_dtype)
+            self._allocator = BlockAllocator(self.num_blocks)
+            self._radix = RadixPrefixCache(bk, self._allocator)
+            # Per-slot block-table mirror; rows of freed slots point at
+            # SCRATCH_BLOCK (0). The device copy is cached and
+            # invalidated only on admission/eviction, so steady-state
+            # ticks skip the host→device upload.
+            self._block_table_np = np.zeros(
+                (num_slots, self._max_blocks), np.int32)
+            self._block_table_dev = None
+            # Per-slot allocator refs to drop at eviction + radix path
+            # locks to release.
+            self._slot_refs: List[List[int]] = [[] for _ in
+                                                range(num_slots)]
+            self._slot_nodes: List[list] = [[] for _ in range(num_slots)]
+        else:
+            self._cache = decode.init_kv_cache(self.cfg, num_slots,
+                                               self.dcfg.max_len,
+                                               self.dcfg.kv_cache_dtype)
+        # Host mirrors of per-slot device state.
+        self._slots: List[Optional[Request]] = [None] * num_slots
+        self._token = np.zeros((num_slots,), np.int32)
+        self._pos = np.zeros((num_slots,), np.int32)
+        self._done = np.ones((num_slots,), bool)
+        self._remaining = np.zeros((num_slots,), np.int32)
 
     # ------------------------------------------------------------ intake
 
@@ -994,14 +1028,37 @@ class DecodeEngine:
                 break
             except ValueError as e:
                 self._reject(req, f'error: {e}')
+            except Exception as e:
+                # Crash mid-admission: the request was already popped
+                # from its queue and is not yet slotted — finish it NOW
+                # (its client learns instantly via on_finish) before
+                # re-raising to the supervisor, or it would be the one
+                # request neither the fail-fast sweep nor the queue
+                # replay covers, silently riding out the full request
+                # timeout.
+                self._fail_request(req, f'admission crashed: {e}')
+                raise
         return n
 
     def _reject(self, req: Request, reason: str, **payload) -> None:
-        self._journal(journal.EventKind.ENGINE_REJECT, req, -1,
-                      action='reject', reason=reason, **payload)
+        """Terminal rejection (the request's fault: unservable prompt,
+        bad budget) — clients see a 4xx."""
+        self._finish_unadmitted(req, f'rejected: {reason}',
+                                action='reject', reason=reason, **payload)
+
+    def _fail_request(self, req: Request, reason: str, **payload) -> None:
+        """Terminal server-side failure (engine crash, permanent fail)
+        for a request that never got a slot — finish as 'error: ...' so
+        the model server answers 500, not the 422 a rejection earns."""
+        self._finish_unadmitted(req, f'error: {reason}',
+                                action='error', reason=reason, **payload)
+
+    def _finish_unadmitted(self, req: Request, finish_reason: str,
+                           **payload) -> None:
+        self._journal(journal.EventKind.ENGINE_REJECT, req, -1, **payload)
         self._m.counter('skytpu_engine_rejected_total',
                         'Requests rejected at admission.').inc()
-        req._finish(f'rejected: {reason}')  # pylint: disable=protected-access
+        req._finish(finish_reason)  # pylint: disable=protected-access
         slow = self.telemetry.on_finish(req, req.finish_reason)
         if slow is not None:
             self._journal(journal.EventKind.ENGINE_SLOW_REQUEST, req, -1,
@@ -1013,6 +1070,11 @@ class DecodeEngine:
         """Admit, then run one chunk of fused decode steps across all
         slots. Returns the number of slots that were active (0 = idle:
         nothing queued, nothing decoding)."""
+        # Chaos points (default off: two dict lookups): an injected
+        # raise exercises the run_forever supervisor; slow_step widens
+        # decode windows for drain/stall tests.
+        chaos.maybe_raise('engine_step_raise')
+        chaos.maybe_slow_step()
         self._admit()
         active = self.active_slots()
         if active == 0:
@@ -1133,8 +1195,22 @@ class DecodeEngine:
     # ------------------------------------------------------------- loop
 
     def run_forever(self, stop_event: threading.Event) -> None:
-        """Engine loop: step while there is work, sleep briefly when
-        idle. Run on a dedicated thread; ``stop_event`` ends it."""
+        """Supervised engine loop: step while there is work, sleep
+        briefly when idle. Run on a dedicated thread; ``stop_event``
+        ends it.
+
+        A ``step()`` exception no longer kills the thread silently (one
+        bad request or a flaky device call used to wedge the whole
+        replica behind a live HTTP server): the supervisor journals an
+        ``engine.crash`` with the traceback, fails every in-flight
+        request fast (clients get an error via ``on_finish``, not a
+        request timeout), rebuilds the device state, and restarts —
+        bounded by ``SKYTPU_ENGINE_MAX_RESTARTS`` within a rolling
+        ``SKYTPU_ENGINE_RESTART_WINDOW_SECONDS`` window, after which the
+        engine is permanently ``failed`` and the loop exits (the model
+        server's /healthz 503s for good and the replica manager's probe
+        machinery replaces the replica). Queued (not-yet-admitted)
+        requests survive a restart and re-prefill."""
         try:
             idle = float(os.environ.get(IDLE_SLEEP_ENV, '0.02'))
         except ValueError:
@@ -1144,9 +1220,106 @@ class DecodeEngine:
             # server's /healthz staleness reads this, and an idle-but-
             # alive engine must not decay into a 503.
             self.profiler.beat()
-            if self.step() == 0:
+            try:
+                active = self.step()
+            except Exception as exc:  # pylint: disable=broad-except
+                if not self._recover_from_crash(exc):
+                    return  # restart budget exhausted: permanent fail
+                continue
+            if active == 0:
                 self.flush_journal()  # one-token admissions while idle
                 time.sleep(idle)
+
+    # ------------------------------------------------------- supervision
+
+    def restart_count(self) -> int:
+        return self._restarts
+
+    def _recover_from_crash(self, exc: BaseException) -> bool:
+        """One supervisor round: journal the crash (with traceback),
+        fail in-flight requests fast, then either rebuild + restart
+        (returns True) or — budget exhausted — fail the queued requests
+        too and flip the engine permanently ``failed`` (returns
+        False)."""
+        now = time.time()
+        window = common_utils.env_float(RESTART_WINDOW_ENV,
+                                        DEFAULT_RESTART_WINDOW_SECONDS)
+        budget = common_utils.env_int(MAX_RESTARTS_ENV,
+                                      DEFAULT_MAX_RESTARTS)
+        self._crash_times = [t for t in self._crash_times
+                             if now - t <= window]
+        self._crash_times.append(now)
+        permanent = len(self._crash_times) > budget
+        self._journal_raw(journal.EventKind.ENGINE_CRASH, {
+            'error': str(exc) or type(exc).__name__,
+            'traceback': traceback.format_exc(),
+            'in_flight': self.active_slots(),
+            'queued': self.queue_depth(),
+            'crashes_in_window': len(self._crash_times),
+            'max_restarts': budget,
+            'permanent': permanent,
+        })
+        exc_text = str(exc) or type(exc).__name__
+        self._fail_in_flight(f'error: engine crashed: {exc_text}')
+        if permanent:
+            self.failed = True
+            self.fail_reason = (
+                f'{len(self._crash_times)} crashes within {window:.0f}s '
+                f'(budget {budget}); last: {exc_text}')
+            self._fail_queued()
+            self.flush_journal()
+            return False
+        # Fresh cache/pool, radix cache dropped, slot mirrors cleared;
+        # the tenant queues are untouched — their requests re-prefill.
+        self._init_runtime_state()
+        self._restarts += 1
+        self._m.counter(
+            'skytpu_engine_restarts_total',
+            'Engine supervisor restarts after a step() crash.').inc()
+        self._journal_raw(journal.EventKind.ENGINE_RESTART, {
+            'restarts': self._restarts,
+            'queued': self.queue_depth(),
+        })
+        self.flush_journal()
+        self._publish_slot_gauges()
+        if self.paged:
+            self._publish_block_gauges()
+        return True
+
+    def _fail_in_flight(self, reason: str) -> None:
+        """Finish every slotted request with an error — clients learn
+        NOW (500 via on_finish), not at the request timeout. Does NOT
+        touch the allocator/radix (the crash may have left them
+        inconsistent mid-admission); the caller rebuilds all device
+        state afterwards."""
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            self._slots[slot] = None
+            self._evicted += 1
+            self._m.counter(
+                'skytpu_engine_evicted_total',
+                'Requests evicted from a slot (finished).').inc()
+            self._journal(journal.EventKind.ENGINE_EVICT, req, slot,
+                          reason=reason, generated=len(req.tokens))
+            req._finish(reason)  # pylint: disable=protected-access
+            slow = self.telemetry.on_finish(req, reason)
+            if slow is not None:
+                self._journal(journal.EventKind.ENGINE_SLOW_REQUEST,
+                              req, slot, **slow)
+        self._publish_slot_gauges()
+
+    def _fail_queued(self) -> None:
+        """Permanent-failure path only: nothing will ever serve the
+        queue again, so fail every queued request (server-side error →
+        clients get 500) instead of letting them ride out the request
+        timeout."""
+        while True:
+            req = self._pop_next()
+            if req is None:
+                break
+            self._fail_request(req, 'engine failed permanently')
+        self._publish_queue_depth()
 
     # ------------------------------------------------------------ stats
 
@@ -1175,6 +1348,8 @@ class DecodeEngine:
             'decode_tokens': self._decode_emitted,
             'mean_occupancy': round(self.mean_occupancy(), 4),
             'stalls': self.profiler.stall_count(),
+            'restarts': self._restarts,
+            'failed': self.failed,
             'step_chunk': self.step_chunk,
             'kv_cache_dtype': self.dcfg.kv_cache_dtype,
             'max_len': self.dcfg.max_len,

@@ -96,6 +96,15 @@ class EventKind(enum.Enum):
     # the stall threshold journals the step profile evidence.
     ENGINE_SLOW_REQUEST = 'engine.slow_request'
     ENGINE_STALL = 'engine.stall'
+    # Serving-plane fault tolerance: the engine supervisor's crash →
+    # fail-fast → rebuild → restart lifecycle (engine.crash carries the
+    # traceback; restarts are bounded by SKYTPU_ENGINE_MAX_RESTARTS),
+    # the model server's graceful-drain phases, and load-balancer
+    # circuit-breaker ejections/reinstatements.
+    ENGINE_CRASH = 'engine.crash'
+    ENGINE_RESTART = 'engine.restart'
+    SERVER_DRAIN = 'server.drain'
+    LB_EJECT = 'lb.eject'
 
 
 KINDS = frozenset(k.value for k in EventKind)

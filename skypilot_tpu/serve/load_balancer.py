@@ -11,6 +11,13 @@ ready-replica set. The controller spawns, monitors, and restarts it
 
 An in-process mode (``get_ready_urls`` callback) remains for unit tests
 of the proxy itself.
+
+Fault tolerance: on top of the controller-synced ready set (probe-driven,
+seconds stale) sits a per-replica consecutive-failure **circuit breaker**
+(:class:`ReplicaCircuitBreaker`): connect errors, pre-byte 5xx responses
+and failed probes eject a replica from the candidate set for a backoff
+window with probe-based reinstatement, and a 502/503 received before any
+body bytes fails over to another replica instead of reaching the client.
 """
 import argparse
 import asyncio
@@ -25,12 +32,25 @@ from aiohttp import web
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.observability import exporter as exporter_lib
+from skypilot_tpu.observability import journal
 from skypilot_tpu.observability import metrics
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.utils import common_utils
 
 logger = sky_logging.init_logger(__name__)
 
 LB_METRICS_PORT_ENV = 'SKYTPU_LB_METRICS_PORT'
+# Replica circuit breaker: this many CONSECUTIVE failures (connect
+# errors, pre-byte 5xx, failed reinstatement probes) eject a replica
+# from the candidate set for a backoff window; a passing /healthz probe
+# reinstates it, a failing one doubles the backoff (capped).
+EJECT_THRESHOLD_ENV = 'SKYTPU_LB_EJECT_THRESHOLD'
+DEFAULT_EJECT_THRESHOLD = 3
+EJECT_BACKOFF_ENV = 'SKYTPU_LB_EJECT_BACKOFF_SECONDS'
+DEFAULT_EJECT_BACKOFF_SECONDS = 10.0
+EJECT_PROBE_INTERVAL_ENV = 'SKYTPU_LB_EJECT_PROBE_INTERVAL'
+DEFAULT_EJECT_PROBE_INTERVAL = 1.0
+_EJECT_BACKOFF_MAX_SECONDS = 120.0
 
 
 def _observe_request(replica: str, code, t0: float) -> None:
@@ -68,6 +88,93 @@ def lb_sync_interval_seconds() -> float:
     return float(os.environ.get('SKYTPU_SERVE_LB_SYNC_INTERVAL', '2'))
 
 
+class ReplicaCircuitBreaker:
+    """Per-replica consecutive-failure circuit breaker.
+
+    The ready set the controller syncs is probe-driven and seconds
+    stale; a replica that just wedged (or is draining) keeps receiving
+    traffic for a whole probe cycle. The breaker closes that window
+    from the data path: every connect error / pre-byte 5xx / failed
+    probe counts, ``threshold`` consecutive failures eject the replica
+    from the candidate set for a backoff window, and reinstatement is
+    probe-based (the LB's probe loop GETs /healthz after the backoff —
+    success reinstates, failure doubles the backoff up to a cap). Any
+    successful proxied response resets the failure count (and
+    reinstates — the all-ejected fallback path may prove a replica
+    healthy before its probe does).
+
+    Writes come from the LB's asyncio loop; the lock makes reads from
+    in-proc test threads safe.
+    """
+
+    def __init__(self, threshold: Optional[int] = None,
+                 backoff_seconds: Optional[float] = None):
+        self.threshold = (threshold if threshold is not None
+                          else max(1, common_utils.env_int(
+                              EJECT_THRESHOLD_ENV,
+                              DEFAULT_EJECT_THRESHOLD)))
+        self.base_backoff = (backoff_seconds if backoff_seconds is not None
+                             else common_utils.env_float(
+                                 EJECT_BACKOFF_ENV,
+                                 DEFAULT_EJECT_BACKOFF_SECONDS))
+        self._lock = threading.Lock()
+        self._failures: dict = {}   # url -> consecutive failure count
+        self._ejected: dict = {}    # url -> {'until': ts, 'backoff': s}
+
+    def record_failure(self, url: str) -> Optional[dict]:
+        """Count one failure; returns an eviction payload when this one
+        crossed the threshold (None otherwise, incl. already-ejected)."""
+        with self._lock:
+            n = self._failures.get(url, 0) + 1
+            self._failures[url] = n
+            if url in self._ejected or n < self.threshold:
+                return None
+            self._ejected[url] = {'until': time.time() + self.base_backoff,
+                                  'backoff': self.base_backoff}
+            return {'consecutive_failures': n,
+                    'backoff_seconds': self.base_backoff}
+
+    def record_success(self, url: str) -> bool:
+        """Reset the failure streak; returns True when this success
+        reinstated an ejected replica (the fallback path served it)."""
+        with self._lock:
+            self._failures.pop(url, None)
+            return self._ejected.pop(url, None) is not None
+
+    def extend_backoff(self, url: str) -> float:
+        """Failed reinstatement probe: double the backoff (capped).
+        Returns the new backoff (0.0 if the url is not ejected)."""
+        with self._lock:
+            e = self._ejected.get(url)
+            if e is None:
+                return 0.0
+            e['backoff'] = min(e['backoff'] * 2,
+                               _EJECT_BACKOFF_MAX_SECONDS)
+            e['until'] = time.time() + e['backoff']
+            return e['backoff']
+
+    def reinstate(self, url: str) -> None:
+        with self._lock:
+            self._ejected.pop(url, None)
+            self._failures.pop(url, None)
+
+    def is_ejected(self, url: str) -> bool:
+        with self._lock:
+            return url in self._ejected
+
+    def filter(self, urls: List[str]) -> List[str]:
+        with self._lock:
+            return [u for u in urls if u not in self._ejected]
+
+    def due_probes(self, now: Optional[float] = None) -> List[str]:
+        """Ejected urls whose backoff expired (probe before
+        reinstating)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            return [u for u, e in self._ejected.items()
+                    if e['until'] <= now]
+
+
 class LoadBalancer:
     """aiohttp reverse proxy with a pluggable policy.
 
@@ -87,6 +194,10 @@ class LoadBalancer:
         self._metrics_port = metrics_port
         self._exporter: Optional[exporter_lib.MetricsExporter] = None
         self._synced_urls: List[str] = []
+        # Replica ejection: the consecutive-failure circuit breaker
+        # (connect errors, pre-byte 5xx, probe failures) — see
+        # ReplicaCircuitBreaker.
+        self.breaker = ReplicaCircuitBreaker()
         # Request arrival timestamps for the autoscaler (QPS window).
         # Guarded by a lock: the aiohttp thread appends while another
         # thread (in-proc mode) or the sync task snapshots.
@@ -95,6 +206,9 @@ class LoadBalancer:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
+        # Background tasks (controller sync, eject probes) — cancelled
+        # at teardown so loop close does not warn about pending tasks.
+        self._bg_tasks: List[asyncio.Task] = []
 
     # ---------------------------------------------------------- lifecycle
 
@@ -119,7 +233,12 @@ class LoadBalancer:
         self._loop.run_until_complete(self._setup())
         self._started.set()
         if self._controller_url:
-            self._loop.create_task(self._sync_loop())
+            self._bg_tasks.append(
+                self._loop.create_task(self._sync_loop()))
+        # Reinstatement probes for ejected replicas (both modes: the
+        # in-proc tests exercise the breaker too).
+        self._bg_tasks.append(
+            self._loop.create_task(self._eject_probe_loop()))
         try:
             self._loop.run_forever()
         finally:
@@ -159,6 +278,9 @@ class LoadBalancer:
                 self._exporter = None
 
     async def _teardown(self) -> None:
+        for task in self._bg_tasks:
+            task.cancel()
+        self._bg_tasks = []
         if self._exporter is not None:
             self._exporter.stop()
             self._exporter = None
@@ -235,11 +357,69 @@ class LoadBalancer:
             return self._get_ready_urls()
         return self._synced_urls
 
+    def _candidate_urls(self) -> List[str]:
+        """Ready set minus breaker-ejected replicas. With EVERY replica
+        ejected, fall back to the full ready set — degraded service
+        beats a self-inflicted black hole, and a success on the
+        fallback path reinstates the replica that served it."""
+        ready = self._ready_urls()
+        healthy = self.breaker.filter(ready)
+        return healthy if healthy else ready
+
+    def _record_replica_failure(self, url: str, kind: str) -> None:
+        """Breaker bookkeeping for one replica-side failure; journals +
+        counts the ejection when the failure streak crosses the
+        threshold."""
+        ejected = self.breaker.record_failure(url)
+        if ejected is None:
+            return
+        metrics.counter('skytpu_lb_ejected_total',
+                        'Replicas ejected from the LB candidate set by '
+                        'the circuit breaker.',
+                        labels=('replica',)).inc(labels=(url,))
+        journal.event(journal.EventKind.LB_EJECT, f'lb:{self.port}',
+                      {'action': 'eject', 'replica': url, 'kind': kind,
+                       **ejected})
+        logger.warning(
+            f'Ejecting replica {url} after '
+            f'{ejected["consecutive_failures"]} consecutive failures '
+            f'({kind}); probing again in '
+            f'{ejected["backoff_seconds"]:.0f}s.')
+
+    async def _eject_probe_loop(self) -> None:
+        """Probe ejected replicas once their backoff expires: a 200
+        /healthz reinstates, anything else doubles the backoff. Until
+        the probe passes, the replica receives zero proxied requests."""
+        interval = common_utils.env_float(EJECT_PROBE_INTERVAL_ENV,
+                                          DEFAULT_EJECT_PROBE_INTERVAL)
+        while True:
+            await asyncio.sleep(interval)
+            for url in self.breaker.due_probes():
+                try:
+                    async with self._session.get(
+                            url.rstrip('/') + '/healthz',
+                            timeout=aiohttp.ClientTimeout(
+                                total=5)) as resp:
+                        ok = resp.status == 200
+                except (aiohttp.ClientError, asyncio.TimeoutError):
+                    ok = False
+                if ok:
+                    self.breaker.reinstate(url)
+                    journal.event(journal.EventKind.LB_EJECT,
+                                  f'lb:{self.port}',
+                                  {'action': 'reinstate', 'replica': url})
+                    logger.info(f'Replica {url} probe passed; '
+                                'reinstated.')
+                else:
+                    backoff = self.breaker.extend_backoff(url)
+                    logger.info(f'Replica {url} probe failed; next '
+                                f'probe in {backoff:.0f}s.')
+
     async def _handle(self, request: web.Request) -> web.StreamResponse:
         t_start = time.perf_counter()
         with self._ts_lock:
             self._request_timestamps.append(time.time())
-        self.policy.set_ready_replicas(self._ready_urls())
+        self.policy.set_ready_replicas(self._candidate_urls())
         url = self.policy.select_replica()
         if url is None and self._controller_url is not None:
             # Empty ready set: sync on demand before 503ing — bounds
@@ -249,7 +429,7 @@ class LoadBalancer:
             # sync-visible race.
             for _ in range(2):
                 await self._sync_once()
-                self.policy.set_ready_replicas(self._ready_urls())
+                self.policy.set_ready_replicas(self._candidate_urls())
                 url = self.policy.select_replica()
                 if url is not None:
                     break
@@ -270,7 +450,8 @@ class LoadBalancer:
         # inside the sync window, and its requests should fail over,
         # not 502. Errors after bytes flowed are NOT retried (the
         # request may not be idempotent mid-stream).
-        for attempt in range(2):
+        attempts = 2
+        for attempt in range(attempts):
             if url is None or url in tried:
                 break
             current = url
@@ -285,6 +466,33 @@ class LoadBalancer:
                 async with self._session.request(request.method, target,
                                                  headers=headers,
                                                  data=body) as resp:
+                    if resp.status >= 500:
+                        # A 5xx before any body bytes flowed to the
+                        # client feeds the circuit breaker, and a
+                        # 502/503 (dead or DRAINING upstream) fails
+                        # over like a connect error when another
+                        # candidate exists — a draining replica's 503
+                        # must not reach the client while healthy
+                        # replicas serve. Other 5xx (or no candidate
+                        # left) proxy through below.
+                        self._record_replica_failure(
+                            current, f'status_{resp.status}')
+                        _observe_proxy_error(current,
+                                             f'status_{resp.status}')
+                        # Only fail over while another attempt remains:
+                        # on the LAST attempt, proxying the 5xx through
+                        # beats the generic 502 the exhausted loop
+                        # would return.
+                        if (resp.status in (502, 503) and
+                                attempt + 1 < attempts):
+                            failover = [u for u in self._candidate_urls()
+                                        if u not in tried]
+                            if failover:
+                                last_err = RuntimeError(
+                                    f'replica answered {resp.status} '
+                                    'before any body bytes')
+                                url = failover[0]
+                                continue
                     out_headers = {k: v for k, v in resp.headers.items()
                                    if k.lower() not in _HOP_HEADERS}
                     # Stream chunk-by-chunk: token streams (SSE/chunked
@@ -298,10 +506,18 @@ class LoadBalancer:
                         await out.write(chunk)
                     await out.write_eof()
                     _observe_request(current, resp.status, t_start)
+                    if resp.status < 500 and \
+                            self.breaker.record_success(current):
+                        journal.event(
+                            journal.EventKind.LB_EJECT,
+                            f'lb:{self.port}',
+                            {'action': 'reinstate', 'replica': current,
+                             'kind': 'fallback_success'})
                     return out
             except (aiohttp.ClientConnectorError,
                     aiohttp.ServerDisconnectedError) as e:
                 _observe_proxy_error(current, type(e).__name__)
+                self._record_replica_failure(current, type(e).__name__)
                 if out is not None:
                     # Headers already went out: terminate the stream
                     # hard (force_close drops keep-alive so the client
@@ -316,12 +532,13 @@ class LoadBalancer:
                 # Pick a DIFFERENT replica from a local candidate list —
                 # rewriting the shared policy's ready set here would
                 # reset its in-flight accounting mid-traffic.
-                candidates = [u for u in self._ready_urls()
+                candidates = [u for u in self._candidate_urls()
                               if u not in tried]
                 url = candidates[0] if candidates else None
                 continue
             except aiohttp.ClientError as e:
                 _observe_proxy_error(current, type(e).__name__)
+                self._record_replica_failure(current, type(e).__name__)
                 if out is not None:
                     out.force_close()
                     _observe_request(current, 'truncated', t_start)

@@ -42,7 +42,20 @@ Endpoints:
   utilization, stall count).
 * ``GET /slo`` — rolling p50/p95/p99 TTFT / per-token / total latency
   and reject/error rates over the completed-request ring (rendered by
-  ``skytpu slo``).
+  ``skytpu slo``), plus a ``resilience`` block (server state, drains,
+  engine supervisor restarts).
+* ``POST /drain`` — graceful drain: the server flips to DRAINING
+  (``/healthz`` 503s so the LB routes away, ``/generate`` answers 503 +
+  ``Retry-After``), in-flight requests get up to
+  ``SKYTPU_DRAIN_TIMEOUT_SECONDS`` (default 30) to finish, then the
+  server exits. SIGTERM does the same in standalone mode; the replica
+  manager calls it before tearing a replica down.
+
+Fault tolerance: the engine loop is *supervised* — a ``step()`` crash
+journals ``engine.crash``, fails in-flight requests fast (clients get a
+500, not a 300 s timeout), rebuilds engine state and restarts, bounded
+by ``SKYTPU_ENGINE_MAX_RESTARTS`` per rolling window; past the budget
+``/healthz`` 503s permanently and the serve plane replaces the replica.
 
 Every ``/generate`` carries an ``X-Request-Id``: the client's header
 value if present, else a fresh trace id — echoed on the response and
@@ -64,6 +77,7 @@ import argparse
 import asyncio
 import json
 import os
+import signal
 import threading
 import time
 from typing import Optional
@@ -75,8 +89,10 @@ from skypilot_tpu.models import decode
 from skypilot_tpu.models import engine as engine_lib
 from skypilot_tpu.models import llama
 from skypilot_tpu.observability import exporter as exporter_lib
+from skypilot_tpu.observability import journal
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import trace as trace_lib
+from skypilot_tpu.utils import chaos
 from skypilot_tpu.utils import common_utils
 
 logger = sky_logging.init_logger(__name__)
@@ -91,6 +107,19 @@ REQUEST_TIMEOUT_ENV = 'SKYTPU_MODEL_SERVER_REQUEST_TIMEOUT'
 # client timeouts instead of an actionable signal). 0 disables.
 MAX_QUEUE_ENV = 'SKYTPU_SERVE_MAX_QUEUE'
 DEFAULT_MAX_QUEUE = 256
+# Graceful drain: once DRAINING (SIGTERM or POST /drain), in-flight
+# requests get up to this long to finish before the server exits.
+DRAIN_TIMEOUT_ENV = 'SKYTPU_DRAIN_TIMEOUT_SECONDS'
+DEFAULT_DRAIN_TIMEOUT_SECONDS = 30.0
+# stop(): how long to wait for the engine loop thread before declaring
+# it wedged (logged + journaled — it still holds the accelerator).
+STOP_TIMEOUT_ENV = 'SKYTPU_SERVER_STOP_TIMEOUT_SECONDS'
+DEFAULT_STOP_TIMEOUT_SECONDS = 10.0
+
+# skytpu_server_state gauge values (the LB/operators read the metric;
+# /healthz carries the string).
+_STATE_VALUES = {'starting': 0, 'running': 0, 'draining': 1,
+                 'stopped': 2}
 
 
 def encode_text(text: str, vocab_size: int) -> list:
@@ -128,14 +157,28 @@ class ModelServer:
         # engine loop's heartbeat as the freshness signal.
         self.max_staleness = common_utils.env_optional_float(
             exporter_lib.HEALTHZ_MAX_STALENESS_ENV)
+        self.drain_timeout = common_utils.env_float(
+            DRAIN_TIMEOUT_ENV, DEFAULT_DRAIN_TIMEOUT_SECONDS)
         self._started_at: Optional[float] = None
         self._stop = threading.Event()
         self._engine_thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
+        # Lifecycle: starting → running → draining → stopped. The
+        # state lock serializes begin_drain/stop against each other.
+        self._state = 'starting'
+        self._state_lock = threading.Lock()
+        self._startup_error: Optional[BaseException] = None
+        self._drain_thread: Optional[threading.Thread] = None
+        self._drains = 0
 
     # ---------------------------------------------------------- lifecycle
+
+    @property
+    def startup_error(self) -> Optional[BaseException]:
+        """The setup exception that aborted run_forever, if any."""
+        return self._startup_error
 
     def start(self) -> int:
         """In-proc mode (tests): serve from a daemon thread; returns the
@@ -146,19 +189,50 @@ class ModelServer:
         self._thread.start()
         if not self._started.wait(timeout=60):
             raise RuntimeError('Model server failed to start.')
+        if self._startup_error is not None:
+            # Setup failed (port in use, bad host): surface it NOW —
+            # the old code only flipped _started after a successful
+            # setup, so the caller blocked out the full 60s wait to
+            # learn about an error known in milliseconds.
+            raise RuntimeError(
+                f'Model server failed to start: {self._startup_error}'
+            ) from self._startup_error
         return self.port
 
     def stop(self) -> None:
         self._stop.set()
+        stop_timeout = common_utils.env_float(
+            STOP_TIMEOUT_ENV, DEFAULT_STOP_TIMEOUT_SECONDS)
         if self._engine_thread is not None:
-            self._engine_thread.join(timeout=10)
-        if self._loop is not None:
-            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._engine_thread.join(timeout=stop_timeout)
+            if self._engine_thread.is_alive():
+                # A wedged engine loop (stuck device call) holds the
+                # accelerator and keeps this process — and its port —
+                # alive after "stop". Operators need to see WHY, not a
+                # silent return.
+                logger.error(
+                    f'Engine thread did not stop within '
+                    f'{stop_timeout:.0f}s — wedged (it still holds the '
+                    'accelerator); the process/port will linger until '
+                    'it exits.')
+                journal.event(
+                    journal.EventKind.ENGINE_CRASH,
+                    f'engine:{self.engine.name}',
+                    {'error': 'engine thread wedged at server stop',
+                     'wedged': True, 'phase': 'stop',
+                     'join_timeout_seconds': stop_timeout})
+        if self._loop is not None and not self._loop.is_closed():
+            try:
+                self._loop.call_soon_threadsafe(self._loop.stop)
+            except RuntimeError:
+                pass  # loop closed between the check and the call
         if self._thread is not None:
             self._thread.join(timeout=10)
+        self._set_state('stopped')
 
     def run_forever(self) -> None:
-        """Standalone mode: engine thread + HTTP server until stopped."""
+        """Standalone mode: engine thread + HTTP server until stopped
+        (SIGTERM triggers a graceful drain first)."""
         self._started_at = time.time()
         self._engine_thread = threading.Thread(
             target=self.engine.run_forever, args=(self._stop,),
@@ -166,7 +240,17 @@ class ModelServer:
         self._engine_thread.start()
         self._loop = asyncio.new_event_loop()
         asyncio.set_event_loop(self._loop)
-        self._loop.run_until_complete(self._setup())
+        try:
+            self._loop.run_until_complete(self._setup())
+        except Exception as e:  # pylint: disable=broad-except
+            logger.error(f'Model server setup failed: {e}')
+            self._startup_error = e
+            self._stop.set()  # reap the engine thread
+            self._loop.close()
+            self._started.set()  # unblock start() immediately
+            return
+        self._install_signal_handlers()
+        self._set_state('running')
         self._started.set()
         try:
             self._loop.run_forever()
@@ -174,10 +258,85 @@ class ModelServer:
             self._stop.set()
             self._loop.run_until_complete(self._teardown())
             self._loop.close()
+            self._set_state('stopped')
+
+    def _install_signal_handlers(self) -> None:
+        """SIGTERM → graceful drain (standalone mode; replica teardown
+        sends SIGTERM first). Signal handlers need the main thread — the
+        in-proc test mode runs the loop on a daemon thread and relies on
+        POST /drain instead."""
+        try:
+            self._loop.add_signal_handler(
+                signal.SIGTERM, self.begin_drain, 'sigterm')
+        except (NotImplementedError, RuntimeError, ValueError):
+            pass
+
+    # -------------------------------------------------------------- drain
+
+    def _set_state(self, state: str) -> None:
+        self._state = state
+        metrics_lib.gauge(
+            'skytpu_server_state',
+            'Model server lifecycle state (0=running, 1=draining, '
+            '2=stopped).').set(_STATE_VALUES.get(state, 0))
+
+    def _entity(self) -> str:
+        return f'server:{self.engine.name}:{self.port}'
+
+    def begin_drain(self, reason: str = 'api') -> bool:
+        """Flip the server to DRAINING (idempotent; returns False when
+        already draining/stopped): /healthz answers 503 so the LB's
+        ready-set sync routes new traffic away, /generate answers 503 +
+        Retry-After, in-flight requests get up to
+        ``SKYTPU_DRAIN_TIMEOUT_SECONDS`` to finish, then the server
+        stops."""
+        with self._state_lock:
+            if self._state != 'running':
+                return False
+            self._drains += 1
+            self._set_state('draining')
+        journal.event(journal.EventKind.SERVER_DRAIN, self._entity(),
+                      {'phase': 'begin', 'reason': reason,
+                       'in_flight': self.engine.active_slots(),
+                       'queued': self.engine.queue_depth(),
+                       'timeout_seconds': self.drain_timeout})
+        logger.info(f'Draining ({reason}): waiting up to '
+                    f'{self.drain_timeout:.0f}s for in-flight requests.')
+        self._drain_thread = threading.Thread(target=self._drain_and_stop,
+                                              daemon=True,
+                                              name='skytpu-drain')
+        self._drain_thread.start()
+        return True
+
+    def _drain_and_stop(self) -> None:
+        t0 = time.time()
+        deadline = t0 + self.drain_timeout
+        drained = False
+        while time.time() < deadline:
+            idle = (self.engine.active_slots() == 0 and
+                    self.engine.queue_depth() == 0)
+            if chaos.armed('drain_hang'):
+                idle = False  # chaos: ride out the full drain timeout
+            if idle:
+                drained = True
+                break
+            time.sleep(0.05)
+        journal.event(journal.EventKind.SERVER_DRAIN, self._entity(),
+                      {'phase': 'done', 'drained': drained,
+                       'waited_seconds': round(time.time() - t0, 3),
+                       'in_flight': self.engine.active_slots(),
+                       'queued': self.engine.queue_depth()})
+        if not drained:
+            logger.warning(
+                f'Drain timed out after {self.drain_timeout:.0f}s with '
+                f'{self.engine.active_slots()} request(s) still in '
+                'flight; stopping anyway.')
+        self.stop()
 
     async def _setup(self) -> None:
         app = web.Application()
         app.router.add_post('/generate', self._handle_generate)
+        app.router.add_post('/drain', self._handle_drain)
         app.router.add_get('/healthz', self._handle_healthz)
         app.router.add_get('/metrics', self._handle_metrics)
         app.router.add_get('/debug/requests', self._handle_debug_requests)
@@ -200,6 +359,23 @@ class ModelServer:
 
     async def _handle_generate(self, request: web.Request
                                ) -> web.StreamResponse:
+        # Chaos: a pre-byte replica 500 (the LB's circuit breaker and
+        # failover logic feed on these in the chaos e2e).
+        if chaos.should_fire('replica_500'):
+            return web.json_response(
+                {'error': 'chaos: injected replica_500'}, status=500)
+        # Draining/stopped: answer 503 + Retry-After instantly — the
+        # LB routes away on the next ready-set sync, and a client that
+        # raced the flip retries another replica instead of queueing
+        # behind a server that will never admit it.
+        if self._state != 'running':
+            return web.json_response(
+                {'error': f'server {self._state}', 'state': self._state},
+                status=503, headers={'Retry-After': '1'})
+        if self.engine.failed:
+            return web.json_response(
+                {'error': f'engine failed: {self.engine.fail_reason}'},
+                status=503, headers={'Retry-After': '30'})
         try:
             body = await request.json()
         except (json.JSONDecodeError, UnicodeDecodeError):
@@ -341,15 +517,26 @@ class ModelServer:
         except asyncio.TimeoutError:
             return web.json_response({'error': 'timeout'}, status=504,
                                      headers=rid)
+        finish = req.finish_reason or ''
         if token is None and not req.tokens:
-            # Engine-side rejection: known instantly, surfaced as a
-            # client error instead of a request-timeout 504.
-            return web.json_response({'error': req.finish_reason},
-                                     status=422, headers=rid)
+            # Engine-side terminal state with zero tokens, known
+            # instantly: a rejection is the client's fault (422), an
+            # engine crash is ours (500) — either way not a 504 after
+            # the full request timeout.
+            status = 422 if finish.startswith('rejected') else 500
+            return web.json_response({'error': finish}, status=status,
+                                     headers=rid)
+        if finish.startswith('error'):
+            # Crashed mid-generation: partial tokens + 500 (the
+            # supervisor failed this request fast; the client must see
+            # a server error, not a 200 with a truncated body).
+            return web.json_response(
+                {'error': finish, 'tokens': req.tokens,
+                 'generated': len(req.tokens)}, status=500, headers=rid)
         return web.json_response({
             'tokens': req.tokens,
             'text': decode_tokens(req.tokens),
-            'finish_reason': req.finish_reason,
+            'finish_reason': finish,
             'generated': len(req.tokens),
         }, headers=rid)
 
@@ -368,6 +555,20 @@ class ModelServer:
         staleness = self.staleness_seconds()
         stats = self.engine.stats()
         line = ' '.join(f'{k}={v}' for k, v in stats.items())
+        if self.engine.failed:
+            # Permanent: the supervisor's restart budget is spent. This
+            # 503 never clears — the replica manager's probe/retry
+            # machinery recycles the replica.
+            return web.Response(
+                status=503,
+                text=f'engine failed permanently '
+                     f'({self.engine.fail_reason}) '
+                     f'staleness_seconds={staleness:.3f} {line}\n')
+        if self._state != 'running':
+            return web.Response(
+                status=503,
+                text=f'{self._state} '
+                     f'staleness_seconds={staleness:.3f} {line}\n')
         if not alive:
             return web.Response(
                 status=503,
@@ -405,7 +606,20 @@ class ModelServer:
         })
 
     async def _handle_slo(self, request: web.Request) -> web.Response:
-        return web.json_response(self.engine.telemetry.slo())
+        body = self.engine.telemetry.slo()
+        body['resilience'] = {
+            'server_state': self._state,
+            'drains_total': self._drains,
+            'engine_restarts': self.engine.restart_count(),
+            'engine_failed': self.engine.failed,
+        }
+        return web.json_response(body)
+
+    async def _handle_drain(self, request: web.Request) -> web.Response:
+        initiated = self.begin_drain('http')
+        return web.json_response(
+            {'state': self._state, 'initiated': initiated,
+             'drain_timeout_seconds': self.drain_timeout}, status=202)
 
 
 def build_engine(model: str, num_slots: int, max_len: int,
@@ -500,6 +714,9 @@ def main() -> None:
     server = ModelServer(engine, args.port, host=args.host,
                          default_max_new_tokens=args.max_new_tokens)
     server.run_forever()
+    if server.startup_error is not None:
+        raise SystemExit(f'Model server failed to start: '
+                         f'{server.startup_error}')
 
 
 if __name__ == '__main__':

@@ -39,6 +39,9 @@ _MAX_FAILED_REPLICAS = int(os.environ.get('SKYTPU_SERVE_MAX_FAILURES',
 
 REPLICA_PORT_ENV = 'SKYTPU_REPLICA_PORT'
 REPLICA_ID_ENV = 'SKYTPU_REPLICA_ID'
+# Shared with serve/model_server.py: how long a draining replica's
+# in-flight requests get before teardown proceeds.
+DRAIN_TIMEOUT_ENV = 'SKYTPU_DRAIN_TIMEOUT_SECONDS'
 
 
 class ReplicaManager:
@@ -320,11 +323,20 @@ class ReplicaManager:
 
     def terminate_replica(self, replica_id: int, reason: str,
                           remove_record: bool = True) -> None:
+        rec = next((r for r in serve_state.get_replicas(self.service_name)
+                    if r['replica_id'] == replica_id), None)
+        endpoint = rec['endpoint'] if rec else None
         self._set_status(replica_id, ReplicaStatus.SHUTTING_DOWN)
         cluster_name = self.replica_cluster_name(replica_id)
         logger.info(f'Terminating replica {replica_id} ({reason}).')
 
         def _term() -> None:
+            # Graceful drain first (autoscale-down, rolling update,
+            # shutdown): in-flight requests finish instead of being cut
+            # mid-stream. 'unhealthy' replicas skip it — they are not
+            # answering anyway.
+            if reason != 'unhealthy':
+                self._drain_replica(replica_id, endpoint, reason)
             self._teardown_cluster(cluster_name)
             if remove_record:
                 serve_state.remove_replica(self.service_name, replica_id)
@@ -333,6 +345,38 @@ class ReplicaManager:
                              name=f'term-{cluster_name}')
         self._track(t)
         t.start()
+
+    def _drain_replica(self, replica_id: int, endpoint: Optional[str],
+                       reason: str) -> None:
+        """Best-effort graceful drain before teardown: POST /drain flips
+        a first-party model server to DRAINING (its /healthz 503s so the
+        LB routes away; in-flight requests get up to
+        ``SKYTPU_DRAIN_TIMEOUT_SECONDS``), then wait for it to go quiet
+        (the drained server exits, so the poll ends on a connection
+        error). Replicas that do not speak /drain (plain HTTP demos)
+        answer an error instantly and are torn down as before."""
+        if not endpoint:
+            return
+        from skypilot_tpu.utils import common_utils
+        timeout_s = common_utils.env_float(DRAIN_TIMEOUT_ENV, 30.0)
+        url = endpoint.rstrip('/')
+        try:
+            resp = requests_lib.post(f'{url}/drain', timeout=5)
+        except requests_lib.RequestException:
+            return  # replica already gone — nothing to drain
+        if resp.status_code not in (200, 202):
+            return  # not a drain-capable replica
+        logger.info(f'Replica {replica_id} draining ({reason}); waiting '
+                    f'up to {timeout_s:.0f}s for in-flight requests.')
+        deadline = time.time() + timeout_s + 5.0
+        while time.time() < deadline:
+            try:
+                requests_lib.get(f'{url}/healthz', timeout=2)
+            except requests_lib.RequestException:
+                return  # server exited: drain complete
+            time.sleep(0.25)
+        logger.warning(f'Replica {replica_id} did not finish draining '
+                       'in time; terminating anyway.')
 
     def terminate_all(self) -> None:
         for rec in serve_state.get_replicas(self.service_name):

@@ -1,0 +1,496 @@
+"""ISSUE-10 chaos e2e (tier-1, CPU engine): the serving plane under
+injected faults.
+
+* An engine-step crash mid-decode ends with the supervisor restarting
+  the engine, every accepted request answered (error or completion —
+  none riding out the 300 s request timeout), and `engine.crash` in the
+  journal with the traceback.
+* Graceful drain under load finishes in-flight requests while new
+  traffic gets 503 + Retry-After, then the server exits.
+* The LB's circuit breaker ejects a failing replica (which receives
+  ZERO proxied requests while ejected) and reinstates it only after its
+  health probe passes; a pre-byte replica 503 fails over instead of
+  reaching the client.
+
+Faults come from the env-driven chaos harness (`skypilot_tpu/utils/
+chaos.py`, `SKYTPU_CHAOS=...`) — the serving-plane sibling of
+`SKYTPU_LOCAL_PROVISION_FAIL_FILE`.
+"""
+import http.server
+import json
+import socket
+import threading
+import time
+
+import jax
+import pytest
+import requests
+
+from skypilot_tpu.models import decode
+from skypilot_tpu.models import engine as engine_lib
+from skypilot_tpu.models import llama
+from skypilot_tpu.observability import journal
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import model_server
+from skypilot_tpu.utils import chaos
+
+pytestmark = pytest.mark.engine
+
+CFG = llama.CONFIGS['debug']
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos(monkeypatch):
+    monkeypatch.delenv(chaos.CHAOS_ENV, raising=False)
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _sse_events(resp):
+    events = []
+    for line in resp.iter_lines():
+        if line.startswith(b'data: '):
+            events.append(json.loads(line[len(b'data: '):]))
+    return events
+
+
+def _server(num_slots=2, step_chunk=2, name='chaos-e2e'):
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    eng = engine_lib.DecodeEngine(params, CFG,
+                                  decode.DecodeConfig(max_len=64),
+                                  num_slots=num_slots,
+                                  step_chunk=step_chunk,
+                                  prefill_buckets=(16,), name=name)
+    srv = model_server.ModelServer(eng, port=0, host='127.0.0.1')
+    port = srv.start()
+    return srv, eng, f'http://127.0.0.1:{port}'
+
+
+# ------------------------------------------------- engine crash recovery
+
+
+def test_engine_crash_mid_decode_restart_and_recovery(monkeypatch):
+    """Acceptance: injected step crash mid-decode → the in-flight
+    request is answered with a 500 fast (not the 300 s timeout), the
+    supervisor restarts the engine, follow-up requests complete, and
+    `skytpu events -k engine.crash` shows the trace."""
+    # Slowed steps give the crash a wide mid-decode window.
+    monkeypatch.setenv(chaos.CHAOS_ENV, 'slow_step:1.0')
+    monkeypatch.setenv(chaos.SLOW_STEP_SECONDS_ENV, '0.05')
+    srv, eng, base = _server(step_chunk=1)
+    try:
+        result = {}
+
+        def post():
+            result['resp'] = requests.post(
+                f'{base}/generate',
+                json={'prompt': [3, 1, 4], 'max_new_tokens': 40,
+                      'stream': False}, timeout=120)
+
+        restarts_counter = metrics_lib.counter(
+            'skytpu_engine_restarts_total',
+            'Engine supervisor restarts after a step() crash.')
+        restarts_before = restarts_counter.value()
+        th = threading.Thread(target=post, daemon=True)
+        th.start()
+        deadline = time.time() + 20
+        while eng.active_slots() == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert eng.active_slots() == 1, 'request never started decoding'
+        time.sleep(0.2)  # a few slowed decode steps in
+        t0 = time.time()
+        monkeypatch.setenv(chaos.CHAOS_ENV,
+                           'slow_step:1.0,engine_step_raise:1')
+        th.join(30)
+        assert not th.is_alive(), 'client still waiting after crash'
+        resp = result['resp']
+        # Mid-generation crash: 500 with the partial tokens, instantly.
+        assert resp.status_code == 500, (resp.status_code, resp.text)
+        body = resp.json()
+        assert 'engine crashed' in body['error']
+        assert body['generated'] >= 1
+        assert time.time() - t0 < 20  # fail-fast, not a timeout
+
+        # Recovery: the restarted engine serves new traffic.
+        monkeypatch.setenv(chaos.CHAOS_ENV, '')
+        r2 = requests.post(f'{base}/generate',
+                           json={'prompt': [7, 8, 9],
+                                 'max_new_tokens': 4, 'stream': False},
+                           timeout=120)
+        assert r2.status_code == 200 and r2.json()['generated'] == 4
+        h = requests.get(f'{base}/healthz', timeout=30)
+        assert h.status_code == 200, h.text
+        assert 'restarts=1' in h.text and 'failed=False' in h.text
+
+        # Flight recorder: crash (with traceback) + restart journaled.
+        eng.flush_journal()
+        crashes = journal.query(kinds=[journal.EventKind.ENGINE_CRASH])
+        assert crashes and 'ChaosError' in \
+            crashes[0]['payload']['traceback']
+        assert journal.query(kinds=[journal.EventKind.ENGINE_RESTART])
+
+        # Acceptance surface: `skytpu events -k engine.crash`.
+        from click.testing import CliRunner
+        from skypilot_tpu.client import cli as cli_mod
+        res = CliRunner().invoke(cli_mod.cli,
+                                 ['events', '-k', 'engine.crash'])
+        assert res.exit_code == 0, res.output
+        assert 'engine.crash' in res.output
+
+        # Relative: the registry is process-global and other tests'
+        # supervisor restarts count in the same series.
+        assert restarts_counter.value() == restarts_before + 1
+        assert 'skytpu_engine_restarts_total' in \
+            requests.get(f'{base}/metrics', timeout=30).text
+        slo = requests.get(f'{base}/slo', timeout=30).json()
+        assert slo['resilience']['engine_restarts'] == 1
+        assert slo['resilience']['server_state'] == 'running'
+    finally:
+        srv.stop()
+
+
+def test_restart_budget_exhaustion_is_permanent_503(monkeypatch):
+    """Past SKYTPU_ENGINE_MAX_RESTARTS the engine fails permanently:
+    /healthz answers 503 for good (the replica manager's probe budget
+    then recycles the replica) and /generate refuses with 503 — every
+    accepted request still gets answered, never a timeout."""
+    monkeypatch.setenv('SKYTPU_ENGINE_MAX_RESTARTS', '0')
+    srv, eng, base = _server(num_slots=1)
+    try:
+        monkeypatch.setenv(chaos.CHAOS_ENV, 'engine_step_raise:3')
+        t0 = time.time()
+        r = requests.post(f'{base}/generate',
+                          json={'prompt': [1, 2], 'max_new_tokens': 4,
+                                'stream': False}, timeout=60)
+        # Either the request was queued and failed when the engine went
+        # permanent (500 'error: engine failed permanently' — a server
+        # fault, not a client rejection), or the crash won the race and
+        # the server already refuses at the door (503).
+        assert r.status_code in (500, 503), (r.status_code, r.text)
+        assert time.time() - t0 < 30
+
+        deadline = time.time() + 15
+        while not eng.failed and time.time() < deadline:
+            time.sleep(0.05)
+        assert eng.failed
+        for _ in range(2):  # permanent: the 503 never clears
+            h = requests.get(f'{base}/healthz', timeout=30)
+            assert h.status_code == 503, h.text
+            assert 'engine failed permanently' in h.text
+            time.sleep(0.1)
+        g = requests.post(f'{base}/generate',
+                          json={'prompt': [1], 'stream': False},
+                          timeout=30)
+        assert g.status_code == 503
+        assert 'engine failed' in g.json()['error']
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------- drain
+
+
+def test_drain_under_load_finishes_in_flight(monkeypatch):
+    """POST /drain under load: the in-flight stream completes fully,
+    new /generate traffic gets 503 + Retry-After, /healthz flips to 503
+    'draining' (LB routes away), and the server exits afterwards."""
+    monkeypatch.setenv('SKYTPU_DRAIN_TIMEOUT_SECONDS', '25')
+    monkeypatch.setenv(chaos.CHAOS_ENV, 'slow_step:1.0')
+    monkeypatch.setenv(chaos.SLOW_STEP_SECONDS_ENV, '0.08')
+    srv, eng, base = _server(step_chunk=1)
+    try:
+        events = []
+
+        def stream():
+            with requests.post(f'{base}/generate',
+                               json={'prompt': [3, 1, 4],
+                                     'max_new_tokens': 30},
+                               stream=True, timeout=120) as r:
+                events.extend(_sse_events(r))
+
+        th = threading.Thread(target=stream, daemon=True)
+        th.start()
+        deadline = time.time() + 20
+        while eng.active_slots() == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert eng.active_slots() == 1
+
+        d = requests.post(f'{base}/drain', timeout=10)
+        assert d.status_code == 202 and d.json()['state'] == 'draining'
+        g = requests.post(f'{base}/generate',
+                          json={'prompt': [5], 'stream': False},
+                          timeout=10)
+        assert g.status_code == 503 and g.headers['Retry-After']
+        assert 'draining' in g.json()['error']
+        h = requests.get(f'{base}/healthz', timeout=10)
+        assert h.status_code == 503 and h.text.startswith('draining')
+
+        th.join(60)
+        assert not th.is_alive(), 'in-flight stream cut by drain'
+        assert len(events) == 30, 'drain truncated the stream'
+        assert events[-1]['done'] and \
+            events[-1]['finish_reason'] == 'length'
+
+        # Drained server exits on its own.
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                requests.get(f'{base}/healthz', timeout=2)
+                time.sleep(0.1)
+            except requests.RequestException:
+                break
+        else:
+            pytest.fail('server did not stop after draining')
+
+        rows = journal.query(kinds=[journal.EventKind.SERVER_DRAIN],
+                             ascending=True)
+        phases = [r['payload']['phase'] for r in rows]
+        assert 'begin' in phases and 'done' in phases
+        done = [r for r in rows if r['payload']['phase'] == 'done'][0]
+        assert done['payload']['drained'] is True
+        state = metrics_lib.get_registry().get('skytpu_server_state')
+        assert state.value() == 2  # stopped
+    finally:
+        srv.stop()
+
+
+def test_drain_hang_chaos_rides_out_the_timeout(monkeypatch):
+    """The drain_hang fault point keeps the drain loop from ever seeing
+    an idle engine, so the drain exercises its timeout path and the
+    server still stops."""
+    monkeypatch.setenv('SKYTPU_DRAIN_TIMEOUT_SECONDS', '0.4')
+    monkeypatch.setenv(chaos.CHAOS_ENV, 'drain_hang')
+    srv, eng, base = _server()
+    try:
+        assert srv.begin_drain('test') is True
+        assert srv.begin_drain('test') is False  # idempotent
+        deadline = time.time() + 15
+        while srv._state != 'stopped' and time.time() < deadline:  # pylint: disable=protected-access
+            time.sleep(0.05)
+        assert srv._state == 'stopped'  # pylint: disable=protected-access
+        done = [r for r in journal.query(
+                    kinds=[journal.EventKind.SERVER_DRAIN])
+                if r['payload']['phase'] == 'done']
+        assert done and done[0]['payload']['drained'] is False
+        assert done[0]['payload']['waited_seconds'] >= 0.4
+    finally:
+        srv.stop()
+
+
+def test_replica_500_chaos_point(monkeypatch):
+    """replica_500 answers /generate with a pre-byte 500 before the
+    engine is touched — the fault the LB breaker e2e feeds on."""
+    srv, eng, base = _server()
+    try:
+        monkeypatch.setenv(chaos.CHAOS_ENV, 'replica_500:1.0')
+        r = requests.post(f'{base}/generate', json={'prompt': [1]},
+                          timeout=10)
+        assert r.status_code == 500 and 'chaos' in r.json()['error']
+        monkeypatch.setenv(chaos.CHAOS_ENV, '')
+        r = requests.post(f'{base}/generate',
+                          json={'prompt': [1, 2], 'max_new_tokens': 2,
+                                'stream': False}, timeout=120)
+        assert r.status_code == 200
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------ server lifecycle
+
+
+def test_server_start_surfaces_setup_error_immediately():
+    """Satellite: a setup exception (port in use) used to block start()
+    for the full 60 s wait; now it re-raises immediately."""
+    occupied = socket.socket()
+    occupied.bind(('127.0.0.1', 0))
+    occupied.listen(1)
+    port = occupied.getsockname()[1]
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    eng = engine_lib.DecodeEngine(params, CFG,
+                                  decode.DecodeConfig(max_len=64),
+                                  num_slots=1, prefill_buckets=(16,),
+                                  name='start-fail')
+    srv = model_server.ModelServer(eng, port=port, host='127.0.0.1')
+    t0 = time.time()
+    with pytest.raises(RuntimeError, match='failed to start'):
+        srv.start()
+    assert time.time() - t0 < 30  # not the 60 s hang
+    assert srv.startup_error is not None
+    occupied.close()
+    srv.stop()
+
+
+def test_stop_journals_wedged_engine_thread(monkeypatch):
+    """Satellite: stop() with an engine thread that won't join logs +
+    journals the wedged thread (it still holds the accelerator) instead
+    of returning silently."""
+    srv, eng, base = _server()
+    monkeypatch.setenv('SKYTPU_SERVER_STOP_TIMEOUT_SECONDS', '0.3')
+    # Wedge the loop: every step now sleeps far past the stop timeout.
+    monkeypatch.setenv(chaos.CHAOS_ENV, 'slow_step:1.0')
+    monkeypatch.setenv(chaos.SLOW_STEP_SECONDS_ENV, '2')
+    time.sleep(0.2)  # the loop is inside its slowed step
+    srv.stop()
+    rows = journal.query(kinds=[journal.EventKind.ENGINE_CRASH])
+    assert any(r['payload'].get('wedged') for r in rows), \
+        'wedged engine thread not journaled at stop'
+
+
+# ----------------------------------------------------------- LB ejection
+
+
+class _FlakyState:
+    def __init__(self):
+        self.healthy = False
+        self.data_hits = 0
+
+
+def _flaky_backend(state, body):
+    class Handler(http.server.BaseHTTPRequestHandler):
+
+        def do_GET(self):  # noqa: N802
+            if self.path == '/healthz':
+                self.send_response(200 if state.healthy else 503)
+                self.send_header('Content-Length', '0')
+                self.end_headers()
+                return
+            state.data_hits += 1
+            if not state.healthy:
+                self.send_response(503)
+                self.send_header('Content-Length', '0')
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    server = http.server.ThreadingHTTPServer(('127.0.0.1', 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, f'http://127.0.0.1:{server.server_port}'
+
+
+def _healthy_backend(body):
+    state = _FlakyState()
+    state.healthy = True
+    return _flaky_backend(state, body)
+
+
+def test_lb_ejects_failing_replica_until_probe_passes(monkeypatch):
+    """Acceptance: a replica answering pre-byte 503s (a) never surfaces
+    them to clients while a healthy replica exists (failover), (b) is
+    ejected after the failure threshold and receives ZERO proxied
+    requests while ejected, and (c) is reinstated only once its
+    /healthz probe passes — after which traffic returns."""
+    monkeypatch.setenv('SKYTPU_LB_EJECT_THRESHOLD', '2')
+    monkeypatch.setenv('SKYTPU_LB_EJECT_BACKOFF_SECONDS', '0.4')
+    monkeypatch.setenv('SKYTPU_LB_EJECT_PROBE_INTERVAL', '0.1')
+    good_srv, good_url = _healthy_backend(b'ok-a')
+    bad_state = _FlakyState()
+    bad_srv, bad_url = _flaky_backend(bad_state, b'ok-b')
+    with socket.socket() as s:
+        s.bind(('', 0))
+        lb_port = s.getsockname()[1]
+    lb = lb_lib.LoadBalancer(lb_port, 'round_robin',
+                             get_ready_urls=lambda: [good_url, bad_url])
+    lb.start()
+    try:
+        # (a) pre-byte 503s fail over: every client request succeeds.
+        for _ in range(6):
+            r = requests.get(f'http://127.0.0.1:{lb_port}/x', timeout=10)
+            assert r.status_code == 200 and r.text == 'ok-a'
+        # (b) the failing replica is ejected...
+        assert lb.breaker.is_ejected(bad_url)
+        hits_at_ejection = bad_state.data_hits
+        for _ in range(5):
+            r = requests.get(f'http://127.0.0.1:{lb_port}/x', timeout=10)
+            assert r.status_code == 200 and r.text == 'ok-a'
+        # ...and receives zero proxied requests while ejected (its
+        # /healthz probes don't count data traffic).
+        assert bad_state.data_hits == hits_at_ejection
+        ejected = metrics_lib.get_registry().get('skytpu_lb_ejected_total')
+        assert ejected.value(labels=(bad_url,)) == 1
+        rows = journal.query(kinds=[journal.EventKind.LB_EJECT])
+        assert any(r['payload']['action'] == 'eject' for r in rows)
+
+        # (c) probe-based reinstatement: flip the replica healthy and
+        # the probe loop brings it back after the backoff.
+        bad_state.healthy = True
+        deadline = time.time() + 15
+        while lb.breaker.is_ejected(bad_url) and time.time() < deadline:
+            time.sleep(0.05)
+        assert not lb.breaker.is_ejected(bad_url), \
+            'replica never reinstated after its probe passed'
+        texts = set()
+        for _ in range(6):
+            r = requests.get(f'http://127.0.0.1:{lb_port}/x', timeout=10)
+            assert r.status_code == 200
+            texts.add(r.text)
+        assert 'ok-b' in texts, 'reinstated replica got no traffic'
+        rows = journal.query(kinds=[journal.EventKind.LB_EJECT])
+        assert any(r['payload']['action'] == 'reinstate' for r in rows)
+    finally:
+        lb.stop()
+        good_srv.shutdown()
+        bad_srv.shutdown()
+
+
+def test_lb_all_replicas_ejected_degrades_instead_of_blackholing(
+        monkeypatch):
+    """With every replica ejected the LB falls back to the full ready
+    set (a degraded answer beats a guaranteed 502), and a success on
+    the fallback path reinstates the replica."""
+    monkeypatch.setenv('SKYTPU_LB_EJECT_THRESHOLD', '1')
+    monkeypatch.setenv('SKYTPU_LB_EJECT_BACKOFF_SECONDS', '60')
+    state = _FlakyState()
+    srv, url = _flaky_backend(state, b'ok-solo')
+    with socket.socket() as s:
+        s.bind(('', 0))
+        lb_port = s.getsockname()[1]
+    lb = lb_lib.LoadBalancer(lb_port, 'round_robin',
+                             get_ready_urls=lambda: [url])
+    lb.start()
+    try:
+        # One pre-byte 503 ejects the only replica (threshold 1); the
+        # 503 has no failover target so it proxies through.
+        r = requests.get(f'http://127.0.0.1:{lb_port}/x', timeout=10)
+        assert r.status_code == 503
+        assert lb.breaker.is_ejected(url)
+        # Replica recovers; the fallback path still routes to it and
+        # the success reinstates it without waiting out the backoff.
+        state.healthy = True
+        r = requests.get(f'http://127.0.0.1:{lb_port}/x', timeout=10)
+        assert r.status_code == 200 and r.text == 'ok-solo'
+        assert not lb.breaker.is_ejected(url)
+    finally:
+        lb.stop()
+        srv.shutdown()
+
+
+def test_lb_last_attempt_proxies_5xx_instead_of_generic_502(monkeypatch):
+    """With more failing replicas than retry attempts, the last
+    attempt's pre-byte 503 is proxied through (with its headers) rather
+    than swallowed into a generic LB 502 after picking a candidate the
+    exhausted loop would never request."""
+    monkeypatch.setenv('SKYTPU_LB_EJECT_THRESHOLD', '100')  # breaker off
+    backends = [_flaky_backend(_FlakyState(), b'x') for _ in range(3)]
+    urls = [u for _, u in backends]
+    with socket.socket() as s:
+        s.bind(('', 0))
+        lb_port = s.getsockname()[1]
+    lb = lb_lib.LoadBalancer(lb_port, 'round_robin',
+                             get_ready_urls=lambda: list(urls))
+    lb.start()
+    try:
+        for _ in range(3):
+            r = requests.get(f'http://127.0.0.1:{lb_port}/x', timeout=10)
+            assert r.status_code == 503, r.status_code
+    finally:
+        lb.stop()
+        for srv, _ in backends:
+            srv.shutdown()

@@ -1,0 +1,224 @@
+"""Chaos harness + engine supervisor units (tier-1, CPU).
+
+The chaos module's firing semantics (counted / probabilistic / bare
+specs, re-arming, malformed-spec safety) and the engine supervisor's
+crash → fail-fast → rebuild → restart path, driven directly without an
+HTTP server (tests/test_chaos.py is the serving-plane e2e).
+"""
+import threading
+import time
+
+import jax
+import pytest
+
+from skypilot_tpu.models import decode
+from skypilot_tpu.models import engine as engine_lib
+from skypilot_tpu.models import llama
+from skypilot_tpu.observability import journal
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.utils import chaos
+
+pytestmark = pytest.mark.engine
+
+CFG = llama.CONFIGS['debug']
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos(monkeypatch):
+    monkeypatch.delenv(chaos.CHAOS_ENV, raising=False)
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+# ------------------------------------------------------------ chaos spec
+
+
+def test_chaos_disarmed_by_default():
+    assert not chaos.armed('engine_step_raise')
+    assert not chaos.should_fire('engine_step_raise')
+    chaos.maybe_raise('engine_step_raise')  # no-op
+    chaos.maybe_slow_step()  # no-op
+
+
+def test_counted_point_fires_exactly_n_times(monkeypatch):
+    monkeypatch.setenv(chaos.CHAOS_ENV, 'engine_step_raise:2')
+    assert chaos.should_fire('engine_step_raise')
+    assert chaos.should_fire('engine_step_raise')
+    assert not chaos.should_fire('engine_step_raise')
+    assert chaos.armed('engine_step_raise')  # still in the env spec
+    with pytest.raises(chaos.ChaosError):
+        monkeypatch.setenv(chaos.CHAOS_ENV, 'engine_step_raise:3')
+        chaos.maybe_raise('engine_step_raise')  # new arg → re-armed
+
+
+def test_probabilistic_and_bare_points(monkeypatch):
+    monkeypatch.setenv(chaos.CHAOS_ENV, 'replica_500:1.0,drain_hang')
+    assert all(chaos.should_fire('replica_500') for _ in range(20))
+    assert all(chaos.should_fire('drain_hang') for _ in range(3))
+    monkeypatch.setenv(chaos.CHAOS_ENV, 'replica_500:0.0')
+    assert not any(chaos.should_fire('replica_500') for _ in range(20))
+    assert not chaos.armed('drain_hang')
+
+
+def test_malformed_spec_is_ignored(monkeypatch):
+    monkeypatch.setenv(chaos.CHAOS_ENV, ' , :5, bogus:xyz ,slow_step:nan')
+    assert not chaos.should_fire('bogus')
+    assert not chaos.should_fire('slow_step')
+    chaos.maybe_slow_step()  # must not raise
+
+
+def test_slow_step_chaos_delays_engine_step(monkeypatch):
+    monkeypatch.setenv(chaos.CHAOS_ENV, 'slow_step:1.0')
+    monkeypatch.setenv(chaos.SLOW_STEP_SECONDS_ENV, '0.08')
+    eng = _engine()
+    eng.submit(engine_lib.Request([1, 2, 3], 2))
+    t0 = time.perf_counter()
+    eng.step()
+    assert time.perf_counter() - t0 >= 0.08
+
+
+# ------------------------------------------------------------ supervisor
+
+
+def _engine(**kwargs):
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    kwargs.setdefault('num_slots', 2)
+    kwargs.setdefault('prefill_buckets', (16,))
+    kwargs.setdefault('name', 'chaos-unit')
+    return engine_lib.DecodeEngine(params, CFG,
+                                   decode.DecodeConfig(max_len=64),
+                                   **kwargs)
+
+
+def _restarts_total():
+    c = metrics_lib.get_registry().get('skytpu_engine_restarts_total')
+    return c.value() if c is not None else 0
+
+
+def test_supervisor_restarts_and_queued_requests_survive(monkeypatch):
+    """A step() crash fails the in-flight request fast (error finish,
+    not a timeout), journals engine.crash with the traceback, rebuilds
+    state, and the QUEUED request is admitted after the restart and
+    completes normally."""
+    monkeypatch.setenv('SKYTPU_ENGINE_IDLE_SLEEP_SECONDS', '0.002')
+    eng = _engine(num_slots=1)
+    in_flight = engine_lib.Request([3, 1, 4], 8)
+    queued = engine_lib.Request([2, 7], 4)
+    eng.submit(in_flight)
+    eng.step()  # admits in_flight; starts decoding
+    assert eng.active_slots() == 1
+    eng.submit(queued)  # no free slot: stays queued
+    restarts_before = _restarts_total()
+    monkeypatch.setenv(chaos.CHAOS_ENV, 'engine_step_raise:1')
+    stop = threading.Event()
+    t = threading.Thread(target=eng.run_forever, args=(stop,),
+                         daemon=True)
+    t.start()
+    try:
+        # The supervised loop crashes on its first step (chaos fires
+        # before admission): the in-flight request errors instantly...
+        assert in_flight.wait(30)
+        assert in_flight.finish_reason.startswith('error: engine crashed')
+        # ...and the queued one survives the restart and finishes.
+        assert queued.wait(30)
+        assert queued.finish_reason in ('length', 'eos')
+        assert len(queued.tokens) >= 1
+    finally:
+        stop.set()
+        t.join(10)
+    assert not t.is_alive()
+    assert eng.restart_count() == 1
+    assert not eng.failed
+    assert _restarts_total() == restarts_before + 1
+    assert eng.stats()['restarts'] == 1
+
+    crashes = journal.query(kinds=[journal.EventKind.ENGINE_CRASH])
+    assert crashes, 'engine.crash not journaled'
+    payload = crashes[0]['payload']
+    assert 'ChaosError' in payload['traceback']
+    assert payload['permanent'] is False
+    assert journal.query(kinds=[journal.EventKind.ENGINE_RESTART])
+
+
+def test_restart_budget_exhausted_fails_permanently(monkeypatch):
+    """Crashes past SKYTPU_ENGINE_MAX_RESTARTS within the rolling window
+    flip the engine permanently failed: the loop exits on its own,
+    queued requests are rejected (not stranded), and `failed` sticks."""
+    monkeypatch.setenv('SKYTPU_ENGINE_MAX_RESTARTS', '1')
+    monkeypatch.setenv('SKYTPU_ENGINE_IDLE_SLEEP_SECONDS', '0.002')
+    monkeypatch.setenv(chaos.CHAOS_ENV, 'engine_step_raise:5')
+    eng = _engine(num_slots=1)
+    req = engine_lib.Request([5, 6, 7], 4)
+    eng.submit(req)
+    stop = threading.Event()
+    t = threading.Thread(target=eng.run_forever, args=(stop,),
+                         daemon=True)
+    t.start()
+    # The loop exits by itself: crash 1 restarts, crash 2 is permanent.
+    t.join(30)
+    assert not t.is_alive(), 'supervised loop did not give up'
+    assert eng.failed
+    assert 'crashes within' in eng.fail_reason
+    assert eng.restart_count() == 1
+    # The queued request was answered, not stranded until a timeout —
+    # and as a server-side error (→ HTTP 500), not a client rejection.
+    assert req.done
+    assert req.finish_reason == 'error: engine failed permanently'
+    crashes = journal.query(kinds=[journal.EventKind.ENGINE_CRASH])
+    assert any(c['payload'].get('permanent') for c in crashes)
+    assert eng.stats()['failed'] is True
+
+
+def test_admission_crash_answers_the_request(monkeypatch):
+    """A crash inside insert() (mid-admission) must finish the popped
+    request before the supervisor takes over — it is neither slotted nor
+    queued, so nothing else would ever answer it."""
+    eng = _engine(num_slots=1)
+    req = engine_lib.Request([1, 2, 3], 4)
+    eng.submit(req)
+    boom = RuntimeError('device fell over')
+    monkeypatch.setattr(eng, 'insert',
+                        lambda *a, **k: (_ for _ in ()).throw(boom))
+    with pytest.raises(RuntimeError):
+        eng.step()
+    assert req.done
+    assert 'admission crashed' in req.finish_reason
+
+
+def test_rebuild_resets_paged_pool_and_prefix_cache(monkeypatch):
+    """After a crash restart in paged mode the pool is fresh: no leaked
+    refs from the crashed generation, radix cache dropped, and new
+    admissions decode correctly against the rebuilt pool."""
+    monkeypatch.setenv('SKYTPU_ENGINE_IDLE_SLEEP_SECONDS', '0.002')
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    dcfg = decode.DecodeConfig(max_len=64, decode_attention='xla',
+                               kernel_block_k=8)
+    eng = engine_lib.DecodeEngine(params, CFG, dcfg, num_slots=2,
+                                  prefill_buckets=(16,), paged=True,
+                                  name='chaos-paged')
+    r1 = engine_lib.Request([9] * 10, 8)
+    eng.submit(r1)
+    eng.step()
+    assert eng.stats()['blocks_used'] > 0
+    monkeypatch.setenv(chaos.CHAOS_ENV, 'engine_step_raise:1')
+    stop = threading.Event()
+    t = threading.Thread(target=eng.run_forever, args=(stop,),
+                         daemon=True)
+    t.start()
+    try:
+        assert r1.wait(30)
+        assert r1.finish_reason.startswith('error')
+        # Fresh pool serves a new request end to end.
+        r2 = engine_lib.Request([9] * 10, 4)
+        eng.submit(r2)
+        assert r2.wait(30)
+        assert r2.finish_reason in ('length', 'eos')
+    finally:
+        stop.set()
+        t.join(10)
+    stats = eng.stats()
+    assert stats['restarts'] == 1
+    # Only r2's blocks were ever allocated from the rebuilt pool; after
+    # its eviction the prefix cache holds its published prompt block.
+    assert stats['blocks_used'] == stats['prefix_cache_blocks']
