@@ -69,6 +69,10 @@ _PHASE_DEADLINES = {
     # CPU failover tier (engine-scheduler phase; ROADMAP item 5).
     'sched_compile': 240,
     'sched_run': 150,
+    # Speculative-decoding workload (rides the CPU failover tier too,
+    # so every perf round reports an acceptance ratio).
+    'spec_compile': 240,
+    'spec_run': 150,
 }
 
 
@@ -241,15 +245,33 @@ def _payload() -> None:
 
 def _payload_sched() -> None:
     """CPU failover payload: the device-agnostic engine-scheduler bench
-    (continuous-batching + paged/prefix scheduling on the debug model).
-    Spawned by the supervisor with JAX_PLATFORMS=cpu when the TPU path
-    produced nothing, so a perf round NEVER goes dark — the emitted
-    line carries a ``platform`` tag to keep trends attributable."""
+    (continuous-batching + paged/prefix scheduling on the debug model)
+    plus the speculative-decoding workload. Spawned by the supervisor
+    with JAX_PLATFORMS=cpu when the TPU path produced nothing, so a
+    perf round NEVER goes dark — the emitted line carries a
+    ``platform`` tag to keep trends attributable, and every round
+    reports the spec path's acceptance ratio and per-token speedup.
+    Lines are cumulative (sched-only first): the supervisor takes the
+    last stdout line, so a kill mid-spec still lands the sched
+    result."""
     from skypilot_tpu.benchmark import harness
 
     harness.beat('start')
     from skypilot_tpu.benchmark import decode_bench
     out = decode_bench.run_scheduler_bench(beat=harness.beat)
+    print(json.dumps(out), flush=True)
+    spec = decode_bench.run_spec_bench(beat=harness.beat)
+    out['detail']['spec'] = {
+        'value': spec['value'],
+        'unit': spec['unit'],
+        'platform': spec['platform'],
+        **{k: spec['detail'][k] for k in (
+            'spec_k', 'drafter_layers', 'prefill_chunk',
+            'drafted_tokens', 'accepted_tokens', 'accept_ratio',
+            'prefill_chunks', 'chunked_admissions',
+            'base_per_token_ms', 'spec_per_token_ms',
+            'per_token_speedup')},
+    }
     print(json.dumps(out), flush=True)
 
 

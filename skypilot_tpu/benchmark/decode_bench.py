@@ -517,7 +517,132 @@ def run_prefix_bench(model_name: str, num_slots: int = 8,
     }
 
 
-def run_scheduler_bench(steps: int = 2, beat=None, seed: int = 0) -> dict:
+def run_spec_bench(model_name: str = 'debug', num_slots: int = 4,
+                   n_requests: int = 0, spec_k: int = 0,
+                   drafter_layers: int = 0, prefill_chunk: int = 0,
+                   kv_int8: bool = False, attn: str = 'kernel',
+                   steps: int = 2, beat=None, seed: int = 0) -> dict:
+    """Speculative decoding + chunked prefill vs the plain paged engine
+    on short greedy decodes — the workload speculation exists for.
+
+    Both sides serve the SAME request list through the paged engine;
+    only ``spec_k``/``prefill_chunk`` differ, so the reported per-token
+    latency delta is the speculative path's doing. Reports what the
+    acceptance economics actually are on this model/platform: drafted
+    and accepted token counts, acceptance ratio, per-token latency both
+    sides and the speedup — greedy output is token-identical by
+    construction (tier-1 pins it), so the numbers compare equal work.
+    Device-agnostic like ``sched``: the emitted line carries a
+    ``platform`` tag and runs in bench.py's CPU failover tier, so every
+    perf round reports an acceptance ratio even when TPUs are dark.
+    """
+    from skypilot_tpu.models import decode, llama
+    from skypilot_tpu.models import engine as engine_lib
+
+    beat, devices = _init(beat)
+    platform = devices[0].platform
+    on_accelerator = platform != 'cpu'
+    if on_accelerator:
+        prompt_lens = (48, 96, 128)
+        new_tokens = (24, 32, 48)      # short decodes: the spec target
+        max_len, block_k = 512, 128
+        spec_k = spec_k or 4
+        prefill_chunk = prefill_chunk or 256
+        n_requests = n_requests or 4 * num_slots
+    else:
+        model_name, num_slots = 'debug', 4
+        prompt_lens = (6, 10, 14, 40)
+        new_tokens = (8, 12, 16)
+        max_len, block_k = 64, 8
+        spec_k = spec_k or 3
+        prefill_chunk = prefill_chunk or 16
+        n_requests = min(n_requests or 16, 16)
+        steps = min(steps, 2)
+    drafter_layers = drafter_layers or max(
+        1, llama.CONFIGS[model_name].n_layers // 2)
+
+    cfg = dataclasses.replace(llama.CONFIGS[model_name], remat=False)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    requests = _mixed_requests(cfg.vocab_size, num_slots, n_requests,
+                               prompt_lens, new_tokens, seed=seed)
+    num_blocks = num_slots * (max_len // block_k) + 1
+
+    def run(spec_on):
+        dcfg = decode.DecodeConfig(
+            max_len=max_len, temperature=0.0, decode_attention=attn,
+            kernel_block_k=block_k,
+            kv_cache_dtype='int8' if kv_int8 else 'bf16',
+            spec_k=spec_k if spec_on else 0,
+            spec_drafter_layers=drafter_layers)
+        eng = engine_lib.DecodeEngine(
+            params, cfg, dcfg, num_slots, step_chunk=1,
+            name='spec-bench', paged=True, num_blocks=num_blocks,
+            prefill_chunk=prefill_chunk if spec_on else 0)
+        useful, _, n_steps = _drive_engine(eng, engine_lib, requests)
+        return useful, n_steps, eng.stats(), eng.spec_stats()
+
+    def timed(fn, n):
+        fn()  # warmup/compile
+        beat('spec_run')
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn()
+        return (time.perf_counter() - t0) / n, out
+
+    beat('spec_compile')
+    with _journal_disabled():
+        base_dt, (base_useful, base_steps, _, _) = timed(
+            lambda: run(False), steps)
+        spec_dt, (spec_useful, spec_steps, sstats, sspec) = timed(
+            lambda: run(True), steps)
+    assert spec_useful == base_useful, (spec_useful, base_useful)
+    base_per_tok = base_dt / max(base_useful, 1)
+    spec_per_tok = spec_dt / max(spec_useful, 1)
+    return {
+        'metric': 'llama_decode_spec_tokens_per_sec',
+        'value': round(spec_useful / max(spec_dt, 1e-9), 1),
+        'unit': 'tokens/s/chip',
+        'platform': platform,
+        'detail': {
+            'workload': 'spec',
+            'model': model_name,
+            'num_slots': num_slots,
+            'n_requests': len(requests),
+            'spec_k': spec_k,
+            'drafter_layers': drafter_layers,
+            'prefill_chunk': prefill_chunk,
+            'block_k': block_k,
+            'kv_cache_dtype': 'int8' if kv_int8 else 'bf16',
+            'useful_tokens': spec_useful,
+            # Acceptance economics: what the drafter actually earned.
+            'drafted_tokens': sspec['drafted_total'],
+            'accepted_tokens': sspec['accepted_total'],
+            'accept_ratio': sspec['accept_ratio'],
+            'prefill_chunks': sspec['prefill_chunks_total'],
+            'chunked_admissions': sspec['chunked_admissions'],
+            # Scheduler-level gain: tokens per engine step (a spec step
+            # emits the accepted run + 1, a baseline step emits <= 1
+            # per lane).
+            'spec_engine_steps': spec_steps,
+            'base_engine_steps': base_steps,
+            'tokens_per_step': round(
+                sstats['decode_tokens'] / max(sstats['decode_steps'], 1),
+                4),
+            # Wall-clock per-token latency, both sides, and the
+            # headline speedup.
+            'base_per_token_ms': round(base_per_tok * 1e3, 3),
+            'spec_per_token_ms': round(spec_per_tok * 1e3, 3),
+            'per_token_speedup': round(
+                base_per_tok / max(spec_per_tok, 1e-12), 3),
+            'steps': steps,
+            'device': str(devices[0]),
+        },
+    }
+
+
+def run_scheduler_bench(steps: int = 2, beat=None, seed: int = 0,
+                        spec_k: int = 0, prefill_chunk: int = 0,
+                        drafter_layers: int = 1) -> dict:
     """Device-agnostic engine-SCHEDULER phase: the CPU failover tier.
 
     Runs the continuous-batching scheduler (dense and paged+prefix) on a
@@ -528,7 +653,9 @@ def run_scheduler_bench(steps: int = 2, beat=None, seed: int = 0) -> dict:
     the same heartbeat/JSON schema as the TPU phases with a
     ``platform`` tag so perf trends never go dark when PJRT is
     unreachable (ROADMAP item 5). The tier-1 perf-regression gate
-    replays the same trace against a checked-in envelope.
+    replays the same trace against a checked-in envelope — and replays
+    it AGAIN with ``spec_k``/``prefill_chunk`` set, so the speculative
+    + chunked machinery must hold the same tokens/step envelope.
     """
     from skypilot_tpu.models import decode, llama
     from skypilot_tpu.models import engine as engine_lib
@@ -541,6 +668,10 @@ def run_scheduler_bench(steps: int = 2, beat=None, seed: int = 0) -> dict:
     dcfg = decode.DecodeConfig(max_len=max_len, temperature=0.0,
                                decode_attention='xla',
                                kernel_block_k=block_k)
+    # Spec rides only the paged side (dense stays the spec-off control).
+    dcfg_paged = dataclasses.replace(
+        dcfg, spec_k=spec_k,
+        spec_drafter_layers=drafter_layers) if spec_k else dcfg
     requests = _prefix_requests(cfg.vocab_size, n_requests=24,
                                 prefix_len=24, suffix_lens=(3, 5, 8),
                                 new_token_mix=(4, 8),
@@ -551,14 +682,20 @@ def run_scheduler_bench(steps: int = 2, beat=None, seed: int = 0) -> dict:
     with _journal_disabled():
         def run(paged):
             eng = engine_lib.DecodeEngine(
-                params, cfg, dcfg, 16 if paged else num_slots,
+                params, cfg, dcfg_paged if paged else dcfg,
+                16 if paged else num_slots,
                 step_chunk=4, name='sched-bench',
-                paged=paged, num_blocks=num_blocks if paged else None)
+                paged=paged, num_blocks=num_blocks if paged else None,
+                prefill_chunk=prefill_chunk if paged else 0)
             useful, conc, n_steps = _drive_engine(eng, engine_lib,
                                                   requests)
             st = eng.stats()
             eslo = eng.telemetry.slo()
+            spec_stats = (eng.spec_stats()
+                          if paged and (spec_k or prefill_chunk)
+                          else None)
             return {
+                **({'spec': spec_stats} if spec_stats else {}),
                 'useful_tokens': useful,
                 'admitted_concurrency': conc,
                 'engine_steps': n_steps,
@@ -598,6 +735,8 @@ def run_scheduler_bench(steps: int = 2, beat=None, seed: int = 0) -> dict:
             'model': model_name,
             'block_k': block_k,
             'n_requests': len(requests),
+            'spec_k': spec_k,
+            'prefill_chunk': prefill_chunk,
             'paged': paged,
             'dense': dense,
             'paged_wall_seconds': round(dt, 3),
@@ -612,7 +751,8 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='bench-1b')
     parser.add_argument('--workload',
-                        choices=('static', 'mixed', 'prefix', 'sched'),
+                        choices=('static', 'mixed', 'prefix', 'sched',
+                                 'spec'),
                         default='static',
                         help='static: one fixed-shape generate() batch; '
                              'mixed: continuous engine vs static '
@@ -620,7 +760,10 @@ def main() -> None:
                              'prefix: paged+radix engine vs dense at '
                              'equal HBM on shared-prefix traffic; '
                              'sched: device-agnostic engine-scheduler '
-                             'phase (the CPU failover tier)')
+                             'phase (the CPU failover tier); '
+                             'spec: speculative decoding + chunked '
+                             'prefill vs the plain paged engine on '
+                             'short greedy decodes')
     parser.add_argument('--batch', type=int, default=16)
     parser.add_argument('--prompt-len', type=int, default=128)
     parser.add_argument('--new-tokens', type=int, default=128)
@@ -656,9 +799,26 @@ def main() -> None:
     parser.add_argument('--prefix-share', type=float, default=0.75,
                         help='prefix workload: fraction of requests '
                              'opening with the shared prefix')
+    parser.add_argument('--spec-k', type=int, default=0,
+                        help='spec workload: draft tokens per engine '
+                             'step (default: workload-tier choice)')
+    parser.add_argument('--drafter-layers', type=int, default=0,
+                        help='spec workload: truncated-layer drafter '
+                             'depth (default: half the model)')
+    parser.add_argument('--prefill-chunk', type=int, default=0,
+                        help='spec workload: chunked-prefill threshold '
+                             'in tokens (default: workload-tier choice)')
     args = parser.parse_args()
     if args.workload == 'sched':
         out = run_scheduler_bench(steps=min(args.steps, 3))
+    elif args.workload == 'spec':
+        out = run_spec_bench(args.model, args.num_slots,
+                             n_requests=args.requests,
+                             spec_k=args.spec_k,
+                             drafter_layers=args.drafter_layers,
+                             prefill_chunk=args.prefill_chunk,
+                             kv_int8=args.kv_int8, attn=args.attn,
+                             steps=min(args.steps, 3))
     elif args.workload == 'prefix':
         out = run_prefix_bench(args.model, args.num_slots,
                                n_requests=args.requests,

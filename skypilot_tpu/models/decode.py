@@ -65,6 +65,15 @@ class DecodeConfig:
     # None: auto (compiled on TPU, XLA fallback elsewhere); True forces
     # the Pallas interpreter (CPU numerics tests).
     kernel_interpret: Optional[bool] = None
+    # Speculative decoding (paged engine only, greedy): a truncated-layer
+    # drafter proposes spec_k tokens per engine step and one batched
+    # multi-token verify scores them against the full model. 0 disables.
+    spec_k: int = 0
+    # The drafter is the served model's first N decoder layers plus its
+    # (tied) out-norm + lm_head — no second set of weights, and its
+    # attention reads the SAME pool blocks the full model wrote, so the
+    # only extra state is a spec_k-deep local K/V buffer per draft round.
+    spec_drafter_layers: int = 1
 
 
 def quantize_params(params: Params) -> Params:
@@ -456,6 +465,193 @@ def _paged_prefill_with_prefix(params: Params, tokens: jax.Array,
     pool = _write_kv(pool, jnp.index_exp[:, blk, g % block_k],
                      ks[:, 0], vs[:, 0])
     return logits[0, suffix_len - 1], pool
+
+
+# ------------------------------------------------- speculative decoding
+
+
+def _gather_layer_kv(lpool: Cache, block_tables: jax.Array,
+                     dtype) -> Tuple[jax.Array, jax.Array]:
+    """One layer's pool → per-sequence contiguous K/V views
+    [B, max_blocks*block_k, Hkv, hd] through the tables (int8 pools
+    dequantize here). The drafter's attention history.
+
+    Cost note: this materializes the TABLE-WIDTH view (once per
+    drafter layer per spec round), the same O(B × max_len) traffic
+    every paged XLA-fallback attention call already pays — fine on the
+    CPU tier and for shallow drafters, but on TPU at long max_len the
+    drafter should instead stream live blocks through the paged kernel
+    and merge the spec_k-deep local buffer via an online-softmax
+    combine (needs the kernel to expose its m/l state; ROADMAP
+    follow-up)."""
+    k, v, ks, vs = decode_attention_ops.gather_paged_kv(
+        lpool['k'], lpool['v'], block_tables,
+        lpool.get('k_scale'), lpool.get('v_scale'))
+    if ks is not None:
+        k = k.astype(jnp.float32) * ks[..., None]
+        v = v.astype(jnp.float32) * vs[..., None]
+    return k.astype(dtype), v.astype(dtype)
+
+
+def _draft_attention(q: jax.Array, hist_k: jax.Array, hist_v: jax.Array,
+                     hist_len: jax.Array, buf_k: jax.Array,
+                     buf_v: jax.Array, n_filled: int) -> jax.Array:
+    """Drafter attention: q [B,1,H,hd] against the pool-gathered history
+    (positions < hist_len, per row) PLUS the drafted-this-round local
+    K/V buffer ([B, spec_k, Hkv, hd], entries < ``n_filled`` live —
+    the current draft token's own K/V included). One joint softmax, so
+    no pool writes happen during drafting and a rejected tail needs no
+    rollback at all in the drafter layers."""
+    b, s, h, hd = q.shape
+    t_hist = hist_k.shape[1]
+    hkv = hist_k.shape[2]
+    g = h // hkv
+    k = jnp.concatenate([hist_k, buf_k], axis=1)
+    v = jnp.concatenate([hist_v, buf_v], axis=1)
+    qg = q.reshape(b, s, hkv, g, hd)
+    logits = jnp.einsum('bskgd,btkd->bkgst', qg, k,
+                        preferred_element_type=jnp.float32) * hd**-0.5
+    t_idx = jnp.arange(k.shape[1])
+    # [B, T+K]: history gates on hist_len, buffer entries on fill count
+    # (a static python int — the mask folds to a constant per step).
+    mask = jnp.where(t_idx[None, :] < t_hist,
+                     t_idx[None, :] < hist_len[:, None],
+                     (t_idx[None, :] - t_hist) < n_filled)
+    logits = jnp.where(mask[:, None, None, None, :], logits,
+                       decode_attention_ops.NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    probs = jnp.where(mask[:, None, None, None, :], probs, 0)
+    out = jnp.einsum('bkgst,btkd->bskgd', probs, v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, s, h, hd).astype(q.dtype)
+
+
+def _spec_draft_tokens(params: Params, token: jax.Array, pos: jax.Array,
+                       block_tables: jax.Array, cfg: llama.LlamaConfig,
+                       dcfg: DecodeConfig, pool: Cache) -> jax.Array:
+    """Greedy-draft ``spec_k`` tokens per sequence with the truncated-
+    layer drafter. token [B] (last emitted token, K/V not yet written),
+    pos [B] its position. Returns drafts [B, spec_k]; the pool is READ
+    (history gather per drafter layer) but never written — verify owns
+    all cache writes, which is what makes rejection rollback purely
+    positional."""
+    k_spec = dcfg.spec_k
+    d = dcfg.spec_drafter_layers
+    assert 1 <= d <= cfg.n_layers, (d, cfg.n_layers)
+    b = token.shape[0]
+    hd = cfg.head_dim
+    layers = [jax.tree.map(lambda a, i=i: a[i], params['layers'])
+              for i in range(d)]
+    hist = [_gather_layer_kv(
+        jax.tree.map(lambda a, i=i: a[i], pool), block_tables, cfg.dtype)
+        for i in range(d)]
+    buf_k = [jnp.zeros((b, k_spec, cfg.n_kv_heads, hd), cfg.dtype)
+             for _ in range(d)]
+    buf_v = [jnp.zeros((b, k_spec, cfg.n_kv_heads, hd), cfg.dtype)
+             for _ in range(d)]
+    drafts = []
+    tok = token
+    for j in range(k_spec):
+        positions = pos + j
+        cos, sin = llama._rope_freqs(cfg, positions[:, None])  # pylint: disable=protected-access
+        x = params['tok_embedding'][tok][:, None].astype(cfg.dtype)
+        for li in range(d):
+            layer = layers[li]
+            h = llama.rms_norm(x, layer['attn_norm'], cfg.norm_eps)
+            q = llama.quant_mm(h, layer['wq']).reshape(
+                b, 1, cfg.n_heads, hd)
+            kx = llama.quant_mm(h, layer['wk']).reshape(
+                b, 1, cfg.n_kv_heads, hd)
+            vx = llama.quant_mm(h, layer['wv']).reshape(
+                b, 1, cfg.n_kv_heads, hd)
+            q = llama.apply_rope(q, cos, sin)
+            kx = llama.apply_rope(kx, cos, sin)
+            buf_k[li] = buf_k[li].at[:, j].set(kx[:, 0])
+            buf_v[li] = buf_v[li].at[:, j].set(vx[:, 0])
+            attn = _draft_attention(q, hist[li][0], hist[li][1], pos,
+                                    buf_k[li], buf_v[li], j + 1)
+            attn = attn.reshape(b, 1, cfg.n_heads * hd)
+            x = x + llama.quant_mm(attn, layer['wo']).astype(cfg.dtype)
+            x = llama.ffn_sublayer(cfg, x, layer)
+        x = llama.rms_norm(x, params['out_norm'], cfg.norm_eps)
+        logits = (x[:, 0] @ params['lm_head']).astype(jnp.float32)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        drafts.append(tok)
+    return jnp.stack(drafts, axis=1)
+
+
+def _attend_paged_verify(dcfg: DecodeConfig, q: jax.Array, lpool: Cache,
+                         block_tables: jax.Array,
+                         start_pos: jax.Array) -> jax.Array:
+    """q [B,S,H,hd] against one layer's pool; query ``i`` masks by its
+    own causal length ``start_pos + i + 1``."""
+    k_scale = lpool.get('k_scale')
+    v_scale = lpool.get('v_scale')
+    if dcfg.decode_attention == 'kernel':
+        return decode_attention_ops.paged_verify_attention(
+            q, lpool['k'], lpool['v'], block_tables, start_pos,
+            k_scale=k_scale, v_scale=v_scale,
+            interpret=dcfg.kernel_interpret)
+    assert dcfg.decode_attention == 'xla', dcfg.decode_attention
+    return decode_attention_ops.paged_verify_attention_xla(
+        q, lpool['k'], lpool['v'], block_tables, start_pos,
+        k_scale=k_scale, v_scale=v_scale)
+
+
+def _paged_verify_step(params: Params, tokens: jax.Array, pos: jax.Array,
+                       block_tables: jax.Array, cfg: llama.LlamaConfig,
+                       dcfg: DecodeConfig, pool: Cache
+                       ) -> Tuple[jax.Array, Cache]:
+    """Multi-token full-model step: score tokens [B, S] (the last
+    emitted token followed by S-1 drafts) at positions pos..pos+S-1 →
+    (logits [B, S, vocab], pool).
+
+    K/V for ALL S positions scatter through the tables before attention
+    (exactly the write-then-attend order of the single-token step), so
+    the accepted prefix's cache entries are already correct when the
+    host commits it — rejected tails are "rolled back" by simply not
+    advancing ``pos`` past them: attention masks by position, and the
+    stale entries are overwritten when a real token reaches that
+    position. Positions at/past the table's capacity (a near-budget
+    lane drafted past its reservation) route their writes to the
+    engine's scratch block 0 instead of wrapping into a live block.
+    """
+    b, s = tokens.shape
+    block_k = pool['k'].shape[2]
+    max_len = block_tables.shape[1] * block_k
+    positions = pos[:, None] + jnp.arange(s, dtype=jnp.int32)  # [B, S]
+    cos, sin = llama._rope_freqs(cfg, positions)  # pylint: disable=protected-access
+    x = params['tok_embedding'][tokens].astype(cfg.dtype)
+    safe = positions < max_len
+    pidx = jnp.minimum(positions, max_len - 1)
+    blk_all = jnp.take_along_axis(block_tables, pidx // block_k, axis=1)
+    # Scratch-route overflow writes (block 0 is engine-reserved).
+    blk_all = jnp.where(safe, blk_all, 0)
+    off = pidx % block_k
+
+    def body(carry, layer_lpool):
+        layer, lpool = layer_lpool
+        bl, sl, _ = carry.shape
+        hd = cfg.head_dim
+        h = llama.rms_norm(carry, layer['attn_norm'], cfg.norm_eps)
+        q = llama.quant_mm(h, layer['wq']).reshape(bl, sl, cfg.n_heads,
+                                                   hd)
+        k = llama.quant_mm(h, layer['wk']).reshape(bl, sl,
+                                                   cfg.n_kv_heads, hd)
+        v = llama.quant_mm(h, layer['wv']).reshape(bl, sl,
+                                                   cfg.n_kv_heads, hd)
+        q = llama.apply_rope(q, cos, sin)
+        k = llama.apply_rope(k, cos, sin)
+        lpool = _write_kv(lpool, (blk_all, off), k, v)
+        attn = _attend_paged_verify(dcfg, q, lpool, block_tables, pos)
+        attn = attn.reshape(bl, sl, cfg.n_heads * hd)
+        xc = carry + llama.quant_mm(attn, layer['wo']).astype(cfg.dtype)
+        return llama.ffn_sublayer(cfg, xc, layer), lpool
+
+    x, pool = jax.lax.scan(body, x, (params['layers'], pool))
+    x = llama.rms_norm(x, params['out_norm'], cfg.norm_eps)
+    logits = (x @ params['lm_head']).astype(jnp.float32)  # [B, S, V]
+    return logits, pool
 
 
 def _copy_block(pool: Cache, src: jax.Array, dst: jax.Array) -> Cache:

@@ -61,6 +61,37 @@ per-slot int32 block tables, so HBM scales with live tokens instead of
   reservation cannot be met even after eviction, the request stays
   queued.
 
+**Speculative decoding** (``dcfg.spec_k > 0``, paged + greedy): a
+truncated-layer drafter (the served model's first
+``spec_drafter_layers`` blocks + tied out-norm/lm_head — no second
+weight set) proposes ``spec_k`` tokens per lane per step, and ONE
+batched multi-token verify scores every lane's drafts against the full
+model over the existing block tables (``decode._paged_verify_step`` —
+a q-length ``spec_k+1`` decode through the same pool). Accept/rollback
+is host-side and purely positional: the accepted run's K/V were
+already written correctly by verify, and a rejected tail is "rolled
+back" by not advancing ``pos`` past it (the stale entries sit in
+lane-private blocks, are never attended — attention masks by
+position — and are overwritten when a real token reaches that
+position). No block churn, no device rollback. Greedy output is
+token-identical to the non-speculative paged path (every emitted token
+is the full model's argmax in the true context), pinned in tier-1.
+
+**Chunked prefill** (``prefill_chunk > 0``, paged): an admission whose
+un-cached suffix exceeds ``SKYTPU_PREFILL_CHUNK`` tokens no longer
+runs one monolithic prefill that stalls every decode lane for its full
+length — the blocks are reserved up front, the slot parks in a
+"prefilling" state (its table rows stay scratch-pointed so frozen-lane
+writes cannot touch half-filled blocks), and each engine step advances
+it by ONE bounded chunk (``decode.paged_prefill_with_prefix`` over the
+already-written prefix) before the decode dispatch runs. The bound is
+per prompt: concurrent chunked admissions each advance one chunk per
+step, so a burst of long prompts does not serialize admission. The last
+chunk's logits produce the first token; only then does the real table
+install and the lane join decoding. The PR 9 ``engine.stall`` detector
+tags each stalled step with its prefill/decode composition, so a
+chunk-induced stall is distinguishable from a true wedge.
+
 Admission is **per-tenant fair**: the queue is one FIFO per tenant
 drained round-robin, so one tenant's burst cannot monopolize slots or
 pool blocks. Over-budget requests are clamped (budget) or rejected
@@ -109,6 +140,10 @@ MAX_RESTARTS_ENV = 'SKYTPU_ENGINE_MAX_RESTARTS'
 DEFAULT_MAX_RESTARTS = 3
 RESTART_WINDOW_ENV = 'SKYTPU_ENGINE_RESTART_WINDOW_SECONDS'
 DEFAULT_RESTART_WINDOW_SECONDS = 300.0
+# Chunked prefill: paged admissions whose un-cached suffix exceeds this
+# many tokens split into one-chunk-per-step prefills interleaved with
+# decode steps (0 disables — the pre-chunking monolithic prefill).
+PREFILL_CHUNK_ENV = 'SKYTPU_PREFILL_CHUNK'
 
 # The pool's block 0 is engine-owned scratch: freed slots' table rows
 # point at it so frozen lanes write harmlessly, and bucket-padding
@@ -528,6 +563,30 @@ def _engine_paged_steps_impl(params, token, pos, done, remaining, keys,
                               remaining, keys, cache)
 
 
+@functools.partial(jax.jit, static_argnames=('cfg', 'dcfg'),
+                   donate_argnums=(4,))
+def _engine_spec_step_impl(params, token, pos, block_tables, cache,
+                           cfg: llama.LlamaConfig,
+                           dcfg: decode.DecodeConfig):
+    """One speculative round over every slot in ONE dispatch: draft
+    ``spec_k`` tokens per lane with the truncated-layer drafter (pool
+    read-only), then one batched multi-token verify of
+    ``[token, drafts]`` against the full model through the block
+    tables. Returns (drafts [num_slots, spec_k], verify argmax
+    [num_slots, spec_k+1], cache). Acceptance is host-side
+    (:meth:`DecodeEngine._spec_round`): the device never needs to know
+    how much of the draft survived — rejected positions are simply
+    never advanced past, and their cache entries are overwritten when
+    a real token reaches them."""
+    drafts = decode._spec_draft_tokens(  # pylint: disable=protected-access
+        params, token, pos, block_tables, cfg, dcfg, cache)
+    seq = jnp.concatenate([token[:, None], drafts], axis=1)
+    logits, cache = decode._paged_verify_step(  # pylint: disable=protected-access
+        params, seq, pos, block_tables, cfg, dcfg, cache)
+    vtok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return drafts, vtok, cache
+
+
 @functools.partial(jax.jit, static_argnames=('cfg',), donate_argnums=(4,))
 def _prefill_greedy_impl(params, tokens, prompt_len, slot, cache,
                          cfg: llama.LlamaConfig):
@@ -567,11 +626,27 @@ class DecodeEngine:
                  rng: Optional[jax.Array] = None,
                  name: str = 'engine',
                  paged: bool = False,
-                 num_blocks: Optional[int] = None):
+                 num_blocks: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None):
         if num_slots < 1:
             raise ValueError(f'num_slots must be >= 1, got {num_slots}')
         if step_chunk < 1:
             raise ValueError(f'step_chunk must be >= 1, got {step_chunk}')
+        if dcfg.spec_k:
+            # Speculative decoding rides the paged pool (verify is a
+            # multi-token decode over the block tables) and commits the
+            # full model's argmax — greedy by construction.
+            if not paged:
+                raise ValueError('speculative decoding (spec_k > 0) '
+                                 'requires paged=True')
+            if dcfg.temperature != 0.0:
+                raise ValueError(
+                    'speculative decoding is greedy-only; got '
+                    f'temperature={dcfg.temperature}')
+            if not 1 <= dcfg.spec_drafter_layers <= cfg.n_layers:
+                raise ValueError(
+                    f'spec_drafter_layers must be in [1, '
+                    f'{cfg.n_layers}], got {dcfg.spec_drafter_layers}')
         self.params = params
         self.cfg = cfg
         self.dcfg = dcfg
@@ -603,8 +678,23 @@ class DecodeEngine:
                                else num_slots * self._max_blocks + 1)
         else:
             self.num_blocks = 0
+        # Chunked prefill is a paged-admission policy (the chunk calls
+        # hand their block rows to decode.paged_prefill_with_prefix
+        # explicitly); dense mode ignores it.
+        if prefill_chunk is None:
+            prefill_chunk = common_utils.env_int(PREFILL_CHUNK_ENV, 0)
+        self.prefill_chunk = max(0, int(prefill_chunk)) if paged else 0
         self._prompt_tokens_total = 0
         self._prompt_tokens_saved = 0
+        # Speculative-decoding counters (cumulative; survive restarts).
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._prefill_chunks = 0
+        self._chunked_admissions = 0
+        # engine.compile dedupe: jitted-dispatch shapes already noted
+        # (the jit cache is process-global, so restarts do NOT reset
+        # this — a rebuild does not retrace old shapes).
+        self._traced_shapes: set = set()
         self._init_runtime_state()
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         # Greedy decoding ignores sampling keys; reuse one zero buffer
@@ -679,6 +769,10 @@ class DecodeEngine:
             self._cache = decode.init_kv_cache(self.cfg, num_slots,
                                                self.dcfg.max_len,
                                                self.dcfg.kv_cache_dtype)
+        # Chunked-prefill resume state: slot → {'req', 'table', 'p',
+        # 'm', 'next'} while an admission is mid-prefill (its device
+        # table rows stay scratch-pointed until the last chunk).
+        self._prefill_state: List[Optional[dict]] = [None] * num_slots
         # Host mirrors of per-slot device state.
         self._slots: List[Optional[Request]] = [None] * num_slots
         self._token = np.zeros((num_slots,), np.int32)
@@ -791,9 +885,35 @@ class DecodeEngine:
         admit_ts = time.perf_counter()
         if self.paged:
             first, shared_tokens = self._prefill_paged(slot, request)
+            if first is None:
+                # Chunked admission: blocks are reserved and the resume
+                # state parked; step() runs one chunk per tick and the
+                # LAST chunk's logits deliver the first token (TTFT is
+                # observed there). The lane stays done=True with
+                # scratch-pointed table rows until then.
+                self._admitted += 1
+                self._chunked_admissions += 1
+                self._m.counter('skytpu_engine_admitted_total',
+                                'Requests admitted into a slot.').inc()
+                self.telemetry.on_admit(
+                    request, slot, admit_ts=admit_ts,
+                    prefix_hit_tokens=shared_tokens,
+                    blocks_reserved=len(self._slot_refs[slot]))
+                self._journal(journal.EventKind.ENGINE_ADMIT, request,
+                              slot, prompt_len=p,
+                              prefix_hit_tokens=shared_tokens,
+                              max_new_tokens=request.max_new_tokens,
+                              chunked=True,
+                              prefill_chunk=self.prefill_chunk)
+                self._slots[slot] = request
+                self._done[slot] = True
+                self._remaining[slot] = 0
+                self._publish_slot_gauges()
+                return slot
         else:
             shared_tokens = 0
             bucket = self._bucket_for(p)
+            self._note_compile('prefill', bucket=bucket)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :p] = request.prompt
             if self.dcfg.temperature == 0.0:
@@ -806,16 +926,9 @@ class DecodeEngine:
                     self.params, jnp.asarray(padded), jnp.int32(p),
                     jnp.int32(slot), self.cfg, self._cache)
                 first = int(self._sample_first(last))
-        self._m.histogram(
-            'skytpu_engine_ttft_seconds',
-            'Time from enqueue to first token (includes queueing).',
-            buckets=runtime_metrics.TTFT_BUCKETS).observe(
-                time.perf_counter() - request.enqueue_ts)
         self._admitted += 1
         self._m.counter('skytpu_engine_admitted_total',
                         'Requests admitted into a slot.').inc()
-        self._m.counter('skytpu_engine_tokens_total',
-                        'Tokens generated by the engine.').inc()
         self.telemetry.on_admit(
             request, slot, admit_ts=admit_ts,
             prefix_hit_tokens=shared_tokens,
@@ -824,29 +937,45 @@ class DecodeEngine:
         self._journal(journal.EventKind.ENGINE_ADMIT, request, slot,
                       prompt_len=p, prefix_hit_tokens=shared_tokens,
                       max_new_tokens=request.max_new_tokens)
+        self._deliver_first(slot, request, first)
+        return slot
+
+    def _deliver_first(self, slot: int, request: Request,
+                       first: int) -> None:
+        """First-token delivery + decode-lane init, shared by direct
+        admission and the chunked-prefill finish: TTFT observation, the
+        one-token/immediate-EOS fast path (never occupies a decode
+        lane), and the per-slot mirror setup."""
+        self._m.histogram(
+            'skytpu_engine_ttft_seconds',
+            'Time from enqueue to first token (includes queueing).',
+            buckets=runtime_metrics.TTFT_BUCKETS).observe(
+                time.perf_counter() - request.enqueue_ts)
+        self._m.counter('skytpu_engine_tokens_total',
+                        'Tokens generated by the engine.').inc()
         hit_eos = (self.dcfg.eos_id is not None and
                    first == self.dcfg.eos_id)
         first_done = hit_eos or request.max_new_tokens == 1
         request._deliver(first, done=first_done)  # pylint: disable=protected-access
         self._slots[slot] = request
         if first_done:
-            # One-token request (or immediate EOS): never occupies a
-            # decode lane.
             self._evict(slot, 'eos' if hit_eos else 'length')
-            return slot
+            return
         self._token[slot] = first
-        self._pos[slot] = p
+        self._pos[slot] = len(request.prompt)
         self._done[slot] = False
         self._remaining[slot] = request.max_new_tokens - 1
         self._publish_slot_gauges()
-        return slot
 
     def _prefill_paged(self, slot: int, request: Request
-                       ) -> Tuple[int, int]:
+                       ) -> Tuple[Optional[int], int]:
         """Paged admission: radix-match the prompt, reserve blocks,
         copy-on-write the boundary block of a full hit, prefill only
         the un-cached suffix, then publish the prompt's full blocks to
-        the prefix cache. Returns (first token, shared prefix tokens).
+        the prefix cache. Returns (first token, shared prefix tokens) —
+        or (None, shared) when the suffix exceeds ``prefill_chunk``:
+        the reservation is made, the resume state parked, and
+        :meth:`_advance_prefill` runs one chunk per step.
 
         Raises PoolExhausted with NO state mutated when the
         reservation cannot be met (caller requeues the request)."""
@@ -889,12 +1018,37 @@ class DecodeEngine:
             self._radix.release(path)
             raise
         table = blocks[:first_owned] + owned
-        try:
-            if needs_copy:
+        if needs_copy:
+            # The boundary COW happens before the chunked/single-shot
+            # split — ONE copy of the copy-or-rollback contract.
+            try:
                 self._cache = decode.copy_block(
                     self._cache, jnp.int32(cow_src), jnp.int32(cow_dst))
+            except Exception:
+                self._allocator.decref(blocks + owned)
+                self._radix.release(path)
+                raise
+        if self.prefill_chunk and (p - m) > self.prefill_chunk:
+            # Chunked admission: the reservation (and the boundary COW)
+            # happen now — cheap and atomic wrt the pool — but the
+            # suffix forward runs one chunk per engine step. The slot's
+            # device table rows stay scratch-pointed until the last
+            # chunk, so frozen-lane writes from the decode/verify
+            # dispatch cannot land in a half-prefilled block (the chunk
+            # calls take their block rows explicitly). The radix
+            # publish also waits: a prefix is only shareable once its
+            # blocks hold real K/V.
+            self._slot_refs[slot] = blocks + owned
+            self._slot_nodes[slot] = path
+            self._prefill_state[slot] = {
+                'req': request, 'table': table, 'p': p, 'm': m,
+                'next': m}
+            self._publish_block_gauges()
+            return None, m
+        try:
             if m == 0:
                 bucket = self._bucket_for(p)
+                self._note_compile('paged_prefill', bucket=bucket)
                 padded = np.zeros((1, bucket), np.int32)
                 padded[0, :p] = request.prompt
                 row = np.full((bucket // bk,), SCRATCH_BLOCK, np.int32)
@@ -915,6 +1069,8 @@ class DecodeEngine:
                 npb_bucket = 1
                 while npb_bucket < npb:
                     npb_bucket *= 2
+                self._note_compile('paged_prefill_with_prefix',
+                                   bucket=bucket, npb_bucket=npb_bucket)
                 pref = np.full((npb_bucket,), SCRATCH_BLOCK, np.int32)
                 pref[:npb] = table[:npb]
                 # Suffix writes start inside block m // bk at offset
@@ -1064,6 +1220,110 @@ class DecodeEngine:
             self._journal(journal.EventKind.ENGINE_SLOW_REQUEST, req, -1,
                           **slow)
 
+    # -------------------------------------------------- chunked prefill
+
+    def _advance_prefill(self) -> int:
+        """Advance every prefilling slot by ONE chunk; returns the
+        total prefill tokens processed — the fairness half of chunked
+        prefill: each long admission does a bounded ``prefill_chunk``
+        of work per engine step while every decode lane keeps stepping,
+        so ONE long prompt can no longer freeze TTFT for every other
+        lane. The bound is per prompt, not global: serializing all
+        admissions through a single chunk per step would collapse
+        admission bandwidth exactly when a burst of long prompts fills
+        the slots (lanes parked prefilling deliver nothing)."""
+        if not self.paged:
+            return 0
+        total = 0
+        for slot, st in enumerate(self._prefill_state):
+            if st is not None:
+                total += self._advance_prefill_slot(slot)
+        return total
+
+    def _advance_prefill_slot(self, slot: int) -> int:
+        """Run one chunk of one slot's pending prefill; returns its
+        token count."""
+        st = self._prefill_state[slot]
+        req = st['req']
+        bk = self._block_k
+        p, table = st['p'], st['table']
+        start = st['next']
+        end = min(start + self.prefill_chunk, p)
+        suf = end - start
+        bucket = self._bucket_for(suf)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :suf] = req.prompt[start:end]
+        if start == 0:
+            # First chunk of a cold prompt: plain block-scatter prefill
+            # over the chunk (in-bucket padding spills into blocks later
+            # chunks overwrite; never attended — prefix_len masks it).
+            self._note_compile('paged_prefill', bucket=bucket,
+                               chunk=self.prefill_chunk)
+            row = np.full((bucket // bk,), SCRATCH_BLOCK, np.int32)
+            nrow = min(len(table), len(row))
+            row[:nrow] = table[:nrow]
+            last, self._cache = decode.paged_prefill(
+                self.params, jnp.asarray(padded), jnp.int32(suf),
+                jnp.asarray(row), self.cfg, self._cache)
+        else:
+            # Resume chunk: the already-written positions [0, start)
+            # ARE a prefix in the pool — the radix-hit prefill path
+            # handles arbitrary offsets, so it is reused verbatim.
+            npb = -(-start // bk)
+            npb_bucket = 1
+            while npb_bucket < npb:
+                npb_bucket *= 2
+            self._note_compile('paged_prefill_with_prefix',
+                               bucket=bucket, npb_bucket=npb_bucket,
+                               chunk=self.prefill_chunk)
+            pref = np.full((npb_bucket,), SCRATCH_BLOCK, np.int32)
+            pref[:npb] = table[:npb]
+            srow = start // bk
+            row = np.full((bucket // bk + 1,), SCRATCH_BLOCK, np.int32)
+            avail = table[srow:srow + len(row)]
+            row[:len(avail)] = avail
+            last, self._cache = decode.paged_prefill_with_prefix(
+                self.params, jnp.asarray(padded), jnp.int32(suf),
+                jnp.int32(start), jnp.asarray(pref), jnp.asarray(row),
+                self.cfg, self._cache)
+        st['next'] = end
+        self._prefill_chunks += 1
+        self._m.counter(
+            'skytpu_engine_prefill_chunks_total',
+            'Prefill chunks executed by chunked admissions.').inc()
+        if end >= p:
+            self._finish_prefill(slot, st, last)
+        return suf
+
+    def _finish_prefill(self, slot: int, st: dict, last) -> None:
+        """Last chunk done: publish the prompt to the prefix cache,
+        install the real table (the lane's frozen writes may touch its
+        own blocks from now on — they hold real K/V), deliver the first
+        token, and join the decode lanes."""
+        req = st['req']
+        p, m, table = st['p'], st['m'], st['table']
+        bk = self._block_k
+        if m:
+            self._prompt_tokens_saved += m
+            self._m.counter(
+                'skytpu_engine_prefill_tokens_saved_total',
+                'Prompt tokens NOT prefilled thanks to prefix-'
+                'cache hits.').inc(m)
+        self._prompt_tokens_total += p
+        full = p // bk
+        if full:
+            self._radix.insert(req.prompt[:full * bk], table[:full])
+        self._block_table_np[slot, :] = SCRATCH_BLOCK
+        self._block_table_np[slot, :len(table)] = table
+        self._block_table_dev = None
+        self._prefill_state[slot] = None
+        self._publish_block_gauges()
+        if self.dcfg.temperature == 0.0:
+            first = int(jnp.argmax(last))
+        else:
+            first = int(self._sample_first(last))
+        self._deliver_first(slot, req, first)
+
     # ------------------------------------------------------------- step
 
     def step(self) -> int:
@@ -1079,13 +1339,74 @@ class DecodeEngine:
         active = self.active_slots()
         if active == 0:
             return 0
+        t0 = time.perf_counter()
+        # One bounded prefill chunk BEFORE the decode dispatch: the
+        # fairness contract of chunked admission. When NO lane is
+        # decoding there is nothing to stall — keep draining chunks
+        # until a prefill completes (a lane becomes ready) instead of
+        # burning one idle step per chunk.
+        pf_tokens = self._advance_prefill()
+        decode_lanes = int(np.count_nonzero(~self._done))
+        while (decode_lanes == 0 and
+               any(st is not None for st in self._prefill_state)):
+            pf_tokens += self._advance_prefill()
+            decode_lanes = int(np.count_nonzero(~self._done))
+        emitted_before = self._decode_emitted
+        n = 0
+        if decode_lanes:
+            # Token latency is observed over the decode dispatch ONLY —
+            # the chunked-prefill share of the step is admission work,
+            # attributed separately (profiler ring + stall payloads),
+            # and must not read as a per-token regression.
+            t_dec = time.perf_counter()
+            if self.dcfg.spec_k:
+                n = 1
+                self._spec_round()
+                # One spec round replaces a VARIABLE number of per-lane
+                # decode steps: normalize per-token latency by the mean
+                # tokens delivered per live lane, not the single
+                # dispatch — else enabling speculation would read as a
+                # per-token regression exactly when it is winning.
+                per_token_div = max(
+                    (self._decode_emitted - emitted_before)
+                    / decode_lanes, 1.0)
+            else:
+                n = self._decode_round()
+                per_token_div = float(n)
+            self._decode_steps += n
+            self._m.counter('skytpu_engine_steps_total',
+                            'Batched decode steps executed.').inc(n)
+            self._m.histogram(
+                'skytpu_engine_token_seconds',
+                'Per-token decode step latency.',
+                buckets=runtime_metrics.TOKEN_LATENCY_BUCKETS
+            ).observe((time.perf_counter() - t_dec) / per_token_div)
+        dt = time.perf_counter() - t0
+        stall = self.profiler.record(
+            dt, chunk=n, active=active,
+            delivered=self._decode_emitted - emitted_before,
+            queue_depth=self._publish_queue_depth(),
+            blocks_used=self._allocator.used() if self.paged else 0,
+            blocks_total=(self.num_blocks - 1) if self.paged else 0,
+            prefill_tokens=pf_tokens)
+        if stall is not None:
+            self._journal_raw(journal.EventKind.ENGINE_STALL, stall)
+        # Refill freed lanes NOW so the next chunk runs full.
+        self._admit()
+        self.flush_journal()
+        return active
+
+    def _decode_round(self) -> int:
+        """The non-speculative decode dispatch: ``step_chunk`` fused
+        single-token steps over every slot, then host delivery. Returns
+        the number of decode steps executed."""
         n = self.step_chunk
         if self.dcfg.temperature > 0.0:
             self._rng, sub = jax.random.split(self._rng)
             keys = jax.random.split(sub, n)
         else:
             keys = self._zero_keys
-        t0 = time.perf_counter()
+        self._note_compile('decode_steps', n_steps=n, paged=self.paged)
         if self.paged:
             if self._block_table_dev is None:
                 self._block_table_dev = jnp.asarray(self._block_table_np)
@@ -1113,51 +1434,115 @@ class DecodeEngine:
         self._pos = np.array(pos)
         self._done = np.array(done)
         self._remaining = np.array(remaining)
-        dt = time.perf_counter() - t0
-        self._decode_steps += n
-        self._m.counter('skytpu_engine_steps_total',
-                        'Batched decode steps executed.').inc(n)
-        self._m.histogram('skytpu_engine_token_seconds',
-                          'Per-token decode step latency.',
-                          buckets=runtime_metrics.TOKEN_LATENCY_BUCKETS
-                          ).observe(dt / n)
-        emitted_before = self._decode_emitted
         self._deliver_chunk(toks_np)
-        stall = self.profiler.record(
-            dt, chunk=n, active=active,
-            delivered=self._decode_emitted - emitted_before,
-            queue_depth=self._publish_queue_depth(),
-            blocks_used=self._allocator.used() if self.paged else 0,
-            blocks_total=(self.num_blocks - 1) if self.paged else 0)
-        if stall is not None:
-            self._journal_raw(journal.EventKind.ENGINE_STALL, stall)
-        # Refill freed lanes NOW so the next chunk runs full.
-        self._admit()
-        self.flush_journal()
-        return active
+        return n
+
+    def _spec_round(self) -> None:
+        """One speculative draft + batched-verify round across all
+        decoding lanes, with host-side accept/rollback.
+
+        Acceptance is the standard chain rule: verify token ``i`` is
+        the full model's argmax GIVEN the drafted context up to ``i``,
+        which matches the true greedy context exactly while every
+        earlier draft was accepted — so committing ``a_0..a_n`` (the
+        accepted run plus the one correction/bonus token) emits
+        precisely the tokens the non-speculative path would have, one
+        dispatch instead of up to ``spec_k+1``. Rollback is positional:
+        ``pos`` advances by the delivered count only; the rejected
+        tail's cache entries sit in lane-private blocks past ``pos``,
+        are never attended, and are overwritten when a real token
+        reaches that position."""
+        if self._block_table_dev is None:
+            self._block_table_dev = jnp.asarray(self._block_table_np)
+        k = self.dcfg.spec_k
+        self._note_compile('spec_step', spec_k=k,
+                           drafter_layers=self.dcfg.spec_drafter_layers)
+        drafts, vtok, self._cache = _engine_spec_step_impl(
+            self.params, jnp.asarray(self._token),
+            jnp.asarray(self._pos), self._block_table_dev, self._cache,
+            cfg=self.cfg, dcfg=self.dcfg)
+        drafts, vtok = jax.device_get((drafts, vtok))
+        emitted_total = 0
+        round_drafted = 0
+        round_accepted = 0
+        for slot, req in enumerate(self._slots):
+            if req is None or self._done[slot]:
+                continue
+            n_acc = 0
+            while (n_acc < k and
+                   int(drafts[slot, n_acc]) == int(vtok[slot, n_acc])):
+                n_acc += 1
+            round_drafted += k
+            round_accepted += n_acc
+            delivered, last_tok, evicted = self._deliver_run(
+                slot, req, vtok[slot, :n_acc + 1])
+            emitted_total += delivered
+            if not evicted:
+                self._token[slot] = last_tok
+                self._pos[slot] += delivered
+                self._remaining[slot] -= delivered
+        self._spec_drafted += round_drafted
+        self._spec_accepted += round_accepted
+        self._decode_emitted += emitted_total
+        self._m.counter('skytpu_engine_tokens_total',
+                        'Tokens generated by the engine.').inc(
+                            emitted_total)
+        self._m.counter(
+            'skytpu_engine_spec_drafted_total',
+            'Tokens proposed by the speculative drafter.').inc(
+                round_drafted)
+        self._m.counter(
+            'skytpu_engine_spec_accepted_total',
+            'Drafted tokens accepted by the batched verify.').inc(
+                round_accepted)
+        self._m.gauge(
+            'skytpu_engine_spec_accept_ratio',
+            'Cumulative accepted/drafted ratio of the speculative '
+            'path.').set(self.spec_accept_ratio())
+
+    def _deliver_run(self, slot: int, req: Request,
+                     tokens) -> Tuple[int, int, bool]:
+        """Deliver a run of tokens to ONE lane with budget/EOS clipping
+        — the single copy of the engine's finish semantics, shared by
+        the plain chunked delivery and the speculative accept path (so
+        a change to stop conditions cannot silently fork the two).
+        Evicts on a terminal condition; returns (delivered tokens,
+        last delivered token, evicted?)."""
+        eos = self.dcfg.eos_id
+        budget = req.max_new_tokens - len(req.tokens)
+        reason = None
+        delivered = 0
+        last_tok = 0
+        for t in tokens:
+            t = int(t)
+            budget -= 1
+            delivered += 1
+            last_tok = t
+            hit_eos = eos is not None and t == eos
+            req._deliver(t, done=hit_eos or budget <= 0)  # pylint: disable=protected-access
+            if hit_eos:
+                reason = 'eos'
+                break
+            if budget <= 0:
+                reason = 'length'
+                break
+        if reason is not None:
+            self._evict(slot, reason)
+        return delivered, last_tok, reason is not None
 
     def _deliver_chunk(self, toks_np: np.ndarray) -> None:
-        eos = self.dcfg.eos_id
         emitted = 0
         for slot, req in enumerate(self._slots):
             if req is None:
                 continue
-            budget = req.max_new_tokens - len(req.tokens)
-            reason = None
-            for j in range(toks_np.shape[0]):
-                t = int(toks_np[j, slot])
-                budget -= 1
-                emitted += 1
-                hit_eos = eos is not None and t == eos
-                req._deliver(t, done=hit_eos or budget <= 0)  # pylint: disable=protected-access
-                if hit_eos:
-                    reason = 'eos'
-                    break
-                if budget <= 0:
-                    reason = 'length'
-                    break
-            if reason is not None:
-                self._evict(slot, reason)
+            if self._prefill_state[slot] is not None:
+                # Mid-chunked-prefill: the lane is slotted but not yet
+                # decoding — the dispatch's frozen-lane outputs for it
+                # are scratch noise, not tokens.
+                continue
+            delivered, _, _ = self._deliver_run(slot, req,
+                                                toks_np[:, slot])
+            emitted += delivered
         self._decode_emitted += emitted
         self._m.counter('skytpu_engine_tokens_total',
                         'Tokens generated by the engine.').inc(emitted)
@@ -1177,6 +1562,7 @@ class DecodeEngine:
             self._radix.release(self._slot_nodes[slot])
             self._slot_refs[slot] = []
             self._slot_nodes[slot] = []
+            self._prefill_state[slot] = None
             self._block_table_np[slot, :] = SCRATCH_BLOCK
             self._block_table_dev = None
             self._publish_block_gauges()
@@ -1296,6 +1682,7 @@ class DecodeEngine:
             if req is None:
                 continue
             self._slots[slot] = None
+            self._prefill_state[slot] = None
             self._evicted += 1
             self._m.counter(
                 'skytpu_engine_evicted_total',
@@ -1328,6 +1715,28 @@ class DecodeEngine:
         fraction of batch lanes doing useful work."""
         lane_steps = self._decode_steps * self.num_slots
         return self._decode_emitted / lane_steps if lane_steps else 0.0
+
+    def spec_accept_ratio(self) -> float:
+        """Cumulative accepted/drafted ratio of the speculative path
+        (0.0 while nothing was drafted)."""
+        if not self._spec_drafted:
+            return 0.0
+        return self._spec_accepted / self._spec_drafted
+
+    def spec_stats(self) -> dict:
+        """The ``/slo`` ``spec`` block: speculative-decoding and
+        chunked-prefill counters for one engine."""
+        return {
+            'enabled': self.dcfg.spec_k > 0,
+            'spec_k': self.dcfg.spec_k,
+            'drafter_layers': self.dcfg.spec_drafter_layers,
+            'drafted_total': self._spec_drafted,
+            'accepted_total': self._spec_accepted,
+            'accept_ratio': round(self.spec_accept_ratio(), 4),
+            'prefill_chunk': self.prefill_chunk,
+            'prefill_chunks_total': self._prefill_chunks,
+            'chunked_admissions': self._chunked_admissions,
+        }
 
     def prefix_hit_ratio(self) -> float:
         """Fraction of admitted prompt tokens served from the prefix
@@ -1363,6 +1772,16 @@ class DecodeEngine:
                 'prefix_cache_blocks': self._radix.held_blocks(),
                 'prefix_hit_ratio': round(self.prefix_hit_ratio(), 4),
                 'prefill_tokens_saved': self._prompt_tokens_saved,
+                'prefill_chunk': self.prefill_chunk,
+                'prefill_chunks': self._prefill_chunks,
+                'chunked_admissions': self._chunked_admissions,
+            })
+        if self.dcfg.spec_k:
+            out.update({
+                'spec_k': self.dcfg.spec_k,
+                'spec_drafted': self._spec_drafted,
+                'spec_accepted': self._spec_accepted,
+                'spec_accept_ratio': round(self.spec_accept_ratio(), 4),
             })
         return out
 
@@ -1387,6 +1806,25 @@ class DecodeEngine:
             'skytpu_engine_prefix_hit_ratio',
             'Cumulative fraction of prompt tokens served from the '
             'prefix cache.').set(self.prefix_hit_ratio())
+
+    def _note_compile(self, kind: str, **shape) -> None:
+        """Journal ``engine.compile`` ONCE per distinct jitted dispatch
+        shape, just before the dispatch that would trace it — recompile
+        churn from new (bucket, chunk, spec_k) shapes shows up in
+        ``skytpu events`` instead of silently eating p99. The dedupe
+        set survives supervisor restarts (the jit cache is
+        process-global: a rebuilt engine does not retrace old
+        shapes)."""
+        key = (kind, tuple(sorted(shape.items())))
+        if key in self._traced_shapes:
+            return
+        self._traced_shapes.add(key)
+        self._m.counter(
+            'skytpu_engine_compiles_total',
+            'Distinct engine dispatch shapes traced (journaled as '
+            'engine.compile).').inc()
+        self._journal_raw(journal.EventKind.ENGINE_COMPILE,
+                          {'compile_kind': kind, **shape})
 
     def _journal(self, kind, request: Request, slot: int,
                  **payload) -> None:

@@ -96,6 +96,11 @@ class EventKind(enum.Enum):
     # the stall threshold journals the step profile evidence.
     ENGINE_SLOW_REQUEST = 'engine.slow_request'
     ENGINE_STALL = 'engine.stall'
+    # Speculative decoding + chunked prefill (models/engine.py):
+    # journaled the first time each (bucket, chunk, spec_k) dispatch
+    # shape traces, so recompile churn from new shapes is visible
+    # instead of silently eating p99.
+    ENGINE_COMPILE = 'engine.compile'
     # Serving-plane fault tolerance: the engine supervisor's crash →
     # fail-fast → rebuild → restart lifecycle (engine.crash carries the
     # traceback; restarts are bounded by SKYTPU_ENGINE_MAX_RESTARTS),

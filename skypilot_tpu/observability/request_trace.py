@@ -468,10 +468,15 @@ class EngineStepProfiler:
     def record(self, step_seconds: float, chunk: int, active: int,
                delivered: int, queue_depth: int,
                blocks_used: int = 0,
-               blocks_total: int = 0) -> Optional[Dict[str, Any]]:
+               blocks_total: int = 0,
+               prefill_tokens: int = 0) -> Optional[Dict[str, Any]]:
         """Record one engine step; returns a stall payload (for an
         ``engine.stall`` journal entry) when this step blew past
-        ``stall_factor`` × the rolling median, else None."""
+        ``stall_factor`` × the rolling median, else None.
+        ``prefill_tokens`` is the step's chunked-prefill share — the
+        stall payload carries the prefill/decode composition so a
+        chunk-induced stall is distinguishable from a true wedge in
+        ``skytpu events``."""
         now = time.time()
         self._last_beat = now
         step_seconds = float(step_seconds)
@@ -494,6 +499,11 @@ class EngineStepProfiler:
                     'stall_factor': self.stall_factor,
                     'active_slots': active,
                     'queue_depth': queue_depth,
+                    # Step composition: a stall with prefill_tokens > 0
+                    # is a long-admission chunk hogging the step, not a
+                    # wedged decode.
+                    'prefill_tokens': int(prefill_tokens),
+                    'decode_tokens': int(delivered),
                 }
             # The stalled step joins the window AFTER the check, so it
             # cannot vouch for itself — but a genuinely slower regime
@@ -501,7 +511,8 @@ class EngineStepProfiler:
             self._recent.append(step_seconds)
             self._ring.append((now, step_seconds, int(chunk), int(active),
                                int(delivered), int(queue_depth),
-                               int(blocks_used), int(blocks_total)))
+                               int(blocks_used), int(blocks_total),
+                               int(prefill_tokens)))
             self._steps += 1
         if stall is not None:
             metrics_lib.counter(
@@ -533,7 +544,7 @@ class EngineStepProfiler:
         durs = [r[1] for r in ring]
         keys = ('unix_ts', 'step_seconds', 'chunk', 'active_slots',
                 'delivered_tokens', 'queue_depth', 'blocks_used',
-                'blocks_total')
+                'blocks_total', 'prefill_tokens')
         tail = ring[-last_n:] if last_n > 0 else []
         recent = [dict(zip(keys, r)) for r in tail]
         recent.reverse()  # newest first

@@ -41,6 +41,16 @@ are live) and, with GQA, used to materialize ``repeat_kv``-expanded K/V
   (``models/engine.py``'s radix prefix cache): two rows whose tables
   name the same block read the same HBM, copy-free.
 
+* **Multi-query verify (speculative decoding)**: scoring ``spec_k``
+  drafted tokens against the full model is a q-length > 1 decode over
+  the same pool — query ``i`` sits at position ``start + i`` and masks
+  by its own causal length. ``paged_verify_attention`` widens the paged
+  kernel's per-head online-softmax rows to ``H * s_q`` (rows stay
+  grouped per kv head so the MXU tiles are unchanged); a q-length-1
+  verify is numerically the single-token decode step, which is what
+  pins greedy speculative output token-identical to the plain paged
+  path.
+
 Off-TPU the grouped-einsum XLA path below runs instead (tests force the
 kernel through the Pallas interpreter to check numerics on CPU); its
 paged variant gathers pool blocks through the table first.
@@ -391,6 +401,229 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
                                           cur_len, k_scale, v_scale)
     return paged_decode_attention_kernel(q, k_pool, v_pool, block_tables,
                                          cur_len, k_scale, v_scale,
+                                         interpret=itp)
+
+
+# ----------------------------------------------------- paged verify (q>1)
+
+
+def _paged_verify_kernel(start_ref, bt_ref, q_ref, k_ref, v_ref, *rest,
+                         block_k: int, n_blocks: int, n_kv_heads: int,
+                         s_q: int, scale: float, quantized: bool):
+    """One (batch, kv-block) program of the multi-query verify pass.
+
+    Speculative-decoding verify is a q-length ``s_q`` decode: query ``i``
+    sits at position ``start + i`` and may attend keys at positions
+    ``<= start + i`` (the drafted tokens' K/V were scattered into the
+    pool before this call, so the causal tail among the new positions is
+    just part of the same per-query length mask). The body is the dense
+    decode kernel's online softmax with the [H, ·] rows widened to
+    [H*s_q, ·] — rows ordered (head, query) so each kv head's G*s_q
+    query rows stay contiguous for the per-kv-head MXU tiles.
+
+    start_ref: scalar-prefetch [B] int32 first-query positions; q_ref
+    [1, H, s_q, hd]; k/v/scale refs as in the paged decode kernel;
+    o_ref [1, H, s_q, hd]; m/l scratch [H*s_q, 128], acc [H*s_q, hd].
+    """
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+    bi = pl.program_id(0)
+    j = pl.program_id(1)
+    h = q_ref.shape[1]
+    groups = h // n_kv_heads
+    rows = h * s_q
+    start = start_ref[bi]
+    # Keys live at positions < start + s_q (the verify block's last
+    # query position, inclusive).
+    live_blocks = pl.cdiv(start + s_q, block_k)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(j < live_blocks)
+    def _accumulate():
+        q = q_ref[0].reshape(rows, q_ref.shape[-1])        # [H*S, hd]
+        q = q.astype(jnp.float32) * scale
+        k_blk = k_ref[0]                                   # [bk, Hkv, hd]
+        v_blk = v_ref[0]
+        if quantized:
+            k_blk = k_blk.astype(jnp.float32) * ks_ref[0][:, :, None]
+            v_blk = v_blk.astype(jnp.float32) * vs_ref[0][:, :, None]
+        else:
+            k_blk = k_blk.astype(jnp.float32)
+            v_blk = v_blk.astype(jnp.float32)
+
+        gs = groups * s_q
+        logits = jnp.concatenate([
+            jax.lax.dot_general(
+                q[g * gs:(g + 1) * gs], k_blk[:, g, :],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            for g in range(n_kv_heads)
+        ], axis=0)                                         # [H*S, bk]
+        pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, block_k), 1)
+        # Row r is query s = r % s_q of head r // s_q: per-query causal
+        # length start + s + 1.
+        s_of_row = jax.lax.broadcasted_iota(
+            jnp.int32, (rows, block_k), 0) % s_q
+        mask = pos <= start + s_of_row                     # [H*S, bk]
+        logits = jnp.where(mask, logits, NEG_INF)
+
+        m = m_scr[:, 0:1]
+        l = l_scr[:, 0:1]
+        m_blk = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        safe_m = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        p = jnp.where(mask, jnp.exp(logits - safe_m), 0.0)
+        correction = jnp.where(m == NEG_INF, 0.0, jnp.exp(m - safe_m))
+        pv = jnp.concatenate([
+            jax.lax.dot_general(
+                p[g * gs:(g + 1) * gs], v_blk[:, g, :],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            for g in range(n_kv_heads)
+        ], axis=0)                                         # [H*S, hd]
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(
+            l * correction + jnp.sum(p, axis=-1, keepdims=True),
+            l_scr.shape)
+        acc_scr[...] = acc_scr[...] * correction + pv
+
+    @pl.when(j == n_blocks - 1)
+    def _finalize():
+        l = l_scr[:, 0:1]
+        out = (acc_scr[...] / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+        o_ref[0] = out.reshape(h, s_q, o_ref.shape[-1])
+
+
+def paged_verify_attention_kernel(q: jax.Array, k_pool: jax.Array,
+                                  v_pool: jax.Array,
+                                  block_tables: jax.Array,
+                                  start_pos: jax.Array,
+                                  k_scale: Optional[jax.Array] = None,
+                                  v_scale: Optional[jax.Array] = None,
+                                  interpret: bool = False) -> jax.Array:
+    """q [B,S,H,hd] (S > 1 fine) vs a block pool through ``block_tables``
+    → [B,S,H,hd]; query ``i`` of row ``b`` sits at position
+    ``start_pos[b] + i`` and attends positions ``<= start_pos[b] + i``.
+    The speculative-verify counterpart of
+    :func:`paged_decode_attention_kernel`."""
+    b, s_q, h, hd = q.shape
+    _, block_k, hkv, _ = k_pool.shape
+    n_bt = block_tables.shape[1]
+    assert block_tables.shape[0] == b, (block_tables.shape, b)
+    quantized = k_scale is not None
+
+    def q_index(bi, j, start_ref, bt_ref):
+        del j, start_ref, bt_ref
+        return (bi, 0, 0, 0)
+
+    def _table_block(bi, j, start_ref, bt_ref):
+        live = pl.cdiv(start_ref[bi] + s_q, block_k)
+        jc = jnp.minimum(j, jnp.maximum(live - 1, 0))
+        return bt_ref[bi, jc]
+
+    def kv_index(bi, j, start_ref, bt_ref):
+        return (_table_block(bi, j, start_ref, bt_ref), 0, 0, 0)
+
+    def scale_index(bi, j, start_ref, bt_ref):
+        return (_table_block(bi, j, start_ref, bt_ref), 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, h, s_q, hd), q_index),
+        pl.BlockSpec((1, block_k, hkv, hd), kv_index),
+        pl.BlockSpec((1, block_k, hkv, hd), kv_index),
+    ]
+    # Rows ordered (head, query): transpose outside the kernel so the
+    # per-kv-head row slices stay contiguous.
+    operands = [q.transpose(0, 2, 1, 3), k_pool, v_pool]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, block_k, hkv), scale_index),
+            pl.BlockSpec((1, block_k, hkv), scale_index),
+        ]
+        operands += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, n_bt),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, h, s_q, hd), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((h * s_q, 128), jnp.float32),   # m
+            pltpu.VMEM((h * s_q, 128), jnp.float32),   # l
+            pltpu.VMEM((h * s_q, hd), jnp.float32),    # acc
+        ],
+    )
+    kernel = functools.partial(
+        _paged_verify_kernel, block_k=block_k, n_blocks=n_bt,
+        n_kv_heads=hkv, s_q=s_q, scale=hd**-0.5, quantized=quantized)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, s_q, hd), q.dtype),
+        interpret=interpret,
+    )(start_pos.astype(jnp.int32), block_tables.astype(jnp.int32),
+      *operands)
+    return out.transpose(0, 2, 1, 3)
+
+
+def paged_verify_attention_xla(q: jax.Array, k_pool: jax.Array,
+                               v_pool: jax.Array, block_tables: jax.Array,
+                               start_pos: jax.Array,
+                               k_scale: Optional[jax.Array] = None,
+                               v_scale: Optional[jax.Array] = None
+                               ) -> jax.Array:
+    """Grouped-einsum fallback for the multi-query verify pass; same
+    contract as :func:`paged_verify_attention_kernel`. The per-query
+    masked softmax mirrors :func:`decode_attention_xla` exactly so a
+    q-length-1 verify is numerically the single-token decode step —
+    what makes greedy speculative output token-identical to the
+    non-speculative paged path."""
+    b, s, h, hd = q.shape
+    k, v, ks, vs = gather_paged_kv(k_pool, v_pool, block_tables,
+                                   k_scale, v_scale)
+    if ks is not None:
+        k = (k.astype(jnp.float32) * ks[..., None]).astype(q.dtype)
+        v = (v.astype(jnp.float32) * vs[..., None]).astype(q.dtype)
+    hkv = k.shape[2]
+    g = h // hkv
+    qg = q.reshape(b, s, hkv, g, hd)
+    logits = jnp.einsum('bskgd,btkd->bkgst', qg, k,
+                        preferred_element_type=jnp.float32) * hd**-0.5
+    t_idx = jnp.arange(k.shape[1])
+    # [B, S, T]: query i attends positions <= start_pos + i. Every row
+    # keeps at least its own position, so no empty-row re-mask dance.
+    mask = t_idx[None, None, :] <= (start_pos[:, None, None] +
+                                    jnp.arange(s)[None, :, None])
+    logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    probs = jnp.where(mask[:, None, None, :, :], probs, 0)
+    out = jnp.einsum('bkgst,btkd->bskgd', probs, v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, s, h, hd).astype(q.dtype)
+
+
+def paged_verify_attention(q: jax.Array, k_pool: jax.Array,
+                           v_pool: jax.Array, block_tables: jax.Array,
+                           start_pos: jax.Array,
+                           k_scale: Optional[jax.Array] = None,
+                           v_scale: Optional[jax.Array] = None,
+                           interpret: Optional[bool] = None) -> jax.Array:
+    """Kernel when it can run (TPU, or forced interpreter), XLA otherwise
+    (mirrors :func:`paged_decode_attention`)."""
+    itp = _resolve_interpret(interpret)
+    if itp is None:
+        return paged_verify_attention_xla(q, k_pool, v_pool, block_tables,
+                                          start_pos, k_scale, v_scale)
+    return paged_verify_attention_kernel(q, k_pool, v_pool, block_tables,
+                                         start_pos, k_scale, v_scale,
                                          interpret=itp)
 
 

@@ -115,6 +115,10 @@ DEFAULT_DRAIN_TIMEOUT_SECONDS = 30.0
 # it wedged (logged + journaled — it still holds the accelerator).
 STOP_TIMEOUT_ENV = 'SKYTPU_SERVER_STOP_TIMEOUT_SECONDS'
 DEFAULT_STOP_TIMEOUT_SECONDS = 10.0
+# Speculative decoding (paged + greedy replicas): draft tokens per
+# engine step (0 disables) and the truncated-layer drafter's depth.
+SPEC_K_ENV = 'SKYTPU_SPEC_K'
+SPEC_DRAFTER_LAYERS_ENV = 'SKYTPU_SPEC_DRAFTER_LAYERS'
 
 # skytpu_server_state gauge values (the LB/operators read the metric;
 # /healthz carries the string).
@@ -613,6 +617,9 @@ class ModelServer:
             'engine_restarts': self.engine.restart_count(),
             'engine_failed': self.engine.failed,
         }
+        # Speculative decoding + chunked prefill: acceptance ratio and
+        # chunk counters next to the latency percentiles they move.
+        body['spec'] = self.engine.spec_stats()
         return web.json_response(body)
 
     async def _handle_drain(self, request: web.Request) -> web.Response:
@@ -628,9 +635,17 @@ def build_engine(model: str, num_slots: int, max_len: int,
                  attn: str = 'kernel', step_chunk: int = 4,
                  checkpoint_dir: Optional[str] = None, seed: int = 0,
                  paged: bool = False, num_blocks: Optional[int] = None,
-                 block_k: Optional[int] = None
+                 block_k: Optional[int] = None,
+                 spec_k: Optional[int] = None,
+                 drafter_layers: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None
                  ) -> engine_lib.DecodeEngine:
-    """Assemble params + configs into a DecodeEngine (CLI + tests)."""
+    """Assemble params + configs into a DecodeEngine (CLI + tests).
+
+    ``spec_k``/``drafter_layers``/``prefill_chunk`` default from
+    ``SKYTPU_SPEC_K`` / ``SKYTPU_SPEC_DRAFTER_LAYERS`` /
+    ``SKYTPU_PREFILL_CHUNK`` so a deployed replica can be tuned via the
+    task's envs without a CLI change."""
     import jax
     cfg = llama.CONFIGS[model]
     params = llama.init_params(jax.random.PRNGKey(seed), cfg)
@@ -652,10 +667,19 @@ def build_engine(model: str, num_slots: int, max_len: int,
         kv_cache_dtype='int8' if kv_int8 else 'bf16')
     if block_k is not None:
         dcfg_kwargs['kernel_block_k'] = block_k
+    if spec_k is None:
+        spec_k = common_utils.env_int(SPEC_K_ENV, 0)
+    if drafter_layers is None:
+        drafter_layers = common_utils.env_int(SPEC_DRAFTER_LAYERS_ENV, 1)
+    if spec_k:
+        dcfg_kwargs['spec_k'] = spec_k
+        dcfg_kwargs['spec_drafter_layers'] = min(drafter_layers,
+                                                 cfg.n_layers)
     dcfg = decode.DecodeConfig(**dcfg_kwargs)
     return engine_lib.DecodeEngine(params, cfg, dcfg, num_slots,
                                    step_chunk=step_chunk, name=model,
-                                   paged=paged, num_blocks=num_blocks)
+                                   paged=paged, num_blocks=num_blocks,
+                                   prefill_chunk=prefill_chunk)
 
 
 def main() -> None:
@@ -697,6 +721,19 @@ def main() -> None:
     parser.add_argument('--block-k', type=int, default=None,
                         help='paged pool block size in tokens (default: '
                              'the kernel KV block, 128)')
+    parser.add_argument('--spec-k', type=int, default=None,
+                        help='speculative decoding: draft tokens per '
+                             'engine step (paged + greedy only; default '
+                             'SKYTPU_SPEC_K or 0 = off)')
+    parser.add_argument('--drafter-layers', type=int, default=None,
+                        help='truncated-layer drafter depth (default '
+                             'SKYTPU_SPEC_DRAFTER_LAYERS or 1)')
+    parser.add_argument('--prefill-chunk', type=int, default=None,
+                        help='chunked prefill: split paged admissions '
+                             'longer than this many tokens into one-'
+                             'chunk-per-step prefills interleaved with '
+                             'decode (default SKYTPU_PREFILL_CHUNK or '
+                             '0 = off)')
     parser.add_argument('--checkpoint-dir', default=None,
                         help='restore params from models/checkpoint '
                              'layout (default: random init — demo mode)')
@@ -710,7 +747,10 @@ def main() -> None:
                           checkpoint_dir=args.checkpoint_dir,
                           seed=args.seed, paged=args.paged,
                           num_blocks=args.num_blocks,
-                          block_k=args.block_k)
+                          block_k=args.block_k,
+                          spec_k=args.spec_k,
+                          drafter_layers=args.drafter_layers,
+                          prefill_chunk=args.prefill_chunk)
     server = ModelServer(engine, args.port, host=args.host,
                          default_max_new_tokens=args.max_new_tokens)
     server.run_forever()

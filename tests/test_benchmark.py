@@ -90,3 +90,21 @@ def test_decode_bench_smoke():
     assert result['metric'] == 'llama_decode_tokens_per_sec'
     assert result['value'] > 0
     assert result['unit'] == 'tokens/s/chip'
+
+
+def test_decode_bench_spec_workload_smoke():
+    """The spec workload reports acceptance economics and per-token
+    latency vs the non-spec baseline (ISSUE-11), platform-tagged, on
+    the CPU tier."""
+    from skypilot_tpu.benchmark import decode_bench
+    result = decode_bench.run_spec_bench(steps=1)
+    assert result['metric'] == 'llama_decode_spec_tokens_per_sec'
+    assert result['platform'] == 'cpu'
+    d = result['detail']
+    assert d['workload'] == 'spec' and d['spec_k'] > 0
+    assert d['drafted_tokens'] > 0
+    assert 0 <= d['accepted_tokens'] <= d['drafted_tokens']
+    assert 0.0 <= d['accept_ratio'] <= 1.0
+    assert d['chunked_admissions'] > 0 and d['prefill_chunks'] > 0
+    assert d['base_per_token_ms'] > 0 and d['spec_per_token_ms'] > 0
+    assert d['per_token_speedup'] > 0

@@ -247,6 +247,13 @@ def test_request_telemetry_plane_e2e(server, monkeypatch):
     assert slo['ttft_seconds']['p95'] > 0
     assert slo['rates']['finished_total'] >= 4
     assert slo['rates']['slow_total'] >= 4
+    # ISSUE-11: the spec block is always present (disabled here — the
+    # fixture engine is dense/greedy without speculation) so dashboards
+    # can key on it unconditionally.
+    assert slo['spec']['enabled'] is False
+    for key in ('spec_k', 'accept_ratio', 'drafted_total',
+                'prefill_chunk', 'prefill_chunks_total'):
+        assert key in slo['spec'], key
 
     eng_dbg = requests.get(f'{server}/debug/engine', timeout=30).json()
     assert eng_dbg['step_profile']['steps_recorded'] > 0

@@ -262,11 +262,23 @@ def test_cpu_sched_payload_end_to_end():
         capture_output=True, text=True, timeout=300,
         env={**os.environ, 'JAX_PLATFORMS': 'cpu'}, cwd=REPO_ROOT)
     assert res.returncode == 0, res.stderr[-2000:]
-    out = json.loads(res.stdout.strip().splitlines()[-1])
+    lines = res.stdout.strip().splitlines()
+    out = json.loads(lines[-1])
     assert out['platform'] == 'cpu'
     assert out['value'] > 0
     assert out['detail']['paged']['prefix_hit_ratio'] > 0
     assert out['detail']['dense']['tokens_per_step'] > 0
+    # ISSUE-11: every perf round reports the speculative path's
+    # acceptance economics, even on the CPU failover tier — and the
+    # lines are cumulative (a sched-only line lands first, so a kill
+    # mid-spec still leaves a result).
+    spec = out['detail']['spec']
+    assert spec['platform'] == 'cpu'
+    assert spec['drafted_tokens'] > 0
+    assert 0.0 <= spec['accept_ratio'] <= 1.0
+    assert spec['base_per_token_ms'] > 0
+    assert spec['per_token_speedup'] > 0
+    assert 'spec' not in json.loads(lines[-2])['detail']
 
 
 def test_supervisor_accepts_partial_result_on_decode_wedge():

@@ -276,3 +276,77 @@ def test_generate_kernel_path_matches_xla_path_tokens():
     g_x = decode.generate(params, prompt, lens, cfg, base, 6)
     g_k = decode.generate(params, prompt, lens, cfg, kern, 6)
     np.testing.assert_array_equal(np.asarray(g_x), np.asarray(g_k))
+
+
+# -------------------------------------------- paged verify (speculative)
+
+
+def _verify_case(key, b, t, s, h, hkv, hd, block_k):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, h, hd), jnp.float32)
+    k = jax.random.normal(kk, (b, t, hkv, hd), jnp.float32)
+    v = jax.random.normal(kv, (b, t, hkv, hd), jnp.float32)
+    kp, vp, bt = _paged_from_dense(k, v, block_k=block_k)
+    return q, k, v, kp, vp, bt
+
+
+def test_paged_verify_xla_equals_per_token_decode_exactly():
+    """The load-bearing parity property: a q-length-S verify over a
+    fixed pool must reproduce the single-token decode output at every
+    query EXACTLY (same gathered view, same masked-softmax sequence) —
+    this is what makes greedy speculative output token-identical to the
+    non-speculative paged path."""
+    q, _, _, kp, vp, bt = _verify_case(jax.random.PRNGKey(20), b=3, t=64,
+                                       s=4, h=8, hkv=2, hd=32,
+                                       block_k=16)
+    start = jnp.array([5, 0, 37], jnp.int32)
+    out = da.paged_verify_attention_xla(q, kp, vp, bt, start)
+    for i in range(4):
+        ref = da.paged_decode_attention_xla(q[:, i:i + 1], kp, vp, bt,
+                                            start + i + 1)
+        np.testing.assert_array_equal(np.asarray(out[:, i]),
+                                      np.asarray(ref[:, 0]))
+
+
+@pytest.mark.parametrize('starts', [(0, 15, 30), (16, 47, 1)])
+def test_paged_verify_kernel_matches_xla(starts):
+    """Verify kernel (interpreter) == XLA reference through a SHUFFLED
+    block table, with per-query causal lengths straddling block
+    boundaries."""
+    q, _, _, kp, vp, bt = _verify_case(jax.random.PRNGKey(21), b=3, t=64,
+                                       s=3, h=8, hkv=2, hd=32,
+                                       block_k=16)
+    start = jnp.array(starts, jnp.int32)
+    ref = da.paged_verify_attention_xla(q, kp, vp, bt, start)
+    out = da.paged_verify_attention_kernel(q, kp, vp, bt, start,
+                                           interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_paged_verify_kernel_int8_matches_xla():
+    key = jax.random.PRNGKey(22)
+    kq, kk, kv = jax.random.split(key, 3)
+    b, t, s, h, hkv, hd, bk = 2, 64, 4, 4, 2, 32, 16
+    q = jax.random.normal(kq, (b, s, h, hd), jnp.float32)
+    k = jax.random.normal(kk, (b, t, hkv, hd), jnp.float32)
+    v = jax.random.normal(kv, (b, t, hkv, hd), jnp.float32)
+    kq8, ks = quant.quantize_kv(k)
+    vq8, vs = quant.quantize_kv(v)
+    kp, vp, bt, ksp, vsp = _paged_from_dense(k=kq8, v=vq8, block_k=bk,
+                                             k_scale=ks, v_scale=vs)
+    start = jnp.array([11, 40], jnp.int32)
+    ref = da.paged_verify_attention_xla(q, kp, vp, bt, start, ksp, vsp)
+    out = da.paged_verify_attention_kernel(q, kp, vp, bt, start, ksp,
+                                           vsp, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_paged_verify_dispatch_falls_back_to_xla_off_tpu():
+    q, _, _, kp, vp, bt = _verify_case(jax.random.PRNGKey(23), b=1, t=16,
+                                       s=2, h=2, hkv=2, hd=8, block_k=8)
+    start = jnp.array([6], jnp.int32)
+    out = da.paged_verify_attention(q, kp, vp, bt, start)
+    ref = da.paged_verify_attention_xla(q, kp, vp, bt, start)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))

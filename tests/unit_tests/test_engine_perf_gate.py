@@ -92,6 +92,32 @@ def test_replay_runs_with_step_profiler_enabled(sched_result):
     assert p95['ttft'] > 0 and p95['total'] > 0
 
 
+def test_replay_holds_with_spec_and_chunked_enabled():
+    """ISSUE-11: the SAME deterministic trace replayed with speculative
+    decoding + chunked prefill enabled must hold the tokens/step
+    envelope — the new machinery may only add throughput, never cost
+    scheduler-level tokens/step. (A spec step always delivers at least
+    one token per live lane, so this also guards against accept-logic
+    regressions that would silently emit less.)"""
+    from skypilot_tpu.benchmark import decode_bench
+    res = decode_bench.run_scheduler_bench(steps=1, spec_k=2,
+                                           prefill_chunk=8)
+    env = _envelope()
+    floor = 1 - env['regression_tolerance']
+    paged = res['detail']['paged']
+    assert paged['tokens_per_step'] >= \
+        env['paged_tokens_per_step'] * floor, (
+            f"spec+chunked replay regressed: {paged['tokens_per_step']} "
+            f"tokens/step vs envelope {env['paged_tokens_per_step']}")
+    # The replay actually exercised both features and reports them.
+    spec = paged['spec']
+    assert spec['drafted_total'] > 0
+    assert 0.0 <= spec['accept_ratio'] <= 1.0
+    assert spec['prefill_chunks_total'] > 0
+    assert res['detail']['spec_k'] == 2
+    assert res['detail']['prefill_chunk'] == 8
+
+
 def test_result_is_platform_tagged(sched_result):
     """The failover tier's contract: the emitted line must carry the
     platform that actually ran so trends stay attributable when TPU

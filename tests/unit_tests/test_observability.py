@@ -318,7 +318,14 @@ def test_all_registered_metric_names_match_convention():
                      # Serving-plane fault tolerance (ISSUE 10).
                      'skytpu_engine_restarts_total',
                      'skytpu_server_state',
-                     'skytpu_lb_ejected_total'):
+                     'skytpu_lb_ejected_total',
+                     # Speculative decoding + chunked prefill
+                     # (ISSUE 11).
+                     'skytpu_engine_spec_drafted_total',
+                     'skytpu_engine_spec_accepted_total',
+                     'skytpu_engine_spec_accept_ratio',
+                     'skytpu_engine_prefill_chunks_total',
+                     'skytpu_engine_compiles_total'):
         assert expected in names, f'{expected} not found by lint scan'
 
 
@@ -368,7 +375,10 @@ def test_all_journal_event_kinds_are_registered():
                      'ENGINE_SLOW_REQUEST', 'ENGINE_STALL',
                      # Serving-plane fault tolerance (ISSUE 10).
                      'ENGINE_CRASH', 'ENGINE_RESTART', 'SERVER_DRAIN',
-                     'LB_EJECT'):
+                     'LB_EJECT',
+                     # Speculative decoding + chunked prefill
+                     # (ISSUE 11).
+                     'ENGINE_COMPILE'):
         assert expected in attr_names, \
             f'EventKind.{expected} not found by lint scan'
 
