@@ -258,15 +258,21 @@ def _payload_sched() -> None:
 
     harness.beat('start')
     from skypilot_tpu.benchmark import decode_bench
-    out = decode_bench.run_scheduler_bench(beat=harness.beat)
+    # Mesh shape rides next to the platform tag: SKYTPU_BENCH_TP asks
+    # the engine workloads to shard over a tensor-parallel mesh (the
+    # bench clamps to what the platform/model supports and reports the
+    # EFFECTIVE degree), so perf trends stay attributable to topology.
+    from skypilot_tpu.utils import common_utils
+    tp = common_utils.env_int('SKYTPU_BENCH_TP', 1)
+    out = decode_bench.run_scheduler_bench(beat=harness.beat, tp=tp)
     print(json.dumps(out), flush=True)
-    spec = decode_bench.run_spec_bench(beat=harness.beat)
+    spec = decode_bench.run_spec_bench(beat=harness.beat, tp=tp)
     out['detail']['spec'] = {
         'value': spec['value'],
         'unit': spec['unit'],
         'platform': spec['platform'],
         **{k: spec['detail'][k] for k in (
-            'spec_k', 'drafter_layers', 'prefill_chunk',
+            'tp', 'spec_k', 'drafter_layers', 'prefill_chunk',
             'drafted_tokens', 'accepted_tokens', 'accept_ratio',
             'prefill_chunks', 'chunked_admissions',
             'base_per_token_ms', 'spec_per_token_ms',

@@ -62,6 +62,22 @@ def _journal_disabled():
             os.environ[journal_lib.DISABLE_ENV] = prev
 
 
+def _resolve_tp(tp: int, model_name: str, devices) -> int:
+    """Clamp a requested tensor-parallel degree to what this platform
+    and model can actually shard: the visible device count and the
+    model's KV-head divisibility. Benchmarks must keep emitting (the
+    CPU failover tier cannot die on a TPU-sized --tp), so this degrades
+    with a note instead of raising; the emitted ``tp`` tag is the
+    EFFECTIVE degree."""
+    from skypilot_tpu.models import llama
+    tp = max(1, int(tp))
+    cfg = llama.CONFIGS[model_name]
+    while tp > 1 and (tp > len(devices) or cfg.n_kv_heads % tp
+                      or cfg.n_heads % tp):
+        tp -= 1
+    return tp
+
+
 def _init(beat):
     """Device init shared by both workloads. When a supervising caller
     passes `beat`, devices are already up (bench.py's payload ran
@@ -521,7 +537,8 @@ def run_spec_bench(model_name: str = 'debug', num_slots: int = 4,
                    n_requests: int = 0, spec_k: int = 0,
                    drafter_layers: int = 0, prefill_chunk: int = 0,
                    kv_int8: bool = False, attn: str = 'kernel',
-                   steps: int = 2, beat=None, seed: int = 0) -> dict:
+                   steps: int = 2, beat=None, seed: int = 0,
+                   tp: int = 1) -> dict:
     """Speculative decoding + chunked prefill vs the plain paged engine
     on short greedy decodes — the workload speculation exists for.
 
@@ -560,6 +577,7 @@ def run_spec_bench(model_name: str = 'debug', num_slots: int = 4,
         steps = min(steps, 2)
     drafter_layers = drafter_layers or max(
         1, llama.CONFIGS[model_name].n_layers // 2)
+    tp = _resolve_tp(tp, model_name, devices)
 
     cfg = dataclasses.replace(llama.CONFIGS[model_name], remat=False)
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
@@ -577,7 +595,7 @@ def run_spec_bench(model_name: str = 'debug', num_slots: int = 4,
         eng = engine_lib.DecodeEngine(
             params, cfg, dcfg, num_slots, step_chunk=1,
             name='spec-bench', paged=True, num_blocks=num_blocks,
-            prefill_chunk=prefill_chunk if spec_on else 0)
+            prefill_chunk=prefill_chunk if spec_on else 0, tp=tp)
         useful, _, n_steps = _drive_engine(eng, engine_lib, requests)
         return useful, n_steps, eng.stats(), eng.spec_stats()
 
@@ -607,6 +625,7 @@ def run_spec_bench(model_name: str = 'debug', num_slots: int = 4,
             'workload': 'spec',
             'model': model_name,
             'num_slots': num_slots,
+            'tp': tp,
             'n_requests': len(requests),
             'spec_k': spec_k,
             'drafter_layers': drafter_layers,
@@ -642,7 +661,7 @@ def run_spec_bench(model_name: str = 'debug', num_slots: int = 4,
 
 def run_scheduler_bench(steps: int = 2, beat=None, seed: int = 0,
                         spec_k: int = 0, prefill_chunk: int = 0,
-                        drafter_layers: int = 1) -> dict:
+                        drafter_layers: int = 1, tp: int = 1) -> dict:
     """Device-agnostic engine-SCHEDULER phase: the CPU failover tier.
 
     Runs the continuous-batching scheduler (dense and paged+prefix) on a
@@ -663,6 +682,9 @@ def run_scheduler_bench(steps: int = 2, beat=None, seed: int = 0,
     beat, devices = _init(beat)
     platform = devices[0].platform
     model_name, num_slots, block_k, max_len = 'debug', 4, 8, 64
+    # TP rides the paged side only (tp > 1 requires the paged pool; the
+    # dense engine stays the unsharded control).
+    tp = _resolve_tp(tp, model_name, devices)
     cfg = dataclasses.replace(llama.CONFIGS[model_name], remat=False)
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     dcfg = decode.DecodeConfig(max_len=max_len, temperature=0.0,
@@ -686,7 +708,8 @@ def run_scheduler_bench(steps: int = 2, beat=None, seed: int = 0,
                 16 if paged else num_slots,
                 step_chunk=4, name='sched-bench',
                 paged=paged, num_blocks=num_blocks if paged else None,
-                prefill_chunk=prefill_chunk if paged else 0)
+                prefill_chunk=prefill_chunk if paged else 0,
+                tp=tp if paged else 1)
             useful, conc, n_steps = _drive_engine(eng, engine_lib,
                                                   requests)
             st = eng.stats()
@@ -734,6 +757,7 @@ def run_scheduler_bench(steps: int = 2, beat=None, seed: int = 0,
             'workload': 'sched',
             'model': model_name,
             'block_k': block_k,
+            'tp': tp,
             'n_requests': len(requests),
             'spec_k': spec_k,
             'prefill_chunk': prefill_chunk,
@@ -808,9 +832,15 @@ def main() -> None:
     parser.add_argument('--prefill-chunk', type=int, default=0,
                         help='spec workload: chunked-prefill threshold '
                              'in tokens (default: workload-tier choice)')
+    parser.add_argument('--tp', type=int, default=1,
+                        help='sched/spec workloads: tensor-parallel '
+                             'degree for the paged engine (clamped to '
+                             'the visible devices / model head counts; '
+                             'the emitted tp tag is the effective '
+                             'degree)')
     args = parser.parse_args()
     if args.workload == 'sched':
-        out = run_scheduler_bench(steps=min(args.steps, 3))
+        out = run_scheduler_bench(steps=min(args.steps, 3), tp=args.tp)
     elif args.workload == 'spec':
         out = run_spec_bench(args.model, args.num_slots,
                              n_requests=args.requests,
@@ -818,7 +848,7 @@ def main() -> None:
                              drafter_layers=args.drafter_layers,
                              prefill_chunk=args.prefill_chunk,
                              kv_int8=args.kv_int8, attn=args.attn,
-                             steps=min(args.steps, 3))
+                             steps=min(args.steps, 3), tp=args.tp)
     elif args.workload == 'prefix':
         out = run_prefix_bench(args.model, args.num_slots,
                                n_requests=args.requests,

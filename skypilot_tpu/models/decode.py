@@ -272,16 +272,19 @@ prefill_into_slot = jax.jit(_prefill_into_slot, static_argnames=('cfg',),
 
 
 def _attend_paged(dcfg: DecodeConfig, q: jax.Array, lpool: Cache,
-                  block_tables: jax.Array, cur_len: jax.Array) -> jax.Array:
+                  block_tables: jax.Array, cur_len: jax.Array,
+                  mesh=None) -> jax.Array:
     """q [B,1,H,hd] against one layer's pool [n_blocks, block_k, Hkv, hd]
-    through ``block_tables`` [B, max_blocks]."""
+    through ``block_tables`` [B, max_blocks]. A tensor-parallel
+    ``mesh`` selects the shard_map kernel dispatch (the XLA fallback
+    partitions under plain GSPMD and ignores it)."""
     k_scale = lpool.get('k_scale')
     v_scale = lpool.get('v_scale')
     if dcfg.decode_attention == 'kernel':
         return decode_attention_ops.paged_decode_attention(
             q, lpool['k'], lpool['v'], block_tables, cur_len,
             k_scale=k_scale, v_scale=v_scale,
-            interpret=dcfg.kernel_interpret)
+            interpret=dcfg.kernel_interpret, mesh=mesh)
     assert dcfg.decode_attention == 'xla', dcfg.decode_attention
     return decode_attention_ops.paged_decode_attention_xla(
         q, lpool['k'], lpool['v'], block_tables, cur_len,
@@ -291,7 +294,7 @@ def _attend_paged(dcfg: DecodeConfig, q: jax.Array, lpool: Cache,
 def _paged_block_decode(cfg: llama.LlamaConfig, dcfg: DecodeConfig,
                         x: jax.Array, layer: Params, lpool: Cache,
                         cos: jax.Array, sin: jax.Array, pos: jax.Array,
-                        block_tables: jax.Array
+                        block_tables: jax.Array, mesh=None
                         ) -> Tuple[jax.Array, Cache]:
     """One decoder block for one new token per sequence, paged cache:
     the K/V write scatters to (table[pos // block_k], pos % block_k)."""
@@ -307,7 +310,8 @@ def _paged_block_decode(cfg: llama.LlamaConfig, dcfg: DecodeConfig,
     blk = jnp.take_along_axis(block_tables,
                               (pos // block_k)[:, None], axis=1)[:, 0]
     lpool = _write_kv(lpool, (blk, pos % block_k), k[:, 0], v[:, 0])
-    attn = _attend_paged(dcfg, q, lpool, block_tables, cur_len=pos + 1)
+    attn = _attend_paged(dcfg, q, lpool, block_tables, cur_len=pos + 1,
+                         mesh=mesh)
     attn = attn.reshape(b, s, cfg.n_heads * hd)
     x = x + llama.quant_mm(attn, layer['wo']).astype(cfg.dtype)
     return llama.ffn_sublayer(cfg, x, layer), lpool
@@ -315,7 +319,7 @@ def _paged_block_decode(cfg: llama.LlamaConfig, dcfg: DecodeConfig,
 
 def _paged_decode_step(params: Params, token: jax.Array, pos: jax.Array,
                        block_tables: jax.Array, cfg: llama.LlamaConfig,
-                       dcfg: DecodeConfig, pool: Cache
+                       dcfg: DecodeConfig, pool: Cache, mesh=None
                        ) -> Tuple[jax.Array, Cache]:
     """token [B] at positions pos [B], tables [B, max_blocks] →
     (logits [B, vocab], pool)."""
@@ -325,7 +329,8 @@ def _paged_decode_step(params: Params, token: jax.Array, pos: jax.Array,
     def body(carry, layer_lpool):
         layer, lpool = layer_lpool
         xc, lpool = _paged_block_decode(cfg, dcfg, carry, layer, lpool,
-                                        cos, sin, pos, block_tables)
+                                        cos, sin, pos, block_tables,
+                                        mesh=mesh)
         return xc, lpool
 
     x, pool = jax.lax.scan(body, x, (params['layers'], pool))
@@ -582,7 +587,7 @@ def _spec_draft_tokens(params: Params, token: jax.Array, pos: jax.Array,
 
 def _attend_paged_verify(dcfg: DecodeConfig, q: jax.Array, lpool: Cache,
                          block_tables: jax.Array,
-                         start_pos: jax.Array) -> jax.Array:
+                         start_pos: jax.Array, mesh=None) -> jax.Array:
     """q [B,S,H,hd] against one layer's pool; query ``i`` masks by its
     own causal length ``start_pos + i + 1``."""
     k_scale = lpool.get('k_scale')
@@ -591,7 +596,7 @@ def _attend_paged_verify(dcfg: DecodeConfig, q: jax.Array, lpool: Cache,
         return decode_attention_ops.paged_verify_attention(
             q, lpool['k'], lpool['v'], block_tables, start_pos,
             k_scale=k_scale, v_scale=v_scale,
-            interpret=dcfg.kernel_interpret)
+            interpret=dcfg.kernel_interpret, mesh=mesh)
     assert dcfg.decode_attention == 'xla', dcfg.decode_attention
     return decode_attention_ops.paged_verify_attention_xla(
         q, lpool['k'], lpool['v'], block_tables, start_pos,
@@ -600,7 +605,7 @@ def _attend_paged_verify(dcfg: DecodeConfig, q: jax.Array, lpool: Cache,
 
 def _paged_verify_step(params: Params, tokens: jax.Array, pos: jax.Array,
                        block_tables: jax.Array, cfg: llama.LlamaConfig,
-                       dcfg: DecodeConfig, pool: Cache
+                       dcfg: DecodeConfig, pool: Cache, mesh=None
                        ) -> Tuple[jax.Array, Cache]:
     """Multi-token full-model step: score tokens [B, S] (the last
     emitted token followed by S-1 drafts) at positions pos..pos+S-1 →
@@ -643,7 +648,8 @@ def _paged_verify_step(params: Params, tokens: jax.Array, pos: jax.Array,
         q = llama.apply_rope(q, cos, sin)
         k = llama.apply_rope(k, cos, sin)
         lpool = _write_kv(lpool, (blk_all, off), k, v)
-        attn = _attend_paged_verify(dcfg, q, lpool, block_tables, pos)
+        attn = _attend_paged_verify(dcfg, q, lpool, block_tables, pos,
+                                    mesh=mesh)
         attn = attn.reshape(bl, sl, cfg.n_heads * hd)
         xc = carry + llama.quant_mm(attn, layer['wo']).astype(cfg.dtype)
         return llama.ffn_sublayer(cfg, xc, layer), lpool

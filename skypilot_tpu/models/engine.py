@@ -123,6 +123,7 @@ import numpy as np
 
 from skypilot_tpu.models import decode, llama
 from skypilot_tpu.observability import journal
+from skypilot_tpu.parallel import mesh as mesh_lib
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import request_trace
 from skypilot_tpu.observability import runtime_metrics
@@ -541,33 +542,38 @@ def _engine_steps_impl(params, token, pos, done, remaining, keys, cache,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=('cfg', 'dcfg', 'n_steps'),
+                   static_argnames=('cfg', 'dcfg', 'n_steps', 'mesh'),
                    donate_argnums=(7,))
 def _engine_paged_steps_impl(params, token, pos, done, remaining, keys,
                              block_tables, cache,
                              cfg: llama.LlamaConfig,
-                             dcfg: decode.DecodeConfig, n_steps: int):
+                             dcfg: decode.DecodeConfig, n_steps: int,
+                             mesh=None):
     """Paged twin of :func:`_engine_steps_impl`: identical per-step
     semantics, but the cache is the global block pool and every K/V
     read/write indirects through ``block_tables`` [num_slots,
     max_len // block_k] (frozen lanes keep writing their frozen
     position — eviction repoints their table rows at the scratch block,
-    so those writes can never land in a reallocated block)."""
+    so those writes can never land in a reallocated block). ``mesh``
+    (static; Mesh hashes by devices + axis names) is the
+    tensor-parallel serving mesh — params/pool arrive sharded over its
+    'model' axis and the paged kernel dispatches per shard."""
     del n_steps
 
     def decode_fn(tok, p, cache_c):
         return decode._paged_decode_step(  # pylint: disable=protected-access
-            params, tok, p, block_tables, cfg, dcfg, cache_c)
+            params, tok, p, block_tables, cfg, dcfg, cache_c, mesh=mesh)
 
     return _scan_engine_steps(decode_fn, dcfg, token, pos, done,
                               remaining, keys, cache)
 
 
-@functools.partial(jax.jit, static_argnames=('cfg', 'dcfg'),
-                   donate_argnums=(4,))
-def _engine_spec_step_impl(params, token, pos, block_tables, cache,
+@functools.partial(jax.jit, static_argnames=('cfg', 'dcfg', 'mesh'),
+                   donate_argnums=(5,))
+def _engine_spec_step_impl(params, token, pos, draft_tables,
+                           block_tables, cache,
                            cfg: llama.LlamaConfig,
-                           dcfg: decode.DecodeConfig):
+                           dcfg: decode.DecodeConfig, mesh=None):
     """One speculative round over every slot in ONE dispatch: draft
     ``spec_k`` tokens per lane with the truncated-layer drafter (pool
     read-only), then one batched multi-token verify of
@@ -577,12 +583,20 @@ def _engine_spec_step_impl(params, token, pos, block_tables, cache,
     (:meth:`DecodeEngine._spec_round`): the device never needs to know
     how much of the draft survived — rejected positions are simply
     never advanced past, and their cache entries are overwritten when
-    a real token reaches them."""
+    a real token reaches them.
+
+    ``draft_tables`` is the host-narrowed ``block_tables[:, :n]`` slice
+    covering the max LIVE block count across lanes (power-of-two
+    bucketed so compiles stay bounded): the drafter's per-round history
+    gather materializes only the live prefix of the pool view instead
+    of the full table width. Verify keeps the full tables — its writes
+    land at pos..pos+spec_k, which may cross into blocks past the live
+    prefix."""
     drafts = decode._spec_draft_tokens(  # pylint: disable=protected-access
-        params, token, pos, block_tables, cfg, dcfg, cache)
+        params, token, pos, draft_tables, cfg, dcfg, cache)
     seq = jnp.concatenate([token[:, None], drafts], axis=1)
     logits, cache = decode._paged_verify_step(  # pylint: disable=protected-access
-        params, seq, pos, block_tables, cfg, dcfg, cache)
+        params, seq, pos, block_tables, cfg, dcfg, cache, mesh=mesh)
     vtok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return drafts, vtok, cache
 
@@ -627,11 +641,27 @@ class DecodeEngine:
                  name: str = 'engine',
                  paged: bool = False,
                  num_blocks: Optional[int] = None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 tp: int = 1):
         if num_slots < 1:
             raise ValueError(f'num_slots must be >= 1, got {num_slots}')
         if step_chunk < 1:
             raise ValueError(f'step_chunk must be >= 1, got {step_chunk}')
+        tp = int(tp)
+        if tp < 1:
+            raise ValueError(f'tp must be >= 1, got {tp}')
+        if tp > 1:
+            # Tensor-parallel serving shards the PAGED pool by KV head
+            # (the dense cache has no TP story — the pool is the thing
+            # being sharded) and needs the head counts to split evenly,
+            # else a shard would own a fractional GQA group.
+            if not paged:
+                raise ValueError('tensor-parallel serving (tp > 1) '
+                                 'requires paged=True')
+            if cfg.n_kv_heads % tp or cfg.n_heads % tp:
+                raise ValueError(
+                    f'tp={tp} must divide n_kv_heads '
+                    f'({cfg.n_kv_heads}) and n_heads ({cfg.n_heads})')
         if dcfg.spec_k:
             # Speculative decoding rides the paged pool (verify is a
             # multi-token decode over the block tables) and commits the
@@ -647,6 +677,18 @@ class DecodeEngine:
                 raise ValueError(
                     f'spec_drafter_layers must be in [1, '
                     f'{cfg.n_layers}], got {dcfg.spec_drafter_layers}')
+        self.tp = tp
+        # serving_mesh raises when tp exceeds the visible device count
+        # — at multi-host scale that count is the whole slice's devices
+        # (jax.distributed.initialize ran first; see
+        # parallel/distributed.py), so one engine replica spans the
+        # slice while the host-side allocator/radix cache stay exactly
+        # as they are: block tables are replicated, sharding only
+        # touches the head axis.
+        self.mesh = mesh_lib.serving_mesh(tp) if tp > 1 else None
+        if self.mesh is not None:
+            params = mesh_lib.shard_serving_params(
+                params, self.mesh, mesh_lib.serving_param_specs(cfg))
         self.params = params
         self.cfg = cfg
         self.dcfg = dcfg
@@ -737,6 +779,30 @@ class DecodeEngine:
         self._m.gauge('skytpu_engine_num_slots',
                       'Configured KV-cache lanes.').set(num_slots)
         self._publish_slot_gauges()
+        self._m.gauge(
+            'skytpu_engine_tp_degree',
+            'Tensor-parallel degree of the serving mesh (1 = '
+            'unsharded).').set(tp)
+        mesh_devices = ([d for d in self.mesh.devices.flat]
+                        if self.mesh is not None else [jax.devices()[0]])
+        self._m.gauge(
+            'skytpu_engine_mesh_devices',
+            'Devices in the engine serving mesh.').set(len(mesh_devices))
+        # engine.mesh: journaled ONCE at engine start (not per restart —
+        # the mesh is construction-time state) so perf rounds and
+        # postmortems can attribute throughput to the topology that
+        # served it.
+        self._journal_raw(journal.EventKind.ENGINE_MESH, {
+            'tp': tp,
+            'mesh_shape': (dict(self.mesh.shape)
+                           if self.mesh is not None else {'model': 1}),
+            'devices': len(mesh_devices),
+            'device_kinds': sorted({d.device_kind for d in mesh_devices}),
+            'platform': mesh_devices[0].platform,
+            'process_count': jax.process_count(),
+            'paged': self.paged,
+        })
+        self.flush_journal()
 
     def _init_runtime_state(self) -> None:
         """(Re)build everything a crashed step may have corrupted: the
@@ -751,6 +817,19 @@ class DecodeEngine:
             bk = self._block_k
             self._cache = decode.init_block_pool(
                 self.cfg, self.num_blocks, bk, self.dcfg.kv_cache_dtype)
+            if self.mesh is not None:
+                # Shard the pool over the 'model' axis by KV head: each
+                # device holds [L, n_blocks, block_k, Hkv/tp, hd] — the
+                # heads its wk/wv shard produces — so per-step writes
+                # and attention reads are all-local. The allocator,
+                # radix cache and block tables below stay HOST-side and
+                # unsharded: paging is a global concern, only the head
+                # axis splits.
+                shardings = mesh_lib.kv_cache_shardings(self.mesh,
+                                                       self._cache)
+                self._cache = {
+                    name: jax.device_put(arr, shardings[name])
+                    for name, arr in self._cache.items()}
             self._allocator = BlockAllocator(self.num_blocks)
             self._radix = RadixPrefixCache(bk, self._allocator)
             # Per-slot block-table mirror; rows of freed slots point at
@@ -1396,6 +1475,22 @@ class DecodeEngine:
         self.flush_journal()
         return active
 
+    def _tables_dev(self) -> jax.Array:
+        """The cached device copy of the block tables, uploaded lazily
+        after admission/eviction invalidates it. Under a TP mesh the
+        tables are device_put REPLICATED — every shard indirects
+        through the same int32 table (allocation/COW/prefix-match stay
+        host-global; only the KV-head axis shards)."""
+        if self._block_table_dev is None:
+            if self.mesh is not None:
+                self._block_table_dev = jax.device_put(
+                    self._block_table_np,
+                    jax.sharding.NamedSharding(
+                        self.mesh, jax.sharding.PartitionSpec()))
+            else:
+                self._block_table_dev = jnp.asarray(self._block_table_np)
+        return self._block_table_dev
+
     def _decode_round(self) -> int:
         """The non-speculative decode dispatch: ``step_chunk`` fused
         single-token steps over every slot, then host delivery. Returns
@@ -1408,15 +1503,14 @@ class DecodeEngine:
             keys = self._zero_keys
         self._note_compile('decode_steps', n_steps=n, paged=self.paged)
         if self.paged:
-            if self._block_table_dev is None:
-                self._block_table_dev = jnp.asarray(self._block_table_np)
             toks, token, pos, done, remaining, self._cache = \
                 _engine_paged_steps_impl(
                     self.params, jnp.asarray(self._token),
                     jnp.asarray(self._pos), jnp.asarray(self._done),
                     jnp.asarray(self._remaining), keys,
-                    self._block_table_dev, self._cache,
-                    cfg=self.cfg, dcfg=self.dcfg, n_steps=n)
+                    self._tables_dev(), self._cache,
+                    cfg=self.cfg, dcfg=self.dcfg, n_steps=n,
+                    mesh=self.mesh)
         else:
             toks, token, pos, done, remaining, self._cache = \
                 _engine_steps_impl(
@@ -1452,15 +1546,31 @@ class DecodeEngine:
         tail's cache entries sit in lane-private blocks past ``pos``,
         are never attended, and are overwritten when a real token
         reaches that position."""
-        if self._block_table_dev is None:
-            self._block_table_dev = jnp.asarray(self._block_table_np)
+        tables = self._tables_dev()
         k = self.dcfg.spec_k
+        # Bound the drafter's per-round history gather to the LIVE
+        # block count: the drafter only ever attends positions < pos,
+        # so gathering the full table width materializes dead pool
+        # blocks for nothing (the PR 11 follow-up noted at
+        # decode._gather_layer_kv). Power-of-two bucketing keeps the
+        # dispatch-shape count logarithmic; masked entries are exact
+        # zeros in the drafter softmax, so narrowing is
+        # numerics-invisible. Verify keeps the full tables — its
+        # writes land at pos..pos+spec_k, past the live prefix.
+        live = ~self._done
+        max_pos = int(self._pos[live].max()) if live.any() else 1
+        npb = max(1, -(-max_pos // self._block_k))
+        nb_bucket = 1
+        while nb_bucket < npb:
+            nb_bucket *= 2
+        nb_bucket = min(nb_bucket, self._max_blocks)
         self._note_compile('spec_step', spec_k=k,
-                           drafter_layers=self.dcfg.spec_drafter_layers)
+                           drafter_layers=self.dcfg.spec_drafter_layers,
+                           draft_blocks=nb_bucket)
         drafts, vtok, self._cache = _engine_spec_step_impl(
             self.params, jnp.asarray(self._token),
-            jnp.asarray(self._pos), self._block_table_dev, self._cache,
-            cfg=self.cfg, dcfg=self.dcfg)
+            jnp.asarray(self._pos), tables[:, :nb_bucket], tables,
+            self._cache, cfg=self.cfg, dcfg=self.dcfg, mesh=self.mesh)
         drafts, vtok = jax.device_get((drafts, vtok))
         emitted_total = 0
         round_drafted = 0
@@ -1763,6 +1873,7 @@ class DecodeEngine:
             'kv_cache_dtype': self.dcfg.kv_cache_dtype,
             'max_len': self.dcfg.max_len,
             'paged': self.paged,
+            'tp': self.tp,
         }
         if self.paged:
             out.update({

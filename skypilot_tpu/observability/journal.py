@@ -110,6 +110,11 @@ class EventKind(enum.Enum):
     ENGINE_RESTART = 'engine.restart'
     SERVER_DRAIN = 'server.drain'
     LB_EJECT = 'lb.eject'
+    # Tensor-parallel serving (models/engine.py): journaled once at
+    # engine start with the GSPMD mesh shape + device kinds, so perf
+    # rounds and postmortems can attribute throughput to the topology
+    # that served it.
+    ENGINE_MESH = 'engine.mesh'
 
 
 KINDS = frozenset(k.value for k in EventKind)

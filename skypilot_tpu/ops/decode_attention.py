@@ -62,8 +62,14 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
 
 NEG_INF = -1e30
+
+# Serving tensor parallelism: the named mesh axis the KV-head dim
+# shards over (== parallel/mesh.MODEL_AXIS; a string literal keeps this
+# module free of a parallel/ import at module load).
+TP_AXIS = 'model'
 
 # Default KV block: small enough that skipping tracks cur_len closely at
 # serving lengths (prompt 128 + 128 new = 2 blocks), large enough that
@@ -391,17 +397,68 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
                            cur_len: jax.Array,
                            k_scale: Optional[jax.Array] = None,
                            v_scale: Optional[jax.Array] = None,
-                           interpret: Optional[bool] = None) -> jax.Array:
+                           interpret: Optional[bool] = None,
+                           mesh=None) -> jax.Array:
     """Kernel when it can run (TPU, or forced interpreter), XLA otherwise
     (mirrors :func:`decode_attention`; the pool's block_k is the kernel
-    block size, so there is no divisibility fallback to consider)."""
+    block size, so there is no divisibility fallback to consider).
+
+    ``mesh`` (a Mesh whose 'model' axis is the TP degree) selects the
+    tensor-parallel kernel dispatch: the pool arrives sharded by KV
+    head, so the kernel runs per shard under shard_map (see
+    :func:`_paged_kernel_tp`). The XLA fallback needs no such wrapper —
+    its gather + grouped einsum partition under plain GSPMD."""
     itp = _resolve_interpret(interpret)
     if itp is None:
         return paged_decode_attention_xla(q, k_pool, v_pool, block_tables,
                                           cur_len, k_scale, v_scale)
+    if mesh is not None and mesh.shape.get(TP_AXIS, 1) > 1:
+        return _paged_kernel_tp(paged_decode_attention_kernel, mesh, q,
+                                k_pool, v_pool, block_tables, cur_len,
+                                k_scale, v_scale, itp)
     return paged_decode_attention_kernel(q, k_pool, v_pool, block_tables,
                                          cur_len, k_scale, v_scale,
                                          interpret=itp)
+
+
+def _paged_kernel_tp(kernel_fn, mesh, q: jax.Array, k_pool: jax.Array,
+                     v_pool: jax.Array, block_tables: jax.Array,
+                     lens: jax.Array, k_scale: Optional[jax.Array],
+                     v_scale: Optional[jax.Array],
+                     interpret: bool) -> jax.Array:
+    """Tensor-parallel paged-kernel dispatch: shard_map over the
+    'model' (KV-head) axis.
+
+    Pallas calls are opaque to GSPMD's automatic partitioner, so the
+    sharding is made explicit: each device runs the UNMODIFIED paged
+    kernel on its local ``Hkv / tp`` pool shard and the matching
+    ``H / tp`` query heads (q heads are grouped kv-head-major, so a
+    contiguous head split keeps every GQA group on the device that
+    holds its kv head — no cross-device attention traffic at all; the
+    one per-sublayer all-reduce lives in the wo projection outside this
+    op). Block tables and lengths are replicated — paging stays a
+    host-global concern; only the head axis shards."""
+    from skypilot_tpu.parallel import compat  # pylint: disable=import-outside-toplevel
+    head = P(None, None, TP_AXIS, None)
+    pool = P(None, None, TP_AXIS, None)
+    scale = P(None, None, TP_AXIS)
+    rep = P(None, None)
+    has_scale = k_scale is not None
+    in_specs = [head, pool, pool, rep, P(None)]
+    if has_scale:
+        in_specs += [scale, scale]
+
+    def inner(q_l, k_l, v_l, bt, ln, *scales):
+        ks, vs = scales if scales else (None, None)
+        return kernel_fn(q_l, k_l, v_l, bt, ln, ks, vs,
+                         interpret=interpret)
+
+    fn = compat.shard_map(inner, mesh=mesh, in_specs=tuple(in_specs),
+                          out_specs=head, check_vma=False)
+    args = (q, k_pool, v_pool, block_tables, lens)
+    if has_scale:
+        args += (k_scale, v_scale)
+    return fn(*args)
 
 
 # ----------------------------------------------------- paged verify (q>1)
@@ -615,13 +672,20 @@ def paged_verify_attention(q: jax.Array, k_pool: jax.Array,
                            start_pos: jax.Array,
                            k_scale: Optional[jax.Array] = None,
                            v_scale: Optional[jax.Array] = None,
-                           interpret: Optional[bool] = None) -> jax.Array:
+                           interpret: Optional[bool] = None,
+                           mesh=None) -> jax.Array:
     """Kernel when it can run (TPU, or forced interpreter), XLA otherwise
-    (mirrors :func:`paged_decode_attention`)."""
+    (mirrors :func:`paged_decode_attention`, tensor-parallel dispatch
+    included — the verify q is [B, S, H, hd] but the head axis sits at
+    the same position, so the shard_map specs are shared)."""
     itp = _resolve_interpret(interpret)
     if itp is None:
         return paged_verify_attention_xla(q, k_pool, v_pool, block_tables,
                                           start_pos, k_scale, v_scale)
+    if mesh is not None and mesh.shape.get(TP_AXIS, 1) > 1:
+        return _paged_kernel_tp(paged_verify_attention_kernel, mesh, q,
+                                k_pool, v_pool, block_tables, start_pos,
+                                k_scale, v_scale, itp)
     return paged_verify_attention_kernel(q, k_pool, v_pool, block_tables,
                                          start_pos, k_scale, v_scale,
                                          interpret=itp)

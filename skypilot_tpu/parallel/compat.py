@@ -1,0 +1,41 @@
+"""Version-portable shard_map / varying-axis helpers.
+
+jax moved ``shard_map`` out of ``jax.experimental`` (where it took
+``check_rep``) to top-level ``jax.shard_map`` (where the flag is
+``check_vma`` and replicated-carry marking uses ``jax.lax.pcast``).
+The repo pins no jax version — the container bakes whichever toolchain
+ships with jax_graft — so every shard_map call site goes through this
+shim instead of betting on one API generation.
+"""
+from typing import Optional
+
+import jax
+
+_NEW_API = hasattr(jax, 'shard_map')
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` when present, else the ``jax.experimental``
+    spelling. ``check_vma`` is honored only on the new API: the old
+    ``check_rep`` machinery predates pvary/pcast, so a body that marks
+    its carries varying for the vma check (pipeline.py) would trip the
+    old checker for the wrong reason — on old jax the check is always
+    off."""
+    if _NEW_API:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental import shard_map as _sm  # pylint: disable=import-outside-toplevel
+    return _sm.shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
+
+def pvary(x, axes):
+    """Mark a (replicated) value as varying over ``axes`` so scan carry
+    types stay stable under the new API's vma check. Old jax has no
+    pcast/pvary — and no vma check when ``check_rep=False`` — so the
+    value passes through unchanged there."""
+    if hasattr(jax.lax, 'pcast'):
+        return jax.lax.pcast(x, axes, to='varying')
+    if hasattr(jax.lax, 'pvary'):
+        return jax.lax.pvary(x, axes)
+    return x

@@ -1,0 +1,80 @@
+"""jax.distributed bootstrap from the gang-runtime environment.
+
+``skylet.gang_run`` injects the multi-host rendezvous envs into every
+rank's task environment (``build_rank_envs``): ``JAX_COORDINATOR_ADDRESS``
+(head host + fixed port), ``JAX_NUM_PROCESSES`` and ``JAX_PROCESS_ID``.
+This module turns those into a ``jax.distributed.initialize`` call, so a
+task that simply runs ``python -m skypilot_tpu.serve.model_server`` on
+every host of a gang-provisioned slice forms ONE jax runtime spanning
+the slice — ``jax.devices()`` then enumerates the whole slice's chips
+and the engine's tensor-parallel mesh (``mesh.serving_mesh``) can cover
+them, which is what turns "one replica per host" into "one replica per
+slice".
+
+Call :func:`maybe_initialize` before the first jax device access.
+Idempotent and safe everywhere: no coordinator env / one process →
+no-op, so the same entry point serves laptops, single-host replicas and
+pod slices. ``SKYTPU_DISABLE_JAX_DISTRIBUTED=1`` opts out (e.g. running
+several independent single-host replicas on the hosts of one slice).
+"""
+import os
+from typing import Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.skylet import constants
+
+logger = sky_logging.init_logger(__name__)
+
+DISABLE_ENV = 'SKYTPU_DISABLE_JAX_DISTRIBUTED'
+
+_initialized = False
+
+
+def distributed_env() -> Optional[dict]:
+    """The gang-injected rendezvous triple, or None when this process
+    is not part of a multi-process gang (no coordinator env, or a
+    single-process gang — nothing to rendezvous)."""
+    coordinator = os.environ.get(constants.JAX_COORDINATOR_ENV)
+    if not coordinator:
+        return None
+    try:
+        num_processes = int(
+            os.environ.get(constants.JAX_NUM_PROCESSES_ENV, '1'))
+        process_id = int(
+            os.environ.get(constants.JAX_PROCESS_ID_ENV, '0'))
+    except ValueError:
+        logger.warning(
+            f'Malformed {constants.JAX_NUM_PROCESSES_ENV}/'
+            f'{constants.JAX_PROCESS_ID_ENV}; skipping '
+            'jax.distributed init.')
+        return None
+    if num_processes <= 1:
+        return None
+    return {
+        'coordinator_address': coordinator,
+        'num_processes': num_processes,
+        'process_id': process_id,
+    }
+
+
+def maybe_initialize() -> bool:
+    """``jax.distributed.initialize`` from the gang env plumbing.
+
+    Returns True when a multi-process runtime was (or already is)
+    initialized. MUST run before the first device access — libtpu
+    client setup happens at backend init."""
+    global _initialized
+    if _initialized:
+        return True
+    if os.environ.get(DISABLE_ENV, '').lower() in ('1', 'true'):
+        return False
+    env = distributed_env()
+    if env is None:
+        return False
+    import jax  # pylint: disable=import-outside-toplevel
+    logger.info(
+        f'jax.distributed: process {env["process_id"]}/'
+        f'{env["num_processes"]} via {env["coordinator_address"]}')
+    jax.distributed.initialize(**env)
+    _initialized = True
+    return True

@@ -128,11 +128,99 @@ def batch_seq_spec() -> P:
     return P((DATA_AXIS, FSDP_AXIS), SEQ_AXIS)
 
 
+def serving_mesh(tp: int,
+                 devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Tensor-parallel serving mesh: ``tp`` devices on the 'model' axis
+    (every other axis size 1). 'model' is the innermost axis, so the TP
+    collectives (the attention/FFN output all-reduces GSPMD inserts)
+    ride neighboring ICI links on a real slice. Uses the FIRST ``tp``
+    visible devices — under ``jax.distributed`` on a pod slice that is
+    the slice's device order, so one engine replica spans the slice."""
+    if tp < 1:
+        raise ValueError(f'tp must be >= 1, got {tp}')
+    devices = list(devices if devices is not None else jax.devices())
+    if tp > len(devices):
+        raise ValueError(
+            f'tp={tp} exceeds the {len(devices)} visible device(s)')
+    return make_mesh(MeshConfig(data=1, fsdp=1, model=tp),
+                     devices=devices[:tp])
+
+
+def serving_param_specs(cfg) -> dict:
+    """Megatron-style TP specs for the SERVING path (no fsdp axis in
+    play): attention/FFN projections shard their head/contraction dims
+    over 'model' (wq/wk/wv/w1/w3 column-parallel, wo/w2 row-parallel —
+    GSPMD inserts exactly one all-reduce per sublayer), the lm_head
+    shards its vocab columns (logits stay vocab-sharded; argmax is
+    collective-cheap), and the small embedding/norm tensors replicate.
+    Sharding wk/wv outputs over 'model' is what makes the per-device KV
+    cache hold ``Hkv / tp`` heads — the cache sharding of
+    :func:`kv_cache_specs` follows from it."""
+    del cfg
+    return {
+        'tok_embedding': P(None, None),
+        'layers': {
+            'attn_norm': P(None, None),
+            'wq': P(None, None, MODEL_AXIS),
+            'wk': P(None, None, MODEL_AXIS),
+            'wv': P(None, None, MODEL_AXIS),
+            'wo': P(None, MODEL_AXIS, None),
+            'ffn_norm': P(None, None),
+            'w1': P(None, None, MODEL_AXIS),
+            'w3': P(None, None, MODEL_AXIS),
+            'w2': P(None, MODEL_AXIS, None),
+        },
+        'out_norm': P(None),
+        'lm_head': P(None, MODEL_AXIS),
+    }
+
+
+def kv_cache_spec() -> P:
+    """KV cache/pool sharding under serving TP: the KV-head axis (dim 3
+    of both the dense ``[L, B, max_len, Hkv, hd]`` cache and the paged
+    ``[L, n_blocks, block_k, Hkv, hd]`` pool) shards over 'model' —
+    each device holds the K/V of exactly the heads its wk/wv shard
+    produces, so per-step cache writes and attention reads are
+    all-local. Scale planes drop the trailing head_dim."""
+    return P(None, None, None, MODEL_AXIS, None)
+
+
+def kv_cache_shardings(mesh: Mesh, cache: dict) -> dict:
+    """NamedShardings for a decode cache pytree (``{'k','v'}`` +
+    optional int8 ``{'k_scale','v_scale'}`` planes)."""
+    spec = kv_cache_spec()
+    out = {}
+    for name, arr in cache.items():
+        s = P(*spec[:arr.ndim]) if arr.ndim < len(spec) else spec
+        out[name] = NamedSharding(mesh, s)
+    return out
+
+
 def shard_params(params, mesh: Mesh, specs) -> 'jax.Array':
     """Device-put a param pytree with a matching PartitionSpec pytree."""
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params,
         specs)
+
+
+def shard_serving_params(params, mesh: Mesh, specs):
+    """Like :func:`shard_params`, but the spec tree may be a PREFIX of
+    the param tree: int8-quantized weights are QuantizedTensor pytrees
+    ({values [L, in, out], scale [L, 1, out]}) under one spec leaf.
+    Size-1 dims drop their spec axis per leaf — a quantized scale's
+    contraction dim is 1 and cannot shard over a >1 axis (device_put
+    would reject it), so e.g. a row-parallel ``P(None, 'model', None)``
+    wo spec becomes ``P(None, None, None)`` for wo.scale while the
+    output-channel axis still shards alongside the values."""
+    def _put(x, s):
+        fitted = P(*[a if x.shape[i] > 1 else None
+                     for i, a in enumerate(tuple(s))])
+        return jax.device_put(x, NamedSharding(mesh, fitted))
+
+    return jax.tree.map(
+        lambda s, sub: jax.tree.map(lambda x: _put(x, s), sub),
+        specs, params,
+        is_leaf=lambda x: isinstance(x, P))
 
 
 def spec_to_sharding(mesh: Mesh, spec_tree):

@@ -23,6 +23,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from skypilot_tpu.models import llama
+from skypilot_tpu.parallel import compat
 from skypilot_tpu.parallel.mesh import DATA_AXIS
 
 STAGE_AXIS = 'stage'
@@ -75,9 +76,10 @@ def _gpipe_shard(stage_fn: Callable, layers, xs: jax.Array,
     ys0 = jnp.zeros_like(xs)
     # The carries become device-varying after the first ppermute/write;
     # mark the (replicated-zero) initial values as varying so the scan's
-    # carry type is stable (shard_map vma check).
-    buf0 = jax.lax.pcast(buf0, (STAGE_AXIS, DATA_AXIS), to='varying')
-    ys0 = jax.lax.pcast(ys0, (STAGE_AXIS,), to='varying')
+    # carry type is stable (shard_map vma check; no-op on jax versions
+    # without the check).
+    buf0 = compat.pvary(buf0, (STAGE_AXIS, DATA_AXIS))
+    ys0 = compat.pvary(ys0, (STAGE_AXIS,))
     (_, ys), _ = jax.lax.scan(tick, (buf0, ys0), jnp.arange(ticks))
     # Replicate the final-stage outputs across the stage axis (masked
     # psum; its transpose under AD routes cotangents back to the last
@@ -136,12 +138,16 @@ def pipeline_loss_fn(params, tokens: jax.Array, targets: jax.Array,
 
     layer_specs = jax.tree.map(lambda _: P(STAGE_AXIS),
                                params['layers'])
-    pipelined = jax.shard_map(
+    pipelined = compat.shard_map(
         functools.partial(_gpipe_shard, stage_fn,
                           num_stages=num_stages),
         mesh=mesh,
         in_specs=(layer_specs, P(None, DATA_AXIS)),
         out_specs=P(None, DATA_AXIS),
+        # Keep the vma replication check ON where it exists (new-API
+        # jax) — the pvary carry marking above exists to satisfy it,
+        # and it catches wrong-axis psum/ppermute bugs at trace time.
+        check_vma=True,
     )
     ys = pipelined(params['layers'], xs)          # [M, mb, S, D]
     y = ys.reshape(b, s, cfg.dim)

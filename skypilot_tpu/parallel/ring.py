@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.parallel import compat
 
 NEG_INF = -1e30
 
@@ -85,7 +86,7 @@ def ring_attention(q: jax.Array,
 
     divisible by the seq-axis size."""
     spec = P(batch_axes, seq_axis, head_axis, None)
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         functools.partial(ring_attention_inner, axis_name=seq_axis),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -119,6 +119,12 @@ DEFAULT_STOP_TIMEOUT_SECONDS = 10.0
 # engine step (0 disables) and the truncated-layer drafter's depth.
 SPEC_K_ENV = 'SKYTPU_SPEC_K'
 SPEC_DRAFTER_LAYERS_ENV = 'SKYTPU_SPEC_DRAFTER_LAYERS'
+# Tensor parallelism: shard the engine (weights + paged KV pool) over
+# this many devices on a named GSPMD 'model' mesh axis. 1 = unsharded.
+# On a gang-provisioned slice the jax.distributed bootstrap runs first,
+# so the degree may span the whole slice's devices — one replica per
+# SLICE, serving models larger than one host's HBM.
+SERVE_TP_ENV = 'SKYTPU_SERVE_TP'
 
 # skytpu_server_state gauge values (the LB/operators read the metric;
 # /healthz carries the string).
@@ -638,14 +644,15 @@ def build_engine(model: str, num_slots: int, max_len: int,
                  block_k: Optional[int] = None,
                  spec_k: Optional[int] = None,
                  drafter_layers: Optional[int] = None,
-                 prefill_chunk: Optional[int] = None
+                 prefill_chunk: Optional[int] = None,
+                 tp: Optional[int] = None
                  ) -> engine_lib.DecodeEngine:
     """Assemble params + configs into a DecodeEngine (CLI + tests).
 
-    ``spec_k``/``drafter_layers``/``prefill_chunk`` default from
+    ``spec_k``/``drafter_layers``/``prefill_chunk``/``tp`` default from
     ``SKYTPU_SPEC_K`` / ``SKYTPU_SPEC_DRAFTER_LAYERS`` /
-    ``SKYTPU_PREFILL_CHUNK`` so a deployed replica can be tuned via the
-    task's envs without a CLI change."""
+    ``SKYTPU_PREFILL_CHUNK`` / ``SKYTPU_SERVE_TP`` so a deployed
+    replica can be tuned via the task's envs without a CLI change."""
     import jax
     cfg = llama.CONFIGS[model]
     params = llama.init_params(jax.random.PRNGKey(seed), cfg)
@@ -676,10 +683,19 @@ def build_engine(model: str, num_slots: int, max_len: int,
         dcfg_kwargs['spec_drafter_layers'] = min(drafter_layers,
                                                  cfg.n_layers)
     dcfg = decode.DecodeConfig(**dcfg_kwargs)
+    if tp is None:
+        # Strict parse, no env_int swallow-and-default: a replica
+        # sized for tp=16 silently starting unsharded (mis-rendered
+        # template, leftover placeholder) would be discovered from OOM
+        # symptoms instead of a startup error.
+        raw = os.environ.get(SERVE_TP_ENV, '')
+        tp = int(raw) if raw else 1
+    # tp also passes through UNclamped: a nonpositive degree is a
+    # misconfiguration the engine rejects loudly.
     return engine_lib.DecodeEngine(params, cfg, dcfg, num_slots,
                                    step_chunk=step_chunk, name=model,
                                    paged=paged, num_blocks=num_blocks,
-                                   prefill_chunk=prefill_chunk)
+                                   prefill_chunk=prefill_chunk, tp=tp)
 
 
 def main() -> None:
@@ -734,11 +750,25 @@ def main() -> None:
                              'chunk-per-step prefills interleaved with '
                              'decode (default SKYTPU_PREFILL_CHUNK or '
                              '0 = off)')
+    parser.add_argument('--tp', type=int, default=None,
+                        help='tensor-parallel degree: shard weights + '
+                             'the paged KV pool over this many devices '
+                             'on a GSPMD model axis (requires --paged; '
+                             'default SKYTPU_SERVE_TP or 1 = unsharded; '
+                             'at multi-host scale the jax.distributed '
+                             'bootstrap makes the whole slice devices '
+                             'visible first)')
     parser.add_argument('--checkpoint-dir', default=None,
                         help='restore params from models/checkpoint '
                              'layout (default: random init — demo mode)')
     parser.add_argument('--seed', type=int, default=0)
     args = parser.parse_args()
+    # Multi-host slices: join the gang's jax.distributed rendezvous
+    # BEFORE the first device access, so the engine mesh below can span
+    # every host of the slice (one serving replica per slice). No-op
+    # outside a gang.
+    from skypilot_tpu.parallel import distributed
+    distributed.maybe_initialize()
     engine = build_engine(args.model, args.num_slots, args.max_len,
                           temperature=args.temperature,
                           eos_id=args.eos_id, kv_int8=args.kv_int8,
@@ -750,7 +780,8 @@ def main() -> None:
                           block_k=args.block_k,
                           spec_k=args.spec_k,
                           drafter_layers=args.drafter_layers,
-                          prefill_chunk=args.prefill_chunk)
+                          prefill_chunk=args.prefill_chunk,
+                          tp=args.tp)
     server = ModelServer(engine, args.port, host=args.host,
                          default_max_new_tokens=args.max_new_tokens)
     server.run_forever()

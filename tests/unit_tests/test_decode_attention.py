@@ -350,3 +350,62 @@ def test_paged_verify_dispatch_falls_back_to_xla_off_tpu():
     out = da.paged_verify_attention(q, kp, vp, bt, start)
     ref = da.paged_verify_attention_xla(q, kp, vp, bt, start)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+# ------------------------------------------------- tensor-parallel (TP)
+
+
+def _tp_shard(x, mesh, dims):
+    """device_put with 'model' on the given dim (None elsewhere)."""
+    spec = jax.sharding.PartitionSpec(
+        *['model' if i in dims else None for i in range(x.ndim)])
+    return jax.device_put(x, jax.sharding.NamedSharding(mesh, spec))
+
+
+def test_paged_kernel_tp_dispatch_matches_xla():
+    """The shard_map TP dispatch (mesh= with a >1 'model' axis) runs
+    the unmodified kernel per KV-head shard and must equal the
+    unsharded XLA reference — head sharding is layout, not numerics."""
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    mesh = mesh_lib.serving_mesh(2)
+    q, k, v = _rand_case(jax.random.PRNGKey(31), b=2, t=64, h=4, hkv=2,
+                         hd=16)
+    cur = jnp.array([17, 40], jnp.int32)
+    kp, vp, bt = _paged_from_dense(k, v, block_k=16)
+    ref = da.paged_decode_attention_xla(q, kp, vp, bt, cur)
+    out = da.paged_decode_attention(
+        _tp_shard(q, mesh, (2,)), _tp_shard(kp, mesh, (2,)),
+        _tp_shard(vp, mesh, (2,)), bt, cur, interpret=True, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    # mesh with a size-1 model axis (tp=1) takes the plain kernel path.
+    out1 = da.paged_decode_attention(q, kp, vp, bt, cur, interpret=True,
+                                     mesh=mesh_lib.serving_mesh(1))
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_paged_verify_kernel_tp_dispatch_matches_xla():
+    """TP dispatch of the multi-token verify kernel (int8 pool: the
+    scale planes shard by KV head alongside the values)."""
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    mesh = mesh_lib.serving_mesh(2)
+    key = jax.random.PRNGKey(32)
+    kq, kk, kv = jax.random.split(key, 3)
+    b, t, s, h, hkv, hd, bk = 2, 64, 3, 4, 2, 16, 16
+    q = jax.random.normal(kq, (b, s, h, hd), jnp.float32)
+    k = jax.random.normal(kk, (b, t, hkv, hd), jnp.float32)
+    v = jax.random.normal(kv, (b, t, hkv, hd), jnp.float32)
+    kq8, ks = quant.quantize_kv(k)
+    vq8, vs = quant.quantize_kv(v)
+    kp, vp, bt, ksp, vsp = _paged_from_dense(k=kq8, v=vq8, block_k=bk,
+                                             k_scale=ks, v_scale=vs)
+    start = jnp.array([11, 40], jnp.int32)
+    ref = da.paged_verify_attention_xla(q, kp, vp, bt, start, ksp, vsp)
+    out = da.paged_verify_attention(
+        _tp_shard(q, mesh, (2,)), _tp_shard(kp, mesh, (2,)),
+        _tp_shard(vp, mesh, (2,)), bt, start,
+        k_scale=_tp_shard(ksp, mesh, (2,)),
+        v_scale=_tp_shard(vsp, mesh, (2,)), interpret=True, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)

@@ -325,7 +325,10 @@ def test_all_registered_metric_names_match_convention():
                      'skytpu_engine_spec_accepted_total',
                      'skytpu_engine_spec_accept_ratio',
                      'skytpu_engine_prefill_chunks_total',
-                     'skytpu_engine_compiles_total'):
+                     'skytpu_engine_compiles_total',
+                     # Tensor-parallel serving (ISSUE 12).
+                     'skytpu_engine_tp_degree',
+                     'skytpu_engine_mesh_devices'):
         assert expected in names, f'{expected} not found by lint scan'
 
 
@@ -378,7 +381,9 @@ def test_all_journal_event_kinds_are_registered():
                      'LB_EJECT',
                      # Speculative decoding + chunked prefill
                      # (ISSUE 11).
-                     'ENGINE_COMPILE'):
+                     'ENGINE_COMPILE',
+                     # Tensor-parallel serving mesh (ISSUE 12).
+                     'ENGINE_MESH'):
         assert expected in attr_names, \
             f'EventKind.{expected} not found by lint scan'
 
