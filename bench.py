@@ -239,6 +239,12 @@ def _payload() -> None:
     if bf16 and kv8:
         decode_detail['kv_int8_speedup'] = round(kv8 / bf16, 3)
     result['detail']['decode'] = decode_detail
+    # Control-plane SLO ledger (journal-derived p99 launch latency /
+    # recovery time + the SKYTPU_BENCH_SLO_P99_LAUNCH_GATE verdict):
+    # every perf round records what the control plane cost beside what
+    # the chip delivered.
+    from skypilot_tpu.observability import slo as slo_lib
+    result['detail']['control_plane_slo'] = slo_lib.bench_slo_block()
     # Cumulative line #2: train + decode. Last line wins.
     print(json.dumps(result), flush=True)
 
@@ -278,6 +284,11 @@ def _payload_sched() -> None:
             'base_per_token_ms', 'spec_per_token_ms',
             'per_token_speedup')},
     }
+    # Control-plane SLO ledger rides the dark tier too: even a round
+    # with no TPU reports what the control plane's launch/recovery
+    # latency looked like (and whether the regression gate held).
+    from skypilot_tpu.observability import slo as slo_lib
+    out['detail']['control_plane_slo'] = slo_lib.bench_slo_block()
     print(json.dumps(out), flush=True)
 
 

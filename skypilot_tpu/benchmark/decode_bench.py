@@ -46,20 +46,36 @@ import contextlib
 
 
 @contextlib.contextmanager
-def _journal_disabled():
-    """Silence the flight recorder for measured engine passes: a
-    synthetic bench's admit/evict stream is journal noise, and per-tick
-    sqlite commits would tax only the engine side of a comparison."""
+def _journal_slow_requests_only():
+    """Filter the flight recorder down to ``engine.slow_request`` for
+    measured engine passes: a synthetic bench's admit/evict stream is
+    journal noise, and per-tick sqlite commits would tax only the
+    engine side of a comparison — but every bench request carries a
+    trace id (see :func:`_bench_requests_with_trace`), so a lane that
+    breaches the slow-request SLO still journals its phase timeline and
+    stays joinable via ``skytpu trace <id>`` after the bench exits. In
+    the common no-breach case nothing is written at all (the filtered
+    batch is empty before it touches sqlite)."""
     from skypilot_tpu.observability import journal as journal_lib
-    prev = os.environ.get(journal_lib.DISABLE_ENV)
-    os.environ[journal_lib.DISABLE_ENV] = '1'
+    prev = os.environ.get(journal_lib.ONLY_KINDS_ENV)
+    os.environ[journal_lib.ONLY_KINDS_ENV] = \
+        journal_lib.EventKind.ENGINE_SLOW_REQUEST.value
     try:
         yield
     finally:
         if prev is None:
-            os.environ.pop(journal_lib.DISABLE_ENV, None)
+            os.environ.pop(journal_lib.ONLY_KINDS_ENV, None)
         else:
-            os.environ[journal_lib.DISABLE_ENV] = prev
+            os.environ[journal_lib.ONLY_KINDS_ENV] = prev
+
+
+def _bench_requests_with_trace(engine_lib, requests):
+    """Engine requests for one bench pass, each stamped with a fresh
+    trace id — the join key a slow lane's ``engine.slow_request``
+    journal row (and any operator-side `skytpu trace`) needs."""
+    from skypilot_tpu.observability import trace as trace_lib
+    return [engine_lib.Request(p, m, trace_id=trace_lib.new_trace_id())
+            for p, m in requests]
 
 
 def _resolve_tp(tp: int, model_name: str, devices) -> int:
@@ -313,7 +329,7 @@ def run_mixed_bench(model_name: str, num_slots: int,
         eng = engine_lib.DecodeEngine(params, cfg, dcfg, num_slots,
                                       step_chunk=step_chunk,
                                       name='decode-bench', paged=paged)
-        reqs = [engine_lib.Request(p, m) for p, m in requests]
+        reqs = _bench_requests_with_trace(engine_lib, requests)
         for r in reqs:
             eng.submit(r)
         while not all(r.done for r in reqs):
@@ -330,7 +346,7 @@ def run_mixed_bench(model_name: str, num_slots: int,
         return (time.perf_counter() - t0) / n, out
 
     beat('decode_mixed_compile')
-    with _journal_disabled():
+    with _journal_slow_requests_only():
         static_dt, (static_useful, static_lane_steps) = timed(run_static,
                                                               steps)
         engine_dt, (engine_useful, engine_occupancy, engine_slo) = timed(
@@ -405,7 +421,7 @@ def _prefix_requests(vocab_size: int, n_requests: int, prefix_len: int,
 def _drive_engine(eng, engine_lib, requests):
     """Submit all requests, step to drain; returns (useful_tokens,
     max_concurrent_active, steps)."""
-    reqs = [engine_lib.Request(p, m) for p, m in requests]
+    reqs = _bench_requests_with_trace(engine_lib, requests)
     for r in reqs:
         eng.submit(r)
     max_active = 0
@@ -491,7 +507,7 @@ def run_prefix_bench(model_name: str, num_slots: int = 8,
         return (time.perf_counter() - t0) / n, out
 
     beat('decode_prefix_compile')
-    with _journal_disabled():
+    with _journal_slow_requests_only():
         dense_dt, (dense_useful, dense_conc, _, _, _) = timed(
             lambda: run(False), steps)
         paged_dt, (paged_useful, paged_conc, _, pstats, pslo) = timed(
@@ -608,7 +624,7 @@ def run_spec_bench(model_name: str = 'debug', num_slots: int = 4,
         return (time.perf_counter() - t0) / n, out
 
     beat('spec_compile')
-    with _journal_disabled():
+    with _journal_slow_requests_only():
         base_dt, (base_useful, base_steps, _, _) = timed(
             lambda: run(False), steps)
         spec_dt, (spec_useful, spec_steps, sstats, sspec) = timed(
@@ -701,7 +717,7 @@ def run_scheduler_bench(steps: int = 2, beat=None, seed: int = 0,
     num_blocks = num_slots * (max_len // block_k) + 1
 
     beat('sched_compile')
-    with _journal_disabled():
+    with _journal_slow_requests_only():
         def run(paged):
             eng = engine_lib.DecodeEngine(
                 params, cfg, dcfg_paged if paged else dcfg,

@@ -375,18 +375,37 @@ def requests_cmd(endpoint, limit):
 
 @cli.command()
 @click.argument('endpoint', required=False, default=None)
-def slo(endpoint):
-    """Rolling SLO surface of a model server.
+@click.option('--control-plane', is_flag=True, default=False,
+              help='Render the journal-derived control-plane SLO '
+                   'ledger (p50/p95/p99 launch latency and managed-job '
+                   'recovery time) from the local flight recorder '
+                   'instead of fetching a server endpoint.')
+def slo(endpoint, control_plane):
+    """Rolling SLO surface of a model server, an LB fleet, or the
+    control plane.
 
     Reads ENDPOINT's /slo (default http://127.0.0.1:8000): p50/p95/p99
     for queue wait, prefill, TTFT, per-token and total request latency
     over the completed-request window, plus reject/error/slow rates and
     the active SKYTPU_SLOW_REQUEST_SECONDS / SKYTPU_TTFT_SLO_SECONDS
-    thresholds.
+    thresholds. Pointed at a LOAD BALANCER, /slo answers with the
+    cross-replica fleet rollup (per-replica + fleet-wide percentiles,
+    straggler flags) and is rendered as the fleet table.
+    --control-plane reads no endpoint at all: it derives launch/
+    recovery percentiles from the local journal (the same block
+    bench.py records per perf round).
     """
     from skypilot_tpu.observability import request_trace
-    click.echo(request_trace.format_slo(
-        _fetch_server_json(endpoint, '/slo')))
+    from skypilot_tpu.observability import slo as slo_lib
+    if control_plane:
+        click.echo(slo_lib.format_control_plane(
+            slo_lib.control_plane_slo()))
+        return
+    body = _fetch_server_json(endpoint, '/slo')
+    if body.get('kind') == 'fleet':
+        click.echo(slo_lib.format_fleet_slo(body))
+    else:
+        click.echo(request_trace.format_slo(body))
 
 
 @cli.command()

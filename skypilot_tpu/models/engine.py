@@ -432,7 +432,8 @@ class Request:
                  on_token: Optional[Callable[[int, bool], None]] = None,
                  request_id: Optional[str] = None,
                  tenant: str = 'default',
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 span_id: Optional[str] = None):
         if max_new_tokens < 1:
             raise ValueError(f'max_new_tokens must be >= 1, got '
                              f'{max_new_tokens}')
@@ -451,8 +452,13 @@ class Request:
         # Per-request trace id (the server's X-Request-Id): the engine
         # stamps this request's journal rows with it, so `skytpu trace
         # <id>` joins the HTTP request to its engine timeline. None →
-        # rows carry the ambient process trace context.
+        # rows carry the ambient process trace context. span_id (the
+        # model server's per-request `server.request` span) nests those
+        # rows under the HTTP span in the rendered tree, which itself
+        # parents under the LB's `lb.proxy` span when the request came
+        # through the load balancer.
         self.trace_id = trace_id
+        self.span_id = span_id
         self.tokens: List[int] = []
         self.finish_reason: Optional[str] = None
         self.enqueue_ts: Optional[float] = None
@@ -757,12 +763,11 @@ class DecodeEngine:
         self._admitted = 0
         self._evicted = 0
         # Flight-recorder buffer: admit/evict events batch into ONE
-        # sqlite transaction per tick (journal.event_batch) — a per-event
-        # commit costs an fsync, which at token-loop rates would dominate
-        # the decode step itself on slow filesystems. Locked: stats()
-        # flushes from the HTTP thread while the loop appends.
-        self._journal_lock = threading.Lock()
-        self._journal_buf: List[tuple] = []
+        # sqlite transaction per tick (journal.JournalBuffer) — a
+        # per-event commit costs an fsync, which at token-loop rates
+        # would dominate the decode step itself on slow filesystems.
+        # stats() flushes from the HTTP thread while the loop appends.
+        self._jbuf = journal.JournalBuffer()
         # Request-telemetry plane: per-request phase records assembled
         # at the admit/evict/reject choke points (the per-token hot path
         # stays untouched) + the per-step profiler behind /debug/engine.
@@ -802,7 +807,65 @@ class DecodeEngine:
             'process_count': jax.process_count(),
             'paged': self.paged,
         })
+        # HBM accounting: per-device weights vs KV-pool vs workspace
+        # bytes on the serving mesh, journaled once beside engine.mesh
+        # (construction-time state like the mesh itself — the
+        # supervisor rebuild re-creates an identically-sized pool) and
+        # published as skytpu_engine_hbm_bytes{kind}.
+        hbm = self._hbm_accounting(mesh_devices[0])
+        hbm_g = self._m.gauge(
+            'skytpu_engine_hbm_bytes',
+            'Per-device HBM bytes by consumer: sharded weights, the '
+            'paged KV pool (or dense cache), and measured workspace '
+            'residual.', labels=('kind',))
+        for kind, nbytes in hbm['per_device_bytes'].items():
+            hbm_g.set(nbytes, labels=(kind,))
+        self._journal_raw(journal.EventKind.ENGINE_HBM,
+                          {'tp': tp, **hbm})
         self.flush_journal()
+
+    def _hbm_accounting(self, device) -> dict:
+        """Per-device byte split of this engine's HBM footprint.
+
+        Weights and KV pool are EXACT: each pytree leaf contributes its
+        first addressable shard's bytes (under a TP mesh that is the
+        per-device shard; replicated leaves contribute their full
+        size). Workspace is the measured residual —
+        ``device.memory_stats()`` bytes_in_use minus weights and pool —
+        when the backend reports memory stats (TPU), else 0 with
+        ``workspace_measured: false`` (the CPU tier has no HBM to
+        meter; the split still proves the accounting dark)."""
+
+        def per_device_bytes(tree) -> int:
+            total = 0
+            for leaf in jax.tree_util.tree_leaves(tree):
+                shards = getattr(leaf, 'addressable_shards', None)
+                if shards:
+                    total += shards[0].data.nbytes
+                else:
+                    total += int(getattr(leaf, 'nbytes', 0))
+            return total
+
+        weights = per_device_bytes(self.params)
+        pool = per_device_bytes(self._cache)
+        pool_kind = 'paged_pool' if self.paged else 'kv_cache'
+        workspace = 0
+        measured = False
+        try:
+            stats = device.memory_stats()
+            if stats and 'bytes_in_use' in stats:
+                workspace = max(
+                    0, int(stats['bytes_in_use']) - weights - pool)
+                measured = True
+        except (RuntimeError, AttributeError, TypeError):
+            pass  # backend without memory stats (CPU) — estimate-free 0
+        return {
+            'per_device_bytes': {'weights': weights,
+                                 pool_kind: pool,
+                                 'workspace': workspace},
+            'workspace_measured': measured,
+            'pool_kind': pool_kind,
+        }
 
     def _init_runtime_state(self) -> None:
         """(Re)build everything a crashed step may have corrupted: the
@@ -1941,23 +2004,40 @@ class DecodeEngine:
                  **payload) -> None:
         self._journal_raw(kind,
                           {'request': request.id, 'slot': slot, **payload},
-                          trace_id=request.trace_id)
+                          trace_id=request.trace_id,
+                          span_id=getattr(request, 'span_id', None))
 
     def _journal_raw(self, kind, payload: dict,
-                     trace_id: Optional[str] = None) -> None:
-        """Buffer one engine-entity event; a per-request ``trace_id``
-        overrides the ambient trace for that row (the X-Request-Id
-        join)."""
-        with self._journal_lock:
-            self._journal_buf.append(
-                (kind, f'engine:{self.name}', payload, time.time(),
-                 trace_id))
+                     trace_id: Optional[str] = None,
+                     span_id: Optional[str] = None,
+                     parent_span_id: Optional[str] = None,
+                     entity: Optional[str] = None) -> None:
+        """Buffer one event; a per-request ``trace_id`` overrides the
+        ambient trace for that row (the X-Request-Id join), and
+        ``span_id``/``parent_span_id`` nest it under the HTTP span that
+        carried the request (``span_id`` requires ``trace_id``)."""
+        if trace_id is not None and span_id is not None:
+            override = (trace_id, span_id, parent_span_id)
+        else:
+            override = trace_id
+        self._jbuf.append(kind, entity or f'engine:{self.name}', payload,
+                          override)
+
+    def journal_buffered(self, kind, payload: dict,
+                         trace_id: Optional[str] = None,
+                         span_id: Optional[str] = None,
+                         parent_span_id: Optional[str] = None,
+                         entity: Optional[str] = None) -> None:
+        """Public form of the batched journal buffer for co-located
+        callers on the request hot path (the model server's per-request
+        span rows): rows ride the engine tick's single transaction
+        instead of paying a per-event sqlite commit."""
+        self._journal_raw(kind, payload, trace_id=trace_id,
+                          span_id=span_id,
+                          parent_span_id=parent_span_id, entity=entity)
 
     def flush_journal(self) -> None:
         """Write buffered admit/evict events in one transaction. Called
         per tick by ``step()``; direct ``insert()`` drivers (tests) call
         it, or ``stats()``, to see their rows."""
-        with self._journal_lock:
-            buf, self._journal_buf = self._journal_buf, []
-        if buf:
-            journal.event_batch(buf)
+        self._jbuf.flush()

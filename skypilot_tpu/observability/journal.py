@@ -30,6 +30,7 @@ import enum
 import json
 import os
 import sqlite3
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -37,6 +38,11 @@ from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.utils import db_utils
 
 DISABLE_ENV = 'SKYTPU_JOURNAL_DISABLED'
+# Comma-separated kind values: when set, ONLY those kinds are written
+# (everything else is dropped silently). The benchmark harness uses it
+# to keep slow-request breaches joinable (`skytpu trace`) while the
+# measured engine passes stay free of per-tick admit/evict fsyncs.
+ONLY_KINDS_ENV = 'SKYTPU_JOURNAL_ONLY_KINDS'
 MAX_EVENTS_ENV = 'SKYTPU_JOURNAL_MAX_EVENTS'
 DEFAULT_MAX_EVENTS = 20000
 # job.phase rows are exempt from the generic prune (goodput recomputes
@@ -110,11 +116,30 @@ class EventKind(enum.Enum):
     ENGINE_RESTART = 'engine.restart'
     SERVER_DRAIN = 'server.drain'
     LB_EJECT = 'lb.eject'
+    # Fleet request tracing (serve/load_balancer.py): one event per
+    # proxy hop inside the LB-side `lb.proxy` span — candidate
+    # selection (with the circuit-breaker ejections traversed) and each
+    # failover hop — journaled under the request's own trace id
+    # (X-Request-Id), so `skytpu trace <request-id>` shows WHICH
+    # replicas a request tried before it was answered.
+    LB_HOP = 'lb.hop'
+    # Fleet SLO rollup (observability/slo.py): a replica whose TTFT p95
+    # deviates from the fleet median past the straggler threshold is
+    # journaled on the flag TRANSITION (and again when it recovers),
+    # with the evidence; the LB also feeds the flag to its circuit
+    # breaker as a soft signal.
+    REPLICA_STRAGGLER = 'replica.straggler'
     # Tensor-parallel serving (models/engine.py): journaled once at
     # engine start with the GSPMD mesh shape + device kinds, so perf
     # rounds and postmortems can attribute throughput to the topology
     # that served it.
     ENGINE_MESH = 'engine.mesh'
+    # HBM accounting (models/engine.py): journaled once at engine start
+    # beside engine.mesh — per-device weights vs KV-pool vs workspace
+    # bytes on the serving mesh (also the skytpu_engine_hbm_bytes{kind}
+    # gauges), so "what is eating this replica's HBM" is answerable
+    # without a device debugger.
+    ENGINE_HBM = 'engine.hbm'
 
 
 KINDS = frozenset(k.value for k in EventKind)
@@ -166,6 +191,16 @@ def enabled() -> bool:
     return os.environ.get(DISABLE_ENV, '0') != '1'
 
 
+def kind_writable(kind_value: str) -> bool:
+    """Whether this kind passes the ONLY_KINDS filter (always True when
+    the env is unset). Re-read per call: the bench toggles it around
+    measured passes."""
+    only = os.environ.get(ONLY_KINDS_ENV, '')
+    if not only:
+        return True
+    return kind_value in {k.strip() for k in only.split(',') if k.strip()}
+
+
 def event(kind: Union[EventKind, str],
           entity: str,
           payload: Optional[Dict[str, Any]] = None,
@@ -182,7 +217,7 @@ def event(kind: Union[EventKind, str],
         raise ValueError(
             f'Unregistered journal event kind {kind_value!r}; add it to '
             'observability.journal.EventKind first.')
-    if not enabled():
+    if not enabled() or not kind_writable(kind_value):
         return
     trace_id = trace_id or trace_lib.get_trace_id()
     span_id = span_id or trace_lib.get_span_id()
@@ -230,26 +265,38 @@ def event_batch(items: Sequence[tuple]) -> None:
     caller at buffer time, so batching does not skew the timeline.
     Trace context is resolved once at write time (the buffering caller
     is single-threaded per engine loop, so ambient context is stable).
-    An optional fifth element overrides the trace id for THAT row: the
-    engine stamps request-scoped events (admit/evict/slow_request) with
-    the request's own trace id (the server's ``X-Request-Id``), so
-    ``skytpu trace <request-id>`` reconstructs one request's timeline.
+    An optional fifth element overrides the trace context for THAT row:
+    a bare string overrides the trace id (span/parent nulled — the
+    pre-fleet-tracing form), and a ``(trace_id, span_id,
+    parent_span_id)`` tuple overrides all three — the engine stamps
+    request-scoped events (admit/evict/slow_request) with the request's
+    own trace id (the server's ``X-Request-Id``) AND the server-side
+    request span, so ``skytpu trace <request-id>`` reconstructs one
+    request's timeline nested under the HTTP spans that carried it.
     """
     if not items:
         return
     rows = []
     for item in items:
         kind, entity, payload, ts = item[:4]
-        row_trace = item[4] if len(item) > 4 else None
+        override = item[4] if len(item) > 4 else None
         kind_value = (kind.value if isinstance(kind, EventKind)
                       else str(kind))
         if kind_value not in KINDS:
             raise ValueError(
                 f'Unregistered journal event kind {kind_value!r}; add it '
                 'to observability.journal.EventKind first.')
+        if isinstance(override, (tuple, list)):
+            row_ctx = (tuple(override) + (None, None, None))[:3]
+        elif override:
+            row_ctx = (override, None, None)
+        else:
+            row_ctx = None
+        if not kind_writable(kind_value):
+            continue
         rows.append((ts, kind_value, entity or '',
-                     json.dumps(payload or {}, default=str), row_trace))
-    if not enabled():
+                     json.dumps(payload or {}, default=str), row_ctx))
+    if not enabled() or not rows:
         return
     trace_id = trace_lib.get_trace_id()
     span_id = trace_lib.get_span_id()
@@ -257,15 +304,15 @@ def event_batch(items: Sequence[tuple]) -> None:
     try:
         with _db() as conn:
             cur = None
-            for ts, kind_value, entity, payload_json, row_trace in rows:
+            for ts, kind_value, entity, payload_json, row_ctx in rows:
                 cur = conn.execute(
                     'INSERT INTO events (ts, kind, entity, payload, '
                     'trace_id, span_id, parent_span_id) '
                     'VALUES (?,?,?,?,?,?,?)',
                     (ts, kind_value, entity, payload_json,
-                     row_trace or trace_id,
-                     None if row_trace else span_id,
-                     None if row_trace else parent))
+                     row_ctx[0] if row_ctx else trace_id,
+                     row_ctx[1] if row_ctx else span_id,
+                     row_ctx[2] if row_ctx else parent))
             cap = max_events()
             if cur is not None and cur.lastrowid is not None \
                     and cur.lastrowid > cap:
@@ -275,6 +322,32 @@ def event_batch(items: Sequence[tuple]) -> None:
                     (cur.lastrowid - cap, EventKind.JOB_PHASE.value))
     except (sqlite3.Error, OSError):
         pass  # the flight recorder must never take the plane down
+
+
+class JournalBuffer:
+    """Lock-guarded buffer of :func:`event_batch` rows for hot-path
+    writers (the decode engine's tick loop, the LB's proxy handler):
+    appends are lock+list-append cheap, and one ``flush()`` writes the
+    whole batch in a single transaction. The optional ``override`` per
+    row is event_batch's fifth element (a trace-id string or a
+    ``(trace, span, parent)`` tuple)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buf: List[tuple] = []
+
+    def append(self, kind, entity: str, payload: Optional[Dict[str, Any]],
+               override=None, ts: Optional[float] = None) -> None:
+        with self._lock:
+            self._buf.append((kind, entity, payload,
+                              time.time() if ts is None else ts,
+                              override))
+
+    def flush(self) -> None:
+        with self._lock:
+            buf, self._buf = self._buf, []
+        if buf:
+            event_batch(buf)
 
 
 def query(kinds: Optional[Sequence[Union[EventKind, str]]] = None,

@@ -31,6 +31,16 @@ METRIC_NAME_PATTERN = r'^skytpu_[a-z0-9_]+$'
 _NAME_RE = re.compile(METRIC_NAME_PATTERN)
 _LABEL_RE = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*$')
 
+# Label-cardinality guard: these label NAMES are rejected at
+# registration because their values are unbounded by construction —
+# per-request ids would mint one child series per request and grow the
+# registry (and every scrape) without bound. Request-scoped telemetry
+# belongs in the journal (keyed by trace id) or the request-trace ring,
+# not in metric labels. A tier-1 lint additionally scans call sites.
+UNBOUNDED_LABEL_NAMES = frozenset({
+    'request_id', 'request', 'trace_id', 'span_id',
+})
+
 # Default histogram buckets: wide enough to cover sub-ms decode token
 # latencies AND multi-minute provisioning spans in one scheme.
 DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
@@ -91,6 +101,12 @@ class Metric:
         for label in labels:
             if not _LABEL_RE.match(label):
                 raise ValueError(f'Invalid label name {label!r}')
+            if label in UNBOUNDED_LABEL_NAMES:
+                raise ValueError(
+                    f'Label {label!r} on {name!r} is unbounded by '
+                    'construction (one series per request); key '
+                    'request-scoped telemetry by trace id in the '
+                    'journal / request-trace ring instead.')
         self.name = name
         self.help_text = help_text
         self.label_names = tuple(labels)
@@ -110,6 +126,17 @@ class Metric:
                 f'{self.name}: got {len(key)} label values for '
                 f'{len(self.label_names)} labels {self.label_names}')
         return key
+
+    def remove(self, labels: Sequence[str] = ()) -> None:
+        """Drop one labeled child series. For gauges whose label values
+        churn over a process lifetime (fleet replica URLs): a departed
+        replica's series must disappear from the exposition instead of
+        exporting its last value — and leaking one series per
+        ever-seen value — forever. No-op when the child never existed."""
+        key = self._key(labels)
+        with self._lock:
+            self._children.pop(key, None)
+        self._note_write()
 
     def _render_series(self, suffix: str, key: Tuple[str, ...], value,
                        extra_labels: Sequence[Tuple[str, str]] = ()

@@ -1,0 +1,445 @@
+"""Fleet + control-plane SLO plane: cross-replica latency rollups,
+straggler detection, and the journal-derived control-plane ledger.
+
+PR 9 gave ONE replica an `/slo` surface (rolling phase percentiles off
+the request-telemetry ring); this module lifts the same signal to fleet
+scope — the TPU-pod scaling playbook ("Exploring the limits of
+Concurrency in ML Training on Google TPUs", arXiv:2011.03641; the
+MLPerf TPU-v3 pod paper) finds stragglers by comparing per-host numbers
+against the slice median, and a serving fleet needs exactly that at the
+replica level. Three pieces:
+
+* :class:`FleetSlo` — the load balancer's aggregator. On the LB's probe
+  cadence (``SKYTPU_FLEET_SLO_INTERVAL``) it is fed each ready
+  replica's ``/slo`` body and computes the rollup: per-replica +
+  fleet-wide TTFT / per-token p50/p95 (``skytpu_fleet_*`` gauges),
+  straggler flags (a replica whose TTFT p95 deviates from the fleet
+  median past ``SKYTPU_FLEET_STRAGGLER_FACTOR`` ×, and by at least
+  ``SKYTPU_FLEET_STRAGGLER_MIN_SECONDS``), journaled as
+  ``replica.straggler`` on flag TRANSITIONS and handed to the LB's
+  circuit breaker as a *soft* signal (nudges the failure streak, never
+  ejects on its own). The cached rollup backs the LB's fleet ``/slo``
+  endpoint.
+* :func:`control_plane_slo` — the control-plane ledger (ROADMAP item
+  5's observability half): p50/p95/p99 launch latency (paired
+  ``launch.start``/``launch.done`` journal events per cluster entity)
+  and managed-job recovery time (``job.recover_done`` carries its
+  measured seconds), derived from the same journal/goodput plane the
+  flight recorder writes. Exposed via ``skytpu slo --control-plane``
+  and recorded in ``bench.py`` output as a regression-gated block
+  (:func:`bench_slo_block`).
+
+Percentile caveat, stated rather than hidden: replicas expose
+*percentiles*, not raw samples, so the fleet-wide row is the
+completed-window-weighted mean of the per-replica percentiles — an
+approximation that is exact when replicas see similar distributions and
+conservative (pulled toward busy replicas) otherwise. Straggler
+detection uses ``median_low`` across replica p95s so a 2-replica fleet
+compares against the *faster* replica instead of the midpoint.
+"""
+import statistics
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu.observability import journal
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.utils import common_utils
+
+# Fleet rollup phases exported as gauges (the full rollup body carries
+# every phase the replica /slo reports).
+GAUGE_PHASES = ('ttft', 'per_token')
+ROLLUP_PHASES = ('queue_wait', 'prefill', 'ttft', 'per_token', 'total')
+FLEET_KEY = 'fleet'
+
+# Straggler detection: a replica is a straggler when its TTFT p95
+# exceeds factor × the fleet median AND the absolute deviation exceeds
+# the floor (sub-ms jitter on an idle CPU fleet must not alarm), over
+# at least MIN_COMPLETED completed requests in its window.
+STRAGGLER_FACTOR_ENV = 'SKYTPU_FLEET_STRAGGLER_FACTOR'
+DEFAULT_STRAGGLER_FACTOR = 2.0
+STRAGGLER_MIN_SECONDS_ENV = 'SKYTPU_FLEET_STRAGGLER_MIN_SECONDS'
+DEFAULT_STRAGGLER_MIN_SECONDS = 0.05
+STRAGGLER_MIN_COMPLETED_ENV = 'SKYTPU_FLEET_STRAGGLER_MIN_COMPLETED'
+DEFAULT_STRAGGLER_MIN_COMPLETED = 4
+
+# bench.py regression gate: when set, the bench SLO block marks
+# gate_pass=False if the journal-derived p99 launch latency exceeds it.
+BENCH_LAUNCH_GATE_ENV = 'SKYTPU_BENCH_SLO_P99_LAUNCH_GATE'
+
+
+def _pct(values: List[float], q: float) -> float:
+    return round(common_utils.percentile(sorted(values), q), 6)
+
+
+# ------------------------------------------------------------ fleet SLO
+
+
+def replica_row(body: Dict[str, Any]) -> Dict[str, Any]:
+    """Distill one replica's ``/slo`` body into the rollup row."""
+    win = body.get('window', {})
+    res = body.get('resilience', {})
+    row: Dict[str, Any] = {
+        'completed': int(win.get('completed', 0) or 0),
+        'in_flight': body.get('in_flight', 0),
+        'queued': body.get('queued', 0),
+        'engine_restarts': res.get('engine_restarts', 0),
+        'server_state': res.get('server_state'),
+    }
+    for phase in ROLLUP_PHASES:
+        p = body.get(f'{phase}_seconds') or {}
+        row[phase] = {'p50': float(p.get('p50', 0.0) or 0.0),
+                      'p95': float(p.get('p95', 0.0) or 0.0)}
+    steps = body.get('steps') or {}
+    if steps:
+        row['engine_steps'] = {
+            'steps_recorded': steps.get('steps_recorded', 0),
+            'stalls': steps.get('stalls', 0),
+            'step_seconds_p95': (steps.get('step_seconds') or {}).get(
+                'p95', 0.0),
+            'last_step_age_seconds': steps.get('last_step_age_seconds'),
+        }
+    return row
+
+
+def fleet_rollup(snapshots: Dict[str, Dict[str, Any]],
+                 now: Optional[float] = None) -> Dict[str, Any]:
+    """Pure rollup over ``{replica_url: /slo body}``: per-replica rows,
+    the completed-weighted fleet-wide row, and straggler flags."""
+    now = time.time() if now is None else now
+    replicas = {url: replica_row(body)
+                for url, body in snapshots.items()}
+    fleet: Dict[str, Any] = {
+        'completed': sum(r['completed'] for r in replicas.values()),
+        'in_flight': sum(r['in_flight'] for r in replicas.values()),
+        'queued': sum(r['queued'] for r in replicas.values()),
+    }
+    for phase in ROLLUP_PHASES:
+        weights = [(r[phase], max(r['completed'], 0))
+                   for r in replicas.values()]
+        total_w = sum(w for _, w in weights)
+        fleet[phase] = {
+            stat: (round(sum(p[stat] * w for p, w in weights) / total_w,
+                         6) if total_w else 0.0)
+            for stat in ('p50', 'p95')}
+
+    factor = common_utils.env_float(STRAGGLER_FACTOR_ENV,
+                                    DEFAULT_STRAGGLER_FACTOR)
+    min_dev = common_utils.env_float(STRAGGLER_MIN_SECONDS_ENV,
+                                     DEFAULT_STRAGGLER_MIN_SECONDS)
+    min_completed = common_utils.env_int(STRAGGLER_MIN_COMPLETED_ENV,
+                                         DEFAULT_STRAGGLER_MIN_COMPLETED)
+    eligible = {url: r for url, r in replicas.items()
+                if r['completed'] >= min_completed}
+    stragglers: List[str] = []
+    median = 0.0
+    if len(eligible) >= 2:
+        # median_low: a 2-replica fleet compares the slow replica
+        # against the FAST one, not the midpoint between them (the
+        # midpoint can never deviate by 2x from itself).
+        median = statistics.median_low(
+            [r['ttft']['p95'] for r in eligible.values()])
+        for url, r in eligible.items():
+            p95 = r['ttft']['p95']
+            r['straggler'] = bool(p95 > factor * median and
+                                  p95 - median > min_dev)
+            if r['straggler']:
+                stragglers.append(url)
+    for r in replicas.values():
+        r.setdefault('straggler', False)
+    return {
+        'kind': 'fleet',
+        'unix_ts': round(now, 3),
+        'replica_count': len(replicas),
+        'replicas': replicas,
+        FLEET_KEY: fleet,
+        'stragglers': sorted(stragglers),
+        'straggler_policy': {
+            'factor': factor,
+            'min_deviation_seconds': min_dev,
+            'min_completed': min_completed,
+            'fleet_ttft_p95_median': round(median, 6),
+        },
+    }
+
+
+class FleetSlo:
+    """The LB-side aggregator: feed it ``{url: /slo body}`` snapshots
+    each probe tick; it publishes gauges, journals straggler
+    transitions, calls the soft-signal callback, and caches the rollup
+    for the LB's fleet ``/slo`` endpoint. Thread-safe: the LB's asyncio
+    loop writes, HTTP/in-proc test threads read."""
+
+    def __init__(self, entity: str = 'lb',
+                 straggler_cb: Optional[Callable[[str], None]] = None):
+        self.entity = entity
+        self._straggler_cb = straggler_cb
+        self._lock = threading.Lock()
+        self._rollup: Optional[Dict[str, Any]] = None
+        self._stragglers: set = set()
+        # Replicas whose gauges were published on the previous poll:
+        # a replica that leaves the fleet gets its series REMOVED, not
+        # frozen at its last value (a departed straggler must not
+        # export straggler=1 forever, and churned replica URLs must not
+        # leak one series each).
+        self._published: set = set()
+
+    def update(self, snapshots: Dict[str, Dict[str, Any]],
+               now: Optional[float] = None) -> Dict[str, Any]:
+        rollup = fleet_rollup(snapshots, now=now)
+        self._publish(rollup)
+        self._journal_transitions(rollup)
+        with self._lock:
+            self._rollup = rollup
+        return rollup
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The cached rollup (the fleet ``/slo`` body); a fleet that has
+        not been polled yet reads as empty, not an error."""
+        with self._lock:
+            rollup = self._rollup
+        if rollup is None:
+            return {'kind': 'fleet', 'replica_count': 0, 'replicas': {},
+                    FLEET_KEY: {}, 'stragglers': [],
+                    'note': 'no fleet poll has completed yet'}
+        body = dict(rollup)
+        body['age_seconds'] = round(
+            max(0.0, time.time() - rollup['unix_ts']), 3)
+        return body
+
+    def _publish(self, rollup: Dict[str, Any]) -> None:
+        m = metrics_lib
+        m.gauge('skytpu_fleet_replicas',
+                'Replicas in the most recent fleet SLO poll.').set(
+                    rollup['replica_count'])
+        gauges = {
+            'ttft': m.gauge(
+                'skytpu_fleet_ttft_seconds',
+                'Rolling TTFT percentiles per replica (and the '
+                'completed-weighted fleet-wide row, replica="fleet").',
+                labels=('replica', 'stat')),
+            'per_token': m.gauge(
+                'skytpu_fleet_per_token_seconds',
+                'Rolling per-token decode latency percentiles per '
+                'replica (replica="fleet" = fleet-wide).',
+                labels=('replica', 'stat')),
+        }
+        straggler_g = m.gauge(
+            'skytpu_fleet_straggler',
+            'Straggler flag per replica (TTFT p95 deviating from the '
+            'fleet median past the threshold).',
+            labels=('replica',))
+        rows = dict(rollup['replicas'])
+        rows[FLEET_KEY] = rollup[FLEET_KEY]
+        for url, row in rows.items():
+            for phase, gauge in gauges.items():
+                p = row.get(phase) or {}
+                for stat in ('p50', 'p95'):
+                    gauge.set(float(p.get(stat, 0.0) or 0.0),
+                              labels=(url, stat))
+            if url != FLEET_KEY:
+                straggler_g.set(1.0 if row.get('straggler') else 0.0,
+                                labels=(url,))
+        with self._lock:
+            departed = self._published - set(rows)
+            self._published = set(rows)
+        for url in departed:
+            for gauge in gauges.values():
+                for stat in ('p50', 'p95'):
+                    gauge.remove(labels=(url, stat))
+            straggler_g.remove(labels=(url,))
+
+    def _journal_transitions(self, rollup: Dict[str, Any]) -> None:
+        """``replica.straggler`` on flag transitions only (read paths
+        republish every poll; the journal records state CHANGES)."""
+        current = set(rollup['stragglers'])
+        policy = rollup['straggler_policy']
+        with self._lock:
+            previous = self._stragglers
+            self._stragglers = current
+        for url in sorted(current - previous):
+            p95 = rollup['replicas'][url]['ttft']['p95']
+            journal.event(journal.EventKind.REPLICA_STRAGGLER,
+                          self.entity,
+                          {'replica': url, 'straggler': True,
+                           'ttft_p95_seconds': p95,
+                           'fleet_median_seconds':
+                               policy['fleet_ttft_p95_median'],
+                           'factor': policy['factor']})
+            if self._straggler_cb is not None:
+                self._straggler_cb(url)
+        for url in sorted(previous - current):
+            journal.event(journal.EventKind.REPLICA_STRAGGLER,
+                          self.entity,
+                          {'replica': url, 'straggler': False})
+
+
+def format_fleet_slo(body: Dict[str, Any]) -> str:
+    """Render a fleet ``/slo`` body (the LB endpoint) as the
+    `skytpu slo` table: one row per replica plus the fleet rollup."""
+    replicas = body.get('replicas') or {}
+    if not replicas:
+        return ('No fleet SLO data yet '
+                f"({body.get('note', 'empty fleet')}).")
+    header = ('REPLICA', 'COMPLETED', 'TTFT-P50', 'TTFT-P95',
+              'PERTOK-P95', 'RESTARTS', 'FLAGS')
+
+    def _s(v) -> str:
+        v = float(v or 0.0)
+        return f'{v * 1e3:.1f}ms' if v < 1.0 else f'{v:.2f}s'
+
+    rows = []
+    items = list(replicas.items()) + [(FLEET_KEY, {
+        **body.get(FLEET_KEY, {}), 'straggler': False})]
+    for url, r in items:
+        rows.append((
+            url, str(r.get('completed', 0)),
+            _s((r.get('ttft') or {}).get('p50')),
+            _s((r.get('ttft') or {}).get('p95')),
+            _s((r.get('per_token') or {}).get('p95')),
+            str(r.get('engine_restarts', '-')),
+            'STRAGGLER' if r.get('straggler') else '-'))
+    widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+              for i in range(len(header))]
+    lines = [f"== fleet SLO ({body.get('replica_count', 0)} replicas, "
+             f"age {body.get('age_seconds', 0.0)}s) =="]
+    lines.append('  '.join(h.ljust(widths[i])
+                           for i, h in enumerate(header)))
+    for r in rows:
+        lines.append('  '.join(c.ljust(widths[i])
+                               for i, c in enumerate(r)))
+    if body.get('stragglers'):
+        lines.append('stragglers: ' + ', '.join(body['stragglers']))
+    return '\n'.join(lines)
+
+
+# -------------------------------------------------- control-plane ledger
+
+
+def _pair_durations(events: List[Dict[str, Any]], start_kind: str,
+                    end_kinds: Dict[str, bool]) -> List[Dict[str, Any]]:
+    """Pair start/end events per entity (oldest-first input): each end
+    closes the most recent open start on the same entity. ``end_kinds``
+    maps kind value → success flag."""
+    open_starts: Dict[str, float] = {}
+    out = []
+    for e in events:
+        if e['kind'] == start_kind:
+            open_starts[e['entity']] = e['ts']
+        elif e['kind'] in end_kinds and e['entity'] in open_starts:
+            t0 = open_starts.pop(e['entity'])
+            out.append({'entity': e['entity'],
+                        'seconds': max(0.0, e['ts'] - t0),
+                        'ok': end_kinds[e['kind']],
+                        'ts': e['ts']})
+    return out
+
+
+def control_plane_slo(now: Optional[float] = None,
+                      limit: int = 10000) -> Dict[str, Any]:
+    """The control-plane SLO ledger, derived from the local journal:
+    launch latency (``launch.start`` → ``launch.done``/``launch.error``
+    per cluster entity) and managed-job recovery time (the measured
+    ``seconds`` each ``job.recover_done`` already carries). Percentiles
+    use the shared ``common_utils.percentile`` semantics. An empty
+    journal reads as zero counts, never an error — the bench block must
+    emit on a fresh host."""
+    now = time.time() if now is None else now
+    launch_events = journal.query(
+        kinds=[journal.EventKind.LAUNCH_START,
+               journal.EventKind.LAUNCH_DONE,
+               journal.EventKind.LAUNCH_ERROR],
+        ascending=True, limit=limit)
+    launches = _pair_durations(
+        launch_events, journal.EventKind.LAUNCH_START.value,
+        {journal.EventKind.LAUNCH_DONE.value: True,
+         journal.EventKind.LAUNCH_ERROR.value: False})
+    ok_launch = [l['seconds'] for l in launches if l['ok']]
+
+    recover_events = journal.query(
+        kinds=[journal.EventKind.JOB_RECOVER_DONE],
+        ascending=True, limit=limit)
+    recoveries = []
+    for e in recover_events:
+        secs = (e.get('payload') or {}).get('seconds')
+        if secs is not None:
+            recoveries.append({'entity': e['entity'],
+                               'seconds': float(secs),
+                               'ok': bool((e['payload'] or {}).get(
+                                   'recovered', True))})
+    rec_secs = [r['seconds'] for r in recoveries]
+
+    def _stats(values: List[float]) -> Dict[str, float]:
+        if not values:
+            return {'count': 0, 'p50_seconds': 0.0, 'p95_seconds': 0.0,
+                    'p99_seconds': 0.0, 'max_seconds': 0.0}
+        return {'count': len(values),
+                'p50_seconds': _pct(values, 50),
+                'p95_seconds': _pct(values, 95),
+                'p99_seconds': _pct(values, 99),
+                'max_seconds': round(max(values), 6)}
+
+    return {
+        'kind': 'control_plane',
+        'unix_ts': round(now, 3),
+        'launch': {**_stats(ok_launch),
+                   'failed': sum(1 for l in launches if not l['ok'])},
+        'recovery': {**_stats(rec_secs),
+                     'failed': sum(1 for r in recoveries
+                                   if not r['ok'])},
+    }
+
+
+def bench_slo_block(now: Optional[float] = None) -> Dict[str, Any]:
+    """The regression-gated control-plane SLO block ``bench.py`` stamps
+    on its result lines. ``SKYTPU_BENCH_SLO_P99_LAUNCH_GATE`` (seconds)
+    arms the gate: the block carries ``gate_pass`` so a round whose
+    control plane regressed is visible in the perf record (the bench
+    still emits — a perf round must never go dark over its own
+    gate)."""
+    block = control_plane_slo(now=now)
+    gate = common_utils.env_optional_float(BENCH_LAUNCH_GATE_ENV)
+    launch = block['launch']
+    if gate is None:
+        gate_pass = True
+    elif launch['count'] > 0:
+        gate_pass = launch['p99_seconds'] <= gate
+    else:
+        # No successful launches in the window: nothing-attempted
+        # passes vacuously, but an armed gate over an all-failed window
+        # must FAIL — total launch failure is the worst regression, not
+        # a free pass.
+        gate_pass = launch['failed'] == 0
+    block['gate'] = {
+        'p99_launch_seconds_max': gate,
+        'gate_pass': gate_pass,
+    }
+    return block
+
+
+def format_control_plane(body: Dict[str, Any]) -> str:
+    """Render the control-plane ledger for `skytpu slo
+    --control-plane`."""
+    lines = ['== control-plane SLO (journal-derived) ==',
+             'METRIC    COUNT  P50        P95        P99        MAX'
+             '        FAILED']
+
+    def _s(v) -> str:
+        v = float(v or 0.0)
+        return f'{v * 1e3:.1f}ms' if v < 1.0 else f'{v:.2f}s'
+
+    for key in ('launch', 'recovery'):
+        r = body.get(key) or {}
+        lines.append(
+            f"{key:<8}  {r.get('count', 0):<5}  "
+            f"{_s(r.get('p50_seconds')):<9}  "
+            f"{_s(r.get('p95_seconds')):<9}  "
+            f"{_s(r.get('p99_seconds')):<9}  "
+            f"{_s(r.get('max_seconds')):<9}  "
+            f"{r.get('failed', 0)}")
+    gate = body.get('gate')
+    if gate and gate.get('p99_launch_seconds_max') is not None:
+        lines.append(
+            f"gate: p99 launch <= {gate['p99_launch_seconds_max']:g}s "
+            f"-> {'PASS' if gate.get('gate_pass') else 'FAIL'}")
+    return '\n'.join(lines)

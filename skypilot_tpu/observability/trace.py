@@ -34,6 +34,17 @@ from typing import Dict, Iterator, Optional
 TRACE_ID_ENV = 'SKYTPU_TRACE_ID'
 SPAN_ID_ENV = 'SKYTPU_SPAN_ID'
 
+# HTTP hop propagation (the env pair's wire form): the serve-plane load
+# balancer mints/forwards these on every proxied request and the model
+# server JOINS the carried context instead of starting a fresh trace,
+# so `skytpu trace <X-Request-Id>` rebuilds one tree across the LB →
+# replica-HTTP → engine hops. X-Request-Id doubles as the trace id
+# (PR 9's convention); the span header carries the upstream hop's span
+# id so the downstream side can parent under it.
+REQUEST_ID_HEADER = 'X-Request-Id'
+TRACE_ID_HEADER = 'X-Skytpu-Trace-Id'
+SPAN_ID_HEADER = 'X-Skytpu-Span-Id'
+
 _trace_id: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
     'skytpu_trace_id', default=None)
 _span_id: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(

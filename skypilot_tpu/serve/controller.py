@@ -151,6 +151,35 @@ class SkyServeController:
                 stdin=subprocess.DEVNULL, start_new_session=True)
         logger.info(f'Load balancer subprocess pid='
                     f'{self._lb_proc.pid} on :{self.lb_port}.')
+        # Wait (bounded) for the LB to actually accept connections: the
+        # service endpoint is advertised the moment replicas go READY,
+        # and a fast replica (Local cloud, e2e tests) can beat the LB
+        # subprocess's interpreter startup to it — the first client
+        # request then hits connection-refused on a port the service
+        # just called ready. Non-fatal on timeout: the proxy may still
+        # come up late, and _ensure_lb_alive respawns a dead one.
+        self._wait_lb_accepting()
+
+    def _wait_lb_accepting(self, timeout: float = 15.0) -> bool:
+        import socket
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            proc = self._lb_proc
+            if proc is None or proc.poll() is not None:
+                rc = proc.poll() if proc is not None else 'not spawned'
+                logger.warning(
+                    'Load balancer subprocess exited before accepting '
+                    f'connections (rc={rc}).')
+                return False
+            try:
+                with socket.create_connection(
+                        ('127.0.0.1', self.lb_port), timeout=0.5):
+                    return True
+            except OSError:
+                time.sleep(0.05)
+        logger.warning(f'Load balancer did not accept connections on '
+                       f':{self.lb_port} within {timeout:.0f}s.')
+        return False
 
     def _ensure_lb_alive(self) -> None:
         """Restart a dead LB (crash/OOM/kill) — replica serving must

@@ -18,6 +18,25 @@ seconds stale) sits a per-replica consecutive-failure **circuit breaker**
 and failed probes eject a replica from the candidate set for a backoff
 window with probe-based reinstatement, and a 502/503 received before any
 body bytes fails over to another replica instead of reaching the client.
+
+Fleet observability (two planes, both LB-side):
+
+* **Cross-hop tracing.** Every proxied request gets an ``X-Request-Id``
+  (the client's, else minted) that doubles as its trace id, plus the
+  ``X-Skytpu-Trace-Id``/``X-Skytpu-Span-Id`` hop headers. The LB opens
+  an ``lb.proxy`` span around the whole exchange and journals one
+  ``lb.hop`` event per candidate selection / failover hop (with the
+  circuit-breaker ejections traversed); the model server JOINS the
+  carried context, so ``skytpu trace <X-Request-Id>`` rebuilds one tree
+  — LB proxy → replica HTTP → engine lifecycle — across processes.
+* **Fleet SLO rollup.** On the ``SKYTPU_FLEET_SLO_INTERVAL`` cadence
+  the LB pulls each ready replica's ``/slo`` into
+  ``observability/slo.FleetSlo``: per-replica + fleet-wide
+  ``skytpu_fleet_*`` latency gauges, straggler detection against the
+  fleet median (journaled as ``replica.straggler`` and fed to the
+  circuit breaker as a soft signal), and a fleet ``GET /slo`` endpoint
+  served by the LB itself (replica-local ``/slo`` stays reachable on
+  the replica's own port).
 """
 import argparse
 import asyncio
@@ -34,12 +53,19 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu.observability import exporter as exporter_lib
 from skypilot_tpu.observability import journal
 from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import slo as slo_lib
+from skypilot_tpu.observability import trace as trace_lib
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
 from skypilot_tpu.utils import common_utils
 
 logger = sky_logging.init_logger(__name__)
 
 LB_METRICS_PORT_ENV = 'SKYTPU_LB_METRICS_PORT'
+# Fleet SLO poll cadence: each tick pulls every ready replica's /slo
+# into the FleetSlo rollup (gauges + straggler detection + the LB's
+# fleet /slo endpoint).
+FLEET_SLO_INTERVAL_ENV = 'SKYTPU_FLEET_SLO_INTERVAL'
+DEFAULT_FLEET_SLO_INTERVAL = 5.0
 # Replica circuit breaker: this many CONSECUTIVE failures (connect
 # errors, pre-byte 5xx, failed reinstatement probes) eject a replica
 # from the candidate set for a backoff window; a passing /healthz probe
@@ -134,6 +160,19 @@ class ReplicaCircuitBreaker:
             return {'consecutive_failures': n,
                     'backoff_seconds': self.base_backoff}
 
+    def record_soft_failure(self, url: str) -> None:
+        """Soft signal (fleet straggler detection): nudge the failure
+        streak toward the threshold WITHOUT ever ejecting on its own —
+        a straggling replica ejects on its next hard failure instead of
+        needing the full streak, but stragglers alone keep serving
+        (slow beats down)."""
+        with self._lock:
+            if url in self._ejected:
+                return
+            n = self._failures.get(url, 0)
+            if n + 1 < self.threshold:
+                self._failures[url] = n + 1
+
     def record_success(self, url: str) -> bool:
         """Reset the failure streak; returns True when this success
         reinstated an ejected replica (the fallback path served it)."""
@@ -198,11 +237,21 @@ class LoadBalancer:
         # (connect errors, pre-byte 5xx, probe failures) — see
         # ReplicaCircuitBreaker.
         self.breaker = ReplicaCircuitBreaker()
+        # Fleet SLO aggregator: fed by _fleet_slo_loop, backs the LB's
+        # fleet /slo endpoint; straggler transitions nudge the breaker.
+        self.fleet = slo_lib.FleetSlo(
+            entity=f'lb:{port}',
+            straggler_cb=self.breaker.record_soft_failure)
         # Request arrival timestamps for the autoscaler (QPS window).
         # Guarded by a lock: the aiohttp thread appends while another
         # thread (in-proc mode) or the sync task snapshots.
         self._ts_lock = threading.Lock()
         self._request_timestamps: Deque[float] = deque(maxlen=100_000)
+        # Trace-event buffer: span/hop rows batch into ONE sqlite
+        # transaction per flush tick (the engine's journaling idiom) —
+        # a per-event commit inside the asyncio loop would stall every
+        # in-flight proxy stream on fsync under load.
+        self._jbuf = journal.JournalBuffer()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
@@ -239,6 +288,14 @@ class LoadBalancer:
         # in-proc tests exercise the breaker too).
         self._bg_tasks.append(
             self._loop.create_task(self._eject_probe_loop()))
+        # Fleet SLO polls (both modes): each tick pulls ready replicas'
+        # /slo into the rollup behind the LB's fleet /slo endpoint.
+        self._bg_tasks.append(
+            self._loop.create_task(self._fleet_slo_loop()))
+        # Trace-row flusher (both modes): drains the span/hop buffer
+        # in one transaction per tick.
+        self._bg_tasks.append(
+            self._loop.create_task(self._journal_flush_loop()))
         try:
             self._loop.run_forever()
         finally:
@@ -281,6 +338,7 @@ class LoadBalancer:
         for task in self._bg_tasks:
             task.cancel()
         self._bg_tasks = []
+        self.flush_journal()  # best-effort: don't strand buffered rows
         if self._exporter is not None:
             self._exporter.stop()
             self._exporter = None
@@ -415,10 +473,110 @@ class LoadBalancer:
                     logger.info(f'Replica {url} probe failed; next '
                                 f'probe in {backoff:.0f}s.')
 
+    async def _fleet_slo_loop(self) -> None:
+        """Pull every ready replica's /slo each interval into the
+        FleetSlo rollup. Non-/slo-capable replicas (plain http.server
+        demos answer 404/non-JSON) are simply absent from the rollup;
+        one slow replica cannot stall the tick (bounded per-pull
+        timeout, pulled concurrently)."""
+        interval = common_utils.env_float(FLEET_SLO_INTERVAL_ENV,
+                                          DEFAULT_FLEET_SLO_INTERVAL)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self._fleet_slo_tick()
+            except Exception as e:  # pylint: disable=broad-except
+                # The poll is advisory: it must never take the proxy
+                # loop down with it.
+                logger.warning(f'Fleet SLO poll failed: {e}')
+
+    async def _fleet_slo_tick(self) -> None:
+        urls = self._ready_urls()
+
+        async def pull(url: str):
+            try:
+                async with self._session.get(
+                        url.rstrip('/') + '/slo',
+                        timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                    if resp.status != 200:
+                        return url, None
+                    return url, await resp.json()
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    json.JSONDecodeError, ValueError):
+                return url, None
+
+        results = await asyncio.gather(*(pull(u) for u in urls))
+        self.fleet.update({u: body for u, body in results
+                           if isinstance(body, dict)})
+
     async def _handle(self, request: web.Request) -> web.StreamResponse:
+        tail = request.match_info['tail']
+        # Fleet /slo: the LB answers with the cross-replica rollup
+        # itself instead of proxying — the per-replica body stays
+        # reachable on each replica's own port.
+        if request.method == 'GET' and tail == 'slo':
+            return web.json_response(self.fleet.snapshot())
         t_start = time.perf_counter()
         with self._ts_lock:
             self._request_timestamps.append(time.time())
+        # Cross-hop tracing: X-Request-Id doubles as the trace id
+        # (client-supplied or minted here); the lb.proxy span covers
+        # queueing, candidate selection, and every failover hop, and
+        # the hop headers let the replica-side server parent its own
+        # span under this one.
+        req_id = (request.headers.get(trace_lib.REQUEST_ID_HEADER)
+                  or trace_lib.new_trace_id())
+        lb_trace = (request.headers.get(trace_lib.TRACE_ID_HEADER)
+                    or req_id)
+        parent_span = request.headers.get(trace_lib.SPAN_ID_HEADER)
+        lb_span = trace_lib.new_span_id()
+        self._journal_trace_row(
+            journal.EventKind.SPAN_START,
+            {'name': 'lb.proxy', 'method': request.method,
+             'path': '/' + tail, 'request': req_id},
+            lb_trace, lb_span, parent_span)
+        status = None
+        try:
+            resp = await self._proxy(request, t_start, req_id, lb_trace,
+                                     lb_span)
+            status = getattr(resp, 'status', None)
+            return resp
+        except BaseException as e:
+            status = f'{type(e).__name__}: {e}'
+            raise
+        finally:
+            self._journal_trace_row(
+                journal.EventKind.SPAN_END,
+                {'name': 'lb.proxy', 'status': status},
+                lb_trace, lb_span, parent_span)
+
+    def _journal_trace_row(self, kind, payload: dict, lb_trace: str,
+                           lb_span: str,
+                           parent_span: Optional[str] = None) -> None:
+        """Buffer one span/hop row under the request's trace context;
+        the flush loop writes the batch in one transaction."""
+        self._jbuf.append(kind, f'lb:{self.port}', payload,
+                          (lb_trace, lb_span, parent_span))
+
+    def _journal_hop(self, lb_trace: str, lb_span: str,
+                     payload: dict) -> None:
+        self._journal_trace_row(journal.EventKind.LB_HOP, payload,
+                                lb_trace, lb_span)
+
+    def flush_journal(self) -> None:
+        self._jbuf.flush()
+
+    async def _journal_flush_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            await asyncio.sleep(0.5)
+            # Off the event loop: the batched commit still pays an
+            # fsync, and in-flight proxy streams must not pause for it.
+            await loop.run_in_executor(None, self.flush_journal)
+
+    async def _proxy(self, request: web.Request, t_start: float,
+                     req_id: str, lb_trace: str,
+                     lb_span: str) -> web.StreamResponse:
         self.policy.set_ready_replicas(self._candidate_urls())
         url = self.policy.select_replica()
         if url is None and self._controller_url is not None:
@@ -439,10 +597,17 @@ class LoadBalancer:
             return web.Response(
                 status=503,
                 text='No ready replicas. Use `sky serve status` to check '
-                     'the service.')
+                     'the service.',
+                headers={trace_lib.REQUEST_ID_HEADER: req_id})
         body = await request.read()
         headers = {k: v for k, v in request.headers.items()
                    if k.lower() not in _HOP_HEADERS}
+        # Hop propagation: the replica sees the same request id (it
+        # becomes the engine request's trace id) and parents its
+        # server-side span under this lb.proxy span.
+        headers[trace_lib.REQUEST_ID_HEADER] = req_id
+        headers[trace_lib.TRACE_ID_HEADER] = lb_trace
+        headers[trace_lib.SPAN_ID_HEADER] = lb_span
         last_err: Optional[Exception] = None
         tried = set()
         # Connect-level failures retry ONCE against a freshly-synced
@@ -456,6 +621,18 @@ class LoadBalancer:
                 break
             current = url
             tried.add(current)
+            ready = self._ready_urls()
+            self._journal_hop(lb_trace, lb_span, {
+                'phase': 'select', 'attempt': attempt + 1,
+                'replica': current,
+                'candidates': len(self._candidate_urls()),
+                # Breaker-ejected replicas the selection skipped over.
+                'ejected_traversed':
+                    len(ready) - len(self.breaker.filter(ready)),
+                # Arrival → selection (the on-demand-sync wait rides in
+                # the first hop's number).
+                'queue_seconds': round(
+                    time.perf_counter() - t_start, 6)})
             target = (current.rstrip('/') + '/' +
                       request.match_info['tail'])
             if request.query_string:
@@ -492,9 +669,21 @@ class LoadBalancer:
                                     f'replica answered {resp.status} '
                                     'before any body bytes')
                                 url = failover[0]
+                                self._journal_hop(lb_trace, lb_span, {
+                                    'phase': 'failover',
+                                    'attempt': attempt + 1,
+                                    'replica': current,
+                                    'kind': f'status_{resp.status}',
+                                    'next': url})
                                 continue
                     out_headers = {k: v for k, v in resp.headers.items()
                                    if k.lower() not in _HOP_HEADERS}
+                    # Replicas that don't echo the request id (plain
+                    # http.server demos) still answer with one — the
+                    # client must always get the trace join key.
+                    if not any(k.lower() == 'x-request-id'
+                               for k in out_headers):
+                        out_headers[trace_lib.REQUEST_ID_HEADER] = req_id
                     # Stream chunk-by-chunk: token streams (SSE/chunked
                     # LLM responses) must reach the client as they are
                     # produced, not after the replica finishes.
@@ -535,6 +724,10 @@ class LoadBalancer:
                 candidates = [u for u in self._candidate_urls()
                               if u not in tried]
                 url = candidates[0] if candidates else None
+                self._journal_hop(lb_trace, lb_span, {
+                    'phase': 'failover', 'attempt': attempt + 1,
+                    'replica': current, 'kind': type(e).__name__,
+                    'next': url})
                 continue
             except aiohttp.ClientError as e:
                 _observe_proxy_error(current, type(e).__name__)
@@ -551,7 +744,8 @@ class LoadBalancer:
         # before the loop, so iteration 1 ran at least to the assignment.
         _observe_request(current, 502, t_start)
         return web.Response(status=502,
-                            text=f'Replica request failed: {last_err}')
+                            text=f'Replica request failed: {last_err}',
+                            headers={trace_lib.REQUEST_ID_HEADER: req_id})
 
 
 def main() -> None:

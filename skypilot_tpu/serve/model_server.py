@@ -444,8 +444,17 @@ class ModelServer:
         # request's trace id, so this request's journal rows
         # (admit/evict/slow_request) are joined to the HTTP exchange —
         # `skytpu trace <X-Request-Id>` after `curl -i` shows both.
-        request_id = (request.headers.get('X-Request-Id')
+        request_id = (request.headers.get(trace_lib.REQUEST_ID_HEADER)
                       or trace_lib.new_trace_id())
+        # Cross-hop join: a request proxied by the LB carries the
+        # lb.proxy span in the hop headers — this server's own
+        # `server.request` span parents under it instead of starting a
+        # fresh trace, so `skytpu trace <X-Request-Id>` renders ONE
+        # tree: LB proxy → replica HTTP → engine lifecycle.
+        trace_id = (request.headers.get(trace_lib.TRACE_ID_HEADER)
+                    or request_id)
+        parent_span = request.headers.get(trace_lib.SPAN_ID_HEADER)
+        span_id = trace_lib.new_span_id()
 
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
@@ -457,23 +466,45 @@ class ModelServer:
         # stay server-generated and unique, so a client retrying with
         # the same X-Request-Id (or two clients colliding) cannot
         # cross-contaminate the telemetry plane's per-id records.
+        # span_id nests the request's engine lifecycle events under
+        # this server.request span in the rendered trace.
         req = engine_lib.Request(tokens, max_new, on_token=on_token,
                                  tenant=str(tenant),
-                                 trace_id=request_id)
+                                 trace_id=trace_id,
+                                 span_id=span_id)
         # Terminal sentinel: a request the engine rejects (or fails at
         # admission) finishes WITHOUT ever emitting a token — without
         # this, the handler would sit on the empty queue until the
         # request timeout while the rejection is already known.
         req.on_finish = lambda: loop.call_soon_threadsafe(
             q.put_nowait, (None, True))
+        # The span rows ride the engine's batched journal buffer (one
+        # sqlite transaction per engine tick), not a per-request
+        # commit: the /generate hot path stays fsync-free.
+        self.engine.journal_buffered(
+            journal.EventKind.SPAN_START,
+            {'name': 'server.request', 'request': req.id,
+             'tenant': req.tenant, 'prompt_len': len(tokens),
+             'stream': stream},
+            trace_id=trace_id, span_id=span_id,
+            parent_span_id=parent_span, entity=self._entity())
         self.engine.submit(req)
         metrics_lib.counter('skytpu_engine_requests_total',
                             'HTTP /generate requests accepted.',
                             labels=('stream',)).inc(
                                 labels=(str(stream).lower(),))
-        if stream:
-            return await self._stream_response(request, req, q)
-        return await self._unary_response(req, q)
+        try:
+            if stream:
+                return await self._stream_response(request, req, q)
+            return await self._unary_response(req, q)
+        finally:
+            self.engine.journal_buffered(
+                journal.EventKind.SPAN_END,
+                {'name': 'server.request',
+                 'finish_reason': req.finish_reason,
+                 'generated': len(req.tokens)},
+                trace_id=trace_id, span_id=span_id,
+                parent_span_id=parent_span, entity=self._entity())
 
     async def _next_token(self, q: asyncio.Queue):
         return await asyncio.wait_for(q.get(),
@@ -626,6 +657,15 @@ class ModelServer:
         # Speculative decoding + chunked prefill: acceptance ratio and
         # chunk counters next to the latency percentiles they move.
         body['spec'] = self.engine.spec_stats()
+        # Engine-step snapshot (aggregates only, no ring rows): the
+        # fleet SLO aggregator pulls /slo on the LB's probe cadence and
+        # needs the step-time/stall/heartbeat signal beside the request
+        # percentiles — and it must be LIVE state (recomputed per call,
+        # heartbeat age included), so a drain → supervisor rebuild can
+        # never serve a stale snapshot.
+        steps = self.engine.profiler.snapshot(last_n=0)
+        steps.pop('recent', None)
+        body['steps'] = steps
         return web.json_response(body)
 
     async def _handle_drain(self, request: web.Request) -> web.Response:

@@ -1,0 +1,267 @@
+"""Fleet-wide request tracing + cross-replica SLO plane (ISSUE 13).
+
+E2e, all dark (JAX_PLATFORMS=cpu, in-process servers): a request driven
+through the in-process load balancer — including one failover hop off a
+dead replica — must leave ONE journal trace tree under its
+``X-Request-Id`` (LB proxy span → replica HTTP span → engine lifecycle
+events), the LB's fleet ``/slo`` endpoint must roll up every ready
+replica's SLO surface, and a supervised engine restart must never leave
+``/slo``/``/healthz`` serving stale snapshots.
+"""
+import json
+import socket
+import time
+
+import jax
+import pytest
+import requests
+
+from skypilot_tpu.models import decode
+from skypilot_tpu.models import engine as engine_lib
+from skypilot_tpu.models import llama
+from skypilot_tpu.observability import journal
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import model_server
+from skypilot_tpu.utils import chaos
+
+pytestmark = pytest.mark.engine
+
+CFG = llama.CONFIGS['debug']
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('', 0))
+        return s.getsockname()[1]
+
+
+def _make_server(name: str, num_slots: int = 2) -> model_server.ModelServer:
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    eng = engine_lib.DecodeEngine(params, CFG,
+                                  decode.DecodeConfig(max_len=64),
+                                  num_slots=num_slots, step_chunk=2,
+                                  prefill_buckets=(16,), name=name)
+    srv = model_server.ModelServer(eng, port=0, host='127.0.0.1',
+                                   default_max_new_tokens=8)
+    srv.start()
+    return srv
+
+
+def _wait(cond, timeout=20.0, interval=0.1, msg='condition'):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        result = cond()
+        if result:
+            return result
+        time.sleep(interval)
+    raise AssertionError(f'timed out waiting for {msg}')
+
+
+def test_cross_hop_trace_tree_with_failover_and_fleet_slo(monkeypatch):
+    """ISSUE-13 acceptance: one request through the in-process LB with
+    one failover hop → `skytpu trace <X-Request-Id>` returns a single
+    tree containing the LB, replica-HTTP, and engine spans; the LB's
+    fleet /slo endpoint serves the cross-replica rollup."""
+    monkeypatch.setenv('SKYTPU_FLEET_SLO_INTERVAL', '0.2')
+    srv_a = _make_server('fleet-a')
+    srv_b = _make_server('fleet-b')
+    url_a = f'http://127.0.0.1:{srv_a.port}'
+    url_b = f'http://127.0.0.1:{srv_b.port}'
+    dead = f'http://127.0.0.1:{_free_port()}'  # nothing listening
+
+    # Round-robin with the DEAD replica first: the first proxied
+    # request deterministically selects it, eats a connect error, and
+    # fails over to the live replica.
+    ready = [dead, url_a]
+    lb = lb_lib.LoadBalancer(_free_port(), 'round_robin',
+                             get_ready_urls=lambda: list(ready))
+    lb.start()
+    try:
+        custom = 'feedc0de' * 4
+        r = requests.post(
+            f'http://127.0.0.1:{lb.port}/generate',
+            json={'prompt': [3, 1, 4], 'max_new_tokens': 4,
+                  'stream': False},
+            headers={'X-Request-Id': custom}, timeout=120)
+        assert r.status_code == 200, r.text
+        assert r.headers['X-Request-Id'] == custom
+        assert r.json()['generated'] == 4
+
+        # Flush the replica engine's journal buffer (stats() flushes),
+        # then assert the single tree. The server-side span.end lands a
+        # beat after the client sees the body, so poll.
+        def tree_ready():
+            requests.get(f'{url_a}/healthz', timeout=10)
+            rows = journal.query(trace_id=custom, ascending=True,
+                                 limit=1000)
+            kinds = {e['kind'] for e in rows}
+            names = {(e['payload'] or {}).get('name')
+                     for e in rows if e['kind'] == 'span.end'}
+            if {'lb.proxy', 'server.request'} <= names and \
+                    'engine.admit' in kinds and 'lb.hop' in kinds:
+                return rows
+            return None
+
+        rows = _wait(tree_ready, msg='trace rows')
+        # ONE tree: a single root span (lb.proxy), the replica's
+        # server.request span nested under it, and the engine lifecycle
+        # events attached to the server span.
+        roots = journal.span_tree(rows)
+        assert len(roots) == 1, [r.name for r in roots]
+        lb_root = roots[0]
+        assert lb_root.name == 'lb.proxy'
+        # The failover hop is recorded inside the LB span: one select
+        # of the dead replica, a failover event, a select of the live
+        # one.
+        hop_events = [e for e in lb_root.events if e['kind'] == 'lb.hop']
+        phases = [(e['payload']['phase'], e['payload'].get('replica'))
+                  for e in hop_events]
+        assert ('select', dead) in phases
+        assert ('select', url_a) in phases
+        assert any(p == 'failover' and rep == dead
+                   for p, rep in phases), phases
+        child_names = {c.name for c in lb_root.children}
+        assert 'server.request' in child_names
+        server_span = next(c for c in lb_root.children
+                           if c.name == 'server.request')
+        engine_kinds = {e['kind'] for e in server_span.events}
+        assert 'engine.admit' in engine_kinds
+        assert 'engine.evict' in engine_kinds
+
+        # The CLI renders the same single tree.
+        from click.testing import CliRunner
+        from skypilot_tpu.client import cli as cli_mod
+        res = CliRunner().invoke(cli_mod.cli, ['trace', custom])
+        assert res.exit_code == 0, res.output
+        for needle in ('lb.proxy', 'server.request', 'engine.admit',
+                       'lb.hop'):
+            assert needle in res.output, res.output
+
+        # ------------------------------------------------- fleet /slo
+        # Both live replicas ready; a couple of requests against B so
+        # its window is non-empty too.
+        ready[:] = [url_a, url_b]
+        for _ in range(2):
+            requests.post(f'{url_b}/generate',
+                          json={'prompt': [2, 7, 1], 'max_new_tokens': 2,
+                                'stream': False}, timeout=120)
+
+        def fleet_ready():
+            body = requests.get(f'http://127.0.0.1:{lb.port}/slo',
+                                timeout=10).json()
+            # Wait until a poll has seen BOTH replicas and all three
+            # completed requests (an earlier tick may have sampled a
+            # replica mid-request).
+            if body.get('replica_count') == 2 and \
+                    all(u in body['replicas'] for u in (url_a, url_b)) \
+                    and body['fleet'].get('completed', 0) >= 3:
+                return body
+            return None
+
+        body = _wait(fleet_ready, msg='fleet /slo rollup')
+        assert body['kind'] == 'fleet'
+        row_a = body['replicas'][url_a]
+        assert row_a['completed'] >= 1
+        assert row_a['ttft']['p95'] > 0
+        assert 'engine_steps' in row_a  # the /slo steps block rode up
+        fleet = body['fleet']
+        assert fleet['completed'] >= 3
+        assert fleet['ttft']['p95'] > 0
+        # Fleet gauges live in the LB-side registry.
+        from skypilot_tpu.observability import metrics as metrics_lib
+        reg = metrics_lib.get_registry()
+        assert reg.get('skytpu_fleet_replicas').value() == 2
+        assert reg.get('skytpu_fleet_ttft_seconds').value(
+            labels=('fleet', 'p95')) > 0
+
+        # The fleet body renders via `skytpu slo <lb endpoint>`.
+        res = CliRunner().invoke(
+            cli_mod.cli, ['slo', f'http://127.0.0.1:{lb.port}'])
+        assert res.exit_code == 0, res.output
+        assert 'fleet SLO' in res.output and url_a in res.output
+    finally:
+        lb.stop()
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_slo_and_healthz_survive_supervised_restart(monkeypatch):
+    """ISSUE-13 satellite: a supervised engine crash → rebuild must not
+    leave /slo or /healthz serving stale snapshots — the restart shows
+    up in the resilience block, the step heartbeat is fresh, and new
+    requests land in the telemetry window."""
+    monkeypatch.setenv('SKYTPU_HEALTHZ_MAX_STALENESS_SECONDS', '10')
+    srv = _make_server('restart-slo', num_slots=1)
+    base = f'http://127.0.0.1:{srv.port}'
+    try:
+        r = requests.post(f'{base}/generate',
+                          json={'prompt': [3, 1, 4], 'max_new_tokens': 2,
+                                'stream': False}, timeout=120)
+        assert r.status_code == 200
+
+        # Crash the next engine step (queued request survives the
+        # rebuild and re-prefills — the client sees a normal answer).
+        chaos.reset()
+        monkeypatch.setenv('SKYTPU_CHAOS', 'engine_step_raise:1')
+        r2 = requests.post(f'{base}/generate',
+                           json={'prompt': [1, 2, 3],
+                                 'max_new_tokens': 2, 'stream': False},
+                           timeout=120)
+        monkeypatch.delenv('SKYTPU_CHAOS')
+        chaos.reset()
+
+        def restarted():
+            body = requests.get(f'{base}/slo', timeout=10).json()
+            return (body if body['resilience']['engine_restarts'] >= 1
+                    else None)
+
+        body = _wait(restarted, msg='engine restart in /slo')
+        # A request after the rebuild proves the fresh pool serves.
+        r3 = requests.post(f'{base}/generate',
+                           json={'prompt': [5, 1], 'max_new_tokens': 2,
+                                 'stream': False}, timeout=120)
+        assert r3.status_code == 200
+        body = requests.get(f'{base}/slo', timeout=10).json()
+        # Not stale: the window kept accumulating across the rebuild
+        # and the step heartbeat is live (recomputed per call).
+        finished = body['rates']['finished_total']
+        assert finished >= 2 + (1 if r2.status_code == 200 else 0)
+        steps = body['steps']
+        assert steps['last_step_age_seconds'] is not None
+        assert steps['last_step_age_seconds'] < 10
+        assert body['resilience']['engine_failed'] is False
+        # /healthz agrees: alive and fresh within the staleness bound.
+        h = requests.get(f'{base}/healthz', timeout=10)
+        assert h.status_code == 200, h.text
+        assert float(h.text.split('staleness_seconds=')[1].split()[0]) \
+            < 10
+        # The supervisor journaled the lifecycle.
+        kinds = {e['kind'] for e in journal.query(
+            kinds=[journal.EventKind.ENGINE_CRASH,
+                   journal.EventKind.ENGINE_RESTART], limit=50)}
+        assert kinds == {'engine.crash', 'engine.restart'}
+    finally:
+        srv.stop()
+
+
+def test_drain_keeps_slo_surface_consistent(monkeypatch):
+    """Draining flips /healthz to 503 (the LB routes away) while /slo
+    keeps answering with the DRAINING state — operators can watch a
+    drain through the same surface they alert on. drain_hang holds the
+    DRAINING window open (an idle server would finish the drain and
+    exit between our two probes)."""
+    monkeypatch.setenv('SKYTPU_DRAIN_TIMEOUT_SECONDS', '15')
+    monkeypatch.setenv('SKYTPU_CHAOS', 'drain_hang')
+    srv = _make_server('drain-slo', num_slots=1)
+    base = f'http://127.0.0.1:{srv.port}'
+    try:
+        r = requests.post(f'{base}/drain', timeout=10)
+        assert r.status_code == 202
+        body = requests.get(f'{base}/slo', timeout=10).json()
+        assert body['resilience']['server_state'] in ('draining',
+                                                      'stopped')
+        assert body['resilience']['drains_total'] == 1
+        h = requests.get(f'{base}/healthz', timeout=10)
+        assert h.status_code == 503
+    finally:
+        srv.stop()

@@ -279,6 +279,14 @@ def test_cpu_sched_payload_end_to_end():
     assert spec['base_per_token_ms'] > 0
     assert spec['per_token_speedup'] > 0
     assert 'spec' not in json.loads(lines[-2])['detail']
+    # ISSUE-13: the control-plane SLO ledger rides every perf line,
+    # dark tier included — an empty journal reads zero counts with the
+    # (ungated) gate recorded as passing, never an error.
+    cp = out['detail']['control_plane_slo']
+    assert cp['kind'] == 'control_plane'
+    assert cp['launch']['count'] >= 0
+    assert cp['recovery']['count'] >= 0
+    assert cp['gate']['gate_pass'] is True
 
 
 def test_supervisor_accepts_partial_result_on_decode_wedge():

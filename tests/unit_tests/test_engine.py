@@ -203,6 +203,57 @@ def test_occupancy_and_metrics_and_journal():
     assert all(e['payload']['reason'] == 'length' for e in evicts)
 
 
+def test_hbm_accounting_gauges_and_journal():
+    """ISSUE-13 satellite: per-device weights / pool / workspace bytes
+    published as skytpu_engine_hbm_bytes{kind} and journaled ONCE at
+    engine start beside engine.mesh; under a TP mesh the pool shard is
+    exactly 1/tp of the unsharded pool (sharding is by KV head)."""
+    params = _params()
+    dcfg = decode.DecodeConfig(max_len=32, decode_attention='xla',
+                               kernel_block_k=8)
+    eng = engine_lib.DecodeEngine(params, CFG, dcfg, num_slots=2,
+                                  prefill_buckets=(16,), paged=True,
+                                  name='t-hbm')
+    reg = metrics.get_registry()
+    g = reg.get('skytpu_engine_hbm_bytes')
+    weights = g.value(labels=('weights',))
+    pool = g.value(labels=('paged_pool',))
+    assert weights > 0 and pool > 0
+    # Exact pool math: [L, n_blocks, block_k, Hkv, hd] bf16 (2 bytes).
+    expected_pool = (CFG.n_layers * eng.num_blocks * 8 *
+                     CFG.n_kv_heads * CFG.head_dim * 2) * 2  # k + v
+    assert pool == expected_pool
+    rows = journal.query(kinds=[journal.EventKind.ENGINE_HBM],
+                         entity='engine:t-hbm', limit=10)
+    assert len(rows) == 1
+    payload = rows[0]['payload']
+    assert payload['per_device_bytes']['weights'] == weights
+    assert payload['per_device_bytes']['paged_pool'] == pool
+    assert payload['pool_kind'] == 'paged_pool'
+    # The CPU backend has no memory stats: workspace reads 0 and says
+    # so, instead of faking a number.
+    assert payload['workspace_measured'] is False
+
+    # TP mesh: the per-device pool shard is exactly half.
+    eng_tp = engine_lib.DecodeEngine(params, CFG, dcfg, num_slots=2,
+                                     prefill_buckets=(16,), paged=True,
+                                     tp=2, name='t-hbm-tp')
+    tp_pool = eng_tp._hbm_accounting(  # pylint: disable=protected-access
+        eng_tp.mesh.devices.flat[0])['per_device_bytes']['paged_pool']
+    assert tp_pool == pool // 2
+
+    # Dense engines account their cache under 'kv_cache'.
+    eng_dense = engine_lib.DecodeEngine(params, CFG,
+                                        decode.DecodeConfig(max_len=32),
+                                        num_slots=2,
+                                        prefill_buckets=(16,),
+                                        name='t-hbm-dense')
+    dense_rows = journal.query(kinds=[journal.EventKind.ENGINE_HBM],
+                               entity='engine:t-hbm-dense', limit=10)
+    assert dense_rows[0]['payload']['pool_kind'] == 'kv_cache'
+    assert g.value(labels=('kv_cache',)) > 0
+
+
 # ------------------------------------------------------------- paged mode
 
 

@@ -328,8 +328,61 @@ def test_all_registered_metric_names_match_convention():
                      'skytpu_engine_compiles_total',
                      # Tensor-parallel serving (ISSUE 12).
                      'skytpu_engine_tp_degree',
-                     'skytpu_engine_mesh_devices'):
+                     'skytpu_engine_mesh_devices',
+                     # Fleet SLO rollup + HBM accounting (ISSUE 13).
+                     'skytpu_fleet_replicas',
+                     'skytpu_fleet_ttft_seconds',
+                     'skytpu_fleet_per_token_seconds',
+                     'skytpu_fleet_straggler',
+                     'skytpu_engine_hbm_bytes'):
         assert expected in names, f'{expected} not found by lint scan'
+
+
+def test_metric_label_cardinality_lint():
+    """Lint (ISSUE 13): no unbounded label NAMES at any metric
+    registration site (a per-request id label mints one series per
+    request — the registry and every scrape grow without bound), and no
+    label VALUE expression derives from a request/trace id. The runtime
+    registry enforces the name half too
+    (metrics.UNBOUNDED_LABEL_NAMES); this scan catches the value half
+    and keeps the denylist honest against the whole tree."""
+    reg_re = re.compile(
+        r"""(?:\.(?:counter|gauge|histogram)|RateTracker)\(""")
+    labels_re = re.compile(r'labels\s*=\s*\(')
+    name_in_tuple_re = re.compile(r"['\"]([A-Za-z_][A-Za-z0-9_]*)['\"]")
+    # Expressions that smell like per-request identifiers when used as
+    # a label VALUE.
+    forbidden_value_tokens = ('trace_id', 'request_id', 'req.id',
+                              'request.id', 'span_id', '.trace_id')
+    bad = []
+    pkg = os.path.join(REPO_ROOT, 'skypilot_tpu')
+    sources = [os.path.join(REPO_ROOT, 'bench.py')]
+    for dirpath, _, files in os.walk(pkg):
+        sources += [os.path.join(dirpath, f) for f in files
+                    if f.endswith('.py')]
+    for path in sources:
+        with open(path, encoding='utf-8') as f:
+            src = f.read()
+        rel = os.path.relpath(path, REPO_ROOT)
+        for m in labels_re.finditer(src):
+            tup = _balanced_call(src, m.end() - 1)
+            is_registration = bool(reg_re.search(
+                src[max(0, m.start() - 300):m.start()]))
+            if is_registration:
+                # Registration site: label NAMES are string literals.
+                for name in name_in_tuple_re.findall(tup):
+                    if name in metrics.UNBOUNDED_LABEL_NAMES:
+                        bad.append((rel, f'label name {name!r}'))
+            for token in forbidden_value_tokens:
+                if token in tup:
+                    bad.append((rel, f'label value expr contains '
+                                     f'{token!r}: {tup[:80]}'))
+    assert not bad, f'unbounded metric labels: {bad}'
+    # The runtime guard backs the lint: registration rejects the names.
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        metrics.MetricsRegistry().counter('skytpu_lint_total', 'x',
+                                          labels=('request_id',))
 
 
 def test_all_journal_event_kinds_are_registered():
@@ -383,7 +436,9 @@ def test_all_journal_event_kinds_are_registered():
                      # (ISSUE 11).
                      'ENGINE_COMPILE',
                      # Tensor-parallel serving mesh (ISSUE 12).
-                     'ENGINE_MESH'):
+                     'ENGINE_MESH',
+                     # Fleet tracing + SLO plane (ISSUE 13).
+                     'LB_HOP', 'REPLICA_STRAGGLER', 'ENGINE_HBM'):
         assert expected in attr_names, \
             f'EventKind.{expected} not found by lint scan'
 

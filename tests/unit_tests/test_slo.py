@@ -1,0 +1,271 @@
+"""Fleet + control-plane SLO plane units (ISSUE 13): rollup math,
+straggler detection + transition journaling + the circuit-breaker soft
+signal, the journal-derived control-plane ledger, the bench gate block,
+and the journal extensions that carry the cross-hop trace join."""
+import time
+
+import pytest
+
+from skypilot_tpu.observability import journal
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import slo as slo_lib
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    prev = metrics_lib.get_registry()
+    metrics_lib.set_registry(metrics_lib.MetricsRegistry())
+    yield
+    metrics_lib.set_registry(prev)
+
+
+def _body(completed=10, ttft_p50=0.01, ttft_p95=0.02, tok_p95=0.002,
+          restarts=0, state='running'):
+    return {
+        'window': {'completed': completed},
+        'in_flight': 1, 'queued': 0,
+        'queue_wait_seconds': {'p50': 0.001, 'p95': 0.002},
+        'prefill_seconds': {'p50': 0.001, 'p95': 0.002},
+        'ttft_seconds': {'p50': ttft_p50, 'p95': ttft_p95},
+        'per_token_seconds': {'p50': tok_p95 / 2, 'p95': tok_p95},
+        'total_seconds': {'p50': 0.01, 'p95': 0.05},
+        'resilience': {'engine_restarts': restarts,
+                       'server_state': state},
+        'steps': {'steps_recorded': 5, 'stalls': 0,
+                  'step_seconds': {'p95': 0.001},
+                  'last_step_age_seconds': 0.1},
+    }
+
+
+# ------------------------------------------------------------- rollup
+
+
+def test_fleet_rollup_weighted_math():
+    snaps = {'a': _body(completed=30, ttft_p95=0.1),
+             'b': _body(completed=10, ttft_p95=0.5)}
+    r = slo_lib.fleet_rollup(snaps)
+    assert r['kind'] == 'fleet'
+    assert r['replica_count'] == 2
+    # Completed-weighted mean: (30*0.1 + 10*0.5) / 40 = 0.2.
+    assert r['fleet']['ttft']['p95'] == pytest.approx(0.2)
+    assert r['fleet']['completed'] == 40
+    assert r['replicas']['a']['engine_steps']['steps_recorded'] == 5
+
+
+def test_fleet_rollup_empty_and_zero_weight():
+    assert slo_lib.fleet_rollup({})['replica_count'] == 0
+    r = slo_lib.fleet_rollup({'a': _body(completed=0)})
+    assert r['fleet']['ttft']['p95'] == 0.0  # no weight, no NaN
+
+
+def test_straggler_detection_uses_median_low():
+    # 2-replica fleet: median_low compares the slow replica against the
+    # FAST one (the midpoint could never deviate 2x from itself).
+    snaps = {'fast': _body(ttft_p95=0.02),
+             'slow': _body(ttft_p95=0.5)}
+    r = slo_lib.fleet_rollup(snaps)
+    assert r['stragglers'] == ['slow']
+    assert r['replicas']['slow']['straggler'] is True
+    assert r['replicas']['fast']['straggler'] is False
+    assert r['straggler_policy']['fleet_ttft_p95_median'] == \
+        pytest.approx(0.02)
+
+
+def test_straggler_needs_min_window_and_min_deviation(monkeypatch):
+    # Below the completed-window floor: never flagged (cold replicas
+    # with 1-2 samples are noise, not stragglers).
+    snaps = {'fast': _body(completed=2, ttft_p95=0.02),
+             'slow': _body(completed=2, ttft_p95=0.5)}
+    assert slo_lib.fleet_rollup(snaps)['stragglers'] == []
+    # Deviation under the absolute floor: 2x of a sub-ms median is
+    # still sub-ms jitter.
+    monkeypatch.setenv(slo_lib.STRAGGLER_MIN_SECONDS_ENV, '0.05')
+    snaps = {'fast': _body(ttft_p95=0.001),
+             'slow': _body(ttft_p95=0.01)}
+    assert slo_lib.fleet_rollup(snaps)['stragglers'] == []
+
+
+def test_fleet_slo_journals_transitions_and_feeds_breaker(monkeypatch):
+    nudged = []
+    fleet = slo_lib.FleetSlo(entity='lb:test',
+                             straggler_cb=nudged.append)
+    fast, slow = _body(ttft_p95=0.02), _body(ttft_p95=0.5)
+    fleet.update({'a': fast, 'b': slow})
+    fleet.update({'a': fast, 'b': slow})  # steady state: no re-journal
+    rows = journal.query(kinds=[journal.EventKind.REPLICA_STRAGGLER],
+                         limit=50)
+    assert len(rows) == 1
+    assert rows[0]['payload'] == {
+        'replica': 'b', 'straggler': True,
+        'ttft_p95_seconds': 0.5,
+        'fleet_median_seconds': 0.02,
+        'factor': slo_lib.DEFAULT_STRAGGLER_FACTOR}
+    assert nudged == ['b']
+    # Recovery journals the clear transition.
+    fleet.update({'a': fast, 'b': fast})
+    rows = journal.query(kinds=[journal.EventKind.REPLICA_STRAGGLER],
+                         limit=50, ascending=True)
+    assert len(rows) == 2
+    assert rows[-1]['payload'] == {'replica': 'b', 'straggler': False}
+    # Gauges: per-replica + the fleet row.
+    reg = metrics_lib.get_registry()
+    assert reg.get('skytpu_fleet_replicas').value() == 2
+    assert reg.get('skytpu_fleet_ttft_seconds').value(
+        labels=('a', 'p95')) == pytest.approx(0.02)
+    assert reg.get('skytpu_fleet_ttft_seconds').value(
+        labels=('fleet', 'p95')) == pytest.approx(0.02)
+    assert reg.get('skytpu_fleet_straggler').value(labels=('b',)) == 0.0
+    # snapshot() is the LB /slo body, with freshness.
+    body = fleet.snapshot()
+    assert body['kind'] == 'fleet' and 'age_seconds' in body
+    # A replica that leaves the fleet takes its series with it: a
+    # departed straggler must not export straggler=1 (or its stale
+    # latencies) forever.
+    fleet.update({'a': fast, 'b': slow})
+    fleet.update({'a': fast})
+    ttft_lines = '\n'.join(
+        reg.get('skytpu_fleet_ttft_seconds').expose())
+    straggler_lines = '\n'.join(
+        reg.get('skytpu_fleet_straggler').expose())
+    assert 'replica="b"' not in ttft_lines
+    assert 'replica="b"' not in straggler_lines
+    assert 'replica="a"' in ttft_lines
+
+
+def test_breaker_soft_signal_never_ejects_alone():
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    b = lb_lib.ReplicaCircuitBreaker(threshold=3, backoff_seconds=10)
+    for _ in range(10):
+        b.record_soft_failure('u')
+    assert not b.is_ejected('u')
+    # ...but a straggling replica ejects on its FIRST hard failure:
+    # the soft streak sits at threshold-1.
+    assert b.record_failure('u') is not None
+    assert b.is_ejected('u')
+
+
+def test_format_fleet_slo_renders_rows():
+    r = slo_lib.fleet_rollup({'fast': _body(ttft_p95=0.02),
+                              'slow': _body(ttft_p95=0.5)})
+    out = slo_lib.format_fleet_slo({**r, 'age_seconds': 0.0})
+    assert 'fast' in out and 'slow' in out and 'STRAGGLER' in out
+    assert 'fleet' in out
+    assert 'No fleet SLO data' in slo_lib.format_fleet_slo(
+        {'replicas': {}})
+
+
+# ------------------------------------------------ control-plane ledger
+
+
+def test_control_plane_ledger_pairs_launches_and_recoveries():
+    now = time.time()
+    ev = journal.event
+    # Two successful launches (3s, 7s), one failed (never counted in
+    # percentiles, counted as failed).
+    ev(journal.EventKind.LAUNCH_START, 'cluster:a', ts=now - 100)
+    ev(journal.EventKind.LAUNCH_DONE, 'cluster:a', ts=now - 97)
+    ev(journal.EventKind.LAUNCH_START, 'cluster:b', ts=now - 90)
+    ev(journal.EventKind.LAUNCH_DONE, 'cluster:b', ts=now - 83)
+    ev(journal.EventKind.LAUNCH_START, 'cluster:c', ts=now - 80)
+    ev(journal.EventKind.LAUNCH_ERROR, 'cluster:c', ts=now - 79)
+    # Recovery durations come from the journaled seconds payload.
+    ev(journal.EventKind.JOB_RECOVER_DONE, 'job:1',
+       {'recovered': True, 'seconds': 12.0}, ts=now - 50)
+    ev(journal.EventKind.JOB_RECOVER_DONE, 'job:2',
+       {'recovered': False, 'seconds': 30.0}, ts=now - 40)
+    body = slo_lib.control_plane_slo(now=now)
+    assert body['launch']['count'] == 2
+    assert body['launch']['failed'] == 1
+    assert body['launch']['p50_seconds'] == pytest.approx(5.0)
+    assert body['launch']['max_seconds'] == pytest.approx(7.0)
+    assert body['launch']['p99_seconds'] <= 7.0
+    assert body['recovery']['count'] == 2
+    assert body['recovery']['failed'] == 1
+    assert body['recovery']['max_seconds'] == pytest.approx(30.0)
+    out = slo_lib.format_control_plane(body)
+    assert 'launch' in out and 'recovery' in out
+
+
+def test_bench_slo_block_gate(monkeypatch):
+    now = time.time()
+    journal.event(journal.EventKind.LAUNCH_START, 'cluster:g',
+                  ts=now - 20)
+    journal.event(journal.EventKind.LAUNCH_DONE, 'cluster:g',
+                  ts=now - 10)
+    # Ungated: pass by definition, gate recorded as absent.
+    block = slo_lib.bench_slo_block(now=now)
+    assert block['gate']['p99_launch_seconds_max'] is None
+    assert block['gate']['gate_pass'] is True
+    # Gated tight: the 10s launch p99 fails a 5s gate.
+    monkeypatch.setenv(slo_lib.BENCH_LAUNCH_GATE_ENV, '5')
+    assert slo_lib.bench_slo_block(now=now)['gate']['gate_pass'] is False
+    monkeypatch.setenv(slo_lib.BENCH_LAUNCH_GATE_ENV, '60')
+    assert slo_lib.bench_slo_block(now=now)['gate']['gate_pass'] is True
+
+
+def test_bench_slo_gate_fails_on_total_launch_failure(monkeypatch):
+    """An armed gate over a window where EVERY launch failed must fail
+    — zero successes is the worst regression, not a free pass."""
+    now = time.time()
+    journal.event(journal.EventKind.LAUNCH_START, 'cluster:x',
+                  ts=now - 20)
+    journal.event(journal.EventKind.LAUNCH_ERROR, 'cluster:x',
+                  ts=now - 19)
+    monkeypatch.setenv(slo_lib.BENCH_LAUNCH_GATE_ENV, '60')
+    block = slo_lib.bench_slo_block(now=now)
+    assert block['launch']['count'] == 0
+    assert block['launch']['failed'] == 1
+    assert block['gate']['gate_pass'] is False
+    # Unarmed, the same window still just records the facts.
+    monkeypatch.delenv(slo_lib.BENCH_LAUNCH_GATE_ENV)
+    assert slo_lib.bench_slo_block(now=now)['gate']['gate_pass'] is True
+
+
+# ------------------------------------- journal extensions (trace join)
+
+
+def test_event_batch_span_override_tuple():
+    ts = time.time()
+    journal.event_batch([
+        ('engine.admit', 'engine:t', {'request': 'r1'}, ts,
+         ('trace-x', 'span-y', 'parent-z')),
+        ('engine.evict', 'engine:t', {'request': 'r1'}, ts + 0.1,
+         'trace-x'),
+    ])
+    rows = journal.query(trace_id='trace-x', ascending=True)
+    assert len(rows) == 2
+    assert (rows[0]['span_id'], rows[0]['parent_span_id']) == \
+        ('span-y', 'parent-z')
+    # Bare-string override keeps the pre-fleet behavior: span nulled.
+    assert rows[1]['span_id'] is None
+
+
+def test_journal_only_kinds_filter(monkeypatch):
+    monkeypatch.setenv(journal.ONLY_KINDS_ENV, 'engine.slow_request')
+    journal.event(journal.EventKind.ENGINE_ADMIT, 'engine:f',
+                  {'request': 'r'}, trace_id='filtered-t')
+    journal.event(journal.EventKind.ENGINE_SLOW_REQUEST, 'engine:f',
+                  {'request': 'r'}, trace_id='filtered-t')
+    journal.event_batch([
+        ('engine.evict', 'engine:f', {}, time.time(), 'filtered-t'),
+        ('engine.slow_request', 'engine:f', {'n': 2}, time.time(),
+         'filtered-t'),
+    ])
+    kinds = [r['kind'] for r in journal.query(trace_id='filtered-t')]
+    assert kinds == ['engine.slow_request', 'engine.slow_request']
+    # Unregistered kinds still raise even while filtered out.
+    with pytest.raises(ValueError):
+        journal.event('engine.bogus', 'engine:f')
+    monkeypatch.delenv(journal.ONLY_KINDS_ENV)
+    journal.event(journal.EventKind.ENGINE_ADMIT, 'engine:f', {},
+                  trace_id='filtered-t')
+    assert len(journal.query(trace_id='filtered-t')) == 3
+
+
+def test_unbounded_metric_label_names_rejected():
+    with pytest.raises(ValueError, match='unbounded'):
+        metrics_lib.counter('skytpu_bad_total', 'x',
+                            labels=('request_id',))
+    with pytest.raises(ValueError, match='unbounded'):
+        metrics_lib.gauge('skytpu_bad_gauge', 'x',
+                          labels=('tenant', 'trace_id'))
