@@ -1,0 +1,277 @@
+"""Rule engine for the ``skytpu lint`` static-analysis plane.
+
+Dependency-free (stdlib ``ast`` only — the tier-1 driver test runs the
+full tree scan without importing JAX). The engine owns everything that
+is not rule-specific:
+
+* the file walker (``iter_python_files``),
+* one parse per file (``ModuleSource``: source, lines, AST, import
+  aliases),
+* inline suppressions — ``# lint: disable=<rule>[,<rule>...]`` on the
+  flagged line, or on a comment-only line immediately above it — plus
+  the unused-suppression check (a suppression that matched no finding
+  is itself a finding: stale suppressions are how lints rot),
+* ``Finding`` records and the JSON shape the CLI emits.
+
+Rules subclass :class:`Rule` and implement ``check(module)`` (per
+file); cross-file rules (the env-var registry) aggregate in ``check``
+and emit from ``finalize()``. Findings from ``finalize`` still carry
+the originating file/line, so inline suppression works uniformly.
+"""
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+SUPPRESS_RE = re.compile(r'#\s*lint:\s*disable=([A-Za-z0-9_\-, ]+)')
+HOLDS_RE = re.compile(r'#\s*lint:\s*holds=([A-Za-z0-9_, ]+)')
+
+# The rule name the engine itself emits for stale suppressions. Not
+# suppressible (a suppressed unused-suppression would be unreachable
+# by construction).
+UNUSED_SUPPRESSION = 'unused-suppression'
+# Emitted when a scanned file does not parse; counts as a finding so a
+# syntax error cannot silently shrink the scan.
+PARSE_ERROR = 'parse-error'
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One lint hit: ``path:line  rule  message``."""
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def as_dict(self) -> Dict[str, object]:
+        return {'path': self.path, 'line': self.line, 'rule': self.rule,
+                'message': self.message}
+
+    def render(self) -> str:
+        return f'{self.path}:{self.line}: [{self.rule}] {self.message}'
+
+
+class ImportMap:
+    """Module-level import aliases, for resolving dotted call names.
+
+    ``import requests as requests_lib`` maps ``requests_lib`` →
+    ``requests``; ``from urllib.request import urlopen`` maps
+    ``urlopen`` → ``urllib.request.urlopen``. ``resolve`` rewrites a
+    dotted name through both tables so rules can match canonical names
+    (``requests.get``, ``time.sleep``) regardless of local aliasing.
+    """
+
+    def __init__(self, tree: ast.AST):
+        self.modules: Dict[str, str] = {}
+        self.names: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split('.')[0]
+                    # `import a.b` binds `a`; `import a.b as c` binds c→a.b.
+                    self.modules[local] = (alias.name if alias.asname
+                                           else alias.name.split('.')[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    self.names[local] = f'{node.module}.{alias.name}'
+
+    def resolve(self, dotted: Optional[str]) -> Optional[str]:
+        if not dotted:
+            return None
+        head, sep, rest = dotted.partition('.')
+        if head in self.modules:
+            return self.modules[head] + sep + rest
+        if head in self.names:
+            return self.names[head] + sep + rest
+        return dotted
+
+    def aliases_of(self, module: str) -> Set[str]:
+        """Local names an import bound into ``module``'s namespace —
+        plain imports (``import requests as requests_lib``) AND
+        from-imports (``from aiohttp import ClientSession``). A local
+        *variable* that merely shadows the name (k8s_api's ``requests``
+        resource dict) is not an import and does not count."""
+        out = {local for local, target in self.modules.items()
+               if target == module or target.startswith(module + '.')}
+        out |= {local for local, target in self.names.items()
+                if target.startswith(module + '.')}
+        return out
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return '.'.join(reversed(parts))
+    return None
+
+
+class ModuleSource:
+    """One parsed file: source, line table, AST, imports, suppressions."""
+
+    def __init__(self, path: str, display_path: str, source: str):
+        self.path = path
+        self.display_path = display_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.parts = tuple(os.path.normpath(display_path).split(os.sep))
+        self.tree = ast.parse(source, filename=path)
+        self.imports = ImportMap(self.tree)
+        # line number → set of rule names suppressed there.
+        self.suppressions: Dict[int, Set[str]] = {}
+        # lines that are comment-only (a suppression there covers the
+        # next line).
+        self.comment_only: Set[int] = set()
+        for i, line in enumerate(self.lines, start=1):
+            m = SUPPRESS_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(',')
+                         if r.strip()}
+                self.suppressions.setdefault(i, set()).update(rules)
+            if line.strip().startswith('#'):
+                self.comment_only.add(i)
+
+    def suppression_lines_for(self, line: int) -> List[int]:
+        """Lines whose suppressions cover a finding at ``line``: the
+        line itself, or a comment-only line directly above it."""
+        covers = [line]
+        if line - 1 in self.comment_only:
+            covers.append(line - 1)
+        return covers
+
+    def holds_locks(self, fn: ast.AST) -> Set[str]:
+        """Locks a ``# lint: holds=<lock>`` annotation on the def line
+        asserts are held by every caller (helper methods called under
+        an already-taken lock)."""
+        lineno = getattr(fn, 'lineno', None)
+        if lineno is None or lineno > len(self.lines):
+            return set()
+        m = HOLDS_RE.search(self.lines[lineno - 1])
+        if not m:
+            return set()
+        return {n.strip() for n in m.group(1).split(',') if n.strip()}
+
+
+class Rule:
+    """Base class: subclasses set ``name``/``description`` and
+    implement ``check``; cross-file rules also ``finalize``."""
+
+    name: str = ''
+    description: str = ''
+
+    def check(self, module: ModuleSource) -> List[Finding]:
+        raise NotImplementedError
+
+    def finalize(self) -> List[Finding]:
+        return []
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
+    """Expand files/directories into .py files (skipping __pycache__
+    and hidden dirs), deduped, in sorted order."""
+    seen: Set[str] = set()
+    for path in paths:
+        path = os.path.abspath(path)
+        if os.path.isfile(path):
+            seen.add(path)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = [d for d in dirnames
+                           if d != '__pycache__'
+                           and not d.startswith('.')]
+            seen.update(os.path.join(dirpath, f) for f in filenames
+                        if f.endswith('.py'))
+    return iter(sorted(seen))
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]
+    files_scanned: int
+    rules: List[str]
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            'findings': [f.as_dict() for f in sorted(self.findings)],
+            'files_scanned': self.files_scanned,
+            'rules': sorted(self.rules),
+            'clean': self.clean,
+        }
+
+
+def _display_path(path: str, root: Optional[str]) -> str:
+    base = os.path.abspath(root) if root else os.getcwd()
+    rel = os.path.relpath(path, base)
+    return path if rel.startswith('..') else rel
+
+
+def run(paths: Sequence[str], rules: Sequence[Rule], *,
+        root: Optional[str] = None,
+        known_rule_names: Optional[Iterable[str]] = None) -> LintResult:
+    """Walk ``paths``, run every rule, apply suppressions, report
+    unused suppressions.
+
+    ``known_rule_names`` is the full registry vocabulary: suppressions
+    naming a rule that is registered but not active this run are left
+    alone (a ``--rule async-blocking`` pass must not report every other
+    rule's suppressions as stale); names in no registry at all are
+    reported (typo catcher).
+    """
+    active = {r.name for r in rules}
+    known = set(known_rule_names) if known_rule_names else set(active)
+    known |= active
+    modules: List[ModuleSource] = []
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        display = _display_path(path, root)
+        try:
+            with open(path, encoding='utf-8') as f:
+                source = f.read()
+            modules.append(ModuleSource(path, display, source))
+        except (SyntaxError, ValueError, OSError) as e:
+            findings.append(Finding(display, getattr(e, 'lineno', 0) or 0,
+                                    PARSE_ERROR, f'cannot analyze: {e}'))
+    for rule in rules:
+        for module in modules:
+            findings.extend(rule.check(module))
+        findings.extend(rule.finalize())
+
+    by_display = {m.display_path: m for m in modules}
+    used: Set[Tuple[str, int, str]] = set()
+    kept: List[Finding] = []
+    for f in findings:
+        module = by_display.get(f.path)
+        suppressed = False
+        if module is not None and f.rule not in (UNUSED_SUPPRESSION,
+                                                 PARSE_ERROR):
+            for line in module.suppression_lines_for(f.line):
+                if f.rule in module.suppressions.get(line, set()):
+                    used.add((f.path, line, f.rule))
+                    suppressed = True
+        if not suppressed:
+            kept.append(f)
+    for module in modules:
+        for line, names in sorted(module.suppressions.items()):
+            for name in sorted(names):
+                if name not in known:
+                    kept.append(Finding(
+                        module.display_path, line, UNUSED_SUPPRESSION,
+                        f'suppression names unknown rule {name!r}'))
+                elif (name in active
+                      and (module.display_path, line, name) not in used):
+                    kept.append(Finding(
+                        module.display_path, line, UNUSED_SUPPRESSION,
+                        f'suppression for {name!r} matched no finding '
+                        '(stale — remove it)'))
+    return LintResult(findings=sorted(kept), files_scanned=len(modules),
+                      rules=sorted(active))

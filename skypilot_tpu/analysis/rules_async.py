@@ -1,0 +1,113 @@
+"""async-blocking: no synchronous blocking calls on the event loop.
+
+The serving plane's asyncio surfaces (LB proxy, model server, API
+server) stall EVERY in-flight stream when a handler blocks — the exact
+bug class PR 13 fixed by hand when the LB's journal fsync paused proxy
+streams. This rule flags blocking calls reached *lexically* inside
+``async def`` bodies:
+
+* ``time.sleep`` (use ``asyncio.sleep``),
+* blocking HTTP: any ``requests.*`` call, ``urllib.request.urlopen``
+  (use the aiohttp session the LB already holds),
+* ``subprocess.run`` / ``call`` / ``check_call`` / ``check_output``
+  (use ``asyncio.create_subprocess_*``),
+* sqlite commits: ``.execute(`` / ``.executemany(`` / ``.commit(``
+  method calls (an fsync under the loop),
+* ``os.fsync`` / ``os.fdatasync`` / file ``.fsync()`` and bare
+  zero-arg ``.read()`` on a file-like.
+
+The sanctioned escape is ``loop.run_in_executor(...)`` /
+``asyncio.to_thread(...)``: the blocking call then sits in a lambda or
+a named function — a fresh (sync) scope — so it is no longer lexically
+inside the async body and is not flagged. Directly ``await``-ed calls
+(aiosqlite, aiofiles) are async by construction and skipped.
+"""
+import ast
+from typing import List, Optional
+
+from skypilot_tpu.analysis import engine
+
+_SUBPROCESS_BLOCKING = ('run', 'call', 'check_call', 'check_output',
+                        'getoutput', 'getstatusoutput')
+_REQUESTS_VERBS = ('get', 'post', 'put', 'head', 'delete', 'patch',
+                   'request')
+_SQLITE_METHODS = ('execute', 'executemany', 'executescript', 'commit')
+
+
+class AsyncBlockingRule(engine.Rule):
+    name = 'async-blocking'
+    description = ('Blocking call (sleep/HTTP/subprocess/sqlite/fsync) '
+                   'lexically inside an async def; wrap in '
+                   'run_in_executor/to_thread.')
+
+    def check(self, module: engine.ModuleSource) -> List[engine.Finding]:
+        findings: List[engine.Finding] = []
+        requests_aliases = module.imports.aliases_of('requests')
+        awaited = {id(n.value) for n in ast.walk(module.tree)
+                   if isinstance(n, ast.Await)}
+
+        def classify(call: ast.Call) -> Optional[str]:
+            dotted = engine.dotted_name(call.func)
+            canonical = module.imports.resolve(dotted)
+            if canonical:
+                if canonical == 'time.sleep':
+                    return ('time.sleep blocks the event loop — use '
+                            'await asyncio.sleep')
+                head = dotted.partition('.')[0] if dotted else ''
+                _, _, tail = canonical.partition('.')
+                if head in requests_aliases and (
+                        tail in _REQUESTS_VERBS
+                        or tail.startswith('Session')):
+                    return (f'{canonical} is a synchronous HTTP call on '
+                            'the event loop — use aiohttp or '
+                            'run_in_executor')
+                if canonical == 'urllib.request.urlopen':
+                    return ('urlopen is a synchronous HTTP call on the '
+                            'event loop — use aiohttp or run_in_executor')
+                if (canonical.partition('.')[0] == 'subprocess'
+                        and tail in _SUBPROCESS_BLOCKING):
+                    return (f'{canonical} blocks the event loop — use '
+                            'asyncio.create_subprocess_exec or '
+                            'run_in_executor')
+                if canonical in ('os.fsync', 'os.fdatasync'):
+                    return (f'{canonical} blocks the event loop on disk '
+                            'flush — use run_in_executor')
+            if isinstance(call.func, ast.Attribute):
+                attr = call.func.attr
+                if attr in _SQLITE_METHODS:
+                    return (f'.{attr}() is a blocking sqlite/db call on '
+                            'the event loop — use run_in_executor')
+                if attr == 'fsync':
+                    return ('.fsync() blocks the event loop on disk '
+                            'flush — use run_in_executor')
+                if (attr == 'read' and not call.args
+                        and not call.keywords):
+                    return ('bare .read() can block the event loop on '
+                            'I/O — use run_in_executor (or an async '
+                            'read)')
+            return None
+
+        def visit(node: ast.AST, in_async: bool) -> None:
+            if isinstance(node, ast.AsyncFunctionDef):
+                for child in ast.iter_child_nodes(node):
+                    visit(child, True)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.Lambda)):
+                # A new sync scope: its body runs wherever it is CALLED
+                # (run_in_executor hands it to a worker thread) — the
+                # sanctioned escape hatch.
+                for child in ast.iter_child_nodes(node):
+                    visit(child, False)
+                return
+            if (in_async and isinstance(node, ast.Call)
+                    and id(node) not in awaited):
+                message = classify(node)
+                if message:
+                    findings.append(engine.Finding(
+                        module.display_path, node.lineno, self.name,
+                        message))
+            for child in ast.iter_child_nodes(node):
+                visit(child, in_async)
+
+        visit(module.tree, False)
+        return findings

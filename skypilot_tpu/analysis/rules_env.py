@@ -1,0 +1,97 @@
+"""env-registry: every ``SKYTPU_*`` knob is registered and documented.
+
+~150 ``SKYTPU_*`` environment variables steer the tree today; before
+this rule each one lived only at its read site, and the docs' knob
+tables drifted with every PR. The registry
+(:mod:`skypilot_tpu.utils.env_registry`) is the single source of truth
+— (name, default, one-line doc, consumer module, doc group) — and the
+docs generator renders the knob tables from it.
+
+This rule holds both directions:
+
+* an exact string literal ``SKYTPU_<NAME>`` anywhere in the scanned
+  tree (outside the registry itself) that is not a registry entry →
+  *unregistered* finding at the read site;
+* a registry entry whose name appears in NO scanned file, while its
+  declared consumer module was part of the scan → *unread* finding at
+  the entry's line in the registry (dead knobs rot docs).
+
+Literals must match exactly (``^SKYTPU_[A-Z0-9_]+$``): shell snippets,
+heredoc markers and prefixes of dynamically-built names
+(``f'SKYTPU_{cloud}_FAKE'``) do not trigger the rule — dynamic
+families are documented as pattern entries in the registry but are
+not statically checkable.
+"""
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis import engine
+
+ENV_NAME_RE = re.compile(r'^SKYTPU_[A-Z0-9_]+$')
+REGISTRY_BASENAME = 'env_registry.py'
+
+
+class EnvRegistryRule(engine.Rule):
+    name = 'env-registry'
+    description = ('SKYTPU_* env read missing from '
+                   'utils/env_registry.py, or a registry entry no '
+                   'longer read anywhere.')
+
+    def __init__(self, registry: Optional[Dict[str, object]] = None):
+        # Injectable for fixture tests; default is the real registry.
+        if registry is None:
+            from skypilot_tpu.utils import env_registry
+            registry = env_registry.REGISTRY
+        self._registry = registry
+        self._reads: Dict[str, Tuple[str, int]] = {}
+        self._scanned_files: Set[str] = set()
+        self._registry_lines: Dict[str, Tuple[str, int]] = {}
+
+    def check(self, module: engine.ModuleSource) -> List[engine.Finding]:
+        self._scanned_files.add('/'.join(module.parts))
+        if module.parts[-1] == REGISTRY_BASENAME:
+            # The registry itself: record each entry's line so the
+            # unread finding lands on the stale entry, not the file.
+            for node in ast.walk(module.tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and ENV_NAME_RE.match(node.value)
+                        and node.value not in self._registry_lines):
+                    self._registry_lines[node.value] = (
+                        module.display_path, node.lineno)
+            return []
+        findings: List[engine.Finding] = []
+        for node in ast.walk(module.tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and ENV_NAME_RE.match(node.value)):
+                name = node.value
+                self._reads.setdefault(
+                    name, (module.display_path, node.lineno))
+                if name not in self._registry:
+                    findings.append(engine.Finding(
+                        module.display_path, node.lineno, self.name,
+                        f'{name} is not registered in '
+                        'skypilot_tpu/utils/env_registry.py — add '
+                        '(name, default, doc, consumer)'))
+        return findings
+
+    def finalize(self) -> List[engine.Finding]:
+        findings: List[engine.Finding] = []
+        for name, entry in self._registry.items():
+            if name in self._reads:
+                continue
+            consumer = getattr(entry, 'consumer', None) or ''
+            if consumer not in self._scanned_files:
+                # Partial scan (e.g. `skytpu lint skypilot_tpu/serve`):
+                # absence proves nothing about files outside it.
+                continue
+            path, line = self._registry_lines.get(name, ('', 0))
+            findings.append(engine.Finding(
+                path or 'skypilot_tpu/utils/env_registry.py', line,
+                self.name,
+                f'registry entry {name} is read nowhere in the scanned '
+                f'tree (consumer {consumer}) — remove the dead knob or '
+                'fix the consumer'))
+        return findings

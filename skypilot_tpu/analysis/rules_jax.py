@@ -1,0 +1,149 @@
+"""jax-tracer-hygiene: no host effects inside jitted/shard_mapped code.
+
+The tier-1 replay gates and tp-parity pins depend on jitted dispatches
+being pure functions of their (traced) inputs: the same trace must
+replay bit-identically across restarts, tp degrees and cache states.
+Inside any function that is jitted — decorated ``@jax.jit`` /
+``@functools.partial(jax.jit, ...)``, or wrapped via
+``name = jax.jit(fn, ...)`` / ``compat.shard_map(fn, ...)`` — this
+rule flags:
+
+* host sync: ``.item()`` anywhere; ``float(x)`` / ``int(x)`` /
+  ``np.asarray(x)`` where ``x`` is a parameter of the jitted function
+  (a traced argument — on static args, suppress inline with the
+  justification),
+* ``print`` (side effect that fires at TRACE time, silent thereafter),
+* nondeterminism: ``np.random.*`` and stdlib ``random.*`` (host RNG is
+  invisible to the trace — thread ``jax.random`` keys instead),
+* ``time.*`` (a traced timestamp is frozen at compile time).
+
+Detection is lexical: a nested helper ``def`` inside a jitted body is
+traced too and is checked; a module-level helper merely *called* from
+jitted code is not (annotate/jit it directly if it needs the checks).
+"""
+import ast
+from typing import List, Optional, Set
+
+from skypilot_tpu.analysis import engine
+
+_JIT_WRAPPERS = ('jax.jit', 'jit', 'jax.pjit', 'pjit.pjit')
+_SHARD_WRAPPERS = ('shard_map',)  # any `*.shard_map` / bare shard_map
+_PARTIAL = ('functools.partial', 'partial')
+
+
+def _is_jit_name(canonical: Optional[str]) -> bool:
+    if not canonical:
+        return False
+    return (canonical in _JIT_WRAPPERS
+            or canonical.split('.')[-1] in _SHARD_WRAPPERS)
+
+
+class JaxTracerHygieneRule(engine.Rule):
+    name = 'jax-tracer-hygiene'
+    description = ('Host sync/print/host-RNG/time inside a jitted or '
+                   'shard_mapped function breaks replay determinism.')
+
+    def check(self, module: engine.ModuleSource) -> List[engine.Finding]:
+        jitted_names = self._collect_wrapped_names(module)
+        findings: List[engine.Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if (node.name in jitted_names
+                        or self._has_jit_decorator(module, node)):
+                    self._check_traced_body(module, node, findings)
+            elif isinstance(node, ast.Call):
+                # Inline-lambda form: jax.jit(lambda ...) /
+                # shard_map(lambda ...).
+                canonical = module.imports.resolve(
+                    engine.dotted_name(node.func))
+                if (_is_jit_name(canonical) and node.args
+                        and isinstance(node.args[0], ast.Lambda)):
+                    self._check_traced_body(module, node.args[0],
+                                            findings)
+        return findings
+
+    def _collect_wrapped_names(self,
+                               module: engine.ModuleSource) -> Set[str]:
+        """Function names passed to jax.jit(...)/shard_map(...) as the
+        wrapped callable (``step = jax.jit(_step, ...)`` marks
+        ``_step``)."""
+        names: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            canonical = module.imports.resolve(
+                engine.dotted_name(node.func))
+            if not _is_jit_name(canonical):
+                continue
+            target = node.args[0] if node.args else None
+            if target is None:
+                for kw in node.keywords:
+                    if kw.arg in ('f', 'fun', 'func'):
+                        target = kw.value
+            if isinstance(target, ast.Name):
+                names.add(target.id)
+        return names
+
+    def _has_jit_decorator(self, module: engine.ModuleSource,
+                           fn: ast.AST) -> bool:
+        for dec in fn.decorator_list:
+            canonical = module.imports.resolve(engine.dotted_name(dec))
+            if _is_jit_name(canonical):
+                return True
+            if isinstance(dec, ast.Call):
+                dec_name = module.imports.resolve(
+                    engine.dotted_name(dec.func))
+                if _is_jit_name(dec_name):
+                    return True
+                if dec_name in _PARTIAL and dec.args:
+                    inner = module.imports.resolve(
+                        engine.dotted_name(dec.args[0]))
+                    if _is_jit_name(inner):
+                        return True
+        return False
+
+    def _check_traced_body(self, module: engine.ModuleSource,
+                           fn: ast.AST,
+                           findings: List[engine.Finding]) -> None:
+        params: Set[str] = set()
+        args = fn.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            params.add(a.arg)
+        fn_name = getattr(fn, 'name', '<lambda>')
+
+        def flag(node: ast.AST, what: str) -> None:
+            findings.append(engine.Finding(
+                module.display_path, node.lineno, self.name,
+                f'{what} inside jitted `{fn_name}`'))
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == 'item' and not node.args):
+                flag(node, 'host sync `.item()`')
+                continue
+            canonical = module.imports.resolve(
+                engine.dotted_name(node.func))
+            if canonical == 'print':
+                flag(node, '`print` (fires at trace time only)')
+            elif canonical in ('float', 'int') and len(node.args) == 1:
+                arg = node.args[0]
+                if isinstance(arg, ast.Name) and arg.id in params:
+                    flag(node, f'host sync `{canonical}()` on traced '
+                               f'argument `{arg.id}`')
+            elif (canonical in ('numpy.asarray', 'np.asarray')
+                  and node.args and isinstance(node.args[0], ast.Name)
+                  and node.args[0].id in params):
+                flag(node, 'host sync `np.asarray()` on traced '
+                           f'argument `{node.args[0].id}`')
+            elif canonical and (canonical.startswith('numpy.random.')
+                                or canonical.startswith('np.random.')):
+                flag(node, f'host RNG `{canonical}` (invisible to the '
+                           'trace — thread jax.random keys)')
+            elif canonical and canonical.startswith('random.'):
+                flag(node, f'host RNG `{canonical}` (invisible to the '
+                           'trace — thread jax.random keys)')
+            elif canonical and canonical.startswith('time.'):
+                flag(node, f'`{canonical}` is frozen at trace time')

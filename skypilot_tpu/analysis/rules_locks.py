@@ -1,0 +1,143 @@
+"""lock-discipline: ``_GUARDED_BY``-annotated attributes stay under
+their lock.
+
+A lightweight race detector for the engine-thread-vs-HTTP-thread seam.
+A class declares which lock each shared attribute rides under:
+
+    class DecodeEngine:
+        _GUARDED_BY = {
+            '_queues': '_queue_lock',       # with self._queue_lock: only
+            '_slots': 'loop',               # loop-thread-confined
+        }
+        _CROSS_THREAD_METHODS = ('submit', 'stats')
+
+Two value forms:
+
+* A lock attribute name (``'_queue_lock'``): every read/write of
+  ``self.<attr>`` in the class body must sit lexically inside a
+  ``with self.<lock>:`` block. ``__init__`` is exempt (construction
+  precedes sharing), and a helper called only with the lock held
+  annotates its def line with ``# lint: holds=<lock>``.
+* The sentinel ``'loop'``: the attribute is confined to the owner
+  thread's loop; it may be touched anywhere EXCEPT methods named in
+  ``_CROSS_THREAD_METHODS`` (the entry points other threads call —
+  ``submit``/``stats``/the HTTP surface). A deliberate cross-thread
+  snapshot read suppresses inline with its justification.
+
+Both declarations must be literal (a dict/tuple of string constants)
+so the check needs no imports.
+"""
+import ast
+from typing import Dict, List, Set, Tuple
+
+from skypilot_tpu.analysis import engine
+
+LOOP_CONFINED = 'loop'
+
+
+def _literal_str_dict(node: ast.AST) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    if isinstance(node, ast.Dict):
+        for k, v in zip(node.keys, node.values):
+            if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                out[k.value] = v.value
+    return out
+
+
+def _literal_str_seq(node: ast.AST) -> Tuple[str, ...]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+class LockDisciplineRule(engine.Rule):
+    name = 'lock-discipline'
+    description = ('_GUARDED_BY attribute accessed outside its with-'
+                   'lock block (or loop-confined state touched from a '
+                   'cross-thread method).')
+
+    def check(self, module: engine.ModuleSource) -> List[engine.Finding]:
+        findings: List[engine.Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(module, node))
+        return findings
+
+    def _check_class(self, module: engine.ModuleSource,
+                     cls: ast.ClassDef) -> List[engine.Finding]:
+        guarded: Dict[str, str] = {}
+        cross_thread: Tuple[str, ...] = ()
+        for stmt in cls.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                target = stmt.targets[0]
+                if isinstance(target, ast.Name):
+                    if target.id == '_GUARDED_BY':
+                        guarded = _literal_str_dict(stmt.value)
+                    elif target.id == '_CROSS_THREAD_METHODS':
+                        cross_thread = _literal_str_seq(stmt.value)
+        if not guarded:
+            return []
+        findings: List[engine.Finding] = []
+        for stmt in cls.body:
+            if not isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if stmt.name == '__init__':
+                continue
+            held = set(module.holds_locks(stmt))
+            for child in ast.iter_child_nodes(stmt):
+                self._walk(module, cls.name, guarded,
+                           stmt.name in cross_thread, child, held,
+                           findings)
+        return findings
+
+    def _walk(self, module: engine.ModuleSource, cls_name: str,
+              guarded: Dict[str, str], is_cross_thread: bool,
+              node: ast.AST, held: Set[str],
+              findings: List[engine.Finding]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # A nested def/lambda runs when CALLED — usually after the
+            # enclosing with-block released the lock (deferred
+            # callbacks, executor thunks). The held set does not carry
+            # over; only an explicit holds= annotation vouches for it.
+            nested_held = set(module.holds_locks(node))
+            for child in ast.iter_child_nodes(node):
+                self._walk(module, cls_name, guarded, is_cross_thread,
+                           child, nested_held, findings)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            entered = set(held)
+            for item in node.items:
+                expr = item.context_expr
+                name = engine.dotted_name(expr)
+                if name and name.startswith('self.'):
+                    entered.add(name[len('self.'):])
+            for child in node.body:
+                self._walk(module, cls_name, guarded, is_cross_thread,
+                           child, entered, findings)
+            return
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == 'self'
+                and node.attr in guarded):
+            lock = guarded[node.attr]
+            if lock == LOOP_CONFINED:
+                if is_cross_thread:
+                    findings.append(engine.Finding(
+                        module.display_path, node.lineno, self.name,
+                        f'{cls_name}.{node.attr} is loop-thread-'
+                        'confined (_GUARDED_BY: loop) but is touched '
+                        'from a cross-thread method'))
+            elif lock not in held:
+                findings.append(engine.Finding(
+                    module.display_path, node.lineno, self.name,
+                    f'{cls_name}.{node.attr} accessed outside '
+                    f'`with self.{lock}:` (declared in _GUARDED_BY)'))
+        for child in ast.iter_child_nodes(node):
+            self._walk(module, cls_name, guarded, is_cross_thread,
+                       child, held, findings)

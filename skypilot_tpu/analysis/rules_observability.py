@@ -1,0 +1,195 @@
+"""Observability vocabulary rules, migrated from the regex lints that
+``tests/unit_tests/test_observability.py`` grew across PRs 2–13.
+
+* metric-name: every metric registration site
+  (``*.counter/gauge/histogram('name', ...)`` and
+  ``RateTracker('name', ...)``) names a metric matching
+  ``^skytpu_[a-z0-9_]+$`` — exposition-format drift is a scrape-time
+  break.
+* journal-kind: ``journal.event('<literal>')`` literals are registered
+  ``EventKind`` values and ``EventKind.X`` attribute references are
+  real members — the journal vocabulary stays closed.
+* label-cardinality: no unbounded label NAMES at registration
+  (``metrics.UNBOUNDED_LABEL_NAMES`` — the runtime registry rejects
+  them too; this is the static half) and no label VALUE expression
+  that derives from a request/trace id
+  (``metrics.UNBOUNDED_LABEL_VALUE_MARKERS`` — the shared vocabulary
+  constant, so the runtime guard and the lint cannot drift apart).
+
+Each rule records what it saw (``found_names`` / ``found_kinds``), so
+the tier-1 driver can assert the scan actually covered the
+instrumentation (a lint that silently matches nothing is worse than no
+lint).
+"""
+import ast
+import re
+from typing import Iterable, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis import engine
+
+_REGISTRATION_ATTRS = ('counter', 'gauge', 'histogram')
+METRIC_NAME_RE = re.compile(r'^skytpu_[a-z0-9_]+$')
+
+
+def _is_registration(call: ast.Call) -> bool:
+    """One definition of 'metric registration site' shared by the
+    metric-name and label-cardinality rules (two copies would drift)."""
+    if (isinstance(call.func, ast.Attribute)
+            and call.func.attr in _REGISTRATION_ATTRS):
+        return True
+    dotted = engine.dotted_name(call.func)
+    return bool(dotted) and dotted.split('.')[-1] == 'RateTracker'
+
+
+def _registration_name(call: ast.Call) -> Optional[str]:
+    """The metric-name literal of a registration call, else None."""
+    if not _is_registration(call):
+        return None
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+class MetricNameRule(engine.Rule):
+    name = 'metric-name'
+    description = ('Metric registration whose name violates '
+                   '^skytpu_[a-z0-9_]+$.')
+
+    def __init__(self):
+        self.found_names: Set[str] = set()
+
+    def check(self, module: engine.ModuleSource) -> List[engine.Finding]:
+        findings: List[engine.Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            metric = _registration_name(node)
+            if metric is None:
+                continue
+            self.found_names.add(metric)
+            if not METRIC_NAME_RE.match(metric):
+                findings.append(engine.Finding(
+                    module.display_path, node.lineno, self.name,
+                    f'metric name {metric!r} violates the '
+                    'skytpu_[a-z0-9_]+ convention'))
+        return findings
+
+
+class JournalKindRule(engine.Rule):
+    name = 'journal-kind'
+    description = ('journal.event() kind literal not in the registered '
+                   'EventKind vocabulary (or a bogus EventKind.X '
+                   'member).')
+
+    def __init__(self, kinds: Optional[Iterable[str]] = None,
+                 members: Optional[Iterable[str]] = None):
+        if kinds is None or members is None:
+            from skypilot_tpu.observability import journal
+            kinds = journal.KINDS if kinds is None else kinds
+            if members is None:
+                members = {k.name for k in journal.EventKind}
+        self.kinds = frozenset(kinds)
+        self.members = frozenset(members)
+        self.found_kinds: Set[str] = set()
+        self.found_members: Set[str] = set()
+
+    @staticmethod
+    def _is_journal_event(module: engine.ModuleSource,
+                          func: ast.AST) -> bool:
+        """``<journal-ish>.event(...)``: the module (``journal.event``,
+        any import alias resolving to the journal module) or an
+        attribute holding one (``self._journal.event``) — the old
+        unanchored regex matched all of these; the AST rule must not
+        narrow coverage."""
+        if not (isinstance(func, ast.Attribute) and func.attr == 'event'):
+            return False
+        base = engine.dotted_name(func.value)
+        if not base:
+            return False
+        if base.split('.')[-1].endswith('journal'):
+            return True
+        canonical = module.imports.resolve(base) or ''
+        return canonical == 'journal' or canonical.endswith('.journal')
+
+    def check(self, module: engine.ModuleSource) -> List[engine.Finding]:
+        findings: List[engine.Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                if (self._is_journal_event(module, node.func)
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    kind = node.args[0].value
+                    self.found_kinds.add(kind)
+                    if kind not in self.kinds:
+                        findings.append(engine.Finding(
+                            module.display_path, node.lineno, self.name,
+                            f'journal kind {kind!r} is not a registered '
+                            'EventKind value'))
+            elif isinstance(node, ast.Attribute):
+                base = engine.dotted_name(node.value)
+                if base and base.split('.')[-1] == 'EventKind':
+                    self.found_members.add(node.attr)
+                    if node.attr not in self.members:
+                        findings.append(engine.Finding(
+                            module.display_path, node.lineno, self.name,
+                            f'EventKind.{node.attr} is not a real '
+                            'member'))
+        return findings
+
+
+class LabelCardinalityRule(engine.Rule):
+    name = 'label-cardinality'
+    description = ('Unbounded metric label: denylisted label NAME at a '
+                   'registration site, or a label VALUE expression '
+                   'derived from a request/trace id.')
+
+    def __init__(self, unbounded_names: Optional[Iterable[str]] = None,
+                 value_markers: Optional[Iterable[str]] = None):
+        # ONE vocabulary, shared with the runtime registration guard
+        # (metrics.Metric.__init__) — the satellite fix for the
+        # duplicated denylists.
+        if unbounded_names is None or value_markers is None:
+            from skypilot_tpu.observability import metrics
+            if unbounded_names is None:
+                unbounded_names = metrics.UNBOUNDED_LABEL_NAMES
+            if value_markers is None:
+                value_markers = metrics.UNBOUNDED_LABEL_VALUE_MARKERS
+        self.unbounded_names = frozenset(unbounded_names)
+        self.value_markers = tuple(value_markers)
+
+    def check(self, module: engine.ModuleSource) -> List[engine.Finding]:
+        findings: List[engine.Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            labels_kw = next((kw for kw in node.keywords
+                              if kw.arg == 'labels'), None)
+            if labels_kw is None:
+                continue
+            if _is_registration(node):
+                for name in self._tuple_literals(labels_kw.value):
+                    if name in self.unbounded_names:
+                        findings.append(engine.Finding(
+                            module.display_path, node.lineno, self.name,
+                            f'label name {name!r} is unbounded by '
+                            'construction (one series per request) — '
+                            'key request-scoped telemetry by trace id '
+                            'in the journal instead'))
+            expr = ast.unparse(labels_kw.value)
+            for marker in self.value_markers:
+                if marker in expr:
+                    findings.append(engine.Finding(
+                        module.display_path, node.lineno, self.name,
+                        f'label value expression contains {marker!r} '
+                        f'(per-request series): {expr[:80]}'))
+        return findings
+
+    @staticmethod
+    def _tuple_literals(node: ast.AST) -> Tuple[str, ...]:
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return tuple(e.value for e in node.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str))
+        return ()

@@ -1,0 +1,102 @@
+"""Robustness rules migrated from the PR-10 regex lints.
+
+* timeout-required: every blocking HTTP call names an explicit
+  ``timeout=`` — a defaulted (infinite) timeout in a probe/drain/proxy
+  path is how a dead peer wedges a control loop. A deliberately
+  unbounded stream passes ``timeout=None`` *explicitly* (greppable
+  intent, still legal). Scope mirrors the regex lint: ``requests.*``
+  verb calls (through any import alias, so files with a local dict
+  named ``requests`` are naturally excluded), ``urllib.request.
+  urlopen``, and ``aiohttp.ClientSession(...)`` at the session level
+  (per-request overrides stay allowed).
+* exception-swallow: in ``serve/`` and ``skylet/`` (the supervision
+  loops), no bare ``except:`` and no SILENT broad swallow
+  (``except Exception: pass``). Typed-narrow swallows
+  (``except ValueError: pass`` around an env parse) stay legal, as
+  does a broad swallow whose ``pass`` line carries an explanatory
+  comment — the rule forces the *justification*, not a blanket style.
+"""
+import ast
+from typing import List, Sequence
+
+from skypilot_tpu.analysis import engine
+
+_HTTP_VERBS = ('get', 'post', 'put', 'head', 'delete', 'request')
+_BROAD_TYPES = ('Exception', 'BaseException')
+
+
+class TimeoutRequiredRule(engine.Rule):
+    name = 'timeout-required'
+    description = ('Blocking HTTP call (requests/urlopen/aiohttp '
+                   'session) without an explicit timeout=.')
+
+    def __init__(self, verbs: Sequence[str] = _HTTP_VERBS):
+        self.verbs = tuple(verbs)
+        self.found_calls = 0
+
+    def check(self, module: engine.ModuleSource) -> List[engine.Finding]:
+        findings: List[engine.Finding] = []
+        requests_aliases = module.imports.aliases_of('requests')
+        aiohttp_aliases = module.imports.aliases_of('aiohttp')
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = engine.dotted_name(node.func)
+            canonical = module.imports.resolve(dotted)
+            if not canonical or not dotted:
+                continue
+            head = dotted.partition('.')[0]
+            _, _, tail = canonical.partition('.')
+            is_http = ((head in requests_aliases and tail in self.verbs)
+                       or canonical == 'urllib.request.urlopen'
+                       or (head in aiohttp_aliases
+                           and canonical == 'aiohttp.ClientSession'))
+            if not is_http:
+                continue
+            self.found_calls += 1
+            if not any(kw.arg == 'timeout' for kw in node.keywords):
+                findings.append(engine.Finding(
+                    module.display_path, node.lineno, self.name,
+                    f'{canonical}(...) without an explicit timeout= '
+                    '(pass timeout=None if the wait is deliberately '
+                    'unbounded)'))
+        return findings
+
+
+class ExceptionSwallowRule(engine.Rule):
+    name = 'exception-swallow'
+    description = ('Bare except or silent broad except-pass in a '
+                   'supervision-loop package (serve/, skylet/).')
+
+    def __init__(self, dirs: Sequence[str] = ('serve', 'skylet')):
+        self.dirs = tuple(dirs)
+        self.files_scanned = 0
+
+    def check(self, module: engine.ModuleSource) -> List[engine.Finding]:
+        if not any(part in self.dirs for part in module.parts[:-1]):
+            return []
+        self.files_scanned += 1
+        findings: List[engine.Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                findings.append(engine.Finding(
+                    module.display_path, node.lineno, self.name,
+                    'bare `except:` swallows KeyboardInterrupt/'
+                    'SystemExit too — name the exception type'))
+                continue
+            type_name = engine.dotted_name(node.type)
+            if type_name not in _BROAD_TYPES:
+                continue
+            if len(node.body) == 1 and isinstance(node.body[0], ast.Pass):
+                pass_line = node.body[0].lineno
+                src_line = (module.lines[pass_line - 1]
+                            if pass_line <= len(module.lines) else '')
+                if '#' not in src_line:
+                    findings.append(engine.Finding(
+                        module.display_path, node.lineno, self.name,
+                        f'silent `except {type_name}: pass` — narrow '
+                        'the type, or justify the swallow with a '
+                        'comment on the pass line'))
+        return findings

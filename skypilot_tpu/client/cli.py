@@ -1063,6 +1063,58 @@ def api_stop():
                '(if it was running).')
 
 
+@cli.command(name='lint')
+@click.argument('paths', nargs=-1, type=click.Path(exists=True))
+@click.option('--rule', 'rules', multiple=True,
+              help='Run only this rule (repeatable). Default: all.')
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='Machine-readable findings (stable shape).')
+@click.option('--list-rules', is_flag=True, default=False,
+              help='Print the rule catalog and exit.')
+def lint(paths, rules, as_json, list_rules):
+    """AST-based static analysis over the tree (docs/analysis.md).
+
+    Scans PATHS (default: the skypilot_tpu package + bench.py) with
+    the analysis-plane rules: async-blocking, lock-discipline,
+    jax-tracer-hygiene, env-registry, and the migrated observability/
+    robustness lints. Exit code contract (grep-style): 0 = clean,
+    1 = findings, 2 = no verdict (bad invocation or internal error).
+    Suppress a finding inline with `# lint: disable=<rule>` plus a
+    justification comment.
+    """
+    import json as json_lib
+    import sys
+    import traceback
+
+    from skypilot_tpu import analysis
+    if list_rules:
+        for name, factory in analysis.RULES.items():
+            click.echo(f'{name}: {factory().description}')
+        return
+    try:
+        result = analysis.run_lint(paths=list(paths) or None,
+                                   rule_names=list(rules) or None)
+    except ValueError as e:
+        # Unknown --rule name. click exits 2 for usage errors, which
+        # matches the contract: 2 = lint produced no verdict.
+        raise click.BadParameter(str(e))
+    except Exception:  # pylint: disable=broad-except
+        # Exit-code contract: a crash (no verdict) must be
+        # distinguishable from "findings exist" for CI.
+        traceback.print_exc(file=sys.stderr)
+        click.echo('lint: internal error (exit 2)', err=True)
+        sys.exit(2)
+    if as_json:
+        click.echo(json_lib.dumps(result.as_dict(), indent=2))
+    else:
+        for finding in result.findings:
+            click.echo(finding.render())
+        click.echo(f'{len(result.findings)} finding(s) across '
+                   f'{result.files_scanned} file(s), '
+                   f'{len(result.rules)} rule(s).')
+    sys.exit(0 if result.clean else 1)
+
+
 def main() -> None:
     try:
         cli()  # pylint: disable=no-value-for-parameter

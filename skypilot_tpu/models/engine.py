@@ -639,6 +639,35 @@ class DecodeEngine:
     on ONE engine loop thread (they own the donated cache).
     """
 
+    # Lock discipline (enforced by `skytpu lint`'s lock-discipline
+    # rule, docs/analysis.md): the admission queues are the one
+    # mutex-shared seam; everything else host-side-mutable is confined
+    # to the engine loop thread and must not be touched from the
+    # cross-thread entry points below (snapshot reads that accept a
+    # benign race suppress inline with their justification).
+    _GUARDED_BY = {
+        '_queues': '_queue_lock',
+        '_rr_offset': '_queue_lock',
+        '_slots': 'loop',
+        '_token': 'loop',
+        '_pos': 'loop',
+        '_done': 'loop',
+        '_remaining': 'loop',
+        '_allocator': 'loop',
+        '_radix': 'loop',
+        '_block_table_np': 'loop',
+        '_block_table_dev': 'loop',
+        '_slot_refs': 'loop',
+        '_slot_nodes': 'loop',
+        '_prefill_state': 'loop',
+    }
+    # Entry points other threads call (HTTP handlers, the supervisor's
+    # observers). submit/queue_depth take _queue_lock; stats/
+    # active_slots/free_slots are the snapshot surface.
+    _CROSS_THREAD_METHODS = ('submit', 'queue_depth', 'stats',
+                             'spec_stats', 'flush_journal',
+                             'active_slots', 'free_slots')
+
     def __init__(self, params, cfg: llama.LlamaConfig,
                  dcfg: decode.DecodeConfig, num_slots: int,
                  step_chunk: int = 1,
@@ -998,7 +1027,10 @@ class DecodeEngine:
         return depth
 
     def free_slots(self) -> int:
-        return sum(1 for r in self._slots if r is None)
+        # Cross-thread snapshot over the slot mirror: list length is
+        # fixed, entries are GIL-atomic refs — worst case a one-tick-
+        # stale count in /healthz-adjacent surfaces.
+        return sum(1 for r in self._slots if r is None)  # lint: disable=lock-discipline
 
     def active_slots(self) -> int:
         return self.num_slots - self.free_slots()
@@ -1942,8 +1974,10 @@ class DecodeEngine:
             out.update({
                 'block_k': self._block_k,
                 'blocks_total': self.num_blocks - 1,
-                'blocks_used': self._allocator.used(),
-                'prefix_cache_blocks': self._radix.held_blocks(),
+                # Snapshot reads of loop-owned counters: worst case one
+                # stale integer in a /slo body, never a torn structure.
+                'blocks_used': self._allocator.used(),  # lint: disable=lock-discipline
+                'prefix_cache_blocks': self._radix.held_blocks(),  # lint: disable=lock-discipline
                 'prefix_hit_ratio': round(self.prefix_hit_ratio(), 4),
                 'prefill_tokens_saved': self._prompt_tokens_saved,
                 'prefill_chunk': self.prefill_chunk,

@@ -332,6 +332,9 @@ class JournalBuffer:
     row is event_batch's fifth element (a trace-id string or a
     ``(trace, span, parent)`` tuple)."""
 
+    # Lock discipline (skytpu lint): appenders race the flusher.
+    _GUARDED_BY = {'_buf': '_lock'}
+
     def __init__(self):
         self._lock = threading.Lock()
         self._buf: List[tuple] = []

@@ -41,6 +41,14 @@ UNBOUNDED_LABEL_NAMES = frozenset({
     'request_id', 'request', 'trace_id', 'span_id',
 })
 
+# The VALUE half of the same guard: expression fragments that mark a
+# label value as derived from a per-request identifier. ONE vocabulary
+# shared by the runtime guard above and the static label-cardinality
+# rule (skypilot_tpu/analysis/rules_observability.py) — previously the
+# lint test carried its own copy, which is how denylists drift.
+UNBOUNDED_LABEL_VALUE_MARKERS = ('trace_id', 'request_id', 'req.id',
+                                 'request.id', 'span_id')
+
 # Default histogram buckets: wide enough to cover sub-ms decode token
 # latencies AND multi-minute provisioning spans in one scheme.
 DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,

@@ -134,6 +134,17 @@ class _Entry:
 class RequestTelemetry:
     """Per-request phase tracing for one engine; see the module doc."""
 
+    # Lock discipline (skytpu lint): the telemetry ring is written by
+    # the engine loop and read by HTTP handler threads.
+    _GUARDED_BY = {
+        '_in_flight': '_lock',
+        '_completed': '_lock',
+        '_finished': '_lock',
+        '_rejected': '_lock',
+        '_errors': '_lock',
+        '_slow': '_lock',
+    }
+
     def __init__(self, name: str = 'engine',
                  capacity: Optional[int] = None):
         self.name = name
@@ -435,6 +446,17 @@ class RequestTelemetry:
 class EngineStepProfiler:
     """Per-``step()`` ring buffer + stall detector for one engine."""
 
+    # Lock discipline (skytpu lint): ring + stall window are written by
+    # the engine loop and snapshotted by /debug/engine handler threads.
+    # _last_beat stays deliberately lock-free (a monotonic float stamp
+    # read by /healthz; torn reads are impossible under the GIL).
+    _GUARDED_BY = {
+        '_ring': '_lock',
+        '_recent': '_lock',
+        '_steps': '_lock',
+        '_stalls': '_lock',
+    }
+
     def __init__(self, name: str = 'engine',
                  capacity: Optional[int] = None,
                  stall_factor: Optional[float] = None,
@@ -524,10 +546,12 @@ class EngineStepProfiler:
     # -------------------------------------------------------------- reads
 
     def steps_recorded(self) -> int:
-        return self._steps
+        # GIL-atomic int snapshot; a one-step-stale count is fine.
+        return self._steps  # lint: disable=lock-discipline
 
     def stall_count(self) -> int:
-        return self._stalls
+        # GIL-atomic int snapshot; a one-step-stale count is fine.
+        return self._stalls  # lint: disable=lock-discipline
 
     def heartbeat_ts(self) -> float:
         """Unix timestamp of the last beat/record (0.0 = never)."""

@@ -170,6 +170,15 @@ class FleetSlo:
     for the LB's fleet ``/slo`` endpoint. Thread-safe: the LB's asyncio
     loop writes, HTTP/in-proc test threads read."""
 
+    # Lock discipline (skytpu lint): rollup cache, straggler set and
+    # published-series set are written by the poll loop and read by
+    # the /slo handler thread.
+    _GUARDED_BY = {
+        '_rollup': '_lock',
+        '_stragglers': '_lock',
+        '_published': '_lock',
+    }
+
     def __init__(self, entity: str = 'lb',
                  straggler_cb: Optional[Callable[[str], None]] = None):
         self.entity = entity

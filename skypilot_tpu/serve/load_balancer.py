@@ -133,6 +133,13 @@ class ReplicaCircuitBreaker:
     in-proc test threads safe.
     """
 
+    # Lock discipline (skytpu lint, docs/analysis.md): every access to
+    # the failure/ejection maps rides the breaker lock.
+    _GUARDED_BY = {
+        '_failures': '_lock',
+        '_ejected': '_lock',
+    }
+
     def __init__(self, threshold: Optional[int] = None,
                  backoff_seconds: Optional[float] = None):
         self.threshold = (threshold if threshold is not None
@@ -220,6 +227,12 @@ class LoadBalancer:
     Ready replicas come from ``get_ready_urls`` (in-proc mode) or from
     controller syncs (``controller_url`` mode — the production path).
     """
+
+    # Lock discipline (skytpu lint): the autoscaler-QPS timestamp deque
+    # is appended by the aiohttp loop and snapshotted by other threads.
+    _GUARDED_BY = {
+        '_request_timestamps': '_ts_lock',
+    }
 
     def __init__(self, port: int, policy_name: str,
                  get_ready_urls: Optional[Callable[[], List[str]]] = None,

@@ -120,24 +120,28 @@ async def handle_api_stream(request: web.Request) -> web.StreamResponse:
     log_file = requests_db.log_path(request_id)
     pos = 0
     loop = asyncio.get_event_loop()
+
+    def read_tail(start: int) -> bytes:
+        # Runs in the executor: a large log chunk (or a slow network
+        # filesystem) must not stall every other in-flight stream.
+        if not os.path.exists(log_file):
+            return b''
+        with open(log_file, 'rb') as f:
+            f.seek(start)
+            return f.read()
+
     while True:
-        if os.path.exists(log_file):
-            with open(log_file, 'rb') as f:
-                f.seek(pos)
-                chunk = f.read()
-            if chunk:
-                pos += len(chunk)
-                await resp.write(chunk)
+        chunk = await loop.run_in_executor(None, read_tail, pos)
+        if chunk:
+            pos += len(chunk)
+            await resp.write(chunk)
         rec = await loop.run_in_executor(None, requests_db.get_request,
                                          request_id)
         if rec is None or rec['status'].is_terminal():
             # Drain any tail written between read and status check.
-            if os.path.exists(log_file):
-                with open(log_file, 'rb') as f:
-                    f.seek(pos)
-                    chunk = f.read()
-                if chunk:
-                    await resp.write(chunk)
+            chunk = await loop.run_in_executor(None, read_tail, pos)
+            if chunk:
+                await resp.write(chunk)
             break
         await asyncio.sleep(0.2)
     await resp.write_eof()

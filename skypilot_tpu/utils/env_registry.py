@@ -1,0 +1,580 @@
+"""The ``SKYTPU_*`` environment-knob registry: one row per knob.
+
+Every exact ``SKYTPU_<NAME>`` string literal in ``skypilot_tpu/`` +
+``bench.py`` must have an entry here — enforced by the ``env-registry``
+rule of ``skytpu lint`` in BOTH directions (an unregistered read is a
+finding; a registered name read nowhere is a finding). The docs' knob
+tables in ``docs/serving.md`` and ``docs/observability.md`` are
+GENERATED from this module (``render_doc_table``), so a knob cannot
+ship undocumented and a removed knob cannot linger in the docs.
+
+``default=None`` means "unset" — the consumer derives a value or the
+feature is off; the doc line says which. ``consumer`` is the
+repo-relative module that owns the read (the env-registry rule's
+unread check keys off it); other modules may read the same name.
+
+Dynamically-built names (the shared neocloud fake's
+``f'SKYTPU_{{CLOUD}}_FAKE[_STATE|_STOCKOUT]'`` families in
+``provision/neocloud_fake.py``) cannot be statically checked; the
+statically-read members of those families are registered individually
+below and the pattern is documented in the ``provision`` group notes.
+"""
+import dataclasses
+from typing import Dict, Iterable, List, Optional
+
+GROUPS = ('serving', 'observability', 'bench', 'control_plane',
+          'provision')
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    name: str
+    default: Optional[str]
+    doc: str
+    consumer: str
+    group: str
+
+
+REGISTRY: Dict[str, EnvVar] = {}
+
+
+def _e(name: str, default: Optional[str], doc: str, consumer: str,
+       group: str) -> None:
+    assert group in GROUPS, group
+    assert name not in REGISTRY, name
+    REGISTRY[name] = EnvVar(name, default, doc, consumer, group)
+
+
+# --------------------------------------------------------------- serving
+
+_e('SKYTPU_SERVE_TP', '1',
+   'Tensor-parallel degree for the serving engine (shards weights + '
+   'paged KV pool over the model mesh axis).',
+   'skypilot_tpu/serve/model_server.py', 'serving')
+_e('SKYTPU_SPEC_K', '0',
+   'Speculative tokens drafted per engine step (0 disables).',
+   'skypilot_tpu/serve/model_server.py', 'serving')
+_e('SKYTPU_SPEC_DRAFTER_LAYERS', '1',
+   'Truncated-layer drafter depth for speculative decoding.',
+   'skypilot_tpu/serve/model_server.py', 'serving')
+_e('SKYTPU_PREFILL_CHUNK', '0',
+   'Chunked-prefill bound per prefilling slot per step, in tokens '
+   '(0 = monolithic prefill).',
+   'skypilot_tpu/models/engine.py', 'serving')
+_e('SKYTPU_ENGINE_IDLE_SLEEP_SECONDS', '0.02',
+   'Engine loop sleep when no slot is active and the queue is empty.',
+   'skypilot_tpu/models/engine.py', 'serving')
+_e('SKYTPU_ENGINE_MAX_RESTARTS', '3',
+   'Supervisor restart budget: crashes allowed within the rolling '
+   'window before the engine goes permanently failed (503).',
+   'skypilot_tpu/models/engine.py', 'serving')
+_e('SKYTPU_ENGINE_RESTART_WINDOW_SECONDS', '300',
+   'Rolling window for the engine supervisor restart budget.',
+   'skypilot_tpu/models/engine.py', 'serving')
+_e('SKYTPU_MODEL_SERVER_REQUEST_TIMEOUT', '300',
+   'Cap on one /generate request\'s SSE lifetime on the model server.',
+   'skypilot_tpu/serve/model_server.py', 'serving')
+_e('SKYTPU_SERVE_MAX_QUEUE', '256',
+   'Admission-queue depth that flips /generate to 429 + Retry-After '
+   '(0 disables backpressure).',
+   'skypilot_tpu/serve/model_server.py', 'serving')
+_e('SKYTPU_REPLICA_PORT', None,
+   'Replica-injected: port the model server binds (set by the replica '
+   'manager).',
+   'skypilot_tpu/serve/model_server.py', 'serving')
+_e('SKYTPU_REPLICA_ID', None,
+   'Replica-injected: stable id of this replica within its service.',
+   'skypilot_tpu/serve/replica_managers.py', 'serving')
+_e('SKYTPU_DRAIN_TIMEOUT_SECONDS', '30',
+   'Graceful-drain grace: how long in-flight requests get to finish '
+   'after SIGTERM / POST /drain before the server exits.',
+   'skypilot_tpu/serve/model_server.py', 'serving')
+_e('SKYTPU_SERVER_STOP_TIMEOUT_SECONDS', '10',
+   'Bound on joining the engine thread at server stop; exceeding it '
+   'journals a wedged-engine crash event.',
+   'skypilot_tpu/serve/model_server.py', 'serving')
+_e('SKYTPU_SERVE_CONTROLLER_INTERVAL', '10',
+   'Serve controller tick interval in seconds.',
+   'skypilot_tpu/serve/controller.py', 'serving')
+_e('SKYTPU_SERVE_METRICS_PORT', None,
+   'Serve controller /metrics exporter port (unset = disabled).',
+   'skypilot_tpu/serve/controller.py', 'serving')
+_e('SKYTPU_SERVE_LB_SYNC_INTERVAL', '2',
+   'LB ready-set sync interval against the controller, seconds.',
+   'skypilot_tpu/serve/load_balancer.py', 'serving')
+_e('SKYTPU_SERVE_LB_ORPHAN_TIMEOUT', '120',
+   'Standalone LB exits after this long without a successful '
+   'controller sync (orphan protection).',
+   'skypilot_tpu/serve/load_balancer.py', 'serving')
+_e('SKYTPU_LB_METRICS_PORT', None,
+   'LB /metrics exporter port (unset = disabled, 0 = ephemeral).',
+   'skypilot_tpu/serve/load_balancer.py', 'serving')
+_e('SKYTPU_LB_EJECT_THRESHOLD', '3',
+   'Consecutive replica failures (connect error / pre-byte 5xx / '
+   'failed probe) that eject a replica from LB candidates.',
+   'skypilot_tpu/serve/load_balancer.py', 'serving')
+_e('SKYTPU_LB_EJECT_BACKOFF_SECONDS', '10',
+   'Initial ejection backoff; doubles per failed reinstatement probe '
+   '(capped at 120 s).',
+   'skypilot_tpu/serve/load_balancer.py', 'serving')
+_e('SKYTPU_LB_EJECT_PROBE_INTERVAL', '1',
+   'How often the LB probes ejected replicas\' /healthz for '
+   'reinstatement.',
+   'skypilot_tpu/serve/load_balancer.py', 'serving')
+_e('SKYTPU_FLEET_SLO_INTERVAL', '5',
+   'LB fleet-SLO poll cadence: each tick pulls every ready replica\'s '
+   '/slo into the fleet rollup.',
+   'skypilot_tpu/serve/load_balancer.py', 'serving')
+_e('SKYTPU_SERVE_QPS_WINDOW', '60',
+   'Autoscaler QPS measurement window in seconds.',
+   'skypilot_tpu/serve/autoscalers.py', 'serving')
+_e('SKYTPU_SERVE_UPSCALE_DELAY', '300',
+   'Autoscaler upscale stabilization delay (spec-level delays win).',
+   'skypilot_tpu/serve/autoscalers.py', 'serving')
+_e('SKYTPU_SERVE_DOWNSCALE_DELAY', '1200',
+   'Autoscaler downscale stabilization delay (spec-level delays win).',
+   'skypilot_tpu/serve/autoscalers.py', 'serving')
+_e('SKYTPU_SERVE_UTIL_BLEND', '0',
+   'Opt-in: floor the QPS replica target by measured replica '
+   'utilization (ceil(ready*util/target_util)).',
+   'skypilot_tpu/serve/autoscalers.py', 'serving')
+_e('SKYTPU_SERVE_TARGET_UTIL', '0.8',
+   'Target per-replica utilization for the util-blend autoscaler '
+   'floor.',
+   'skypilot_tpu/serve/autoscalers.py', 'serving')
+_e('SKYTPU_SERVE_MAX_FAILURES', '3',
+   'Replica-launch failure budget before the service stops retrying.',
+   'skypilot_tpu/serve/replica_managers.py', 'serving')
+_e('SKYTPU_SERVE_DOWN_TIMEOUT', '300',
+   'Bound on waiting for service teardown in `sky serve down`.',
+   'skypilot_tpu/serve/core.py', 'serving')
+_e('SKYTPU_CHAOS', None,
+   'Fault-injection spec (engine_step_raise:N,slow_step:p,drain_hang,'
+   'replica_500:p); unset = off.',
+   'skypilot_tpu/utils/chaos.py', 'serving')
+_e('SKYTPU_CHAOS_SLOW_STEP_SECONDS', '0.2',
+   'Injected engine-step delay for the slow_step chaos point.',
+   'skypilot_tpu/utils/chaos.py', 'serving')
+_e('SKYTPU_DISABLE_JAX_DISTRIBUTED', '0',
+   'Opt out of the idempotent jax.distributed.initialize bootstrap on '
+   'gang-scheduled multi-host replicas.',
+   'skypilot_tpu/parallel/distributed.py', 'serving')
+
+# ---------------------------------------------------------- observability
+
+_e('SKYTPU_DEBUG', '0',
+   'Debug logging + lazy Chrome-trace timeline capture.',
+   'skypilot_tpu/sky_logging.py', 'observability')
+_e('SKYTPU_JOURNAL_DISABLED', '0',
+   'Disable the sqlite flight-recorder journal entirely.',
+   'skypilot_tpu/observability/journal.py', 'observability')
+_e('SKYTPU_JOURNAL_MAX_EVENTS', '20000',
+   'Journal retention: rowid-window pruning bound.',
+   'skypilot_tpu/observability/journal.py', 'observability')
+_e('SKYTPU_JOURNAL_ONLY_KINDS', None,
+   'Comma-separated EventKind filter: when set, only those kinds are '
+   'written (bench lanes keep slow_request joinable without '
+   'admit/evict fsyncs).',
+   'skypilot_tpu/observability/journal.py', 'observability')
+_e('SKYTPU_TRACE_ID', None,
+   'Cross-process trace propagation (set for spawned work; read at '
+   'attach).',
+   'skypilot_tpu/observability/trace.py', 'observability')
+_e('SKYTPU_SPAN_ID', None,
+   'Cross-process parent-span propagation, beside SKYTPU_TRACE_ID.',
+   'skypilot_tpu/observability/trace.py', 'observability')
+_e('SKYTPU_METRICS_HOST', '127.0.0.1',
+   'Bind host for /metrics + /healthz exporters.',
+   'skypilot_tpu/observability/exporter.py', 'observability')
+_e('SKYTPU_HEALTHZ_MAX_STALENESS_SECONDS', None,
+   'Exporter /healthz flips 503 once the liveness signal ages past '
+   'this (unset = no staleness check).',
+   'skypilot_tpu/observability/exporter.py', 'observability')
+_e('SKYTPU_PROFILE_DIR', None,
+   'Enables the jax.profiler step capture, writing traces here.',
+   'skypilot_tpu/observability/runtime_metrics.py', 'observability')
+_e('SKYTPU_PROFILE_STEPS', '3',
+   'Steps per jax.profiler capture window.',
+   'skypilot_tpu/observability/runtime_metrics.py', 'observability')
+_e('SKYTPU_PEAK_FLOPS', None,
+   'Override the per-chip peak bf16 FLOPs used for MFU (unset = '
+   'accelerator-registry lookup).',
+   'skypilot_tpu/observability/runtime_metrics.py', 'observability')
+_e('SKYTPU_REQUEST_TRACE_CAPACITY', '512',
+   'Per-request telemetry ring capacity.',
+   'skypilot_tpu/observability/request_trace.py', 'observability')
+_e('SKYTPU_ENGINE_STEP_RING', '512',
+   'Engine step-profiler ring capacity.',
+   'skypilot_tpu/observability/request_trace.py', 'observability')
+_e('SKYTPU_ENGINE_STALL_FACTOR', '10',
+   'A step slower than this multiple of the rolling median (and past '
+   'the floor) journals engine.stall.',
+   'skypilot_tpu/observability/request_trace.py', 'observability')
+_e('SKYTPU_ENGINE_STALL_MIN_SECONDS', '0.05',
+   'Absolute floor for stall detection (keeps dev runs quiet on '
+   'scheduler jitter).',
+   'skypilot_tpu/observability/request_trace.py', 'observability')
+_e('SKYTPU_SLOW_REQUEST_SECONDS', '30',
+   'A request slower than this journals its full phase timeline under '
+   'its own trace id (0 disables).',
+   'skypilot_tpu/observability/request_trace.py', 'observability')
+_e('SKYTPU_TTFT_SLO_SECONDS', '0',
+   'TTFT SLO: a breach journals even when the total stayed fast '
+   '(0 disables).',
+   'skypilot_tpu/observability/request_trace.py', 'observability')
+_e('SKYTPU_FLEET_STRAGGLER_FACTOR', '2.0',
+   'Straggler threshold: replica TTFT p95 vs the fleet median_low '
+   'p95.',
+   'skypilot_tpu/observability/slo.py', 'observability')
+_e('SKYTPU_FLEET_STRAGGLER_MIN_SECONDS', '0.05',
+   'Absolute deviation floor for fleet straggler detection.',
+   'skypilot_tpu/observability/slo.py', 'observability')
+_e('SKYTPU_FLEET_STRAGGLER_MIN_COMPLETED', '4',
+   'Minimum completed requests in a replica\'s window before it can '
+   'be judged a straggler.',
+   'skypilot_tpu/observability/slo.py', 'observability')
+_e('SKYTPU_NODE_STALE_SECONDS', '120',
+   'Fleet aggregator: node snapshot older than this is flagged stale.',
+   'skypilot_tpu/observability/fleet.py', 'observability')
+_e('SKYTPU_STRAGGLER_THRESHOLD', '0.25',
+   'Fleet aggregator: |node − slice mean| utilization deviation that '
+   'flags a straggler node.',
+   'skypilot_tpu/observability/fleet.py', 'observability')
+_e('SKYTPU_TIMESERIES_MAX_ROWS', '4096',
+   'Per-resolution row cap of the host timeseries ring (raw/1m/10m).',
+   'skypilot_tpu/observability/timeseries.py', 'observability')
+_e('SKYTPU_PROC_ROOT', '/proc',
+   'Test override for the /proc root the host sampler parses.',
+   'skypilot_tpu/observability/timeseries.py', 'observability')
+_e('SKYTPU_SAMPLER_ACCEL', 'auto',
+   'Accelerator-memory sampling gate: auto only probes when '
+   'JAX_PLATFORMS names a chip (libtpu is single-client).',
+   'skypilot_tpu/observability/timeseries.py', 'observability')
+_e('SKYTPU_SAMPLER_INTERVAL_SECONDS', None,
+   'Test override of the skylet metrics-sampler tick (unset = event '
+   'default).',
+   'skypilot_tpu/skylet/events.py', 'observability')
+
+# ------------------------------------------------------------------ bench
+
+_e('SKYTPU_AXON_RELAY', '127.0.0.1:8083',
+   'host:port of the heartbeat relay the bench harness beats through.',
+   'skypilot_tpu/benchmark/harness.py', 'bench')
+_e('SKYTPU_BENCH_HEARTBEAT_FILE', None,
+   'File the bench harness appends heartbeat JSON lines to.',
+   'skypilot_tpu/benchmark/harness.py', 'bench')
+_e('SKYTPU_BENCH_INIT_TIMEOUT', None,
+   'Bound on device enumeration at bench start (unset = harness '
+   'default).',
+   'skypilot_tpu/benchmark/harness.py', 'bench')
+_e('SKYTPU_BENCH_LOG_DIR', None,
+   'Directory the bench callbacks write summary.json into.',
+   'skypilot_tpu/callbacks/base.py', 'bench')
+_e('SKYTPU_BENCH_MODEL', 'bench-1b', 'Train-bench model config.',
+   'bench.py', 'bench')
+_e('SKYTPU_BENCH_BATCH', '12', 'Train-bench global batch size.',
+   'bench.py', 'bench')
+_e('SKYTPU_BENCH_SEQ', '2048', 'Train-bench sequence length.',
+   'bench.py', 'bench')
+_e('SKYTPU_BENCH_STEPS', '10', 'Train-bench measured steps.',
+   'bench.py', 'bench')
+_e('SKYTPU_BENCH_REMAT', 'full', 'Train-bench remat policy.',
+   'bench.py', 'bench')
+_e('SKYTPU_BENCH_MOMENT_DTYPE', 'float32',
+   'Optimizer moment dtype for the train bench.', 'bench.py', 'bench')
+_e('SKYTPU_BENCH_DECODE', '1',
+   'Run the decode phases of the bench payload (0 skips).',
+   'bench.py', 'bench')
+_e('SKYTPU_BENCH_DECODE_ATTN', 'kernel',
+   'Decode-bench attention path: kernel (Pallas) or xla.',
+   'bench.py', 'bench')
+_e('SKYTPU_BENCH_DECODE_BATCH', '32', 'Decode-bench batch size.',
+   'bench.py', 'bench')
+_e('SKYTPU_BENCH_PREFIX_SLOTS', '8',
+   'Slots for the shared-prefix paged-vs-dense bench.',
+   'bench.py', 'bench')
+_e('SKYTPU_BENCH_TP', '1',
+   'Tensor-parallel degree for the sched/spec bench workloads '
+   '(clamped to devices/head divisibility).',
+   'bench.py', 'bench')
+_e('SKYTPU_BENCH_ATTEMPTS', '3',
+   'Supervisor attempts before the CPU fallback tier.',
+   'bench.py', 'bench')
+_e('SKYTPU_BENCH_TOTAL_TIMEOUT', '1080',
+   'Whole-payload budget; a partial (train-only) result still lands.',
+   'bench.py', 'bench')
+_e('SKYTPU_BENCH_DEADLINE_SCALE', '1',
+   'Multiplier on per-phase heartbeat deadlines.',
+   'bench.py', 'bench')
+_e('SKYTPU_BENCH_PREFLIGHT_TIMEOUT', '90',
+   'Bound on the TPU preflight probe.', 'bench.py', 'bench')
+_e('SKYTPU_BENCH_WAIT_SECONDS', '0',
+   'Optional settle wait before the preflight probe.',
+   'bench.py', 'bench')
+_e('SKYTPU_BENCH_CPU_FALLBACK', '1',
+   'Run the dark sched-tier payload when preflight/attempts fail '
+   '(0 opts out — supervisor tests).',
+   'bench.py', 'bench')
+_e('SKYTPU_BENCH_FALLBACK_TIMEOUT', '300',
+   'Budget for the CPU fallback sched payload.',
+   'bench.py', 'bench')
+_e('SKYTPU_BENCH_PAYLOAD_CMD', None,
+   'Test override: command the bench supervisor runs as the payload.',
+   'bench.py', 'bench')
+_e('SKYTPU_BENCH_SCHED_PAYLOAD_CMD', None,
+   'Test override: command for the CPU fallback sched payload.',
+   'bench.py', 'bench')
+_e('SKYTPU_BENCH_SLO_P99_LAUNCH_GATE', None,
+   'Arms the bench control-plane SLO gate: p99 launch latency above '
+   'this records gate_pass=false (bench never dies over it).',
+   'skypilot_tpu/observability/slo.py', 'bench')
+
+# ----------------------------------------------------------- control_plane
+
+_e('SKYTPU_API_SERVER_URL', None,
+   'Explicit API server endpoint; wins over the persisted login '
+   'endpoint.',
+   'skypilot_tpu/server/common.py', 'control_plane')
+_e('SKYTPU_API_SERVER_HOST', '127.0.0.1',
+   'Bind host of the local API server.',
+   'skypilot_tpu/server/server.py', 'control_plane')
+_e('SKYTPU_API_SERVER_PORT', '46590',
+   'Bind port of the local API server.',
+   'skypilot_tpu/server/server.py', 'control_plane')
+_e('SKYTPU_API_MAX_UPLOAD_BYTES', '536870912',
+   'Max API request body (workdir uploads).',
+   'skypilot_tpu/server/server.py', 'control_plane')
+_e('SKYTPU_UPLOAD_TTL_SECONDS', '604800',
+   'Server-side workdir-upload retention before garbage collection.',
+   'skypilot_tpu/server/uploads.py', 'control_plane')
+_e('SKYTPU_ALWAYS_UPLOAD', '0',
+   'Force workdir upload even against a local API server.',
+   'skypilot_tpu/client/sdk.py', 'control_plane')
+_e('SKYTPU_CONFIG', '~/.skytpu/config.yaml',
+   'Path of the user config YAML.',
+   'skypilot_tpu/skypilot_config.py', 'control_plane')
+_e('SKYTPU_CATALOG_DIR', None,
+   'Catalog override directory (tests / refreshed data).',
+   'skypilot_tpu/catalog/__init__.py', 'control_plane')
+_e('SKYTPU_CONTROLLER_MODE', None,
+   'Managed-jobs controller execution mode override (else config '
+   'jobs.controller.mode).',
+   'skypilot_tpu/utils/controller_utils.py', 'control_plane')
+_e('SKYTPU_JOBS_MAX_PARALLEL', None,
+   'Cap on concurrently RUNNING managed-job controllers (unset = '
+   'derived from host resources).',
+   'skypilot_tpu/jobs/scheduler.py', 'control_plane')
+_e('SKYTPU_JOBS_POLL_SECONDS', '15',
+   'Managed-job controller status-poll interval.',
+   'skypilot_tpu/jobs/controller.py', 'control_plane')
+_e('SKYTPU_JOBS_RETRY_GAP_SECONDS', '10',
+   'Gap between managed-job recovery launch attempts.',
+   'skypilot_tpu/jobs/recovery_strategy.py', 'control_plane')
+_e('SKYTPU_MAX_PARALLEL_JOBS', '1',
+   'Skylet job-queue parallelism on one cluster.',
+   'skypilot_tpu/skylet/job_lib.py', 'control_plane')
+_e('SKYTPU_SKYLET_TICK_SECONDS', '5',
+   'Skylet main-loop tick interval.',
+   'skypilot_tpu/skylet/skylet.py', 'control_plane')
+_e('SKYTPU_SKYLET_HOME', None,
+   'Skylet home dir override (Local-cloud nodes; real hosts use '
+   '$HOME).',
+   'skypilot_tpu/skylet/constants.py', 'control_plane')
+_e('SKYTPU_AUTOSTOP_INTERVAL_SECONDS', None,
+   'Test override of the autostop event tick (unset = event default).',
+   'skypilot_tpu/skylet/events.py', 'control_plane')
+_e('SKYTPU_AUTOSTOP_UTIL_THRESHOLD', '0.9',
+   'Utilization below which an idle window counts toward autostop '
+   '(`off` restores queue-only).',
+   'skypilot_tpu/skylet/events.py', 'control_plane')
+_e('SKYTPU_AUTOSTOP_UTIL_WINDOW_SECONDS', '30',
+   'Window whose max utilization the autostop gate inspects.',
+   'skypilot_tpu/skylet/events.py', 'control_plane')
+_e('SKYTPU_AUTOSTOP_BUSY_CORES', '1.0',
+   'Absolute busy-cores floor backing the fraction threshold on '
+   'many-core hosts.',
+   'skypilot_tpu/skylet/events.py', 'control_plane')
+_e('SKYTPU_GANG_GRACE_SECONDS', '2',
+   'Grace before surviving gang ranks are killed after one rank '
+   'fails.',
+   'skypilot_tpu/skylet/gang_run.py', 'control_plane')
+_e('SKYTPU_NODE_RANK', None,
+   'Gang-injected: this node\'s rank within the task.',
+   'skypilot_tpu/skylet/constants.py', 'control_plane')
+_e('SKYTPU_NODE_IPS', None,
+   'Gang-injected: newline-separated IPs of all task nodes.',
+   'skypilot_tpu/skylet/constants.py', 'control_plane')
+_e('SKYTPU_NUM_NODES', None,
+   'Gang-injected: node count of the task.',
+   'skypilot_tpu/skylet/constants.py', 'control_plane')
+_e('SKYTPU_NUM_CHIPS_PER_NODE', None,
+   'Gang-injected: accelerator chips per node.',
+   'skypilot_tpu/skylet/constants.py', 'control_plane')
+_e('SKYTPU_CLUSTER_NAME', None,
+   'Injected: cluster name for on-cluster consumers.',
+   'skypilot_tpu/skylet/constants.py', 'control_plane')
+_e('SKYTPU_TASK_ID', None, 'Injected: id of the running task.',
+   'skypilot_tpu/skylet/constants.py', 'control_plane')
+_e('SKYTPU_JOB_ID', None,
+   'Injected into task env: skylet job id of the running job.',
+   'skypilot_tpu/skylet/job_runner.py', 'control_plane')
+_e('SKYTPU_NODE_DIR', None,
+   'Local-cloud node dir (process-tree accounting + per-node state).',
+   'skypilot_tpu/observability/timeseries.py', 'control_plane')
+_e('SKYTPU_BLOCKLIST_BASE_SECONDS', '60',
+   'Base cooldown for the provision failure blocklist (doubles per '
+   'strike).',
+   'skypilot_tpu/backends/gang_backend.py', 'control_plane')
+_e('SKYTPU_SKIP_HEALTH_PROBE', '0',
+   'Skip the post-provision cluster health probe (tests).',
+   'skypilot_tpu/backends/backend_utils.py', 'control_plane')
+_e('SKYTPU_USER', None,
+   'Username override (else the OS login user).',
+   'skypilot_tpu/utils/common_utils.py', 'control_plane')
+_e('SKYTPU_USER_HASH', None,
+   'Stable user-hash override (else generated and cached).',
+   'skypilot_tpu/utils/common_utils.py', 'control_plane')
+_e('SKYTPU_DEV', '0', 'Developer mode (extra surfaces).',
+   'skypilot_tpu/utils/env_options.py', 'control_plane')
+_e('SKYTPU_INTERNAL', '0',
+   'Set when running inside a skytpu-managed buffer/controller.',
+   'skypilot_tpu/utils/env_options.py', 'control_plane')
+_e('SKYTPU_MINIMIZE_LOGGING', '0',
+   'Terse logging for controller/buffer processes.',
+   'skypilot_tpu/sky_logging.py', 'control_plane')
+_e('SKYTPU_SUPPRESS_SENSITIVE_LOG', '0',
+   'Redact sensitive values from logs.',
+   'skypilot_tpu/utils/env_options.py', 'control_plane')
+_e('SKYTPU_DISABLE_USAGE_COLLECTION', '0',
+   'Disable usage telemetry.',
+   'skypilot_tpu/utils/env_options.py', 'control_plane')
+_e('SKYTPU_LOCAL_PROVISION_FAIL_FILE', None,
+   'Fault injection: file holding a count of Local-cloud provisions '
+   'to fail (chaos/e2e tests).',
+   'skypilot_tpu/provision/local/instance.py', 'control_plane')
+
+# -------------------------------------------------------------- provision
+# Cloud-API fakes and per-cloud credentials. The shared neocloud fake
+# additionally reads the dynamic families SKYTPU_<CLOUD>_FAKE /
+# _FAKE_STATE / _FAKE_STOCKOUT (provision/neocloud_fake.py) for clouds
+# without a dedicated module; those reads are f-string-built and
+# outside static reach.
+
+_e('SKYTPU_AWS_FAKE', '0', 'Use the in-process EC2 fake.',
+   'skypilot_tpu/provision/aws/ec2_api.py', 'provision')
+_e('SKYTPU_AWS_FAKE_STATE', None,
+   'JSON state file for the cross-process EC2 fake.',
+   'skypilot_tpu/provision/aws/ec2_api.py', 'provision')
+_e('SKYTPU_AWS_FAKE_STOCKOUT', None,
+   'Comma-separated zones the EC2 fake stocks out.',
+   'skypilot_tpu/provision/aws/ec2_api.py', 'provision')
+_e('SKYTPU_AZURE_FAKE', '0', 'Use the in-process Azure fake.',
+   'skypilot_tpu/provision/azure/az_api.py', 'provision')
+_e('SKYTPU_AZURE_FAKE_STATE', None,
+   'JSON state file for the cross-process Azure fake.',
+   'skypilot_tpu/provision/azure/az_api.py', 'provision')
+_e('SKYTPU_AZURE_FAKE_STOCKOUT', None,
+   'Comma-separated regions the Azure fake stocks out.',
+   'skypilot_tpu/provision/azure/az_api.py', 'provision')
+_e('SKYTPU_AZURE_FAKE_SKU_OUT', None,
+   'Comma-separated regions the Azure fake reports SKU-unavailable.',
+   'skypilot_tpu/provision/azure/az_api.py', 'provision')
+_e('SKYTPU_GCP_FAKE', '0', 'Use the in-process GCP (GCE+TPU) fakes.',
+   'skypilot_tpu/provision/gcp/tpu_api.py', 'provision')
+_e('SKYTPU_GCP_FAKE_STATE', None,
+   'JSON state file for the cross-process TPU fake.',
+   'skypilot_tpu/provision/gcp/tpu_api.py', 'provision')
+_e('SKYTPU_GCP_GCE_FAKE_STATE', None,
+   'JSON state file for the cross-process GCE fake.',
+   'skypilot_tpu/provision/gcp/gce_api.py', 'provision')
+_e('SKYTPU_GCP_FAKE_STOCKOUT', None,
+   'Comma-separated zones the TPU fake stocks out.',
+   'skypilot_tpu/provision/gcp/tpu_api.py', 'provision')
+_e('SKYTPU_GCP_FAKE_GCE_STOCKOUT', None,
+   'Comma-separated zones the GCE fake stocks out.',
+   'skypilot_tpu/provision/gcp/gce_api.py', 'provision')
+_e('SKYTPU_GCP_FAKE_QR_DENY', None,
+   'Queued-resource names the TPU fake denies.',
+   'skypilot_tpu/provision/gcp/tpu_api.py', 'provision')
+_e('SKYTPU_GCP_FAKE_QR_WAIT', None,
+   'Queued-resource names the TPU fake holds WAITING.',
+   'skypilot_tpu/provision/gcp/tpu_api.py', 'provision')
+_e('SKYTPU_K8S_FAKE', '0', 'Use the in-process Kubernetes fake.',
+   'skypilot_tpu/provision/kubernetes/k8s_api.py', 'provision')
+_e('SKYTPU_K8S_FAKE_CONTEXT', 'fake-gke',
+   'Context name the Kubernetes fake reports.',
+   'skypilot_tpu/clouds/kubernetes.py', 'provision')
+_e('SKYTPU_K8S_FAKE_STATE', None,
+   'JSON state file for the cross-process Kubernetes fake.',
+   'skypilot_tpu/provision/kubernetes/k8s_api.py', 'provision')
+_e('SKYTPU_K8S_FAKE_NODES', None,
+   'JSON node-list override for the Kubernetes fake.',
+   'skypilot_tpu/provision/kubernetes/k8s_api.py', 'provision')
+_e('SKYTPU_K8S_FAKE_UNSCHEDULABLE', '0',
+   'Mark the Kubernetes fake\'s pods unschedulable (1, or a context '
+   'list for failover chains).',
+   'skypilot_tpu/provision/kubernetes/k8s_api.py', 'provision')
+_e('SKYTPU_K8S_SA_DIR', '/var/run/secrets/kubernetes.io/serviceaccount',
+   'Test override for the in-cluster service-account mount path.',
+   'skypilot_tpu/provision/kubernetes/k8s_api.py', 'provision')
+_e('SKYTPU_LAMBDA_FAKE', '0', 'Use the in-process Lambda Cloud fake.',
+   'skypilot_tpu/provision/lambda_cloud/lambda_api.py', 'provision')
+_e('SKYTPU_LAMBDA_FAKE_STATE', None,
+   'JSON state file for the cross-process Lambda fake.',
+   'skypilot_tpu/provision/lambda_cloud/lambda_api.py', 'provision')
+_e('SKYTPU_LAMBDA_FAKE_STOCKOUT', None,
+   'Comma-separated regions the Lambda fake stocks out.',
+   'skypilot_tpu/provision/lambda_cloud/lambda_api.py', 'provision')
+_e('SKYTPU_RUNPOD_FAKE', '0', 'Use the in-process RunPod fake.',
+   'skypilot_tpu/provision/runpod/runpod_api.py', 'provision')
+_e('SKYTPU_RUNPOD_FAKE_STATE', None,
+   'JSON state file for the cross-process RunPod fake.',
+   'skypilot_tpu/provision/runpod/runpod_api.py', 'provision')
+_e('SKYTPU_RUNPOD_FAKE_STOCKOUT', None,
+   'Comma-separated regions the RunPod fake stocks out.',
+   'skypilot_tpu/provision/runpod/runpod_api.py', 'provision')
+_e('SKYTPU_IBM_FAKE', '0', 'Use the IBM fake (credential bypass).',
+   'skypilot_tpu/backends/backend_utils.py', 'provision')
+_e('SKYTPU_VSPHERE_SSH_USER', 'ubuntu',
+   'SSH user for vSphere-provisioned VMs.',
+   'skypilot_tpu/provision/vsphere/vsphere_api.py', 'provision')
+_e('SKYTPU_VSPHERE_TEMPLATE', 'skytpu-ubuntu2204-template',
+   'VM template vSphere clones from.',
+   'skypilot_tpu/provision/vsphere/vsphere_api.py', 'provision')
+
+
+# --------------------------------------------------------- doc generation
+
+_GENERATED_NOTE = ('<!-- This table is GENERATED from '
+                   'skypilot_tpu/utils/env_registry.py (group: {group}) '
+                   'by `skytpu lint`\'s env-registry plane; edit the '
+                   'registry, not the table. A tier-1 test keeps them '
+                   'in sync. -->')
+
+
+def entries(group: Optional[str] = None) -> List[EnvVar]:
+    rows = (REGISTRY.values() if group is None else
+            (e for e in REGISTRY.values() if e.group == group))
+    return sorted(rows, key=lambda e: e.name)
+
+
+def render_doc_table(group: str) -> str:
+    """The markdown knob table embedded (between BEGIN/END markers) in
+    docs/serving.md and docs/observability.md."""
+    lines = [_GENERATED_NOTE.format(group=group),
+             '| Knob | Default | What it does |',
+             '| --- | --- | --- |']
+    for e in entries(group):
+        default = f'`{e.default}`' if e.default is not None else '(unset)'
+        doc = e.doc.replace('|', '\\|')  # a raw | splits the table row
+        lines.append(f'| `{e.name}` | {default} | {doc} |')
+    return '\n'.join(lines)
+
+
+def doc_table_markers(group: str) -> 'tuple[str, str]':
+    return (f'<!-- BEGIN generated env knob table: {group} -->',
+            f'<!-- END generated env knob table: {group} -->')
+
+
+def names(group: Optional[str] = None) -> Iterable[str]:
+    return [e.name for e in entries(group)]

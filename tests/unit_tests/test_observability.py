@@ -250,31 +250,36 @@ def test_peak_flops_env_override(monkeypatch):
 
 
 # ------------------------------------------------------- metric name lint
+#
+# The five regex lints that used to live in this module (metric names,
+# label cardinality, EventKind vocabulary, network timeouts, exception
+# swallows — PRs 2–13) migrated into the AST rule engine
+# (skypilot_tpu/analysis/, ISSUE 14). The tests below are thin drivers:
+# run the corresponding rule over the package tree, assert zero
+# findings, and keep the coverage guards (the scan must SEE the
+# instrumentation — a lint that silently matches nothing is worse than
+# no lint).
+
+
+def _run_rule(rule):
+    """Run one analysis rule over the package + bench.py; returns the
+    engine result (suppressions applied, stale suppressions
+    reported)."""
+    from skypilot_tpu import analysis
+    from skypilot_tpu.analysis import engine as analysis_engine
+    return analysis_engine.run(analysis.default_paths(), [rule],
+                               root=REPO_ROOT,
+                               known_rule_names=analysis.RULES.keys())
 
 
 def test_all_registered_metric_names_match_convention():
-    """Lint: every metric name in the package matches
+    """Lint driver: every metric registration in the package matches
     ^skytpu_[a-z0-9_]+$ (prevents exposition-format drift)."""
-    pattern = re.compile(
-        r"""(?:\.(?:counter|gauge|histogram)|RateTracker)\(\s*
-            ['"]([^'"]+)['"]""", re.VERBOSE)
-    name_re = re.compile(metrics.METRIC_NAME_PATTERN)
-    found = []
-    pkg = os.path.join(REPO_ROOT, 'skypilot_tpu')
-    sources = [os.path.join(REPO_ROOT, 'bench.py')]
-    for dirpath, _, files in os.walk(pkg):
-        sources += [os.path.join(dirpath, f) for f in files
-                    if f.endswith('.py')]
-    for path in sources:
-        with open(path, encoding='utf-8') as f:
-            src = f.read()
-        for m in pattern.finditer(src):
-            found.append((os.path.relpath(path, REPO_ROOT), m.group(1)))
-    bad = [(p, n) for p, n in found if not name_re.match(n)]
-    assert not bad, f'metric names violating the skytpu_ convention: {bad}'
-    # The scan itself must see the instrumentation (guard against the
-    # regex silently matching nothing).
-    names = {n for _, n in found}
+    from skypilot_tpu.analysis import rules_observability
+    rule = rules_observability.MetricNameRule()
+    result = _run_rule(rule)
+    assert result.clean, result.findings
+    names = rule.found_names
     for expected in ('skytpu_lb_requests_total', 'skytpu_span_seconds',
                      'skytpu_train_step_seconds',
                      'skytpu_serve_requests_total',
@@ -339,45 +344,20 @@ def test_all_registered_metric_names_match_convention():
 
 
 def test_metric_label_cardinality_lint():
-    """Lint (ISSUE 13): no unbounded label NAMES at any metric
-    registration site (a per-request id label mints one series per
-    request — the registry and every scrape grow without bound), and no
-    label VALUE expression derives from a request/trace id. The runtime
-    registry enforces the name half too
-    (metrics.UNBOUNDED_LABEL_NAMES); this scan catches the value half
-    and keeps the denylist honest against the whole tree."""
-    reg_re = re.compile(
-        r"""(?:\.(?:counter|gauge|histogram)|RateTracker)\(""")
-    labels_re = re.compile(r'labels\s*=\s*\(')
-    name_in_tuple_re = re.compile(r"['\"]([A-Za-z_][A-Za-z0-9_]*)['\"]")
-    # Expressions that smell like per-request identifiers when used as
-    # a label VALUE.
-    forbidden_value_tokens = ('trace_id', 'request_id', 'req.id',
-                              'request.id', 'span_id', '.trace_id')
-    bad = []
-    pkg = os.path.join(REPO_ROOT, 'skypilot_tpu')
-    sources = [os.path.join(REPO_ROOT, 'bench.py')]
-    for dirpath, _, files in os.walk(pkg):
-        sources += [os.path.join(dirpath, f) for f in files
-                    if f.endswith('.py')]
-    for path in sources:
-        with open(path, encoding='utf-8') as f:
-            src = f.read()
-        rel = os.path.relpath(path, REPO_ROOT)
-        for m in labels_re.finditer(src):
-            tup = _balanced_call(src, m.end() - 1)
-            is_registration = bool(reg_re.search(
-                src[max(0, m.start() - 300):m.start()]))
-            if is_registration:
-                # Registration site: label NAMES are string literals.
-                for name in name_in_tuple_re.findall(tup):
-                    if name in metrics.UNBOUNDED_LABEL_NAMES:
-                        bad.append((rel, f'label name {name!r}'))
-            for token in forbidden_value_tokens:
-                if token in tup:
-                    bad.append((rel, f'label value expr contains '
-                                     f'{token!r}: {tup[:80]}'))
-    assert not bad, f'unbounded metric labels: {bad}'
+    """Lint driver (ISSUE 13 → 14): no unbounded label NAMES at any
+    registration site and no label VALUE expression derived from a
+    request/trace id. The rule shares ONE vocabulary with the runtime
+    guard (metrics.UNBOUNDED_LABEL_NAMES +
+    metrics.UNBOUNDED_LABEL_VALUE_MARKERS) — the denylists cannot
+    drift apart anymore."""
+    from skypilot_tpu.analysis import rules_observability
+    rule = rules_observability.LabelCardinalityRule()
+    # The rule's defaults ARE the runtime constants (the satellite fix
+    # for the duplicated denylists).
+    assert rule.unbounded_names == metrics.UNBOUNDED_LABEL_NAMES
+    assert rule.value_markers == metrics.UNBOUNDED_LABEL_VALUE_MARKERS
+    result = _run_rule(rule)
+    assert result.clean, result.findings
     # The runtime guard backs the lint: registration rejects the names.
     import pytest as _pytest
     with _pytest.raises(ValueError):
@@ -386,38 +366,17 @@ def test_metric_label_cardinality_lint():
 
 
 def test_all_journal_event_kinds_are_registered():
-    """Lint: journal call sites only use kinds registered in
-    observability.journal.EventKind — string literals must be registered
-    values, and EventKind attribute references must be real members —
-    so the journal vocabulary stays bounded (ISSUE 3)."""
-    from skypilot_tpu.observability import journal
-
-    literal_re = re.compile(
-        r"""journal\.event\(\s*['"]([^'"]+)['"]""")
-    attr_re = re.compile(r'EventKind\.([A-Z_]+)')
-    member_names = {k.name for k in journal.EventKind}
-    found_literals, found_attrs, bad = [], [], []
-    pkg = os.path.join(REPO_ROOT, 'skypilot_tpu')
-    sources = []
-    for dirpath, _, files in os.walk(pkg):
-        sources += [os.path.join(dirpath, f) for f in files
-                    if f.endswith('.py')]
-    for path in sources:
-        with open(path, encoding='utf-8') as f:
-            src = f.read()
-        rel = os.path.relpath(path, REPO_ROOT)
-        for m in literal_re.finditer(src):
-            found_literals.append((rel, m.group(1)))
-            if m.group(1) not in journal.KINDS:
-                bad.append((rel, m.group(1)))
-        for m in attr_re.finditer(src):
-            found_attrs.append((rel, m.group(1)))
-            if m.group(1) not in member_names:
-                bad.append((rel, f'EventKind.{m.group(1)}'))
-    assert not bad, f'unregistered journal event kinds: {bad}'
-    # Guard against the regexes silently matching nothing: the wired
+    """Lint driver: journal call sites only use kinds registered in
+    observability.journal.EventKind — string literals must be
+    registered values, and EventKind attribute references must be real
+    members — so the journal vocabulary stays bounded (ISSUE 3)."""
+    from skypilot_tpu.analysis import rules_observability
+    rule = rules_observability.JournalKindRule()
+    result = _run_rule(rule)
+    assert result.clean, result.findings
+    # Guard against the scan silently matching nothing: the wired
     # call sites must be seen.
-    attr_names = {n for _, n in found_attrs}
+    attr_names = rule.found_members
     for expected in ('PROVISION_FAILOVER', 'JOB_PHASE', 'JOB_CREATED',
                      'REPLICA_TRANSITION', 'SKYLET_JOB_START',
                      'BACKEND_JOB_SUBMIT',
@@ -446,91 +405,38 @@ def test_all_journal_event_kinds_are_registered():
 # ---------------------------------------------- static robustness lints
 
 
-def _package_sources():
-    pkg = os.path.join(REPO_ROOT, 'skypilot_tpu')
-    for dirpath, _, files in os.walk(pkg):
-        for f in files:
-            if f.endswith('.py'):
-                path = os.path.join(dirpath, f)
-                with open(path, encoding='utf-8') as fh:
-                    yield os.path.relpath(path, REPO_ROOT), fh.read()
-
-
-def _balanced_call(src: str, open_paren_idx: int) -> str:
-    """The call text from the opening paren to its balanced close (good
-    enough for lint purposes: none of the scanned calls embed parens in
-    string literals)."""
-    depth, i = 1, open_paren_idx + 1
-    while i < len(src) and depth:
-        if src[i] == '(':
-            depth += 1
-        elif src[i] == ')':
-            depth -= 1
-        i += 1
-    return src[open_paren_idx:i]
-
-
 def test_network_calls_carry_explicit_timeouts():
-    """Robustness lint (ISSUE 10): every blocking HTTP call in the
-    package names an explicit ``timeout=`` — a defaulted (infinite)
-    timeout in a probe/drain/proxy path is how a dead peer wedges a
-    control loop. A deliberately unbounded stream passes
-    ``timeout=None`` *explicitly* (greppable intent, still counted
-    here). aiohttp is covered at the session level: every
-    ``aiohttp.ClientSession(...)`` must carry a ``timeout=`` client
-    config (per-request overrides remain allowed)."""
-    # requests as a bare module only in files that actually import it
-    # (k8s_api has a local dict named `requests`).
-    lib_call = re.compile(
-        r'requests_lib\.(?:get|post|put|head|delete|request)\(')
-    bare_call = re.compile(
-        r'(?<![\w.])requests\.(?:get|post|put|head|delete|request)\(')
-    urlopen_call = re.compile(r'urllib\.request\.urlopen\(')
-    session_ctor = re.compile(r'aiohttp\.ClientSession\(')
-    bad, found = [], 0
-    for rel, src in _package_sources():
-        imports_requests = re.search(r'^\s*import requests\b', src,
-                                     re.M) is not None
-        patterns = [lib_call, urlopen_call, session_ctor]
-        if imports_requests:
-            patterns.append(bare_call)
-        for pat in patterns:
-            for m in pat.finditer(src):
-                found += 1
-                call = _balanced_call(src, m.end() - 1)
-                if 'timeout' not in call:
-                    bad.append((rel, m.group(0) + '...'))
-    assert not bad, f'network calls lacking an explicit timeout: {bad}'
+    """Robustness lint driver (ISSUE 10 → 14): every blocking HTTP
+    call in the package names an explicit ``timeout=`` — a defaulted
+    (infinite) timeout in a probe/drain/proxy path is how a dead peer
+    wedges a control loop. A deliberately unbounded stream passes
+    ``timeout=None`` *explicitly*. The rule resolves import aliases,
+    so ``requests_lib.`` calls count and k8s_api's local ``requests``
+    dict does not; ``aiohttp.ClientSession(...)`` is covered at the
+    session level (per-request overrides remain allowed)."""
+    from skypilot_tpu.analysis import rules_robustness
+    rule = rules_robustness.TimeoutRequiredRule()
+    result = _run_rule(rule)
+    assert result.clean, result.findings
     # The scan must actually see the instrumented call sites.
-    assert found >= 10, f'lint scan looks broken (only {found} calls)'
+    assert rule.found_calls >= 10, \
+        f'lint scan looks broken (only {rule.found_calls} calls)'
 
 
 def test_no_swallowed_exceptions_in_serve_and_skylet_loops():
-    """Robustness lint (ISSUE 10): no bare ``except:`` and no SILENT
-    ``except Exception: pass`` in serve/ and skylet/ — a swallowed
-    error in a supervision loop is exactly how replicas black-hole.
-    Typed-narrow swallows (``except ValueError: pass`` around an env
-    parse) stay legal, as does a broad swallow whose ``pass`` line
-    carries an explanatory comment (e.g. 'the journal must never take
-    the tick loop down') — the lint forces the *justification*, not a
-    blanket style."""
-    silent_broad = re.compile(
-        r'except\s+(?:Exception|BaseException)(?:\s+as\s+\w+)?\s*:'
-        r'\s*(?:#[^\n]*)?\n\s*pass[ \t]*\n')
-    bare = re.compile(r'except\s*:')
-    bad, scanned = [], 0
-    for rel, src in _package_sources():
-        top = os.path.normpath(rel).split(os.sep)[1]
-        if top not in ('serve', 'skylet'):
-            continue
-        scanned += 1
-        for pat, label in ((silent_broad, 'silent except Exception'),
-                           (bare, 'bare except')):
-            for m in pat.finditer(src):
-                bad.append((rel, label,
-                            src[:m.start()].count('\n') + 1))
-    assert not bad, f'silently swallowed exceptions in loops: {bad}'
-    assert scanned >= 10, 'lint scanned suspiciously few files'
+    """Robustness lint driver (ISSUE 10 → 14): no bare ``except:`` and
+    no SILENT ``except Exception: pass`` in serve/ and skylet/ — a
+    swallowed error in a supervision loop is exactly how replicas
+    black-hole. Typed-narrow swallows (``except ValueError: pass``
+    around an env parse) stay legal, as does a broad swallow whose
+    ``pass`` line carries an explanatory comment — the rule forces the
+    *justification*, not a blanket style."""
+    from skypilot_tpu.analysis import rules_robustness
+    rule = rules_robustness.ExceptionSwallowRule()
+    result = _run_rule(rule)
+    assert result.clean, result.findings
+    assert rule.files_scanned >= 10, \
+        'lint scanned suspiciously few files'
 
 
 # ------------------------------------------------------ timeline spans
