@@ -73,6 +73,11 @@ _PHASE_DEADLINES = {
     # so every perf round reports an acceptance ratio).
     'spec_compile': 240,
     'spec_run': 150,
+    # Prefix-aware routing workload (CPU failover tier): fleet
+    # prefix-hit-ratio / tokens-saved / drain-churn numbers land every
+    # round even when TPUs are dark.
+    'route_compile': 240,
+    'route_run': 150,
 }
 
 
@@ -83,6 +88,12 @@ def _payload() -> None:
     from skypilot_tpu.benchmark import harness
 
     harness.beat('start')
+    # This payload is EXPECTED to be killed mid-compile (stall
+    # deadlines, total budget): persistent-compile-cache writes must be
+    # atomic or a kill poisons the shared cache dir for every later
+    # jax process (utils/jax_cache.py).
+    from skypilot_tpu.utils import jax_cache
+    jax_cache.harden_compilation_cache()
     import jax
 
     devices = harness.init_devices()  # beats 'init' / 'devices_ok'
@@ -263,6 +274,8 @@ def _payload_sched() -> None:
     from skypilot_tpu.benchmark import harness
 
     harness.beat('start')
+    from skypilot_tpu.utils import jax_cache
+    jax_cache.harden_compilation_cache()  # kill-prone payload, see above
     from skypilot_tpu.benchmark import decode_bench
     # Mesh shape rides next to the platform tag: SKYTPU_BENCH_TP asks
     # the engine workloads to shard over a tensor-parallel mesh (the
@@ -289,6 +302,20 @@ def _payload_sched() -> None:
     # latency looked like (and whether the regression gate held).
     from skypilot_tpu.observability import slo as slo_lib
     out['detail']['control_plane_slo'] = slo_lib.bench_slo_block()
+    print(json.dumps(out), flush=True)
+    # Prefix-aware routing: fleet locality numbers (affinity vs
+    # random/round-robin, cross-replica fetch recovery, drain churn)
+    # as a third cumulative line — a kill mid-route still lands the
+    # sched+spec result.
+    route = decode_bench.run_route_bench(beat=harness.beat)
+    out['detail']['routing'] = {
+        'value': route['value'],
+        'unit': route['unit'],
+        'platform': route['platform'],
+        **{k: route['detail'][k] for k in (
+            'n_replicas', 'n_requests', 'n_families', 'arms', 'drain',
+            'affinity_vs_random')},
+    }
     print(json.dumps(out), flush=True)
 
 

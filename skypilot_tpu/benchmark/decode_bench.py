@@ -787,12 +787,253 @@ def run_scheduler_bench(steps: int = 2, beat=None, seed: int = 0,
     }
 
 
+def _route_requests(vocab_size: int, n_families: int, per_family: int,
+                    prefix_len: int, suffix_lens, new_tokens,
+                    seed: int = 0):
+    """Multi-tenant shared-prefix traffic: ``n_families`` distinct
+    system-prompt prefixes, ``per_family`` requests each with unique
+    suffixes, arrival order shuffled — the workload where ROUTING
+    decides whether the fleet's radix caches see locality or 1/N of
+    it."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    families = [rng.randint(0, vocab_size, size=prefix_len).tolist()
+                for _ in range(n_families)]
+    reqs = []
+    for i in range(n_families * per_family):
+        fam = families[i % n_families]
+        suf = rng.randint(
+            0, vocab_size,
+            size=int(suffix_lens[i % len(suffix_lens)])).tolist()
+        reqs.append((fam + suf, int(new_tokens[i % len(new_tokens)])))
+    rng.shuffle(reqs)
+    return families, reqs
+
+
+def run_route_bench(beat=None, seed: int = 0,
+                    n_replicas: int = 3, n_families: int = 6,
+                    per_family: int = 6) -> dict:
+    """Multi-replica prefix-aware ROUTING bench (dark CPU tier).
+
+    Simulates the `sky serve` layer in-process: N paged debug-model
+    engines behind a load-balancing policy, serving the same
+    multi-family shared-prefix request list under four routing arms —
+    ``prefix_affinity`` (bounded-load consistent hashing on the
+    block-aligned prompt digest), ``round_robin``, ``random``, and
+    ``random`` + the cross-replica prefix-fetch tier (what peer
+    fetching buys back when routing is locality-blind). Reports fleet
+    ``prefix_hit_ratio``, ``prefill_tokens_saved`` and TTFT p95 per
+    arm, then DRAINS one replica under the affinity arm and reports the
+    key-remap fraction (consistent hashing: only the drained replica's
+    keys move) and the post-drain hit ratio (warm survivors — no
+    fleet-wide cold start). Device-agnostic: the numbers are properties
+    of routing + the radix caches, so the CPU failover tier emits them
+    every perf round with a ``platform`` tag.
+    """
+    import numpy as np
+
+    from skypilot_tpu.models import decode, llama
+    from skypilot_tpu.models import engine as engine_lib
+    from skypilot_tpu.serve import load_balancing_policies as lb_policies
+    from skypilot_tpu.utils import common_utils
+
+    beat, devices = _init(beat)
+    platform = devices[0].platform
+    model_name, num_slots, block_k, max_len = 'debug', 4, 8, 64
+    prefix_len = 24
+    cfg = dataclasses.replace(llama.CONFIGS[model_name], remat=False)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    dcfg = decode.DecodeConfig(max_len=max_len, temperature=0.0,
+                               decode_attention='xla',
+                               kernel_block_k=block_k)
+    families, requests = _route_requests(
+        cfg.vocab_size, n_families=n_families, per_family=per_family,
+        prefix_len=prefix_len, suffix_lens=(3, 5, 8),
+        new_tokens=(4, 8), seed=seed)
+    num_blocks = num_slots * (max_len // block_k) + 1
+    replicas = [f'replica-{i}' for i in range(n_replicas)]
+    digest_kwargs = dict(block_tokens=block_k, max_tokens=prefix_len)
+
+    def make_engines(with_fetch: bool):
+        engines = {}
+
+        def fetch_fn(url, tokens, from_tokens, budget):
+            # In-process transport contract: None = transport failure
+            # (engine backs the peer off); a cold peer answers the
+            # honest empty payload.
+            peer = engines.get(url)
+            if peer is None:
+                return None
+            raw = peer._export_prefix_now(tokens, from_tokens)  # pylint: disable=protected-access
+            if raw is None:
+                from skypilot_tpu.models import prefix_transfer
+                return prefix_transfer.empty_payload(
+                    from_tokens, block_k, 'bf16')
+            return raw
+
+        for name in replicas:
+            engines[name] = engine_lib.DecodeEngine(
+                params, cfg, dcfg, num_slots, step_chunk=2,
+                name=f'route-{name}', paged=True, num_blocks=num_blocks,
+                prefix_peers=([u for u in replicas] if with_fetch
+                              else []),
+                prefix_fetch_fn=fetch_fn)
+        return engines
+
+    def fleet_counters(engines):
+        return (
+            sum(e._prompt_tokens_saved for e in engines.values()),  # pylint: disable=protected-access
+            sum(e._prompt_tokens_total for e in engines.values()),  # pylint: disable=protected-access
+            sum(e.cache_stats()['prefix_fetch_hits']
+                for e in engines.values()))
+
+    def run_leg(policy, engines, request_list):
+        """Route + serve one request list CLOSED-LOOP (outstanding
+        bounded at the fleet's slot capacity, like steady traffic
+        behind a concurrency-limited client): the policy's in-flight
+        accounting sees real concurrency, and TTFT measures routing +
+        prefill cost instead of an artificial submit-all queue.
+        Counters are per-leg deltas, so warm engines (the post-drain
+        arm) report only this leg's locality."""
+        policy.set_ready_replicas(sorted(engines))
+        saved0, total0, fetch0 = fleet_counters(engines)
+        max_outstanding = len(engines) * num_slots
+        placed = []        # (request, replica)
+        pending = list(request_list)
+        outstanding = []
+        while pending or outstanding:
+            while pending and len(outstanding) < max_outstanding:
+                prompt, max_new = pending.pop(0)
+                ctx = lb_policies.RouteContext(
+                    prefix_digest=lb_policies.prefix_digest(
+                        prompt, **digest_kwargs))
+                target = policy.select_replica(ctx)
+                req = engine_lib.Request(prompt, max_new)
+                engines[target].submit(req)
+                policy.request_started(target)
+                placed.append((req, target))
+                outstanding.append((req, target))
+            for eng in engines.values():
+                eng.step()
+            still = []
+            for req, target in outstanding:
+                if req.done:
+                    policy.request_finished(target)
+                else:
+                    still.append((req, target))
+            outstanding = still
+        ttfts = sorted(req.first_token_ts - req.enqueue_ts
+                       for req, _ in placed
+                       if req.first_token_ts is not None)
+        saved1, total1, fetch1 = fleet_counters(engines)
+        saved, total = saved1 - saved0, total1 - total0
+        return {
+            'prefix_hit_ratio': round(saved / max(total, 1), 4),
+            'prefill_tokens_saved': saved,
+            'prompt_tokens_total': total,
+            'prefix_fetch_hits': fetch1 - fetch0,
+            'ttft_p95_ms': round(
+                common_utils.percentile(ttfts, 95) * 1e3, 3),
+            'requests_per_replica': {
+                name: sum(1 for _, t in placed if t == name)
+                for name in sorted(engines)},
+        }
+
+    beat('route_compile')
+    arms = {}
+    with _journal_slow_requests_only():
+        # Warmup/compile passes (throwaway engines): the full request
+        # list through a plain fleet AND a fetch-enabled fleet, so
+        # every prefill-bucket / prefix-gather / block-inject dispatch
+        # shape is jit-cached before anything is timed — else the
+        # first measured arm eats the compiles and its TTFT p95
+        # measures XLA, not routing.
+        run_leg(lb_policies.PrefixAffinityPolicy(),
+                make_engines(False), requests)
+        run_leg(lb_policies.RandomPolicy(seed=seed),
+                make_engines(True), requests)
+        beat('route_run')
+        affinity_engines = make_engines(False)
+        affinity_policy = lb_policies.PrefixAffinityPolicy()
+        arms['prefix_affinity'] = run_leg(affinity_policy,
+                                          affinity_engines, requests)
+        arms['round_robin'] = run_leg(lb_policies.RoundRobinPolicy(),
+                                      make_engines(False), requests)
+        arms['random'] = run_leg(lb_policies.RandomPolicy(seed=seed),
+                                 make_engines(False), requests)
+        arms['random_peer_fetch'] = run_leg(
+            lb_policies.RandomPolicy(seed=seed), make_engines(True),
+            requests)
+        # The production config: affinity routing AND the fetch tier —
+        # bounded-load spills land on a peer that pulls the blocks
+        # instead of re-prefilling, so locality survives load spikes.
+        arms['affinity_peer_fetch'] = run_leg(
+            lb_policies.PrefixAffinityPolicy(), make_engines(True),
+            requests)
+
+        # DRAIN: drop one replica from the affinity ring; consistent
+        # hashing must re-map ONLY its keys, and the survivors' warm
+        # caches must keep the fleet hit ratio off the floor.
+        ring = affinity_policy.ring
+        fam_digests = [lb_policies.prefix_digest(f, **digest_kwargs)
+                       for f in families]
+        owners_before = {d: ring.owner(d) for d in fam_digests}
+        drained = replicas[0]
+        survivors = {n: e for n, e in affinity_engines.items()
+                     if n != drained}
+        arms['affinity_post_drain'] = run_leg(
+            affinity_policy, survivors, requests)
+        owners_after = {d: affinity_policy.ring.owner(d)
+                        for d in fam_digests}
+        moved = [d for d in fam_digests
+                 if owners_before[d] != owners_after[d]]
+        moved_from_drained = [d for d in moved
+                              if owners_before[d] == drained]
+        drain = {
+            'drained_replica': drained,
+            'families': len(fam_digests),
+            'keys_moved': len(moved),
+            # Consistent hashing's churn contract: every moved key
+            # belonged to the drained replica.
+            'moved_only_drained_keys':
+                len(moved) == len(moved_from_drained),
+            'remap_fraction': round(len(moved) / len(fam_digests), 4),
+        }
+    affinity = arms['prefix_affinity']
+    return {
+        'metric': 'fleet_route_prefix_hit_ratio',
+        'value': affinity['prefix_hit_ratio'],
+        'unit': 'ratio',
+        'platform': platform,
+        'detail': {
+            'workload': 'route',
+            'model': model_name,
+            'n_replicas': n_replicas,
+            'n_requests': len(requests),
+            'n_families': len(families),
+            'prefix_len': prefix_len,
+            'block_k': block_k,
+            'arms': arms,
+            'drain': drain,
+            'affinity_vs_random': {
+                'hit_ratio_delta': round(
+                    affinity['prefix_hit_ratio'] -
+                    arms['random']['prefix_hit_ratio'], 4),
+                'tokens_saved_delta':
+                    affinity['prefill_tokens_saved'] -
+                    arms['random']['prefill_tokens_saved'],
+            },
+            'device': str(devices[0]),
+        },
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='bench-1b')
     parser.add_argument('--workload',
                         choices=('static', 'mixed', 'prefix', 'sched',
-                                 'spec'),
+                                 'spec', 'route'),
                         default='static',
                         help='static: one fixed-shape generate() batch; '
                              'mixed: continuous engine vs static '
@@ -803,7 +1044,11 @@ def main() -> None:
                              'phase (the CPU failover tier); '
                              'spec: speculative decoding + chunked '
                              'prefill vs the plain paged engine on '
-                             'short greedy decodes')
+                             'short greedy decodes; '
+                             'route: multi-replica prefix-affinity '
+                             'routing + cross-replica prefix fetch vs '
+                             'random/round-robin (fleet hit ratio, '
+                             'tokens saved, TTFT p95, drain churn)')
     parser.add_argument('--batch', type=int, default=16)
     parser.add_argument('--prompt-len', type=int, default=128)
     parser.add_argument('--new-tokens', type=int, default=128)
@@ -855,7 +1100,11 @@ def main() -> None:
                              'the emitted tp tag is the effective '
                              'degree)')
     args = parser.parse_args()
-    if args.workload == 'sched':
+    if args.workload == 'route':
+        # Deterministic single measured pass per arm: --steps has no
+        # meaning here (the numbers are scheduler/routing properties).
+        out = run_route_bench()
+    elif args.workload == 'sched':
         out = run_scheduler_bench(steps=min(args.steps, 3), tp=args.tp)
     elif args.workload == 'spec':
         out = run_spec_bench(args.model, args.num_slots,

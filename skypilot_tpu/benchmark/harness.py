@@ -168,6 +168,12 @@ def init_devices(timeout_env: str = 'SKYTPU_BENCH_INIT_TIMEOUT') -> list:
     runs keep the faulthandler watchdog (fires without the GIL, which
     the wedged native dial loop may hold).
     """
+    # Benchmark processes get killed at phase deadlines, routinely
+    # mid-compile: persistent-compile-cache writes must be atomic or
+    # the kill leaves a torn entry that corrupts every later process
+    # sharing the cache dir (utils/jax_cache.py).
+    from skypilot_tpu.utils import jax_cache
+    jax_cache.harden_compilation_cache()
     import jax
     if os.environ.get('JAX_PLATFORMS'):
         jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])

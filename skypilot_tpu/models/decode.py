@@ -670,6 +670,22 @@ def _copy_block(pool: Cache, src: jax.Array, dst: jax.Array) -> Cache:
     return out
 
 
+def _inject_pool_blocks(pool: Cache, block_idx: jax.Array,
+                        values: Cache) -> Cache:
+    """Write peer-fetched prefix blocks into the pool: ``values[name]``
+    is ``[L, n, block_k, ...]`` landing at pool blocks ``block_idx``
+    [n] — the device half of the cross-replica prefix tier. Values
+    arrive in the pool's own storage dtype (int8 pools receive int8 +
+    scale planes verbatim), so injection is a pure scatter: no
+    re-quantization, and the fetched bytes decode bit-identically to
+    the peer's."""
+    out = dict(pool)
+    for name in pool:
+        out[name] = pool[name].at[:, block_idx].set(
+            values[name].astype(pool[name].dtype))
+    return out
+
+
 # Engine-serving entry points for the paged cache. The pool is DONATED
 # everywhere — block scatters mutate the persistent HBM buffers; callers
 # rebind to the returned pool. One compile per (bucket, prefix-bucket)
@@ -680,6 +696,7 @@ paged_prefill_with_prefix = jax.jit(_paged_prefill_with_prefix,
                                     static_argnames=('cfg',),
                                     donate_argnums=(7,))
 copy_block = jax.jit(_copy_block, donate_argnums=(0,))
+inject_pool_blocks = jax.jit(_inject_pool_blocks, donate_argnums=(0,))
 
 
 def _decode_step(params: Params, token: jax.Array, pos: jax.Array,

@@ -122,6 +122,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from skypilot_tpu.models import decode, llama
+from skypilot_tpu.models import prefix_transfer
 from skypilot_tpu.observability import journal
 from skypilot_tpu.parallel import mesh as mesh_lib
 from skypilot_tpu.observability import metrics as metrics_lib
@@ -261,6 +262,7 @@ class RadixPrefixCache:
         self._root = _RadixNode([], [], None)
         self._clock = 0
         self._n_blocks = 0          # blocks currently held by the tree
+        self._n_nodes = 0           # edges in the tree (root excluded)
 
     # ------------------------------------------------------------ utils
 
@@ -271,6 +273,9 @@ class RadixPrefixCache:
 
     def held_blocks(self) -> int:
         return self._n_blocks
+
+    def node_count(self) -> int:
+        return self._n_nodes
 
     def _touch(self, node: '_RadixNode') -> None:
         self._clock += 1
@@ -338,6 +343,7 @@ class RadixPrefixCache:
                 new = _RadixNode(keys[i:], list(blocks[i:]), node)
                 node.children[keys[i]] = new
                 self._touch(new)
+                self._n_nodes += 1
                 adopted = len(new.blocks)
                 self._alloc.incref(new.blocks)
                 self._n_blocks += adopted
@@ -369,6 +375,7 @@ class RadixPrefixCache:
         node.keys = node.keys[:at]
         node.blocks = node.blocks[:at]
         node.children = {tail.keys[0]: tail}
+        self._n_nodes += 1
 
     # ------------------------------------------------------------ evict
 
@@ -399,6 +406,7 @@ class RadixPrefixCache:
                 continue            # pinned by slots: freeing gains 0
             freed += len(self._alloc.decref(victim.blocks))
             self._n_blocks -= len(victim.blocks)
+            self._n_nodes -= 1
             parent = victim.parent
             del parent.children[victim.keys[0]]
             if (parent is not self._root and not parent.children):
@@ -433,7 +441,8 @@ class Request:
                  request_id: Optional[str] = None,
                  tenant: str = 'default',
                  trace_id: Optional[str] = None,
-                 span_id: Optional[str] = None):
+                 span_id: Optional[str] = None,
+                 prefix_hint: Optional[str] = None):
         if max_new_tokens < 1:
             raise ValueError(f'max_new_tokens must be >= 1, got '
                              f'{max_new_tokens}')
@@ -459,6 +468,10 @@ class Request:
         # through the load balancer.
         self.trace_id = trace_id
         self.span_id = span_id
+        # Cross-replica prefix tier: the LB's X-Skytpu-Prefix-Owner hop
+        # header — the peer most likely holding this prompt's cached KV
+        # blocks. Tried FIRST on a local radix miss.
+        self.prefix_hint = prefix_hint
         self.tokens: List[int] = []
         self.finish_reason: Optional[str] = None
         self.enqueue_ts: Optional[float] = None
@@ -648,6 +661,7 @@ class DecodeEngine:
     _GUARDED_BY = {
         '_queues': '_queue_lock',
         '_rr_offset': '_queue_lock',
+        '_export_jobs': '_export_lock',
         '_slots': 'loop',
         '_token': 'loop',
         '_pos': 'loop',
@@ -665,8 +679,9 @@ class DecodeEngine:
     # observers). submit/queue_depth take _queue_lock; stats/
     # active_slots/free_slots are the snapshot surface.
     _CROSS_THREAD_METHODS = ('submit', 'queue_depth', 'stats',
-                             'spec_stats', 'flush_journal',
-                             'active_slots', 'free_slots')
+                             'spec_stats', 'cache_stats', 'flush_journal',
+                             'active_slots', 'free_slots',
+                             'export_prefix_blocks')
 
     def __init__(self, params, cfg: llama.LlamaConfig,
                  dcfg: decode.DecodeConfig, num_slots: int,
@@ -677,7 +692,10 @@ class DecodeEngine:
                  paged: bool = False,
                  num_blocks: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
-                 tp: int = 1):
+                 tp: int = 1,
+                 prefix_peers: Optional[Sequence[str]] = None,
+                 prefix_fetch_budget: Optional[float] = None,
+                 prefix_fetch_fn: Optional[Callable] = None):
         if num_slots < 1:
             raise ValueError(f'num_slots must be >= 1, got {num_slots}')
         if step_chunk < 1:
@@ -763,6 +781,61 @@ class DecodeEngine:
         self.prefill_chunk = max(0, int(prefill_chunk)) if paged else 0
         self._prompt_tokens_total = 0
         self._prompt_tokens_saved = 0
+        # Cross-replica prefix tier (paged only): peers consulted on a
+        # local radix miss, bounded by the fetch budget — a slow peer
+        # degrades the admission to plain prefill, never stalls it.
+        # prefix_fetch_fn(peer_url, tokens, from_tokens, budget) is the
+        # transport (HTTP by default); tests/benches inject direct
+        # engine-to-engine calls.
+        if prefix_peers is None:
+            raw = os.environ.get(prefix_transfer.PREFIX_PEERS_ENV, '')
+            prefix_peers = [u.strip() for u in raw.split(',')
+                            if u.strip()]
+        self.prefix_peers: List[str] = list(prefix_peers) if paged else []
+        self.prefix_fetch_budget = (
+            prefix_fetch_budget if prefix_fetch_budget is not None
+            else common_utils.env_float(
+                prefix_transfer.FETCH_BUDGET_ENV,
+                prefix_transfer.DEFAULT_FETCH_BUDGET_SECONDS))
+        # Only fetch when at least this many block-aligned tokens stand
+        # to be gained (default: one block — the smallest unit a peer
+        # can ship).
+        self._prefix_fetch_min_tokens = common_utils.env_int(
+            prefix_transfer.FETCH_MIN_TOKENS_ENV, self._block_k)
+        # Unique engine identity: the default transport sends it with
+        # every fetch and /prefix_blocks echoes a self-marker when it
+        # arrives at the engine that minted it — the ONLY reliable
+        # self-detection under a fleet-shared peers list (URL guessing
+        # can't know this replica's external address).
+        import uuid
+        self.instance_id = uuid.uuid4().hex
+        self._prefix_fetch_fn = (
+            prefix_fetch_fn if prefix_fetch_fn is not None
+            else functools.partial(prefix_transfer.http_fetch,
+                                   instance=self.instance_id))
+        # Dead-peer memory: a peer whose fetch failed is skipped until
+        # its backoff expires — one dead/unreachable peer must not cost
+        # every cold admission an engine-loop stall forever. A
+        # successful fetch clears the peer's backoff.
+        self._prefix_fetch_backoff = common_utils.env_float(
+            prefix_transfer.FETCH_BACKOFF_ENV,
+            prefix_transfer.DEFAULT_FETCH_BACKOFF_SECONDS)
+        self._peer_backoff_until: dict = {}
+        # URLs this replica knows refer to ITSELF (the model server
+        # registers its bound addresses): fetching from self would
+        # stall the loop for a budget — the export queue is serviced by
+        # the very thread doing the fetch.
+        self._prefix_self_urls: set = set()
+        self._prefix_fetch_hits = 0
+        self._prefix_fetch_misses = 0
+        self._prefix_fetch_tokens = 0
+        self._prefix_evictions = 0
+        # Prefix-export jobs: peers' /prefix_blocks requests queue here
+        # (any thread) and are serviced by the engine loop at the top of
+        # each step — radix/pool reads are loop-confined, so the HTTP
+        # thread must never touch them directly.
+        self._export_lock = threading.Lock()
+        self._export_jobs: List[dict] = []
         # Speculative-decoding counters (cumulative; survive restarts).
         self._spec_drafted = 0
         self._spec_accepted = 0
@@ -1157,6 +1230,17 @@ class DecodeEngine:
         p = len(request.prompt)
         blocks, path = self._radix.match(request.prompt)
         m_full = len(blocks) * bk
+        if self._should_prefix_fetch(p, m_full, request):
+            if self._prefix_fetch_into_cache(request, blocks, m_full):
+                # The fetched blocks now live in the pool AND the radix
+                # tree: release the stale match and re-match, which
+                # picks up the extended prefix with proper refs/locks —
+                # from here on a remote hit is indistinguishable from a
+                # local one (same COW/reservation/publish invariants).
+                self._allocator.decref(blocks)
+                self._radix.release(path)
+                blocks, path = self._radix.match(request.prompt)
+                m_full = len(blocks) * bk
         # Keep >= 1 suffix token: the first generated token samples from
         # the last prompt position's logits, which only a forward pass
         # produces (a full-prompt hit caches K/V, not logits).
@@ -1166,7 +1250,7 @@ class DecodeEngine:
         need = n_total - first_owned
         short = need - self._allocator.available()
         if short > 0:
-            self._radix.evict(short)
+            self._radix_evict(short)
         cow_dst = cow_src = None
         try:
             if m < m_full:
@@ -1498,6 +1582,346 @@ class DecodeEngine:
             first = int(self._sample_first(last))
         self._deliver_first(slot, req, first)
 
+    # ---------------------------------------- cross-replica prefix tier
+
+    def _radix_evict(self, need: int) -> int:
+        """The ONE gateway to radix LRU eviction: counts freed blocks
+        so locality numbers can be read against cache pressure."""
+        freed = self._radix.evict(need)
+        if freed:
+            self._prefix_evictions += freed
+            self._m.counter(
+                'skytpu_engine_prefix_evictions_total',
+                'Prefix-cache blocks LRU-evicted under pool '
+                'pressure.').inc(freed)
+        return freed
+
+    def _should_prefix_fetch(self, p: int, m_full: int,
+                             request: Request) -> bool:
+        """Consult peers only when a fetch could actually help: peers
+        (or an LB owner hint) exist, and the local radix miss leaves at
+        least the minimum block-aligned gain on the table."""
+        if not self.paged:
+            return False
+        if not self.prefix_peers:
+            # No configured trust set: nothing to fetch from (the LB
+            # hint alone cannot introduce URLs — see
+            # _prefix_fetch_peers).
+            return False
+        aligned = (p // self._block_k) * self._block_k
+        return aligned - m_full >= max(self._prefix_fetch_min_tokens,
+                                       self._block_k)
+
+    def register_self_url(self, url: str) -> None:
+        """Model-server hook: URLs that address THIS replica are never
+        fetched from (a self-fetch stalls the loop for a whole budget —
+        the export queue is serviced by the fetching thread itself)."""
+        self._prefix_self_urls.add(url.rstrip('/'))
+
+    def _prefix_fetch_peers(self, request: Request) -> List[str]:
+        """The configured peer list, minus self and peers in failure
+        backoff. The LB-advertised owner hint only REORDERS the
+        configured set (a matching peer moves to the front) — it can
+        never introduce a new URL, because the hint rides an HTTP
+        header any direct-to-replica client can set, and fetching from
+        (= injecting KV blocks published to every tenant from) an
+        unvetted URL is prompt exfiltration + cache poisoning. The
+        peer list is the trust set."""
+        now = time.perf_counter()
+        peers = []
+        hint = (request.prefix_hint or '').rstrip('/')
+        candidates = sorted(
+            self.prefix_peers,
+            key=lambda u: 0 if u.rstrip('/') == hint else 1)
+        for u in candidates:
+            if (u and u not in peers
+                    and u.rstrip('/') not in self._prefix_self_urls
+                    and self._peer_backoff_until.get(u, 0.0) <= now):
+                peers.append(u)
+        return peers
+
+    def _note_peer_failure(self, peer: str) -> None:
+        self._peer_backoff_until[peer] = (time.perf_counter() +
+                                          self._prefix_fetch_backoff)
+
+    def _prefix_fetch_into_cache(self, request: Request,
+                                 local_blocks: List[int],
+                                 m_full: int) -> bool:
+        """Try to pull the prompt's missing prefix blocks from a peer
+        and install them in the pool + radix tree. Returns True when
+        the tree now holds a longer prefix (caller re-matches). Bounded
+        by the fetch budget; ANY failure — timeout, malformed payload,
+        dtype/shape mismatch, pool exhaustion — degrades to plain
+        prefill with the outcome journaled as ``engine.prefix_fetch``.
+        """
+        bk = self._block_k
+        p = len(request.prompt)
+        aligned = (p // bk) * bk
+        deadline = time.perf_counter() + self.prefix_fetch_budget
+        t0 = time.perf_counter()
+        outcome = 'miss'
+        peers_tried = self._prefix_fetch_peers(request)
+        for peer in peers_tried:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                outcome = 'budget_exhausted'
+                break
+            try:
+                payload = self._prefix_fetch_fn(
+                    peer, request.prompt[:aligned], m_full, remaining)
+            except Exception as e:  # pylint: disable=broad-except
+                # A misbehaving peer/transport must never crash
+                # admission; the outcome is journaled below and the
+                # peer sits out a backoff window.
+                self._note_peer_failure(peer)
+                outcome = 'error'
+                self._journal(journal.EventKind.ENGINE_PREFIX_FETCH,
+                              request, -1, outcome='error', peer=peer,
+                              error=f'{type(e).__name__}: {e}')
+                continue
+            if payload is None:
+                # Transport-level failure (timeout, non-200, garbage
+                # bytes): back the peer off. An honest empty match is
+                # a well-formed payload and lands in the 'empty' branch
+                # below — it does NOT penalize the peer.
+                self._note_peer_failure(peer)
+                continue
+            if payload.get('self'):
+                # The peer answered "I am you" (instance-id echo): this
+                # URL is one of OUR addresses under a fleet-shared
+                # peers list — exclude it permanently, instantly.
+                self.register_self_url(peer)
+                continue
+            try:
+                gained = self._inject_fetched_prefix(
+                    request, peer, payload, local_blocks, m_full)
+            except Exception as e:  # pylint: disable=broad-except
+                # Materialization failure past validation (host OOM on
+                # the padded bucket, XLA resource exhaustion): the
+                # contract is degrade-to-prefill, never crash the step
+                # (the inner handler already returned the allocator
+                # refs).
+                self._note_peer_failure(peer)
+                outcome = 'error'
+                self._journal(journal.EventKind.ENGINE_PREFIX_FETCH,
+                              request, -1, outcome='error', peer=peer,
+                              error=f'{type(e).__name__}: {e}')
+                continue
+            if gained == 'empty':
+                # Peer reachable, just cold: the admission-level
+                # outcome is a MISS even if an earlier peer errored
+                # (those failures were journaled per-peer) — dashboards
+                # reading the result label as peer health must not see
+                # a flaky peer masking a healthy fleet's honest cold.
+                outcome = 'miss'
+                continue
+            if gained is None:
+                # Version-skewed peer (wrong block_k / dtype / shapes):
+                # back it off like a dead one — re-downloading and
+                # discarding full KV payloads every cold admission is
+                # the same per-admission stall class.
+                self._note_peer_failure(peer)
+                outcome = 'mismatch'
+                continue
+            if gained == 'pool_exhausted':
+                outcome = 'pool_exhausted'
+                break
+            self._peer_backoff_until.pop(peer, None)
+            self._prefix_fetch_hits += 1
+            self._prefix_fetch_tokens += gained
+            self._m.counter(
+                'skytpu_engine_prefix_fetches_total',
+                'Cross-replica prefix-block fetch attempts by '
+                'outcome.', labels=('result',)).inc(labels=('hit',))
+            self._journal(journal.EventKind.ENGINE_PREFIX_FETCH,
+                          request, -1, outcome='hit', peer=peer,
+                          tokens_gained=gained,
+                          blocks_gained=gained // bk,
+                          seconds=round(time.perf_counter() - t0, 6))
+            return True
+        self._prefix_fetch_misses += 1
+        self._m.counter(
+            'skytpu_engine_prefix_fetches_total',
+            'Cross-replica prefix-block fetch attempts by outcome.',
+            labels=('result',)).inc(labels=(outcome,))
+        # peers_tried, not a recomputation: the failed peers were just
+        # placed in backoff, and the row must record what was actually
+        # consulted.
+        self._journal(journal.EventKind.ENGINE_PREFIX_FETCH, request,
+                      -1, outcome=outcome, peers=len(peers_tried),
+                      seconds=round(time.perf_counter() - t0, 6))
+        return False
+
+    def _inject_fetched_prefix(self, request: Request, peer: str,
+                               payload: dict, local_blocks: List[int],
+                               m_full: int):
+        """Validate + install one peer payload: allocate pool blocks,
+        scatter the fetched K/V (dtype-exact — int8 values and scale
+        planes transfer verbatim), publish the extended prefix to the
+        radix tree. Returns tokens gained, ``'empty'`` (peer holds
+        nothing past what we have — a miss, not a protocol error),
+        ``'pool_exhausted'``, or None on a validation mismatch."""
+        bk = self._block_k
+        p = len(request.prompt)
+        aligned = (p // bk) * bk
+        matched = int(payload.get('matched_tokens', 0))
+        arrays = payload.get('arrays') or {}
+        if matched <= m_full or not arrays:
+            return 'empty'
+        if (payload.get('block_k') != bk or
+                payload.get('kv_cache_dtype') != self.dcfg.kv_cache_dtype
+                or payload.get('from_tokens') != m_full
+                or matched % bk or matched > aligned):
+            return None
+        if set(arrays) != set(self._cache):
+            return None
+        n_new = (matched - m_full) // bk
+        for name, pool_arr in self._cache.items():
+            want = ((pool_arr.shape[0], n_new) + pool_arr.shape[2:])
+            if tuple(arrays[name].shape) != want:
+                return None
+            # dtype must match EXACTLY: inject_pool_blocks' astype is a
+            # VALUE cast, and bytes decoded under the wrong dtype (a
+            # version-skewed peer) would cast into plausible-looking
+            # garbage K/V instead of failing — silently wrong
+            # generations for every future sharer of the prefix.
+            if arrays[name].dtype != np.dtype(pool_arr.dtype):
+                return None
+        short = n_new - self._allocator.available()
+        if short > 0:
+            self._radix_evict(short)
+        try:
+            new_blocks = self._allocator.alloc(n_new)
+        except PoolExhausted:
+            return 'pool_exhausted'
+        try:
+            # Power-of-two bucket the scatter shape (pad the index row
+            # with the scratch block — its writes are harmless by
+            # design — and the values with zeros): one jit trace per
+            # bucket instead of one per distinct fetched-block count,
+            # and the trace is journaled like every other engine
+            # dispatch shape.
+            bucket = 1
+            while bucket < n_new:
+                bucket *= 2
+            self._note_compile('prefix_inject', blocks=bucket)
+            idx_np = np.full((bucket,), SCRATCH_BLOCK, np.int32)
+            idx_np[:n_new] = new_blocks
+            values = {}
+            for name in self._cache:
+                a = arrays[name]
+                if bucket > n_new:
+                    pad = np.zeros((a.shape[0], bucket - n_new) +
+                                   a.shape[2:], a.dtype)
+                    a = np.concatenate([a, pad], axis=1)
+                values[name] = jnp.asarray(a)
+            self._cache = decode.inject_pool_blocks(
+                self._cache, jnp.asarray(idx_np), values)
+            # Publish [0, matched) to the tree: the already-cached
+            # branch dedupes, the fetched suffix is adopted (tree
+            # takes its refs)...
+            self._radix.insert(request.prompt[:matched],
+                               local_blocks[:m_full // bk] + new_blocks)
+        except Exception:
+            self._allocator.decref(new_blocks)
+            raise
+        # ...then drop OUR alloc refs: the blocks are tree-owned now,
+        # and the caller's re-match takes the request's own refs.
+        self._allocator.decref(new_blocks)
+        self._publish_block_gauges()
+        return matched - m_full
+
+    def _export_prefix_now(self, tokens: Sequence[int],
+                           from_tokens: int = 0) -> Optional[dict]:
+        """LOOP-THREAD ONLY: radix-match ``tokens`` and read the
+        matched pool blocks past ``from_tokens`` off the device. Under
+        a TP mesh the gather assembles the full logical block from
+        every shard (owner-side gather), so the payload is always the
+        unsharded ``[L, n, block_k, ...]`` view. Returns None when
+        nothing past ``from_tokens`` is cached."""
+        if not self.paged:
+            return None
+        bk = self._block_k
+        tokens = [int(t) for t in tokens]
+        blocks, path = self._radix.match(tokens)
+        try:
+            matched = len(blocks) * bk
+            start = from_tokens // bk
+            if matched <= from_tokens or start >= len(blocks):
+                return None
+            send = blocks[start:]
+            # Bucketed gather (padding rows read the scratch block and
+            # are sliced off host-side): one trace per power-of-two
+            # block count, not one per export size.
+            bucket = 1
+            while bucket < len(send):
+                bucket *= 2
+            self._note_compile('prefix_export', blocks=bucket)
+            idx_np = np.full((bucket,), SCRATCH_BLOCK, np.int32)
+            idx_np[:len(send)] = send
+            idx = jnp.asarray(idx_np)
+            # The match's increfs pin these blocks for the read; the
+            # gather output is a fresh buffer, safe to ship after the
+            # refs drop.
+            arrays = {
+                name: np.asarray(
+                    jax.device_get(arr[:, idx]))[:, :len(send)]
+                for name, arr in self._cache.items()}
+            return {
+                'matched_tokens': matched,
+                'from_tokens': start * bk,
+                'block_k': bk,
+                'kv_cache_dtype': self.dcfg.kv_cache_dtype,
+                'arrays': arrays,
+            }
+        finally:
+            if blocks:
+                self._allocator.decref(blocks)
+            self._radix.release(path)
+
+    def export_prefix_blocks(self, tokens: Sequence[int],
+                             from_tokens: int = 0,
+                             timeout: float = 2.0) -> Optional[dict]:
+        """Cross-thread prefix export (the model server's
+        ``/prefix_blocks`` handler): enqueue a job the engine loop
+        services at its next tick and wait bounded. None on timeout or
+        no match — the peer degrades to plain prefill either way."""
+        job = {'tokens': list(tokens), 'from': int(from_tokens),
+               'event': threading.Event(), 'result': None,
+               # Past this the waiter is gone: the loop must not burn
+               # a radix match + device gather on an unread reply.
+               'deadline': time.monotonic() + timeout}
+        with self._export_lock:
+            self._export_jobs.append(job)
+        if job['event'].wait(timeout):
+            return job['result']
+        return None
+
+    def _service_prefix_exports(self) -> None:
+        """Drain queued export jobs (loop thread, top of every step)."""
+        with self._export_lock:
+            if not self._export_jobs:
+                return
+            jobs = self._export_jobs
+            self._export_jobs = []
+        for job in jobs:
+            if job['deadline'] < time.monotonic():
+                # Waiter already timed out: skip the (match + gather)
+                # work — nobody reads the result.
+                job['event'].set()
+                continue
+            try:
+                job['result'] = self._export_prefix_now(job['tokens'],
+                                                        job['from'])
+            except Exception as e:  # pylint: disable=broad-except
+                # Export is best-effort for the PEER; this engine's
+                # loop must not crash over a read that raced an evict.
+                self._journal_raw(journal.EventKind.ENGINE_PREFIX_FETCH,
+                                  {'outcome': 'export_error',
+                                   'error': f'{type(e).__name__}: {e}'})
+                job['result'] = None
+            job['event'].set()
+
     # ------------------------------------------------------------- step
 
     def step(self) -> int:
@@ -1509,6 +1933,11 @@ class DecodeEngine:
         # decode windows for drain/stall tests.
         chaos.maybe_raise('engine_step_raise')
         chaos.maybe_slow_step()
+        # Peer /prefix_blocks exports queue cross-thread and are
+        # serviced here (radix/pool are loop-confined) — before
+        # admission so a just-published prefix is immediately
+        # exportable.
+        self._service_prefix_exports()
         self._admit()
         active = self.active_slots()
         if active == 0:
@@ -1950,6 +2379,27 @@ class DecodeEngine:
             return 0.0
         return self._prompt_tokens_saved / self._prompt_tokens_total
 
+    def cache_stats(self) -> dict:
+        """The ``/slo`` ``cache`` block: prefix-cache locality and
+        pressure counters for one engine — what the LB's FleetSlo
+        aggregates into the fleet hit ratio. Snapshot reads of
+        loop-owned ints (stale-by-one-tick at worst, never torn)."""
+        return {
+            'paged': self.paged,
+            'prefix_hit_ratio': round(self.prefix_hit_ratio(), 4),
+            'prefill_tokens_saved': self._prompt_tokens_saved,
+            'prompt_tokens_total': self._prompt_tokens_total,
+            'prefix_cache_blocks': (self._radix.held_blocks()  # lint: disable=lock-discipline
+                                    if self.paged else 0),
+            'radix_nodes': (self._radix.node_count()  # lint: disable=lock-discipline
+                            if self.paged else 0),
+            'prefix_evictions': self._prefix_evictions,
+            'prefix_fetch_hits': self._prefix_fetch_hits,
+            'prefix_fetch_misses': self._prefix_fetch_misses,
+            'prefix_fetch_tokens': self._prefix_fetch_tokens,
+            'prefix_peers': len(self.prefix_peers),
+        }
+
     def stats(self) -> dict:
         self.flush_journal()
         out = {
@@ -1983,6 +2433,9 @@ class DecodeEngine:
                 'prefill_chunk': self.prefill_chunk,
                 'prefill_chunks': self._prefill_chunks,
                 'chunked_admissions': self._chunked_admissions,
+                'prefix_evictions': self._prefix_evictions,
+                'prefix_fetch_hits': self._prefix_fetch_hits,
+                'prefix_fetch_misses': self._prefix_fetch_misses,
             })
         if self.dcfg.spec_k:
             out.update({
@@ -2014,6 +2467,16 @@ class DecodeEngine:
             'skytpu_engine_prefix_hit_ratio',
             'Cumulative fraction of prompt tokens served from the '
             'prefix cache.').set(self.prefix_hit_ratio())
+        # Cache-pressure context for the locality numbers: how big the
+        # radix tree actually is, in edges and in held blocks.
+        self._m.gauge(
+            'skytpu_engine_radix_nodes',
+            'Edges in the radix prefix tree.').set(
+                self._radix.node_count())
+        self._m.gauge(
+            'skytpu_engine_prefix_cache_blocks',
+            'KV pool blocks held by the radix prefix cache.').set(
+                self._radix.held_blocks())
 
     def _note_compile(self, kind: str, **shape) -> None:
         """Journal ``engine.compile`` ONCE per distinct jitted dispatch

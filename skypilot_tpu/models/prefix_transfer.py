@@ -1,0 +1,165 @@
+"""Cross-replica KV prefix-block transfer: wire format + HTTP fetch.
+
+The serving half of the fleet-wide prefix cache (ROADMAP item 3): each
+replica's radix cache (PR 8) holds the KV blocks of the prompt prefixes
+it has served, and the prefix-affinity LB routes shared-prefix traffic
+to one owner — but rehashes (load spill, drain, failover) still land
+requests on replicas whose pool is cold for that prefix. Instead of
+re-prefilling, the engine pulls the matched blocks from a peer:
+
+* The OWNER side (``serve/model_server.py`` ``POST /prefix_blocks``)
+  radix-matches the posted token prefix on the engine loop thread and
+  returns the matched pool blocks, serialized by :func:`encode_payload`.
+  Reads go through ``jax.device_get`` of the pool gather, which under a
+  tensor-parallel mesh assembles the full (unsharded) block from every
+  shard — the wire format is always the logical
+  ``[L, n_blocks, block_k, Hkv, hd]`` view, so a tp=4 owner can feed a
+  tp=1 peer and vice versa (each side re-shards on injection).
+* The MISS side (``models/engine.py`` ``_maybe_prefix_fetch``) POSTs
+  the block-aligned prompt prefix to the LB-advertised owner (the
+  ``X-Skytpu-Prefix-Owner`` hop header) or the configured
+  ``SKYTPU_PREFIX_PEERS``, bounded by
+  ``SKYTPU_PREFIX_FETCH_BUDGET_SECONDS`` — a slow or dead peer degrades
+  the admission to plain prefill, never stalls it.
+
+The dtype survives exactly: bf16 pools ship bf16 bytes, int8 pools ship
+int8 values plus their fp32 scale planes — which is what makes a
+fetched-block decode bit-identical to the local re-prefill it replaced
+(pinned in tier-1).
+"""
+import base64
+import json
+import time
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+# np.dtype('bfloat16') resolves once ml_dtypes (a jax dependency) has
+# registered its extension dtypes.
+import ml_dtypes  # noqa: F401  pylint: disable=unused-import
+
+# Engine-side knobs (read in models/engine.py; registered in
+# utils/env_registry.py).
+PREFIX_PEERS_ENV = 'SKYTPU_PREFIX_PEERS'
+FETCH_BUDGET_ENV = 'SKYTPU_PREFIX_FETCH_BUDGET_SECONDS'
+DEFAULT_FETCH_BUDGET_SECONDS = 0.5
+FETCH_MIN_TOKENS_ENV = 'SKYTPU_PREFIX_FETCH_MIN_TOKENS'
+# A peer whose fetch failed (timeout/connect error/garbage) is skipped
+# for this long: without the backoff, one dead-but-configured peer
+# costs every eligible cold admission a budget's worth of engine-loop
+# stall, forever.
+FETCH_BACKOFF_ENV = 'SKYTPU_PREFIX_FETCH_BACKOFF_SECONDS'
+DEFAULT_FETCH_BACKOFF_SECONDS = 10.0
+
+
+def encode_array(a: np.ndarray) -> Dict[str, Any]:
+    a = np.ascontiguousarray(a)
+    return {'shape': list(a.shape), 'dtype': str(a.dtype),
+            'data': base64.b64encode(a.tobytes()).decode('ascii')}
+
+
+def decode_array(d: Dict[str, Any]) -> np.ndarray:
+    buf = base64.b64decode(d['data'])
+    return np.frombuffer(buf, dtype=np.dtype(str(d['dtype']))).reshape(
+        [int(s) for s in d['shape']])
+
+
+def empty_payload(from_tokens: int, block_k: int,
+                  kv_cache_dtype: str) -> Dict[str, Any]:
+    """An honest "nothing cached past from_tokens" reply. Transport
+    functions must return THIS (not None) for a reachable-but-cold
+    peer: None means transport failure and puts the peer in the
+    engine's failure backoff."""
+    return {'matched_tokens': int(from_tokens),
+            'from_tokens': int(from_tokens),
+            'block_k': int(block_k),
+            'kv_cache_dtype': kv_cache_dtype,
+            'arrays': {}}
+
+
+def encode_payload(matched_tokens: int, from_tokens: int, block_k: int,
+                   kv_cache_dtype: str,
+                   arrays: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """The ``/prefix_blocks`` response body: the pool arrays covering
+    blocks ``[from_tokens // block_k, matched_tokens // block_k)`` of
+    the posted prefix, each ``[L, n, block_k, ...]``."""
+    return {
+        'matched_tokens': int(matched_tokens),
+        'from_tokens': int(from_tokens),
+        'block_k': int(block_k),
+        'kv_cache_dtype': kv_cache_dtype,
+        'arrays': {name: encode_array(a) for name, a in arrays.items()},
+    }
+
+
+def decode_payload(body: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Inverse of :func:`encode_payload`; None for malformed bodies
+    (a corrupt peer response degrades to plain prefill, it does not
+    crash admission)."""
+    try:
+        out = {
+            'matched_tokens': int(body['matched_tokens']),
+            'from_tokens': int(body['from_tokens']),
+            'block_k': int(body['block_k']),
+            'kv_cache_dtype': str(body['kv_cache_dtype']),
+            'arrays': {str(name): decode_array(d)
+                       for name, d in body['arrays'].items()},
+        }
+    except (KeyError, TypeError, ValueError):
+        return None
+    return out
+
+
+def http_fetch(peer_url: str, tokens: Sequence[int], from_tokens: int,
+               budget_seconds: float,
+               instance: Optional[str] = None
+               ) -> Optional[Dict[str, Any]]:
+    """Default peer transport: ``POST <peer>/prefix_blocks`` with the
+    block-aligned prompt prefix; returns the decoded payload, a
+    ``{'self': True}`` marker (the peer IS the calling engine —
+    instance-id echo), or None on any failure (timeout, non-200,
+    malformed body). The budget bounds the TOTAL stall: requests'
+    scalar timeout applies to connect and read independently, so it is
+    split into a (connect, read) tuple — a stalling peer costs at most
+    ~one budget, then the admission prefills locally."""
+    import requests
+    half = max(budget_seconds / 2, 1e-3)
+    deadline = time.monotonic() + max(budget_seconds, 1e-3)
+    try:
+        resp = requests.post(
+            peer_url.rstrip('/') + '/prefix_blocks',
+            # budget_seconds rides along so the OWNER caps its export
+            # wait too: past the fetcher's timeout nobody reads the
+            # reply, and the owner must not burn engine-loop + encode
+            # time producing it. `instance` lets the owner answer "I
+            # am you" instantly under a fleet-shared peers list.
+            json={'prompt': [int(t) for t in tokens],
+                  'from_tokens': int(from_tokens),
+                  'budget_seconds': float(budget_seconds),
+                  'instance': instance},
+            timeout=(half, half), stream=True)
+    except requests.RequestException:
+        return None
+    try:
+        if resp.status_code != 200:
+            return None
+        # Stream the body under a WALL-CLOCK deadline: requests' read
+        # timeout is between-bytes, so a slow-but-streaming peer could
+        # otherwise hold the (engine-loop-blocking) fetch far past the
+        # budget while each individual read stays under the timeout.
+        chunks = []
+        for chunk in resp.iter_content(64 * 1024):
+            chunks.append(chunk)
+            if time.monotonic() > deadline:
+                return None
+    except requests.RequestException:
+        return None
+    finally:
+        resp.close()
+    try:
+        body = json.loads(b''.join(chunks))
+    except ValueError:
+        return None
+    if isinstance(body, dict) and body.get('self'):
+        return {'self': True}
+    return decode_payload(body)

@@ -7,6 +7,7 @@ fsdp all-gathers/reduce-scatters and tensor-parallel collectives over ICI.
 """
 import dataclasses
 import functools
+import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -214,11 +215,25 @@ def train_loop(model_cfg: llama.LlamaConfig,
     stream it would have unpreempted.
     """
     from skypilot_tpu.models import checkpoint as ckpt_lib
+    from skypilot_tpu.utils import jax_cache
 
     key = jax.random.PRNGKey(0)
     start_step = 0
     state = None
     if checkpoint_dir:
+        if ckpt_lib.list_steps(
+                os.path.abspath(os.path.expanduser(checkpoint_dir))):
+            # This run will RESUME: opt out of the shared persistent
+            # compilation cache BEFORE the restore compiles anything.
+            # Executables compiled against orbax-restored buffers are
+            # not fully distinguished by jax<=0.4.x's cache key from
+            # other processes' entries, and loading a cross-process
+            # entry on the resume path corrupts the heap (free()/
+            # malloc aborts, NaN losses) — the root cause of the
+            # long-seed-broken checkpoint-resume recovery tests,
+            # isolated by per-entry cache bisection. Recovery is rare;
+            # one full re-compile per resume buys soundness.
+            jax_cache.disable_persistent_cache()
         abstract = ckpt_lib.abstract_train_state(key, model_cfg, train_cfg,
                                                  mesh=mesh)
         restored = ckpt_lib.restore_latest(checkpoint_dir, abstract)
@@ -311,6 +326,12 @@ def main() -> None:
                         help='token file (models/data.py format); '
                         'default: deterministic synthetic stream')
     args = parser.parse_args()
+    # Preemption-safe compile-cache writes BEFORE the first dispatch:
+    # this process is exactly the one spot teardown kills mid-compile,
+    # and jax<=0.4.x's non-atomic cache write would poison the shared
+    # cache for every later resume (utils/jax_cache.py).
+    from skypilot_tpu.utils import jax_cache
+    jax_cache.harden_compilation_cache()
     # Multi-host gangs: the runtime injects JAX_COORDINATOR_ADDRESS /
     # JAX_NUM_PROCESSES / JAX_PROCESS_ID (gang_run.build_rank_envs).
     # jax only auto-reads the coordinator address from env — process

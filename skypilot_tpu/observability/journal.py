@@ -140,6 +140,18 @@ class EventKind(enum.Enum):
     # gauges), so "what is eating this replica's HBM" is answerable
     # without a device debugger.
     ENGINE_HBM = 'engine.hbm'
+    # Prefix-aware routing (serve/load_balancer.py): one event per
+    # digest-keyed routing decision — the consistent-hash owner, and
+    # whether the request landed on it (affinity hit) or was rehashed
+    # away (excluded replica / load bound / saturated fleet) — nested
+    # under the request's lb.proxy span.
+    LB_ROUTE = 'lb.route'
+    # Cross-replica prefix cache tier (models/engine.py): an admission
+    # that radix-missed locally and consulted a peer (the LB-advertised
+    # owner or SKYTPU_PREFIX_PEERS) journals the outcome — blocks
+    # fetched and injected, miss, dtype/shape mismatch, or budget
+    # exhaustion degrading to plain prefill.
+    ENGINE_PREFIX_FETCH = 'engine.prefix_fetch'
 
 
 KINDS = frozenset(k.value for k in EventKind)

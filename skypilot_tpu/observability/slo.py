@@ -99,6 +99,23 @@ def replica_row(body: Dict[str, Any]) -> Dict[str, Any]:
                 'p95', 0.0),
             'last_step_age_seconds': steps.get('last_step_age_seconds'),
         }
+    cache = body.get('cache') or {}
+    if cache:
+        # Prefix-cache locality: the raw token counts ride along so the
+        # fleet row can be the TRUE token-weighted ratio, not a mean of
+        # per-replica ratios.
+        row['cache'] = {
+            'prefix_hit_ratio': float(
+                cache.get('prefix_hit_ratio', 0.0) or 0.0),
+            'prefill_tokens_saved': int(
+                cache.get('prefill_tokens_saved', 0) or 0),
+            'prompt_tokens_total': int(
+                cache.get('prompt_tokens_total', 0) or 0),
+            'prefix_fetch_hits': int(
+                cache.get('prefix_fetch_hits', 0) or 0),
+            'prefix_evictions': int(
+                cache.get('prefix_evictions', 0) or 0),
+        }
     return row
 
 
@@ -122,6 +139,23 @@ def fleet_rollup(snapshots: Dict[str, Dict[str, Any]],
             stat: (round(sum(p[stat] * w for p, w in weights) / total_w,
                          6) if total_w else 0.0)
             for stat in ('p50', 'p95')}
+    # Fleet prefix locality: EXACT token-weighted ratio (sum of saved
+    # over sum of admitted prompt tokens across replicas) — the number
+    # prefix-affinity routing exists to move.
+    cache_rows = [r['cache'] for r in replicas.values() if 'cache' in r]
+    if cache_rows:
+        saved = sum(c['prefill_tokens_saved'] for c in cache_rows)
+        total_tokens = sum(c['prompt_tokens_total'] for c in cache_rows)
+        fleet['cache'] = {
+            'prefix_hit_ratio': (round(saved / total_tokens, 6)
+                                 if total_tokens else 0.0),
+            'prefill_tokens_saved': saved,
+            'prompt_tokens_total': total_tokens,
+            'prefix_fetch_hits': sum(c['prefix_fetch_hits']
+                                     for c in cache_rows),
+            'prefix_evictions': sum(c['prefix_evictions']
+                                    for c in cache_rows),
+        }
 
     factor = common_utils.env_float(STRAGGLER_FACTOR_ENV,
                                     DEFAULT_STRAGGLER_FACTOR)
@@ -238,6 +272,12 @@ class FleetSlo:
             'Straggler flag per replica (TTFT p95 deviating from the '
             'fleet median past the threshold).',
             labels=('replica',))
+        prefix_g = m.gauge(
+            'skytpu_fleet_prefix_hit_ratio',
+            'Prefix-cache hit ratio per replica (replica="fleet" = the '
+            'token-weighted fleet-wide ratio — the locality number '
+            'prefix-affinity routing moves).',
+            labels=('replica',))
         rows = dict(rollup['replicas'])
         rows[FLEET_KEY] = rollup[FLEET_KEY]
         for url, row in rows.items():
@@ -249,6 +289,9 @@ class FleetSlo:
             if url != FLEET_KEY:
                 straggler_g.set(1.0 if row.get('straggler') else 0.0,
                                 labels=(url,))
+            if 'cache' in row:
+                prefix_g.set(row['cache']['prefix_hit_ratio'],
+                             labels=(url,))
         with self._lock:
             departed = self._published - set(rows)
             self._published = set(rows)
@@ -257,6 +300,7 @@ class FleetSlo:
                 for stat in ('p50', 'p95'):
                     gauge.remove(labels=(url, stat))
             straggler_g.remove(labels=(url,))
+            prefix_g.remove(labels=(url,))
 
     def _journal_transitions(self, rollup: Dict[str, Any]) -> None:
         """``replica.straggler`` on flag transitions only (read paths

@@ -44,6 +44,11 @@ SPAN_ID_ENV = 'SKYTPU_SPAN_ID'
 REQUEST_ID_HEADER = 'X-Request-Id'
 TRACE_ID_HEADER = 'X-Skytpu-Trace-Id'
 SPAN_ID_HEADER = 'X-Skytpu-Span-Id'
+# Prefix-affinity routing (serve/load_balancer.py): set when the LB
+# rehashed a digest-keyed request AWAY from its primary consistent-hash
+# owner — the replica's engine tries that owner first when its own
+# radix cache misses (cross-replica prefix fetch).
+PREFIX_OWNER_HEADER = 'X-Skytpu-Prefix-Owner'
 
 _trace_id: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
     'skytpu_trace_id', default=None)

@@ -32,11 +32,23 @@ Fleet observability (two planes, both LB-side):
 * **Fleet SLO rollup.** On the ``SKYTPU_FLEET_SLO_INTERVAL`` cadence
   the LB pulls each ready replica's ``/slo`` into
   ``observability/slo.FleetSlo``: per-replica + fleet-wide
-  ``skytpu_fleet_*`` latency gauges, straggler detection against the
+  ``skytpu_fleet_*`` latency gauges (incl. the token-weighted
+  ``skytpu_fleet_prefix_hit_ratio``), straggler detection against the
   fleet median (journaled as ``replica.straggler`` and fed to the
   circuit breaker as a soft signal), and a fleet ``GET /slo`` endpoint
   served by the LB itself (replica-local ``/slo`` stays reachable on
   the replica's own port).
+
+Prefix-aware routing: with the ``prefix_affinity`` policy the proxy
+digests each POST body's prompt (block-aligned prefix) BEFORE
+selection and routes by bounded-load consistent hashing, so
+shared-prefix traffic sticks to the replica whose radix cache holds
+its blocks; every selection and failover hop goes through ONE
+``_select_replica`` (policy-side exclusion of tried replicas), the
+decision evidence is journaled as ``lb.route``, and a request rehashed
+off its primary owner carries that owner in the
+``X-Skytpu-Prefix-Owner`` hop header — the replica engine's
+cross-replica prefix-fetch hint (docs/serving.md).
 """
 import argparse
 import asyncio
@@ -78,6 +90,53 @@ EJECT_PROBE_INTERVAL_ENV = 'SKYTPU_LB_EJECT_PROBE_INTERVAL'
 DEFAULT_EJECT_PROBE_INTERVAL = 1.0
 _EJECT_BACKOFF_MAX_SECONDS = 120.0
 
+# Prefix-affinity owner advertisement: when the affinity policy routes
+# a digest AWAY from its primary consistent-hash owner (load spill,
+# failover), this header tells the serving replica WHICH peer most
+# likely holds the prefix's KV blocks — the engine's cross-replica
+# prefix fetch tries it first (models/engine.py).
+PREFIX_OWNER_HEADER = trace_lib.PREFIX_OWNER_HEADER
+# Bodies past this size skip digest extraction (the JSON parse would
+# tax the proxy hot path; such prompts route load-based instead).
+_DIGEST_BODY_CAP = 4 * 1024 * 1024
+# Bodies past THIS size digest in the executor: a multi-hundred-KB
+# json.loads on the asyncio loop would add head-of-line jitter to
+# every token stream the LB is concurrently proxying.
+_DIGEST_INLINE_CAP = 16 * 1024
+
+
+def _prompt_prefix_digest(body: bytes) -> Optional[str]:
+    """The routing digest of a proxied /generate body: token-id lists
+    digest as ints, demo-codec text as its UTF-8 bytes (byte identity
+    implies token identity under the model server's byte-level codec).
+    None for non-JSON / prompt-less / oversized bodies — those route
+    load-based."""
+    if not body or len(body) > _DIGEST_BODY_CAP:
+        return None
+    try:
+        payload = json.loads(body)
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(payload, dict):
+        return None
+    prompt = payload.get('prompt')
+    if isinstance(prompt, list):
+        try:
+            # Raw ids, deliberately WITHOUT the model server's
+            # `% vocab` normalization: the LB is model-agnostic and
+            # does not know vocab. Clients sending out-of-vocab ids
+            # digest distinctly from their normalized twins — a
+            # locality loss only (the replicas still share blocks),
+            # never a correctness issue.
+            tokens = [int(t) for t in prompt]
+        except (TypeError, ValueError):
+            return None
+    elif isinstance(payload.get('text'), str):
+        tokens = list(payload['text'].encode('utf-8'))
+    else:
+        return None
+    return lb_policies.prefix_digest(tokens)
+
 
 def _observe_request(replica: str, code, t0: float) -> None:
     """Per-replica request count + latency (resolved at call time so a
@@ -106,6 +165,12 @@ _HOP_HEADERS = {
     # 'Content-Encoding: gzip' over an already-inflated body is garbage
     # to the client.
     'content-encoding', 'accept-encoding',
+    # LB-minted only: a client-supplied prefix-owner hint must never
+    # pass through (the replica engine would POST prompt tokens to —
+    # and inject KV blocks from — an attacker-chosen URL). This filter
+    # compares lower-cased, so casing tricks don't smuggle it; the LB
+    # re-adds its own canonical header per attempt below.
+    'x-skytpu-prefix-owner',
 }
 
 
@@ -587,11 +652,45 @@ class LoadBalancer:
             # fsync, and in-flight proxy streams must not pause for it.
             await loop.run_in_executor(None, self.flush_journal)
 
+    def _select_replica(self, digest: Optional[str], req_id: str,
+                        tried) -> tuple:
+        """ONE selection through the policy: the candidate set (ready
+        minus breaker-ejected) is refreshed and already-tried replicas
+        are excluded inside the policy via the RouteContext — first
+        selection and every failover hop share this path instead of
+        each filtering candidates by hand. Returns (url, route_meta)."""
+        self.policy.set_ready_replicas(self._candidate_urls())
+        ctx = lb_policies.RouteContext(prefix_digest=digest,
+                                       request_id=req_id,
+                                       exclude=frozenset(tried))
+        return self.policy.select_replica(ctx), ctx.meta
+
+    def _journal_route(self, lb_trace: str, lb_span: str, replica: str,
+                       meta: dict) -> None:
+        """``lb.route``: one event per digest-keyed routing decision
+        (affinity hit/rehash evidence), nested under the request's
+        lb.proxy span so `skytpu trace` shows WHY a request landed
+        where it did."""
+        if not meta:
+            return
+        self._journal_trace_row(journal.EventKind.LB_ROUTE,
+                                {'replica': replica, **meta},
+                                lb_trace, lb_span)
+
     async def _proxy(self, request: web.Request, t_start: float,
                      req_id: str, lb_trace: str,
                      lb_span: str) -> web.StreamResponse:
-        self.policy.set_ready_replicas(self._candidate_urls())
-        url = self.policy.select_replica()
+        # The body is read BEFORE selection: prefix-affinity policies
+        # route on a digest of the prompt's block-aligned prefix.
+        body = await request.read()
+        digest = None
+        if self.policy.wants_prefix_digest and request.method == 'POST':
+            if len(body) > _DIGEST_INLINE_CAP:
+                digest = await asyncio.get_running_loop(
+                ).run_in_executor(None, _prompt_prefix_digest, body)
+            else:
+                digest = _prompt_prefix_digest(body)
+        url, route_meta = self._select_replica(digest, req_id, ())
         if url is None and self._controller_url is not None:
             # Empty ready set: sync on demand before 503ing — bounds
             # first-request latency after startup or a replica-set flip
@@ -600,8 +699,8 @@ class LoadBalancer:
             # sync-visible race.
             for _ in range(2):
                 await self._sync_once()
-                self.policy.set_ready_replicas(self._candidate_urls())
-                url = self.policy.select_replica()
+                url, route_meta = self._select_replica(digest, req_id,
+                                                       ())
                 if url is not None:
                     break
                 await asyncio.sleep(0.2)
@@ -612,7 +711,6 @@ class LoadBalancer:
                 text='No ready replicas. Use `sky serve status` to check '
                      'the service.',
                 headers={trace_lib.REQUEST_ID_HEADER: req_id})
-        body = await request.read()
         headers = {k: v for k, v in request.headers.items()
                    if k.lower() not in _HOP_HEADERS}
         # Hop propagation: the replica sees the same request id (it
@@ -635,6 +733,7 @@ class LoadBalancer:
             current = url
             tried.add(current)
             ready = self._ready_urls()
+            self._journal_route(lb_trace, lb_span, current, route_meta)
             self._journal_hop(lb_trace, lb_span, {
                 'phase': 'select', 'attempt': attempt + 1,
                 'replica': current,
@@ -646,6 +745,18 @@ class LoadBalancer:
                 # the first hop's number).
                 'queue_seconds': round(
                     time.perf_counter() - t_start, 6)})
+            # Owner advertisement: a digest routed off its primary
+            # owner tells the replica where the prefix's KV blocks
+            # likely live (the engine's peer-fetch hint). Never
+            # advertise a replica this request already FAILED on — a
+            # dead primary would make the engine burn a fetch budget
+            # on exactly the host that just didn't answer.
+            primary = route_meta.get('primary')
+            if (primary and primary != current and primary not in tried
+                    and not route_meta.get('affinity_hit', True)):
+                headers[PREFIX_OWNER_HEADER] = primary
+            else:
+                headers.pop(PREFIX_OWNER_HEADER, None)
             target = (current.rstrip('/') + '/' +
                       request.match_info['tail'])
             if request.query_string:
@@ -675,13 +786,13 @@ class LoadBalancer:
                         # would return.
                         if (resp.status in (502, 503) and
                                 attempt + 1 < attempts):
-                            failover = [u for u in self._candidate_urls()
-                                        if u not in tried]
-                            if failover:
+                            nxt, nxt_meta = self._select_replica(
+                                digest, req_id, tried)
+                            if nxt is not None:
                                 last_err = RuntimeError(
                                     f'replica answered {resp.status} '
                                     'before any body bytes')
-                                url = failover[0]
+                                url, route_meta = nxt, nxt_meta
                                 self._journal_hop(lb_trace, lb_span, {
                                     'phase': 'failover',
                                     'attempt': attempt + 1,
@@ -731,12 +842,11 @@ class LoadBalancer:
                 last_err = e
                 if self._controller_url is not None:
                     await self._sync_once()
-                # Pick a DIFFERENT replica from a local candidate list —
-                # rewriting the shared policy's ready set here would
-                # reset its in-flight accounting mid-traffic.
-                candidates = [u for u in self._candidate_urls()
-                              if u not in tried]
-                url = candidates[0] if candidates else None
+                # Re-select through the policy with this replica
+                # excluded (in-flight accounting survives: policies
+                # preserve counts across unchanged/shrunk ready sets).
+                url, route_meta = self._select_replica(digest, req_id,
+                                                       tried)
                 self._journal_hop(lb_trace, lb_span, {
                     'phase': 'failover', 'attempt': attempt + 1,
                     'replica': current, 'kind': type(e).__name__,

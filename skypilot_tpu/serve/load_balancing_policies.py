@@ -1,17 +1,187 @@
 """Load-balancing policies (parity: sky/serve/load_balancing_policies.py).
 
 ``round_robin`` cycles ready replicas; ``least_load`` (default) picks the
-replica with the fewest in-flight requests proxied through this LB.
+replica with the fewest in-flight requests proxied through this LB;
+``random`` picks uniformly (the routing bench's control arm);
+``prefix_affinity`` routes requests sharing a prompt prefix to the same
+replica via bounded-load consistent hashing, so the engines' radix
+prefix caches (PR 8) see fleet-local traffic instead of 1/N of it.
+
+Every policy receives one :class:`RouteContext` per selection — the LB
+builds it once per request (prefix digest, tried-replica exclusions)
+and both the first selection and every failover hop go through
+``select_replica(context)``, so the candidate-filter logic lives HERE
+instead of being split between the proxy loop and the policies.
+
+Prefix affinity
+---------------
+The routing key is a **block-aligned prompt-prefix digest**
+(:func:`prefix_digest`): the first ``SKYTPU_LB_AFFINITY_PREFIX_TOKENS``
+tokens of the prompt, truncated DOWN to a whole number of
+``SKYTPU_LB_AFFINITY_BLOCK_TOKENS``-token blocks, hashed. Block
+alignment matters because the engine's radix cache shares whole blocks
+only — two prompts that diverge inside a block share nothing, while two
+prompts sharing k whole blocks digest identically here exactly when
+they can share k blocks there.
+
+Placement is **consistent hashing with bounded loads** (the
+Mirrokni/Thorup/Zadimoghaddam scheme CDNs use): each replica owns
+``SKYTPU_LB_AFFINITY_VNODES`` points on a hash ring; a digest walks the
+ring from its own hash and takes the first replica whose in-flight
+count is within ``SKYTPU_LB_AFFINITY_LOAD_FACTOR`` × the fleet mean —
+affinity until a replica is genuinely hot, then spill to the next ring
+neighbor instead of queueing behind the hotspot. Consistent hashing
+gives the churn bound the serve plane needs: draining/ejecting one
+replica re-maps ONLY that replica's keys (every other digest keeps its
+owner), so a rolling update never cold-starts the whole fleet's prefix
+caches.
 """
+import bisect
+import dataclasses
+import hashlib
 import itertools
+import math
+import random as random_lib
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, FrozenSet, List, Optional, Sequence
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.utils import common_utils
+
+# Block alignment of the routing digest: prompts are truncated DOWN to
+# whole multiples of this many tokens before hashing, mirroring the
+# engine's block_k sharing granularity (default = the kernel KV block).
+AFFINITY_BLOCK_TOKENS_ENV = 'SKYTPU_LB_AFFINITY_BLOCK_TOKENS'
+DEFAULT_AFFINITY_BLOCK_TOKENS = 128
+# Cap on how much of the prompt feeds the digest: prefixes longer than
+# this hash identically (they share at least this much), keeping the
+# LB's per-request hashing O(1) in prompt length.
+AFFINITY_PREFIX_TOKENS_ENV = 'SKYTPU_LB_AFFINITY_PREFIX_TOKENS'
+DEFAULT_AFFINITY_PREFIX_TOKENS = 512
+# Bounded-load factor c: a replica is "full" for affinity purposes when
+# its in-flight count exceeds c × ceil(total_in_flight / replicas);
+# full owners spill to the next ring neighbor (locality degrades to
+# load balance, never to a hotspot queue).
+AFFINITY_LOAD_FACTOR_ENV = 'SKYTPU_LB_AFFINITY_LOAD_FACTOR'
+DEFAULT_AFFINITY_LOAD_FACTOR = 1.25
+# Virtual nodes per replica on the hash ring (more = smoother key
+# distribution, linearly more ring memory).
+AFFINITY_VNODES_ENV = 'SKYTPU_LB_AFFINITY_VNODES'
+DEFAULT_AFFINITY_VNODES = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class RouteContext:
+    """Per-request routing context threaded through ``select_replica``.
+
+    ``exclude`` carries the replicas already tried this request (the
+    LB's failover path), so the candidate filtering happens inside the
+    policy instead of in an ad-hoc list comprehension per call site.
+    ``meta`` is a scratch dict the policy may fill with its decision
+    evidence (digest, primary owner, hit/rehash) — the LB journals it
+    as the ``lb.route`` event.
+    """
+    prefix_digest: Optional[str] = None
+    tenant: str = 'default'
+    request_id: Optional[str] = None
+    exclude: FrozenSet[str] = frozenset()
+    meta: Dict = dataclasses.field(default_factory=dict)
+
+
+def prefix_digest(tokens: Sequence[int],
+                  block_tokens: Optional[int] = None,
+                  max_tokens: Optional[int] = None) -> Optional[str]:
+    """Block-aligned prompt-prefix digest: hash of the first
+    ``max_tokens`` tokens truncated DOWN to whole ``block_tokens``
+    blocks. ``None`` when the prompt is shorter than one block —
+    nothing shareable, so affinity has nothing to key on and the
+    policy falls back to load-based selection."""
+    if block_tokens is None:
+        block_tokens = max(1, common_utils.env_int(
+            AFFINITY_BLOCK_TOKENS_ENV, DEFAULT_AFFINITY_BLOCK_TOKENS))
+    if max_tokens is None:
+        max_tokens = common_utils.env_int(
+            AFFINITY_PREFIX_TOKENS_ENV, DEFAULT_AFFINITY_PREFIX_TOKENS)
+    n = (min(len(tokens), max(max_tokens, block_tokens))
+         // block_tokens) * block_tokens
+    if n <= 0:
+        return None
+    h = hashlib.sha1()
+    for t in tokens[:n]:
+        # Decimal text, not to_bytes: a token id outside int32 (clients
+        # send arbitrary ints; the replica normalizes mod vocab) must
+        # digest, not raise OverflowError into a proxy 500.
+        h.update(b'%d,' % int(t))
+    return h.hexdigest()[:16]
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes.
+
+    Deterministic: placement depends only on the member set (and the
+    vnode count), never on join order — every LB replica computes the
+    same owner for the same fleet. Removing one member moves ONLY that
+    member's arcs to their ring successors; every other key keeps its
+    owner (the churn bound the drain/eject paths rely on).
+    """
+
+    def __init__(self, vnodes: Optional[int] = None):
+        self.vnodes = (vnodes if vnodes is not None
+                       else max(1, common_utils.env_int(
+                           AFFINITY_VNODES_ENV, DEFAULT_AFFINITY_VNODES)))
+        self._hashes: List[int] = []
+        self._owners: List[str] = []
+        self._members: List[str] = []
+
+    @staticmethod
+    def _hash(key: str) -> int:
+        return int.from_bytes(
+            hashlib.sha1(key.encode('utf-8')).digest()[:8], 'big')
+
+    def set_members(self, members: Sequence[str]) -> None:
+        members = sorted(set(members))
+        if members == self._members:
+            return
+        self._members = members
+        points = []
+        for url in members:
+            for i in range(self.vnodes):
+                points.append((self._hash(f'{url}#{i}'), url))
+        points.sort()
+        self._hashes = [h for h, _ in points]
+        self._owners = [u for _, u in points]
+
+    def members(self) -> List[str]:
+        return list(self._members)
+
+    def owner(self, key: str) -> Optional[str]:
+        """The key's primary owner (first replica clockwise)."""
+        for url in self.ordered_owners(key):
+            return url
+        return None
+
+    def ordered_owners(self, key: str):
+        """Distinct members in ring order starting at the key's hash —
+        the preference list bounded-load selection walks."""
+        if not self._hashes:
+            return
+        start = bisect.bisect_left(self._hashes, self._hash(key))
+        seen = set()
+        n = len(self._owners)
+        for i in range(n):
+            url = self._owners[(start + i) % n]
+            if url not in seen:
+                seen.add(url)
+                yield url
 
 
 class LoadBalancingPolicy:
     """Tracks the ready-replica set and picks a target per request."""
+
+    # The LB computes the prompt digest only for policies that use it
+    # (parsing every proxied body would tax the non-affinity paths).
+    wants_prefix_digest = False
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -26,7 +196,15 @@ class LoadBalancingPolicy:
     def _on_replicas_changed(self, urls: List[str]) -> None:
         pass
 
-    def select_replica(self) -> Optional[str]:
+    def _eligible(self, context: Optional[RouteContext]) -> List[str]:
+        """Ready minus the request's already-tried replicas — the ONE
+        copy of the candidate filter (callers must hold the lock)."""
+        if context is None or not context.exclude:
+            return self.ready_urls
+        return [u for u in self.ready_urls if u not in context.exclude]
+
+    def select_replica(self, context: Optional[RouteContext] = None
+                       ) -> Optional[str]:
         raise NotImplementedError
 
     def request_started(self, url: str) -> None:
@@ -55,11 +233,36 @@ class RoundRobinPolicy(LoadBalancingPolicy):
     def _on_replicas_changed(self, urls: List[str]) -> None:
         self._cycle = itertools.cycle(urls)
 
-    def select_replica(self) -> Optional[str]:
+    def select_replica(self, context: Optional[RouteContext] = None
+                       ) -> Optional[str]:
         with self._lock:
-            if not self.ready_urls:
+            eligible = self._eligible(context)
+            if not eligible:
                 return None
-            return next(self._cycle)
+            allowed = set(eligible)
+            for _ in range(len(self.ready_urls)):
+                url = next(self._cycle)
+                if url in allowed:
+                    return url
+            return eligible[0]
+
+
+class RandomPolicy(LoadBalancingPolicy):
+    """Uniform random pick — the locality-blind control arm the route
+    bench compares ``prefix_affinity`` against. Seeded at construction
+    so a fixed request sequence routes deterministically in tests."""
+
+    def __init__(self, seed: int = 0):
+        super().__init__()
+        self._rng = random_lib.Random(seed)
+
+    def select_replica(self, context: Optional[RouteContext] = None
+                       ) -> Optional[str]:
+        with self._lock:
+            eligible = self._eligible(context)
+            if not eligible:
+                return None
+            return self._rng.choice(eligible)
 
 
 class LeastLoadPolicy(LoadBalancingPolicy):
@@ -72,11 +275,13 @@ class LeastLoadPolicy(LoadBalancingPolicy):
     def _on_replicas_changed(self, urls: List[str]) -> None:
         self._inflight = {u: self._inflight.get(u, 0) for u in urls}
 
-    def select_replica(self) -> Optional[str]:
+    def select_replica(self, context: Optional[RouteContext] = None
+                       ) -> Optional[str]:
         with self._lock:
-            if not self.ready_urls:
+            eligible = self._eligible(context)
+            if not eligible:
                 return None
-            return min(self.ready_urls,
+            return min(eligible,
                        key=lambda u: self._inflight.get(u, 0))
 
     def request_started(self, url: str) -> None:
@@ -88,7 +293,101 @@ class LeastLoadPolicy(LoadBalancingPolicy):
             self._inflight[url] = max(0, self._inflight.get(url, 1) - 1)
 
 
+class PrefixAffinityPolicy(LeastLoadPolicy):
+    """Bounded-load consistent hashing over the prompt-prefix digest.
+
+    Requests with a digest walk the hash ring from their key and take
+    the first eligible replica whose in-flight count is within the
+    load bound; requests without one (no prompt, sub-block prompt,
+    non-generate endpoints) fall back to least-load. The selection
+    evidence (digest, primary owner, hit vs rehash and why) lands in
+    ``context.meta`` for the LB's ``lb.route`` journal event, and the
+    hit/rehash split is counted as
+    ``skytpu_lb_affinity_{hits,rehash}_total``.
+    """
+
+    wants_prefix_digest = True
+
+    def __init__(self, vnodes: Optional[int] = None,
+                 load_factor: Optional[float] = None):
+        super().__init__()
+        self.ring = HashRing(vnodes=vnodes)
+        self.load_factor = (load_factor if load_factor is not None
+                            else common_utils.env_float(
+                                AFFINITY_LOAD_FACTOR_ENV,
+                                DEFAULT_AFFINITY_LOAD_FACTOR))
+
+    def _on_replicas_changed(self, urls: List[str]) -> None:
+        super()._on_replicas_changed(urls)
+        self.ring.set_members(urls)
+
+    def _load_bound(self, n_replicas: int) -> int:
+        """Max in-flight a replica may hold and still take affinity
+        traffic: ceil(c × ceil((total+1)/N)), floored at 1 so an idle
+        fleet always accepts. Ceil, not int(): truncation would erase
+        the c-factor headroom exactly when a replica sits at the mean
+        (e.g. mean 3, c=1.25 → bound 4, not 3)."""
+        total = sum(self._inflight.values()) + 1
+        mean = -(-total // max(n_replicas, 1))
+        return max(1, math.ceil(self.load_factor * mean))
+
+    def select_replica(self, context: Optional[RouteContext] = None
+                       ) -> Optional[str]:
+        digest = context.prefix_digest if context is not None else None
+        if digest is None:
+            return super().select_replica(context)
+        with self._lock:
+            eligible = self._eligible(context)
+            if not eligible:
+                return None
+            allowed = set(eligible)
+            bound = self._load_bound(len(eligible))
+            primary = self.ring.owner(digest)
+            selected = None
+            rehash_reason = None
+            for url in self.ring.ordered_owners(digest):
+                if url not in allowed:
+                    # Tried/ejected-this-request: keep walking the ring
+                    # (the next arc owner is the stable secondary).
+                    rehash_reason = rehash_reason or 'excluded'
+                    continue
+                if self._inflight.get(url, 0) >= bound:
+                    rehash_reason = rehash_reason or 'load'
+                    continue
+                selected = url
+                break
+            if selected is None:
+                # Every owner at/over the bound: least-load beats
+                # queueing behind the ring order.
+                selected = min(eligible,
+                               key=lambda u: self._inflight.get(u, 0))
+                rehash_reason = rehash_reason or 'saturated'
+            hit = selected == primary
+        if hit:
+            metrics_lib.counter(
+                'skytpu_lb_affinity_hits_total',
+                'Digest-keyed selections routed to the digest\'s '
+                'primary consistent-hash owner.').inc()
+        else:
+            metrics_lib.counter(
+                'skytpu_lb_affinity_rehash_total',
+                'Digest-keyed selections routed AWAY from the primary '
+                'owner (excluded, over the load bound, or saturated '
+                'fleet).').inc()
+        if context is not None:
+            context.meta.update({
+                'digest': digest,
+                'primary': primary,
+                'affinity_hit': hit,
+            })
+            if not hit:
+                context.meta['rehash'] = rehash_reason
+        return selected
+
+
 _POLICIES = {
     'round_robin': RoundRobinPolicy,
     'least_load': LeastLoadPolicy,
+    'random': RandomPolicy,
+    'prefix_affinity': PrefixAffinityPolicy,
 }

@@ -75,6 +75,7 @@ backlogs its own queue.
 """
 import argparse
 import asyncio
+import functools
 import json
 import os
 import signal
@@ -88,6 +89,7 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu.models import decode
 from skypilot_tpu.models import engine as engine_lib
 from skypilot_tpu.models import llama
+from skypilot_tpu.models import prefix_transfer
 from skypilot_tpu.observability import exporter as exporter_lib
 from skypilot_tpu.observability import journal
 from skypilot_tpu.observability import metrics as metrics_lib
@@ -346,6 +348,7 @@ class ModelServer:
     async def _setup(self) -> None:
         app = web.Application()
         app.router.add_post('/generate', self._handle_generate)
+        app.router.add_post('/prefix_blocks', self._handle_prefix_blocks)
         app.router.add_post('/drain', self._handle_drain)
         app.router.add_get('/healthz', self._handle_healthz)
         app.router.add_get('/metrics', self._handle_metrics)
@@ -358,6 +361,14 @@ class ModelServer:
         await site.start()
         if self.port == 0:
             self.port = site._server.sockets[0].getsockname()[1]  # pylint: disable=protected-access
+        # Self-fetch guard: URLs that obviously address this replica
+        # are excluded from the prefix-fetch peer list (a self-fetch
+        # would stall the engine loop for a whole budget — the export
+        # queue is serviced by the fetching thread itself). Exotic
+        # aliases slip through; the per-peer failure backoff bounds
+        # those to one stall per window.
+        for host in {self.host, '127.0.0.1', 'localhost'}:
+            self.engine.register_self_url(f'http://{host}:{self.port}')
         logger.info(f'Model server listening on :{self.port} '
                     f'({self.engine.num_slots} slots, '
                     f'max_len {self.engine.dcfg.max_len}).')
@@ -471,7 +482,14 @@ class ModelServer:
         req = engine_lib.Request(tokens, max_new, on_token=on_token,
                                  tenant=str(tenant),
                                  trace_id=trace_id,
-                                 span_id=span_id)
+                                 span_id=span_id,
+                                 # The LB's owner advertisement: when
+                                 # affinity routing rehashed this
+                                 # request off its primary owner, the
+                                 # engine's peer fetch tries that owner
+                                 # first on a local radix miss.
+                                 prefix_hint=request.headers.get(
+                                     trace_lib.PREFIX_OWNER_HEADER))
         # Terminal sentinel: a request the engine rejects (or fails at
         # admission) finishes WITHOUT ever emitting a token — without
         # this, the handler would sit on the empty queue until the
@@ -657,6 +675,9 @@ class ModelServer:
         # Speculative decoding + chunked prefill: acceptance ratio and
         # chunk counters next to the latency percentiles they move.
         body['spec'] = self.engine.spec_stats()
+        # Prefix-cache locality + pressure: what the LB's fleet SLO
+        # poll aggregates into skytpu_fleet_prefix_hit_ratio.
+        body['cache'] = self.engine.cache_stats()
         # Engine-step snapshot (aggregates only, no ring rows): the
         # fleet SLO aggregator pulls /slo on the LB's probe cadence and
         # needs the step-time/stall/heartbeat signal beside the request
@@ -667,6 +688,68 @@ class ModelServer:
         steps.pop('recent', None)
         body['steps'] = steps
         return web.json_response(body)
+
+    async def _handle_prefix_blocks(self, request: web.Request
+                                    ) -> web.Response:
+        """Cross-replica prefix tier, owner side: a peer replica whose
+        radix cache missed POSTs the block-aligned prompt prefix (+ how
+        much it already holds); this replica radix-matches it on the
+        ENGINE LOOP (the radix tree and pool are loop-confined) and
+        answers with the matched KV blocks, serialized dtype-exact.
+        The export wait and the base64 encode both run in the executor
+        — neither may block the event loop."""
+        if not self.engine.paged:
+            return web.json_response(
+                {'error': 'replica is not paged'}, status=400)
+        if not self.engine.prefix_peers:
+            # The tier is opt-in and symmetric (every participant lists
+            # the fleet): a replica NOT configured into it must not
+            # export its tenants' cached KV to whoever reaches its
+            # port. Trust model: within the tier, the replica port is
+            # the same trust domain as /generate (LB-fronted network);
+            # see docs/serving.md.
+            return web.json_response(
+                {'error': 'prefix tier not configured '
+                          '(SKYTPU_PREFIX_PEERS)'}, status=404)
+        try:
+            body = await request.json()
+            tokens = [int(t) for t in body['prompt']]
+            from_tokens = int(body.get('from_tokens', 0))
+            budget = float(body.get('budget_seconds', 2.0))
+            instance = body.get('instance')
+        except (json.JSONDecodeError, UnicodeDecodeError, KeyError,
+                TypeError, ValueError):
+            return web.json_response(
+                {'error': 'body needs "prompt" (token ids) and '
+                          'optional "from_tokens"'}, status=400)
+        if instance and instance == self.engine.instance_id:
+            # The caller IS this engine (fleet-shared peers list):
+            # answer instantly — no export wait, and the fetcher
+            # permanently excludes this URL.
+            return web.json_response({'self': True})
+        loop = asyncio.get_running_loop()
+        # The export wait honors the FETCHER's effective read window
+        # (~half its budget — its transport splits connect/read): past
+        # that nobody reads the reply, so a busy engine must not burn
+        # loop + gather + encode time producing it.
+        result = await loop.run_in_executor(
+            None, functools.partial(self.engine.export_prefix_blocks,
+                                    tokens, from_tokens,
+                                    min(2.0, max(budget / 2, 0.05))))
+        if result is None:
+            # Nothing cached past from_tokens: an explicit empty match,
+            # not an error — the peer prefills locally (and does NOT
+            # back this replica off: the reply is well-formed).
+            return web.json_response(prefix_transfer.empty_payload(
+                from_tokens, self.engine.dcfg.kernel_block_k,
+                self.engine.dcfg.kv_cache_dtype))
+        payload = await loop.run_in_executor(
+            None, functools.partial(
+                prefix_transfer.encode_payload,
+                result['matched_tokens'], result['from_tokens'],
+                result['block_k'], result['kv_cache_dtype'],
+                result['arrays']))
+        return web.json_response(payload)
 
     async def _handle_drain(self, request: web.Request) -> web.Response:
         initiated = self.begin_drain('http')
@@ -685,7 +768,8 @@ def build_engine(model: str, num_slots: int, max_len: int,
                  spec_k: Optional[int] = None,
                  drafter_layers: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
-                 tp: Optional[int] = None
+                 tp: Optional[int] = None,
+                 prefix_peers: Optional[list] = None
                  ) -> engine_lib.DecodeEngine:
     """Assemble params + configs into a DecodeEngine (CLI + tests).
 
@@ -735,7 +819,8 @@ def build_engine(model: str, num_slots: int, max_len: int,
     return engine_lib.DecodeEngine(params, cfg, dcfg, num_slots,
                                    step_chunk=step_chunk, name=model,
                                    paged=paged, num_blocks=num_blocks,
-                                   prefill_chunk=prefill_chunk, tp=tp)
+                                   prefill_chunk=prefill_chunk, tp=tp,
+                                   prefix_peers=prefix_peers)
 
 
 def main() -> None:
@@ -798,11 +883,25 @@ def main() -> None:
                              'at multi-host scale the jax.distributed '
                              'bootstrap makes the whole slice devices '
                              'visible first)')
+    parser.add_argument('--prefix-peers', default=None,
+                        help='comma-separated peer replica URLs for the '
+                             'cross-replica prefix cache tier: on a '
+                             'local radix miss the engine pulls cached '
+                             'KV prefix blocks from a peer (or the '
+                             'LB-advertised owner) instead of '
+                             're-prefilling (default SKYTPU_PREFIX_PEERS '
+                             'or disabled)')
     parser.add_argument('--checkpoint-dir', default=None,
                         help='restore params from models/checkpoint '
                              'layout (default: random init — demo mode)')
     parser.add_argument('--seed', type=int, default=0)
     args = parser.parse_args()
+    # Replica teardown / chaos kill this process mid-compile as a matter
+    # of course: make persistent-compile-cache writes atomic first, or a
+    # kill can leave a torn entry that corrupts every later process
+    # sharing the cache dir (utils/jax_cache.py).
+    from skypilot_tpu.utils import jax_cache
+    jax_cache.harden_compilation_cache()
     # Multi-host slices: join the gang's jax.distributed rendezvous
     # BEFORE the first device access, so the engine mesh below can span
     # every host of the slice (one serving replica per slice). No-op
@@ -821,7 +920,12 @@ def main() -> None:
                           spec_k=args.spec_k,
                           drafter_layers=args.drafter_layers,
                           prefill_chunk=args.prefill_chunk,
-                          tp=args.tp)
+                          tp=args.tp,
+                          prefix_peers=(
+                              [u.strip()
+                               for u in args.prefix_peers.split(',')
+                               if u.strip()]
+                              if args.prefix_peers else None))
     server = ModelServer(engine, args.port, host=args.host,
                          default_max_new_tokens=args.max_new_tokens)
     server.run_forever()

@@ -12,7 +12,11 @@ YAML form::
         max_replicas: 4
         target_qps_per_replica: 10
       replica_port: 8080
-      load_balancing_policy: least_load # or round_robin
+      load_balancing_policy: least_load # round_robin / random /
+                                        # prefix_affinity (route shared
+                                        # prompt prefixes to the replica
+                                        # whose radix cache holds them;
+                                        # docs/serving.md)
 """
 from typing import Any, Dict, Optional
 

@@ -117,6 +117,44 @@ _e('SKYTPU_LB_EJECT_BACKOFF_SECONDS', '10',
    'Initial ejection backoff; doubles per failed reinstatement probe '
    '(capped at 120 s).',
    'skypilot_tpu/serve/load_balancer.py', 'serving')
+_e('SKYTPU_LB_AFFINITY_BLOCK_TOKENS', '128',
+   'Prefix-affinity routing: the digest covers the prompt truncated '
+   'DOWN to whole multiples of this many tokens (match the engines\' '
+   'paged block_k so LB-level sharing equals cache-level sharing).',
+   'skypilot_tpu/serve/load_balancing_policies.py', 'serving')
+_e('SKYTPU_LB_AFFINITY_PREFIX_TOKENS', '512',
+   'Prefix-affinity routing: at most this many leading prompt tokens '
+   'feed the routing digest (longer prompts hash identically).',
+   'skypilot_tpu/serve/load_balancing_policies.py', 'serving')
+_e('SKYTPU_LB_AFFINITY_LOAD_FACTOR', '1.25',
+   'Bounded-load factor for prefix-affinity consistent hashing: a '
+   'replica holding more than factor x the mean in-flight count '
+   'spills its digests to the next ring owner.',
+   'skypilot_tpu/serve/load_balancing_policies.py', 'serving')
+_e('SKYTPU_LB_AFFINITY_VNODES', '64',
+   'Virtual nodes per replica on the prefix-affinity hash ring.',
+   'skypilot_tpu/serve/load_balancing_policies.py', 'serving')
+_e('SKYTPU_PREFIX_PEERS', None,
+   'Comma-separated peer replica URLs for the cross-replica prefix '
+   'cache tier: on a local radix miss the engine pulls cached KV '
+   'prefix blocks from a peer instead of re-prefilling. This list is '
+   'the TRUST set — the LB-advertised owner header only reorders it '
+   '(unset = fetch tier disabled).',
+   'skypilot_tpu/models/prefix_transfer.py', 'serving')
+_e('SKYTPU_PREFIX_FETCH_BUDGET_SECONDS', '0.5',
+   'Total wall-clock budget one admission may spend fetching prefix '
+   'blocks from peers; past it the admission degrades to plain '
+   'prefill.',
+   'skypilot_tpu/models/prefix_transfer.py', 'serving')
+_e('SKYTPU_PREFIX_FETCH_MIN_TOKENS', None,
+   'Minimum block-aligned token gain that justifies a peer fetch '
+   '(default: one block — block_k tokens).',
+   'skypilot_tpu/models/prefix_transfer.py', 'serving')
+_e('SKYTPU_PREFIX_FETCH_BACKOFF_SECONDS', '10',
+   'How long a peer whose prefix fetch failed (timeout, connect '
+   'error, malformed reply) is skipped before being retried — one '
+   'dead peer must not stall every cold admission.',
+   'skypilot_tpu/models/prefix_transfer.py', 'serving')
 _e('SKYTPU_LB_EJECT_PROBE_INTERVAL', '1',
    'How often the LB probes ejected replicas\' /healthz for '
    'reinstatement.',

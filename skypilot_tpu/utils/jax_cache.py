@@ -1,0 +1,127 @@
+"""Crash-safe JAX persistent-compilation-cache writes.
+
+jax <= 0.4.x writes compilation-cache entries with a bare
+``Path.write_bytes`` (``jax/_src/lru_cache.py``): a process killed
+mid-write — which is NORMAL OPERATION here (spot preemption tears down
+trainers, the chaos harness and replica teardown SIGKILL model servers,
+the Local cloud's "VM termination" sweeps whole process trees) — leaves
+a TORN entry in the shared cache directory. Every later process that
+hits that key hands the truncated bytes to XLA's executable
+deserializer, which dies in native code (``free(): corrupted unsorted
+chunks`` / SIGSEGV, with silently-wrong numerics on the way down).
+That was the root cause of the seed-broken
+``test_managed_job_checkpoint_resume``: the resumed run was the only
+path hitting a poisoned restore-executable entry, recovering once and
+then dying FAILED.
+
+:func:`harden_compilation_cache` replaces ``LRUCache.put`` with a
+byte-identical twin whose data write goes through a unique temp file +
+``os.replace`` (atomic on POSIX): a killed writer leaves only an
+orphaned ``*.tmp`` the next writer ignores, never a readable torn
+entry. Call it before the first jitted dispatch in any process that can
+be killed mid-compile; it is idempotent and degrades to a no-op when
+jax's cache internals have moved (newer jax writes atomically itself).
+"""
+import os
+import tempfile
+import time
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_PATCHED_FLAG = '_skytpu_atomic_put'
+
+
+def harden_compilation_cache() -> None:
+    """Make persistent-compile-cache writes atomic (idempotent)."""
+    try:
+        from jax._src import lru_cache as _lru
+    except ImportError:
+        return
+    cls = getattr(_lru, 'LRUCache', None)
+    if cls is None or getattr(cls, _PATCHED_FLAG, False):
+        return
+    cache_suffix = getattr(_lru, '_CACHE_SUFFIX', None)
+    atime_suffix = getattr(_lru, '_ATIME_SUFFIX', None)
+    if cache_suffix is None or atime_suffix is None:
+        return  # internals moved: assume the newer jax writes atomically
+
+    orig_put = cls.put
+
+    def put(self, key, val):  # mirrors LRUCache.put, atomic data write
+        if not key:
+            raise ValueError('key cannot be empty')
+        if self.eviction_enabled and len(val) > self.max_size:
+            logger.warning(
+                f'Cache value for key {key!r} of size {len(val)} bytes '
+                f'exceeds the maximum cache size of {self.max_size} '
+                'bytes')
+            return
+        cache_path = self.path / f'{key}{cache_suffix}'
+        atime_path = self.path / f'{key}{atime_suffix}'
+        if self.eviction_enabled:
+            self.lock.acquire(timeout=self.lock_timeout_secs)
+        try:
+            if cache_path.exists():
+                return
+            self._evict_if_needed(additional_size=len(val))
+            # The one behavioral change vs upstream: write-then-rename,
+            # so a SIGKILL mid-write can never leave a readable torn
+            # entry (os.replace is atomic within a filesystem).
+            fd, tmp = tempfile.mkstemp(dir=str(self.path),
+                                       suffix='.skytpu-tmp')
+            try:
+                with os.fdopen(fd, 'wb') as f:
+                    f.write(val)
+                os.replace(tmp, str(cache_path))
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            atime_path.write_bytes(
+                time.time_ns().to_bytes(8, 'little'))
+        finally:
+            if self.eviction_enabled:
+                self.lock.release()
+
+    def safe_put(self, key, val):
+        try:
+            put(self, key, val)
+        except AttributeError:
+            # Cache internals drifted (attribute the twin relies on is
+            # gone): fall back to the upstream implementation — a
+            # non-atomic write beats no cache writes at all.
+            orig_put(self, key, val)
+
+    cls.put = safe_put
+    setattr(cls, _PATCHED_FLAG, True)
+
+
+def disable_persistent_cache() -> None:
+    """Opt THIS process out of the persistent compilation cache
+    entirely (reads and writes).
+
+    Used by the resumed-training path: executables compiled against
+    orbax-restored buffers are not fully distinguished by the cache key
+    from (or even between) other processes' entries — loading a
+    cross-process entry on the resume path corrupts the heap
+    (``free(): corrupted unsorted chunks`` / SIGSEGV, NaN losses;
+    isolated by per-entry bisection of a crashing cache). Must run
+    BEFORE the restore itself — restore compiles too. Note
+    ``jax.config.update('jax_enable_compilation_cache', False)`` is
+    NOT honored dynamically by jax 0.4.x; nulling the cache dir and
+    resetting the cache object is."""
+    import jax
+    try:
+        jax.config.update('jax_compilation_cache_dir', None)
+        from jax._src import compilation_cache
+        compilation_cache.reset_cache()
+    except Exception as e:  # pylint: disable=broad-except
+        # Internals drifted: say so loudly — a resumed run silently
+        # sharing the cache is exactly the corruption class this
+        # exists to prevent.
+        logger.warning('Could not disable the persistent compilation '
+                       f'cache for this resumed run: {e}')

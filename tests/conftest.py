@@ -18,9 +18,14 @@ os.environ['JAX_PLATFORMS'] = 'cpu'
 # Persistent compile cache: jax compiles dominate the slow tests (sharded
 # train steps ~30-75s each); cached re-runs drop them to seconds. A stable
 # path OUTSIDE the per-test isolated $HOME so every test (and spawned
-# skylet/controller subprocess) shares it across runs.
+# skylet/controller subprocess) shares it across runs. The `_v2` bump
+# orphans caches written before utils/jax_cache.harden_compilation_cache
+# existed: jax<=0.4.x wrote entries non-atomically, so any pre-hardening
+# cache may hold TORN entries from processes this suite killed mid-write
+# (they deserialize into native heap corruption — the root cause of the
+# old seed-broken checkpoint-resume failure).
 os.environ.setdefault('JAX_COMPILATION_CACHE_DIR',
-                      f'/tmp/skytpu_jax_cache_{os.getuid()}')
+                      f'/tmp/skytpu_jax_cache_{os.getuid()}_v2')
 os.environ.setdefault('JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES', '0')
 os.environ.setdefault('JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS', '0')
 import jax  # noqa: E402
@@ -30,6 +35,13 @@ jax.config.update('jax_platforms', 'cpu')
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
+
+# Atomic cache writes for THIS process (spawned jax children — trainers,
+# model servers — call harden_compilation_cache() in their own mains;
+# the suite's chaos/preemption tests kill them mid-compile routinely).
+from skypilot_tpu.utils import jax_cache as _jax_cache  # noqa: E402
+
+_jax_cache.harden_compilation_cache()
 
 import pytest  # noqa: E402
 

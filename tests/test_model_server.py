@@ -372,3 +372,137 @@ def _log_tail(path, n=4000):
             return f.read()[-n:]
     except OSError:
         return '<no log>'
+
+
+# ------------------------------------- cross-replica prefix tier (ISSUE 15)
+
+
+def _paged_server(prefix_peers=None):
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    dcfg = decode.DecodeConfig(max_len=64, temperature=0.0,
+                               decode_attention='xla', kernel_block_k=8)
+    eng = engine_lib.DecodeEngine(params, CFG, dcfg, num_slots=2,
+                                  step_chunk=2, name='prefix-e2e',
+                                  paged=True, num_blocks=33,
+                                  prefix_peers=prefix_peers or [])
+    srv = model_server.ModelServer(eng, port=0, host='127.0.0.1',
+                                   default_max_new_tokens=8)
+    port = srv.start()
+    return srv, eng, f'http://127.0.0.1:{port}'
+
+
+def test_cross_replica_prefix_fetch_http_e2e():
+    """The full HTTP tier: replica B, whose radix cache is cold, pulls
+    replica A's cached prefix blocks via POST /prefix_blocks (served
+    off A's engine loop) and generates token-identically to A — plus
+    the /prefix_blocks endpoint contract and the /slo cache block."""
+    import numpy as np
+    from skypilot_tpu.models import prefix_transfer
+    rng = np.random.RandomState(3)
+    shared = rng.randint(0, CFG.vocab_size, size=24).tolist()
+    srv_a = srv_b = None
+    try:
+        # A participates in the tier (the export endpoint is gated on a
+        # configured peer list — symmetric fleet config).
+        srv_a, eng_a, url_a = _paged_server(
+            prefix_peers=['http://peer-placeholder:1'])
+        # Warm A with the shared prefix.
+        warm = requests.post(f'{url_a}/generate',
+                             json={'prompt': shared + [1, 2, 3],
+                                   'max_new_tokens': 4,
+                                   'stream': False}, timeout=30)
+        assert warm.status_code == 200
+
+        # Endpoint contract: A exports the matched blocks, wire-decodable.
+        resp = requests.post(f'{url_a}/prefix_blocks',
+                             json={'prompt': shared, 'from_tokens': 0},
+                             timeout=30)
+        assert resp.status_code == 200
+        payload = prefix_transfer.decode_payload(resp.json())
+        assert payload is not None
+        assert payload['matched_tokens'] == len(shared)
+        assert payload['arrays']['k'].shape[1] == len(shared) // 8
+        # Unknown prefix: an explicit empty match, not an error.
+        miss = requests.post(f'{url_a}/prefix_blocks',
+                             json={'prompt': [9] * 24}, timeout=30)
+        assert miss.status_code == 200
+        assert miss.json()['arrays'] == {}
+
+        # B fetches from A on its cold miss and matches A
+        # token-for-token. B's OWN url leads the peer list (the
+        # fleet-shared config): the instance-id echo must detect and
+        # permanently exclude it, not stall a budget on it.
+        srv_b, eng_b, url_b = _paged_server(prefix_peers=['SELF', url_a])
+        # An alias of B's own address that URL guessing cannot know
+        # (register_self_url covers 127.0.0.1/localhost, not 0.0.0.0):
+        # only the instance-id echo can catch it.
+        self_alias = url_b.replace('127.0.0.1', '0.0.0.0')
+        eng_b.prefix_peers[0] = self_alias
+        prompt = shared + [5, 6, 7, 8]
+        out_a = requests.post(f'{url_a}/generate',
+                              json={'prompt': prompt,
+                                    'max_new_tokens': 6,
+                                    'stream': False}, timeout=30).json()
+        out_b = requests.post(f'{url_b}/generate',
+                              json={'prompt': prompt,
+                                    'max_new_tokens': 6,
+                                    'stream': False}, timeout=30).json()
+        assert out_b['tokens'] == out_a['tokens']
+        slo = requests.get(f'{url_b}/slo', timeout=10).json()
+        assert slo['cache']['prefix_fetch_hits'] == 1
+        assert slo['cache']['prefill_tokens_saved'] >= len(shared)
+        assert slo['cache']['prefix_peers'] == 2
+        # The self alias was detected via the instance-id echo and
+        # permanently excluded — the fetch came from A.
+        assert self_alias.rstrip('/') in eng_b._prefix_self_urls  # pylint: disable=protected-access
+    finally:
+        for srv in (srv_a, srv_b):
+            if srv is not None:
+                srv.stop()
+
+
+def test_lb_prefix_affinity_stickiness_e2e(monkeypatch):
+    """An in-proc LB running the prefix_affinity policy keeps
+    shared-prefix traffic on ONE replica (the radix cache that already
+    holds the blocks) while prompt-less traffic still balances."""
+    import numpy as np
+    import socket as socket_lib
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    # Match the digest's block alignment to the engines' block_k (the
+    # production default of 128 would leave a 24-token prefix below
+    # one block — nothing shareable, nothing to route on).
+    monkeypatch.setenv('SKYTPU_LB_AFFINITY_BLOCK_TOKENS', '8')
+    rng = np.random.RandomState(7)
+    shared = rng.randint(0, CFG.vocab_size, size=24).tolist()
+    srv_a = srv_b = lb = None
+    try:
+        srv_a, eng_a, url_a = _paged_server()
+        srv_b, eng_b, url_b = _paged_server()
+        with socket_lib.socket() as s:
+            s.bind(('', 0))
+            lb_port = s.getsockname()[1]
+        lb = lb_lib.LoadBalancer(
+            lb_port, 'prefix_affinity',
+            get_ready_urls=lambda: [url_a, url_b])
+        lb.start()
+        for i in range(4):
+            r = requests.post(
+                f'http://127.0.0.1:{lb_port}/generate',
+                json={'prompt': shared + [i], 'max_new_tokens': 2,
+                      'stream': False},
+                # The LB digests the body: block_tokens must divide the
+                # shared prefix for the digest to cover it.
+                timeout=30)
+            assert r.status_code == 200, r.text
+        admitted = (eng_a.stats()['admitted'], eng_b.stats()['admitted'])
+        # All four shared-prefix requests landed on one replica...
+        assert sorted(admitted) == [0, 4], admitted
+        owner = eng_a if admitted[0] == 4 else eng_b
+        # ...which served the last three from its radix cache.
+        assert owner.stats()['prefill_tokens_saved'] >= 3 * 24
+    finally:
+        if lb is not None:
+            lb.stop()
+        for srv in (srv_a, srv_b):
+            if srv is not None:
+                srv.stop()

@@ -278,7 +278,23 @@ def test_cpu_sched_payload_end_to_end():
     assert 0.0 <= spec['accept_ratio'] <= 1.0
     assert spec['base_per_token_ms'] > 0
     assert spec['per_token_speedup'] > 0
-    assert 'spec' not in json.loads(lines[-2])['detail']
+    # ISSUE-15: the prefix-aware-routing numbers ride the dark tier as
+    # a THIRD cumulative line — affinity must beat locality-blind
+    # routing on the fleet hit ratio, the peer-fetch arm must land
+    # hits, and draining must move only the drained replica's keys.
+    routing = out['detail']['routing']
+    assert routing['platform'] == 'cpu'
+    arms = routing['arms']
+    assert (arms['prefix_affinity']['prefix_hit_ratio'] >
+            arms['random']['prefix_hit_ratio'])
+    assert (arms['prefix_affinity']['prefill_tokens_saved'] >
+            arms['random']['prefill_tokens_saved'])
+    assert arms['random_peer_fetch']['prefix_fetch_hits'] > 0
+    assert routing['drain']['moved_only_drained_keys'] is True
+    # Cumulative-line contract: sched-only first, then +spec, then
+    # +routing (a kill mid-route still lands the sched+spec result).
+    assert 'routing' not in json.loads(lines[-2])['detail']
+    assert 'spec' not in json.loads(lines[-3])['detail']
     # ISSUE-13: the control-plane SLO ledger rides every perf line,
     # dark tier included — an empty journal reads zero counts with the
     # (ungated) gate recorded as passing, never an error.

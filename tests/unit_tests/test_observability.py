@@ -339,7 +339,16 @@ def test_all_registered_metric_names_match_convention():
                      'skytpu_fleet_ttft_seconds',
                      'skytpu_fleet_per_token_seconds',
                      'skytpu_fleet_straggler',
-                     'skytpu_engine_hbm_bytes'):
+                     'skytpu_engine_hbm_bytes',
+                     # Prefix-aware routing + cross-replica prefix
+                     # cache tier (ISSUE 15).
+                     'skytpu_lb_affinity_hits_total',
+                     'skytpu_lb_affinity_rehash_total',
+                     'skytpu_fleet_prefix_hit_ratio',
+                     'skytpu_engine_prefix_evictions_total',
+                     'skytpu_engine_prefix_fetches_total',
+                     'skytpu_engine_radix_nodes',
+                     'skytpu_engine_prefix_cache_blocks'):
         assert expected in names, f'{expected} not found by lint scan'
 
 
@@ -397,7 +406,10 @@ def test_all_journal_event_kinds_are_registered():
                      # Tensor-parallel serving mesh (ISSUE 12).
                      'ENGINE_MESH',
                      # Fleet tracing + SLO plane (ISSUE 13).
-                     'LB_HOP', 'REPLICA_STRAGGLER', 'ENGINE_HBM'):
+                     'LB_HOP', 'REPLICA_STRAGGLER', 'ENGINE_HBM',
+                     # Prefix-aware routing + cross-replica prefix
+                     # cache tier (ISSUE 15).
+                     'LB_ROUTE', 'ENGINE_PREFIX_FETCH'):
         assert expected in attr_names, \
             f'EventKind.{expected} not found by lint scan'
 

@@ -1,0 +1,453 @@
+"""Cross-replica prefix cache tier (ISSUE 15): peer-fetch parity
+(fetched-block decode token-identical to local re-prefill, bf16 AND
+int8 KV), budget/mismatch degradation to plain prefill, the loop-
+serviced export path, the wire format, and the radix observability
+counters that ride along.
+"""
+import dataclasses
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import decode, llama, prefix_transfer
+from skypilot_tpu.models import engine as engine_lib
+from skypilot_tpu.observability import journal, metrics
+
+
+@pytest.fixture
+def fresh_registry():
+    prev = metrics.set_registry(metrics.MetricsRegistry())
+    yield metrics.get_registry()
+    metrics.set_registry(prev)
+
+
+CFG = dataclasses.replace(llama.CONFIGS['debug'], remat=False)
+PARAMS = llama.init_params(jax.random.PRNGKey(0), CFG)
+BLOCK_K = 8
+
+
+def _dcfg(kv='bf16'):
+    return decode.DecodeConfig(max_len=64, temperature=0.0,
+                               decode_attention='xla',
+                               kernel_block_k=BLOCK_K,
+                               kv_cache_dtype=kv)
+
+
+def _engine(kv='bf16', **kwargs):
+    return engine_lib.DecodeEngine(PARAMS, CFG, _dcfg(kv), 2,
+                                   paged=True, num_blocks=33, **kwargs)
+
+
+def _drive(eng, reqs):
+    for r in reqs:
+        eng.submit(r)
+    while not all(r.done for r in reqs):
+        eng.step()
+
+
+def _shared_prefix(seed=3, n=24):
+    # Pinned tie-free seed (debug-model logit ties are fp32-accumulation
+    # -order-dependent; see tests/unit_tests/test_spec_decode.py).
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, CFG.vocab_size, size=n).tolist()
+
+
+def _wire_fetch(owner):
+    """Fetch transport that exercises the FULL wire format: the owner's
+    loop-side export, encode_payload, a JSON round trip (what aiohttp
+    would ship), decode_payload."""
+
+    def fetch(url, tokens, from_tokens, budget):
+        raw = owner._export_prefix_now(tokens, from_tokens)  # pylint: disable=protected-access
+        if raw is None:
+            # Reachable-but-cold peer: the honest empty payload (None
+            # would mean transport failure and back the peer off).
+            return prefix_transfer.empty_payload(
+                from_tokens, BLOCK_K, owner.dcfg.kv_cache_dtype)
+        enc = prefix_transfer.encode_payload(
+            raw['matched_tokens'], raw['from_tokens'], raw['block_k'],
+            raw['kv_cache_dtype'], raw['arrays'])
+        return prefix_transfer.decode_payload(json.loads(json.dumps(enc)))
+
+    return fetch
+
+
+@pytest.mark.parametrize('kv', ['bf16', 'int8'])
+def test_peer_fetch_parity(kv, fresh_registry):
+    """The tier's correctness contract: serving a prompt whose prefix
+    was FETCHED from a peer emits exactly the tokens a cold local
+    prefill emits — bf16 bytes and int8 values + scale planes transfer
+    verbatim, so there is nothing to drift."""
+    shared = _shared_prefix()
+    owner = _engine(kv)
+    _drive(owner, [engine_lib.Request(shared + [1, 2, 3], 6)])
+
+    prompt = shared + [5, 6, 7, 8]
+    fetcher = _engine(kv, prefix_peers=['peer'],
+                      prefix_fetch_fn=_wire_fetch(owner))
+    control = _engine(kv)
+    rf = engine_lib.Request(prompt, 8)
+    rc = engine_lib.Request(prompt, 8)
+    _drive(fetcher, [rf])
+    _drive(control, [rc])
+
+    assert rf.tokens == rc.tokens
+    cache = fetcher.cache_stats()
+    assert cache['prefix_fetch_hits'] == 1
+    assert cache['prefix_fetch_tokens'] == len(shared)
+    assert cache['prefill_tokens_saved'] >= len(shared)
+    # The outcome is journaled under the request (stats() flushed the
+    # buffer above via _drive's steps).
+    fetcher.flush_journal()
+    events = journal.query(
+        kinds=[journal.EventKind.ENGINE_PREFIX_FETCH])
+    hits = [e for e in events
+            if e['payload'].get('outcome') == 'hit']
+    assert hits and hits[0]['payload']['tokens_gained'] == len(shared)
+
+
+def test_peer_fetch_parity_tp2(fresh_registry):
+    """TP-awareness: a tp=1 owner feeds a tp=2 fetcher (the conftest
+    CPU mesh has 8 virtual devices). The wire format is the unsharded
+    logical block — the owner gathers its shards on export, the
+    fetcher re-shards on injection — so greedy output still matches a
+    tp=2 cold-prefill control token for token."""
+    shared = _shared_prefix(seed=5)
+    owner = _engine()
+    _drive(owner, [engine_lib.Request(shared + [9, 9], 6)])
+
+    prompt = shared + [4, 3, 2, 1]
+    fetcher = _engine(tp=2, prefix_peers=['peer'],
+                      prefix_fetch_fn=_wire_fetch(owner))
+    control = _engine(tp=2)
+    rf = engine_lib.Request(prompt, 8)
+    rc = engine_lib.Request(prompt, 8)
+    _drive(fetcher, [rf])
+    _drive(control, [rc])
+    assert rf.tokens == rc.tokens
+    assert fetcher.cache_stats()['prefix_fetch_hits'] == 1
+
+
+def test_fetch_budget_exhaustion_degrades_to_prefill(fresh_registry):
+    """A slow first peer eats the budget; the second (working) peer is
+    never consulted past the deadline and the admission prefills
+    locally — degraded, correct, journaled."""
+    shared = _shared_prefix()
+    owner = _engine()
+    _drive(owner, [engine_lib.Request(shared + [1], 4)])
+    calls = []
+
+    def slow_then_good(url, tokens, from_tokens, budget):
+        calls.append(url)
+        if url == 'slow':
+            time.sleep(0.08)
+            return None        # the transport timed out
+        return _wire_fetch(owner)('peer', tokens, from_tokens, budget)
+
+    fetcher = _engine(prefix_peers=['slow', 'good'],
+                      prefix_fetch_fn=slow_then_good,
+                      prefix_fetch_budget=0.05)
+    control = _engine()
+    prompt = shared + [7, 7, 7]
+    rf = engine_lib.Request(prompt, 6)
+    rc = engine_lib.Request(prompt, 6)
+    _drive(fetcher, [rf])
+    _drive(control, [rc])
+    assert rf.tokens == rc.tokens          # plain prefill, same output
+    assert calls == ['slow']               # budget gated peer 2
+    cache = fetcher.cache_stats()
+    assert cache['prefix_fetch_hits'] == 0
+    assert cache['prefix_fetch_misses'] == 1
+    fetcher.flush_journal()
+    events = journal.query(
+        kinds=[journal.EventKind.ENGINE_PREFIX_FETCH])
+    assert any(e['payload'].get('outcome') == 'budget_exhausted'
+               for e in events)
+
+
+def test_fetch_mismatch_rejected(fresh_registry):
+    """A peer shipping the wrong block size / cache dtype is ignored
+    (validated before any pool write), and the request still serves
+    correctly via local prefill."""
+    shared = _shared_prefix()
+    owner = _engine()
+    _drive(owner, [engine_lib.Request(shared + [2], 4)])
+    good = _wire_fetch(owner)
+
+    def bad_block_k(url, tokens, from_tokens, budget):
+        payload = good(url, tokens, from_tokens, budget)
+        payload['block_k'] = 16
+        return payload
+
+    fetcher = _engine(prefix_peers=['peer'],
+                      prefix_fetch_fn=bad_block_k)
+    control = _engine()
+    prompt = shared + [8, 8]
+    rf = engine_lib.Request(prompt, 6)
+    rc = engine_lib.Request(prompt, 6)
+    _drive(fetcher, [rf])
+    _drive(control, [rc])
+    assert rf.tokens == rc.tokens
+    assert fetcher.cache_stats()['prefix_fetch_hits'] == 0
+
+
+def test_fetch_error_and_raise_degrade(fresh_registry):
+    """A raising transport is caught (admission never crashes over a
+    peer) and the request serves via local prefill."""
+    shared = _shared_prefix()
+
+    def boom(url, tokens, from_tokens, budget):
+        raise RuntimeError('peer on fire')
+
+    fetcher = _engine(prefix_peers=['peer'], prefix_fetch_fn=boom)
+    control = _engine()
+    prompt = shared + [1, 2]
+    rf = engine_lib.Request(prompt, 6)
+    rc = engine_lib.Request(prompt, 6)
+    _drive(fetcher, [rf])
+    _drive(control, [rc])
+    assert rf.tokens == rc.tokens
+    assert fetcher.cache_stats()['prefix_fetch_misses'] == 1
+
+
+def test_prefix_hint_reorders_but_never_adds(fresh_registry):
+    """The LB-advertised owner (Request.prefix_hint) moves a MATCHING
+    configured peer to the front of the try order — but a hint naming
+    an unconfigured URL is ignored: the peer list is the trust set,
+    and an HTTP header must not be able to make the engine fetch (and
+    publish to every tenant) KV blocks from an arbitrary URL."""
+    shared = _shared_prefix()
+    owner = _engine()
+    _drive(owner, [engine_lib.Request(shared + [3], 4)])
+    order = []
+    good = _wire_fetch(owner)
+
+    def recording(url, tokens, from_tokens, budget):
+        order.append(url)
+        return good(url, tokens, from_tokens, budget)
+
+    fetcher = _engine(prefix_peers=['peer-a', 'peer-b'],
+                      prefix_fetch_fn=recording)
+    req = engine_lib.Request(shared + [6, 6], 6,
+                             prefix_hint='peer-b')
+    _drive(fetcher, [req])
+    assert order[0] == 'peer-b'
+    assert fetcher.cache_stats()['prefix_fetch_hits'] == 1
+
+    # Unconfigured hint: never contacted, static order preserved.
+    order2 = []
+
+    def recording2(url, tokens, from_tokens, budget):
+        order2.append(url)
+        return good(url, tokens, from_tokens, budget)
+
+    fetcher2 = _engine(prefix_peers=['peer-a'],
+                       prefix_fetch_fn=recording2)
+    req2 = engine_lib.Request(shared + [7, 7], 6,
+                              prefix_hint='http://evil:9')
+    _drive(fetcher2, [req2])
+    assert 'http://evil:9' not in order2
+    assert order2[0] == 'peer-a'
+
+
+def test_digest_survives_out_of_range_tokens():
+    """A token id outside int32 digests instead of raising (the
+    replica normalizes mod vocab; the LB must proxy, not 500)."""
+    from skypilot_tpu.serve import load_balancing_policies as lbp
+    d = lbp.prefix_digest([2**31, 2**63, -5] + list(range(13)),
+                          block_tokens=8, max_tokens=16)
+    assert d is not None
+
+
+def test_mismatching_peer_backed_off(fresh_registry):
+    """A version-skewed peer (validation mismatch) is backed off like
+    a dead one — its payloads must not be re-downloaded and discarded
+    on every cold admission."""
+    shared = _shared_prefix()
+    owner = _engine()
+    _drive(owner, [engine_lib.Request(shared + [2], 4)])
+    good = _wire_fetch(owner)
+    calls = []
+
+    def bad_block_k(url, tokens, from_tokens, budget):
+        calls.append(url)
+        payload = good(url, tokens, from_tokens, budget)
+        payload['block_k'] = 16
+        return payload
+
+    fetcher = _engine(prefix_peers=['skewed'],
+                      prefix_fetch_fn=bad_block_k)
+    _drive(fetcher, [engine_lib.Request(shared + [8, 8], 6)])
+    _drive(fetcher, [engine_lib.Request(shared[:16] + [9] * 10, 6)])
+    assert calls == ['skewed']      # second admission skipped it
+
+
+def test_short_prompts_never_fetch(fresh_registry):
+    """Nothing block-aligned to gain → no peer round trip at all."""
+    calls = []
+
+    def spy(url, tokens, from_tokens, budget):
+        calls.append(url)
+        return None
+
+    fetcher = _engine(prefix_peers=['peer'], prefix_fetch_fn=spy)
+    _drive(fetcher, [engine_lib.Request([1, 2, 3], 4)])
+    assert calls == []
+
+
+def test_cross_thread_export_serviced_by_step(fresh_registry):
+    """The model server's /prefix_blocks path: export_prefix_blocks
+    queues cross-thread and the engine LOOP services it (radix/pool
+    are loop-confined); allocator refcounts balance afterwards."""
+    shared = _shared_prefix()
+    eng = _engine()
+    _drive(eng, [engine_lib.Request(shared + [1], 4)])
+    refs_before = np.array(eng._allocator._ref)  # pylint: disable=protected-access
+    result = {}
+
+    def exporter():
+        result['payload'] = eng.export_prefix_blocks(shared, timeout=5)
+
+    t = threading.Thread(target=exporter)
+    t.start()
+    deadline = time.time() + 5
+    while t.is_alive() and time.time() < deadline:
+        eng.step()
+        time.sleep(0.001)
+    t.join(timeout=1)
+    payload = result['payload']
+    assert payload is not None
+    assert payload['matched_tokens'] == len(shared)
+    assert payload['block_k'] == BLOCK_K
+    k = payload['arrays']['k']
+    assert k.shape[1] == len(shared) // BLOCK_K
+    np.testing.assert_array_equal(
+        np.array(eng._allocator._ref), refs_before)  # pylint: disable=protected-access
+    # A miss (unknown prefix) answers None, not an error.
+    t2 = threading.Thread(target=lambda: result.update(
+        miss=eng.export_prefix_blocks([9] * 24, timeout=5)))
+    t2.start()
+    deadline = time.time() + 5
+    while t2.is_alive() and time.time() < deadline:
+        eng.step()
+        time.sleep(0.001)
+    t2.join(timeout=1)
+    assert result['miss'] is None
+
+
+@pytest.mark.parametrize('dtype', ['bfloat16', 'int8', 'float32'])
+def test_wire_roundtrip_preserves_bytes(dtype):
+    rng = np.random.RandomState(0)
+    a = rng.randn(2, 3, 8, 2, 4)
+    arr = (a * 10).astype(np.dtype(dtype))
+    enc = prefix_transfer.encode_array(arr)
+    dec = prefix_transfer.decode_array(json.loads(json.dumps(enc)))
+    assert dec.dtype == arr.dtype and dec.shape == arr.shape
+    assert dec.tobytes() == arr.tobytes()
+
+
+def test_decode_payload_rejects_garbage():
+    assert prefix_transfer.decode_payload({'nope': 1}) is None
+    assert prefix_transfer.decode_payload(
+        {'matched_tokens': 'x', 'from_tokens': 0, 'block_k': 8,
+         'kv_cache_dtype': 'bf16', 'arrays': {}}) is None
+
+
+def test_prefix_evictions_counter(fresh_registry):
+    """Pool pressure that LRU-evicts radix entries shows up in
+    stats()['prefix_evictions'] and the counter — the cache-pressure
+    context the locality gauges are read against."""
+    # Tiny pool: 2 slots * 8 blocks + 1; distinct 24-token prompts with
+    # generation budgets reserve 4 blocks each and publish 3.
+    eng = engine_lib.DecodeEngine(PARAMS, CFG, _dcfg(), 2, paged=True,
+                                  num_blocks=13)
+    rng = np.random.RandomState(11)
+    for i in range(5):
+        prompt = rng.randint(0, CFG.vocab_size, size=24).tolist()
+        _drive(eng, [engine_lib.Request(prompt, 4)])
+    stats = eng.stats()
+    assert stats['prefix_evictions'] > 0
+    assert eng.cache_stats()['prefix_evictions'] == \
+        stats['prefix_evictions']
+    text = metrics.generate_latest().decode()
+    assert 'skytpu_engine_prefix_evictions_total' in text
+    assert 'skytpu_engine_radix_nodes' in text
+    assert 'skytpu_engine_prefix_cache_blocks' in text
+
+
+def test_dead_peer_backoff_and_honest_miss(fresh_registry):
+    """A transport failure (None) puts the peer in backoff — the next
+    eligible admission skips it entirely — while an honest empty
+    payload does NOT penalize the peer (it is retried next time)."""
+    shared = _shared_prefix()
+    calls = []
+
+    def dead(url, tokens, from_tokens, budget):
+        calls.append(url)
+        return None                # transport failure
+
+    fetcher = _engine(prefix_peers=['dead-peer'], prefix_fetch_fn=dead)
+    _drive(fetcher, [engine_lib.Request(shared + [1], 4)])
+    _drive(fetcher, [engine_lib.Request(shared[:16] + [2] * 10, 4)])
+    assert calls == ['dead-peer']   # second admission skipped it
+
+    calls2 = []
+
+    def cold(url, tokens, from_tokens, budget):
+        calls2.append(url)
+        return prefix_transfer.empty_payload(from_tokens, BLOCK_K,
+                                             'bf16')
+
+    fetcher2 = _engine(prefix_peers=['cold-peer'], prefix_fetch_fn=cold)
+    _drive(fetcher2, [engine_lib.Request(shared + [1], 4)])
+    _drive(fetcher2, [engine_lib.Request(shared[:16] + [2] * 10, 4)])
+    assert calls2 == ['cold-peer', 'cold-peer']  # no backoff
+    assert fetcher2.cache_stats()['prefix_fetch_misses'] == 2
+
+
+def test_self_url_never_fetched(fresh_registry):
+    """A registered self URL is filtered from the peer list (a
+    self-fetch would stall the engine loop for a whole budget)."""
+    shared = _shared_prefix()
+    calls = []
+
+    def spy(url, tokens, from_tokens, budget):
+        calls.append(url)
+        return None
+
+    fetcher = _engine(prefix_peers=['http://me:8000', 'http://other:1'],
+                      prefix_fetch_fn=spy)
+    fetcher.register_self_url('http://me:8000/')
+    _drive(fetcher, [engine_lib.Request(shared + [1], 4)])
+    assert calls == ['http://other:1']
+
+
+def test_wrong_dtype_array_rejected(fresh_registry):
+    """A payload whose dtype STRING matches but whose array bytes
+    decode under a different dtype is rejected before any pool write
+    (a value cast would install plausible garbage K/V)."""
+    shared = _shared_prefix()
+    owner = _engine()
+    _drive(owner, [engine_lib.Request(shared + [2], 4)])
+    good = _wire_fetch(owner)
+
+    def f16(url, tokens, from_tokens, budget):
+        payload = good(url, tokens, from_tokens, budget)
+        payload['arrays'] = {
+            name: a.view(np.float16) if a.dtype != np.float32
+            else a for name, a in payload['arrays'].items()}
+        return payload
+
+    fetcher = _engine(prefix_peers=['peer'], prefix_fetch_fn=f16)
+    control = _engine()
+    prompt = shared + [3, 3]
+    rf = engine_lib.Request(prompt, 6)
+    rc = engine_lib.Request(prompt, 6)
+    _drive(fetcher, [rf])
+    _drive(control, [rc])
+    assert rf.tokens == rc.tokens
+    assert fetcher.cache_stats()['prefix_fetch_hits'] == 0
