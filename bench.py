@@ -78,6 +78,11 @@ _PHASE_DEADLINES = {
     # round even when TPUs are dark.
     'route_compile': 240,
     'route_run': 150,
+    # Disaggregated prefill/decode workload (CPU failover tier): split
+    # vs monolithic TTFT/goodput under a long-prompt burst, with the
+    # streaming KV handoff on the measured path.
+    'disagg_compile': 240,
+    'disagg_run': 180,
 }
 
 
@@ -315,6 +320,21 @@ def _payload_sched() -> None:
         **{k: route['detail'][k] for k in (
             'n_replicas', 'n_requests', 'n_families', 'arms', 'drain',
             'affinity_vs_random')},
+    }
+    print(json.dumps(out), flush=True)
+    # Disaggregated prefill/decode: split (2P+2D, streaming KV
+    # handoff) vs monolithic (4 mixed) under a long-prompt burst, as
+    # a fourth cumulative line — a kill mid-disagg still lands the
+    # sched+spec+routing result.
+    disagg = decode_bench.run_disagg_bench(beat=harness.beat)
+    out['detail']['disagg'] = {
+        'value': disagg['value'],
+        'unit': disagg['unit'],
+        'platform': disagg['platform'],
+        **{k: disagg['detail'][k] for k in (
+            'n_engines', 'n_burst', 'n_background', 'burst_prompt_len',
+            'split', 'mono', 'ttft_improved', 'goodput_ratio',
+            'goodput_holds')},
     }
     print(json.dumps(out), flush=True)
 

@@ -161,9 +161,15 @@ def exec_cmd(entrypoint, cluster, detach_run):
 @click.option('--kubernetes', '-k', 'show_k8s', is_flag=True,
               default=False,
               help='Show framework pods across allowed k8s contexts.')
+@click.option('--limit', '-n', type=int, default=None,
+              help='Show at most this many clusters (server-side '
+                   'pagination; default: all).')
+@click.option('--offset', type=int, default=0,
+              help='Skip this many clusters before the page (pairs '
+                   'with --limit).')
 @click.argument('clusters', nargs=-1)
 def status(refresh, verbose, show_endpoints, one_endpoint, show_k8s,
-           clusters):
+           limit, offset, clusters):
     """Show clusters (parity incl. `sky status --endpoints` and
     `sky status --kubernetes`)."""
     if show_k8s:
@@ -192,9 +198,12 @@ def status(refresh, verbose, show_endpoints, one_endpoint, show_k8s,
             click.echo(f'{p}: {url}')
         return
     records = sdk.get(sdk.status(list(clusters) or None, refresh=refresh,
-                                 verbose=verbose))
+                                 verbose=verbose, limit=limit,
+                                 offset=offset))
     if not records:
-        click.echo('No existing clusters.')
+        click.echo('No existing clusters.'
+                   if not offset and limit is None else
+                   'No clusters in this page.')
         return
     rows = [(r['name'], r['resources'], r['status'],
              _age(r['launched_at']),

@@ -186,16 +186,23 @@ def exec_(task: Union['task_lib.Task', 'dag_lib.Dag'],
 
 
 def status(cluster_names: Optional[List[str]] = None,
-           refresh: bool = False, verbose: bool = False) -> str:
+           refresh: bool = False, verbose: bool = False,
+           limit: Optional[int] = None, offset: int = 0) -> str:
     return _post('/status', {'cluster_names': cluster_names,
-                             'refresh': refresh, 'verbose': verbose})
+                             'refresh': refresh, 'verbose': verbose,
+                             'limit': limit, 'offset': offset})
 
 
 def fleet(cluster_names: Optional[List[str]] = None,
-          window_seconds: float = 120.0) -> str:
-    """Fleet telemetry snapshots (per-node utilization windows)."""
+          window_seconds: float = 120.0,
+          limit: Optional[int] = None, offset: int = 0) -> str:
+    """Fleet telemetry snapshots (per-node utilization windows).
+
+    ``limit``/``offset`` page the (deterministically ordered) summary
+    list server-side; both default to the full, unpaginated view."""
     return _post('/fleet', {'cluster_names': cluster_names,
-                            'window_seconds': window_seconds})
+                            'window_seconds': window_seconds,
+                            'limit': limit, 'offset': offset})
 
 
 def endpoints(cluster_name: str, port: Optional[int] = None) -> str:

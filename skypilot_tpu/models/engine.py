@@ -108,6 +108,7 @@ events, so a serving replica's scheduling decisions are reconstructable
 after the fact.
 """
 import collections
+import concurrent.futures
 import functools
 import heapq
 import itertools
@@ -472,6 +473,17 @@ class Request:
         # header — the peer most likely holding this prompt's cached KV
         # blocks. Tried FIRST on a local radix miss.
         self.prefix_hint = prefix_hint
+        # Disaggregated prefill/decode: when set, the engine streams
+        # this request's KV blocks to a decode peer as prefill chunks
+        # complete (``handoff_push(tokens_prefix, payload) -> bool``,
+        # True = acked) and — once every full block is acked — finishes
+        # the request as ``'handoff'`` without decoding: the decode
+        # replica owns the token stream. Any push failure degrades to
+        # decode-in-place on this replica. ``handoff_peer`` (the decode
+        # peer's URL, when known) feeds the engine's per-peer failure
+        # backoff.
+        self.handoff_push: Optional[Callable[..., bool]] = None
+        self.handoff_peer: Optional[str] = None
         self.tokens: List[int] = []
         self.finish_reason: Optional[str] = None
         self.enqueue_ts: Optional[float] = None
@@ -681,7 +693,8 @@ class DecodeEngine:
     _CROSS_THREAD_METHODS = ('submit', 'queue_depth', 'stats',
                              'spec_stats', 'cache_stats', 'flush_journal',
                              'active_slots', 'free_slots',
-                             'export_prefix_blocks')
+                             'export_prefix_blocks',
+                             'inject_handoff_blocks', 'handoff_stats')
 
     def __init__(self, params, cfg: llama.LlamaConfig,
                  dcfg: decode.DecodeConfig, num_slots: int,
@@ -830,6 +843,21 @@ class DecodeEngine:
         self._prefix_fetch_misses = 0
         self._prefix_fetch_tokens = 0
         self._prefix_evictions = 0
+        # Disaggregated prefill/decode handoff counters: the prefill
+        # side counts completed/degraded handoffs and tokens pushed,
+        # the decode side counts injections and tokens adopted (one
+        # engine can play both roles under role=mixed).
+        self._handoffs_completed = 0
+        self._handoffs_degraded = 0
+        self._handoff_tokens_pushed = 0
+        self._handoff_injections = 0
+        self._handoff_tokens_injected = 0
+        # Outbound handoff pushes ride a lazy executor so the wire
+        # transfer of chunk k overlaps the compute of chunk k+1 (the
+        # exported payload is a host-side snapshot; the loop thread
+        # only awaits the PREVIOUS push before exporting the next).
+        self._handoff_pool: Optional[
+            concurrent.futures.ThreadPoolExecutor] = None
         # Prefix-export jobs: peers' /prefix_blocks requests queue here
         # (any thread) and are serviced by the engine loop at the top of
         # each step — radix/pool reads are loop-confined, so the HTTP
@@ -1228,6 +1256,14 @@ class DecodeEngine:
         reservation cannot be met (caller requeues the request)."""
         bk = self._block_k
         p = len(request.prompt)
+        handoff = request.handoff_push is not None
+        if handoff and p < bk:
+            # Nothing block-aligned to hand off: decode in place (the
+            # model server normally filters these before arming, but a
+            # direct-to-engine caller must degrade, not wedge).
+            request.handoff_push = None
+            handoff = False
+            self._handoff_degrade(request, 'short_prompt', prompt_len=p)
         blocks, path = self._radix.match(request.prompt)
         m_full = len(blocks) * bk
         if self._should_prefix_fetch(p, m_full, request):
@@ -1286,7 +1322,8 @@ class DecodeEngine:
                 self._allocator.decref(blocks + owned)
                 self._radix.release(path)
                 raise
-        if self.prefill_chunk and (p - m) > self.prefill_chunk:
+        if handoff or (self.prefill_chunk and
+                       (p - m) > self.prefill_chunk):
             # Chunked admission: the reservation (and the boundary COW)
             # happen now — cheap and atomic wrt the pool — but the
             # suffix forward runs one chunk per engine step. The slot's
@@ -1295,12 +1332,16 @@ class DecodeEngine:
             # dispatch cannot land in a half-prefilled block (the chunk
             # calls take their block rows explicitly). The radix
             # publish also waits: a prefix is only shareable once its
-            # blocks hold real K/V.
+            # blocks hold real K/V. Handoff requests ride this path
+            # even with chunking off (one chunk covering the whole
+            # suffix): the per-chunk hook is where completed blocks
+            # stream to the decode peer.
             self._slot_refs[slot] = blocks + owned
             self._slot_nodes[slot] = path
             self._prefill_state[slot] = {
                 'req': request, 'table': table, 'p': p, 'm': m,
-                'next': m}
+                'next': m, 'chunk': self.prefill_chunk or (p - m),
+                'hand': handoff, 'pushed': 0, 'hand_failed': False}
             self._publish_block_gauges()
             return None, m
         try:
@@ -1506,7 +1547,10 @@ class DecodeEngine:
         bk = self._block_k
         p, table = st['p'], st['table']
         start = st['next']
-        end = min(start + self.prefill_chunk, p)
+        # Per-slot chunk: handoff admissions park the whole suffix as
+        # one chunk when global chunking is off.
+        chunk = st.get('chunk') or self.prefill_chunk
+        end = min(start + chunk, p)
         suf = end - start
         bucket = self._bucket_for(suf)
         padded = np.zeros((1, bucket), np.int32)
@@ -1516,7 +1560,7 @@ class DecodeEngine:
             # over the chunk (in-bucket padding spills into blocks later
             # chunks overwrite; never attended — prefix_len masks it).
             self._note_compile('paged_prefill', bucket=bucket,
-                               chunk=self.prefill_chunk)
+                               chunk=chunk)
             row = np.full((bucket // bk,), SCRATCH_BLOCK, np.int32)
             nrow = min(len(table), len(row))
             row[:nrow] = table[:nrow]
@@ -1533,7 +1577,7 @@ class DecodeEngine:
                 npb_bucket *= 2
             self._note_compile('paged_prefill_with_prefix',
                                bucket=bucket, npb_bucket=npb_bucket,
-                               chunk=self.prefill_chunk)
+                               chunk=chunk)
             pref = np.full((npb_bucket,), SCRATCH_BLOCK, np.int32)
             pref[:npb] = table[:npb]
             srow = start // bk
@@ -1549,6 +1593,13 @@ class DecodeEngine:
         self._m.counter(
             'skytpu_engine_prefill_chunks_total',
             'Prefill chunks executed by chunked admissions.').inc()
+        if st.get('hand') and not st.get('hand_failed'):
+            # Stream the chunk's newly-completed full blocks to the
+            # decode peer BEFORE the finish check: by the time
+            # _finish_prefill runs, either every aligned block was
+            # acked (handoff completes, slot frees) or the slot flipped
+            # to degraded decode-in-place.
+            self._push_handoff_chunk(st)
         if end >= p:
             self._finish_prefill(slot, st, last)
         return suf
@@ -1568,6 +1619,30 @@ class DecodeEngine:
                 'Prompt tokens NOT prefilled thanks to prefix-'
                 'cache hits.').inc(m)
         self._prompt_tokens_total += p
+        if st.get('hand') and not st.get('hand_failed'):
+            # Resolve the final in-flight push before judging the
+            # handoff: an unacked tail would hand the stream to a peer
+            # that never got it. A failure here degrades and falls
+            # through to the normal decode-in-place finish below.
+            self._await_handoff_ack(st)
+        if st.get('hand') and not st.get('hand_failed'):
+            # Every aligned block was pushed and acked: the decode peer
+            # owns the stream from here. NO radix publish and NO first
+            # token on this side — evicting returns every reserved
+            # block to the pool, so the prefill tier's pool turns over
+            # per burst instead of accreting a cache nobody decodes
+            # against.
+            self._handoffs_completed += 1
+            self._m.counter(
+                'skytpu_engine_handoffs_total',
+                'Full-request KV handoff attempts by outcome.',
+                labels=('result',)).inc(labels=('complete',))
+            self._journal(journal.EventKind.ENGINE_HANDOFF, req, slot,
+                          outcome='complete',
+                          tokens_pushed=st['pushed'] * bk,
+                          peer=req.handoff_peer)
+            self._evict(slot, 'handoff')
+            return
         full = p // bk
         if full:
             self._radix.insert(req.prompt[:full * bk], table[:full])
@@ -1581,6 +1656,248 @@ class DecodeEngine:
         else:
             first = int(self._sample_first(last))
         self._deliver_first(slot, req, first)
+
+    # ------------------------------------- disaggregated P/D handoff
+
+    def _handoff_degrade(self, req: Request, reason: str,
+                         **payload) -> None:
+        """One degraded handoff: the request decodes in place on this
+        (prefill) replica — journaled and counted, the stream is still
+        answered. Degrade is the ONLY failure mode: a handoff must
+        never turn into a hung stream."""
+        self._handoffs_degraded += 1
+        self._m.counter(
+            'skytpu_engine_handoffs_total',
+            'Full-request KV handoff attempts by outcome.',
+            labels=('result',)).inc(labels=('degraded',))
+        self._journal(journal.EventKind.ENGINE_HANDOFF, req, -1,
+                      outcome='degraded', reason=reason, **payload)
+
+    def _export_slot_blocks(self, send: List[int],
+                            from_tokens: int) -> dict:
+        """LOOP-THREAD ONLY: device-read an explicit block list from
+        the pool into the PR 15 wire format — the handoff twin of
+        :meth:`_export_prefix_now` (same bucketed gather, same
+        owner-side TP assembly), except the blocks come from a
+        still-prefilling slot's reserved table instead of a radix
+        match: mid-prefill blocks are not in the tree yet, and
+        ``_slot_refs`` already pins them for the read."""
+        bk = self._block_k
+        bucket = 1
+        while bucket < len(send):
+            bucket *= 2
+        self._note_compile('prefix_export', blocks=bucket)
+        idx_np = np.full((bucket,), SCRATCH_BLOCK, np.int32)
+        idx_np[:len(send)] = send
+        idx = jnp.asarray(idx_np)
+        arrays = {
+            name: np.asarray(
+                jax.device_get(arr[:, idx]))[:, :len(send)]
+            for name, arr in self._cache.items()}
+        return {
+            'matched_tokens': from_tokens + len(send) * bk,
+            'from_tokens': from_tokens,
+            'block_k': bk,
+            'kv_cache_dtype': self.dcfg.kv_cache_dtype,
+            'arrays': arrays,
+        }
+
+    def _handoff_executor(self) -> concurrent.futures.ThreadPoolExecutor:
+        """Lazy: engines that never hand off never pay the threads."""
+        if self._handoff_pool is None:
+            self._handoff_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=max(2, min(self.num_slots, 8)),
+                thread_name_prefix=f'{self.name}-handoff')
+        return self._handoff_pool
+
+    def _await_handoff_ack(self, st: dict) -> bool:
+        """Resolve the slot's in-flight handoff push, if any. On ack
+        the pushed watermark advances and the peer's backoff clears; on
+        failure/timeout the slot flips to degraded decode-in-place and
+        the peer backs off. Returns whether the handoff is still
+        live."""
+        pend = st.pop('hand_fut', None)
+        if pend is None:
+            return not st.get('hand_failed')
+        fut, end_blocks, prev = pend
+        req = st['req']
+        bk = self._block_k
+        budget = common_utils.env_float(
+            prefix_transfer.PUSH_BUDGET_ENV,
+            prefix_transfer.DEFAULT_PUSH_BUDGET_SECONDS)
+        try:
+            # The transport's own budget bounds the push; this outer
+            # timeout only catches a wedged transport (an abandoned
+            # future is harmless: the payload is a snapshot).
+            ok = bool(fut.result(timeout=max(2.0 * budget, 1.0)))
+            err = None
+        except Exception as e:  # pylint: disable=broad-except
+            ok = False
+            err = f'{type(e).__name__}: {e}'
+        if ok:
+            st['pushed'] = end_blocks
+            self._handoff_tokens_pushed += (end_blocks - prev) * bk
+            if req.handoff_peer:
+                self._peer_backoff_until.pop(req.handoff_peer, None)
+            return True
+        st['hand_failed'] = True
+        if req.handoff_peer:
+            self._note_peer_failure(req.handoff_peer)
+        self._handoff_degrade(req, 'push_failed', error=err,
+                              peer=req.handoff_peer,
+                              tokens_pushed=st['pushed'] * bk)
+        return False
+
+    def _push_handoff_chunk(self, st: dict) -> None:
+        """Prefill side of a handoff: stream the slot's newly-completed
+        FULL blocks to the decode peer. The partial tail block never
+        ships — the decode replica re-prefills the unaligned suffix
+        itself, which is also what makes the handed-off stream
+        token-identical to monolithic serving: its first token samples
+        from last-position logits the decode replica computed.
+
+        The push is double-buffered: the device read happens here on
+        the loop thread, but the wire transfer rides the handoff
+        executor, so chunk k streams to the peer WHILE chunk k+1
+        prefills. At most one push is in flight per slot — the
+        previous ack is awaited before the next export — which keeps
+        payloads ordered (the decode side rejects gaps) and bounds
+        host memory to one chunk of blocks. Any failure flips the slot
+        to degraded decode-in-place and backs the peer off; it never
+        raises into the step loop."""
+        req = st['req']
+        bk = self._block_k
+        end_blocks = min(st['next'], st['p']) // bk
+        if not self._await_handoff_ack(st):
+            return
+        pushed = st['pushed']
+        if end_blocks <= pushed:
+            return
+        send = st['table'][pushed:end_blocks]
+        try:
+            payload = self._export_slot_blocks(send, pushed * bk)
+        except Exception as e:  # pylint: disable=broad-except
+            st['hand_failed'] = True
+            if req.handoff_peer:
+                self._note_peer_failure(req.handoff_peer)
+            self._handoff_degrade(req, 'push_failed',
+                                  error=f'{type(e).__name__}: {e}',
+                                  peer=req.handoff_peer,
+                                  tokens_pushed=pushed * bk)
+            return
+        st['hand_fut'] = (
+            self._handoff_executor().submit(
+                req.handoff_push, req.prompt[:end_blocks * bk],
+                payload),
+            end_blocks, pushed)
+
+    def inject_handoff_blocks(self, tokens: Sequence[int],
+                              payload: dict,
+                              timeout: float = 5.0) -> dict:
+        """Cross-thread handoff injection (the model server's
+        ``/handoff_blocks`` handler and in-process benches): enqueue
+        the pushed blocks for the engine loop to install at its next
+        tick and wait bounded. Returns ``{'ok': bool, ...}`` — a
+        non-ok reply makes the prefill side degrade. The
+        ``handoff_decode_death`` chaos point fires HERE (the receiving
+        replica dies mid-handoff) so both the HTTP and in-process
+        paths exercise the prefill side's degrade."""
+        chaos.maybe_raise('handoff_decode_death')
+        if not self.paged:
+            return {'ok': False, 'error': 'not_paged'}
+        job = {'kind': 'inject', 'tokens': list(tokens),
+               'payload': payload, 'event': threading.Event(),
+               'result': None,
+               'deadline': time.monotonic() + timeout}
+        with self._export_lock:
+            self._export_jobs.append(job)
+        if job['event'].wait(timeout):
+            res = job['result']
+            if res is None:
+                return {'ok': False, 'error': 'inject_failed'}
+            return res
+        return {'ok': False, 'error': 'timeout'}
+
+    def _inject_handoff_now(self, tokens: List[int],
+                            payload: dict) -> dict:
+        """LOOP-THREAD ONLY: install one pushed handoff chunk.
+        Incremental and idempotent against the radix tree: the pushed
+        blocks extend whatever prefix is already cached for these
+        tokens (an already-covered push is an ok no-op; a push whose
+        ``from_tokens`` is past our coverage would leave a hole and is
+        refused). Validation — dtype/shape/block_k-exact — is shared
+        with the PR 15 fetch path via :meth:`_install_remote_blocks`.
+        """
+        bk = self._block_k
+        tokens = [int(t) for t in tokens]
+        try:
+            matched = int(payload.get('matched_tokens', 0))
+            from_tokens = int(payload.get('from_tokens', 0))
+        except (TypeError, ValueError):
+            matched = from_tokens = -1
+        if (matched <= 0 or matched % bk or from_tokens < 0
+                or from_tokens % bk or matched > len(tokens)):
+            return self._handoff_inject_result(
+                {'ok': False, 'error': 'malformed'})
+        blocks, path = self._radix.match(tokens[:matched])
+        m_d = len(blocks) * bk
+        try:
+            if matched <= m_d:
+                # Already covered (an earlier push, or a warm cache):
+                # idempotent ok — the prefill side keeps streaming.
+                return self._handoff_inject_result(
+                    {'ok': True, 'gained': 0})
+            if from_tokens > m_d:
+                # The push assumes blocks that were never installed (a
+                # lost earlier chunk): refusing keeps the tree
+                # hole-free.
+                return self._handoff_inject_result(
+                    {'ok': False, 'error': 'gap'})
+            skip = (m_d - from_tokens) // bk
+            arrays = payload.get('arrays') or {}
+            if skip:
+                arrays = {name: a[:, skip:]
+                          for name, a in arrays.items()}
+            adj = dict(payload, arrays=arrays, from_tokens=m_d)
+            gained = self._install_remote_blocks(
+                tokens[:matched], adj, blocks, m_d)
+        finally:
+            self._allocator.decref(blocks)
+            self._radix.release(path)
+        if gained == 'empty':
+            return self._handoff_inject_result({'ok': True, 'gained': 0})
+        if gained == 'pool_exhausted' or gained is None:
+            return self._handoff_inject_result(
+                {'ok': False, 'error': (gained or 'mismatch')})
+        self._handoff_injections += 1
+        self._handoff_tokens_injected += gained
+        return self._handoff_inject_result(
+            {'ok': True, 'gained': gained})
+
+    def _handoff_inject_result(self, res: dict) -> dict:
+        result = 'inject' if res.get('ok') else 'inject_error'
+        self._m.counter(
+            'skytpu_engine_handoffs_total',
+            'Full-request KV handoff attempts by outcome.',
+            labels=('result',)).inc(labels=(result,))
+        self._journal_raw(
+            journal.EventKind.ENGINE_HANDOFF,
+            {'outcome': result,
+             **{k: v for k, v in res.items() if k != 'ok'}})
+        return res
+
+    def handoff_stats(self) -> dict:
+        """The ``/slo`` ``handoff`` block: disaggregated
+        prefill/decode counters for one engine, both directions.
+        Snapshot reads of loop-owned ints (stale-by-one-tick at worst,
+        never torn)."""
+        return {
+            'completed': self._handoffs_completed,
+            'degraded': self._handoffs_degraded,
+            'tokens_pushed': self._handoff_tokens_pushed,
+            'injections': self._handoff_injections,
+            'tokens_injected': self._handoff_tokens_injected,
+        }
 
     # ---------------------------------------- cross-replica prefix tier
 
@@ -1643,6 +1960,14 @@ class DecodeEngine:
     def _note_peer_failure(self, peer: str) -> None:
         self._peer_backoff_until[peer] = (time.perf_counter() +
                                           self._prefix_fetch_backoff)
+
+    def peer_in_backoff(self, peer: str) -> bool:
+        """Model-server hook: is this peer inside its failure-backoff
+        window? Arming a handoff at a peer that just failed would burn
+        a push budget per chunk only to degrade — the server degrades
+        up front instead."""
+        return self._peer_backoff_until.get(peer,
+                                            0.0) > time.perf_counter()
 
     def _prefix_fetch_into_cache(self, request: Request,
                                  local_blocks: List[int],
@@ -1755,14 +2080,25 @@ class DecodeEngine:
     def _inject_fetched_prefix(self, request: Request, peer: str,
                                payload: dict, local_blocks: List[int],
                                m_full: int):
+        """Fetch-path wrapper over :meth:`_install_remote_blocks` (the
+        outcome journaling — with ``peer`` — happens in the caller)."""
+        del peer
+        return self._install_remote_blocks(request.prompt, payload,
+                                           local_blocks, m_full)
+
+    def _install_remote_blocks(self, prompt_tokens: Sequence[int],
+                               payload: dict, local_blocks: List[int],
+                               m_full: int):
         """Validate + install one peer payload: allocate pool blocks,
         scatter the fetched K/V (dtype-exact — int8 values and scale
         planes transfer verbatim), publish the extended prefix to the
-        radix tree. Returns tokens gained, ``'empty'`` (peer holds
-        nothing past what we have — a miss, not a protocol error),
-        ``'pool_exhausted'``, or None on a validation mismatch."""
+        radix tree. Shared by the PR 15 prefix fetch and the handoff
+        injection — SAME wire format, SAME validation. Returns tokens
+        gained, ``'empty'`` (peer holds nothing past what we have — a
+        miss, not a protocol error), ``'pool_exhausted'``, or None on
+        a validation mismatch."""
         bk = self._block_k
-        p = len(request.prompt)
+        p = len(prompt_tokens)
         aligned = (p // bk) * bk
         matched = int(payload.get('matched_tokens', 0))
         arrays = payload.get('arrays') or {}
@@ -1820,7 +2156,7 @@ class DecodeEngine:
             # Publish [0, matched) to the tree: the already-cached
             # branch dedupes, the fetched suffix is adopted (tree
             # takes its refs)...
-            self._radix.insert(request.prompt[:matched],
+            self._radix.insert(list(prompt_tokens[:matched]),
                                local_blocks[:m_full // bk] + new_blocks)
         except Exception:
             self._allocator.decref(new_blocks)
@@ -1898,7 +2234,10 @@ class DecodeEngine:
         return None
 
     def _service_prefix_exports(self) -> None:
-        """Drain queued export jobs (loop thread, top of every step)."""
+        """Drain queued export/inject jobs (loop thread, top of every
+        step): peers' ``/prefix_blocks`` exports and pushed
+        ``/handoff_blocks`` injections both queue here — radix/pool
+        mutation is loop-confined either way."""
         with self._export_lock:
             if not self._export_jobs:
                 return
@@ -1911,11 +2250,16 @@ class DecodeEngine:
                 job['event'].set()
                 continue
             try:
-                job['result'] = self._export_prefix_now(job['tokens'],
-                                                        job['from'])
+                if job.get('kind') == 'inject':
+                    job['result'] = self._inject_handoff_now(
+                        job['tokens'], job['payload'])
+                else:
+                    job['result'] = self._export_prefix_now(
+                        job['tokens'], job['from'])
             except Exception as e:  # pylint: disable=broad-except
-                # Export is best-effort for the PEER; this engine's
-                # loop must not crash over a read that raced an evict.
+                # Export/inject is best-effort for the PEER; this
+                # engine's loop must not crash over a read that raced
+                # an evict (the pushing side degrades on a None).
                 self._journal_raw(journal.EventKind.ENGINE_PREFIX_FETCH,
                                   {'outcome': 'export_error',
                                    'error': f'{type(e).__name__}: {e}'})
@@ -2436,6 +2780,9 @@ class DecodeEngine:
                 'prefix_evictions': self._prefix_evictions,
                 'prefix_fetch_hits': self._prefix_fetch_hits,
                 'prefix_fetch_misses': self._prefix_fetch_misses,
+                'handoffs_completed': self._handoffs_completed,
+                'handoffs_degraded': self._handoffs_degraded,
+                'handoff_injections': self._handoff_injections,
             })
         if self.dcfg.spec_k:
             out.update({

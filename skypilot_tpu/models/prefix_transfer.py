@@ -50,6 +50,13 @@ FETCH_MIN_TOKENS_ENV = 'SKYTPU_PREFIX_FETCH_MIN_TOKENS'
 # stall, forever.
 FETCH_BACKOFF_ENV = 'SKYTPU_PREFIX_FETCH_BACKOFF_SECONDS'
 DEFAULT_FETCH_BACKOFF_SECONDS = 10.0
+# Disaggregated prefill/decode handoff (push direction): the per-push
+# budget for streaming one chunk's worth of pool blocks to the decode
+# peer. Pushes happen from the prefill engine loop, so the budget bounds
+# the loop stall exactly like the fetch budget does — a slow decode peer
+# degrades the request to decode-in-place, never wedges prefill.
+PUSH_BUDGET_ENV = 'SKYTPU_HANDOFF_PUSH_BUDGET_SECONDS'
+DEFAULT_PUSH_BUDGET_SECONDS = 2.0
 
 
 def encode_array(a: np.ndarray) -> Dict[str, Any]:
@@ -163,3 +170,49 @@ def http_fetch(peer_url: str, tokens: Sequence[int], from_tokens: int,
     if isinstance(body, dict) and body.get('self'):
         return {'self': True}
     return decode_payload(body)
+
+
+def http_push(peer_url: str, tokens: Sequence[int],
+              payload: Dict[str, Any], budget_seconds: float,
+              instance: Optional[str] = None) -> bool:
+    """Handoff transport (push direction): ``POST <peer>/handoff_blocks``
+    with the prompt prefix the payload's blocks cover, returning True
+    only when the decode peer acked the injection (200 + ``ok``). Any
+    failure — timeout, non-200, injection error, malformed reply —
+    returns False and the prefill side degrades to decode-in-place.
+
+    The same wire format as the fetch direction rides the body
+    (:func:`encode_payload` fields over the engine's raw-numpy export),
+    so dtype/shape/block_k validation on the decode side is shared
+    with PR 15's fetch injection."""
+    import requests
+    from skypilot_tpu.utils import chaos
+    body = encode_payload(payload['matched_tokens'],
+                          payload['from_tokens'], payload['block_k'],
+                          payload['kv_cache_dtype'], payload['arrays'])
+    body['prompt'] = [int(t) for t in tokens]
+    body['instance'] = instance
+    data = json.dumps(body)
+    if chaos.should_fire('handoff_truncate'):
+        # Truncated block stream: ship half the serialized body. The
+        # decode side sees malformed JSON, answers non-2xx, and the
+        # prefill side degrades — the chaos e2e pins that the request
+        # is still answered.
+        data = data[:len(data) // 2]
+    half = max(budget_seconds / 2, 1e-3)
+    try:
+        resp = requests.post(
+            peer_url.rstrip('/') + '/handoff_blocks',
+            data=data, headers={'Content-Type': 'application/json'},
+            timeout=(half, half))
+    except requests.RequestException:
+        return False
+    try:
+        if resp.status_code != 200:
+            return False
+        reply = resp.json()
+    except (requests.RequestException, ValueError):
+        return False
+    finally:
+        resp.close()
+    return bool(isinstance(reply, dict) and reply.get('ok'))

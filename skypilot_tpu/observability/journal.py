@@ -152,6 +152,14 @@ class EventKind(enum.Enum):
     # fetched and injected, miss, dtype/shape mismatch, or budget
     # exhaustion degrading to plain prefill.
     ENGINE_PREFIX_FETCH = 'engine.prefix_fetch'
+    # Disaggregated prefill/decode (models/engine.py): a prefill-tier
+    # admission streaming its KV blocks to a decode-tier peer journals
+    # the handoff outcome — complete (all aligned blocks acked, slot
+    # freed), degraded (push failure / peer backoff / truncated stream:
+    # decode-in-place on the prefill replica), and the decode side's
+    # injection result — so "who served this request's tokens" is
+    # answerable per handoff.
+    ENGINE_HANDOFF = 'engine.handoff'
 
 
 KINDS = frozenset(k.value for k in EventKind)

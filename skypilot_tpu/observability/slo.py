@@ -99,6 +99,19 @@ def replica_row(body: Dict[str, Any]) -> Dict[str, Any]:
                 'p95', 0.0),
             'last_step_age_seconds': steps.get('last_step_age_seconds'),
         }
+    # Disaggregated prefill/decode: the replica's tier + its handoff
+    # counters (both directions) — fleet_rollup aggregates these into
+    # the per-tier block.
+    row['role'] = str(body.get('role') or 'mixed')
+    hand = body.get('handoff') or {}
+    if hand:
+        row['handoff'] = {
+            'completed': int(hand.get('completed', 0) or 0),
+            'degraded': int(hand.get('degraded', 0) or 0),
+            'tokens_pushed': int(hand.get('tokens_pushed', 0) or 0),
+            'injections': int(hand.get('injections', 0) or 0),
+            'tokens_injected': int(hand.get('tokens_injected', 0) or 0),
+        }
     cache = body.get('cache') or {}
     if cache:
         # Prefix-cache locality: the raw token counts ride along so the
@@ -156,6 +169,38 @@ def fleet_rollup(snapshots: Dict[str, Dict[str, Any]],
             'prefix_evictions': sum(c['prefix_evictions']
                                     for c in cache_rows),
         }
+
+    # Disaggregated tiers: one aggregate block per serving role (only
+    # when some replica actually reports a non-mixed role — an unsplit
+    # fleet's rollup stays tier-free). TTFT aggregates
+    # completed-weighted within the tier, handoff counters sum.
+    roles_seen = {r.get('role', 'mixed') for r in replicas.values()}
+    if roles_seen - {'mixed'}:
+        tiers: Dict[str, Any] = {}
+        for role in sorted(roles_seen):
+            rows = [r for r in replicas.values()
+                    if r.get('role', 'mixed') == role]
+            tier: Dict[str, Any] = {
+                'replicas': len(rows),
+                'completed': sum(r['completed'] for r in rows),
+                'in_flight': sum(r['in_flight'] for r in rows),
+            }
+            weights = [(r['ttft'], max(r['completed'], 0))
+                       for r in rows]
+            total_w = sum(w for _, w in weights)
+            tier['ttft'] = {
+                stat: (round(sum(p[stat] * w
+                                 for p, w in weights) / total_w, 6)
+                       if total_w else 0.0)
+                for stat in ('p50', 'p95')}
+            hand_rows = [r['handoff'] for r in rows if 'handoff' in r]
+            if hand_rows:
+                tier['handoff'] = {
+                    key: sum(h[key] for h in hand_rows)
+                    for key in ('completed', 'degraded', 'tokens_pushed',
+                                'injections', 'tokens_injected')}
+            tiers[role] = tier
+        fleet['tiers'] = tiers
 
     factor = common_utils.env_float(STRAGGLER_FACTOR_ENV,
                                     DEFAULT_STRAGGLER_FACTOR)

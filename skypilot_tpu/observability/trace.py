@@ -49,6 +49,12 @@ SPAN_ID_HEADER = 'X-Skytpu-Span-Id'
 # owner — the replica's engine tries that owner first when its own
 # radix cache misses (cross-replica prefix fetch).
 PREFIX_OWNER_HEADER = 'X-Skytpu-Prefix-Owner'
+# Disaggregated prefill/decode (serve/load_balancer.py `disagg`
+# policy): the LB picks the decode replica up front and carries its URL
+# on the prefill leg. The prefill replica only honors a target inside
+# its own configured peer trust set — the header selects WITHIN the
+# set, it can never introduce a URL (same rule as the owner hint).
+HANDOFF_TARGET_HEADER = 'X-Skytpu-Handoff-Target'
 
 _trace_id: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
     'skytpu_trace_id', default=None)

@@ -41,11 +41,15 @@ class _LbSyncServer:
     """The controller half of the LB↔controller sync protocol.
 
     POST /sync {"request_timestamps": [...]} →
-        {"ready_urls": [...]}  (parity: load_balancer.py:73)
+        {"ready_urls": [...], "ready_roles": {url: role}}
+    (parity: load_balancer.py:73; ready_roles feeds the LB's disagg
+    policy its prefill/decode split)
     """
 
-    def __init__(self, get_ready_urls, service_name: str = ''):
+    def __init__(self, get_ready_urls, service_name: str = '',
+                 get_ready_roles=None):
         self._get_ready_urls = get_ready_urls
+        self._get_ready_roles = get_ready_roles or (lambda: {})
         # Registry-backed request signal: the autoscaler reads its QPS
         # from this tracker, and /metrics exposes the same counter
         # (skytpu_serve_requests_total) — one signal, two consumers.
@@ -69,7 +73,8 @@ class _LbSyncServer:
                 outer.tracker.extend(
                     body.get('request_timestamps', []))
                 payload = json.dumps(
-                    {'ready_urls': outer._get_ready_urls()}).encode()
+                    {'ready_urls': outer._get_ready_urls(),
+                     'ready_roles': outer._get_ready_roles()}).encode()
                 self.send_response(200)
                 self.send_header('Content-Type', 'application/json')
                 self.send_header('Content-Length', str(len(payload)))
@@ -105,8 +110,10 @@ class SkyServeController:
             service_name, self.spec, svc['task_yaml_path'],
             version=self.version)
         self.autoscaler = autoscalers_lib.Autoscaler.make(self.spec)
-        self._sync = _LbSyncServer(self.replica_manager.ready_urls,
-                                   service_name=service_name)
+        self._sync = _LbSyncServer(
+            self.replica_manager.ready_urls,
+            service_name=service_name,
+            get_ready_roles=self.replica_manager.ready_roles)
         self._lb_proc: Optional[subprocess.Popen] = None
         # Controller-side /metrics + /healthz (env-gated; '0' binds an
         # ephemeral port and logs it).

@@ -171,6 +171,10 @@ _HOP_HEADERS = {
     # compares lower-cased, so casing tricks don't smuggle it; the LB
     # re-adds its own canonical header per attempt below.
     'x-skytpu-prefix-owner',
+    # Same rule for the disagg handoff target: only the LB names the
+    # decode replica (and the prefill replica additionally validates it
+    # against its own peer trust set — defense in depth).
+    'x-skytpu-handoff-target',
 }
 
 
@@ -447,6 +451,9 @@ class LoadBalancer:
                     timeout=aiohttp.ClientTimeout(total=10)) as resp:
                 body = await resp.json()
             self._synced_urls = list(body.get('ready_urls', []))
+            roles = body.get('ready_roles')
+            if isinstance(roles, dict):
+                self._note_roles(roles)
             return True
         except (aiohttp.ClientError, asyncio.TimeoutError,
                 json.JSONDecodeError) as e:
@@ -584,8 +591,23 @@ class LoadBalancer:
                 return url, None
 
         results = await asyncio.gather(*(pull(u) for u in urls))
-        self.fleet.update({u: body for u, body in results
-                           if isinstance(body, dict)})
+        snapshots = {u: body for u, body in results
+                     if isinstance(body, dict)}
+        self.fleet.update(snapshots)
+        # Second role source (besides the controller sync): replicas
+        # self-report their disagg role on /slo, so an in-proc LB (no
+        # controller) still builds the tier map.
+        roles = {u: b.get('role') for u, b in snapshots.items()
+                 if isinstance(b.get('role'), str)}
+        if roles:
+            self._note_roles(roles)
+
+    def _note_roles(self, roles: dict) -> None:
+        """Feed url → role observations (controller sync body, fleet
+        /slo polls) to a role-aware policy; a no-op for the rest."""
+        note = getattr(self.policy, 'note_roles', None)
+        if note is not None:
+            note({str(u): str(r) for u, r in roles.items()})
 
     async def _handle(self, request: web.Request) -> web.StreamResponse:
         tail = request.match_info['tail']
@@ -677,6 +699,136 @@ class LoadBalancer:
                                 {'replica': replica, **meta},
                                 lb_trace, lb_span)
 
+    async def _pipe_response(self, request: web.Request, resp,
+                             current: str, t_start: float,
+                             req_id: str) -> web.StreamResponse:
+        """Stream one upstream response through to the client
+        chunk-by-chunk (the disagg legs' copy of the main loop's
+        streaming tail); a mid-stream upstream error truncates hard."""
+        out_headers = {k: v for k, v in resp.headers.items()
+                       if k.lower() not in _HOP_HEADERS}
+        if not any(k.lower() == 'x-request-id' for k in out_headers):
+            out_headers[trace_lib.REQUEST_ID_HEADER] = req_id
+        out = web.StreamResponse(status=resp.status, headers=out_headers)
+        await out.prepare(request)
+        try:
+            async for chunk in resp.content.iter_chunked(64 * 1024):
+                await out.write(chunk)
+            await out.write_eof()
+            _observe_request(current, resp.status, t_start)
+        except aiohttp.ClientError as e:
+            _observe_proxy_error(current, type(e).__name__)
+            self._record_replica_failure(current, type(e).__name__)
+            out.force_close()
+            _observe_request(current, 'truncated', t_start)
+        return out
+
+    async def _proxy_disagg(self, request: web.Request, body: bytes,
+                            digest, t_start: float, req_id: str,
+                            lb_trace: str, lb_span: str,
+                            headers: dict):
+        """Disaggregated admission (the ``disagg`` policy): pick the
+        (prefill, decode) pair up front, POST the prefill leg with the
+        decode target in the hop header, then — on a completed handoff
+        — proxy the same /generate body to the decode replica, which
+        owns the token stream. The prefill replica answering
+        ``degraded`` means it decoded in place: its stream IS the
+        client's response. Returns None whenever no pair can be formed
+        or a leg fails before bytes flowed — the caller then serves
+        the request monolithically (degraded latency, never an
+        unanswered request). The whole split rides an ``lb.handoff``
+        span nested under lb.proxy."""
+        self.policy.set_ready_replicas(self._candidate_urls())
+        ctx = lb_policies.RouteContext(prefix_digest=digest,
+                                       request_id=req_id)
+        pair = self.policy.select_pair(ctx)
+        if pair is None:
+            return None
+        prefill, decode = pair
+        hand_span = trace_lib.new_span_id()
+        self._journal_trace_row(
+            journal.EventKind.SPAN_START,
+            {'name': 'lb.handoff', 'request': req_id, **ctx.meta},
+            lb_trace, hand_span, lb_span)
+        outcome = 'prefill_unreachable'
+        try:
+            pheaders = dict(headers)
+            pheaders[trace_lib.HANDOFF_TARGET_HEADER] = decode
+            pheaders[trace_lib.SPAN_ID_HEADER] = hand_span
+            self.policy.request_started(prefill)
+            try:
+                async with self._session.post(
+                        prefill.rstrip('/') + '/prefill_handoff',
+                        headers=pheaders, data=body) as resp:
+                    mode = resp.headers.get('X-Skytpu-Handoff', '')
+                    if (resp.status != 200
+                            or mode not in ('complete', 'degraded')):
+                        # 404 (replica predates the endpoint), 5xx, or
+                        # an unknown shape: monolithic fallback.
+                        if resp.status >= 500:
+                            self._record_replica_failure(
+                                prefill, f'status_{resp.status}')
+                            _observe_proxy_error(
+                                prefill, f'status_{resp.status}')
+                        outcome = f'prefill_status_{resp.status}'
+                        return None
+                    if mode == 'degraded':
+                        # Decode-in-place on the prefill replica (push
+                        # failure, untrusted/backed-off target, …): its
+                        # response answers the client.
+                        outcome = 'degraded'
+                        self._journal_hop(lb_trace, hand_span, {
+                            'phase': 'handoff_degraded',
+                            'replica': prefill, 'decode': decode})
+                        return await self._pipe_response(
+                            request, resp, prefill, t_start, req_id)
+                    await resp.json()  # drain the complete-ack body
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    ValueError) as e:
+                self._record_replica_failure(prefill, type(e).__name__)
+                _observe_proxy_error(prefill, type(e).__name__)
+                outcome = f'prefill_{type(e).__name__}'
+                return None
+            finally:
+                self.policy.request_finished(prefill)
+            # Decode leg: the pushed KV blocks are installed on
+            # `decode`; the same /generate body admits there as a
+            # (near-)full prefix hit and streams from the first decoded
+            # token. A pre-byte failure falls back to monolithic — the
+            # blocks are just cache, any replica can still answer.
+            outcome = 'decode_unreachable'
+            self._journal_hop(lb_trace, hand_span, {
+                'phase': 'handoff_decode', 'replica': decode,
+                'prefill': prefill})
+            self.policy.request_started(decode)
+            try:
+                async with self._session.post(
+                        decode.rstrip('/') + '/generate',
+                        headers=headers, data=body) as resp:
+                    if resp.status >= 500:
+                        self._record_replica_failure(
+                            decode, f'status_{resp.status}')
+                        _observe_proxy_error(decode,
+                                             f'status_{resp.status}')
+                        outcome = f'decode_status_{resp.status}'
+                        return None
+                    outcome = 'complete'
+                    return await self._pipe_response(
+                        request, resp, decode, t_start, req_id)
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                self._record_replica_failure(decode, type(e).__name__)
+                _observe_proxy_error(decode, type(e).__name__)
+                outcome = f'decode_{type(e).__name__}'
+                return None
+            finally:
+                self.policy.request_finished(decode)
+        finally:
+            self._journal_trace_row(
+                journal.EventKind.SPAN_END,
+                {'name': 'lb.handoff', 'outcome': outcome,
+                 'prefill': prefill, 'decode': decode},
+                lb_trace, hand_span, lb_span)
+
     async def _proxy(self, request: web.Request, t_start: float,
                      req_id: str, lb_trace: str,
                      lb_span: str) -> web.StreamResponse:
@@ -719,6 +871,23 @@ class LoadBalancer:
         headers[trace_lib.REQUEST_ID_HEADER] = req_id
         headers[trace_lib.TRACE_ID_HEADER] = lb_trace
         headers[trace_lib.SPAN_ID_HEADER] = lb_span
+        # Disaggregated prefill/decode: /generate admissions under the
+        # `disagg` policy try the two-leg split first; any reason it
+        # cannot complete falls through to the monolithic loop below.
+        if (isinstance(self.policy, lb_policies.DisaggPolicy)
+                and request.method == 'POST'
+                and request.match_info['tail'] == 'generate'):
+            out = await self._proxy_disagg(request, body, digest,
+                                           t_start, req_id, lb_trace,
+                                           lb_span, headers)
+            if out is not None:
+                return out
+            # Re-select: the split attempt may have ejected a replica.
+            # Keep the original pick if the fresh selection comes up
+            # empty (the loop below needs SOME url to try).
+            nxt, nxt_meta = self._select_replica(digest, req_id, ())
+            if nxt is not None:
+                url, route_meta = nxt, nxt_meta
         last_err: Optional[Exception] = None
         tried = set()
         # Connect-level failures retry ONCE against a freshly-synced

@@ -385,9 +385,92 @@ class PrefixAffinityPolicy(LeastLoadPolicy):
         return selected
 
 
+class DisaggPolicy(PrefixAffinityPolicy):
+    """Disaggregated prefill/decode routing (docs/serving.md).
+
+    The fleet is split into tiers by per-replica role (the service
+    spec's ``prefill_replicas`` split, surfaced through ``/slo`` and
+    the controller's ready-set sync; a replica whose role is unknown
+    counts as ``mixed`` and is eligible for both tiers).
+    :meth:`select_pair` picks both legs up front:
+
+    * the DECODE target by a prefix-affinity ring walk over the decode
+      tier — the handed-off request re-admits there, so landing it on
+      the replica whose radix cache already holds the prefix makes the
+      injection incremental instead of full;
+    * the PREFILL replica least-loaded over the prefill tier
+      (prefill work is compute-bound and prefix-agnostic once the
+      handoff streams the blocks out).
+
+    When either tier is empty, or the only candidate for both legs is
+    the same replica, there is no pair — the LB falls back to the
+    inherited monolithic selection (prefix-affinity over everything),
+    which is also what every non-generate request uses."""
+
+    def __init__(self, vnodes: Optional[int] = None,
+                 load_factor: Optional[float] = None):
+        super().__init__(vnodes=vnodes, load_factor=load_factor)
+        self._roles: Dict[str, str] = {}
+
+    def note_roles(self, roles: Dict[str, str]) -> None:
+        """Merge a url → role observation (fleet-SLO poll, controller
+        sync). Roles persist across ready-set flaps: a briefly
+        not-ready replica keeps its tier when it returns."""
+        with self._lock:
+            for url, role in roles.items():
+                if url and role in ('prefill', 'decode', 'mixed'):
+                    self._roles[url.rstrip('/')] = role
+
+    def roles(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._roles)
+
+    def _role(self, url: str) -> str:
+        return self._roles.get(url.rstrip('/'), 'mixed')
+
+    def _tier(self, eligible: List[str], tier: str) -> List[str]:
+        return [u for u in eligible if self._role(u) in (tier, 'mixed')]
+
+    def select_pair(self, context: Optional[RouteContext] = None):
+        """``(prefill_url, decode_url)`` for one admission, or None
+        when no disaggregated pair can be formed (the LB then serves
+        the request monolithically)."""
+        digest = context.prefix_digest if context is not None else None
+        with self._lock:
+            eligible = self._eligible(context)
+            prefills = self._tier(eligible, 'prefill')
+            decodes = self._tier(eligible, 'decode')
+            if not prefills or not decodes:
+                return None
+            decode = None
+            if digest is not None:
+                bound = self._load_bound(len(decodes))
+                allowed = set(decodes)
+                for url in self.ring.ordered_owners(digest):
+                    if (url in allowed
+                            and self._inflight.get(url, 0) < bound):
+                        decode = url
+                        break
+            if decode is None:
+                decode = min(decodes,
+                             key=lambda u: self._inflight.get(u, 0))
+            pre = [u for u in prefills if u != decode]
+            if not pre:
+                # The decode pick is the whole prefill tier (1-replica
+                # mixed fleet): a self-handoff is pure overhead.
+                return None
+            prefill = min(pre, key=lambda u: self._inflight.get(u, 0))
+        if context is not None:
+            context.meta.update({'disagg': True, 'prefill': prefill,
+                                 'decode': decode,
+                                 'digest': digest})
+        return prefill, decode
+
+
 _POLICIES = {
     'round_robin': RoundRobinPolicy,
     'least_load': LeastLoadPolicy,
     'random': RandomPolicy,
     'prefix_affinity': PrefixAffinityPolicy,
+    'disagg': DisaggPolicy,
 }

@@ -127,6 +127,13 @@ SPEC_DRAFTER_LAYERS_ENV = 'SKYTPU_SPEC_DRAFTER_LAYERS'
 # so the degree may span the whole slice's devices — one replica per
 # SLICE, serving models larger than one host's HBM.
 SERVE_TP_ENV = 'SKYTPU_SERVE_TP'
+# Disaggregated prefill/decode: this replica's serving role. The
+# replica manager injects it from the service spec's
+# `prefill_replicas` split; it is surfaced on /healthz and /slo so the
+# LB's `disagg` policy can build its role map. `mixed` (the default)
+# is monolithic serving.
+REPLICA_ROLE_ENV = 'SKYTPU_REPLICA_ROLE'
+_ROLES = ('prefill', 'decode', 'mixed')
 
 # skytpu_server_state gauge values (the LB/operators read the metric;
 # /healthz carries the string).
@@ -150,11 +157,18 @@ class ModelServer:
 
     def __init__(self, engine: engine_lib.DecodeEngine, port: int,
                  host: str = '0.0.0.0',
-                 default_max_new_tokens: int = 128):
+                 default_max_new_tokens: int = 128,
+                 role: Optional[str] = None):
         self.engine = engine
         self.host = host
         self.port = port  # rebound to the OS-assigned port when 0
         self.default_max_new_tokens = default_max_new_tokens
+        # Disaggregated serving role (prefill|decode|mixed); anything
+        # unrecognized degrades to mixed — a typo'd role must serve,
+        # not crash the replica.
+        role = (role or os.environ.get(REPLICA_ROLE_ENV)
+                or 'mixed').strip().lower()
+        self.role = role if role in _ROLES else 'mixed'
         try:
             self.request_timeout = float(
                 os.environ.get(REQUEST_TIMEOUT_ENV, '300'))
@@ -348,7 +362,11 @@ class ModelServer:
     async def _setup(self) -> None:
         app = web.Application()
         app.router.add_post('/generate', self._handle_generate)
+        app.router.add_post('/prefill_handoff',
+                            self._handle_prefill_handoff)
         app.router.add_post('/prefix_blocks', self._handle_prefix_blocks)
+        app.router.add_post('/handoff_blocks',
+                            self._handle_handoff_blocks)
         app.router.add_post('/drain', self._handle_drain)
         app.router.add_get('/healthz', self._handle_healthz)
         app.router.add_get('/metrics', self._handle_metrics)
@@ -378,6 +396,41 @@ class ModelServer:
 
     # ----------------------------------------------------------- handlers
 
+    def _parse_prompt_body(self, body):
+        """Shared /generate + /prefill_handoff body validation:
+        ``(tokens, max_new, None)`` or ``(None, 0, error_response)``."""
+        vocab = self.engine.cfg.vocab_size
+        if 'prompt' in body:
+            try:
+                tokens = [int(t) % vocab for t in body['prompt']]
+            except (TypeError, ValueError):
+                return None, 0, web.json_response(
+                    {'error': 'prompt must be a list of token ids'},
+                    status=400)
+        elif 'text' in body and isinstance(body['text'], str):
+            tokens = encode_text(body['text'], vocab)
+        else:
+            return None, 0, web.json_response(
+                {'error': 'body needs "prompt" (token ids) or "text"'},
+                status=400)
+        if not tokens:
+            return None, 0, web.json_response({'error': 'empty prompt'},
+                                              status=400)
+        try:
+            max_new = int(body.get('max_new_tokens',
+                                   self.default_max_new_tokens))
+        except (TypeError, ValueError):
+            return None, 0, web.json_response(
+                {'error': 'max_new_tokens must be an integer'},
+                status=400)
+        limit = self.engine.dcfg.max_len - len(tokens)
+        if limit < 1:
+            return None, 0, web.json_response(
+                {'error': f'prompt too long: {len(tokens)} tokens, '
+                          f'max_len {self.engine.dcfg.max_len}'},
+                status=400)
+        return tokens, max(1, min(max_new, limit)), None
+
     async def _handle_generate(self, request: web.Request
                                ) -> web.StreamResponse:
         # Chaos: a pre-byte replica 500 (the LB's circuit breaker and
@@ -402,37 +455,9 @@ class ModelServer:
         except (json.JSONDecodeError, UnicodeDecodeError):
             return web.json_response({'error': 'invalid JSON body'},
                                      status=400)
-        vocab = self.engine.cfg.vocab_size
-        if 'prompt' in body:
-            try:
-                tokens = [int(t) % vocab for t in body['prompt']]
-            except (TypeError, ValueError):
-                return web.json_response(
-                    {'error': 'prompt must be a list of token ids'},
-                    status=400)
-        elif 'text' in body and isinstance(body['text'], str):
-            tokens = encode_text(body['text'], vocab)
-        else:
-            return web.json_response(
-                {'error': 'body needs "prompt" (token ids) or "text"'},
-                status=400)
-        if not tokens:
-            return web.json_response({'error': 'empty prompt'},
-                                     status=400)
-        try:
-            max_new = int(body.get('max_new_tokens',
-                                   self.default_max_new_tokens))
-        except (TypeError, ValueError):
-            return web.json_response(
-                {'error': 'max_new_tokens must be an integer'},
-                status=400)
-        limit = self.engine.dcfg.max_len - len(tokens)
-        if limit < 1:
-            return web.json_response(
-                {'error': f'prompt too long: {len(tokens)} tokens, '
-                          f'max_len {self.engine.dcfg.max_len}'},
-                status=400)
-        max_new = max(1, min(max_new, limit))
+        tokens, max_new, err = self._parse_prompt_body(body)
+        if err is not None:
+            return err
         stream = bool(body.get('stream', True))
         # Backpressure BEFORE enqueueing: a full admission queue answers
         # 429 with a (fixed 1 s) Retry-After hint instead of parking
@@ -524,23 +549,175 @@ class ModelServer:
                 trace_id=trace_id, span_id=span_id,
                 parent_span_id=parent_span, entity=self._entity())
 
+    async def _handle_prefill_handoff(self, request: web.Request
+                                      ) -> web.StreamResponse:
+        """Disaggregated prefill leg (LB ``disagg`` policy): run the
+        (chunked) prefill here, streaming the request's KV blocks to
+        the decode replica named by ``X-Skytpu-Handoff-Target`` as
+        chunks complete. A completed handoff answers one JSON object
+        (header ``X-Skytpu-Handoff: complete``) and the DECODE replica
+        owns the token stream from the first decoded token; any reason
+        the handoff cannot run or fails mid-push degrades to
+        decode-in-place — the reply is then the normal /generate
+        response (header ``X-Skytpu-Handoff: degraded``), so the
+        request is answered either way.
+
+        Trust rule: the target header only selects WITHIN this
+        replica's configured peer list — it can never introduce a URL
+        (pushing a tenant's KV to an attacker-supplied address would
+        be prompt exfiltration; the peers list is the trust set, same
+        as the fetch direction's owner hint)."""
+        if self._state != 'running':
+            return web.json_response(
+                {'error': f'server {self._state}', 'state': self._state},
+                status=503, headers={'Retry-After': '1'})
+        if self.engine.failed:
+            return web.json_response(
+                {'error': f'engine failed: {self.engine.fail_reason}'},
+                status=503, headers={'Retry-After': '30'})
+        try:
+            body = await request.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return web.json_response({'error': 'invalid JSON body'},
+                                     status=400)
+        tokens, max_new, err = self._parse_prompt_body(body)
+        if err is not None:
+            return err
+        stream = bool(body.get('stream', True))
+        if self.max_queue > 0:
+            depth = self.engine.queue_depth()
+            if depth >= self.max_queue:
+                metrics_lib.counter(
+                    'skytpu_server_rejected_total',
+                    'Requests rejected with 429 (queue full).').inc()
+                return web.json_response(
+                    {'error': f'queue full ({depth} waiting)'},
+                    status=429, headers={'Retry-After': '1'})
+        target = (request.headers.get(trace_lib.HANDOFF_TARGET_HEADER)
+                  or '').strip().rstrip('/')
+        # Resolve the header back to the configured peer entry so the
+        # engine's per-peer backoff map keys stay consistent between
+        # the fetch and push directions.
+        peers = {u.rstrip('/'): u for u in self.engine.prefix_peers}
+        peer = peers.get(target)
+        degrade = None
+        if not self.engine.paged:
+            degrade = 'not_paged'
+        elif not target:
+            degrade = 'no_target'
+        elif peer is None:
+            degrade = 'untrusted_target'
+        elif self.engine.peer_in_backoff(peer):
+            degrade = 'peer_backoff'
+        if degrade is not None:
+            # Count + journal here (the engine never sees a handoff
+            # request it cannot arm), then serve as a plain generate.
+            metrics_lib.counter(
+                'skytpu_engine_handoffs_total',
+                'Full-request KV handoff attempts by outcome.',
+                labels=('result',)).inc(labels=('degraded',))
+            journal.event(journal.EventKind.ENGINE_HANDOFF,
+                          self._entity(),
+                          {'outcome': 'degraded', 'reason': degrade,
+                           'target': target or None})
+        tenant = (request.headers.get('X-Tenant')
+                  or body.get('tenant') or 'default')
+        request_id = (request.headers.get(trace_lib.REQUEST_ID_HEADER)
+                      or trace_lib.new_trace_id())
+        trace_id = (request.headers.get(trace_lib.TRACE_ID_HEADER)
+                    or request_id)
+        parent_span = request.headers.get(trace_lib.SPAN_ID_HEADER)
+        span_id = trace_lib.new_span_id()
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def on_token(token: int, done: bool) -> None:
+            loop.call_soon_threadsafe(q.put_nowait, (token, done))
+
+        req = engine_lib.Request(tokens, max_new, on_token=on_token,
+                                 tenant=str(tenant), trace_id=trace_id,
+                                 span_id=span_id)
+        req.on_finish = lambda: loop.call_soon_threadsafe(
+            q.put_nowait, (None, True))
+        if degrade is None:
+            budget = common_utils.env_float(
+                prefix_transfer.PUSH_BUDGET_ENV,
+                prefix_transfer.DEFAULT_PUSH_BUDGET_SECONDS)
+            req.handoff_peer = peer
+            req.handoff_push = functools.partial(
+                prefix_transfer.http_push, peer,
+                budget_seconds=budget,
+                instance=self.engine.instance_id)
+        self.engine.journal_buffered(
+            journal.EventKind.SPAN_START,
+            {'name': 'server.handoff', 'request': req.id,
+             'tenant': req.tenant, 'prompt_len': len(tokens),
+             'target': target or None, 'degraded_at_admission': degrade},
+            trace_id=trace_id, span_id=span_id,
+            parent_span_id=parent_span, entity=self._entity())
+        self.engine.submit(req)
+        metrics_lib.counter('skytpu_engine_requests_total',
+                            'HTTP /generate requests accepted.',
+                            labels=('stream',)).inc(
+                                labels=(str(stream).lower(),))
+        try:
+            try:
+                first = await self._next_token(q)
+            except asyncio.TimeoutError:
+                return web.json_response(
+                    {'error': 'timeout'}, status=504,
+                    headers={'X-Request-Id': req.trace_id or req.id})
+            if first[0] is None and req.finish_reason == 'handoff':
+                # Handed off: every block acked, the prefill side freed
+                # its pool blocks, the decode target owns the stream.
+                return web.json_response(
+                    {'handoff': 'complete', 'decode_url': peer,
+                     'prompt_len': len(tokens),
+                     'max_new_tokens': max_new},
+                    headers={'X-Skytpu-Handoff': 'complete',
+                             'X-Request-Id': req.trace_id or req.id})
+            hdr = {'X-Skytpu-Handoff': 'degraded'}
+            if stream:
+                return await self._stream_response(request, req, q,
+                                                   first=first,
+                                                   extra_headers=hdr)
+            return await self._unary_response(req, q, first=first,
+                                              extra_headers=hdr)
+        finally:
+            self.engine.journal_buffered(
+                journal.EventKind.SPAN_END,
+                {'name': 'server.handoff',
+                 'finish_reason': req.finish_reason,
+                 'generated': len(req.tokens)},
+                trace_id=trace_id, span_id=span_id,
+                parent_span_id=parent_span, entity=self._entity())
+
     async def _next_token(self, q: asyncio.Queue):
         return await asyncio.wait_for(q.get(),
                                       timeout=self.request_timeout)
 
     async def _stream_response(self, http_request: web.Request,
-                               req: engine_lib.Request, q: asyncio.Queue
+                               req: engine_lib.Request, q: asyncio.Queue,
+                               first=None, extra_headers=None
                                ) -> web.StreamResponse:
-        resp = web.StreamResponse(
-            status=200,
-            headers={'Content-Type': 'text/event-stream',
-                     'Cache-Control': 'no-cache',
-                     'X-Request-Id': req.trace_id or req.id,
-                     'X-Accel-Buffering': 'no'})
+        headers = {'Content-Type': 'text/event-stream',
+                   'Cache-Control': 'no-cache',
+                   'X-Request-Id': req.trace_id or req.id,
+                   'X-Accel-Buffering': 'no'}
+        if extra_headers:
+            headers.update(extra_headers)
+        resp = web.StreamResponse(status=200, headers=headers)
         await resp.prepare(http_request)
         try:
             while True:
-                token, done = await self._next_token(q)
+                # `first`: an event the caller already pulled off the
+                # queue deciding the response shape (/prefill_handoff's
+                # complete-vs-degraded split).
+                if first is not None:
+                    token, done = first
+                    first = None
+                else:
+                    token, done = await self._next_token(q)
                 if token is None:
                     # Terminal sentinel with no token: engine-side
                     # rejection/error. (After a normal final token the
@@ -566,11 +743,18 @@ class ModelServer:
         return resp
 
     async def _unary_response(self, req: engine_lib.Request,
-                              q: asyncio.Queue) -> web.Response:
+                              q: asyncio.Queue, first=None,
+                              extra_headers=None) -> web.Response:
         rid = {'X-Request-Id': req.trace_id or req.id}
+        if extra_headers:
+            rid.update(extra_headers)
         try:
             while True:
-                token, done = await self._next_token(q)
+                if first is not None:
+                    token, done = first
+                    first = None
+                else:
+                    token, done = await self._next_token(q)
                 if done:
                     break
         except asyncio.TimeoutError:
@@ -613,7 +797,8 @@ class ModelServer:
                  self._engine_thread.is_alive())
         staleness = self.staleness_seconds()
         stats = self.engine.stats()
-        line = ' '.join(f'{k}={v}' for k, v in stats.items())
+        line = ' '.join([f'role={self.role}'] +
+                        [f'{k}={v}' for k, v in stats.items()])
         if self.engine.failed:
             # Permanent: the supervisor's restart budget is spent. This
             # 503 never clears — the replica manager's probe/retry
@@ -678,6 +863,12 @@ class ModelServer:
         # Prefix-cache locality + pressure: what the LB's fleet SLO
         # poll aggregates into skytpu_fleet_prefix_hit_ratio.
         body['cache'] = self.engine.cache_stats()
+        # Disaggregated prefill/decode: the replica's role plus both
+        # directions' handoff counters — the fleet SLO poll aggregates
+        # these into the per-tier rollup, and the LB's `disagg` policy
+        # reads `role` to build its routing map.
+        body['role'] = self.role
+        body['handoff'] = self.engine.handoff_stats()
         # Engine-step snapshot (aggregates only, no ring rows): the
         # fleet SLO aggregator pulls /slo on the LB's probe cadence and
         # needs the step-time/stall/heartbeat signal beside the request
@@ -750,6 +941,59 @@ class ModelServer:
                 result['block_k'], result['kv_cache_dtype'],
                 result['arrays']))
         return web.json_response(payload)
+
+    async def _handle_handoff_blocks(self, request: web.Request
+                                     ) -> web.Response:
+        """Disaggregated handoff, decode side: a prefill-tier peer
+        POSTs one chunk's worth of a still-prefilling request's KV
+        blocks (the prefix tier's wire format + a ``prompt`` echo); the
+        engine loop installs them incrementally into the pool + radix
+        tree so the re-routed request admits as a (near-)full prefix
+        hit. Refusals mirror ``/prefix_blocks``: 400 unpaged, 404 when
+        no peer trust set is configured — and 503 while draining, so a
+        draining decode replica pushes the prefill side into its
+        degrade path (answer in place) instead of accepting blocks it
+        is about to drop."""
+        if not self.engine.paged:
+            return web.json_response(
+                {'ok': False, 'error': 'replica is not paged'},
+                status=400)
+        if not self.engine.prefix_peers:
+            # Same trust model as /prefix_blocks: a replica not
+            # configured into the tier must not accept KV pushed to
+            # whoever reaches its port (cache poisoning).
+            return web.json_response(
+                {'ok': False, 'error': 'handoff tier not configured '
+                                       '(SKYTPU_PREFIX_PEERS)'},
+                status=404)
+        if self._state != 'running':
+            return web.json_response(
+                {'ok': False, 'error': f'server {self._state}'},
+                status=503, headers={'Retry-After': '1'})
+        try:
+            body = await request.json()
+            tokens = [int(t) for t in body['prompt']]
+        except (json.JSONDecodeError, UnicodeDecodeError, KeyError,
+                TypeError, ValueError):
+            return web.json_response(
+                {'ok': False, 'error': 'malformed body'}, status=400)
+        payload = await asyncio.get_running_loop().run_in_executor(
+            None, functools.partial(prefix_transfer.decode_payload,
+                                    body))
+        if payload is None:
+            return web.json_response(
+                {'ok': False, 'error': 'malformed payload'}, status=400)
+        try:
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, functools.partial(
+                    self.engine.inject_handoff_blocks, tokens, payload))
+        except chaos.ChaosError as e:
+            # handoff_decode_death: this decode replica "dies"
+            # mid-handoff — a 500 mid-stream makes the prefill side
+            # degrade exactly like a real peer death would.
+            return web.json_response(
+                {'ok': False, 'error': str(e)}, status=500)
+        return web.json_response(result)
 
     async def _handle_drain(self, request: web.Request) -> web.Response:
         initiated = self.begin_drain('http')
@@ -891,6 +1135,12 @@ def main() -> None:
                              'LB-advertised owner) instead of '
                              're-prefilling (default SKYTPU_PREFIX_PEERS '
                              'or disabled)')
+    parser.add_argument('--role', choices=_ROLES, default=None,
+                        help='disaggregated serving role (default '
+                             'SKYTPU_REPLICA_ROLE or mixed): prefill '
+                             'replicas hand requests off to a decode '
+                             'peer after prefill; decode replicas '
+                             'adopt them; mixed serves monolithically')
     parser.add_argument('--checkpoint-dir', default=None,
                         help='restore params from models/checkpoint '
                              'layout (default: random init — demo mode)')
@@ -927,7 +1177,8 @@ def main() -> None:
                                if u.strip()]
                               if args.prefix_peers else None))
     server = ModelServer(engine, args.port, host=args.host,
-                         default_max_new_tokens=args.max_new_tokens)
+                         default_max_new_tokens=args.max_new_tokens,
+                         role=args.role)
     server.run_forever()
     if server.startup_error is not None:
         raise SystemExit(f'Model server failed to start: '

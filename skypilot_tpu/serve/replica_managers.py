@@ -39,6 +39,10 @@ _MAX_FAILED_REPLICAS = int(os.environ.get('SKYTPU_SERVE_MAX_FAILURES',
 
 REPLICA_PORT_ENV = 'SKYTPU_REPLICA_PORT'
 REPLICA_ID_ENV = 'SKYTPU_REPLICA_ID'
+# Disaggregated prefill/decode: the replica's serving role
+# (prefill|decode|mixed), derived from the spec's prefill_replicas
+# split and read by serve/model_server.py.
+REPLICA_ROLE_ENV = 'SKYTPU_REPLICA_ROLE'
 # Shared with serve/model_server.py: how long a draining replica's
 # in-flight requests get before teardown proceeds.
 DRAIN_TIMEOUT_ENV = 'SKYTPU_DRAIN_TIMEOUT_SECONDS'
@@ -240,6 +244,7 @@ class ReplicaManager:
         task.update_envs({
             REPLICA_PORT_ENV: str(port),
             REPLICA_ID_ENV: str(replica_id),
+            REPLICA_ROLE_ENV: self.spec.role_for_replica(replica_id),
         })
         if ondemand_fallback:
             # The fallback pool rides assured capacity.
@@ -491,3 +496,12 @@ class ReplicaManager:
         return [r['endpoint']
                 for r in serve_state.get_replicas(self.service_name)
                 if r['status'] == ReplicaStatus.READY and r['endpoint']]
+
+    def ready_roles(self) -> Dict[str, str]:
+        """endpoint → serving role for READY replicas (the LB's disagg
+        policy splits its ready set by this; an unsplit spec reports
+        everything 'mixed')."""
+        return {r['endpoint']:
+                self.spec.role_for_replica(r['replica_id'])
+                for r in serve_state.get_replicas(self.service_name)
+                if r['status'] == ReplicaStatus.READY and r['endpoint']}

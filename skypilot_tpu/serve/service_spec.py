@@ -42,7 +42,8 @@ class SkyServiceSpec:
                  downscale_delay_seconds: Optional[float] = None,
                  base_ondemand_fallback_replicas: int = 0,
                  dynamic_ondemand_fallback: bool = False,
-                 spot_placer: Optional[str] = None):
+                 spot_placer: Optional[str] = None,
+                 prefill_replicas: Optional[int] = None):
         if not readiness_path.startswith('/'):
             raise exceptions.InvalidSkyError(
                 f'readiness_probe path must start with "/": '
@@ -68,6 +69,16 @@ class SkyServiceSpec:
             raise exceptions.InvalidSkyError(
                 f'Unknown spot_placer {spot_placer!r}; expected '
                 "'dynamic_fallback'.")
+        if prefill_replicas is not None:
+            if prefill_replicas < 1:
+                raise exceptions.InvalidSkyError(
+                    'prefill_replicas must be >= 1 (omit it for an '
+                    'all-mixed fleet).')
+            if prefill_replicas >= min_replicas:
+                raise exceptions.InvalidSkyError(
+                    f'prefill_replicas ({prefill_replicas}) must leave '
+                    f'at least one decode replica (min_replicas='
+                    f'{min_replicas}).')
         self.readiness_path = readiness_path
         self.initial_delay_seconds = initial_delay_seconds
         self.readiness_timeout_seconds = readiness_timeout_seconds
@@ -82,6 +93,20 @@ class SkyServiceSpec:
             base_ondemand_fallback_replicas
         self.dynamic_ondemand_fallback = dynamic_ondemand_fallback
         self.spot_placer = spot_placer
+        # Disaggregated prefill/decode: the first `prefill_replicas`
+        # replica ids run role=prefill, the rest role=decode; None
+        # keeps every replica role=mixed (monolithic serving).
+        self.prefill_replicas = prefill_replicas
+
+    def role_for_replica(self, replica_id: int) -> str:
+        """Per-replica serving role under the disaggregated split.
+        Replica ids are 1-based (serve_state.next_replica_id): ids
+        [1, prefill_replicas] prefill, the rest decode; an unsplit
+        fleet is all 'mixed'."""
+        if self.prefill_replicas is None:
+            return 'mixed'
+        return ('prefill' if replica_id <= self.prefill_replicas
+                else 'decode')
 
     @property
     def autoscaling_enabled(self) -> bool:
@@ -124,6 +149,7 @@ class SkyServiceSpec:
             dynamic_ondemand_fallback=policy.get(
                 'dynamic_ondemand_fallback', False),
             spot_placer=policy.get('spot_placer'),
+            prefill_replicas=policy.get('prefill_replicas'),
         )
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -157,6 +183,9 @@ class SkyServiceSpec:
             cfg['replica_policy']['dynamic_ondemand_fallback'] = True
         if self.spot_placer is not None:
             cfg['replica_policy']['spot_placer'] = self.spot_placer
+        if self.prefill_replicas is not None:
+            cfg['replica_policy']['prefill_replicas'] = \
+                self.prefill_replicas
         return cfg
 
     def __repr__(self) -> str:

@@ -55,6 +55,31 @@ def _exec(payload: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+def _paginate(rows: List[Any], payload: Dict[str, Any]) -> List[Any]:
+    """Optional ``limit``/``offset`` window over a deterministic row
+    list (/status and /fleet grow with the fleet; a dashboard polling
+    hundreds of clusters pages instead of re-shipping everything).
+
+    Opt-in: with neither knob in the payload the full list comes back,
+    so existing clients are unchanged. An offset past the end is an
+    empty page, not an error (the fleet may have shrunk between
+    pages); malformed values fall back to the unpaginated view rather
+    than failing the request."""
+    try:
+        offset = max(int(payload.get('offset') or 0), 0)
+    except (TypeError, ValueError):
+        offset = 0
+    rows = rows[offset:]
+    limit = payload.get('limit')
+    try:
+        limit = None if limit is None else int(limit)
+    except (TypeError, ValueError):
+        limit = None
+    if limit is not None and limit >= 0:
+        rows = rows[:limit]
+    return rows
+
+
 def _status(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
     from skypilot_tpu import core
     records = core.status(cluster_names=payload.get('cluster_names'),
@@ -90,14 +115,16 @@ def _status(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
         if r['name'] in fleet_by_name:
             rec['fleet'] = fleet_by_name[r['name']]
         out.append(rec)
-    return out
+    return _paginate(out, payload)
 
 
 def _fleet(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
     from skypilot_tpu import core
-    return core.fleet_status(
-        cluster_names=payload.get('cluster_names'),
-        window_seconds=payload.get('window_seconds', 120.0))
+    return _paginate(
+        core.fleet_status(
+            cluster_names=payload.get('cluster_names'),
+            window_seconds=payload.get('window_seconds', 120.0)),
+        payload)
 
 
 def _kubernetes_status(payload: Dict[str, Any]) -> List[Dict[str, Any]]:

@@ -38,6 +38,16 @@ Registered points (grep for ``chaos.`` call sites):
 ``replica_500``        the model server answers ``/generate`` with a 500
                        before touching the engine (a pre-byte replica
                        failure — LB failover + circuit-breaker food).
+``handoff_decode_death``  the decode replica "dies" mid-handoff:
+                       ``DecodeEngine.inject_handoff_blocks`` raises
+                       :class:`ChaosError` before touching the pool, so
+                       the prefill side's push fails and the request
+                       degrades to decode-in-place (answered, never
+                       hung).
+``handoff_truncate``   the prefill side's ``http_push`` ships only half
+                       the serialized block payload — the decode side
+                       rejects the malformed body and the prefill side
+                       degrades.
 =====================  ====================================================
 
 Default **off**: with ``SKYTPU_CHAOS`` unset every check is one dict

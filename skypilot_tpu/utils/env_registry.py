@@ -155,6 +155,18 @@ _e('SKYTPU_PREFIX_FETCH_BACKOFF_SECONDS', '10',
    'error, malformed reply) is skipped before being retried — one '
    'dead peer must not stall every cold admission.',
    'skypilot_tpu/models/prefix_transfer.py', 'serving')
+_e('SKYTPU_REPLICA_ROLE', None,
+   'Disaggregated serving role for this replica: prefill | decode | '
+   'mixed (default mixed). Prefill replicas run chunked prefill and '
+   'stream each request\'s KV blocks to a decode replica; decode '
+   'replicas own the token stream. Advertised via /healthz and /slo '
+   'so the LB\'s disagg policy can pair tiers.',
+   'skypilot_tpu/serve/model_server.py', 'serving')
+_e('SKYTPU_HANDOFF_PUSH_BUDGET_SECONDS', '2.0',
+   'Wall-clock budget for ONE handoff chunk push to the decode peer; '
+   'past it the prefill side degrades the request to decode-in-place '
+   '(answered locally) and backs the peer off.',
+   'skypilot_tpu/models/prefix_transfer.py', 'serving')
 _e('SKYTPU_LB_EJECT_PROBE_INTERVAL', '1',
    'How often the LB probes ejected replicas\' /healthz for '
    'reinstatement.',
@@ -188,7 +200,8 @@ _e('SKYTPU_SERVE_DOWN_TIMEOUT', '300',
    'skypilot_tpu/serve/core.py', 'serving')
 _e('SKYTPU_CHAOS', None,
    'Fault-injection spec (engine_step_raise:N,slow_step:p,drain_hang,'
-   'replica_500:p); unset = off.',
+   'replica_500:p,handoff_decode_death,handoff_truncate); unset = '
+   'off.',
    'skypilot_tpu/utils/chaos.py', 'serving')
 _e('SKYTPU_CHAOS_SLOW_STEP_SECONDS', '0.2',
    'Injected engine-step delay for the slow_step chaos point.',

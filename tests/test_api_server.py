@@ -57,6 +57,12 @@ def test_sdk_roundtrip(api_env):
     assert records[0]['name'] == 'api-c1'
     assert records[0]['status'] == 'UP'
 
+    # Pagination passthrough: a one-cluster fleet pages to itself,
+    # and an offset past the end is an empty page (not an error).
+    assert len(sdk.get(sdk.status(limit=1))) == 1
+    assert sdk.get(sdk.status(offset=1)) == []
+    assert sdk.get(sdk.fleet(limit=0)) == []
+
     # queue + wait job done.
     deadline = time.time() + 60
     while time.time() < deadline:
@@ -80,6 +86,22 @@ def test_sdk_roundtrip(api_env):
 
     sdk.get(sdk.down('api-c1'))
     assert sdk.get(sdk.status()) == []
+
+
+def test_status_pagination_window():
+    """_paginate is a pure windowing helper: opt-in, clamped, and
+    forgiving of malformed knobs (bad values mean 'no pagination',
+    never a failed /status)."""
+    from skypilot_tpu.server import requests_impl
+    rows = list(range(10))
+    page = requests_impl._paginate
+    assert page(rows, {}) == rows
+    assert page(rows, {'limit': 3}) == [0, 1, 2]
+    assert page(rows, {'limit': 3, 'offset': 8}) == [8, 9]
+    assert page(rows, {'offset': 50}) == []
+    assert page(rows, {'limit': 0}) == []
+    assert page(rows, {'limit': 'junk', 'offset': None}) == rows
+    assert page(rows, {'limit': -1, 'offset': -5}) == rows
 
 
 def test_sdk_error_reconstruction(api_env):

@@ -494,3 +494,115 @@ def test_lb_last_attempt_proxies_5xx_instead_of_generic_502(monkeypatch):
         lb.stop()
         for srv, _ in backends:
             srv.shutdown()
+
+
+# ------------------------------------------- disaggregated handoff chaos
+
+
+def _disagg_pair():
+    """A paged prefill+decode server pair wired as each other's trust
+    set (the decode side refuses pushed KV from outside its configured
+    peer list)."""
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+
+    def dcfg():
+        return decode.DecodeConfig(max_len=64, temperature=0.0,
+                                   decode_attention='xla',
+                                   kernel_block_k=8)
+
+    d_eng = engine_lib.DecodeEngine(params, CFG, dcfg(), 2, paged=True,
+                                    num_blocks=33, prefill_chunk=8,
+                                    name='chaos-hd-d',
+                                    prefix_peers=['pending'])
+    d_srv = model_server.ModelServer(d_eng, port=0, host='127.0.0.1',
+                                     role='decode')
+    d_url = f'http://127.0.0.1:{d_srv.start()}'
+    p_eng = engine_lib.DecodeEngine(params, CFG, dcfg(), 2, paged=True,
+                                    num_blocks=33, prefill_chunk=8,
+                                    name='chaos-hd-p',
+                                    prefix_peers=[d_url])
+    p_srv = model_server.ModelServer(p_eng, port=0, host='127.0.0.1',
+                                     role='prefill')
+    p_url = f'http://127.0.0.1:{p_srv.start()}'
+    d_eng.prefix_peers[:] = [p_url]
+    return (p_srv, p_eng, p_url), (d_srv, d_eng, d_url)
+
+
+_HANDOFF_PROMPT = list(range(1, 29))  # 3 aligned blocks + 4-token tail
+
+
+def _prefill_handoff(p_url, d_url, timeout=60):
+    from skypilot_tpu.observability import trace as trace_lib
+    return requests.post(
+        f'{p_url}/prefill_handoff',
+        json={'prompt': _HANDOFF_PROMPT, 'max_new_tokens': 6,
+              'stream': False},
+        headers={trace_lib.HANDOFF_TARGET_HEADER: d_url},
+        timeout=timeout)
+
+
+def test_http_handoff_completes_and_decode_serves():
+    """Clean-path control for the chaos runs: over real HTTP the
+    prefill replica streams every aligned block, answers `complete`,
+    and the decode replica then admits the re-routed request on the
+    injected blocks."""
+    (p_srv, p_eng, p_url), (d_srv, d_eng, d_url) = _disagg_pair()
+    try:
+        resp = _prefill_handoff(p_url, d_url)
+        assert resp.status_code == 200, resp.text
+        assert resp.headers.get('X-Skytpu-Handoff') == 'complete'
+        assert resp.json()['decode_url'] == d_url
+        assert p_eng.handoff_stats()['completed'] == 1
+        assert d_eng.handoff_stats()['tokens_injected'] == 24
+        r2 = requests.post(
+            f'{d_url}/generate',
+            json={'prompt': _HANDOFF_PROMPT, 'max_new_tokens': 6,
+                  'stream': False}, timeout=60)
+        assert r2.status_code == 200, r2.text
+        assert r2.json()['generated'] == 6
+        # The injected blocks made admission a (near-)full prefix hit.
+        assert d_eng.cache_stats()['prefill_tokens_saved'] >= 24
+    finally:
+        p_srv.stop()
+        d_srv.stop()
+
+
+def test_chaos_handoff_decode_death_degrades_to_answer(monkeypatch):
+    """Acceptance: the decode replica "dying" mid-handoff
+    (`handoff_decode_death` fires in its inject path → 500s on
+    /handoff_blocks) never hangs or drops the request — the prefill
+    side degrades to decode-in-place and answers the stream."""
+    (p_srv, p_eng, p_url), (d_srv, d_eng, d_url) = _disagg_pair()
+    try:
+        monkeypatch.setenv(chaos.CHAOS_ENV, 'handoff_decode_death')
+        resp = _prefill_handoff(p_url, d_url)
+        assert resp.status_code == 200, resp.text
+        assert resp.headers.get('X-Skytpu-Handoff') == 'degraded'
+        body = resp.json()
+        assert body['generated'] == 6 and len(body['tokens']) == 6
+        st = p_eng.handoff_stats()
+        assert st['degraded'] == 1 and st['completed'] == 0
+        assert d_eng.handoff_stats()['tokens_injected'] == 0
+    finally:
+        p_srv.stop()
+        d_srv.stop()
+
+
+def test_chaos_handoff_truncate_degrades_to_answer(monkeypatch):
+    """Acceptance: a truncated wire payload (`handoff_truncate` halves
+    the push body) is rejected by the decode side's validation and the
+    prefill side degrades — answered, never hung, nothing malformed
+    installed in the decode pool."""
+    (p_srv, p_eng, p_url), (d_srv, d_eng, d_url) = _disagg_pair()
+    try:
+        monkeypatch.setenv(chaos.CHAOS_ENV, 'handoff_truncate')
+        resp = _prefill_handoff(p_url, d_url)
+        assert resp.status_code == 200, resp.text
+        assert resp.headers.get('X-Skytpu-Handoff') == 'degraded'
+        assert resp.json()['generated'] == 6
+        st = p_eng.handoff_stats()
+        assert st['degraded'] == 1 and st['completed'] == 0
+        assert d_eng.handoff_stats()['tokens_injected'] == 0
+    finally:
+        p_srv.stop()
+        d_srv.stop()

@@ -259,7 +259,7 @@ def test_cpu_sched_payload_end_to_end():
     result with paged-vs-dense detail, runnable on plain CPU."""
     res = subprocess.run(
         [sys.executable, BENCH, '--payload-sched'],
-        capture_output=True, text=True, timeout=300,
+        capture_output=True, text=True, timeout=420,
         env={**os.environ, 'JAX_PLATFORMS': 'cpu'}, cwd=REPO_ROOT)
     assert res.returncode == 0, res.stderr[-2000:]
     lines = res.stdout.strip().splitlines()
@@ -291,10 +291,25 @@ def test_cpu_sched_payload_end_to_end():
             arms['random']['prefill_tokens_saved'])
     assert arms['random_peer_fetch']['prefix_fetch_hits'] > 0
     assert routing['drain']['moved_only_drained_keys'] is True
+    # ISSUE-16: the disaggregated prefill/decode numbers ride the dark
+    # tier as a FOURTH cumulative line — the split fleet's burst TTFT
+    # p95 must beat monolithic with goodput holding, every handoff
+    # completing (none degraded on the clean path).
+    disagg = out['detail']['disagg']
+    assert disagg['platform'] == 'cpu'
+    assert disagg['ttft_improved'] is True
+    assert disagg['goodput_holds'] is True
+    assert (disagg['split']['ttft_p95_ms'] <
+            disagg['mono']['ttft_p95_ms'])
+    assert disagg['split']['handoff']['completed'] > 0
+    assert disagg['split']['handoff']['degraded'] == 0
+    assert disagg['split']['burst_completed'] == disagg['n_burst']
     # Cumulative-line contract: sched-only first, then +spec, then
-    # +routing (a kill mid-route still lands the sched+spec result).
-    assert 'routing' not in json.loads(lines[-2])['detail']
-    assert 'spec' not in json.loads(lines[-3])['detail']
+    # +routing, then +disagg (a kill mid-disagg still lands the
+    # sched+spec+routing result).
+    assert 'disagg' not in json.loads(lines[-2])['detail']
+    assert 'routing' not in json.loads(lines[-3])['detail']
+    assert 'spec' not in json.loads(lines[-4])['detail']
     # ISSUE-13: the control-plane SLO ledger rides every perf line,
     # dark tier included — an empty journal reads zero counts with the
     # (ungated) gate recorded as passing, never an error.

@@ -348,7 +348,9 @@ def test_all_registered_metric_names_match_convention():
                      'skytpu_engine_prefix_evictions_total',
                      'skytpu_engine_prefix_fetches_total',
                      'skytpu_engine_radix_nodes',
-                     'skytpu_engine_prefix_cache_blocks'):
+                     'skytpu_engine_prefix_cache_blocks',
+                     # Disaggregated prefill/decode handoff (ISSUE 16).
+                     'skytpu_engine_handoffs_total'):
         assert expected in names, f'{expected} not found by lint scan'
 
 
@@ -409,7 +411,9 @@ def test_all_journal_event_kinds_are_registered():
                      'LB_HOP', 'REPLICA_STRAGGLER', 'ENGINE_HBM',
                      # Prefix-aware routing + cross-replica prefix
                      # cache tier (ISSUE 15).
-                     'LB_ROUTE', 'ENGINE_PREFIX_FETCH'):
+                     'LB_ROUTE', 'ENGINE_PREFIX_FETCH',
+                     # Disaggregated prefill/decode handoff (ISSUE 16).
+                     'ENGINE_HANDOFF'):
         assert expected in attr_names, \
             f'EventKind.{expected} not found by lint scan'
 
