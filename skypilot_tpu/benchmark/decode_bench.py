@@ -729,6 +729,8 @@ def run_scheduler_bench(steps: int = 2, beat=None, seed: int = 0,
                 tp=tp if paged else 1)
             useful, conc, n_steps = _drive_engine(eng, engine_lib,
                                                   requests)
+            eng.flush_journal()  # land buffered rows so stats are final
+            jstats = eng.journal_stats()
             st = eng.stats()
             eslo = eng.telemetry.slo()
             spec_stats = (eng.spec_stats()
@@ -752,6 +754,10 @@ def run_scheduler_bench(steps: int = 2, beat=None, seed: int = 0,
                 # tokens/step envelope held, pinning the telemetry
                 # plane's overhead inside the regression tolerance.
                 'profiler_steps': eng.profiler.steps_recorded(),
+                # Journal-plane overhead rides the same replay: the
+                # buffered path's append/drop/flush profile lands
+                # beside the tokens/step signal the perf gate holds.
+                'journal': jstats,
                 'request_phase_p95': {
                     k: eslo[f'{k}_seconds']['p95']
                     for k in ('queue_wait', 'ttft', 'per_token',

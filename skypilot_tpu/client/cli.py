@@ -476,15 +476,26 @@ def top(cluster, watch, interval, window):
               help='Only these event kinds (repeatable).')
 @click.option('--limit', '-n', type=int, default=50,
               help='Max events to show (most recent).')
+@click.option('--since', type=int, default=None, metavar='ROWID',
+              help='Only events past this journal rowid (the resume '
+                   'cursor printed as next_since_id / used by --follow).')
+@click.option('--fleet', 'fleet_endpoints', multiple=True, metavar='URL',
+              help='Pull /journal from these endpoints (an LB expands '
+                   'to its ready replicas) instead of the local file; '
+                   'rows come back host-tagged. Repeatable, or '
+                   'comma-separated.')
 @click.option('--follow', '-f', is_flag=True, default=False,
               help='Poll for new events until interrupted.')
-def events(job_id, cluster, service, kinds, limit, follow):
+def events(job_id, cluster, service, kinds, limit, since,
+           fleet_endpoints, follow):
     """Show the control-plane flight recorder (journal) as a timeline.
 
     Reads this host's ~/.skytpu/journal.db — provision failover
     attempts, managed-job phase transitions, recovery rounds, replica
     lifecycle. Each row carries a trace id; follow one with
-    `skytpu trace <id>`.
+    `skytpu trace <id>`. With --fleet the timeline is federated: every
+    endpoint's /journal (LBs expanding to their ready set) merges into
+    one host-tagged view.
     """
     from skypilot_tpu.observability import journal
     filters = [f for f in (job_id, cluster, service) if f is not None]
@@ -504,13 +515,19 @@ def events(job_id, cluster, service, kinds, limit, follow):
             raise click.UsageError(
                 f'Unknown event kind {k!r}. Known kinds: '
                 f'{", ".join(sorted(journal.KINDS))}')
+    if fleet_endpoints:
+        _fleet_events(list(fleet_endpoints), kinds, entity,
+                      entity_prefix, limit, since, follow)
+        return
     rows = journal.query(kinds=kinds or None, entity=entity,
-                         entity_prefix=entity_prefix, limit=limit)
-    rows.reverse()  # oldest first reads as a timeline
+                         entity_prefix=entity_prefix, since_id=since,
+                         limit=limit, ascending=since is not None)
+    if since is None:
+        rows.reverse()  # oldest first reads as a timeline
     click.echo(journal.format_events(rows))
     if not follow:
         return
-    last_id = rows[-1]['event_id'] if rows else 0
+    last_id = max((r['event_id'] for r in rows), default=since or 0)
     try:
         while True:
             time.sleep(1.0)
@@ -525,15 +542,76 @@ def events(job_id, cluster, service, kinds, limit, follow):
         pass
 
 
+def _split_endpoints(endpoints):
+    out = []
+    for ep in endpoints:
+        out.extend(p.strip() for p in ep.split(',') if p.strip())
+    return out
+
+
+def _fleet_events(endpoints, kinds, entity, entity_prefix, limit, since,
+                  follow):
+    """The federated `skytpu events --fleet` pull/tail loop."""
+    from skypilot_tpu.observability import federation
+    from skypilot_tpu.observability import journal
+    endpoints = _split_endpoints(endpoints)
+    params = {'kinds': ','.join(kinds) if kinds else None,
+              'entity': entity, 'entity_prefix': entity_prefix,
+              'limit': limit}
+    if since is not None:
+        params['since_id'] = since
+    result = federation.collect(endpoints, params)
+    click.echo(journal.format_events(result.events))
+    for url, err in sorted(result.errors.items()):
+        click.echo(f'# {url}: {err}', err=True)
+    if not follow:
+        return
+    cursors = dict(result.cursors)
+    params.pop('since_id', None)
+    try:
+        while True:
+            time.sleep(1.0)
+            fresh = federation.collect(endpoints,
+                                       {**params, 'limit': 1000},
+                                       since=cursors)
+            for e in fresh.events:
+                click.echo(journal.format_event_line(e))
+            # Only advance cursors for hosts that answered; an erroring
+            # peer resumes from its last seen rowid once it recovers.
+            cursors.update(fresh.cursors)
+    except KeyboardInterrupt:
+        pass
+
+
 @cli.command()
 @click.argument('trace_id', required=True)
-def trace(trace_id):
+@click.option('--fleet', 'fleet_endpoints', multiple=True, metavar='URL',
+              help='Merge the trace across these /journal endpoints (an '
+                   'LB expands to its ready replicas) — one span tree '
+                   'for a request that crossed the LB and several '
+                   'replicas, each row host-attributed.')
+def trace(trace_id, fleet_endpoints):
     """Render one trace's span tree (launch → failover attempts →
-    recovery rounds → job phases) from the local journal.
+    recovery rounds → job phases) from the local journal — or, with
+    --fleet, joined across every host's journal by trace id.
 
-    TRACE_ID may be a unique prefix (as printed by `skytpu events`).
+    TRACE_ID may be a unique prefix (as printed by `skytpu events`;
+    local mode only — fleet endpoints match the full id).
     """
     from skypilot_tpu.observability import journal
+    if fleet_endpoints:
+        from skypilot_tpu.observability import federation
+        result = federation.collect(
+            _split_endpoints(list(fleet_endpoints)),
+            {'trace_id': trace_id, 'limit': 10000})
+        for url, err in sorted(result.errors.items()):
+            click.echo(f'# {url}: {err}', err=True)
+        if not result.events:
+            raise click.ClickException(
+                f'No events for trace {trace_id!r} on '
+                f'{len(result.hosts) or len(fleet_endpoints)} host(s).')
+        click.echo(journal.format_trace(trace_id, result.events))
+        return
     rows = journal.query(trace_id=trace_id, ascending=True, limit=10000)
     if not rows:
         # Prefix match: `skytpu events` prints 8-char trace ids.

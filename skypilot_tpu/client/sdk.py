@@ -334,3 +334,21 @@ def serve_down(service_name: str, purge: bool = False) -> str:
 def serve_logs(service_name: str, follow: bool = True) -> str:
     return _post('/serve/logs', {'service_name': service_name,
                                  'follow': follow})
+
+
+def journal(kinds: Optional[List[str]] = None,
+            entity: Optional[str] = None,
+            entity_prefix: Optional[str] = None,
+            trace_id: Optional[str] = None,
+            since_id: Optional[int] = None,
+            limit: Optional[int] = None, offset: int = 0) -> str:
+    """Query the head's flight recorder (bounded /journal endpoint):
+    filter by kind/entity/trace, resume from a ``since_id`` rowid
+    cursor, and page with the same opt-in ``limit``/``offset`` contract
+    as /status. The result body carries ``events`` (oldest-first) and
+    ``next_since_id`` (feed back as ``since_id`` to poll)."""
+    return _post('/journal', {'kinds': kinds, 'entity': entity,
+                              'entity_prefix': entity_prefix,
+                              'trace_id': trace_id,
+                              'since_id': since_id,
+                              'limit': limit, 'offset': offset})

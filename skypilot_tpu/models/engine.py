@@ -708,7 +708,8 @@ class DecodeEngine:
                  tp: int = 1,
                  prefix_peers: Optional[Sequence[str]] = None,
                  prefix_fetch_budget: Optional[float] = None,
-                 prefix_fetch_fn: Optional[Callable] = None):
+                 prefix_fetch_fn: Optional[Callable] = None,
+                 journal_db: Optional[str] = None):
         if num_slots < 1:
             raise ValueError(f'num_slots must be >= 1, got {num_slots}')
         if step_chunk < 1:
@@ -897,7 +898,12 @@ class DecodeEngine:
         # per-event commit costs an fsync, which at token-loop rates
         # would dominate the decode step itself on slow filesystems.
         # stats() flushes from the HTTP thread while the loop appends.
-        self._jbuf = journal.JournalBuffer()
+        # ``journal_db`` pins this engine to its own journal file (the
+        # federated flight-recorder e2e runs several replicas
+        # in-process); None = the host journal.
+        self.journal_db = journal_db
+        self._jbuf = journal.JournalBuffer(db_path=journal_db,
+                                           entity=f'engine:{name}')
         # Request-telemetry plane: per-request phase records assembled
         # at the admit/evict/reject choke points (the per-token hot path
         # stays untouched) + the per-step profiler behind /debug/engine.
@@ -2338,9 +2344,11 @@ class DecodeEngine:
             prefill_tokens=pf_tokens)
         if stall is not None:
             self._journal_raw(journal.EventKind.ENGINE_STALL, stall)
-        # Refill freed lanes NOW so the next chunk runs full.
+        # Refill freed lanes NOW so the next chunk runs full. The
+        # journal write rides a background thread (wait=False): a
+        # stalled journal disk must never block the step loop.
         self._admit()
-        self.flush_journal()
+        self.flush_journal(wait=False)
         return active
 
     def _tables_dev(self) -> jax.Array:
@@ -2591,7 +2599,9 @@ class DecodeEngine:
                     return  # restart budget exhausted: permanent fail
                 continue
             if active == 0:
-                self.flush_journal()  # one-token admissions while idle
+                # one-token admissions while idle (non-blocking: the
+                # idle loop must keep beating through a journal stall)
+                self.flush_journal(wait=False)
                 time.sleep(idle)
 
     # ------------------------------------------------------- supervision
@@ -2880,8 +2890,16 @@ class DecodeEngine:
                           span_id=span_id,
                           parent_span_id=parent_span_id, entity=entity)
 
-    def flush_journal(self) -> None:
-        """Write buffered admit/evict events in one transaction. Called
-        per tick by ``step()``; direct ``insert()`` drivers (tests) call
-        it, or ``stats()``, to see their rows."""
-        self._jbuf.flush()
+    def flush_journal(self, wait: bool = True) -> None:
+        """Write buffered admit/evict events in one transaction.
+        ``step()`` calls it per tick with ``wait=False`` (the write
+        rides a short-lived background thread — a wedged journal disk
+        never blocks the decode loop); direct ``insert()`` drivers
+        (tests) call it, or ``stats()``, with the default synchronous
+        form to see their rows."""
+        self._jbuf.flush(wait=wait)
+
+    def journal_stats(self) -> dict:
+        """Journal-plane self-observability (buffered/dropped/flush
+        p95) — surfaced in the sched-bench detail block and /slo."""
+        return self._jbuf.stats()

@@ -1,0 +1,200 @@
+"""Federated flight recorder: fan out /journal pulls, merge one fleet
+timeline.
+
+Each host journals to its own sqlite file (observability/journal) — by
+design there is no shared database. This module is the read-side join:
+``collect()`` fans out bounded ``GET /journal`` pulls (parallel, per-peer
+timeout + failure backoff, the ``prefix_transfer`` transport discipline)
+across a peer list, expands a load balancer endpoint one level through
+the ``replicas`` field it advertises (its ready set), tags every row
+with the journal that served it, and merges the rows into one
+timestamp-ordered timeline — so ``skytpu trace <id> --fleet <lb>``
+renders a single span tree for a request that crossed the LB and both
+disagg legs, and ``skytpu events --fleet`` tails the whole fleet with
+per-host ``since_id`` cursors.
+
+Trust model: the pull side is a plain HTTP client; WHO may pull is the
+serving side's call (the model server's /journal answers only inside a
+configured fleet — SKYTPU_PREFIX_PEERS / SKYTPU_JOURNAL_PEERS).
+"""
+import concurrent.futures
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import requests
+
+# Per-peer pull timeout: a wedged replica must cost one timeout, not
+# hang the whole render.
+PEER_TIMEOUT_ENV = 'SKYTPU_JOURNAL_PEER_TIMEOUT'
+DEFAULT_PEER_TIMEOUT_SECONDS = 5.0
+# Fan-out bound: concurrent /journal pulls in flight (a 100-replica
+# fleet must not open 100 sockets at once from an operator laptop).
+FANOUT_ENV = 'SKYTPU_JOURNAL_FANOUT'
+DEFAULT_FANOUT = 8
+# A peer whose pull failed is skipped for this long (same rationale as
+# SKYTPU_PREFIX_FETCH_BACKOFF_SECONDS: one dead peer must not cost
+# every subsequent --follow tick a full timeout).
+PEER_BACKOFF_ENV = 'SKYTPU_JOURNAL_PEER_BACKOFF_SECONDS'
+DEFAULT_PEER_BACKOFF_SECONDS = 10.0
+
+
+def peer_timeout() -> float:
+    try:
+        return float(os.environ.get(PEER_TIMEOUT_ENV,
+                                    str(DEFAULT_PEER_TIMEOUT_SECONDS)))
+    except ValueError:
+        return DEFAULT_PEER_TIMEOUT_SECONDS
+
+
+def fanout() -> int:
+    try:
+        return max(1, int(os.environ.get(FANOUT_ENV, DEFAULT_FANOUT)))
+    except ValueError:
+        return DEFAULT_FANOUT
+
+
+def peer_backoff_seconds() -> float:
+    try:
+        return float(os.environ.get(
+            PEER_BACKOFF_ENV, str(DEFAULT_PEER_BACKOFF_SECONDS)))
+    except ValueError:
+        return DEFAULT_PEER_BACKOFF_SECONDS
+
+
+# Failure backoff, process-wide (the CLI --follow loop re-enters
+# collect() every tick): url -> monotonic deadline before which the
+# peer is skipped.
+_backoff_lock = threading.Lock()
+_backoff_until: Dict[str, float] = {}
+
+
+def reset_backoff() -> None:
+    """Drop peer-failure backoff state (tests)."""
+    with _backoff_lock:
+        _backoff_until.clear()
+
+
+def _in_backoff(url: str) -> bool:
+    with _backoff_lock:
+        return time.monotonic() < _backoff_until.get(url, 0.0)
+
+
+def _note_failure(url: str) -> None:
+    with _backoff_lock:
+        _backoff_until[url] = time.monotonic() + peer_backoff_seconds()
+
+
+def _note_success(url: str) -> None:
+    with _backoff_lock:
+        _backoff_until.pop(url, None)
+
+
+def normalize_endpoint(url: str) -> str:
+    url = url.strip().rstrip('/')
+    if url and '://' not in url:
+        url = f'http://{url}'
+    return url
+
+
+def fetch_journal(url: str,
+                  params: Optional[Dict[str, Any]] = None
+                  ) -> Dict[str, Any]:
+    """One bounded /journal pull. Raises requests.RequestException /
+    ValueError on transport or shape failure (collect() turns those
+    into per-peer error strings + backoff)."""
+    half = peer_timeout() / 2
+    resp = requests.get(f'{normalize_endpoint(url)}/journal',
+                        params={k: v for k, v in (params or {}).items()
+                                if v is not None},
+                        timeout=(half, half))
+    resp.raise_for_status()
+    body = resp.json()
+    if not isinstance(body, dict) or 'events' not in body:
+        raise ValueError('malformed /journal body (no events field)')
+    return body
+
+
+class FleetJournal:
+    """One federated pull: merged host-tagged rows + per-host cursors
+    and errors (the CLI renders all three)."""
+
+    def __init__(self) -> None:
+        self.events: List[Dict[str, Any]] = []
+        # url -> next_since_id resume cursor (feed back via `since`).
+        self.cursors: Dict[str, int] = {}
+        # url -> the host tag its journal rows carry.
+        self.hosts: Dict[str, str] = {}
+        # url -> error string (timeout, non-200, malformed body, 404
+        # trust gate...) — surfaced, never silently dropped.
+        self.errors: Dict[str, str] = {}
+
+
+def collect(endpoints: Sequence[str],
+            params: Optional[Dict[str, Any]] = None,
+            since: Optional[Dict[str, int]] = None,
+            expand_replicas: bool = True) -> FleetJournal:
+    """Pull /journal from every endpoint (parallel, bounded by
+    SKYTPU_JOURNAL_FANOUT), expanding LB endpoints one level through
+    their advertised ``replicas`` ready set. Rows come back merged
+    oldest-first, each tagged ``host`` (the serving journal's identity
+    — what the span tree and the events table render as ``@host``).
+
+    ``since`` maps endpoint url -> last-seen rowid (the --follow
+    cursor); hosts without an entry pull from their default window.
+    Per-peer failures land in ``result.errors`` and arm the peer
+    backoff; they never fail the pull as a whole.
+    """
+    result = FleetJournal()
+    seen: set = set()
+    frontier = [normalize_endpoint(u) for u in endpoints if u.strip()]
+    since = since or {}
+    # Two waves at most: the explicit endpoints, then the replica sets
+    # the LBs among them advertised (one-level expansion by design —
+    # a replica advertising "replicas" of its own does not recurse).
+    for _wave in range(2):
+        wave = [u for u in frontier if u and u not in seen]
+        if not wave:
+            break
+        seen.update(wave)
+        frontier = []
+        skipped = [u for u in wave if _in_backoff(u)]
+        for url in skipped:
+            result.errors[url] = 'in failure backoff'
+        wave = [u for u in wave if u not in skipped]
+
+        def _pull(url: str) -> Tuple[str, Any]:
+            p = dict(params or {})
+            if url in since:
+                p['since_id'] = since[url]
+            try:
+                return url, fetch_journal(url, p)
+            except (requests.RequestException, ValueError) as exc:
+                return url, exc
+
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=fanout()) as pool:
+            for url, body in pool.map(_pull, wave):
+                if isinstance(body, Exception):
+                    _note_failure(url)
+                    result.errors[url] = f'{type(body).__name__}: {body}'
+                    continue
+                _note_success(url)
+                host = str(body.get('host') or url)
+                result.hosts[url] = host
+                result.cursors[url] = int(body.get('next_since_id') or 0)
+                for row in body.get('events') or []:
+                    if isinstance(row, dict):
+                        row.setdefault('host', host)
+                        result.events.append(row)
+                if expand_replicas:
+                    for rep in body.get('replicas') or []:
+                        frontier.append(normalize_endpoint(str(rep)))
+        expand_replicas = False  # one level only
+    # One fleet timeline: timestamp order, rowids tie-break within a
+    # host (rowids are NOT comparable across journals).
+    result.events.sort(
+        key=lambda e: (e.get('ts') or 0, e.get('host') or '',
+                       e.get('event_id') or 0))
+    return result

@@ -34,7 +34,9 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
+from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import trace as trace_lib
+from skypilot_tpu.utils import chaos
 from skypilot_tpu.utils import db_utils
 
 DISABLE_ENV = 'SKYTPU_JOURNAL_DISABLED'
@@ -48,6 +50,22 @@ DEFAULT_MAX_EVENTS = 20000
 # job.phase rows are exempt from the generic prune (goodput recomputes
 # from them) and capped separately, much higher — see event().
 PHASE_EVENTS_CAP = 50000
+# Journal file override: lets several in-process instances (the federated
+# flight-recorder e2e: LB + prefill replica + decode replica) keep
+# genuinely separate journals; in prod each host resolves its own
+# ~/.skytpu/journal.db and the env is a deploy-time escape hatch (tmpfs,
+# per-replica volumes).
+DB_PATH_ENV = 'SKYTPU_JOURNAL_PATH'
+# JournalBuffer bound: appends beyond this depth are dropped (and
+# counted) instead of growing without bound while the writer is stalled.
+QUEUE_DEPTH_ENV = 'SKYTPU_JOURNAL_QUEUE_DEPTH'
+DEFAULT_QUEUE_DEPTH = 4096
+# A flush slower than this journals ONE journal.stall row on recovery.
+STALL_SECONDS_ENV = 'SKYTPU_JOURNAL_STALL_SECONDS'
+DEFAULT_STALL_SECONDS = 1.0
+# Hard cap on rows a /journal query endpoint will serve per call.
+QUERY_LIMIT_ENV = 'SKYTPU_JOURNAL_QUERY_LIMIT'
+DEFAULT_QUERY_LIMIT = 1000
 
 
 class EventKind(enum.Enum):
@@ -160,6 +178,12 @@ class EventKind(enum.Enum):
     # injection result — so "who served this request's tokens" is
     # answerable per handoff.
     ENGINE_HANDOFF = 'engine.handoff'
+    # Journal-plane self-observability (this module): a JournalBuffer
+    # flush that blew past SKYTPU_JOURNAL_STALL_SECONDS journals ONE row
+    # when writes recover — written via the direct (unbuffered,
+    # un-chaos'd) path so a stalled journal can never recurse into
+    # reporting its own stall.
+    JOURNAL_STALL = 'journal.stall'
 
 
 KINDS = frozenset(k.value for k in EventKind)
@@ -183,6 +207,9 @@ _TABLE = """
 
 
 def db_path() -> str:
+    override = os.environ.get(DB_PATH_ENV)
+    if override:
+        return os.path.expanduser(override)
     return os.path.join(os.path.expanduser('~'), '.skytpu', 'journal.db')
 
 
@@ -194,10 +221,23 @@ def db_path() -> str:
 
 
 _CONN = db_utils.SqliteConn('journal', db_path, _TABLE)
+# Explicit-path connections (the ``db_path=`` parameter threaded through
+# event/event_batch/query): one SqliteConn per resolved path, so several
+# in-process instances can journal to separate files concurrently.
+_conns_lock = threading.Lock()
+_CONNS: Dict[str, db_utils.SqliteConn] = {}
 
 
-def _db() -> sqlite3.Connection:
-    return _CONN.get()
+def _db(db_path_override: Optional[str] = None) -> sqlite3.Connection:
+    if not db_path_override:
+        return _CONN.get()
+    resolved = os.path.abspath(os.path.expanduser(db_path_override))
+    with _conns_lock:
+        conn = _CONNS.get(resolved)
+        if conn is None:
+            conn = _CONNS[resolved] = db_utils.SqliteConn(
+                f'journal@{resolved}', lambda p=resolved: p, _TABLE)
+    return conn.get()
 
 
 def max_events() -> int:
@@ -205,6 +245,31 @@ def max_events() -> int:
         return int(os.environ.get(MAX_EVENTS_ENV, DEFAULT_MAX_EVENTS))
     except ValueError:
         return DEFAULT_MAX_EVENTS
+
+
+def queue_depth() -> int:
+    """JournalBuffer bound (re-read per call: tests shrink it to force
+    the drop path without thousands of appends)."""
+    try:
+        return int(os.environ.get(QUEUE_DEPTH_ENV, DEFAULT_QUEUE_DEPTH))
+    except ValueError:
+        return DEFAULT_QUEUE_DEPTH
+
+
+def stall_seconds() -> float:
+    try:
+        return float(os.environ.get(STALL_SECONDS_ENV,
+                                    str(DEFAULT_STALL_SECONDS)))
+    except ValueError:
+        return DEFAULT_STALL_SECONDS
+
+
+def query_limit() -> int:
+    """Hard per-call row cap for the /journal query endpoints."""
+    try:
+        return int(os.environ.get(QUERY_LIMIT_ENV, DEFAULT_QUERY_LIMIT))
+    except ValueError:
+        return DEFAULT_QUERY_LIMIT
 
 
 def enabled() -> bool:
@@ -228,10 +293,12 @@ def event(kind: Union[EventKind, str],
           trace_id: Optional[str] = None,
           span_id: Optional[str] = None,
           parent_span_id: Optional[str] = None,
-          ts: Optional[float] = None) -> None:
+          ts: Optional[float] = None,
+          db_path: Optional[str] = None) -> None:
     """Append one event. Trace/span default to the ambient context
     (``observability/trace``); entity is a ``type:name`` string, e.g.
-    ``cluster:train-1-0``, ``job:3``, ``replica:svc/2``."""
+    ``cluster:train-1-0``, ``job:3``, ``replica:svc/2``. ``db_path``
+    targets an explicit journal file (defaults to this host's)."""
     kind_value = kind.value if isinstance(kind, EventKind) else str(kind)
     if kind_value not in KINDS:
         raise ValueError(
@@ -244,7 +311,7 @@ def event(kind: Union[EventKind, str],
     if parent_span_id is None:
         parent_span_id = trace_lib.get_parent_span_id()
     try:
-        with _db() as conn:
+        with _db(db_path) as conn:
             cur = conn.execute(
                 'INSERT INTO events (ts, kind, entity, payload, trace_id, '
                 'span_id, parent_span_id) VALUES (?,?,?,?,?,?,?)',
@@ -274,12 +341,19 @@ def event(kind: Union[EventKind, str],
         pass  # the flight recorder must never take the plane down
 
 
-def event_batch(items: Sequence[tuple]) -> None:
+def event_batch(items: Sequence[tuple],
+                db_path: Optional[str] = None) -> int:
     """Append many events in ONE transaction (one fsync) — the hot-path
     form. Per-event ``event()`` pays a commit per call, which is fine at
     control-plane rates; a serving engine journaling admissions and
     evictions per scheduling tick uses this instead (models/engine.py
     buffers and flushes per tick).
+
+    Returns the number of rows committed (filtered/disabled rows are not
+    counted — they were dropped by policy, not lost), or ``-1`` when the
+    transaction failed (sqlite/OS error): one transaction means the
+    WHOLE batch was lost, which the JournalBuffer counts as
+    ``write_error`` drops.
 
     Each item is ``(kind, entity, payload, ts)`` — ts stamped by the
     caller at buffer time, so batching does not skew the timeline.
@@ -295,7 +369,7 @@ def event_batch(items: Sequence[tuple]) -> None:
     request's timeline nested under the HTTP spans that carried it.
     """
     if not items:
-        return
+        return 0
     rows = []
     for item in items:
         kind, entity, payload, ts = item[:4]
@@ -317,12 +391,12 @@ def event_batch(items: Sequence[tuple]) -> None:
         rows.append((ts, kind_value, entity or '',
                      json.dumps(payload or {}, default=str), row_ctx))
     if not enabled() or not rows:
-        return
+        return 0
     trace_id = trace_lib.get_trace_id()
     span_id = trace_lib.get_span_id()
     parent = trace_lib.get_parent_span_id()
     try:
-        with _db() as conn:
+        with _db(db_path) as conn:
             cur = None
             for ts, kind_value, entity, payload_json, row_ctx in rows:
                 cur = conn.execute(
@@ -341,36 +415,207 @@ def event_batch(items: Sequence[tuple]) -> None:
                     'kind != ?',
                     (cur.lastrowid - cap, EventKind.JOB_PHASE.value))
     except (sqlite3.Error, OSError):
-        pass  # the flight recorder must never take the plane down
+        return -1  # the flight recorder must never take the plane down
+    return len(rows)
 
 
 class JournalBuffer:
-    """Lock-guarded buffer of :func:`event_batch` rows for hot-path
-    writers (the decode engine's tick loop, the LB's proxy handler):
-    appends are lock+list-append cheap, and one ``flush()`` writes the
-    whole batch in a single transaction. The optional ``override`` per
-    row is event_batch's fifth element (a trace-id string or a
-    ``(trace, span, parent)`` tuple)."""
+    """Bounded, lock-guarded buffer of :func:`event_batch` rows for
+    hot-path writers (the decode engine's tick loop, the LB's proxy
+    handler): appends are lock+list-append cheap and NEVER block on the
+    database — at ``SKYTPU_JOURNAL_QUEUE_DEPTH`` the row is dropped and
+    counted (``skytpu_journal_dropped_total{reason="queue_full"}``)
+    instead of growing without bound behind a stalled disk. One
+    ``flush()`` writes the whole batch in a single transaction;
+    ``flush(wait=False)`` hands the write to a short-lived background
+    thread so the engine step loop never sits behind an fsync. The
+    optional ``override`` per row is event_batch's fifth element (a
+    trace-id string or a ``(trace, span, parent)`` tuple).
 
-    # Lock discipline (skytpu lint): appenders race the flusher.
-    _GUARDED_BY = {'_buf': '_lock'}
+    The buffer observes itself: flush latency/batch counters feed the
+    ``skytpu_journal_*`` self-metrics and :meth:`stats`, and a flush
+    slower than ``SKYTPU_JOURNAL_STALL_SECONDS`` journals ONE
+    ``journal.stall`` row on recovery (via the direct, unbuffered write
+    path — reporting a stall must not re-enter the stalled path).
+    """
 
-    def __init__(self):
+    # Lock discipline (skytpu lint): appenders race the flusher; the
+    # self-accounting counters ride the same lock. Metric increments and
+    # the actual sqlite write happen OUTSIDE the lock — a wedged journal
+    # write must never wedge appenders.
+    _GUARDED_BY = {
+        '_buf': '_lock',
+        '_appended': '_lock',
+        '_written': '_lock',
+        '_dropped_queue_full': '_lock',
+        '_dropped_write_error': '_lock',
+        '_flushes': '_lock',
+        '_flush_secs': '_lock',
+        '_pending_stall': '_lock',
+        '_async_inflight': '_lock',
+        '_async_pending': '_lock',
+    }
+
+    # Flush-latency ring for the stats() p95 (not a full histogram —
+    # the registry metric has the buckets).
+    _FLUSH_RING = 256
+
+    def __init__(self, db_path: Optional[str] = None,
+                 entity: str = 'journal'):
         self._lock = threading.Lock()
+        # Serializes _flush_once bodies: a flush(wait=True) must not
+        # return while an async flush that already claimed rows is
+        # still committing them, or "flush then read" callers miss the
+        # tail of the batch. Never held while taking _lock-only paths'
+        # callers (append stays lock-cheap and never touches it).
+        self._write_lock = threading.Lock()
         self._buf: List[tuple] = []
+        self._db_path = db_path
+        self._entity = entity
+        self._appended = 0
+        self._written = 0
+        self._dropped_queue_full = 0
+        self._dropped_write_error = 0
+        self._flushes = 0
+        self._flush_secs: List[float] = []
+        self._pending_stall: Optional[Dict[str, Any]] = None
+        self._async_inflight = False
+        self._async_pending = False
+
+    @property
+    def db_path(self) -> Optional[str]:
+        return self._db_path
 
     def append(self, kind, entity: str, payload: Optional[Dict[str, Any]],
-               override=None, ts: Optional[float] = None) -> None:
+               override=None, ts: Optional[float] = None) -> bool:
+        """Buffer one row. Returns False when the bounded queue was full
+        and the row was dropped (counted, never blocking)."""
+        row = (kind, entity, payload,
+               time.time() if ts is None else ts, override)
         with self._lock:
-            self._buf.append((kind, entity, payload,
-                              time.time() if ts is None else ts,
-                              override))
+            if len(self._buf) >= queue_depth():
+                self._dropped_queue_full += 1
+                dropped = True
+            else:
+                self._buf.append(row)
+                self._appended += 1
+                dropped = False
+        if dropped:
+            # Outside the buffer lock: the registry takes its own locks
+            # and the drop path must never hold ours while doing so.
+            metrics_lib.counter(
+                'skytpu_journal_dropped_total',
+                'Journal rows lost (bounded queue full, or a failed '
+                'batch transaction).',
+                labels=('reason',)).inc(labels=('queue_full',))
+        return not dropped
 
-    def flush(self) -> None:
+    def flush(self, wait: bool = True) -> None:
+        """Write buffered rows. ``wait=True`` (teardown, stats, tests)
+        blocks until the batch is committed; ``wait=False`` (the engine
+        step loop) schedules the write on a short-lived daemon thread
+        and returns immediately — concurrent calls coalesce, so a flush
+        stalled behind a wedged disk queues at most one follow-up."""
+        if wait:
+            self._flush_once()
+            return
+        with self._lock:
+            if self._async_inflight:
+                self._async_pending = True
+                return
+            self._async_inflight = True
+        threading.Thread(target=self._async_flush,
+                         name='journal-flush', daemon=True).start()
+
+    def _async_flush(self) -> None:
+        while True:
+            self._flush_once()
+            with self._lock:
+                if not self._async_pending:
+                    self._async_inflight = False
+                    return
+                self._async_pending = False
+
+    def _flush_once(self) -> None:
+        # Taken before rows are claimed and held through the commit:
+        # once a sync flush acquires it, every row claimed by an
+        # earlier (possibly async) flush is already durable.
+        with self._write_lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
         with self._lock:
             buf, self._buf = self._buf, []
-        if buf:
-            event_batch(buf)
+        if not buf:
+            return
+        t0 = time.monotonic()
+        if chaos.should_fire('journal_write_stall'):
+            time.sleep(chaos.journal_stall_seconds())
+        if chaos.should_fire('journal_disk_full'):
+            written = -1
+        else:
+            written = event_batch(buf, db_path=self._db_path)
+        dt = time.monotonic() - t0
+        stall_note = None
+        with self._lock:
+            self._flushes += 1
+            self._flush_secs.append(dt)
+            del self._flush_secs[:-self._FLUSH_RING]
+            if written < 0:
+                self._dropped_write_error += len(buf)
+            else:
+                self._written += written
+            if dt >= stall_seconds():
+                note = self._pending_stall or {'stall_seconds': 0.0,
+                                               'stalled_flushes': 0}
+                note['stall_seconds'] = max(note['stall_seconds'], dt)
+                note['stalled_flushes'] += 1
+                self._pending_stall = note
+            elif self._pending_stall is not None:
+                # Recovery: this flush was fast again.
+                stall_note = self._pending_stall
+                self._pending_stall = None
+                stall_note['dropped_queue_full'] = self._dropped_queue_full
+                stall_note['dropped_write_error'] = \
+                    self._dropped_write_error
+        metrics_lib.histogram(
+            'skytpu_journal_flush_seconds',
+            'JournalBuffer batch-commit latency.').observe(dt)
+        if written > 0:
+            metrics_lib.counter(
+                'skytpu_journal_events_total',
+                'Journal rows committed through the buffered '
+                'path.').inc(written)
+        elif written < 0:
+            metrics_lib.counter(
+                'skytpu_journal_dropped_total',
+                'Journal rows lost (bounded queue full, or a failed '
+                'batch transaction).',
+                labels=('reason',)).inc(len(buf),
+                                        labels=('write_error',))
+        if stall_note is not None:
+            # Direct synchronous write, NOT through this buffer and not
+            # through the chaos'd batch path — cannot recurse.
+            event(EventKind.JOURNAL_STALL, self._entity, stall_note,
+                  db_path=self._db_path)
+
+    def stats(self) -> Dict[str, Any]:
+        """Self-observability snapshot (the bench detail block and the
+        engine's journal_stats surface)."""
+        with self._lock:
+            secs = sorted(self._flush_secs)
+            p95 = secs[int(0.95 * (len(secs) - 1))] if secs else 0.0
+            return {
+                'buffered': len(self._buf),
+                'appended': self._appended,
+                'written': self._written,
+                'dropped_queue_full': self._dropped_queue_full,
+                'dropped_write_error': self._dropped_write_error,
+                'dropped': (self._dropped_queue_full
+                            + self._dropped_write_error),
+                'flushes': self._flushes,
+                'flush_p95_seconds': p95,
+            }
 
 
 def query(kinds: Optional[Sequence[Union[EventKind, str]]] = None,
@@ -379,7 +624,8 @@ def query(kinds: Optional[Sequence[Union[EventKind, str]]] = None,
           trace_id: Optional[str] = None,
           since_id: Optional[int] = None,
           limit: int = 200,
-          ascending: bool = False) -> List[Dict[str, Any]]:
+          ascending: bool = False,
+          db_path: Optional[str] = None) -> List[Dict[str, Any]]:
     """Read events, newest first by default (``ascending=True`` for
     timeline/trace rendering). Payloads come back as dicts."""
     clauses, args = [], []
@@ -407,7 +653,7 @@ def query(kinds: Optional[Sequence[Union[EventKind, str]]] = None,
     where = f' WHERE {" AND ".join(clauses)}' if clauses else ''
     order = 'ASC' if ascending else 'DESC'
     try:
-        rows = _db().execute(
+        rows = _db(db_path).execute(
             f'SELECT * FROM events{where} ORDER BY event_id {order} '
             'LIMIT ?', (*args, limit)).fetchall()
     except (sqlite3.Error, OSError):
@@ -423,14 +669,61 @@ def query(kinds: Optional[Sequence[Union[EventKind, str]]] = None,
     return out
 
 
-def resolve_trace_prefix(prefix: str) -> List[str]:
+def serve_query(params: Dict[str, Any],
+                db_path: Optional[str] = None,
+                host: str = '') -> Dict[str, Any]:
+    """The /journal query endpoint, shared by the model server, the LB,
+    and the API server: filter (trace id, kinds, entity/prefix,
+    since-rowid cursor) + a hard ``SKYTPU_JOURNAL_QUERY_LIMIT`` row cap
+    per call. Unknown kinds are filtered out and malformed values
+    degrade to defaults — the journal read plane must not 500 on a
+    typo'd cursor. Rows come back oldest-first within the page;
+    ``next_since_id`` is the resume cursor for the federation poll
+    (``skytpu events --since``)."""
+    def _int(key: str) -> Optional[int]:
+        try:
+            return int(params[key])
+        except (KeyError, TypeError, ValueError):
+            return None
+
+    kinds = params.get('kinds')
+    if isinstance(kinds, str):
+        kinds = [k.strip() for k in kinds.split(',') if k.strip()]
+    kinds = [k for k in (kinds or []) if k in KINDS] or None
+    cap = query_limit()
+    limit = _int('limit')
+    limit = cap if limit is None else max(1, min(limit, cap))
+    since_id = _int('since_id')
+    trace_id = params.get('trace_id') or params.get('trace') or None
+    # A cursor pull pages oldest-first (resumable); the initial pull
+    # serves the NEWEST rows (what `events` shows), re-sorted so the
+    # page itself always reads oldest-first.
+    ascending = since_id is not None or trace_id is not None
+    rows = query(kinds=kinds,
+                 entity=params.get('entity') or None,
+                 entity_prefix=params.get('entity_prefix') or None,
+                 trace_id=trace_id, since_id=since_id, limit=limit,
+                 ascending=ascending, db_path=db_path)
+    if not ascending:
+        rows.reverse()
+    return {
+        'host': host,
+        'count': len(rows),
+        'events': rows,
+        'next_since_id': max((r['event_id'] for r in rows),
+                             default=since_id or 0),
+    }
+
+
+def resolve_trace_prefix(prefix: str,
+                         db_path: Optional[str] = None) -> List[str]:
     """Full trace ids matching a prefix — resolved in SQL so even traces
     whose events sit deep in the journal are found (`skytpu events`
     prints 8-char prefixes)."""
     escaped = (prefix.replace('\\', '\\\\')
                .replace('%', '\\%').replace('_', '\\_'))
     try:
-        rows = _db().execute(
+        rows = _db(db_path).execute(
             "SELECT DISTINCT trace_id FROM events WHERE trace_id "
             "LIKE ? ESCAPE '\\'", (escaped + '%',)).fetchall()
     except (sqlite3.Error, OSError):
@@ -456,23 +749,33 @@ def _fmt_payload(payload: Dict[str, Any], skip: Sequence[str] = ()) -> str:
 
 def format_event_line(e: Dict[str, Any]) -> str:
     """One event as a stable, non-tabular line (the --follow stream —
-    per-event table widths would make columns jump on every row)."""
+    per-event table widths would make columns jump on every row).
+    Federated rows carry a ``host`` tag (which journal served the row);
+    local rows don't and the column stays out of the way."""
+    host = f'  @{e["host"]}' if e.get('host') else ''
     return (f'{_fmt_ts(e["ts"])}  {e["kind"]:<24} '
             f'{(e["entity"] or "-"):<24} '
             f'{(e["trace_id"] or "")[:8] or "-":<8}  '
-            f'{_fmt_payload(e["payload"]) or "-"}')
+            f'{_fmt_payload(e["payload"]) or "-"}{host}')
 
 
 def format_events(events: List[Dict[str, Any]]) -> str:
-    """Flat timeline table for ``skytpu events`` (pass oldest-first)."""
+    """Flat timeline table for ``skytpu events`` (pass oldest-first).
+    A HOST column appears when any row is host-tagged (federated)."""
     if not events:
         return 'No journal events.'
+    with_host = any(e.get('host') for e in events)
     header = ('TIME', 'KIND', 'ENTITY', 'TRACE', 'DETAIL')
+    if with_host:
+        header = ('TIME', 'HOST', 'KIND', 'ENTITY', 'TRACE', 'DETAIL')
     rows = []
     for e in events:
-        rows.append((_fmt_ts(e['ts']), e['kind'], e['entity'] or '-',
-                     (e['trace_id'] or '')[:8] or '-',
-                     _fmt_payload(e['payload']) or '-'))
+        row = (_fmt_ts(e['ts']), e['kind'], e['entity'] or '-',
+               (e['trace_id'] or '')[:8] or '-',
+               _fmt_payload(e['payload']) or '-')
+        if with_host:
+            row = (row[0], e.get('host') or '-') + row[1:]
+        rows.append(row)
     widths = [max(len(header[i]), *(len(r[i]) for r in rows))
               for i in range(len(header))]
     lines = ['  '.join(h.ljust(widths[i]) for i, h in enumerate(header))]
@@ -482,13 +785,14 @@ def format_events(events: List[Dict[str, Any]]) -> str:
 
 
 class _SpanNode:
-    __slots__ = ('span_id', 'name', 'entity', 'start', 'end', 'error',
-                 'events', 'children', 'parent')
+    __slots__ = ('span_id', 'name', 'entity', 'host', 'start', 'end',
+                 'error', 'events', 'children', 'parent')
 
     def __init__(self, span_id: Optional[str]):
         self.span_id = span_id
         self.name: Optional[str] = None
         self.entity = ''
+        self.host = ''
         self.start: Optional[float] = None
         self.end: Optional[float] = None
         self.error: Optional[str] = None
@@ -517,6 +821,7 @@ def span_tree(events: List[Dict[str, Any]]) -> List[_SpanNode]:
         if kind == EventKind.SPAN_START.value:
             n.name = e['payload'].get('name')
             n.entity = e['entity'] or n.entity
+            n.host = e.get('host') or n.host
             n.start = e['ts']
             n.parent = e['parent_span_id']
         elif kind == EventKind.SPAN_END.value:
@@ -525,6 +830,7 @@ def span_tree(events: List[Dict[str, Any]]) -> List[_SpanNode]:
         else:
             n.events.append(e)
             n.entity = n.entity or (e['entity'] or '')
+            n.host = n.host or (e.get('host') or '')
             if n.start is None:
                 n.start = e['ts']
             if n.parent is None:
@@ -565,14 +871,19 @@ def format_trace(trace_id: str,
         # before the controller exists) collect under '(no span)'.
         label = n.name or (f'span {n.span_id}' if n.span_id
                            else '(no span)')
-        suffix = f'  [{n.entity}]' if n.entity else ''
+        where = n.entity
+        if n.host:
+            where = f'{where}@{n.host}' if where else f'@{n.host}'
+        suffix = f'  [{where}]' if where else ''
         err = f'  ERROR: {n.error}' if n.error else ''
         lines.append(f'{indent}{label}{suffix}{_dur(n)}{err}')
         for e in n.events:
             detail = _fmt_payload(e['payload'], skip=('name',))
             detail = f'  {detail}' if detail else ''
+            host = f'  @{e["host"]}' if e.get('host') else ''
             lines.append(f'{indent}  +{e["ts"] - t0:7.1f}s '
-                         f'{e["kind"]}  {e["entity"] or "-"}{detail}')
+                         f'{e["kind"]}  {e["entity"] or "-"}{detail}'
+                         f'{host}')
         for c in n.children:
             _render(c, indent + '  ')
 

@@ -53,6 +53,7 @@ cross-replica prefix-fetch hint (docs/serving.md).
 import argparse
 import asyncio
 import json
+import os
 import threading
 import time
 from collections import deque
@@ -89,6 +90,13 @@ DEFAULT_EJECT_BACKOFF_SECONDS = 10.0
 EJECT_PROBE_INTERVAL_ENV = 'SKYTPU_LB_EJECT_PROBE_INTERVAL'
 DEFAULT_EJECT_PROBE_INTERVAL = 1.0
 _EJECT_BACKOFF_MAX_SECONDS = 120.0
+# Federated flight recorder: the LB answers /journal itself (its own
+# lb.proxy/lb.hop rows) and advertises the ready set so `skytpu trace
+# --fleet <lb>` can expand to every replica's /journal. Gate follows
+# the replica convention: an LB with NO replica source configured
+# (neither in-proc callback nor controller) only answers when
+# SKYTPU_JOURNAL_PEERS names its callers.
+JOURNAL_PEERS_ENV = 'SKYTPU_JOURNAL_PEERS'
 
 # Prefix-affinity owner advertisement: when the affinity policy routes
 # a digest AWAY from its primary consistent-hash owner (load spill,
@@ -306,7 +314,8 @@ class LoadBalancer:
     def __init__(self, port: int, policy_name: str,
                  get_ready_urls: Optional[Callable[[], List[str]]] = None,
                  controller_url: Optional[str] = None,
-                 metrics_port: Optional[int] = None):
+                 metrics_port: Optional[int] = None,
+                 journal_db: Optional[str] = None):
         self.port = port
         self.policy = lb_policies.LoadBalancingPolicy.make(policy_name)
         self._get_ready_urls = get_ready_urls
@@ -332,8 +341,12 @@ class LoadBalancer:
         # Trace-event buffer: span/hop rows batch into ONE sqlite
         # transaction per flush tick (the engine's journaling idiom) —
         # a per-event commit inside the asyncio loop would stall every
-        # in-flight proxy stream on fsync under load.
-        self._jbuf = journal.JournalBuffer()
+        # in-flight proxy stream on fsync under load. ``journal_db``
+        # pins this LB to its own journal file (federated e2e); None =
+        # the host journal.
+        self._journal_db = journal_db
+        self._jbuf = journal.JournalBuffer(db_path=journal_db,
+                                           entity=f'lb:{port}')
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
@@ -522,7 +535,7 @@ class LoadBalancer:
                         labels=('replica',)).inc(labels=(url,))
         journal.event(journal.EventKind.LB_EJECT, f'lb:{self.port}',
                       {'action': 'eject', 'replica': url, 'kind': kind,
-                       **ejected})
+                       **ejected}, db_path=self._journal_db)
         logger.warning(
             f'Ejecting replica {url} after '
             f'{ejected["consecutive_failures"]} consecutive failures '
@@ -550,7 +563,8 @@ class LoadBalancer:
                     self.breaker.reinstate(url)
                     journal.event(journal.EventKind.LB_EJECT,
                                   f'lb:{self.port}',
-                                  {'action': 'reinstate', 'replica': url})
+                                  {'action': 'reinstate', 'replica': url},
+                                  db_path=self._journal_db)
                     logger.info(f'Replica {url} probe passed; '
                                 'reinstated.')
                 else:
@@ -616,6 +630,12 @@ class LoadBalancer:
         # reachable on each replica's own port.
         if request.method == 'GET' and tail == 'slo':
             return web.json_response(self.fleet.snapshot())
+        # Federated flight recorder head: the LB serves ITS OWN journal
+        # rows (the lb.proxy/lb.hop side of every trace) plus the ready
+        # set, so one `--fleet <lb>` endpoint expands to the whole
+        # fleet's journals.
+        if tail == 'journal' and request.method in ('GET', 'POST'):
+            return await self._handle_journal(request)
         t_start = time.perf_counter()
         with self._ts_lock:
             self._request_timestamps.append(time.time())
@@ -665,6 +685,40 @@ class LoadBalancer:
 
     def flush_journal(self) -> None:
         self._jbuf.flush()
+
+    async def _handle_journal(self, request: web.Request) -> web.Response:
+        """LB side of the /journal query plane: this LB's own rows +
+        the ready-replica set for one-level federation expansion. An LB
+        with no replica source at all (not a fleet head) follows the
+        replica trust convention — 404 unless SKYTPU_JOURNAL_PEERS is
+        set."""
+        if (self._get_ready_urls is None
+                and self._controller_url is None
+                and not os.environ.get(JOURNAL_PEERS_ENV, '').strip()):
+            return web.json_response(
+                {'error': 'journal query plane not configured '
+                          '(SKYTPU_JOURNAL_PEERS)'}, status=404)
+        params: dict = dict(request.query)
+        if request.method == 'POST' and request.can_read_body:
+            try:
+                body = await request.json()
+                if isinstance(body, dict):
+                    params.update(body)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                pass  # malformed filter → serve the unfiltered page
+        loop = asyncio.get_running_loop()
+
+        def _pull() -> dict:
+            # Land buffered span/hop rows first (off the event loop —
+            # this may sit behind a stalled journal disk, which must
+            # never pause in-flight proxy streams).
+            self.flush_journal()
+            return journal.serve_query(params, db_path=self._journal_db,
+                                       host=f'lb:{self.port}')
+
+        out = await loop.run_in_executor(None, _pull)
+        out['replicas'] = self._ready_urls()
+        return web.json_response(out)
 
     async def _journal_flush_loop(self) -> None:
         loop = asyncio.get_running_loop()
@@ -994,7 +1048,8 @@ class LoadBalancer:
                             journal.EventKind.LB_EJECT,
                             f'lb:{self.port}',
                             {'action': 'reinstate', 'replica': current,
-                             'kind': 'fallback_success'})
+                             'kind': 'fallback_success'},
+                            db_path=self._journal_db)
                     return out
             except (aiohttp.ClientConnectorError,
                     aiohttp.ServerDisconnectedError) as e:

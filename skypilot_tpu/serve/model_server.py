@@ -134,6 +134,13 @@ SERVE_TP_ENV = 'SKYTPU_SERVE_TP'
 # is monolithic serving.
 REPLICA_ROLE_ENV = 'SKYTPU_REPLICA_ROLE'
 _ROLES = ('prefill', 'decode', 'mixed')
+# Federated flight recorder trust set: hosts allowed to pull this
+# replica's /journal. The endpoint answers when the replica is already
+# configured into a fleet (SKYTPU_PREFIX_PEERS — the PR 15 trust
+# convention) OR this knob names the head(s); with neither, /journal is
+# 404 — a replica outside any fleet must not export its journal to
+# whoever reaches its port.
+JOURNAL_PEERS_ENV = 'SKYTPU_JOURNAL_PEERS'
 
 # skytpu_server_state gauge values (the LB/operators read the metric;
 # /healthz carries the string).
@@ -158,8 +165,15 @@ class ModelServer:
     def __init__(self, engine: engine_lib.DecodeEngine, port: int,
                  host: str = '0.0.0.0',
                  default_max_new_tokens: int = 128,
-                 role: Optional[str] = None):
+                 role: Optional[str] = None,
+                 journal_db: Optional[str] = None):
         self.engine = engine
+        # Which journal file this replica's direct writes and /journal
+        # reads target: explicit > the engine's (they share a replica) >
+        # the host default. The federated e2e gives each in-process
+        # replica its own file.
+        self._journal_db = (journal_db if journal_db is not None
+                            else getattr(engine, 'journal_db', None))
         self.host = host
         self.port = port  # rebound to the OS-assigned port when 0
         self.default_max_new_tokens = default_max_new_tokens
@@ -246,7 +260,8 @@ class ModelServer:
                     f'engine:{self.engine.name}',
                     {'error': 'engine thread wedged at server stop',
                      'wedged': True, 'phase': 'stop',
-                     'join_timeout_seconds': stop_timeout})
+                     'join_timeout_seconds': stop_timeout},
+                    db_path=self._journal_db)
         if self._loop is not None and not self._loop.is_closed():
             try:
                 self._loop.call_soon_threadsafe(self._loop.stop)
@@ -325,7 +340,8 @@ class ModelServer:
                       {'phase': 'begin', 'reason': reason,
                        'in_flight': self.engine.active_slots(),
                        'queued': self.engine.queue_depth(),
-                       'timeout_seconds': self.drain_timeout})
+                       'timeout_seconds': self.drain_timeout},
+                      db_path=self._journal_db)
         logger.info(f'Draining ({reason}): waiting up to '
                     f'{self.drain_timeout:.0f}s for in-flight requests.')
         self._drain_thread = threading.Thread(target=self._drain_and_stop,
@@ -351,7 +367,8 @@ class ModelServer:
                       {'phase': 'done', 'drained': drained,
                        'waited_seconds': round(time.time() - t0, 3),
                        'in_flight': self.engine.active_slots(),
-                       'queued': self.engine.queue_depth()})
+                       'queued': self.engine.queue_depth()},
+                      db_path=self._journal_db)
         if not drained:
             logger.warning(
                 f'Drain timed out after {self.drain_timeout:.0f}s with '
@@ -373,6 +390,8 @@ class ModelServer:
         app.router.add_get('/debug/requests', self._handle_debug_requests)
         app.router.add_get('/debug/engine', self._handle_debug_engine)
         app.router.add_get('/slo', self._handle_slo)
+        app.router.add_get('/journal', self._handle_journal)
+        app.router.add_post('/journal', self._handle_journal)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -619,7 +638,8 @@ class ModelServer:
             journal.event(journal.EventKind.ENGINE_HANDOFF,
                           self._entity(),
                           {'outcome': 'degraded', 'reason': degrade,
-                           'target': target or None})
+                           'target': target or None},
+                          db_path=self._journal_db)
         tenant = (request.headers.get('X-Tenant')
                   or body.get('tenant') or 'default')
         request_id = (request.headers.get(trace_lib.REQUEST_ID_HEADER)
@@ -879,6 +899,41 @@ class ModelServer:
         steps.pop('recent', None)
         body['steps'] = steps
         return web.json_response(body)
+
+    async def _handle_journal(self, request: web.Request) -> web.Response:
+        """Federated flight recorder, replica side: serve filtered rows
+        from THIS replica's journal (trace id, kinds, entity, since-rowid
+        cursor, hard row cap — journal.serve_query). Trust gate follows
+        the /prefix_blocks convention: only a replica configured into a
+        fleet (SKYTPU_PREFIX_PEERS) or with an explicit head allowlist
+        (SKYTPU_JOURNAL_PEERS) answers; everyone else sees 404."""
+        if not self.engine.prefix_peers and \
+                not os.environ.get(JOURNAL_PEERS_ENV, '').strip():
+            return web.json_response(
+                {'error': 'journal query plane not configured '
+                          '(SKYTPU_JOURNAL_PEERS)'}, status=404)
+        params: dict = dict(request.query)
+        if request.method == 'POST' and request.can_read_body:
+            try:
+                body = await request.json()
+                if isinstance(body, dict):
+                    params.update(body)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                pass  # malformed filter → serve the unfiltered page
+        loop = asyncio.get_running_loop()
+
+        def _pull() -> dict:
+            # Land buffered engine rows first so a just-finished
+            # request's spans are visible to the federation pull. This
+            # synchronous flush may sit behind a stalled journal disk —
+            # acceptable on the query plane (never on /generate).
+            self.engine.flush_journal()
+            return journal.serve_query(params, db_path=self._journal_db,
+                                       host=self._entity())
+
+        out = await loop.run_in_executor(None, _pull)
+        out['role'] = self.role
+        return web.json_response(out)
 
     async def _handle_prefix_blocks(self, request: web.Request
                                     ) -> web.Response:

@@ -320,6 +320,28 @@ def _serve_logs(payload: Dict[str, Any]) -> Dict[str, Any]:
     return {'returncode': rc}
 
 
+def _journal(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Journal query API on the head: the controller host's own flight
+    recorder (launch/provision/job/serve lifecycle), served with the
+    shared /journal filter surface (``journal.serve_query`` — trace id,
+    kinds, entity, since-rowid cursor, hard
+    ``SKYTPU_JOURNAL_QUERY_LIMIT`` row cap) and the PR 16 ``limit``/
+    ``offset`` window applied on top — the same opt-in pagination
+    contract as /status."""
+    from skypilot_tpu.observability import journal as journal_lib
+    params = {k: v for k, v in payload.items()
+              if k not in ('limit', 'offset')}
+    body = journal_lib.serve_query(params, host='api-server')
+    body['events'] = _paginate(body['events'], payload)
+    body['count'] = len(body['events'])
+    if body['events']:
+        # The resume cursor tracks the page actually served, so a
+        # limited pull continues where it left off.
+        body['next_since_id'] = max(r['event_id']
+                                    for r in body['events'])
+    return body
+
+
 EXECUTORS: Dict[str, Callable[[Dict[str, Any]], Any]] = {
     'launch': _launch,
     'exec': _exec,
@@ -349,6 +371,7 @@ EXECUTORS: Dict[str, Callable[[Dict[str, Any]], Any]] = {
     'logs': _tail_logs,
     'jobs_logs': _jobs_logs,
     'serve_logs': _serve_logs,
+    'journal': _journal,
 }
 
 # LONG requests get a dedicated worker process (they can run for hours and

@@ -56,6 +56,7 @@ _VERB_ROUTES = {
     '/serve/status': 'serve_status',
     '/serve/down': 'serve_down',
     '/serve/logs': 'serve_logs',
+    '/journal': 'journal',
 }
 
 

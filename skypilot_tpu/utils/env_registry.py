@@ -200,11 +200,16 @@ _e('SKYTPU_SERVE_DOWN_TIMEOUT', '300',
    'skypilot_tpu/serve/core.py', 'serving')
 _e('SKYTPU_CHAOS', None,
    'Fault-injection spec (engine_step_raise:N,slow_step:p,drain_hang,'
-   'replica_500:p,handoff_decode_death,handoff_truncate); unset = '
-   'off.',
+   'replica_500:p,handoff_decode_death,handoff_truncate,'
+   'journal_write_stall,journal_disk_full); unset = off.',
    'skypilot_tpu/utils/chaos.py', 'serving')
 _e('SKYTPU_CHAOS_SLOW_STEP_SECONDS', '0.2',
    'Injected engine-step delay for the slow_step chaos point.',
+   'skypilot_tpu/utils/chaos.py', 'serving')
+_e('SKYTPU_CHAOS_JOURNAL_STALL_SECONDS', '2.0',
+   'Injected journal-flush delay for the journal_write_stall chaos '
+   'point (must exceed SKYTPU_JOURNAL_STALL_SECONDS to trip the stall '
+   'detector).',
    'skypilot_tpu/utils/chaos.py', 'serving')
 _e('SKYTPU_DISABLE_JAX_DISTRIBUTED', '0',
    'Opt out of the idempotent jax.distributed.initialize bootstrap on '
@@ -222,6 +227,42 @@ _e('SKYTPU_JOURNAL_DISABLED', '0',
 _e('SKYTPU_JOURNAL_MAX_EVENTS', '20000',
    'Journal retention: rowid-window pruning bound.',
    'skypilot_tpu/observability/journal.py', 'observability')
+_e('SKYTPU_JOURNAL_PATH', None,
+   'Journal sqlite file override (unset = ~/.skytpu/journal.db). '
+   'Multi-replica-per-host tests give each server its own journal '
+   'this way.',
+   'skypilot_tpu/observability/journal.py', 'observability')
+_e('SKYTPU_JOURNAL_QUEUE_DEPTH', '4096',
+   'JournalBuffer bound: buffered events past this are DROPPED (and '
+   'counted in skytpu_journal_dropped_total) rather than blocking the '
+   'engine step loop.',
+   'skypilot_tpu/observability/journal.py', 'observability')
+_e('SKYTPU_JOURNAL_STALL_SECONDS', '1.0',
+   'A journal flush slower than this is a write stall: journaled as '
+   'journal.stall once the disk recovers.',
+   'skypilot_tpu/observability/journal.py', 'observability')
+_e('SKYTPU_JOURNAL_QUERY_LIMIT', '1000',
+   'Hard cap on rows one /journal query may return (client limit is '
+   'clamped to it).',
+   'skypilot_tpu/observability/journal.py', 'observability')
+_e('SKYTPU_JOURNAL_PEERS', None,
+   'Comma-separated peers trusted to pull this host\'s /journal; '
+   'arms the journal query plane on servers outside a prefix-peer '
+   'fleet (unset + no fleet = /journal answers 404).',
+   'skypilot_tpu/serve/model_server.py', 'observability')
+_e('SKYTPU_JOURNAL_PEER_TIMEOUT', '5.0',
+   'Per-peer timeout for one federated /journal pull (split between '
+   'connect and read) — a wedged replica costs one timeout, not the '
+   'whole render.',
+   'skypilot_tpu/observability/federation.py', 'observability')
+_e('SKYTPU_JOURNAL_FANOUT', '8',
+   'Concurrent /journal pulls in flight during a federated collect.',
+   'skypilot_tpu/observability/federation.py', 'observability')
+_e('SKYTPU_JOURNAL_PEER_BACKOFF_SECONDS', '10.0',
+   'How long a peer whose /journal pull failed is skipped before '
+   'being retried (one dead peer must not cost every --follow tick a '
+   'timeout).',
+   'skypilot_tpu/observability/federation.py', 'observability')
 _e('SKYTPU_JOURNAL_ONLY_KINDS', None,
    'Comma-separated EventKind filter: when set, only those kinds are '
    'written (bench lanes keep slow_request joinable without '

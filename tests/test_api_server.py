@@ -655,3 +655,53 @@ def test_ws_ssh_proxy_kubernetes_transport(api_env, tmp_path,
     finally:
         echo.close()
         global_state.remove_cluster('wsk8s-c1', terminate=True)
+
+
+def test_sdk_journal_cursor_and_pagination(api_env):
+    """ISSUE-19: the head's /journal verb through the SDK — the API
+    server serves its OWN flight recorder (host-tagged 'api-server'),
+    the since_id cursor resumes exactly, and the /status-style opt-in
+    limit/offset window recomputes the resume cursor for the page it
+    actually served."""
+    rid = sdk.launch(_local_task('api-j', 'echo j'),
+                     cluster_name='api-j1')
+    sdk.get(rid)
+
+    body = sdk.get(sdk.journal())
+    assert body['host'] == 'api-server'
+    events = body['events']
+    assert events and body['count'] == len(events)
+    ids = [e['event_id'] for e in events]
+    assert ids == sorted(ids)  # page reads oldest-first
+    assert body['next_since_id'] == ids[-1]
+    assert any(e['entity'] == 'cluster:api-j1' for e in events)
+
+    # Cursor: nothing new since the snapshot...
+    again = sdk.get(sdk.journal(since_id=body['next_since_id']))
+    assert again['events'] == []
+    assert again['next_since_id'] == body['next_since_id']
+    # ...and new activity resumes exactly after it.
+    sdk.get(sdk.exec_(_local_task('api-j2', 'echo j2'),
+                      cluster_name='api-j1'))
+    fresh = sdk.get(sdk.journal(since_id=body['next_since_id']))
+    assert fresh['events']
+    assert min(e['event_id'] for e in fresh['events']) > \
+        body['next_since_id']
+
+    # Opt-in limit/offset window rides ON TOP of the journal page,
+    # with the cursor recomputed for the served window.
+    page = sdk.get(sdk.journal(limit=2))
+    assert [e['event_id'] for e in page['events']] == ids[:2]
+    assert page['next_since_id'] == ids[1]
+    assert sdk.get(sdk.journal(limit=2, offset=10_000))['events'] == []
+
+    # Filters pass through.
+    ent = sdk.get(sdk.journal(entity_prefix='cluster:'))
+    assert ent['events']
+    assert all(e['entity'].startswith('cluster:')
+               for e in ent['events'])
+    kinds = sorted({e['kind'] for e in events})
+    one = sdk.get(sdk.journal(kinds=[kinds[0]]))
+    assert {e['kind'] for e in one['events']} == {kinds[0]}
+
+    sdk.get(sdk.down('api-j1'))

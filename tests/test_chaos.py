@@ -588,6 +588,94 @@ def test_chaos_handoff_decode_death_degrades_to_answer(monkeypatch):
         d_srv.stop()
 
 
+def test_chaos_journal_write_stall_never_blocks_serving(monkeypatch):
+    """Acceptance (ISSUE 19): with the journal disk wedged
+    (`journal_write_stall` sleeps inside JournalBuffer batch commits)
+    and a tiny bounded queue, serving never notices — in-flight
+    /generate completes (direct AND through the LB proxy), /healthz
+    stays 200 throughout, overflow rows are dropped and counted
+    instead of blocking an appender, and exactly ONE `journal.stall`
+    row lands once the disk recovers."""
+    monkeypatch.setenv(journal.QUEUE_DEPTH_ENV, '4')
+    monkeypatch.setenv(journal.STALL_SECONDS_ENV, '0.2')
+    monkeypatch.setenv(chaos.JOURNAL_STALL_SECONDS_ENV, '1.0')
+    srv, eng, base = _server(name='chaos-jstall')
+    with socket.socket() as s:
+        s.bind(('', 0))
+        lb_port = s.getsockname()[1]
+    lb = lb_lib.LoadBalancer(lb_port, 'round_robin',
+                             get_ready_urls=lambda: [base])
+    lb.start()
+    try:
+        # Warm the compile cache with chaos disarmed so the stall
+        # window cleanly covers serving, not XLA compilation.
+        r = requests.post(f'{base}/generate',
+                          json={'prompt': [1, 2, 3],
+                                'max_new_tokens': 2, 'stream': False},
+                          timeout=120)
+        assert r.status_code == 200
+
+        monkeypatch.setenv(chaos.CHAOS_ENV, 'journal_write_stall:1')
+        # Wedge the disk: the next non-empty batch commit sleeps 1 s on
+        # a background flusher thread.
+        eng.journal_buffered(journal.EventKind.SPAN_START,
+                             {'name': 'wedge'})
+        eng.flush_journal(wait=False)
+        time.sleep(0.05)  # let the flusher thread take the batch
+        # While it is wedged: appends drop at the bound instead of
+        # blocking (lock + list append — the wall clock proves it).
+        t0 = time.time()
+        for i in range(10):
+            eng.journal_buffered(journal.EventKind.SPAN_START,
+                                 {'name': f'overflow-{i}'})
+        assert time.time() - t0 < 0.5
+        assert eng.journal_stats()['dropped_queue_full'] >= 6
+        # ... and serving continues inside the stall window: direct +
+        # proxied requests answer, /healthz stays 200.
+        r = requests.post(f'{base}/generate',
+                          json={'prompt': [4, 5, 6],
+                                'max_new_tokens': 4, 'stream': False},
+                          timeout=60)
+        assert r.status_code == 200 and r.json()['generated'] == 4
+        r = requests.post(f'http://127.0.0.1:{lb_port}/generate',
+                          json={'prompt': [7, 8, 9],
+                                'max_new_tokens': 4, 'stream': False},
+                          timeout=60)
+        assert r.status_code == 200 and r.json()['generated'] == 4
+        assert requests.get(f'{base}/healthz',
+                            timeout=10).status_code == 200
+
+        # Recovery: the next fast non-empty flush journals the stall,
+        # once, with the drop accounting attached.
+        deadline = time.time() + 15
+        stalls = []
+        while time.time() < deadline:
+            eng.journal_buffered(journal.EventKind.SPAN_END,
+                                 {'name': 'recovery-probe'})
+            eng.flush_journal()
+            stalls = journal.query(
+                kinds=[journal.EventKind.JOURNAL_STALL], limit=10)
+            if stalls:
+                break
+            time.sleep(0.05)
+        assert len(stalls) == 1, stalls
+        payload = stalls[0]['payload']
+        assert payload['stall_seconds'] >= 0.2
+        assert payload['dropped_queue_full'] >= 6
+        # Still exactly one after further flush cycles.
+        eng.journal_buffered(journal.EventKind.SPAN_END, {'name': 'w'})
+        eng.flush_journal()
+        assert len(journal.query(
+            kinds=[journal.EventKind.JOURNAL_STALL], limit=10)) == 1
+        # The drops are on the exported metric surface too.
+        dropped = metrics_lib.get_registry().get(
+            'skytpu_journal_dropped_total')
+        assert dropped.value(labels=('queue_full',)) >= 6
+    finally:
+        lb.stop()
+        srv.stop()
+
+
 def test_chaos_handoff_truncate_degrades_to_answer(monkeypatch):
     """Acceptance: a truncated wire payload (`handoff_truncate` halves
     the push body) is rejected by the decode side's validation and the

@@ -244,6 +244,195 @@ def test_slo_and_healthz_survive_supervised_restart(monkeypatch):
         srv.stop()
 
 
+def test_federated_trace_across_three_journals(monkeypatch, tmp_path):
+    """ISSUE-19 acceptance: an LB plus prefill-role and decode-role
+    replicas — THREE separate journal sqlite files — serve one
+    disaggregated two-leg request; `skytpu trace <id> --fleet <lb>`
+    renders a single span tree containing the lb.proxy span, both
+    legs' server-side spans and the engine.handoff event, every row
+    attributed to the journal host that served it."""
+    from skypilot_tpu.observability import federation
+    monkeypatch.setenv('SKYTPU_FLEET_SLO_INTERVAL', '0.2')
+    federation.reset_backoff()
+    db_lb = str(tmp_path / 'lb.db')
+    db_p = str(tmp_path / 'prefill.db')
+    db_d = str(tmp_path / 'decode.db')
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+
+    def dcfg():
+        return decode.DecodeConfig(max_len=64, temperature=0.0,
+                                   decode_attention='xla',
+                                   kernel_block_k=8)
+
+    d_eng = engine_lib.DecodeEngine(params, CFG, dcfg(), 2, paged=True,
+                                    num_blocks=33, prefill_chunk=8,
+                                    name='fed-d',
+                                    prefix_peers=['pending'],
+                                    journal_db=db_d)
+    d_srv = model_server.ModelServer(d_eng, port=0, host='127.0.0.1',
+                                     role='decode')
+    d_url = f'http://127.0.0.1:{d_srv.start()}'
+    p_eng = engine_lib.DecodeEngine(params, CFG, dcfg(), 2, paged=True,
+                                    num_blocks=33, prefill_chunk=8,
+                                    name='fed-p',
+                                    prefix_peers=[d_url],
+                                    journal_db=db_p)
+    p_srv = model_server.ModelServer(p_eng, port=0, host='127.0.0.1',
+                                     role='prefill')
+    p_url = f'http://127.0.0.1:{p_srv.start()}'
+    d_eng.prefix_peers[:] = [p_url]
+    lb = lb_lib.LoadBalancer(_free_port(), 'disagg',
+                             get_ready_urls=lambda: [p_url, d_url],
+                             journal_db=db_lb)
+    lb.start()
+    lb_url = f'http://127.0.0.1:{lb.port}'
+    p_host = f'server:fed-p:{p_srv.port}'
+    d_host = f'server:fed-d:{d_srv.port}'
+    try:
+        _wait(lambda: {'prefill', 'decode'} <=
+              set(lb.policy.roles().values()),
+              msg='LB learning replica roles from /slo')
+        custom = 'feedc0de' * 4
+        prompt = list(range(1, 29))  # 3 aligned blocks + 4-token tail
+        r = requests.post(f'{lb_url}/generate',
+                          json={'prompt': prompt, 'max_new_tokens': 6,
+                                'stream': False},
+                          headers={'X-Request-Id': custom}, timeout=120)
+        assert r.status_code == 200, r.text
+        assert r.json()['generated'] == 6
+        # The request really took the two-leg split, not the
+        # monolithic fallback.
+        assert p_eng.handoff_stats()['completed'] == 1
+        assert d_eng.handoff_stats()['tokens_injected'] == 24
+
+        # Three separate journals by construction: nothing under the
+        # trace in this process's default journal.
+        assert journal.query(trace_id=custom, limit=10) == []
+
+        def fed_ready():
+            res = federation.collect([lb_url],
+                                     {'trace_id': custom,
+                                      'limit': 1000})
+            ends = {(e['payload'] or {}).get('name')
+                    for e in res.events if e['kind'] == 'span.end'}
+            kinds = {e['kind'] for e in res.events}
+            if {'lb.proxy', 'lb.handoff', 'server.handoff',
+                    'server.request'} <= ends \
+                    and 'engine.handoff' in kinds:
+                return res
+            return None
+
+        res = _wait(fed_ready, msg='federated trace rows')
+        # One LB endpoint expanded to the whole fleet: all three
+        # journals answered, none errored.
+        assert res.errors == {}
+        assert set(res.hosts.values()) == {f'lb:{lb.port}', p_host,
+                                           d_host}
+        # Every merged row is attributed to the journal that served it.
+        assert {e['host'] for e in res.events} == \
+            {f'lb:{lb.port}', p_host, d_host}
+
+        # ONE tree across the three journals: lb.proxy at the root,
+        # the handoff split and both server-side legs nested under it.
+        roots = journal.span_tree(res.events)
+        assert len(roots) == 1, [n.name for n in roots]
+        root = roots[0]
+        assert root.name == 'lb.proxy' and root.host == f'lb:{lb.port}'
+
+        def find(node, name):
+            for c in node.children:
+                if c.name == name:
+                    return c
+                deeper = find(c, name)
+                if deeper is not None:
+                    return deeper
+            return None
+
+        handoff = find(root, 'lb.handoff')
+        assert handoff is not None and handoff.host == f'lb:{lb.port}'
+        prefill_leg = find(root, 'server.handoff')
+        assert prefill_leg is not None and prefill_leg.host == p_host
+        decode_leg = find(root, 'server.request')
+        assert decode_leg is not None and decode_leg.host == d_host
+        assert any(e['kind'] == 'engine.handoff'
+                   and e['payload'].get('outcome') == 'complete'
+                   for e in res.events)
+
+        # The CLI renders the same single federated tree.
+        from click.testing import CliRunner
+        from skypilot_tpu.client import cli as cli_mod
+        out = CliRunner().invoke(cli_mod.cli,
+                                 ['trace', custom, '--fleet', lb_url])
+        assert out.exit_code == 0, out.output
+        for needle in ('lb.proxy', 'lb.handoff', 'server.handoff',
+                       'server.request', 'engine.handoff',
+                       f'@{p_host}', f'@{d_host}', f'@lb:{lb.port}'):
+            assert needle in out.output, (needle, out.output)
+
+        # `skytpu events --fleet` merges the same three journals into
+        # one host-tagged timeline (comma-splitting + LB expansion).
+        out = CliRunner().invoke(
+            cli_mod.cli, ['events', '--fleet', lb_url, '-n', '200'])
+        assert out.exit_code == 0, out.output
+        assert 'HOST' in out.output
+        for host in (f'lb:{lb.port}', p_host, d_host):
+            assert host in out.output, (host, out.output)
+    finally:
+        lb.stop()
+        p_srv.stop()
+        d_srv.stop()
+
+
+def test_journal_query_plane_trust_gate(monkeypatch):
+    """ISSUE-19: /journal follows the prefix-peer trust convention — a
+    replica outside any configured fleet (no SKYTPU_PREFIX_PEERS wiring,
+    no SKYTPU_JOURNAL_PEERS allowlist) answers 404; arming the
+    allowlist opens the bounded query plane."""
+    monkeypatch.delenv('SKYTPU_JOURNAL_PEERS', raising=False)
+    srv = _make_server('gate')
+    base = f'http://127.0.0.1:{srv.port}'
+    try:
+        r = requests.get(f'{base}/journal', timeout=10)
+        assert r.status_code == 404, r.text
+        assert 'SKYTPU_JOURNAL_PEERS' in r.json()['error']
+
+        monkeypatch.setenv('SKYTPU_JOURNAL_PEERS', 'http://head:1')
+        g = requests.post(f'{base}/generate',
+                          json={'prompt': [5, 3, 1], 'max_new_tokens': 2,
+                                'stream': False}, timeout=120)
+        assert g.status_code == 200
+        r = requests.get(f'{base}/journal', timeout=10)
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body['host'] == f'server:gate:{srv.port}'
+        assert body['count'] == len(body['events']) > 0
+        assert body['next_since_id'] > 0
+        kinds = {e['kind'] for e in body['events']}
+        assert 'span.end' in kinds  # buffered spans flushed on demand
+
+        # POST-body filters ride the same endpoint; the row cap holds.
+        r = requests.post(f'{base}/journal',
+                          json={'kinds': 'engine.admit', 'limit': 1},
+                          timeout=10)
+        assert r.status_code == 200
+        rows = r.json()['events']
+        assert len(rows) == 1 and rows[0]['kind'] == 'engine.admit'
+
+        # The LB side of the same convention: an LB with NO replica
+        # source at all is not a fleet head either.
+        headless = lb_lib.LoadBalancer(_free_port(), 'round_robin')
+        monkeypatch.delenv('SKYTPU_JOURNAL_PEERS')
+        headless.start()
+        try:
+            r = requests.get(
+                f'http://127.0.0.1:{headless.port}/journal', timeout=10)
+            assert r.status_code == 404, r.text
+        finally:
+            headless.stop()
+    finally:
+        srv.stop()
+
+
 def test_drain_keeps_slo_surface_consistent(monkeypatch):
     """Draining flips /healthz to 503 (the LB routes away) while /slo
     keeps answering with the DRAINING state — operators can watch a

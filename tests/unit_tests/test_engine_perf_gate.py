@@ -146,6 +146,33 @@ def test_replay_holds_with_tp2():
         env['paged_admitted_concurrency'] * floor
 
 
+def test_replay_holds_with_buffered_journal(sched_result):
+    """ISSUE-19: the envelope replay runs with the engine's buffered
+    journal path live (every step ends with a non-blocking
+    flush_journal(wait=False)), so the tokens/step assertions double as
+    the journal-overhead gate — if buffering/flushing ever got
+    expensive enough to cost scheduler throughput >20%, tier-1 fails.
+    The detail block must also carry the journal profile so bench
+    trends can watch drops and flush p95 directly."""
+    env = _envelope()
+    floor = 1 - env['regression_tolerance']
+    paged = sched_result['detail']['paged']
+    assert paged['tokens_per_step'] >= \
+        env['paged_tokens_per_step'] * floor, (
+            f"buffered-journal replay regressed: "
+            f"{paged['tokens_per_step']} tokens/step vs envelope "
+            f"{env['paged_tokens_per_step']}")
+    for side in ('paged', 'dense'):
+        j = sched_result['detail'][side]['journal']
+        # A healthy replay never drops: the bound is sized for real
+        # traffic and the bench flushes every step.
+        assert j['dropped'] == 0, (side, j)
+        assert j['dropped_queue_full'] == 0, (side, j)
+        assert j['dropped_write_error'] == 0, (side, j)
+        assert j['buffered'] == 0, (side, j)  # final flush landed all
+        assert j['flush_p95_seconds'] >= 0.0, (side, j)
+
+
 def test_result_is_platform_tagged(sched_result):
     """The failover tier's contract: the emitted line must carry the
     platform that actually ran so trends stay attributable when TPU

@@ -7,6 +7,8 @@ failover → recovery → RUNNING) lives in tests/test_flight_recorder.py.
 import os
 import subprocess
 import sys
+import threading
+import time
 
 import pytest
 
@@ -322,6 +324,326 @@ def test_cli_events_rejects_bad_filters():
     assert out.exit_code != 0
     out = runner.invoke(_cli(), ['trace', 'deadbeef'])
     assert out.exit_code != 0
+
+
+# ------------------------------------------------ JournalBuffer (ISSUE 19)
+
+
+def test_journal_buffer_flush_roundtrip_and_stats():
+    buf = journal.JournalBuffer(entity='engine:t')
+    for i in range(3):
+        assert buf.append(journal.EventKind.PROVISION_ATTEMPT,
+                          'cluster:b', {'i': i})
+    assert buf.stats()['buffered'] == 3
+    assert journal.query() == []  # nothing lands before a flush
+    buf.flush()
+    st = buf.stats()
+    assert st['buffered'] == 0
+    assert st['appended'] == st['written'] == 3
+    assert st['dropped'] == 0 and st['flushes'] == 1
+    assert st['flush_p95_seconds'] >= 0.0
+    rows = journal.query(ascending=True)
+    assert [r['payload']['i'] for r in rows] == [0, 1, 2]
+    total = metrics.get_registry().get('skytpu_journal_events_total')
+    assert total.value() == 3.0
+
+
+def test_journal_buffer_bounded_queue_drops_and_counts(monkeypatch):
+    monkeypatch.setenv(journal.QUEUE_DEPTH_ENV, '2')
+    buf = journal.JournalBuffer()
+    results = [buf.append(journal.EventKind.PROVISION_ATTEMPT,
+                          'cluster:b', {'i': i}) for i in range(5)]
+    assert results == [True, True, False, False, False]
+    st = buf.stats()
+    assert st['dropped_queue_full'] == 3 and st['buffered'] == 2
+    dropped = metrics.get_registry().get('skytpu_journal_dropped_total')
+    assert dropped.value(labels=('queue_full',)) == 3.0
+    buf.flush()
+    assert len(journal.query(limit=100)) == 2  # survivors committed
+
+
+def test_journal_buffer_multi_db_isolation(tmp_path):
+    """Explicit db_path journals never leak into the default journal —
+    the property the 3-DB federated e2e stands on."""
+    side = str(tmp_path / 'side.db')
+    buf = journal.JournalBuffer(db_path=side)
+    buf.append(journal.EventKind.PROVISION_ATTEMPT, 'cluster:s', {})
+    buf.flush()
+    assert journal.query() == []
+    assert len(journal.query(db_path=side)) == 1
+    # Direct writes honor the same override.
+    journal.event(journal.EventKind.LAUNCH_START, 'cluster:s', {},
+                  db_path=side)
+    assert len(journal.query(db_path=side)) == 2
+    assert journal.query() == []
+
+
+def test_journal_buffer_async_flush_never_blocks_on_stalled_disk(
+        monkeypatch):
+    monkeypatch.setenv('SKYTPU_CHAOS', 'journal_write_stall')
+    monkeypatch.setenv(journal.chaos.JOURNAL_STALL_SECONDS_ENV, '0.3')
+    buf = journal.JournalBuffer()
+    buf.append(journal.EventKind.PROVISION_ATTEMPT, 'cluster:w', {})
+    t0 = time.monotonic()
+    buf.flush(wait=False)
+    assert time.monotonic() - t0 < 0.1  # the caller never waited
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if journal.query(limit=10):
+            break
+        time.sleep(0.02)
+    assert len(journal.query(limit=10)) == 1  # ... but the row landed
+
+
+def test_journal_buffer_sync_flush_waits_for_inflight_async(monkeypatch):
+    """flush(wait=True) must not return while an async flush that
+    already claimed rows is still committing them: "flush then read"
+    callers (teardown, tests, /journal's flush-on-demand) would miss
+    the tail of the batch."""
+    from skypilot_tpu.utils import chaos
+    monkeypatch.setenv('SKYTPU_CHAOS', 'journal_write_stall:1')
+    monkeypatch.setenv(chaos.JOURNAL_STALL_SECONDS_ENV, '0.3')
+    chaos.reset()
+    try:
+        buf = journal.JournalBuffer()
+        buf.append(journal.EventKind.PROVISION_ATTEMPT, 'cluster:a', {})
+        buf.flush(wait=False)  # claims row A, stalls 0.3s in background
+        deadline = time.monotonic() + 2
+        while buf.stats()['buffered'] and time.monotonic() < deadline:
+            time.sleep(0.005)  # until the async flush claimed row A
+        buf.append(journal.EventKind.PROVISION_ATTEMPT, 'cluster:b', {})
+        buf.flush()  # sync: must wait out the in-flight commit too
+        entities = {r['entity'] for r in journal.query(limit=10)}
+        assert entities == {'cluster:a', 'cluster:b'}
+    finally:
+        chaos.reset()
+
+
+def test_journal_buffer_stall_journals_once_on_recovery(monkeypatch):
+    from skypilot_tpu.utils import chaos
+    monkeypatch.setenv('SKYTPU_CHAOS', 'journal_write_stall:1')
+    monkeypatch.setenv(chaos.JOURNAL_STALL_SECONDS_ENV, '0.1')
+    monkeypatch.setenv(journal.STALL_SECONDS_ENV, '0.05')
+    chaos.reset()
+    try:
+        buf = journal.JournalBuffer(entity='engine:st')
+        buf.append(journal.EventKind.PROVISION_ATTEMPT, 'cluster:s', {})
+        buf.flush()  # stalled flush: detected, NOT yet journaled
+        stalls = journal.query(
+            kinds=[journal.EventKind.JOURNAL_STALL], limit=10)
+        assert stalls == []
+        buf.flush()  # empty flush proves nothing — still pending
+        buf.append(journal.EventKind.PROVISION_ATTEMPT, 'cluster:s', {})
+        buf.flush()  # fast again -> ONE journal.stall on recovery
+        buf.append(journal.EventKind.PROVISION_ATTEMPT, 'cluster:s', {})
+        buf.flush()
+        stalls = journal.query(
+            kinds=[journal.EventKind.JOURNAL_STALL], limit=10)
+        assert len(stalls) == 1
+        assert stalls[0]['entity'] == 'engine:st'
+        assert stalls[0]['payload']['stall_seconds'] >= 0.1
+        assert stalls[0]['payload']['stalled_flushes'] == 1
+    finally:
+        chaos.reset()
+
+
+def test_journal_buffer_disk_full_counts_write_error(monkeypatch):
+    monkeypatch.setenv('SKYTPU_CHAOS', 'journal_disk_full')
+    buf = journal.JournalBuffer()
+    buf.append(journal.EventKind.PROVISION_ATTEMPT, 'cluster:f', {})
+    buf.append(journal.EventKind.PROVISION_ATTEMPT, 'cluster:f', {})
+    buf.flush()
+    st = buf.stats()
+    assert st['dropped_write_error'] == 2 and st['written'] == 0
+    dropped = metrics.get_registry().get('skytpu_journal_dropped_total')
+    assert dropped.value(labels=('write_error',)) == 2.0
+    assert journal.query(limit=10) == []  # the plane kept flying anyway
+
+
+def test_journal_buffer_concurrent_writers_at_capacity(monkeypatch):
+    """Appenders racing each other at a full queue must neither block
+    nor lose count: every append accounts as appended or dropped."""
+    monkeypatch.setenv(journal.QUEUE_DEPTH_ENV, '8')
+    buf = journal.JournalBuffer()
+    n_threads, per_thread = 8, 200
+
+    def _hammer():
+        for i in range(per_thread):
+            buf.append(journal.EventKind.PROVISION_ATTEMPT,
+                       'cluster:c', {'i': i})
+
+    threads = [threading.Thread(target=_hammer)
+               for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive(), 'append blocked at capacity'
+    st = buf.stats()
+    assert st['appended'] + st['dropped_queue_full'] == \
+        n_threads * per_thread
+    assert st['buffered'] <= 8
+    buf.flush()
+    assert buf.stats()['written'] == st['appended']
+
+
+def test_journal_buffer_rotation_racing_flush(monkeypatch):
+    """Rowid-window pruning (direct writers) racing batch flushes must
+    not corrupt either side: both finish and the cap holds."""
+    monkeypatch.setenv(journal.MAX_EVENTS_ENV, '50')
+    buf = journal.JournalBuffer()
+    errors = []
+
+    def _flusher():
+        try:
+            for i in range(40):
+                buf.append(journal.EventKind.PROVISION_ATTEMPT,
+                           'cluster:r', {'i': i})
+                buf.flush()
+        except Exception as exc:  # pylint: disable=broad-except
+            errors.append(exc)
+
+    t = threading.Thread(target=_flusher)
+    t.start()
+    for i in range(120):  # direct writes trigger pruning concurrently
+        journal.event(journal.EventKind.PROVISION_FAILOVER, 'cluster:r',
+                      {'i': i})
+    t.join(timeout=30)
+    assert not t.is_alive() and not errors
+    assert len(journal.query(limit=1000)) <= 50
+
+
+def test_journal_buffer_drop_path_no_deadlock_subprocess(tmp_path):
+    """The drop path increments a registry metric; the registry takes
+    its own locks. Prove (in a subprocess, bounded by timeout) that
+    hammering a full queue while a chaos-stalled flush is in flight
+    never deadlocks buffer lock against registry lock."""
+    script = r'''
+import sys, threading, time
+sys.path.insert(0, %(repo)r)
+from skypilot_tpu.observability import journal
+
+buf = journal.JournalBuffer()
+buf.append(journal.EventKind.PROVISION_ATTEMPT, 'cluster:p', {})
+buf.flush(wait=False)  # rides out the chaos stall in the background
+
+def hammer():
+    for i in range(500):
+        buf.append(journal.EventKind.PROVISION_ATTEMPT, 'cluster:p',
+                   {'i': i})
+
+threads = [threading.Thread(target=hammer) for _ in range(4)]
+for t in threads: t.start()
+for t in threads: t.join()
+buf.flush(wait=True)
+st = buf.stats()
+assert st['appended'] + st['dropped_queue_full'] == 2001, st
+print('DROP-PATH-OK', st['dropped_queue_full'])
+'''
+    env = dict(os.environ,
+               HOME=str(tmp_path),
+               SKYTPU_JOURNAL_QUEUE_DEPTH='4',
+               SKYTPU_CHAOS='journal_write_stall',
+               SKYTPU_CHAOS_JOURNAL_STALL_SECONDS='0.5')
+    proc = subprocess.run(
+        [sys.executable, '-c', script % {'repo': REPO_ROOT}],
+        env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+        timeout=60, check=False)
+    assert proc.returncode == 0, proc.stderr
+    assert 'DROP-PATH-OK' in proc.stdout
+
+
+# ----------------------------------------- /journal serve_query (ISSUE 19)
+
+
+def test_serve_query_initial_page_and_cursor():
+    for i in range(5):
+        journal.event(journal.EventKind.PROVISION_ATTEMPT, 'cluster:q',
+                      {'i': i})
+    out = journal.serve_query({'limit': 3}, host='replica:svc/0')
+    assert out['host'] == 'replica:svc/0'
+    assert out['count'] == 3
+    # Initial pull: the NEWEST rows, page itself oldest-first.
+    assert [e['payload']['i'] for e in out['events']] == [2, 3, 4]
+    cursor = out['next_since_id']
+    assert cursor == out['events'][-1]['event_id']
+    # Cursor pull: nothing new yet.
+    again = journal.serve_query({'since_id': cursor})
+    assert again['events'] == [] and again['next_since_id'] == cursor
+    # New rows resume exactly after the cursor.
+    journal.event(journal.EventKind.PROVISION_FAILOVER, 'cluster:q', {})
+    fresh = journal.serve_query({'since_id': cursor})
+    assert [e['kind'] for e in fresh['events']] == ['provision.failover']
+    assert fresh['next_since_id'] > cursor
+
+
+def test_serve_query_clamps_limit_and_degrades(monkeypatch):
+    monkeypatch.setenv(journal.QUERY_LIMIT_ENV, '3')
+    for i in range(6):
+        journal.event(journal.EventKind.PROVISION_ATTEMPT, 'cluster:q',
+                      {'i': i})
+    assert journal.serve_query({'limit': 100})['count'] == 3  # clamped
+    assert journal.serve_query({'limit': 'junk'})['count'] == 3
+    assert journal.serve_query({'since_id': 'junk'})['count'] == 3
+    # Unknown kinds are dropped from the filter, not 500s; an entirely
+    # unknown filter degrades to unfiltered.
+    out = journal.serve_query(
+        {'kinds': 'made.up,provision.attempt', 'limit': 2})
+    assert {e['kind'] for e in out['events']} == {'provision.attempt'}
+    assert journal.serve_query({'kinds': 'made.up'})['count'] == 3
+
+
+def test_serve_query_trace_filter_is_ascending():
+    with trace.span('execution.launch', 'cluster:t') as root:
+        journal.event(journal.EventKind.PROVISION_ATTEMPT, 'cluster:t',
+                      {})
+    journal.event(journal.EventKind.JOB_PHASE, 'job:9',
+                  {'status': 'RUNNING'})  # different trace
+    out = journal.serve_query({'trace_id': root.trace_id})
+    kinds = [e['kind'] for e in out['events']]
+    assert 'job.phase' not in kinds
+    assert kinds[0] == 'span.start' and kinds[-1] == 'span.end'
+
+
+# -------------------------------------- host-tagged rendering (ISSUE 19)
+
+
+def test_format_events_host_column():
+    journal.event(journal.EventKind.LAUNCH_START, 'cluster:h',
+                  {'task': 'demo'})
+    rows = journal.query(ascending=True)
+    assert 'HOST' not in journal.format_events(rows)  # local: no column
+    for r in rows:
+        r['host'] = 'replica:svc/1'
+    text = journal.format_events(rows)
+    assert 'HOST' in text and 'replica:svc/1' in text
+    line = journal.format_event_line(rows[0])
+    assert line.endswith('@replica:svc/1')
+
+
+def test_format_trace_host_attribution():
+    with trace.span('execution.launch', 'cluster:h2') as root:
+        journal.event(journal.EventKind.PROVISION_ATTEMPT, 'cluster:h2',
+                      {})
+    rows = journal.query(trace_id=root.trace_id, ascending=True)
+    for r in rows:
+        r['host'] = 'lb:8080'
+    text = journal.format_trace(root.trace_id, rows)
+    assert '[cluster:h2@lb:8080]' in text
+    assert '@lb:8080' in text
+
+
+def test_cli_events_since_cursor():
+    from click.testing import CliRunner
+    journal.event(journal.EventKind.LAUNCH_START, 'cluster:old',
+                  {'task': 'before'})
+    cursor = journal.query(limit=1)[0]['event_id']
+    journal.event(journal.EventKind.PROVISION_ATTEMPT, 'cluster:new',
+                  {'zone': 'after'})
+    out = CliRunner().invoke(_cli(), ['events', '--since', str(cursor)])
+    assert out.exit_code == 0, out.output
+    assert 'cluster:new' in out.output
+    assert 'cluster:old' not in out.output
 
 
 def test_dashboard_renders_journal_section():

@@ -350,7 +350,11 @@ def test_all_registered_metric_names_match_convention():
                      'skytpu_engine_radix_nodes',
                      'skytpu_engine_prefix_cache_blocks',
                      # Disaggregated prefill/decode handoff (ISSUE 16).
-                     'skytpu_engine_handoffs_total'):
+                     'skytpu_engine_handoffs_total',
+                     # Journal self-observability (ISSUE 19).
+                     'skytpu_journal_dropped_total',
+                     'skytpu_journal_flush_seconds',
+                     'skytpu_journal_events_total'):
         assert expected in names, f'{expected} not found by lint scan'
 
 
@@ -413,7 +417,9 @@ def test_all_journal_event_kinds_are_registered():
                      # cache tier (ISSUE 15).
                      'LB_ROUTE', 'ENGINE_PREFIX_FETCH',
                      # Disaggregated prefill/decode handoff (ISSUE 16).
-                     'ENGINE_HANDOFF'):
+                     'ENGINE_HANDOFF',
+                     # Journal write-stall self-observability (ISSUE 19).
+                     'JOURNAL_STALL'):
         assert expected in attr_names, \
             f'EventKind.{expected} not found by lint scan'
 
