@@ -337,6 +337,20 @@ def _payload_sched() -> None:
             'goodput_holds')},
     }
     print(json.dumps(out), flush=True)
+    # Durable fleet KV cache: cold-restart TTFT warmed from the block
+    # store vs full recompute, as a fifth cumulative line — a kill
+    # mid-store still lands everything above.
+    store = decode_bench.run_store_bench(beat=harness.beat)
+    out['detail']['store'] = {
+        'value': store['value'],
+        'unit': store['unit'],
+        'platform': store['platform'],
+        **{k: store['detail'][k] for k in (
+            'n_engines', 'n_families', 'per_family', 'shared_len',
+            'warmed', 'recompute', 'spill', 'ttft_improved',
+            'prefill_tokens_saved')},
+    }
+    print(json.dumps(out), flush=True)
 
 
 # ---------------------------------------------------------------------------

@@ -32,6 +32,8 @@ def run_allreduce_bench(sizes_mb: List[float], iters: int = 10,
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    from skypilot_tpu.parallel import compat
+
     devices = np.asarray(jax.devices())
     n = devices.size
     mesh = Mesh(devices.reshape(n), ('x',))
@@ -45,10 +47,12 @@ def run_allreduce_bench(sizes_mb: List[float], iters: int = 10,
 
         @jax.jit
         def allreduce(a):
-            return jax.shard_map(lambda s: jax.lax.psum(s, 'x'),
-                                 mesh=mesh,
-                                 in_specs=P('x', None),
-                                 out_specs=P(None, None))(a)
+            # Through the version-portable shim: top-level
+            # ``jax.shard_map`` only exists on newer jax; the pinned
+            # jax_graft toolchain (0.4.x) still ships it under
+            # ``jax.experimental``.
+            return compat.shard_map(lambda s: jax.lax.psum(s, 'x'),
+                                    mesh, P('x', None), P(None, None))(a)
 
         out = allreduce(x)
         float(out[0, 0])  # host fetch = the only reliable sync barrier

@@ -1339,12 +1339,229 @@ def run_disagg_bench(beat=None, seed: int = 0) -> dict:
     }
 
 
+def run_store_bench(beat=None, seed: int = 0) -> dict:
+    """Durable fleet KV cache bench (dark CPU tier): cold-restart TTFT,
+    store-warmed vs recompute.
+
+    A warm fleet serves each digest family's shared head once; the
+    engines' write-behind spill persists those runs into a disk-backed
+    :class:`block_store.BlockStore`. The fleet is then torn down — the
+    restart the durable tier exists for — and the SAME shared-prefix
+    burst is served twice by brand-new (empty-radix) engines:
+
+    * **warmed**: engines configured with the store (in-process
+      transport through the full ``handle_store_post`` wire format —
+      encode, JSON, decode — against a store RELOADED from disk, so
+      the restart path is on the clock). Each family's first admission
+      store-fetches the shared head and prefills only its tail.
+    * **recompute**: identical engines with no store; every family's
+      head is re-prefilled from scratch.
+
+    Contract (asserted by the bench supervisor e2e): warmed TTFT p95
+    beats recompute with ``prefill_tokens_saved > 0`` — device-agnostic
+    engine/store properties, so the CPU tier emits them every round."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from skypilot_tpu.models import block_store, decode, llama
+    from skypilot_tpu.models import engine as engine_lib
+    from skypilot_tpu.models import prefix_transfer
+    from skypilot_tpu.utils import common_utils
+
+    beat, devices = _init(beat)
+    platform = devices[0].platform
+    # Engine geometry matches run_disagg_bench exactly: under
+    # --payload-sched this runs after the disagg leg in one process,
+    # so every fused-decode / prefill-bucket dispatch shape is already
+    # jit-cached and the store leg pays only its own work.
+    model_name, block_k = 'bench-cpu', 8
+    num_slots, max_len = 10, 256
+    prefill_chunk = 32
+    step_chunk = 8
+    n_engines = 2
+    n_families, per_family = 4, 3
+    shared_len, tail_len, new_tokens = 128, 8, 4
+    cfg = llama.CONFIGS[model_name]
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    dcfg = decode.DecodeConfig(max_len=max_len, temperature=0.0,
+                               decode_attention='xla',
+                               kernel_block_k=block_k)
+    rng = np.random.RandomState(seed + 17)
+
+    def make_family_set(n):
+        return [rng.randint(1, cfg.vocab_size, size=shared_len).tolist()
+                for _ in range(n)]
+
+    def make_tail():
+        return rng.randint(1, cfg.vocab_size, size=tail_len).tolist()
+
+    num_blocks = num_slots * (max_len // block_k) + 1
+
+    def wire_fetch(store):
+        """In-process store-role fetch through the FULL wire format."""
+
+        def fetch(url, tokens, from_tokens, budget):
+            status, reply = block_store.handle_store_post(
+                store, {'prompt': [int(t) for t in tokens],
+                        'from_tokens': int(from_tokens)})
+            if status != 200:
+                return None
+            return prefix_transfer.decode_payload(
+                json.loads(json.dumps(reply)))
+
+        return fetch
+
+    def wire_spill(store):
+
+        def spill(url, tokens, raw, budget):
+            body = prefix_transfer.encode_payload(
+                raw['matched_tokens'], raw['from_tokens'],
+                raw['block_k'], raw['kv_cache_dtype'], raw['arrays'])
+            body['prompt'] = [int(t) for t in tokens]
+            status, reply = block_store.handle_store_post(
+                store, json.loads(json.dumps(body)))
+            return status == 200 and bool(reply.get('ok'))
+
+        return spill
+
+    def make_engine(name, store=None):
+        kwargs = {}
+        if store is not None:
+            kwargs = dict(store_url='store://bench',
+                          store_fetch_fn=wire_fetch(store),
+                          store_spill_fn=wire_spill(store))
+        return engine_lib.DecodeEngine(
+            params, cfg, dcfg, num_slots, step_chunk=step_chunk,
+            name=name, paged=True, num_blocks=num_blocks,
+            prefill_chunk=prefill_chunk, **kwargs)
+
+    def step_until(engines, cond, timeout=240.0):
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            if cond():
+                return True
+            for e in engines:
+                e.step()
+        return False
+
+    def warm_fleet(families, store, tag):
+        """Phase A: each family's shared head served once (affinity:
+        family i → engine i % n), then the loop pumped until every
+        run's write-behind spill is acked by the store."""
+        engines = [make_engine(f'{tag}-w{i}', store)
+                   for i in range(n_engines)]
+        reqs = []
+        for i, head in enumerate(families):
+            r = engine_lib.Request(list(head), new_tokens)
+            engines[i % n_engines].submit(r)
+            reqs.append(r)
+        assert step_until(engines, lambda: all(r.done for r in reqs))
+        assert step_until(
+            engines,
+            lambda: store.stats()['spills'] >= len(families)), \
+            f'spills never landed: {store.stats()}'
+        return engines
+
+    def serve_burst(families, store, tag):
+        """One cold-restart arm: fresh engines (store-warmed or not)
+        serve per_family tail-distinct requests per family. All
+        requests are submitted up front (the restart's thundering
+        herd); the step loop is identical across arms."""
+        engines = [make_engine(f'{tag}-{i}', store)
+                   for i in range(n_engines)]
+        jobs = []
+        t0 = time.perf_counter()
+        for i, head in enumerate(families):
+            for _ in range(per_family):
+                r = engine_lib.Request(list(head) + make_tail(),
+                                       new_tokens)
+                engines[i % n_engines].submit(r)
+                jobs.append({'req': r, 't0': t0})
+        ok = step_until(engines,
+                        lambda: all(j['req'].done for j in jobs))
+        window = time.perf_counter() - t0
+        ttfts = sorted(j['req'].first_token_ts - j['t0'] for j in jobs
+                       if j['req'].first_token_ts is not None)
+        saved = sum(e.cache_stats()['prefill_tokens_saved']
+                    for e in engines)
+        fetch_hits = sum(e.cache_stats()['store_fetch_hits']
+                         for e in engines)
+        fetch_tokens = sum(e.cache_stats()['store_fetch_tokens']
+                           for e in engines)
+        return {
+            'completed': sum(1 for j in jobs if j['req'].done),
+            'all_done': ok,
+            'ttft_p95_ms': round(
+                common_utils.percentile(ttfts, 95) * 1e3, 3),
+            'ttft_p50_ms': round(
+                common_utils.percentile(ttfts, 50) * 1e3, 3),
+            'wall_ms': round(window * 1e3, 1),
+            'prefill_tokens_saved': saved,
+            'store_fetch_hits': fetch_hits,
+            'store_fetch_tokens': fetch_tokens,
+        }
+
+    root = tempfile.mkdtemp(prefix='skytpu-store-bench-')
+    beat('store_compile')
+    try:
+        with _journal_slow_requests_only():
+            # Warmup leg (throwaway families + store): compiles every
+            # prefill-bucket, export-gather and install dispatch shape
+            # before anything is timed — otherwise the warmed arm's
+            # first store fetch pays the inject path's jit compile.
+            warm_store = block_store.BlockStore(
+                os.path.join(root, 'warmup'))
+            warm_fleet(make_family_set(1), warm_store, 'jit')
+            serve_burst(make_family_set(1), warm_store, 'jit-b')
+
+            beat('store_run')
+            families = make_family_set(n_families)
+            store = block_store.BlockStore(os.path.join(root, 'store'))
+            warm_fleet(families, store, 'warm')
+            spill_stats = store.stats()
+            # Fleet restart: the warm engines are garbage now; the
+            # store index is rebuilt from disk like a store process
+            # coming back up.
+            store = block_store.BlockStore(os.path.join(root, 'store'))
+            warmed = serve_burst(families, store, 'warmed')
+            recompute = serve_burst(families, None, 'recomp')
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return {
+        'metric': 'store_warm_ttft_p95_ms',
+        'value': warmed['ttft_p95_ms'],
+        'unit': 'ms',
+        'platform': platform,
+        'detail': {
+            'workload': 'store',
+            'model': model_name,
+            'n_engines': n_engines,
+            'n_families': n_families,
+            'per_family': per_family,
+            'shared_len': shared_len,
+            'block_k': block_k,
+            'warmed': warmed,
+            'recompute': recompute,
+            'spill': {k: spill_stats[k]
+                      for k in ('entries', 'families', 'spills',
+                                'bytes')},
+            'store_after': store.stats(),
+            'ttft_improved':
+                warmed['ttft_p95_ms'] < recompute['ttft_p95_ms'],
+            'prefill_tokens_saved': warmed['prefill_tokens_saved'],
+            'device': str(devices[0]),
+        },
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='bench-1b')
     parser.add_argument('--workload',
                         choices=('static', 'mixed', 'prefix', 'sched',
-                                 'spec', 'route', 'disagg'),
+                                 'spec', 'route', 'disagg', 'store'),
                         default='static',
                         help='static: one fixed-shape generate() batch; '
                              'mixed: continuous engine vs static '
@@ -1363,7 +1580,11 @@ def main() -> None:
                              'disagg: 2 prefill + 2 decode engines with '
                              'streaming KV handoff vs 4 mixed '
                              'monolithic under a long-prompt burst '
-                             '(TTFT p95, goodput)')
+                             '(TTFT p95, goodput); '
+                             'store: cold-fleet restart warmed from '
+                             'the durable block store vs full '
+                             'recompute (TTFT p95, prefill tokens '
+                             'saved)')
     parser.add_argument('--batch', type=int, default=16)
     parser.add_argument('--prompt-len', type=int, default=128)
     parser.add_argument('--new-tokens', type=int, default=128)
@@ -1421,6 +1642,8 @@ def main() -> None:
         out = run_route_bench()
     elif args.workload == 'disagg':
         out = run_disagg_bench()
+    elif args.workload == 'store':
+        out = run_store_bench()
     elif args.workload == 'sched':
         out = run_scheduler_bench(steps=min(args.steps, 3), tp=args.tp)
     elif args.workload == 'spec':

@@ -1,0 +1,606 @@
+"""Durable fleet KV cache: a disk-backed persistent prefix-block store.
+
+The cross-replica prefix tier (PR 15) and the handoff plane (PR 16)
+made every replica's radix cache one fleet-wide cache — but it all dies
+with the fleet: a cold start, a scale-up, or a full restart re-prefills
+every shared prefix from scratch. This module is the durable tier
+behind that cache: a store process (LB- or head-hosted, or a model
+server running ``--role store``) persists published radix runs to disk
+and serves them back over the SAME ``POST /prefix_blocks`` protocol a
+peer replica speaks, so the engine's cold-miss path needs no second
+wire format — peer first, store second, plain prefill last.
+
+Design points:
+
+* **Wire-format exact.** Entries are the :mod:`prefix_transfer` payload
+  (bf16 bytes; int8 values + their fp32 scale planes; unsharded logical
+  ``[L, n, block_k, ...]`` blocks), so a tp=1 owner's spill warms a
+  tp=2 fetcher and vice versa, and the engine's
+  ``_install_remote_blocks`` validation/injection path — the thing that
+  makes a fetched-block decode token-identical to local prefill — is
+  reused verbatim.
+* **Torn-write safe.** Spills write ``<digest>.json.tmp-*`` then
+  ``os.replace`` — a crash mid-spill leaves either the old entry or a
+  tmp file (swept at load), never a half-written visible entry. Any
+  entry that fails to deserialize (pre-rename legacy crash, disk
+  corruption, chaos ``store_torn_entry``) is dropped from the index and
+  unlinked instead of being served: a torn entry is a MISS, not a
+  crash and never garbage K/V.
+* **Capacity-bounded.** ``SKYTPU_STORE_CAPACITY_BYTES`` caps on-disk
+  bytes with LRU eviction over *digest families* (entries sharing a
+  prompt head evict together — partial families would leave fetchers
+  paying store round-trips for prefixes whose tails are gone).
+* **Write-behind spill.** Replicas persist newly published radix runs
+  asynchronously (``DecodeEngine._service_store_spills``): the engine
+  loop only exports the host-side payload; the POST rides a background
+  worker, budget-bounded (``SKYTPU_STORE_SPILL_BUDGET_SECONDS``) and
+  backoff-bounded (``SKYTPU_STORE_BACKOFF_SECONDS``) like every other
+  transfer in the serving plane. A dead or slow store degrades spill
+  and fetch alike to "no store" — never a stall, never a 500.
+
+Chaos points (``SKYTPU_CHAOS``, see utils/chaos.py): ``store_down``
+(client transports fail as if the store were unreachable),
+``store_torn_entry`` (a spill persists a truncated entry — the read
+side must ignore it), ``store_slow`` (the store wedges each lookup
+``SKYTPU_CHAOS_STORE_SLOW_SECONDS`` — the fetcher's wall-clock budget
+must degrade the admission to plain prefill).
+"""
+import hashlib
+import json
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from skypilot_tpu.models import prefix_transfer
+from skypilot_tpu.utils import chaos
+from skypilot_tpu.utils import common_utils
+
+# Engine/server-side knobs (registered in utils/env_registry.py).
+# Where replicas fetch from / spill to (the store's base URL; unset =
+# no durable tier).
+STORE_URL_ENV = 'SKYTPU_STORE_URL'
+# Arms the store ROLE on a model server / LB host: the directory
+# entries persist under.
+STORE_DIR_ENV = 'SKYTPU_STORE_DIR'
+CAPACITY_ENV = 'SKYTPU_STORE_CAPACITY_BYTES'
+DEFAULT_CAPACITY_BYTES = 1 << 30
+# Cold-miss store lookups ride the engine loop exactly like peer
+# fetches, so they get their own (typically tighter) budget.
+FETCH_BUDGET_ENV = 'SKYTPU_STORE_FETCH_BUDGET_SECONDS'
+DEFAULT_FETCH_BUDGET_SECONDS = 0.5
+# One write-behind spill POST's budget (off-loop; bounds the worker,
+# not the engine step).
+SPILL_BUDGET_ENV = 'SKYTPU_STORE_SPILL_BUDGET_SECONDS'
+DEFAULT_SPILL_BUDGET_SECONDS = 2.0
+# A store that failed (fetch or spill) is left alone this long.
+BACKOFF_ENV = 'SKYTPU_STORE_BACKOFF_SECONDS'
+DEFAULT_BACKOFF_SECONDS = 30.0
+# Only runs at least this many tokens long are worth a durable entry
+# (0 = the engine's block size).
+SPILL_MIN_TOKENS_ENV = 'SKYTPU_STORE_SPILL_MIN_TOKENS'
+# Digest-family grouping: entries sharing their first N tokens (or
+# their full prompt when shorter) evict together and are advertised
+# together to the autoscaler's pre-warm plane.
+FAMILY_TOKENS_ENV = 'SKYTPU_STORE_FAMILY_TOKENS'
+DEFAULT_FAMILY_TOKENS = 128
+# Chaos: how long a fired ``store_slow`` wedges one store lookup.
+STORE_SLOW_SECONDS_ENV = 'SKYTPU_CHAOS_STORE_SLOW_SECONDS'
+DEFAULT_STORE_SLOW_SECONDS = 2.0
+
+
+def family_digest(tokens: Sequence[int],
+                  family_tokens: Optional[int] = None) -> str:
+    """The digest-family key of a token run: sha1 over the first
+    ``family_tokens`` token ids as decimal text (the
+    ``lb_policies.prefix_digest`` encoding, so LB routing digests and
+    store families computed over the same head agree)."""
+    if family_tokens is None:
+        family_tokens = common_utils.env_int(FAMILY_TOKENS_ENV,
+                                             DEFAULT_FAMILY_TOKENS)
+    head = [int(t) for t in tokens[:max(int(family_tokens), 1)]]
+    h = hashlib.sha1()
+    for t in head:
+        h.update(b'%d,' % t)
+    return h.hexdigest()[:16]
+
+
+def _entry_digest(tokens: Sequence[int]) -> str:
+    """Entry key: sha1 over the FULL block-aligned run (same decimal
+    text encoding as the family digest)."""
+    h = hashlib.sha1()
+    for t in tokens:
+        h.update(b'%d,' % int(t))
+    return h.hexdigest()
+
+
+class _Entry:
+    __slots__ = ('tokens', 'family', 'path', 'nbytes', 'block_k',
+                 'kv_cache_dtype')
+
+    def __init__(self, tokens: Tuple[int, ...], family: str, path: str,
+                 nbytes: int, block_k: int, kv_cache_dtype: str):
+        self.tokens = tokens
+        self.family = family
+        self.path = path
+        self.nbytes = nbytes
+        self.block_k = block_k
+        self.kv_cache_dtype = kv_cache_dtype
+
+
+class BlockStore:
+    """Disk-backed prefix-block store (one directory, one process).
+
+    Thread-safe: the store role's HTTP handlers call ``get``/``put``
+    from server threads. Entries live as
+    ``<root>/<family>/<digest>.json`` — the encoded wire payload plus
+    the run's token list — and the in-memory index holds metadata only
+    (tokens, sizes); array bytes stay on disk until a fetch slices
+    them.
+    """
+
+    def __init__(self, root: str,
+                 capacity_bytes: Optional[int] = None,
+                 family_tokens: Optional[int] = None):
+        self.root = root
+        self.capacity_bytes = (
+            capacity_bytes if capacity_bytes is not None
+            else common_utils.env_int(CAPACITY_ENV,
+                                      DEFAULT_CAPACITY_BYTES))
+        self._family_tokens = (
+            family_tokens if family_tokens is not None
+            else common_utils.env_int(FAMILY_TOKENS_ENV,
+                                      DEFAULT_FAMILY_TOKENS))
+        self._lock = threading.Lock()
+        # digest -> _Entry; families keep LRU order (move_to_end on
+        # every touch, popitem(last=False) evicts the coldest family).
+        self._index: Dict[str, _Entry] = {}
+        self._families: 'OrderedDict[str, set]' = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.spills = 0
+        self.evictions = 0
+        self.torn_dropped = 0
+        os.makedirs(root, exist_ok=True)
+        self._load()
+
+    # ------------------------------------------------------------ loading
+
+    def _load(self) -> None:
+        """Rebuild the index from disk (process restart — the whole
+        point of a durable tier). Tmp files from interrupted spills are
+        swept; entries that fail to parse are dropped, not served."""
+        for fam in sorted(os.listdir(self.root)):
+            fam_dir = os.path.join(self.root, fam)
+            if not os.path.isdir(fam_dir):
+                continue
+            for name in sorted(os.listdir(fam_dir)):
+                path = os.path.join(fam_dir, name)
+                if not name.endswith('.json'):
+                    # Interrupted tmp spill (crash between write and
+                    # rename): sweep it.
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    continue
+                entry = self._parse_entry(path)
+                if entry is None:
+                    self.torn_dropped += 1
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    continue
+                digest = name[:-len('.json')]
+                self._index[digest] = entry
+                self._families.setdefault(entry.family, set()).add(digest)
+                self._bytes += entry.nbytes
+
+    def _parse_entry(self, path: str) -> Optional[_Entry]:
+        """Metadata-only validation of one on-disk entry; None for
+        anything torn or malformed (the caller drops it)."""
+        try:
+            nbytes = os.path.getsize(path)
+            with open(path, 'r', encoding='utf-8') as f:
+                body = json.load(f)
+            tokens = tuple(int(t) for t in body['prompt'])
+            bk = int(body['block_k'])
+            matched = int(body['matched_tokens'])
+            dtype = str(body['kv_cache_dtype'])
+            if (not tokens or bk <= 0 or matched != len(tokens)
+                    or matched % bk or not body['arrays']):
+                return None
+        except (OSError, ValueError, TypeError, KeyError,
+                json.JSONDecodeError):
+            return None
+        return _Entry(tokens, family_digest(tokens, self._family_tokens),
+                      path, nbytes, bk, dtype)
+
+    # ------------------------------------------------------------- writes
+
+    def put(self, tokens: Sequence[int], payload: Dict[str, Any]) -> bool:
+        """Persist one published radix run (decoded-payload form: numpy
+        arrays, ``from_tokens == 0`` — spills always ship whole runs so
+        any fetcher offset can be served by slicing). Returns False on
+        validation failure or disk error (the spiller backs off);
+        duplicate runs are a cheap no-op True."""
+        tokens = tuple(int(t) for t in tokens)
+        try:
+            matched = int(payload['matched_tokens'])
+            bk = int(payload['block_k'])
+            arrays = payload['arrays']
+        except (KeyError, TypeError, ValueError):
+            return False
+        if (not tokens or int(payload.get('from_tokens', -1)) != 0
+                or matched != len(tokens) or bk <= 0 or matched % bk
+                or not arrays):
+            return False
+        digest = _entry_digest(tokens)
+        with self._lock:
+            if digest in self._index:
+                self._touch_family(self._index[digest].family)
+                return True
+        fam = family_digest(tokens, self._family_tokens)
+        fam_dir = os.path.join(self.root, fam)
+        os.makedirs(fam_dir, exist_ok=True)
+        body = prefix_transfer.encode_payload(
+            matched, 0, bk, payload['kv_cache_dtype'], arrays)
+        body['prompt'] = list(tokens)
+        data = json.dumps(body).encode('utf-8')
+        path = os.path.join(fam_dir, digest + '.json')
+        if chaos.should_fire('store_torn_entry'):
+            # A spiller killed mid-write (legacy non-atomic writer /
+            # disk corruption): half the bytes land at the FINAL path.
+            # The spiller believes it succeeded; reads and restarts
+            # must treat the entry as absent, never deserialize it.
+            try:
+                with open(path, 'wb') as f:
+                    f.write(data[:len(data) // 2])
+            except OSError:
+                return False
+            with self._lock:
+                self._admit_entry(digest, _Entry(
+                    tokens, fam, path, len(data) // 2, bk,
+                    str(payload['kv_cache_dtype'])))
+            return True
+        tmp = path + f'.tmp-{os.getpid()}-{threading.get_ident()}'
+        try:
+            with open(tmp, 'wb') as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        with self._lock:
+            self._admit_entry(digest, _Entry(
+                tokens, fam, path, len(data), bk,
+                str(payload['kv_cache_dtype'])))
+        return True
+
+    def _admit_entry(self, digest: str, entry: _Entry) -> None:
+        """Index one just-written entry (lock held), then evict cold
+        families over capacity. A stored strict prefix of the new run
+        is NOT pruned: ``get`` probes by exact extension, so the
+        128-token shared head and a 136-token tail-specific run serve
+        DIFFERENT fetchers — dropping the short one would turn every
+        other tail of that family into a store miss."""
+        self._index[digest] = entry
+        self._families.setdefault(entry.family, set()).add(digest)
+        self._touch_family(entry.family)
+        self._bytes += entry.nbytes
+        self.spills += 1
+        self._evict_over_capacity(keep=entry.family)
+
+    def _drop_entry(self, digest: str) -> None:
+        entry = self._index.pop(digest, None)
+        if entry is None:
+            return
+        fam = self._families.get(entry.family)
+        if fam is not None:
+            fam.discard(digest)
+            if not fam:
+                self._families.pop(entry.family, None)
+        self._bytes -= entry.nbytes
+        try:
+            os.unlink(entry.path)
+        except OSError:
+            pass
+
+    def _touch_family(self, family: str) -> None:
+        if family in self._families:
+            self._families.move_to_end(family)
+
+    def _evict_over_capacity(self, keep: Optional[str] = None) -> None:
+        """LRU-evict whole digest families until under capacity (lock
+        held). ``keep`` (the family just touched) survives even when it
+        alone exceeds capacity — evicting the entry being admitted
+        would turn an over-sized knob into a store that caches
+        nothing."""
+        while self._bytes > self.capacity_bytes and self._families:
+            victim = next(iter(self._families))
+            if victim == keep and len(self._families) == 1:
+                break
+            if victim == keep:
+                # Cheapest way to skip the protected head: rotate it to
+                # the MRU end; the loop then sees the true coldest.
+                self._families.move_to_end(victim)
+                victim = next(iter(self._families))
+            for digest in list(self._families.get(victim, ())):
+                self._drop_entry(digest)
+            self._families.pop(victim, None)
+            self.evictions += 1
+
+    # -------------------------------------------------------------- reads
+
+    def get(self, tokens: Sequence[int], from_tokens: int,
+            block_k: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """Longest stored prefix of ``tokens`` extending past
+        ``from_tokens``, as a decoded wire payload sliced to the
+        caller's offset (``from_tokens`` multiples of the entry's
+        block_k only — the engine always asks block-aligned). None =
+        store miss (including torn entries, which are dropped on
+        contact)."""
+        if chaos.should_fire('store_slow'):
+            time.sleep(common_utils.env_float(
+                STORE_SLOW_SECONDS_ENV, DEFAULT_STORE_SLOW_SECONDS))
+        tokens = tuple(int(t) for t in tokens)
+        from_tokens = int(from_tokens)
+        entry = digest = None
+        with self._lock:
+            # Longest-prefix probe by hash lookup: O(len/block_k) tuple
+            # hashes, no scan. The caller's block_k bounds the stride;
+            # absent (store-side handlers), fall back to probing every
+            # stored length in this family.
+            if block_k and block_k > 0:
+                lengths = range((len(tokens) // block_k) * block_k, 0,
+                                -block_k)
+            else:
+                fam = family_digest(tokens, self._family_tokens)
+                lengths = sorted(
+                    {len(self._index[d].tokens)
+                     for d in self._families.get(fam, ())
+                     if len(self._index[d].tokens) <= len(tokens)},
+                    reverse=True)
+            for n in lengths:
+                if n <= from_tokens:
+                    break
+                d = _entry_digest(tokens[:n])
+                e = self._index.get(d)
+                if e is not None and e.tokens == tokens[:n]:
+                    entry, digest = e, d
+                    self._touch_family(e.family)
+                    break
+        if entry is None:
+            with self._lock:
+                self.misses += 1
+            return None
+        payload = self._read_payload(digest, entry)
+        if payload is None:
+            return None
+        return self._slice_payload(payload, from_tokens)
+
+    def _read_payload(self, digest: str,
+                      entry: _Entry) -> Optional[Dict[str, Any]]:
+        """Load + decode one entry; a torn/corrupt body drops the entry
+        (counted) and reads as a miss."""
+        try:
+            with open(entry.path, 'r', encoding='utf-8') as f:
+                body = json.load(f)
+            payload = prefix_transfer.decode_payload(body)
+        except (OSError, ValueError, json.JSONDecodeError):
+            payload = None
+        if (payload is None or not payload['arrays']
+                or payload['matched_tokens'] != len(entry.tokens)):
+            with self._lock:
+                self._drop_entry(digest)
+                self.torn_dropped += 1
+                self.misses += 1
+            return None
+        with self._lock:
+            self.hits += 1
+        return payload
+
+    @staticmethod
+    def _slice_payload(payload: Dict[str, Any],
+                       from_tokens: int) -> Optional[Dict[str, Any]]:
+        """Re-base a whole-run payload to the caller's offset: drop the
+        blocks before ``from_tokens`` (the fetcher already has them)."""
+        bk = payload['block_k']
+        if from_tokens % bk or from_tokens >= payload['matched_tokens']:
+            return None
+        start = from_tokens // bk
+        return {
+            'matched_tokens': payload['matched_tokens'],
+            'from_tokens': from_tokens,
+            'block_k': bk,
+            'kv_cache_dtype': payload['kv_cache_dtype'],
+            'arrays': {name: a[:, start:]
+                       for name, a in payload['arrays'].items()},
+        }
+
+    def get_by_digest(self, digest: str
+                      ) -> Optional[Tuple[List[int], Dict[str, Any]]]:
+        """Pre-warm lookup: the longest entry of the FAMILY ``digest``
+        names (family key or full entry digest both resolve), returned
+        as (tokens, whole-run payload) — what a joining replica needs
+        to warm a family it has never seen a prompt for."""
+        with self._lock:
+            candidates = list(self._families.get(digest, ()))
+            if not candidates and digest in self._index:
+                candidates = [digest]
+            if not candidates:
+                self.misses += 1
+                return None
+            best = max(candidates,
+                       key=lambda d: len(self._index[d].tokens))
+            entry = self._index[best]
+            self._touch_family(entry.family)
+        payload = self._read_payload(best, entry)
+        if payload is None:
+            return None
+        return list(entry.tokens), payload
+
+    def stats(self) -> Dict[str, Any]:
+        """The store block of ``/slo`` / ``GET /prefix_blocks``."""
+        with self._lock:
+            return {
+                'entries': len(self._index),
+                'families': len(self._families),
+                'bytes': self._bytes,
+                'capacity_bytes': self.capacity_bytes,
+                'hits': self.hits,
+                'misses': self.misses,
+                'spills': self.spills,
+                'evictions': self.evictions,
+                'torn_dropped': self.torn_dropped,
+            }
+
+    def families(self) -> List[str]:
+        """Family keys, LRU → MRU (the pre-warm plane's menu)."""
+        with self._lock:
+            return list(self._families)
+
+
+# --------------------------------------------------------------- transport
+# The store speaks the peer protocol: fetches reuse
+# prefix_transfer.http_fetch against the store URL; the spill direction
+# POSTs the same encoded body WITH arrays (the handler disambiguates on
+# their presence). ``store_down`` chaos fires client-side so an armed
+# replica degrades exactly as if the store host vanished.
+
+
+def http_store_fetch(store_url: str, tokens: Sequence[int],
+                     from_tokens: int, budget_seconds: float,
+                     instance: Optional[str] = None
+                     ) -> Optional[Dict[str, Any]]:
+    """Fetch transport: identical wire exchange to a peer fetch. None
+    on failure/unreachable (the engine backs the store off)."""
+    if chaos.should_fire('store_down'):
+        return None
+    return prefix_transfer.http_fetch(store_url, tokens, from_tokens,
+                                      budget_seconds, instance=instance)
+
+
+def http_store_spill(store_url: str, tokens: Sequence[int],
+                     payload: Dict[str, Any],
+                     budget_seconds: float) -> bool:
+    """Spill transport: POST the encoded whole-run payload (plus its
+    prompt) to the store's ``/prefix_blocks``. True only on an acked
+    persist."""
+    if chaos.should_fire('store_down'):
+        return False
+    import requests
+    body = prefix_transfer.encode_payload(
+        payload['matched_tokens'], payload['from_tokens'],
+        payload['block_k'], payload['kv_cache_dtype'],
+        payload['arrays'])
+    body['prompt'] = [int(t) for t in tokens]
+    half = max(budget_seconds / 2, 1e-3)
+    try:
+        resp = requests.post(store_url.rstrip('/') + '/prefix_blocks',
+                             json=body, timeout=(half, half))
+    except requests.RequestException:
+        return False
+    try:
+        if resp.status_code != 200:
+            return False
+        reply = resp.json()
+    except (requests.RequestException, ValueError):
+        return False
+    finally:
+        resp.close()
+    return bool(isinstance(reply, dict) and reply.get('ok'))
+
+
+def http_store_prewarm_fetch(store_url: str, digest: str,
+                             budget_seconds: float
+                             ) -> Optional[Tuple[List[int],
+                                                 Dict[str, Any]]]:
+    """Pre-warm transport: resolve a digest family to its longest
+    stored run — (tokens, decoded whole-run payload) or None."""
+    if chaos.should_fire('store_down'):
+        return None
+    import requests
+    half = max(budget_seconds / 2, 1e-3)
+    try:
+        resp = requests.post(store_url.rstrip('/') + '/prefix_blocks',
+                             json={'digest': str(digest)},
+                             timeout=(half, half))
+    except requests.RequestException:
+        return None
+    try:
+        if resp.status_code != 200:
+            return None
+        body = resp.json()
+    except (requests.RequestException, ValueError):
+        return None
+    finally:
+        resp.close()
+    if not isinstance(body, dict) or 'prompt' not in body:
+        return None
+    payload = prefix_transfer.decode_payload(body)
+    if payload is None:
+        return None
+    try:
+        tokens = [int(t) for t in body['prompt']]
+    except (TypeError, ValueError):
+        return None
+    return tokens, payload
+
+
+# ---------------------------------------------------------------- serving
+
+
+def handle_store_post(store: BlockStore,
+                      body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+    """The store role's ``POST /prefix_blocks`` dispatch, shared by the
+    model server's store role and any LB/head host. Three request
+    shapes, disambiguated by body keys:
+
+    * ``arrays`` present → SPILL: decode, persist, ack ``{'ok': true}``.
+    * ``digest`` present → PRE-WARM: the family's longest run (encoded,
+      with its ``prompt``) or an empty payload.
+    * otherwise → FETCH (the peer-protocol body): longest stored prefix
+      past ``from_tokens``, or an honest empty payload.
+
+    Returns (status, json_body); errors are 400s with a reason — the
+    store must never 500 over a malformed body.
+    """
+    if not isinstance(body, dict):
+        return 400, {'error': 'malformed body'}
+    if 'arrays' in body:
+        payload = prefix_transfer.decode_payload(body)
+        tokens = body.get('prompt')
+        if payload is None or not isinstance(tokens, list):
+            return 400, {'error': 'malformed spill payload'}
+        ok = store.put(tokens, payload)
+        return 200, {'ok': bool(ok)}
+    if 'digest' in body:
+        hit = store.get_by_digest(str(body['digest']))
+        if hit is None:
+            return 200, {'ok': False}
+        tokens, payload = hit
+        out = prefix_transfer.encode_payload(
+            payload['matched_tokens'], payload['from_tokens'],
+            payload['block_k'], payload['kv_cache_dtype'],
+            payload['arrays'])
+        out['prompt'] = tokens
+        return 200, out
+    try:
+        tokens = [int(t) for t in body['prompt']]
+        from_tokens = int(body.get('from_tokens', 0))
+    except (KeyError, TypeError, ValueError):
+        return 400, {'error': 'malformed fetch body'}
+    payload = store.get(tokens, from_tokens)
+    if payload is None:
+        return 200, prefix_transfer.empty_payload(from_tokens, 0, '')
+    return 200, prefix_transfer.encode_payload(
+        payload['matched_tokens'], payload['from_tokens'],
+        payload['block_k'], payload['kv_cache_dtype'],
+        payload['arrays'])

@@ -122,6 +122,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from skypilot_tpu.models import block_store
 from skypilot_tpu.models import decode, llama
 from skypilot_tpu.models import prefix_transfer
 from skypilot_tpu.observability import journal
@@ -686,6 +687,9 @@ class DecodeEngine:
         '_slot_refs': 'loop',
         '_slot_nodes': 'loop',
         '_prefill_state': 'loop',
+        '_spill_pending': 'loop',
+        '_spill_inflight': 'loop',
+        '_spill_seen': 'loop',
     }
     # Entry points other threads call (HTTP handlers, the supervisor's
     # observers). submit/queue_depth take _queue_lock; stats/
@@ -709,6 +713,9 @@ class DecodeEngine:
                  prefix_peers: Optional[Sequence[str]] = None,
                  prefix_fetch_budget: Optional[float] = None,
                  prefix_fetch_fn: Optional[Callable] = None,
+                 store_url: Optional[str] = None,
+                 store_fetch_fn: Optional[Callable] = None,
+                 store_spill_fn: Optional[Callable] = None,
                  journal_db: Optional[str] = None):
         if num_slots < 1:
             raise ValueError(f'num_slots must be >= 1, got {num_slots}')
@@ -859,6 +866,50 @@ class DecodeEngine:
         # only awaits the PREVIOUS push before exporting the next).
         self._handoff_pool: Optional[
             concurrent.futures.ThreadPoolExecutor] = None
+        # Durable block-store tier (paged only): the second level of
+        # the cold-miss lookup (peer first, store second) and the
+        # write-behind spill target for newly published radix runs.
+        # Both directions share one backoff: a dead store must cost at
+        # most one budget per window, across fetch AND spill.
+        if store_url is None:
+            store_url = os.environ.get(block_store.STORE_URL_ENV,
+                                       '').strip() or None
+        self.store_url: Optional[str] = store_url if paged else None
+        self._store_fetch_fn = (
+            store_fetch_fn if store_fetch_fn is not None
+            else functools.partial(block_store.http_store_fetch,
+                                   instance=self.instance_id))
+        self._store_spill_fn = (store_spill_fn if store_spill_fn
+                                is not None
+                                else block_store.http_store_spill)
+        self._store_fetch_budget = common_utils.env_float(
+            block_store.FETCH_BUDGET_ENV,
+            block_store.DEFAULT_FETCH_BUDGET_SECONDS)
+        self._store_spill_budget = common_utils.env_float(
+            block_store.SPILL_BUDGET_ENV,
+            block_store.DEFAULT_SPILL_BUDGET_SECONDS)
+        self._store_backoff = common_utils.env_float(
+            block_store.BACKOFF_ENV,
+            block_store.DEFAULT_BACKOFF_SECONDS)
+        self._store_backoff_until = 0.0
+        self._store_spill_min_tokens = common_utils.env_int(
+            block_store.SPILL_MIN_TOKENS_ENV, 0) or self._block_k
+        # Write-behind spill state (loop-confined): published runs
+        # queue here; one export + one in-flight POST at a time, so
+        # spill never costs the loop more than one host-side gather
+        # per step and the wire time rides the worker thread.
+        self._spill_pending: List[List[int]] = []
+        self._spill_inflight: Optional[Tuple] = None
+        self._spill_seen: set = set()
+        self._spill_pool: Optional[
+            concurrent.futures.ThreadPoolExecutor] = None
+        self._store_fetch_hits = 0
+        self._store_fetch_misses = 0
+        self._store_fetch_tokens = 0
+        self._store_spills = 0
+        self._store_spill_tokens = 0
+        self._store_spill_failures = 0
+        self._store_spill_drops = 0
         # Prefix-export jobs: peers' /prefix_blocks requests queue here
         # (any thread) and are serviced by the engine loop at the top of
         # each step — radix/pool reads are loop-confined, so the HTTP
@@ -1237,6 +1288,10 @@ class DecodeEngine:
         hit_eos = (self.dcfg.eos_id is not None and
                    first == self.dcfg.eos_id)
         first_done = hit_eos or request.max_new_tokens == 1
+        if first_done:
+            # Same pre-publication as _deliver_run: done=True must
+            # never be observable before the finish reason is.
+            request.finish_reason = 'eos' if hit_eos else 'length'
         request._deliver(first, done=first_done)  # pylint: disable=protected-access
         self._slots[slot] = request
         if first_done:
@@ -1279,6 +1334,17 @@ class DecodeEngine:
                 # picks up the extended prefix with proper refs/locks —
                 # from here on a remote hit is indistinguishable from a
                 # local one (same COW/reservation/publish invariants).
+                self._allocator.decref(blocks)
+                self._radix.release(path)
+                blocks, path = self._radix.match(request.prompt)
+                m_full = len(blocks) * bk
+        # Second level of the cold-miss lookup: whatever the peers
+        # (or an empty peer set) left uncovered, the durable block
+        # store may hold — cold starts, scale-ups and full-fleet
+        # restarts warm from disk instead of recomputing. Same
+        # re-match contract as the peer path above.
+        if self._should_store_fetch(p, m_full):
+            if self._store_fetch_into_cache(request, blocks, m_full):
                 self._allocator.decref(blocks)
                 self._radix.release(path)
                 blocks, path = self._radix.match(request.prompt)
@@ -1403,6 +1469,7 @@ class DecodeEngine:
             if full:
                 self._radix.insert(request.prompt[:full * bk],
                                    table[:full])
+                self._queue_store_spill(request.prompt[:full * bk])
         except Exception:
             # ANY failure past allocation (device prefill, tracing,
             # bucket lookup) must return the reservation — leaking the
@@ -1652,6 +1719,7 @@ class DecodeEngine:
         full = p // bk
         if full:
             self._radix.insert(req.prompt[:full * bk], table[:full])
+            self._queue_store_spill(req.prompt[:full * bk])
         self._block_table_np[slot, :] = SCRATCH_BLOCK
         self._block_table_np[slot, :len(table)] = table
         self._block_table_dev = None
@@ -2173,6 +2241,201 @@ class DecodeEngine:
         self._publish_block_gauges()
         return matched - m_full
 
+    # ------------------------------------------- durable block-store tier
+
+    def _should_store_fetch(self, p: int, m_full: int) -> bool:
+        """Second-level lookup gate: a store is configured, it is not
+        in failure backoff, and the residual miss (after the local
+        match AND any peer fetch) still leaves the minimum
+        block-aligned gain on the table."""
+        if not self.paged or not self.store_url:
+            return False
+        if self._store_backoff_until > time.perf_counter():
+            return False
+        aligned = (p // self._block_k) * self._block_k
+        return aligned - m_full >= max(self._prefix_fetch_min_tokens,
+                                       self._block_k)
+
+    def _note_store_failure(self) -> None:
+        self._store_backoff_until = (time.perf_counter() +
+                                     self._store_backoff)
+
+    def store_in_backoff(self) -> bool:
+        """Snapshot: is the durable store inside its failure-backoff
+        window (surfaced on /slo)?"""
+        return self._store_backoff_until > time.perf_counter()
+
+    def _store_fetch_into_cache(self, request: Request,
+                                local_blocks: List[int],
+                                m_full: int) -> bool:
+        """Pull the prompt's missing prefix blocks from the DURABLE
+        store and install them — the store twin of
+        :meth:`_prefix_fetch_into_cache`, sharing
+        :meth:`_install_remote_blocks` so a store-warmed decode
+        inherits the exact validation that makes a peer-warmed one
+        token-identical to local prefill. ANY failure (store down,
+        torn entry served as miss, dtype/shape mismatch, pool
+        exhaustion) degrades to plain prefill; transport failures put
+        the store in backoff."""
+        bk = self._block_k
+        aligned = (len(request.prompt) // bk) * bk
+        t0 = time.perf_counter()
+        outcome = 'miss'
+        gained = 0
+        try:
+            payload = self._store_fetch_fn(
+                self.store_url, request.prompt[:aligned], m_full,
+                self._store_fetch_budget)
+        except Exception as e:  # pylint: disable=broad-except
+            payload = None
+            self._note_store_failure()
+            outcome = 'error'
+            self._journal(journal.EventKind.ENGINE_STORE_FETCH,
+                          request, -1, outcome='error',
+                          store=self.store_url,
+                          error=f'{type(e).__name__}: {e}')
+        if outcome != 'error':
+            if payload is None:
+                # Transport failure (down, timeout, garbage): back the
+                # store off — one dead store must not cost every cold
+                # admission a budget-long loop stall.
+                self._note_store_failure()
+                outcome = 'down'
+            elif payload.get('self'):
+                # A store URL pointing at THIS replica (misconfig):
+                # treat as no store at all.
+                self.store_url = None
+                outcome = 'miss'
+            else:
+                try:
+                    gained = self._install_remote_blocks(
+                        request.prompt, payload, local_blocks, m_full)
+                except Exception as e:  # pylint: disable=broad-except
+                    self._note_store_failure()
+                    gained = 0
+                    outcome = 'error'
+                    self._journal(journal.EventKind.ENGINE_STORE_FETCH,
+                                  request, -1, outcome='error',
+                                  store=self.store_url,
+                                  error=f'{type(e).__name__}: {e}')
+                else:
+                    if gained == 'empty':
+                        outcome = 'miss'
+                    elif gained is None:
+                        # Version-skewed store entry (wrong block_k /
+                        # dtype / shape): a mismatch, not an outage —
+                        # other families may still be served, so no
+                        # backoff, but the row records the evidence.
+                        outcome = 'mismatch'
+                    elif gained == 'pool_exhausted':
+                        outcome = 'pool_exhausted'
+                    else:
+                        outcome = 'hit'
+        hit = outcome == 'hit'
+        if hit:
+            self._store_fetch_hits += 1
+            self._store_fetch_tokens += gained
+        else:
+            self._store_fetch_misses += 1
+        self._m.counter(
+            'skytpu_store_fetches_total',
+            'Durable-store prefix-block fetch attempts by outcome.',
+            labels=('result',)).inc(labels=(outcome,))
+        if outcome != 'error':
+            # (error outcomes journaled above, with the exception text)
+            payload_kw = {'tokens_gained': gained,
+                          'blocks_gained': gained // bk} if hit else {}
+            self._journal(journal.EventKind.ENGINE_STORE_FETCH, request,
+                          -1, outcome=outcome, store=self.store_url,
+                          seconds=round(time.perf_counter() - t0, 6),
+                          **payload_kw)
+        return hit
+
+    def _queue_store_spill(self, tokens: Sequence[int]) -> None:
+        """LOOP-THREAD ONLY: remember one newly published radix run
+        for write-behind spill to the durable store. Dedup'd (a shared
+        system prompt publishes on every admission that extends it —
+        one spill per distinct run), bounded (the queue holds the 16
+        newest runs; older drops are counted, not silently lost)."""
+        if not (self.paged and self.store_url
+                and self._store_spill_fn is not None):
+            return
+        tokens = [int(t) for t in tokens]
+        if len(tokens) < max(self._store_spill_min_tokens,
+                             self._block_k):
+            return
+        key = (len(tokens), hash(tuple(tokens)))
+        if key in self._spill_seen:
+            return
+        if len(self._spill_seen) > 4096:
+            self._spill_seen.clear()
+        self._spill_seen.add(key)
+        self._spill_pending.append(tokens)
+        if len(self._spill_pending) > 16:
+            self._spill_pending.pop(0)
+            self._store_spill_drops += 1
+
+    def _spill_executor(self) -> concurrent.futures.ThreadPoolExecutor:
+        if self._spill_pool is None:
+            self._spill_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1,
+                thread_name_prefix=f'skytpu-spill-{self.name}')
+        return self._spill_pool
+
+    def _service_store_spills(self) -> None:
+        """LOOP-THREAD ONLY (top of step): resolve the previous spill
+        POST's outcome, then launch at most one new spill — the loop
+        pays one radix match + device gather per spill; the wire time
+        rides the worker thread. A failed POST puts the store in the
+        shared fetch/spill backoff."""
+        if self._spill_inflight is not None:
+            fut, tokens = self._spill_inflight
+            if not fut.done():
+                return
+            self._spill_inflight = None
+            try:
+                ok = bool(fut.result())
+            except Exception:  # pylint: disable=broad-except
+                ok = False
+            if ok:
+                self._store_spills += 1
+                self._store_spill_tokens += len(tokens)
+                self._m.counter(
+                    'skytpu_store_spills_total',
+                    'Write-behind spills to the durable store by '
+                    'outcome.', labels=('result',)).inc(labels=('ok',))
+                self._journal_raw(journal.EventKind.STORE_SPILL,
+                                  {'outcome': 'ok',
+                                   'tokens': len(tokens),
+                                   'store': self.store_url})
+            else:
+                self._store_spill_failures += 1
+                self._note_store_failure()
+                # Re-queue once the backoff clears? No: the run is
+                # still in the radix tree and republish-on-extend will
+                # re-offer it; retrying here would hammer a dead store.
+                self._m.counter(
+                    'skytpu_store_spills_total',
+                    'Write-behind spills to the durable store by '
+                    'outcome.',
+                    labels=('result',)).inc(labels=('failed',))
+                self._journal_raw(journal.EventKind.STORE_SPILL,
+                                  {'outcome': 'failed',
+                                   'tokens': len(tokens),
+                                   'store': self.store_url})
+        if (not self._spill_pending or not self.store_url
+                or self._store_backoff_until > time.perf_counter()):
+            return
+        tokens = self._spill_pending.pop(0)
+        raw = self._export_prefix_now(tokens, 0)
+        if raw is None:
+            # Evicted between publish and spill: nothing to persist.
+            return
+        fut = self._spill_executor().submit(
+            self._store_spill_fn, self.store_url, tokens, raw,
+            self._store_spill_budget)
+        self._spill_inflight = (fut, tokens)
+
     def _export_prefix_now(self, tokens: Sequence[int],
                            from_tokens: int = 0) -> Optional[dict]:
         """LOOP-THREAD ONLY: radix-match ``tokens`` and read the
@@ -2288,6 +2551,10 @@ class DecodeEngine:
         # admission so a just-published prefix is immediately
         # exportable.
         self._service_prefix_exports()
+        # Write-behind spill to the durable store rides the same slot:
+        # harvest the previous POST's outcome, launch at most one new
+        # export per step.
+        self._service_store_spills()
         self._admit()
         active = self.active_slots()
         if active == 0:
@@ -2505,12 +2772,19 @@ class DecodeEngine:
             delivered += 1
             last_tok = t
             hit_eos = eos is not None and t == eos
-            req._deliver(t, done=hit_eos or budget <= 0)  # pylint: disable=protected-access
             if hit_eos:
                 reason = 'eos'
-                break
-            if budget <= 0:
+            elif budget <= 0:
                 reason = 'length'
+            if reason is not None:
+                # Publish the reason BEFORE the terminal token: the
+                # HTTP thread wakes on done=True and reads
+                # finish_reason immediately, while _finish() only runs
+                # after _evict's allocator/radix/journal bookkeeping —
+                # readers raced that gap and observed ''.
+                req.finish_reason = reason
+            req._deliver(t, done=reason is not None)  # pylint: disable=protected-access
+            if reason is not None:
                 break
         if reason is not None:
             self._evict(slot, reason)
@@ -2752,6 +3026,16 @@ class DecodeEngine:
             'prefix_fetch_misses': self._prefix_fetch_misses,
             'prefix_fetch_tokens': self._prefix_fetch_tokens,
             'prefix_peers': len(self.prefix_peers),
+            'store_configured': bool(self.store_url),
+            'store_in_backoff': (self.store_in_backoff()
+                                 if self.store_url else False),
+            'store_fetch_hits': self._store_fetch_hits,
+            'store_fetch_misses': self._store_fetch_misses,
+            'store_fetch_tokens': self._store_fetch_tokens,
+            'store_spills': self._store_spills,
+            'store_spill_tokens': self._store_spill_tokens,
+            'store_spill_failures': self._store_spill_failures,
+            'store_spill_drops': self._store_spill_drops,
         }
 
     def stats(self) -> dict:
@@ -2793,6 +3077,9 @@ class DecodeEngine:
                 'handoffs_completed': self._handoffs_completed,
                 'handoffs_degraded': self._handoffs_degraded,
                 'handoff_injections': self._handoff_injections,
+                'store_configured': bool(self.store_url),
+                'store_fetch_hits': self._store_fetch_hits,
+                'store_spills': self._store_spills,
             })
         if self.dcfg.spec_k:
             out.update({

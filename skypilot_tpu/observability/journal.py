@@ -178,6 +178,22 @@ class EventKind(enum.Enum):
     # injection result — so "who served this request's tokens" is
     # answerable per handoff.
     ENGINE_HANDOFF = 'engine.handoff'
+    # Durable fleet KV cache (models/block_store.py): a cold-miss
+    # admission that also missed its peers consulted the persistent
+    # block store; the outcome (blocks fetched and injected, store
+    # miss, mismatch rejection, store down → plain prefill) journals
+    # under the request's trace id beside engine.prefix_fetch.
+    ENGINE_STORE_FETCH = 'engine.store_fetch'
+    # Write-behind spill (models/engine.py → block_store): an owner
+    # that published a new radix run persisted it to the store (or
+    # failed to, entering backoff) — so "which prefixes survive a
+    # fleet restart" is answerable from the journal.
+    STORE_SPILL = 'store.spill'
+    # Digest-aware autoscaling (serve/autoscalers.py + controller):
+    # a scale-up triggered by hot digest-family load journals the
+    # family evidence, and a joining replica pre-warmed from the
+    # store (POST /prewarm) journals the digests it warmed.
+    AUTOSCALE_PREWARM = 'autoscale.prewarm'
     # Journal-plane self-observability (this module): a JournalBuffer
     # flush that blew past SKYTPU_JOURNAL_STALL_SECONDS journals ONE row
     # when writes recover — written via the direct (unbuffered,

@@ -10,7 +10,7 @@ dynamic on-demand covering preempted spot capacity.
 import dataclasses
 import os
 import time
-from typing import List, Optional, Union
+from typing import Dict, List, Optional, Union
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.observability import metrics
@@ -57,6 +57,46 @@ def util_blend_enabled() -> bool:
     return os.environ.get(UTIL_BLEND_ENV, '0') == '1'
 
 
+# Digest-aware scaling (opt-in): under prefix-affinity routing every
+# hot digest family pins to ONE owner replica, so fleet-wide QPS
+# headroom does not protect the owner — a single family hotter than one
+# replica's target saturates its owner while the mean looks healthy.
+# The blend floors demand by the count of hot families (one owner
+# each), so the ring grows BEFORE owners saturate, and joining replicas
+# can pre-warm those families from the durable store.
+DIGEST_BLEND_ENV = 'SKYTPU_SERVE_DIGEST_BLEND'
+DIGEST_HOT_FRACTION_ENV = 'SKYTPU_SERVE_DIGEST_HOT_FRACTION'
+DEFAULT_DIGEST_HOT_FRACTION = 0.5
+
+
+def digest_blend_enabled() -> bool:
+    return os.environ.get(DIGEST_BLEND_ENV, '0') == '1'
+
+
+def digest_family_demand(family_counts: Optional[Dict[str, int]],
+                         window_seconds: float,
+                         target_qps_per_replica: Optional[float]
+                         ) -> int:
+    """Replicas demanded by hot digest families: one owner per family
+    whose windowed rate is at least ``hot_fraction × target_qps`` —
+    the saturation-imminent threshold (default 0.5: a family at half
+    an owner's capacity deserves its own owner before the next doubling
+    saturates it). Affinity routing pins each family to one replica,
+    so this is a FLOOR on ring size, not a rate conversion; like the
+    utilization blend it can only raise demand (max, not replace)."""
+    if (not family_counts or window_seconds <= 0
+            or not target_qps_per_replica
+            or target_qps_per_replica <= 0):
+        return 0
+    hot_fraction = _env_float(DIGEST_HOT_FRACTION_ENV,
+                              DEFAULT_DIGEST_HOT_FRACTION)
+    if hot_fraction <= 0:
+        return 0
+    threshold = hot_fraction * target_qps_per_replica
+    return sum(1 for count in family_counts.values()
+               if count / window_seconds >= threshold)
+
+
 def utilization_demand(num_ready: int,
                        utilization: Optional[float]) -> int:
     """Replicas needed to bring mean replica utilization under target:
@@ -86,21 +126,29 @@ class Autoscaler:
         self.spec = spec
 
     def evaluate(self, num_ready: int, request_signal: RequestSignal,
-                 utilization: Optional[float] = None) -> int:
+                 utilization: Optional[float] = None,
+                 digest_families: Optional[Dict[str, int]] = None
+                 ) -> int:
         """→ target number of replicas. ``num_ready`` is the count the
-        ``utilization`` mean was measured over (READY replicas)."""
-        del num_ready, request_signal, utilization
+        ``utilization`` mean was measured over (READY replicas);
+        ``digest_families`` is the LB-reported windowed per-family
+        request count (digest-aware blend, opt-in)."""
+        del num_ready, request_signal, utilization, digest_families
         return self.spec.min_replicas
 
     def plan(self, num_ready_default: int, num_alive_default: int,
              request_signal: RequestSignal,
-             utilization: Optional[float] = None) -> ScalePlan:
+             utilization: Optional[float] = None,
+             digest_families: Optional[Dict[str, int]] = None
+             ) -> ScalePlan:
         """→ ScalePlan; base autoscalers put everything in the default
         pool. ``utilization`` is the mean replica utilization (0..1)
-        from the fleet plane, or None when unavailable/disabled."""
+        from the fleet plane, or None when unavailable/disabled;
+        ``digest_families`` the LB's hot-family counts, or None."""
         del num_alive_default
         return ScalePlan(self.evaluate(num_ready_default, request_signal,
-                                       utilization=utilization))
+                                       utilization=utilization,
+                                       digest_families=digest_families))
 
     @classmethod
     def make(cls, spec: spec_lib.SkyServiceSpec) -> 'Autoscaler':
@@ -146,7 +194,9 @@ class RequestRateAutoscaler(Autoscaler):
         return len(recent) / window
 
     def evaluate(self, num_ready: int, request_signal: RequestSignal,
-                 utilization: Optional[float] = None) -> int:
+                 utilization: Optional[float] = None,
+                 digest_families: Optional[Dict[str, int]] = None
+                 ) -> int:
         spec = self.spec
         assert spec.target_qps_per_replica is not None
         qps = self.current_qps(request_signal)
@@ -157,6 +207,14 @@ class RequestRateAutoscaler(Autoscaler):
         # cost grows; the measured-capacity floor covers that case and
         # NEVER scales below what QPS asks (max, not replace).
         demand = max(demand, utilization_demand(num_ready, utilization))
+        # Digest blend: mean QPS undercounts demand when traffic
+        # concentrates on a few prefix owners; the hot-family floor
+        # grows the ring before those owners saturate (max, not
+        # replace — and the [min, max] clamp below still wins).
+        if digest_blend_enabled():
+            demand = max(demand, digest_family_demand(
+                digest_families, self.qps_window_seconds,
+                spec.target_qps_per_replica))
         demand = min(max(demand, spec.min_replicas),
                      spec.max_replicas or demand)
         now = time.time()
@@ -197,11 +255,14 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
 
     def plan(self, num_ready_default: int, num_alive_default: int,
              request_signal: RequestSignal,
-             utilization: Optional[float] = None) -> ScalePlan:
+             utilization: Optional[float] = None,
+             digest_families: Optional[Dict[str, int]] = None
+             ) -> ScalePlan:
         spec = self.spec
         if spec.autoscaling_enabled:
             total = self.evaluate(num_ready_default, request_signal,
-                                  utilization=utilization)
+                                  utilization=utilization,
+                                  digest_families=digest_families)
         else:
             total = max(spec.min_replicas, 1)
         base_od = min(spec.base_ondemand_fallback_replicas, total)

@@ -16,6 +16,7 @@ import sys
 import threading
 import time
 import traceback
+from collections import deque
 from typing import Optional
 
 from skypilot_tpu import sky_logging
@@ -57,6 +58,13 @@ class _LbSyncServer:
             'skytpu_serve_requests_total',
             'Requests observed by the serve controller (LB sync).',
             labels=('service',), label_values=(service_name,))
+        # Digest-family load (LB sync body `digest_families`): batches
+        # of per-family request counts, windowed like the QPS signal —
+        # the digest-aware autoscale blend and the pre-warm digest set
+        # both read family_counts(). Bounded deque: adversarially
+        # diverse traffic ages out instead of growing.
+        self._family_lock = threading.Lock()
+        self._family_events: 'deque' = deque(maxlen=4096)
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -72,6 +80,9 @@ class _LbSyncServer:
                     body = {}
                 outer.tracker.extend(
                     body.get('request_timestamps', []))
+                fams = body.get('digest_families')
+                if isinstance(fams, dict):
+                    outer.note_families(fams)
                 payload = json.dumps(
                     {'ready_urls': outer._get_ready_urls(),
                      'ready_roles': outer._get_ready_roles()}).encode()
@@ -91,6 +102,33 @@ class _LbSyncServer:
                                         daemon=True,
                                         name='skytpu-lb-sync')
         self._thread.start()
+
+    def note_families(self, families: dict) -> None:
+        """Record one sync's per-family request counts (timestamped,
+        so family_counts can window them like the QPS tracker)."""
+        now = time.time()
+        with self._family_lock:
+            for digest, count in families.items():
+                try:
+                    count = int(count)
+                except (TypeError, ValueError):
+                    continue
+                if count > 0:
+                    self._family_events.append((now, str(digest), count))
+
+    def family_counts(self, window_seconds: float) -> dict:
+        """Per-digest-family request counts over the trailing window —
+        the digest-aware autoscale signal and the pre-warm digest
+        source (hottest families first when sorted by value)."""
+        cutoff = time.time() - window_seconds
+        with self._family_lock:
+            while self._family_events and \
+                    self._family_events[0][0] < cutoff:
+                self._family_events.popleft()
+            out: dict = {}
+            for _, digest, count in self._family_events:
+                out[digest] = out.get(digest, 0) + count
+        return out
 
     def close(self) -> None:
         self._server.shutdown()
@@ -240,6 +278,11 @@ class SkyServeController:
         default_pool = [r for r in replicas
                         if r['is_spot'] and
                         r.get('version', 1) == rm.version]
+        # Digest-family load over the autoscaler's own QPS window: one
+        # windowed signal feeds both the digest-aware scale blend and
+        # the pre-warm digest set a joining replica receives.
+        window = getattr(self.autoscaler, 'qps_window_seconds', 60.0)
+        families = self._sync.family_counts(window)
         plan = self.autoscaler.plan(
             sum(1 for r in default_pool
                 if r['status'] == ReplicaStatus.READY),
@@ -248,7 +291,13 @@ class SkyServeController:
             # Measured over the same set num_ready_default counts —
             # utilization_demand multiplies the mean by that count, so
             # mixing in fallback/old-version replicas would skew it.
-            utilization=self._replica_utilization(default_pool))
+            utilization=self._replica_utilization(default_pool),
+            digest_families=families)
+        # Hottest-first digest list for the replica manager's READY
+        # pre-warm hook (no-op unless a durable store is configured).
+        rm.set_prewarm_digests(
+            [d for d, _ in sorted(families.items(),
+                                  key=lambda kv: -kv[1])])
         rm.scale_to(plan)
         rm.rolling_update_tick(plan)
         self._update_service_status()
@@ -265,7 +314,6 @@ class SkyServeController:
         svc_gauge.set(plan.total, labels=(svc, 'target'))
         # The autoscaler's windowed request rate, labeled per service so
         # co-resident controllers don't clobber each other's series.
-        window = getattr(self.autoscaler, 'qps_window_seconds', 60.0)
         metrics.gauge('skytpu_serve_qps',
                       'Windowed request rate seen by the autoscaler.',
                       labels=('service',)).set(

@@ -57,7 +57,7 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Callable, Deque, List, Optional
+from typing import Callable, Deque, Dict, List, Optional
 
 import aiohttp
 from aiohttp import web
@@ -78,6 +78,9 @@ LB_METRICS_PORT_ENV = 'SKYTPU_LB_METRICS_PORT'
 # into the FleetSlo rollup (gauges + straggler detection + the LB's
 # fleet /slo endpoint).
 FLEET_SLO_INTERVAL_ENV = 'SKYTPU_FLEET_SLO_INTERVAL'
+# Most digest families one controller sync reports (hottest-first):
+# bounds the sync body under adversarially diverse traffic.
+_SYNC_FAMILY_CAP = 32
 DEFAULT_FLEET_SLO_INTERVAL = 5.0
 # Replica circuit breaker: this many CONSECUTIVE failures (connect
 # errors, pre-byte 5xx, failed reinstatement probes) eject a replica
@@ -309,6 +312,7 @@ class LoadBalancer:
     # is appended by the aiohttp loop and snapshotted by other threads.
     _GUARDED_BY = {
         '_request_timestamps': '_ts_lock',
+        '_digest_counts': '_ts_lock',
     }
 
     def __init__(self, port: int, policy_name: str,
@@ -338,6 +342,20 @@ class LoadBalancer:
         # thread (in-proc mode) or the sync task snapshots.
         self._ts_lock = threading.Lock()
         self._request_timestamps: Deque[float] = deque(maxlen=100_000)
+        # Digest-family load for the autoscaler: per-prefix-digest
+        # request counts since the last controller sync. The digest
+        # here IS the store's family key (same token window, same
+        # hash), so the controller can forward the hottest families
+        # straight to a joining replica's POST /prewarm. Same lock as
+        # the timestamps — both are written on request arrival and
+        # drained by the sync task.
+        self._digest_counts: Dict[str, int] = {}
+        # Store advertisement (observability only): the fleet /slo
+        # names the durable store so operators and the bench can find
+        # it. Replicas get the URL via their own config/envs — never
+        # via a request header (the trust-set rule).
+        self._store_url = os.environ.get('SKYTPU_STORE_URL',
+                                         '').strip() or None
         # Trace-event buffer: span/hop rows batch into ONE sqlite
         # transaction per flush tick (the engine's journaling idiom) —
         # a per-event commit inside the asyncio loop would stall every
@@ -457,10 +475,20 @@ class LoadBalancer:
         with self._ts_lock:
             fresh = list(self._request_timestamps)
             self._request_timestamps.clear()
+            families = self._digest_counts
+            self._digest_counts = {}
+        # Hottest families only: the sync body must stay bounded no
+        # matter how diverse the traffic (the long tail is noise to
+        # the autoscaler anyway).
+        if len(families) > _SYNC_FAMILY_CAP:
+            families = dict(sorted(families.items(),
+                                   key=lambda kv: -kv[1]
+                                   )[:_SYNC_FAMILY_CAP])
         try:
             async with self._session.post(
                     f'{self._controller_url}/sync',
-                    json={'request_timestamps': fresh},
+                    json={'request_timestamps': fresh,
+                          'digest_families': families},
                     timeout=aiohttp.ClientTimeout(total=10)) as resp:
                 body = await resp.json()
             self._synced_urls = list(body.get('ready_urls', []))
@@ -507,6 +535,17 @@ class LoadBalancer:
     def snapshot_request_timestamps(self) -> list:
         with self._ts_lock:
             return list(self._request_timestamps)
+
+    def snapshot_digest_counts(self, top: int = 0) -> Dict[str, int]:
+        """Per-digest-family request counts since the last sync drain
+        (in-proc autoscaler + the fleet /slo's hot-family view).
+        ``top`` > 0 keeps only the hottest families."""
+        with self._ts_lock:
+            counts = dict(self._digest_counts)
+        if top and len(counts) > top:
+            counts = dict(sorted(counts.items(),
+                                 key=lambda kv: -kv[1])[:top])
+        return counts
 
     def _ready_urls(self) -> List[str]:
         if self._get_ready_urls is not None:
@@ -629,7 +668,14 @@ class LoadBalancer:
         # itself instead of proxying — the per-replica body stays
         # reachable on each replica's own port.
         if request.method == 'GET' and tail == 'slo':
-            return web.json_response(self.fleet.snapshot())
+            snap = self.fleet.snapshot()
+            # Durable store advertisement + the hot digest families the
+            # autoscaler is watching — the fleet-level store view.
+            snap['store'] = {
+                'url': self._store_url,
+                'hot_families': self.snapshot_digest_counts(top=8),
+            }
+            return web.json_response(snap)
         # Federated flight recorder head: the LB serves ITS OWN journal
         # rows (the lb.proxy/lb.hop side of every trace) plus the ready
         # set, so one `--fleet <lb>` endpoint expands to the whole
@@ -896,6 +942,13 @@ class LoadBalancer:
                 ).run_in_executor(None, _prompt_prefix_digest, body)
             else:
                 digest = _prompt_prefix_digest(body)
+        if digest is not None:
+            # Digest-family load signal: counted at arrival (like the
+            # QPS timestamps, same lock) so the autoscaler sees hot
+            # families even when every replica still answers fast.
+            with self._ts_lock:
+                self._digest_counts[digest] = (
+                    self._digest_counts.get(digest, 0) + 1)
         url, route_meta = self._select_replica(digest, req_id, ())
         if url is None and self._controller_url is not None:
             # Empty ready set: sync on demand before 503ing — bounds

@@ -86,6 +86,7 @@ from typing import Optional
 from aiohttp import web
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.models import block_store
 from skypilot_tpu.models import decode
 from skypilot_tpu.models import engine as engine_lib
 from skypilot_tpu.models import llama
@@ -133,7 +134,16 @@ SERVE_TP_ENV = 'SKYTPU_SERVE_TP'
 # LB's `disagg` policy can build its role map. `mixed` (the default)
 # is monolithic serving.
 REPLICA_ROLE_ENV = 'SKYTPU_REPLICA_ROLE'
-_ROLES = ('prefill', 'decode', 'mixed')
+_ROLES = ('prefill', 'decode', 'mixed', 'store')
+# Store-warmed scale-up: how many hot digest families one POST /prewarm
+# may pull from the durable store, and the per-digest fetch budget.
+# Both bound a prewarm's cost on a replica that is about to take
+# traffic — warming must never delay readiness by more than
+# digests × budget.
+PREWARM_MAX_DIGESTS_ENV = 'SKYTPU_PREWARM_MAX_DIGESTS'
+DEFAULT_PREWARM_MAX_DIGESTS = 8
+PREWARM_BUDGET_ENV = 'SKYTPU_PREWARM_BUDGET_SECONDS'
+DEFAULT_PREWARM_BUDGET_SECONDS = 2.0
 # Federated flight recorder trust set: hosts allowed to pull this
 # replica's /journal. The endpoint answers when the replica is already
 # configured into a fleet (SKYTPU_PREFIX_PEERS — the PR 15 trust
@@ -166,7 +176,8 @@ class ModelServer:
                  host: str = '0.0.0.0',
                  default_max_new_tokens: int = 128,
                  role: Optional[str] = None,
-                 journal_db: Optional[str] = None):
+                 journal_db: Optional[str] = None,
+                 store: Optional[block_store.BlockStore] = None):
         self.engine = engine
         # Which journal file this replica's direct writes and /journal
         # reads target: explicit > the engine's (they share a replica) >
@@ -183,6 +194,20 @@ class ModelServer:
         role = (role or os.environ.get(REPLICA_ROLE_ENV)
                 or 'mixed').strip().lower()
         self.role = role if role in _ROLES else 'mixed'
+        # Durable block store hosting: an explicit store instance (the
+        # bench/tests), or the `store` role + SKYTPU_STORE_DIR (a
+        # head-hosted store node launched by the serve plane). A
+        # hosting server answers /prefix_blocks from DISK instead of
+        # the radix export — same endpoint, same wire format, so
+        # replicas fetch from peers and the store identically.
+        if store is None and self.role == 'store':
+            store_dir = os.environ.get(block_store.STORE_DIR_ENV,
+                                       '').strip()
+            if store_dir:
+                store = block_store.BlockStore(store_dir)
+        self._store = store
+        self._prewarms = 0
+        self._prewarm_tokens = 0
         try:
             self.request_timeout = float(
                 os.environ.get(REQUEST_TIMEOUT_ENV, '300'))
@@ -382,8 +407,10 @@ class ModelServer:
         app.router.add_post('/prefill_handoff',
                             self._handle_prefill_handoff)
         app.router.add_post('/prefix_blocks', self._handle_prefix_blocks)
+        app.router.add_get('/prefix_blocks', self._handle_store_stats)
         app.router.add_post('/handoff_blocks',
                             self._handle_handoff_blocks)
+        app.router.add_post('/prewarm', self._handle_prewarm)
         app.router.add_post('/drain', self._handle_drain)
         app.router.add_get('/healthz', self._handle_healthz)
         app.router.add_get('/metrics', self._handle_metrics)
@@ -889,6 +916,20 @@ class ModelServer:
         # reads `role` to build its routing map.
         body['role'] = self.role
         body['handoff'] = self.engine.handoff_stats()
+        # Durable block store: what this replica knows about the store
+        # tier — hosting (disk occupancy/hit counters) or consuming
+        # (configured URL, backoff, prewarm counters). The engine-side
+        # fetch/spill counters ride the `cache` block above.
+        body['store'] = {
+            'hosting': self._store is not None,
+            'configured_url': self.engine.store_url,
+            'in_backoff': (self.engine.store_in_backoff()
+                           if self.engine.store_url else False),
+            'prewarms': self._prewarms,
+            'prewarm_tokens': self._prewarm_tokens,
+        }
+        if self._store is not None:
+            body['store']['stats'] = self._store.stats()
         # Engine-step snapshot (aggregates only, no ring rows): the
         # fleet SLO aggregator pulls /slo on the LB's probe cadence and
         # needs the step-time/stall/heartbeat signal beside the request
@@ -943,7 +984,24 @@ class ModelServer:
         ENGINE LOOP (the radix tree and pool are loop-confined) and
         answers with the matched KV blocks, serialized dtype-exact.
         The export wait and the base64 encode both run in the executor
-        — neither may block the event loop."""
+        — neither may block the event loop.
+
+        A STORE-HOSTING server (``store`` role, or an explicit store
+        instance) answers this endpoint from disk instead: spill
+        bodies (``arrays`` present) persist, prewarm bodies
+        (``digest``) return a family's longest run, fetch bodies
+        longest-prefix-probe the index. Same wire format either way —
+        the engine's two-level lookup needs no store-specific code."""
+        if self._store is not None:
+            try:
+                body = await request.json()
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                return web.json_response({'error': 'invalid JSON body'},
+                                         status=400)
+            status, reply = await asyncio.get_running_loop(
+            ).run_in_executor(None, functools.partial(
+                block_store.handle_store_post, self._store, body))
+            return web.json_response(reply, status=status)
         if not self.engine.paged:
             return web.json_response(
                 {'error': 'replica is not paged'}, status=400)
@@ -996,6 +1054,97 @@ class ModelServer:
                 result['block_k'], result['kv_cache_dtype'],
                 result['arrays']))
         return web.json_response(payload)
+
+    async def _handle_store_stats(self, request: web.Request
+                                  ) -> web.Response:
+        """GET /prefix_blocks on a store-hosting server: the store's
+        occupancy/hit counters (capacity planning + the bench's
+        evidence that a cold fleet really warmed from disk). 404 when
+        this server does not host a store."""
+        if self._store is None:
+            return web.json_response(
+                {'error': 'no block store hosted here'}, status=404)
+        return web.json_response(self._store.stats())
+
+    async def _handle_prewarm(self, request: web.Request
+                              ) -> web.Response:
+        """Store-warmed scale-up, replica side: the controller (via the
+        replica manager's READY hook) POSTs the fleet's hottest digest
+        families; this replica pulls each family's longest run from the
+        CONFIGURED store and installs it through the handoff-injection
+        path, so its first routed request admits as a prefix hit.
+
+        Trust model: the body carries only digests — the store URL
+        comes from this replica's own config (engine store_url), never
+        from the request, so whoever reaches this port cannot point the
+        replica at a poisoned store. Every failure path answers
+        structured non-ok JSON (or an empty warm), never a 500: prewarm
+        is best-effort and must not mark a joining replica unhealthy."""
+        if not self.engine.paged:
+            return web.json_response(
+                {'ok': False, 'error': 'replica is not paged'},
+                status=400)
+        if not self.engine.store_url:
+            return web.json_response(
+                {'ok': False, 'error': 'no durable store configured '
+                                       '(SKYTPU_STORE_URL)'}, status=404)
+        if self._state != 'running':
+            return web.json_response(
+                {'ok': False, 'error': f'server {self._state}'},
+                status=503, headers={'Retry-After': '1'})
+        try:
+            body = await request.json()
+            digests = [str(d) for d in body['digests']]
+        except (json.JSONDecodeError, UnicodeDecodeError, KeyError,
+                TypeError, ValueError):
+            return web.json_response(
+                {'ok': False, 'error': 'body needs "digests" (list)'},
+                status=400)
+        max_digests = common_utils.env_int(
+            PREWARM_MAX_DIGESTS_ENV, DEFAULT_PREWARM_MAX_DIGESTS)
+        budget = common_utils.env_float(
+            PREWARM_BUDGET_ENV, DEFAULT_PREWARM_BUDGET_SECONDS)
+        digests = digests[:max(0, max_digests)]
+
+        def _warm() -> dict:
+            warmed = 0
+            tokens_gained = 0
+            missed = 0
+            for digest in digests:
+                got = block_store.http_store_prewarm_fetch(
+                    self.engine.store_url, digest, budget)
+                if got is None:
+                    missed += 1
+                    continue
+                tokens, payload = got
+                res = self.engine.inject_handoff_blocks(tokens, payload)
+                if res.get('ok'):
+                    warmed += 1
+                    tokens_gained += int(res.get('gained', 0))
+                else:
+                    missed += 1
+            return {'ok': True, 'warmed': warmed, 'missed': missed,
+                    'tokens': tokens_gained}
+
+        out = await asyncio.get_running_loop().run_in_executor(None,
+                                                               _warm)
+        self._prewarms += 1
+        self._prewarm_tokens += out['tokens']
+        metrics_lib.counter(
+            'skytpu_prewarm_requests_total',
+            'POST /prewarm requests served (store-warmed '
+            'scale-up).').inc()
+        metrics_lib.counter(
+            'skytpu_prewarm_tokens_total',
+            'Prefix tokens installed from the durable store by '
+            '/prewarm.').inc(out['tokens'])
+        journal.event(journal.EventKind.AUTOSCALE_PREWARM,
+                      self._entity(),
+                      {'digests': digests, 'warmed': out['warmed'],
+                       'missed': out['missed'], 'tokens': out['tokens'],
+                       'store': self.engine.store_url},
+                      db_path=self._journal_db)
+        return web.json_response(out)
 
     async def _handle_handoff_blocks(self, request: web.Request
                                      ) -> web.Response:
@@ -1068,7 +1217,8 @@ def build_engine(model: str, num_slots: int, max_len: int,
                  drafter_layers: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
                  tp: Optional[int] = None,
-                 prefix_peers: Optional[list] = None
+                 prefix_peers: Optional[list] = None,
+                 store_url: Optional[str] = None
                  ) -> engine_lib.DecodeEngine:
     """Assemble params + configs into a DecodeEngine (CLI + tests).
 
@@ -1119,7 +1269,8 @@ def build_engine(model: str, num_slots: int, max_len: int,
                                    step_chunk=step_chunk, name=model,
                                    paged=paged, num_blocks=num_blocks,
                                    prefill_chunk=prefill_chunk, tp=tp,
-                                   prefix_peers=prefix_peers)
+                                   prefix_peers=prefix_peers,
+                                   store_url=store_url)
 
 
 def main() -> None:
@@ -1190,6 +1341,19 @@ def main() -> None:
                              'LB-advertised owner) instead of '
                              're-prefilling (default SKYTPU_PREFIX_PEERS '
                              'or disabled)')
+    parser.add_argument('--store-url', default=None,
+                        help='durable block-store URL: the second '
+                             'level of the cold-miss lookup (peer '
+                             'first, store second) and the write-'
+                             'behind spill target for newly published '
+                             'radix runs (default SKYTPU_STORE_URL or '
+                             'disabled)')
+    parser.add_argument('--store-dir', default=None,
+                        help='host the durable block store from this '
+                             'directory: /prefix_blocks answers from '
+                             'disk instead of the radix export '
+                             '(head-hosted store node; default '
+                             'SKYTPU_STORE_DIR when --role store)')
     parser.add_argument('--role', choices=_ROLES, default=None,
                         help='disaggregated serving role (default '
                              'SKYTPU_REPLICA_ROLE or mixed): prefill '
@@ -1230,10 +1394,13 @@ def main() -> None:
                               [u.strip()
                                for u in args.prefix_peers.split(',')
                                if u.strip()]
-                              if args.prefix_peers else None))
+                              if args.prefix_peers else None),
+                          store_url=args.store_url)
+    store = (block_store.BlockStore(args.store_dir)
+             if args.store_dir else None)
     server = ModelServer(engine, args.port, host=args.host,
                          default_max_new_tokens=args.max_new_tokens,
-                         role=args.role)
+                         role=args.role, store=store)
     server.run_forever()
     if server.startup_error is not None:
         raise SystemExit(f'Model server failed to start: '

@@ -46,6 +46,16 @@ REPLICA_ROLE_ENV = 'SKYTPU_REPLICA_ROLE'
 # Shared with serve/model_server.py: how long a draining replica's
 # in-flight requests get before teardown proceeds.
 DRAIN_TIMEOUT_ENV = 'SKYTPU_DRAIN_TIMEOUT_SECONDS'
+# Durable fleet KV cache (shared with models/block_store.py): when the
+# controller env names a store, every replica task inherits the URL —
+# the CONFIG plane, never a request header (the LB owner-hint trust
+# rule) — and a replica's STARTING→READY transition triggers a
+# best-effort POST /prewarm with the fleet's hottest digest families.
+STORE_URL_ENV = 'SKYTPU_STORE_URL'
+# How many hottest families one pre-warm POST carries (the replica
+# side caps again via SKYTPU_PREWARM_MAX_DIGESTS).
+PREWARM_TOP_K_ENV = 'SKYTPU_PREWARM_TOP_K'
+_DEFAULT_PREWARM_TOP_K = 4
 
 
 class ReplicaManager:
@@ -74,6 +84,10 @@ class ReplicaManager:
         # launch (from the provider the optimizer picked) can disagree
         # with what the replica was told to bind.
         self._replica_ports: Dict[int, int] = {}
+        # Hottest digest families (controller-fed, hottest first): what
+        # a freshly READY replica is told to pre-warm from the durable
+        # store. Empty (or no store configured) = hook disabled.
+        self._prewarm_digests: List[str] = []
 
     def _candidate_locations(self):
         from skypilot_tpu.serve import spot_placer as spot_placer_lib
@@ -246,6 +260,11 @@ class ReplicaManager:
             REPLICA_ID_ENV: str(replica_id),
             REPLICA_ROLE_ENV: self.spec.role_for_replica(replica_id),
         })
+        store_url = os.environ.get(STORE_URL_ENV, '').strip()
+        if store_url:
+            # Config-plane propagation: the replica learns the durable
+            # store from its own task env, never from request headers.
+            task.update_envs({STORE_URL_ENV: store_url})
         if ondemand_fallback:
             # The fallback pool rides assured capacity.
             task.set_resources({r.copy(use_spot=False)
@@ -471,6 +490,12 @@ class ReplicaManager:
                 if self._placer is not None:
                     self._placer.handle_active(
                         self._replica_locations.get(rid))
+                # Store-warmed scale-up: tell the joining replica to
+                # pull the fleet's hottest digest families from the
+                # durable store BEFORE the LB's next sync routes
+                # traffic to it. Best-effort and asynchronous — a
+                # slow or dead store must not delay readiness.
+                self._prewarm_replica(rid, rec.get('endpoint'))
             serve_state.set_replica_failures(self.service_name, rid, 0)
             self._set_status(rid, ReplicaStatus.READY, prev=status)
             return
@@ -489,6 +514,54 @@ class ReplicaManager:
             self.terminate_replica(rid, reason='unhealthy')
         elif failures >= _NOT_READY_THRESHOLD:
             self._set_status(rid, ReplicaStatus.NOT_READY, prev=status)
+
+    # ------------------------------------------------- store pre-warm hook
+
+    def set_prewarm_digests(self, digests: List[str]) -> None:
+        """Controller-fed hot-digest-family list (hottest first): what
+        the next freshly READY replica will be asked to pre-warm."""
+        self._prewarm_digests = list(digests)
+
+    def _prewarm_replica(self, replica_id: int,
+                         endpoint: Optional[str]) -> None:
+        """Fire one best-effort POST /prewarm at a replica that just
+        went READY, on a daemon thread: readiness must never wait on
+        the store, and a failed pre-warm costs nothing (the replica's
+        own two-level cold-miss lookup still warms it lazily)."""
+        if not os.environ.get(STORE_URL_ENV, '').strip():
+            return
+        if not endpoint or not self._prewarm_digests:
+            return
+        try:
+            top_k = int(os.environ.get(PREWARM_TOP_K_ENV,
+                                       str(_DEFAULT_PREWARM_TOP_K)))
+        except ValueError:
+            top_k = _DEFAULT_PREWARM_TOP_K
+        digests = self._prewarm_digests[:max(0, top_k)]
+        if not digests:
+            return
+        url = endpoint.rstrip('/') + '/prewarm'
+
+        def _post() -> None:
+            try:
+                resp = requests_lib.post(url, json={'digests': digests},
+                                         timeout=30)
+                body = resp.json() if resp.status_code == 200 else {}
+            except (requests_lib.RequestException, ValueError):
+                return
+            journal.event(
+                journal.EventKind.AUTOSCALE_PREWARM,
+                f'serve:{self.service_name}',
+                {'replica_id': replica_id, 'digests': digests,
+                 'warmed': body.get('warmed', 0),
+                 'tokens': body.get('tokens', 0)})
+            metrics.counter(
+                'skytpu_prewarm_dispatched_total',
+                'Pre-warm POSTs dispatched to freshly READY replicas.',
+                labels=('service',)).inc(labels=(self.service_name,))
+
+        threading.Thread(target=_post, daemon=True,
+                         name=f'prewarm-{replica_id}').start()
 
     # ------------------------------------------------------------- views
 

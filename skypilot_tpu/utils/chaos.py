@@ -57,6 +57,20 @@ Registered points (grep for ``chaos.`` call sites):
 ``journal_disk_full``  ``JournalBuffer`` batch commits fail outright —
                        the whole batch is counted as ``write_error``
                        drops and the plane keeps flying.
+``store_down``         the block-store client transports
+                       (``http_store_fetch`` / ``http_store_spill`` /
+                       ``http_store_prewarm_fetch``) fail before any
+                       bytes move — the engine notes the failure, backs
+                       off, and the request degrades to plain prefill.
+``store_torn_entry``   ``BlockStore.put`` writes only half the entry
+                       bytes at the *final* path (a crash mid-rename
+                       window) — the read side drops the torn entry on
+                       contact (counted ``torn_dropped``), never
+                       deserializes garbage.
+``store_slow``         ``BlockStore.get`` sleeps
+                       ``SKYTPU_CHAOS_STORE_SLOW_SECONDS`` (default
+                       2.0) first — a slow store disk; the fetch budget
+                       must bound the stall and fall back to prefill.
 =====================  ====================================================
 
 Default **off**: with ``SKYTPU_CHAOS`` unset every check is one dict

@@ -198,11 +198,73 @@ _e('SKYTPU_SERVE_MAX_FAILURES', '3',
 _e('SKYTPU_SERVE_DOWN_TIMEOUT', '300',
    'Bound on waiting for service teardown in `sky serve down`.',
    'skypilot_tpu/serve/core.py', 'serving')
+_e('SKYTPU_STORE_URL', None,
+   'Base URL of the durable block store replicas fetch cold prefixes '
+   'from and spill published radix runs to (unset = no durable tier). '
+   'The engine tries peers first, the store second.',
+   'skypilot_tpu/models/block_store.py', 'serving')
+_e('SKYTPU_STORE_DIR', None,
+   'Arms the store ROLE on a model server or LB host: the directory '
+   'persisted prefix-block entries live under.',
+   'skypilot_tpu/models/block_store.py', 'serving')
+_e('SKYTPU_STORE_CAPACITY_BYTES', '1073741824',
+   'On-disk byte cap of the block store; past it whole digest '
+   'families are evicted coldest-first (LRU over families, never '
+   'partial entries).',
+   'skypilot_tpu/models/block_store.py', 'serving')
+_e('SKYTPU_STORE_FETCH_BUDGET_SECONDS', '0.5',
+   'Wall-clock budget one cold admission may spend on its store '
+   'lookup; past it the request degrades to plain prefill.',
+   'skypilot_tpu/models/block_store.py', 'serving')
+_e('SKYTPU_STORE_SPILL_BUDGET_SECONDS', '2.0',
+   'Budget for ONE write-behind spill POST to the store (bounds the '
+   'off-loop spill worker, not the engine step).',
+   'skypilot_tpu/models/block_store.py', 'serving')
+_e('SKYTPU_STORE_BACKOFF_SECONDS', '30',
+   'How long a store whose fetch or spill failed is left alone before '
+   'being retried — a dead store must not tax every cold admission.',
+   'skypilot_tpu/models/block_store.py', 'serving')
+_e('SKYTPU_STORE_SPILL_MIN_TOKENS', None,
+   'Minimum published-run length worth a durable store entry '
+   '(default: the engine\'s paged block size).',
+   'skypilot_tpu/models/block_store.py', 'serving')
+_e('SKYTPU_STORE_FAMILY_TOKENS', '128',
+   'Digest-family window: store entries sharing their first N prompt '
+   'tokens group into one family for eviction and pre-warm '
+   'advertisement (match the LB affinity window so families equal '
+   'routing digests).',
+   'skypilot_tpu/models/block_store.py', 'serving')
+_e('SKYTPU_PREWARM_MAX_DIGESTS', '8',
+   'Server-side cap on digests one POST /prewarm request may ask a '
+   'replica to pull from the store.',
+   'skypilot_tpu/serve/model_server.py', 'serving')
+_e('SKYTPU_PREWARM_BUDGET_SECONDS', '2.0',
+   'Wall-clock budget for one replica\'s whole /prewarm pull — past '
+   'it the remaining digests are skipped (the replica serves cold).',
+   'skypilot_tpu/serve/model_server.py', 'serving')
+_e('SKYTPU_PREWARM_TOP_K', '4',
+   'How many of the hottest digest families the replica manager sends '
+   'a joining replica to pre-warm (server caps again via '
+   'SKYTPU_PREWARM_MAX_DIGESTS).',
+   'skypilot_tpu/serve/replica_managers.py', 'serving')
+_e('SKYTPU_SERVE_DIGEST_BLEND', '0',
+   'Opt-in: floor the QPS replica target by hot digest-family demand '
+   'so the ring scales before prefix owners saturate.',
+   'skypilot_tpu/serve/autoscalers.py', 'serving')
+_e('SKYTPU_SERVE_DIGEST_HOT_FRACTION', '0.5',
+   'Fraction of the per-replica target QPS a digest family must '
+   'sustain to count as hot for the digest-blend autoscaler floor.',
+   'skypilot_tpu/serve/autoscalers.py', 'serving')
 _e('SKYTPU_CHAOS', None,
    'Fault-injection spec (engine_step_raise:N,slow_step:p,drain_hang,'
    'replica_500:p,handoff_decode_death,handoff_truncate,'
-   'journal_write_stall,journal_disk_full); unset = off.',
+   'journal_write_stall,journal_disk_full,store_down,store_torn_entry,'
+   'store_slow); unset = off.',
    'skypilot_tpu/utils/chaos.py', 'serving')
+_e('SKYTPU_CHAOS_STORE_SLOW_SECONDS', '2.0',
+   'Injected block-store lookup delay for the store_slow chaos point '
+   '(exercises the fetch budget\'s degrade-to-prefill path).',
+   'skypilot_tpu/models/block_store.py', 'serving')
 _e('SKYTPU_CHAOS_SLOW_STEP_SECONDS', '0.2',
    'Injected engine-step delay for the slow_step chaos point.',
    'skypilot_tpu/utils/chaos.py', 'serving')

@@ -419,21 +419,29 @@ def test_lb_ejects_failing_replica_until_probe_passes(monkeypatch):
         assert any(r['payload']['action'] == 'eject' for r in rows)
 
         # (c) probe-based reinstatement: flip the replica healthy and
-        # the probe loop brings it back after the backoff.
+        # wait for the probe loop's reinstate journal event. The event
+        # is emitted (and flushed) strictly after breaker.reinstate(),
+        # so it is the one signal that both the candidate set AND the
+        # journal row are in place — polling breaker state alone races
+        # the journal flush and flaked the final query below.
         bad_state.healthy = True
         deadline = time.time() + 15
-        while lb.breaker.is_ejected(bad_url) and time.time() < deadline:
-            time.sleep(0.05)
-        assert not lb.breaker.is_ejected(bad_url), \
+        reinstated = False
+        while not reinstated and time.time() < deadline:
+            rows = journal.query(kinds=[journal.EventKind.LB_EJECT])
+            reinstated = any(
+                r['payload']['action'] == 'reinstate' for r in rows)
+            if not reinstated:
+                time.sleep(0.05)
+        assert reinstated, \
             'replica never reinstated after its probe passed'
+        assert not lb.breaker.is_ejected(bad_url)
         texts = set()
         for _ in range(6):
             r = requests.get(f'http://127.0.0.1:{lb_port}/x', timeout=10)
             assert r.status_code == 200
             texts.add(r.text)
         assert 'ok-b' in texts, 'reinstated replica got no traffic'
-        rows = journal.query(kinds=[journal.EventKind.LB_EJECT])
-        assert any(r['payload']['action'] == 'reinstate' for r in rows)
     finally:
         lb.stop()
         good_srv.shutdown()

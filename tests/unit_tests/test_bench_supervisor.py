@@ -304,12 +304,27 @@ def test_cpu_sched_payload_end_to_end():
     assert disagg['split']['handoff']['completed'] > 0
     assert disagg['split']['handoff']['degraded'] == 0
     assert disagg['split']['burst_completed'] == disagg['n_burst']
+    # ISSUE-20: the durable fleet KV cache numbers ride the dark tier
+    # as a FIFTH cumulative line — a cold-restarted fleet warmed from
+    # the block store must beat full recompute on TTFT p95 with
+    # prefill tokens actually saved, through the real spill → disk →
+    # reload → fetch round trip.
+    store = out['detail']['store']
+    assert store['platform'] == 'cpu'
+    assert store['ttft_improved'] is True
+    assert store['prefill_tokens_saved'] > 0
+    assert (store['warmed']['ttft_p95_ms'] <
+            store['recompute']['ttft_p95_ms'])
+    assert store['warmed']['store_fetch_hits'] > 0
+    assert store['spill']['entries'] > 0
+    assert store['recompute']['store_fetch_hits'] == 0
     # Cumulative-line contract: sched-only first, then +spec, then
-    # +routing, then +disagg (a kill mid-disagg still lands the
-    # sched+spec+routing result).
-    assert 'disagg' not in json.loads(lines[-2])['detail']
-    assert 'routing' not in json.loads(lines[-3])['detail']
-    assert 'spec' not in json.loads(lines[-4])['detail']
+    # +routing, +disagg, +store (a kill mid-store still lands the
+    # sched+spec+routing+disagg result).
+    assert 'store' not in json.loads(lines[-2])['detail']
+    assert 'disagg' not in json.loads(lines[-3])['detail']
+    assert 'routing' not in json.loads(lines[-4])['detail']
+    assert 'spec' not in json.loads(lines[-5])['detail']
     # ISSUE-13: the control-plane SLO ledger rides every perf line,
     # dark tier included — an empty journal reads zero counts with the
     # (ungated) gate recorded as passing, never an error.

@@ -1,0 +1,599 @@
+"""Durable fleet KV cache (ISSUE 20): store-warmed parity (a decode
+warmed from the persistent block store is token-identical to local
+prefill, bf16 AND int8 KV, across tp widths), torn-write recovery,
+capacity-bounded family eviction, dtype/shape mismatch rejection at
+fetch, the write-behind spill path, the pre-warm round trip, and the
+digest-aware autoscaler trigger math.
+"""
+import dataclasses
+import json
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import block_store, decode, llama, prefix_transfer
+from skypilot_tpu.models import engine as engine_lib
+from skypilot_tpu.observability import journal, metrics
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.utils import chaos
+
+
+@pytest.fixture
+def fresh_registry():
+    prev = metrics.set_registry(metrics.MetricsRegistry())
+    yield metrics.get_registry()
+    metrics.set_registry(prev)
+
+
+CFG = dataclasses.replace(llama.CONFIGS['debug'], remat=False)
+PARAMS = llama.init_params(jax.random.PRNGKey(0), CFG)
+BLOCK_K = 8
+
+
+def _dcfg(kv='bf16'):
+    return decode.DecodeConfig(max_len=64, temperature=0.0,
+                               decode_attention='xla',
+                               kernel_block_k=BLOCK_K,
+                               kv_cache_dtype=kv)
+
+
+def _engine(kv='bf16', **kwargs):
+    return engine_lib.DecodeEngine(PARAMS, CFG, _dcfg(kv), 2,
+                                   paged=True, num_blocks=33, **kwargs)
+
+
+def _drive(eng, reqs):
+    for r in reqs:
+        eng.submit(r)
+    while not all(r.done for r in reqs):
+        eng.step()
+
+
+def _shared_prefix(seed=3, n=24):
+    # Pinned tie-free seed (debug-model logit ties are fp32-accumulation
+    # -order-dependent; see tests/unit_tests/test_spec_decode.py).
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, CFG.vocab_size, size=n).tolist()
+
+
+def _store_fetch(store):
+    """Fetch transport backed by an in-process BlockStore, through the
+    FULL wire format the store role speaks: handle_store_post dispatch,
+    a JSON round trip, decode_payload."""
+
+    def fetch(url, tokens, from_tokens, budget):
+        status, reply = block_store.handle_store_post(
+            store, {'prompt': [int(t) for t in tokens],
+                    'from_tokens': int(from_tokens)})
+        assert status == 200
+        return prefix_transfer.decode_payload(json.loads(json.dumps(reply)))
+
+    return fetch
+
+
+def _store_spill(store):
+    """Spill transport: encode the engine's raw export exactly like
+    http_store_spill, JSON round trip, store-role dispatch."""
+
+    def spill(url, tokens, raw, budget):
+        body = prefix_transfer.encode_payload(
+            raw['matched_tokens'], raw['from_tokens'], raw['block_k'],
+            raw['kv_cache_dtype'], raw['arrays'])
+        body['prompt'] = [int(t) for t in tokens]
+        status, reply = block_store.handle_store_post(
+            store, json.loads(json.dumps(body)))
+        return status == 200 and bool(reply.get('ok'))
+
+    return spill
+
+
+def _no_spill(url, tokens, raw, budget):
+    """Benign spill transport for tests isolating the FETCH path: the
+    engine's default transport would POST to the fake store URL, fail,
+    and trip the shared fetch/spill backoff under test."""
+    return True
+
+
+def _pump_spills(eng, store, want=1):
+    """Run the engine loop until the write-behind spill lands (the POST
+    rides a worker thread; the loop only harvests it)."""
+    for _ in range(200):
+        eng.step()
+        if store.stats()['spills'] >= want:
+            # One more step so the loop harvests the future (counters).
+            eng.step()
+            return
+        time.sleep(0.005)
+    raise AssertionError(f'spill never landed: {store.stats()}')
+
+
+def _export_run(owner, tokens):
+    """The owner's cached run for ``tokens`` as a decoded whole-run
+    payload (what a spill persists)."""
+    raw = owner._export_prefix_now(list(tokens), 0)  # pylint: disable=protected-access
+    assert raw is not None
+    return raw
+
+
+# ------------------------------------------------------------------ parity
+
+
+@pytest.mark.parametrize('kv', ['bf16', 'int8'])
+def test_store_warmed_parity(kv, fresh_registry, tmp_path):
+    """The tier's correctness contract: after a full fleet restart, a
+    replica warmed ONLY from the durable store emits exactly the tokens
+    a cold local prefill emits — the spill→disk→fetch round trip ships
+    bf16 bytes / int8 values + scale planes verbatim and reuses the
+    peer-fetch injection path, so there is nothing to drift."""
+    shared = _shared_prefix()
+    store = block_store.BlockStore(str(tmp_path / 'store'))
+    owner = _engine(kv, store_url='store://fleet',
+                    store_fetch_fn=_store_fetch(store),
+                    store_spill_fn=_store_spill(store))
+    _drive(owner, [engine_lib.Request(shared + [1, 2, 3], 6)])
+    _pump_spills(owner, store)
+    assert store.stats()['entries'] >= 1
+    assert owner.cache_stats()['store_spills'] >= 1
+
+    # "Fleet restart": brand-new engines, empty radix caches, only the
+    # store (which outlived the owner) to warm from.
+    prompt = shared + [5, 6, 7, 8]
+    fetcher = _engine(kv, store_url='store://fleet',
+                      store_fetch_fn=_store_fetch(store),
+                      store_spill_fn=_store_spill(store))
+    control = _engine(kv)
+    rf = engine_lib.Request(prompt, 8)
+    rc = engine_lib.Request(prompt, 8)
+    _drive(fetcher, [rf])
+    _drive(control, [rc])
+
+    assert rf.tokens == rc.tokens
+    cache = fetcher.cache_stats()
+    assert cache['store_fetch_hits'] == 1
+    assert cache['store_fetch_tokens'] == len(shared)
+    assert cache['prefill_tokens_saved'] >= len(shared)
+    fetcher.flush_journal()
+    owner.flush_journal()
+    fetches = journal.query(kinds=[journal.EventKind.ENGINE_STORE_FETCH])
+    hits = [e for e in fetches if e['payload'].get('outcome') == 'hit']
+    assert hits and hits[0]['payload']['tokens_gained'] == len(shared)
+    spills = journal.query(kinds=[journal.EventKind.STORE_SPILL])
+    assert any(e['payload'].get('outcome') == 'ok' for e in spills)
+
+
+def test_store_warmed_parity_tp2(fresh_registry, tmp_path):
+    """TP interop through the durable tier: a tp=1 owner's spill warms
+    a tp=2 fetcher (entries are the unsharded logical blocks; the
+    fetcher re-shards on injection) — token-identical to a tp=2 cold
+    prefill."""
+    shared = _shared_prefix(seed=5)
+    store = block_store.BlockStore(str(tmp_path / 'store'))
+    owner = _engine(store_url='store://fleet',
+                    store_fetch_fn=_store_fetch(store),
+                    store_spill_fn=_store_spill(store))
+    _drive(owner, [engine_lib.Request(shared + [9, 9], 6)])
+    _pump_spills(owner, store)
+
+    prompt = shared + [4, 3, 2, 1]
+    fetcher = _engine(tp=2, store_url='store://fleet',
+                      store_fetch_fn=_store_fetch(store),
+                      store_spill_fn=_store_spill(store))
+    control = _engine(tp=2)
+    rf = engine_lib.Request(prompt, 8)
+    rc = engine_lib.Request(prompt, 8)
+    _drive(fetcher, [rf])
+    _drive(control, [rc])
+    assert rf.tokens == rc.tokens
+    assert fetcher.cache_stats()['store_fetch_hits'] == 1
+
+
+# ------------------------------------------------------- failure degradation
+
+
+def test_store_down_backs_off_and_degrades_to_prefill(fresh_registry):
+    """A dead store (transport None) costs ONE admission a lookup, puts
+    the store in backoff, and every request is still answered by plain
+    prefill."""
+    calls = []
+
+    def down(url, tokens, from_tokens, budget):
+        calls.append(list(tokens))
+        return None
+
+    eng = _engine(store_url='store://dead', store_fetch_fn=down,
+                  store_spill_fn=_no_spill)
+    control = _engine()
+    p1 = _shared_prefix(seed=7) + [1]
+    p2 = _shared_prefix(seed=11) + [2]
+    r1, r2 = engine_lib.Request(p1, 4), engine_lib.Request(p2, 4)
+    c1, c2 = engine_lib.Request(p1, 4), engine_lib.Request(p2, 4)
+    _drive(eng, [r1])
+    _drive(eng, [r2])
+    _drive(control, [c1])
+    _drive(control, [c2])
+    assert r1.tokens == c1.tokens and r2.tokens == c2.tokens
+    # The second admission never consulted the backed-off store.
+    assert len(calls) == 1
+    assert eng.store_in_backoff()
+    assert eng.cache_stats()['store_fetch_misses'] == 1
+    eng.flush_journal()
+    events = journal.query(kinds=[journal.EventKind.ENGINE_STORE_FETCH])
+    assert [e['payload']['outcome'] for e in events] == ['down']
+
+
+def test_store_fetch_exception_backs_off(fresh_registry):
+    """A raising transport is contained: journaled as an error with the
+    exception text, store backed off, the request served by prefill."""
+
+    def boom(url, tokens, from_tokens, budget):
+        raise RuntimeError('store exploded')
+
+    eng = _engine(store_url='store://bad', store_fetch_fn=boom,
+                  store_spill_fn=_no_spill)
+    control = _engine()
+    prompt = _shared_prefix(seed=13) + [3]
+    r = engine_lib.Request(prompt, 4)
+    c = engine_lib.Request(prompt, 4)
+    _drive(eng, [r])
+    _drive(control, [c])
+    assert r.tokens == c.tokens
+    assert eng.store_in_backoff()
+    eng.flush_journal()
+    events = journal.query(kinds=[journal.EventKind.ENGINE_STORE_FETCH])
+    assert events and events[0]['payload']['outcome'] == 'error'
+    assert 'store exploded' in events[0]['payload']['error']
+
+
+def test_store_mismatch_rejected_without_backoff(fresh_registry, tmp_path):
+    """A version-skewed store entry (wrong block_k) is rejected by the
+    shared installation validation — the decode falls back to plain
+    prefill and stays correct, and the store is NOT backed off (other
+    families may still be servable)."""
+    shared = _shared_prefix()
+    store = block_store.BlockStore(str(tmp_path / 'store'))
+    owner = _engine()
+    _drive(owner, [engine_lib.Request(shared + [1], 4)])
+    assert store.put(shared, _export_run(owner, shared))
+    inner = _store_fetch(store)
+
+    def skewed(url, tokens, from_tokens, budget):
+        payload = inner(url, tokens, from_tokens, budget)
+        payload['block_k'] = 4  # an entry from an older fleet config
+        return payload
+
+    eng = _engine(store_url='store://skew', store_fetch_fn=skewed,
+                  store_spill_fn=_no_spill)
+    control = _engine()
+    prompt = shared + [5, 6]
+    r = engine_lib.Request(prompt, 6)
+    c = engine_lib.Request(prompt, 6)
+    _drive(eng, [r])
+    _drive(control, [c])
+    assert r.tokens == c.tokens
+    assert not eng.store_in_backoff()
+    assert eng.cache_stats()['store_fetch_hits'] == 0
+    eng.flush_journal()
+    events = journal.query(kinds=[journal.EventKind.ENGINE_STORE_FETCH])
+    assert events and events[0]['payload']['outcome'] == 'mismatch'
+
+
+def test_store_dtype_skew_rejected(fresh_registry, tmp_path):
+    """A bf16 entry cannot warm an int8 engine (the scale planes it
+    needs do not exist): rejected at install, decode still correct."""
+    shared = _shared_prefix()
+    store = block_store.BlockStore(str(tmp_path / 'store'))
+    owner = _engine('bf16')
+    _drive(owner, [engine_lib.Request(shared + [1], 4)])
+    assert store.put(shared, _export_run(owner, shared))
+
+    eng = _engine('int8', store_url='store://skew',
+                  store_fetch_fn=_store_fetch(store),
+                  store_spill_fn=_no_spill)
+    control = _engine('int8')
+    prompt = shared + [5, 6]
+    r = engine_lib.Request(prompt, 6)
+    c = engine_lib.Request(prompt, 6)
+    _drive(eng, [r])
+    _drive(control, [c])
+    assert r.tokens == c.tokens
+    assert eng.cache_stats()['store_fetch_hits'] == 0
+
+
+def test_spill_failure_backs_off_store(fresh_registry, tmp_path):
+    """A refused spill is counted, journaled, and puts the store in the
+    SHARED fetch/spill backoff — fetch and spill see one store health."""
+    shared = _shared_prefix()
+    refused = []
+
+    def refuse(url, tokens, raw, budget):
+        refused.append(len(tokens))
+        return False
+
+    eng = _engine(store_url='store://full',
+                  store_fetch_fn=lambda *a: prefix_transfer.empty_payload(
+                      0, BLOCK_K, 'bf16'),
+                  store_spill_fn=refuse)
+    _drive(eng, [engine_lib.Request(shared + [1, 2, 3], 6)])
+    for _ in range(200):
+        eng.step()
+        if eng.cache_stats()['store_spill_failures']:
+            break
+        time.sleep(0.005)
+    cache = eng.cache_stats()
+    assert cache['store_spill_failures'] == 1
+    assert cache['store_spills'] == 0
+    assert refused == [len(shared)]
+    assert eng.store_in_backoff()
+    eng.flush_journal()
+    events = journal.query(kinds=[journal.EventKind.STORE_SPILL])
+    assert events and events[0]['payload']['outcome'] == 'failed'
+
+
+# ------------------------------------------------------------- torn writes
+
+
+def test_torn_entry_is_a_miss_not_garbage(fresh_registry, tmp_path,
+                                          monkeypatch):
+    """chaos ``store_torn_entry``: a spill that persists half an entry
+    (legacy non-atomic writer / disk corruption) reads back as a MISS —
+    the read side drops the entry on contact instead of deserializing
+    garbage K/V."""
+    shared = _shared_prefix()
+    owner = _engine()
+    _drive(owner, [engine_lib.Request(shared + [1], 4)])
+    raw = _export_run(owner, shared)
+
+    root = str(tmp_path / 'store')
+    store = block_store.BlockStore(root)
+    monkeypatch.setenv('SKYTPU_CHAOS', 'store_torn_entry')
+    chaos.reset()
+    try:
+        assert store.put(shared, raw)  # the spiller believes it landed
+    finally:
+        monkeypatch.delenv('SKYTPU_CHAOS')
+        chaos.reset()
+    assert store.get(shared, 0, block_k=BLOCK_K) is None
+    stats = store.stats()
+    assert stats['torn_dropped'] == 1
+    assert stats['entries'] == 0
+
+    # And the restart path: a torn entry on disk at load time is swept,
+    # never indexed.
+    monkeypatch.setenv('SKYTPU_CHAOS', 'store_torn_entry')
+    chaos.reset()
+    try:
+        assert store.put(shared, raw)
+    finally:
+        monkeypatch.delenv('SKYTPU_CHAOS')
+        chaos.reset()
+    reloaded = block_store.BlockStore(root)
+    assert reloaded.stats()['entries'] == 0
+    assert reloaded.stats()['torn_dropped'] == 1
+    assert reloaded.get(shared, 0, block_k=BLOCK_K) is None
+
+
+def test_interrupted_tmp_spill_swept_on_load(fresh_registry, tmp_path):
+    """A crash between tmp write and rename leaves only a tmp file; the
+    restart sweeps it and the good entry still serves."""
+    import os
+    shared = _shared_prefix()
+    owner = _engine()
+    _drive(owner, [engine_lib.Request(shared + [1], 4)])
+    root = str(tmp_path / 'store')
+    store = block_store.BlockStore(root)
+    assert store.put(shared, _export_run(owner, shared))
+    fam_dir = os.path.join(root, block_store.family_digest(shared))
+    tmp = os.path.join(fam_dir, 'deadbeef.json.tmp-123-456')
+    with open(tmp, 'wb') as f:
+        f.write(b'{"half": ')
+    reloaded = block_store.BlockStore(root)
+    assert not os.path.exists(tmp)
+    assert reloaded.stats()['entries'] == 1
+    assert reloaded.get(shared, 0, block_k=BLOCK_K) is not None
+
+
+def test_store_survives_restart(fresh_registry, tmp_path):
+    """The point of the tier: entries persist across a store-process
+    restart and still warm a cold engine to parity."""
+    shared = _shared_prefix()
+    owner = _engine()
+    _drive(owner, [engine_lib.Request(shared + [1], 4)])
+    root = str(tmp_path / 'store')
+    block_store.BlockStore(root).put(shared, _export_run(owner, shared))
+
+    reloaded = block_store.BlockStore(root)  # fresh index from disk
+    eng = _engine(store_url='store://fleet',
+                  store_fetch_fn=_store_fetch(reloaded),
+                  store_spill_fn=_no_spill)
+    control = _engine()
+    prompt = shared + [5, 6]
+    r = engine_lib.Request(prompt, 6)
+    c = engine_lib.Request(prompt, 6)
+    _drive(eng, [r])
+    _drive(control, [c])
+    assert r.tokens == c.tokens
+    assert eng.cache_stats()['store_fetch_hits'] == 1
+
+
+# ------------------------------------------------------- store-side policy
+
+
+def test_capacity_evicts_coldest_family(fresh_registry, tmp_path):
+    """LRU eviction over digest families: with room for two entries,
+    admitting a third evicts the family touched longest ago — not the
+    one just read."""
+    runs = [_shared_prefix(seed=s) for s in (3, 5, 7)]
+    owner = _engine()
+    for run in runs:
+        _drive(owner, [engine_lib.Request(run + [1], 4)])
+    payloads = [_export_run(owner, run) for run in runs]
+
+    probe = block_store.BlockStore(str(tmp_path / 'probe'))
+    assert probe.put(runs[0], payloads[0])
+    entry_bytes = probe.stats()['bytes']
+
+    store = block_store.BlockStore(str(tmp_path / 'store'),
+                                   capacity_bytes=int(entry_bytes * 2.5))
+    assert store.put(runs[0], payloads[0])
+    assert store.put(runs[1], payloads[1])
+    assert store.get(runs[0], 0, block_k=BLOCK_K) is not None  # touch A
+    assert store.put(runs[2], payloads[2])  # over capacity → evict B
+    stats = store.stats()
+    assert stats['evictions'] == 1
+    assert stats['entries'] == 2
+    fams = set(store.families())
+    assert block_store.family_digest(runs[0]) in fams
+    assert block_store.family_digest(runs[2]) in fams
+    assert block_store.family_digest(runs[1]) not in fams
+    assert store.get(runs[1], 0, block_k=BLOCK_K) is None
+
+
+def test_prefix_chain_coexists_longest_wins(fresh_registry, tmp_path):
+    """A shared head and a longer tail-specific run of the same prompt
+    chain COEXIST: ``get`` probes longest-first, so a fetcher extending
+    the full run gets all of it, while a fetcher sharing only the head
+    still hits the short entry (pruning it would turn every other tail
+    of the family into a miss)."""
+    shared = _shared_prefix(n=32)
+    owner = _engine()
+    _drive(owner, [engine_lib.Request(shared + [1], 4)])
+    store = block_store.BlockStore(str(tmp_path / 'store'))
+    assert store.put(shared[:16], owner._export_prefix_now(shared[:16], 0))  # pylint: disable=protected-access
+    assert store.put(shared, _export_run(owner, shared))
+    assert store.stats()['entries'] == 2
+    # Extending the full run: the longest entry serves, sliced to the
+    # fetcher's offset (it already holds the first 16 tokens).
+    got = store.get(shared, 16, block_k=BLOCK_K)
+    assert got is not None
+    assert got['from_tokens'] == 16 and got['matched_tokens'] == 32
+    # Sharing only the head: a different tail still hits the short
+    # entry — the shareability the durable tier exists for.
+    other_tail = shared[:16] + [7] * 16
+    got = store.get(other_tail, 0, block_k=BLOCK_K)
+    assert got is not None and got['matched_tokens'] == 16
+
+
+def test_prewarm_roundtrip_warms_cold_engine(fresh_registry, tmp_path):
+    """The /prewarm engine half: a family digest resolves to its
+    longest stored run (the LB routing digest IS the family key) and
+    injects into a cold engine, so the first real request of that
+    family prefills only its tail."""
+    shared = _shared_prefix()
+    owner = _engine()
+    _drive(owner, [engine_lib.Request(shared + [1], 4)])
+    store = block_store.BlockStore(str(tmp_path / 'store'))
+    assert store.put(shared, _export_run(owner, shared))
+
+    status, body = block_store.handle_store_post(
+        store, {'digest': block_store.family_digest(shared)})
+    assert status == 200 and body.get('prompt') == list(shared)
+    tokens = [int(t) for t in body['prompt']]
+    payload = prefix_transfer.decode_payload(json.loads(json.dumps(body)))
+
+    eng = _engine()
+    # The injection resolves only when the engine LOOP services the
+    # job (the handshake the HTTP /prewarm handler rides), so inject
+    # from a side thread while stepping the loop.
+    import threading
+    box = {}
+    t = threading.Thread(
+        target=lambda: box.update(
+            res=eng.inject_handoff_blocks(tokens, payload)))
+    t.start()
+    while t.is_alive():
+        eng.step()
+        time.sleep(0.001)
+    t.join()
+    res = box['res']
+    assert res['ok'] and res['gained'] == len(shared)
+    control = _engine()
+    prompt = shared + [5, 6]
+    r = engine_lib.Request(prompt, 6)
+    c = engine_lib.Request(prompt, 6)
+    _drive(eng, [r])
+    _drive(control, [c])
+    assert r.tokens == c.tokens
+    assert eng.cache_stats()['prefill_tokens_saved'] >= len(shared)
+
+
+def test_handle_store_post_never_500s(fresh_registry, tmp_path):
+    """The store role's dispatch: malformed bodies are 400s with a
+    reason, misses are honest 200s — never an exception."""
+    store = block_store.BlockStore(str(tmp_path / 'store'))
+    assert block_store.handle_store_post(store, 'nonsense')[0] == 400
+    assert block_store.handle_store_post(store, {})[0] == 400
+    assert block_store.handle_store_post(
+        store, {'prompt': ['x', 'y']})[0] == 400
+    assert block_store.handle_store_post(
+        store, {'arrays': {}, 'prompt': [1, 2]})[0] == 400
+    # Fetch miss: the honest empty payload, not an error.
+    status, body = block_store.handle_store_post(
+        store, {'prompt': [1, 2, 3], 'from_tokens': 0})
+    assert status == 200
+    assert prefix_transfer.decode_payload(body)['arrays'] == {}
+    # Pre-warm miss.
+    assert block_store.handle_store_post(
+        store, {'digest': 'f' * 16}) == (200, {'ok': False})
+
+
+def test_store_slow_chaos_delays_lookup(fresh_registry, tmp_path,
+                                        monkeypatch):
+    """chaos ``store_slow``: one armed lookup wedges for the configured
+    window (the engine's wall-clock fetch budget is what keeps this
+    from stalling admissions in the fleet)."""
+    store = block_store.BlockStore(str(tmp_path / 'store'))
+    monkeypatch.setenv('SKYTPU_CHAOS', 'store_slow:1')
+    monkeypatch.setenv('SKYTPU_CHAOS_STORE_SLOW_SECONDS', '0.05')
+    chaos.reset()
+    try:
+        t0 = time.perf_counter()
+        assert store.get([1, 2, 3, 4, 5, 6, 7, 8], 0,
+                         block_k=BLOCK_K) is None
+        assert time.perf_counter() - t0 >= 0.05
+        t0 = time.perf_counter()  # counted point: fires once
+        store.get([1, 2, 3, 4, 5, 6, 7, 8], 0, block_k=BLOCK_K)
+        assert time.perf_counter() - t0 < 0.05
+    finally:
+        chaos.reset()
+
+
+# ------------------------------------------------- digest-aware autoscaling
+
+
+def test_digest_family_demand_math():
+    """The hot-family floor: one replica per family at ≥ hot_fraction ×
+    target_qps (default 0.5), and degenerate inputs demand nothing."""
+    demand = autoscalers.digest_family_demand
+    # 600 req / 60 s = 10 qps ≥ 0.5×10 → hot; 10/60 is not.
+    assert demand({'a': 600, 'b': 10}, 60.0, 10.0) == 1
+    # Boundary is inclusive: exactly half the target counts.
+    assert demand({'a': 300}, 60.0, 10.0) == 1
+    assert demand({'a': 299}, 60.0, 10.0) == 0
+    # Several hot families each demand their own owner.
+    assert demand({'a': 600, 'b': 600, 'c': 600}, 60.0, 10.0) == 3
+    # Degenerate inputs: no signal, no demand.
+    assert demand(None, 60.0, 10.0) == 0
+    assert demand({}, 60.0, 10.0) == 0
+    assert demand({'a': 600}, 0.0, 10.0) == 0
+    assert demand({'a': 600}, 60.0, None) == 0
+    assert demand({'a': 600}, 60.0, 0.0) == 0
+
+
+def test_digest_family_demand_fraction_knob(monkeypatch):
+    monkeypatch.setenv(autoscalers.DIGEST_HOT_FRACTION_ENV, '1.0')
+    assert autoscalers.digest_family_demand({'a': 300}, 60.0, 10.0) == 0
+    assert autoscalers.digest_family_demand({'a': 600}, 60.0, 10.0) == 1
+    monkeypatch.setenv(autoscalers.DIGEST_HOT_FRACTION_ENV, '0')
+    assert autoscalers.digest_family_demand({'a': 600}, 60.0, 10.0) == 0
+
+
+def test_family_digest_matches_lb_route_prefix_encoding():
+    """The family key and the LB routing digest use one encoding over
+    one head window, so the controller can hand LB-reported hot digests
+    straight to the store's pre-warm lookup."""
+    from skypilot_tpu.serve import load_balancing_policies as lbp
+    tokens = list(range(40))
+    assert (block_store.family_digest(tokens, family_tokens=16)
+            == lbp.prefix_digest(tokens, block_tokens=16, max_tokens=16))

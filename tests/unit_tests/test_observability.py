@@ -354,7 +354,13 @@ def test_all_registered_metric_names_match_convention():
                      # Journal self-observability (ISSUE 19).
                      'skytpu_journal_dropped_total',
                      'skytpu_journal_flush_seconds',
-                     'skytpu_journal_events_total'):
+                     'skytpu_journal_events_total',
+                     # Durable fleet KV cache (ISSUE 20).
+                     'skytpu_store_fetches_total',
+                     'skytpu_store_spills_total',
+                     'skytpu_prewarm_requests_total',
+                     'skytpu_prewarm_tokens_total',
+                     'skytpu_prewarm_dispatched_total'):
         assert expected in names, f'{expected} not found by lint scan'
 
 
@@ -419,7 +425,10 @@ def test_all_journal_event_kinds_are_registered():
                      # Disaggregated prefill/decode handoff (ISSUE 16).
                      'ENGINE_HANDOFF',
                      # Journal write-stall self-observability (ISSUE 19).
-                     'JOURNAL_STALL'):
+                     'JOURNAL_STALL',
+                     # Durable fleet KV cache (ISSUE 20).
+                     'ENGINE_STORE_FETCH', 'STORE_SPILL',
+                     'AUTOSCALE_PREWARM'):
         assert expected in attr_names, \
             f'EventKind.{expected} not found by lint scan'
 

@@ -12,6 +12,12 @@ from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu.provision.gcp import instance as gcp_instance
 from skypilot_tpu.provision.gcp import tpu_api
 
+try:
+    from cryptography.hazmat.primitives.asymmetric import rsa  # noqa: F401
+    _HAS_CRYPTOGRAPHY = True
+except ImportError:
+    _HAS_CRYPTOGRAPHY = False
+
 
 @pytest.fixture(autouse=True)
 def fake_gcp(monkeypatch):
@@ -126,6 +132,11 @@ def test_qr_denial_feeds_failover_blocklist(monkeypatch):
         assert h.classify(exc) == h.ZONE
 
 
+@pytest.mark.skipif(
+    not _HAS_CRYPTOGRAPHY,
+    reason='make_provision_config generates the control-plane SSH keypair '
+    'via cryptography.hazmat RSA (authentication._generate_keypair); '
+    'this host has no cryptography package')
 def test_deploy_vars_surface_qr_knobs(monkeypatch):
     """Resources(accelerator_args={'queued_resources': ..}) reaches the
     provisioner's node_config; config fallback applies otherwise."""
